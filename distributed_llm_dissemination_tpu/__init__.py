@@ -1,0 +1,15 @@
+"""TPU-native model-weight dissemination framework.
+
+A ground-up re-design of ``ynishimi/distributed-llm-dissemination`` for TPU
+pods: given a declarative ``Assignment`` of model layers to nodes, it
+disseminates LLM weight layers under pluggable schedules — naive leader
+broadcast (mode 0), peer retransmission (mode 1), pull/work-stealing
+(mode 2), and a max-flow-optimal plan (mode 3) — then signals readiness and
+reports time-to-deliver.  The host control plane mirrors the reference's
+announce/ack/retransmit/startup protocol; the data plane is JAX/XLA
+collectives over ICI/DCN landing weights directly in TPU HBM, with the
+Assignment mapping to pipeline-parallel device groups on a
+``jax.sharding.Mesh``.
+"""
+
+__version__ = "0.1.0"

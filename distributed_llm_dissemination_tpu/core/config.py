@@ -1,0 +1,267 @@
+"""JSON topology config, schema-compatible with the reference.
+
+Mirrors ``/root/reference/cmd/config.go:14-45``: one JSON file holds the
+node list (addr, leader bit, NIC bandwidth, per-source rate limits, initial
+layer placement with per-layer sizes), external clients, the target
+``Assignment``, and a default ``LayerSize``.  TPU extension: an optional
+``Mesh`` section describing the device mesh the Assignment maps onto
+(axis names/sizes, which axis is the pipeline axis) so dissemination can
+land layers directly in HBM with pipeline-stage placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+from .types import (
+    Assignment,
+    LayerID,
+    LayerMeta,
+    LayerLocation,
+    LayerSrc,
+    LayersSrc,
+    NodeID,
+    SourceType,
+    assignment_from_json,
+)
+
+
+def _jget(d: dict, key: str, default=None):
+    """Go-style JSON field lookup: exact key first, then case-insensitive
+    (encoding/json unmarshal semantics — the reference's own config.json
+    uses ``Id`` against a struct field ``ID``)."""
+    if key in d:
+        return d[key]
+    lk = key.lower()
+    for k, v in d.items():
+        if k.lower() == lk:
+            return v
+    return default
+
+
+@dataclasses.dataclass
+class MeshConf:
+    """TPU extension: device-mesh description for the HBM data plane."""
+
+    axis_names: List[str] = dataclasses.field(default_factory=lambda: ["nodes"])
+    axis_sizes: List[int] = dataclasses.field(default_factory=lambda: [1])
+    pipeline_axis: str = "nodes"
+
+    @classmethod
+    def from_json(cls, d: dict) -> "MeshConf":
+        return cls(
+            axis_names=list(_jget(d, "AxisNames", ["nodes"])),
+            axis_sizes=[int(s) for s in _jget(d, "AxisSizes", [1])],
+            pipeline_axis=_jget(d, "PipelineAxis", "nodes"),
+        )
+
+
+@dataclasses.dataclass
+class NodeConf:
+    """Per-node config (cmd/config.go:21-28)."""
+
+    id: NodeID
+    addr: str
+    network_bw: int = 0  # NIC bandwidth, bytes/sec
+    is_leader: bool = False
+    # SourceType -> rate limit (bytes/sec)  (cmd/config.go:26)
+    sources: Dict[SourceType, int] = dataclasses.field(default_factory=dict)
+    # SourceType -> {LayerID -> layer size}  (cmd/config.go:30-36)
+    initial_layers: Dict[SourceType, Dict[LayerID, int]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    @classmethod
+    def from_json(cls, d: dict) -> "NodeConf":
+        sources = {
+            SourceType(int(k)): int(v)
+            for k, v in (_jget(d, "Sources") or {}).items()
+        }
+        initial: Dict[SourceType, Dict[LayerID, int]] = {}
+        for st, by_layer in (_jget(d, "InitialLayers") or {}).items():
+            initial[SourceType(int(st))] = {
+                int(lid): int(_jget(lc or {}, "LayerSize", 0))
+                for lid, lc in by_layer.items()
+            }
+        return cls(
+            id=int(_jget(d, "ID", 0) or 0),
+            addr=_jget(d, "Addr", ""),
+            network_bw=int(_jget(d, "NetworkBW", 0)),
+            is_leader=bool(_jget(d, "IsLeader", False)),
+            sources=sources,
+            initial_layers=initial,
+        )
+
+
+@dataclasses.dataclass
+class ClientConf:
+    """External weight-source config (cmd/config.go:41-45).
+
+    ``layers_rate_limit`` maps LayerID -> bytes/sec serving rate (the JSON
+    key is ``Layers``, as in the reference).
+    """
+
+    id: NodeID
+    addr: str
+    layers_rate_limit: Dict[LayerID, int] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ClientConf":
+        return cls(
+            id=int(_jget(d, "ID", 0) or 0),
+            addr=_jget(d, "Addr", ""),
+            layers_rate_limit={
+                int(k): int(v) for k, v in (_jget(d, "Layers") or {}).items()
+            },
+        )
+
+
+@dataclasses.dataclass
+class Config:
+    """Top-level config (cmd/config.go:14-19) + TPU mesh extension."""
+
+    nodes: List[NodeConf] = dataclasses.field(default_factory=list)
+    clients: List[ClientConf] = dataclasses.field(default_factory=list)
+    assignment: Assignment = dataclasses.field(default_factory=dict)
+    layer_size: int = 0
+    mesh: Optional[MeshConf] = None
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Config":
+        return cls(
+            nodes=[NodeConf.from_json(n) for n in _jget(d, "Nodes") or []],
+            clients=[ClientConf.from_json(c) for c in _jget(d, "Clients") or []],
+            assignment=assignment_from_json(_jget(d, "Assignment") or {}),
+            layer_size=int(_jget(d, "LayerSize", 0)),
+            mesh=MeshConf.from_json(_jget(d, "Mesh")) if _jget(d, "Mesh") else None,
+        )
+
+
+def read_json(path: str) -> Config:
+    """Load a topology config file (cmd/config.go:48-62)."""
+    with open(path, "r") as f:
+        return Config.from_json(json.load(f))
+
+
+def get_leader_conf(conf: Config) -> NodeConf:
+    """First node with IsLeader set (cmd/config.go:64-72)."""
+    for nc in conf.nodes:
+        if nc.is_leader:
+            return nc
+    raise ValueError("no leader found")
+
+
+def get_node_conf(conf: Config, node: NodeID) -> NodeConf:
+    for nc in conf.nodes:
+        if nc.id == node:
+            return nc
+    raise ValueError(f"no node found: {node}")
+
+
+def get_client_conf(conf: Config, node: NodeID) -> ClientConf:
+    for cc in conf.clients:
+        if cc.id == node:
+            return cc
+    raise ValueError(f"no client found: {node}")
+
+
+# ---------------------------------------------------------------------------
+# Dummy-layer fabrication (cmd/config.go:94-198)
+# ---------------------------------------------------------------------------
+
+
+def create_layers(my_conf: NodeConf, save_disk: bool, storage_path: str = ".") -> LayersSrc:
+    """Fabricate this node's initial layers (cmd/config.go:94-117).
+
+    ``SourceType`` is a *rate class* keying the per-source limit, not a
+    storage location: layers are fabricated in RAM unless ``save_disk``
+    (the reference's ``-s`` flag) forces disk-backed files.
+    """
+    layers: LayersSrc = {}
+    for source_type, by_layer in my_conf.initial_layers.items():
+        for layer_id, size in by_layer.items():
+            size = max(0, size)
+            if save_disk:
+                src = create_disk_layer(my_conf.id, layer_id, size, storage_path)
+            else:
+                src = create_inmem_layer(layer_id, size)
+            src.data_size = size
+            src.meta.limit_rate = my_conf.sources.get(source_type, 0)
+            src.meta.source_type = source_type
+            layers[layer_id] = src
+    return layers
+
+
+def add_client_layers(
+    client_conf: ClientConf, layer_size: int, layers: LayersSrc
+) -> LayersSrc:
+    """Record layers served by this node's external client
+    (cmd/config.go:119-131); layers already in RAM/disk win."""
+    for layer_id, limit_rate in client_conf.layers_rate_limit.items():
+        if layer_id in layers:
+            continue
+        layers[layer_id] = create_client_layer_info(layer_id, layer_size, limit_rate)
+    return layers
+
+
+def create_disk_layer(
+    my_id: NodeID, layer_id: LayerID, layer_size: int, storage_path: str
+) -> LayerSrc:
+    """Write a dummy layer file ``layers/<nodeID>/<layerID>.layer``
+    (cmd/config.go:133-157)."""
+    d = os.path.join(storage_path, "layers", str(my_id))
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{layer_id}.layer")
+    if not os.path.exists(path):
+        with open(path, "wb") as f:
+            f.write(b"\x00" * layer_size)
+    return LayerSrc(
+        inmem_data=None,
+        fp=path,
+        data_size=layer_size,
+        offset=0,
+        meta=LayerMeta(location=LayerLocation.DISK, source_type=SourceType.DISK),
+    )
+
+
+def create_inmem_layer(layer_id: LayerID, layer_size: int) -> LayerSrc:
+    """Dummy in-RAM layer (cmd/config.go:159-171)."""
+    return LayerSrc(
+        inmem_data=bytearray(layer_size),
+        fp="",
+        data_size=layer_size,
+        offset=0,
+        meta=LayerMeta(location=LayerLocation.INMEM, source_type=SourceType.MEM),
+    )
+
+
+def create_client_layer(layer_id: LayerID, layer_size: int, limit_rate: int) -> LayerSrc:
+    """A layer held *at the client process itself* (cmd/config.go:174-184)."""
+    src = create_inmem_layer(layer_id, layer_size)
+    src.meta = LayerMeta(
+        location=LayerLocation.INMEM,
+        limit_rate=limit_rate,
+        source_type=SourceType.CLIENT,
+    )
+    return src
+
+
+def create_client_layer_info(
+    layer_id: LayerID, layer_size: int, limit_rate: int
+) -> LayerSrc:
+    """The *node's record* of a layer that lives at its external client
+    (cmd/config.go:187-198)."""
+    return LayerSrc(
+        inmem_data=None,
+        fp="",
+        data_size=layer_size,
+        offset=0,
+        meta=LayerMeta(
+            location=LayerLocation.CLIENT,
+            limit_rate=limit_rate,
+            source_type=SourceType.CLIENT,
+        ),
+    )

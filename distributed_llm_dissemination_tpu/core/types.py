@@ -1,0 +1,153 @@
+"""Core identifier and layer-store types.
+
+TPU-native re-design of the reference's core types
+(``/root/reference/distributor/node.go:128-211``): a *layer* is an opaque
+byte blob that may live in host RAM, on disk, at an external client process,
+or — new in this framework — in TPU HBM as a ``jax.Array`` sharded over a
+``jax.sharding.Mesh``. The *Assignment* (node → layers it must end up
+holding, ``distributor/node.go:174``) doubles as the pipeline-parallel stage
+placement for the model that boots after dissemination.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, Optional, Set
+
+# Reference: distributor/node.go:128-129 — uint identifiers.
+NodeID = int
+LayerID = int
+
+# Reference: distributor/node.go:132 — a set of node IDs.
+NodeIDs = Set[NodeID]
+
+# Reference: distributor/client.go:10 — clients use the max uint as their ID.
+# Python ints are unbounded; pick the Go MaxUint64 for wire compatibility.
+CLIENT_ID: NodeID = (1 << 64) - 1
+
+
+class LayerLocation(enum.IntEnum):
+    """Where a layer currently lives (distributor/node.go:182-189).
+
+    ``HBM`` is new: the layer has been materialized as a device array on the
+    TPU — the terminal state for this framework's data plane, whereas the
+    reference's terminal state is host RAM (``InmemLayer``).
+    """
+
+    INMEM = 0
+    DISK = 1
+    CLIENT = 2
+    HBM = 3
+
+
+class SourceType(enum.IntEnum):
+    """Class of a layer's origin, keying per-source rate limits
+    (distributor/node.go:192-198)."""
+
+    CLIENT = 0
+    DISK = 1
+    MEM = 2
+
+
+@dataclasses.dataclass
+class LayerMeta:
+    """Per-layer metadata (distributor/node.go:134-138)."""
+
+    location: LayerLocation = LayerLocation.INMEM
+    limit_rate: int = 0  # bytes/sec; 0 = unlimited
+    source_type: SourceType = SourceType.MEM
+
+    def to_json(self) -> dict:
+        return {
+            "Location": int(self.location),
+            "LimitRate": self.limit_rate,
+            "SourceType": int(self.source_type),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "LayerMeta":
+        return cls(
+            location=LayerLocation(d.get("Location", 0)),
+            limit_rate=int(d.get("LimitRate", 0)),
+            source_type=SourceType(d.get("SourceType", 0)),
+        )
+
+
+# Reference: distributor/node.go:141 — map[LayerID]LayerMeta, a set with
+# metadata.  JSON keys are strings, mirroring Go's map encoding.
+LayerIDs = Dict[LayerID, LayerMeta]
+
+
+def layer_ids_to_json(layers: LayerIDs) -> dict:
+    return {str(lid): meta.to_json() for lid, meta in layers.items()}
+
+
+def layer_ids_from_json(d: dict) -> LayerIDs:
+    return {int(lid): LayerMeta.from_json(meta) for lid, meta in d.items()}
+
+
+@dataclasses.dataclass
+class LayerSrc:
+    """A layer's storage record (distributor/node.go:200-211).
+
+    Exactly one of ``inmem_data`` / ``fp`` / client-location describes where
+    the bytes are; ``device_array`` is the TPU-native extension — once a
+    layer has been staged into HBM it is a jax.Array and ``meta.location``
+    is ``LayerLocation.HBM``.
+    """
+
+    inmem_data: Optional[bytearray] = None
+    fp: str = ""  # file path when on disk
+    data_size: int = 0
+    offset: int = 0
+    meta: LayerMeta = dataclasses.field(default_factory=LayerMeta)
+    # TPU-native: the layer materialized on device (jax.Array), if staged.
+    device_array: object = None
+
+    def read_bytes(self) -> bytes:
+        """Materialize the layer's bytes on the host (RAM or disk source)."""
+        if self.meta.location == LayerLocation.INMEM and self.inmem_data is not None:
+            return bytes(self.inmem_data)
+        if self.meta.location == LayerLocation.DISK and self.fp:
+            with open(self.fp, "rb") as f:
+                f.seek(self.offset)
+                return f.read(self.data_size)
+        raise ValueError(
+            f"layer has no host-readable bytes (location={self.meta.location!r})"
+        )
+
+
+# Reference: distributor/node.go:166 — node's layer store.
+LayersSrc = Dict[LayerID, LayerSrc]
+
+# Reference: distributor/node.go:174-176 — the goal state (node → layers it
+# must hold) and the leader's live view of who holds what.
+Assignment = Dict[NodeID, LayerIDs]
+Status = Dict[NodeID, LayerIDs]
+
+
+def assignment_to_json(a: Assignment) -> dict:
+    return {str(nid): layer_ids_to_json(layers) for nid, layers in a.items()}
+
+
+def assignment_from_json(d: dict) -> Assignment:
+    return {int(nid): layer_ids_from_json(layers) for nid, layers in d.items()}
+
+
+@dataclasses.dataclass
+class RoutingInfo:
+    """Next-hop entry (distributor/node.go:168-171)."""
+
+    next_hop: NodeID
+    remaining_hops: int = 1
+
+
+def delivered(meta: LayerMeta) -> bool:
+    """Whether a layer counts as delivered for assignment satisfaction.
+
+    The reference requires ``InmemLayer`` (distributor/node.go:435-446);
+    the TPU build additionally accepts HBM, which is strictly "more
+    delivered" — the bytes are already on the accelerator.
+    """
+    return meta.location in (LayerLocation.INMEM, LayerLocation.HBM)

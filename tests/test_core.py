@@ -1,0 +1,146 @@
+"""Core types, config loader, fabrication, logging, rate limiter."""
+
+import io
+import json
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core import (
+    Assignment,
+    LayerLocation,
+    LayerMeta,
+    SourceType,
+    assignment_from_json,
+    assignment_to_json,
+    create_layers,
+    delivered,
+    get_leader_conf,
+    read_json,
+)
+from distributed_llm_dissemination_tpu.core.config import Config
+from distributed_llm_dissemination_tpu.utils import JsonLogger, PacedWriter, TokenBucket
+
+
+# A config in the reference's JSON schema (readme.md:15-64, cmd/config.go:14-45).
+REFERENCE_STYLE_CONFIG = {
+    "Nodes": [
+        {
+            "ID": 0,
+            "Addr": ":8080",
+            "NetworkBW": 1562500000,
+            "IsLeader": True,
+            "Sources": {"1": 209715200, "2": 0},
+            "InitialLayers": {
+                "1": {"0": {"LayerSize": 1048576}, "1": {"LayerSize": 1048576}}
+            },
+        },
+        {
+            "ID": 1,
+            "Addr": ":8081",
+            "NetworkBW": 1562500000,
+            "IsLeader": False,
+            "Sources": {},
+            "InitialLayers": {},
+        },
+    ],
+    "Clients": [{"ID": 18446744073709551615, "Addr": ":9090", "Layers": {"2": 16257500}}],
+    "Assignment": {"1": {"0": {"Location": 0}, "1": {"Location": 0}}},
+    "LayerSize": 1048576,
+}
+
+
+def test_config_roundtrip(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(REFERENCE_STYLE_CONFIG))
+    conf = read_json(str(p))
+    assert len(conf.nodes) == 2
+    leader = get_leader_conf(conf)
+    assert leader.id == 0 and leader.addr == ":8080"
+    assert leader.sources[SourceType.DISK] == 209715200
+    assert leader.initial_layers[SourceType.DISK][0] == 1048576
+    assert conf.layer_size == 1048576
+    assert conf.clients[0].layers_rate_limit[2] == 16257500
+    # Assignment parsed with int keys and LayerMeta values.
+    assert 1 in conf.assignment
+    assert conf.assignment[1][0].location == LayerLocation.INMEM
+
+
+def test_create_layers_inmem_and_disk(tmp_path):
+    conf = Config.from_json(REFERENCE_STYLE_CONFIG)
+    leader = get_leader_conf(conf)
+    # SourceType is a rate class, not a location: without save_disk the
+    # layers live in RAM (reference cmd/config.go:104-109).
+    layers = create_layers(leader, save_disk=False, storage_path=str(tmp_path))
+    assert set(layers) == {0, 1}
+    src = layers[0]
+    assert src.meta.location == LayerLocation.INMEM
+    assert src.data_size == 1048576
+    assert src.meta.limit_rate == 209715200
+    assert src.meta.source_type == SourceType.DISK
+    assert len(src.read_bytes()) == 1048576
+    # save_disk (the -s flag) forces disk-backed files.
+    disk_layers = create_layers(leader, save_disk=True, storage_path=str(tmp_path))
+    assert disk_layers[0].meta.location == LayerLocation.DISK
+    assert len(disk_layers[0].read_bytes()) == 1048576
+    # Re-fabrication reuses the existing file.
+    disk_layers2 = create_layers(leader, save_disk=True, storage_path=str(tmp_path))
+    assert disk_layers2[0].fp == disk_layers[0].fp
+
+
+def test_assignment_json_roundtrip():
+    a: Assignment = {7: {i: LayerMeta() for i in range(8)}}
+    back = assignment_from_json(assignment_to_json(a))
+    assert set(back) == {7}
+    assert set(back[7]) == set(range(8))
+
+
+def test_delivered_semantics():
+    # Reference: delivery means "in RAM" (node.go:435-446); HBM also counts here.
+    assert delivered(LayerMeta(location=LayerLocation.INMEM))
+    assert delivered(LayerMeta(location=LayerLocation.HBM))
+    assert not delivered(LayerMeta(location=LayerLocation.DISK))
+    assert not delivered(LayerMeta(location=LayerLocation.CLIENT))
+
+
+def test_json_logger_fields():
+    buf = io.StringIO()
+    lg = JsonLogger(node="3", stream=buf, level="debug")
+    lg.info("timer start", layer=5)
+    rec = json.loads(buf.getvalue())
+    assert rec["node"] == "3" and rec["message"] == "timer start"
+    assert rec["layer"] == 5 and isinstance(rec["time"], int)
+
+
+def test_json_logger_level_filter():
+    buf = io.StringIO()
+    lg = JsonLogger(stream=buf, level="info")
+    lg.debug("hidden")
+    assert buf.getvalue() == ""
+
+
+def test_token_bucket_paces():
+    # 1 MiB at 4 MiB/s with a 64 KiB burst should take ~0.23s (burst credit).
+    bucket = TokenBucket(rate=4 * 1024 * 1024, burst=64 * 1024)
+    t0 = time.monotonic()
+    total = 1024 * 1024
+    step = 64 * 1024
+    for _ in range(total // step):
+        bucket.wait_n(step)
+    elapsed = time.monotonic() - t0
+    assert 0.1 < elapsed < 1.0
+
+
+def test_token_bucket_unlimited_is_instant():
+    bucket = TokenBucket(rate=0)
+    t0 = time.monotonic()
+    bucket.wait_n(10**9)
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_paced_writer_delivers_all_bytes():
+    out = bytearray()
+    w = PacedWriter(out.extend, rate=50 * 1024 * 1024, burst=16 * 1024)
+    payload = bytes(range(256)) * 1024  # 256 KiB
+    assert w.write(payload) == len(payload)
+    assert bytes(out) == payload
