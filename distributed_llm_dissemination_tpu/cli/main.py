@@ -1,0 +1,177 @@
+"""Process entry point: reference-compatible CLI.
+
+Re-design of ``/root/reference/cmd/main.go``: same flags
+(``-id -f -s -m -l -c -v``, cmd/main.go:15-21), same JSON config, same role
+dispatch (leader / receiver / external client), same "Time to deliver"
+measurement printed from the leader.  Run one process per node:
+
+    python -m distributed_llm_dissemination_tpu.cli.main -id 0 -f conf.json -m 1
+    python -m distributed_llm_dissemination_tpu.cli.main -id 1 -f conf.json -m 1
+    python -m distributed_llm_dissemination_tpu.cli.main -id 2 -f conf.json -c
+
+An external client shares the node ID it is attached to (``-c`` selects the
+client role for that ID, cmd/main.go:69-91).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..core import config as cfg
+from ..core.types import CLIENT_ID
+from ..runtime import (
+    Client,
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    LeaderNode,
+    Node,
+    PullRetransmitLeaderNode,
+    ReceiverNode,
+    RetransmitLeaderNode,
+    RetransmitReceiverNode,
+)
+from ..transport import TcpTransport
+from ..utils import logging as ulog
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # Single-dash long flags, matching the Go CLI (cmd/main.go:15-21).
+    p = argparse.ArgumentParser(
+        prog="distributor", description=__doc__, prefix_chars="-"
+    )
+    p.add_argument("-id", type=int, required=True, help="my ID")
+    p.add_argument("-f", type=str, required=True,
+                   help="filename of topology JSON file")
+    p.add_argument("-s", type=str, default="",
+                   help="path of storing layers (empty: keep layers in RAM)")
+    p.add_argument("-m", type=int, default=0, choices=[0, 1, 2, 3],
+                   help="0: naive, 1: retransmit, 2: pull, 3: max-flow")
+    p.add_argument("-l", action="store_true",
+                   help="create layer files and exit")
+    p.add_argument("-c", action="store_true", help="if the process is client")
+    p.add_argument("-v", action="store_true", help="output debug messages")
+    return p
+
+
+def run_client(args, conf: cfg.Config) -> int:
+    """External-client role: serve layers to the node with my ID
+    (cmd/main.go:69-91, 217-220)."""
+    client_conf = cfg.get_client_conf(conf, args.id)
+    node_conf = cfg.get_node_conf(conf, args.id)
+    transport = TcpTransport(
+        client_conf.addr,
+        addr_registry={node_conf.id: node_conf.addr},
+        is_client=True,
+    )
+    layers = {
+        lid: cfg.create_client_layer(lid, conf.layer_size, rate)
+        for lid, rate in client_conf.layers_rate_limit.items()
+    }
+    Client(args.id, transport, layers)
+    ulog.log.info("client ready", addr=client_conf.addr)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
+    """Leader role: constructor per mode, then drive the TTD timer
+    (cmd/main.go:149-181)."""
+    assignment = conf.assignment
+    # Wait for every configured node to announce, seeders included, so the
+    # schedule sees all sources (the reference waits only for assignees and
+    # races seeder announcements).
+    expected = {nc.id for nc in conf.nodes}
+    if args.m == 0:
+        leader = LeaderNode(node, layers, assignment, expected_nodes=expected)
+    elif args.m == 1:
+        leader = RetransmitLeaderNode(node, layers, assignment,
+                                      expected_nodes=expected)
+    elif args.m == 2:
+        leader = PullRetransmitLeaderNode(node, layers, assignment,
+                                          expected_nodes=expected)
+    else:
+        bw = {nc.id: nc.network_bw for nc in conf.nodes}
+        leader = FlowRetransmitLeaderNode(node, layers, assignment, bw,
+                                          expected_nodes=expected)
+
+    print(
+        f"launching leader...\n[addr: {node.transport.get_address()}, "
+        f"id: {args.id}, filename: {args.f}, storagePath: {args.s}, mode: {args.m}]",
+        flush=True,
+    )
+    leader.start_distribution().get()
+    t0 = time.monotonic()
+    leader.ready().get()
+    ttd = time.monotonic() - t0
+    ulog.log.info("Time to deliver", seconds=round(ttd, 6))
+    print(f"Time to deliver: {ttd:.6f}s", flush=True)
+    return 0
+
+
+def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
+    """Receiver role (cmd/main.go:183-215)."""
+    if args.m == 0:
+        receiver = ReceiverNode(node, layers, args.s or ".")
+    elif args.m in (1, 2):
+        receiver = RetransmitReceiverNode(node, layers, args.s or ".")
+    else:
+        receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".")
+
+    print(
+        f"launching receiver...\n[addr: {node.transport.get_address()}, "
+        f"id: {args.id}, filename: {args.f}, storagePath: {args.s}, mode: {args.m}]",
+        flush=True,
+    )
+    receiver.announce()
+    receiver.ready().get()
+    ulog.log.info("received startup: ready")
+    print("ready", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    ulog.configure(node=str(args.id), verbose=args.v)
+    conf = cfg.read_json(args.f)
+
+    if args.c:
+        return run_client(args, conf)
+
+    node_conf = cfg.get_node_conf(conf, args.id)
+    try:
+        my_client_conf = cfg.get_client_conf(conf, args.id)
+    except ValueError:
+        my_client_conf = None
+        ulog.log.info("external client not found in config")
+
+    save_disk = bool(args.s)
+    layers = cfg.create_layers(node_conf, save_disk, args.s or ".")
+    if my_client_conf is not None:
+        cfg.add_client_layers(my_client_conf, conf.layer_size, layers)
+
+    if args.l:
+        ulog.log.info("layer set up")
+        return 0
+
+    addr_registry = {nc.id: nc.addr for nc in conf.nodes}
+    if my_client_conf is not None:
+        addr_registry[CLIENT_ID] = my_client_conf.addr
+
+    transport = TcpTransport(node_conf.addr, addr_registry=addr_registry)
+    node = Node(args.id, cfg.get_leader_conf(conf).id, transport)
+
+    try:
+        if node_conf.is_leader:
+            return run_leader(args, conf, node, layers)
+        return run_receiver(args, conf, node, layers)
+    finally:
+        transport.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

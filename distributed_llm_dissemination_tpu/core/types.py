@@ -52,17 +52,24 @@ class SourceType(enum.IntEnum):
 
 @dataclasses.dataclass
 class LayerMeta:
-    """Per-layer metadata (distributor/node.go:134-138)."""
+    """Per-layer metadata (distributor/node.go:134-138).
+
+    ``data_size`` is an extension over the reference: announce messages
+    carry each layer's size so a mode-3 leader can schedule layers it does
+    not itself hold (the reference's announce drops sizes, so its flow
+    solver zero-sizes peer-only layers)."""
 
     location: LayerLocation = LayerLocation.INMEM
     limit_rate: int = 0  # bytes/sec; 0 = unlimited
     source_type: SourceType = SourceType.MEM
+    data_size: int = 0  # bytes; 0 = unknown
 
     def to_json(self) -> dict:
         return {
             "Location": int(self.location),
             "LimitRate": self.limit_rate,
             "SourceType": int(self.source_type),
+            "DataSize": self.data_size,
         }
 
     @classmethod
@@ -71,6 +78,7 @@ class LayerMeta:
             location=LayerLocation(d.get("Location", 0)),
             limit_rate=int(d.get("LimitRate", 0)),
             source_type=SourceType(d.get("SourceType", 0)),
+            data_size=int(d.get("DataSize", 0)),
         )
 
 
@@ -106,9 +114,22 @@ class LayerSrc:
     device_array: object = None
 
     def read_bytes(self) -> bytes:
-        """Materialize the layer's bytes on the host (RAM or disk source)."""
+        """This record's own bytes (a received fragment's buffer, or a full
+        in-RAM layer).  For slicing a *source* store by offset/data_size use
+        ``read_range`` — the two differ only for INMEM records, where this
+        returns the whole buffer."""
         if self.meta.location == LayerLocation.INMEM and self.inmem_data is not None:
             return bytes(self.inmem_data)
+        return self.read_range()
+
+    def read_range(self) -> bytes:
+        """The byte range ``[offset, offset+data_size)`` of this source
+        store — what a transport actually puts on the wire.  ``offset``
+        indexes into the full layer (RAM buffer or file)."""
+        if self.meta.location == LayerLocation.INMEM and self.inmem_data is not None:
+            return bytes(
+                memoryview(self.inmem_data)[self.offset : self.offset + self.data_size]
+            )
         if self.meta.location == LayerLocation.DISK and self.fp:
             with open(self.fp, "rb") as f:
                 f.seek(self.offset)

@@ -1,0 +1,15 @@
+from .client import Client  # noqa: F401
+from .leader import (  # noqa: F401
+    FlowRetransmitLeaderNode,
+    LeaderNode,
+    PullRetransmitLeaderNode,
+    RetransmitLeaderNode,
+    assignment_satisfied,
+)
+from .node import MessageLoop, Node  # noqa: F401
+from .receiver import (  # noqa: F401
+    FlowRetransmitReceiverNode,
+    ReceiverNode,
+    RetransmitReceiverNode,
+)
+from .send import fetch_from_client, handle_flow_retransmit, send_layer  # noqa: F401

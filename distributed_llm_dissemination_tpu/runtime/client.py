@@ -1,0 +1,46 @@
+"""External weight source ("client").
+
+Re-design of ``/root/reference/distributor/client.go``: a separate process
+holding layers (stand-in for S3/GCS/blob store) attached to one node.  On a
+``ClientReqMsg`` it streams the requested layer to its node at the
+configured rate; the node's registered pipe relays it onward cut-through.
+"""
+
+from __future__ import annotations
+
+from ..core.types import CLIENT_ID, LayersSrc, NodeID  # noqa: F401  (CLIENT_ID re-exported)
+from ..transport.base import Transport
+from ..transport.messages import ClientReqMsg, LayerMsg
+from ..utils.logging import log
+from .node import MessageLoop
+
+
+class Client:
+    """Serves layers to its attached node on request (client.go:12-63)."""
+
+    def __init__(self, node_id: NodeID, transport: Transport, layers: LayersSrc,
+                 start_loop: bool = True):
+        self.node_id = node_id  # the node this client is attached to
+        self.transport = transport
+        self.layers = layers
+        self.loop = MessageLoop(transport)
+        self.loop.register(ClientReqMsg, self.handle_client_req)
+        if start_loop:
+            self.loop.start()
+
+    def handle_client_req(self, msg: ClientReqMsg) -> None:
+        layer = self.layers.get(msg.layer_id)
+        if layer is None:
+            log.error("client has no such layer", layerID=msg.layer_id)
+            return
+        log.debug("sending layer", layerID=msg.layer_id)
+        try:
+            self.transport.send(
+                self.node_id,
+                LayerMsg(CLIENT_ID, msg.layer_id, layer, layer.data_size),
+            )
+        except (OSError, KeyError) as e:
+            log.error("failed to send layer", dest=self.node_id, err=repr(e))
+
+    def close(self) -> None:
+        self.loop.stop()

@@ -1,0 +1,599 @@
+"""Leader state machines: the four dissemination schedulers.
+
+Re-design of the reference's leaders (``/root/reference/distributor/node.go``):
+
+- **Mode 0** ``LeaderNode`` — naive broadcast: once every assigned node has
+  announced, the leader itself sends every assigned layer to every assignee
+  (node.go:228-469).
+- **Mode 1** ``RetransmitLeaderNode`` — peer retransmission: layers already
+  owned by some peer are forwarded by that peer instead, offloading the
+  leader's NIC (node.go:472-626).
+- **Mode 2** ``PullRetransmitLeaderNode`` — pull/work-stealing: rarest-first
+  job table, min-loaded sender selection, and straggler mitigation by
+  stealing pending jobs from slow senders, re-scheduled on every ack
+  (node.go:629-1073).
+- **Mode 3** ``FlowRetransmitLeaderNode`` — max-flow optimal: a global plan
+  of partial-layer byte-range jobs with per-job rate budgets, computed by
+  the time-parameterized max-flow solver (node.go:1076-1288).
+
+Deviations from the reference, on purpose:
+- ``start_distribution``/``ready`` queues are buffered, so a leader never
+  deadlocks when the driver isn't listening yet (reference quirk: unbuffered
+  send inside the announce handler, node.go:322).
+- Startup/ready fire exactly once, guarded by a flag (the reference's mode-2
+  per-node completion map can re-fire, node.go:753-759).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+
+from ..core.types import (
+    Assignment,
+    LayerID,
+    LayerIDs,
+    LayerLocation,
+    LayerMeta,
+    LayersSrc,
+    NodeID,
+    Status,
+    delivered,
+)
+from ..sched.flow import FlowGraph, FlowJob, FlowJobsMap
+from ..transport.messages import (
+    AckMsg,
+    AnnounceMsg,
+    ClientReqMsg,
+    FlowRetransmitMsg,
+    LayerMsg,
+    RetransmitMsg,
+    StartupMsg,
+)
+from ..utils.logging import log
+from .node import MessageLoop, Node
+from .send import fetch_from_client, handle_flow_retransmit, send_layer
+
+
+def assignment_satisfied(a: Assignment, s: Status) -> bool:
+    """Every assigned layer is held in RAM/HBM by its node
+    (node.go:435-446)."""
+    for node_id, layers in a.items():
+        held = s.get(node_id, {})
+        for layer_id in layers:
+            meta = held.get(layer_id)
+            if meta is None or not delivered(meta):
+                return False
+    return True
+
+
+class LeaderNode:
+    """Mode 0: naive leader broadcast."""
+
+    def __init__(
+        self,
+        node: Node,
+        layers: LayersSrc,
+        assignment: Assignment,
+        start_loop: bool = True,
+        expected_nodes: Optional[Set[NodeID]] = None,
+    ):
+        """``expected_nodes``: when given, distribution also waits for these
+        nodes to announce — not just the assignment keys.  The reference
+        starts once all *assignees* have announced (node.go:313-319), which
+        races pure seeders' announcements and silently schedules around
+        them (its benchmark config has 7 seeders and 1 assignee)."""
+        self.node = node
+        self.layers = layers
+        self.assignment = assignment
+        self.expected_nodes = set(expected_nodes or ())
+        self.status: Status = {}
+        self._lock = threading.Lock()
+        self._start_q: "queue.Queue[Assignment]" = queue.Queue()
+        self._ready_q: "queue.Queue[Assignment]" = queue.Queue()
+        self._started = False
+        self._startup_sent = False
+
+        # The leader's own layers seed its status row (node.go:251-257);
+        # carry sizes so the flow solver can size any layer from status.
+        self.status[node.my_id] = {
+            lid: LayerMeta(
+                location=src.meta.location,
+                limit_rate=src.meta.limit_rate,
+                source_type=src.meta.source_type,
+                data_size=src.data_size,
+            )
+            for lid, src in self.layers.items()
+        }
+
+        self.loop = MessageLoop(node.transport)
+        self._register_handlers()
+        if start_loop:
+            self.loop.start()
+
+    def _register_handlers(self) -> None:
+        self.loop.register(AnnounceMsg, self.handle_announce)
+        self.loop.register(AckMsg, self.handle_ack)
+        self.loop.register(LayerMsg, self.handle_layer)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start_distribution(self) -> "queue.Queue[Assignment]":
+        """Fires when all assigned nodes have announced (node.go:222)."""
+        return self._start_q
+
+    def ready(self) -> "queue.Queue[Assignment]":
+        """Fires when the assignment is satisfied (node.go:225)."""
+        return self._ready_q
+
+    def close(self) -> None:
+        self.loop.stop()
+
+    # -------------------------------------------------------------- handlers
+
+    def handle_announce(self, msg: AnnounceMsg) -> None:
+        """Register the peer; once everyone announced, start sending
+        (node.go:295-324)."""
+        with self._lock:
+            if msg.src_id not in self.status:
+                self.status[msg.src_id] = msg.layer_ids
+                self.node.add_node(msg.src_id)
+            if self._started:
+                return
+            for node_id in set(self.assignment) | self.expected_nodes:
+                if node_id not in self.status:
+                    return
+            self._started = True
+        log.info("timer start")
+        self._start_q.put(self.assignment)
+        self.send_layers()
+
+    def send_layers(self) -> None:
+        """Leader sends every missing assigned layer itself
+        (node.go:326-352)."""
+        for node_id, layer_ids in self.assignment.items():
+            for layer_id in layer_ids:
+                with self._lock:
+                    meta = self.status.get(node_id, {}).get(layer_id)
+                if meta is not None and delivered(meta):
+                    continue
+                layer = self.layers.get(layer_id)
+                if layer is None:
+                    log.warn("no layers found", layerID=layer_id)
+                    continue
+                self.loop._pool.submit(self._send_one, node_id, layer_id, layer)
+
+    def _send_one(self, dest: NodeID, layer_id: LayerID, layer) -> None:
+        try:
+            send_layer(self.node, dest, layer_id, layer)
+        except Exception as e:  # noqa: BLE001
+            log.error("couldn't send a layer", layerID=layer_id, err=repr(e))
+
+    def handle_layer(self, msg: LayerMsg) -> None:
+        """The leader can itself receive layers (e.g. from a client pipe):
+        store + ack (node.go:376-407)."""
+        with self._lock:
+            src = msg.layer_src
+            src.meta = LayerMeta(location=LayerLocation.INMEM)
+            self.layers[msg.layer_id] = src
+        self.node.transport.send(
+            self.node.leader_id,
+            AckMsg(self.node.my_id, msg.layer_id, LayerLocation.INMEM),
+        )
+
+    def handle_ack(self, msg: AckMsg) -> None:
+        """Record delivery; on satisfaction broadcast startup + signal ready
+        (node.go:410-432)."""
+        with self._lock:
+            self.status.setdefault(msg.src_id, {})[msg.layer_id] = LayerMeta(
+                location=msg.location
+            )
+            if self._startup_sent or not assignment_satisfied(
+                self.assignment, self.status
+            ):
+                return
+            self._startup_sent = True
+        log.info("timer stop: startup")
+        self.send_startup()
+        self._ready_q.put(self.assignment)
+
+    def send_startup(self) -> None:
+        with self._lock:
+            receivers = list(self.status)
+        for node_id in receivers:
+            try:
+                self.node.transport.send(node_id, StartupMsg(self.node.my_id))
+            except (OSError, KeyError) as e:
+                log.error("failed to send startup", dest=node_id, err=repr(e))
+
+
+class RetransmitLeaderNode(LeaderNode):
+    """Mode 1: peers that already own a layer forward it (node.go:472-626)."""
+
+    def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
+                 start_loop: bool = True,
+                 expected_nodes: Optional[Set[NodeID]] = None):
+        self.layer_owners: Dict[LayerID, Set[NodeID]] = {}
+        super().__init__(node, layers, assignment, start_loop=start_loop,
+                         expected_nodes=expected_nodes)
+
+    def _build_layer_owners(self) -> None:
+        """Index layer → owner set from announcements (node.go:558-571)."""
+        for node_id, layer_ids in self.status.items():
+            for layer_id in layer_ids:
+                self.layer_owners.setdefault(layer_id, set()).add(node_id)
+
+    def send_layers(self) -> None:
+        with self._lock:
+            self._build_layer_owners()
+            owners_by_layer = {k: set(v) for k, v in self.layer_owners.items()}
+        for node_id, layer_ids in self.assignment.items():
+            for layer_id in layer_ids:
+                owners = owners_by_layer.get(layer_id, set())
+                if owners:
+                    if node_id in owners:
+                        continue  # dest already has it
+                    # Deterministic owner pick (reference picks randomly via
+                    # map iteration, node.go:583-588).
+                    owner = min(owners)
+                    try:
+                        self.send_retransmit(layer_id, owner, node_id)
+                    except Exception as e:  # noqa: BLE001
+                        log.error(
+                            "couldn't send retransmit",
+                            layerID=layer_id, owner=owner, err=repr(e),
+                        )
+                else:
+                    layer = self.layers.get(layer_id)
+                    if layer is None:
+                        log.warn("no layers found", layerID=layer_id)
+                        continue
+                    self.loop._pool.submit(self._send_one, node_id, layer_id, layer)
+
+    def send_retransmit(self, layer_id: LayerID, owner: NodeID, dest: NodeID) -> None:
+        """Ask ``owner`` to forward ``layer_id`` to ``dest``; leader-owned
+        layers go out directly (node.go:611-626)."""
+        if owner == self.node.my_id:
+            layer = self.layers.get(layer_id)
+            if layer is None:
+                log.warn("no layers found", layerID=layer_id)
+                return
+            self._send_one(dest, layer_id, layer)
+            return
+        self.node.transport.send(
+            owner, RetransmitMsg(self.node.my_id, layer_id, dest)
+        )
+
+
+class _JobInfo:
+    """Mode-2 job table entry (node.go:639-647)."""
+
+    __slots__ = ("sender", "status", "t_start")
+    PENDING = 0
+    SENDING = 1
+
+    def __init__(self, sender: Optional[NodeID] = None):
+        self.sender = sender
+        self.status = _JobInfo.PENDING
+        self.t_start: Optional[float] = None
+
+
+class PullRetransmitLeaderNode(RetransmitLeaderNode):
+    """Mode 2: pull/work-stealing scheduler (node.go:662-1073).
+
+    Rarest-first initial assignment to the min-loaded owner; every ack frees
+    its sender, which immediately pulls its rarest remaining job or steals a
+    pending job from the slowest/overloaded sender (estimated by moving-
+    average job duration × queue length)."""
+
+    def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
+                 start_loop: bool = True,
+                 expected_nodes: Optional[Set[NodeID]] = None):
+        # layer -> dest -> job
+        self.jobs: Dict[LayerID, Dict[NodeID, _JobInfo]] = {}
+        self.sender_load: Dict[NodeID, int] = {}
+        # sender -> (avg job duration seconds, completed count)
+        self.performance: Dict[NodeID, Tuple[float, int]] = {}
+        super().__init__(node, layers, assignment, start_loop=start_loop,
+                         expected_nodes=expected_nodes)
+
+    def send_layers(self) -> None:
+        """Build the job table rarest-first and kick every node
+        (node.go:810-904)."""
+        with self._lock:
+            self._build_layer_owners()
+            # Rarest-first layer order, layer-id tiebreak (node.go:842-851).
+            sorted_layers = sorted(
+                self.layer_owners,
+                key=lambda lid: (len(self.layer_owners[lid]), lid),
+            )
+            for dest, layer_ids in self.assignment.items():
+                held = self.status.get(dest, {})
+                for layer_id in layer_ids:
+                    meta = held.get(layer_id)
+                    if meta is None or not delivered(meta):
+                        self.jobs.setdefault(layer_id, {})[dest] = _JobInfo()
+            for node_id in self.status:
+                self.sender_load.setdefault(node_id, 0)
+            for layer_id in sorted_layers:
+                for dest in sorted(self.jobs.get(layer_id, {})):
+                    sender = self._min_loaded_sender(layer_id)
+                    self.jobs[layer_id][dest] = _JobInfo(sender)
+                    self.sender_load[sender] += 1
+                    log.info("job assignment", layer=layer_id, sender=sender)
+            # Kick every node that might have work: assignment dests AND
+            # loaded senders.  (The reference kicks only assignment nodes,
+            # node.go:890-903, which strands jobs assigned to the leader
+            # when no peer owns a layer.)
+            nodes = sorted(
+                set(self.assignment)
+                | {s for s, load in self.sender_load.items() if load > 0}
+            )
+        for node_id in nodes:
+            self.loop._pool.submit(self._assign_new_job_safe, node_id)
+
+    def _assign_new_job_safe(self, node_id: NodeID) -> None:
+        try:
+            self.assign_new_job(node_id)
+        except Exception as e:  # noqa: BLE001
+            log.error("failed to assign a new job", node=node_id, err=repr(e))
+
+    def _min_loaded_sender(self, layer_id: LayerID) -> NodeID:
+        """Owner with the fastest source rate, then least load, then lowest
+        id (node.go:948-978)."""
+        best, best_rate, min_count = None, -1, 1 << 62
+        for sender in sorted(self.sender_load):
+            count = self.sender_load[sender]
+            meta = self.status.get(sender, {}).get(layer_id)
+            if meta is None:
+                continue
+            rate = meta.limit_rate if meta.limit_rate != 0 else 1 << 62
+            if rate > best_rate or (
+                rate == best_rate
+                and (count < min_count or (count == min_count and sender < best))
+            ):
+                best, best_rate, min_count = sender, rate, count
+        return best
+
+    def _rarest_own_job(
+        self, node_id: NodeID
+    ) -> Optional[Tuple[LayerID, NodeID, _JobInfo]]:
+        """This node's still-pending job with the rarest layer
+        (node.go:981-1010)."""
+        best = None
+        min_owners = 1 << 62
+        for layer_id in self.status.get(node_id, {}):
+            for dest, job in self.jobs.get(layer_id, {}).items():
+                if job.sender != node_id or job.status != _JobInfo.PENDING:
+                    continue
+                owners = len(self.layer_owners.get(layer_id, ()))
+                if owners < min_owners or (
+                    best is not None and owners == min_owners and layer_id < best[0]
+                ):
+                    min_owners = owners
+                    best = (layer_id, dest, job)
+        return best
+
+    def _rarest_stealable_job(
+        self, node_id: NodeID
+    ) -> Optional[Tuple[LayerID, NodeID, NodeID]]:
+        """A pending job owned by a slower/overloaded sender that this node
+        could serve instead (node.go:1012-1073)."""
+        best = None  # (layer, dest, sender, owner_count, time_to_finish)
+        for layer_id in self.status.get(node_id, {}):
+            owner_count = len(self.layer_owners.get(layer_id, ()))
+            for dest, job in self.jobs.get(layer_id, {}).items():
+                sender = job.sender
+                if sender is None:
+                    continue
+                # Normalize the 0-means-unlimited sentinel before comparing
+                # (the reference's raw comparison lets a slow node steal
+                # from an unlimited-rate sender, node.go:1039-1040).
+                _raw_s = self.status.get(sender, {}).get(layer_id, LayerMeta()).limit_rate
+                _raw_n = self.status.get(node_id, {}).get(layer_id, LayerMeta()).limit_rate
+                sender_rate = _raw_s if _raw_s != 0 else 1 << 62
+                node_rate = _raw_n if _raw_n != 0 else 1 << 62
+                if (
+                    sender == node_id
+                    or job.status != _JobInfo.PENDING
+                    or self.sender_load.get(sender, 0) == 0
+                    or node_rate < sender_rate
+                ):
+                    continue
+                perf = self.performance.get(sender)
+                if perf is None:
+                    # Sender stuck on its first job: steal with priority.
+                    ttf = float("inf")
+                else:
+                    ttf = perf[0] * self.sender_load.get(sender, 0)
+                cand = (layer_id, dest, sender, owner_count, ttf)
+                if (
+                    best is None
+                    or cand[3] < best[3]
+                    or (cand[3] == best[3] and cand[4] > best[4])
+                ):
+                    best = cand
+        if best is None:
+            return None
+        return best[0], best[1], best[2]
+
+    def assign_new_job(self, node_id: NodeID) -> None:
+        """The scheduling loop body (node.go:909-945)."""
+        with self._lock:
+            own = self._rarest_own_job(node_id)
+            if own is not None:
+                layer_id, dest, job = own
+                job.status = _JobInfo.SENDING
+                job.t_start = time.monotonic()
+                self.sender_load[node_id] -= 1
+                sender = node_id
+            else:
+                stolen = self._rarest_stealable_job(node_id)
+                if stolen is None:
+                    log.info("there is no job left to assign", node=node_id)
+                    return
+                layer_id, dest, prev_sender = stolen
+                self.sender_load[prev_sender] -= 1
+                job = self.jobs[layer_id][dest]
+                job.sender = node_id
+                job.status = _JobInfo.SENDING
+                job.t_start = time.monotonic()
+                sender = node_id
+                log.debug("steal a job", layer=layer_id, frm=prev_sender, to=node_id)
+        self.send_retransmit(layer_id, sender, dest)
+
+    def handle_ack(self, msg: AckMsg) -> None:
+        """Completion accounting + throughput tracking + re-scheduling
+        (node.go:741-807)."""
+        super().handle_ack(msg)
+        with self._lock:
+            job = self.jobs.get(msg.layer_id, {}).get(msg.src_id)
+            if job is None:
+                return  # e.g. a client-loaded layer: no tracked job
+            log.info("job completed", node=job.sender, layerID=msg.layer_id)
+            dur = (
+                time.monotonic() - job.t_start if job.t_start is not None else 0.0
+            )
+            avg, count = self.performance.get(job.sender, (0.0, 0))
+            self.performance[job.sender] = ((avg * count + dur) / (count + 1), count + 1)
+            # The new owner can now serve this layer too.
+            self.layer_owners.setdefault(msg.layer_id, set()).add(msg.src_id)
+            del self.jobs[msg.layer_id][msg.src_id]
+            sender = job.sender
+        if sender is not None:
+            self._assign_new_job_safe(sender)
+
+
+class FlowRetransmitLeaderNode(RetransmitLeaderNode):
+    """Mode 3: globally optimal plan via time-parameterized max-flow
+    (node.go:1076-1288).
+
+    Like the reference, only one destination per layer is supported
+    (node.go:1078); lifting this requires per-(layer, dest) flow
+    decomposition."""
+
+    def __init__(
+        self,
+        node: Node,
+        layers: LayersSrc,
+        assignment: Assignment,
+        node_network_bw: Dict[NodeID, int],
+        start_loop: bool = True,
+        expected_nodes: Optional[Set[NodeID]] = None,
+    ):
+        self.layer_dests: Dict[LayerID, NodeID] = {}
+        for dest, layer_ids in assignment.items():
+            for layer_id in layer_ids:
+                if layer_id in self.layer_dests:
+                    log.error("a layer assigned to multiple dests", layerID=layer_id)
+                else:
+                    self.layer_dests[layer_id] = dest
+        self.node_network_bw = dict(node_network_bw)
+        super().__init__(node, layers, assignment, start_loop=start_loop,
+                         expected_nodes=expected_nodes)
+
+    def _register_handlers(self) -> None:
+        super()._register_handlers()
+        self.loop.register(FlowRetransmitMsg, self.handle_flow_retransmit)
+
+    def send_layers(self) -> None:
+        t, self_jobs, jobs = self.assign_jobs()
+        self._dispatch(t, self_jobs, jobs)
+
+    def assign_jobs(self) -> Tuple[int, FlowJobsMap, FlowJobsMap]:
+        """Split off self-jobs (dest already holds the layer at its own
+        client), then solve the flow problem for the rest
+        (node.go:1200-1234)."""
+        self_jobs: FlowJobsMap = {}
+        modified: Assignment = {}
+        with self._lock:
+            # Size every layer from announced metadata — the leader need not
+            # hold a layer to schedule it (its own layers are in status too).
+            layer_sizes: Dict[LayerID, int] = {}
+            for layer_metas in self.status.values():
+                for layer_id, meta in layer_metas.items():
+                    if meta.data_size > 0:
+                        layer_sizes[layer_id] = meta.data_size
+            for dest, layer_ids in self.assignment.items():
+                for layer_id, meta in layer_ids.items():
+                    if layer_id not in layer_sizes:
+                        log.error("no announced size for layer", layerID=layer_id)
+                        continue
+                    if layer_id in self.status.get(dest, {}):
+                        self_jobs.setdefault(dest, []).append(
+                            FlowJob(dest, layer_id, layer_sizes[layer_id], 0)
+                        )
+                    else:
+                        modified.setdefault(dest, {})[layer_id] = meta
+            if not modified:
+                log.info("No jobs to assign other than self-assignment")
+                return 0, self_jobs, {}
+            t0 = time.monotonic()
+            graph = FlowGraph(
+                modified, self.status, layer_sizes, self.node_network_bw
+            )
+            t, jobs = graph.get_job_assignment()
+        log.info(
+            "Job assignment completed",
+            computation_ms=round((time.monotonic() - t0) * 1000, 3),
+        )
+        return t, self_jobs, jobs
+
+    def _dispatch(self, min_time: int, self_jobs: FlowJobsMap, jobs: FlowJobsMap) -> None:
+        """Send every flow job as a rate-budgeted command
+        (node.go:1237-1288)."""
+        for dest, job_list in self_jobs.items():
+            for job in job_list:
+                rate = self.status.get(job.sender_id, {}).get(
+                    job.layer_id, LayerMeta()
+                ).limit_rate
+                self.node.transport.send(
+                    job.sender_id,
+                    FlowRetransmitMsg(
+                        self.node.my_id, job.layer_id, job.sender_id,
+                        job.data_size, job.offset, rate,
+                    ),
+                )
+        for sender, job_list in jobs.items():
+            for job in job_list:
+                dest = self.layer_dests.get(job.layer_id)
+                if dest is None:
+                    log.error("receiver not found", layerID=job.layer_id)
+                    continue
+                rate = job.data_size // max(1, min_time)
+                log.debug(
+                    "dispatching a job",
+                    layer=job.layer_id, sender=sender, rate_mibps=rate >> 20,
+                )
+                try:
+                    self.node.transport.send(
+                        sender,
+                        FlowRetransmitMsg(
+                            self.node.my_id, job.layer_id, dest,
+                            job.data_size, job.offset, rate,
+                        ),
+                    )
+                except (OSError, KeyError) as e:
+                    log.error("couldn't dispatch job", layerID=job.layer_id, err=repr(e))
+
+    def handle_flow_retransmit(self, msg: FlowRetransmitMsg) -> None:
+        """The leader can be a sender in the plan too (node.go:1168-1187)."""
+        t0 = time.monotonic()
+        log.info(
+            "start sending layer",
+            layer=msg.layer_id, dest=msg.dest_id, size_mb=msg.data_size >> 20,
+            expected_mibps=msg.rate >> 20,
+        )
+        handle_flow_retransmit(
+            self.node, self.layers, self._lock,
+            lambda lid, dest: fetch_from_client(self.node, lid, dest), msg,
+        )
+        dur = time.monotonic() - t0
+        log.info(
+            "finished sending layer",
+            layer=msg.layer_id, dest=msg.dest_id,
+            send_dur_ms=round(dur * 1000, 3),
+            throughput_mibps=round(msg.data_size / max(dur, 1e-9) / (1 << 20), 2),
+        )

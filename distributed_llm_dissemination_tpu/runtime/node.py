@@ -1,0 +1,103 @@
+"""Node identity core + message-loop plumbing.
+
+Re-design of the reference's ``N`` struct and per-node goroutine fan-out
+(``/root/reference/distributor/node.go:17-126, 271-287``): every node owns a
+transport, a leader pointer, and a (1-hop, forward-provisioned) routing
+table; incoming messages are drained from the transport's delivery queue by
+one loop thread and dispatched to handlers on a small pool so long layer
+transfers never block control traffic.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Type
+
+from ..core.types import NodeID, RoutingInfo
+from ..transport.base import Transport
+from ..utils.logging import log
+
+
+class Node:
+    """Identity + leader pointer + routing table (node.go:35-126)."""
+
+    def __init__(self, my_id: NodeID, leader_id: NodeID, transport: Transport):
+        self.my_id = my_id
+        self.leader_id = leader_id
+        self.transport = transport
+        self.routing_table: Dict[NodeID, RoutingInfo] = {}
+        self._lock = threading.Lock()
+        if my_id != leader_id:
+            self.add_node(leader_id)
+
+    def get_next_hop(self, goal: NodeID) -> NodeID:
+        with self._lock:
+            info = self.routing_table.get(goal)
+        if info is None:
+            raise KeyError(f"no routing entry for {goal}")
+        return info.next_hop
+
+    def add_node(self, goal: NodeID) -> None:
+        self.add_routing_table(goal, goal, 1)
+
+    def add_routing_table(
+        self, goal: NodeID, next_hop: NodeID, remaining_hops: int = 1
+    ) -> None:
+        with self._lock:
+            self.routing_table[goal] = RoutingInfo(next_hop, remaining_hops)
+
+    def update_leader(self, leader_id: NodeID) -> None:
+        with self._lock:
+            if leader_id not in self.routing_table:
+                raise KeyError("routing entry for the specified leader does not exist")
+            self.leader_id = leader_id
+
+
+class MessageLoop:
+    """Drains a transport's delivery queue and dispatches by message type.
+
+    The reference runs one goroutine per node reading ``Deliver()`` and
+    spawns a goroutine per message (node.go:271-287); here a single loop
+    thread feeds a bounded pool, which gives the same never-block-control
+    property with tamer thread counts.
+    """
+
+    def __init__(self, transport: Transport, max_workers: int = 16):
+        self._transport = transport
+        self._handlers: Dict[Type, Callable] = {}
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, msg_cls: Type, handler: Callable) -> None:
+        self._handlers[msg_cls] = handler
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        q = self._transport.deliver()
+        while not self._stop.is_set():
+            try:
+                msg = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            handler = self._handlers.get(type(msg))
+            if handler is None:
+                log.debug("unhandled message", kind=type(msg).__name__)
+                continue
+            self._pool.submit(self._safe, handler, msg)
+
+    @staticmethod
+    def _safe(handler: Callable, msg) -> None:
+        try:
+            handler(msg)
+        except Exception as e:  # noqa: BLE001 — a handler crash must not kill the loop
+            log.error("handler failed", kind=type(msg).__name__, err=repr(e))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pool.shutdown(wait=False)

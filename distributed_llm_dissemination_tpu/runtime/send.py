@@ -1,0 +1,108 @@
+"""Shared layer-send paths used by leaders and receivers.
+
+Re-design of the reference's send helpers: ``sendLayer``
+(``/root/reference/distributor/node.go:354-373``), ``fetchFromClient``
+(node.go:1345-1351), and the flow-job executor ``handleFlowRetransmit``
+(node.go:1592-1643).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+from ..core.types import (
+    CLIENT_ID,
+    LayerID,
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    LayersSrc,
+    NodeID,
+)
+from ..transport.messages import ClientReqMsg, FlowRetransmitMsg, LayerMsg
+from ..utils.logging import log
+from ..utils.rate import TokenBucket
+from .node import Node
+
+
+def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc) -> None:
+    """Send one full layer to ``dest``; client-held layers are fetched via
+    the pipe mechanism instead (node.go:354-365)."""
+    if layer.meta.location == LayerLocation.CLIENT:
+        log.debug("loading layer from client", layer=layer_id)
+        fetch_from_client(node, layer_id, dest)
+        return
+    node.transport.send(
+        dest, LayerMsg(node.my_id, layer_id, layer, layer.data_size)
+    )
+
+
+def fetch_from_client(node: Node, layer_id: LayerID, dest: NodeID) -> None:
+    """Register a cut-through pipe (layer → dest) and ask the external
+    client to stream the layer (node.go:367-373)."""
+    log.debug("ask the client to send the layer", layerID=layer_id)
+    node.transport.register_pipe(layer_id, dest)
+    node.transport.send(CLIENT_ID, ClientReqMsg(node.my_id, layer_id, False))
+
+
+def handle_flow_retransmit(
+    node: Node,
+    layers: LayersSrc,
+    lock: threading.Lock,
+    fetch_fn: Callable[[LayerID, NodeID], None],
+    msg: FlowRetransmitMsg,
+) -> None:
+    """Execute one flow job: send ``[offset, offset+data_size)`` of a layer
+    to the dest at the commanded rate (node.go:1592-1643).
+
+    The ClientLayer branch simulates a rate-limited fetch from the node's
+    own external client, then loops the partial layer back into the node's
+    own delivery queue — the reference does the same (node.go:1610-1635)
+    but would nil-panic there because client-layer records carry no data
+    (cmd/config.go:187-198); here missing bytes are zero-filled."""
+    with lock:
+        layer = layers.get(msg.layer_id)
+    if layer is None:
+        log.error("no layer for flow job", layerID=msg.layer_id)
+        return
+    node.add_node(msg.dest_id)
+
+    if layer.meta.location in (LayerLocation.INMEM, LayerLocation.DISK):
+        partial = LayerSrc(
+            inmem_data=layer.inmem_data,
+            fp=layer.fp,
+            data_size=msg.data_size,
+            offset=msg.offset,
+            meta=LayerMeta(
+                location=layer.meta.location,
+                limit_rate=msg.rate,
+                source_type=layer.meta.source_type,
+            ),
+        )
+        node.transport.send(
+            msg.dest_id,
+            LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size),
+        )
+    elif layer.meta.location == LayerLocation.CLIENT:
+        def _simulate_client_fetch() -> None:
+            if layer.inmem_data is not None:
+                data = bytearray(
+                    memoryview(layer.inmem_data)[msg.offset : msg.offset + msg.data_size]
+                )
+            else:
+                data = bytearray(msg.data_size)
+            TokenBucket(msg.rate).wait_n(len(data))
+            partial = LayerSrc(
+                inmem_data=data,
+                data_size=msg.data_size,
+                offset=msg.offset,
+                meta=LayerMeta(location=LayerLocation.INMEM),
+            )
+            node.transport.deliver().put(
+                LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size)
+            )
+
+        threading.Thread(target=_simulate_client_fetch, daemon=True).start()
+    else:
+        log.error("unknown location", layerID=msg.layer_id)

@@ -1,0 +1,1 @@
+from .flow import FlowGraph, FlowJob, FlowJobsMap  # noqa: F401

@@ -1,0 +1,235 @@
+"""Max-flow optimal dissemination scheduler (mode 3's brain).
+
+Re-design of the reference's flow solver
+(``/root/reference/distributor/flow.go``): model dissemination as a
+time-parameterized max-flow problem over a six-level graph
+
+    source → sender → per-sender source-class ("client") → layer → receiver → sink
+
+with capacities scaled by a candidate completion time ``t``:
+``src→sender`` = sender NIC bandwidth × t; ``sender→class`` = that source
+class's rate limit × t; ``class→layer`` = ∞; ``layer→receiver`` = layer
+size; ``receiver→sink`` = receiver NIC bandwidth × t.  Exponential search
+finds a feasible ``t``, binary search minimizes it, and the residual flows
+on the class→layer edges decompose into per-sender byte-range jobs
+(offset + size) — the multi-sender split of one layer
+(flow.go:146-218).
+
+Deviation from the reference: a sender whose source class has rate limit 0
+("unlimited") gets its NIC bandwidth as the class capacity instead of a
+zero-capacity (unusable) edge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Tuple
+
+from ..core.types import Assignment, LayerID, NodeID, SourceType, Status
+from ..utils.logging import log
+
+_INF = 1 << 62
+
+
+@dataclasses.dataclass
+class FlowJob:
+    """One partial-layer send command (flow.go:30-39)."""
+
+    sender_id: NodeID
+    layer_id: LayerID
+    data_size: int
+    offset: int
+
+
+# sender -> its jobs
+FlowJobsMap = Dict[NodeID, List[FlowJob]]
+
+
+@dataclasses.dataclass(frozen=True)
+class _V:
+    """Flow-graph vertex key (flow.go:23-28)."""
+
+    kind: str  # source | sender | class | layer | receiver | sink
+    node_id: NodeID = 0
+    layer_id: LayerID = 0
+    source_type: int = 0
+
+
+class FlowGraph:
+    """Edmonds–Karp over an adjacency matrix, rebuilt per candidate time
+    (flow.go:43-144, 221-353).  Vertex indexing is deterministic (sorted
+    iteration) so schedules are reproducible across runs."""
+
+    def __init__(
+        self,
+        assignment: Assignment,
+        status: Status,
+        layer_sizes: Dict[LayerID, int],
+        node_network_bw: Dict[NodeID, int],
+    ):
+        self.assignment = assignment
+        self.status = status
+        self.layer_sizes = layer_sizes
+        self.node_network_bw = node_network_bw
+
+        self.needed_layers = sorted(
+            {lid for layers in assignment.values() for lid in layers}
+        )
+        needed = set(self.needed_layers)
+
+        self.idx: Dict[_V, int] = {}
+
+        def add(v: _V) -> None:
+            if v not in self.idx:
+                self.idx[v] = len(self.idx)
+
+        add(_V("source"))
+        for node_id in sorted(status):
+            add(_V("sender", node_id=node_id))
+        for node_id in sorted(status):
+            for st in sorted({int(m.source_type) for m in status[node_id].values()}):
+                add(_V("class", node_id=node_id, source_type=st))
+        for layer_id in self.needed_layers:
+            add(_V("layer", layer_id=layer_id))
+        for node_id in sorted(assignment):
+            add(_V("receiver", node_id=node_id))
+        add(_V("sink"))
+
+        self.n = len(self.idx)
+        self.cap = [[0] * self.n for _ in range(self.n)]
+        self._needed = needed
+
+    # ------------------------------------------------------------- capacities
+
+    def _class_capacity(self, node_id: NodeID, limit_rate: int, t: int) -> int:
+        if limit_rate > 0:
+            return limit_rate * t
+        # Unlimited source class: NIC bandwidth is the real ceiling.
+        return self.node_network_bw.get(node_id, 0) * t
+
+    def _build(self, t: int) -> None:
+        """(Re)build edge capacities for candidate time t (flow.go:221-270)."""
+        for row in self.cap:
+            for j in range(self.n):
+                row[j] = 0
+        src = self.idx[_V("source")]
+        sink = self.idx[_V("sink")]
+
+        for node_id, layer_metas in self.status.items():
+            sender = self.idx[_V("sender", node_id=node_id)]
+            self.cap[src][sender] = self.node_network_bw.get(node_id, 0) * t
+            for layer_id, meta in layer_metas.items():
+                if layer_id not in self._needed:
+                    continue
+                cls = self.idx[
+                    _V("class", node_id=node_id, source_type=int(meta.source_type))
+                ]
+                layer = self.idx[_V("layer", layer_id=layer_id)]
+                self.cap[sender][cls] = self._class_capacity(
+                    node_id, meta.limit_rate, t
+                )
+                # One layer may feed multiple receivers; don't cap here.
+                self.cap[cls][layer] = _INF
+
+        for node_id, layer_ids in self.assignment.items():
+            receiver = self.idx[_V("receiver", node_id=node_id)]
+            for layer_id in layer_ids:
+                layer = self.idx[_V("layer", layer_id=layer_id)]
+                self.cap[layer][receiver] = self.layer_sizes[layer_id]
+            self.cap[receiver][sink] = self.node_network_bw.get(node_id, 0) * t
+
+    # --------------------------------------------------------------- max-flow
+
+    def _bfs(self, src: int, sink: int) -> Tuple[List[int], bool]:
+        parent = [0] * self.n
+        visited = [False] * self.n
+        visited[src] = True
+        q = deque([src])
+        while q:
+            u = q.popleft()
+            row = self.cap[u]
+            for v in range(self.n):
+                if not visited[v] and row[v] > 0:
+                    visited[v] = True
+                    parent[v] = u
+                    if v == sink:
+                        return parent, True
+                    q.append(v)
+        return parent, False
+
+    def max_flow(self, t: int) -> int:
+        """Edmonds–Karp on the residual matrix for candidate time t
+        (flow.go:319-353)."""
+        self._build(t)
+        src = self.idx[_V("source")]
+        sink = self.idx[_V("sink")]
+        total = 0
+        while True:
+            parent, ok = self._bfs(src, sink)
+            if not ok:
+                return total
+            path_flow = _INF
+            v = sink
+            while v != src:
+                path_flow = min(path_flow, self.cap[parent[v]][v])
+                v = parent[v]
+            total += path_flow
+            v = sink
+            while v != src:
+                self.cap[parent[v]][v] -= path_flow
+                self.cap[v][parent[v]] += path_flow
+                v = parent[v]
+
+    # ------------------------------------------------------------ scheduling
+
+    def get_job_assignment(self) -> Tuple[int, FlowJobsMap]:
+        """Minimum feasible completion time + per-sender byte-range jobs
+        (flow.go:146-218)."""
+        required = sum(
+            self.layer_sizes[lid]
+            for layers in self.assignment.values()
+            for lid in layers
+        )
+
+        t_upper = 1
+        while self.max_flow(t_upper) < required:
+            if t_upper > _INF // 2:
+                log.error("t_upper not found")
+                break
+            t_upper *= 2
+
+        lo, hi, t = 1, t_upper, t_upper
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if self.max_flow(mid) < required:
+                lo = mid + 1
+            else:
+                t = min(t, mid)
+                hi = mid - 1
+
+        self.max_flow(t)  # leave residuals for decomposition
+
+        jobs: FlowJobsMap = {}
+        layer_offset: Dict[LayerID, int] = {}
+        for sender_id in sorted(self.status):
+            for layer_id in sorted(self.status[sender_id]):
+                if layer_id not in self._needed:
+                    continue
+                meta = self.status[sender_id][layer_id]
+                cls = self.idx[
+                    _V("class", node_id=sender_id, source_type=int(meta.source_type))
+                ]
+                layer = self.idx[_V("layer", layer_id=layer_id)]
+                # Residual reverse edge layer→class equals the flow pushed
+                # class→layer: the bytes this sender contributes.
+                flow = self.cap[layer][cls]
+                if flow > 0:
+                    offset = layer_offset.get(layer_id, 0)
+                    jobs.setdefault(sender_id, []).append(
+                        FlowJob(sender_id, layer_id, flow, offset)
+                    )
+                    layer_offset[layer_id] = offset + flow
+
+        log.info("job assignment calculated", min_time_s=t)
+        return t, jobs

@@ -1,0 +1,280 @@
+"""Typed control-plane protocol messages.
+
+Re-design of the reference's message layer
+(``/root/reference/distributor/message.go``): the same protocol vocabulary —
+announce / ack / retransmit / flowRetransmit / layer / clientReq / startup —
+as plain dataclasses with symmetric JSON payload codecs.  Layer payloads are
+never JSON-encoded: a ``LayerMsg`` travels as a JSON header followed by the
+raw byte stream (message.go:286-287, transport.go:308-373).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Union
+
+from ..core.types import (
+    LayerID,
+    LayerIDs,
+    LayerLocation,
+    LayerSrc,
+    NodeID,
+    layer_ids_from_json,
+    layer_ids_to_json,
+)
+
+
+class MsgType(enum.IntEnum):
+    """Wire message kinds (message.go:16-28)."""
+
+    ANNOUNCE = 0
+    ACK = 1
+    LAYER = 2
+    RETRANSMIT = 3
+    FLOW_RETRANSMIT = 4
+    CLIENT_REQ = 5
+    STARTUP = 6
+    SIMPLE = 7
+
+
+@dataclasses.dataclass
+class AnnounceMsg:
+    """Receiver → leader: my initial layers + metadata (message.go:31-58)."""
+
+    src_id: NodeID
+    layer_ids: LayerIDs
+
+    msg_type = MsgType.ANNOUNCE
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "LayerIDs": layer_ids_to_json(self.layer_ids)}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "AnnounceMsg":
+        return cls(
+            src_id=int(d["SrcID"]),
+            layer_ids=layer_ids_from_json(d.get("LayerIDs") or {}),
+        )
+
+
+@dataclasses.dataclass
+class AckMsg:
+    """Receiver → leader: layer landed (message.go:62-91)."""
+
+    src_id: NodeID
+    layer_id: LayerID
+    location: LayerLocation = LayerLocation.INMEM
+
+    msg_type = MsgType.ACK
+
+    def to_payload(self) -> dict:
+        return {
+            "SrcID": self.src_id,
+            "LayerID": self.layer_id,
+            "Location": int(self.location),
+        }
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "AckMsg":
+        return cls(
+            src_id=int(d["SrcID"]),
+            layer_id=int(d["LayerID"]),
+            location=LayerLocation(d.get("Location", 0)),
+        )
+
+
+@dataclasses.dataclass
+class RetransmitMsg:
+    """Leader → owner: forward your copy of a layer to dest
+    (message.go:94-118)."""
+
+    src_id: NodeID
+    layer_id: LayerID
+    dest_id: NodeID
+
+    msg_type = MsgType.RETRANSMIT
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "LayerID": self.layer_id, "DestID": self.dest_id}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "RetransmitMsg":
+        return cls(int(d["SrcID"]), int(d["LayerID"]), int(d["DestID"]))
+
+
+@dataclasses.dataclass
+class FlowRetransmitMsg:
+    """Leader → sender: partial-layer send command with a bandwidth budget
+    (message.go:121-151)."""
+
+    src_id: NodeID
+    layer_id: LayerID
+    dest_id: NodeID
+    data_size: int
+    offset: int
+    rate: int
+
+    msg_type = MsgType.FLOW_RETRANSMIT
+
+    def to_payload(self) -> dict:
+        return {
+            "SrcID": self.src_id,
+            "LayerID": self.layer_id,
+            "DestID": self.dest_id,
+            "DataSize": self.data_size,
+            "Offset": self.offset,
+            "Rate": self.rate,
+        }
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "FlowRetransmitMsg":
+        return cls(
+            int(d["SrcID"]),
+            int(d["LayerID"]),
+            int(d["DestID"]),
+            int(d.get("DataSize", 0)),
+            int(d.get("Offset", 0)),
+            int(d.get("Rate", 0)),
+        )
+
+
+@dataclasses.dataclass
+class LayerMsg:
+    """A layer (or byte-range of one) in flight (message.go:154-190).
+
+    Never JSON-serialized whole: the transport writes a ``LayerHeader``
+    then streams the bytes.  ``total_size`` is the full layer size so a
+    receiver can account partial transfers (mode 3).
+    """
+
+    src_id: NodeID
+    layer_id: LayerID
+    layer_src: LayerSrc
+    total_size: int
+
+    msg_type = MsgType.LAYER
+
+
+@dataclasses.dataclass
+class LayerHeader:
+    """Data-plane preamble (transport.go:47-54, sans the ``Offert`` typo)."""
+
+    src_id: NodeID
+    layer_id: LayerID
+    layer_size: int
+    total_size: int
+    offset: int
+
+    def to_payload(self) -> dict:
+        return {
+            "SrcID": self.src_id,
+            "LayerID": self.layer_id,
+            "LayerSize": self.layer_size,
+            "TotalSize": self.total_size,
+            "Offset": self.offset,
+        }
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "LayerHeader":
+        return cls(
+            int(d["SrcID"]),
+            int(d["LayerID"]),
+            int(d["LayerSize"]),
+            int(d.get("TotalSize", 0)),
+            int(d.get("Offset", 0)),
+        )
+
+
+@dataclasses.dataclass
+class ClientReqMsg:
+    """Node → external client: stream me a layer (message.go:193-214)."""
+
+    src_id: NodeID
+    layer_id: LayerID
+    save_disk: bool = False
+
+    msg_type = MsgType.CLIENT_REQ
+
+    def to_payload(self) -> dict:
+        return {
+            "SrcID": self.src_id,
+            "LayerID": self.layer_id,
+            "SaveDisk": self.save_disk,
+        }
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "ClientReqMsg":
+        return cls(int(d["SrcID"]), int(d["LayerID"]), bool(d.get("SaveDisk", False)))
+
+
+@dataclasses.dataclass
+class StartupMsg:
+    """Leader → all: assignment satisfied, boot the inference engine
+    (message.go:217-241)."""
+
+    src_id: NodeID
+
+    msg_type = MsgType.STARTUP
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "StartupMsg":
+        return cls(int(d["SrcID"]))
+
+
+@dataclasses.dataclass
+class SimpleMsg:
+    """Free-form test message (message.go:244-270)."""
+
+    src_addr: str
+    payload_str: str
+
+    msg_type = MsgType.SIMPLE
+
+    def to_payload(self) -> dict:
+        return {"SrcAddr": self.src_addr, "PayloadStr": self.payload_str}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "SimpleMsg":
+        return cls(d.get("SrcAddr", ""), d.get("PayloadStr", ""))
+
+
+Message = Union[
+    AnnounceMsg,
+    AckMsg,
+    RetransmitMsg,
+    FlowRetransmitMsg,
+    LayerMsg,
+    ClientReqMsg,
+    StartupMsg,
+    SimpleMsg,
+]
+
+_DECODERS = {
+    MsgType.ANNOUNCE: AnnounceMsg,
+    MsgType.ACK: AckMsg,
+    MsgType.RETRANSMIT: RetransmitMsg,
+    MsgType.FLOW_RETRANSMIT: FlowRetransmitMsg,
+    MsgType.CLIENT_REQ: ClientReqMsg,
+    MsgType.STARTUP: StartupMsg,
+    MsgType.SIMPLE: SimpleMsg,
+}
+
+
+def decode_msg(msg_type: MsgType, payload: dict) -> Message:
+    """Envelope payload → typed message (message.go:280-301).  LAYER is
+    intentionally absent: it is reconstructed by the transport from the
+    binary stream, never JSON-decoded."""
+    try:
+        cls = _DECODERS[MsgType(msg_type)]
+    except (KeyError, ValueError):
+        raise ValueError(f"unknown MsgType: {msg_type}")
+    return cls.from_payload(payload)
+
+
+def src_of(msg: Message) -> Optional[NodeID]:
+    """Originating node id, if the message carries one."""
+    return getattr(msg, "src_id", None)

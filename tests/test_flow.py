@@ -1,0 +1,117 @@
+"""Max-flow scheduler unit tests (reference has none for flow.go)."""
+
+from distributed_llm_dissemination_tpu.core.types import LayerMeta, SourceType
+from distributed_llm_dissemination_tpu.sched.flow import FlowGraph
+
+
+def _meta(rate=0, st=SourceType.MEM):
+    return LayerMeta(limit_rate=rate, source_type=st)
+
+
+def test_single_sender_min_time():
+    # One sender at 100 B/s NIC, one 100-B layer -> t = 1 s.
+    g = FlowGraph(
+        assignment={1: {0: _meta()}},
+        status={0: {0: _meta(rate=100)}},
+        layer_sizes={0: 100},
+        node_network_bw={0: 100, 1: 100},
+    )
+    t, jobs = g.get_job_assignment()
+    assert t == 1
+    assert jobs[0][0].data_size == 100 and jobs[0][0].offset == 0
+
+
+def test_two_senders_split_layer():
+    # Two seeders, each 100 B/s, receiver NIC 200 B/s, 200-B layer:
+    # optimal t = 1 s with the layer split across both senders.
+    g = FlowGraph(
+        assignment={2: {0: _meta()}},
+        status={0: {0: _meta(rate=100)}, 1: {0: _meta(rate=100)}},
+        layer_sizes={0: 200},
+        node_network_bw={0: 100, 1: 100, 2: 200},
+    )
+    t, jobs = g.get_job_assignment()
+    assert t == 1
+    chunks = [j for sender in jobs.values() for j in sender]
+    assert sum(c.data_size for c in chunks) == 200
+    # Offsets tile the layer contiguously.
+    spans = sorted((c.offset, c.offset + c.data_size) for c in chunks)
+    assert spans[0][0] == 0 and spans[-1][1] == 200
+    for (_, e1), (s2, _) in zip(spans, spans[1:]):
+        assert e1 == s2
+
+
+def test_heterogeneous_rates_proportional_split():
+    # 10 B/s + 90 B/s senders, 100-B layer, receiver 100 B/s -> t=1,
+    # bytes split proportional to rates.
+    g = FlowGraph(
+        assignment={2: {0: _meta()}},
+        status={0: {0: _meta(rate=10)}, 1: {0: _meta(rate=90)}},
+        layer_sizes={0: 100},
+        node_network_bw={0: 100, 1: 100, 2: 100},
+    )
+    t, jobs = g.get_job_assignment()
+    assert t == 1
+    sizes = {s: sum(j.data_size for j in js) for s, js in jobs.items()}
+    assert sizes.get(0, 0) <= 10
+    assert sizes.get(1, 0) >= 90
+
+
+def test_receiver_nic_bound():
+    # Plenty of senders but the receiver NIC (100 B/s) is the bottleneck
+    # for 800 B -> t = 8 s.
+    status = {i: {0: _meta(rate=1000)} for i in range(4)}
+    g = FlowGraph(
+        assignment={9: {0: _meta()}},
+        status=status,
+        layer_sizes={0: 800},
+        node_network_bw={**{i: 1000 for i in range(4)}, 9: 100},
+    )
+    t, _ = g.get_job_assignment()
+    assert t == 8
+
+
+def test_unlimited_rate_uses_nic_bw():
+    # limit_rate 0 means unlimited: capacity falls back to NIC bandwidth
+    # (deviation from the reference, which would model a dead edge).
+    g = FlowGraph(
+        assignment={1: {0: _meta()}},
+        status={0: {0: _meta(rate=0)}},
+        layer_sizes={0: 500},
+        node_network_bw={0: 100, 1: 100},
+    )
+    t, jobs = g.get_job_assignment()
+    assert t == 5
+    assert jobs[0][0].data_size == 500
+
+
+def test_multiple_layers_multiple_receivers():
+    # 2 layers to 2 different receivers from one seeder at 100 B/s:
+    # 200 B total -> t = 2 s.
+    g = FlowGraph(
+        assignment={1: {0: _meta()}, 2: {1: _meta()}},
+        status={0: {0: _meta(rate=100), 1: _meta(rate=100)}},
+        layer_sizes={0: 100, 1: 100},
+        node_network_bw={0: 100, 1: 100, 2: 100},
+    )
+    t, jobs = g.get_job_assignment()
+    assert t == 2
+    total = sum(j.data_size for js in jobs.values() for j in js)
+    assert total == 200
+
+
+def test_deterministic_schedule():
+    kwargs = dict(
+        assignment={2: {0: _meta()}},
+        status={0: {0: _meta(rate=100)}, 1: {0: _meta(rate=100)}},
+        layer_sizes={0: 200},
+        node_network_bw={0: 100, 1: 100, 2: 200},
+    )
+    t1, j1 = FlowGraph(**kwargs).get_job_assignment()
+    t2, j2 = FlowGraph(**kwargs).get_job_assignment()
+    assert t1 == t2
+    assert {
+        s: [(j.layer_id, j.data_size, j.offset) for j in js] for s, js in j1.items()
+    } == {
+        s: [(j.layer_id, j.data_size, j.offset) for j in js] for s, js in j2.items()
+    }

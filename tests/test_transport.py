@@ -1,0 +1,258 @@
+"""Transport tests, dual-backend like the reference
+(/root/reference/distributor/transport_test.go): every scenario runs on the
+in-process fake AND real TCP on loopback.  Extends the reference's coverage
+with layer transfers (RAM, disk, rate-limited) and cut-through pipe relay,
+which the reference leaves untested.
+"""
+
+import queue
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+)
+from distributed_llm_dissemination_tpu.transport import (
+    InmemTransport,
+    LayerMsg,
+    SimpleMsg,
+    TcpTransport,
+    reset_registry,
+)
+
+RECV_TIMEOUT = 2.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_inmem_registry():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def make_transports(kind, n=2, is_client=False):
+    """1..n transports with a shared addr registry; TCP uses ephemeral ports."""
+    if kind == "inmem":
+        addrs = {i: f"node{i}" for i in range(n)}
+        ts = [InmemTransport(addrs[i], addr_registry=addrs) for i in range(n)]
+        return ts
+    # TCP: bind ephemeral ports first, then fill in the registry.
+    ts = [TcpTransport("127.0.0.1:0") for _ in range(n)]
+    registry = {i: ts[i].get_address() for i in range(n)}
+    for t in ts:
+        t.addr_registry.update(registry)
+    return ts
+
+
+def close_all(ts):
+    for t in ts:
+        t.close()
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_send_single(kind):
+    # Reference: TestTransportSendSingle (transport_test.go:18).
+    ts = make_transports(kind, 2)
+    try:
+        msg = SimpleMsg(src_addr=ts[0].get_address(), payload_str="hello")
+        ts[0].send(1, msg)
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert got.payload_str == "hello"
+    finally:
+        close_all(ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_send_three_fifo(kind):
+    # Reference: TestInmemoryTransportSendThree (transport_test.go:70).
+    ts = make_transports(kind, 2)
+    try:
+        for i in range(3):
+            ts[0].send(1, SimpleMsg(ts[0].get_address(), f"m{i}"))
+        for i in range(3):
+            got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+            assert got.payload_str == f"m{i}"
+    finally:
+        close_all(ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_broadcast(kind):
+    # Reference: TestInmemoryTransportBroadcastSingle (transport_test.go:140).
+    ts = make_transports(kind, 3)
+    try:
+        ts[0].broadcast(SimpleMsg(ts[0].get_address(), "all"))
+        for t in ts[1:]:
+            got = t.deliver().get(timeout=RECV_TIMEOUT)
+            assert got.payload_str == "all"
+    finally:
+        close_all(ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_self_send_short_circuit(kind):
+    # transport.go:282-285 — sending to myself lands in my own queue.
+    ts = make_transports(kind, 2)
+    try:
+        ts[0].send(0, SimpleMsg(ts[0].get_address(), "me"))
+        got = ts[0].deliver().get(timeout=RECV_TIMEOUT)
+        assert got.payload_str == "me"
+    finally:
+        close_all(ts)
+
+
+def _mem_layer(data: bytes, rate: int = 0) -> LayerSrc:
+    return LayerSrc(
+        inmem_data=bytearray(data),
+        data_size=len(data),
+        meta=LayerMeta(location=LayerLocation.INMEM, limit_rate=rate),
+    )
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_layer_transfer_inmem_source(kind):
+    ts = make_transports(kind, 2)
+    try:
+        payload = bytes(range(256)) * 2048  # 512 KiB
+        ts[0].send(1, LayerMsg(0, 7, _mem_layer(payload), len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert isinstance(got, LayerMsg)
+        assert got.layer_id == 7 and got.total_size == len(payload)
+        assert got.layer_src.meta.location == LayerLocation.INMEM
+        assert bytes(got.layer_src.inmem_data) == payload
+    finally:
+        close_all(ts)
+
+
+def test_layer_transfer_partial_range_tcp():
+    # Mode-3 style: only [offset, offset+data_size) travels.
+    ts = make_transports("tcp", 2)
+    try:
+        full = bytes(range(256)) * 1024
+        src = _mem_layer(full)
+        src.offset, src.data_size = 1000, 5000
+        ts[0].send(1, LayerMsg(0, 3, src, len(full)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert got.layer_src.offset == 1000
+        assert got.layer_src.data_size == 5000
+        assert bytes(got.layer_src.inmem_data) == full[1000:6000]
+        assert got.total_size == len(full)
+    finally:
+        close_all(ts)
+
+
+def test_layer_transfer_disk_source_tcp(tmp_path):
+    # Disk layers stream via sendfile (transport.go:357-367).
+    ts = make_transports("tcp", 2)
+    try:
+        payload = b"\xabQ" * (128 * 1024)
+        fp = tmp_path / "0.layer"
+        fp.write_bytes(payload)
+        src = LayerSrc(
+            fp=str(fp),
+            data_size=len(payload),
+            meta=LayerMeta(location=LayerLocation.DISK),
+        )
+        ts[0].send(1, LayerMsg(0, 1, src, len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert bytes(got.layer_src.inmem_data) == payload
+    finally:
+        close_all(ts)
+
+
+def test_layer_rate_limited_tcp():
+    # 512 KiB at 2 MiB/s should take ~0.13s+ (burst credit 256 KiB).
+    ts = make_transports("tcp", 2)
+    try:
+        payload = b"z" * (512 * 1024)
+        t0 = time.monotonic()
+        ts[0].send(1, LayerMsg(0, 2, _mem_layer(payload, rate=2 * 1024 * 1024), len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        elapsed = time.monotonic() - t0
+        assert bytes(got.layer_src.inmem_data) == payload
+        assert elapsed > 0.08
+    finally:
+        close_all(ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_pipe_cut_through_relay(kind):
+    # A pipe (layer 5 -> node 2) on node 1 relays the layer onward while
+    # receiving it (transport.go:144-196).
+    ts = make_transports(kind, 3)
+    try:
+        ts[1].register_pipe(5, 2)
+        payload = bytes(range(256)) * 1024
+        ts[0].send(1, LayerMsg(0, 5, _mem_layer(payload), len(payload)))
+        got1 = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        got2 = ts[2].deliver().get(timeout=RECV_TIMEOUT)
+        assert bytes(got1.layer_src.inmem_data) == payload
+        assert bytes(got2.layer_src.inmem_data) == payload
+        # Forwarded header keeps the original src (reference TODO :152-164).
+        assert got2.src_id == 0
+        # Pipe is one-shot: a second transfer is NOT relayed.
+        ts[0].send(1, LayerMsg(0, 5, _mem_layer(b"x"), 1))
+        ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        with pytest.raises(queue.Empty):
+            ts[2].deliver().get(timeout=0.3)
+    finally:
+        close_all(ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_duplicate_pipe_rejected(kind):
+    ts = make_transports(kind, 2)
+    try:
+        ts[0].register_pipe(1, 1)
+        with pytest.raises(ValueError):
+            ts[0].register_pipe(1, 1)
+    finally:
+        close_all(ts)
+
+
+def test_send_to_unknown_node_raises():
+    ts = make_transports("tcp", 1)
+    try:
+        with pytest.raises(KeyError):
+            ts[0].send(99, SimpleMsg("a", "b"))
+    finally:
+        close_all(ts)
+
+
+def test_control_conn_recovers_after_peer_restart():
+    # A cached control connection dies with the peer; the next send must
+    # evict, re-dial, and succeed (the reference poisons the conn forever).
+    t0 = TcpTransport("127.0.0.1:0")
+    t1 = TcpTransport("127.0.0.1:0")
+    addr1 = t1.get_address()
+    t0.addr_registry[1] = addr1
+    try:
+        t0.send(1, SimpleMsg(t0.get_address(), "before"))
+        assert t1.deliver().get(timeout=RECV_TIMEOUT).payload_str == "before"
+        t1.close()  # peer dies
+        time.sleep(0.1)
+        # Restart the peer on the SAME port.
+        t1 = TcpTransport(addr1)
+        # A send into the stale conn may vanish into the TCP buffer before
+        # the RST arrives (loss is only detectable by the application), so
+        # retry until a message lands: the transport must evict the dead
+        # conn and re-dial rather than staying poisoned forever.
+        got = None
+        for _ in range(10):
+            try:
+                t0.send(1, SimpleMsg(t0.get_address(), "after"))
+            except OSError:
+                time.sleep(0.1)
+                continue
+            try:
+                got = t1.deliver().get(timeout=0.5)
+                break
+            except queue.Empty:
+                continue
+        assert got is not None and got.payload_str == "after"
+    finally:
+        t0.close()
+        t1.close()
