@@ -1,0 +1,85 @@
+"""Benchmark: layer-dissemination throughput at the chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GB/s/chip", "vs_baseline": N}
+
+Measures the terminal hop of dissemination on the device: byte-range
+fragments (the multi-sender flow-job splits of mode 3 — flow.go:193-211 in
+the reference — laid out as equal HBM shards, the same layout
+``parallel/collectives.allgather_shards`` produces) are fused into the
+contiguous Llama-3-8B-shaped layer (~416 MiB) in one read+write pass per
+layer.  ROUNDS layers are processed inside a single jit program so
+relay/dispatch latency is excluded; each round depends on the previous
+one's output so XLA cannot elide work.  Reported bytes count only the
+layer writes (conservative: actual traffic also reads the fragments).
+
+Baseline: the reference's modeled per-node NIC line rate, 12.5 Gbit/s =
+1.5625 GB/s (``/root/reference/conf/config.json`` ``NetworkBW``) — the
+fastest the Go/TCP system can deliver layer bytes into a node's memory.
+"""
+
+import json
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BASELINE_GBPS = 1.5625  # 12.5 Gbit/s reference NetworkBW, conf/config.json
+ROUNDS = 30
+PARTS = 8
+TRIALS = 3
+
+
+def main() -> None:
+    from distributed_llm_dissemination_tpu.models.llama import CONFIGS
+
+    layer_bytes = CONFIGS["llama3-8b"].layer_nbytes()  # ~416 MiB
+    total = (layer_bytes // 2 // PARTS) * PARTS  # bf16 elements, tiled
+    frag = total // PARTS
+
+    frags = jnp.ones((PARTS, frag), jnp.bfloat16)
+
+    @jax.jit
+    def reassemble_layers(frags):
+        def round_body(r, prev):
+            # Chain on the previous layer so no round can be elided.
+            rb = prev[0] * 0 + r.astype(jnp.bfloat16)
+            return frags.reshape(total) + rb
+
+        return lax.fori_loop(
+            0, ROUNDS, round_body, jnp.zeros((total,), jnp.bfloat16)
+        )
+
+    # Warm twice: compile, then the first post-compile call (which pays
+    # one-time relay/allocation costs on some backends).
+    jax.block_until_ready(reassemble_layers(frags))
+    jax.block_until_ready(reassemble_layers(frags))
+
+    times = []
+    for _ in range(TRIALS):
+        t0 = time.monotonic()
+        out = reassemble_layers(frags)
+        checksum = float(out[0])  # forces completion before the clock stops
+        times.append(time.monotonic() - t0)
+        assert checksum == checksum
+
+    moved = total * 2 * ROUNDS  # layer-write bytes only
+    gbps = moved / statistics.median(times) / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "llama3-8b layer reassembly into HBM "
+                f"({PARTS} flow-job fragments x {ROUNDS} layers, "
+                f"{total * 2 >> 20} MiB each)",
+                "value": round(gbps, 3),
+                "unit": "GB/s/chip",
+                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

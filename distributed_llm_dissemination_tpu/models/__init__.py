@@ -1,0 +1,17 @@
+from .llama import (  # noqa: F401
+    CONFIGS,
+    ModelConfig,
+    forward,
+    forward_jit,
+    init_params,
+    loss_fn,
+)
+from .sharded import (  # noqa: F401
+    AXES,
+    build_train_step,
+    example_batch,
+    factor_mesh_axes,
+    make_train_mesh,
+    param_specs,
+    shard_params,
+)

@@ -1,0 +1,257 @@
+"""Llama-style transformer: the model whose layers get disseminated.
+
+The reference treats layers as opaque byte blobs sized like Llama-70B
+shards (``/root/reference/conf/config.json``: 8 × 10.18 GiB) and its
+``startupMsg`` is "the hook that would launch an inference engine"
+(``distributor/message.go:216-241``).  This module supplies that engine:
+a pure-JAX (pytree params + functional apply) Llama-3-family model — GQA
+attention with RoPE, RMSNorm, SwiGLU FFN, optional MoE — so disseminated
+weights boot a real jitted forward pass, and the preset configs give the
+benchmark scenarios their true layer sizes.
+
+All matmuls are einsums in bfloat16 with fp32 accumulation — large, batched,
+MXU-friendly; no data-dependent Python control flow anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny"
+    vocab: int = 256
+    d_model: int = 128
+    n_layers: int = 4
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    d_ff: int = 256
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # MoE (expert-parallel) variant: 0 experts = dense SwiGLU.
+    n_experts: int = 0
+    top_k: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def layer_nbytes(self) -> int:
+        """Bytes of one transformer layer's params in this dtype — the
+        'LayerSize' the dissemination configs should use."""
+        itemsize = np.dtype(self.dtype).itemsize
+        d, f, h, kv = self.d_model, self.d_ff, self.n_heads, self.n_kv_heads
+        hd = self.head_dim
+        attn = d * h * hd + 2 * d * kv * hd + h * hd * d
+        if self.n_experts:
+            ffn = self.n_experts * 3 * d * f + d * self.n_experts
+        else:
+            ffn = 3 * d * f
+        norms = 2 * d
+        return (attn + ffn + norms) * itemsize
+
+
+# Real Llama-3 family shapes (public architecture constants) + test sizes.
+CONFIGS: Dict[str, ModelConfig] = {
+    "tiny": ModelConfig(),
+    "tiny-moe": ModelConfig(name="tiny-moe", n_experts=4, top_k=2),
+    "llama3-8b": ModelConfig(
+        name="llama3-8b", vocab=128256, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, d_ff=14336,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b", vocab=128256, d_model=8192, n_layers=80,
+        n_heads=64, n_kv_heads=8, d_ff=28672,
+    ),
+    "llama3-405b": ModelConfig(
+        name="llama3-405b", vocab=128256, d_model=16384, n_layers=126,
+        n_heads=128, n_kv_heads=8, d_ff=53248,
+    ),
+}
+
+
+# ---------------------------------------------------------------------- init
+
+def init_layer_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, jax.Array]:
+    """One transformer layer's weights as a flat dict pytree."""
+    d, f = cfg.d_model, cfg.d_ff
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    k = iter(jax.random.split(key, 8))
+    scale = d ** -0.5
+    p = {
+        "wq": jax.random.normal(next(k), (d, h * hd), cfg.dtype) * scale,
+        "wk": jax.random.normal(next(k), (d, kv * hd), cfg.dtype) * scale,
+        "wv": jax.random.normal(next(k), (d, kv * hd), cfg.dtype) * scale,
+        "wo": jax.random.normal(next(k), (h * hd, d), cfg.dtype) * scale,
+        "ln1": jnp.ones((d,), cfg.dtype),
+        "ln2": jnp.ones((d,), cfg.dtype),
+    }
+    if cfg.n_experts:
+        e = cfg.n_experts
+        p["router"] = jax.random.normal(next(k), (d, e), cfg.dtype) * scale
+        p["w1"] = jax.random.normal(next(k), (e, d, f), cfg.dtype) * scale
+        p["w3"] = jax.random.normal(next(k), (e, d, f), cfg.dtype) * scale
+        p["w2"] = jax.random.normal(next(k), (e, f, d), cfg.dtype) * (f ** -0.5)
+    else:
+        p["w1"] = jax.random.normal(next(k), (d, f), cfg.dtype) * scale
+        p["w3"] = jax.random.normal(next(k), (d, f), cfg.dtype) * scale
+        p["w2"] = jax.random.normal(next(k), (f, d), cfg.dtype) * (f ** -0.5)
+    return p
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
+    """Full model params.  Layer weights are STACKED along a leading
+    n_layers axis — one pytree leaf per weight kind — so a layer is a
+    slice (disseminable blob) and scan/pipeline stages index it."""
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    per_layer = [init_layer_params(cfg, lk) for lk in layer_keys]
+    stacked = {
+        name: jnp.stack([lp[name] for lp in per_layer])
+        for name in per_layer[0]
+    }
+    return {
+        "embed": jax.random.normal(k_emb, (cfg.vocab, cfg.d_model), cfg.dtype)
+        * (cfg.d_model ** -0.5),
+        "layers": stacked,
+        "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
+        "lm_head": jax.random.normal(
+            k_out, (cfg.d_model, cfg.vocab), cfg.dtype
+        ) * (cfg.d_model ** -0.5),
+    }
+
+
+# ------------------------------------------------------------------- blocks
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings; x: [..., seq, heads, head_dim]."""
+    hd = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, hd // 2, dtype=jnp.float32) / (hd // 2))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., :, None, :]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def gqa_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal_mask: jax.Array
+) -> jax.Array:
+    """Grouped-query attention core.  q: [b, s, h, hd]; k/v: [b, s, kv, hd];
+    mask: [sq, sk] additive."""
+    b, sq, h, hd = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
+    qg = q.reshape(b, sq, kvh, group, hd)
+    logits = jnp.einsum(
+        "bskgh,btkh->bkgst", qg, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(hd)
+    logits = logits + causal_mask  # broadcast over [b, kv, g, sq, sk]
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkh->bskgh", probs, v)
+    return out.reshape(b, sq, h, hd)
+
+
+def attention_block(
+    p: Dict[str, jax.Array], x: jax.Array, positions: jax.Array, cfg: ModelConfig
+) -> jax.Array:
+    b, s, d = x.shape
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    xn = rms_norm(x, p["ln1"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dq->bsq", xn, p["wq"]).reshape(b, s, h, hd)
+    k = jnp.einsum("bsd,dq->bsq", xn, p["wk"]).reshape(b, s, kv, hd)
+    v = jnp.einsum("bsd,dq->bsq", xn, p["wv"]).reshape(b, s, kv, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    mask = jnp.where(
+        positions[:, None] >= positions[None, :], 0.0, -jnp.inf
+    ).astype(jnp.float32)
+    out = gqa_attention(q, k, v, mask)
+    return x + jnp.einsum("bsq,qd->bsd", out.reshape(b, s, h * hd), p["wo"])
+
+
+def dense_ffn(p: Dict[str, jax.Array], x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    xn = rms_norm(x, p["ln2"], cfg.norm_eps)
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", xn, p["w1"]))
+    up = jnp.einsum("bsd,df->bsf", xn, p["w3"])
+    return x + jnp.einsum("bsf,fd->bsd", gate * up, p["w2"])
+
+
+def route_topk(weights: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Keep the top-k routing weights per token (tie-inclusive) and
+    renormalize.  Shared by the dense-dispatch and the ep-sharded MoE paths
+    so routing semantics cannot diverge."""
+    if cfg.top_k >= cfg.n_experts:
+        return weights
+    top = jax.lax.top_k(weights, cfg.top_k)[0][..., -1:]
+    weights = jnp.where(weights >= top, weights, 0.0)
+    return weights / (weights.sum(-1, keepdims=True) + 1e-9)
+
+
+def moe_ffn(p: Dict[str, jax.Array], x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Top-k routed mixture of SwiGLU experts (dense dispatch: every expert
+    computes, gates zero out unrouted pairs — compile-friendly, and the
+    expert dimension shards cleanly over the ep axis)."""
+    xn = rms_norm(x, p["ln2"], cfg.norm_eps)
+    logits = jnp.einsum("bsd,de->bse", xn, p["router"]).astype(jnp.float32)
+    weights = route_topk(jax.nn.softmax(logits, axis=-1), cfg)
+    gate = jax.nn.silu(jnp.einsum("bsd,edf->besf", xn, p["w1"]))
+    up = jnp.einsum("bsd,edf->besf", xn, p["w3"])
+    expert_out = jnp.einsum("besf,efd->besd", gate * up, p["w2"])
+    mixed = jnp.einsum("besd,bse->bsd", expert_out, weights.astype(x.dtype))
+    return x + mixed
+
+
+def layer_apply(
+    p: Dict[str, jax.Array], x: jax.Array, positions: jax.Array, cfg: ModelConfig
+) -> jax.Array:
+    x = attention_block(p, x, positions, cfg)
+    if cfg.n_experts:
+        return moe_ffn(p, x, cfg)
+    return dense_ffn(p, x, cfg)
+
+
+# ------------------------------------------------------------------ forward
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Logits for [batch, seq] int tokens.  Layers run under lax.scan over
+    the stacked layer axis — one traced layer body regardless of depth."""
+    b, s = tokens.shape
+    positions = jnp.arange(s)
+    x = params["embed"][tokens]
+
+    def body(x, layer_p):
+        return layer_apply(layer_p, x, positions, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["ln_f"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+
+
+def loss_fn(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Next-token cross-entropy (fp32 logits)."""
+    logits = forward(params, tokens[:, :-1], cfg).astype(jnp.float32)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def forward_jit(params, tokens, cfg: ModelConfig):
+    return forward(params, tokens, cfg)

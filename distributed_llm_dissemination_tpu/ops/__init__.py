@@ -1,0 +1,6 @@
+from .reassembly import (  # noqa: F401
+    alloc_layer_buffer,
+    assemble_fragments,
+    split_offsets,
+    write_fragment,
+)

@@ -1,0 +1,67 @@
+"""On-device partial-layer reassembly.
+
+The device-plane fix for the reference's biggest shortcut: its mode-3
+receiver never reassembles partial layers (the copy is commented out,
+``/root/reference/distributor/node.go:1545-1547``).  Host-side reassembly
+lives in ``runtime/receiver.py``; here fragments are written into a
+preallocated HBM buffer with ``lax.dynamic_update_slice`` under donation,
+so shards arriving from different seeders land at their byte offsets
+without host round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+# Donation lets XLA write fragments into the existing HBM buffer instead of
+# allocating a copy per fragment — essential at multi-GiB layer sizes.
+_write_fragment_donated = jax.jit(
+    lambda buf, frag, offset: lax.dynamic_update_slice(buf, frag, (offset,)),
+    donate_argnums=(0,),
+)
+
+
+def alloc_layer_buffer(n_elements: int, dtype=jnp.bfloat16, sharding=None) -> jax.Array:
+    """Preallocate the reassembly target in HBM."""
+    if sharding is not None:
+        return jnp.zeros((n_elements,), dtype=dtype, device=sharding)
+    return jnp.zeros((n_elements,), dtype=dtype)
+
+
+def write_fragment(buf: jax.Array, frag: jax.Array, offset: int) -> jax.Array:
+    """Write one fragment at its element offset, donating the buffer."""
+    return _write_fragment_donated(buf, frag, jnp.asarray(offset, jnp.int32))
+
+
+def assemble_fragments(
+    n_elements: int,
+    fragments: Sequence[Tuple[int, jax.Array]],
+    dtype=jnp.bfloat16,
+    sharding=None,
+) -> jax.Array:
+    """Build a full layer in HBM from (element_offset, fragment) pairs —
+    the device-side equivalent of the receiver's byte-range reassembly."""
+    buf = alloc_layer_buffer(n_elements, dtype, sharding)
+    for offset, frag in fragments:
+        buf = write_fragment(buf, frag, offset)
+    return buf
+
+
+def split_offsets(total: int, parts: int) -> Sequence[Tuple[int, int]]:
+    """Contiguous (offset, size) tiling of ``total`` elements into
+    ``parts`` chunks — the shape of a flow schedule's per-sender jobs
+    (flow.go:193-211)."""
+    base, rem = divmod(total, parts)
+    spans = []
+    off = 0
+    for i in range(parts):
+        size = base + (1 if i < rem else 0)
+        spans.append((off, size))
+        off += size
+    return spans

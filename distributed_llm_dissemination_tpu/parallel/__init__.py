@@ -1,0 +1,20 @@
+from .collectives import (  # noqa: F401
+    allgather_shards,
+    one_to_all,
+    permute_blocks,
+    replicate,
+    ring_broadcast,
+    shard_along,
+)
+from .mesh import (  # noqa: F401
+    StagePlacement,
+    assignment_to_placement,
+    make_mesh,
+    mesh_from_conf,
+)
+from .mover import (  # noqa: F401
+    StageResult,
+    WeightMover,
+    array_to_bytes,
+    bytes_to_array,
+)

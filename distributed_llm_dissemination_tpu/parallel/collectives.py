@@ -1,0 +1,162 @@
+"""Dissemination as XLA collectives over a device mesh.
+
+The TPU data plane replacing the reference's TCP byte streams
+(``/root/reference/distributor/transport.go``): each dissemination mode has
+a collective-program equivalent compiled via ``jax.shard_map`` onto a
+``jax.sharding.Mesh`` so the layer bytes ride ICI into HBM (SURVEY §5.8):
+
+- **mode 0** (leader broadcast, node.go:326-352) → ``replicate`` /
+  ``one_to_all``: a single source's HBM copy lands on every device.
+- **mode 1** (peer retransmission, node.go:554-608) → ``ring_broadcast``:
+  an explicit ``ppermute`` ring relay — each hop forwards the layer to its
+  neighbor while later hops are still pending; the device analogue of the
+  cut-through pipe relay (transport.go:144-196).
+- **mode 3** (multi-sender byte-range split, flow.go:193-211) →
+  ``allgather_shards``: every seeder holds a byte-range shard and one
+  tiled ``all_gather`` reassembles the full layer everywhere at the full
+  bisection bandwidth.
+
+These programs are jit-compiled once per (shape, mesh) and reused per
+layer; the scalar plumbing stays on host (the control plane).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicate(x: jax.Array, mesh: Mesh) -> jax.Array:
+    """Mode-0 equivalent: replicate onto every device of the mesh.  XLA
+    emits the broadcast (single source → all) over ICI."""
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def shard_along(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
+    """Split a 1-D layer into per-device byte-range shards along ``axis``
+    (the device-plane form of flow.go's offset/dataSize jobs)."""
+    return jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+
+@functools.lru_cache(maxsize=64)
+def _allgather_fn(mesh: Mesh, axis: str):
+    @jax.jit
+    def gather(v):
+        return jax.shard_map(
+            lambda s: lax.all_gather(s, axis, tiled=True),
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(),
+            check_vma=False,
+        )(v)
+
+    return gather
+
+
+def allgather_shards(shards: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
+    """Mode-3 equivalent: every device contributes its shard; the full
+    layer materializes replicated on all devices in one collective."""
+    return _allgather_fn(mesh, axis)(shards)
+
+
+@functools.lru_cache(maxsize=64)
+def _ring_broadcast_fn(mesh: Mesh, axis: str, src: int):
+    n = mesh.shape[axis]
+    fwd: Tuple[Tuple[int, int], ...] = tuple((i, (i + 1) % n) for i in range(n))
+
+    def per_device(buf):
+        idx = lax.axis_index(axis)
+        # Hop distance from the source along the ring.
+        dist = (idx - src) % n
+
+        def step(k, b):
+            recv = lax.ppermute(b, axis, fwd)
+            # Devices exactly k hops downstream adopt the relayed copy;
+            # earlier hops already hold it, later hops wait their turn.
+            return jnp.where(dist == k, recv, b)
+
+        return lax.fori_loop(1, n, step, buf)
+
+    @jax.jit
+    def broadcast(v):
+        return jax.shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(axis),
+            check_vma=False,
+        )(v)
+
+    return broadcast
+
+
+def ring_broadcast(
+    per_device: jax.Array, mesh: Mesh, axis: str, src: int = 0
+) -> jax.Array:
+    """Mode-1 equivalent: relay the source device's block around the ring
+    with n-1 ``ppermute`` hops until every device holds it.
+
+    ``per_device`` is sharded along ``axis`` (one block per device); the
+    result is also sharded, with every block equal to the source's.  On a
+    TPU torus each hop is a neighbor ICI transfer, so the relay pipelines
+    exactly like the reference's TeeReader cut-through chain."""
+    return _ring_broadcast_fn(mesh, axis, src)(per_device)
+
+
+@functools.lru_cache(maxsize=64)
+def _permute_fn(mesh: Mesh, axis: str, perm: Tuple[Tuple[int, int], ...]):
+    @jax.jit
+    def permute(v):
+        return jax.shard_map(
+            lambda s: lax.ppermute(s, axis, perm),
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(axis),
+            check_vma=False,
+        )(v)
+
+    return permute
+
+
+def permute_blocks(
+    per_device: jax.Array,
+    mesh: Mesh,
+    axis: str,
+    perm: Sequence[Tuple[int, int]],
+) -> jax.Array:
+    """General leader-directed point-to-point schedule: one
+    ``collective_permute`` step moving each source's block to its dest —
+    the device-plane form of a batch of retransmitMsg commands
+    (distributor/message.go:94-118)."""
+    return _permute_fn(mesh, axis, tuple(perm))(per_device)
+
+
+@functools.lru_cache(maxsize=64)
+def _one_to_all_fn(mesh: Mesh, axis: str, src: int):
+    @jax.jit
+    def run(v):
+        def per_device(s):
+            idx = lax.axis_index(axis)
+            contrib = jnp.where(idx == src, s, jnp.zeros_like(s))
+            return lax.psum(contrib, axis)
+
+        return jax.shard_map(
+            per_device, mesh=mesh, in_specs=P(axis), out_specs=P(),
+            check_vma=False,
+        )(v)
+
+    return run
+
+
+def one_to_all(
+    x: jax.Array, mesh: Mesh, axis: str, src: int = 0
+) -> jax.Array:
+    """Mode-0 as an explicit collective: zero-mask every non-source block
+    and psum — the source's block lands everywhere.  Prefer ``replicate``
+    (XLA broadcast) in production; this exists for schedule parity tests."""
+    return _one_to_all_fn(mesh, axis, src)(x)

@@ -1,0 +1,111 @@
+"""Device-mesh construction and Assignment → pipeline-stage placement.
+
+The TPU-native heart of the framework: the reference's ``Assignment``
+(node → layers, ``/root/reference/distributor/node.go:174``) is exactly a
+pipeline-parallel *stage placement* map — which node hosts which contiguous
+layers for staged inference (SURVEY §2.3).  Here a node corresponds to a
+slice of a ``jax.sharding.Mesh`` along the pipeline axis, and dissemination
+lands each layer in the HBM of its stage's devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.config import MeshConf
+from ..core.types import Assignment, LayerID, NodeID
+
+
+def make_mesh(
+    axis_sizes: Sequence[int],
+    axis_names: Sequence[str],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the available devices (row-major fill)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = int(np.prod(axis_sizes))
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {tuple(axis_sizes)} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:need], dtype=object).reshape(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def mesh_from_conf(conf: MeshConf, devices=None) -> Mesh:
+    return make_mesh(conf.axis_sizes, conf.axis_names, devices)
+
+
+@dataclasses.dataclass
+class StagePlacement:
+    """Node → (pipeline-stage index, devices) mapping derived from an
+    Assignment.
+
+    Nodes are ranked by their minimum assigned layer id so contiguous layer
+    ranges land on consecutive stages — the staged-inference layout the
+    reference's startup hook presumes (distributor/message.go:216-241).
+    """
+
+    mesh: Mesh
+    pipeline_axis: str
+    node_to_stage: Dict[NodeID, int]
+    layer_to_stage: Dict[LayerID, int]
+
+    @property
+    def num_stages(self) -> int:
+        return self.mesh.shape[self.pipeline_axis]
+
+    def stage_devices(self, stage: int) -> List[jax.Device]:
+        axis = list(self.mesh.axis_names).index(self.pipeline_axis)
+        take = np.take(self.mesh.devices, stage, axis=axis)
+        return list(np.ravel(take))
+
+    def devices_for_node(self, node_id: NodeID) -> List[jax.Device]:
+        return self.stage_devices(self.node_to_stage[node_id])
+
+    def devices_for_layer(self, layer_id: LayerID) -> List[jax.Device]:
+        return self.stage_devices(self.layer_to_stage[layer_id])
+
+    def layer_sharding(self, spec: P = P()) -> NamedSharding:
+        """Sharding for one stage-local layer (default: replicated within
+        the stage)."""
+        return NamedSharding(self.mesh, spec)
+
+
+def assignment_to_placement(
+    assignment: Assignment, mesh: Mesh, pipeline_axis: str = "nodes"
+) -> StagePlacement:
+    """Map each assigned node to a pipeline stage of the mesh.
+
+    Requires len(assignment) <= mesh.shape[pipeline_axis].  Stage order
+    follows each node's minimum assigned layer, so Assignment
+    {7: [0..7]} or a contiguous {1: [0-19], 2: [20-39], ...} both produce
+    the natural stage order.
+    """
+    n_stages = mesh.shape[pipeline_axis]
+    if len(assignment) > n_stages:
+        raise ValueError(
+            f"assignment has {len(assignment)} nodes but mesh axis "
+            f"'{pipeline_axis}' has only {n_stages} stages"
+        )
+    ranked: List[Tuple[int, NodeID]] = sorted(
+        (min(layers) if layers else 0, node_id)
+        for node_id, layers in assignment.items()
+    )
+    node_to_stage = {node_id: stage for stage, (_, node_id) in enumerate(ranked)}
+    layer_to_stage = {
+        layer_id: node_to_stage[node_id]
+        for node_id, layers in assignment.items()
+        for layer_id in layers
+    }
+    return StagePlacement(
+        mesh=mesh,
+        pipeline_axis=pipeline_axis,
+        node_to_stage=node_to_stage,
+        layer_to_stage=layer_to_stage,
+    )

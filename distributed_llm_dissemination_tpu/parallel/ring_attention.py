@@ -1,0 +1,90 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context support is first-class in this framework: the sequence dim is
+sharded over the ``sp`` mesh axis and attention runs blockwise — each
+device keeps its Q shard resident while K/V blocks rotate around the ring
+via ``lax.ppermute``, accumulating with an online (flash-style) softmax.
+Communication of the next K/V block overlaps the current block's matmuls on
+TPU (XLA schedules the ppermute DMA concurrently), so attention over an
+S-long sequence costs S/sp memory per chip and n-1 neighbor hops.
+
+This is new capability relative to the reference (which has no compute at
+all, SURVEY §2.3); the pattern follows the public ring-attention /
+blockwise-attention literature (see PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    s_local: int,
+) -> jax.Array:
+    """Causal GQA ring attention inside a manual (shard_map) context.
+
+    q: [b, s_local, h, hd] — this device's query block (heads may be
+    tp-sharded; grouping is h//kv locally).
+    k, v: [b, s_local, kv, hd] — this device's key/value block, already
+    position-encoded with *global* positions.
+    Returns [b, s_local, h, hd].
+    """
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, sq, h, hd = q.shape
+    kvh = k.shape[2]
+    group = h // kvh
+    qg = q.reshape(b, sq, kvh, group, hd)
+    q_pos = my * s_local + jnp.arange(s_local)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, o = carry
+        # The block in hand originated at device (my - i) mod n.
+        src = (my - i) % n
+        k_pos = src * s_local + jnp.arange(s_local)
+        logits = jnp.einsum(
+            "bskgh,btkh->bkgst", qg, k_blk, preferred_element_type=jnp.float32
+        ) / np.sqrt(hd)
+        causal = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(causal, logits, _NEG_INF)
+
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum("bkgst,btkh->bkgsh", p.astype(v_blk.dtype), v_blk)
+        o_new = o * alpha[..., None].astype(o.dtype) + pv
+
+        # Skip the final rotation: after the last accumulation the blocks
+        # are discarded, so that hop would be a wasted ICI transfer.
+        k_nxt, v_nxt = lax.cond(
+            i < n - 1,
+            lambda kv: (
+                lax.ppermute(kv[0], axis, perm),
+                lax.ppermute(kv[1], axis, perm),
+            ),
+            lambda kv: kv,
+            (k_blk, v_blk),
+        )
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, kvh, group, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, group, sq), jnp.float32)
+    o0 = jnp.zeros((b, kvh, group, sq, hd), v.dtype)
+    (k_f, v_f, m_f, l_f, o_f), _ = lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    out = o_f / jnp.maximum(l_f, 1e-30)[..., None].astype(o_f.dtype)
+    # [b, kv, g, s, hd] -> [b, s, h, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, hd)

@@ -1,27 +1,33 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's dual-backend test pattern
 (/root/reference/distributor/transport_test.go:35-66): protocol tests run on
 a process-local fake transport *and* real TCP on loopback; device-plane
 tests run on a virtual 8-device CPU mesh standing in for a TPU slice.
+
+The axon sitecustomize imports jax and registers the TPU plugin at
+interpreter start, so env vars alone are too late — but the backend itself
+is not initialized until first use, so flipping ``jax_platforms`` here
+(before any jax call) still wins.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
     return devices

@@ -1,0 +1,153 @@
+"""Device-plane tests on the virtual 8-device CPU mesh: mesh/placement,
+WeightMover staging, collective dissemination programs, HBM reassembly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_llm_dissemination_tpu.core.types import LayerMeta, LayerLocation
+from distributed_llm_dissemination_tpu.core.config import create_inmem_layer
+from distributed_llm_dissemination_tpu.ops import (
+    assemble_fragments,
+    split_offsets,
+)
+from distributed_llm_dissemination_tpu.parallel import (
+    WeightMover,
+    allgather_shards,
+    array_to_bytes,
+    assignment_to_placement,
+    bytes_to_array,
+    make_mesh,
+    one_to_all,
+    permute_blocks,
+    replicate,
+    ring_broadcast,
+    shard_along,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh(cpu_devices):
+    return make_mesh((8,), ("nodes",))
+
+
+def test_make_mesh_shape(mesh):
+    assert mesh.shape == {"nodes": 8}
+
+
+def test_assignment_to_placement(mesh):
+    # Contiguous PP placement: node 1 -> layers 0-9, node 2 -> 10-19, ...
+    assignment = {
+        n + 1: {lid: LayerMeta() for lid in range(n * 10, (n + 1) * 10)}
+        for n in range(8)
+    }
+    placement = assignment_to_placement(assignment, mesh, "nodes")
+    assert placement.num_stages == 8
+    assert placement.node_to_stage[1] == 0 and placement.node_to_stage[8] == 7
+    assert placement.layer_to_stage[0] == 0
+    assert placement.layer_to_stage[79] == 7
+    assert len(placement.devices_for_node(1)) == 1
+
+
+def test_placement_too_many_nodes(mesh):
+    assignment = {i: {0: LayerMeta()} for i in range(9)}
+    with pytest.raises(ValueError):
+        assignment_to_placement(assignment, mesh, "nodes")
+
+
+def test_bytes_roundtrip():
+    data = bytes(range(256)) * 33  # not dtype-aligned
+    arr = bytes_to_array(data, jnp.bfloat16)
+    back = array_to_bytes(arr)
+    assert back[: len(data)] == data
+
+
+def test_weight_mover_stage_updates_location(mesh):
+    layer = create_inmem_layer(0, 4096)
+    layer.inmem_data[:] = bytes(range(256)) * 16
+    mover = WeightMover(sharding=NamedSharding(mesh, P()))
+    arr = mover.stage(layer)
+    assert layer.meta.location == LayerLocation.HBM
+    assert layer.device_array is arr
+    assert array_to_bytes(arr) == bytes(layer.inmem_data)
+
+
+def test_weight_mover_bulk_double_buffered(mesh):
+    layers = {}
+    for lid in range(4):
+        layers[lid] = create_inmem_layer(lid, 8192)
+        layers[lid].inmem_data[:] = bytes([lid * 7 % 256]) * 8192
+    mover = WeightMover()
+    results = mover.stage_layers(layers)
+    assert [r.layer_id for r in results] == [0, 1, 2, 3]
+    for r in results:
+        assert array_to_bytes(r.array) == bytes(layers[r.layer_id].inmem_data)
+    assert mover.throughput_gbps(results) > 0
+
+
+def test_replicate_mode0(mesh):
+    x = jnp.arange(1024, dtype=jnp.float32)
+    y = replicate(x, mesh)
+    assert y.sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(y), np.arange(1024, dtype=np.float32))
+
+
+def test_one_to_all_matches_replicate(mesh):
+    # Schedule parity: explicit masked-psum broadcast == XLA replicate.
+    x = jnp.arange(64, dtype=jnp.float32) * 3
+    sharded = shard_along(x, mesh, "nodes")
+    out = one_to_all(sharded, mesh, "nodes", src=2)
+    # Every device must hold src's block (block 2 = elements 16..23).
+    expect = np.asarray(x[16:24])
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_ring_broadcast_mode1(mesh):
+    # Each device starts with its own block; after the ring relay all hold
+    # the source's block.
+    x = jnp.arange(64, dtype=jnp.float32)
+    sharded = shard_along(x, mesh, "nodes")
+    out = ring_broadcast(sharded, mesh, "nodes", src=3)
+    got = np.asarray(out).reshape(8, 8)
+    expect = np.asarray(x[24:32])
+    for d in range(8):
+        np.testing.assert_array_equal(got[d], expect)
+
+
+def test_allgather_shards_mode3(mesh):
+    # Mode 3: every seeder holds a byte-range shard; one all-gather
+    # reassembles the layer everywhere.
+    layer = np.random.default_rng(0).standard_normal(4096).astype(np.float32)
+    shards = shard_along(jnp.asarray(layer), mesh, "nodes")
+    full = allgather_shards(shards, mesh, "nodes")
+    assert full.sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(full), layer)
+
+
+def test_permute_blocks_point_to_point(mesh):
+    # Leader-directed schedule: shift every block one hop (ring).
+    x = jnp.arange(64, dtype=jnp.float32)
+    sharded = shard_along(x, mesh, "nodes")
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    out = np.asarray(permute_blocks(sharded, mesh, "nodes", perm)).reshape(8, 8)
+    src_blocks = np.asarray(x).reshape(8, 8)
+    for i in range(8):
+        np.testing.assert_array_equal(out[(i + 1) % 8], src_blocks[i])
+
+
+def test_assemble_fragments_multi_sender(mesh):
+    # Device-side reassembly of a mode-3 style multi-sender split.
+    total = 1000
+    full = np.arange(total, dtype=np.float32)
+    spans = split_offsets(total, 3)
+    frags = [(off, jnp.asarray(full[off : off + size])) for off, size in spans]
+    out = assemble_fragments(total, frags, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(out), full)
+
+
+def test_split_offsets_tiling():
+    spans = split_offsets(10, 3)
+    assert spans == [(0, 4), (4, 3), (7, 3)]
+    assert split_offsets(2, 4) == [(0, 1), (1, 1), (2, 0), (2, 0)]
