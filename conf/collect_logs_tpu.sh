@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Gather per-worker JSONL logs and merge them onto the leader's clock.
+#
+# Replacement for /root/reference/conf/collect_logs.sh: scp each worker's
+# log, then the collect_logs CLI does the jq merge + "timer start" rebase.
+#
+# Usage: conf/collect_logs_tpu.sh <tpu-name> <zone> <n-workers> [project]
+set -euo pipefail
+
+TPU=${1:?tpu-vm name}
+ZONE=${2:?zone}
+NWORKERS=${3:?number of workers}
+PROJECT=${4:-$(gcloud config get-value project)}
+OUT=logs/$TPU
+mkdir -p "$OUT"
+
+for ((w = 0; w < NWORKERS; w++)); do
+    gcloud compute tpus tpu-vm scp \
+        "$TPU":/tmp/node_"$w".jsonl "$OUT/node_$w.jsonl" \
+        --zone "$ZONE" --project "$PROJECT" --worker="$w" &
+done
+wait
+
+python -m distributed_llm_dissemination_tpu.cli.collect_logs \
+    "$OUT" -o "$OUT/merged.jsonl"
+echo "merged trace: $OUT/merged.jsonl"

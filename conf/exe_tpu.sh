@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Run one dissemination round across a TPU pod slice.
+#
+# Replacement for /root/reference/conf/exe.sh: per worker w, start the node
+# process with -id w (worker 0 is the leader per the config's IsLeader bit).
+# "-l" runs the layer-setup pass first (fabricate dummy/disk layers, then
+# exit — cmd/main.go:108-111), and caches are dropped before the timed run
+# so disk sources measure NVMe, not page cache (conf/exe.sh:16).
+#
+# Usage: conf/exe_tpu.sh <tpu-name> <zone> <config.json> <mode> [project]
+set -euo pipefail
+
+TPU=${1:?tpu-vm name}
+ZONE=${2:?zone}
+CONF=${3:?config path on the workers, e.g. ~/dissem/conf/tpu_v5e32_llama70b.json}
+MODE=${4:-3}
+PROJECT=${5:-$(gcloud config get-value project)}
+
+gcloud compute tpus tpu-vm ssh "$TPU" --zone "$ZONE" --project "$PROJECT" \
+    --worker=all --command "
+set -e
+cd ~/dissem
+W=\$(curl -s -H 'Metadata-Flavor: Google' \
+  'http://metadata.google.internal/computeMetadata/v1/instance/attributes/agent-worker-number')
+python -m distributed_llm_dissemination_tpu.cli.main \
+    -id \"\$W\" -f '$CONF' -s /nvme -l
+sync; echo 3 | sudo tee /proc/sys/vm/drop_caches >/dev/null
+python -m distributed_llm_dissemination_tpu.cli.main \
+    -id \"\$W\" -f '$CONF' -s /nvme -m '$MODE' 2> /tmp/node_\$W.jsonl
+"
+echo "run complete; gather logs with conf/collect_logs_tpu.sh"

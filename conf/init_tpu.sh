@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Prepare local NVMe scratch on every TPU-VM worker.
+#
+# Replacement for /root/reference/conf/init.sh (mkfs+mount the EC2 NVMe):
+# TPU-VMs created with --data-disk get /dev/nvme0n* block devices; this
+# formats and mounts the first unmounted one at /nvme for layer staging.
+#
+# Usage: conf/init_tpu.sh <tpu-name> <zone> [project]
+set -euo pipefail
+
+TPU=${1:?tpu-vm name}
+ZONE=${2:?zone}
+PROJECT=${3:-$(gcloud config get-value project)}
+
+gcloud compute tpus tpu-vm ssh "$TPU" --zone "$ZONE" --project "$PROJECT" \
+    --worker=all --command '
+set -e
+DEV=$(lsblk -ndo NAME,MOUNTPOINT | awk "\$1 ~ /^nvme/ && \$2 == \"\" {print \$1; exit}")
+[ -n "$DEV" ] || { echo "no unmounted nvme device"; exit 0; }
+sudo mkfs.ext4 -F "/dev/$DEV"
+sudo mkdir -p /nvme
+sudo mount "/dev/$DEV" /nvme
+sudo chown "$USER" /nvme
+echo "mounted /dev/$DEV at /nvme"'

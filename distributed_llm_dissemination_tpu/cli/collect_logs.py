@@ -1,0 +1,111 @@
+"""Merge per-node JSONL logs into one leader-relative timeline.
+
+Port of ``/root/reference/conf/collect_logs.sh:14-16`` (the jq pipeline)
+into the CLI: gather each node's JSON log stream, merge sorted by the
+unix-ms ``time`` field, and rebase every timestamp onto the leader's
+``"timer start"`` event so all nodes share one clock origin without any
+cross-host clock sync (SURVEY §5.1 — the logs *are* the trace).
+
+Each merged record gains ``rel_ms`` (milliseconds since timer start; events
+before it are negative).  This is the offline trace viewer: pipe the output
+to jq to plot per-layer receive durations, per-job throughputs, and the
+end-to-end time-to-deliver.
+
+Usage:
+    python -m distributed_llm_dissemination_tpu.cli.collect_logs logs/*.jsonl
+    python -m ....collect_logs --anchor "timer start" -o merged.jsonl logs/
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Iterable, List
+
+
+def iter_records(paths: Iterable[str]) -> Iterable[dict]:
+    """Yield JSON objects from files (or every ``*.jsonl``/``*.log`` in a
+    directory); non-JSON lines are skipped, matching jq's -R fromjson? trick
+    used by some log mergers."""
+    for path in paths:
+        if os.path.isdir(path):
+            inner = sorted(
+                os.path.join(path, f)
+                for f in os.listdir(path)
+                if f.endswith((".jsonl", ".log", ".json"))
+            )
+            yield from iter_records(inner)
+            continue
+        with open(path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    yield rec
+
+
+def merge(records: Iterable[dict], anchor: str = "timer start") -> List[dict]:
+    """Sort by ``time`` and rebase onto the first ``anchor`` message
+    (emitted by the leader at distribution start, runtime/leader.py)."""
+    recs = sorted(
+        (r for r in records if isinstance(r.get("time"), (int, float))),
+        key=lambda r: r["time"],
+    )
+    t0 = next((r["time"] for r in recs if r.get("message") == anchor), None)
+    if t0 is None and recs:
+        t0 = recs[0]["time"]
+    for r in recs:
+        r["rel_ms"] = round(r["time"] - t0, 3) if t0 is not None else 0
+    return recs
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="collect_logs", description=__doc__)
+    p.add_argument("paths", nargs="+", help="log files or directories")
+    p.add_argument("--anchor", default="timer start",
+                   help="message whose timestamp becomes rel_ms=0")
+    p.add_argument("-o", "--output", default="-",
+                   help="output file (default: stdout)")
+    args = p.parse_args(argv)
+
+    merged = merge(iter_records(args.paths), anchor=args.anchor)
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    try:
+        for rec in merged:
+            out.write(json.dumps(rec) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+
+    ttd = time_to_deliver(merged)
+    if ttd is not None:
+        print(f"time to deliver: {ttd:.3f} ms", file=sys.stderr)
+    return 0
+
+
+def time_to_deliver(merged: List[dict]) -> float | None:
+    """TTD extracted from the merged trace: 'timer start' → 'timer stop:
+    startup' (cmd/main.go:173-181 measures the same span in-process).
+
+    Requires the real 'timer start' anchor: without it (leader log missing,
+    or rel_ms rebased onto a custom --anchor) the stop event's rel_ms is
+    measured from some other origin and would misreport the TTD span."""
+    start = next((r for r in merged if r.get("message") == "timer start"), None)
+    stop = next(
+        (r for r in merged if str(r.get("message", "")).startswith("timer stop")),
+        None,
+    )
+    if start is None or stop is None:
+        return None
+    return float(stop["time"] - start["time"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

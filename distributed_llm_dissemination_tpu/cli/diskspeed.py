@@ -1,0 +1,113 @@
+"""Disk read-throughput microbenchmark.
+
+Equivalent of the reference's ``diskspeed`` tool
+(``/root/reference/diskspeed/main.go:18-68``): time a full sequential read
+of a file into RAM and print MiB/s.  Used to calibrate the per-source rate
+limits (``Sources``) in the topology config — on TPU-VMs, run it against
+the local NVMe scratch disk that stages checkpoints before the HBM upload.
+
+Extensions over the reference: ``--size`` fabricates a test file first (so
+no pre-existing layer file is needed), ``--drop-caches`` re-reads after an
+fadvise(DONTNEED) to measure cold-cache throughput instead of page-cache
+bandwidth (the reference relies on an external ``drop_caches`` in
+``conf/exe.sh:16``), and the result is also emitted as one JSON line so
+``collect_logs`` can merge it with run logs.
+
+Usage:
+    python -m distributed_llm_dissemination_tpu.cli.diskspeed <file>
+    python -m distributed_llm_dissemination_tpu.cli.diskspeed --size 1G /nvme/t
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_CHUNK = 8 << 20  # 8 MiB read chunks
+
+
+def parse_size(s: str) -> int:
+    """'512M', '4G', '1048576' -> bytes."""
+    s = s.strip().upper()
+    mult = 1
+    for suffix, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30)):
+        if s.endswith(suffix):
+            s, mult = s[: -len(suffix)], m
+            break
+    return int(float(s) * mult)
+
+
+def fabricate(path: str, size: int) -> None:
+    """Write ``size`` pseudo-random-ish bytes (not zeros: some filesystems
+    and SSD firmware short-circuit all-zero blocks)."""
+    block = os.urandom(1 << 20)
+    with open(path, "wb") as f:
+        remaining = size
+        while remaining > 0:
+            n = min(remaining, len(block))
+            f.write(block[:n])
+            remaining -= n
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def drop_cache(path: str) -> None:
+    """Evict the file from the page cache (best effort)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+        if hasattr(os, "posix_fadvise"):
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    finally:
+        os.close(fd)
+
+
+def read_throughput(path: str) -> tuple[int, float]:
+    """Full sequential read into RAM; returns (bytes, seconds) —
+    the reference's Read() (diskspeed/main.go:47-68)."""
+    total = 0
+    t0 = time.monotonic()
+    with open(path, "rb", buffering=0) as f:
+        while True:
+            chunk = f.read(_CHUNK)
+            if not chunk:
+                break
+            total += len(chunk)
+    return total, time.monotonic() - t0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="diskspeed", description=__doc__)
+    p.add_argument("file", help="file to read (created if --size is given)")
+    p.add_argument("--size", type=parse_size, default=None,
+                   help="fabricate the file at this size first (e.g. 4G)")
+    p.add_argument("--drop-caches", action="store_true",
+                   help="fadvise(DONTNEED) before reading (cold-cache run)")
+    args = p.parse_args(argv)
+
+    if args.size is not None:
+        fabricate(args.file, args.size)
+    if args.drop_caches:
+        drop_cache(args.file)
+
+    nbytes, secs = read_throughput(args.file)
+    mibps = nbytes / max(secs, 1e-9) / (1 << 20)
+    print(f"read {nbytes} bytes in {secs:.3f}s: {mibps:.1f} MiB/s")
+    print(json.dumps({
+        "metric": "disk read throughput",
+        "file": args.file,
+        "bytes": nbytes,
+        "seconds": round(secs, 6),
+        "value": round(mibps, 1),
+        "unit": "MiB/s",
+        # the config wants bytes/sec for Sources rate limits
+        "sources_rate": int(nbytes / max(secs, 1e-9)),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,8 +5,20 @@ receiver never reassembles partial layers (the copy is commented out,
 ``/root/reference/distributor/node.go:1545-1547``).  Host-side reassembly
 lives in ``runtime/receiver.py``; here fragments are written into a
 preallocated HBM buffer with ``lax.dynamic_update_slice`` under donation,
-so shards arriving from different seeders land at their byte offsets
+so shards arriving from different seeders land at their element offsets
 without host round-trips.
+
+TPU index-width constraint: XLA's TPU backend rejects dynamic-update-slice
+on shapes whose indices exceed 32 bits ("While rewriting computation to not
+contain X64 element types..."), and on a buffer longer than 2^31-1 elements
+even an in-range int32 start is *silently misplaced* because the clamp
+bound ``size - update_size`` overflows S32.  Layers past that size
+(llama3-405b: ~3.19B elements) therefore use a **segmented 2-D layout**:
+the buffer is ``(rows, seg)`` with ``seg <= 2^30``, a fragment write is
+split into row-aligned pieces, and every dynamic index stays far below
+2^31.  The final 1-D view is a free reshape when ``seg`` divides the
+element count (true for all real transformer layer sizes, which carry
+large power-of-two factors).
 """
 
 from __future__ import annotations
@@ -18,25 +30,109 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+_INT32_MAX = np.iinfo(np.int32).max
+_MAX_SEG = 1 << 30  # elements per row of the segmented layout
+
 
 # Donation lets XLA write fragments into the existing HBM buffer instead of
 # allocating a copy per fragment — essential at multi-GiB layer sizes.
-_write_fragment_donated = jax.jit(
-    lambda buf, frag, offset: lax.dynamic_update_slice(buf, frag, (offset,)),
+_write_1d = jax.jit(
+    lambda buf, frag, off: lax.dynamic_update_slice(buf, frag, (off,)),
+    donate_argnums=(0,),
+)
+
+# Segmented variant: 2-D buffer, (row, col) int32 indices.  The update is a
+# (1, n) row slice, so both clamp bounds (rows-1, seg-n) fit int32.
+_write_2d = jax.jit(
+    lambda buf, frag, row, col: lax.dynamic_update_slice(
+        buf, frag[None, :], (row, col)
+    ),
     donate_argnums=(0,),
 )
 
 
-def alloc_layer_buffer(n_elements: int, dtype=jnp.bfloat16, sharding=None) -> jax.Array:
+def _pick_seg(n_elements: int) -> int:
+    """Largest power-of-two divisor of ``n_elements``.  Real layer element
+    counts are multiples of the model dims' big 2-power factors, so this is
+    >= 2^20 in practice."""
+    return n_elements & -n_elements  # lowest set bit = largest 2^k divisor
+
+
+class LayerBuffer:
+    """A preallocated HBM reassembly target of any size.
+
+    Small layers (< 2^31 elements) are a flat 1-D array; larger ones use
+    the segmented ``(rows, seg)`` layout.  ``write`` places a fragment at
+    its absolute element offset; ``array()`` returns the contiguous 1-D
+    layer (a free reshape — no copy, no re-layout)."""
+
+    def __init__(self, n_elements: int, dtype=jnp.bfloat16, sharding=None,
+                 max_flat: int = _INT32_MAX, seg_cap: int = _MAX_SEG):
+        """``max_flat``/``seg_cap`` exist so tests can force the segmented
+        layout at small sizes; production callers use the defaults."""
+        self.n_elements = n_elements
+        self.dtype = dtype
+        if n_elements <= max_flat:
+            self.seg = 0  # flat mode
+            shape: Tuple[int, ...] = (n_elements,)
+        else:
+            self.seg = min(_pick_seg(n_elements), seg_cap)
+            if n_elements % self.seg != 0:
+                raise ValueError(
+                    f"layer of {n_elements} elements exceeds 2^31-1 and has "
+                    f"no power-of-two segmentation (odd count?); pad the "
+                    f"layer to an even element count first"
+                )
+            shape = (n_elements // self.seg, self.seg)
+        if sharding is not None:
+            self.buf = jnp.zeros(shape, dtype=dtype, device=sharding)
+        else:
+            self.buf = jnp.zeros(shape, dtype=dtype)
+
+    def write(self, offset: int, frag: jax.Array) -> None:
+        """Write ``frag`` at absolute element ``offset`` (donating the
+        previous buffer).  Fragments may span row boundaries; each
+        row-aligned piece is one 32-bit-indexed update."""
+        if offset < 0 or offset + frag.size > self.n_elements:
+            raise ValueError(
+                f"fragment [{offset}, {offset + frag.size}) outside layer "
+                f"of {self.n_elements} elements"
+            )
+        if self.seg == 0:
+            self.buf = _write_1d(self.buf, frag, jnp.asarray(offset, jnp.int32))
+            return
+        pos = 0
+        while pos < frag.size:
+            row, col = divmod(offset + pos, self.seg)
+            n = min(frag.size - pos, self.seg - col)
+            self.buf = _write_2d(
+                self.buf,
+                lax.dynamic_slice(frag, (pos,), (n,)) if (pos or n != frag.size) else frag,
+                jnp.asarray(row, jnp.int32),
+                jnp.asarray(col, jnp.int32),
+            )
+            pos += n
+
+    def array(self) -> jax.Array:
+        """The assembled contiguous layer (free reshape in segmented mode)."""
+        return self.buf if self.seg == 0 else self.buf.reshape(self.n_elements)
+
+
+def alloc_layer_buffer(n_elements: int, dtype=jnp.bfloat16, sharding=None) -> LayerBuffer:
     """Preallocate the reassembly target in HBM."""
-    if sharding is not None:
-        return jnp.zeros((n_elements,), dtype=dtype, device=sharding)
-    return jnp.zeros((n_elements,), dtype=dtype)
+    return LayerBuffer(n_elements, dtype, sharding)
 
 
 def write_fragment(buf: jax.Array, frag: jax.Array, offset: int) -> jax.Array:
-    """Write one fragment at its element offset, donating the buffer."""
-    return _write_fragment_donated(buf, frag, jnp.asarray(offset, jnp.int32))
+    """Write one fragment into a flat (< 2^31-element) buffer, donating it.
+    Larger layers must go through ``LayerBuffer`` — a flat giant buffer
+    cannot be dynamically indexed on TPU at all (module docstring)."""
+    if buf.size > _INT32_MAX:
+        raise ValueError(
+            f"buffer of {buf.size} elements exceeds the TPU 32-bit dynamic "
+            f"index range; use LayerBuffer for segmented reassembly"
+        )
+    return _write_1d(buf, frag, jnp.asarray(offset, jnp.int32))
 
 
 def assemble_fragments(
@@ -47,10 +143,10 @@ def assemble_fragments(
 ) -> jax.Array:
     """Build a full layer in HBM from (element_offset, fragment) pairs —
     the device-side equivalent of the receiver's byte-range reassembly."""
-    buf = alloc_layer_buffer(n_elements, dtype, sharding)
+    buf = LayerBuffer(n_elements, dtype, sharding)
     for offset, frag in fragments:
-        buf = write_fragment(buf, frag, offset)
-    return buf
+        buf.write(offset, frag)
+    return buf.array()
 
 
 def split_offsets(total: int, parts: int) -> Sequence[Tuple[int, int]]:

@@ -71,10 +71,22 @@ class StagePlacement:
     def devices_for_layer(self, layer_id: LayerID) -> List[jax.Device]:
         return self.stage_devices(self.layer_to_stage[layer_id])
 
-    def layer_sharding(self, spec: P = P()) -> NamedSharding:
-        """Sharding for one stage-local layer (default: replicated within
-        the stage)."""
-        return NamedSharding(self.mesh, spec)
+    def stage_mesh(self, stage: int) -> Mesh:
+        """Sub-mesh of one pipeline stage: the full mesh with the pipeline
+        axis sliced away, keeping every other axis (tp/dp/...)."""
+        axis = list(self.mesh.axis_names).index(self.pipeline_axis)
+        devs = np.take(self.mesh.devices, stage, axis=axis)
+        names = tuple(n for n in self.mesh.axis_names if n != self.pipeline_axis)
+        if not names:  # 1-axis mesh: np.take returned a bare Device scalar
+            devs = np.asarray([devs], dtype=object)
+            names = (self.pipeline_axis,)
+        return Mesh(devs, names)
+
+    def layer_sharding(self, layer_id: LayerID, spec: P = P()) -> NamedSharding:
+        """Sharding that lands a layer on *its stage's* devices only
+        (default: replicated within the stage, absent everywhere else) —
+        the HBM footprint the Assignment prescribes."""
+        return NamedSharding(self.stage_mesh(self.layer_to_stage[layer_id]), spec)
 
 
 def assignment_to_placement(

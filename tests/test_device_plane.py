@@ -57,6 +57,32 @@ def test_placement_too_many_nodes(mesh):
         assignment_to_placement(assignment, mesh, "nodes")
 
 
+def test_layer_sharding_is_stage_local(cpu_devices):
+    # pp=4 x tp=2 mesh; a layer must land only on its own stage's devices.
+    mesh2 = make_mesh((4, 2), ("pp", "tp"))
+    assignment = {
+        n: {lid: LayerMeta() for lid in range(n * 2, n * 2 + 2)} for n in range(4)
+    }
+    placement = assignment_to_placement(assignment, mesh2, "pp")
+    for lid in range(8):
+        stage = placement.layer_to_stage[lid]
+        sh = placement.layer_sharding(lid)
+        arr = jax.device_put(jnp.arange(16, dtype=jnp.float32), sh)
+        got = {d for d in arr.devices()}
+        want = set(placement.stage_devices(stage))
+        assert got == want, f"layer {lid} landed on {got}, want stage {want}"
+        assert len(got) == 2  # tp devices of one stage, not the whole mesh
+
+
+def test_layer_sharding_single_axis_mesh(mesh):
+    assignment = {7: {lid: LayerMeta() for lid in range(8)}}
+    placement = assignment_to_placement(assignment, mesh, "nodes")
+    sh = placement.layer_sharding(3)
+    arr = jax.device_put(jnp.ones((4,)), sh)
+    assert set(arr.devices()) == set(placement.devices_for_layer(3))
+    assert len(arr.devices()) == 1
+
+
 def test_bytes_roundtrip():
     data = bytes(range(256)) * 33  # not dtype-aligned
     arr = bytes_to_array(data, jnp.bfloat16)
@@ -151,3 +177,46 @@ def test_split_offsets_tiling():
     spans = split_offsets(10, 3)
     assert spans == [(0, 4), (4, 3), (7, 3)]
     assert split_offsets(2, 4) == [(0, 1), (1, 1), (2, 0), (2, 0)]
+
+
+def test_layer_buffer_segmented_reassembly():
+    """Layers past 2^31-1 elements (llama3-405b) cannot use a flat dynamic-
+    indexed buffer on TPU (32-bit index limit, and the S32 clamp bound
+    silently misplaces writes on giant buffers).  LayerBuffer's segmented
+    2-D layout is the fix; force it at a small size and check fragments
+    landing at exact offsets, including row-straddling ones."""
+    from distributed_llm_dissemination_tpu.ops.reassembly import LayerBuffer
+
+    total = 1 << 10
+    full = np.arange(total, dtype=np.float32)
+    buf = LayerBuffer(total, jnp.float32, max_flat=64, seg_cap=128)
+    assert buf.seg == 128 and buf.buf.shape == (8, 128)
+    # Unaligned spans: within-row, multi-row-straddling, row-exact, tail.
+    for off, size in [(0, 100), (100, 300), (400, 128), (528, 496)]:
+        buf.write(off, jnp.asarray(full[off : off + size]))
+    np.testing.assert_array_equal(np.asarray(buf.array()), full)
+    # Out-of-bounds writes are rejected, not clamped.
+    with pytest.raises(ValueError, match="outside layer"):
+        buf.write(1000, jnp.asarray(full[:100]))
+
+
+def test_layer_buffer_segmented_full_roundtrip():
+    from distributed_llm_dissemination_tpu.ops.reassembly import LayerBuffer
+    from distributed_llm_dissemination_tpu.ops import split_offsets
+
+    total = 1 << 12
+    full = np.random.default_rng(0).standard_normal(total).astype(np.float32)
+    buf = LayerBuffer(total, jnp.float32, max_flat=1024, seg_cap=512)
+    for off, size in split_offsets(total, 7):  # 7 does not divide 4096: unaligned
+        buf.write(off, jnp.asarray(full[off : off + size]))
+    np.testing.assert_array_equal(np.asarray(buf.array()), full)
+
+
+def test_write_fragment_rejects_giant_flat_buffer():
+    from distributed_llm_dissemination_tpu.ops.reassembly import write_fragment
+
+    class FakeBuf:  # avoid allocating 2 GiB in CI; only .size is consulted
+        size = 2**31
+
+    with pytest.raises(ValueError, match="LayerBuffer"):
+        write_fragment(FakeBuf(), jnp.ones((4,)), 0)

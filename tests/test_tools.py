@@ -1,0 +1,160 @@
+"""Tools + shipped configs: diskspeed, collect_logs, conf/*.json.
+
+The reference ships diskspeed (diskspeed/main.go), collect_logs.sh, and
+conf/config.json; these tests cover our equivalents end to end.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from distributed_llm_dissemination_tpu.cli import collect_logs, diskspeed
+from distributed_llm_dissemination_tpu.core import config as cfg
+
+CONF_DIR = "conf"
+
+
+# ---------------------------------------------------------------- diskspeed
+
+
+def test_diskspeed_parse_size():
+    assert diskspeed.parse_size("1024") == 1024
+    assert diskspeed.parse_size("4K") == 4096
+    assert diskspeed.parse_size("2M") == 2 << 20
+    assert diskspeed.parse_size("1.5G") == int(1.5 * (1 << 30))
+
+
+def test_diskspeed_end_to_end(tmp_path, capsys):
+    f = tmp_path / "t.bin"
+    rc = diskspeed.main([str(f), "--size", "2M", "--drop-caches"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    rec = json.loads(out[-1])
+    assert rec["bytes"] == 2 << 20
+    assert rec["unit"] == "MiB/s"
+    assert rec["value"] > 0
+    assert rec["sources_rate"] > 0
+    assert f.stat().st_size == 2 << 20
+
+
+# ------------------------------------------------------------- collect_logs
+
+
+def _writelog(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_collect_logs_merge_and_rebase(tmp_path):
+    # Leader log: timer start at t=2000; receiver events straddle it.
+    _writelog(tmp_path / "leader.jsonl", [
+        {"level": "info", "time": 1500, "node": "0", "message": "start listening"},
+        {"level": "info", "time": 2000, "node": "0", "message": "timer start"},
+        {"level": "info", "time": 2600, "node": "0", "message": "timer stop: startup"},
+    ])
+    _writelog(tmp_path / "recv.jsonl", [
+        {"level": "info", "time": 2400, "node": "1", "message": "layer received"},
+        {"level": "info", "time": 1900, "node": "1", "message": "announce"},
+        "not json at all",  # ignored junk line
+    ])
+    (tmp_path / "recv.jsonl").write_text(
+        (tmp_path / "recv.jsonl").read_text() + "junk line\n"
+    )
+
+    merged = collect_logs.merge(collect_logs.iter_records([str(tmp_path)]))
+    assert [r["time"] for r in merged] == sorted(r["time"] for r in merged)
+    by_msg = {r["message"]: r for r in merged}
+    assert by_msg["timer start"]["rel_ms"] == 0
+    assert by_msg["announce"]["rel_ms"] == -100
+    assert by_msg["layer received"]["rel_ms"] == 400
+    assert collect_logs.time_to_deliver(merged) == 600
+
+
+def test_collect_logs_cli(tmp_path, capsys):
+    _writelog(tmp_path / "a.jsonl", [
+        {"time": 10, "message": "timer start"},
+        {"time": 35, "message": "timer stop: startup"},
+    ])
+    out_file = tmp_path / "merged.jsonl"
+    rc = collect_logs.main([str(tmp_path / "a.jsonl"), "-o", str(out_file)])
+    assert rc == 0
+    lines = [json.loads(x) for x in out_file.read_text().splitlines()]
+    assert lines[0]["rel_ms"] == 0 and lines[1]["rel_ms"] == 25
+    assert "time to deliver: 25" in capsys.readouterr().err
+
+
+# ----------------------------------------------------------- shipped configs
+
+
+@pytest.mark.parametrize("name,nodes,layers", [
+    ("reference_8node.json", 8, 8),
+    ("local_4node.json", 5, 4),
+    ("tpu_v5e32_llama70b.json", 8, 80),
+])
+def test_shipped_configs_load(name, nodes, layers):
+    conf = cfg.read_json(f"{CONF_DIR}/{name}")
+    assert len(conf.nodes) == nodes
+    leader = cfg.get_leader_conf(conf)
+    assert leader.is_leader
+    assigned = set()
+    for lids in conf.assignment.values():
+        assigned |= set(lids)
+    assert len(assigned) == layers
+    # Every assigned layer must be seeded somewhere (node disk/RAM or client).
+    seeded = set()
+    for nc in conf.nodes:
+        for by_layer in nc.initial_layers.values():
+            seeded |= set(by_layer)
+    for cc in conf.clients:
+        seeded |= set(cc.layers_rate_limit)
+    assert assigned <= seeded
+
+
+def test_v5e32_config_matches_llama70b():
+    from distributed_llm_dissemination_tpu.models.llama import CONFIGS
+
+    conf = cfg.read_json(f"{CONF_DIR}/tpu_v5e32_llama70b.json")
+    assert conf.layer_size == CONFIGS["llama3-70b"].layer_nbytes()
+    assert conf.mesh is not None
+    assert conf.mesh.axis_names == ["pp", "tp"]
+    assert conf.mesh.axis_sizes == [8, 4]
+    # Pipeline placement: each stage gets a contiguous, disjoint layer range.
+    seen = set()
+    for stage, lids in sorted(conf.assignment.items()):
+        ids = sorted(lids)
+        assert ids == list(range(ids[0], ids[0] + len(ids)))
+        assert not (set(ids) & seen)
+        seen |= set(ids)
+    assert len(seen) == 80
+
+
+def test_local_4node_runs_end_to_end(tmp_path):
+    """Spawn the real CLI against conf/local_4node.json (mode 1, real TCP,
+    5 processes) and assert the leader prints Time to deliver — the
+    reference's manual smoke run, automated."""
+    procs = []
+    try:
+        for i in range(1, 5):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "distributed_llm_dissemination_tpu.cli.main",
+                 "-id", str(i), "-f", f"{CONF_DIR}/local_4node.json",
+                 "-m", "1"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            ))
+        leader = subprocess.run(
+            [sys.executable, "-m",
+             "distributed_llm_dissemination_tpu.cli.main",
+             "-id", "0", "-f", f"{CONF_DIR}/local_4node.json", "-m", "1"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+        )
+        assert b"Time to deliver" in leader.stdout, leader.stderr[-2000:]
+        for p in procs:
+            assert p.wait(timeout=30) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
