@@ -14,12 +14,16 @@ PROJECT=${4:-$(gcloud config get-value project)}
 OUT=logs/$TPU
 mkdir -p "$OUT"
 
+pids=()
 for ((w = 0; w < NWORKERS; w++)); do
     gcloud compute tpus tpu-vm scp \
         "$TPU":/tmp/node_"$w".jsonl "$OUT/node_$w.jsonl" \
         --zone "$ZONE" --project "$PROJECT" --worker="$w" &
+    pids+=($!)
 done
-wait
+# Bare `wait` swallows job failures; a missing worker log must abort the
+# merge, not silently produce a trace with that node's events absent.
+for pid in "${pids[@]}"; do wait "$pid"; done
 
 python -m distributed_llm_dissemination_tpu.cli.collect_logs \
     "$OUT" -o "$OUT/merged.jsonl"

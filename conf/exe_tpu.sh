@@ -12,7 +12,10 @@ set -euo pipefail
 
 TPU=${1:?tpu-vm name}
 ZONE=${2:?zone}
-CONF=${3:?config path on the workers, e.g. ~/dissem/conf/tpu_v5e32_llama70b.json}
+# Relative to the remote ~/dissem checkout (the command cd's there); an
+# absolute or ~-prefixed path would resolve against the LOCAL shell or not
+# expand at all inside the remote quoting.
+CONF=${3:?config path relative to ~/dissem on the workers, e.g. conf/tpu_v5e32_llama70b.json}
 MODE=${4:-3}
 PROJECT=${5:-$(gcloud config get-value project)}
 

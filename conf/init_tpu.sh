@@ -15,7 +15,12 @@ PROJECT=${3:-$(gcloud config get-value project)}
 gcloud compute tpus tpu-vm ssh "$TPU" --zone "$ZONE" --project "$PROJECT" \
     --worker=all --command '
 set -e
-DEV=$(lsblk -ndo NAME,MOUNTPOINT | awk "\$1 ~ /^nvme/ && \$2 == \"\" {print \$1; exit}")
+# Whole nvme disks where neither the disk nor any partition is mounted —
+# `lsblk -d` alone would call a disk with a mounted partition "unmounted".
+DEV=$(lsblk -rno NAME,TYPE,MOUNTPOINT | awk "
+    \$2 == \"disk\" && \$1 ~ /^nvme/ { cand[\$1] = 1 }
+    \$3 != \"\" { for (d in cand) if (index(\$1, d) == 1) delete cand[d] }
+    END { for (d in cand) { print d; exit } }")
 [ -n "$DEV" ] || { echo "no unmounted nvme device"; exit 0; }
 sudo mkfs.ext4 -F "/dev/$DEV"
 sudo mkdir -p /nvme

@@ -22,21 +22,27 @@ import argparse
 import json
 import os
 import sys
-from typing import Iterable, List
+from typing import Iterable, List, Optional
 
 
-def iter_records(paths: Iterable[str]) -> Iterable[dict]:
+def iter_records(
+    paths: Iterable[str], exclude: Optional[str] = None
+) -> Iterable[dict]:
     """Yield JSON objects from files (or every ``*.jsonl``/``*.log`` in a
     directory); non-JSON lines are skipped, matching jq's -R fromjson? trick
-    used by some log mergers."""
+    used by some log mergers.  ``exclude`` drops one path — the merge's own
+    output file, which on a re-run would otherwise be ingested as input and
+    duplicate every event."""
     for path in paths:
+        if exclude is not None and os.path.abspath(path) == exclude:
+            continue
         if os.path.isdir(path):
             inner = sorted(
                 os.path.join(path, f)
                 for f in os.listdir(path)
                 if f.endswith((".jsonl", ".log", ".json"))
             )
-            yield from iter_records(inner)
+            yield from iter_records(inner, exclude)
             continue
         with open(path, "r") as f:
             for line in f:
@@ -75,7 +81,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="output file (default: stdout)")
     args = p.parse_args(argv)
 
-    merged = merge(iter_records(args.paths), anchor=args.anchor)
+    exclude = None if args.output == "-" else os.path.abspath(args.output)
+    merged = merge(iter_records(args.paths, exclude), anchor=args.anchor)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     try:
         for rec in merged:

@@ -97,6 +97,9 @@ def main(argv: list[str] | None = None) -> int:
     mibps = nbytes / max(secs, 1e-9) / (1 << 20)
     print(f"read {nbytes} bytes in {secs:.3f}s: {mibps:.1f} MiB/s")
     print(json.dumps({
+        # unix-ms "time" keys the collect_logs merge; without it the
+        # calibration record would be silently dropped from the trace
+        "time": int(time.time() * 1000),
         "metric": "disk read throughput",
         "file": args.file,
         "bytes": nbytes,

@@ -76,14 +76,20 @@ class LayerBuffer:
             self.seg = 0  # flat mode
             shape: Tuple[int, ...] = (n_elements,)
         else:
+            # _pick_seg returns a power-of-two divisor and seg_cap is a
+            # power of two, so seg always divides n_elements; the only
+            # unrepresentable case is a tiny seg (odd-ish count) blowing the
+            # ROW index past int32 — misplaced writes, not an XLA error.
             self.seg = min(_pick_seg(n_elements), seg_cap)
-            if n_elements % self.seg != 0:
+            rows = n_elements // self.seg
+            if rows > _INT32_MAX:
                 raise ValueError(
-                    f"layer of {n_elements} elements exceeds 2^31-1 and has "
-                    f"no power-of-two segmentation (odd count?); pad the "
-                    f"layer to an even element count first"
+                    f"layer of {n_elements} elements factors into "
+                    f"{rows} rows x {self.seg} (> 2^31-1 rows): row indices "
+                    f"would overflow int32; pad the layer to a count with a "
+                    f"larger power-of-two factor"
                 )
-            shape = (n_elements // self.seg, self.seg)
+            shape = (rows, self.seg)
         if sharding is not None:
             self.buf = jnp.zeros(shape, dtype=dtype, device=sharding)
         else:
@@ -123,14 +129,27 @@ def alloc_layer_buffer(n_elements: int, dtype=jnp.bfloat16, sharding=None) -> La
     return LayerBuffer(n_elements, dtype, sharding)
 
 
-def write_fragment(buf: jax.Array, frag: jax.Array, offset: int) -> jax.Array:
-    """Write one fragment into a flat (< 2^31-element) buffer, donating it.
-    Larger layers must go through ``LayerBuffer`` — a flat giant buffer
-    cannot be dynamically indexed on TPU at all (module docstring)."""
+def write_fragment(buf, frag: jax.Array, offset: int):
+    """Write one fragment into ``buf``, donating the previous storage.
+
+    ``buf`` may be a ``LayerBuffer`` (any size — the ``alloc_layer_buffer``
+    return type) or a flat jax.Array of < 2^31 elements; a flat giant
+    buffer cannot be dynamically indexed on TPU at all (module docstring).
+    Returns the updated buffer, same type as given."""
+    if isinstance(buf, LayerBuffer):
+        buf.write(offset, frag)
+        return buf
     if buf.size > _INT32_MAX:
         raise ValueError(
             f"buffer of {buf.size} elements exceeds the TPU 32-bit dynamic "
             f"index range; use LayerBuffer for segmented reassembly"
+        )
+    if offset < 0 or offset + frag.size > buf.size:
+        # dynamic_update_slice would silently clamp the start and misplace
+        # the fragment — the exact failure mode LayerBuffer.write rejects.
+        raise ValueError(
+            f"fragment [{offset}, {offset + frag.size}) outside buffer "
+            f"of {buf.size} elements"
         )
     return _write_1d(buf, frag, jnp.asarray(offset, jnp.int32))
 

@@ -55,6 +55,11 @@ class StagePlacement:
     pipeline_axis: str
     node_to_stage: Dict[NodeID, int]
     layer_to_stage: Dict[LayerID, int]
+    # stage → sub-Mesh cache: staging an 80-layer model calls layer_sharding
+    # per layer but there are only pp-axis-size distinct stage meshes.
+    _stage_meshes: Dict[int, Mesh] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False
+    )
 
     @property
     def num_stages(self) -> int:
@@ -74,13 +79,17 @@ class StagePlacement:
     def stage_mesh(self, stage: int) -> Mesh:
         """Sub-mesh of one pipeline stage: the full mesh with the pipeline
         axis sliced away, keeping every other axis (tp/dp/...)."""
+        cached = self._stage_meshes.get(stage)
+        if cached is not None:
+            return cached
         axis = list(self.mesh.axis_names).index(self.pipeline_axis)
         devs = np.take(self.mesh.devices, stage, axis=axis)
         names = tuple(n for n in self.mesh.axis_names if n != self.pipeline_axis)
         if not names:  # 1-axis mesh: np.take returned a bare Device scalar
             devs = np.asarray([devs], dtype=object)
             names = (self.pipeline_axis,)
-        return Mesh(devs, names)
+        self._stage_meshes[stage] = Mesh(devs, names)
+        return self._stage_meshes[stage]
 
     def layer_sharding(self, layer_id: LayerID, spec: P = P()) -> NamedSharding:
         """Sharding that lands a layer on *its stage's* devices only
