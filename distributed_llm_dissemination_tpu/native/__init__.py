@@ -1,0 +1,95 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's runtime is a compiled binary (Go, ``go.mod:1``); this
+package keeps the performance-critical scheduler core native too.  The
+library is built from source on first use with the system ``g++`` (the
+build is cached next to the source), so no build step is required at
+install time and every environment with a C++ toolchain gets the fast
+path.  Environments without one transparently fall back to the pure-Python
+implementations — behavior is identical, only slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from ..utils.logging import log
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "flow_solver.cc")
+_LIB = os.path.join(_DIR, "libflowsolver.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> None:
+    # Compile to a per-process temp name, then rename into place: rename is
+    # atomic on POSIX, so concurrent node processes on one host never load
+    # a partially written library.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.flow_max_flow_at.restype = ctypes.c_int64
+    lib.flow_max_flow_at.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64p, i64p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, i64p,
+    ]
+    lib.flow_min_time_schedule.restype = ctypes.c_int64
+    lib.flow_min_time_schedule.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64p, i64p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, i64p, i64p,
+    ]
+    return lib
+
+
+def load_flow_solver() -> Optional[ctypes.CDLL]:
+    """The native solver library, building it on first use; None if this
+    environment can't build or load it (callers then use the Python path)."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        # Try a pre-existing library first; if it fails to load (stale,
+        # wrong arch — mtimes don't survive git checkout, so they prove
+        # nothing), rebuild from source once before giving up.
+        if os.path.exists(_LIB):
+            try:
+                _lib = _bind(ctypes.CDLL(_LIB))
+                return _lib
+            except OSError:
+                try:
+                    os.unlink(_LIB)
+                except OSError:
+                    pass
+        try:
+            _build()
+            _lib = _bind(ctypes.CDLL(_LIB))
+            return _lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            _load_failed = True
+            stderr = getattr(e, "stderr", b"")
+            log.warn("native flow solver unavailable, using Python path",
+                     err=repr(e),
+                     compiler_stderr=stderr.decode(errors="replace") if stderr else "")
+            return None

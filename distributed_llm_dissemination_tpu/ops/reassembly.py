@@ -76,12 +76,16 @@ class LayerBuffer:
             self.seg = 0  # flat mode
             shape: Tuple[int, ...] = (n_elements,)
         else:
-            # _pick_seg returns a power-of-two divisor and seg_cap is a
-            # power of two, so seg always divides n_elements; the only
-            # unrepresentable case is a tiny seg (odd-ish count) blowing the
-            # ROW index past int32 — misplaced writes, not an XLA error.
             self.seg = min(_pick_seg(n_elements), seg_cap)
             rows = n_elements // self.seg
+            if rows * self.seg != n_elements:
+                # Reachable only via a non-power-of-two seg_cap: a short
+                # buffer would let dynamic_update_slice clamp the row index
+                # and silently overwrite the previous row.
+                raise ValueError(
+                    f"seg {self.seg} does not divide {n_elements} elements; "
+                    f"seg_cap must be a power of two"
+                )
             if rows > _INT32_MAX:
                 raise ValueError(
                     f"layer of {n_elements} elements factors into "

@@ -42,7 +42,8 @@ from ..core.types import (
     Status,
     delivered,
 )
-from ..sched.flow import FlowGraph, FlowJob, FlowJobsMap
+from ..sched.flow import FlowJob, FlowJobsMap
+from ..sched.native import make_flow_graph
 from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
@@ -531,7 +532,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 log.info("No jobs to assign other than self-assignment")
                 return 0, self_jobs, {}
             t0 = time.monotonic()
-            graph = FlowGraph(
+            graph = make_flow_graph(
                 modified, self.status, layer_sizes, self.node_network_bw
             )
             t, jobs = graph.get_job_assignment()

@@ -1,1 +1,2 @@
 from .flow import FlowGraph, FlowJob, FlowJobsMap  # noqa: F401
+from .native import NativeFlowGraph, make_flow_graph  # noqa: F401

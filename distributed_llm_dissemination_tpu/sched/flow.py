@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 from collections import deque
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..core.types import Assignment, LayerID, NodeID, SourceType, Status
 from ..utils.logging import log
@@ -97,7 +97,9 @@ class FlowGraph:
         add(_V("sink"))
 
         self.n = len(self.idx)
-        self.cap = [[0] * self.n for _ in range(self.n)]
+        # The O(n^2) matrix is only needed by the Python solver; allocated
+        # lazily in _build so NativeFlowGraph never pays for it.
+        self.cap: Optional[List[List[int]]] = None
         self._needed = needed
 
     # ------------------------------------------------------------- capacities
@@ -110,9 +112,12 @@ class FlowGraph:
 
     def _build(self, t: int) -> None:
         """(Re)build edge capacities for candidate time t (flow.go:221-270)."""
-        for row in self.cap:
-            for j in range(self.n):
-                row[j] = 0
+        if self.cap is None:
+            self.cap = [[0] * self.n for _ in range(self.n)]
+        else:
+            for row in self.cap:
+                for j in range(self.n):
+                    row[j] = 0
         src = self.idx[_V("source")]
         sink = self.idx[_V("sink")]
 
@@ -126,8 +131,12 @@ class FlowGraph:
                     _V("class", node_id=node_id, source_type=int(meta.source_type))
                 ]
                 layer = self.idx[_V("layer", layer_id=layer_id)]
-                self.cap[sender][cls] = self._class_capacity(
-                    node_id, meta.limit_rate, t
+                # Rates are a property of the source class (reference
+                # config.go:26); if per-layer metadata disagrees, take the
+                # max so the rule is deterministic (not dict-order).
+                self.cap[sender][cls] = max(
+                    self.cap[sender][cls],
+                    self._class_capacity(node_id, meta.limit_rate, t),
                 )
                 # One layer may feed multiple receivers; don't cap here.
                 self.cap[cls][layer] = _INF

@@ -1,16 +1,48 @@
-"""Max-flow scheduler unit tests (reference has none for flow.go)."""
+"""Max-flow scheduler unit tests (reference has none for flow.go).
+
+Every scenario runs against both the pure-Python Edmonds–Karp solver and
+the native C++ Dinic solver — the dual-backend pattern the transport tests
+use, applied to the scheduler."""
+
+import random
+
+import pytest
 
 from distributed_llm_dissemination_tpu.core.types import LayerMeta, SourceType
 from distributed_llm_dissemination_tpu.sched.flow import FlowGraph
+from distributed_llm_dissemination_tpu.sched.native import NativeFlowGraph
+from distributed_llm_dissemination_tpu.native import load_flow_solver
+
+
+needs_native = pytest.mark.skipif(
+    load_flow_solver() is None,
+    reason="native flow solver unavailable (no C++ toolchain)",
+)
+
+SOLVERS = [FlowGraph, pytest.param(NativeFlowGraph, marks=needs_native)]
 
 
 def _meta(rate=0, st=SourceType.MEM):
     return LayerMeta(limit_rate=rate, source_type=st)
 
 
-def test_single_sender_min_time():
+def check_tiling(jobs, layer_sizes):
+    """Every layer's jobs tile [0, size) contiguously without overlap."""
+    by_layer = {}
+    for js in jobs.values():
+        for j in js:
+            by_layer.setdefault(j.layer_id, []).append(j)
+    for lid, chunks in by_layer.items():
+        spans = sorted((c.offset, c.offset + c.data_size) for c in chunks)
+        assert spans[0][0] == 0 and spans[-1][1] == layer_sizes[lid]
+        for (_, e1), (s2, _) in zip(spans, spans[1:]):
+            assert e1 == s2
+
+
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_single_sender_min_time(solver):
     # One sender at 100 B/s NIC, one 100-B layer -> t = 1 s.
-    g = FlowGraph(
+    g = solver(
         assignment={1: {0: _meta()}},
         status={0: {0: _meta(rate=100)}},
         layer_sizes={0: 100},
@@ -21,10 +53,11 @@ def test_single_sender_min_time():
     assert jobs[0][0].data_size == 100 and jobs[0][0].offset == 0
 
 
-def test_two_senders_split_layer():
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_two_senders_split_layer(solver):
     # Two seeders, each 100 B/s, receiver NIC 200 B/s, 200-B layer:
     # optimal t = 1 s with the layer split across both senders.
-    g = FlowGraph(
+    g = solver(
         assignment={2: {0: _meta()}},
         status={0: {0: _meta(rate=100)}, 1: {0: _meta(rate=100)}},
         layer_sizes={0: 200},
@@ -32,19 +65,14 @@ def test_two_senders_split_layer():
     )
     t, jobs = g.get_job_assignment()
     assert t == 1
-    chunks = [j for sender in jobs.values() for j in sender]
-    assert sum(c.data_size for c in chunks) == 200
-    # Offsets tile the layer contiguously.
-    spans = sorted((c.offset, c.offset + c.data_size) for c in chunks)
-    assert spans[0][0] == 0 and spans[-1][1] == 200
-    for (_, e1), (s2, _) in zip(spans, spans[1:]):
-        assert e1 == s2
+    check_tiling(jobs, {0: 200})
 
 
-def test_heterogeneous_rates_proportional_split():
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_heterogeneous_rates_proportional_split(solver):
     # 10 B/s + 90 B/s senders, 100-B layer, receiver 100 B/s -> t=1,
     # bytes split proportional to rates.
-    g = FlowGraph(
+    g = solver(
         assignment={2: {0: _meta()}},
         status={0: {0: _meta(rate=10)}, 1: {0: _meta(rate=90)}},
         layer_sizes={0: 100},
@@ -57,11 +85,12 @@ def test_heterogeneous_rates_proportional_split():
     assert sizes.get(1, 0) >= 90
 
 
-def test_receiver_nic_bound():
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_receiver_nic_bound(solver):
     # Plenty of senders but the receiver NIC (100 B/s) is the bottleneck
     # for 800 B -> t = 8 s.
     status = {i: {0: _meta(rate=1000)} for i in range(4)}
-    g = FlowGraph(
+    g = solver(
         assignment={9: {0: _meta()}},
         status=status,
         layer_sizes={0: 800},
@@ -71,10 +100,11 @@ def test_receiver_nic_bound():
     assert t == 8
 
 
-def test_unlimited_rate_uses_nic_bw():
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_unlimited_rate_uses_nic_bw(solver):
     # limit_rate 0 means unlimited: capacity falls back to NIC bandwidth
     # (deviation from the reference, which would model a dead edge).
-    g = FlowGraph(
+    g = solver(
         assignment={1: {0: _meta()}},
         status={0: {0: _meta(rate=0)}},
         layer_sizes={0: 500},
@@ -85,10 +115,11 @@ def test_unlimited_rate_uses_nic_bw():
     assert jobs[0][0].data_size == 500
 
 
-def test_multiple_layers_multiple_receivers():
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_multiple_layers_multiple_receivers(solver):
     # 2 layers to 2 different receivers from one seeder at 100 B/s:
     # 200 B total -> t = 2 s.
-    g = FlowGraph(
+    g = solver(
         assignment={1: {0: _meta()}, 2: {1: _meta()}},
         status={0: {0: _meta(rate=100), 1: _meta(rate=100)}},
         layer_sizes={0: 100, 1: 100},
@@ -100,18 +131,78 @@ def test_multiple_layers_multiple_receivers():
     assert total == 200
 
 
-def test_deterministic_schedule():
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_deterministic_schedule(solver):
     kwargs = dict(
         assignment={2: {0: _meta()}},
         status={0: {0: _meta(rate=100)}, 1: {0: _meta(rate=100)}},
         layer_sizes={0: 200},
         node_network_bw={0: 100, 1: 100, 2: 200},
     )
-    t1, j1 = FlowGraph(**kwargs).get_job_assignment()
-    t2, j2 = FlowGraph(**kwargs).get_job_assignment()
+    t1, j1 = solver(**kwargs).get_job_assignment()
+    t2, j2 = solver(**kwargs).get_job_assignment()
     assert t1 == t2
     assert {
         s: [(j.layer_id, j.data_size, j.offset) for j in js] for s, js in j1.items()
     } == {
         s: [(j.layer_id, j.data_size, j.offset) for j in js] for s, js in j2.items()
     }
+
+
+@needs_native
+def test_native_matches_python_on_random_instances():
+    """Property test: for random clusters, native and Python solvers agree
+    on the minimum completion time, and both produce valid tilings (the
+    exact split may differ — any max flow is an optimal plan)."""
+    rng = random.Random(7)
+    for _ in range(20):
+        n_senders = rng.randint(1, 6)
+        n_layers = rng.randint(1, 5)
+        layer_sizes = {lid: rng.randint(1, 10_000) for lid in range(n_layers)}
+        status = {}
+        for s in range(n_senders):
+            held = rng.sample(range(n_layers), rng.randint(1, n_layers))
+            status[s] = {
+                lid: _meta(rate=rng.choice([0, 50, 100, 1000]),
+                           st=rng.choice(list(SourceType)))
+                for lid in held
+            }
+        # Ensure every layer has at least one owner.
+        for lid in range(n_layers):
+            if not any(lid in held for held in status.values()):
+                status[rng.randrange(n_senders)][lid] = _meta(rate=100)
+        receiver = 100
+        assignment = {receiver: {lid: _meta() for lid in range(n_layers)}}
+        bw = {i: rng.choice([100, 500, 2000]) for i in status}
+        bw[receiver] = rng.choice([100, 500, 2000])
+
+        t_py, jobs_py = FlowGraph(assignment, status, layer_sizes, bw).get_job_assignment()
+        t_nat, jobs_nat = NativeFlowGraph(
+            assignment, status, layer_sizes, bw
+        ).get_job_assignment()
+        assert t_py == t_nat
+        check_tiling(jobs_py, layer_sizes)
+        check_tiling(jobs_nat, layer_sizes)
+
+
+@needs_native
+def test_native_pod_scale_schedule():
+    """v5e-32-shaped instance: 31 seeders x 80 layers to one cold host.
+    The native solver must produce a valid tiling at the receiver-NIC
+    lower bound; this is the graph size where the Python path takes
+    tens of seconds and the native one milliseconds."""
+    n_nodes, n_layers = 32, 80
+    layer_size = 1_750_000_000  # ~1.75 GB per layer (70B-class / 80)
+    bw = {i: 1_562_500_000 for i in range(n_nodes)}
+    status = {
+        i: {lid: _meta(rate=209_715_200, st=SourceType.DISK)
+            for lid in range(n_layers)}
+        for i in range(n_nodes - 1)
+    }
+    assignment = {n_nodes - 1: {lid: _meta() for lid in range(n_layers)}}
+    sizes = {lid: layer_size for lid in range(n_layers)}
+    g = NativeFlowGraph(assignment, status, sizes, bw)
+    t, jobs = g.get_job_assignment()
+    check_tiling(jobs, sizes)
+    # Receiver NIC is the bottleneck: 80 * 1.75e9 / 1.5625e9 = 89.6 -> 90 s.
+    assert t == 90
