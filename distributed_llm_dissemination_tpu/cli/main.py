@@ -52,6 +52,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="create layer files and exit")
     p.add_argument("-c", action="store_true", help="if the process is client")
     p.add_argument("-v", action="store_true", help="output debug messages")
+    # Extensions beyond the reference flag set (failure handling is its
+    # TODO, node.go:218-220); both default off = exact reference behavior.
+    p.add_argument("-ft", type=float, default=0.0,
+                   help="leader: seconds of node silence before declaring "
+                        "it crashed and re-planning (0: off)")
+    p.add_argument("-hb", type=float, default=0.0,
+                   help="receiver: heartbeat interval seconds (use ~ft/4; "
+                        "0: off)")
     return p
 
 
@@ -86,18 +94,23 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     # schedule sees all sources (the reference waits only for assignees and
     # races seeder announcements).
     expected = {nc.id for nc in conf.nodes}
+    ft = args.ft
     if args.m == 0:
-        leader = LeaderNode(node, layers, assignment, expected_nodes=expected)
+        leader = LeaderNode(node, layers, assignment, expected_nodes=expected,
+                            failure_timeout=ft)
     elif args.m == 1:
         leader = RetransmitLeaderNode(node, layers, assignment,
-                                      expected_nodes=expected)
+                                      expected_nodes=expected,
+                                      failure_timeout=ft)
     elif args.m == 2:
         leader = PullRetransmitLeaderNode(node, layers, assignment,
-                                          expected_nodes=expected)
+                                          expected_nodes=expected,
+                                          failure_timeout=ft)
     else:
         bw = {nc.id: nc.network_bw for nc in conf.nodes}
         leader = FlowRetransmitLeaderNode(node, layers, assignment, bw,
-                                          expected_nodes=expected)
+                                          expected_nodes=expected,
+                                          failure_timeout=ft)
 
     print(
         f"launching leader...\n[addr: {node.transport.get_address()}, "
@@ -116,11 +129,14 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
 def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     """Receiver role (cmd/main.go:183-215)."""
     if args.m == 0:
-        receiver = ReceiverNode(node, layers, args.s or ".")
+        receiver = ReceiverNode(node, layers, args.s or ".",
+                                heartbeat_interval=args.hb)
     elif args.m in (1, 2):
-        receiver = RetransmitReceiverNode(node, layers, args.s or ".")
+        receiver = RetransmitReceiverNode(node, layers, args.s or ".",
+                                          heartbeat_interval=args.hb)
     else:
-        receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".")
+        receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
+                                              heartbeat_interval=args.hb)
 
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "

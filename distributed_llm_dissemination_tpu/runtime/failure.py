@@ -1,0 +1,127 @@
+"""Failure detection: heartbeats + timeout-based crash detection.
+
+The reference declares failure handling and leaves it TODO — ``crash(n
+node)`` is an empty interface stub (``/root/reference/distributor/
+node.go:218-220``) and there are no timeouts or retries anywhere.  This
+module fills that gap:
+
+- :class:`HeartbeatSender` — a receiver-side thread beaconing
+  ``HeartbeatMsg`` to the leader on a fixed interval.
+- :class:`FailureDetector` — a leader-side monitor; any message from a
+  node refreshes its lease (heartbeats just guarantee traffic during long
+  silences), and a node silent past the timeout is declared crashed, once,
+  via the leader's ``crash()`` hook.
+
+Both are opt-in (interval/timeout 0 disables them), preserving exact
+reference behavior by default.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..core.types import NodeID
+from ..transport.base import Transport
+from ..transport.messages import HeartbeatMsg
+from ..utils.logging import log
+
+
+class HeartbeatSender:
+    """Beacons ``HeartbeatMsg(my_id)`` to the leader every ``interval``
+    seconds until stopped.  Send failures are logged, not raised — a
+    temporarily unreachable leader must not kill the beacon."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        my_id: NodeID,
+        leader_id: NodeID,
+        interval: float,
+    ):
+        self._transport = transport
+        self._my_id = my_id
+        self._leader_id = leader_id
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._transport.send(self._leader_id, HeartbeatMsg(self._my_id))
+            except (OSError, KeyError) as e:
+                log.warn("heartbeat send failed", err=repr(e))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class FailureDetector:
+    """Leader-side liveness monitor.
+
+    ``touch(node_id)`` refreshes a node's lease (call it for *every*
+    message received from the node); a monitor thread scans every
+    ``timeout / 4`` seconds and reports nodes silent for longer than
+    ``timeout`` to ``on_crash``, exactly once per node.
+    """
+
+    def __init__(self, timeout: float, on_crash: Callable[[NodeID], None]):
+        self._timeout = timeout
+        self._on_crash = on_crash
+        self._last_seen: Dict[NodeID, float] = {}
+        self._dead: "set[NodeID]" = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._timeout <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def touch(self, node_id: NodeID) -> None:
+        with self._lock:
+            if node_id not in self._dead:
+                self._last_seen[node_id] = time.monotonic()
+
+    def forget(self, node_id: NodeID) -> None:
+        """Stop monitoring a node (e.g. after its assignment was dropped)."""
+        with self._lock:
+            self._last_seen.pop(node_id, None)
+
+    def _run(self) -> None:
+        scan = self._timeout / 4
+        while not self._stop.wait(scan):
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    nid
+                    for nid, seen in self._last_seen.items()
+                    if now - seen > self._timeout
+                ]
+                for nid in expired:
+                    del self._last_seen[nid]
+                    self._dead.add(nid)
+            for nid in expired:
+                log.error("node declared crashed", node=nid,
+                          timeout_s=self._timeout)
+                try:
+                    self._on_crash(nid)
+                except Exception as e:  # noqa: BLE001 — keep monitoring
+                    log.error("crash handler failed", node=nid, err=repr(e))
+
+    def is_dead(self, node_id: NodeID) -> bool:
+        with self._lock:
+            return node_id in self._dead
+
+    def stop(self) -> None:
+        self._stop.set()

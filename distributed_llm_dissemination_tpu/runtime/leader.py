@@ -49,11 +49,13 @@ from ..transport.messages import (
     AnnounceMsg,
     ClientReqMsg,
     FlowRetransmitMsg,
+    HeartbeatMsg,
     LayerMsg,
     RetransmitMsg,
     StartupMsg,
 )
 from ..utils.logging import log
+from .failure import FailureDetector
 from .node import MessageLoop, Node
 from .send import fetch_from_client, handle_flow_retransmit, send_layer
 
@@ -80,12 +82,18 @@ class LeaderNode:
         assignment: Assignment,
         start_loop: bool = True,
         expected_nodes: Optional[Set[NodeID]] = None,
+        failure_timeout: float = 0.0,
     ):
         """``expected_nodes``: when given, distribution also waits for these
         nodes to announce — not just the assignment keys.  The reference
         starts once all *assignees* have announced (node.go:313-319), which
         races pure seeders' announcements and silently schedules around
-        them (its benchmark config has 7 seeders and 1 assignee)."""
+        them (its benchmark config has 7 seeders and 1 assignee).
+
+        ``failure_timeout``: seconds of silence after which an announced
+        node is declared crashed and ``crash()`` re-plans around it; 0
+        disables detection (the reference has none — crash() is its TODO,
+        node.go:218-220)."""
         self.node = node
         self.layers = layers
         self.assignment = assignment
@@ -96,6 +104,14 @@ class LeaderNode:
         self._ready_q: "queue.Queue[Assignment]" = queue.Queue()
         self._started = False
         self._startup_sent = False
+        self.detector = FailureDetector(failure_timeout, self.crash)
+        # Seed the liveness leases so a node that dies before ever
+        # announcing is still detected (its lease simply expires).  Never
+        # monitor the leader itself: it sends itself no heartbeats, and
+        # "self-crashing" would drop its own layer inventory.
+        for node_id in set(self.assignment) | self.expected_nodes:
+            if node_id != node.my_id:
+                self.detector.touch(node_id)
 
         # The leader's own layers seed its status row (node.go:251-257);
         # carry sizes so the flow solver can size any layer from status.
@@ -113,11 +129,15 @@ class LeaderNode:
         self._register_handlers()
         if start_loop:
             self.loop.start()
+            self.detector.start()
 
     def _register_handlers(self) -> None:
         self.loop.register(AnnounceMsg, self.handle_announce)
         self.loop.register(AckMsg, self.handle_ack)
         self.loop.register(LayerMsg, self.handle_layer)
+        self.loop.register(
+            HeartbeatMsg, lambda msg: self.detector.touch(msg.src_id)
+        )
 
     # ------------------------------------------------------------- lifecycle
 
@@ -130,26 +150,44 @@ class LeaderNode:
         return self._ready_q
 
     def close(self) -> None:
+        self.detector.stop()
         self.loop.stop()
 
     # -------------------------------------------------------------- handlers
 
+    def _maybe_start(self) -> bool:
+        """Flip to started when every awaited node has announced."""
+        with self._lock:
+            if self._started:
+                return False
+            for node_id in set(self.assignment) | self.expected_nodes:
+                if node_id not in self.status:
+                    return False
+            self._started = True
+        log.info("timer start")
+        self._start_q.put(self.assignment)
+        return True
+
     def handle_announce(self, msg: AnnounceMsg) -> None:
         """Register the peer; once everyone announced, start sending
         (node.go:295-324)."""
+        if self.detector.is_dead(msg.src_id):
+            # A late announce from a node already declared crashed must not
+            # resurrect it as a schedulable sender.
+            log.warn("ignoring announce from crashed node", node=msg.src_id)
+            return
+        self.detector.touch(msg.src_id)
         with self._lock:
             if msg.src_id not in self.status:
                 self.status[msg.src_id] = msg.layer_ids
                 self.node.add_node(msg.src_id)
-            if self._started:
-                return
-            for node_id in set(self.assignment) | self.expected_nodes:
-                if node_id not in self.status:
-                    return
-            self._started = True
-        log.info("timer start")
-        self._start_q.put(self.assignment)
-        self.send_layers()
+        if self._maybe_start():
+            self.send_layers()
+            # Announce metadata can already satisfy the assignment (every
+            # assignee holds its layers in RAM) — no acks will ever arrive,
+            # so check now or hang.  (The reference checks only on acks,
+            # node.go:410-432, and would hang here.)
+            self._maybe_finish()
 
     def send_layers(self) -> None:
         """Leader sends every missing assigned layer itself
@@ -164,7 +202,7 @@ class LeaderNode:
                 if layer is None:
                     log.warn("no layers found", layerID=layer_id)
                     continue
-                self.loop._pool.submit(self._send_one, node_id, layer_id, layer)
+                self.loop.submit(self._send_one, node_id, layer_id, layer)
 
     def _send_one(self, dest: NodeID, layer_id: LayerID, layer) -> None:
         try:
@@ -187,10 +225,23 @@ class LeaderNode:
     def handle_ack(self, msg: AckMsg) -> None:
         """Record delivery; on satisfaction broadcast startup + signal ready
         (node.go:410-432)."""
+        if msg.src_id != self.node.my_id:
+            if self.detector.is_dead(msg.src_id):
+                # Re-creating the status row would resurrect the node as a
+                # schedulable sender that no one monitors anymore.
+                log.warn("ignoring ack from crashed node", node=msg.src_id)
+                return
+            self.detector.touch(msg.src_id)
         with self._lock:
             self.status.setdefault(msg.src_id, {})[msg.layer_id] = LayerMeta(
                 location=msg.location
             )
+        self._maybe_finish()
+
+    def _maybe_finish(self) -> None:
+        """Fire startup + ready exactly once when the (possibly shrunk)
+        assignment is satisfied."""
+        with self._lock:
             if self._startup_sent or not assignment_satisfied(
                 self.assignment, self.status
             ):
@@ -199,6 +250,46 @@ class LeaderNode:
         log.info("timer stop: startup")
         self.send_startup()
         self._ready_q.put(self.assignment)
+
+    # ------------------------------------------------------------- failures
+
+    def _recover(self) -> None:
+        """Re-drive delivery after a crash; mode-specific schedulers
+        override this when re-running ``send_layers`` wholesale would
+        corrupt their job state."""
+        self.send_layers()
+
+    def crash(self, node_id: NodeID) -> None:
+        """Remove a dead node and re-plan around it — the reference's
+        never-implemented ``crash(n node)`` (node.go:218-220).
+
+        A dead *sender*'s duties are re-scheduled onto the survivors.  A
+        dead *assignee* is dropped from the assignment (its layers can
+        never land), loudly, so the rest of the cluster still converges."""
+        if node_id == self.node.my_id:
+            log.error("refusing to declare self crashed")
+            return
+        self.detector.forget(node_id)
+        with self._lock:
+            self.status.pop(node_id, None)
+            dropped = self.assignment.pop(node_id, None)
+            self.expected_nodes.discard(node_id)
+            started = self._started
+        if dropped:
+            log.error("crashed node was an assignee; dropping its layers",
+                      node=node_id, layers=sorted(dropped))
+        if not started:
+            # Crash before start: the node may have been the last holdout —
+            # and the shrunk assignment may even be satisfied already.
+            if self._maybe_start():
+                self.send_layers()
+                self._maybe_finish()
+            return
+        self._maybe_finish()
+        with self._lock:
+            finished = self._startup_sent
+        if not finished:
+            self._recover()
 
     def send_startup(self) -> None:
         with self._lock:
@@ -215,10 +306,21 @@ class RetransmitLeaderNode(LeaderNode):
 
     def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
                  start_loop: bool = True,
-                 expected_nodes: Optional[Set[NodeID]] = None):
+                 expected_nodes: Optional[Set[NodeID]] = None,
+                 failure_timeout: float = 0.0):
         self.layer_owners: Dict[LayerID, Set[NodeID]] = {}
         super().__init__(node, layers, assignment, start_loop=start_loop,
-                         expected_nodes=expected_nodes)
+                         expected_nodes=expected_nodes,
+                         failure_timeout=failure_timeout)
+
+    def crash(self, node_id: NodeID) -> None:
+        """A dead node no longer serves its layers; re-run the owner
+        scheduling for everything unacked (receivers tolerate duplicate
+        deliveries, so re-sending in-flight layers is safe)."""
+        with self._lock:
+            for owners in self.layer_owners.values():
+                owners.discard(node_id)
+        super().crash(node_id)
 
     def _build_layer_owners(self) -> None:
         """Index layer → owner set from announcements (node.go:558-571)."""
@@ -251,7 +353,7 @@ class RetransmitLeaderNode(LeaderNode):
                     if layer is None:
                         log.warn("no layers found", layerID=layer_id)
                         continue
-                    self.loop._pool.submit(self._send_one, node_id, layer_id, layer)
+                    self.loop.submit(self._send_one, node_id, layer_id, layer)
 
     def send_retransmit(self, layer_id: LayerID, owner: NodeID, dest: NodeID) -> None:
         """Ask ``owner`` to forward ``layer_id`` to ``dest``; leader-owned
@@ -291,14 +393,58 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
 
     def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
                  start_loop: bool = True,
-                 expected_nodes: Optional[Set[NodeID]] = None):
+                 expected_nodes: Optional[Set[NodeID]] = None,
+                 failure_timeout: float = 0.0):
         # layer -> dest -> job
         self.jobs: Dict[LayerID, Dict[NodeID, _JobInfo]] = {}
         self.sender_load: Dict[NodeID, int] = {}
         # sender -> (avg job duration seconds, completed count)
         self.performance: Dict[NodeID, Tuple[float, int]] = {}
         super().__init__(node, layers, assignment, start_loop=start_loop,
-                         expected_nodes=expected_nodes)
+                         expected_nodes=expected_nodes,
+                         failure_timeout=failure_timeout)
+
+    def crash(self, node_id: NodeID) -> None:
+        """Surgical job-table repair: jobs destined for the dead node are
+        dropped; jobs it was sending (or queued to send) are orphaned for
+        ``_recover`` to reassign.  Living senders' load counters for
+        dropped jobs are left as-is — they only bias the min-load
+        heuristic, and self-correct as jobs complete."""
+        with self._lock:
+            self.sender_load.pop(node_id, None)
+            self.performance.pop(node_id, None)
+            for layer_id in list(self.jobs):
+                dests = self.jobs[layer_id]
+                dests.pop(node_id, None)
+                for job in dests.values():
+                    if job.sender == node_id:
+                        job.sender = None
+                        job.status = _JobInfo.PENDING
+                        job.t_start = None
+                if not dests:
+                    del self.jobs[layer_id]
+        super().crash(node_id)
+
+    def _recover(self) -> None:
+        """Reassign orphaned jobs to the min-loaded surviving owner and
+        kick those senders (instead of the base full ``send_layers`` rerun,
+        which would rebuild the live job table from scratch)."""
+        kicked: Set[NodeID] = set()
+        with self._lock:
+            for layer_id, dests in self.jobs.items():
+                for dest, job in dests.items():
+                    if job.sender is not None:
+                        continue
+                    sender = self._min_loaded_sender(layer_id)
+                    if sender is None:
+                        log.error("no surviving owner for orphaned job",
+                                  layer=layer_id, dest=dest)
+                        continue
+                    job.sender = sender
+                    self.sender_load[sender] += 1
+                    kicked.add(sender)
+        for sender in kicked:
+            self.loop.submit(self._assign_new_job_safe, sender)
 
     def send_layers(self) -> None:
         """Build the job table rarest-first and kick every node
@@ -333,7 +479,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                 | {s for s, load in self.sender_load.items() if load > 0}
             )
         for node_id in nodes:
-            self.loop._pool.submit(self._assign_new_job_safe, node_id)
+            self.loop.submit(self._assign_new_job_safe, node_id)
 
     def _assign_new_job_safe(self, node_id: NodeID) -> None:
         try:
@@ -483,6 +629,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         node_network_bw: Dict[NodeID, int],
         start_loop: bool = True,
         expected_nodes: Optional[Set[NodeID]] = None,
+        failure_timeout: float = 0.0,
     ):
         self.layer_dests: Dict[LayerID, NodeID] = {}
         for dest, layer_ids in assignment.items():
@@ -493,7 +640,20 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     self.layer_dests[layer_id] = dest
         self.node_network_bw = dict(node_network_bw)
         super().__init__(node, layers, assignment, start_loop=start_loop,
-                         expected_nodes=expected_nodes)
+                         expected_nodes=expected_nodes,
+                         failure_timeout=failure_timeout)
+
+    def crash(self, node_id: NodeID) -> None:
+        """Drop routes to a dead assignee, then let the base re-plan: the
+        inherited ``_recover`` re-runs ``send_layers``, and ``assign_jobs``
+        already skips delivered layers, so the new flow plan covers exactly
+        the undelivered remainder (receivers reassemble by byte range, so
+        overlapping re-sends are harmless)."""
+        with self._lock:
+            self.layer_dests = {
+                lid: d for lid, d in self.layer_dests.items() if d != node_id
+            }
+        super().crash(node_id)
 
     def _register_handlers(self) -> None:
         super()._register_handlers()

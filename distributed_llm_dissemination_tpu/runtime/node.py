@@ -89,14 +89,24 @@ class MessageLoop:
             if handler is None:
                 log.debug("unhandled message", kind=type(msg).__name__)
                 continue
-            self._pool.submit(self._safe, handler, msg)
+            self.submit(handler, msg)
+
+    def submit(self, fn: Callable, *args) -> None:
+        """Run ``fn(*args)`` on the pool, tolerating a concurrent
+        ``stop()`` (work arriving mid-shutdown is dropped, not raised)."""
+        try:
+            self._pool.submit(self._safe, fn, *args)
+        except RuntimeError:
+            if not self._stop.is_set():
+                raise
 
     @staticmethod
-    def _safe(handler: Callable, msg) -> None:
+    def _safe(handler: Callable, *args) -> None:
         try:
-            handler(msg)
+            handler(*args)
         except Exception as e:  # noqa: BLE001 — a handler crash must not kill the loop
-            log.error("handler failed", kind=type(msg).__name__, err=repr(e))
+            log.error("handler failed", fn=getattr(handler, "__name__", "?"),
+                      err=repr(e))
 
     def stop(self) -> None:
         self._stop.set()

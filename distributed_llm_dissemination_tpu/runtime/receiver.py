@@ -40,13 +40,19 @@ from ..transport.messages import (
     RetransmitMsg,
     StartupMsg,
 )
+from ..utils import intervals
 from ..utils.logging import log
+from .failure import HeartbeatSender
 from .node import MessageLoop, Node
 from .send import fetch_from_client, handle_flow_retransmit, send_layer
 
 
 class ReceiverNode:
-    """Mode 0 receiver (node.go:1299-1418)."""
+    """Mode 0 receiver (node.go:1299-1418).
+
+    ``heartbeat_interval`` > 0 starts a liveness beacon to the leader on
+    the first ``announce()`` — the receiver half of the failure detection
+    the reference leaves TODO (node.go:218-220)."""
 
     def __init__(
         self,
@@ -54,12 +60,16 @@ class ReceiverNode:
         layers: LayersSrc,
         storage_path: str = ".",
         start_loop: bool = True,
+        heartbeat_interval: float = 0.0,
     ):
         self.node = node
         self.layers = layers
         self.storage_path = storage_path
         self._ready_q: "queue.Queue[object]" = queue.Queue()
         self._lock = threading.Lock()
+        self.heartbeat = HeartbeatSender(
+            node.transport, node.my_id, node.leader_id, heartbeat_interval
+        )
         self.loop = MessageLoop(node.transport)
         self._register_handlers()
         if start_loop:
@@ -84,11 +94,13 @@ class ReceiverNode:
             }
         next_hop = self.node.get_next_hop(self.node.leader_id)
         self.node.transport.send(next_hop, AnnounceMsg(self.node.my_id, layer_ids))
+        self.heartbeat.start()
 
     def ready(self) -> "queue.Queue[object]":
         return self._ready_q
 
     def close(self) -> None:
+        self.heartbeat.stop()
         self.loop.stop()
 
     def handle_layer(self, msg: LayerMsg) -> None:
@@ -142,10 +154,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
     (node.go:1487-1589)."""
 
     def __init__(self, node: Node, layers: LayersSrc, storage_path: str = ".",
-                 start_loop: bool = True):
-        # layer -> (reassembly buffer, bytes received so far)
-        self._partial: Dict[int, Tuple[bytearray, int]] = {}
-        super().__init__(node, layers, storage_path, start_loop=start_loop)
+                 start_loop: bool = True, heartbeat_interval: float = 0.0):
+        # layer -> (reassembly buffer, disjoint covered [start, end) ranges)
+        self._partial: Dict[int, Tuple[bytearray, list]] = {}
+        super().__init__(node, layers, storage_path, start_loop=start_loop,
+                         heartbeat_interval=heartbeat_interval)
 
     def _register_handlers(self) -> None:
         super()._register_handlers()
@@ -153,29 +166,49 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
 
     def handle_layer(self, msg: LayerMsg) -> None:
         """Write the fragment at its offset; ack when the layer is whole
-        (node.go:1520-1567, with the real byte copy the reference skips)."""
+        (node.go:1520-1567, with the real byte copy the reference skips).
+
+        Coverage is tracked as an interval union, not a byte counter (the
+        reference sums sizes, node.go:1542-1554) — so duplicate or
+        overlapping fragments from a crash-triggered re-plan can never ack
+        a layer full of holes."""
         with self._lock:
-            buf, received = self._partial.get(
-                msg.layer_id, (bytearray(msg.total_size), 0)
-            )
-            frag = msg.layer_src
-            data = frag.read_bytes()
-            buf[frag.offset : frag.offset + frag.data_size] = data
-            received += frag.data_size
-            self._partial[msg.layer_id] = (buf, received)
-            log.info(
-                "layer fragment stored",
-                layerID=msg.layer_id, received=received, total=msg.total_size,
-            )
-            if received < msg.total_size:
-                return
-            self.layers[msg.layer_id] = LayerSrc(
-                inmem_data=buf,
-                data_size=msg.total_size,
-                meta=LayerMeta(location=LayerLocation.INMEM),
-            )
-            del self._partial[msg.layer_id]
-        log.info("layer fully received", layer=msg.layer_id, total_bytes=msg.total_size)
+            if msg.layer_id in self.layers:
+                # A re-plan duplicate of a finished layer: drop the bytes
+                # but re-ack below — the re-send happened precisely because
+                # the leader never saw our ack.
+                complete = True
+            else:
+                entry = self._partial.get(msg.layer_id)
+                if entry is None:
+                    # Allocate lazily — an eager dict.get default would
+                    # zero a full layer-sized buffer on *every* fragment.
+                    entry = (bytearray(msg.total_size), [])
+                buf, covered = entry
+                frag = msg.layer_src
+                data = frag.read_bytes()
+                buf[frag.offset : frag.offset + frag.data_size] = data
+                covered = intervals.insert(
+                    covered, frag.offset, frag.offset + frag.data_size
+                )
+                self._partial[msg.layer_id] = (buf, covered)
+                received = intervals.covered(covered)
+                log.info(
+                    "layer fragment stored",
+                    layerID=msg.layer_id, received=received, total=msg.total_size,
+                )
+                complete = received >= msg.total_size
+                if complete:
+                    self.layers[msg.layer_id] = LayerSrc(
+                        inmem_data=buf,
+                        data_size=msg.total_size,
+                        meta=LayerMeta(location=LayerLocation.INMEM),
+                    )
+                    del self._partial[msg.layer_id]
+                    log.info("layer fully received", layer=msg.layer_id,
+                             total_bytes=msg.total_size)
+        if not complete:
+            return
         try:
             self.node.transport.send(
                 self.node.leader_id,

@@ -36,6 +36,10 @@ class MsgType(enum.IntEnum):
     CLIENT_REQ = 5
     STARTUP = 6
     SIMPLE = 7
+    # Extension beyond the reference enum (message.go:16-28): liveness
+    # beacon for the failure detector, which the reference leaves TODO
+    # (crash(n node), node.go:218-220).
+    HEARTBEAT = 8
 
 
 @dataclasses.dataclass
@@ -242,6 +246,23 @@ class SimpleMsg:
         return cls(d.get("SrcAddr", ""), d.get("PayloadStr", ""))
 
 
+@dataclasses.dataclass
+class HeartbeatMsg:
+    """Receiver → leader: I'm alive.  Extension beyond the reference
+    (its failure handling is explicitly TODO, node.go:218-220)."""
+
+    src_id: NodeID
+
+    msg_type = MsgType.HEARTBEAT
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "HeartbeatMsg":
+        return cls(int(d["SrcID"]))
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -251,6 +272,7 @@ Message = Union[
     ClientReqMsg,
     StartupMsg,
     SimpleMsg,
+    HeartbeatMsg,
 ]
 
 _DECODERS = {
@@ -261,6 +283,7 @@ _DECODERS = {
     MsgType.CLIENT_REQ: ClientReqMsg,
     MsgType.STARTUP: StartupMsg,
     MsgType.SIMPLE: SimpleMsg,
+    MsgType.HEARTBEAT: HeartbeatMsg,
 }
 
 

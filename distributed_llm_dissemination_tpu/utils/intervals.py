@@ -1,0 +1,53 @@
+"""Byte-range interval accounting.
+
+The reference's mode-3 receiver counts received *sizes* and acks when the
+sum reaches the layer total (``/root/reference/distributor/node.go:
+1542-1566``) — duplicated or overlapping fragments would ack a layer full
+of holes.  Tracking the union of covered ``[start, end)`` intervals makes
+reassembly idempotent, which is what allows the failure detector to
+re-plan in-flight layers (duplicates are harmless) and resumable
+transfers to report precise missing ranges.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+Interval = Tuple[int, int]  # [start, end)
+
+
+def insert(intervals: List[Interval], start: int, end: int) -> List[Interval]:
+    """Union ``[start, end)`` into a sorted list of disjoint intervals."""
+    if start >= end:
+        return intervals
+    out: List[Interval] = []
+    i, n = 0, len(intervals)
+    while i < n and intervals[i][1] < start:
+        out.append(intervals[i])
+        i += 1
+    while i < n and intervals[i][0] <= end:
+        start = min(start, intervals[i][0])
+        end = max(end, intervals[i][1])
+        i += 1
+    out.append((start, end))
+    out.extend(intervals[i:])
+    return out
+
+
+def covered(intervals: List[Interval]) -> int:
+    """Total bytes covered by a disjoint interval list."""
+    return sum(e - s for s, e in intervals)
+
+
+def complement(intervals: List[Interval], total: int) -> List[Interval]:
+    """The gaps: ranges of ``[0, total)`` NOT covered — the byte ranges a
+    resumed transfer still needs."""
+    gaps: List[Interval] = []
+    pos = 0
+    for s, e in intervals:
+        if s > pos:
+            gaps.append((pos, s))
+        pos = max(pos, e)
+    if pos < total:
+        gaps.append((pos, total))
+    return gaps

@@ -1,0 +1,242 @@
+"""Failure detection and crash recovery tests.
+
+The reference's failure handling is an unimplemented TODO (``crash(n
+node)``, /root/reference/distributor/node.go:218-220); these tests cover
+the framework's implementation of it: heartbeat-based detection, dead
+*sender* re-planning in modes 1/2/3, and dead *assignee* drop-out.
+
+Zombie pattern: a node constructed with ``start_loop=False`` announces
+(and so gets scheduled) but never processes messages — exactly a process
+that froze right after announcing.
+"""
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import LayerMeta
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    LeaderNode,
+    Node,
+    PullRetransmitLeaderNode,
+    ReceiverNode,
+    RetransmitLeaderNode,
+    RetransmitReceiverNode,
+)
+from distributed_llm_dissemination_tpu.transport import reset_registry
+from distributed_llm_dissemination_tpu.utils import intervals
+
+from test_node import close_all, layer_bytes, make_transports, mem_layer
+
+TIMEOUT = 15.0
+FT = 0.8   # leader failure timeout
+HB = 0.1   # receiver heartbeat interval
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+# --------------------------------------------------------------- intervals
+
+def test_interval_union_and_gaps():
+    ivs = []
+    ivs = intervals.insert(ivs, 10, 20)
+    ivs = intervals.insert(ivs, 30, 40)
+    assert intervals.covered(ivs) == 20
+    ivs = intervals.insert(ivs, 15, 35)  # bridges both
+    assert ivs == [(10, 40)]
+    # Duplicates add nothing.
+    ivs = intervals.insert(ivs, 10, 40)
+    assert intervals.covered(ivs) == 30
+    assert intervals.complement(ivs, 50) == [(0, 10), (40, 50)]
+    assert intervals.complement([], 5) == [(0, 5)]
+
+
+def test_interval_duplicate_fragments_do_not_fake_completion():
+    # The reference's size-sum accounting (node.go:1542-1554) would count
+    # 2 x 50 bytes as a complete 100-byte layer; intervals must not.
+    ivs = intervals.insert([], 0, 50)
+    ivs = intervals.insert(ivs, 0, 50)
+    assert intervals.covered(ivs) == 50
+
+
+# ------------------------------------------------------------ crash: sender
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_mode1_sender_crash_leader_takes_over(kind):
+    # Leader (id 9) and zombie r1 both own layer 0; r2 needs it.  Mode 1
+    # delegates to the lowest-id owner = the zombie; after the failure
+    # timeout the leader must detect the crash and send the layer itself.
+    ids = [9, 1, 2]
+    ts, _ = make_transports(kind, ids)
+    assignment = {2: {0: LayerMeta()}}
+    leader = RetransmitLeaderNode(
+        Node(9, 9, ts[9]), {0: mem_layer(0)}, assignment,
+        expected_nodes={1, 2}, failure_timeout=FT,
+    )
+    zombie = RetransmitReceiverNode(
+        Node(1, 9, ts[1]), {0: mem_layer(0)}, start_loop=False
+    )
+    r2 = RetransmitReceiverNode(Node(2, 9, ts[2]), {}, heartbeat_interval=HB)
+    try:
+        zombie.announce()
+        r2.announce()
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == assignment
+        assert bytes(r2.layers[0].inmem_data) == layer_bytes(0)
+    finally:
+        close_all(leader, [zombie, r2], ts)
+
+
+def test_mode2_sender_crash_job_reassigned():
+    ids = [9, 1, 2]
+    ts, _ = make_transports("inmem", ids)
+    assignment = {2: {0: LayerMeta()}}
+    leader = PullRetransmitLeaderNode(
+        Node(9, 9, ts[9]), {0: mem_layer(0)}, assignment,
+        expected_nodes={1, 2}, failure_timeout=FT,
+    )
+    zombie = RetransmitReceiverNode(
+        Node(1, 9, ts[1]), {0: mem_layer(0)}, start_loop=False
+    )
+    r2 = RetransmitReceiverNode(Node(2, 9, ts[2]), {}, heartbeat_interval=HB)
+    try:
+        zombie.announce()
+        r2.announce()
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == assignment
+        assert bytes(r2.layers[0].inmem_data) == layer_bytes(0)
+        # The zombie's job table entries are gone.
+        assert all(
+            job.sender != 1
+            for dests in leader.jobs.values()
+            for job in dests.values()
+        )
+    finally:
+        close_all(leader, [zombie, r2], ts)
+
+
+def test_mode3_seeder_crash_replan_with_duplicates():
+    # Cold node 4 needs layers 0-1, split across seeders by the flow plan.
+    # Seeder 1 is a zombie: its fragments never arrive.  The re-plan
+    # re-sends whole layers from survivors; interval-based reassembly must
+    # absorb the overlap and deliver byte-correct layers.
+    ids = [0, 1, 2, 3, 4]
+    ts, _ = make_transports("inmem", ids)
+    size = 4096
+    assignment = {4: {i: LayerMeta() for i in range(2)}}
+    seed = lambda: {i: mem_layer(i, size) for i in range(2)}  # noqa: E731
+    bw = {i: 10_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), seed(), assignment, bw,
+        expected_nodes={1, 2, 3, 4}, failure_timeout=FT,
+    )
+    zombie = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), seed(),
+                                        start_loop=False)
+    live = [
+        FlowRetransmitReceiverNode(Node(i, 0, ts[i]), seed(),
+                                   heartbeat_interval=HB)
+        for i in (2, 3)
+    ]
+    cold = FlowRetransmitReceiverNode(Node(4, 0, ts[4]), {},
+                                      heartbeat_interval=HB)
+    try:
+        zombie.announce()
+        for r in live + [cold]:
+            r.announce()
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == assignment
+        for lid in range(2):
+            src = cold.layers[lid]
+            assert src.data_size == size
+            assert bytes(src.inmem_data) == layer_bytes(lid, size)
+    finally:
+        close_all(leader, [zombie, cold] + live, ts)
+
+
+def test_mode3_duplicate_of_finished_layer_reacks():
+    # If the receiver's original ack was lost, the leader re-sends the
+    # layer; the duplicate must trigger a fresh ack (silently dropping it
+    # would deadlock the re-plan).
+    from distributed_llm_dissemination_tpu.core.types import (
+        LayerLocation,
+        LayerSrc,
+    )
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AckMsg,
+        LayerMsg,
+    )
+
+    ids = [0, 1]
+    ts, _ = make_transports("inmem", ids)
+    recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {}, start_loop=False)
+    size = 128
+    frag = lambda: LayerMsg(  # noqa: E731
+        0, 7,
+        LayerSrc(inmem_data=bytearray(layer_bytes(7, size)), data_size=size,
+                 offset=0, meta=LayerMeta(location=LayerLocation.INMEM)),
+        size,
+    )
+    try:
+        recv.handle_layer(frag())
+        recv.handle_layer(frag())  # re-plan duplicate
+        acks = []
+        q = ts[0].deliver()
+        while not q.empty():
+            m = q.get_nowait()
+            if isinstance(m, AckMsg):
+                acks.append(m)
+        assert len(acks) == 2 and all(a.layer_id == 7 for a in acks)
+        assert bytes(recv.layers[7].inmem_data) == layer_bytes(7, size)
+    finally:
+        recv.close()
+        for t in ts.values():
+            t.close()
+
+
+# ---------------------------------------------------------- crash: assignee
+
+def test_mode0_assignee_crash_dropped_from_assignment():
+    # r1 acks its layer; r2 freezes after announcing and never acks.  The
+    # leader must drop r2 and fire ready with the shrunk assignment.
+    ids = [0, 1, 2]
+    ts, _ = make_transports("inmem", ids)
+    assignment = {1: {0: LayerMeta()}, 2: {1: LayerMeta()}}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i) for i in range(2)}, assignment,
+        failure_timeout=FT,
+    )
+    r1 = ReceiverNode(Node(1, 0, ts[1]), {}, heartbeat_interval=HB)
+    zombie = ReceiverNode(Node(2, 0, ts[2]), {}, start_loop=False)
+    try:
+        r1.announce()
+        zombie.announce()
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == {1: {0: LayerMeta()}}
+        assert bytes(r1.layers[0].inmem_data) == layer_bytes(0)
+    finally:
+        close_all(leader, [r1, zombie], ts)
+
+
+def test_mode0_crash_of_never_announcing_node_unblocks_start():
+    # The leader waits for an expected node that died before it could even
+    # announce; its seeded lease must expire and unblock the start instead
+    # of hanging forever.
+    ids = [0, 1, 2]
+    ts, _ = make_transports("inmem", ids)
+    assignment = {1: {0: LayerMeta()}}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0)}, assignment,
+        expected_nodes={1, 2}, failure_timeout=FT,
+    )
+    r1 = ReceiverNode(Node(1, 0, ts[1]), {}, heartbeat_interval=HB)
+    try:
+        r1.announce()  # node 2 never announces at all
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == assignment
+    finally:
+        close_all(leader, [r1], ts)
