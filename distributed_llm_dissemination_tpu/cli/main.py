@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-hb", type=float, default=0.0,
                    help="receiver: heartbeat interval seconds (use ~ft/4; "
                         "0: off)")
+    p.add_argument("-ckpt", type=str, default="",
+                   help="receiver (mode 3): directory for durable partial-"
+                        "layer checkpoints; a restarted receiver resumes "
+                        "and only the missing byte ranges are re-sent")
     return p
 
 
@@ -136,7 +140,8 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
                                           heartbeat_interval=args.hb)
     else:
         receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
-                                              heartbeat_interval=args.hb)
+                                              heartbeat_interval=args.hb,
+                                              checkpoint_dir=args.ckpt)
 
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "

@@ -1,4 +1,6 @@
+from .checkpoint import LayerCheckpointStore, map_through_gaps  # noqa: F401
 from .client import Client  # noqa: F401
+from .failure import FailureDetector, HeartbeatSender  # noqa: F401
 from .leader import (  # noqa: F401
     FlowRetransmitLeaderNode,
     LeaderNode,

@@ -1,0 +1,133 @@
+"""Transfer checkpoint/resume: crash-durable partial-layer progress.
+
+The reference has no checkpointing; its nearest machinery is layer files
+on disk (``/root/reference/cmd/config.go:133-157``) and the mode-3
+receiver's (non-durable) incremental byte accounting
+(``distributor/node.go:1542-1554``).  Here every received fragment is
+also written at its offset into ``<dir>/<layer_id>.part`` with the
+covered intervals journaled in ``<dir>/<layer_id>.meta.json``; a
+restarted receiver reloads its partial buffers, announces the covered
+ranges, and the mode-3 leader schedules **only the gaps** — a crash
+costs at most the fragments in flight, not the transfer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from ..core.types import LayerID
+from ..utils import intervals
+from ..utils.logging import log
+
+# layer -> (buffer, covered intervals, total size)
+PartialState = Dict[LayerID, Tuple[bytearray, List[Tuple[int, int]], int]]
+
+
+class LayerCheckpointStore:
+    """Durable fragment journal for one receiver.
+
+    ``write_fragment`` is crash-ordered: bytes land in the ``.part`` file
+    before the meta journal records them as covered, so a crash between
+    the two writes only *under*-reports progress (the range is re-sent,
+    which interval reassembly absorbs) — never the fatal inverse.
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _part(self, layer_id: LayerID) -> str:
+        return os.path.join(self.dir, f"{layer_id}.part")
+
+    def _meta(self, layer_id: LayerID) -> str:
+        return os.path.join(self.dir, f"{layer_id}.meta.json")
+
+    def write_fragment(
+        self,
+        layer_id: LayerID,
+        offset: int,
+        data: bytes,
+        covered: List[Tuple[int, int]],
+        total: int,
+    ) -> None:
+        """Persist one fragment + the post-write coverage state."""
+        part = self._part(layer_id)
+        mode = "r+b" if os.path.exists(part) else "w+b"
+        with open(part, mode) as f:
+            if mode == "w+b":
+                f.truncate(total)
+            f.seek(offset)
+            f.write(data)
+        tmp = self._meta(layer_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"Total": total, "Covered": [list(iv) for iv in covered]}, f)
+        os.replace(tmp, self._meta(layer_id))  # atomic journal update
+
+    def complete(self, layer_id: LayerID) -> None:
+        """Drop checkpoint state for a fully assembled layer."""
+        for path in (self._part(layer_id), self._meta(layer_id)):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+    def load(self) -> PartialState:
+        """Restore all partial layers recorded in this directory."""
+        state: PartialState = {}
+        if not os.path.isdir(self.dir):
+            return state
+        for name in sorted(os.listdir(self.dir)):
+            if not name.endswith(".meta.json"):
+                continue
+            try:
+                layer_id = int(name.split(".", 1)[0])
+            except ValueError:
+                log.warn("ignoring foreign file in checkpoint dir", file=name)
+                continue
+            try:
+                with open(self._meta(layer_id)) as f:
+                    meta = json.load(f)
+                total = int(meta["Total"])
+                covered = [(int(s), int(e)) for s, e in meta["Covered"]]
+                buf = bytearray(total)
+                with open(self._part(layer_id), "rb") as f:
+                    for s, e in covered:
+                        f.seek(s)
+                        buf[s:e] = f.read(e - s)
+            except (OSError, ValueError, KeyError) as e:
+                log.warn("dropping unreadable checkpoint", layer=layer_id,
+                         err=repr(e))
+                self.complete(layer_id)  # clear the corrupt pair
+                continue
+            state[layer_id] = (buf, covered, total)
+            log.info("restored partial layer from checkpoint",
+                     layer=layer_id, covered_bytes=intervals.covered(covered),
+                     total=total)
+        return state
+
+
+def map_through_gaps(
+    gaps: List[Tuple[int, int]], offset: int, size: int
+) -> List[Tuple[int, int]]:
+    """Translate a job span over *remaining* bytes into real byte ranges.
+
+    When a layer is partially delivered, the flow solver plans over its
+    remaining size R; a per-sender job tiles ``[offset, offset+size)`` of
+    that compacted space.  This maps the span back through the gap list
+    (the uncovered ranges, in order) to absolute (offset, size) pairs —
+    possibly several, when the span crosses a gap boundary.
+    """
+    out: List[Tuple[int, int]] = []
+    pos = 0  # position in compacted remaining-space
+    for s, e in gaps:
+        glen = e - s
+        lo = max(offset, pos)
+        hi = min(offset + size, pos + glen)
+        if lo < hi:
+            out.append((s + (lo - pos), hi - lo))
+        pos += glen
+        if pos >= offset + size:
+            break
+    return out

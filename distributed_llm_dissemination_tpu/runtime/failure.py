@@ -98,6 +98,14 @@ class FailureDetector:
         with self._lock:
             self._last_seen.pop(node_id, None)
 
+    def revive(self, node_id: NodeID) -> None:
+        """Re-admit a declared-dead node (a restarted process announcing
+        again) and restart its lease.  If the announce was actually a stale
+        queued message, the fresh lease simply expires again."""
+        with self._lock:
+            self._dead.discard(node_id)
+            self._last_seen[node_id] = time.monotonic()
+
     def _run(self) -> None:
         scan = self._timeout / 4
         while not self._stop.wait(scan):

@@ -54,7 +54,9 @@ from ..transport.messages import (
     RetransmitMsg,
     StartupMsg,
 )
+from ..utils import intervals
 from ..utils.logging import log
+from .checkpoint import map_through_gaps
 from .failure import FailureDetector
 from .node import MessageLoop, Node
 from .send import fetch_from_client, handle_flow_retransmit, send_layer
@@ -104,6 +106,9 @@ class LeaderNode:
         self._ready_q: "queue.Queue[Assignment]" = queue.Queue()
         self._started = False
         self._startup_sent = False
+        # node -> {layer: {"Total": n, "Covered": [[s, e], ...]}} from
+        # announces of checkpoint-resuming receivers.
+        self.partial_status: Dict[NodeID, dict] = {}
         self.detector = FailureDetector(failure_timeout, self.crash)
         # Seed the liveness leases so a node that dies before ever
         # announcing is still detected (its lease simply expires).  Never
@@ -170,17 +175,32 @@ class LeaderNode:
 
     def handle_announce(self, msg: AnnounceMsg) -> None:
         """Register the peer; once everyone announced, start sending
-        (node.go:295-324)."""
+        (node.go:295-324).
+
+        A *re*-announce after the start is a restarted process: its status
+        row is refreshed (delivered-to-RAM layers died with it; surviving
+        state arrives via the announce itself, checkpointed partials
+        included) and the scheduler re-plans its missing layers."""
         if self.detector.is_dead(msg.src_id):
-            # A late announce from a node already declared crashed must not
-            # resurrect it as a schedulable sender.
-            log.warn("ignoring announce from crashed node", node=msg.src_id)
-            return
+            log.warn("declared-dead node announced again; reviving",
+                     node=msg.src_id)
+            self.detector.revive(msg.src_id)
         self.detector.touch(msg.src_id)
         with self._lock:
-            if msg.src_id not in self.status:
-                self.status[msg.src_id] = msg.layer_ids
-                self.node.add_node(msg.src_id)
+            reannounce = self._started and msg.src_id in self.status
+            # Always refresh: an announce is the node's authoritative
+            # current inventory (a pre-start restart must not leave a stale
+            # row claiming layers the new incarnation lost).
+            self.status[msg.src_id] = msg.layer_ids
+            self.node.add_node(msg.src_id)
+            if msg.partial:
+                # Checkpointed in-progress coverage (resume extension);
+                # mode 3 schedules only the complement.
+                self.partial_status[msg.src_id] = msg.partial
+            else:
+                # A re-announce without partials supersedes any stale ones
+                # (e.g. the checkpoint dir was wiped between restarts).
+                self.partial_status.pop(msg.src_id, None)
         if self._maybe_start():
             self.send_layers()
             # Announce metadata can already satisfy the assignment (every
@@ -188,6 +208,19 @@ class LeaderNode:
             # so check now or hang.  (The reference checks only on acks,
             # node.go:410-432, and would hang here.)
             self._maybe_finish()
+            return
+        if reannounce:
+            log.info("node re-announced; re-planning", node=msg.src_id)
+            self._maybe_finish()
+            with self._lock:
+                finished = self._startup_sent
+            if not finished:
+                self._on_reannounce(msg.src_id)
+
+    def _on_reannounce(self, node_id: NodeID) -> None:
+        """Re-drive delivery for a restarted node; mode 2 overrides (its
+        job table needs surgical repair, not a wholesale re-run)."""
+        self._recover()
 
     def send_layers(self) -> None:
         """Leader sends every missing assigned layer itself
@@ -323,7 +356,10 @@ class RetransmitLeaderNode(LeaderNode):
         super().crash(node_id)
 
     def _build_layer_owners(self) -> None:
-        """Index layer → owner set from announcements (node.go:558-571)."""
+        """(Re)index layer → owner set from live status (node.go:558-571).
+        Rebuilt from scratch: status is the source of truth, and a
+        restarted node no longer owns what its dead incarnation held."""
+        self.layer_owners = {}
         for node_id, layer_ids in self.status.items():
             for layer_id in layer_ids:
                 self.layer_owners.setdefault(layer_id, set()).add(node_id)
@@ -424,6 +460,37 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                 if not dests:
                     del self.jobs[layer_id]
         super().crash(node_id)
+
+    def _on_reannounce(self, node_id: NodeID) -> None:
+        """Rebuild jobs for a restarted assignee's still-missing layers
+        (its in-flight transfers died with the old process) and kick the
+        chosen senders."""
+        kicked: Set[NodeID] = set()
+        with self._lock:
+            self._build_layer_owners()
+            held = self.status.get(node_id, {})
+            for layer_id in self.assignment.get(node_id, {}):
+                meta = held.get(layer_id)
+                if meta is not None and delivered(meta):
+                    continue
+                sender = self._min_loaded_sender(layer_id)
+                if sender is None:
+                    log.error("no owner for restarted node's layer",
+                              layer=layer_id, dest=node_id)
+                    continue
+                # Release the superseded job's load slot (still held only
+                # while PENDING — a pull already decremented it).
+                old = self.jobs.get(layer_id, {}).get(node_id)
+                if (old is not None and old.status == _JobInfo.PENDING
+                        and old.sender is not None):
+                    self.sender_load[old.sender] = max(
+                        0, self.sender_load.get(old.sender, 1) - 1
+                    )
+                self.jobs.setdefault(layer_id, {})[node_id] = _JobInfo(sender)
+                self.sender_load[sender] = self.sender_load.get(sender, 0) + 1
+                kicked.add(sender)
+        for sender in kicked:
+            self.loop.submit(self._assign_new_job_safe, sender)
 
     def _recover(self) -> None:
         """Reassign orphaned jobs to the min-loaded surviving owner and
@@ -666,9 +733,16 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
     def assign_jobs(self) -> Tuple[int, FlowJobsMap, FlowJobsMap]:
         """Split off self-jobs (dest already holds the layer at its own
         client), then solve the flow problem for the rest
-        (node.go:1200-1234)."""
+        (node.go:1200-1234).
+
+        Resume extension: when a dest announced checkpointed partial
+        coverage for a layer, the solver plans over its *remaining* bytes
+        and the resulting jobs are mapped back through the gap list, so a
+        resumed transfer re-sends only what's missing."""
         self_jobs: FlowJobsMap = {}
         modified: Assignment = {}
+        # layer -> uncovered [start, end) ranges, for partially-held layers.
+        gaps_by_layer: Dict[LayerID, list] = {}
         with self._lock:
             # Size every layer from announced metadata — the leader need not
             # hold a layer to schedule it (its own layers are in status too).
@@ -677,6 +751,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 for layer_id, meta in layer_metas.items():
                     if meta.data_size > 0:
                         layer_sizes[layer_id] = meta.data_size
+            solver_sizes = dict(layer_sizes)
             for dest, layer_ids in self.assignment.items():
                 for layer_id, meta in layer_ids.items():
                     if layer_id not in layer_sizes:
@@ -686,21 +761,54 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         self_jobs.setdefault(dest, []).append(
                             FlowJob(dest, layer_id, layer_sizes[layer_id], 0)
                         )
-                    else:
-                        modified.setdefault(dest, {})[layer_id] = meta
+                        continue
+                    info = self.partial_status.get(dest, {}).get(layer_id)
+                    if info:
+                        covered = [(int(s), int(e)) for s, e in info["Covered"]]
+                        gaps = intervals.complement(covered, int(info["Total"]))
+                        remaining = intervals.covered(gaps)
+                        if remaining <= 0:
+                            continue  # fully covered; receiver will re-ack
+                        gaps_by_layer[layer_id] = gaps
+                        solver_sizes[layer_id] = remaining
+                        log.info("resuming partial layer", layer=layer_id,
+                                 dest=dest, remaining=remaining,
+                                 total=info["Total"])
+                    modified.setdefault(dest, {})[layer_id] = meta
             if not modified:
                 log.info("No jobs to assign other than self-assignment")
                 return 0, self_jobs, {}
             t0 = time.monotonic()
             graph = make_flow_graph(
-                modified, self.status, layer_sizes, self.node_network_bw
+                modified, self.status, solver_sizes, self.node_network_bw
             )
             t, jobs = graph.get_job_assignment()
+        if gaps_by_layer:
+            jobs = self._remap_resumed_jobs(jobs, gaps_by_layer)
         log.info(
             "Job assignment completed",
             computation_ms=round((time.monotonic() - t0) * 1000, 3),
         )
         return t, self_jobs, jobs
+
+    @staticmethod
+    def _remap_resumed_jobs(
+        jobs: FlowJobsMap, gaps_by_layer: Dict[LayerID, list]
+    ) -> FlowJobsMap:
+        """Translate jobs planned over remaining-space into absolute byte
+        ranges (one job may split across several gaps)."""
+        out: FlowJobsMap = {}
+        for sender, job_list in jobs.items():
+            for job in job_list:
+                gaps = gaps_by_layer.get(job.layer_id)
+                if gaps is None:
+                    out.setdefault(sender, []).append(job)
+                    continue
+                for off, size in map_through_gaps(gaps, job.offset, job.data_size):
+                    out.setdefault(sender, []).append(
+                        FlowJob(sender, job.layer_id, size, off)
+                    )
+        return out
 
     def _dispatch(self, min_time: int, self_jobs: FlowJobsMap, jobs: FlowJobsMap) -> None:
         """Send every flow job as a rate-budgeted command

@@ -42,6 +42,7 @@ from ..transport.messages import (
 )
 from ..utils import intervals
 from ..utils.logging import log
+from .checkpoint import LayerCheckpointStore
 from .failure import HeartbeatSender
 from .node import MessageLoop, Node
 from .send import fetch_from_client, handle_flow_retransmit, send_layer
@@ -93,8 +94,17 @@ class ReceiverNode:
                 for lid, src in self.layers.items()
             }
         next_hop = self.node.get_next_hop(self.node.leader_id)
-        self.node.transport.send(next_hop, AnnounceMsg(self.node.my_id, layer_ids))
+        self.node.transport.send(
+            next_hop,
+            AnnounceMsg(self.node.my_id, layer_ids,
+                        partial=self._announce_partial()),
+        )
         self.heartbeat.start()
+
+    def _announce_partial(self) -> dict:
+        """Checkpointed in-progress coverage to include in the announce;
+        the base receiver has none."""
+        return {}
 
     def ready(self) -> "queue.Queue[object]":
         return self._ready_q
@@ -154,11 +164,41 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
     (node.go:1487-1589)."""
 
     def __init__(self, node: Node, layers: LayersSrc, storage_path: str = ".",
-                 start_loop: bool = True, heartbeat_interval: float = 0.0):
+                 start_loop: bool = True, heartbeat_interval: float = 0.0,
+                 checkpoint_dir: str = ""):
+        """``checkpoint_dir``: when set, every fragment is journaled there
+        and partial layers survive a process restart (resume support —
+        absent in the reference, whose partial accounting dies with the
+        process, node.go:1542-1554)."""
         # layer -> (reassembly buffer, disjoint covered [start, end) ranges)
         self._partial: Dict[int, Tuple[bytearray, list]] = {}
+        self._partial_total: Dict[int, int] = {}
+        self.ckpt = LayerCheckpointStore(checkpoint_dir) if checkpoint_dir else None
+        if self.ckpt is not None:
+            for lid, (buf, covered, total) in self.ckpt.load().items():
+                if intervals.covered(covered) >= total:
+                    # Crashed between assembly and journal cleanup: done.
+                    layers[lid] = LayerSrc(
+                        inmem_data=buf, data_size=total,
+                        meta=LayerMeta(location=LayerLocation.INMEM),
+                    )
+                    self.ckpt.complete(lid)
+                else:
+                    self._partial[lid] = (buf, covered)
+                    self._partial_total[lid] = total
         super().__init__(node, layers, storage_path, start_loop=start_loop,
                          heartbeat_interval=heartbeat_interval)
+
+    def _announce_partial(self) -> dict:
+        with self._lock:
+            return {
+                lid: {
+                    "Total": self._partial_total[lid],
+                    "Covered": [list(iv) for iv in covered],
+                }
+                for lid, (_, covered) in self._partial.items()
+                if lid in self._partial_total
+            }
 
     def _register_handlers(self) -> None:
         super()._register_handlers()
@@ -192,6 +232,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     covered, frag.offset, frag.offset + frag.data_size
                 )
                 self._partial[msg.layer_id] = (buf, covered)
+                self._partial_total[msg.layer_id] = msg.total_size
+                if self.ckpt is not None:
+                    self.ckpt.write_fragment(
+                        msg.layer_id, frag.offset, data, covered, msg.total_size
+                    )
                 received = intervals.covered(covered)
                 log.info(
                     "layer fragment stored",
@@ -205,6 +250,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                         meta=LayerMeta(location=LayerLocation.INMEM),
                     )
                     del self._partial[msg.layer_id]
+                    self._partial_total.pop(msg.layer_id, None)
+                    if self.ckpt is not None:
+                        self.ckpt.complete(msg.layer_id)
                     log.info("layer fully received", layer=msg.layer_id,
                              total_bytes=msg.total_size)
         if not complete:

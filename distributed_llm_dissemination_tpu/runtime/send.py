@@ -25,6 +25,13 @@ from ..utils.logging import log
 from ..utils.rate import TokenBucket
 from .node import Node
 
+# Flow jobs are sent as sub-fragments of at most this many bytes (the
+# reference streams a job as one blob, node.go:1592-1607).  Bounded
+# fragments give receivers incremental progress: each one advances the
+# interval accounting and the durable checkpoint journal, so a transfer
+# killed mid-job loses at most one fragment, not the whole job.
+FLOW_FRAGMENT_BYTES = 16 << 20
+
 
 def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc) -> None:
     """Send one full layer to ``dest``; client-held layers are fetched via
@@ -69,21 +76,25 @@ def handle_flow_retransmit(
     node.add_node(msg.dest_id)
 
     if layer.meta.location in (LayerLocation.INMEM, LayerLocation.DISK):
-        partial = LayerSrc(
-            inmem_data=layer.inmem_data,
-            fp=layer.fp,
-            data_size=msg.data_size,
-            offset=msg.offset,
-            meta=LayerMeta(
-                location=layer.meta.location,
-                limit_rate=msg.rate,
-                source_type=layer.meta.source_type,
-            ),
-        )
-        node.transport.send(
-            msg.dest_id,
-            LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size),
-        )
+        sent = 0
+        while sent < msg.data_size:
+            n = min(FLOW_FRAGMENT_BYTES, msg.data_size - sent)
+            partial = LayerSrc(
+                inmem_data=layer.inmem_data,
+                fp=layer.fp,
+                data_size=n,
+                offset=msg.offset + sent,
+                meta=LayerMeta(
+                    location=layer.meta.location,
+                    limit_rate=msg.rate,
+                    source_type=layer.meta.source_type,
+                ),
+            )
+            node.transport.send(
+                msg.dest_id,
+                LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size),
+            )
+            sent += n
     elif layer.meta.location == LayerLocation.CLIENT:
         def _simulate_client_fetch() -> None:
             if layer.inmem_data is not None:

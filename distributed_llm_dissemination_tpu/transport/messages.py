@@ -44,21 +44,38 @@ class MsgType(enum.IntEnum):
 
 @dataclasses.dataclass
 class AnnounceMsg:
-    """Receiver → leader: my initial layers + metadata (message.go:31-58)."""
+    """Receiver → leader: my initial layers + metadata (message.go:31-58).
+
+    ``partial`` is an extension the reference doesn't have: covered byte
+    ranges of checkpointed in-progress layers,
+    ``{layer_id: {"Total": n, "Covered": [[s, e), ...]}}`` — the mode-3
+    leader schedules only the gaps (checkpoint/resume)."""
 
     src_id: NodeID
     layer_ids: LayerIDs
+    partial: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.ANNOUNCE
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id, "LayerIDs": layer_ids_to_json(self.layer_ids)}
+        payload = {
+            "SrcID": self.src_id,
+            "LayerIDs": layer_ids_to_json(self.layer_ids),
+        }
+        if self.partial:
+            payload["Partial"] = {
+                str(lid): info for lid, info in self.partial.items()
+            }
+        return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "AnnounceMsg":
         return cls(
             src_id=int(d["SrcID"]),
             layer_ids=layer_ids_from_json(d.get("LayerIDs") or {}),
+            partial={
+                int(lid): info for lid, info in (d.get("Partial") or {}).items()
+            },
         )
 
 
