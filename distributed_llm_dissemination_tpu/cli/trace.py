@@ -1,0 +1,142 @@
+"""Export merged node logs as a Chrome/Perfetto trace.
+
+The reference's only "trace viewer" is jq post-processing of merged JSON
+logs (``/root/reference/conf/collect_logs.sh:14-16``); this tool turns
+the same log stream into the Chrome Trace Event Format, so a whole
+dissemination run — per-layer receives, per-job sends, solver time,
+crashes, resume points — renders as a timeline in ``chrome://tracing``
+or https://ui.perfetto.dev.
+
+Mapping:
+- one **process row per node** (the ``node`` field);
+- log records carrying a duration (layer receives ``duration_ms``, job
+  sends ``send_dur_ms``, flow solves ``computation_ms``) become complete
+  ("X") slices, laid out on a per-layer track;
+- lifecycle markers (timer start/stop, crash declarations, resume
+  events) become instant ("i") events;
+- reassembly progress (``layer fragment stored``) becomes a per-layer
+  counter ("C") track.
+
+Usage:
+    python -m distributed_llm_dissemination_tpu.cli.trace logs/ -o run.trace.json
+    python -m ....trace merged.jsonl            # from collect_logs output
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterable, List
+
+from .collect_logs import iter_records
+
+# message -> (slice name, duration field)
+_DURATION_RULES = {
+    "(a fraction of) layer received": ("receive layer", "duration_ms"),
+    "finished sending layer": ("send layer", "send_dur_ms"),
+    "Job assignment completed": ("flow solve", "computation_ms"),
+}
+
+_INSTANT_MESSAGES = {
+    "timer start",
+    "timer stop: startup",
+    "node declared crashed",
+    "declared-dead node announced again; reviving",
+    "node re-announced; re-planning",
+    "resuming partial layer",
+    "restored partial layer from checkpoint",
+    "steal a job",
+    "job assignment",
+    "job completed",
+    "layer fully received",
+    "received startup: ready",
+}
+
+
+def _layer_of(rec: dict):
+    for key in ("layerID", "layer"):
+        if key in rec:
+            return rec[key]
+    return None
+
+
+def to_trace_events(records: Iterable[dict]) -> List[dict]:
+    events: List[dict] = []
+    seen_pids = set()
+    for rec in records:
+        msg = rec.get("message")
+        t = rec.get("time")
+        if msg is None or not isinstance(t, (int, float)):
+            continue
+        pid = rec.get("node", "?")
+        ts_us = t * 1000.0  # unix-ms -> µs
+        layer = _layer_of(rec)
+        tid = int(layer) if layer is not None else 0
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            events.append({
+                "ph": "M", "pid": pid, "name": "process_name",
+                "args": {"name": f"node {pid}"},
+            })
+
+        rule = _DURATION_RULES.get(msg)
+        if rule is not None:
+            name, dur_field = rule
+            dur_ms = rec.get(dur_field)
+            if isinstance(dur_ms, (int, float)):
+                events.append({
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": tid,
+                    "name": f"{name} {layer}" if layer is not None else name,
+                    "ts": ts_us - dur_ms * 1000.0,  # log records the end
+                    "dur": dur_ms * 1000.0,
+                    "args": {k: v for k, v in rec.items()
+                             if k not in ("message", "time", "level")},
+                })
+                continue
+        if msg == "layer fragment stored":
+            events.append({
+                "ph": "C",
+                "pid": pid,
+                "name": f"layer {layer} bytes",
+                "ts": ts_us,
+                "args": {"received": rec.get("received", 0)},
+            })
+            continue
+        if msg in _INSTANT_MESSAGES:
+            events.append({
+                "ph": "i",
+                "pid": pid,
+                "tid": tid,
+                "name": msg,
+                "ts": ts_us,
+                "s": "p",  # process-scoped marker
+                "args": {k: v for k, v in rec.items()
+                         if k not in ("message", "time", "level")},
+            })
+    events.sort(key=lambda e: e.get("ts", 0))
+    return events
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="trace", description=__doc__)
+    p.add_argument("paths", nargs="+", help="log files or directories")
+    p.add_argument("-o", "--output", default="-",
+                   help="trace JSON output (default: stdout)")
+    args = p.parse_args(argv)
+
+    events = to_trace_events(iter_records(args.paths))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if args.output == "-":
+        json.dump(doc, sys.stdout)
+    else:
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        print(f"{len(events)} trace events -> {args.output}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,7 +95,15 @@ class LayerCheckpointStore:
                 with open(self._part(layer_id), "rb") as f:
                     for s, e in covered:
                         f.seek(s)
-                        buf[s:e] = f.read(e - s)
+                        chunk = f.read(e - s)
+                        if len(chunk) != e - s:
+                            # Truncated .part (disk full, partial copy):
+                            # a short slice assignment would silently
+                            # SHRINK the buffer — corrupt restore.
+                            raise ValueError(
+                                f"part file truncated: wanted [{s}, {e})"
+                            )
+                        buf[s:e] = chunk
             except (OSError, ValueError, KeyError) as e:
                 log.warn("dropping unreadable checkpoint", layer=layer_id,
                          err=repr(e))

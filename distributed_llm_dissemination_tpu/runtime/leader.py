@@ -109,6 +109,9 @@ class LeaderNode:
         # node -> {layer: {"Total": n, "Covered": [[s, e], ...]}} from
         # announces of checkpoint-resuming receivers.
         self.partial_status: Dict[NodeID, dict] = {}
+        # Assignments dropped by crash(), kept so a declared-dead node that
+        # restarts and re-announces gets its layers back (resume).
+        self._dropped_assignment: Dict[NodeID, LayerIDs] = {}
         self.detector = FailureDetector(failure_timeout, self.crash)
         # Seed the liveness leases so a node that dies before ever
         # announcing is still detected (its lease simply expires).  Never
@@ -187,12 +190,25 @@ class LeaderNode:
             self.detector.revive(msg.src_id)
         self.detector.touch(msg.src_id)
         with self._lock:
-            reannounce = self._started and msg.src_id in self.status
+            # Any announce after the start needs a re-plan — whether the
+            # node restarted (was in status) or returns from the dead
+            # (crash() popped its row).
+            reannounce = self._started
             # Always refresh: an announce is the node's authoritative
             # current inventory (a pre-start restart must not leave a stale
             # row claiming layers the new incarnation lost).
             self.status[msg.src_id] = msg.layer_ids
             self.node.add_node(msg.src_id)
+            dropped = self._dropped_assignment.pop(msg.src_id, None)
+            if dropped and not self._startup_sent:
+                # The node was declared crashed and its assignment dropped;
+                # it's back, so it gets its layers back.
+                self._restore_assignment(msg.src_id, dropped)
+                log.info("restored dropped assignment for returned node",
+                         node=msg.src_id, layers=sorted(dropped))
+            elif dropped:
+                log.warn("node returned after distribution finished; its "
+                         "dropped assignment stays dropped", node=msg.src_id)
             if msg.partial:
                 # Checkpointed in-progress coverage (resume extension);
                 # mode 3 schedules only the complement.
@@ -221,6 +237,10 @@ class LeaderNode:
         """Re-drive delivery for a restarted node; mode 2 overrides (its
         job table needs surgical repair, not a wholesale re-run)."""
         self._recover()
+
+    def _restore_assignment(self, node_id: NodeID, layers: LayerIDs) -> None:
+        """Re-admit a previously dropped assignee (called under _lock)."""
+        self.assignment[node_id] = layers
 
     def send_layers(self) -> None:
         """Leader sends every missing assigned layer itself
@@ -306,6 +326,10 @@ class LeaderNode:
         with self._lock:
             self.status.pop(node_id, None)
             dropped = self.assignment.pop(node_id, None)
+            if dropped:
+                # Remembered so a restarted incarnation that re-announces
+                # gets its layers back (resume after declared death).
+                self._dropped_assignment[node_id] = dropped
             self.expected_nodes.discard(node_id)
             started = self._started
         if dropped:
@@ -464,10 +488,28 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
     def _on_reannounce(self, node_id: NodeID) -> None:
         """Rebuild jobs for a restarted assignee's still-missing layers
         (its in-flight transfers died with the old process) and kick the
-        chosen senders."""
+        chosen senders.  Jobs the restarted node was *sending* also died
+        with it: those are reset to PENDING (kept with the node if it
+        still owns the layer, orphaned otherwise) and re-driven."""
         kicked: Set[NodeID] = set()
+        orphaned = False
         with self._lock:
             self._build_layer_owners()
+            for layer_id, dests in self.jobs.items():
+                for dest, job in dests.items():
+                    if job.sender != node_id or job.status != _JobInfo.SENDING:
+                        continue
+                    job.t_start = None
+                    job.status = _JobInfo.PENDING
+                    if layer_id in self.status.get(node_id, {}):
+                        # Still owns it (e.g. disk layer): re-drive there.
+                        self.sender_load[node_id] = (
+                            self.sender_load.get(node_id, 0) + 1
+                        )
+                        kicked.add(node_id)
+                    else:
+                        job.sender = None  # _recover reassigns orphans
+                        orphaned = True
             held = self.status.get(node_id, {})
             for layer_id in self.assignment.get(node_id, {}):
                 meta = held.get(layer_id)
@@ -489,6 +531,8 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                 self.jobs.setdefault(layer_id, {})[node_id] = _JobInfo(sender)
                 self.sender_load[sender] = self.sender_load.get(sender, 0) + 1
                 kicked.add(sender)
+        if orphaned:
+            self._recover()
         for sender in kicked:
             self.loop.submit(self._assign_new_job_safe, sender)
 
@@ -721,6 +765,11 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 lid: d for lid, d in self.layer_dests.items() if d != node_id
             }
         super().crash(node_id)
+
+    def _restore_assignment(self, node_id: NodeID, layers: LayerIDs) -> None:
+        super()._restore_assignment(node_id, layers)
+        for layer_id in layers:
+            self.layer_dests[layer_id] = node_id
 
     def _register_handlers(self) -> None:
         super()._register_handlers()

@@ -1,0 +1,40 @@
+"""In-process span instrumentation over the structured log stream.
+
+The reference profiles exclusively through timestamped logs
+(``/root/reference/distributor/node.go:1168-1186`` et al.); ``span``
+standardizes that idiom: a context manager that logs completion with a
+``duration_ms`` field, which ``cli/trace.py`` renders as a timeline
+slice.  Zero infrastructure — the logs stay the single source of truth,
+merged across hosts by ``cli/collect_logs.py`` exactly like the
+reference's jq pipeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from .logging import log
+
+
+@contextlib.contextmanager
+def span(name: str, **fields):
+    """Time a block and log it as a trace-friendly completion record::
+
+        with span("stage layer", layerID=3):
+            ...
+
+    emits ``{"message": "stage layer", "layerID": 3, "duration_ms": ...}``.
+    The record is logged even when the block raises (with ``error`` set),
+    so traces show failed work instead of omitting it.
+    """
+    t0 = time.monotonic()
+    try:
+        yield
+    except BaseException as e:
+        log.error(name, duration_ms=round((time.monotonic() - t0) * 1000, 3),
+                  error=repr(e), **fields)
+        raise
+    else:
+        log.info(name, duration_ms=round((time.monotonic() - t0) * 1000, 3),
+                 **fields)

@@ -151,6 +151,77 @@ def test_resume_after_restart_sends_only_gaps(tmp_path):
         close_all(leader, [seeder, resumed], ts)
 
 
+def test_declared_dead_assignee_resumes_on_return(tmp_path):
+    """An assignee is declared crashed (assignment dropped), then a
+    restarted incarnation re-announces with checkpointed coverage: the
+    leader must restore its assignment, plan only the gaps, and still
+    reach ready for the full original assignment."""
+    size = 8192
+    data = layer_bytes(0, size)
+    ids = [0, 1, 3, 4]
+    ts, registry = make_transports("inmem", ids)
+    assignment = {3: {1: LayerMeta()}, 4: {0: LayerMeta()}}
+    bw = {i: 10_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]),
+        {0: mem_layer(0, size), 1: mem_layer(1, size)},
+        assignment, bw, expected_nodes={1, 3, 4},
+    )
+    seeder = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]), {0: mem_layer(0, size), 1: mem_layer(1, size)}
+    )
+    r3 = FlowRetransmitReceiverNode(Node(3, 0, ts[3]), {})
+    # Phase-1 assignee: builds checkpointed partial coverage, then "dies".
+    dead = FlowRetransmitReceiverNode(Node(4, 0, ts[4]), {},
+                                      start_loop=False,
+                                      checkpoint_dir=str(tmp_path))
+    dead.handle_layer(_fragment(0, data, 0, 3000, size))
+    try:
+        import time as _time
+
+        seeder.announce()
+        dead.announce()
+        # Wait for the announce to be handled, then drive the crash the
+        # detector would deliver on timeout.  The distribution hasn't
+        # started (r3 hasn't announced yet).
+        deadline = _time.monotonic() + TIMEOUT
+        while 4 not in leader.status and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        leader.crash(4)
+        assert 4 not in leader.assignment
+
+        # Restarted incarnation on the same checkpoint dir.
+        dead.close()
+        ts[4].close()
+        ts4b = type(ts[4])("n4", addr_registry=registry)
+        revived = FlowRetransmitReceiverNode(Node(4, 0, ts4b), {},
+                                             checkpoint_dir=str(tmp_path))
+        assert 0 in revived._partial
+        revived.announce()
+        deadline = _time.monotonic() + TIMEOUT
+        while 4 not in leader.assignment and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert 4 in leader.assignment  # restored on return
+        r3.announce()  # last holdout: distribution starts now
+
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == assignment  # full, restored assignment
+        assert bytes(revived.layers[0].inmem_data) == data
+        assert bytes(r3.layers[1].inmem_data) == layer_bytes(1, size)
+        revived.close()
+        ts4b.close()
+    finally:
+        close_all(leader, [seeder, r3], ts)
+
+
+def test_checkpoint_load_rejects_truncated_part(tmp_path):
+    store = LayerCheckpointStore(str(tmp_path))
+    store.write_fragment(5, 0, b"y" * 100, [(0, 100)], 100)
+    with open(tmp_path / "5.part", "r+b") as f:
+        f.truncate(40)  # simulate disk-full / partial copy
+    assert LayerCheckpointStore(str(tmp_path)).load() == {}
+
+
 def test_resume_plan_covers_only_remaining_bytes(tmp_path):
     # Direct scheduling check: with announced partial coverage, the jobs
     # the leader computes tile exactly the gaps.

@@ -86,6 +86,92 @@ def test_collect_logs_cli(tmp_path, capsys):
     assert "time to deliver: 25" in capsys.readouterr().err
 
 
+# -------------------------------------------------------------------- trace
+
+
+def test_trace_events_from_logs(tmp_path):
+    from distributed_llm_dissemination_tpu.cli import trace
+
+    _writelog(tmp_path / "run.jsonl", [
+        {"level": "info", "time": 2000, "node": "0", "message": "timer start"},
+        {"level": "info", "time": 2500, "node": "1", "layerID": 3,
+         "duration_ms": 400.0, "layer_size": 1000, "total_size": 1000,
+         "message": "(a fraction of) layer received"},
+        {"level": "info", "time": 2500, "node": "1", "layerID": 3,
+         "received": 1000, "total": 1000, "message": "layer fragment stored"},
+        {"level": "info", "time": 2600, "node": "0", "layer": 3, "dest": 1,
+         "send_dur_ms": 500.0, "message": "finished sending layer"},
+        {"level": "info", "time": 2700, "node": "0",
+         "message": "timer stop: startup"},
+        {"level": "info", "time": 2800, "node": "0", "message": "ignored noise"},
+    ])
+    events = trace.to_trace_events(collect_logs.iter_records([str(tmp_path)]))
+
+    slices = [e for e in events if e["ph"] == "X"]
+    assert {s["name"] for s in slices} == {"receive layer 3", "send layer 3"}
+    recv = next(s for s in slices if s["name"] == "receive layer 3")
+    # End-time log rebased to start: ts = (2500 - 400) ms in µs.
+    assert recv["ts"] == (2500 - 400) * 1000.0
+    assert recv["dur"] == 400 * 1000.0
+    assert recv["pid"] == "1" and recv["tid"] == 3
+
+    instants = {e["name"] for e in events if e["ph"] == "i"}
+    assert {"timer start", "timer stop: startup"} <= instants
+    assert "ignored noise" not in instants
+
+    counters = [e for e in events if e["ph"] == "C"]
+    assert counters and counters[0]["args"]["received"] == 1000
+
+    # Process-name metadata for every node that appears.
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert names == {"node 0", "node 1"}
+
+    # Sorted by timestamp — viewers require monotone input.
+    ts = [e["ts"] for e in events if "ts" in e]
+    assert ts == sorted(ts)
+
+
+def test_trace_cli_writes_valid_json(tmp_path, capsys):
+    from distributed_llm_dissemination_tpu.cli import trace
+
+    _writelog(tmp_path / "run.jsonl", [
+        {"time": 1000, "node": "0", "message": "timer start"},
+    ])
+    out = tmp_path / "run.trace.json"
+    rc = trace.main([str(tmp_path / "run.jsonl"), "-o", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    assert any(e["name"] == "timer start" for e in doc["traceEvents"])
+
+
+def test_span_logs_duration():
+    import io
+
+    import pytest as _pytest
+
+    from distributed_llm_dissemination_tpu.utils.logging import log
+    from distributed_llm_dissemination_tpu.utils.trace import span
+
+    buf = io.StringIO()
+    old_stream = log.stream
+    log.stream = buf
+    try:
+        with span("unit work", layerID=7):
+            pass
+        rec = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert rec["message"] == "unit work" and rec["layerID"] == 7
+        assert rec["duration_ms"] >= 0
+
+        with _pytest.raises(ValueError):
+            with span("failing work"):
+                raise ValueError("boom")
+        rec = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert rec["level"] == "error" and "boom" in rec["error"]
+    finally:
+        log.stream = old_stream
+
+
 # ----------------------------------------------------------- shipped configs
 
 
