@@ -27,7 +27,9 @@ import jax.numpy as jnp
 from jax import lax
 
 BASELINE_GBPS = 1.5625  # 12.5 Gbit/s reference NetworkBW, conf/config.json
-ROUNDS = 30
+# Enough rounds that the one-time dispatch/fetch latency of the driver's
+# TPU relay (~100 ms) is amortized below ~3% of the measured span.
+ROUNDS = 300
 PARTS = 8
 TRIALS = 3
 
@@ -44,9 +46,9 @@ def main() -> None:
     @jax.jit
     def reassemble_layers(frags):
         def round_body(r, prev):
-            # Chain on the previous layer so no round can be elided.
-            rb = prev[0] * 0 + r.astype(jnp.bfloat16)
-            return frags.reshape(total) + rb
+            # True data dependence on the previous layer's bytes (not a
+            # zeroed-out pseudo-chain), so no round can be elided.
+            return frags.reshape(total) + prev[0]
 
         return lax.fori_loop(
             0, ROUNDS, round_body, jnp.zeros((total,), jnp.bfloat16)

@@ -80,7 +80,12 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
                 "args": {"name": f"node {pid}"},
             })
 
+        # Known duration-carrying messages get curated slice names; any
+        # other record with a duration_ms field (e.g. emitted by
+        # utils.trace.span) becomes a slice named by its message.
         rule = _DURATION_RULES.get(msg)
+        if rule is None and isinstance(rec.get("duration_ms"), (int, float)):
+            rule = (msg, "duration_ms")
         if rule is not None:
             name, dur_field = rule
             dur_ms = rec.get(dur_field)

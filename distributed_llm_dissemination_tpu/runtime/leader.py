@@ -184,16 +184,20 @@ class LeaderNode:
         row is refreshed (delivered-to-RAM layers died with it; surviving
         state arrives via the announce itself, checkpointed partials
         included) and the scheduler re-plans its missing layers."""
-        if self.detector.is_dead(msg.src_id):
+        was_dead = self.detector.is_dead(msg.src_id)
+        if was_dead:
             log.warn("declared-dead node announced again; reviving",
                      node=msg.src_id)
             self.detector.revive(msg.src_id)
         self.detector.touch(msg.src_id)
         with self._lock:
-            # Any announce after the start needs a re-plan — whether the
-            # node restarted (was in status) or returns from the dead
-            # (crash() popped its row).
-            reannounce = self._started
+            # A re-plan is only for a node the run already knew: one that
+            # restarted (still in status), or one returning from the dead
+            # (crash() popped its row / dropped its assignment).  A brand-
+            # new late announcer must NOT re-drive in-flight transfers.
+            known = (msg.src_id in self.status or was_dead
+                     or msg.src_id in self._dropped_assignment)
+            reannounce = self._started and known
             # Always refresh: an announce is the node's authoritative
             # current inventory (a pre-start restart must not leave a stale
             # row claiming layers the new incarnation lost).
