@@ -732,9 +732,13 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
     """Mode 3: globally optimal plan via time-parameterized max-flow
     (node.go:1076-1288).
 
-    Like the reference, only one destination per layer is supported
-    (node.go:1078); lifting this requires per-(layer, dest) flow
-    decomposition."""
+    Unlike the reference, which supports only one destination per layer
+    (node.go:1078, error at :1092), the flow graph here models a vertex
+    per (layer, dest) pair, so one layer can be scheduled to any number
+    of receivers — each needing its own full copy — with per-sender
+    contributions still exactly attributable (PP-stage replication needs
+    this).  Crash recovery needs no dest bookkeeping: the re-plan derives
+    everything from assignment + status."""
 
     def __init__(
         self,
@@ -746,34 +750,10 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         expected_nodes: Optional[Set[NodeID]] = None,
         failure_timeout: float = 0.0,
     ):
-        self.layer_dests: Dict[LayerID, NodeID] = {}
-        for dest, layer_ids in assignment.items():
-            for layer_id in layer_ids:
-                if layer_id in self.layer_dests:
-                    log.error("a layer assigned to multiple dests", layerID=layer_id)
-                else:
-                    self.layer_dests[layer_id] = dest
         self.node_network_bw = dict(node_network_bw)
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
                          failure_timeout=failure_timeout)
-
-    def crash(self, node_id: NodeID) -> None:
-        """Drop routes to a dead assignee, then let the base re-plan: the
-        inherited ``_recover`` re-runs ``send_layers``, and ``assign_jobs``
-        already skips delivered layers, so the new flow plan covers exactly
-        the undelivered remainder (receivers reassemble by byte range, so
-        overlapping re-sends are harmless)."""
-        with self._lock:
-            self.layer_dests = {
-                lid: d for lid, d in self.layer_dests.items() if d != node_id
-            }
-        super().crash(node_id)
-
-    def _restore_assignment(self, node_id: NodeID, layers: LayerIDs) -> None:
-        super()._restore_assignment(node_id, layers)
-        for layer_id in layers:
-            self.layer_dests[layer_id] = node_id
 
     def _register_handlers(self) -> None:
         super()._register_handlers()
@@ -794,8 +774,10 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         resumed transfer re-sends only what's missing."""
         self_jobs: FlowJobsMap = {}
         modified: Assignment = {}
-        # layer -> uncovered [start, end) ranges, for partially-held layers.
-        gaps_by_layer: Dict[LayerID, list] = {}
+        # (layer, dest) -> uncovered [start, end) ranges, for resumes.
+        gaps_by_pair: Dict[Tuple[LayerID, NodeID], list] = {}
+        # (layer, dest) -> remaining bytes to plan for.
+        remaining_sizes: Dict[Tuple[LayerID, NodeID], int] = {}
         with self._lock:
             # Size every layer from announced metadata — the leader need not
             # hold a layer to schedule it (its own layers are in status too).
@@ -804,7 +786,6 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 for layer_id, meta in layer_metas.items():
                     if meta.data_size > 0:
                         layer_sizes[layer_id] = meta.data_size
-            solver_sizes = dict(layer_sizes)
             for dest, layer_ids in self.assignment.items():
                 for layer_id, meta in layer_ids.items():
                     if layer_id not in layer_sizes:
@@ -812,7 +793,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         continue
                     if layer_id in self.status.get(dest, {}):
                         self_jobs.setdefault(dest, []).append(
-                            FlowJob(dest, layer_id, layer_sizes[layer_id], 0)
+                            FlowJob(dest, layer_id, layer_sizes[layer_id], 0,
+                                    dest)
                         )
                         continue
                     info = self.partial_status.get(dest, {}).get(layer_id)
@@ -822,8 +804,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         remaining = intervals.covered(gaps)
                         if remaining <= 0:
                             continue  # fully covered; receiver will re-ack
-                        gaps_by_layer[layer_id] = gaps
-                        solver_sizes[layer_id] = remaining
+                        gaps_by_pair[(layer_id, dest)] = gaps
+                        remaining_sizes[(layer_id, dest)] = remaining
                         log.info("resuming partial layer", layer=layer_id,
                                  dest=dest, remaining=remaining,
                                  total=info["Total"])
@@ -833,11 +815,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 return 0, self_jobs, {}
             t0 = time.monotonic()
             graph = make_flow_graph(
-                modified, self.status, solver_sizes, self.node_network_bw
+                modified, self.status, layer_sizes, self.node_network_bw,
+                remaining=remaining_sizes,
             )
             t, jobs = graph.get_job_assignment()
-        if gaps_by_layer:
-            jobs = self._remap_resumed_jobs(jobs, gaps_by_layer)
+        if gaps_by_pair:
+            jobs = self._remap_resumed_jobs(jobs, gaps_by_pair)
         log.info(
             "Job assignment completed",
             computation_ms=round((time.monotonic() - t0) * 1000, 3),
@@ -846,20 +829,20 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
 
     @staticmethod
     def _remap_resumed_jobs(
-        jobs: FlowJobsMap, gaps_by_layer: Dict[LayerID, list]
+        jobs: FlowJobsMap, gaps_by_pair: Dict[Tuple[LayerID, NodeID], list]
     ) -> FlowJobsMap:
         """Translate jobs planned over remaining-space into absolute byte
         ranges (one job may split across several gaps)."""
         out: FlowJobsMap = {}
         for sender, job_list in jobs.items():
             for job in job_list:
-                gaps = gaps_by_layer.get(job.layer_id)
+                gaps = gaps_by_pair.get((job.layer_id, job.dest_id))
                 if gaps is None:
                     out.setdefault(sender, []).append(job)
                     continue
                 for off, size in map_through_gaps(gaps, job.offset, job.data_size):
                     out.setdefault(sender, []).append(
-                        FlowJob(sender, job.layer_id, size, off)
+                        FlowJob(sender, job.layer_id, size, off, job.dest_id)
                     )
         return out
 
@@ -880,10 +863,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 )
         for sender, job_list in jobs.items():
             for job in job_list:
-                dest = self.layer_dests.get(job.layer_id)
-                if dest is None:
-                    log.error("receiver not found", layerID=job.layer_id)
-                    continue
+                dest = job.dest_id
                 rate = job.data_size // max(1, min_time)
                 log.debug(
                     "dispatching a job",

@@ -34,12 +34,15 @@ _INF = 1 << 62
 
 @dataclasses.dataclass
 class FlowJob:
-    """One partial-layer send command (flow.go:30-39)."""
+    """One partial-layer send command (flow.go:30-39), extended with the
+    destination — the reference supports only one dest per layer
+    (node.go:1078); carrying the dest on the job lifts that."""
 
     sender_id: NodeID
     layer_id: LayerID
     data_size: int
     offset: int
+    dest_id: NodeID  # required: dispatch trusts it unconditionally
 
 
 # sender -> its jobs
@@ -48,10 +51,13 @@ FlowJobsMap = Dict[NodeID, List[FlowJob]]
 
 @dataclasses.dataclass(frozen=True)
 class _V:
-    """Flow-graph vertex key (flow.go:23-28)."""
+    """Flow-graph vertex key (flow.go:23-28).  Unlike the reference, a
+    "layer" vertex is per (layer, dest) pair — that is what lets one
+    layer be scheduled to multiple receivers (each needing its own full
+    copy) while per-sender flows stay attributable."""
 
     kind: str  # source | sender | class | layer | receiver | sink
-    node_id: NodeID = 0
+    node_id: NodeID = 0  # sender/receiver id; for "layer": the dest
     layer_id: LayerID = 0
     source_type: int = 0
 
@@ -67,16 +73,26 @@ class FlowGraph:
         status: Status,
         layer_sizes: Dict[LayerID, int],
         node_network_bw: Dict[NodeID, int],
+        remaining: Optional[Dict[Tuple[LayerID, NodeID], int]] = None,
     ):
+        """``remaining``: optional per-(layer, dest) byte overrides — a
+        resumed dest needs only its gap bytes, not the full layer."""
         self.assignment = assignment
         self.status = status
         self.layer_sizes = layer_sizes
         self.node_network_bw = node_network_bw
+        self.remaining = remaining or {}
 
-        self.needed_layers = sorted(
-            {lid for layers in assignment.values() for lid in layers}
+        # (layer, dest) pairs to deliver; dests_of inverts them so sender
+        # edges can fan a held layer out to every receiver that wants it.
+        self.pairs = sorted(
+            (lid, dest)
+            for dest, layers in assignment.items()
+            for lid in layers
         )
-        needed = set(self.needed_layers)
+        self.dests_of: Dict[LayerID, List[NodeID]] = {}
+        for lid, dest in self.pairs:
+            self.dests_of.setdefault(lid, []).append(dest)
 
         self.idx: Dict[_V, int] = {}
 
@@ -90,8 +106,8 @@ class FlowGraph:
         for node_id in sorted(status):
             for st in sorted({int(m.source_type) for m in status[node_id].values()}):
                 add(_V("class", node_id=node_id, source_type=st))
-        for layer_id in self.needed_layers:
-            add(_V("layer", layer_id=layer_id))
+        for layer_id, dest in self.pairs:
+            add(_V("layer", layer_id=layer_id, node_id=dest))
         for node_id in sorted(assignment):
             add(_V("receiver", node_id=node_id))
         add(_V("sink"))
@@ -100,7 +116,6 @@ class FlowGraph:
         # The O(n^2) matrix is only needed by the Python solver; allocated
         # lazily in _build so NativeFlowGraph never pays for it.
         self.cap: Optional[List[List[int]]] = None
-        self._needed = needed
 
     # ------------------------------------------------------------- capacities
 
@@ -109,6 +124,10 @@ class FlowGraph:
             return limit_rate * t
         # Unlimited source class: NIC bandwidth is the real ceiling.
         return self.node_network_bw.get(node_id, 0) * t
+
+    def _pair_size(self, layer_id: LayerID, dest: NodeID) -> int:
+        """Bytes still needed by ``dest`` for ``layer_id``."""
+        return self.remaining.get((layer_id, dest), self.layer_sizes[layer_id])
 
     def _build(self, t: int) -> None:
         """(Re)build edge capacities for candidate time t (flow.go:221-270)."""
@@ -125,27 +144,31 @@ class FlowGraph:
             sender = self.idx[_V("sender", node_id=node_id)]
             self.cap[src][sender] = self.node_network_bw.get(node_id, 0) * t
             for layer_id, meta in layer_metas.items():
-                if layer_id not in self._needed:
+                dests = self.dests_of.get(layer_id, ())
+                if not dests:
                     continue
                 cls = self.idx[
-                    _V("class", node_id=node_id, source_type=int(meta.source_type))
+                    _V("class", node_id=node_id,
+                       source_type=int(meta.source_type))
                 ]
-                layer = self.idx[_V("layer", layer_id=layer_id)]
                 # Rates are a property of the source class (reference
-                # config.go:26); if per-layer metadata disagrees, take the
-                # max so the rule is deterministic (not dict-order).
+                # config.go:26); if per-layer metadata disagrees, take
+                # the max so the rule is deterministic (not dict-order).
                 self.cap[sender][cls] = max(
                     self.cap[sender][cls],
                     self._class_capacity(node_id, meta.limit_rate, t),
                 )
-                # One layer may feed multiple receivers; don't cap here.
-                self.cap[cls][layer] = _INF
+                for dest in dests:
+                    layer = self.idx[
+                        _V("layer", layer_id=layer_id, node_id=dest)
+                    ]
+                    self.cap[cls][layer] = _INF
 
         for node_id, layer_ids in self.assignment.items():
             receiver = self.idx[_V("receiver", node_id=node_id)]
             for layer_id in layer_ids:
-                layer = self.idx[_V("layer", layer_id=layer_id)]
-                self.cap[layer][receiver] = self.layer_sizes[layer_id]
+                layer = self.idx[_V("layer", layer_id=layer_id, node_id=node_id)]
+                self.cap[layer][receiver] = self._pair_size(layer_id, node_id)
             self.cap[receiver][sink] = self.node_network_bw.get(node_id, 0) * t
 
     # --------------------------------------------------------------- max-flow
@@ -195,11 +218,7 @@ class FlowGraph:
     def get_job_assignment(self) -> Tuple[int, FlowJobsMap]:
         """Minimum feasible completion time + per-sender byte-range jobs
         (flow.go:146-218)."""
-        required = sum(
-            self.layer_sizes[lid]
-            for layers in self.assignment.values()
-            for lid in layers
-        )
+        required = sum(self._pair_size(lid, dest) for lid, dest in self.pairs)
 
         t_upper = 1
         while self.max_flow(t_upper) < required:
@@ -220,25 +239,25 @@ class FlowGraph:
         self.max_flow(t)  # leave residuals for decomposition
 
         jobs: FlowJobsMap = {}
-        layer_offset: Dict[LayerID, int] = {}
+        pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
         for sender_id in sorted(self.status):
             for layer_id in sorted(self.status[sender_id]):
-                if layer_id not in self._needed:
-                    continue
                 meta = self.status[sender_id][layer_id]
                 cls = self.idx[
                     _V("class", node_id=sender_id, source_type=int(meta.source_type))
                 ]
-                layer = self.idx[_V("layer", layer_id=layer_id)]
-                # Residual reverse edge layer→class equals the flow pushed
-                # class→layer: the bytes this sender contributes.
-                flow = self.cap[layer][cls]
-                if flow > 0:
-                    offset = layer_offset.get(layer_id, 0)
-                    jobs.setdefault(sender_id, []).append(
-                        FlowJob(sender_id, layer_id, flow, offset)
-                    )
-                    layer_offset[layer_id] = offset + flow
+                for dest in self.dests_of.get(layer_id, ()):
+                    layer = self.idx[_V("layer", layer_id=layer_id, node_id=dest)]
+                    # Residual reverse edge layer→class equals the flow
+                    # pushed class→layer: the bytes this sender
+                    # contributes toward (layer, dest).
+                    flow = self.cap[layer][cls]
+                    if flow > 0:
+                        offset = pair_offset.get((layer_id, dest), 0)
+                        jobs.setdefault(sender_id, []).append(
+                            FlowJob(sender_id, layer_id, flow, offset, dest)
+                        )
+                        pair_offset[(layer_id, dest)] = offset + flow
 
         log.info("job assignment calculated", min_time_s=t)
         return t, jobs

@@ -35,16 +35,16 @@ class NativeFlowGraph(FlowGraph):
     """
 
     def _edge_list(self) -> Tuple[List[int], List[int], List[int], List[int],
-                                  Dict[Tuple[NodeID, LayerID], int]]:
+                                  Dict[Tuple[NodeID, LayerID, NodeID], int]]:
         """Edges as (u, v, cap_const, cap_per_t) arrays, plus the map from
-        (sender, layer) to its class→layer edge index — the edges whose
-        flow is read back as that sender's byte contribution
-        (flow.go:193-211)."""
+        (sender, layer, dest) to its class→layer edge index — the edges
+        whose flow is read back as that sender's byte contribution toward
+        that (layer, dest) pair (flow.go:193-211)."""
         eu: List[int] = []
         ev: List[int] = []
         const: List[int] = []
         per_t: List[int] = []
-        contrib: Dict[Tuple[NodeID, LayerID], int] = {}
+        contrib: Dict[Tuple[NodeID, LayerID, NodeID], int] = {}
         class_edge: Dict[Tuple[int, int], int] = {}
 
         src = self.idx[_V("source")]
@@ -57,13 +57,13 @@ class NativeFlowGraph(FlowGraph):
             const.append(0)
             per_t.append(self.node_network_bw.get(node_id, 0))
             for layer_id in sorted(self.status[node_id]):
-                if layer_id not in self._needed:
+                dests = self.dests_of.get(layer_id, ())
+                if not dests:
                     continue
                 meta = self.status[node_id][layer_id]
                 cls = self.idx[
                     _V("class", node_id=node_id, source_type=int(meta.source_type))
                 ]
-                layer = self.idx[_V("layer", layer_id=layer_id)]
                 # Class-edge rate: max across the class's layers, matching
                 # FlowGraph._build (rates belong to the source class).
                 # _class_capacity at t=1 is exactly the per-second rate.
@@ -77,19 +77,23 @@ class NativeFlowGraph(FlowGraph):
                 else:
                     i = class_edge[(sender, cls)]
                     per_t[i] = max(per_t[i], rate)
-                contrib[(node_id, layer_id)] = len(eu)
-                eu.append(cls)
-                ev.append(layer)
-                const.append(_INF)
-                per_t.append(0)
+                for dest in dests:
+                    layer = self.idx[
+                        _V("layer", layer_id=layer_id, node_id=dest)
+                    ]
+                    contrib[(node_id, layer_id, dest)] = len(eu)
+                    eu.append(cls)
+                    ev.append(layer)
+                    const.append(_INF)
+                    per_t.append(0)
 
         for node_id in sorted(self.assignment):
             receiver = self.idx[_V("receiver", node_id=node_id)]
             for layer_id in sorted(self.assignment[node_id]):
-                layer = self.idx[_V("layer", layer_id=layer_id)]
+                layer = self.idx[_V("layer", layer_id=layer_id, node_id=node_id)]
                 eu.append(layer)
                 ev.append(receiver)
-                const.append(self.layer_sizes[layer_id])
+                const.append(self._pair_size(layer_id, node_id))
                 per_t.append(0)
             eu.append(receiver)
             ev.append(sink)
@@ -103,11 +107,7 @@ class NativeFlowGraph(FlowGraph):
         if lib is None:
             return super().get_job_assignment()
 
-        required = sum(
-            self.layer_sizes[lid]
-            for layers in self.assignment.values()
-            for lid in layers
-        )
+        required = sum(self._pair_size(lid, dest) for lid, dest in self.pairs)
         eu, ev, const, per_t, contrib = self._edge_list()
         m = len(eu)
         a_eu = (ctypes.c_int32 * m)(*eu)
@@ -128,19 +128,20 @@ class NativeFlowGraph(FlowGraph):
                       required=required, achieved=achieved.value)
 
         jobs: FlowJobsMap = {}
-        layer_offset: Dict[LayerID, int] = {}
+        pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
         for sender_id in sorted(self.status):
             for layer_id in sorted(self.status[sender_id]):
-                edge = contrib.get((sender_id, layer_id))
-                if edge is None:
-                    continue
-                flow = flows[edge]
-                if flow > 0:
-                    offset = layer_offset.get(layer_id, 0)
-                    jobs.setdefault(sender_id, []).append(
-                        FlowJob(sender_id, layer_id, flow, offset)
-                    )
-                    layer_offset[layer_id] = offset + flow
+                for dest in self.dests_of.get(layer_id, ()):
+                    edge = contrib.get((sender_id, layer_id, dest))
+                    if edge is None:
+                        continue
+                    flow = flows[edge]
+                    if flow > 0:
+                        offset = pair_offset.get((layer_id, dest), 0)
+                        jobs.setdefault(sender_id, []).append(
+                            FlowJob(sender_id, layer_id, flow, offset, dest)
+                        )
+                        pair_offset[(layer_id, dest)] = offset + flow
 
         log.info(
             "job assignment calculated (native)",
@@ -155,8 +156,9 @@ def make_flow_graph(
     status: Status,
     layer_sizes: Dict[LayerID, int],
     node_network_bw: Dict[NodeID, int],
+    remaining=None,
 ) -> FlowGraph:
     """The fastest available mode-3 scheduler for this environment."""
-    if load_flow_solver() is not None:
-        return NativeFlowGraph(assignment, status, layer_sizes, node_network_bw)
-    return FlowGraph(assignment, status, layer_sizes, node_network_bw)
+    cls = FlowGraph if load_flow_solver() is None else NativeFlowGraph
+    return cls(assignment, status, layer_sizes, node_network_bw,
+               remaining=remaining)

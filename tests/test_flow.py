@@ -185,6 +185,71 @@ def test_native_matches_python_on_random_instances():
         check_tiling(jobs_nat, layer_sizes)
 
 
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_multi_dest_replication(solver):
+    # One layer assigned to TWO receivers (PP-stage replication) — the
+    # reference errors on this (node.go:1078, :1092).  One seeder at
+    # 100 B/s must send 2 x 100 B -> t = 2 s, with per-dest full copies.
+    g = solver(
+        assignment={1: {0: _meta()}, 2: {0: _meta()}},
+        status={0: {0: _meta(rate=100)}},
+        layer_sizes={0: 100},
+        node_network_bw={0: 200, 1: 100, 2: 100},
+    )
+    t, jobs = g.get_job_assignment()
+    assert t == 2
+    by_dest = {}
+    for js in jobs.values():
+        for j in js:
+            by_dest.setdefault(j.dest_id, []).append(j)
+    assert set(by_dest) == {1, 2}
+    for dest, chunks in by_dest.items():
+        spans = sorted((c.offset, c.offset + c.data_size) for c in chunks)
+        assert spans[0][0] == 0 and spans[-1][1] == 100
+
+
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_multi_dest_multi_sender_split(solver):
+    # Two seeders, two receivers, one 200-B layer each way: senders split
+    # each dest's copy; all four (sender, dest) flows are attributable.
+    g = solver(
+        assignment={2: {0: _meta()}, 3: {0: _meta()}},
+        status={0: {0: _meta(rate=100)}, 1: {0: _meta(rate=100)}},
+        layer_sizes={0: 200},
+        node_network_bw={0: 100, 1: 100, 2: 100, 3: 100},
+    )
+    t, jobs = g.get_job_assignment()
+    # 400 B total through 200 B/s of sender capacity -> t = 2 s.
+    assert t == 2
+    for dest in (2, 3):
+        chunks = [j for js in jobs.values() for j in js if j.dest_id == dest]
+        spans = sorted((c.offset, c.offset + c.data_size) for c in chunks)
+        assert spans[0][0] == 0 and spans[-1][1] == 200
+        for (_, e1), (s2, _) in zip(spans, spans[1:]):
+            assert e1 == s2
+
+
+@pytest.mark.parametrize("solver", SOLVERS)
+def test_remaining_override_plans_partial_bytes(solver):
+    # Resume support in the solver itself: dest 1 already holds 75 of the
+    # 100 bytes, dest 2 needs all 100 -> 125 B at 100 B/s -> t = 2
+    # (integer time), with dest 1 planned for exactly 25 bytes.
+    g = solver(
+        assignment={1: {0: _meta()}, 2: {0: _meta()}},
+        status={0: {0: _meta(rate=100)}},
+        layer_sizes={0: 100},
+        node_network_bw={0: 200, 1: 100, 2: 100},
+        remaining={(0, 1): 25},
+    )
+    t, jobs = g.get_job_assignment()
+    assert t == 2
+    sizes = {}
+    for js in jobs.values():
+        for j in js:
+            sizes[j.dest_id] = sizes.get(j.dest_id, 0) + j.data_size
+    assert sizes == {1: 25, 2: 100}
+
+
 @needs_native
 def test_native_pod_scale_schedule():
     """v5e-32-shaped instance: 31 seeders x 80 layers to one cold host.

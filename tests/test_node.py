@@ -214,6 +214,41 @@ def test_mode3_flow_distribution_multi_sender(kind):
 
 
 @pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_mode3_multi_dest_replication(kind):
+    # One layer set assigned to TWO cold receivers — PP-stage replication.
+    # The reference's mode 3 errors on this (node.go:1078, :1092); here
+    # the per-(layer, dest) flow graph delivers full copies to both.
+    ids = range(5)
+    ts, _ = make_transports(kind, ids)
+    size = 4096
+    assignment = {3: {i: LayerMeta() for i in range(2)},
+                  4: {i: LayerMeta() for i in range(2)}}
+    bw = {i: 10_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i, size) for i in range(2)},
+        assignment, bw,
+    )
+    seeders = [
+        FlowRetransmitReceiverNode(
+            Node(i, 0, ts[i]), {j: mem_layer(j, size) for j in range(2)}
+        )
+        for i in (1, 2)
+    ]
+    colds = [
+        FlowRetransmitReceiverNode(Node(i, 0, ts[i]), {}) for i in (3, 4)
+    ]
+    try:
+        exec_distribution(leader, seeders + colds, assignment)
+        for cold in colds:
+            for lid in range(2):
+                got = cold.layers[lid]
+                assert got.data_size == size
+                assert bytes(got.inmem_data) == layer_bytes(lid, size)
+    finally:
+        close_all(leader, seeders + colds, ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
 def test_mode0_client_source_pipe(kind):
     # Leader's layer 0 lives at an external client; delivery must flow
     # client -> leader (pipe) -> receiver.  Untested in the reference.
