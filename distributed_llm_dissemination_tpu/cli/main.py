@@ -64,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="receiver (mode 3): directory for durable partial-"
                         "layer checkpoints; a restarted receiver resumes "
                         "and only the missing byte ranges are re-sent")
+    p.add_argument("-hbm", action="store_true",
+                   help="receiver: stage each delivered layer into TPU HBM "
+                        "(jax.Array) before acking")
     return p
 
 
@@ -134,14 +137,17 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     """Receiver role (cmd/main.go:183-215)."""
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".",
-                                heartbeat_interval=args.hb)
+                                heartbeat_interval=args.hb,
+                                stage_hbm=args.hbm)
     elif args.m in (1, 2):
         receiver = RetransmitReceiverNode(node, layers, args.s or ".",
-                                          heartbeat_interval=args.hb)
+                                          heartbeat_interval=args.hb,
+                                          stage_hbm=args.hbm)
     else:
         receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
                                               heartbeat_interval=args.hb,
-                                              checkpoint_dir=args.ckpt)
+                                              checkpoint_dir=args.ckpt,
+                                              stage_hbm=args.hbm)
 
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "

@@ -113,12 +113,21 @@ class LayerSrc:
     # TPU-native: the layer materialized on device (jax.Array), if staged.
     device_array: object = None
 
+    def _host_resident(self) -> bool:
+        """Host bytes available?  True for INMEM, and for HBM-staged layers
+        whose host buffer was retained (staging keeps ``inmem_data``, so an
+        HBM layer can still be *served* to peers over the host transport)."""
+        return (
+            self.meta.location in (LayerLocation.INMEM, LayerLocation.HBM)
+            and self.inmem_data is not None
+        )
+
     def read_bytes(self) -> bytes:
         """This record's own bytes (a received fragment's buffer, or a full
         in-RAM layer).  For slicing a *source* store by offset/data_size use
         ``read_range`` — the two differ only for INMEM records, where this
         returns the whole buffer."""
-        if self.meta.location == LayerLocation.INMEM and self.inmem_data is not None:
+        if self._host_resident():
             return bytes(self.inmem_data)
         return self.read_range()
 
@@ -126,7 +135,7 @@ class LayerSrc:
         """The byte range ``[offset, offset+data_size)`` of this source
         store — what a transport actually puts on the wire.  ``offset``
         indexes into the full layer (RAM buffer or file)."""
-        if self.meta.location == LayerLocation.INMEM and self.inmem_data is not None:
+        if self._host_resident():
             return bytes(
                 memoryview(self.inmem_data)[self.offset : self.offset + self.data_size]
             )

@@ -1,0 +1,113 @@
+"""Flow schedules as compiled device collective programs.
+
+The bridge between the host scheduler and the TPU data plane: a mode-3
+plan is a set of per-sender byte-range jobs (``sched.flow.FlowJob``,
+reference flow.go:193-211); on a device mesh the same plan becomes ONE
+XLA collective — every seeder device contributes exactly its planned
+byte range and a tiled ``all_gather`` materializes the full layer on all
+devices over ICI.  This is the SPMD resolution of the reference's
+asymmetric event-driven protocol (SURVEY §7 "hard parts"): the leader
+computes the plan on the host control plane, then all participants enter
+the same compiled program.
+
+Unequal ranges are handled by padding each contribution to the plan's
+largest range; the re-splice back to the contiguous layer happens
+on-device with static slice bounds (sizes are compile-time constants of
+the program), so XLA fuses it with the gather epilogue.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..sched.flow import FlowJob
+
+
+def plan_layout(jobs: Sequence[FlowJob]) -> List[Tuple[int, int, int]]:
+    """Validate + order one layer's jobs into a device layout.
+
+    Returns ``[(sender_id, offset, size), ...]`` sorted by offset: device
+    rank i on the mesh axis carries sender ``layout[i][0]``'s byte range,
+    which is how a caller maps ``fragment_bytes[i]`` to the seeder that
+    holds those bytes.  Raises if the ranges don't tile a contiguous
+    ``[0, total)`` — a malformed plan must fail loudly before any device
+    work is launched."""
+    spans = sorted((j.offset, j.data_size, j.sender_id) for j in jobs)
+    layout = []
+    pos = 0
+    for off, size, sender_id in spans:
+        if off != pos:
+            raise ValueError(
+                f"plan does not tile: expected offset {pos}, got {off}"
+            )
+        layout.append((sender_id, off, size))
+        pos += size
+    return layout
+
+
+@functools.lru_cache(maxsize=64)
+def _gather_program(mesh: Mesh, axis: str, sizes: Tuple[int, ...]):
+    """Compiled: each device holds its padded fragment; one tiled gather +
+    static re-splice yields the full layer replicated everywhere."""
+
+    def per_device(frag):
+        g = lax.all_gather(frag, axis)  # (n, pad)
+        parts = [lax.slice(g[i], (0,), (sizes[i],)) for i in range(len(sizes))]
+        return jnp.concatenate(parts)
+
+    @jax.jit
+    def run(v):
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=P(axis), out_specs=P(),
+            check_vma=False,
+        )(v)
+
+    return run
+
+
+def execute_flow_plan(
+    jobs: Sequence[FlowJob],
+    fragment_bytes: Sequence[bytes],
+    mesh: Mesh,
+    axis: str,
+    dtype=jnp.uint8,
+) -> jax.Array:
+    """Run one layer's flow plan as a single device collective.
+
+    ``fragment_bytes[i]`` is the byte range of the i-th job in
+    ``plan_layout`` order (what that seeder would have sent over TCP).
+    The number of jobs must not exceed the mesh axis size; idle devices
+    contribute zero-size ranges.  Returns the full layer, replicated on
+    every device of the mesh, as a 1-D array of ``dtype``."""
+    layout = plan_layout(jobs)
+    n = mesh.shape[axis]
+    if len(layout) > n:
+        raise ValueError(f"{len(layout)} fragments > {n} devices on '{axis}'")
+    itemsize = np.dtype(dtype).itemsize
+    sizes = [size // itemsize for _, _, size in layout]
+    if any(size % itemsize for _, _, size in layout):
+        raise ValueError(f"fragment sizes must be multiples of {itemsize}")
+    sizes += [0] * (n - len(layout))  # idle devices
+    pad = max(sizes)
+
+    devices = mesh.devices.reshape(-1)
+    shards = []
+    for rank in range(n):
+        buf = np.zeros(pad, dtype=dtype)
+        if rank < len(layout):
+            frag = np.frombuffer(fragment_bytes[rank], dtype=dtype)
+            buf[: sizes[rank]] = frag
+        shards.append(jax.device_put(buf, devices[rank]))
+    global_shape = (n * pad,)
+    v = jax.make_array_from_single_device_arrays(
+        global_shape, NamedSharding(mesh, P(axis)), shards
+    )
+    return _gather_program(mesh, axis, tuple(sizes))(v)

@@ -62,10 +62,24 @@ class ReceiverNode:
         storage_path: str = ".",
         start_loop: bool = True,
         heartbeat_interval: float = 0.0,
+        stage_hbm: bool = False,
     ):
+        """``stage_hbm``: stage each delivered layer into device HBM (a
+        jax.Array) before acking — the TPU-native terminal state; the
+        reference stops at host RAM (node.go:435-446)."""
         self.node = node
         self.layers = layers
         self.storage_path = storage_path
+        self.stage_hbm = stage_hbm
+        # Eager when enabled: handlers run on a 16-worker pool, so a lazy
+        # check-then-set would race; raw byte blobs stage as uint8 so
+        # odd-length layers round-trip exactly (bf16 would pad a byte).
+        self._mover = None
+        if stage_hbm:
+            import numpy as _np
+
+            from ..parallel.mover import WeightMover
+            self._mover = WeightMover(dtype=_np.uint8)
         self._ready_q: "queue.Queue[object]" = queue.Queue()
         self._lock = threading.Lock()
         self.heartbeat = HeartbeatSender(
@@ -113,6 +127,23 @@ class ReceiverNode:
         self.heartbeat.stop()
         self.loop.stop()
 
+    def _stage_to_hbm(self, layer_id, src) -> "LayerLocation":
+        """Move a completed layer host→HBM when enabled; returns the
+        location to ack with.  jax is imported lazily so host-only nodes
+        never pay for it."""
+        if not self.stage_hbm:
+            return LayerLocation.INMEM
+        if src.meta.location == LayerLocation.HBM:
+            return LayerLocation.HBM  # a re-plan duplicate: already staged
+        try:
+            self._mover.stage(src)
+            log.info("layer staged to HBM", layerID=layer_id)
+            return LayerLocation.HBM
+        except Exception as e:  # noqa: BLE001 — delivery beats staging
+            log.error("HBM staging failed; acking host RAM",
+                      layerID=layer_id, err=repr(e))
+            return LayerLocation.INMEM
+
     def handle_layer(self, msg: LayerMsg) -> None:
         """Store to RAM, ack the leader (node.go:1354-1384)."""
         with self._lock:
@@ -121,10 +152,11 @@ class ReceiverNode:
             src.offset = 0
             self.layers[msg.layer_id] = src
         log.debug("saved layer in memory", layerID=msg.layer_id)
+        loc = self._stage_to_hbm(msg.layer_id, src)
         try:
             self.node.transport.send(
                 self.node.leader_id,
-                AckMsg(self.node.my_id, msg.layer_id, LayerLocation.INMEM),
+                AckMsg(self.node.my_id, msg.layer_id, loc),
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
@@ -165,7 +197,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
 
     def __init__(self, node: Node, layers: LayersSrc, storage_path: str = ".",
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
-                 checkpoint_dir: str = ""):
+                 checkpoint_dir: str = "", stage_hbm: bool = False):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -187,7 +219,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     self._partial[lid] = (buf, covered)
                     self._partial_total[lid] = total
         super().__init__(node, layers, storage_path, start_loop=start_loop,
-                         heartbeat_interval=heartbeat_interval)
+                         heartbeat_interval=heartbeat_interval,
+                         stage_hbm=stage_hbm)
 
     def _announce_partial(self) -> dict:
         with self._lock:
@@ -257,10 +290,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                              total_bytes=msg.total_size)
         if not complete:
             return
+        loc = self._stage_to_hbm(msg.layer_id, self.layers[msg.layer_id])
         try:
             self.node.transport.send(
                 self.node.leader_id,
-                AckMsg(self.node.my_id, msg.layer_id, LayerLocation.INMEM),
+                AckMsg(self.node.my_id, msg.layer_id, loc),
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))

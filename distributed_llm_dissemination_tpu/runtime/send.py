@@ -75,7 +75,11 @@ def handle_flow_retransmit(
         return
     node.add_node(msg.dest_id)
 
-    if layer.meta.location in (LayerLocation.INMEM, LayerLocation.DISK):
+    # An HBM-staged layer with its host buffer retained serves like INMEM.
+    send_loc = layer.meta.location
+    if send_loc == LayerLocation.HBM and layer.inmem_data is not None:
+        send_loc = LayerLocation.INMEM
+    if send_loc in (LayerLocation.INMEM, LayerLocation.DISK):
         sent = 0
         while sent < msg.data_size:
             n = min(FLOW_FRAGMENT_BYTES, msg.data_size - sent)
@@ -85,7 +89,7 @@ def handle_flow_retransmit(
                 data_size=n,
                 offset=msg.offset + sent,
                 meta=LayerMeta(
-                    location=layer.meta.location,
+                    location=send_loc,
                     limit_rate=msg.rate,
                     source_type=layer.meta.source_type,
                 ),

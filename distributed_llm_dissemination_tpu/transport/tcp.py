@@ -314,7 +314,9 @@ class TcpTransport(Transport):
             },
         )
 
-        if src.meta.location == LayerLocation.INMEM and src.inmem_data is not None:
+        # HBM-staged layers keep their host buffer and serve like INMEM.
+        if (src.meta.location in (LayerLocation.INMEM, LayerLocation.HBM)
+                and src.inmem_data is not None):
             data = memoryview(src.inmem_data)[src.offset : src.offset + src.data_size]
             if src.meta.limit_rate > 0:
                 log.debug(

@@ -173,6 +173,96 @@ def test_assemble_fragments_multi_sender(mesh):
     np.testing.assert_array_equal(np.asarray(out), full)
 
 
+def test_execute_flow_plan_device_collective(mesh):
+    # A mode-3 plan (uneven byte-range jobs) executed as ONE device
+    # collective: every device ends up with the full layer.
+    from distributed_llm_dissemination_tpu.parallel.plan import (
+        execute_flow_plan,
+        plan_layout,
+    )
+    from distributed_llm_dissemination_tpu.sched.flow import FlowJob
+
+    total = 1000
+    layer = np.arange(total, dtype=np.uint8)
+    sizes = [300, 500, 200]  # uneven, fewer jobs than devices
+    jobs, off = [], 0
+    for i, size in enumerate(sizes):
+        jobs.append(FlowJob(i + 1, 0, size, off, 9))
+        off += size
+    frags = [layer[o : o + s].tobytes() for _, o, s in plan_layout(jobs)]
+
+    out = execute_flow_plan(jobs, frags, mesh, "nodes")
+    assert out.shape == (total,)
+    np.testing.assert_array_equal(np.asarray(out), layer)
+    # Replicated: every device holds the whole layer.
+    assert len(out.sharding.device_set) == 8
+
+
+def test_plan_layout_rejects_gaps():
+    from distributed_llm_dissemination_tpu.parallel.plan import plan_layout
+    from distributed_llm_dissemination_tpu.sched.flow import FlowJob
+
+    with pytest.raises(ValueError):
+        plan_layout([FlowJob(1, 0, 100, 0, 9), FlowJob(2, 0, 100, 150, 9)])
+
+
+def test_receiver_stage_hbm_acks_hbm_location():
+    # A mode-0 receiver with stage_hbm lands the layer as a jax.Array and
+    # acks LayerLocation.HBM.
+    from distributed_llm_dissemination_tpu.runtime import Node, ReceiverNode
+    from distributed_llm_dissemination_tpu.transport import (
+        InmemTransport,
+        reset_registry,
+    )
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AckMsg,
+        LayerMsg,
+    )
+    from distributed_llm_dissemination_tpu.core.types import LayerSrc
+
+    reset_registry()
+    try:
+        registry = {0: "hbm_l", 1: "hbm_r"}
+        tl = InmemTransport("hbm_l", addr_registry=registry)
+        tr = InmemTransport("hbm_r", addr_registry=registry)
+        recv = ReceiverNode(Node(1, 0, tr), {}, start_loop=False,
+                            stage_hbm=True)
+        payload = bytes(range(256)) * 8
+        recv.handle_layer(LayerMsg(
+            0, 5,
+            LayerSrc(inmem_data=bytearray(payload), data_size=len(payload),
+                     meta=LayerMeta(location=LayerLocation.INMEM)),
+            len(payload),
+        ))
+        src = recv.layers[5]
+        assert src.meta.location == LayerLocation.HBM
+        assert isinstance(src.device_array, jax.Array)
+        ack = tl.deliver().get_nowait()
+        assert isinstance(ack, AckMsg) and ack.location == LayerLocation.HBM
+        recv.close()
+        tl.close()
+        tr.close()
+    finally:
+        reset_registry()
+
+
+def test_hbm_staged_layer_still_serves_as_source():
+    # After staging, the host buffer is retained: an HBM-located layer
+    # must still be readable for retransmission to peers.
+    from distributed_llm_dissemination_tpu.core.types import LayerSrc
+
+    payload = bytes(range(256)) * 4 + b"x"  # odd length: uint8 round-trip
+    src = LayerSrc(inmem_data=bytearray(payload), data_size=len(payload),
+                   meta=LayerMeta(location=LayerLocation.INMEM))
+    mover = WeightMover(dtype=np.uint8)
+    mover.stage(src)
+    assert src.meta.location == LayerLocation.HBM
+    assert array_to_bytes(src.device_array) == payload  # exact round-trip
+    assert src.read_bytes() == payload  # host serve path intact
+    src.offset, src.data_size = 3, 100
+    assert src.read_range() == payload[3:103]
+
+
 def test_split_offsets_tiling():
     spans = split_offsets(10, 3)
     assert spans == [(0, 4), (4, 3), (7, 3)]
