@@ -191,12 +191,15 @@ class LeaderNode:
             self.detector.revive(msg.src_id)
         self.detector.touch(msg.src_id)
         with self._lock:
-            # A re-plan is only for a node the run already knew: one that
-            # restarted (still in status), or one returning from the dead
-            # (crash() popped its row / dropped its assignment).  A brand-
-            # new late announcer must NOT re-drive in-flight transfers.
+            # A re-plan is only for a node the run already has business
+            # with: one that restarted (still in status), one returning
+            # from the dead (crash() popped its row / dropped its
+            # assignment), or an assignee added by update() that hadn't
+            # announced yet.  A brand-new late announcer with no assigned
+            # layers must NOT re-drive in-flight transfers.
             known = (msg.src_id in self.status or was_dead
-                     or msg.src_id in self._dropped_assignment)
+                     or msg.src_id in self._dropped_assignment
+                     or msg.src_id in self.assignment)
             reannounce = self._started and known
             # Always refresh: an announce is the node's authoritative
             # current inventory (a pre-start restart must not leave a stale
@@ -245,6 +248,53 @@ class LeaderNode:
     def _restore_assignment(self, node_id: NodeID, layers: LayerIDs) -> None:
         """Re-admit a previously dropped assignee (called under _lock)."""
         self.assignment[node_id] = layers
+
+    def update(self, assignment: Assignment) -> None:
+        """Re-target the distribution to a new goal state — the
+        reference's never-implemented ``update(assignment)``
+        (node.go:215-217).
+
+        Declarative semantics: the new assignment wholly replaces the old
+        one.  Already-delivered layers are not re-sent; missing ones are
+        scheduled; if the new goal adds work after ``ready`` already
+        fired, the completion cycle re-arms and ``ready()`` delivers
+        again once the new goal is met."""
+        with self._lock:
+            self.assignment = assignment
+            self._dropped_assignment.clear()
+            if self._started:
+                # Re-arm: every update() answers with its own ready event,
+                # immediate when the new goal is already met.
+                self._startup_sent = False
+        # New assignees that haven't announced get liveness leases, so one
+        # that never shows up is still detected (as in __init__'s seeding).
+        for node_id in assignment:
+            if node_id != self.node.my_id and node_id not in self.status:
+                self.detector.touch(node_id)
+        log.info("assignment updated", dests=sorted(assignment))
+        self._drive(self._update_replan)
+
+    def _update_replan(self) -> None:
+        """Schedule the new goal's missing deliveries; mode 2 overrides
+        (its live job table needs incremental repair, not a rebuild)."""
+        self._recover()
+
+    def _drive(self, replan) -> None:
+        """The shared goal-chasing tail of crash()/update(): start if the
+        change unblocked the start, finish if the goal is already met,
+        otherwise run the supplied re-planner."""
+        with self._lock:
+            started = self._started
+        if not started:
+            if self._maybe_start():
+                self.send_layers()
+                self._maybe_finish()
+            return
+        self._maybe_finish()
+        with self._lock:
+            finished = self._startup_sent
+        if not finished:
+            replan()
 
     def send_layers(self) -> None:
         """Leader sends every missing assigned layer itself
@@ -335,22 +385,10 @@ class LeaderNode:
                 # gets its layers back (resume after declared death).
                 self._dropped_assignment[node_id] = dropped
             self.expected_nodes.discard(node_id)
-            started = self._started
         if dropped:
             log.error("crashed node was an assignee; dropping its layers",
                       node=node_id, layers=sorted(dropped))
-        if not started:
-            # Crash before start: the node may have been the last holdout —
-            # and the shrunk assignment may even be satisfied already.
-            if self._maybe_start():
-                self.send_layers()
-                self._maybe_finish()
-            return
-        self._maybe_finish()
-        with self._lock:
-            finished = self._startup_sent
-        if not finished:
-            self._recover()
+        self._drive(self._recover)
 
     def send_startup(self) -> None:
         with self._lock:
@@ -489,6 +527,44 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                     del self.jobs[layer_id]
         super().crash(node_id)
 
+    def _release_pending_load(self, job: "_JobInfo") -> None:
+        """Give back a superseded job's load slot (held only while
+        PENDING — a pull already decremented it).  Lock held."""
+        if job.status == _JobInfo.PENDING and job.sender is not None:
+            self.sender_load[job.sender] = max(
+                0, self.sender_load.get(job.sender, 1) - 1
+            )
+
+    def _schedule_missing_locked(
+        self, dest: NodeID, replace_existing: bool = False
+    ) -> Set[NodeID]:
+        """Create PENDING jobs for ``dest``'s undelivered assigned layers
+        and return the senders to kick.  Lock held.  With
+        ``replace_existing`` the dest's current jobs are superseded (a
+        restarted dest's in-flight transfers are dead)."""
+        kicked: Set[NodeID] = set()
+        for node_id in self.status:
+            self.sender_load.setdefault(node_id, 0)
+        held = self.status.get(dest, {})
+        for layer_id in self.assignment.get(dest, {}):
+            meta = held.get(layer_id)
+            if meta is not None and delivered(meta):
+                continue
+            old = self.jobs.get(layer_id, {}).get(dest)
+            if old is not None and not replace_existing:
+                continue  # already queued or in flight
+            sender = self._min_loaded_sender(layer_id)
+            if sender is None:
+                log.error("no owner for missing assigned layer",
+                          layer=layer_id, dest=dest)
+                continue
+            if old is not None:
+                self._release_pending_load(old)
+            self.jobs.setdefault(layer_id, {})[dest] = _JobInfo(sender)
+            self.sender_load[sender] = self.sender_load.get(sender, 0) + 1
+            kicked.add(sender)
+        return kicked
+
     def _on_reannounce(self, node_id: NodeID) -> None:
         """Rebuild jobs for a restarted assignee's still-missing layers
         (its in-flight transfers died with the old process) and kick the
@@ -514,29 +590,33 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                     else:
                         job.sender = None  # _recover reassigns orphans
                         orphaned = True
-            held = self.status.get(node_id, {})
-            for layer_id in self.assignment.get(node_id, {}):
-                meta = held.get(layer_id)
-                if meta is not None and delivered(meta):
-                    continue
-                sender = self._min_loaded_sender(layer_id)
-                if sender is None:
-                    log.error("no owner for restarted node's layer",
-                              layer=layer_id, dest=node_id)
-                    continue
-                # Release the superseded job's load slot (still held only
-                # while PENDING — a pull already decremented it).
-                old = self.jobs.get(layer_id, {}).get(node_id)
-                if (old is not None and old.status == _JobInfo.PENDING
-                        and old.sender is not None):
-                    self.sender_load[old.sender] = max(
-                        0, self.sender_load.get(old.sender, 1) - 1
-                    )
-                self.jobs.setdefault(layer_id, {})[node_id] = _JobInfo(sender)
-                self.sender_load[sender] = self.sender_load.get(sender, 0) + 1
-                kicked.add(sender)
+            kicked |= self._schedule_missing_locked(node_id,
+                                                    replace_existing=True)
         if orphaned:
             self._recover()
+        for sender in kicked:
+            self.loop.submit(self._assign_new_job_safe, sender)
+
+    def _update_replan(self) -> None:
+        """Incremental job-table repair for a changed assignment: prune
+        PENDING jobs whose dest is no longer assigned the layer (in-flight
+        SENDING jobs are left to finish — their acks re-kick the sender,
+        and receivers tolerate the extra delivery), create jobs for newly
+        missing (dest, layer) pairs, and kick their senders."""
+        kicked: Set[NodeID] = set()
+        with self._lock:
+            self._build_layer_owners()
+            for layer_id in list(self.jobs):
+                dests = self.jobs[layer_id]
+                for dest in list(dests):
+                    job = dests[dest]
+                    if (layer_id not in self.assignment.get(dest, {})
+                            and job.status == _JobInfo.PENDING):
+                        self._release_pending_load(dests.pop(dest))
+                if not dests:
+                    del self.jobs[layer_id]
+            for dest in self.assignment:
+                kicked |= self._schedule_missing_locked(dest)
         for sender in kicked:
             self.loop.submit(self._assign_new_job_safe, sender)
 

@@ -1,0 +1,152 @@
+"""Dynamic assignment updates — the reference's never-implemented
+``update(assignment)`` (node.go:215-217).
+
+Covers: adding work after ready already fired (the completion cycle
+re-arms and ready delivers again), an update that is already satisfied,
+and the mode-2 incremental job-table repair."""
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import LayerMeta
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    LeaderNode,
+    Node,
+    PullRetransmitLeaderNode,
+    ReceiverNode,
+    RetransmitReceiverNode,
+)
+from distributed_llm_dissemination_tpu.transport import reset_registry
+
+from test_node import close_all, layer_bytes, make_transports, mem_layer
+
+TIMEOUT = 10.0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def test_mode0_update_adds_work_and_refires_ready():
+    ids = [0, 1]
+    ts, _ = make_transports("inmem", ids)
+    first = {1: {0: LayerMeta()}}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i) for i in range(2)}, first
+    )
+    r1 = ReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        r1.announce()
+        assert leader.ready().get(timeout=TIMEOUT) == first
+
+        second = {1: {0: LayerMeta(), 1: LayerMeta()}}
+        leader.update(second)
+        assert leader.ready().get(timeout=TIMEOUT) == second
+        assert bytes(r1.layers[1].inmem_data) == layer_bytes(1)
+    finally:
+        close_all(leader, [r1], ts)
+
+
+def test_mode0_update_already_satisfied_fires_immediately():
+    ids = [0, 1]
+    ts, _ = make_transports("inmem", ids)
+    first = {1: {0: LayerMeta(), 1: LayerMeta()}}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i) for i in range(2)}, first
+    )
+    r1 = ReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        r1.announce()
+        assert leader.ready().get(timeout=TIMEOUT) == first
+        narrowed = {1: {0: LayerMeta()}}
+        leader.update(narrowed)  # subset of what's delivered
+        assert leader.ready().get(timeout=TIMEOUT) == narrowed
+    finally:
+        close_all(leader, [r1], ts)
+
+
+def test_mode2_update_incremental_jobs():
+    # Seeder r1 owns both layers; r2 initially gets layer 0 only, then an
+    # update adds layer 1 — served by a fresh job, not a table rebuild.
+    ids = [0, 1, 2]
+    ts, _ = make_transports("inmem", ids)
+    first = {2: {0: LayerMeta()}}
+    leader = PullRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, first, expected_nodes={1, 2}
+    )
+    r1 = RetransmitReceiverNode(
+        Node(1, 0, ts[1]), {i: mem_layer(i) for i in range(2)}
+    )
+    r2 = RetransmitReceiverNode(Node(2, 0, ts[2]), {})
+    try:
+        r1.announce()
+        r2.announce()
+        assert leader.ready().get(timeout=TIMEOUT) == first
+
+        second = {2: {0: LayerMeta(), 1: LayerMeta()}}
+        leader.update(second)
+        assert leader.ready().get(timeout=TIMEOUT) == second
+        assert bytes(r2.layers[1].inmem_data) == layer_bytes(1)
+    finally:
+        close_all(leader, [r1, r2], ts)
+
+
+@pytest.mark.parametrize("mode", ["m0", "m2"])
+def test_update_adds_assignee_that_announces_later(mode):
+    # update() targets a node that hasn't even announced yet; its eventual
+    # announce must trigger the delivery (the first sends fail — no route).
+    ids = [0, 1, 2]
+    ts, _ = make_transports("inmem", ids)
+    first = {1: {0: LayerMeta()}}
+    layers = {i: mem_layer(i) for i in range(2)}
+    if mode == "m0":
+        leader = LeaderNode(Node(0, 0, ts[0]), layers, first)
+        mk = ReceiverNode
+    else:
+        leader = PullRetransmitLeaderNode(Node(0, 0, ts[0]), layers, first)
+        mk = RetransmitReceiverNode
+    r1 = mk(Node(1, 0, ts[1]), {})
+    try:
+        r1.announce()
+        assert leader.ready().get(timeout=TIMEOUT) == first
+
+        second = {1: {0: LayerMeta()}, 2: {1: LayerMeta()}}
+        leader.update(second)  # node 2 hasn't announced yet
+        r2 = mk(Node(2, 0, ts[2]), {})
+        r2.announce()
+        assert leader.ready().get(timeout=TIMEOUT) == second
+        assert bytes(r2.layers[1].inmem_data) == layer_bytes(1)
+        r2.close()
+    finally:
+        close_all(leader, [r1], ts)
+
+
+def test_mode3_update_replans_flow():
+    ids = [0, 1, 2]
+    ts, _ = make_transports("inmem", ids)
+    size = 2048
+    bw = {i: 10_000_000 for i in ids}
+    first = {2: {0: LayerMeta()}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i, size) for i in range(2)},
+        first, bw, expected_nodes={1, 2},
+    )
+    r1 = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]), {i: mem_layer(i, size) for i in range(2)}
+    )
+    r2 = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {})
+    try:
+        r1.announce()
+        r2.announce()
+        assert leader.ready().get(timeout=TIMEOUT) == first
+
+        second = {2: {0: LayerMeta(), 1: LayerMeta()}}
+        leader.update(second)
+        assert leader.ready().get(timeout=TIMEOUT) == second
+        assert bytes(r2.layers[1].inmem_data) == layer_bytes(1, size)
+    finally:
+        close_all(leader, [r1, r2], ts)
