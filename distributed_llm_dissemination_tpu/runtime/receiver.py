@@ -41,6 +41,7 @@ from ..transport.messages import (
     StartupMsg,
 )
 from ..utils import intervals
+from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from .checkpoint import LayerCheckpointStore
 from .failure import HeartbeatSender
@@ -254,13 +255,20 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             else:
                 entry = self._partial.get(msg.layer_id)
                 if entry is None:
-                    # Allocate lazily — an eager dict.get default would
-                    # zero a full layer-sized buffer on *every* fragment.
-                    entry = (bytearray(msg.total_size), [])
+                    # Allocate lazily (an eager dict.get default would
+                    # build a full layer-sized buffer on *every* fragment)
+                    # and unzeroed (zero-fill would hold the GIL for
+                    # hundreds of ms at real layer sizes; coverage is
+                    # tracked by intervals, so unwritten bytes are never
+                    # exposed).
+                    entry = (alloc_recv_buffer(msg.total_size), [])
                 buf, covered = entry
                 frag = msg.layer_src
                 data = frag.read_bytes()
-                buf[frag.offset : frag.offset + frag.data_size] = data
+                # memoryview: the one right-hand side both ndarray buffers
+                # (which reject raw bytes) and checkpoint-restored
+                # bytearrays (which reject ndarrays) accept.
+                buf[frag.offset : frag.offset + frag.data_size] = memoryview(data)
                 covered = intervals.insert(
                     covered, frag.offset, frag.offset + frag.data_size
                 )

@@ -32,6 +32,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
+from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from ..utils.rate import PacedWriter
 from .base import AddrRegistry, Transport
@@ -185,7 +186,7 @@ class TcpTransport(Transport):
         t0 = time.monotonic()
 
         pipe = self._get_and_unregister_pipe(header.layer_id)
-        buf = bytearray(header.layer_size)
+        buf = alloc_recv_buffer(header.layer_size)
         view = memoryview(buf)
         if pipe is not None:
             # Cut-through relay: stream chunks to the downstream node while

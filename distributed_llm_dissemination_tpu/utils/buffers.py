@@ -1,0 +1,21 @@
+"""Receive-buffer allocation for the data plane.
+
+``bytearray(n)`` zeroes its memory inside a single C call — for a
+multi-hundred-MiB layer that is hundreds of milliseconds spent holding
+the GIL, which starves every other thread in the node process (the
+sender half of a relay, the control-plane loop) before the first byte is
+even received.  ``np.empty`` returns unfaulted pages immediately; the
+bytes are written exactly once by ``recv_into``/fragment writes, so the
+zero-fill was pure waste.  The array supports the full buffer protocol
+(slice assignment, ``memoryview``, ``bytes()``), so downstream LayerSrc
+handling is unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def alloc_recv_buffer(n: int) -> np.ndarray:
+    """An n-byte write-once receive buffer (unzeroed, instant)."""
+    return np.empty(n, np.uint8)
