@@ -133,21 +133,59 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     return 0
 
 
+def build_placement(args, conf: cfg.Config):
+    """The Assignment → pipeline-stage placement on the configured device
+    mesh (the ``Mesh`` config section), when HBM staging is on.  Without a
+    Mesh section, ``-hbm`` stages to the default device — the single-chip
+    degenerate case."""
+    if not args.hbm or conf.mesh is None:
+        return None
+    # Honor the standard JAX_PLATFORMS env var even where a site hook
+    # (e.g. the axon TPU plugin's sitecustomize) pre-set jax_platforms at
+    # interpreter start: the config can still be flipped before the first
+    # backend use, which happens right below.
+    import os as _os
+
+    import jax as _jax
+
+    want = _os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            _jax.config.update("jax_platforms", want)
+        except RuntimeError:
+            pass  # backend already initialized; leave as-is
+    from ..parallel.mesh import assignment_to_placement, mesh_from_conf
+
+    mesh = mesh_from_conf(conf.mesh)
+    placement = assignment_to_placement(
+        conf.assignment, mesh, conf.mesh.pipeline_axis
+    )
+    ulog.log.info(
+        "device mesh placement",
+        mesh={n: s for n, s in zip(conf.mesh.axis_names, conf.mesh.axis_sizes)},
+        stages={str(n): s for n, s in placement.node_to_stage.items()},
+    )
+    return placement
+
+
 def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     """Receiver role (cmd/main.go:183-215)."""
+    placement = build_placement(args, conf)
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".",
                                 heartbeat_interval=args.hb,
-                                stage_hbm=args.hbm)
+                                stage_hbm=args.hbm, placement=placement)
     elif args.m in (1, 2):
         receiver = RetransmitReceiverNode(node, layers, args.s or ".",
                                           heartbeat_interval=args.hb,
-                                          stage_hbm=args.hbm)
+                                          stage_hbm=args.hbm,
+                                          placement=placement)
     else:
         receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
                                               heartbeat_interval=args.hb,
                                               checkpoint_dir=args.ckpt,
-                                              stage_hbm=args.hbm)
+                                              stage_hbm=args.hbm,
+                                              placement=placement)
 
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "

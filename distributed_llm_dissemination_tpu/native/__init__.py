@@ -12,6 +12,7 @@ implementations — behavior is identical, only slower.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -22,10 +23,16 @@ from ..utils.logging import log
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "flow_solver.cc")
 _LIB = os.path.join(_DIR, "libflowsolver.so")
+_HASH = _LIB + ".srchash"  # content hash of the source the .so was built from
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
+
+
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
 
 
 def _build() -> None:
@@ -33,6 +40,7 @@ def _build() -> None:
     # atomic on POSIX, so concurrent node processes on one host never load
     # a partially written library.
     tmp = f"{_LIB}.{os.getpid()}.tmp"
+    htmp = f"{_HASH}.{os.getpid()}.tmp"
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC],
@@ -40,9 +48,13 @@ def _build() -> None:
             capture_output=True,
         )
         os.replace(tmp, _LIB)
+        with open(htmp, "w") as f:
+            f.write(_src_hash())
+        os.replace(htmp, _HASH)
     finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+        for leftover in (tmp, htmp):
+            if os.path.exists(leftover):
+                os.unlink(leftover)
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -70,23 +82,40 @@ def load_flow_solver() -> Optional[ctypes.CDLL]:
             return _lib
         if _load_failed:
             return None
-        # Try a pre-existing library first; if it fails to load (stale,
-        # wrong arch — mtimes don't survive git checkout, so they prove
-        # nothing), rebuild from source once before giving up.
+        # Use a pre-existing library only when its recorded source hash
+        # matches the current source (mtimes don't survive git checkout, so
+        # content hashing is the staleness check).  On missing/mismatched
+        # hash, rebuild — via atomic os.replace, never by deleting first,
+        # so a host without g++ keeps whatever library it has.
+        hash_known = False
         if os.path.exists(_LIB):
             try:
-                _lib = _bind(ctypes.CDLL(_LIB))
-                return _lib
+                with open(_HASH) as f:
+                    hash_known = True
+                    if f.read().strip() == _src_hash():
+                        try:
+                            _lib = _bind(ctypes.CDLL(_LIB))
+                            return _lib
+                        except OSError:
+                            pass  # wrong arch/corrupt: rebuild below
             except OSError:
-                try:
-                    os.unlink(_LIB)
-                except OSError:
-                    pass
+                pass  # no hash sidecar: provenance unknown, rebuild below
         try:
             _build()
             _lib = _bind(ctypes.CDLL(_LIB))
             return _lib
         except (OSError, subprocess.CalledProcessError) as e:
+            # Build impossible here (no g++?).  A library of unknown
+            # provenance is still better than the slow Python path —
+            # but a KNOWN-stale one (hash mismatch) is wrong code: skip it.
+            if os.path.exists(_LIB) and not hash_known:
+                try:
+                    _lib = _bind(ctypes.CDLL(_LIB))
+                    log.warn("using pre-built flow solver of unknown "
+                             "provenance (no g++ to rebuild)")
+                    return _lib
+                except OSError:
+                    pass
             _load_failed = True
             stderr = getattr(e, "stderr", b"")
             log.warn("native flow solver unavailable, using Python path",

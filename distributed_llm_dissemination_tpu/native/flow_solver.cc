@@ -60,9 +60,10 @@ struct Dinic {
     for (size_t h = 0; h < q.size(); ++h) {
       int32_t u = q[h];
       for (const Edge& e : graph[u]) {
+        // No early exit on reaching t: the full level graph is needed for
+        // each phase to compute a true blocking flow (the O(V^2 E) bound).
         if (e.cap > 0 && level[e.to] < 0) {
           level[e.to] = level[u] + 1;
-          if (e.to == t) return true;
           q.push_back(e.to);
         }
       }

@@ -1,0 +1,183 @@
+"""Incremental sharded HBM ingest: fragments land on-mesh as they arrive.
+
+The runtime-facing device plane for receivers.  The reference's terminal
+state is host RAM (``/root/reference/distributor/node.go:435-446``); the
+TPU-native terminal state is the layer replicated in the HBM of its
+pipeline stage's devices.  The naive way to get there — assemble on host,
+then ``device_put`` the full layer replicated — pays the host→device link
+``layer_size × n_devices`` bytes and only starts after the last network
+byte.  This module does it the TPU way:
+
+- The layer's byte range is tiled across the stage's devices (the same
+  offset/size shape as a mode-3 flow plan, flow.go:193-211).
+- Each arriving network fragment is cut against that tiling and each piece
+  is DMA'd to exactly ONE device, into a preallocated shard buffer at its
+  local offset (``lax.dynamic_update_slice`` under donation) — so PCIe
+  carries ``layer_size`` bytes total, overlapped with the network receive.
+- On completion, one tiled ``all_gather`` replicates the layer across the
+  stage over ICI — the fast fabric does the ×n, not the host link.
+
+``ingest_bytes`` is the one-shot form (whole buffer already on host) used
+by mode-0/1/2 receivers; it routes through
+``parallel.plan.execute_flow_plan`` with jobs synthesized by
+``ops.reassembly.split_offsets`` — i.e. the dissemination runtime executes
+its terminal hop as a flow plan on the mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.reassembly import _write_1d, split_offsets
+from ..sched.flow import FlowJob
+from ..utils import intervals
+from .plan import _gather_program, execute_flow_plan
+
+
+def flat_mesh(devices: Sequence[jax.Device], axis: str = "ingest") -> Mesh:
+    """A 1-axis mesh over an explicit device list (a stage's devices)."""
+    return Mesh(np.asarray(list(devices), dtype=object), (axis,))
+
+
+def synthesize_jobs(total_bytes: int, n: int, layer_id: int = 0) -> List[FlowJob]:
+    """An even byte-range tiling of a layer as FlowJobs — the shape a mode-3
+    plan has, for stages where the real per-seeder split isn't available."""
+    return [
+        FlowJob(sender_id=r, layer_id=layer_id, dest_id=0,
+                data_size=size, offset=off)
+        for r, (off, size) in enumerate(split_offsets(total_bytes, n))
+        if size > 0
+    ]
+
+
+def ingest_bytes(data, devices: Sequence[jax.Device]) -> jax.Array:
+    """One-shot sharded ingest: split ``data`` across ``devices`` (1/n of
+    the host→device traffic each) and all-gather over ICI so the full
+    layer lands replicated on all of them.  Returns a uint8 jax.Array."""
+    data = memoryview(data)
+    n = len(devices)
+    if n == 1:
+        return jax.device_put(np.frombuffer(data, dtype=np.uint8), devices[0])
+    if len(data) < n:
+        # Too small to tile one byte per device; still must land replicated
+        # on ALL the stage's devices (the documented contract).
+        return jax.device_put(
+            np.frombuffer(data, dtype=np.uint8),
+            NamedSharding(flat_mesh(devices), P()),
+        )
+    mesh = flat_mesh(devices)
+    jobs = synthesize_jobs(len(data), n)
+    frags = [bytes(data[j.offset : j.offset + j.data_size]) for j in jobs]
+    return execute_flow_plan(jobs, frags, mesh, "ingest", dtype=jnp.uint8)
+
+
+class ShardedLayerIngest:
+    """Incremental device ingest of one layer onto a device set.
+
+    Fragments arrive in any order with byte offsets (the mode-3 receive
+    path, node.go:1520-1567); ``write`` lands each piece on its span's
+    device immediately — overlapping HBM ingest with the network receive —
+    and ``finalize`` runs the gather collective once coverage is complete.
+
+    Thread-safe: the receiver's handler pool may deliver fragments
+    concurrently.  The ingest keeps its OWN byte-coverage intervals, and
+    ``finalize`` blocks until they cover the layer — so a completion
+    handler racing a sibling fragment handler (host coverage counted, device
+    write not yet executed) can never gather a buffer with holes.
+    """
+
+    def __init__(self, total_bytes: int, devices: Sequence[jax.Device]):
+        if total_bytes <= 0:
+            raise ValueError("empty layer")
+        self.total = total_bytes
+        self.devices = list(devices)
+        n = len(self.devices)
+        # One span per device; spans differ by <=1 byte, buffers are padded
+        # to the largest so the final gather is one tiled collective.
+        self.spans: List[Tuple[int, int]] = list(split_offsets(total_bytes, n))
+        self.pad = max(size for _, size in self.spans)
+        self._lock = threading.Lock()
+        self._complete = threading.Condition(self._lock)
+        self._covered: List[Tuple[int, int]] = []
+        self._failed = False
+        self._closed = False  # finalize ran: late duplicate writes no-op
+        # Zeros are created ON each device (no host materialization, no
+        # host->device transfer of bytes that are about to be overwritten).
+        self._bufs: List[jax.Array] = []
+        for d in self.devices:
+            with jax.default_device(d):
+                self._bufs.append(jnp.zeros(self.pad, dtype=jnp.uint8))
+
+    def write(self, offset: int, data) -> None:
+        """Cut ``data`` (bytes at absolute byte ``offset``) against the
+        device tiling; DMA each piece to its device's shard buffer."""
+        data = memoryview(data)
+        end = offset + len(data)
+        if offset < 0 or end > self.total:
+            raise ValueError(
+                f"fragment [{offset}, {end}) outside layer of {self.total} bytes"
+            )
+        with self._lock:
+            if self._closed:
+                # A late duplicate racing finalize: its bytes are already
+                # covered (finalize only runs at full coverage), and a
+                # donating write here would invalidate the buffers the
+                # gather is consuming.
+                return
+            for r, (s_off, s_size) in enumerate(self.spans):
+                lo = max(offset, s_off)
+                hi = min(end, s_off + s_size)
+                if lo >= hi:
+                    continue
+                piece = np.frombuffer(data[lo - offset : hi - offset], np.uint8)
+                dev_piece = jax.device_put(piece, self.devices[r])
+                self._bufs[r] = _write_1d(
+                    self._bufs[r], dev_piece, jnp.asarray(lo - s_off, jnp.int32)
+                )
+            self._covered = intervals.insert(self._covered, offset, end)
+            if intervals.covered(self._covered) >= self.total:
+                self._complete.notify_all()
+
+    def fail(self) -> None:
+        """Mark the ingest broken (a device write failed); wakes any
+        ``finalize`` waiter, which then raises so the caller falls back to
+        bulk staging."""
+        with self._lock:
+            self._failed = True
+            self._complete.notify_all()
+
+    def finalize(self, timeout: float = 120.0) -> jax.Array:
+        """All-gather the shard buffers into the full layer, replicated on
+        every device of the set.  Blocks until the ingest's own coverage is
+        complete (in-flight sibling writes), then gathers."""
+        with self._lock:
+            self._complete.wait_for(
+                lambda: self._failed
+                or intervals.covered(self._covered) >= self.total,
+                timeout=timeout,
+            )
+            self._closed = True  # any write from here on is a no-op
+            if self._failed:
+                raise RuntimeError("ingest failed; fall back to bulk staging")
+            if intervals.covered(self._covered) < self.total:
+                raise RuntimeError(
+                    f"ingest incomplete after {timeout}s: "
+                    f"{intervals.covered(self._covered)}/{self.total} bytes"
+                )
+            bufs = list(self._bufs)
+        if len(self.devices) == 1:
+            return bufs[0][: self.total]
+        mesh = flat_mesh(self.devices)
+        n = len(self.devices)
+        global_shape = (n * self.pad,)
+        v = jax.make_array_from_single_device_arrays(
+            global_shape, NamedSharding(mesh, P("ingest")), bufs
+        )
+        sizes = tuple(size for _, size in self.spans)
+        return _gather_program(mesh, "ingest", sizes)(v)

@@ -31,7 +31,9 @@ class LayerCheckpointStore:
     ``write_fragment`` is crash-ordered: bytes land in the ``.part`` file
     before the meta journal records them as covered, so a crash between
     the two writes only *under*-reports progress (the range is re-sent,
-    which interval reassembly absorbs) — never the fatal inverse.
+    which interval reassembly absorbs) — never the fatal inverse.  Both
+    writes are fsync'd, so the ordering holds across host power loss, not
+    just process crashes: the journal can never claim bytes the disk lost.
     """
 
     def __init__(self, directory: str):
@@ -60,9 +62,13 @@ class LayerCheckpointStore:
                 f.truncate(total)
             f.seek(offset)
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())  # data durable before the journal covers it
         tmp = self._meta(layer_id) + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"Total": total, "Covered": [list(iv) for iv in covered]}, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._meta(layer_id))  # atomic journal update
 
     def complete(self, layer_id: LayerID) -> None:

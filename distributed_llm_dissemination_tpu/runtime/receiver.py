@@ -64,14 +64,24 @@ class ReceiverNode:
         start_loop: bool = True,
         heartbeat_interval: float = 0.0,
         stage_hbm: bool = False,
+        placement=None,
     ):
         """``stage_hbm``: stage each delivered layer into device HBM (a
         jax.Array) before acking — the TPU-native terminal state; the
-        reference stops at host RAM (node.go:435-446)."""
+        reference stops at host RAM (node.go:435-446).
+
+        ``placement``: a ``parallel.mesh.StagePlacement`` (derived from the
+        Assignment + the config's Mesh section).  With it, a delivered
+        layer lands replicated on *its pipeline stage's* devices via the
+        sharded-ingest path (1/n host→device traffic per device + one ICI
+        all-gather) instead of on the default device — the staged-inference
+        layout the reference's startup hook presumes
+        (distributor/message.go:216-241)."""
         self.node = node
         self.layers = layers
         self.storage_path = storage_path
         self.stage_hbm = stage_hbm
+        self.placement = placement
         # Eager when enabled: handlers run on a 16-worker pool, so a lazy
         # check-then-set would race; raw byte blobs stage as uint8 so
         # odd-length layers round-trip exactly (bf16 would pad a byte).
@@ -83,6 +93,10 @@ class ReceiverNode:
             self._mover = WeightMover(dtype=_np.uint8)
         self._ready_q: "queue.Queue[object]" = queue.Queue()
         self._lock = threading.Lock()
+        # layer -> Event: staging-in-progress marker so a re-plan duplicate
+        # completing concurrently never double-stages a multi-GB layer
+        # (check-and-mark happens under self._lock; the duplicate waits).
+        self._hbm_staging: Dict[int, threading.Event] = {}
         self.heartbeat = HeartbeatSender(
             node.transport, node.my_id, node.leader_id, heartbeat_interval
         )
@@ -128,30 +142,87 @@ class ReceiverNode:
         self.heartbeat.stop()
         self.loop.stop()
 
-    def _stage_to_hbm(self, layer_id, src) -> "LayerLocation":
+    def _stage_to_hbm(self, layer_id, src, ingest=None) -> "LayerLocation":
         """Move a completed layer host→HBM when enabled; returns the
         location to ack with.  jax is imported lazily so host-only nodes
-        never pay for it."""
+        never pay for it.  The HBM transition is check-and-marked under
+        ``self._lock``: exactly one caller stages; a concurrent re-plan
+        duplicate waits for that staging instead of double-allocating the
+        layer on device.  ``ingest``: a completed incremental
+        ``ShardedLayerIngest`` whose finalize collective replaces the bulk
+        host→device transfer."""
         if not self.stage_hbm:
             return LayerLocation.INMEM
-        if src.meta.location == LayerLocation.HBM:
-            return LayerLocation.HBM  # a re-plan duplicate: already staged
+        with self._lock:
+            if src.meta.location == LayerLocation.HBM:
+                return LayerLocation.HBM  # a re-plan duplicate: already staged
+            ev = self._hbm_staging.get(layer_id)
+            if ev is not None:
+                in_progress = ev
+            else:
+                in_progress = None
+                ev = self._hbm_staging[layer_id] = threading.Event()
+        if in_progress is not None:
+            in_progress.wait()
+            with self._lock:
+                return src.meta.location
         try:
-            self._mover.stage(src)
-            log.info("layer staged to HBM", layerID=layer_id)
+            self._stage_layer_device(layer_id, src, ingest)
+            log.info("layer staged to HBM", layerID=layer_id,
+                     via="incremental ingest" if ingest is not None else "bulk")
             return LayerLocation.HBM
         except Exception as e:  # noqa: BLE001 — delivery beats staging
             log.error("HBM staging failed; acking host RAM",
                       layerID=layer_id, err=repr(e))
             return LayerLocation.INMEM
+        finally:
+            ev.set()
+            with self._lock:
+                self._hbm_staging.pop(layer_id, None)
+
+    def _stage_layer_device(self, layer_id, src, ingest=None) -> None:
+        """The actual device landing (called once per layer, under the
+        staging guard).  Priority: finalize an incremental ingest (the
+        bytes are already on-mesh — one ICI all-gather remains); else a
+        one-shot sharded ingest onto the stage's devices; else the plain
+        single-device mover."""
+        if ingest is not None:
+            try:
+                arr = ingest.finalize()
+                arr.block_until_ready()
+                with self._lock:
+                    src.device_array = arr
+                    src.meta.location = LayerLocation.HBM
+                return
+            except Exception as e:  # noqa: BLE001 — fall back to bulk path
+                log.error("ingest finalize failed; bulk staging instead",
+                          layerID=layer_id, err=repr(e))
+        if (self.placement is not None
+                and layer_id in self.placement.layer_to_stage):
+            from ..parallel.ingest import ingest_bytes
+
+            data = (src.inmem_data if src.inmem_data is not None
+                    else src.read_bytes())
+            arr = ingest_bytes(data, self.placement.devices_for_layer(layer_id))
+            arr.block_until_ready()
+            with self._lock:
+                src.device_array = arr
+                src.meta.location = LayerLocation.HBM
+            return
+        self._mover.stage(src)
 
     def handle_layer(self, msg: LayerMsg) -> None:
-        """Store to RAM, ack the leader (node.go:1354-1384)."""
+        """Store to RAM, ack the leader (node.go:1354-1384).  A re-plan
+        duplicate keeps the existing (possibly already HBM-staged) entry —
+        overwriting it would orphan the staged device array and leave the
+        node acking HBM for a host-only copy."""
         with self._lock:
-            src = msg.layer_src
-            src.meta = LayerMeta(location=LayerLocation.INMEM)
-            src.offset = 0
-            self.layers[msg.layer_id] = src
+            src = self.layers.get(msg.layer_id)
+            if src is None:
+                src = msg.layer_src
+                src.meta = LayerMeta(location=LayerLocation.INMEM)
+                src.offset = 0
+                self.layers[msg.layer_id] = src
         log.debug("saved layer in memory", layerID=msg.layer_id)
         loc = self._stage_to_hbm(msg.layer_id, src)
         try:
@@ -198,7 +269,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
 
     def __init__(self, node: Node, layers: LayersSrc, storage_path: str = ".",
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
-                 checkpoint_dir: str = "", stage_hbm: bool = False):
+                 checkpoint_dir: str = "", stage_hbm: bool = False,
+                 placement=None):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -206,6 +278,16 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # layer -> (reassembly buffer, disjoint covered [start, end) ranges)
         self._partial: Dict[int, Tuple[bytearray, list]] = {}
         self._partial_total: Dict[int, int] = {}
+        # layer -> ShardedLayerIngest: incremental device staging, fed per
+        # fragment so HBM ingest overlaps the network receive (the
+        # reference-analogous alternative — one synchronous device_put
+        # after full host assembly — serializes ingest behind the ack).
+        # Guarded by its own lock so creation/teardown never holds the main
+        # receiver lock during device work.
+        self._ingests: Dict[int, object] = {}
+        self._ingests_lock = threading.Lock()
+        self._ingest_dead: set = set()  # layers whose ingest failed: fall back
+        self._ingest_done: set = set()  # completed: late creation is a leak
         self.ckpt = LayerCheckpointStore(checkpoint_dir) if checkpoint_dir else None
         if self.ckpt is not None:
             for lid, (buf, covered, total) in self.ckpt.load().items():
@@ -219,9 +301,65 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 else:
                     self._partial[lid] = (buf, covered)
                     self._partial_total[lid] = total
-        super().__init__(node, layers, storage_path, start_loop=start_loop,
+        # Loop start is deferred past the checkpoint replay below so no
+        # handler races the ingest reconstruction.
+        super().__init__(node, layers, storage_path, start_loop=False,
                          heartbeat_interval=heartbeat_interval,
-                         stage_hbm=stage_hbm)
+                         stage_hbm=stage_hbm, placement=placement)
+        # Replay checkpoint-restored coverage into device ingests so a
+        # resumed transfer's already-held bytes are on-mesh too.
+        if self.stage_hbm:
+            for lid, (buf, covered) in self._partial.items():
+                ing = self._get_or_create_ingest(lid, self._partial_total[lid])
+                if ing is None:
+                    continue
+                try:
+                    for s, e in covered:
+                        ing.write(s, memoryview(buf)[s:e])
+                except Exception as err:  # noqa: BLE001
+                    self._ingest_write_failed(lid, ing, err)
+        if start_loop:
+            self.loop.start()
+
+    def _get_or_create_ingest(self, layer_id, total_size):
+        """The layer's incremental device ingest, created on first use;
+        None when device staging doesn't apply (no -hbm / no placement for
+        this layer / a previous device failure on it / already completed).
+        Must NOT be called while holding ``self._lock`` — creation
+        dispatches device allocations under ``self._ingests_lock``."""
+        if not self.stage_hbm:
+            return None
+        if (self.placement is None
+                or layer_id not in self.placement.layer_to_stage):
+            return None  # no stage mapping: stage whole at completion
+        with self._ingests_lock:
+            if layer_id in self._ingest_dead or layer_id in self._ingest_done:
+                return None
+            ing = self._ingests.get(layer_id)
+            if ing is None:
+                try:
+                    from ..parallel.ingest import ShardedLayerIngest
+
+                    ing = ShardedLayerIngest(
+                        total_size, self.placement.devices_for_layer(layer_id)
+                    )
+                except Exception as e:  # noqa: BLE001 — delivery beats staging
+                    log.error("device ingest unavailable for layer",
+                              layerID=layer_id, err=repr(e))
+                    self._ingest_dead.add(layer_id)
+                    return None
+                self._ingests[layer_id] = ing
+            return ing
+
+    def _ingest_write_failed(self, layer_id, ing, err) -> None:
+        """A device write failed: poison the ingest (wakes any finalize
+        waiter into the bulk-staging fallback) and stop feeding it."""
+        log.error("incremental device ingest failed; will stage at "
+                  "completion", layerID=layer_id, err=repr(err))
+        ing.fail()
+        with self._ingests_lock:
+            self._ingest_dead.add(layer_id)
+            self._ingests.pop(layer_id, None)
 
     def _announce_partial(self) -> dict:
         with self._lock:
@@ -245,7 +383,21 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         Coverage is tracked as an interval union, not a byte counter (the
         reference sums sizes, node.go:1542-1554) — so duplicate or
         overlapping fragments from a crash-triggered re-plan can never ack
-        a layer full of holes."""
+        a layer full of holes.
+
+        Device staging is incremental: each fragment is also written to its
+        span's device through the layer's ``ShardedLayerIngest`` as it
+        arrives, so HBM ingest overlaps the network receive; completion
+        runs one ICI all-gather instead of a full-layer device_put."""
+        with self._lock:
+            already_done = msg.layer_id in self.layers
+        # Ingest creation dispatches device allocations — do it before
+        # (and outside) the main critical section.
+        ing = None
+        if not already_done:
+            ing = self._get_or_create_ingest(msg.layer_id, msg.total_size)
+        frag_off = frag_data = None
+        ckpt_args = None
         with self._lock:
             if msg.layer_id in self.layers:
                 # A re-plan duplicate of a finished layer: drop the bytes
@@ -275,7 +427,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 self._partial[msg.layer_id] = (buf, covered)
                 self._partial_total[msg.layer_id] = msg.total_size
                 if self.ckpt is not None:
-                    self.ckpt.write_fragment(
+                    # Journal OUTSIDE the lock: two fsyncs per fragment
+                    # must not serialize every other handler.  `covered` is
+                    # snapshotted here; a racing older snapshot landing
+                    # later only under-reports (safe — gaps are re-sent).
+                    ckpt_args = (
                         msg.layer_id, frag.offset, data, covered, msg.total_size
                     )
                 received = intervals.covered(covered)
@@ -283,6 +439,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     "layer fragment stored",
                     layerID=msg.layer_id, received=received, total=msg.total_size,
                 )
+                frag_off, frag_data = frag.offset, data
                 complete = received >= msg.total_size
                 if complete:
                     self.layers[msg.layer_id] = LayerSrc(
@@ -296,9 +453,31 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                         self.ckpt.complete(msg.layer_id)
                     log.info("layer fully received", layer=msg.layer_id,
                              total_bytes=msg.total_size)
+        if ckpt_args is not None and not complete:
+            # (The completing fragment skips the journal: its completion
+            # branch already deleted the checkpoint files.)
+            self.ckpt.write_fragment(*ckpt_args)
+            with self._lock:
+                raced_completion = msg.layer_id in self.layers
+            if raced_completion:
+                # Another thread completed the layer while we journaled;
+                # drop the files our write just resurrected.
+                self.ckpt.complete(msg.layer_id)
+        # Device write OUTSIDE the receiver lock: the DMA dispatch must not
+        # serialize other fragments' network receive (the ingest has its
+        # own lock).
+        if ing is not None and frag_data is not None:
+            try:
+                ing.write(frag_off, frag_data)
+            except Exception as e:  # noqa: BLE001 — delivery beats staging
+                self._ingest_write_failed(msg.layer_id, ing, e)
         if not complete:
             return
-        loc = self._stage_to_hbm(msg.layer_id, self.layers[msg.layer_id])
+        src = self.layers[msg.layer_id]
+        with self._ingests_lock:
+            self._ingest_done.add(msg.layer_id)
+            ing = self._ingests.pop(msg.layer_id, None)
+        loc = self._stage_to_hbm(msg.layer_id, src, ingest=ing)
         try:
             self.node.transport.send(
                 self.node.leader_id,

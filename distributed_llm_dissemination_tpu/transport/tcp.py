@@ -185,22 +185,29 @@ class TcpTransport(Transport):
         )
         t0 = time.monotonic()
 
-        pipe = self._get_and_unregister_pipe(header.layer_id)
+        pipe_sock = self._get_and_unregister_pipe(header.layer_id)
         buf = alloc_recv_buffer(header.layer_size)
         view = memoryview(buf)
-        if pipe is not None:
+        if pipe_sock is not None:
             # Cut-through relay: stream chunks to the downstream node while
-            # receiving (transport.go:144-196).  The forwarded header keeps
-            # the original src, matching the reference (TODO at :152-164).
-            with pipe.lock:
-                _send_frame(pipe.sock, envelope)
+            # receiving (transport.go:144-196) — over a FRESH data
+            # connection, like every other layer transfer, so a multi-GiB
+            # relay never head-of-line blocks control messages to that peer
+            # (the reference relays through the shared-mutex control
+            # connection, transport.go:144-196 + :42-45).  The forwarded
+            # header keeps the original src, matching the reference (TODO
+            # at :152-164).
+            try:
+                _send_frame(pipe_sock, envelope)
                 got = 0
                 while got < header.layer_size:
                     r = conn.recv_into(view[got:], min(_CHUNK, header.layer_size - got))
                     if r == 0:
                         raise ConnectionError("connection closed mid-layer")
-                    pipe.sock.sendall(view[got : got + r])
+                    pipe_sock.sendall(view[got : got + r])
                     got += r
+            finally:
+                pipe_sock.close()
         else:
             got = 0
             while got < header.layer_size:
@@ -354,7 +361,9 @@ class TcpTransport(Transport):
                 raise ValueError("pipe already registered")
             self._pipes[layer_id] = dest_id
 
-    def _get_and_unregister_pipe(self, layer_id: LayerID) -> Optional[_PConn]:
+    def _get_and_unregister_pipe(self, layer_id: LayerID) -> Optional[socket.socket]:
+        """Fresh data connection to the pipe's downstream node (closed by
+        the relay when the layer completes)."""
         with self._lock:
             dest_id = self._pipes.pop(layer_id, None)
         if dest_id is None:
@@ -364,7 +373,7 @@ class TcpTransport(Transport):
             log.error("addr does not exist", dest=dest_id)
             return None
         try:
-            return self._get_or_connect(dest)
+            return _dial(_parse_addr(dest), self._closed)
         except OSError as e:
             log.error("failed to connect pipe dest", dest=dest_id, err=e)
             return None

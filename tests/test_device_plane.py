@@ -310,3 +310,74 @@ def test_write_fragment_rejects_giant_flat_buffer():
 
     with pytest.raises(ValueError, match="LayerBuffer"):
         write_fragment(FakeBuf(), jnp.ones((4,)), 0)
+
+
+# ---------------------------------------------------------------- ingest
+
+def test_synthesize_jobs_tile_exactly():
+    from distributed_llm_dissemination_tpu.parallel.ingest import synthesize_jobs
+    from distributed_llm_dissemination_tpu.parallel.plan import plan_layout
+
+    jobs = synthesize_jobs(1003, 4)
+    layout = plan_layout(jobs)  # raises if the ranges don't tile [0, total)
+    assert sum(size for _, _, size in layout) == 1003
+
+
+def test_ingest_bytes_single_device(cpu_devices):
+    from distributed_llm_dissemination_tpu.parallel.ingest import ingest_bytes
+
+    data = bytes(range(256)) * 4
+    arr = ingest_bytes(data, [cpu_devices[3]])
+    assert set(arr.devices()) == {cpu_devices[3]}
+    assert bytes(np.asarray(arr).tobytes()) == data
+
+
+def test_ingest_bytes_replicates_across_devices(cpu_devices):
+    from distributed_llm_dissemination_tpu.parallel.ingest import ingest_bytes
+
+    devices = list(cpu_devices[:4])
+    data = bytes([(i * 13) % 256 for i in range(1001)])  # uneven split
+    arr = ingest_bytes(data, devices)
+    assert set(arr.devices()) == set(devices)
+    assert arr.sharding.is_fully_replicated or len(set(arr.devices())) == 4
+    assert np.asarray(arr).tobytes() == data
+
+
+def test_sharded_ingest_out_of_order_overlap(cpu_devices):
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    devices = list(cpu_devices[:3])
+    total = 1000
+    want = bytes([(7 * i) % 256 for i in range(total)])
+    ing = ShardedLayerIngest(total, devices)
+    # Out-of-order fragments with an overlapping duplicate spanning the
+    # device-span boundaries (spans are ~334/333/333).
+    for off, size in [(600, 400), (0, 350), (300, 400), (200, 200)]:
+        ing.write(off, want[off : off + size])
+    arr = ing.finalize()
+    assert set(arr.devices()) == set(devices)
+    assert np.asarray(arr).tobytes() == want
+
+
+def test_sharded_ingest_rejects_out_of_bounds(cpu_devices):
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    ing = ShardedLayerIngest(100, [cpu_devices[0]])
+    with pytest.raises(ValueError, match="outside layer"):
+        ing.write(90, b"x" * 20)
+
+
+def test_sharded_ingest_tiny_layer_many_devices(cpu_devices):
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    # 3 bytes over 8 devices: zero-size spans on the tail devices.
+    ing = ShardedLayerIngest(3, list(cpu_devices))
+    ing.write(0, b"abc")
+    arr = ing.finalize()
+    assert np.asarray(arr).tobytes() == b"abc"

@@ -1,0 +1,191 @@
+"""Runtime ↔ device-plane integration: full dissemination over real TCP
+with delivered layers landing in (virtual) device HBM on their pipeline
+stage's devices — the closed loop the reference's startup hook points at
+(/root/reference/distributor/message.go:216-241).
+
+These tests drive the ACTUAL receiver/leader runtime (not the device-plane
+library in isolation): a Mesh-configured placement, mode-3 multi-fragment
+transfers with per-fragment incremental device ingest, and mode-0 one-shot
+sharded staging.
+"""
+
+import jax
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    SourceType,
+)
+from distributed_llm_dissemination_tpu.parallel import (
+    array_to_bytes,
+    assignment_to_placement,
+    make_mesh,
+)
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    LeaderNode,
+    Node,
+    ReceiverNode,
+)
+from distributed_llm_dissemination_tpu.runtime import send as send_mod
+from distributed_llm_dissemination_tpu.transport import TcpTransport, reset_registry
+
+TIMEOUT = 10.0
+LAYER_SIZE = 64 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def layer_bytes(layer_id: int, size: int = LAYER_SIZE) -> bytes:
+    return bytes([(layer_id * 37 + i) % 256 for i in range(size)])
+
+
+def mem_layer(layer_id: int, size: int = LAYER_SIZE) -> LayerSrc:
+    data = bytearray(layer_bytes(layer_id, size))
+    return LayerSrc(
+        inmem_data=data,
+        data_size=len(data),
+        meta=LayerMeta(location=LayerLocation.INMEM,
+                       source_type=SourceType.MEM),
+    )
+
+
+def tcp_transports(ids):
+    ts = {i: TcpTransport("127.0.0.1:0") for i in ids}
+    registry = {i: ts[i].get_address() for i in ids}
+    for t in ts.values():
+        t.addr_registry.update(registry)
+    return ts
+
+
+def run_distribution(leader, receivers, assignment):
+    for r in receivers:
+        r.announce()
+    assert leader.start_distribution().get(timeout=TIMEOUT) == assignment
+    assert leader.ready().get(timeout=TIMEOUT) == assignment
+    for r in receivers:
+        r.ready().get(timeout=TIMEOUT)
+
+
+def close_all(leader, receivers, ts):
+    leader.close()
+    for r in receivers:
+        r.close()
+    for t in ts.values():
+        t.close()
+
+
+def check_landed_on_stage(receiver, placement, layer_ids):
+    """Every delivered layer: HBM location, replicated on exactly its
+    stage's devices, byte-identical to the seeded content."""
+    for lid in layer_ids:
+        src = receiver.layers[lid]
+        assert src.meta.location == LayerLocation.HBM, f"layer {lid} not in HBM"
+        assert src.device_array is not None
+        got_devices = set(src.device_array.devices())
+        want_devices = set(placement.devices_for_layer(lid))
+        assert got_devices == want_devices, (
+            f"layer {lid} landed on {got_devices}, want stage devices "
+            f"{want_devices}"
+        )
+        assert array_to_bytes(src.device_array) == layer_bytes(lid), (
+            f"layer {lid} content corrupted on device"
+        )
+
+
+def test_mode3_dissemination_lands_on_stage_devices(cpu_devices, monkeypatch):
+    # 8-byte-KiB flow fragments force multi-fragment transfers, so the
+    # incremental per-fragment device ingest path is exercised for real.
+    monkeypatch.setattr(send_mod, "FLOW_FRAGMENT_BYTES", 8 * 1024)
+
+    mesh = make_mesh((2, 4), ("pp", "tp"))
+    assignment = {
+        1: {0: LayerMeta(), 1: LayerMeta()},
+        2: {2: LayerMeta(), 3: LayerMeta()},
+    }
+    placement = assignment_to_placement(assignment, mesh, "pp")
+
+    ids = range(3)
+    ts = tcp_transports(ids)
+    bw = {i: 10_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i) for i in range(4)}, assignment, bw
+    )
+    receivers = [
+        FlowRetransmitReceiverNode(
+            Node(i, 0, ts[i]), {}, stage_hbm=True, placement=placement
+        )
+        for i in (1, 2)
+    ]
+    try:
+        run_distribution(leader, receivers, assignment)
+        check_landed_on_stage(receivers[0], placement, [0, 1])
+        check_landed_on_stage(receivers[1], placement, [2, 3])
+        # Each stage is 4 devices of the 8-device mesh; the two stages are
+        # disjoint — the Assignment really is a pipeline placement.
+        s1 = set(receivers[0].layers[0].device_array.devices())
+        s2 = set(receivers[1].layers[2].device_array.devices())
+        assert len(s1) == 4 and len(s2) == 4 and not (s1 & s2)
+        # The incremental path was actually used (not the bulk fallback).
+        assert not receivers[0]._ingest_dead and not receivers[1]._ingest_dead
+    finally:
+        close_all(leader, receivers, ts)
+
+
+def test_mode3_hbm_ack_reaches_leader_status(cpu_devices):
+    # The leader's live status must record the HBM location the receiver
+    # acked — delivery means "in its stage's HBM", not host RAM.
+    mesh = make_mesh((2, 4), ("pp", "tp"))
+    assignment = {1: {0: LayerMeta()}, 2: {1: LayerMeta()}}
+    placement = assignment_to_placement(assignment, mesh, "pp")
+    ids = range(3)
+    ts = tcp_transports(ids)
+    bw = {i: 10_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i) for i in range(2)}, assignment, bw
+    )
+    receivers = [
+        FlowRetransmitReceiverNode(
+            Node(i, 0, ts[i]), {}, stage_hbm=True, placement=placement
+        )
+        for i in (1, 2)
+    ]
+    try:
+        run_distribution(leader, receivers, assignment)
+        assert leader.status[1][0].location == LayerLocation.HBM
+        assert leader.status[2][1].location == LayerLocation.HBM
+    finally:
+        close_all(leader, receivers, ts)
+
+
+def test_mode0_one_shot_sharded_staging(cpu_devices):
+    # Mode-0 full-layer delivery with a placement: the one-shot sharded
+    # ingest (execute_flow_plan with synthesized jobs) lands the layer on
+    # the stage's devices.
+    mesh = make_mesh((4, 2), ("pp", "tp"))
+    assignment = {i + 1: {i: LayerMeta()} for i in range(4)}
+    placement = assignment_to_placement(assignment, mesh, "pp")
+    ids = range(5)
+    ts = tcp_transports(ids)
+    leader = LeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i) for i in range(4)}, assignment
+    )
+    receivers = [
+        ReceiverNode(Node(i, 0, ts[i]), {}, stage_hbm=True, placement=placement)
+        for i in range(1, 5)
+    ]
+    try:
+        run_distribution(leader, receivers, assignment)
+        for i, r in enumerate(receivers):
+            check_landed_on_stage(r, placement, [i])
+            assert len(set(r.layers[i].device_array.devices())) == 2
+    finally:
+        close_all(leader, receivers, ts)

@@ -6,6 +6,7 @@ which the reference leaves untested.
 """
 
 import queue
+import threading
 import time
 
 import pytest
@@ -198,6 +199,38 @@ def test_pipe_cut_through_relay(kind):
         ts[1].deliver().get(timeout=RECV_TIMEOUT)
         with pytest.raises(queue.Empty):
             ts[2].deliver().get(timeout=0.3)
+    finally:
+        close_all(ts)
+
+
+def test_relay_does_not_block_control_plane():
+    # While node 1 relays a rate-limited (slow) layer to node 2, a control
+    # message 1 -> 2 must arrive BEFORE the relayed layer completes: the
+    # relay rides a fresh data connection, not the shared control
+    # connection (the reference holds the control-conn write mutex for the
+    # whole relay, transport.go:144-196 + :42-45).
+    ts = make_transports("tcp", 3)
+    try:
+        ts[1].register_pipe(7, 2)
+        payload = b"r" * (768 * 1024)
+        # 1 MiB/s with a 256 KiB burst: the relay stays in flight ~0.5s.
+        # The paced send blocks for the full duration, so run it off-thread.
+        sender = threading.Thread(
+            target=ts[0].send,
+            args=(1, LayerMsg(0, 7, _mem_layer(payload, rate=1024 * 1024),
+                              len(payload))),
+        )
+        sender.start()
+        time.sleep(0.1)  # let the relay start
+        ts[1].send(2, SimpleMsg(ts[1].get_address(), "urgent"))
+        first = ts[2].deliver().get(timeout=RECV_TIMEOUT)
+        assert isinstance(first, SimpleMsg), (
+            f"control message was head-of-line blocked behind the relay; "
+            f"got {type(first).__name__} first"
+        )
+        second = ts[2].deliver().get(timeout=RECV_TIMEOUT * 2)
+        assert bytes(second.layer_src.inmem_data) == payload
+        sender.join(timeout=RECV_TIMEOUT)
     finally:
         close_all(ts)
 
