@@ -160,6 +160,23 @@ def build_placement(args, conf: cfg.Config):
     placement = assignment_to_placement(
         conf.assignment, mesh, conf.mesh.pipeline_axis
     )
+    # Every device this node will stage onto must be locally addressable:
+    # in a multi-host deployment each process sees only its host's chips,
+    # and a device_put onto a remote stage device would fail deep in the
+    # receive path (or, worse, a local-only device list would silently
+    # misalign with global stage indices).  Fail loudly up front instead.
+    stage = placement.node_to_stage.get(args.id)
+    if stage is not None:
+        local = set(_jax.local_devices())
+        missing = [d for d in placement.stage_devices(stage)
+                   if d not in local]
+        if missing:
+            raise SystemExit(
+                f"node {args.id} is mapped to pipeline stage {stage}, but "
+                f"its devices {missing} are not in jax.local_devices(); "
+                "multi-host runs need jax.distributed so the mesh spans "
+                "all hosts, or a Mesh section restricted to local devices"
+            )
     ulog.log.info(
         "device mesh placement",
         mesh={n: s for n, s in zip(conf.mesh.axis_names, conf.mesh.axis_sizes)},

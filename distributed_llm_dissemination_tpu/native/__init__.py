@@ -91,13 +91,19 @@ def load_flow_solver() -> Optional[ctypes.CDLL]:
         if os.path.exists(_LIB):
             try:
                 with open(_HASH) as f:
-                    hash_known = True
-                    if f.read().strip() == _src_hash():
-                        try:
-                            _lib = _bind(ctypes.CDLL(_LIB))
-                            return _lib
-                        except OSError:
-                            pass  # wrong arch/corrupt: rebuild below
+                    recorded = f.read().strip()
+                # Only a comparison that actually executed makes the
+                # provenance "known" — an unreadable source (deployment
+                # shipping just the .so + sidecar) must leave the library
+                # eligible for the unknown-provenance fallback below.
+                matches = recorded == _src_hash()
+                hash_known = True
+                if matches:
+                    try:
+                        _lib = _bind(ctypes.CDLL(_LIB))
+                        return _lib
+                    except OSError:
+                        pass  # wrong arch/corrupt: rebuild below
             except OSError:
                 pass  # no hash sidecar: provenance unknown, rebuild below
         try:

@@ -123,6 +123,27 @@ class ShardedLayerIngest:
             raise ValueError(
                 f"fragment [{offset}, {end}) outside layer of {self.total} bytes"
             )
+        if self._closed:
+            # Cheap early exit for a late duplicate racing finalize (benign
+            # race: _closed only transitions False→True; the locked check
+            # below still guards the donation chain).
+            return
+        # Cut against the tiling and issue the host→device DMAs OUTSIDE the
+        # lock: the 16-worker handler pool must not serialize behind device
+        # transfers (nor block finalize waiters on them).  The lock is then
+        # held only to swap the donated shard buffers (dispatch-only; the
+        # donation chain requires exclusive ownership of _bufs) and to
+        # update coverage.
+        pieces = []
+        for r, (s_off, s_size) in enumerate(self.spans):
+            lo = max(offset, s_off)
+            hi = min(end, s_off + s_size)
+            if lo >= hi:
+                continue
+            piece = np.frombuffer(data[lo - offset : hi - offset], np.uint8)
+            pieces.append(
+                (r, lo - s_off, jax.device_put(piece, self.devices[r]))
+            )
         with self._lock:
             if self._closed:
                 # A late duplicate racing finalize: its bytes are already
@@ -130,15 +151,9 @@ class ShardedLayerIngest:
                 # donating write here would invalidate the buffers the
                 # gather is consuming.
                 return
-            for r, (s_off, s_size) in enumerate(self.spans):
-                lo = max(offset, s_off)
-                hi = min(end, s_off + s_size)
-                if lo >= hi:
-                    continue
-                piece = np.frombuffer(data[lo - offset : hi - offset], np.uint8)
-                dev_piece = jax.device_put(piece, self.devices[r])
+            for r, local_off, dev_piece in pieces:
                 self._bufs[r] = _write_1d(
-                    self._bufs[r], dev_piece, jnp.asarray(lo - s_off, jnp.int32)
+                    self._bufs[r], dev_piece, jnp.asarray(local_off, jnp.int32)
                 )
             self._covered = intervals.insert(self._covered, offset, end)
             if intervals.covered(self._covered) >= self.total:

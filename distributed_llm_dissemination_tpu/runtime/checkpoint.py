@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Dict, List, Tuple
 
 from ..core.types import LayerID
@@ -46,6 +47,49 @@ class LayerCheckpointStore:
     def _meta(self, layer_id: LayerID) -> str:
         return os.path.join(self.dir, f"{layer_id}.meta.json")
 
+    def write_bytes(
+        self, layer_id: LayerID, offset: int, data: bytes, total: int
+    ) -> None:
+        """Persist one fragment's bytes into the ``.part`` file, fsync'd —
+        data durable before any journal update covers it.  Safe for
+        concurrent writers: O_CREAT|O_RDWR never truncates (a racing
+        'w+b'-style create would zero a sibling's already-fsync'd range),
+        and the grow-only ftruncate is idempotent."""
+        fd = os.open(self._part(layer_id), os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            if os.fstat(fd).st_size < total:
+                os.ftruncate(fd, total)  # extend-only: never destroys data
+            # Loop to completion: a single pwrite caps at ~2 GiB on Linux,
+            # and a silently short write would let write_meta journal bytes
+            # the file holds as zeros.
+            view = memoryview(data)
+            written = 0
+            while written < len(view):
+                written += os.pwrite(fd, view[written:], offset + written)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def write_meta(
+        self,
+        layer_id: LayerID,
+        covered: List[Tuple[int, int]],
+        total: int,
+    ) -> None:
+        """Journal the durably-covered ranges.  Callers must pass only
+        ranges whose ``write_bytes`` has already returned — the journal can
+        never claim bytes the disk might not hold (a racing older snapshot
+        landing later only under-reports, which re-sending absorbs).  The
+        tmp name is per-writer (pid + thread), so concurrent journalers of
+        one layer never truncate each other's half-written JSON."""
+        tmp = (f"{self._meta(layer_id)}.{os.getpid()}"
+               f".{threading.get_ident()}.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"Total": total, "Covered": [list(iv) for iv in covered]}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._meta(layer_id))  # atomic journal update
+
     def write_fragment(
         self,
         layer_id: LayerID,
@@ -54,22 +98,11 @@ class LayerCheckpointStore:
         covered: List[Tuple[int, int]],
         total: int,
     ) -> None:
-        """Persist one fragment + the post-write coverage state."""
-        part = self._part(layer_id)
-        mode = "r+b" if os.path.exists(part) else "w+b"
-        with open(part, mode) as f:
-            if mode == "w+b":
-                f.truncate(total)
-            f.seek(offset)
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())  # data durable before the journal covers it
-        tmp = self._meta(layer_id) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"Total": total, "Covered": [list(iv) for iv in covered]}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._meta(layer_id))  # atomic journal update
+        """Persist one fragment + coverage (single-writer convenience;
+        concurrent writers must use write_bytes + write_meta with a
+        durable-only coverage union)."""
+        self.write_bytes(layer_id, offset, data, total)
+        self.write_meta(layer_id, covered, total)
 
     def complete(self, layer_id: LayerID) -> None:
         """Drop checkpoint state for a fully assembled layer."""
@@ -85,6 +118,15 @@ class LayerCheckpointStore:
         if not os.path.isdir(self.dir):
             return state
         for name in sorted(os.listdir(self.dir)):
+            # Meta tmp files are per-writer named, so ones orphaned by a
+            # crash never self-overwrite; load() runs before any journaler
+            # exists, making this the one safe point to sweep them.
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+                continue
             if not name.endswith(".meta.json"):
                 continue
             try:

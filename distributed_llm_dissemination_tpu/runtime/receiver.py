@@ -278,6 +278,10 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # layer -> (reassembly buffer, disjoint covered [start, end) ranges)
         self._partial: Dict[int, Tuple[bytearray, list]] = {}
         self._partial_total: Dict[int, int] = {}
+        # layer -> DURABLY-covered ranges: only ranges whose .part write has
+        # fsync'd merge in (under self._lock), so the journal can never
+        # claim bytes another handler thread hasn't landed on disk yet.
+        self._durable: Dict[int, list] = {}
         # layer -> ShardedLayerIngest: incremental device staging, fed per
         # fragment so HBM ingest overlaps the network receive (the
         # reference-analogous alternative — one synchronous device_put
@@ -301,6 +305,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 else:
                     self._partial[lid] = (buf, covered)
                     self._partial_total[lid] = total
+                    self._durable[lid] = list(covered)  # restored = on disk
         # Loop start is deferred past the checkpoint replay below so no
         # handler races the ingest reconstruction.
         super().__init__(node, layers, storage_path, start_loop=False,
@@ -427,13 +432,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 self._partial[msg.layer_id] = (buf, covered)
                 self._partial_total[msg.layer_id] = msg.total_size
                 if self.ckpt is not None:
-                    # Journal OUTSIDE the lock: two fsyncs per fragment
-                    # must not serialize every other handler.  `covered` is
-                    # snapshotted here; a racing older snapshot landing
-                    # later only under-reports (safe — gaps are re-sent).
-                    ckpt_args = (
-                        msg.layer_id, frag.offset, data, covered, msg.total_size
-                    )
+                    # Journaled OUTSIDE the lock below: two fsyncs per
+                    # fragment must not serialize every other handler.
+                    ckpt_args = (msg.layer_id, frag.offset, data, msg.total_size)
                 received = intervals.covered(covered)
                 log.info(
                     "layer fragment stored",
@@ -449,20 +450,37 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     )
                     del self._partial[msg.layer_id]
                     self._partial_total.pop(msg.layer_id, None)
+                    self._durable.pop(msg.layer_id, None)
                     if self.ckpt is not None:
                         self.ckpt.complete(msg.layer_id)
                     log.info("layer fully received", layer=msg.layer_id,
                              total_bytes=msg.total_size)
         if ckpt_args is not None and not complete:
             # (The completing fragment skips the journal: its completion
-            # branch already deleted the checkpoint files.)
-            self.ckpt.write_fragment(*ckpt_args)
+            # branch already deleted the checkpoint files.)  Bytes first,
+            # fsync'd; then merge ONLY this fragment's range into the
+            # durable-coverage union under the lock — the meta can never
+            # claim ranges whose .part writes are still pending in sibling
+            # handler threads (which a crash would restore as zeros).
+            lid, off, data, total = ckpt_args
+            self.ckpt.write_bytes(lid, off, data, total)
             with self._lock:
-                raced_completion = msg.layer_id in self.layers
+                raced_completion = lid in self.layers
+                if not raced_completion:
+                    durable = intervals.insert(
+                        self._durable.get(lid, []), off, off + len(data)
+                    )
+                    self._durable[lid] = durable
+            if not raced_completion:
+                self.ckpt.write_meta(lid, durable, total)
+                with self._lock:
+                    raced_completion = lid in self.layers
             if raced_completion:
                 # Another thread completed the layer while we journaled;
-                # drop the files our write just resurrected.
-                self.ckpt.complete(msg.layer_id)
+                # drop the files our writes just resurrected.
+                self.ckpt.complete(lid)
+                with self._lock:
+                    self._durable.pop(lid, None)
         # Device write OUTSIDE the receiver lock: the DMA dispatch must not
         # serialize other fragments' network receive (the ingest has its
         # own lock).
