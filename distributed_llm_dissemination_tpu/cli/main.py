@@ -67,7 +67,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-hbm", action="store_true",
                    help="receiver: stage each delivered layer into TPU HBM "
                         "(jax.Array) before acking")
+    p.add_argument("-boot", type=str, default="",
+                   help="model config name (models.llama.CONFIGS): receivers "
+                        "boot the model from the delivered layer blobs on "
+                        "startup; the leader waits for every assignee's boot "
+                        "and prints Time to first token (give the flag to "
+                        "both roles)")
     return p
+
+
+def boot_config(name: str):
+    if not name:
+        return None
+    from ..models.llama import CONFIGS
+
+    try:
+        return CONFIGS[name]
+    except KeyError:
+        raise SystemExit(
+            f"unknown -boot model {name!r}; known: {sorted(CONFIGS)}"
+        )
 
 
 def run_client(args, conf: cfg.Config) -> int:
@@ -130,6 +149,14 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     ttd = time.monotonic() - t0
     ulog.log.info("Time to deliver", seconds=round(ttd, 6))
     print(f"Time to deliver: {ttd:.6f}s", flush=True)
+    if args.boot or conf.model:
+        # Receivers boot their model from the delivered blobs and report
+        # back; TTFT = timer start → last boot report (includes TTD).
+        booted = leader.boot_ready().get()
+        ttft = time.monotonic() - t0
+        ulog.log.info("Time to first token", seconds=round(ttft, 6),
+                      nodes={str(n): round(s, 3) for n, s in booted.items()})
+        print(f"Time to first token: {ttft:.6f}s", flush=True)
     return 0
 
 
@@ -188,21 +215,27 @@ def build_placement(args, conf: cfg.Config):
 def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     """Receiver role (cmd/main.go:183-215)."""
     placement = build_placement(args, conf)
+    # A config with a Model section is boot-capable: receivers boot by
+    # default so the leader's boot wait can't hang on a missing flag.
+    boot_cfg = boot_config(args.boot or conf.model)
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".",
                                 heartbeat_interval=args.hb,
-                                stage_hbm=args.hbm, placement=placement)
+                                stage_hbm=args.hbm, placement=placement,
+                                boot_cfg=boot_cfg)
     elif args.m in (1, 2):
         receiver = RetransmitReceiverNode(node, layers, args.s or ".",
                                           heartbeat_interval=args.hb,
                                           stage_hbm=args.hbm,
-                                          placement=placement)
+                                          placement=placement,
+                                          boot_cfg=boot_cfg)
     else:
         receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
                                               heartbeat_interval=args.hb,
                                               checkpoint_dir=args.ckpt,
                                               stage_hbm=args.hbm,
-                                              placement=placement)
+                                              placement=placement,
+                                              boot_cfg=boot_cfg)
 
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "
@@ -232,7 +265,8 @@ def main(argv=None) -> int:
         ulog.log.info("external client not found in config")
 
     save_disk = bool(args.s)
-    layers = cfg.create_layers(node_conf, save_disk, args.s or ".")
+    layers = cfg.create_layers(node_conf, save_disk, args.s or ".",
+                               model=conf.model, model_seed=conf.model_seed)
     if my_client_conf is not None:
         cfg.add_client_layers(my_client_conf, conf.layer_size, layers)
 

@@ -128,6 +128,12 @@ class Config:
     assignment: Assignment = dataclasses.field(default_factory=dict)
     layer_size: int = 0
     mesh: Optional[MeshConf] = None
+    # TPU extension: when set (a models.llama.CONFIGS name), seeders
+    # fabricate REAL model weight blobs (deterministic from ModelSeed)
+    # instead of dummy zero bytes, so the disseminated layers can boot an
+    # inference engine after delivery (-boot).
+    model: str = ""
+    model_seed: int = 0
 
     @classmethod
     def from_json(cls, d: dict) -> "Config":
@@ -137,6 +143,8 @@ class Config:
             assignment=assignment_from_json(_jget(d, "Assignment") or {}),
             layer_size=int(_jget(d, "LayerSize", 0)),
             mesh=MeshConf.from_json(_jget(d, "Mesh")) if _jget(d, "Mesh") else None,
+            model=_jget(d, "Model", "") or "",
+            model_seed=int(_jget(d, "ModelSeed", 0)),
         )
 
 
@@ -173,21 +181,42 @@ def get_client_conf(conf: Config, node: NodeID) -> ClientConf:
 # ---------------------------------------------------------------------------
 
 
-def create_layers(my_conf: NodeConf, save_disk: bool, storage_path: str = ".") -> LayersSrc:
+def create_layers(
+    my_conf: NodeConf,
+    save_disk: bool,
+    storage_path: str = ".",
+    model: str = "",
+    model_seed: int = 0,
+) -> LayersSrc:
     """Fabricate this node's initial layers (cmd/config.go:94-117).
 
     ``SourceType`` is a *rate class* keying the per-source limit, not a
     storage location: layers are fabricated in RAM unless ``save_disk``
     (the reference's ``-s`` flag) forces disk-backed files.
-    """
+
+    ``model``: a ``models.llama.CONFIGS`` name — layers are then REAL
+    weight blobs (``serde.seeded_blob``, deterministic from ``model_seed``)
+    the delivered model boots from, instead of the reference's dummy zero
+    bytes; the blob's true size overrides the configured LayerSize."""
+    blob_fn = None
+    if model:
+        from ..models.llama import CONFIGS
+        from ..models.serde import seeded_blob
+
+        mcfg = CONFIGS[model]
+        blob_fn = lambda lid: seeded_blob(mcfg, lid, model_seed)  # noqa: E731
     layers: LayersSrc = {}
     for source_type, by_layer in my_conf.initial_layers.items():
         for layer_id, size in by_layer.items():
             size = max(0, size)
+            blob = blob_fn(layer_id) if blob_fn is not None else None
+            if blob is not None:
+                size = len(blob)
             if save_disk:
-                src = create_disk_layer(my_conf.id, layer_id, size, storage_path)
+                src = create_disk_layer(my_conf.id, layer_id, size,
+                                        storage_path, content=blob)
             else:
-                src = create_inmem_layer(layer_id, size)
+                src = create_inmem_layer(layer_id, size, content=blob)
             src.data_size = size
             src.meta.limit_rate = my_conf.sources.get(source_type, 0)
             src.meta.source_type = source_type
@@ -208,16 +237,19 @@ def add_client_layers(
 
 
 def create_disk_layer(
-    my_id: NodeID, layer_id: LayerID, layer_size: int, storage_path: str
+    my_id: NodeID, layer_id: LayerID, layer_size: int, storage_path: str,
+    content: Optional[bytes] = None,
 ) -> LayerSrc:
-    """Write a dummy layer file ``layers/<nodeID>/<layerID>.layer``
-    (cmd/config.go:133-157)."""
+    """Write a layer file ``layers/<nodeID>/<layerID>.layer``
+    (cmd/config.go:133-157); dummy zeros unless real ``content`` given."""
     d = os.path.join(storage_path, "layers", str(my_id))
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, f"{layer_id}.layer")
-    if not os.path.exists(path):
+    if not os.path.exists(path) or (
+        content is not None and os.path.getsize(path) != layer_size
+    ):
         with open(path, "wb") as f:
-            f.write(b"\x00" * layer_size)
+            f.write(content if content is not None else b"\x00" * layer_size)
     return LayerSrc(
         inmem_data=None,
         fp=path,
@@ -227,10 +259,13 @@ def create_disk_layer(
     )
 
 
-def create_inmem_layer(layer_id: LayerID, layer_size: int) -> LayerSrc:
-    """Dummy in-RAM layer (cmd/config.go:159-171)."""
+def create_inmem_layer(
+    layer_id: LayerID, layer_size: int, content: Optional[bytes] = None
+) -> LayerSrc:
+    """In-RAM layer (cmd/config.go:159-171): dummy zeros, or real bytes."""
     return LayerSrc(
-        inmem_data=bytearray(layer_size),
+        inmem_data=bytearray(content) if content is not None
+        else bytearray(layer_size),
         fp="",
         data_size=layer_size,
         offset=0,

@@ -107,25 +107,44 @@ def init_layer_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, jax.Array]:
     return p
 
 
+def init_head_params(
+    cfg: ModelConfig, k_emb: jax.Array, k_out: jax.Array
+) -> Dict[str, jax.Array]:
+    """The non-layer weights (embed / final norm / lm head)."""
+    return {
+        "embed": jax.random.normal(k_emb, (cfg.vocab, cfg.d_model), cfg.dtype)
+        * (cfg.d_model ** -0.5),
+        "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
+        "lm_head": jax.random.normal(
+            k_out, (cfg.d_model, cfg.vocab), cfg.dtype
+        ) * (cfg.d_model ** -0.5),
+    }
+
+
+def model_keys(cfg: ModelConfig, key: jax.Array):
+    """Deterministic per-component key split — exposed so one layer's
+    weights can be regenerated in isolation (seeded dissemination blobs)
+    bit-identically to ``init_params``."""
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    return k_emb, jax.random.split(k_layers, cfg.n_layers), k_out
+
+
 def init_params(cfg: ModelConfig, key: jax.Array) -> Dict[str, Any]:
     """Full model params.  Layer weights are STACKED along a leading
     n_layers axis — one pytree leaf per weight kind — so a layer is a
     slice (disseminable blob) and scan/pipeline stages index it."""
-    k_emb, k_layers, k_out = jax.random.split(key, 3)
-    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    k_emb, layer_keys, k_out = model_keys(cfg, key)
     per_layer = [init_layer_params(cfg, lk) for lk in layer_keys]
     stacked = {
         name: jnp.stack([lp[name] for lp in per_layer])
         for name in per_layer[0]
     }
+    head = init_head_params(cfg, k_emb, k_out)
     return {
-        "embed": jax.random.normal(k_emb, (cfg.vocab, cfg.d_model), cfg.dtype)
-        * (cfg.d_model ** -0.5),
+        "embed": head["embed"],
         "layers": stacked,
-        "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
-        "lm_head": jax.random.normal(
-            k_out, (cfg.d_model, cfg.vocab), cfg.dtype
-        ) * (cfg.d_model ** -0.5),
+        "ln_f": head["ln_f"],
+        "lm_head": head["lm_head"],
     }
 
 

@@ -1,0 +1,240 @@
+"""Model params ↔ disseminable layer blobs.
+
+The reference disseminates opaque byte blobs and its ``startupMsg`` is "the
+hook that would launch an inference engine"
+(``/root/reference/distributor/message.go:216-241``).  This module defines
+the byte format that closes that loop for real: each transformer layer of a
+``models.llama`` model serializes to one blob (the dissemination unit), and
+a receiver reassembles delivered blobs back into the stacked-layer params
+pytree the jitted forward consumes.
+
+Format (deterministic, self-describing via the ModelConfig):
+- Blob ``i`` for ``0 <= i < n_layers`` is layer ``i``'s weights — each leaf
+  in the fixed ``layer_param_specs`` order, as raw C-order bytes of
+  ``cfg.dtype``.
+- Blob ``head_blob_id(cfg) == n_layers`` holds the non-layer params:
+  ``embed``, ``ln_f``, ``lm_head`` (same encoding).
+
+Two decode paths, bit-identical by construction (and by test):
+- **host**: numpy views over the blob bytes (zero-copy) — used when layers
+  were delivered to host RAM.
+- **device**: delivered blobs that already live in HBM as uint8 arrays
+  (the ``-hbm`` ingest path) are reinterpreted *on device* with
+  ``lax.bitcast_convert_type`` under one jit — no host round-trip; the
+  bytes never leave the accelerator they were disseminated into.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import ModelConfig
+
+Spec = Tuple[str, Tuple[int, ...]]
+
+
+def layer_param_specs(cfg: ModelConfig) -> List[Spec]:
+    """(name, shape) of one layer's leaves, in canonical blob order."""
+    d, f = cfg.d_model, cfg.d_ff
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    specs: List[Spec] = [
+        ("wq", (d, h * hd)),
+        ("wk", (d, kv * hd)),
+        ("wv", (d, kv * hd)),
+        ("wo", (h * hd, d)),
+        ("ln1", (d,)),
+        ("ln2", (d,)),
+    ]
+    if cfg.n_experts:
+        e = cfg.n_experts
+        specs += [
+            ("router", (d, e)),
+            ("w1", (e, d, f)),
+            ("w3", (e, d, f)),
+            ("w2", (e, f, d)),
+        ]
+    else:
+        specs += [("w1", (d, f)), ("w3", (d, f)), ("w2", (f, d))]
+    return specs
+
+
+def head_param_specs(cfg: ModelConfig) -> List[Spec]:
+    """(name, shape) of the non-layer leaves, in canonical blob order."""
+    return [
+        ("embed", (cfg.vocab, cfg.d_model)),
+        ("ln_f", (cfg.d_model,)),
+        ("lm_head", (cfg.d_model, cfg.vocab)),
+    ]
+
+
+def head_blob_id(cfg: ModelConfig) -> int:
+    """The blob id carrying embed/ln_f/lm_head: one past the layers."""
+    return cfg.n_layers
+
+
+def blob_nbytes(cfg: ModelConfig, blob_id: int) -> int:
+    """Exact byte size of a blob (== cfg.layer_nbytes() for layer blobs)."""
+    itemsize = np.dtype(cfg.dtype).itemsize
+    specs = (head_param_specs(cfg) if blob_id == head_blob_id(cfg)
+             else layer_param_specs(cfg))
+    return sum(int(np.prod(s)) for _, s in specs) * itemsize
+
+
+def _encode(leaves: Sequence[np.ndarray]) -> bytes:
+    return b"".join(np.ascontiguousarray(a).tobytes() for a in leaves)
+
+
+def blobs_from_params(cfg: ModelConfig, params: Dict[str, Any]) -> Dict[int, bytes]:
+    """Serialize a full params pytree into its dissemination blobs."""
+    layers = jax.device_get(params["layers"])
+    blobs: Dict[int, bytes] = {}
+    specs = layer_param_specs(cfg)
+    for i in range(cfg.n_layers):
+        blobs[i] = _encode([np.asarray(layers[name][i]) for name, _ in specs])
+    head = {name: np.asarray(jax.device_get(params[name]))
+            for name, _ in head_param_specs(cfg)}
+    blobs[head_blob_id(cfg)] = _encode(
+        [head[name] for name, _ in head_param_specs(cfg)]
+    )
+    return blobs
+
+
+def _split_blob(
+    cfg: ModelConfig, data, specs: List[Spec]
+) -> Dict[str, np.ndarray]:
+    """Host path: zero-copy numpy views of one blob's leaves."""
+    dt = np.dtype(cfg.dtype)
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8)
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for name, shape in specs:
+        n = int(np.prod(shape)) * dt.itemsize
+        out[name] = buf[off : off + n].view(dt).reshape(shape)
+        off += n
+    if off != len(buf):
+        raise ValueError(f"blob size {len(buf)} != expected {off}")
+    return out
+
+
+def params_from_blobs(
+    cfg: ModelConfig, blobs: Dict[int, Any]
+) -> Dict[str, Any]:
+    """Host path: reassemble the full params pytree from all blobs.
+
+    ``blobs`` maps blob id → bytes-like.  Requires every layer blob plus
+    the head blob.  Leaves are numpy (host) arrays; callers place them on
+    device under whatever sharding the stage placement prescribes."""
+    missing = [i for i in range(cfg.n_layers + 1) if i not in blobs]
+    if missing:
+        raise ValueError(f"missing blobs for full model: {missing}")
+    specs = layer_param_specs(cfg)
+    per_layer = [_split_blob(cfg, blobs[i], specs) for i in range(cfg.n_layers)]
+    stacked = {
+        name: np.stack([lp[name] for lp in per_layer]) for name, _ in specs
+    }
+    head = _split_blob(cfg, blobs[head_blob_id(cfg)], head_param_specs(cfg))
+    return {
+        "embed": head["embed"],
+        "layers": stacked,
+        "ln_f": head["ln_f"],
+        "lm_head": head["lm_head"],
+    }
+
+
+def head_from_blob(cfg: ModelConfig, data) -> Dict[str, np.ndarray]:
+    """Host path: embed/ln_f/lm_head views over the head blob's bytes."""
+    return _split_blob(cfg, data, head_param_specs(cfg))
+
+
+def stacked_from_blobs(
+    cfg: ModelConfig, blobs: Dict[int, Any], layer_ids: Sequence[int]
+) -> Dict[str, np.ndarray]:
+    """Host path: stacked params for a *contiguous subset* of layers — a
+    pipeline stage's slice of the model."""
+    specs = layer_param_specs(cfg)
+    per_layer = [_split_blob(cfg, blobs[i], specs) for i in layer_ids]
+    return {name: np.stack([lp[name] for lp in per_layer]) for name, _ in specs}
+
+
+def seeded_blob(cfg: ModelConfig, blob_id: int, seed: int = 0) -> bytes:
+    """Regenerate ONE blob of the model ``init_params(cfg, key(seed))``
+    would produce, bit-identically, without materializing the rest — how
+    seeder nodes fabricate real (non-dummy) initial layers from just a
+    config + seed, so every process agrees on the weights and a booted
+    model can be checked against an independently initialized source."""
+    import jax
+
+    from .llama import init_head_params, init_layer_params, model_keys
+
+    k_emb, layer_keys, k_out = model_keys(cfg, jax.random.key(seed))
+    if blob_id == head_blob_id(cfg):
+        head = init_head_params(cfg, k_emb, k_out)
+        leaves = [np.asarray(jax.device_get(head[name]))
+                  for name, _ in head_param_specs(cfg)]
+        return _encode(leaves)
+    if not 0 <= blob_id < cfg.n_layers:
+        raise ValueError(f"blob {blob_id} out of range for {cfg.name}")
+    p = init_layer_params(cfg, layer_keys[blob_id])
+    return _encode([np.asarray(jax.device_get(p[name]))
+                    for name, _ in layer_param_specs(cfg)])
+
+
+# ------------------------------------------------------------- device path
+
+def _bitcast_leaf(flat_u8: jax.Array, dtype) -> jax.Array:
+    """uint8[..., n*k] → dtype[..., n] on device (k = itemsize)."""
+    itemsize = np.dtype(dtype).itemsize
+    if itemsize == 1:
+        return jax.lax.bitcast_convert_type(flat_u8, dtype)
+    grouped = flat_u8.reshape(*flat_u8.shape[:-1], -1, itemsize)
+    return jax.lax.bitcast_convert_type(grouped, dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _decode_stacked(blobs_u8: jax.Array, specs: Tuple[Spec, ...], dtype_name: str):
+    """(n, blob_len) uint8 → {name: (n, *shape) dtype} without leaving the
+    device: static slices + bitcasts, fused by XLA."""
+    dt = jnp.dtype(dtype_name)
+    out = {}
+    off = 0
+    for name, shape in specs:
+        n = int(np.prod(shape)) * dt.itemsize
+        leaf = jax.lax.slice_in_dim(blobs_u8, off, off + n, axis=1)
+        out[name] = _bitcast_leaf(leaf, dt).reshape(
+            (blobs_u8.shape[0],) + shape
+        )
+        off += n
+    return out
+
+
+def stacked_from_device_blobs(
+    cfg: ModelConfig, blob_arrays: Sequence[jax.Array]
+) -> Dict[str, jax.Array]:
+    """Device path: stacked layer params from HBM-resident uint8 blobs.
+
+    Each input is one delivered layer blob already on device (the ingest
+    path's terminal artifact); the reinterpret runs entirely on the
+    accelerator."""
+    stacked_u8 = jnp.stack([a for a in blob_arrays])
+    return _decode_stacked(
+        stacked_u8,
+        tuple(layer_param_specs(cfg)),
+        np.dtype(cfg.dtype).name,
+    )
+
+
+def head_from_device_blob(
+    cfg: ModelConfig, blob_u8: jax.Array
+) -> Dict[str, jax.Array]:
+    """Device path: embed/ln_f/lm_head from the HBM-resident head blob."""
+    decoded = _decode_stacked(
+        blob_u8[None, :],
+        tuple(head_param_specs(cfg)),
+        np.dtype(cfg.dtype).name,
+    )
+    return {name: arr[0] for name, arr in decoded.items()}

@@ -47,6 +47,7 @@ from ..sched.native import make_flow_graph
 from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
+    BootReadyMsg,
     ClientReqMsg,
     FlowRetransmitMsg,
     HeartbeatMsg,
@@ -106,6 +107,12 @@ class LeaderNode:
         self._ready_q: "queue.Queue[Assignment]" = queue.Queue()
         self._started = False
         self._startup_sent = False
+        # Model-boot completion tracking (BootReadyMsg is an extension:
+        # the reference's startup hook has no completion signal).
+        self._boot_q: "queue.Queue[Dict[NodeID, float]]" = queue.Queue()
+        self._booted: Dict[NodeID, float] = {}
+        self._boot_reported = False
+        self._t_start: Optional[float] = None
         # node -> {layer: {"Total": n, "Covered": [[s, e], ...]}} from
         # announces of checkpoint-resuming receivers.
         self.partial_status: Dict[NodeID, dict] = {}
@@ -146,6 +153,7 @@ class LeaderNode:
         self.loop.register(
             HeartbeatMsg, lambda msg: self.detector.touch(msg.src_id)
         )
+        self.loop.register(BootReadyMsg, self.handle_boot_ready)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -156,6 +164,29 @@ class LeaderNode:
     def ready(self) -> "queue.Queue[Assignment]":
         """Fires when the assignment is satisfied (node.go:225)."""
         return self._ready_q
+
+    def boot_ready(self) -> "queue.Queue[Dict[NodeID, float]]":
+        """Fires once every assignee has reported booting its model from
+        the delivered layers (receivers constructed with ``boot_cfg``);
+        carries {node: that node's boot seconds}.  The leader's
+        time-to-first-token — timer start → last boot report — is logged
+        as "timer stop: first token"."""
+        return self._boot_q
+
+    def handle_boot_ready(self, msg: BootReadyMsg) -> None:
+        self.detector.touch(msg.src_id)
+        log.info("node booted its model", node=msg.src_id, kind=msg.kind,
+                 boot_seconds=round(msg.seconds, 6))
+        with self._lock:
+            self._booted[msg.src_id] = msg.seconds
+            if self._boot_reported or set(self.assignment) - set(self._booted):
+                return
+            self._boot_reported = True
+            ttft = (time.monotonic() - self._t_start
+                    if self._t_start is not None else 0.0)
+            booted = dict(self._booted)
+        log.info("timer stop: first token", seconds=round(ttft, 6))
+        self._boot_q.put(booted)
 
     def close(self) -> None:
         self.detector.stop()
@@ -172,6 +203,7 @@ class LeaderNode:
                 if node_id not in self.status:
                     return False
             self._started = True
+            self._t_start = time.monotonic()
         log.info("timer start")
         self._start_q.put(self.assignment)
         return True

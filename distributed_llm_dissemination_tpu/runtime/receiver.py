@@ -35,6 +35,7 @@ from ..core.types import (
 from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
+    BootReadyMsg,
     FlowRetransmitMsg,
     LayerMsg,
     RetransmitMsg,
@@ -65,8 +66,15 @@ class ReceiverNode:
         heartbeat_interval: float = 0.0,
         stage_hbm: bool = False,
         placement=None,
+        boot_cfg=None,
     ):
-        """``stage_hbm``: stage each delivered layer into device HBM (a
+        """``boot_cfg``: a ``models.llama.ModelConfig``; when set, the
+        startup message boots the model from the delivered layer blobs
+        (``runtime.boot``) and reports a ``BootReadyMsg`` to the leader —
+        the inference engine the reference's startup hook only gestures at
+        (message.go:216-241).
+
+        ``stage_hbm``: stage each delivered layer into device HBM (a
         jax.Array) before acking — the TPU-native terminal state; the
         reference stops at host RAM (node.go:435-446).
 
@@ -82,6 +90,9 @@ class ReceiverNode:
         self.storage_path = storage_path
         self.stage_hbm = stage_hbm
         self.placement = placement
+        self.boot_cfg = boot_cfg
+        self.boot_result = None  # BootResult after a successful boot
+        self._boot_started = False
         # Eager when enabled: handlers run on a 16-worker pool, so a lazy
         # check-then-set would race; raw byte blobs stage as uint8 so
         # odd-length layers round-trip exactly (bf16 would pad a byte).
@@ -234,8 +245,38 @@ class ReceiverNode:
             log.error("failed to send ackMsg", err=repr(e))
 
     def handle_startup(self, msg: StartupMsg) -> None:
-        """The inference-engine boot hook (node.go:1387-1389)."""
+        """The inference-engine boot hook (node.go:1387-1389) — with
+        ``boot_cfg`` it actually boots the engine: ``ready()`` unblocks
+        immediately (delivery is done), the boot runs on the handler pool,
+        and its completion is reported to the leader as a BootReadyMsg."""
         self._ready_q.put(object())
+        if self.boot_cfg is None:
+            return
+        with self._lock:
+            if self._boot_started:  # a re-sent startup must not re-boot
+                return
+            self._boot_started = True
+        self.loop.submit(self._boot)
+
+    def _boot(self) -> None:
+        from .boot import boot_from_layers
+
+        try:
+            res = boot_from_layers(
+                self.boot_cfg, self.layers,
+                placement=self.placement, node_id=self.node.my_id,
+            )
+        except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
+            log.error("model boot failed", err=repr(e))
+            return
+        self.boot_result = res
+        try:
+            self.node.transport.send(
+                self.node.leader_id,
+                BootReadyMsg(self.node.my_id, res.seconds, res.kind),
+            )
+        except (OSError, KeyError) as e:
+            log.error("failed to send bootReadyMsg", err=repr(e))
 
 
 class RetransmitReceiverNode(ReceiverNode):
@@ -270,7 +311,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
     def __init__(self, node: Node, layers: LayersSrc, storage_path: str = ".",
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
                  checkpoint_dir: str = "", stage_hbm: bool = False,
-                 placement=None):
+                 placement=None, boot_cfg=None):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -310,7 +351,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # handler races the ingest reconstruction.
         super().__init__(node, layers, storage_path, start_loop=False,
                          heartbeat_interval=heartbeat_interval,
-                         stage_hbm=stage_hbm, placement=placement)
+                         stage_hbm=stage_hbm, placement=placement,
+                         boot_cfg=boot_cfg)
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:

@@ -36,10 +36,14 @@ class MsgType(enum.IntEnum):
     CLIENT_REQ = 5
     STARTUP = 6
     SIMPLE = 7
-    # Extension beyond the reference enum (message.go:16-28): liveness
-    # beacon for the failure detector, which the reference leaves TODO
-    # (crash(n node), node.go:218-220).
+    # Extensions beyond the reference enum (message.go:16-28):
+    # HEARTBEAT — liveness beacon for the failure detector, which the
+    # reference leaves TODO (crash(n node), node.go:218-220).
+    # BOOT_READY — receiver booted its model from the disseminated layers;
+    # the reference's startup handler is a stub (node.go:1387-1389), so it
+    # has nothing to report back.
     HEARTBEAT = 8
+    BOOT_READY = 9
 
 
 @dataclasses.dataclass
@@ -280,6 +284,27 @@ class HeartbeatMsg:
         return cls(int(d["SrcID"]))
 
 
+@dataclasses.dataclass
+class BootReadyMsg:
+    """Receiver → leader: model (or pipeline stage) booted from the
+    delivered layers.  ``seconds`` is the receiver's blob-assembly +
+    compile + first-forward wall time; ``kind`` is "full" or "stage"."""
+
+    src_id: NodeID
+    seconds: float = 0.0
+    kind: str = ""
+
+    msg_type = MsgType.BOOT_READY
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "Seconds": self.seconds, "Kind": self.kind}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "BootReadyMsg":
+        return cls(int(d["SrcID"]), float(d.get("Seconds", 0.0)),
+                   str(d.get("Kind", "")))
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -290,6 +315,7 @@ Message = Union[
     StartupMsg,
     SimpleMsg,
     HeartbeatMsg,
+    BootReadyMsg,
 ]
 
 _DECODERS = {
@@ -301,6 +327,7 @@ _DECODERS = {
     MsgType.STARTUP: StartupMsg,
     MsgType.SIMPLE: SimpleMsg,
     MsgType.HEARTBEAT: HeartbeatMsg,
+    MsgType.BOOT_READY: BootReadyMsg,
 }
 
 

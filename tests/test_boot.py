@@ -1,0 +1,179 @@
+"""Dissemination → model boot: the closed loop.
+
+The reference's startup hook is a stub (node.go:1387-1389); these tests
+prove this framework's startup actually boots the model: real weight blobs
+are disseminated (mode 3, multi-fragment, HBM placement), the receiver
+assembles them on device, runs a jitted forward, and the logits match an
+independently initialized source model bit-for-bit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    SourceType,
+)
+from distributed_llm_dissemination_tpu.models import serde
+from distributed_llm_dissemination_tpu.models.llama import (
+    CONFIGS,
+    forward_jit,
+    init_params,
+)
+from distributed_llm_dissemination_tpu.parallel import (
+    assignment_to_placement,
+    make_mesh,
+)
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    Node,
+)
+from distributed_llm_dissemination_tpu.runtime import send as send_mod
+from distributed_llm_dissemination_tpu.runtime.boot import boot_from_layers
+from distributed_llm_dissemination_tpu.transport import TcpTransport, reset_registry
+
+TIMEOUT = 30.0
+CFG = CONFIGS["tiny"]
+SEED = 0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def source_params():
+    return init_params(CFG, jax.random.key(SEED))
+
+
+def all_blobs():
+    return serde.blobs_from_params(CFG, source_params())
+
+
+def blob_layer(data: bytes) -> LayerSrc:
+    return LayerSrc(
+        inmem_data=bytearray(data),
+        data_size=len(data),
+        meta=LayerMeta(location=LayerLocation.INMEM, source_type=SourceType.MEM),
+    )
+
+
+def tcp_transports(ids):
+    ts = {i: TcpTransport("127.0.0.1:0") for i in ids}
+    registry = {i: ts[i].get_address() for i in ids}
+    for t in ts.values():
+        t.addr_registry.update(registry)
+    return ts
+
+
+def test_seeded_blob_matches_init_params():
+    # A seeder regenerating one blob from (config, seed) must produce the
+    # same bytes as serializing the fully initialized model.
+    blobs = all_blobs()
+    for bid in list(range(CFG.n_layers)) + [serde.head_blob_id(CFG)]:
+        assert serde.seeded_blob(CFG, bid, SEED) == blobs[bid], f"blob {bid}"
+
+
+def test_boot_host_path_logits_parity():
+    # Host-RAM blobs (no device staging) boot to bit-identical logits.
+    layers = {bid: blob_layer(b) for bid, b in all_blobs().items()}
+    res = boot_from_layers(CFG, layers)
+    assert res.kind == "full"
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    want = forward_jit(source_params(), tokens, CFG)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(res.logits), np.float32),
+        np.asarray(jax.device_get(want), np.float32),
+    )
+
+
+def test_disseminate_then_boot_full_parity(cpu_devices, monkeypatch):
+    """The round-3 headline test: seed real weight blobs on two seeder
+    nodes, disseminate mode 3 with HBM placement (multi-fragment, so the
+    incremental ingest path runs), boot on StartupMsg, and check
+    bit-for-bit logits parity with the source model — plus the leader's
+    boot_ready / time-to-first-token report."""
+    monkeypatch.setattr(send_mod, "FLOW_FRAGMENT_BYTES", 16 * 1024)
+    blobs = all_blobs()
+    head_id = serde.head_blob_id(CFG)
+
+    mesh = make_mesh((1, 8), ("pp", "tp"))
+    assignment = {3: {bid: LayerMeta() for bid in blobs}}
+    placement = assignment_to_placement(assignment, mesh, "pp")
+
+    ids = range(4)
+    ts = tcp_transports(ids)
+    bw = {i: 10_000_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, assignment, bw,
+        expected_nodes={1, 2, 3},
+    )
+    seeder1 = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]),
+        {bid: blob_layer(blobs[bid]) for bid in range(2)},
+    )
+    seeder2 = FlowRetransmitReceiverNode(
+        Node(2, 0, ts[2]),
+        {bid: blob_layer(blobs[bid]) for bid in range(2, head_id + 1)},
+    )
+    dest = FlowRetransmitReceiverNode(
+        Node(3, 0, ts[3]), {}, stage_hbm=True, placement=placement,
+        boot_cfg=CFG,
+    )
+    receivers = [seeder1, seeder2, dest]
+    try:
+        for r in receivers:
+            r.announce()
+        assert leader.start_distribution().get(timeout=TIMEOUT) == assignment
+        assert leader.ready().get(timeout=TIMEOUT) == assignment
+        dest.ready().get(timeout=TIMEOUT)
+
+        # Leader-side: boot completion reported with per-node timings.
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert set(booted) == {3} and booted[3] > 0
+
+        # The delivered bytes are the source blobs, exactly.
+        for bid, b in blobs.items():
+            assert bytes(dest.layers[bid].inmem_data) == b, f"blob {bid}"
+            assert dest.layers[bid].meta.location == LayerLocation.HBM
+
+        # The booted model is the source model: bit-for-bit logits.
+        res = dest.boot_result
+        assert res is not None and res.kind == "full"
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        want = forward_jit(source_params(), tokens, CFG)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(res.logits), np.float32),
+            np.asarray(jax.device_get(want), np.float32),
+        )
+    finally:
+        leader.close()
+        for r in receivers:
+            r.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_stage_boot_contiguous_slice(cpu_devices):
+    # A node holding a contiguous slice of layers (a pipeline stage) boots
+    # a stage forward over its stacked params.
+    blobs = all_blobs()
+    layers = {bid: blob_layer(blobs[bid]) for bid in (1, 2)}
+    res = boot_from_layers(CFG, layers)
+    assert res.kind == "stage"
+    assert list(res.layer_ids) == [1, 2]
+    assert res.activations.shape == (1, 16, CFG.d_model)
+
+
+def test_boot_rejects_non_contiguous():
+    blobs = all_blobs()
+    layers = {bid: blob_layer(blobs[bid]) for bid in (0, 2)}
+    with pytest.raises(ValueError, match="contiguous"):
+        boot_from_layers(CFG, layers)
