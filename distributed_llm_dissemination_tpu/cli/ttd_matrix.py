@@ -1,0 +1,188 @@
+"""TTD matrix: time-to-deliver across all four modes, recorded.
+
+The reference's primary metric is time-to-deliver, printed per run
+(``/root/reference/cmd/main.go:173-181``) and never recorded anywhere.
+This harness runs the REAL CLI (one OS process per node, loopback TCP —
+the reference's own benchmark shape, ``distributor/node_test.go:275-326``)
+for every mode over the shipped topologies and emits a checked-in matrix,
+including the north-star secondary target: mode 1 (peer retransmission)
+matching mode 0 (leader broadcast) completion time.
+
+    python -m distributed_llm_dissemination_tpu.cli.ttd_matrix \
+        -o TTD_MATRIX.json [-scale BYTES] [-trials N]
+
+Scenarios:
+- ``local_4node``: 4 receivers + leader, 3 dummy layers @1 MiB.
+- ``reference_8node``: the reference benchmark topology (7 seeders co-send
+  one cold node's full model) with LayerSize scaled from 10.18 GiB down to
+  ``-scale`` bytes so the matrix runs on loopback in seconds.  Rates and
+  NIC bandwidths stay at their configured (physical) values — the matrix
+  compares the MODES' scheduling behavior, which scaled-down rates would
+  drown in artificial pacing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+CONF_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "conf")
+_TTD_RE = re.compile(r"Time to deliver: ([0-9.]+)s")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _localize_config(src_path: str, out_path: str,
+                     scale_to: int = 0) -> None:
+    """Rewrite node/client addresses to free loopback ports (the shipped
+    configs use fixed ports that anything else on the host may hold) and,
+    when ``scale_to`` > 0, scale every LayerSize down to loopback-friendly
+    bytes; rates and NIC bandwidths keep their configured (physical)
+    values."""
+    with open(src_path) as f:
+        conf = copy.deepcopy(json.load(f))
+    if scale_to > 0:
+        if "LayerSize" in conf:
+            conf["LayerSize"] = scale_to
+        for n in conf["Nodes"]:
+            for by_layer in (n.get("InitialLayers") or {}).values():
+                for lc in by_layer.values():
+                    if "LayerSize" in lc:
+                        lc["LayerSize"] = scale_to
+    for n in conf["Nodes"]:
+        n["Addr"] = f"127.0.0.1:{_free_port()}"
+    for c in conf.get("Clients") or []:
+        c["Addr"] = f"127.0.0.1:{_free_port()}"
+    with open(out_path, "w") as f:
+        json.dump(conf, f)
+
+
+def run_once(conf_path: str, mode: int, timeout: float = 120.0) -> float:
+    """One full dissemination via the real CLI; returns the leader's TTD."""
+    with open(conf_path) as f:
+        conf = json.load(f)
+    leader_id = next(n["Id"] for n in conf["Nodes"]
+                     if n.get("IsLeader") or n.get("isLeader"))
+    receiver_ids = [n["Id"] for n in conf["Nodes"] if n["Id"] != leader_id]
+    client_ids = [c["Id"] for c in conf.get("Clients") or []]
+
+    def spawn(node_id, extra=()):
+        return subprocess.Popen(
+            [sys.executable, "-m",
+             "distributed_llm_dissemination_tpu.cli.main",
+             "-id", str(node_id), "-f", conf_path, "-m", str(mode), *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        )
+
+    procs = []
+    try:
+        leader = spawn(leader_id)
+        procs.append(leader)
+        time.sleep(0.3)  # listener up before the dial-retry window matters
+        for rid in receiver_ids:
+            procs.append(spawn(rid))
+        for cid in client_ids:
+            procs.append(spawn(cid, ("-c",)))
+        out, _ = leader.communicate(timeout=timeout)
+        m = _TTD_RE.search(out.decode())
+        if not m:
+            raise RuntimeError(
+                f"no TTD in leader output (mode {mode}): {out[-2000:]!r}"
+            )
+        for p in procs[1:]:
+            if p.args[-1] != "-c":  # clients run forever; killed below
+                p.wait(timeout=30)
+        return float(m.group(1))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
+               timeout: float = 120.0) -> dict:
+    with tempfile.TemporaryDirectory() as td:
+        local4 = os.path.join(td, "local_4node.json")
+        _localize_config(os.path.join(CONF_DIR, "local_4node.json"), local4)
+        scaled = os.path.join(td, "reference_8node_scaled.json")
+        _localize_config(os.path.join(CONF_DIR, "reference_8node.json"),
+                         scaled, scale_to=scale)
+        scenarios = {
+            "local_4node": local4,
+            f"reference_8node@{scale >> 20}MiB": scaled,
+        }
+        results: dict = {"scenarios": {}, "scale_bytes": scale,
+                         "trials": trials}
+        for name, path in scenarios.items():
+            per_mode = {}
+            for mode in modes:
+                ts = [run_once(path, mode, timeout) for _ in range(trials)]
+                per_mode[str(mode)] = {
+                    "ttd_s": round(statistics.median(ts), 4),
+                    "all": [round(t, 4) for t in ts],
+                }
+                print(f"{name} mode {mode}: TTD {per_mode[str(mode)]['ttd_s']}s",
+                      file=sys.stderr, flush=True)
+            if "0" in per_mode and "1" in per_mode:
+                per_mode["mode1_vs_mode0"] = round(
+                    per_mode["1"]["ttd_s"] / max(per_mode["0"]["ttd_s"], 1e-9), 3
+                )
+            results["scenarios"][name] = per_mode
+    return results
+
+
+def to_markdown(results: dict) -> str:
+    lines = [
+        "# TTD matrix",
+        "",
+        "Time-to-deliver (median of "
+        f"{results['trials']} runs, real CLI over loopback TCP, one process "
+        "per node). North-star secondary target: mode 1 ≈ mode 0.",
+        "",
+        "| scenario | mode 0 | mode 1 | mode 2 | mode 3 | mode1/mode0 |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, per_mode in results["scenarios"].items():
+        row = [name]
+        for m in ("0", "1", "2", "3"):
+            row.append(f"{per_mode[m]['ttd_s']}s" if m in per_mode else "—")
+        row.append(str(per_mode.get("mode1_vs_mode0", "—")))
+        lines.append("| " + " | ".join(row) + " |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ttd_matrix", prefix_chars="-")
+    p.add_argument("-o", type=str, default="TTD_MATRIX.json")
+    p.add_argument("-scale", type=int, default=8 << 20,
+                   help="scaled LayerSize bytes for the reference scenario")
+    p.add_argument("-trials", type=int, default=3)
+    args = p.parse_args(argv)
+    results = run_matrix(args.scale, args.trials)
+    with open(args.o, "w") as f:
+        json.dump(results, f, indent=1)
+    md = os.path.splitext(args.o)[0] + ".md"
+    with open(md, "w") as f:
+        f.write(to_markdown(results))
+    print(json.dumps(results["scenarios"], indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,12 +63,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.flow_max_flow_at.restype = ctypes.c_int64
     lib.flow_max_flow_at.argtypes = [
         ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64p, i64p,
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, i64p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, i64p,
     ]
     lib.flow_min_time_schedule.restype = ctypes.c_int64
     lib.flow_min_time_schedule.argtypes = [
         ctypes.c_int32, ctypes.c_int32, i32p, i32p, i64p, i64p,
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, i64p, i64p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+        i64p, i64p,
     ]
     return lib
 

@@ -8,11 +8,15 @@
 // better than Edmonds-Karp's O(V E^2) on these dense layered graphs) over
 // an edge list whose capacities are affine in the candidate time t:
 //
-//     cap_i(t) = clamp(cap_const_i + cap_per_t_i * t, 0, INF)
+//     cap_i(t) = clamp(cap_const_i + cap_per_t_i * t / t_scale, 0, INF)
 //
 // which covers every edge class in the flow model: NIC edges (0 + bw*t),
 // source-class edges (0 + rate*t), class->layer edges (INF + 0*t), and
-// layer->receiver edges (size + 0*t).
+// layer->receiver edges (size + 0*t).  t_scale decouples the time
+// granularity from the rate units: rates stay bytes/second while t counts
+// milliseconds (t_scale=1000) — the reference's integer-second search
+// (flow.go:155-187) pads every sub-second plan to 1 s.  Floor division
+// keeps caps integral and monotone in t, so the search is unchanged.
 //
 // Exposed as a plain C ABI for ctypes; no Python.h dependency.
 
@@ -24,9 +28,11 @@ namespace {
 
 constexpr int64_t kInf = int64_t{1} << 62;
 
-// Saturating a + b*t in 128-bit, clamped to [0, kInf].
-inline int64_t affine_cap(int64_t c, int64_t per_t, int64_t t) {
-  __int128 v = (__int128)c + (__int128)per_t * (__int128)t;
+// Saturating a + b*t/scale in 128-bit, clamped to [0, kInf].
+inline int64_t affine_cap(int64_t c, int64_t per_t, int64_t t,
+                          int64_t t_scale) {
+  __int128 v =
+      (__int128)c + (__int128)per_t * (__int128)t / (t_scale > 0 ? t_scale : 1);
   if (v < 0) return 0;
   if (v > (__int128)kInf) return kInf;
   return (int64_t)v;
@@ -102,11 +108,12 @@ struct Dinic {
 // non-null it receives, per original edge, the flow pushed through it.
 int64_t solve_at(int32_t n, int32_t m, const int32_t* eu, const int32_t* ev,
                  const int64_t* cap_const, const int64_t* cap_per_t,
-                 int32_t s, int32_t t_sink, int64_t t, int64_t* out_flows) {
+                 int32_t s, int32_t t_sink, int64_t t, int64_t t_scale,
+                 int64_t* out_flows) {
   Dinic d(n);
   std::vector<int64_t> caps(m);
   for (int32_t i = 0; i < m; ++i) {
-    caps[i] = affine_cap(cap_const[i], cap_per_t[i], t);
+    caps[i] = affine_cap(cap_const[i], cap_per_t[i], t, t_scale);
     d.add_edge(eu[i], ev[i], caps[i], i);
   }
   int64_t flow = d.max_flow(s, t_sink);
@@ -129,8 +136,9 @@ extern "C" {
 int64_t flow_max_flow_at(int32_t n, int32_t m, const int32_t* eu,
                          const int32_t* ev, const int64_t* cap_const,
                          const int64_t* cap_per_t, int32_t s, int32_t t_sink,
-                         int64_t t, int64_t* out_flows) {
-  return solve_at(n, m, eu, ev, cap_const, cap_per_t, s, t_sink, t, out_flows);
+                         int64_t t, int64_t t_scale, int64_t* out_flows) {
+  return solve_at(n, m, eu, ev, cap_const, cap_per_t, s, t_sink, t, t_scale,
+                  out_flows);
 }
 
 // Full scheduler search (flow.go:146-218 equivalent): exponential search for
@@ -143,10 +151,11 @@ int64_t flow_min_time_schedule(int32_t n, int32_t m, const int32_t* eu,
                                const int32_t* ev, const int64_t* cap_const,
                                const int64_t* cap_per_t, int32_t s,
                                int32_t t_sink, int64_t required,
-                               int64_t* out_flows, int64_t* out_achieved) {
+                               int64_t t_scale, int64_t* out_flows,
+                               int64_t* out_achieved) {
   int64_t t_upper = 1;
   while (solve_at(n, m, eu, ev, cap_const, cap_per_t, s, t_sink, t_upper,
-                  nullptr) < required) {
+                  t_scale, nullptr) < required) {
     if (t_upper > kInf / 2) break;  // infeasible: no t can satisfy required
     t_upper *= 2;
   }
@@ -154,7 +163,7 @@ int64_t flow_min_time_schedule(int32_t n, int32_t m, const int32_t* eu,
   int64_t lo = 1, hi = t_upper, best = t_upper;
   while (lo <= hi) {
     int64_t mid = lo + (hi - lo) / 2;
-    if (solve_at(n, m, eu, ev, cap_const, cap_per_t, s, t_sink, mid,
+    if (solve_at(n, m, eu, ev, cap_const, cap_per_t, s, t_sink, mid, t_scale,
                  nullptr) < required) {
       lo = mid + 1;
     } else {
@@ -163,8 +172,8 @@ int64_t flow_min_time_schedule(int32_t n, int32_t m, const int32_t* eu,
     }
   }
 
-  int64_t achieved =
-      solve_at(n, m, eu, ev, cap_const, cap_per_t, s, t_sink, best, out_flows);
+  int64_t achieved = solve_at(n, m, eu, ev, cap_const, cap_per_t, s, t_sink,
+                              best, t_scale, out_flows);
   if (out_achieved != nullptr) *out_achieved = achieved;
   return best;
 }

@@ -42,7 +42,7 @@ from ..core.types import (
     Status,
     delivered,
 )
-from ..sched.flow import FlowJob, FlowJobsMap
+from ..sched.flow import FlowJob, FlowJobsMap, rate_for
 from ..sched.native import make_flow_graph
 from ..transport.messages import (
     AckMsg,
@@ -958,9 +958,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     )
         return out
 
-    def _dispatch(self, min_time: int, self_jobs: FlowJobsMap, jobs: FlowJobsMap) -> None:
+    def _dispatch(self, min_time_ms: int, self_jobs: FlowJobsMap,
+                  jobs: FlowJobsMap) -> None:
         """Send every flow job as a rate-budgeted command
-        (node.go:1237-1288)."""
+        (node.go:1237-1288; the budget comes from the solver's
+        millisecond-granular min time, not the reference's integer
+        seconds)."""
         for dest, job_list in self_jobs.items():
             for job in job_list:
                 rate = self.status.get(job.sender_id, {}).get(
@@ -976,7 +979,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         for sender, job_list in jobs.items():
             for job in job_list:
                 dest = job.dest_id
-                rate = job.data_size // max(1, min_time)
+                rate = rate_for(job.data_size, min_time_ms)
                 log.debug(
                     "dispatching a job",
                     layer=job.layer_id, sender=sender, rate_mibps=rate >> 20,

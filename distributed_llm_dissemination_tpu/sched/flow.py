@@ -15,9 +15,16 @@ on the class→layer edges decompose into per-sender byte-range jobs
 (offset + size) — the multi-sender split of one layer
 (flow.go:146-218).
 
-Deviation from the reference: a sender whose source class has rate limit 0
-("unlimited") gets its NIC bandwidth as the class capacity instead of a
-zero-capacity (unusable) edge.
+Deviations from the reference, on purpose:
+- A sender whose source class has rate limit 0 ("unlimited") gets its NIC
+  bandwidth as the class capacity instead of a zero-capacity (unusable)
+  edge.
+- The completion-time search runs in MILLISECONDS (the reference searches
+  integer seconds, flow.go:155-187, so every sub-second plan is padded to
+  1 s and its jobs paced ~1000× too slow — a v5e-pod-scale dissemination
+  targeting <10 s can't live with 1 s granularity).  Capacities are
+  ``rate × t // 1000`` — floor keeps them integral and monotone in t, so
+  the exponential+binary search is unchanged in shape.
 """
 
 from __future__ import annotations
@@ -30,6 +37,14 @@ from ..core.types import Assignment, LayerID, NodeID, SourceType, Status
 from ..utils.logging import log
 
 _INF = 1 << 62
+
+# Completion time is searched in milliseconds; rates stay bytes/second.
+TIME_SCALE = 1000
+
+
+def rate_for(data_size: int, t_ms: int) -> int:
+    """Bytes/second pacing budget for ``data_size`` over ``t_ms``."""
+    return data_size * TIME_SCALE // max(1, t_ms)
 
 
 @dataclasses.dataclass
@@ -120,10 +135,11 @@ class FlowGraph:
     # ------------------------------------------------------------- capacities
 
     def _class_capacity(self, node_id: NodeID, limit_rate: int, t: int) -> int:
+        """Bytes deliverable by this source class in ``t`` ms."""
         if limit_rate > 0:
-            return limit_rate * t
+            return limit_rate * t // TIME_SCALE
         # Unlimited source class: NIC bandwidth is the real ceiling.
-        return self.node_network_bw.get(node_id, 0) * t
+        return self.node_network_bw.get(node_id, 0) * t // TIME_SCALE
 
     def _pair_size(self, layer_id: LayerID, dest: NodeID) -> int:
         """Bytes still needed by ``dest`` for ``layer_id``."""
@@ -142,7 +158,9 @@ class FlowGraph:
 
         for node_id, layer_metas in self.status.items():
             sender = self.idx[_V("sender", node_id=node_id)]
-            self.cap[src][sender] = self.node_network_bw.get(node_id, 0) * t
+            self.cap[src][sender] = (
+                self.node_network_bw.get(node_id, 0) * t // TIME_SCALE
+            )
             for layer_id, meta in layer_metas.items():
                 dests = self.dests_of.get(layer_id, ())
                 if not dests:
@@ -169,7 +187,9 @@ class FlowGraph:
             for layer_id in layer_ids:
                 layer = self.idx[_V("layer", layer_id=layer_id, node_id=node_id)]
                 self.cap[layer][receiver] = self._pair_size(layer_id, node_id)
-            self.cap[receiver][sink] = self.node_network_bw.get(node_id, 0) * t
+            self.cap[receiver][sink] = (
+                self.node_network_bw.get(node_id, 0) * t // TIME_SCALE
+            )
 
     # --------------------------------------------------------------- max-flow
 
@@ -216,8 +236,8 @@ class FlowGraph:
     # ------------------------------------------------------------ scheduling
 
     def get_job_assignment(self) -> Tuple[int, FlowJobsMap]:
-        """Minimum feasible completion time + per-sender byte-range jobs
-        (flow.go:146-218)."""
+        """Minimum feasible completion time (MILLISECONDS) + per-sender
+        byte-range jobs (flow.go:146-218, at 1000× finer granularity)."""
         required = sum(self._pair_size(lid, dest) for lid, dest in self.pairs)
 
         t_upper = 1
@@ -259,5 +279,5 @@ class FlowGraph:
                         )
                         pair_offset[(layer_id, dest)] = offset + flow
 
-        log.info("job assignment calculated", min_time_s=t)
+        log.info("job assignment calculated", min_time_ms=t)
         return t, jobs

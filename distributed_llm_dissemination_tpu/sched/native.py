@@ -23,7 +23,7 @@ from typing import Dict, List, Tuple
 from ..core.types import Assignment, LayerID, NodeID, Status
 from ..native import load_flow_solver
 from ..utils.logging import log
-from .flow import FlowGraph, FlowJob, FlowJobsMap, _INF, _V
+from .flow import TIME_SCALE, FlowGraph, FlowJob, FlowJobsMap, _INF, _V
 
 
 class NativeFlowGraph(FlowGraph):
@@ -66,8 +66,9 @@ class NativeFlowGraph(FlowGraph):
                 ]
                 # Class-edge rate: max across the class's layers, matching
                 # FlowGraph._build (rates belong to the source class).
-                # _class_capacity at t=1 is exactly the per-second rate.
-                rate = self._class_capacity(node_id, meta.limit_rate, 1)
+                # _class_capacity at t=TIME_SCALE (one full second of ms)
+                # is exactly the per-second rate.
+                rate = self._class_capacity(node_id, meta.limit_rate, TIME_SCALE)
                 if (sender, cls) not in class_edge:
                     class_edge[(sender, cls)] = len(eu)
                     eu.append(sender)
@@ -121,7 +122,7 @@ class NativeFlowGraph(FlowGraph):
         t = lib.flow_min_time_schedule(
             self.n, m, a_eu, a_ev, a_const, a_per_t,
             self.idx[_V("source")], self.idx[_V("sink")],
-            required, flows, ctypes.byref(achieved),
+            required, TIME_SCALE, flows, ctypes.byref(achieved),
         )
         if achieved.value < required:
             log.error("flow schedule infeasible",
@@ -145,7 +146,7 @@ class NativeFlowGraph(FlowGraph):
 
         log.info(
             "job assignment calculated (native)",
-            min_time_s=t,
+            min_time_ms=t,
             solver_ms=round((time.monotonic() - t0) * 1000, 3),
         )
         return t, jobs

@@ -41,7 +41,8 @@ def check_tiling(jobs, layer_sizes):
 
 @pytest.mark.parametrize("solver", SOLVERS)
 def test_single_sender_min_time(solver):
-    # One sender at 100 B/s NIC, one 100-B layer -> t = 1 s.
+    # One sender at 100 B/s NIC, one 100-B layer -> t = 1000 ms (the
+    # solver's time axis is milliseconds).
     g = solver(
         assignment={1: {0: _meta()}},
         status={0: {0: _meta(rate=100)}},
@@ -49,14 +50,14 @@ def test_single_sender_min_time(solver):
         node_network_bw={0: 100, 1: 100},
     )
     t, jobs = g.get_job_assignment()
-    assert t == 1
+    assert t == 1000
     assert jobs[0][0].data_size == 100 and jobs[0][0].offset == 0
 
 
 @pytest.mark.parametrize("solver", SOLVERS)
 def test_two_senders_split_layer(solver):
     # Two seeders, each 100 B/s, receiver NIC 200 B/s, 200-B layer:
-    # optimal t = 1 s with the layer split across both senders.
+    # optimal t = 1000 ms with the layer split across both senders.
     g = solver(
         assignment={2: {0: _meta()}},
         status={0: {0: _meta(rate=100)}, 1: {0: _meta(rate=100)}},
@@ -64,13 +65,13 @@ def test_two_senders_split_layer(solver):
         node_network_bw={0: 100, 1: 100, 2: 200},
     )
     t, jobs = g.get_job_assignment()
-    assert t == 1
+    assert t == 1000
     check_tiling(jobs, {0: 200})
 
 
 @pytest.mark.parametrize("solver", SOLVERS)
 def test_heterogeneous_rates_proportional_split(solver):
-    # 10 B/s + 90 B/s senders, 100-B layer, receiver 100 B/s -> t=1,
+    # 10 B/s + 90 B/s senders, 100-B layer, receiver 100 B/s -> t=1000 ms,
     # bytes split proportional to rates.
     g = solver(
         assignment={2: {0: _meta()}},
@@ -79,7 +80,7 @@ def test_heterogeneous_rates_proportional_split(solver):
         node_network_bw={0: 100, 1: 100, 2: 100},
     )
     t, jobs = g.get_job_assignment()
-    assert t == 1
+    assert t == 1000
     sizes = {s: sum(j.data_size for j in js) for s, js in jobs.items()}
     assert sizes.get(0, 0) <= 10
     assert sizes.get(1, 0) >= 90
@@ -88,7 +89,7 @@ def test_heterogeneous_rates_proportional_split(solver):
 @pytest.mark.parametrize("solver", SOLVERS)
 def test_receiver_nic_bound(solver):
     # Plenty of senders but the receiver NIC (100 B/s) is the bottleneck
-    # for 800 B -> t = 8 s.
+    # for 800 B -> t = 8000 ms.
     status = {i: {0: _meta(rate=1000)} for i in range(4)}
     g = solver(
         assignment={9: {0: _meta()}},
@@ -97,7 +98,7 @@ def test_receiver_nic_bound(solver):
         node_network_bw={**{i: 1000 for i in range(4)}, 9: 100},
     )
     t, _ = g.get_job_assignment()
-    assert t == 8
+    assert t == 8000
 
 
 @pytest.mark.parametrize("solver", SOLVERS)
@@ -111,14 +112,14 @@ def test_unlimited_rate_uses_nic_bw(solver):
         node_network_bw={0: 100, 1: 100},
     )
     t, jobs = g.get_job_assignment()
-    assert t == 5
+    assert t == 5000
     assert jobs[0][0].data_size == 500
 
 
 @pytest.mark.parametrize("solver", SOLVERS)
 def test_multiple_layers_multiple_receivers(solver):
     # 2 layers to 2 different receivers from one seeder at 100 B/s:
-    # 200 B total -> t = 2 s.
+    # 200 B total -> t = 2000 ms.
     g = solver(
         assignment={1: {0: _meta()}, 2: {1: _meta()}},
         status={0: {0: _meta(rate=100), 1: _meta(rate=100)}},
@@ -126,7 +127,7 @@ def test_multiple_layers_multiple_receivers(solver):
         node_network_bw={0: 100, 1: 100, 2: 100},
     )
     t, jobs = g.get_job_assignment()
-    assert t == 2
+    assert t == 2000
     total = sum(j.data_size for js in jobs.values() for j in js)
     assert total == 200
 
@@ -189,7 +190,7 @@ def test_native_matches_python_on_random_instances():
 def test_multi_dest_replication(solver):
     # One layer assigned to TWO receivers (PP-stage replication) — the
     # reference errors on this (node.go:1078, :1092).  One seeder at
-    # 100 B/s must send 2 x 100 B -> t = 2 s, with per-dest full copies.
+    # 100 B/s must send 2 x 100 B -> t = 2000 ms, with per-dest full copies.
     g = solver(
         assignment={1: {0: _meta()}, 2: {0: _meta()}},
         status={0: {0: _meta(rate=100)}},
@@ -197,7 +198,7 @@ def test_multi_dest_replication(solver):
         node_network_bw={0: 200, 1: 100, 2: 100},
     )
     t, jobs = g.get_job_assignment()
-    assert t == 2
+    assert t == 2000
     by_dest = {}
     for js in jobs.values():
         for j in js:
@@ -219,8 +220,8 @@ def test_multi_dest_multi_sender_split(solver):
         node_network_bw={0: 100, 1: 100, 2: 100, 3: 100},
     )
     t, jobs = g.get_job_assignment()
-    # 400 B total through 200 B/s of sender capacity -> t = 2 s.
-    assert t == 2
+    # 400 B total through 200 B/s of sender capacity -> t = 2000 ms.
+    assert t == 2000
     for dest in (2, 3):
         chunks = [j for js in jobs.values() for j in js if j.dest_id == dest]
         spans = sorted((c.offset, c.offset + c.data_size) for c in chunks)
@@ -232,8 +233,9 @@ def test_multi_dest_multi_sender_split(solver):
 @pytest.mark.parametrize("solver", SOLVERS)
 def test_remaining_override_plans_partial_bytes(solver):
     # Resume support in the solver itself: dest 1 already holds 75 of the
-    # 100 bytes, dest 2 needs all 100 -> 125 B at 100 B/s -> t = 2
-    # (integer time), with dest 1 planned for exactly 25 bytes.
+    # 100 bytes, dest 2 needs all 100 -> 125 B at 100 B/s -> exactly
+    # 1250 ms (millisecond granularity: no padding to a whole second),
+    # with dest 1 planned for exactly 25 bytes.
     g = solver(
         assignment={1: {0: _meta()}, 2: {0: _meta()}},
         status={0: {0: _meta(rate=100)}},
@@ -242,7 +244,7 @@ def test_remaining_override_plans_partial_bytes(solver):
         remaining={(0, 1): 25},
     )
     t, jobs = g.get_job_assignment()
-    assert t == 2
+    assert t == 1250
     sizes = {}
     for js in jobs.values():
         for j in js:
@@ -269,5 +271,6 @@ def test_native_pod_scale_schedule():
     g = NativeFlowGraph(assignment, status, sizes, bw)
     t, jobs = g.get_job_assignment()
     check_tiling(jobs, sizes)
-    # Receiver NIC is the bottleneck: 80 * 1.75e9 / 1.5625e9 = 89.6 -> 90 s.
-    assert t == 90
+    # Receiver NIC is the bottleneck: 80 * 1.75e9 / 1.5625e9 = 89.6 s —
+    # exactly 89600 ms (the reference's integer-second search pads to 90).
+    assert t == 89600

@@ -1,0 +1,59 @@
+"""TTD matrix harness: CI-runnable slice of the recorded benchmark.
+
+The full matrix (modes 0-3 × both scenarios × 3 trials) is run offline and
+checked in as TTD_MATRIX.json/md; here the harness itself is exercised —
+real CLI subprocesses over loopback — on the cheap slice, including the
+north-star secondary target (mode 1 ≈ mode 0).
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_llm_dissemination_tpu.cli import ttd_matrix as tm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def local4(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("ttd") / "local_4node.json")
+    tm._localize_config(os.path.join(tm.CONF_DIR, "local_4node.json"), out)
+    return out
+
+
+def test_run_once_reports_ttd(local4):
+    ttd = tm.run_once(local4, mode=0, timeout=60)
+    assert 0 < ttd < 30
+
+
+def test_mode1_close_to_mode0(local4):
+    # The north-star secondary target.  Loopback timings jitter, so the
+    # assertion is a loose envelope — the recorded matrix (TTD_MATRIX.json)
+    # holds the measured ratios.
+    t0 = tm.run_once(local4, mode=0, timeout=60)
+    t1 = tm.run_once(local4, mode=1, timeout=60)
+    assert t1 <= t0 * 3 + 0.05, f"mode1 {t1}s far above mode0 {t0}s"
+
+
+def test_mode3_not_padded_to_a_second(local4):
+    # The millisecond-granular flow solver: a 3x1MiB dissemination must
+    # not be paced to the reference's 1-second integer-time floor.
+    t3 = tm.run_once(local4, mode=3, timeout=60)
+    assert t3 < 0.5, f"mode 3 TTD {t3}s looks 1s-padded"
+
+
+def test_checked_in_matrix_is_current():
+    # The recorded matrix must exist, parse, and hold the north-star
+    # mode1/mode0 ratio for the reference scenario.
+    path = os.path.join(REPO, "TTD_MATRIX.json")
+    with open(path) as f:
+        results = json.load(f)
+    scenarios = results["scenarios"]
+    assert "local_4node" in scenarios
+    ref = next(v for k, v in scenarios.items()
+               if k.startswith("reference_8node"))
+    for mode in ("0", "1", "2", "3"):
+        assert ref[mode]["ttd_s"] > 0
+    assert ref["mode1_vs_mode0"] <= 1.5, ref
