@@ -8,8 +8,13 @@ Re-design of the reference's ``TcpTransport``
   a per-connection write lock (the reference instead streams back-to-back
   JSON objects, transport.go:100-124).
 - **Data plane**: a ``LayerMsg`` travels as an envelope whose payload is the
-  ``LayerHeader``, followed by exactly ``layer_size`` raw bytes — on a fresh
-  connection per transfer for parallelism (transport.go:267-274).
+  ``LayerHeader``, followed by exactly ``layer_size`` raw bytes — on a
+  per-destination POOLED data connection: sequential transfers (a flow
+  job's 16 MiB fragments) share one connection instead of paying a
+  handshake + slow-start per fragment, while concurrent transfers still
+  fan out over as many connections as are in flight.  (The reference dials
+  fresh per transfer, transport.go:267-274 — fine for whole-layer sends,
+  ~640 dials for a fragmented 10 GiB flow job.)
 - In-memory layers are paced by a token bucket (transport.go:407-424); disk
   layers go out via ``socket.sendfile`` — the zero-copy path matching the
   reference's ``io.Copy(SectionReader)`` sendfile (transport.go:357-367).
@@ -127,6 +132,10 @@ class TcpTransport(Transport):
         self.is_client = is_client
         self._queue: "queue.Queue[Message]" = queue.Queue(maxsize=buf_size)
         self._conns: Dict[str, _PConn] = {}
+        # dest addr -> idle data connections (LIFO: the hottest conn has
+        # the widest cwnd).  Checked out per layer transfer, returned
+        # after a clean send; never shared concurrently.
+        self._data_pool: Dict[str, list] = {}
         self._accepted: "set[socket.socket]" = set()
         self._pipes: Dict[LayerID, NodeID] = {}
         self._lock = threading.Lock()
@@ -274,12 +283,7 @@ class TcpTransport(Transport):
             raise KeyError(f"addr of {dest_id} does not exist")
 
         if isinstance(message, LayerMsg):
-            # Fresh connection per layer transfer (transport.go:267-274).
-            sock = _dial(_parse_addr(dest), self._closed)
-            try:
-                self._send_layer(sock, message)
-            finally:
-                sock.close()
+            self._send_layer_pooled(dest, message)
             return
 
         envelope = {
@@ -302,6 +306,48 @@ class TcpTransport(Transport):
                 self._evict(dest, pconn)
                 if attempt == 1:
                     raise
+
+    def _send_layer_pooled(self, dest: str, message: LayerMsg) -> None:
+        """One layer transfer over a pooled data connection.
+
+        A pooled connection may be stale (peer restarted while it idled):
+        the first attempt may fail mid-stream, in which case the transfer
+        retries once on a FRESH dial.  A half-sent fragment on the dead
+        connection is harmless — the receiver drops partial bodies on
+        connection error, and interval reassembly tolerates the re-send.
+        """
+        for attempt in (0, 1):
+            fresh = attempt == 1
+            sock = None
+            try:
+                sock = (self._dial_data(dest) if fresh
+                        else self._acquire_data_conn(dest))
+                self._send_layer(sock, message)
+            except OSError:
+                if sock is not None:
+                    sock.close()  # state unknown: never pool a broken conn
+                if fresh:
+                    raise
+                continue
+            self._release_data_conn(dest, sock)
+            return
+
+    def _dial_data(self, dest: str) -> socket.socket:
+        return _dial(_parse_addr(dest), self._closed)
+
+    def _acquire_data_conn(self, dest: str) -> socket.socket:
+        with self._lock:
+            pool = self._data_pool.get(dest)
+            if pool:
+                return pool.pop()
+        return self._dial_data(dest)
+
+    def _release_data_conn(self, dest: str, sock: socket.socket) -> None:
+        with self._lock:
+            if not self._closed.is_set():
+                self._data_pool.setdefault(dest, []).append(sock)
+                return
+        sock.close()
 
     def _send_layer(self, sock: socket.socket, message: LayerMsg) -> None:
         """Header then raw body (transport.go:308-373)."""
@@ -402,8 +448,15 @@ class TcpTransport(Transport):
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
+            pooled = [s for pool in self._data_pool.values() for s in pool]
+            self._data_pool.clear()
             accepted = list(self._accepted)
             self._accepted.clear()
+        for sock in pooled:
+            try:
+                sock.close()
+            except OSError:
+                pass
         for pconn in conns:
             try:
                 if pconn.sock is not None:

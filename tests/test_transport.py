@@ -289,3 +289,69 @@ def test_control_conn_recovers_after_peer_restart():
     finally:
         t0.close()
         t1.close()
+
+
+def test_data_connection_pooling(monkeypatch):
+    """Sequential layer transfers to one dest share ONE pooled data
+    connection (a flow job's fragments used to dial per fragment —
+    handshake + slow-start per 16 MiB); the payloads still arrive intact
+    and in order."""
+    from distributed_llm_dissemination_tpu.transport import tcp as tcp_mod
+
+    dials = []
+    real_dial = tcp_mod._dial
+
+    def counting_dial(addr, closed):
+        dials.append(addr)
+        return real_dial(addr, closed)
+
+    monkeypatch.setattr(tcp_mod, "_dial", counting_dial)
+    ts = make_transports("tcp", 2)
+    try:
+        full = b"".join(bytes([i]) * 1024 for i in range(5))
+        for i in range(5):
+            # A fragment send slices [offset, offset+size) of the full
+            # layer buffer — the shape runtime/send.py produces.
+            ts[0].send(1, LayerMsg(
+                0, 7,
+                LayerSrc(inmem_data=bytearray(full), data_size=1024,
+                         offset=i * 1024,
+                         meta=LayerMeta(location=LayerLocation.INMEM)),
+                5 * 1024,
+            ))
+        for i in range(5):
+            got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+            assert bytes(got.layer_src.inmem_data) == bytes([i]) * 1024
+            assert got.layer_src.offset == i * 1024
+        assert len(dials) == 1, f"expected 1 data dial, saw {len(dials)}"
+    finally:
+        close_all(ts)
+
+
+def test_data_pool_retries_stale_connection():
+    """A pooled connection whose peer died must not lose the transfer:
+    the send retries once on a fresh dial."""
+    ts = make_transports("tcp", 2)
+    try:
+        def send_one(tag):
+            ts[0].send(1, LayerMsg(
+                0, 3,
+                LayerSrc(inmem_data=bytearray(tag), data_size=len(tag),
+                         offset=0,
+                         meta=LayerMeta(location=LayerLocation.INMEM)),
+                len(tag),
+            ))
+
+        send_one(b"first")
+        assert bytes(ts[1].deliver().get(timeout=RECV_TIMEOUT)
+                     .layer_src.inmem_data) == b"first"
+        # Kill the pooled connection under the sender's feet.
+        with ts[0]._lock:
+            (pool,) = ts[0]._data_pool.values()
+            assert len(pool) == 1
+            pool[0].close()
+        send_one(b"second")
+        assert bytes(ts[1].deliver().get(timeout=RECV_TIMEOUT)
+                     .layer_src.inmem_data) == b"second"
+    finally:
+        close_all(ts)
