@@ -274,6 +274,18 @@ def main(argv=None) -> int:
         ulog.log.info("layer set up")
         return 0
 
+    if conf.mesh is not None and conf.mesh.fabric:
+        # One OS process per node cannot share an in-process FabricPlane;
+        # refusing beats silently running the TCP data plane the config
+        # opted out of.
+        raise SystemExit(
+            "config has Mesh.Fabric=true: the pod-fabric data plane runs "
+            "all nodes under one controller — use "
+            "`python -m distributed_llm_dissemination_tpu.cli.podrun "
+            f"-f {args.f} -m {args.m}` (or drop the Fabric flag to run "
+            "per-node processes over TCP)"
+        )
+
     addr_registry = {nc.id: nc.addr for nc in conf.nodes}
     if my_client_conf is not None:
         addr_registry[CLIENT_ID] = my_client_conf.addr

@@ -1,0 +1,179 @@
+"""Single-controller pod driver: one process, whole mesh, fabric data plane.
+
+The deployment shape the reference cannot express: its data plane is one OS
+process per node streaming TCP (``/root/reference/cmd/main.go:113-146``,
+``distributor/transport.go:267-274``).  On a TPU pod under a single
+controller, one Python process addresses every chip — so this driver hosts
+ALL the topology's nodes in-process (control plane on the in-memory
+transport), maps each node to a pipeline stage of the configured device
+mesh, and lets every scheduled layer transfer ride the device fabric
+(``parallel/fabric.py``): seeders upload their planned byte ranges to their
+own stage's HBM, destinations ingest them over ICI.  No layer byte ever
+touches a socket.
+
+    python -m distributed_llm_dissemination_tpu.cli.podrun -f conf.json -m 3
+
+Prints the reference's "Time to deliver" (cmd/main.go:173-181) and one
+machine-readable JSON summary line.  For multi-process/multi-host
+deployments use ``cli.main`` (TCP data plane) — the SPMD fabric across
+processes needs ``jax.distributed`` mesh formation; see the README runbook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict
+
+from ..core import config as cfg
+from ..runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    LeaderNode,
+    Node,
+    PullRetransmitLeaderNode,
+    ReceiverNode,
+    RetransmitLeaderNode,
+    RetransmitReceiverNode,
+)
+from ..transport.inmem import InmemTransport
+from ..utils import logging as ulog
+
+_LEADERS = {
+    0: LeaderNode,
+    1: RetransmitLeaderNode,
+    2: PullRetransmitLeaderNode,
+    3: FlowRetransmitLeaderNode,
+}
+_RECEIVERS = {
+    0: ReceiverNode,
+    1: RetransmitReceiverNode,
+    2: RetransmitReceiverNode,
+    3: FlowRetransmitReceiverNode,
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="podrun", description=__doc__,
+                                prefix_chars="-")
+    p.add_argument("-f", type=str, required=True,
+                   help="filename of topology JSON file (Mesh section "
+                        "required; Fabric implied)")
+    p.add_argument("-m", type=int, default=3, choices=[0, 1, 2, 3],
+                   help="0: naive, 1: retransmit, 2: pull, 3: max-flow")
+    p.add_argument("-boot", type=str, default="",
+                   help="model config name: boot the model from the "
+                        "fabric-delivered blobs and report TTFT")
+    p.add_argument("-v", action="store_true", help="output debug messages")
+    return p
+
+
+def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
+            timeout: float = 600.0) -> Dict[str, float]:
+    """Drive one full pod dissemination; returns the timing summary.
+
+    Callable from tests/benchmarks; the fabric and placement span every
+    configured node (seeders contribute from their own stages)."""
+    if conf.mesh is None:
+        raise SystemExit("podrun needs a Mesh section in the config")
+    # Honor JAX_PLATFORMS even where a site hook (e.g. the axon TPU
+    # plugin's sitecustomize) imported jax at interpreter start: the
+    # backend isn't initialized until first use, which happens below.
+    import os as _os
+
+    import jax as _jax
+
+    want = _os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            _jax.config.update("jax_platforms", want)
+        except RuntimeError:
+            pass  # backend already initialized; leave as-is
+    from ..parallel.fabric import FabricPlane
+    from ..parallel.mesh import fabric_placement, mesh_from_conf
+
+    mesh = mesh_from_conf(conf.mesh)
+    node_ids = [nc.id for nc in conf.nodes]
+    placement = fabric_placement(node_ids, conf.assignment, mesh,
+                                 conf.mesh.pipeline_axis)
+    fabric = FabricPlane()
+    ulog.log.info("pod fabric up",
+                  mesh={n: s for n, s in zip(conf.mesh.axis_names,
+                                             conf.mesh.axis_sizes)},
+                  stages={str(n): s for n, s in placement.node_to_stage.items()})
+
+    transports = {
+        nc.id: InmemTransport(str(nc.id),
+                              addr_registry={i: str(i) for i in node_ids})
+        for nc in conf.nodes
+    }
+    leader_conf = cfg.get_leader_conf(conf)
+    boot_cfg = None
+    if boot or conf.model:
+        from ..models.llama import CONFIGS
+
+        boot_cfg = CONFIGS[boot or conf.model]
+
+    leader = None
+    receivers = []
+    try:
+        for nc in conf.nodes:
+            layers = cfg.create_layers(nc, save_disk=False,
+                                       model=conf.model,
+                                       model_seed=conf.model_seed)
+            node = Node(nc.id, leader_conf.id, transports[nc.id])
+            if nc.id == leader_conf.id:
+                kwargs = dict(expected_nodes=set(node_ids),
+                              fabric=fabric, placement=placement)
+                if mode == 3:
+                    bw = {n.id: n.network_bw for n in conf.nodes}
+                    leader = _LEADERS[3](node, layers, conf.assignment, bw,
+                                         **kwargs)
+                else:
+                    leader = _LEADERS[mode](node, layers, conf.assignment,
+                                            **kwargs)
+            else:
+                receivers.append(_RECEIVERS[mode](
+                    node, layers, fabric=fabric, placement=placement,
+                    boot_cfg=boot_cfg,
+                ))
+        for r in receivers:
+            r.announce()
+        leader.start_distribution().get(timeout=timeout)
+        t0 = time.monotonic()
+        leader.ready().get(timeout=timeout)
+        ttd = time.monotonic() - t0
+        ulog.log.info("Time to deliver", seconds=round(ttd, 6))
+        print(f"Time to deliver: {ttd:.6f}s", flush=True)
+        summary = {"mode": mode, "ttd_s": round(ttd, 6),
+                   "nodes": len(node_ids), "fabric": True}
+        if boot_cfg is not None:
+            booted = leader.boot_ready().get(timeout=timeout)
+            ttft = time.monotonic() - t0
+            ulog.log.info("Time to first token", seconds=round(ttft, 6))
+            print(f"Time to first token: {ttft:.6f}s", flush=True)
+            summary["ttft_s"] = round(ttft, 6)
+            summary["boot_nodes"] = len(booted)
+        print(json.dumps(summary), flush=True)
+        return summary
+    finally:
+        if leader is not None:
+            leader.close()
+        for r in receivers:
+            r.close()
+        for t in transports.values():
+            t.close()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    ulog.configure(node="pod", verbose=args.v)
+    conf = cfg.read_json(args.f)
+    run_pod(conf, mode=args.m, boot=args.boot)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

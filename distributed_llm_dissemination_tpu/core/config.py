@@ -49,6 +49,11 @@ class MeshConf:
     axis_names: List[str] = dataclasses.field(default_factory=lambda: ["nodes"])
     axis_sizes: List[int] = dataclasses.field(default_factory=lambda: [1])
     pipeline_axis: str = "nodes"
+    # Pod fabric: all nodes are stages of ONE device mesh, and scheduled
+    # layer transfers move as device traffic (ICI) instead of TCP streams
+    # (parallel/fabric.py); TCP carries only the control plane.  Run with
+    # cli.podrun (single controller addresses the whole mesh).
+    fabric: bool = False
 
     @classmethod
     def from_json(cls, d: dict) -> "MeshConf":
@@ -56,6 +61,7 @@ class MeshConf:
             axis_names=list(_jget(d, "AxisNames", ["nodes"])),
             axis_sizes=[int(s) for s in _jget(d, "AxisSizes", [1])],
             pipeline_axis=_jget(d, "PipelineAxis", "nodes"),
+            fabric=bool(_jget(d, "Fabric", False)),
         )
 
 

@@ -143,9 +143,32 @@ class LayerSrc:
             with open(self.fp, "rb") as f:
                 f.seek(self.offset)
                 return f.read(self.data_size)
+        if self.ensure_host_bytes():
+            return bytes(
+                memoryview(self.inmem_data)[self.offset : self.offset + self.data_size]
+            )
         raise ValueError(
             f"layer has no host-readable bytes (location={self.meta.location!r})"
         )
+
+    def ensure_host_bytes(self) -> bool:
+        """Materialize a host copy of an HBM-only layer (e.g. delivered
+        over the pod fabric, where no host copy ever existed) from its
+        device array — one device→host fetch, cached in ``inmem_data`` so
+        re-serving the layer to peers or assembling it at boot doesn't
+        re-fetch.  Returns whether host bytes are now available.  Benign
+        under races: concurrent callers fetch identical content."""
+        if self.inmem_data is not None:
+            return True
+        if self.device_array is None:
+            return False
+        import jax
+        import numpy as np
+
+        self.inmem_data = bytearray(
+            np.asarray(jax.device_get(self.device_array)).tobytes()
+        )
+        return True
 
 
 # Reference: distributor/node.go:166 — node's layer store.

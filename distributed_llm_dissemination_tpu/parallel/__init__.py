@@ -6,9 +6,11 @@ from .collectives import (  # noqa: F401
     ring_broadcast,
     shard_along,
 )
+from .fabric import FabricPlane  # noqa: F401
 from .mesh import (  # noqa: F401
     StagePlacement,
     assignment_to_placement,
+    fabric_placement,
     make_mesh,
     mesh_from_conf,
 )

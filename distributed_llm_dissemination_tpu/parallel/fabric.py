@@ -1,0 +1,114 @@
+"""Pod-fabric data plane: layer bytes ride the device fabric, not TCP.
+
+The north-star replacement for the reference's inter-node data plane
+(``/root/reference/distributor/transport.go:267-274, 308-373``): when every
+node of a topology is a stage of ONE device mesh (a TPU pod), a scheduled
+layer transfer needs no socket stream at all.  The leader turns its plan
+into a ``DevicePlanMsg`` — a small control message listing per-sender byte
+ranges — and the bytes move as device traffic:
+
+1. each *seeder* uploads exactly its planned byte range onto its own
+   stage's devices (the host→HBM hop it would have paid to serve a TCP
+   send anyway),
+2. the *destination* pulls every contribution into its stage's shard
+   buffers — a device-to-device transfer that rides ICI on real hardware —
+   and one tiled all-gather replicates the finished layer within the stage
+   (``parallel.ingest.ShardedLayerIngest`` fed device arrays).
+
+TCP carries only the control plane (announce/plan/ack/startup), exactly
+the split SURVEY §1 calls the key design idea to preserve.
+
+``FabricPlane`` is the rendezvous between the two halves.  Under a single
+controller (one process addressing the whole mesh — the virtual-device
+test topology, or a single-process pod driver) it is an in-process
+registry: publish/collect by plan id.  Under multi-controller SPMD the
+same hand-off is the compiled collective itself (every process enters
+``jax.jit`` with its local shards); that path needs ``jax.distributed``
+mesh formation first (see ``parallel/multihost.py``) and is documented in
+the README runbook rather than wired here.
+
+A sender serving the same layer to two destinations publishes one
+contribution per plan (each dest's plan has its own id) — the fabric
+analogue of the reference opening one fresh connection per transfer
+(transport.go:267-274).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, List, Tuple
+
+
+class FabricPlane:
+    """In-process publish/collect rendezvous for device-plan transfers.
+
+    Contributions are ``(byte_offset, uint8 device array)`` pairs keyed by
+    plan id.  ``collect`` yields them *as they arrive*, so a destination
+    overlaps its ICI ingest with later senders' host→HBM uploads instead
+    of waiting for the full set."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # plan_id -> list of (offset, device array)
+        self._contribs: Dict[str, List[Tuple[int, object]]] = {}
+        # plan_id -> last-publish monotonic time, for stale-plan GC (a plan
+        # whose dest died would otherwise pin device buffers forever).
+        self._touched: Dict[str, float] = {}
+
+    def publish(self, plan_id: str, offset: int, arr) -> None:
+        """Sender side: register one device-resident byte-range fragment."""
+        with self._cond:
+            self._contribs.setdefault(plan_id, []).append((offset, arr))
+            self._touched[plan_id] = time.monotonic()
+            self._cond.notify_all()
+
+    def collect(
+        self, plan_id: str, count: int, timeout: float = 120.0
+    ) -> Iterator[Tuple[int, object]]:
+        """Destination side: yield ``count`` contributions as they arrive.
+
+        Raises ``TimeoutError`` if the remaining contributions don't show
+        up in time (a crashed seeder — the leader's failure detector will
+        re-plan; the superseding plan has a fresh id).  The plan's entries
+        are discarded once fully consumed; abandon via ``discard``."""
+        got = 0
+        deadline = time.monotonic() + timeout
+        while got < count:
+            with self._cond:
+                while len(self._contribs.get(plan_id, ())) <= got:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise TimeoutError(
+                            f"plan {plan_id}: {got}/{count} contributions "
+                            f"after {timeout}s"
+                        )
+                    self._cond.wait(left)
+                fresh = list(self._contribs[plan_id][got:])
+            for item in fresh:
+                yield item
+                got += 1
+        self.discard(plan_id)
+
+    def discard(self, plan_id: str) -> None:
+        """Drop a plan's buffered contributions (frees their device
+        arrays once the consumer releases its references)."""
+        with self._cond:
+            self._contribs.pop(plan_id, None)
+            self._touched.pop(plan_id, None)
+
+    def gc(self, max_age: float = 600.0) -> int:
+        """Drop plans idle longer than ``max_age`` seconds; returns how
+        many were dropped.  Cheap enough to call opportunistically."""
+        cutoff = time.monotonic() - max_age
+        with self._cond:
+            stale = [p for p, ts in self._touched.items() if ts < cutoff]
+            for p in stale:
+                self._contribs.pop(p, None)
+                self._touched.pop(p, None)
+        return len(stale)
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._contribs)

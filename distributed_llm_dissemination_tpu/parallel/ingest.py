@@ -115,10 +115,23 @@ class ShardedLayerIngest:
                 self._bufs.append(jnp.zeros(self.pad, dtype=jnp.uint8))
 
     def write(self, offset: int, data) -> None:
-        """Cut ``data`` (bytes at absolute byte ``offset``) against the
-        device tiling; DMA each piece to its device's shard buffer."""
-        data = memoryview(data)
-        end = offset + len(data)
+        """Cut ``data`` (at absolute byte ``offset``) against the device
+        tiling; move each piece to its device's shard buffer.
+
+        ``data`` is either a host buffer (bytes/bytearray/memoryview —
+        the TCP receive path: pieces are host→device DMAs) or a 1-D uint8
+        ``jax.Array`` already resident on some device (the pod-fabric
+        path, ``parallel/fabric.py``: pieces are device→device transfers,
+        which ride ICI on real hardware — the host link carries nothing)."""
+        is_device = isinstance(data, jax.Array)
+        if is_device:
+            if data.ndim != 1 or data.dtype != np.uint8:
+                raise ValueError("device fragments must be 1-D uint8")
+            length = int(data.shape[0])
+        else:
+            data = memoryview(data)
+            length = len(data)
+        end = offset + length
         if offset < 0 or end > self.total:
             raise ValueError(
                 f"fragment [{offset}, {end}) outside layer of {self.total} bytes"
@@ -140,7 +153,10 @@ class ShardedLayerIngest:
             hi = min(end, s_off + s_size)
             if lo >= hi:
                 continue
-            piece = np.frombuffer(data[lo - offset : hi - offset], np.uint8)
+            if is_device:
+                piece = data[lo - offset : hi - offset]  # lazy on-src slice
+            else:
+                piece = np.frombuffer(data[lo - offset : hi - offset], np.uint8)
             pieces.append(
                 (r, lo - s_off, jax.device_put(piece, self.devices[r]))
             )

@@ -130,3 +130,40 @@ def assignment_to_placement(
         node_to_stage=node_to_stage,
         layer_to_stage=layer_to_stage,
     )
+
+
+def fabric_placement(
+    node_ids,
+    assignment: Assignment,
+    mesh: Mesh,
+    pipeline_axis: str = "nodes",
+) -> StagePlacement:
+    """Placement covering EVERY fabric participant, not just assignees.
+
+    On a pod fabric (``parallel/fabric.py``) a seeder needs local stage
+    devices too: its planned byte range enters the fabric through its own
+    host→HBM link.  Assignees keep the assignment-derived stage order
+    (contiguous layer ranges on consecutive stages, as
+    ``assignment_to_placement``); the remaining nodes — seeders, the
+    leader — fill the leftover stages in id order.  With more extra nodes
+    than free stages they share stages round-robin: harmless under a
+    single controller, but a multi-host deployment should size the mesh's
+    pipeline axis to the cluster (stage ↔ host)."""
+    placement = assignment_to_placement(assignment, mesh, pipeline_axis)
+    extras = sorted(set(node_ids) - set(placement.node_to_stage))
+    if not extras:
+        return placement
+    taken = set(placement.node_to_stage.values())
+    free = [s for s in range(placement.num_stages) if s not in taken]
+    slots = free or list(range(placement.num_stages))
+    if len(extras) > len(free):
+        import warnings
+
+        warnings.warn(
+            f"{len(extras)} non-assignee fabric nodes share "
+            f"{len(slots)} stages; size the mesh pipeline axis to the "
+            f"cluster for multi-host runs", stacklevel=2,
+        )
+    for i, node_id in enumerate(extras):
+        placement.node_to_stage[node_id] = slots[i % len(slots)]
+    return placement

@@ -26,10 +26,11 @@ Deviations from the reference, on purpose:
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
-from typing import Dict, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..core.types import (
     Assignment,
@@ -49,6 +50,7 @@ from ..transport.messages import (
     AnnounceMsg,
     BootReadyMsg,
     ClientReqMsg,
+    DevicePlanMsg,
     FlowRetransmitMsg,
     HeartbeatMsg,
     LayerMsg,
@@ -60,7 +62,12 @@ from ..utils.logging import log
 from .checkpoint import map_through_gaps
 from .failure import FailureDetector
 from .node import MessageLoop, Node
-from .send import fetch_from_client, handle_flow_retransmit, send_layer
+from .send import (
+    contribute_device_plan,
+    fetch_from_client,
+    handle_flow_retransmit,
+    send_layer,
+)
 
 
 def assignment_satisfied(a: Assignment, s: Status) -> bool:
@@ -86,6 +93,8 @@ class LeaderNode:
         start_loop: bool = True,
         expected_nodes: Optional[Set[NodeID]] = None,
         failure_timeout: float = 0.0,
+        fabric=None,
+        placement=None,
     ):
         """``expected_nodes``: when given, distribution also waits for these
         nodes to announce — not just the assignment keys.  The reference
@@ -96,10 +105,22 @@ class LeaderNode:
         ``failure_timeout``: seconds of silence after which an announced
         node is declared crashed and ``crash()`` re-plans around it; 0
         disables detection (the reference has none — crash() is its TODO,
-        node.go:218-220)."""
+        node.go:218-220).
+
+        ``fabric`` + ``placement``: a ``parallel.fabric.FabricPlane`` and a
+        ``parallel.mesh.fabric_placement`` covering every node.  When both
+        are set, scheduled transfers whose participants all have fabric
+        stages are dispatched as ``DevicePlanMsg`` control commands and the
+        layer bytes move as device traffic (ICI) instead of TCP streams —
+        the north-star data plane (SURVEY §5.8).  Transfers the fabric
+        can't carry (client-held sources, unstaged nodes) fall back to the
+        host path per transfer."""
         self.node = node
         self.layers = layers
         self.assignment = assignment
+        self.fabric = fabric
+        self.placement = placement
+        self._plan_seq = itertools.count()
         self.expected_nodes = set(expected_nodes or ())
         self.status: Status = {}
         self._lock = threading.Lock()
@@ -154,6 +175,7 @@ class LeaderNode:
             HeartbeatMsg, lambda msg: self.detector.touch(msg.src_id)
         )
         self.loop.register(BootReadyMsg, self.handle_boot_ready)
+        self.loop.register(DevicePlanMsg, self.handle_device_plan)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -330,7 +352,7 @@ class LeaderNode:
 
     def send_layers(self) -> None:
         """Leader sends every missing assigned layer itself
-        (node.go:326-352)."""
+        (node.go:326-352) — over the device fabric when one is wired."""
         for node_id, layer_ids in self.assignment.items():
             for layer_id in layer_ids:
                 with self._lock:
@@ -341,6 +363,9 @@ class LeaderNode:
                 if layer is None:
                     log.warn("no layers found", layerID=layer_id)
                     continue
+                if self._try_fabric_full_layer(layer_id, self.node.my_id,
+                                               node_id):
+                    continue
                 self.loop.submit(self._send_one, node_id, layer_id, layer)
 
     def _send_one(self, dest: NodeID, layer_id: LayerID, layer) -> None:
@@ -348,6 +373,76 @@ class LeaderNode:
             send_layer(self.node, dest, layer_id, layer)
         except Exception as e:  # noqa: BLE001
             log.error("couldn't send a layer", layerID=layer_id, err=repr(e))
+
+    # --------------------------------------------------- device-fabric plane
+
+    def handle_device_plan(self, msg: DevicePlanMsg) -> None:
+        """The leader can be a seeder in a device plan (it dispatches the
+        plan to itself like any other participant); it is never a fabric
+        *dest* — ``_fabric_ok`` routes those to the host path."""
+        if self.fabric is None or self.placement is None:
+            log.error("device plan but no fabric wired", plan=msg.plan_id)
+            return
+        contribute_device_plan(self.node, self.layers, self._lock,
+                               self.fabric, self.placement, msg)
+
+    def _fabric_ok(
+        self, layer_id: LayerID, layout: List[Tuple[NodeID, int, int]],
+        dest: NodeID,
+    ) -> bool:
+        """Whether one scheduled transfer can ride the device fabric:
+        fabric + placement wired, every participant mapped to a stage, and
+        no sender serving the layer from an external client (a client's
+        bytes live outside the fabric — host path).  Status reads are
+        unlocked, matching the other scheduler-side reads."""
+        if self.fabric is None or self.placement is None:
+            return False
+        if dest == self.node.my_id or dest not in self.placement.node_to_stage:
+            return False
+        for sender, _, _ in layout:
+            if sender not in self.placement.node_to_stage:
+                return False
+            meta = self.status.get(sender, {}).get(layer_id)
+            if meta is None or meta.location == LayerLocation.CLIENT:
+                return False
+        return True
+
+    def _dispatch_device_plan(
+        self, layer_id: LayerID, dest: NodeID,
+        layout: List[Tuple[NodeID, int, int]], total: int,
+    ) -> None:
+        """Send the plan to every participant; the layer bytes themselves
+        never touch the transport (the fabric carries them)."""
+        plan_id = f"{layer_id}.{dest}.{next(self._plan_seq)}"
+        msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
+                            total, list(layout))
+        log.info("dispatching device plan", plan=plan_id, layer=layer_id,
+                 dest=dest, senders=sorted({s for s, _, _ in layout}),
+                 total_bytes=total)
+        for participant in sorted({s for s, _, _ in layout} | {dest}):
+            try:
+                self.node.transport.send(participant, msg)
+            except (OSError, KeyError) as e:
+                log.error("couldn't send device plan", plan=plan_id,
+                          dest=participant, err=repr(e))
+
+    def _try_fabric_full_layer(
+        self, layer_id: LayerID, sender: NodeID, dest: NodeID
+    ) -> bool:
+        """Route a single-source full-layer send (modes 0-2) over the
+        fabric; returns False when it must go the host path."""
+        meta = self.status.get(sender, {}).get(layer_id)
+        size = meta.data_size if meta is not None else 0
+        if size <= 0 and sender == self.node.my_id:
+            src = self.layers.get(layer_id)
+            size = src.data_size if src is not None else 0
+        if size <= 0:
+            return False
+        layout = [(sender, 0, size)]
+        if not self._fabric_ok(layer_id, layout, dest):
+            return False
+        self._dispatch_device_plan(layer_id, dest, layout, size)
+        return True
 
     def handle_layer(self, msg: LayerMsg) -> None:
         """The leader can itself receive layers (e.g. from a client pipe):
@@ -372,10 +467,29 @@ class LeaderNode:
                 return
             self.detector.touch(msg.src_id)
         with self._lock:
-            self.status.setdefault(msg.src_id, {})[msg.layer_id] = LayerMeta(
-                location=msg.location
-            )
+            row = self.status.setdefault(msg.src_id, {})
+            # Carry the layer's size into the new owner's status entry (the
+            # ack doesn't repeat it): schedulers size transfers from status,
+            # and a size-less entry would wrongly disqualify this owner as
+            # a future fabric sender for the layer it just received.
+            prev = row.get(msg.layer_id)
+            size = prev.data_size if prev is not None else 0
+            if size <= 0:
+                size = self._layer_size_locked(msg.layer_id)
+            row[msg.layer_id] = LayerMeta(location=msg.location,
+                                          data_size=size)
         self._maybe_finish()
+
+    def _layer_size_locked(self, layer_id: LayerID) -> int:
+        """A layer's full size: the max announced ``data_size`` across
+        status rows.  Iterates ``status`` — callers MUST hold ``_lock``
+        (pool-concurrent handlers insert/pop rows)."""
+        size = 0
+        for layer_metas in self.status.values():
+            meta = layer_metas.get(layer_id)
+            if meta is not None and meta.data_size > size:
+                size = meta.data_size
+        return size
 
     def _maybe_finish(self) -> None:
         """Fire startup + ready exactly once when the (possibly shrunk)
@@ -438,11 +552,12 @@ class RetransmitLeaderNode(LeaderNode):
     def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
                  start_loop: bool = True,
                  expected_nodes: Optional[Set[NodeID]] = None,
-                 failure_timeout: float = 0.0):
+                 failure_timeout: float = 0.0, fabric=None, placement=None):
         self.layer_owners: Dict[LayerID, Set[NodeID]] = {}
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
-                         failure_timeout=failure_timeout)
+                         failure_timeout=failure_timeout,
+                         fabric=fabric, placement=placement)
 
     def crash(self, node_id: NodeID) -> None:
         """A dead node no longer serves its layers; re-run the owner
@@ -487,11 +602,19 @@ class RetransmitLeaderNode(LeaderNode):
                     if layer is None:
                         log.warn("no layers found", layerID=layer_id)
                         continue
+                    if self._try_fabric_full_layer(layer_id, self.node.my_id,
+                                                   node_id):
+                        continue
                     self.loop.submit(self._send_one, node_id, layer_id, layer)
 
     def send_retransmit(self, layer_id: LayerID, owner: NodeID, dest: NodeID) -> None:
         """Ask ``owner`` to forward ``layer_id`` to ``dest``; leader-owned
-        layers go out directly (node.go:611-626)."""
+        layers go out directly (node.go:611-626).  With a fabric wired the
+        forward becomes a one-source device plan — the owner's copy enters
+        the fabric from its own stage and lands in the dest's HBM with no
+        TCP byte stream (modes 1 and 2 share this path)."""
+        if self._try_fabric_full_layer(layer_id, owner, dest):
+            return
         if owner == self.node.my_id:
             layer = self.layers.get(layer_id)
             if layer is None:
@@ -528,7 +651,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
     def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
                  start_loop: bool = True,
                  expected_nodes: Optional[Set[NodeID]] = None,
-                 failure_timeout: float = 0.0):
+                 failure_timeout: float = 0.0, fabric=None, placement=None):
         # layer -> dest -> job
         self.jobs: Dict[LayerID, Dict[NodeID, _JobInfo]] = {}
         self.sender_load: Dict[NodeID, int] = {}
@@ -536,7 +659,8 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         self.performance: Dict[NodeID, Tuple[float, int]] = {}
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
-                         failure_timeout=failure_timeout)
+                         failure_timeout=failure_timeout,
+                         fabric=fabric, placement=placement)
 
     def crash(self, node_id: NodeID) -> None:
         """Surgical job-table repair: jobs destined for the dead node are
@@ -861,11 +985,14 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         start_loop: bool = True,
         expected_nodes: Optional[Set[NodeID]] = None,
         failure_timeout: float = 0.0,
+        fabric=None,
+        placement=None,
     ):
         self.node_network_bw = dict(node_network_bw)
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
-                         failure_timeout=failure_timeout)
+                         failure_timeout=failure_timeout,
+                         fabric=fabric, placement=placement)
 
     def _register_handlers(self) -> None:
         super()._register_handlers()
@@ -958,12 +1085,43 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     )
         return out
 
+    def _split_fabric_jobs(self, jobs: FlowJobsMap) -> FlowJobsMap:
+        """Dispatch every fabric-eligible (layer, dest) job group as ONE
+        device plan — the plan's multi-sender byte-range split executes as
+        device traffic (seeders upload their ranges, the dest's sharded
+        ingest gathers them over ICI) — and return the jobs the fabric
+        can't carry for the host-path dispatch below.  A resumed dest's
+        plan covers only its gaps; the dest seeds its ingest from the
+        checkpointed bytes it already holds."""
+        if self.fabric is None or self.placement is None:
+            return jobs
+        groups: Dict[Tuple[LayerID, NodeID], List[FlowJob]] = {}
+        for job_list in jobs.values():
+            for job in job_list:
+                groups.setdefault((job.layer_id, job.dest_id), []).append(job)
+        host_jobs: FlowJobsMap = {}
+        for (layer_id, dest), group in sorted(groups.items()):
+            layout = sorted(
+                ((j.sender_id, j.offset, j.data_size) for j in group),
+                key=lambda t: t[1],
+            )
+            with self._lock:
+                total = self._layer_size_locked(layer_id)
+            if total > 0 and self._fabric_ok(layer_id, layout, dest):
+                self._dispatch_device_plan(layer_id, dest, layout, total)
+            else:
+                for j in group:
+                    host_jobs.setdefault(j.sender_id, []).append(j)
+        return host_jobs
+
     def _dispatch(self, min_time_ms: int, self_jobs: FlowJobsMap,
                   jobs: FlowJobsMap) -> None:
         """Send every flow job as a rate-budgeted command
         (node.go:1237-1288; the budget comes from the solver's
         millisecond-granular min time, not the reference's integer
-        seconds)."""
+        seconds).  Fabric-eligible job groups ride the device plane
+        instead."""
+        jobs = self._split_fabric_jobs(jobs)
         for dest, job_list in self_jobs.items():
             for job in job_list:
                 rate = self.status.get(job.sender_id, {}).get(

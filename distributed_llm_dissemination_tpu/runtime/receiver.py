@@ -36,6 +36,7 @@ from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
     BootReadyMsg,
+    DevicePlanMsg,
     FlowRetransmitMsg,
     LayerMsg,
     RetransmitMsg,
@@ -47,7 +48,12 @@ from ..utils.logging import log
 from .checkpoint import LayerCheckpointStore
 from .failure import HeartbeatSender
 from .node import MessageLoop, Node
-from .send import fetch_from_client, handle_flow_retransmit, send_layer
+from .send import (
+    contribute_device_plan,
+    fetch_from_client,
+    handle_flow_retransmit,
+    send_layer,
+)
 
 
 class ReceiverNode:
@@ -67,6 +73,7 @@ class ReceiverNode:
         stage_hbm: bool = False,
         placement=None,
         boot_cfg=None,
+        fabric=None,
     ):
         """``boot_cfg``: a ``models.llama.ModelConfig``; when set, the
         startup message boots the model from the delivered layer blobs
@@ -84,13 +91,22 @@ class ReceiverNode:
         sharded-ingest path (1/n host→device traffic per device + one ICI
         all-gather) instead of on the default device — the staged-inference
         layout the reference's startup hook presumes
-        (distributor/message.go:216-241)."""
+        (distributor/message.go:216-241).
+
+        ``fabric``: a ``parallel.fabric.FabricPlane`` shared with the
+        leader and the other nodes of the pod.  The node then serves
+        ``DevicePlanMsg`` commands: it publishes its planned byte ranges
+        onto its own stage devices (seeder half) and ingests plans
+        addressed to it over the device fabric (dest half) — layer bytes
+        never touch the transport (the reference's per-transfer TCP byte
+        stream, transport.go:267-274, replaced by ICI)."""
         self.node = node
         self.layers = layers
         self.storage_path = storage_path
         self.stage_hbm = stage_hbm
         self.placement = placement
         self.boot_cfg = boot_cfg
+        self.fabric = fabric
         self.boot_result = None  # BootResult after a successful boot
         self._boot_started = False
         # Eager when enabled: handlers run on a 16-worker pool, so a lazy
@@ -119,6 +135,7 @@ class ReceiverNode:
     def _register_handlers(self) -> None:
         self.loop.register(LayerMsg, self.handle_layer)
         self.loop.register(StartupMsg, self.handle_startup)
+        self.loop.register(DevicePlanMsg, self.handle_device_plan)
 
     def announce(self) -> None:
         """Tell the leader what I already hold, routed via the next hop
@@ -244,6 +261,104 @@ class ReceiverNode:
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
 
+    # --------------------------------------------------- device-fabric plane
+
+    def handle_device_plan(self, msg: DevicePlanMsg) -> None:
+        """Serve one pod-fabric transfer command (``parallel/fabric.py``):
+        contribute my planned byte ranges (seeder half, inline on the
+        handler pool), then — when the plan is addressed to me — ingest
+        every contribution over the device fabric on a dedicated thread.
+        Dedicated because the ingest *waits* on other nodes' contributions:
+        parked pool workers across many concurrent plans could otherwise
+        starve the very contribution handlers they wait for."""
+        if self.fabric is None or self.placement is None:
+            log.error("device plan but no fabric wired", plan=msg.plan_id)
+            return
+        # Opportunistic GC: plans whose dest died before collecting would
+        # otherwise pin full-layer device buffers forever.
+        self.fabric.gc()
+        contribute_device_plan(self.node, self.layers, self._lock,
+                               self.fabric, self.placement, msg)
+        if msg.dest_id == self.node.my_id:
+            threading.Thread(
+                target=self._receive_device_plan, args=(msg,), daemon=True
+            ).start()
+
+    def _local_coverage(self, layer_id):
+        """Byte ranges of an in-progress layer this node already holds
+        (checkpoint-restored partials, mode 3): a resumed fabric plan ships
+        only the gaps, so the ingest is seeded with these first.  The base
+        receiver holds none."""
+        return []
+
+    def _fabric_store(self, layer_id, arr, total: int) -> None:
+        """Record a fabric-delivered layer: HBM-resident, replicated on
+        this node's stage — the terminal state the Assignment prescribes.
+        No host copy exists (none ever crossed the wire); readers needing
+        bytes pull them from the device array."""
+        with self._lock:
+            if layer_id not in self.layers:
+                self.layers[layer_id] = LayerSrc(
+                    inmem_data=None,
+                    data_size=total,
+                    meta=LayerMeta(location=LayerLocation.HBM),
+                    device_array=arr,
+                )
+
+    def _receive_device_plan(self, msg: DevicePlanMsg) -> None:
+        """The dest half: pull every contribution into my stage's shard
+        buffers as it arrives (device→device — ICI on real hardware),
+        gather, store, ack."""
+        with self._lock:
+            existing = self.layers.get(msg.layer_id)
+        if existing is not None:
+            # A re-plan duplicate of a delivered layer: drain the plan's
+            # contributions (seeders may publish AFTER this discard would
+            # run — an immediate discard leaves their device buffers
+            # pinned in the registry) and re-ack (the leader missed our
+            # ack).  The drain is bounded and off the handler pool.
+            try:
+                for _ in self.fabric.collect(msg.plan_id, len(msg.layout),
+                                             timeout=30.0):
+                    pass
+            except TimeoutError:
+                pass
+            finally:
+                self.fabric.discard(msg.plan_id)
+            loc = existing.meta.location
+        else:
+            from ..parallel.ingest import ShardedLayerIngest
+
+            try:
+                devices = self.placement.devices_for_node(self.node.my_id)
+                ingest = ShardedLayerIngest(msg.total_size, devices)
+                for off, data in self._local_coverage(msg.layer_id):
+                    ingest.write(off, data)
+                try:
+                    for off, arr in self.fabric.collect(
+                        msg.plan_id, len(msg.layout)
+                    ):
+                        ingest.write(off, arr)
+                    arr = ingest.finalize()
+                    arr.block_until_ready()
+                finally:
+                    self.fabric.discard(msg.plan_id)
+            except Exception as e:  # noqa: BLE001 — no ack: leader re-plans
+                log.error("fabric ingest failed", layerID=msg.layer_id,
+                          plan=msg.plan_id, err=repr(e))
+                return
+            self._fabric_store(msg.layer_id, arr, msg.total_size)
+            loc = LayerLocation.HBM
+            log.info("layer landed over device fabric", layerID=msg.layer_id,
+                     plan=msg.plan_id, total_bytes=msg.total_size)
+        try:
+            self.node.transport.send(
+                self.node.leader_id,
+                AckMsg(self.node.my_id, msg.layer_id, loc),
+            )
+        except (OSError, KeyError) as e:
+            log.error("failed to send ackMsg", err=repr(e))
+
     def handle_startup(self, msg: StartupMsg) -> None:
         """The inference-engine boot hook (node.go:1387-1389) — with
         ``boot_cfg`` it actually boots the engine: ``ready()`` unblocks
@@ -311,7 +426,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
     def __init__(self, node: Node, layers: LayersSrc, storage_path: str = ".",
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
                  checkpoint_dir: str = "", stage_hbm: bool = False,
-                 placement=None, boot_cfg=None):
+                 placement=None, boot_cfg=None, fabric=None):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -352,7 +467,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         super().__init__(node, layers, storage_path, start_loop=False,
                          heartbeat_interval=heartbeat_interval,
                          stage_hbm=stage_hbm, placement=placement,
-                         boot_cfg=boot_cfg)
+                         boot_cfg=boot_cfg, fabric=fabric)
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:
@@ -418,6 +533,28 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 for lid, (_, covered) in self._partial.items()
                 if lid in self._partial_total
             }
+
+    def _local_coverage(self, layer_id):
+        """Checkpoint-restored bytes seed a resumed fabric ingest: the
+        leader's plan covers only the gaps (leader.assign_jobs), so what
+        this node already holds must enter the shard buffers locally."""
+        with self._lock:
+            entry = self._partial.get(layer_id)
+            if entry is None:
+                return []
+            buf, covered = entry
+            return [(s, bytes(memoryview(buf)[s:e])) for s, e in covered]
+
+    def _fabric_store(self, layer_id, arr, total: int) -> None:
+        """A fabric completion supersedes any partial-transfer state: the
+        host buffer and the durable journal for this layer are done."""
+        super()._fabric_store(layer_id, arr, total)
+        with self._lock:
+            self._partial.pop(layer_id, None)
+            self._partial_total.pop(layer_id, None)
+            self._durable.pop(layer_id, None)
+        if self.ckpt is not None:
+            self.ckpt.complete(layer_id)
 
     def _register_handlers(self) -> None:
         super()._register_handlers()

@@ -53,6 +53,65 @@ def fetch_from_client(node: Node, layer_id: LayerID, dest: NodeID) -> None:
     node.transport.send(CLIENT_ID, ClientReqMsg(node.my_id, layer_id, False))
 
 
+def contribute_device_plan(
+    node: Node, layers: LayersSrc, lock: threading.Lock, fabric, placement,
+    msg,
+) -> None:
+    """Publish this node's byte ranges of a device plan onto its OWN stage
+    devices (the pod-fabric sender half, ``parallel/fabric.py``).
+
+    The host→HBM upload happens here, locally — the same hop a TCP send
+    would have paid to read the layer — and the destination's ingest then
+    moves the fragment device-to-device (ICI).  A seeder whose copy is
+    already HBM-staged contributes an on-device slice: no host traffic at
+    all.  Multiple ranges from one node fan out round-robin across its
+    stage devices so their uploads overlap."""
+    mine = [(off, size) for s, off, size in msg.layout if s == node.my_id]
+    if not mine:
+        return
+    with lock:
+        layer = layers.get(msg.layer_id)
+    if layer is None:
+        log.error("no layer for device plan", layerID=msg.layer_id,
+                  plan=msg.plan_id)
+        return
+    import jax
+    import numpy as np
+
+    devices = placement.devices_for_node(node.my_id)
+    dev_src = getattr(layer, "device_array", None)
+    if dev_src is not None and not (
+        getattr(dev_src, "ndim", 0) == 1 and dev_src.dtype == np.uint8
+    ):
+        dev_src = None  # only raw uint8 blobs slice meaningfully by byte
+
+    def host_span(off: int, size: int):
+        """Only the contributed range touches host RAM: a disk-backed
+        seeder of a multi-GiB layer must not load the whole file to serve
+        a small byte range of it."""
+        if layer.inmem_data is not None:
+            return np.frombuffer(
+                memoryview(layer.inmem_data)[off : off + size], np.uint8
+            )
+        if layer.fp:
+            with open(layer.fp, "rb") as f:
+                f.seek(layer.offset + off)
+                return np.frombuffer(f.read(size), np.uint8)
+        return np.frombuffer(
+            memoryview(layer.read_bytes())[off : off + size], np.uint8
+        )
+
+    for k, (off, size) in enumerate(mine):
+        dev = devices[k % len(devices)]
+        if dev_src is not None:
+            piece = jax.device_put(dev_src[off : off + size], dev)
+        else:
+            piece = jax.device_put(host_span(off, size), dev)
+        fabric.publish(msg.plan_id, off, piece)
+        log.debug("published fabric contribution", layerID=msg.layer_id,
+                  plan=msg.plan_id, offset=off, size=size)
+
+
 def handle_flow_retransmit(
     node: Node,
     layers: LayersSrc,
@@ -75,9 +134,11 @@ def handle_flow_retransmit(
         return
     node.add_node(msg.dest_id)
 
-    # An HBM-staged layer with its host buffer retained serves like INMEM.
+    # An HBM-staged layer serves like INMEM: from its retained host buffer,
+    # or — for fabric-delivered layers that never had one — from a host
+    # copy materialized off the device array (one cached fetch).
     send_loc = layer.meta.location
-    if send_loc == LayerLocation.HBM and layer.inmem_data is not None:
+    if send_loc == LayerLocation.HBM and layer.ensure_host_bytes():
         send_loc = LayerLocation.INMEM
     if send_loc in (LayerLocation.INMEM, LayerLocation.DISK):
         sent = 0

@@ -42,8 +42,13 @@ class MsgType(enum.IntEnum):
     # BOOT_READY — receiver booted its model from the disseminated layers;
     # the reference's startup handler is a stub (node.go:1387-1389), so it
     # has nothing to report back.
+    # DEVICE_PLAN — pod-fabric transfer command: the layer bytes move as
+    # device traffic (ICI), so the control plane replaces the reference's
+    # per-transfer TCP byte stream (transport.go:267-274) with this one
+    # small message.
     HEARTBEAT = 8
     BOOT_READY = 9
+    DEVICE_PLAN = 10
 
 
 @dataclasses.dataclass
@@ -305,6 +310,50 @@ class BootReadyMsg:
                    str(d.get("Kind", "")))
 
 
+@dataclasses.dataclass
+class DevicePlanMsg:
+    """Leader → fabric participants: execute one layer transfer on the
+    device data plane (``parallel/fabric.py``).
+
+    ``layout`` is the plan's per-sender byte-range split,
+    ``[(sender_id, offset, size), ...]`` — the same shape as a mode-3
+    flow schedule's jobs (flow.go:193-211); modes 0-2 send a one-element
+    layout (a single full-layer source).  Each listed sender uploads its
+    range onto its own stage devices and publishes it under ``plan_id``;
+    ``dest_id`` ingests every contribution over the fabric and acks.  The
+    layer bytes themselves never touch the transport."""
+
+    src_id: NodeID
+    plan_id: str
+    layer_id: LayerID
+    dest_id: NodeID
+    total_size: int
+    layout: list  # [(sender_id, offset, size), ...]
+
+    msg_type = MsgType.DEVICE_PLAN
+
+    def to_payload(self) -> dict:
+        return {
+            "SrcID": self.src_id,
+            "PlanID": self.plan_id,
+            "LayerID": self.layer_id,
+            "DestID": self.dest_id,
+            "TotalSize": self.total_size,
+            "Layout": [[int(s), int(o), int(z)] for s, o, z in self.layout],
+        }
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "DevicePlanMsg":
+        return cls(
+            int(d["SrcID"]),
+            str(d["PlanID"]),
+            int(d["LayerID"]),
+            int(d["DestID"]),
+            int(d.get("TotalSize", 0)),
+            [(int(s), int(o), int(z)) for s, o, z in d.get("Layout") or []],
+        )
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -316,6 +365,7 @@ Message = Union[
     SimpleMsg,
     HeartbeatMsg,
     BootReadyMsg,
+    DevicePlanMsg,
 ]
 
 _DECODERS = {
@@ -328,6 +378,7 @@ _DECODERS = {
     MsgType.SIMPLE: SimpleMsg,
     MsgType.HEARTBEAT: HeartbeatMsg,
     MsgType.BOOT_READY: BootReadyMsg,
+    MsgType.DEVICE_PLAN: DevicePlanMsg,
 }
 
 

@@ -329,6 +329,13 @@ class TcpTransport(Transport):
                 if fresh:
                     raise
                 continue
+            except Exception:
+                # Non-socket failure (e.g. an unserveable LayerSrc) can
+                # strike after the header frame is on the wire: the conn
+                # is mid-message — close it, never pool it, don't retry.
+                if sock is not None:
+                    sock.close()
+                raise
             self._release_data_conn(dest, sock)
             return
 
@@ -368,7 +375,13 @@ class TcpTransport(Transport):
             },
         )
 
-        # HBM-staged layers keep their host buffer and serve like INMEM.
+        # HBM-staged layers keep their host buffer and serve like INMEM;
+        # fabric-delivered layers never had one — materialize it from the
+        # device array (one cached device→host fetch) so an HBM owner can
+        # re-serve over the host path too.
+        if (src.meta.location == LayerLocation.HBM
+                and src.inmem_data is None):
+            src.ensure_host_bytes()
         if (src.meta.location in (LayerLocation.INMEM, LayerLocation.HBM)
                 and src.inmem_data is not None):
             data = memoryview(src.inmem_data)[src.offset : src.offset + src.data_size]
