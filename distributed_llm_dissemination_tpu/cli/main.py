@@ -167,20 +167,11 @@ def build_placement(args, conf: cfg.Config):
     degenerate case."""
     if not args.hbm or conf.mesh is None:
         return None
-    # Honor the standard JAX_PLATFORMS env var even where a site hook
-    # (e.g. the axon TPU plugin's sitecustomize) pre-set jax_platforms at
-    # interpreter start: the config can still be flipped before the first
-    # backend use, which happens right below.
-    import os as _os
-
     import jax as _jax
 
-    want = _os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            _jax.config.update("jax_platforms", want)
-        except RuntimeError:
-            pass  # backend already initialized; leave as-is
+    from ..parallel.multihost import honor_jax_platforms
+
+    honor_jax_platforms()
     from ..parallel.mesh import assignment_to_placement, mesh_from_conf
 
     mesh = mesh_from_conf(conf.mesh)
@@ -257,6 +248,30 @@ def main(argv=None) -> int:
     if args.c:
         return run_client(args, conf)
 
+    if conf.mesh is not None and conf.mesh.fabric:
+        # One OS process per node cannot share an in-process FabricPlane;
+        # refusing beats silently running the TCP data plane the config
+        # opted out of.  Checked BEFORE any distributed init: joining the
+        # pod runtime blocks on every rank, and a doomed run must fail
+        # fast instead.
+        raise SystemExit(
+            "config has Mesh.Fabric=true: the pod-fabric data plane runs "
+            "all nodes under one controller — use "
+            "`python -m distributed_llm_dissemination_tpu.cli.podrun "
+            f"-f {args.f} -m {args.m}` (or drop the Fabric flag to run "
+            "per-node processes over TCP)"
+        )
+
+    if conf.distributed is not None:
+        # Join the pod-wide JAX runtime BEFORE any device use, so a
+        # configured Mesh can span hosts.  Gated on the config section so
+        # pure-TCP nodes never pay the jax import; external clients never
+        # join (they are auxiliary byte servers, not mesh ranks).
+        from ..parallel.multihost import honor_jax_platforms, maybe_initialize
+
+        honor_jax_platforms()
+        maybe_initialize(conf, args.id)
+
     node_conf = cfg.get_node_conf(conf, args.id)
     try:
         my_client_conf = cfg.get_client_conf(conf, args.id)
@@ -273,18 +288,6 @@ def main(argv=None) -> int:
     if args.l:
         ulog.log.info("layer set up")
         return 0
-
-    if conf.mesh is not None and conf.mesh.fabric:
-        # One OS process per node cannot share an in-process FabricPlane;
-        # refusing beats silently running the TCP data plane the config
-        # opted out of.
-        raise SystemExit(
-            "config has Mesh.Fabric=true: the pod-fabric data plane runs "
-            "all nodes under one controller — use "
-            "`python -m distributed_llm_dissemination_tpu.cli.podrun "
-            f"-f {args.f} -m {args.m}` (or drop the Fabric flag to run "
-            "per-node processes over TCP)"
-        )
 
     addr_registry = {nc.id: nc.addr for nc in conf.nodes}
     if my_client_conf is not None:

@@ -78,19 +78,9 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
     configured node (seeders contribute from their own stages)."""
     if conf.mesh is None:
         raise SystemExit("podrun needs a Mesh section in the config")
-    # Honor JAX_PLATFORMS even where a site hook (e.g. the axon TPU
-    # plugin's sitecustomize) imported jax at interpreter start: the
-    # backend isn't initialized until first use, which happens below.
-    import os as _os
+    from ..parallel.multihost import honor_jax_platforms
 
-    import jax as _jax
-
-    want = _os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            _jax.config.update("jax_platforms", want)
-        except RuntimeError:
-            pass  # backend already initialized; leave as-is
+    honor_jax_platforms()
     from ..parallel.fabric import FabricPlane
     from ..parallel.mesh import fabric_placement, mesh_from_conf
 
@@ -110,11 +100,9 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
         for nc in conf.nodes
     }
     leader_conf = cfg.get_leader_conf(conf)
-    boot_cfg = None
-    if boot or conf.model:
-        from ..models.llama import CONFIGS
+    from .main import boot_config  # same validation as the per-node CLI
 
-        boot_cfg = CONFIGS[boot or conf.model]
+    boot_cfg = boot_config(boot or conf.model)
 
     leader = None
     receivers = []

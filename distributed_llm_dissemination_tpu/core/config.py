@@ -66,6 +66,28 @@ class MeshConf:
 
 
 @dataclasses.dataclass
+class DistributedConf:
+    """TPU extension: multi-host mesh formation (parallel/multihost.py).
+
+    Present (even empty ``{}``) = every node-process joins one pod-wide
+    JAX runtime via ``jax.distributed.initialize`` before any device use;
+    absent = single-host, no initialization.  ``coordinator`` defaults to
+    the leader node's host on JAX's default port; ``cpu_collectives``
+    ("gloo") enables cross-process collectives on CPU backends (the
+    2-process smoke deployment) and is ignored on TPU."""
+
+    coordinator: str = ""
+    cpu_collectives: str = ""
+
+    @classmethod
+    def from_json(cls, d: dict) -> "DistributedConf":
+        return cls(
+            coordinator=_jget(d, "Coordinator", "") or "",
+            cpu_collectives=_jget(d, "CpuCollectives", "") or "",
+        )
+
+
+@dataclasses.dataclass
 class NodeConf:
     """Per-node config (cmd/config.go:21-28)."""
 
@@ -134,6 +156,7 @@ class Config:
     assignment: Assignment = dataclasses.field(default_factory=dict)
     layer_size: int = 0
     mesh: Optional[MeshConf] = None
+    distributed: Optional[DistributedConf] = None
     # TPU extension: when set (a models.llama.CONFIGS name), seeders
     # fabricate REAL model weight blobs (deterministic from ModelSeed)
     # instead of dummy zero bytes, so the disseminated layers can boot an
@@ -149,6 +172,8 @@ class Config:
             assignment=assignment_from_json(_jget(d, "Assignment") or {}),
             layer_size=int(_jget(d, "LayerSize", 0)),
             mesh=MeshConf.from_json(_jget(d, "Mesh")) if _jget(d, "Mesh") else None,
+            distributed=(DistributedConf.from_json(_jget(d, "Distributed"))
+                         if _jget(d, "Distributed") is not None else None),
             model=_jget(d, "Model", "") or "",
             model_seed=int(_jget(d, "ModelSeed", 0)),
         )

@@ -20,3 +20,8 @@ from .mover import (  # noqa: F401
     array_to_bytes,
     bytes_to_array,
 )
+from .multihost import (  # noqa: F401
+    ProcessLayout,
+    derive_layout,
+    maybe_initialize,
+)

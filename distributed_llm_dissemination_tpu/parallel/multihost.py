@@ -1,0 +1,121 @@
+"""Multi-host mesh formation: jax.distributed wiring from the topology.
+
+The reference scales by one OS process per host, wired by the JSON config
+(``/root/reference/cmd/main.go:113-146``) — each process only ever talks
+TCP.  A TPU pod needs one more layer: every per-host process must join ONE
+JAX runtime (``jax.distributed.initialize``) so ``jax.devices()`` spans the
+pod and a configured Mesh can place stages across hosts.  This module
+derives that wiring from the same JSON topology (node list order → process
+rank, leader's host → coordinator), so multi-host runs need no extra
+flags — the config that describes the cluster also forms the mesh.
+
+Single-host runs (no ``Distributed`` section) are a clean no-op.  On CPU
+backends cross-process collectives need gloo; the init flips
+``jax_cpu_collectives_implementation`` automatically so the 2-process CPU
+smoke deployment (tests/test_multihost.py) and a real TPU pod share one
+code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from ..core.config import Config
+from ..core.types import NodeID
+from ..utils.logging import log
+
+# JAX's own default coordinator port, reused when the config names none.
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def honor_jax_platforms() -> None:
+    """Make the JAX_PLATFORMS env var effective even where a site hook
+    (e.g. a TPU plugin's sitecustomize) imported jax at interpreter start:
+    the backend itself initializes on first use, so flipping the config
+    before that still wins.  No-op when the backend is already live.
+
+    ENTRY POINTS ONLY (cli.main / podrun): it re-applies whatever the
+    environment says, so calling it from library code would clobber an
+    embedder's explicit ``jax.config.update("jax_platforms", ...)`` with
+    the ambient launch environment's value."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return  # before importing jax: pure-TCP nodes stay jax-free
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except RuntimeError:
+        pass  # backend already initialized; leave as-is
+
+
+@dataclasses.dataclass
+class ProcessLayout:
+    """One node-process's place in the pod-wide JAX runtime."""
+
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+
+def derive_layout(conf: Config, my_id: NodeID) -> ProcessLayout:
+    """Map this node to a jax.distributed process rank.
+
+    Rank = the node's position in the id-sorted node list (stable across
+    hosts: every process derives the same order from the same config).
+    Coordinator = the configured ``Distributed.Coordinator``, else the
+    leader node's host on JAX's default coordinator port — the leader host
+    is already the one address every node must reach."""
+    ids = sorted(nc.id for nc in conf.nodes)
+    if my_id not in ids:
+        raise ValueError(f"node {my_id} not in config nodes {ids}")
+    coordinator = ""
+    if conf.distributed is not None:
+        coordinator = conf.distributed.coordinator
+    if not coordinator:
+        from ..core.config import get_leader_conf
+
+        leader_addr = get_leader_conf(conf).addr
+        host = leader_addr.rsplit(":", 1)[0] if ":" in leader_addr else leader_addr
+        coordinator = f"{host or '127.0.0.1'}:{DEFAULT_COORDINATOR_PORT}"
+    return ProcessLayout(
+        coordinator=coordinator,
+        num_processes=len(ids),
+        process_id=ids.index(my_id),
+    )
+
+
+def maybe_initialize(conf: Config, my_id: NodeID) -> Optional[ProcessLayout]:
+    """Join the pod-wide JAX runtime when the config asks for one.
+
+    Returns the layout when ``jax.distributed`` was initialized, ``None``
+    for the single-host fallback (no ``Distributed`` section, or a
+    single-node topology).  Must run before the first JAX backend use in
+    the process — the CLI calls it right after parsing the config."""
+    if conf.distributed is None or len(conf.nodes) < 2:
+        return None
+    layout = derive_layout(conf, my_id)
+    import jax
+
+    if conf.distributed.cpu_collectives:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              conf.distributed.cpu_collectives)
+        except (ValueError, RuntimeError) as e:
+            log.warn("couldn't set cpu collectives", err=repr(e))
+    log.info("joining pod-wide jax runtime",
+             coordinator=layout.coordinator,
+             process_id=layout.process_id,
+             num_processes=layout.num_processes)
+    jax.distributed.initialize(
+        coordinator_address=layout.coordinator,
+        num_processes=layout.num_processes,
+        process_id=layout.process_id,
+    )
+    log.info("pod-wide jax runtime up",
+             local_devices=len(jax.local_devices()),
+             global_devices=len(jax.devices()))
+    return layout

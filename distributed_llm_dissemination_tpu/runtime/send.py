@@ -88,10 +88,13 @@ def contribute_device_plan(
     def host_span(off: int, size: int):
         """Only the contributed range touches host RAM: a disk-backed
         seeder of a multi-GiB layer must not load the whole file to serve
-        a small byte range of it."""
+        a small byte range of it.  ``layer.offset`` indexes this record
+        into its backing store (read_range semantics) — both branches
+        apply it."""
         if layer.inmem_data is not None:
+            base = layer.offset + off
             return np.frombuffer(
-                memoryview(layer.inmem_data)[off : off + size], np.uint8
+                memoryview(layer.inmem_data)[base : base + size], np.uint8
             )
         if layer.fp:
             with open(layer.fp, "rb") as f:
