@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import threading
 from typing import Dict, Optional, Set
 
 # Reference: distributor/node.go:128-129 — uint identifiers.
@@ -112,6 +113,10 @@ class LayerSrc:
     meta: LayerMeta = dataclasses.field(default_factory=LayerMeta)
     # TPU-native: the layer materialized on device (jax.Array), if staged.
     device_array: object = None
+    # Guards the one-time device→host materialization of ensure_host_bytes.
+    _host_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def _host_resident(self) -> bool:
         """Host bytes available?  True for INMEM, and for HBM-staged layers
@@ -135,18 +140,23 @@ class LayerSrc:
         """The byte range ``[offset, offset+data_size)`` of this source
         store — what a transport actually puts on the wire.  ``offset``
         indexes into the full layer (RAM buffer or file)."""
+        return self.read_span(0, self.data_size)
+
+    def read_span(self, off: int, size: int) -> bytes:
+        """The byte range ``[offset+off, offset+off+size)`` of this
+        source store — the one place that knows every backing kind's
+        range semantics (RAM slice, file seek+read, HBM fetch).  Only the
+        requested span touches host RAM for disk-backed stores; HBM-only
+        stores materialize once via ``ensure_host_bytes``."""
+        base = self.offset + off
         if self._host_resident():
-            return bytes(
-                memoryview(self.inmem_data)[self.offset : self.offset + self.data_size]
-            )
+            return bytes(memoryview(self.inmem_data)[base : base + size])
         if self.meta.location == LayerLocation.DISK and self.fp:
             with open(self.fp, "rb") as f:
-                f.seek(self.offset)
-                return f.read(self.data_size)
+                f.seek(base)
+                return f.read(size)
         if self.ensure_host_bytes():
-            return bytes(
-                memoryview(self.inmem_data)[self.offset : self.offset + self.data_size]
-            )
+            return bytes(memoryview(self.inmem_data)[base : base + size])
         raise ValueError(
             f"layer has no host-readable bytes (location={self.meta.location!r})"
         )
@@ -156,18 +166,22 @@ class LayerSrc:
         over the pod fabric, where no host copy ever existed) from its
         device array — one device→host fetch, cached in ``inmem_data`` so
         re-serving the layer to peers or assembling it at boot doesn't
-        re-fetch.  Returns whether host bytes are now available.  Benign
-        under races: concurrent callers fetch identical content."""
+        re-fetch.  Returns whether host bytes are now available.  The
+        fetch is once-guarded: concurrent callers (e.g. two flow jobs for
+        the same layer on the handler pool) must not each pull a
+        multi-GiB transfer and spike host RAM."""
         if self.inmem_data is not None:
             return True
         if self.device_array is None:
             return False
-        import jax
-        import numpy as np
+        with self._host_lock:
+            if self.inmem_data is None:
+                import jax
+                import numpy as np
 
-        self.inmem_data = bytearray(
-            np.asarray(jax.device_get(self.device_array)).tobytes()
-        )
+                self.inmem_data = bytearray(
+                    np.asarray(jax.device_get(self.device_array)).tobytes()
+                )
         return True
 
 

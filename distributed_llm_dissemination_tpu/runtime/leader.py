@@ -410,21 +410,41 @@ class LeaderNode:
     def _dispatch_device_plan(
         self, layer_id: LayerID, dest: NodeID,
         layout: List[Tuple[NodeID, int, int]], total: int,
-    ) -> None:
+    ) -> bool:
         """Send the plan to every participant; the layer bytes themselves
-        never touch the transport (the fabric carries them)."""
+        never touch the transport (the fabric carries them).  Returns
+        False when any participant missed the plan — the caller must then
+        deliver over the host path instead (liveness: an incomplete plan
+        would strand the dest waiting on contributions that never come,
+        or pin seeders' uploads that nobody collects)."""
         plan_id = f"{layer_id}.{dest}.{next(self._plan_seq)}"
         msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
                             total, list(layout))
-        log.info("dispatching device plan", plan=plan_id, layer=layer_id,
-                 dest=dest, senders=sorted({s for s, _, _ in layout}),
-                 total_bytes=total)
-        for participant in sorted({s for s, _, _ in layout} | {dest}):
+        # Dest first: if the dest never learns of the plan, abort before
+        # any seeder uploads a contribution nobody will collect.
+        try:
+            self.node.transport.send(dest, msg)
+        except (OSError, KeyError) as e:
+            log.error("couldn't send device plan to dest; host path",
+                      plan=plan_id, dest=dest, err=repr(e))
+            return False
+        ok = True
+        for participant in sorted({s for s, _, _ in layout} - {dest}):
             try:
                 self.node.transport.send(participant, msg)
             except (OSError, KeyError) as e:
-                log.error("couldn't send device plan", plan=plan_id,
-                          dest=participant, err=repr(e))
+                log.error("couldn't send device plan to seeder; host path",
+                          plan=plan_id, dest=participant, err=repr(e))
+                ok = False
+        if not ok:
+            # The dest's collect for this plan will time out and discard
+            # any partial contributions; the host-path duplicate delivery
+            # is tolerated by every receiver.
+            return False
+        log.info("dispatching device plan", plan=plan_id, layer=layer_id,
+                 dest=dest, senders=sorted({s for s, _, _ in layout}),
+                 total_bytes=total)
+        return True
 
     def _try_fabric_full_layer(
         self, layer_id: LayerID, sender: NodeID, dest: NodeID
@@ -441,8 +461,7 @@ class LeaderNode:
         layout = [(sender, 0, size)]
         if not self._fabric_ok(layer_id, layout, dest):
             return False
-        self._dispatch_device_plan(layer_id, dest, layout, size)
-        return True
+        return self._dispatch_device_plan(layer_id, dest, layout, size)
 
     def handle_layer(self, msg: LayerMsg) -> None:
         """The leader can itself receive layers (e.g. from a client pipe):
@@ -1107,11 +1126,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             )
             with self._lock:
                 total = self._layer_size_locked(layer_id)
-            if total > 0 and self._fabric_ok(layer_id, layout, dest):
-                self._dispatch_device_plan(layer_id, dest, layout, total)
-            else:
-                for j in group:
-                    host_jobs.setdefault(j.sender_id, []).append(j)
+            if (total > 0 and self._fabric_ok(layer_id, layout, dest)
+                    and self._dispatch_device_plan(layer_id, dest, layout,
+                                                   total)):
+                continue
+            for j in group:
+                host_jobs.setdefault(j.sender_id, []).append(j)
         return host_jobs
 
     def _dispatch(self, min_time_ms: int, self_jobs: FlowJobsMap,

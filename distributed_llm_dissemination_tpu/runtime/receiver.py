@@ -291,24 +291,36 @@ class ReceiverNode:
         receiver holds none."""
         return []
 
-    def _fabric_store(self, layer_id, arr, total: int) -> None:
-        """Record a fabric-delivered layer: HBM-resident, replicated on
-        this node's stage — the terminal state the Assignment prescribes.
-        No host copy exists (none ever crossed the wire); readers needing
-        bytes pull them from the device array."""
+    def _fabric_store(self, layer_id, total: int, device_arr=None,
+                      host_buf=None) -> None:
+        """Record a fabric-delivered layer — HBM-resident when the ingest
+        succeeded (the terminal state the Assignment prescribes; readers
+        needing bytes pull them from the device array), else the
+        host-assembled fallback buffer (delivery beats staging)."""
         with self._lock:
             if layer_id not in self.layers:
                 self.layers[layer_id] = LayerSrc(
-                    inmem_data=None,
+                    inmem_data=host_buf,
                     data_size=total,
-                    meta=LayerMeta(location=LayerLocation.HBM),
-                    device_array=arr,
+                    meta=LayerMeta(location=LayerLocation.HBM
+                                   if device_arr is not None
+                                   else LayerLocation.INMEM),
+                    device_array=device_arr,
                 )
 
     def _receive_device_plan(self, msg: DevicePlanMsg) -> None:
         """The dest half: pull every contribution into my stage's shard
         buffers as it arrives (device→device — ICI on real hardware),
-        gather, store, ack."""
+        gather, store, ack.
+
+        Liveness: a device-side failure (allocation, write, finalize) must
+        not hang the run — the dest is alive and heartbeating, so the
+        leader would never re-plan for it.  Every contribution is kept,
+        and on ingest failure the layer is assembled on host and acked
+        INMEM, the same delivery-beats-staging fallback the host receive
+        path has.  Only missing contributions (collect timeout — a dead
+        seeder, which heartbeat detection re-plans around) end without an
+        ack."""
         with self._lock:
             existing = self.layers.get(msg.layer_id)
         if existing is not None:
@@ -325,36 +337,85 @@ class ReceiverNode:
                 pass
             finally:
                 self.fabric.discard(msg.plan_id)
-            loc = existing.meta.location
-        else:
+            self._send_ack(msg.layer_id, existing.meta.location)
+            return
+
+        local = self._local_coverage(msg.layer_id)
+        ingest = None
+        try:
             from ..parallel.ingest import ShardedLayerIngest
 
+            devices = self.placement.devices_for_node(self.node.my_id)
+            ingest = ShardedLayerIngest(msg.total_size, devices)
+            for off, data in local:
+                ingest.write(off, data)
+        except Exception as e:  # noqa: BLE001 — fall through to host path
+            log.error("fabric ingest unavailable; will assemble on host",
+                      layerID=msg.layer_id, err=repr(e))
+            ingest = None
+        contribs = []
+        try:
             try:
-                devices = self.placement.devices_for_node(self.node.my_id)
-                ingest = ShardedLayerIngest(msg.total_size, devices)
-                for off, data in self._local_coverage(msg.layer_id):
-                    ingest.write(off, data)
-                try:
-                    for off, arr in self.fabric.collect(
-                        msg.plan_id, len(msg.layout)
-                    ):
-                        ingest.write(off, arr)
-                    arr = ingest.finalize()
-                    arr.block_until_ready()
-                finally:
-                    self.fabric.discard(msg.plan_id)
-            except Exception as e:  # noqa: BLE001 — no ack: leader re-plans
-                log.error("fabric ingest failed", layerID=msg.layer_id,
-                          plan=msg.plan_id, err=repr(e))
-                return
-            self._fabric_store(msg.layer_id, arr, msg.total_size)
+                for off, arr in self.fabric.collect(
+                    msg.plan_id, len(msg.layout)
+                ):
+                    contribs.append((off, arr))
+                    if ingest is not None:
+                        try:
+                            ingest.write(off, arr)
+                        except Exception as e:  # noqa: BLE001
+                            log.error("fabric ingest write failed; will "
+                                      "assemble on host",
+                                      layerID=msg.layer_id, err=repr(e))
+                            ingest = None
+            finally:
+                self.fabric.discard(msg.plan_id)
+        except Exception as e:  # noqa: BLE001 — bytes missing: can't deliver
+            log.error("fabric collect failed; awaiting re-plan",
+                      layerID=msg.layer_id, plan=msg.plan_id, err=repr(e))
+            return
+        device_arr = None
+        if ingest is not None:
+            try:
+                device_arr = ingest.finalize()
+                device_arr.block_until_ready()
+            except Exception as e:  # noqa: BLE001
+                log.error("fabric finalize failed; assembling on host",
+                          layerID=msg.layer_id, err=repr(e))
+        if device_arr is not None:
+            self._fabric_store(msg.layer_id, msg.total_size,
+                               device_arr=device_arr)
             loc = LayerLocation.HBM
             log.info("layer landed over device fabric", layerID=msg.layer_id,
                      plan=msg.plan_id, total_bytes=msg.total_size)
+        else:
+            import jax
+            import numpy as np
+
+            buf = bytearray(msg.total_size)
+            covered: list = []
+            for off, data in local:
+                buf[off : off + len(data)] = data
+                covered = intervals.insert(covered, off, off + len(data))
+            for off, arr in contribs:
+                data = np.asarray(jax.device_get(arr)).tobytes()
+                buf[off : off + len(data)] = data
+                covered = intervals.insert(covered, off, off + len(data))
+            if intervals.covered(covered) < msg.total_size:
+                log.error("fabric plan does not cover the layer; no ack",
+                          layerID=msg.layer_id, plan=msg.plan_id)
+                return
+            self._fabric_store(msg.layer_id, msg.total_size, host_buf=buf)
+            loc = LayerLocation.INMEM
+            log.warn("layer assembled on host after fabric failure",
+                     layerID=msg.layer_id, plan=msg.plan_id)
+        self._send_ack(msg.layer_id, loc)
+
+    def _send_ack(self, layer_id, loc) -> None:
         try:
             self.node.transport.send(
                 self.node.leader_id,
-                AckMsg(self.node.my_id, msg.layer_id, loc),
+                AckMsg(self.node.my_id, layer_id, loc),
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
@@ -545,10 +606,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             buf, covered = entry
             return [(s, bytes(memoryview(buf)[s:e])) for s, e in covered]
 
-    def _fabric_store(self, layer_id, arr, total: int) -> None:
+    def _fabric_store(self, layer_id, total: int, device_arr=None,
+                      host_buf=None) -> None:
         """A fabric completion supersedes any partial-transfer state: the
         host buffer and the durable journal for this layer are done."""
-        super()._fabric_store(layer_id, arr, total)
+        super()._fabric_store(layer_id, total, device_arr=device_arr,
+                              host_buf=host_buf)
         with self._lock:
             self._partial.pop(layer_id, None)
             self._partial_total.pop(layer_id, None)

@@ -85,31 +85,16 @@ def contribute_device_plan(
     ):
         dev_src = None  # only raw uint8 blobs slice meaningfully by byte
 
-    def host_span(off: int, size: int):
-        """Only the contributed range touches host RAM: a disk-backed
-        seeder of a multi-GiB layer must not load the whole file to serve
-        a small byte range of it.  ``layer.offset`` indexes this record
-        into its backing store (read_range semantics) — both branches
-        apply it."""
-        if layer.inmem_data is not None:
-            base = layer.offset + off
-            return np.frombuffer(
-                memoryview(layer.inmem_data)[base : base + size], np.uint8
-            )
-        if layer.fp:
-            with open(layer.fp, "rb") as f:
-                f.seek(layer.offset + off)
-                return np.frombuffer(f.read(size), np.uint8)
-        return np.frombuffer(
-            memoryview(layer.read_bytes())[off : off + size], np.uint8
-        )
-
     for k, (off, size) in enumerate(mine):
         dev = devices[k % len(devices)]
         if dev_src is not None:
             piece = jax.device_put(dev_src[off : off + size], dev)
         else:
-            piece = jax.device_put(host_span(off, size), dev)
+            # read_span: only the contributed range touches host RAM (a
+            # disk seeder of a multi-GiB layer serves small ranges).
+            piece = jax.device_put(
+                np.frombuffer(layer.read_span(off, size), np.uint8), dev
+            )
         fabric.publish(msg.plan_id, off, piece)
         log.debug("published fabric contribution", layerID=msg.layer_id,
                   plan=msg.plan_id, offset=off, size=size)

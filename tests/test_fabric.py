@@ -481,6 +481,47 @@ def test_resumed_partial_layer_completes_over_fabric(cpu_devices, tmp_path):
         close_all(leader, receivers, ts)
 
 
+def test_fabric_ingest_failure_falls_back_to_host_assembly(cpu_devices,
+                                                           monkeypatch):
+    """Liveness: a device-side ingest failure on a live dest must not hang
+    the run (the dest keeps heartbeating, so the leader never re-plans for
+    it) — the dest assembles the collected contributions on host and acks
+    INMEM, the same delivery-beats-staging fallback as the host path."""
+    from distributed_llm_dissemination_tpu.parallel import ingest as ingest_mod
+
+    class Broken:
+        def __init__(self, *a, **k):
+            raise RuntimeError("device allocation failed")
+
+    monkeypatch.setattr(ingest_mod, "ShardedLayerIngest", Broken)
+
+    ids = range(3)
+    ts = inmem_transports(ids)
+    assignment = {2: {0: LayerMeta()}}
+    mesh = make_mesh((3, 2), ("pp", "tp"), devices=list(cpu_devices)[:6])
+    placement = fabric_placement(list(ids), assignment, mesh, "pp")
+    fabric = FabricPlane()
+    bw = {i: 10_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0)}, assignment, bw,
+        expected_nodes=set(ids), fabric=fabric, placement=placement)
+    receivers = [
+        FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {0: mem_layer(0)},
+                                   fabric=fabric, placement=placement),
+        FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {},
+                                   fabric=fabric, placement=placement),
+    ]
+    try:
+        run_distribution(leader, receivers, assignment)
+        dest = receivers[-1]
+        src = dest.layers[0]
+        assert src.meta.location == LayerLocation.INMEM
+        assert bytes(src.inmem_data) == layer_bytes(0)
+        assert leader.status[2][0].location == LayerLocation.INMEM
+    finally:
+        close_all(leader, receivers, ts)
+
+
 def test_hbm_only_layer_is_host_readable(cpu_devices):
     """A fabric-delivered layer (device array, no host copy) still serves
     the host paths: read_range materializes a cached host copy from HBM —
