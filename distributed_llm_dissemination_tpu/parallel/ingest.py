@@ -183,6 +183,25 @@ class ShardedLayerIngest:
             self._failed = True
             self._complete.notify_all()
 
+    def salvage(self) -> List[Tuple[int, bytes]]:
+        """Read the covered byte ranges back out of the shard buffers
+        (device→host) — the escape hatch when the gather collective (or a
+        later write) fails: everything successfully written is already on
+        the dest's devices, so a host-side fallback assembly needs no
+        retained copies of the in-flight fragments.  Closes the ingest."""
+        with self._lock:
+            self._closed = True
+            covered = list(self._covered)
+            bufs = [np.asarray(jax.device_get(b)) for b in self._bufs]
+        out: List[Tuple[int, bytes]] = []
+        for s, e in covered:
+            for r, (s_off, s_size) in enumerate(self.spans):
+                lo = max(s, s_off)
+                hi = min(e, s_off + s_size)
+                if lo < hi:
+                    out.append((lo, bufs[r][lo - s_off : hi - s_off].tobytes()))
+        return out
+
     def finalize(self, timeout: float = 120.0) -> jax.Array:
         """All-gather the shard buffers into the full layer, replicated on
         every device of the set.  Blocks until the ingest's own coverage is

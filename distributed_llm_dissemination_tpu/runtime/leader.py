@@ -639,7 +639,11 @@ class RetransmitLeaderNode(LeaderNode):
             if layer is None:
                 log.warn("no layers found", layerID=layer_id)
                 return
-            self._send_one(dest, layer_id, layer)
+            # Off the caller's thread: send_layers drives this in a loop,
+            # and an inline rate-paced send would serialize every
+            # leader-owned transfer behind the previous one (mode 0's
+            # sends are pooled for the same reason, node.go:343-349).
+            self.loop.submit(self._send_one, dest, layer_id, layer)
             return
         self.node.transport.send(
             owner, RetransmitMsg(self.node.my_id, layer_id, dest)

@@ -353,21 +353,33 @@ class ReceiverNode:
             log.error("fabric ingest unavailable; will assemble on host",
                       layerID=msg.layer_id, err=repr(e))
             ingest = None
-        contribs = []
+        # Fragments are NOT retained while the ingest is healthy (a
+        # full-layer extra pin of seeder HBM); the fallback recovers
+        # already-written bytes from the dest's own shard buffers
+        # (ingest.salvage) and keeps HOST copies only of fragments that
+        # arrive after a failure.
+        ingest_alive = ingest is not None
+        host_frags: list = []
         try:
             try:
                 for off, arr in self.fabric.collect(
                     msg.plan_id, len(msg.layout)
                 ):
-                    contribs.append((off, arr))
-                    if ingest is not None:
+                    if ingest_alive:
                         try:
                             ingest.write(off, arr)
+                            continue
                         except Exception as e:  # noqa: BLE001
                             log.error("fabric ingest write failed; will "
                                       "assemble on host",
                                       layerID=msg.layer_id, err=repr(e))
-                            ingest = None
+                            ingest_alive = False
+                    import jax
+                    import numpy as np
+
+                    host_frags.append(
+                        (off, np.asarray(jax.device_get(arr)).tobytes())
+                    )
             finally:
                 self.fabric.discard(msg.plan_id)
         except Exception as e:  # noqa: BLE001 — bytes missing: can't deliver
@@ -375,7 +387,7 @@ class ReceiverNode:
                       layerID=msg.layer_id, plan=msg.plan_id, err=repr(e))
             return
         device_arr = None
-        if ingest is not None:
+        if ingest_alive:
             try:
                 device_arr = ingest.finalize()
                 device_arr.block_until_ready()
@@ -389,21 +401,30 @@ class ReceiverNode:
             log.info("layer landed over device fabric", layerID=msg.layer_id,
                      plan=msg.plan_id, total_bytes=msg.total_size)
         else:
-            import jax
-            import numpy as np
-
             buf = bytearray(msg.total_size)
             covered: list = []
+
+            def place(off, data):
+                nonlocal covered
+                buf[off : off + len(data)] = data
+                covered = intervals.insert(covered, off, off + len(data))
+
             for off, data in local:
-                buf[off : off + len(data)] = data
-                covered = intervals.insert(covered, off, off + len(data))
-            for off, arr in contribs:
-                data = np.asarray(jax.device_get(arr)).tobytes()
-                buf[off : off + len(data)] = data
-                covered = intervals.insert(covered, off, off + len(data))
+                place(off, data)
+            if ingest is not None:
+                try:
+                    for off, data in ingest.salvage():
+                        place(off, data)
+                except Exception as e:  # noqa: BLE001
+                    log.error("shard-buffer salvage failed",
+                              layerID=msg.layer_id, err=repr(e))
+            for off, data in host_frags:
+                place(off, data)
             if intervals.covered(covered) < msg.total_size:
-                log.error("fabric plan does not cover the layer; no ack",
-                          layerID=msg.layer_id, plan=msg.plan_id)
+                log.error("host fallback incomplete; awaiting re-plan",
+                          layerID=msg.layer_id, plan=msg.plan_id,
+                          have=intervals.covered(covered),
+                          total=msg.total_size)
                 return
             self._fabric_store(msg.layer_id, msg.total_size, host_buf=buf)
             loc = LayerLocation.INMEM

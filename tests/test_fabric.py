@@ -233,6 +233,27 @@ def test_sharded_ingest_accepts_device_fragments(cpu_devices):
     assert set(arr.devices()) == set(cpu_devices[:4])
 
 
+def test_sharded_ingest_salvage_reads_back_written_bytes(cpu_devices):
+    """salvage(): the fallback assembly source when the gather fails —
+    covered ranges come back byte-exact from the shard buffers, and
+    uncovered ranges are not claimed."""
+    total = 4096
+    data = layer_bytes(5, total)
+    ing = ShardedLayerIngest(total, cpu_devices[:4])
+    ing.write(0, data[:1000])
+    ing.write(2500, data[2500:4096])
+    got = ing.salvage()
+    buf = bytearray(total)
+    covered = 0
+    for off, piece in got:
+        buf[off : off + len(piece)] = piece
+        covered += len(piece)
+    assert covered == 1000 + (4096 - 2500)
+    assert bytes(buf[:1000]) == data[:1000]
+    assert bytes(buf[2500:]) == data[2500:]
+    assert bytes(buf[1000:2500]) == b"\x00" * 1500  # never claimed
+
+
 def test_sharded_ingest_rejects_non_uint8_device_fragment(cpu_devices):
     ing = ShardedLayerIngest(64, cpu_devices[:2])
     with pytest.raises(ValueError, match="uint8"):
