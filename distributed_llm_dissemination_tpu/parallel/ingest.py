@@ -222,7 +222,10 @@ class ShardedLayerIngest:
                 )
             bufs = list(self._bufs)
         if len(self.devices) == 1:
-            return bufs[0][: self.total]
+            # split_offsets(total, 1) gives one exact span, so pad == total
+            # and the shard buffer IS the layer — a [:total] slice here
+            # would be a full-layer HBM copy for nothing.
+            return bufs[0] if self.pad == self.total else bufs[0][: self.total]
         mesh = flat_mesh(self.devices)
         n = len(self.devices)
         global_shape = (n * self.pad,)

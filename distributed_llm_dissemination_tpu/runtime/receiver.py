@@ -63,6 +63,10 @@ class ReceiverNode:
     the first ``announce()`` — the receiver half of the failure detection
     the reference leaves TODO (node.go:218-220)."""
 
+    # How long a fabric dest waits for a plan's contributions before
+    # requesting a re-plan (class attribute: tests and deployments tune it).
+    FABRIC_COLLECT_TIMEOUT = 120.0
+
     def __init__(
         self,
         node: Node,
@@ -315,12 +319,15 @@ class ReceiverNode:
 
         Liveness: a device-side failure (allocation, write, finalize) must
         not hang the run — the dest is alive and heartbeating, so the
-        leader would never re-plan for it.  Every contribution is kept,
-        and on ingest failure the layer is assembled on host and acked
-        INMEM, the same delivery-beats-staging fallback the host receive
-        path has.  Only missing contributions (collect timeout — a dead
-        seeder, which heartbeat detection re-plans around) end without an
-        ack."""
+        leader would never re-plan for it on its own.  On ingest failure
+        the layer is assembled on host — already-written bytes salvaged
+        from the shard buffers, later fragments kept as host copies — and
+        acked INMEM, the same delivery-beats-staging fallback the host
+        receive path has.  When even that can't complete (collect timeout
+        from a dead seeder, or a device fault so deep the salvage read
+        fails too), the dest RE-ANNOUNCES: the leader's re-announce path
+        re-plans its missing layers, so the transfer is retried instead
+        of stranded."""
         with self._lock:
             existing = self.layers.get(msg.layer_id)
         if existing is not None:
@@ -330,8 +337,10 @@ class ReceiverNode:
             # pinned in the registry) and re-ack (the leader missed our
             # ack).  The drain is bounded and off the handler pool.
             try:
-                for _ in self.fabric.collect(msg.plan_id, len(msg.layout),
-                                             timeout=30.0):
+                for _ in self.fabric.collect(
+                    msg.plan_id, len(msg.layout),
+                    timeout=min(30.0, self.FABRIC_COLLECT_TIMEOUT),
+                ):
                     pass
             except TimeoutError:
                 pass
@@ -363,7 +372,8 @@ class ReceiverNode:
         try:
             try:
                 for off, arr in self.fabric.collect(
-                    msg.plan_id, len(msg.layout)
+                    msg.plan_id, len(msg.layout),
+                    timeout=self.FABRIC_COLLECT_TIMEOUT,
                 ):
                     if ingest_alive:
                         try:
@@ -383,8 +393,9 @@ class ReceiverNode:
             finally:
                 self.fabric.discard(msg.plan_id)
         except Exception as e:  # noqa: BLE001 — bytes missing: can't deliver
-            log.error("fabric collect failed; awaiting re-plan",
+            log.error("fabric collect failed; requesting re-plan",
                       layerID=msg.layer_id, plan=msg.plan_id, err=repr(e))
+            self._request_replan()
             return
         device_arr = None
         if ingest_alive:
@@ -421,16 +432,29 @@ class ReceiverNode:
             for off, data in host_frags:
                 place(off, data)
             if intervals.covered(covered) < msg.total_size:
-                log.error("host fallback incomplete; awaiting re-plan",
+                log.error("host fallback incomplete; requesting re-plan",
                           layerID=msg.layer_id, plan=msg.plan_id,
                           have=intervals.covered(covered),
                           total=msg.total_size)
+                self._request_replan()
                 return
             self._fabric_store(msg.layer_id, msg.total_size, host_buf=buf)
             loc = LayerLocation.INMEM
             log.warn("layer assembled on host after fabric failure",
                      layerID=msg.layer_id, plan=msg.plan_id)
         self._send_ack(msg.layer_id, loc)
+
+    def _request_replan(self) -> None:
+        """A delivery this node could not complete (failed fabric plan)
+        would otherwise be stranded forever — the node is alive and
+        heartbeating, so the failure detector never fires for it.
+        Re-announcing is the recovery channel: the leader treats a known
+        node's announce as authoritative inventory and re-plans every
+        still-missing layer (leader.handle_announce)."""
+        try:
+            self.announce()
+        except (OSError, KeyError) as e:
+            log.error("re-announce for re-plan failed", err=repr(e))
 
     def _send_ack(self, layer_id, loc) -> None:
         try:

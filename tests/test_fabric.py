@@ -543,6 +543,51 @@ def test_fabric_ingest_failure_falls_back_to_host_assembly(cpu_devices,
         close_all(leader, receivers, ts)
 
 
+def test_fabric_collect_timeout_triggers_replan_recovery(cpu_devices,
+                                                         monkeypatch):
+    """Liveness: a plan whose contributions never arrive (lost seeder
+    message, deep device fault) must not strand the dest forever — the
+    dest is alive and heartbeating, so the failure detector won't fire.
+    After the collect timeout the dest re-announces, and the leader's
+    re-announce path re-plans the missing layer; the retry delivers."""
+    from distributed_llm_dissemination_tpu.runtime import receiver as recv_mod
+
+    monkeypatch.setattr(ReceiverNode, "FABRIC_COLLECT_TIMEOUT", 0.5)
+    real_contribute = recv_mod.contribute_device_plan
+    dropped = []
+
+    def flaky_contribute(node, layers, lock, fabric, placement, msg):
+        # The FIRST plan's contribution is lost; retries go through.
+        if not dropped:
+            dropped.append(msg.plan_id)
+            return
+        real_contribute(node, layers, lock, fabric, placement, msg)
+
+    monkeypatch.setattr(recv_mod, "contribute_device_plan", flaky_contribute)
+
+    ids = range(3)
+    ts = inmem_transports(ids)
+    assignment = {2: {0: LayerMeta()}}
+    mesh = make_mesh((3, 2), ("pp", "tp"), devices=list(cpu_devices)[:6])
+    placement = fabric_placement(list(ids), assignment, mesh, "pp")
+    fabric = FabricPlane()
+    leader = RetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, assignment, expected_nodes=set(ids),
+        fabric=fabric, placement=placement)
+    receivers = [
+        RetransmitReceiverNode(Node(1, 0, ts[1]), {0: mem_layer(0)},
+                               fabric=fabric, placement=placement),
+        RetransmitReceiverNode(Node(2, 0, ts[2]), {},
+                               fabric=fabric, placement=placement),
+    ]
+    try:
+        run_distribution(leader, receivers, assignment)
+        assert dropped, "the fault was never injected"
+        check_fabric_landing(receivers[-1], placement, [0])
+    finally:
+        close_all(leader, receivers, ts)
+
+
 def test_hbm_only_layer_is_host_readable(cpu_devices):
     """A fabric-delivered layer (device array, no host copy) still serves
     the host paths: read_range materializes a cached host copy from HBM —
