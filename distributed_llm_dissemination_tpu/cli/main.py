@@ -173,8 +173,14 @@ def build_placement(args, conf: cfg.Config):
 
     honor_jax_platforms()
     from ..parallel.mesh import assignment_to_placement, mesh_from_conf
+    from ..parallel.multihost import host_aligned_device_order
 
-    mesh = mesh_from_conf(conf.mesh)
+    # Multi-host: order the mesh's devices so each pipeline stage's block
+    # lives on the host of the node mapped to that stage — otherwise a
+    # node's delivered layers would target another host's chips.
+    mesh = mesh_from_conf(
+        conf.mesh, host_aligned_device_order(conf, conf.assignment)
+    )
     placement = assignment_to_placement(
         conf.assignment, mesh, conf.mesh.pipeline_axis
     )

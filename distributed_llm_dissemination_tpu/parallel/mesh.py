@@ -98,6 +98,18 @@ class StagePlacement:
         return NamedSharding(self.stage_mesh(self.layer_to_stage[layer_id]), spec)
 
 
+def ranked_assignees(assignment: Assignment) -> List[NodeID]:
+    """Assignees in pipeline-stage order: ranked by each node's minimum
+    assigned layer id, so contiguous layer ranges land on consecutive
+    stages — the staged-inference layout the reference's startup hook
+    presumes (distributor/message.go:216-241)."""
+    ranked: List[Tuple[int, NodeID]] = sorted(
+        (min(layers) if layers else 0, node_id)
+        for node_id, layers in assignment.items()
+    )
+    return [node_id for _, node_id in ranked]
+
+
 def assignment_to_placement(
     assignment: Assignment, mesh: Mesh, pipeline_axis: str = "nodes"
 ) -> StagePlacement:
@@ -114,11 +126,10 @@ def assignment_to_placement(
             f"assignment has {len(assignment)} nodes but mesh axis "
             f"'{pipeline_axis}' has only {n_stages} stages"
         )
-    ranked: List[Tuple[int, NodeID]] = sorted(
-        (min(layers) if layers else 0, node_id)
-        for node_id, layers in assignment.items()
-    )
-    node_to_stage = {node_id: stage for stage, (_, node_id) in enumerate(ranked)}
+    node_to_stage = {
+        node_id: stage
+        for stage, node_id in enumerate(ranked_assignees(assignment))
+    }
     layer_to_stage = {
         layer_id: node_to_stage[node_id]
         for node_id, layers in assignment.items()

@@ -88,6 +88,75 @@ def derive_layout(conf: Config, my_id: NodeID) -> ProcessLayout:
     )
 
 
+def host_aligned_device_order(conf: Config, assignment) -> list:
+    """Global device list reordered so pipeline-stage blocks follow node
+    locality: stage i's devices are the ones owned by the process that
+    runs the node mapped to stage i.
+
+    On a multi-host mesh, ``jax.devices()`` comes back in process order —
+    but stage order is semantic (contiguous layers on consecutive stages,
+    ``mesh.ranked_assignees``), and node id ↔ process rank follows the
+    id-sorted node list (``derive_layout``).  A mesh built over the raw
+    device order would hand node N a stage whose devices live on some
+    other host, and every ``device_put`` of a delivered layer would fail.
+    Feeding THIS order to ``make_mesh`` makes each node's stage locally
+    addressable, so ``-hbm`` works across hosts.
+
+    Works for any pipeline-axis position: the order returned is the
+    row-major flattening of a device array whose index s along the
+    pipeline axis is exactly process-rank-of-stage-s's device block — so
+    ``make_mesh``'s plain reshape reproduces the alignment.  Requires one
+    pipeline stage's device count to equal one process's (stage ↔ host,
+    the TPU-VM shape); single-process runs return the plain device
+    list."""
+    import jax
+
+    if jax.process_count() <= 1 or conf.mesh is None:
+        return list(jax.devices())
+    import numpy as np
+
+    from .mesh import ranked_assignees
+
+    shape = tuple(conf.mesh.axis_sizes)
+    names = list(conf.mesh.axis_names)
+    k = names.index(conf.mesh.pipeline_axis)
+    n_stages = shape[k]
+    per_stage = int(np.prod(shape)) // n_stages
+
+    ids = sorted(nc.id for nc in conf.nodes)
+    by_proc: dict = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, []).append(d)
+    counts = {rank: len(devs) for rank, devs in by_proc.items()}
+    if len(set(counts.values())) != 1:
+        raise ValueError(
+            f"uneven devices per process {counts}: host-aligned stages "
+            "need a uniform TPU-VM shape"
+        )
+    per_proc = next(iter(counts.values()))
+    if per_stage != per_proc:
+        raise ValueError(
+            f"one pipeline stage spans {per_stage} devices but each "
+            f"process owns {per_proc}: shape the mesh so one stage == "
+            f"one host (mesh {dict(zip(names, shape))}, "
+            f"{len(by_proc)} processes)"
+        )
+    staged = ranked_assignees(assignment)
+    stage_nodes = staged + [n for n in ids if n not in set(staged)]
+    if n_stages > len(stage_nodes):
+        raise ValueError(
+            f"mesh has {n_stages} pipeline stages but only "
+            f"{len(stage_nodes)} configured nodes to own them"
+        )
+    blocks = [by_proc[ids.index(node_id)] for node_id in stage_nodes[:n_stages]]
+    rest_shape = shape[:k] + shape[k + 1 :]
+    arr = np.empty((n_stages, per_stage), dtype=object)
+    for s, block in enumerate(blocks):
+        arr[s] = block
+    arr = np.moveaxis(arr.reshape((n_stages,) + rest_shape), 0, k)
+    return list(arr.reshape(-1))
+
+
 def maybe_initialize(conf: Config, my_id: NodeID) -> Optional[ProcessLayout]:
     """Join the pod-wide JAX runtime when the config asks for one.
 

@@ -118,6 +118,160 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_host_aligned_device_order_single_process():
+    # Single process: the plain device list, untouched.
+    import jax
+
+    from distributed_llm_dissemination_tpu.parallel.multihost import (
+        host_aligned_device_order,
+    )
+
+    conf = make_conf(3)
+    assert host_aligned_device_order(conf, {2: {0: None}}) == list(jax.devices())
+
+
+class _FakeDev:
+    def __init__(self, process_index, i):
+        self.process_index = process_index
+        self.i = i
+
+    def __repr__(self):
+        return f"d{self.process_index}.{self.i}"
+
+
+def _fake_pod(monkeypatch, n_proc, per_proc):
+    import jax
+
+    devs = [_FakeDev(p, i) for p in range(n_proc) for i in range(per_proc)]
+    monkeypatch.setattr(jax, "process_count", lambda: n_proc)
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: devs)
+    return devs
+
+
+def test_host_aligned_leading_axis(monkeypatch):
+    from distributed_llm_dissemination_tpu.parallel.multihost import (
+        host_aligned_device_order,
+    )
+
+    _fake_pod(monkeypatch, 2, 1)
+    conf = make_conf(2)
+    conf.mesh = cfg.MeshConf(axis_names=["nodes"], axis_sizes=[2],
+                             pipeline_axis="nodes")
+    # Assignee is node 1 (process rank 1): stage 0 must hold ITS device.
+    order = host_aligned_device_order(conf, {1: {0: None}})
+    assert [d.process_index for d in order] == [1, 0]
+
+
+def test_host_aligned_trailing_pipeline_axis(monkeypatch):
+    import numpy as np
+
+    from distributed_llm_dissemination_tpu.parallel.multihost import (
+        host_aligned_device_order,
+    )
+
+    _fake_pod(monkeypatch, 2, 2)
+    conf = make_conf(2)
+    conf.mesh = cfg.MeshConf(axis_names=["tp", "nodes"], axis_sizes=[2, 2],
+                             pipeline_axis="nodes")
+    order = host_aligned_device_order(conf, {1: {0: None}})
+    # make_mesh reshapes row-major to (tp=2, nodes=2): the slice along the
+    # trailing 'nodes' axis at stage s must be one process's block.
+    grid = np.asarray(order, dtype=object).reshape(2, 2)
+    assert {d.process_index for d in grid[:, 0]} == {1}  # assignee's host
+    assert {d.process_index for d in grid[:, 1]} == {0}
+
+
+def test_host_aligned_rejects_stage_host_mismatch(monkeypatch):
+    from distributed_llm_dissemination_tpu.parallel.multihost import (
+        host_aligned_device_order,
+    )
+
+    _fake_pod(monkeypatch, 2, 2)
+    conf = make_conf(2)
+    conf.mesh = cfg.MeshConf(axis_names=["nodes"], axis_sizes=[4],
+                             pipeline_axis="nodes")
+    with pytest.raises(ValueError, match="one stage == one host"):
+        host_aligned_device_order(conf, {1: {0: None}})
+
+
+def test_host_aligned_reports_uneven_counts(monkeypatch):
+    import jax
+
+    from distributed_llm_dissemination_tpu.parallel.multihost import (
+        host_aligned_device_order,
+    )
+
+    devs = [_FakeDev(0, 0), _FakeDev(0, 1), _FakeDev(1, 0)]
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: devs)
+    conf = make_conf(2)
+    conf.mesh = cfg.MeshConf(axis_names=["nodes"], axis_sizes=[2],
+                             pipeline_axis="nodes")
+    with pytest.raises(ValueError, match=r"\{0: 2, 1: 1\}"):
+        host_aligned_device_order(conf, {1: {0: None}})
+
+
+def test_two_process_hbm_dissemination():
+    """The full multi-host loop through the REAL CLI: two processes join
+    one JAX runtime, the mesh's stages align to each node's host, and the
+    receiver lands its delivered layers in (its own host's) device memory
+    — the leader reports TTD, the receiver logs the HBM staging."""
+    port = _free_port()
+    p0, p1 = _free_port(), _free_port()
+    conf_path = os.path.join(REPO, ".pytest-2proc-hbm.json")
+    conf_json = {
+        "Nodes": [
+            {"Id": 0, "Addr": f"127.0.0.1:{p0}", "IsLeader": True,
+             "NetworkBW": 12500000000, "Sources": {"2": 0},
+             "InitialLayers": {"2": {"0": {"LayerSize": 262144},
+                                     "1": {"LayerSize": 262144}}}},
+            {"Id": 1, "Addr": f"127.0.0.1:{p1}",
+             "NetworkBW": 12500000000, "Sources": {"2": 0},
+             "InitialLayers": {}},
+        ],
+        "Assignment": {"1": {"0": {}, "1": {}}},
+        "LayerSize": 262144,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [2],
+                 "PipelineAxis": "nodes"},
+        "Distributed": {"Coordinator": f"127.0.0.1:{port}",
+                        "CpuCollectives": "gloo"},
+    }
+    with open(conf_path, "w") as f:
+        json.dump(conf_json, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per process
+    cli = [sys.executable, "-m", "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "0", "-hbm"]
+    try:
+        recv = subprocess.Popen(cli + ["-id", "1"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env, text=True)
+        lead = subprocess.Popen(cli + ["-id", "0"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env, text=True)
+        try:
+            lead_out, lead_err = lead.communicate(timeout=180)
+            recv_out, recv_err = recv.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            lead.kill()
+            recv.kill()
+            raise
+        assert lead.returncode == 0, f"leader failed:\n{lead_err[-3000:]}"
+        assert recv.returncode == 0, f"receiver failed:\n{recv_err[-3000:]}"
+        assert "Time to deliver" in lead_out
+        assert "ready" in recv_out
+        # The receiver really staged to device memory on its own host.
+        assert "layer staged to HBM" in recv_err
+        assert "global_devices\": 2" in lead_err.replace("'", '"') or \
+            '"global_devices": 2' in lead_err
+    finally:
+        for p in (locals().get("recv"), locals().get("lead")):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if os.path.exists(conf_path):
+            os.remove(conf_path)
+
+
 def test_two_process_cpu_smoke():
     """Two real OS processes form one JAX runtime from the same config:
     each contributes its local CPU device; both see global=2."""
