@@ -177,6 +177,36 @@ def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
     return results
 
 
+# The driver-provided BASELINE.json scenarios (#2-#5), materialized by
+# cli.genconf: (config file, the mode the scenario names).
+BASELINE_SCENARIOS = (
+    ("bench_8node_llama8b.json", 0),
+    ("bench_16node_llama70b.json", 1),
+    ("bench_32node_pipeline.json", 1),
+    ("bench_64node_llama405b.json", 1),
+)
+
+
+def run_baseline_scenarios(scale: int, timeout: float = 600.0) -> dict:
+    """One recorded TTD per BASELINE scenario, at loopback scale.
+
+    Layer sizes scale down (64-node Llama-405B at physical size needs a
+    real cluster); node counts and schedules stay faithful — up to 64 OS
+    processes over loopback, the reference's own benchmark shape."""
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        for name, mode in BASELINE_SCENARIOS:
+            local = os.path.join(td, name)
+            _localize_config(os.path.join(CONF_DIR, name), local,
+                             scale_to=scale)
+            ttd = run_once(local, mode, timeout)
+            key = f"{os.path.splitext(name)[0]}@{scale >> 10}KiB"
+            out[key] = {"mode": mode, "ttd_s": round(ttd, 4)}
+            print(f"{key} mode {mode}: TTD {ttd:.4f}s",
+                  file=sys.stderr, flush=True)
+    return out
+
+
 def to_markdown(results: dict) -> str:
     lines = [
         "# TTD matrix",
@@ -202,6 +232,21 @@ def to_markdown(results: dict) -> str:
         row.append(str(per_mode.get("mode1_vs_mode0", "—")))
         lines.append("| " + " | ".join(row) + " |")
     lines.append("")
+    baseline = results.get("baseline_scenarios")
+    if baseline:
+        lines += [
+            "## BASELINE.json scenarios (#2-#5)",
+            "",
+            "Driver-named benchmark topologies (cli.genconf), run at "
+            "loopback scale with faithful node counts and schedules — "
+            "8 to 64 OS processes:",
+            "",
+            "| scenario | mode | TTD |",
+            "|---|---|---|",
+        ]
+        for name, rec in baseline.items():
+            lines.append(f"| {name} | {rec['mode']} | {rec['ttd_s']}s |")
+        lines.append("")
     return "\n".join(lines)
 
 
@@ -211,8 +256,25 @@ def main(argv=None) -> int:
     p.add_argument("-scale", type=int, default=8 << 20,
                    help="scaled LayerSize bytes for the reference scenario")
     p.add_argument("-trials", type=int, default=3)
+    p.add_argument("-baseline", action="store_true",
+                   help="also run the BASELINE.json scenarios #2-#5 "
+                        "(8-64 processes; minutes of wall time)")
     args = p.parse_args(argv)
     results = run_matrix(args.scale, args.trials)
+    if args.baseline:
+        results["baseline_scenarios"] = run_baseline_scenarios(
+            min(args.scale, 256 << 10)
+        )
+    elif os.path.exists(args.o):
+        # A refresh without -baseline must not erase the recorded
+        # BASELINE scenario results (minutes of 64-process wall time).
+        try:
+            with open(args.o) as f:
+                prior = json.load(f).get("baseline_scenarios")
+        except (OSError, ValueError):
+            prior = None
+        if prior:
+            results["baseline_scenarios"] = prior
     with open(args.o, "w") as f:
         json.dump(results, f, indent=1)
     md = os.path.splitext(args.o)[0] + ".md"

@@ -53,6 +53,80 @@ def fetch_from_client(node: Node, layer_id: LayerID, dest: NodeID) -> None:
     node.transport.send(CLIENT_ID, ClientReqMsg(node.my_id, layer_id, False))
 
 
+class _FabricUploadCache:
+    """Budgeted LRU over seeder-side full-layer device copies.
+
+    A seeder serving many layers to many destinations must not pin one
+    whole-layer HBM copy per layer forever — at 70B scale that exceeds a
+    chip.  Entries count against ``budget_bytes`` (default 4 GiB,
+    ``FABRIC_UPLOAD_CACHE_BYTES`` env overrides); eviction clears the
+    record's ``device_array`` (safe: only records this cache populated —
+    never receiver-staged HBM layers, whose location is HBM).  A failed
+    upload is memoized so k plans don't re-read a multi-GiB layer into
+    host RAM k times just to fail the same device_put again."""
+
+    def __init__(self):
+        import os
+
+        self.budget = int(os.environ.get("FABRIC_UPLOAD_CACHE_BYTES",
+                                         4 << 30))
+        self._lock = threading.Lock()
+        self._order: Dict[int, object] = {}  # id(record) -> record (LRU)
+        self._bytes = 0
+        self._failed: set = set()  # id(record)s whose upload failed
+
+    def get_or_put(self, layer, layer_id, device):
+        import jax
+        import numpy as np
+
+        with layer._host_lock:  # once-guard, shared with ensure_host_bytes
+            dev = getattr(layer, "device_array", None)
+            if dev is not None:
+                return dev if (getattr(dev, "ndim", 0) == 1
+                               and dev.dtype == np.uint8) else None
+            key = id(layer)
+            with self._lock:
+                if key in self._failed or layer.data_size > self.budget:
+                    return None
+            try:
+                whole = np.frombuffer(
+                    layer.read_span(0, layer.data_size), np.uint8
+                )
+                dev = jax.device_put(whole, device)
+            except Exception as e:  # noqa: BLE001 — fall back to ranges
+                log.warn("full-layer upload cache failed; using range "
+                         "uploads for this layer from now on",
+                         layerID=layer_id, err=repr(e))
+                with self._lock:
+                    self._failed.add(key)
+                return None
+            layer.device_array = dev
+        # Victims are collected under the cache lock but cleared outside
+        # it: clearing takes the victim's _host_lock, and another thread
+        # in get_or_put holds its own _host_lock while briefly taking the
+        # cache lock — nesting them here in the opposite order could
+        # deadlock.
+        victims = []
+        with self._lock:
+            self._order[key] = layer
+            self._bytes += layer.data_size
+            while self._bytes > self.budget and len(self._order) > 1:
+                old_key, old = next(iter(self._order.items()))
+                if old_key == key:
+                    break  # never evict the entry just inserted
+                del self._order[old_key]
+                self._bytes -= old.data_size
+                victims.append(old)
+        for old in victims:
+            with old._host_lock:
+                if old.meta.location != LayerLocation.HBM:
+                    old.device_array = None  # frees the HBM copy
+        return dev
+
+
+_upload_cache = _FabricUploadCache()
+
+
 def contribute_device_plan(
     node: Node, layers: LayersSrc, lock: threading.Lock, fabric, placement,
     msg,
@@ -84,6 +158,15 @@ def contribute_device_plan(
         getattr(dev_src, "ndim", 0) == 1 and dev_src.dtype == np.uint8
     ):
         dev_src = None  # only raw uint8 blobs slice meaningfully by byte
+
+    if dev_src is None and sum(size for _, size in mine) * 2 >= layer.data_size:
+        # Contributing most of the layer: upload it whole ONCE and cache
+        # the device copy on the record — a mode-0/1 seeder serving k
+        # destinations (k plans, each a full-layer layout) then pays one
+        # host→HBM upload instead of k, and every later plan or re-plan
+        # slices device-side.  Small byte-range jobs (mode-3 splits) keep
+        # the range-only upload below.
+        dev_src = _upload_cache.get_or_put(layer, msg.layer_id, devices[0])
 
     for k, (off, size) in enumerate(mine):
         dev = devices[k % len(devices)]

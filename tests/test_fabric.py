@@ -543,6 +543,40 @@ def test_fabric_ingest_failure_falls_back_to_host_assembly(cpu_devices,
         close_all(leader, receivers, ts)
 
 
+def test_multi_dest_contribution_caches_one_device_upload(cpu_devices):
+    """A seeder serving the same layer to two destinations uploads it to
+    its own HBM once: the full-layer device copy is cached on the record
+    and both plans' contributions slice device-side."""
+    ids = range(4)
+    ts = inmem_transports(ids)
+    assignment = {2: {0: LayerMeta()}, 3: {0: LayerMeta()}}
+    mesh = make_mesh((4, 2), ("pp", "tp"))
+    placement = fabric_placement(list(ids), assignment, mesh, "pp")
+    fabric = FabricPlane()
+    leader = RetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, assignment, expected_nodes=set(ids),
+        fabric=fabric, placement=placement)
+    seeder = RetransmitReceiverNode(Node(1, 0, ts[1]), {0: mem_layer(0)},
+                                    fabric=fabric, placement=placement)
+    dests = [
+        RetransmitReceiverNode(Node(i, 0, ts[i]), {}, fabric=fabric,
+                               placement=placement)
+        for i in (2, 3)
+    ]
+    try:
+        run_distribution(leader, [seeder] + dests, assignment)
+        for d in dests:
+            check_fabric_landing(d, placement, [0])
+        # The seeder's record now carries the cached full-layer device
+        # copy (host bytes untouched, location still INMEM).
+        src = seeder.layers[0]
+        assert src.device_array is not None
+        assert src.meta.location == LayerLocation.INMEM
+        assert array_to_bytes(src.device_array) == layer_bytes(0)
+    finally:
+        close_all(leader, [seeder] + dests, ts)
+
+
 def test_fabric_collect_timeout_triggers_replan_recovery(cpu_devices,
                                                          monkeypatch):
     """Liveness: a plan whose contributions never arrive (lost seeder

@@ -44,9 +44,48 @@ def test_mode3_not_padded_to_a_second(local4):
     assert t3 < 0.5, f"mode 3 TTD {t3}s looks 1s-padded"
 
 
+def test_genconf_scenarios_parse_and_match_shapes(tmp_path):
+    # The four BASELINE benchmark topologies regenerate deterministically,
+    # parse through the loader, and keep their driver-named shapes.
+    from distributed_llm_dissemination_tpu.cli import genconf
+    from distributed_llm_dissemination_tpu.core import config as cfg
+
+    genconf.main(["-o", str(tmp_path)])
+    shapes = {
+        "bench_8node_llama8b.json": (8, 32, 400 << 20),
+        "bench_16node_llama70b.json": (16, 80, int(1.6 * (1 << 30))),
+        "bench_32node_pipeline.json": (32, 80, int(1.6 * (1 << 30))),
+        "bench_64node_llama405b.json": (64, 126, int(3.2 * (1 << 30))),
+    }
+    for name, (nodes, layers, size) in shapes.items():
+        c = cfg.read_json(str(tmp_path / name))
+        assert len(c.nodes) == nodes
+        assigned = {lid for v in c.assignment.values() for lid in v}
+        assert assigned == set(range(layers))
+        assert c.layer_size == size
+        # The shipped copy matches the generator (no drift).
+        shipped = cfg.read_json(os.path.join(tm.CONF_DIR, name))
+        assert shipped == c
+
+
+def test_pipeline_scenario_assignment_is_contiguous(tmp_path):
+    from distributed_llm_dissemination_tpu.cli import genconf
+    from distributed_llm_dissemination_tpu.core import config as cfg
+
+    genconf.main(["-o", str(tmp_path)])
+    c = cfg.read_json(str(tmp_path / "bench_32node_pipeline.json"))
+    pos = 0
+    for dest in sorted(c.assignment):
+        lids = sorted(c.assignment[dest])
+        assert lids == list(range(pos, pos + len(lids))), dest
+        pos += len(lids)
+    assert pos == 80
+
+
 def test_checked_in_matrix_is_current():
     # The recorded matrix must exist, parse, and hold the north-star
-    # mode1/mode0 ratio for the reference scenario.
+    # mode1/mode0 ratio for the reference scenario — plus a recorded TTD
+    # for every BASELINE.json scenario (#2-#5).
     path = os.path.join(REPO, "TTD_MATRIX.json")
     with open(path) as f:
         results = json.load(f)
@@ -57,3 +96,8 @@ def test_checked_in_matrix_is_current():
     for mode in ("0", "1", "2", "3"):
         assert ref[mode]["ttd_s"] > 0
     assert ref["mode1_vs_mode0"] <= 1.5, ref
+    baseline = results["baseline_scenarios"]
+    for stem in ("bench_8node_llama8b", "bench_16node_llama70b",
+                 "bench_32node_pipeline", "bench_64node_llama405b"):
+        rec = next(v for k, v in baseline.items() if k.startswith(stem))
+        assert rec["ttd_s"] > 0
