@@ -117,6 +117,12 @@ class LayerSrc:
     _host_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False
     )
+    # Set by the fabric upload cache when a whole-layer device_put failed
+    # for this record — later plans then stick to range uploads instead of
+    # re-reading a multi-GiB layer just to fail the same allocation again.
+    upload_failed: bool = dataclasses.field(
+        default=False, repr=False, compare=False
+    )
 
     def _host_resident(self) -> bool:
         """Host bytes available?  True for INMEM, and for HBM-staged layers

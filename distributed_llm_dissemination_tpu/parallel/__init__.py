@@ -1,5 +1,6 @@
 from .collectives import (  # noqa: F401
     allgather_shards,
+    gather_tiles,
     one_to_all,
     permute_blocks,
     replicate,

@@ -18,6 +18,13 @@ a collective-program equivalent compiled via ``jax.shard_map`` onto a
 
 These programs are jit-compiled once per (shape, mesh) and reused per
 layer; the scalar plumbing stays on host (the control plane).
+
+``gather_tiles`` is the one the runtime ships bytes through: the fabric
+dest's ingest (``ingest.ShardedLayerIngest.finalize``) and the device-
+executed flow plan (``plan.execute_flow_plan``) both compile it.  The
+mode-shaped programs (``ring_broadcast``/``one_to_all``/``permute_blocks``)
+are schedule-parity forms kept for comparison tests and as building
+blocks for topology-aware schedules.
 """
 
 from __future__ import annotations
@@ -41,6 +48,35 @@ def shard_along(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
     """Split a 1-D layer into per-device byte-range shards along ``axis``
     (the device-plane form of flow.go's offset/dataSize jobs)."""
     return jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+
+@functools.lru_cache(maxsize=64)
+def gather_tiles(mesh: Mesh, axis: str, sizes: Tuple[int, ...]):
+    """Compiled: each device holds one PADDED tile of a byte blob (tile i
+    is ``sizes[i]`` real elements); one ``all_gather`` + static re-splice
+    yields the full blob replicated on every device of the mesh.
+
+    THE terminal-hop collective of the dissemination runtime: both
+    ``plan.execute_flow_plan`` (a mode-3 flow schedule executed as one
+    device program) and ``ingest.ShardedLayerIngest.finalize`` (the
+    receiver's incremental HBM ingest) compile through here — unequal
+    flow-job splits are padded to the largest tile, and the re-splice
+    uses static slice bounds so XLA fuses it into the gather epilogue."""
+
+    def per_device(frag):
+        g = lax.all_gather(frag, axis)  # (n, pad)
+        parts = [lax.slice(g[i], (0,), (sizes[i],)) for i in range(len(sizes))]
+        return jnp.concatenate(parts)
+
+    @jax.jit
+    def run(v):
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=P(axis), out_specs=P(),
+            check_vma=False,
+        )(v)
+
+    return run
 
 
 @functools.lru_cache(maxsize=64)

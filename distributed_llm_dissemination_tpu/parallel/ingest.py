@@ -37,7 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.reassembly import _write_1d, split_offsets
 from ..sched.flow import FlowJob
 from ..utils import intervals
-from .plan import _gather_program, execute_flow_plan
+from .collectives import gather_tiles
+from .plan import execute_flow_plan
 
 
 def flat_mesh(devices: Sequence[jax.Device], axis: str = "ingest") -> Mesh:
@@ -233,4 +234,4 @@ class ShardedLayerIngest:
             global_shape, NamedSharding(mesh, P("ingest")), bufs
         )
         sizes = tuple(size for _, size in self.spans)
-        return _gather_program(mesh, "ingest", sizes)(v)
+        return gather_tiles(mesh, "ingest", sizes)(v)

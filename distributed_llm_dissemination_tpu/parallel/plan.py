@@ -18,16 +18,15 @@ the program), so XLA fuses it with the gather epilogue.
 
 from __future__ import annotations
 
-import functools
 from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..sched.flow import FlowJob
+from .collectives import gather_tiles
 
 
 def plan_layout(jobs: Sequence[FlowJob]) -> List[Tuple[int, int, int]]:
@@ -50,27 +49,6 @@ def plan_layout(jobs: Sequence[FlowJob]) -> List[Tuple[int, int, int]]:
         layout.append((sender_id, off, size))
         pos += size
     return layout
-
-
-@functools.lru_cache(maxsize=64)
-def _gather_program(mesh: Mesh, axis: str, sizes: Tuple[int, ...]):
-    """Compiled: each device holds its padded fragment; one tiled gather +
-    static re-splice yields the full layer replicated everywhere."""
-
-    def per_device(frag):
-        g = lax.all_gather(frag, axis)  # (n, pad)
-        parts = [lax.slice(g[i], (0,), (sizes[i],)) for i in range(len(sizes))]
-        return jnp.concatenate(parts)
-
-    @jax.jit
-    def run(v):
-        return jax.shard_map(
-            per_device, mesh=mesh,
-            in_specs=P(axis), out_specs=P(),
-            check_vma=False,
-        )(v)
-
-    return run
 
 
 def execute_flow_plan(
@@ -110,4 +88,4 @@ def execute_flow_plan(
     v = jax.make_array_from_single_device_arrays(
         global_shape, NamedSharding(mesh, P(axis)), shards
     )
-    return _gather_program(mesh, axis, tuple(sizes))(v)
+    return gather_tiles(mesh, axis, tuple(sizes))(v)

@@ -563,6 +563,10 @@ class LeaderNode:
                 self.node.transport.send(node_id, StartupMsg(self.node.my_id))
             except (OSError, KeyError) as e:
                 log.error("failed to send startup", dest=node_id, err=repr(e))
+        if self.fabric is not None:
+            from .send import release_upload_cache
+
+            release_upload_cache()  # the leader can be a fabric seeder too
 
 
 class RetransmitLeaderNode(LeaderNode):

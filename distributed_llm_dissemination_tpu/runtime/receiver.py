@@ -52,6 +52,7 @@ from .send import (
     contribute_device_plan,
     fetch_from_client,
     handle_flow_retransmit,
+    release_upload_cache,
     send_layer,
 )
 
@@ -471,6 +472,10 @@ class ReceiverNode:
         immediately (delivery is done), the boot runs on the handler pool,
         and its completion is reported to the leader as a BootReadyMsg."""
         self._ready_q.put(object())
+        if self.fabric is not None:
+            # Dissemination is over: the cached fabric uploads' HBM now
+            # belongs to whatever boots next.
+            release_upload_cache()
         if self.boot_cfg is None:
             return
         with self._lock:

@@ -73,21 +73,22 @@ class _FabricUploadCache:
         self._lock = threading.Lock()
         self._order: Dict[int, object] = {}  # id(record) -> record (LRU)
         self._bytes = 0
-        self._failed: set = set()  # id(record)s whose upload failed
 
     def get_or_put(self, layer, layer_id, device):
         import jax
         import numpy as np
 
+        key = id(layer)
         with layer._host_lock:  # once-guard, shared with ensure_host_bytes
             dev = getattr(layer, "device_array", None)
             if dev is not None:
+                with self._lock:  # LRU touch: reuse = recency
+                    if key in self._order:
+                        self._order[key] = self._order.pop(key)
                 return dev if (getattr(dev, "ndim", 0) == 1
                                and dev.dtype == np.uint8) else None
-            key = id(layer)
-            with self._lock:
-                if key in self._failed or layer.data_size > self.budget:
-                    return None
+            if layer.upload_failed or layer.data_size > self.budget:
+                return None
             try:
                 whole = np.frombuffer(
                     layer.read_span(0, layer.data_size), np.uint8
@@ -97,8 +98,9 @@ class _FabricUploadCache:
                 log.warn("full-layer upload cache failed; using range "
                          "uploads for this layer from now on",
                          layerID=layer_id, err=repr(e))
-                with self._lock:
-                    self._failed.add(key)
+                # Memoized on the RECORD (an id()-keyed set would outlive
+                # the object and poison whatever reuses its address).
+                layer.upload_failed = True
                 return None
             layer.device_array = dev
         # Victims are collected under the cache lock but cleared outside
@@ -123,8 +125,29 @@ class _FabricUploadCache:
                     old.device_array = None  # frees the HBM copy
         return dev
 
+    def clear(self) -> int:
+        """Release every cached upload (dissemination is over — the HBM
+        belongs to the booting model now).  Returns entries freed."""
+        with self._lock:
+            victims = list(self._order.values())
+            self._order.clear()
+            self._bytes = 0
+        for old in victims:
+            with old._host_lock:
+                if old.meta.location != LayerLocation.HBM:
+                    old.device_array = None
+        return len(victims)
+
 
 _upload_cache = _FabricUploadCache()
+
+
+def release_upload_cache() -> None:
+    """Drop the fabric upload cache's device copies; nodes call this on
+    startup (assignment satisfied — no more plans will need them)."""
+    freed = _upload_cache.clear()
+    if freed:
+        log.info("released fabric upload cache", entries=freed)
 
 
 def contribute_device_plan(

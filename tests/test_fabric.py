@@ -567,14 +567,66 @@ def test_multi_dest_contribution_caches_one_device_upload(cpu_devices):
         run_distribution(leader, [seeder] + dests, assignment)
         for d in dests:
             check_fabric_landing(d, placement, [0])
-        # The seeder's record now carries the cached full-layer device
-        # copy (host bytes untouched, location still INMEM).
+        # On startup the cache is released: the seeder's record is back
+        # to host-only (its HBM belongs to whatever boots next).
         src = seeder.layers[0]
-        assert src.device_array is not None
+        assert src.device_array is None
         assert src.meta.location == LayerLocation.INMEM
-        assert array_to_bytes(src.device_array) == layer_bytes(0)
     finally:
         close_all(leader, [seeder] + dests, ts)
+
+
+def test_fabric_upload_cache_unit(cpu_devices):
+    """One upload serves many plans; eviction and clear release the HBM
+    copies; a failed upload is memoized on the record."""
+    import jax
+
+    from distributed_llm_dissemination_tpu.runtime.send import (
+        _FabricUploadCache,
+    )
+
+    cache = _FabricUploadCache()
+    cache.budget = 3 * LAYER_SIZE  # room for 3 entries
+
+    puts = []
+    real_put = jax.device_put
+
+    def counting_put(x, d=None, **kw):
+        puts.append(1)
+        return real_put(x, d, **kw)
+
+    layers = [mem_layer(i) for i in range(4)]
+    import unittest.mock as mock
+
+    with mock.patch.object(jax, "device_put", counting_put):
+        a = cache.get_or_put(layers[0], 0, cpu_devices[0])
+        b = cache.get_or_put(layers[0], 0, cpu_devices[0])
+    assert a is b and len(puts) == 1  # second plan reused the upload
+    assert array_to_bytes(a) == layer_bytes(0)
+
+    # LRU: touch layer 0, insert 1..3 — budget 3 evicts the stale entry
+    # (layer 1), never the re-touched layer 0.
+    cache.get_or_put(layers[1], 1, cpu_devices[0])
+    cache.get_or_put(layers[0], 0, cpu_devices[0])  # touch
+    cache.get_or_put(layers[2], 2, cpu_devices[0])
+    cache.get_or_put(layers[3], 3, cpu_devices[0])
+    assert layers[1].device_array is None, "LRU should evict the coldest"
+    assert layers[0].device_array is not None
+
+    assert cache.clear() > 0
+    for rec in layers:
+        assert rec.device_array is None
+
+    # Failure memoized on the record, not by object address.
+    broken = mem_layer(0)
+
+    def failing_put(x, d=None, **kw):
+        raise RuntimeError("no HBM")
+
+    with mock.patch.object(jax, "device_put", failing_put):
+        assert cache.get_or_put(broken, 0, cpu_devices[0]) is None
+    assert broken.upload_failed
+    assert cache.get_or_put(broken, 0, cpu_devices[0]) is None  # no re-read
 
 
 def test_fabric_collect_timeout_triggers_replan_recovery(cpu_devices,
