@@ -66,6 +66,7 @@ from .send import (
     contribute_device_plan,
     fetch_from_client,
     handle_flow_retransmit,
+    release_upload_cache,
     send_layer,
 )
 
@@ -564,8 +565,6 @@ class LeaderNode:
             except (OSError, KeyError) as e:
                 log.error("failed to send startup", dest=node_id, err=repr(e))
         if self.fabric is not None:
-            from .send import release_upload_cache
-
             release_upload_cache()  # the leader can be a fabric seeder too
 
 
@@ -1057,11 +1056,18 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     if layer_id not in layer_sizes:
                         log.error("no announced size for layer", layerID=layer_id)
                         continue
-                    if layer_id in self.status.get(dest, {}):
-                        self_jobs.setdefault(dest, []).append(
-                            FlowJob(dest, layer_id, layer_sizes[layer_id], 0,
-                                    dest)
-                        )
+                    held = self.status.get(dest, {}).get(layer_id)
+                    if held is not None:
+                        # Already in RAM/HBM: satisfaction counts it as-is
+                        # — a self-job would re-send the layer to itself
+                        # for nothing.  DISK/CLIENT copies DO need the
+                        # self-fetch (delivery means in-memory,
+                        # node.go:435-446; self-jobs at :1205-1217).
+                        if not delivered(held):
+                            self_jobs.setdefault(dest, []).append(
+                                FlowJob(dest, layer_id,
+                                        layer_sizes[layer_id], 0, dest)
+                            )
                         continue
                     info = self.partial_status.get(dest, {}).get(layer_id)
                     if info:

@@ -73,6 +73,10 @@ class _FabricUploadCache:
         self._lock = threading.Lock()
         self._order: Dict[int, object] = {}  # id(record) -> record (LRU)
         self._bytes = 0
+        # Bumped by clear(): an upload that straddles a release (startup
+        # raced a late plan on the handler pool) must not re-pin HBM that
+        # now belongs to the booting model.
+        self._epoch = 0
 
     def get_or_put(self, layer, layer_id, device):
         import jax
@@ -89,6 +93,8 @@ class _FabricUploadCache:
                                and dev.dtype == np.uint8) else None
             if layer.upload_failed or layer.data_size > self.budget:
                 return None
+            with self._lock:
+                epoch = self._epoch
             try:
                 whole = np.frombuffer(
                     layer.read_span(0, layer.data_size), np.uint8
@@ -109,16 +115,27 @@ class _FabricUploadCache:
         # cache lock — nesting them here in the opposite order could
         # deadlock.
         victims = []
+        retained = True
         with self._lock:
-            self._order[key] = layer
-            self._bytes += layer.data_size
-            while self._bytes > self.budget and len(self._order) > 1:
-                old_key, old = next(iter(self._order.items()))
-                if old_key == key:
-                    break  # never evict the entry just inserted
-                del self._order[old_key]
-                self._bytes -= old.data_size
-                victims.append(old)
+            if self._epoch != epoch:
+                # clear() ran while we uploaded: serve THIS plan from the
+                # transient handle but do not retain the copy.
+                retained = False
+            else:
+                self._order[key] = layer
+                self._bytes += layer.data_size
+                while self._bytes > self.budget and len(self._order) > 1:
+                    old_key, old = next(iter(self._order.items()))
+                    if old_key == key:
+                        break  # never evict the entry just inserted
+                    del self._order[old_key]
+                    self._bytes -= old.data_size
+                    victims.append(old)
+        if not retained:
+            with layer._host_lock:
+                if (layer.device_array is dev
+                        and layer.meta.location != LayerLocation.HBM):
+                    layer.device_array = None
         for old in victims:
             with old._host_lock:
                 if old.meta.location != LayerLocation.HBM:
@@ -132,6 +149,7 @@ class _FabricUploadCache:
             victims = list(self._order.values())
             self._order.clear()
             self._bytes = 0
+            self._epoch += 1
         for old in victims:
             with old._host_lock:
                 if old.meta.location != LayerLocation.HBM:
