@@ -70,22 +70,32 @@ def main() -> None:
         for i, (off, size) in enumerate(split_offsets(total, PARTS))
     ]
 
-    # Raw host→device ceiling: one bulk transfer of the same byte count.
+    # Raw host→device ceiling: bulk transfers of the same byte count,
+    # INTERLEAVED with the ingest trials below — the link's achievable
+    # rate drifts between runs (shared tunnel/PCIe), so a single upfront
+    # probe can misstate the denominator several-fold.  Medians of
+    # interleaved samples keep the ratio honest.
     bulk = np.frombuffer(b"".join(d for _, d in frags), np.uint8)
-    jax.block_until_ready(jax.device_put(bulk, devices[0]))  # warm
-    t0 = time.monotonic()
-    jax.block_until_ready(jax.device_put(bulk, devices[0]))
-    raw_dma_gbps = total / (time.monotonic() - t0) / 1e9
 
-    # Warm the ingest path (compiles _write_1d per fragment-cut shape and
-    # the finalize gather), then time TRIALS full layers.
-    ingest_once(total, frags, devices)
-    times = []
+    def raw_once() -> float:
+        t0 = time.monotonic()
+        jax.block_until_ready(jax.device_put(bulk, devices[0]))
+        return time.monotonic() - t0
+
+    # Warm both paths (compiles _write_1d per fragment-cut shape and the
+    # finalize gather; first DMA maps buffers), then alternate timings.
+    raw_once()
+    arr = ingest_once(total, frags, devices)
+    times, raw_times = [], []
     for _ in range(TRIALS):
+        arr = None  # free the previous layer BEFORE probing: the raw
+        # measurement must see the same clean device the ingest gets.
+        raw_times.append(raw_once())
         t0 = time.monotonic()
         arr = ingest_once(total, frags, devices)
         times.append(time.monotonic() - t0)
     del arr
+    raw_dma_gbps = total / statistics.median(raw_times) / 1e9
 
     gbps = total / statistics.median(times) / 1e9
     print(
@@ -99,6 +109,9 @@ def main() -> None:
                 "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                 "raw_dma_gbps": round(raw_dma_gbps, 3),
                 "link_fraction": round(gbps / raw_dma_gbps, 3),
+                "note": "absolute GB/s is bound by this host's measured "
+                        "device link (raw_dma_gbps, interleaved medians); "
+                        "link_fraction is the framework's efficiency on it",
             }
         )
     )

@@ -41,6 +41,7 @@ _DURATION_RULES = {
 _INSTANT_MESSAGES = {
     "timer start",
     "timer stop: startup",
+    "timer stop: first token",
     "node declared crashed",
     "declared-dead node announced again; reviving",
     "node re-announced; re-planning",
@@ -51,6 +52,15 @@ _INSTANT_MESSAGES = {
     "job completed",
     "layer fully received",
     "received startup: ready",
+    # Device data plane (fabric) + boot lifecycle:
+    "pod fabric up",
+    "dispatching device plan",
+    "layer landed over device fabric",
+    "layer assembled on host after fabric failure",
+    "layer staged to HBM",
+    "model booted from disseminated layers",
+    "pipeline stage booted from disseminated layers",
+    "released fabric upload cache",
 }
 
 

@@ -384,8 +384,14 @@ class LeaderNode:
         if self.fabric is None or self.placement is None:
             log.error("device plan but no fabric wired", plan=msg.plan_id)
             return
+        with self._lock:
+            # A plan after startup (this leader as seeder for a stale or
+            # next-cycle transfer) serves from a transient upload: the
+            # cache was released for the booting model.
+            retain = not self._startup_sent
         contribute_device_plan(self.node, self.layers, self._lock,
-                               self.fabric, self.placement, msg)
+                               self.fabric, self.placement, msg,
+                               retain_uploads=retain)
 
     def _fabric_ok(
         self, layer_id: LayerID, layout: List[Tuple[NodeID, int, int]],

@@ -114,6 +114,10 @@ class ReceiverNode:
         self.fabric = fabric
         self.boot_result = None  # BootResult after a successful boot
         self._boot_started = False
+        # True once this node saw startup for the current cycle: plans
+        # arriving after it serve from transient uploads (nothing may
+        # re-pin the HBM the booted model owns); announce() re-arms.
+        self._startup_seen = False
         # Eager when enabled: handlers run on a 16-worker pool, so a lazy
         # check-then-set would race; raw byte blobs stage as uint8 so
         # odd-length layers round-trip exactly (bf16 would pad a byte).
@@ -156,6 +160,7 @@ class ReceiverNode:
                 for lid, src in self.layers.items()
             }
         next_hop = self.node.get_next_hop(self.node.leader_id)
+        self._startup_seen = False  # (re)entering a distribution cycle
         self.node.transport.send(
             next_hop,
             AnnounceMsg(self.node.my_id, layer_ids,
@@ -283,7 +288,8 @@ class ReceiverNode:
         # otherwise pin full-layer device buffers forever.
         self.fabric.gc()
         contribute_device_plan(self.node, self.layers, self._lock,
-                               self.fabric, self.placement, msg)
+                               self.fabric, self.placement, msg,
+                               retain_uploads=not self._startup_seen)
         if msg.dest_id == self.node.my_id:
             threading.Thread(
                 target=self._receive_device_plan, args=(msg,), daemon=True
@@ -472,6 +478,7 @@ class ReceiverNode:
         immediately (delivery is done), the boot runs on the handler pool,
         and its completion is reported to the leader as a BootReadyMsg."""
         self._ready_q.put(object())
+        self._startup_seen = True
         if self.fabric is not None:
             # Dissemination is over: the cached fabric uploads' HBM now
             # belongs to whatever boots next.

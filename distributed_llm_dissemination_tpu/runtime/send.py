@@ -78,7 +78,12 @@ class _FabricUploadCache:
         # now belongs to the booting model.
         self._epoch = 0
 
-    def get_or_put(self, layer, layer_id, device):
+    def get_or_put(self, layer, layer_id, device, retain: bool = True):
+        """``retain=False`` serves the plan from a transient upload that
+        is never cached — callers pass it for plans arriving after their
+        node saw startup (a stale re-plan duplicate, or a new cycle whose
+        own startup will re-release): nothing may re-pin HBM the booted
+        model now owns."""
         import jax
         import numpy as np
 
@@ -108,6 +113,8 @@ class _FabricUploadCache:
                 # the object and poison whatever reuses its address).
                 layer.upload_failed = True
                 return None
+            if not retain:
+                return dev  # transient: caller's references only
             layer.device_array = dev
         # Victims are collected under the cache lock but cleared outside
         # it: clearing takes the victim's _host_lock, and another thread
@@ -170,7 +177,7 @@ def release_upload_cache() -> None:
 
 def contribute_device_plan(
     node: Node, layers: LayersSrc, lock: threading.Lock, fabric, placement,
-    msg,
+    msg, retain_uploads: bool = True,
 ) -> None:
     """Publish this node's byte ranges of a device plan onto its OWN stage
     devices (the pod-fabric sender half, ``parallel/fabric.py``).
@@ -207,7 +214,8 @@ def contribute_device_plan(
         # host→HBM upload instead of k, and every later plan or re-plan
         # slices device-side.  Small byte-range jobs (mode-3 splits) keep
         # the range-only upload below.
-        dev_src = _upload_cache.get_or_put(layer, msg.layer_id, devices[0])
+        dev_src = _upload_cache.get_or_put(layer, msg.layer_id, devices[0],
+                                           retain=retain_uploads)
 
     for k, (off, size) in enumerate(mine):
         dev = devices[k % len(devices)]

@@ -642,12 +642,12 @@ def test_fabric_collect_timeout_triggers_replan_recovery(cpu_devices,
     real_contribute = recv_mod.contribute_device_plan
     dropped = []
 
-    def flaky_contribute(node, layers, lock, fabric, placement, msg):
+    def flaky_contribute(node, layers, lock, fabric, placement, msg, **kw):
         # The FIRST plan's contribution is lost; retries go through.
         if not dropped:
             dropped.append(msg.plan_id)
             return
-        real_contribute(node, layers, lock, fabric, placement, msg)
+        real_contribute(node, layers, lock, fabric, placement, msg, **kw)
 
     monkeypatch.setattr(recv_mod, "contribute_device_plan", flaky_contribute)
 
