@@ -70,6 +70,17 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def fabric_bandwidths(conf: cfg.Config) -> Dict[int, int]:
+    """Per-node bandwidths for the mode-3 flow solve on a fabric.
+
+    With ``Mesh.IciBW`` set, every node plans against the stage's ICI
+    capacity — the device plane carries the bytes, so the NIC is not in
+    the path; per-source LimitRates still cap seeders.  Without it, the
+    configured NetworkBW is used as-is."""
+    ici = conf.mesh.ici_bw if conf.mesh is not None else 0
+    return {nc.id: (ici if ici > 0 else nc.network_bw) for nc in conf.nodes}
+
+
 def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
             timeout: float = 600.0) -> Dict[str, float]:
     """Drive one full pod dissemination; returns the timing summary.
@@ -116,9 +127,8 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
                 kwargs = dict(expected_nodes=set(node_ids),
                               fabric=fabric, placement=placement)
                 if mode == 3:
-                    bw = {n.id: n.network_bw for n in conf.nodes}
-                    leader = _LEADERS[3](node, layers, conf.assignment, bw,
-                                         **kwargs)
+                    leader = _LEADERS[3](node, layers, conf.assignment,
+                                         fabric_bandwidths(conf), **kwargs)
                 else:
                     leader = _LEADERS[mode](node, layers, conf.assignment,
                                             **kwargs)

@@ -54,6 +54,13 @@ class MeshConf:
     # (parallel/fabric.py); TCP carries only the control plane.  Run with
     # cli.podrun (single controller addresses the whole mesh).
     fabric: bool = False
+    # Per-stage ICI ingress/egress capacity, bytes/s.  When set on a
+    # fabric config, the mode-3 flow solver plans against it instead of
+    # the nodes' NIC NetworkBW — the plan governs the device plane, where
+    # the NIC is not in the path (SURVEY §7: "rate limiting on ICI").
+    # Per-source LimitRates still cap seeders (host→HBM or disk reads
+    # remain the source-side bottleneck).  0 = plan with NetworkBW.
+    ici_bw: int = 0
 
     @classmethod
     def from_json(cls, d: dict) -> "MeshConf":
@@ -62,6 +69,7 @@ class MeshConf:
             axis_sizes=[int(s) for s in _jget(d, "AxisSizes", [1])],
             pipeline_axis=_jget(d, "PipelineAxis", "nodes"),
             fabric=bool(_jget(d, "Fabric", False)),
+            ici_bw=int(_jget(d, "IciBW", 0)),
         )
 
 

@@ -67,6 +67,7 @@ from .send import (
     fetch_from_client,
     handle_flow_retransmit,
     release_upload_cache,
+    reopen_upload_cache,
     send_layer,
 )
 
@@ -384,14 +385,8 @@ class LeaderNode:
         if self.fabric is None or self.placement is None:
             log.error("device plan but no fabric wired", plan=msg.plan_id)
             return
-        with self._lock:
-            # A plan after startup (this leader as seeder for a stale or
-            # next-cycle transfer) serves from a transient upload: the
-            # cache was released for the booting model.
-            retain = not self._startup_sent
         contribute_device_plan(self.node, self.layers, self._lock,
-                               self.fabric, self.placement, msg,
-                               retain_uploads=retain)
+                               self.fabric, self.placement, msg)
 
     def _fabric_ok(
         self, layer_id: LayerID, layout: List[Tuple[NodeID, int, int]],
@@ -427,6 +422,13 @@ class LeaderNode:
         plan_id = f"{layer_id}.{dest}.{next(self._plan_seq)}"
         msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
                             total, list(layout))
+        with self._lock:
+            active = not self._startup_sent
+        if active:
+            # Dispatching for an unfinished goal (first cycle, or a
+            # re-armed update()): upload retention may re-arm — the next
+            # startup will release again.
+            reopen_upload_cache()
         # Dest first: if the dest never learns of the plan, abort before
         # any seeder uploads a contribution nobody will collect.
         try:

@@ -53,6 +53,7 @@ from .send import (
     fetch_from_client,
     handle_flow_retransmit,
     release_upload_cache,
+    reopen_upload_cache,
     send_layer,
 )
 
@@ -114,10 +115,6 @@ class ReceiverNode:
         self.fabric = fabric
         self.boot_result = None  # BootResult after a successful boot
         self._boot_started = False
-        # True once this node saw startup for the current cycle: plans
-        # arriving after it serve from transient uploads (nothing may
-        # re-pin the HBM the booted model owns); announce() re-arms.
-        self._startup_seen = False
         # Eager when enabled: handlers run on a 16-worker pool, so a lazy
         # check-then-set would race; raw byte blobs stage as uint8 so
         # odd-length layers round-trip exactly (bf16 would pad a byte).
@@ -160,7 +157,10 @@ class ReceiverNode:
                 for lid, src in self.layers.items()
             }
         next_hop = self.node.get_next_hop(self.node.leader_id)
-        self._startup_seen = False  # (re)entering a distribution cycle
+        if self.fabric is not None:
+            # (Re)entering a distribution cycle: uploads may be retained
+            # again until the next startup releases them.
+            reopen_upload_cache()
         self.node.transport.send(
             next_hop,
             AnnounceMsg(self.node.my_id, layer_ids,
@@ -288,8 +288,7 @@ class ReceiverNode:
         # otherwise pin full-layer device buffers forever.
         self.fabric.gc()
         contribute_device_plan(self.node, self.layers, self._lock,
-                               self.fabric, self.placement, msg,
-                               retain_uploads=not self._startup_seen)
+                               self.fabric, self.placement, msg)
         if msg.dest_id == self.node.my_id:
             threading.Thread(
                 target=self._receive_device_plan, args=(msg,), daemon=True
@@ -478,7 +477,6 @@ class ReceiverNode:
         immediately (delivery is done), the boot runs on the handler pool,
         and its completion is reported to the leader as a BootReadyMsg."""
         self._ready_q.put(object())
-        self._startup_seen = True
         if self.fabric is not None:
             # Dissemination is over: the cached fabric uploads' HBM now
             # belongs to whatever boots next.

@@ -73,17 +73,14 @@ class _FabricUploadCache:
         self._lock = threading.Lock()
         self._order: Dict[int, object] = {}  # id(record) -> record (LRU)
         self._bytes = 0
-        # Bumped by clear(): an upload that straddles a release (startup
-        # raced a late plan on the handler pool) must not re-pin HBM that
-        # now belongs to the booting model.
-        self._epoch = 0
+        # Latched by clear() at startup: while closed, new uploads serve
+        # their plan transiently and are never retained — the decision is
+        # made at INSERT time under the cache lock, so no caller-side
+        # flag-read can race the release (the HBM belongs to the booted
+        # model until reopen()).
+        self._closed = False
 
-    def get_or_put(self, layer, layer_id, device, retain: bool = True):
-        """``retain=False`` serves the plan from a transient upload that
-        is never cached — callers pass it for plans arriving after their
-        node saw startup (a stale re-plan duplicate, or a new cycle whose
-        own startup will re-release): nothing may re-pin HBM the booted
-        model now owns."""
+    def get_or_put(self, layer, layer_id, device):
         import jax
         import numpy as np
 
@@ -98,8 +95,6 @@ class _FabricUploadCache:
                                and dev.dtype == np.uint8) else None
             if layer.upload_failed or layer.data_size > self.budget:
                 return None
-            with self._lock:
-                epoch = self._epoch
             try:
                 whole = np.frombuffer(
                     layer.read_span(0, layer.data_size), np.uint8
@@ -113,8 +108,6 @@ class _FabricUploadCache:
                 # the object and poison whatever reuses its address).
                 layer.upload_failed = True
                 return None
-            if not retain:
-                return dev  # transient: caller's references only
             layer.device_array = dev
         # Victims are collected under the cache lock but cleared outside
         # it: clearing takes the victim's _host_lock, and another thread
@@ -124,9 +117,9 @@ class _FabricUploadCache:
         victims = []
         retained = True
         with self._lock:
-            if self._epoch != epoch:
-                # clear() ran while we uploaded: serve THIS plan from the
-                # transient handle but do not retain the copy.
+            if self._closed:
+                # Released (startup fired, the model owns the HBM): serve
+                # THIS plan from the transient handle, retain nothing.
                 retained = False
             else:
                 self._order[key] = layer
@@ -149,6 +142,13 @@ class _FabricUploadCache:
                     old.device_array = None  # frees the HBM copy
         return dev
 
+    def reopen(self) -> None:
+        """Re-arm retention for a new distribution cycle (a node
+        announcing, or a leader dispatching plans for an unfinished
+        goal)."""
+        with self._lock:
+            self._closed = False
+
     def clear(self) -> int:
         """Release every cached upload (dissemination is over — the HBM
         belongs to the booting model now).  Returns entries freed."""
@@ -156,7 +156,7 @@ class _FabricUploadCache:
             victims = list(self._order.values())
             self._order.clear()
             self._bytes = 0
-            self._epoch += 1
+            self._closed = True
         for old in victims:
             with old._host_lock:
                 if old.meta.location != LayerLocation.HBM:
@@ -168,16 +168,22 @@ _upload_cache = _FabricUploadCache()
 
 
 def release_upload_cache() -> None:
-    """Drop the fabric upload cache's device copies; nodes call this on
-    startup (assignment satisfied — no more plans will need them)."""
+    """Drop the fabric upload cache's device copies and close retention;
+    nodes call this on startup (assignment satisfied — the HBM belongs
+    to whatever boots next).  ``reopen_upload_cache`` re-arms it."""
     freed = _upload_cache.clear()
     if freed:
         log.info("released fabric upload cache", entries=freed)
 
 
+def reopen_upload_cache() -> None:
+    """Re-arm upload retention for a new distribution cycle."""
+    _upload_cache.reopen()
+
+
 def contribute_device_plan(
     node: Node, layers: LayersSrc, lock: threading.Lock, fabric, placement,
-    msg, retain_uploads: bool = True,
+    msg,
 ) -> None:
     """Publish this node's byte ranges of a device plan onto its OWN stage
     devices (the pod-fabric sender half, ``parallel/fabric.py``).
@@ -214,8 +220,7 @@ def contribute_device_plan(
         # host→HBM upload instead of k, and every later plan or re-plan
         # slices device-side.  Small byte-range jobs (mode-3 splits) keep
         # the range-only upload below.
-        dev_src = _upload_cache.get_or_put(layer, msg.layer_id, devices[0],
-                                           retain=retain_uploads)
+        dev_src = _upload_cache.get_or_put(layer, msg.layer_id, devices[0])
 
     for k, (off, size) in enumerate(mine):
         dev = devices[k % len(devices)]

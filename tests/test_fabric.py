@@ -617,6 +617,17 @@ def test_fabric_upload_cache_unit(cpu_devices):
     for rec in layers:
         assert rec.device_array is None
 
+    # clear() latches the cache closed: a late plan's upload serves its
+    # caller but is NOT retained (the booted model owns the HBM) until
+    # reopen() re-arms a new cycle.
+    stale = mem_layer(9)
+    dev = cache.get_or_put(stale, 9, cpu_devices[0])
+    assert dev is not None  # the plan is still served
+    assert stale.device_array is None  # ...but nothing was retained
+    cache.reopen()
+    dev = cache.get_or_put(stale, 9, cpu_devices[0])
+    assert stale.device_array is not None
+
     # Failure memoized on the record, not by object address.
     broken = mem_layer(0)
 
@@ -742,6 +753,27 @@ def test_fabric_delivered_owner_reserves_to_second_dest(cpu_devices):
         ), f"expected node 1 to serve the second dest, got {second_hop}"
     finally:
         close_all(leader, receivers, ts)
+
+
+def test_fabric_bandwidths_prefer_ici():
+    """Mesh.IciBW overrides every node's NIC for the fabric flow solve;
+    without it, NetworkBW passes through unchanged."""
+    from distributed_llm_dissemination_tpu.cli.podrun import fabric_bandwidths
+    from distributed_llm_dissemination_tpu.core import config as cfg
+
+    base = {
+        "Nodes": [{"Id": 0, "Addr": ":1", "IsLeader": True,
+                   "NetworkBW": 111},
+                  {"Id": 1, "Addr": ":2", "NetworkBW": 222}],
+        "Assignment": {}, "LayerSize": 1,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [2], "Fabric": True,
+                 "IciBW": 90_000_000_000},
+    }
+    conf = cfg.Config.from_json(base)
+    assert fabric_bandwidths(conf) == {0: 90_000_000_000, 1: 90_000_000_000}
+    base["Mesh"].pop("IciBW")
+    conf = cfg.Config.from_json(base)
+    assert fabric_bandwidths(conf) == {0: 111, 1: 222}
 
 
 def test_podrun_fabric_v5e32_shape(tmp_path):
