@@ -31,7 +31,9 @@ import numpy as np
 
 BASELINE_GBPS = 1.5625  # 12.5 Gbit/s reference NetworkBW, conf/config.json
 PARTS = 8  # fragments per layer (the reference scenario's seeder count)
-TRIALS = 3
+TRIALS = 5  # pair budget; the loop stops early past BUDGET_S wall-clock
+MIN_TRIALS = 2
+BUDGET_S = 180.0
 
 
 def split_offsets(total, n):
@@ -71,10 +73,12 @@ def main() -> None:
     ]
 
     # Raw host→device ceiling: bulk transfers of the same byte count,
-    # INTERLEAVED with the ingest trials below — the link's achievable
-    # rate drifts between runs (shared tunnel/PCIe), so a single upfront
-    # probe can misstate the denominator several-fold.  Medians of
-    # interleaved samples keep the ratio honest.
+    # PAIRED with the ingest trials below — the link's achievable rate
+    # drifts several-fold minute to minute (shared tunnel/PCIe), so
+    # neither a single upfront probe nor even independent medians give a
+    # stable ratio.  Each trial times raw-then-ingest back to back and
+    # link_fraction is the MEDIAN OF THE PER-PAIR RATIOS: adjacent
+    # samples share the drift, so the ratio cancels it.
     bulk = np.frombuffer(b"".join(d for _, d in frags), np.uint8)
 
     def raw_once() -> float:
@@ -84,20 +88,35 @@ def main() -> None:
 
     # Warm both paths (compiles _write_1d per fragment-cut shape and the
     # finalize gather; first DMA maps buffers), then alternate timings.
+    # The budget clock starts BEFORE the warmup: in a slow link phase the
+    # warmup itself costs a pair's worth of transfers, and a budget that
+    # ignored it could still blow a CI timeout.
+    bench_t0 = time.monotonic()
     raw_once()
     arr = ingest_once(total, frags, devices)
-    times, raw_times = [], []
+    times, raw_times, ratios = [], [], []
     for _ in range(TRIALS):
         arr = None  # free the previous layer BEFORE probing: the raw
         # measurement must see the same clean device the ingest gets.
-        raw_times.append(raw_once())
+        rt = raw_once()
+        raw_times.append(rt)
         t0 = time.monotonic()
         arr = ingest_once(total, frags, devices)
-        times.append(time.monotonic() - t0)
+        it = time.monotonic() - t0
+        times.append(it)
+        ratios.append(rt / it)
+        # The tunnel link has minute-scale phases as slow as ~0.01 GB/s;
+        # 5 pairs of 2x416 MiB can then exceed a CI timeout.  Paired
+        # ratios are drift-immune, so 2 pairs already give a usable
+        # median — stop once the wall-clock budget is spent.
+        if (len(ratios) >= MIN_TRIALS
+                and time.monotonic() - bench_t0 > BUDGET_S):
+            break
     del arr
     raw_dma_gbps = total / statistics.median(raw_times) / 1e9
 
     gbps = total / statistics.median(times) / 1e9
+    link_fraction = statistics.median(ratios)
     print(
         json.dumps(
             {
@@ -108,10 +127,16 @@ def main() -> None:
                 "unit": "GB/s/chip",
                 "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                 "raw_dma_gbps": round(raw_dma_gbps, 3),
-                "link_fraction": round(gbps / raw_dma_gbps, 3),
+                "link_fraction": round(link_fraction, 3),
+                "link_fraction_spread": [
+                    round(min(ratios), 3), round(max(ratios), 3)],
                 "note": "absolute GB/s is bound by this host's measured "
-                        "device link (raw_dma_gbps, interleaved medians); "
-                        "link_fraction is the framework's efficiency on it",
+                        "device link (raw_dma_gbps); link_fraction is the "
+                        "framework's efficiency on it — the median of "
+                        "per-trial raw/ingest pair ratios (pairing cancels "
+                        "the link's minute-scale bandwidth drift); >1 means "
+                        "the fragment-pipelined ingest outperforms a single "
+                        "bulk DMA of the same bytes",
             }
         )
     )
