@@ -127,6 +127,12 @@ def main() -> None:
                 "unit": "GB/s/chip",
                 "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                 "raw_dma_gbps": round(raw_dma_gbps, 3),
+                # Absolute rates ride the drifting link, so their spread
+                # is reported too — read `value` with it in hand (the
+                # drift-immune number is link_fraction).
+                "value_spread": [
+                    round(total / max(times) / 1e9, 3),
+                    round(total / min(times) / 1e9, 3)],
                 "link_fraction": round(link_fraction, 3),
                 "link_fraction_spread": [
                     round(min(ratios), 3), round(max(ratios), 3)],

@@ -1,0 +1,274 @@
+"""Blockwise causal GQA attention — the pallas hot-op behind ring attention.
+
+``block_attention`` computes one (Q block x KV block) partial attention
+with LOCAL online-softmax statistics: it returns ``(pv, m, l)`` where
+``m``/``l`` are the block's own running max / normalizer and ``pv`` the
+unnormalized value sum.  The ring loop (``parallel/ring_attention.py``)
+merges successive blocks' partials with the standard rescale
+``exp(m - m_new)`` — so K/V rotation over ICI composes with on-chip
+blockwise attention, the two halves of the ring-attention recipe.
+
+Two interchangeable implementations:
+
+- ``_block_attention_ref``: pure lax (einsum + where).  Runs anywhere,
+  differentiates, and is the numerical oracle.  It materializes the
+  [sq, t] logits in HBM — fine for short blocks, the memory hot spot for
+  long ones.
+- ``_block_attention_pallas``: a pallas TPU kernel.  Grid is
+  (batch*kv_head*group, q_tiles, kv_tiles) with the kv tile dimension
+  innermost, so for each Q tile the output block stays resident in VMEM
+  while KV tiles stream through: logits live only as a [TILE, TILE] VMEM
+  tile, never in HBM.  Entirely-masked KV tiles (future positions under
+  the causal mask — half the work in a causal ring) are skipped with
+  ``pl.when``.  The MXU sees [128, hd] x [hd, 128] matmuls in f32
+  accumulation (``preferred_element_type``).
+
+The public ``block_attention`` picks pallas when the backend is TPU and
+the shapes meet the MXU tiling constraints (hd and block lengths
+multiples of 128), else falls back to lax.  Its backward pass is a
+``custom_vjp`` that REMATERIALIZES through the lax oracle — flash
+attention's usual trade (recompute the block, never store the logits),
+and it keeps the train step differentiable without a handwritten
+backward kernel.
+
+The reference has no compute at all (SURVEY §2.3); this op exists for
+the framework's long-context model path (ring attention over the ``sp``
+mesh axis), which the reference's Assignment-as-pipeline-placement
+implies but never executes.
+"""
+
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30  # finite: -inf would make (m - m_new) NaN on empty rows
+TILE = 128  # MXU-aligned Q/KV tile edge
+
+# Test hook: force the pallas path (interpret mode) off-TPU.
+FORCE_PALLAS = False
+
+
+def eligible(sq: int, t: int, hd: int) -> bool:
+    """Shapes the pallas kernel accepts: MXU-tileable blocks."""
+    return sq % TILE == 0 and t % TILE == 0 and hd % 128 == 0
+
+
+def _use_pallas(sq: int, t: int, hd: int) -> bool:
+    if not eligible(sq, t, hd):
+        return False
+    return FORCE_PALLAS or jax.default_backend() == "tpu"
+
+
+# ------------------------------------------------------------- lax oracle
+
+
+def _block_attention_ref(qg, k, v, q_off, k_off):
+    """qg: [b, kvh, g, sq, hd]; k, v: [b, kvh, t, hd]; offsets are the
+    global positions of row/col 0 (f32 scalars holding integer values).
+    Returns (pv f32, m f32, l f32) with shapes
+    ([b, kvh, g, sq, hd], [b, kvh, g, sq], [b, kvh, g, sq])."""
+    hd = qg.shape[-1]
+    sq, t = qg.shape[3], k.shape[2]
+    logits = jnp.einsum(
+        "bkgsh,bkth->bkgst", qg, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(hd)
+    q_ids = q_off.astype(jnp.int32) + jnp.arange(sq)
+    k_ids = k_off.astype(jnp.int32) + jnp.arange(t)
+    causal = q_ids[:, None] >= k_ids[None, :]
+    logits = jnp.where(causal, logits, _NEG_INF)
+    m = logits.max(axis=-1)
+    p = jnp.exp(logits - m[..., None])
+    # A fully-masked row (this whole KV block is in the row's future) has
+    # m == _NEG_INF and p == 1 everywhere; zero it so (pv, l) are exact
+    # partials and the caller's exp(m - m_new) rescale gets 0 * 0, not
+    # garbage * 0.
+    p = jnp.where((m > _NEG_INF / 2)[..., None], p, 0.0)
+    l = p.sum(axis=-1)
+    pv = jnp.einsum(
+        "bkgst,bkth->bkgsh", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return pv, m, l
+
+
+# ----------------------------------------------------------- pallas kernel
+
+
+def _attn_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                 o_ref, m_ref, l_ref):
+    j = pl.program_id(1)  # q tile
+    kk = pl.program_id(2)  # kv tile (innermost: o/m/l stay resident)
+
+    @pl.when(kk == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    q_lo = qoff_ref[0, 0] + j * TILE
+    k_lo = koff_ref[0, 0] + kk * TILE
+
+    # The tile contributes iff its last query row can see its first key.
+    @pl.when(q_lo + TILE - 1 >= k_lo)
+    def _():
+        q = q_ref[0, 0, 0]  # [TILE, hd]
+        k = k_ref[0, 0]  # [TILE, hd]
+        v = v_ref[0, 0]
+        hd = q.shape[-1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / np.sqrt(hd)  # [TILE, TILE]
+        q_ids = q_lo + lax.broadcasted_iota(jnp.int32, (TILE, TILE), 0)
+        k_ids = k_lo + lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+        s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+
+        # Row stats are [TILE, 1] column vectors: sublane-aligned with
+        # the logits' query rows, so every broadcast below is rank-2.
+        m_prev = m_ref[0, 0, 0]  # [TILE, 1]
+        l_prev = l_ref[0, 0, 0]
+        o_prev = o_ref[0, 0, 0]  # [TILE, hd]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        # Rows whose visible keys start beyond this tile: see the oracle.
+        p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+        l_ref[0, 0, 0] = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0, 0, 0] = o_prev * alpha + pv
+        m_ref[0, 0, 0] = m_new
+
+
+def _block_attention_pallas(qg, k, v, q_off, k_off, interpret):
+    b, kvh, g, sq, hd = qg.shape
+    t = k.shape[2]
+    bh = b * kvh * g
+    grid = (bh, sq // TILE, t // TILE)
+
+    def q_idx(i, j, kk):
+        return (i // (kvh * g), (i // g) % kvh, i % g, j, 0)
+
+    def kv_idx(i, j, kk):
+        return (i // (kvh * g), (i // g) % kvh, kk, 0)
+
+    def stat_idx(i, j, kk):
+        return (i // (kvh * g), (i // g) % kvh, i % g, j, 0)
+
+    # Scalar offsets ride SMEM on TPU; interpret mode accepts the same
+    # spec (memory spaces are advisory there).
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    smem = pl.BlockSpec((1, 1), lambda i, j, kk: (0, 0),
+                        memory_space=pltpu.SMEM)
+
+    # Stats carry a trailing singleton dim so kernel-side row vectors
+    # are [TILE, 1] (sublane-aligned); squeezed off on return.
+    # Inside shard_map the outputs vary over every mesh axis the inputs
+    # do (vma): required by pallas_call when the mesh checks vma.
+    vma = frozenset()
+    for x in (qg, k, v):
+        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+    def _struct(shape):
+        try:
+            return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+        except TypeError:  # older jax: no vma kwarg
+            return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    out_shape = [
+        _struct((b, kvh, g, sq, hd)),
+        _struct((b, kvh, g, sq, 1)),
+        _struct((b, kvh, g, sq, 1)),
+    ]
+    pv, m, l = pl.pallas_call(
+        _attn_kernel,
+        grid=grid,
+        in_specs=[
+            smem,
+            smem,
+            pl.BlockSpec((1, 1, 1, TILE, hd), q_idx),
+            pl.BlockSpec((1, 1, TILE, hd), kv_idx),
+            pl.BlockSpec((1, 1, TILE, hd), kv_idx),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, TILE, hd), q_idx),
+            pl.BlockSpec((1, 1, 1, TILE, 1), stat_idx),
+            pl.BlockSpec((1, 1, 1, TILE, 1), stat_idx),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        q_off.astype(jnp.int32).reshape(1, 1),
+        k_off.astype(jnp.int32).reshape(1, 1),
+        qg, k, v,
+    )
+    return pv, m.squeeze(-1), l.squeeze(-1)
+
+
+# ------------------------------------------------------------- public op
+
+
+def _block_attention_impl(qg, k, v, q_off, k_off):
+    sq, hd = qg.shape[3], qg.shape[4]
+    t = k.shape[2]
+    if _use_pallas(sq, t, hd):
+        return _block_attention_pallas(
+            qg, k, v, q_off, k_off,
+            interpret=jax.default_backend() != "tpu",
+        )
+    return _block_attention_ref(qg, k, v, q_off, k_off)
+
+
+@jax.custom_vjp
+def block_attention(qg, k, v, q_off, k_off):
+    """One KV block's partial attention (see module docstring).
+
+    qg: [b, kvh, g, sq, hd]; k, v: [b, kvh, t, hd]; ``q_off``/``k_off``
+    are f32 scalars holding the blocks' global start positions (f32 so
+    the custom_vjp can hand back an ordinary zero cotangent; exact for
+    any realistic sequence length).  Returns f32 (pv, m, l)."""
+    return _block_attention_impl(qg, k, v, q_off, k_off)
+
+
+def _block_attention_fwd(qg, k, v, q_off, k_off):
+    return (
+        _block_attention_impl(qg, k, v, q_off, k_off),
+        (qg, k, v, q_off, k_off),
+    )
+
+
+def _block_attention_bwd(res, cts):
+    qg, k, v, q_off, k_off = res
+    # Rematerialize through the lax oracle: the logits are recomputed,
+    # never stored — the flash-attention memory trade on the backward.
+    _, vjp = jax.vjp(
+        lambda a, b_, c: _block_attention_ref(a, b_, c, q_off, k_off),
+        qg, k, v,
+    )
+    dq, dk, dv = vjp(cts)
+    zero = jnp.zeros_like(q_off)
+    return dq.astype(qg.dtype), dk.astype(k.dtype), dv.astype(v.dtype), zero, zero
+
+
+block_attention.defvjp(_block_attention_fwd, _block_attention_bwd)
+
+
+def merge_partials(carry, part):
+    """Online-softmax merge of a block's (pv, m, l) into the running
+    (o, m, l) accumulator — all f32."""
+    o, m, l = carry
+    pv, m_blk, l_blk = part
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(m_blk - m_new)
+    l_new = l * alpha + l_blk * beta
+    o_new = o * alpha[..., None] + pv * beta[..., None]
+    return o_new, m_new, l_new

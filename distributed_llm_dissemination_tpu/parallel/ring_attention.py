@@ -8,6 +8,13 @@ Communication of the next K/V block overlaps the current block's matmuls on
 TPU (XLA schedules the ppermute DMA concurrently), so attention over an
 S-long sequence costs S/sp memory per chip and n-1 neighbor hops.
 
+The per-block compute is ``ops.flash_attention.block_attention`` — a
+pallas TPU kernel when shapes are MXU-tileable (logits never leave VMEM),
+the lax oracle otherwise — and the ring loop merges each block's partial
+softmax stats with ``merge_partials``.  The K/V carry is kept in
+[b, kvh, t, hd] layout so the kernel consumes it without per-hop
+transposes; ``ppermute`` is layout-oblivious.
+
 This is new capability relative to the reference (which has no compute at
 all, SURVEY §2.3); the pattern follows the public ring-attention /
 blockwise-attention literature (see PAPERS.md).
@@ -17,10 +24,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
-_NEG_INF = -1e30
+from ..ops.flash_attention import _NEG_INF, block_attention, merge_partials
 
 
 def ring_attention(
@@ -43,28 +49,22 @@ def ring_attention(
     b, sq, h, hd = q.shape
     kvh = k.shape[2]
     group = h // kvh
-    qg = q.reshape(b, sq, kvh, group, hd)
-    q_pos = my * s_local + jnp.arange(s_local)
+    # [b, s, kvh, g|1, hd] -> kernel layouts (loop-invariant, done once).
+    qg = q.reshape(b, sq, kvh, group, hd).transpose(0, 2, 3, 1, 4)
+    kt = k.transpose(0, 2, 1, 3)  # [b, kvh, t, hd] — the ring carry layout
+    vt = v.transpose(0, 2, 1, 3)
+    q_off = (my * s_local).astype(jnp.float32)
 
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def step(carry, i):
-        k_blk, v_blk, m, l, o = carry
+        k_blk, v_blk, o, m, l = carry
         # The block in hand originated at device (my - i) mod n.
         src = (my - i) % n
-        k_pos = src * s_local + jnp.arange(s_local)
-        logits = jnp.einsum(
-            "bskgh,btkh->bkgst", qg, k_blk, preferred_element_type=jnp.float32
-        ) / np.sqrt(hd)
-        causal = q_pos[:, None] >= k_pos[None, :]
-        logits = jnp.where(causal, logits, _NEG_INF)
-
-        m_new = jnp.maximum(m, logits.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(logits - m_new[..., None])
-        l_new = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum("bkgst,btkh->bkgsh", p.astype(v_blk.dtype), v_blk)
-        o_new = o * alpha[..., None].astype(o.dtype) + pv
+        part = block_attention(
+            qg, k_blk, v_blk, q_off, (src * s_local).astype(jnp.float32)
+        )
+        o, m, l = merge_partials((o, m, l), part)
 
         # Skip the final rotation: after the last accumulation the blocks
         # are discarded, so that hop would be a wasted ICI transfer.
@@ -77,14 +77,20 @@ def ring_attention(
             lambda kv: kv,
             (k_blk, v_blk),
         )
-        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+        return (k_nxt, v_nxt, o, m, l), None
 
     m0 = jnp.full((b, kvh, group, sq), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, kvh, group, sq), jnp.float32)
-    o0 = jnp.zeros((b, kvh, group, sq, hd), v.dtype)
-    (k_f, v_f, m_f, l_f, o_f), _ = lax.scan(
-        step, (k, v, m0, l0, o0), jnp.arange(n)
+    o0 = jnp.zeros((b, kvh, group, sq, hd), jnp.float32)
+    if hasattr(lax, "pcast"):
+        # The accumulators become device-varying after the first merge
+        # (the K/V carry is varying); the scan carry must start that way.
+        m0, l0, o0 = (
+            lax.pcast(x, (axis,), to="varying") for x in (m0, l0, o0)
+        )
+    (_, _, o_f, m_f, l_f), _ = lax.scan(
+        step, (kt, vt, o0, m0, l0), jnp.arange(n)
     )
-    out = o_f / jnp.maximum(l_f, 1e-30)[..., None].astype(o_f.dtype)
+    out = (o_f / jnp.maximum(l_f, 1e-30)[..., None]).astype(v.dtype)
     # [b, kv, g, s, hd] -> [b, s, h, hd]
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, hd)

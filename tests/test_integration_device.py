@@ -286,20 +286,28 @@ def test_large_layer_ingest_overlaps_receive(cpu_devices):
         return write_s, residual
 
     run_ingest(paced=False)  # jit/alloc warmup: fair timing after
-    base_write_s, base_residual = run_ingest(paced=False)
-    paced_write_s, paced_residual = run_ingest(paced=True)
     t_receive = delay * len(offsets)
-    stage_work = base_write_s + base_residual  # this machine's real cost
-    # The receive loop spent almost all its time receiving, not staging:
-    # the 128 MiB of host->device DMA hid inside the fragment gaps.
-    # Budgets scale with the machine's measured staging cost so a loaded
-    # CI host doesn't fail a working design.
-    assert paced_write_s < max(0.5 * t_receive, 2.0 * stage_work), (
+    # One retry: the budgets scale with the machine's measured staging
+    # cost, but a load spike BETWEEN the baseline and paced runs can
+    # still skew the pair on a busy CI host.  A real overlap regression
+    # fails both attempts.
+    for attempt in (0, 1):
+        base_write_s, base_residual = run_ingest(paced=False)
+        paced_write_s, paced_residual = run_ingest(paced=True)
+        stage_work = base_write_s + base_residual  # this machine's cost
+        # The receive loop spent almost all its time receiving, not
+        # staging: the 128 MiB of host->device DMA hid inside the
+        # fragment gaps.
+        write_ok = paced_write_s < max(0.5 * t_receive, 2.0 * stage_work)
+        # And nothing meaningful was left when the last byte landed.
+        residual_ok = paced_residual < max(0.5, stage_work)
+        if write_ok and residual_ok:
+            break
+    assert write_ok, (
         f"write() blocked the receive loop: {paced_write_s:.2f}s of "
         f"{t_receive:.2f}s receive time (baseline stage {stage_work:.2f}s)"
     )
-    # And nothing meaningful was left to stage when the last byte landed.
-    assert paced_residual < max(0.5, stage_work), (
+    assert residual_ok, (
         f"{paced_residual:.2f}s of device work outstanding after the "
         f"last fragment — ingest did not overlap the receive "
         f"(baseline stage {stage_work:.2f}s)"
