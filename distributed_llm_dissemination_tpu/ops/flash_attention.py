@@ -25,11 +25,11 @@ Two interchangeable implementations:
 
 The public ``block_attention`` picks pallas when the backend is TPU and
 the shapes meet the MXU tiling constraints (hd and block lengths
-multiples of 128), else falls back to lax.  Its backward pass is a
-``custom_vjp`` that REMATERIALIZES through the lax oracle — flash
-attention's usual trade (recompute the block, never store the logits),
-and it keeps the train step differentiable without a handwritten
-backward kernel.
+multiples of 128), else falls back to lax.  It is forward-only:
+differentiation happens one level up, in ``ring_attention``'s custom
+vjp, which recomputes each block from the saved log-sum-exp while
+re-rotating K/V around the ring — flash attention's recompute-the-
+logits trade, composed with the ring's communication schedule.
 
 The reference has no compute at all (SURVEY §2.3); this op exists for
 the framework's long-context model path (ring attention over the ``sp``
@@ -159,8 +159,7 @@ def _block_attention_pallas(qg, k, v, q_off, k_off, interpret):
     def kv_idx(i, j, kk):
         return (i // (kvh * g), (i // g) % kvh, kk, 0)
 
-    def stat_idx(i, j, kk):
-        return (i // (kvh * g), (i // g) % kvh, i % g, j, 0)
+    stat_idx = q_idx  # same coordinates; stats blocks just have width 1
 
     # Scalar offsets ride SMEM on TPU; interpret mode accepts the same
     # spec (memory spaces are advisory there).
@@ -173,9 +172,11 @@ def _block_attention_pallas(qg, k, v, q_off, k_off, interpret):
     # are [TILE, 1] (sublane-aligned); squeezed off on return.
     # Inside shard_map the outputs vary over every mesh axis the inputs
     # do (vma): required by pallas_call when the mesh checks vma.
+    typeof = getattr(jax, "typeof", None)
     vma = frozenset()
-    for x in (qg, k, v):
-        vma |= getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    if typeof is not None:
+        for x in (qg, k, v):
+            vma |= getattr(typeof(x), "vma", frozenset()) or frozenset()
 
     def _struct(shape):
         try:
@@ -216,7 +217,18 @@ def _block_attention_pallas(qg, k, v, q_off, k_off, interpret):
 # ------------------------------------------------------------- public op
 
 
-def _block_attention_impl(qg, k, v, q_off, k_off):
+def block_attention(qg, k, v, q_off, k_off):
+    """One KV block's partial attention (see module docstring).
+
+    qg: [b, kvh, g, sq, hd]; k, v: [b, kvh, t, hd]; ``q_off``/``k_off``
+    are f32 scalars holding the blocks' global start positions (f32 for
+    a uniform traced-scalar convention; exact for any realistic
+    sequence length).  Returns f32 (pv, m, l).
+
+    This op is forward-only: its consumer, ``ring_attention``, defines
+    its own custom vjp (the backward ring in
+    ``parallel/ring_attention.py``), which never differentiates through
+    this call."""
     sq, hd = qg.shape[3], qg.shape[4]
     t = k.shape[2]
     if _use_pallas(sq, t, hd):
@@ -225,40 +237,6 @@ def _block_attention_impl(qg, k, v, q_off, k_off):
             interpret=jax.default_backend() != "tpu",
         )
     return _block_attention_ref(qg, k, v, q_off, k_off)
-
-
-@jax.custom_vjp
-def block_attention(qg, k, v, q_off, k_off):
-    """One KV block's partial attention (see module docstring).
-
-    qg: [b, kvh, g, sq, hd]; k, v: [b, kvh, t, hd]; ``q_off``/``k_off``
-    are f32 scalars holding the blocks' global start positions (f32 so
-    the custom_vjp can hand back an ordinary zero cotangent; exact for
-    any realistic sequence length).  Returns f32 (pv, m, l)."""
-    return _block_attention_impl(qg, k, v, q_off, k_off)
-
-
-def _block_attention_fwd(qg, k, v, q_off, k_off):
-    return (
-        _block_attention_impl(qg, k, v, q_off, k_off),
-        (qg, k, v, q_off, k_off),
-    )
-
-
-def _block_attention_bwd(res, cts):
-    qg, k, v, q_off, k_off = res
-    # Rematerialize through the lax oracle: the logits are recomputed,
-    # never stored — the flash-attention memory trade on the backward.
-    _, vjp = jax.vjp(
-        lambda a, b_, c: _block_attention_ref(a, b_, c, q_off, k_off),
-        qg, k, v,
-    )
-    dq, dk, dv = vjp(cts)
-    zero = jnp.zeros_like(q_off)
-    return dq.astype(qg.dtype), dk.astype(k.dtype), dv.astype(v.dtype), zero, zero
-
-
-block_attention.defvjp(_block_attention_fwd, _block_attention_bwd)
 
 
 def merge_partials(carry, part):
