@@ -215,24 +215,27 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     # A config with a Model section is boot-capable: receivers boot by
     # default so the leader's boot wait can't hang on a missing flag.
     boot_cfg = boot_config(args.boot or conf.model)
+    codec = conf.model_codec
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".",
                                 heartbeat_interval=args.hb,
                                 stage_hbm=args.hbm, placement=placement,
-                                boot_cfg=boot_cfg)
+                                boot_cfg=boot_cfg, boot_codec=codec)
     elif args.m in (1, 2):
         receiver = RetransmitReceiverNode(node, layers, args.s or ".",
                                           heartbeat_interval=args.hb,
                                           stage_hbm=args.hbm,
                                           placement=placement,
-                                          boot_cfg=boot_cfg)
+                                          boot_cfg=boot_cfg,
+                                          boot_codec=codec)
     else:
         receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
                                               heartbeat_interval=args.hb,
                                               checkpoint_dir=args.ckpt,
                                               stage_hbm=args.hbm,
                                               placement=placement,
-                                              boot_cfg=boot_cfg)
+                                              boot_cfg=boot_cfg,
+                                              boot_codec=codec)
 
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "
@@ -287,7 +290,8 @@ def main(argv=None) -> int:
 
     save_disk = bool(args.s)
     layers = cfg.create_layers(node_conf, save_disk, args.s or ".",
-                               model=conf.model, model_seed=conf.model_seed)
+                               model=conf.model, model_seed=conf.model_seed,
+                               model_codec=conf.model_codec)
     if my_client_conf is not None:
         cfg.add_client_layers(my_client_conf, conf.layer_size, layers)
 

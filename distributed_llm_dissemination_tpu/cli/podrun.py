@@ -121,7 +121,8 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
         for nc in conf.nodes:
             layers = cfg.create_layers(nc, save_disk=False,
                                        model=conf.model,
-                                       model_seed=conf.model_seed)
+                                       model_seed=conf.model_seed,
+                                       model_codec=conf.model_codec)
             node = Node(nc.id, leader_conf.id, transports[nc.id])
             if nc.id == leader_conf.id:
                 kwargs = dict(expected_nodes=set(node_ids),
@@ -135,7 +136,7 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
             else:
                 receivers.append(_RECEIVERS[mode](
                     node, layers, fabric=fabric, placement=placement,
-                    boot_cfg=boot_cfg,
+                    boot_cfg=boot_cfg, boot_codec=conf.model_codec,
                 ))
         for r in receivers:
             r.announce()

@@ -171,6 +171,10 @@ class Config:
     # inference engine after delivery (-boot).
     model: str = ""
     model_seed: int = 0
+    # Transfer codec for the fabricated blobs ("raw" | "int8"): int8
+    # halves the bytes every schedule ships (models/quant.py); receivers
+    # dequantize after landing, on-device when ingest staged to HBM.
+    model_codec: str = "raw"
 
     @classmethod
     def from_json(cls, d: dict) -> "Config":
@@ -184,7 +188,22 @@ class Config:
                          if _jget(d, "Distributed") is not None else None),
             model=_jget(d, "Model", "") or "",
             model_seed=int(_jget(d, "ModelSeed", 0)),
+            model_codec=_validated_codec(_jget(d, "ModelCodec", "raw") or "raw"),
         )
+
+
+def _validated_codec(codec: str) -> str:
+    """Reject unknown codecs AT PARSE TIME: a destination node holds no
+    layers, so a typo'd codec would otherwise only surface after
+    dissemination as a swallowed boot failure — a distributed hang on the
+    leader's boot wait instead of an immediate config error."""
+    if codec == "raw":  # default: don't pull jax into pure-TCP nodes
+        return codec
+    from ..models.quant import CODECS  # lazy for the same reason
+
+    if codec not in CODECS:
+        raise ValueError(f"unknown ModelCodec {codec!r}; known: {CODECS}")
+    return codec
 
 
 def read_json(path: str) -> Config:
@@ -226,6 +245,7 @@ def create_layers(
     storage_path: str = ".",
     model: str = "",
     model_seed: int = 0,
+    model_codec: str = "raw",
 ) -> LayersSrc:
     """Fabricate this node's initial layers (cmd/config.go:94-117).
 
@@ -240,10 +260,15 @@ def create_layers(
     blob_fn = None
     if model:
         from ..models.llama import CONFIGS
+        from ..models.quant import encode_blob
         from ..models.serde import seeded_blob
 
         mcfg = CONFIGS[model]
-        blob_fn = lambda lid: seeded_blob(mcfg, lid, model_seed)  # noqa: E731
+
+        def blob_fn(lid):
+            return encode_blob(
+                mcfg, lid, seeded_blob(mcfg, lid, model_seed), model_codec
+            )
     layers: LayersSrc = {}
     for source_type, by_layer in my_conf.initial_layers.items():
         for layer_id, size in by_layer.items():

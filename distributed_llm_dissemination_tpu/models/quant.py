@@ -1,0 +1,215 @@
+"""Quantized transfer codecs: halve the bytes a layer costs on the wire.
+
+Dissemination is bandwidth-bound — TTD is bytes over line rate
+(SURVEY §6; the reference models it exactly that way in its flow solver,
+``/root/reference/distributor/flow.go:221-270``).  A transfer codec
+attacks the numerator: seeders encode each layer blob into a symmetric
+per-row int8 form (scales + values, ~2x smaller than bf16), the wire and
+every scheduler see only the smaller opaque blob, and the receiver
+dequantizes AFTER the bytes land — on the accelerator, when the ``-hbm``
+ingest staged them, so the host never touches decoded weights.  The
+reference has no equivalent; it ships raw bytes only.
+
+Format of an encoded blob (leaves in the same canonical order as
+``serde``): per leaf, ``rows`` f32 scales followed by ``rows x cols``
+int8 values, where a leaf of shape ``(..., cols)`` is flattened to
+``(rows, cols)`` — per-output-row symmetric absmax scaling,
+``x_hat = q * scale``, deterministic round-to-nearest (every seeder
+fabricating the same seeded blob must agree byte-for-byte).
+
+Decode paths mirror ``serde``'s two:
+- host: numpy over the blob bytes;
+- device: HBM-resident uint8 blobs are sliced, bitcast, and dequantized
+  under one jit — XLA fuses the multiply into the bitcast reads, so the
+  decode is one pass over HBM.
+
+Codec choice is carried by the topology config (``ModelCodec``) next to
+``Model``/``ModelSeed``: every node — seeder, scheduler, booting
+receiver — derives identical blob sizes from (model, codec) alone.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import serde
+from .llama import ModelConfig
+from .serde import (
+    Spec,
+    blob_nbytes,
+    head_blob_id,
+    head_param_specs,
+    layer_param_specs,
+)
+
+CODECS = ("raw", "int8")
+_SCALE_DT = np.float32
+_QMAX = 127.0
+
+
+def _blob_specs(cfg: ModelConfig, blob_id: int) -> List[Spec]:
+    return (head_param_specs(cfg) if blob_id == head_blob_id(cfg)
+            else layer_param_specs(cfg))
+
+
+def _rows_cols(shape: Tuple[int, ...]) -> Tuple[int, int]:
+    if len(shape) == 1:
+        return 1, shape[0]
+    return int(np.prod(shape[:-1])), shape[-1]
+
+
+def blob_nbytes_codec(cfg: ModelConfig, blob_id: int, codec: str) -> int:
+    """Exact wire size of a blob under ``codec``."""
+    if codec == "raw":
+        return blob_nbytes(cfg, blob_id)
+    if codec != "int8":
+        raise ValueError(f"unknown codec {codec!r}; known: {CODECS}")
+    total = 0
+    for _, shape in _blob_specs(cfg, blob_id):
+        rows, cols = _rows_cols(shape)
+        total += rows * _SCALE_DT().itemsize + rows * cols
+    return total
+
+
+def encode_blob(cfg: ModelConfig, blob_id: int, raw: bytes, codec: str) -> bytes:
+    """Encode a raw (cfg.dtype) blob into its wire form under ``codec``."""
+    if codec == "raw":
+        return raw
+    if codec != "int8":
+        raise ValueError(f"unknown codec {codec!r}; known: {CODECS}")
+    dt = np.dtype(cfg.dtype)
+    buf = np.frombuffer(memoryview(raw), dtype=np.uint8)
+    parts: List[bytes] = []
+    off = 0
+    for _, shape in _blob_specs(cfg, blob_id):
+        rows, cols = _rows_cols(shape)
+        n = rows * cols * dt.itemsize
+        x = buf[off : off + n].view(dt).reshape(rows, cols).astype(np.float32)
+        off += n
+        scale = np.abs(x).max(axis=1) / _QMAX
+        scale = np.where(scale > 0, scale, 1.0).astype(_SCALE_DT)
+        q = np.clip(np.rint(x / scale[:, None]), -_QMAX, _QMAX).astype(np.int8)
+        parts.append(scale.tobytes())
+        parts.append(q.tobytes())
+    if off != len(buf):
+        raise ValueError(f"raw blob size {len(buf)} != expected {off}")
+    return b"".join(parts)
+
+
+def decode_blob_host(
+    cfg: ModelConfig, blob_id: int, data, codec: str
+) -> Dict[str, np.ndarray]:
+    """Host path: decode one wire blob into {name: cfg.dtype array}."""
+    specs = _blob_specs(cfg, blob_id)
+    if codec == "raw":
+        return serde._split_blob(cfg, data, specs)
+    if codec != "int8":
+        raise ValueError(f"unknown codec {codec!r}; known: {CODECS}")
+    dt = np.dtype(cfg.dtype)
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8)
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for name, shape in specs:
+        rows, cols = _rows_cols(shape)
+        sb = rows * _SCALE_DT().itemsize
+        scale = buf[off : off + sb].view(_SCALE_DT).reshape(rows, 1)
+        off += sb
+        q = buf[off : off + rows * cols].view(np.int8).reshape(rows, cols)
+        off += rows * cols
+        out[name] = (q.astype(np.float32) * scale).astype(dt).reshape(shape)
+    if off != len(buf):
+        raise ValueError(f"wire blob size {len(buf)} != expected {off}")
+    return out
+
+
+# ------------------------------------------------------------- device path
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _decode_stacked_q(blobs_u8, specs: Tuple[Spec, ...], dtype_name: str):
+    """(n, qblob_len) uint8 → {name: (n, *shape) dtype}, all on device."""
+    dt = jnp.dtype(dtype_name)
+    n_blobs = blobs_u8.shape[0]
+    out = {}
+    off = 0
+    for name, shape in specs:
+        rows, cols = _rows_cols(shape)
+        sb = rows * _SCALE_DT().itemsize  # one wire format: host's widths
+        sraw = jax.lax.slice_in_dim(blobs_u8, off, off + sb, axis=1)
+        scale = serde._bitcast_leaf(sraw, jnp.dtype(_SCALE_DT))
+        off += sb
+        qraw = jax.lax.slice_in_dim(blobs_u8, off, off + rows * cols, axis=1)
+        q = serde._bitcast_leaf(qraw, jnp.int8).reshape(n_blobs, rows, cols)
+        off += rows * cols
+        x = (q.astype(jnp.float32) * scale.reshape(n_blobs, rows, 1)).astype(dt)
+        out[name] = x.reshape((n_blobs,) + shape)
+    return out
+
+
+def stacked_from_device_qblobs(
+    cfg: ModelConfig, blob_arrays: Sequence[Any]
+) -> Dict[str, Any]:
+    """Device path: stacked layer params from HBM-resident int8-codec
+    blobs — slices, bitcasts and the dequant multiply fused in one jit;
+    the disseminated bytes never leave the accelerator."""
+    stacked = jnp.stack(list(blob_arrays))
+    return _decode_stacked_q(
+        stacked, tuple(layer_param_specs(cfg)), np.dtype(cfg.dtype).name
+    )
+
+
+def head_from_device_qblob(cfg: ModelConfig, blob_u8) -> Dict[str, Any]:
+    """Device path: embed/ln_f/lm_head from the HBM-resident head blob."""
+    decoded = _decode_stacked_q(
+        blob_u8[None, :], tuple(head_param_specs(cfg)),
+        np.dtype(cfg.dtype).name,
+    )
+    return {name: arr[0] for name, arr in decoded.items()}
+
+
+# -------------------------------------------------- codec-dispatch facade
+#
+# boot_from_layers talks to the codec layer through these four calls, so
+# adding a codec touches this module only.
+
+
+def stacked_from_blobs_host(
+    cfg: ModelConfig, blobs: Dict[int, Any], layer_ids: Sequence[int],
+    codec: str,
+) -> Dict[str, np.ndarray]:
+    """Host path: stacked layer params from wire blobs under ``codec``."""
+    if codec == "raw":
+        return serde.stacked_from_blobs(cfg, blobs, layer_ids)
+    per_layer = [
+        decode_blob_host(cfg, lid, blobs[lid], codec) for lid in layer_ids
+    ]
+    return {
+        name: np.stack([lp[name] for lp in per_layer])
+        for name, _ in layer_param_specs(cfg)
+    }
+
+
+def head_from_blob_host(cfg: ModelConfig, data, codec: str):
+    """Host path: head leaves from the wire head blob under ``codec``."""
+    return decode_blob_host(cfg, head_blob_id(cfg), data, codec)
+
+
+def stacked_from_device(
+    cfg: ModelConfig, blob_arrays: Sequence[Any], codec: str
+) -> Dict[str, Any]:
+    """Device path: stacked layer params from HBM wire blobs."""
+    if codec == "raw":
+        return serde.stacked_from_device_blobs(cfg, blob_arrays)
+    return stacked_from_device_qblobs(cfg, blob_arrays)
+
+
+def head_from_device(cfg: ModelConfig, blob_u8, codec: str) -> Dict[str, Any]:
+    """Device path: head leaves from the HBM wire head blob."""
+    if codec == "raw":
+        return serde.head_from_device_blob(cfg, blob_u8)
+    return head_from_device_qblob(cfg, blob_u8)

@@ -61,12 +61,16 @@ def boot_from_layers(
     placement=None,
     node_id=None,
     tokens=None,
+    codec: str = "raw",
 ) -> BootResult:
     """Assemble delivered blobs into model params and run one forward.
 
     ``layers``: the receiver's store after dissemination.  ``placement``:
     when given (with ``node_id``), params land replicated on this node's
     stage devices via ``StagePlacement``; otherwise the default device.
+    ``codec``: the transfer codec the blobs were encoded with
+    (``models/quant.py``); "int8" blobs are dequantized during assembly —
+    on-device when they were ingested to HBM.
     Returns a BootResult whose ``seconds`` is the time from blob assembly
     to the first forward's output being ready (includes jit compile — the
     honest time-to-first-token a cold boot pays)."""
@@ -74,7 +78,7 @@ def boot_from_layers(
     import jax.numpy as jnp
     import numpy as np
 
-    from ..models import serde
+    from ..models import quant, serde
     from ..models.llama import forward, layer_apply
 
     t0 = time.monotonic()
@@ -100,10 +104,10 @@ def boot_from_layers(
     # device_put per leaf-stack.
     dev_blobs = {lid: _device_blob(layers[lid]) for lid in held}
     if all(dev_blobs[lid] is not None for lid in layer_ids):
-        stacked = serde.stacked_from_device_blobs(
-            cfg, [dev_blobs[lid] for lid in layer_ids]
+        stacked = quant.stacked_from_device(
+            cfg, [dev_blobs[lid] for lid in layer_ids], codec
         )
-        via = "device bitcast"
+        via = "device bitcast" if codec == "raw" else f"device {codec} dequant"
     else:
         blobs = {
             lid: (
@@ -113,7 +117,7 @@ def boot_from_layers(
             )
             for lid in layer_ids
         }
-        host = serde.stacked_from_blobs(cfg, blobs, layer_ids)
+        host = quant.stacked_from_blobs_host(cfg, blobs, layer_ids, codec)
         stacked = {
             name: jax.device_put(a, sharding) if sharding is not None
             else jnp.asarray(a)
@@ -123,12 +127,12 @@ def boot_from_layers(
 
     if full:
         if dev_blobs[head_id] is not None:
-            head = serde.head_from_device_blob(cfg, dev_blobs[head_id])
+            head = quant.head_from_device(cfg, dev_blobs[head_id], codec)
         else:
             data = (layers[head_id].inmem_data
                     if layers[head_id].inmem_data is not None
                     else layers[head_id].read_bytes())
-            host_head = serde.head_from_blob(cfg, data)
+            host_head = quant.head_from_blob_host(cfg, data, codec)
             head = {
                 name: jax.device_put(a, sharding) if sharding is not None
                 else jnp.asarray(a)

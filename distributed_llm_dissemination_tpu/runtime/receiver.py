@@ -80,12 +80,14 @@ class ReceiverNode:
         placement=None,
         boot_cfg=None,
         fabric=None,
+        boot_codec: str = "raw",
     ):
         """``boot_cfg``: a ``models.llama.ModelConfig``; when set, the
         startup message boots the model from the delivered layer blobs
         (``runtime.boot``) and reports a ``BootReadyMsg`` to the leader —
         the inference engine the reference's startup hook only gestures at
-        (message.go:216-241).
+        (message.go:216-241).  ``boot_codec``: the transfer codec the
+        blobs were encoded with (``models/quant.py``).
 
         ``stage_hbm``: stage each delivered layer into device HBM (a
         jax.Array) before acking — the TPU-native terminal state; the
@@ -112,6 +114,7 @@ class ReceiverNode:
         self.stage_hbm = stage_hbm
         self.placement = placement
         self.boot_cfg = boot_cfg
+        self.boot_codec = boot_codec
         self.fabric = fabric
         self.boot_result = None  # BootResult after a successful boot
         self._boot_started = False
@@ -496,6 +499,7 @@ class ReceiverNode:
             res = boot_from_layers(
                 self.boot_cfg, self.layers,
                 placement=self.placement, node_id=self.node.my_id,
+                codec=self.boot_codec,
             )
         except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
             log.error("model boot failed", err=repr(e))
@@ -542,7 +546,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
     def __init__(self, node: Node, layers: LayersSrc, storage_path: str = ".",
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
                  checkpoint_dir: str = "", stage_hbm: bool = False,
-                 placement=None, boot_cfg=None, fabric=None):
+                 placement=None, boot_cfg=None, fabric=None,
+                 boot_codec: str = "raw"):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -583,7 +588,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         super().__init__(node, layers, storage_path, start_loop=False,
                          heartbeat_interval=heartbeat_interval,
                          stage_hbm=stage_hbm, placement=placement,
-                         boot_cfg=boot_cfg, fabric=fabric)
+                         boot_cfg=boot_cfg, fabric=fabric,
+                         boot_codec=boot_codec)
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:

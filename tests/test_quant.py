@@ -1,0 +1,198 @@
+"""Quantized transfer codec (models/quant.py): wire-size halving, codec
+roundtrip bounds, device/host decode parity, and the full
+disseminate-quantized → boot-dequantized loop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_dissemination_tpu.core import config as cfg_mod
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    SourceType,
+)
+from distributed_llm_dissemination_tpu.models import quant, serde
+from distributed_llm_dissemination_tpu.models.llama import CONFIGS, forward_jit, init_params
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    Node,
+)
+from distributed_llm_dissemination_tpu.runtime.boot import boot_from_layers
+from distributed_llm_dissemination_tpu.transport import InmemTransport, reset_registry
+
+TIMEOUT = 30.0
+CFG = CONFIGS["tiny"]
+SEED = 0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def blob_layer(data: bytes) -> LayerSrc:
+    return LayerSrc(
+        inmem_data=bytearray(data),
+        data_size=len(data),
+        meta=LayerMeta(location=LayerLocation.INMEM, source_type=SourceType.MEM),
+    )
+
+
+def all_ids():
+    return list(range(CFG.n_layers)) + [serde.head_blob_id(CFG)]
+
+
+def test_int8_halves_the_wire_bytes():
+    for bid in all_ids():
+        raw_n = serde.blob_nbytes(CFG, bid)
+        q_n = quant.blob_nbytes_codec(CFG, bid, "int8")
+        # bf16 -> int8 + per-row f32 scales: strictly under 60% of raw.
+        assert q_n < 0.6 * raw_n, (bid, q_n, raw_n)
+        # And the declared size is exact.
+        raw = serde.seeded_blob(CFG, bid, SEED)
+        enc = quant.encode_blob(CFG, bid, raw, "int8")
+        assert len(enc) == q_n
+        assert quant.blob_nbytes_codec(CFG, bid, "raw") == raw_n
+
+
+def test_unknown_codec_rejected():
+    with pytest.raises(ValueError, match="unknown codec"):
+        quant.blob_nbytes_codec(CFG, 0, "fp3")
+    with pytest.raises(ValueError, match="unknown codec"):
+        quant.encode_blob(CFG, 0, b"", "fp3")
+
+
+def test_roundtrip_error_bounded_by_scale():
+    # |dequant(x) - x| <= scale/2 + bf16 rounding slop, per element.
+    bid = 0
+    raw = serde.seeded_blob(CFG, bid, SEED)
+    enc = quant.encode_blob(CFG, bid, raw, "int8")
+    dec = quant.decode_blob_host(CFG, bid, enc, "int8")
+    src = serde._split_blob(CFG, raw, serde.layer_param_specs(CFG))
+    for name, shape in serde.layer_param_specs(CFG):
+        x = src[name].astype(np.float32).reshape(-1, shape[-1])
+        got = dec[name].astype(np.float32).reshape(-1, shape[-1])
+        scale = np.abs(x).max(axis=1, keepdims=True) / 127.0
+        scale = np.where(scale > 0, scale, 1.0)
+        # 0.5 quantization + generous bf16 storage rounding allowance.
+        bound = scale * 0.5 + 0.01 * np.abs(x) + 1e-6
+        assert (np.abs(got - x) <= bound).all(), name
+
+
+def test_device_decode_matches_host(cpu_devices):
+    bid = 1
+    enc = quant.encode_blob(CFG, bid, serde.seeded_blob(CFG, bid, SEED), "int8")
+    host = quant.decode_blob_host(CFG, bid, enc, "int8")
+    dev_blob = jnp.frombuffer(enc, dtype=jnp.uint8) if hasattr(jnp, "frombuffer") \
+        else jnp.asarray(np.frombuffer(enc, np.uint8))
+    dev = quant.stacked_from_device_qblobs(CFG, [dev_blob])
+    for name, _ in serde.layer_param_specs(CFG):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(dev[name][0]), np.float32),
+            host[name].astype(np.float32),
+            err_msg=name,
+        )
+
+
+def test_config_rejects_unknown_codec(tmp_path):
+    # A typo'd codec must die at parse time on EVERY node — a destination
+    # holds no layers, so the error would otherwise surface only as a
+    # swallowed boot failure and a hung leader boot wait.
+    p = tmp_path / "bad.json"
+    p.write_text('{"Nodes": [], "Model": "tiny", "ModelCodec": "INT8"}')
+    with pytest.raises(ValueError, match="unknown ModelCodec"):
+        cfg_mod.read_json(str(p))
+
+
+def test_config_parses_model_codec(tmp_path):
+    p = tmp_path / "c.json"
+    p.write_text(
+        '{"Nodes": [{"ID": 0, "Addr": "a", "IsLeader": true}], '
+        '"Model": "tiny", "ModelCodec": "int8"}'
+    )
+    conf = cfg_mod.read_json(str(p))
+    assert conf.model == "tiny" and conf.model_codec == "int8"
+    # Default stays raw.
+    p.write_text('{"Nodes": [], "Model": "tiny"}')
+    assert cfg_mod.read_json(str(p)).model_codec == "raw"
+
+
+def test_create_layers_encodes_with_codec():
+    nc = cfg_mod.NodeConf(
+        id=1, addr="x",
+        initial_layers={SourceType.MEM: {0: 0}},
+        sources={SourceType.MEM: 0},
+    )
+    layers = cfg_mod.create_layers(nc, save_disk=False, model="tiny",
+                                   model_seed=SEED, model_codec="int8")
+    want = quant.encode_blob(CFG, 0, serde.seeded_blob(CFG, 0, SEED), "int8")
+    assert bytes(layers[0].inmem_data) == want
+    assert layers[0].data_size == quant.blob_nbytes_codec(CFG, 0, "int8")
+
+
+def test_disseminate_int8_then_boot_close_logits(cpu_devices):
+    """End to end: seeders hold int8-encoded blobs (half the wire bytes),
+    mode-3 disseminates them, the receiver boots with dequantization and
+    its logits track the unquantized source model."""
+    head_id = serde.head_blob_id(CFG)
+    enc = {
+        bid: quant.encode_blob(CFG, bid, serde.seeded_blob(CFG, bid, SEED),
+                               "int8")
+        for bid in all_ids()
+    }
+    assignment = {2: {bid: LayerMeta() for bid in enc}}
+    ids = range(3)
+    ts = {i: InmemTransport(str(i)) for i in ids}
+    bw = {i: 10_000_000_000 for i in ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, assignment, bw, expected_nodes={1, 2},
+    )
+    seeder = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]),
+        {bid: blob_layer(enc[bid]) for bid in enc},
+    )
+    dest = FlowRetransmitReceiverNode(
+        Node(2, 0, ts[2]), {}, boot_cfg=CFG, boot_codec="int8",
+    )
+    try:
+        for r in (seeder, dest):
+            r.announce()
+        assert leader.start_distribution().get(timeout=TIMEOUT) == assignment
+        assert leader.ready().get(timeout=TIMEOUT) == assignment
+        dest.ready().get(timeout=TIMEOUT)
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert set(booted) == {2}
+
+        # Wire bytes were the quantized sizes.
+        for bid in enc:
+            assert dest.layers[bid].data_size == quant.blob_nbytes_codec(
+                CFG, bid, "int8"
+            )
+
+        res = dest.boot_result
+        assert res is not None and res.kind == "full"
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        want = np.asarray(jax.device_get(
+            forward_jit(init_params(CFG, jax.random.key(SEED)), tokens, CFG)
+        ), np.float32)
+        got = np.asarray(jax.device_get(res.logits), np.float32)
+        assert got.shape == want.shape
+        # int8 weights shift logits; they must stay strongly correlated
+        # and rank the same next token.
+        corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
+        assert corr > 0.99, corr
+        np.testing.assert_array_equal(
+            got.argmax(axis=-1), want.argmax(axis=-1)
+        )
+    finally:
+        leader.close()
+        for r in (seeder, dest):
+            r.close()
+        for t in ts.values():
+            t.close()

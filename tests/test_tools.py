@@ -179,6 +179,7 @@ def test_span_logs_duration():
     ("reference_8node.json", 8, 8),
     ("local_4node.json", 5, 4),
     ("tpu_v5e32_llama70b.json", 8, 80),
+    ("boot_tiny_4node_int8.json", 4, 5),
 ])
 def test_shipped_configs_load(name, nodes, layers):
     conf = cfg.read_json(f"{CONF_DIR}/{name}")
@@ -197,6 +198,19 @@ def test_shipped_configs_load(name, nodes, layers):
     for cc in conf.clients:
         seeded |= set(cc.layers_rate_limit)
     assert assigned <= seeded
+
+
+def test_int8_config_sizes_match_codec():
+    from distributed_llm_dissemination_tpu.models import quant
+    from distributed_llm_dissemination_tpu.models.llama import CONFIGS
+
+    conf = cfg.read_json(f"{CONF_DIR}/boot_tiny_4node_int8.json")
+    assert conf.model_codec == "int8"
+    mcfg = CONFIGS[conf.model]
+    for nc in conf.nodes:
+        for by_layer in nc.initial_layers.values():
+            for lid, size in by_layer.items():
+                assert size == quant.blob_nbytes_codec(mcfg, lid, "int8")
 
 
 def test_v5e32_config_matches_llama70b():
