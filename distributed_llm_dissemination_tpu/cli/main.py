@@ -77,7 +77,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def boot_config(name: str):
-    if not name:
+    if not name or name == "none":
+        # "-boot none" opts a boot-capable topology (a Model section) out
+        # of booting: dissemination-only runs, e.g. wire benchmarks.
         return None
     from ..models.llama import CONFIGS
 
@@ -85,7 +87,7 @@ def boot_config(name: str):
         return CONFIGS[name]
     except KeyError:
         raise SystemExit(
-            f"unknown -boot model {name!r}; known: {sorted(CONFIGS)}"
+            f"unknown -boot model {name!r}; known: {sorted(CONFIGS)}, none"
         )
 
 
@@ -138,6 +140,10 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
                                           expected_nodes=expected,
                                           failure_timeout=ft)
 
+    # One flag governs the run: the leader's decision rides StartupMsg,
+    # so receivers can never boot (or skip) against the leader's wait.
+    leader.boot_enabled = boot_config(args.boot or conf.model) is not None
+
     print(
         f"launching leader...\n[addr: {node.transport.get_address()}, "
         f"id: {args.id}, filename: {args.f}, storagePath: {args.s}, mode: {args.m}]",
@@ -149,7 +155,7 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     ttd = time.monotonic() - t0
     ulog.log.info("Time to deliver", seconds=round(ttd, 6))
     print(f"Time to deliver: {ttd:.6f}s", flush=True)
-    if args.boot or conf.model:
+    if leader.boot_enabled:
         # Receivers boot their model from the delivered blobs and report
         # back; TTFT = timer start → last boot report (includes TTD).
         booted = leader.boot_ready().get()

@@ -133,6 +133,7 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
                 else:
                     leader = _LEADERS[mode](node, layers, conf.assignment,
                                             **kwargs)
+                leader.boot_enabled = boot_cfg is not None
             else:
                 receivers.append(_RECEIVERS[mode](
                     node, layers, fabric=fabric, placement=placement,

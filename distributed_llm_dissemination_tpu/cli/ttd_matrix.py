@@ -47,6 +47,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _cpu_env() -> dict:
+    """Env for rows that import jax but must never depend on accelerator
+    availability: pin the CPU backend AND drop the accelerator-relay
+    pool var — with it set, jax init blocks on the relay even under
+    JAX_PLATFORMS=cpu when the tunnel is unhealthy."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
 def _localize_config(src_path: str, out_path: str,
                      scale_to: int = 0) -> None:
     """Rewrite node/client addresses to free loopback ports (the shipped
@@ -72,8 +83,11 @@ def _localize_config(src_path: str, out_path: str,
         json.dump(conf, f)
 
 
-def run_once(conf_path: str, mode: int, timeout: float = 120.0) -> float:
-    """One full dissemination via the real CLI; returns the leader's TTD."""
+def run_once(conf_path: str, mode: int, timeout: float = 120.0,
+             env: dict = None, extra_args=()) -> float:
+    """One full dissemination via the real CLI; returns the leader's TTD.
+    ``extra_args`` go to every node process (not external clients), e.g.
+    ("-boot", "none") for dissemination-only runs of boot topologies."""
     with open(conf_path) as f:
         conf = json.load(f)
     leader_id = next(n["Id"] for n in conf["Nodes"]
@@ -86,16 +100,16 @@ def run_once(conf_path: str, mode: int, timeout: float = 120.0) -> float:
             [sys.executable, "-m",
              "distributed_llm_dissemination_tpu.cli.main",
              "-id", str(node_id), "-f", conf_path, "-m", str(mode), *extra],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
         )
 
     procs = []
     try:
-        leader = spawn(leader_id)
+        leader = spawn(leader_id, extra_args)
         procs.append(leader)
         time.sleep(0.3)  # listener up before the dial-retry window matters
         for rid in receiver_ids:
-            procs.append(spawn(rid))
+            procs.append(spawn(rid, extra_args))
         for cid in client_ids:
             procs.append(spawn(cid, ("-c",)))
         out, _ = leader.communicate(timeout=timeout)
@@ -119,8 +133,7 @@ def run_once_pod(conf_path: str, mode: int, timeout: float = 240.0) -> float:
     (cli.podrun) on a virtual 8-device CPU mesh; returns the TTD.  The
     layer bytes move over the device plane — this row measures the
     fabric's scheduling + ingest path, not TCP."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    env = _cpu_env()
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
@@ -175,6 +188,56 @@ def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
                 )
             results["scenarios"][name] = per_mode
     return results
+
+
+def _codec_variant(src_path: str, out_path: str, codec: str,
+                   rate: int) -> None:
+    """boot_tiny_4node's topology, retargeted at the tiny2 model (~2 MiB
+    layers, so the 256 KiB burst bucket is noise), every in-RAM source
+    rate-limited to ``rate`` B/s, under the given transfer codec — the
+    A/B pair where TTD is bytes over a fixed rate, so the codec's
+    wire-size ratio shows up as the TTD ratio."""
+    with open(src_path) as f:
+        conf = copy.deepcopy(json.load(f))
+    conf["Model"] = "tiny2"
+    conf["ModelCodec"] = codec
+    for n in conf["Nodes"]:
+        n["Sources"] = {"2": rate}
+    with open(out_path, "w") as f:
+        json.dump(conf, f)
+    _localize_config(out_path, out_path)  # one free-port rewrite path
+
+
+def run_codec_ab(trials: int, rate: int = 4 << 20, mode: int = 3,
+                 timeout: float = 240.0) -> dict:
+    """Measured int8-codec benefit: the same model topology disseminated
+    raw vs int8 at a fixed source rate (models/quant.py halves the blob
+    bytes, so mode-3 completion time should roughly halve with it; the
+    transport's reference-parity 256 KiB burst bucket gives each job a
+    free head start, so at tiny2's ~2 MiB layers the measured ratio sits
+    a bit below the pure size ratio)."""
+    out: dict = {"rate_bytes_per_s": rate, "mode": mode, "model": "tiny2"}
+    # Blob fabrication imports jax in the receivers: CPU-pinned so the
+    # row measures the rate-limited wire, not the device.  -boot none
+    # skips the post-TTD model boot (compile seconds per run that the
+    # TTD timer doesn't even see).
+    env = _cpu_env()
+    with tempfile.TemporaryDirectory() as td:
+        for codec in ("raw", "int8"):
+            path = os.path.join(td, f"boot_{codec}.json")
+            _codec_variant(os.path.join(CONF_DIR, "boot_tiny_4node.json"),
+                           path, codec, rate)
+            ts = [run_once(path, mode, timeout, env=env,
+                           extra_args=("-boot", "none"))
+                  for _ in range(trials)]
+            out[codec] = {"ttd_s": round(statistics.median(ts), 4),
+                          "all": [round(t, 4) for t in ts]}
+            print(f"codec {codec}: TTD {out[codec]['ttd_s']}s",
+                  file=sys.stderr, flush=True)
+    out["int8_vs_raw"] = round(
+        out["int8"]["ttd_s"] / max(out["raw"]["ttd_s"], 1e-9), 3
+    )
+    return out
 
 
 # The driver-provided BASELINE.json scenarios (#2-#5), materialized by
@@ -232,6 +295,25 @@ def to_markdown(results: dict) -> str:
         row.append(str(per_mode.get("mode1_vs_mode0", "—")))
         lines.append("| " + " | ".join(row) + " |")
     lines.append("")
+    ab = results.get("codec_ab")
+    if ab:
+        lines += [
+            "## Transfer codec A/B (measured int8 benefit)",
+            "",
+            "boot_tiny_4node's topology retargeted at the "
+            f"`{ab.get('model', 'tiny2')}` model (~2 MiB layers, so the "
+            "256 KiB burst bucket is noise), every source rate-limited "
+            f"to {ab['rate_bytes_per_s'] >> 20} MiB/s, mode {ab['mode']}: "
+            "TTD is bytes over a fixed rate, so the int8 codec's ~0.51x "
+            "wire size appears as the TTD ratio (slightly below it: each "
+            "job's burst head start is codec-independent).",
+            "",
+            "| codec | TTD | int8/raw |",
+            "|---|---|---|",
+            f"| raw | {ab['raw']['ttd_s']}s | |",
+            f"| int8 | {ab['int8']['ttd_s']}s | {ab['int8_vs_raw']} |",
+            "",
+        ]
     baseline = results.get("baseline_scenarios")
     if baseline:
         lines += [
@@ -261,6 +343,7 @@ def main(argv=None) -> int:
                         "(8-64 processes; minutes of wall time)")
     args = p.parse_args(argv)
     results = run_matrix(args.scale, args.trials)
+    results["codec_ab"] = run_codec_ab(args.trials)
     if args.baseline:
         results["baseline_scenarios"] = run_baseline_scenarios(
             min(args.scale, 256 << 10)

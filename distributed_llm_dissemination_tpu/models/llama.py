@@ -63,6 +63,12 @@ class ModelConfig:
 CONFIGS: Dict[str, ModelConfig] = {
     "tiny": ModelConfig(),
     "tiny-moe": ModelConfig(name="tiny-moe", n_experts=4, top_k=2),
+    # ~2 MiB/layer: big enough that the transport's 256 KiB burst bucket
+    # is noise — the shape rate-limited wire benchmarks need.
+    "tiny2": ModelConfig(
+        name="tiny2", vocab=512, d_model=256, n_layers=4,
+        n_heads=4, n_kv_heads=2, d_ff=1024,
+    ),
     "llama3-8b": ModelConfig(
         name="llama3-8b", vocab=128256, d_model=4096, n_layers=32,
         n_heads=32, n_kv_heads=8, d_ff=14336,

@@ -130,6 +130,10 @@ class LeaderNode:
         self._ready_q: "queue.Queue[Assignment]" = queue.Queue()
         self._started = False
         self._startup_sent = False
+        # The leader's boot decision rides StartupMsg so one flag governs
+        # the whole run (see send_startup); the CLI sets this False for
+        # dissemination-only runs of boot-capable topologies (-boot none).
+        self.boot_enabled = True
         # Model-boot completion tracking (BootReadyMsg is an extension:
         # the reference's startup hook has no completion signal).
         self._boot_q: "queue.Queue[Dict[NodeID, float]]" = queue.Queue()
@@ -202,6 +206,10 @@ class LeaderNode:
         log.info("node booted its model", node=msg.src_id, kind=msg.kind,
                  boot_seconds=round(msg.seconds, 6))
         with self._lock:
+            if msg.src_id not in self.assignment:
+                # Only assignees gate the boot wait; a seeder's "skipped"
+                # report (it holds no assigned model) is just liveness.
+                return
             self._booted[msg.src_id] = msg.seconds
             if self._boot_reported or set(self.assignment) - set(self._booted):
                 return
@@ -569,7 +577,10 @@ class LeaderNode:
             receivers = list(self.status)
         for node_id in receivers:
             try:
-                self.node.transport.send(node_id, StartupMsg(self.node.my_id))
+                self.node.transport.send(
+                    node_id,
+                    StartupMsg(self.node.my_id, boot=self.boot_enabled),
+                )
             except (OSError, KeyError) as e:
                 log.error("failed to send startup", dest=node_id, err=repr(e))
         if self.fabric is not None:

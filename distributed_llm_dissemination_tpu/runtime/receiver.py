@@ -478,13 +478,35 @@ class ReceiverNode:
         """The inference-engine boot hook (node.go:1387-1389) — with
         ``boot_cfg`` it actually boots the engine: ``ready()`` unblocks
         immediately (delivery is done), the boot runs on the handler pool,
-        and its completion is reported to the leader as a BootReadyMsg."""
+        and its completion is reported to the leader as a BootReadyMsg.
+
+        The LEADER's boot decision (``msg.boot``) governs: with it off,
+        nobody boots; with it on, a receiver that locally opted out
+        (``-boot none``) reports a "skipped" BootReadyMsg instead of
+        silence — the leader's boot wait can never deadlock on a flag
+        mismatch."""
         self._ready_q.put(object())
         if self.fabric is not None:
             # Dissemination is over: the cached fabric uploads' HBM now
             # belongs to whatever boots next.
             release_upload_cache()
+        if not msg.boot:
+            return
         if self.boot_cfg is None:
+            # Outside the _boot_started latch ON PURPOSE: the report is
+            # idempotent and cheap, and a leader that re-sends startup
+            # (after an update/re-plan, or because this send failed)
+            # must get it again — latching it once would re-open the
+            # boot-wait hang on a transient send failure.
+            log.info("startup asked for boot but this node opted out; "
+                     "reporting skipped")
+            try:
+                self.node.transport.send(
+                    self.node.leader_id,
+                    BootReadyMsg(self.node.my_id, 0.0, "skipped"),
+                )
+            except (OSError, KeyError) as e:
+                log.error("failed to send bootReadyMsg", err=repr(e))
             return
         with self._lock:
             if self._boot_started:  # a re-sent startup must not re-boot

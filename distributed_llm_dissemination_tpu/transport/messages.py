@@ -241,18 +241,21 @@ class ClientReqMsg:
 @dataclasses.dataclass
 class StartupMsg:
     """Leader → all: assignment satisfied, boot the inference engine
-    (message.go:217-241)."""
+    (message.go:217-241).  ``boot`` carries the LEADER's boot decision so
+    one flag governs the whole run — a receiver can't be left booting (or
+    skipping) while the leader expects the opposite."""
 
     src_id: NodeID
+    boot: bool = True
 
     msg_type = MsgType.STARTUP
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id}
+        return {"SrcID": self.src_id, "Boot": self.boot}
 
     @classmethod
     def from_payload(cls, d: dict) -> "StartupMsg":
-        return cls(int(d["SrcID"]))
+        return cls(int(d["SrcID"]), bool(d.get("Boot", True)))
 
 
 @dataclasses.dataclass

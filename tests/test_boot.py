@@ -177,3 +177,57 @@ def test_boot_rejects_non_contiguous():
     layers = {bid: blob_layer(blobs[bid]) for bid in (0, 2)}
     with pytest.raises(ValueError, match="contiguous"):
         boot_from_layers(CFG, layers)
+
+
+def _tiny_run(leader_boot: bool, receiver_boot_cfg):
+    """1 seeder-less leader + 1 assignee over inmem; returns (leader,
+    receiver) after dissemination completes.  Mode 0: the leader holds
+    the blobs itself."""
+    from distributed_llm_dissemination_tpu.runtime import LeaderNode, ReceiverNode
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    blobs = all_blobs()
+    assignment = {1: {bid: LayerMeta() for bid in blobs}}
+    ts = {i: InmemTransport(str(i)) for i in (0, 1)}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(b) for bid, b in blobs.items()},
+        assignment, expected_nodes={1},
+    )
+    leader.boot_enabled = leader_boot
+    receiver = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=receiver_boot_cfg)
+    receiver.announce()
+    leader.start_distribution().get(timeout=TIMEOUT)
+    leader.ready().get(timeout=TIMEOUT)
+    receiver.ready().get(timeout=TIMEOUT)
+    return leader, receiver, ts
+
+
+def test_leader_boot_decision_governs_receivers():
+    # Leader opted out (-boot none): a receiver WITH a boot config must
+    # not boot — one flag governs the run.
+    import time as _t
+
+    leader, receiver, ts = _tiny_run(leader_boot=False, receiver_boot_cfg=CFG)
+    try:
+        _t.sleep(0.3)  # a boot, if wrongly started, would be in flight
+        assert receiver.boot_result is None
+        assert not receiver._boot_started
+    finally:
+        leader.close(); receiver.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_opted_out_receiver_reports_skipped():
+    # Leader wants boot, receiver opted out: a "skipped" BootReadyMsg
+    # keeps the leader's boot wait from deadlocking.
+    leader, receiver, ts = _tiny_run(leader_boot=True, receiver_boot_cfg=None)
+    try:
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert booted == {1: 0.0}
+        assert receiver.boot_result is None
+    finally:
+        leader.close(); receiver.close()
+        for t in ts.values():
+            t.close()
