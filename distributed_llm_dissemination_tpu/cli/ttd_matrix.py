@@ -24,7 +24,6 @@ Scenarios:
 from __future__ import annotations
 
 import argparse
-import copy
 import json
 import os
 import re
@@ -48,25 +47,24 @@ def _free_port() -> int:
 
 
 def _cpu_env() -> dict:
-    """Env for rows that import jax but must never depend on accelerator
-    availability: pin the CPU backend AND drop the accelerator-relay
-    pool var — with it set, jax init blocks on the relay even under
-    JAX_PLATFORMS=cpu when the tunnel is unhealthy."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    return env
+    from distributed_llm_dissemination_tpu.utils.env import cpu_pinned_env
+
+    return cpu_pinned_env()
 
 
 def _localize_config(src_path: str, out_path: str,
-                     scale_to: int = 0) -> None:
+                     scale_to: int = 0, mutate=None) -> None:
     """Rewrite node/client addresses to free loopback ports (the shipped
     configs use fixed ports that anything else on the host may hold) and,
     when ``scale_to`` > 0, scale every LayerSize down to loopback-friendly
     bytes; rates and NIC bandwidths keep their configured (physical)
-    values."""
+    values.  ``mutate``: optional callback applied to the loaded dict
+    before the rewrite — scenario-specific edits share this one
+    load/write path."""
     with open(src_path) as f:
-        conf = copy.deepcopy(json.load(f))
+        conf = json.load(f)
+    if mutate is not None:
+        mutate(conf)
     if scale_to > 0:
         if "LayerSize" in conf:
             conf["LayerSize"] = scale_to
@@ -197,15 +195,13 @@ def _codec_variant(src_path: str, out_path: str, codec: str,
     rate-limited to ``rate`` B/s, under the given transfer codec — the
     A/B pair where TTD is bytes over a fixed rate, so the codec's
     wire-size ratio shows up as the TTD ratio."""
-    with open(src_path) as f:
-        conf = copy.deepcopy(json.load(f))
-    conf["Model"] = "tiny2"
-    conf["ModelCodec"] = codec
-    for n in conf["Nodes"]:
-        n["Sources"] = {"2": rate}
-    with open(out_path, "w") as f:
-        json.dump(conf, f)
-    _localize_config(out_path, out_path)  # one free-port rewrite path
+    def mutate(conf):
+        conf["Model"] = "tiny2"
+        conf["ModelCodec"] = codec
+        for n in conf["Nodes"]:
+            n["Sources"] = {"2": rate}
+
+    _localize_config(src_path, out_path, mutate=mutate)
 
 
 def run_codec_ab(trials: int, rate: int = 4 << 20, mode: int = 3,

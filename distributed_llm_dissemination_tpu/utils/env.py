@@ -1,0 +1,16 @@
+"""Process-environment helpers for accelerator-independent subprocesses."""
+
+from __future__ import annotations
+
+import os
+
+
+def cpu_pinned_env(base: dict = None) -> dict:
+    """Env for a process that imports jax but must never depend on
+    accelerator availability: pin the CPU backend AND drop the
+    accelerator-relay pool var — with it set, jax init blocks on the
+    relay even under JAX_PLATFORMS=cpu when the tunnel is unhealthy."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
