@@ -196,3 +196,29 @@ def test_disseminate_int8_then_boot_close_logits(cpu_devices):
             r.close()
         for t in ts.values():
             t.close()
+
+
+def test_int8_over_pod_fabric_boots(cpu_devices):
+    """Codec x fabric: int8 blobs ride the device plane (zero TCP layer
+    bytes) and the dest dequantizes on-device at boot."""
+    import json
+
+    from distributed_llm_dissemination_tpu.cli.podrun import run_pod
+
+    with open("conf/pod_fabric_4node.json") as f:
+        d = json.load(f)
+    d["Model"] = "tiny"
+    d["ModelSeed"] = SEED
+    d["ModelCodec"] = "int8"
+    blob_ids = [str(b) for b in all_ids()]
+    # Leader seeds every blob; cold node 3 is assigned the full model.
+    d["Nodes"][0]["InitialLayers"] = {"2": {b: {} for b in blob_ids}}
+    for n in d["Nodes"][1:]:
+        n["InitialLayers"] = {}
+    d["Assignment"] = {"3": {b: {} for b in blob_ids}}
+    conf = cfg_mod.Config.from_json(d)
+
+    summary = run_pod(conf, mode=3, timeout=120.0)
+    assert summary["fabric"] is True
+    assert summary["ttd_s"] > 0
+    assert summary.get("boot_nodes") == 1
