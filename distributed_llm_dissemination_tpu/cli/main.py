@@ -123,22 +123,19 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     # races seeder announcements).
     expected = {nc.id for nc in conf.nodes}
     ft = args.ft
+    fabric, placement = build_spmd_fabric(args, conf)
+    common = dict(expected_nodes=expected, failure_timeout=ft,
+                  fabric=fabric, placement=placement)
     if args.m == 0:
-        leader = LeaderNode(node, layers, assignment, expected_nodes=expected,
-                            failure_timeout=ft)
+        leader = LeaderNode(node, layers, assignment, **common)
     elif args.m == 1:
-        leader = RetransmitLeaderNode(node, layers, assignment,
-                                      expected_nodes=expected,
-                                      failure_timeout=ft)
+        leader = RetransmitLeaderNode(node, layers, assignment, **common)
     elif args.m == 2:
-        leader = PullRetransmitLeaderNode(node, layers, assignment,
-                                          expected_nodes=expected,
-                                          failure_timeout=ft)
+        leader = PullRetransmitLeaderNode(node, layers, assignment, **common)
     else:
         bw = {nc.id: nc.network_bw for nc in conf.nodes}
         leader = FlowRetransmitLeaderNode(node, layers, assignment, bw,
-                                          expected_nodes=expected,
-                                          failure_timeout=ft)
+                                          **common)
 
     # One flag governs the run: the leader's decision rides StartupMsg,
     # so receivers can never boot (or skip) against the leader's wait.
@@ -215,33 +212,57 @@ def build_placement(args, conf: cfg.Config):
     return placement
 
 
+def build_spmd_fabric(args, conf: cfg.Config):
+    """(fabric, placement) for a Mesh.Fabric + Distributed topology: the
+    multi-controller SPMD fabric (``parallel/spmd_fabric.py``), with a
+    placement covering EVERY node (seeders upload through their own
+    stages).  Returns (None, None) when the config doesn't ask for it."""
+    if conf.mesh is None or not conf.mesh.fabric:
+        return None, None
+    from ..parallel.mesh import fabric_placement, mesh_from_conf
+    from ..parallel.multihost import (
+        honor_jax_platforms,
+        host_aligned_device_order,
+    )
+    from ..parallel.spmd_fabric import SpmdFabric
+
+    honor_jax_platforms()
+    mesh = mesh_from_conf(
+        conf.mesh, host_aligned_device_order(conf, conf.assignment)
+    )
+    placement = fabric_placement(
+        [nc.id for nc in conf.nodes], conf.assignment, mesh,
+        conf.mesh.pipeline_axis,
+    )
+    fabric = SpmdFabric(placement, args.id)
+    ulog.log.info(
+        "spmd fabric up",
+        stages={str(n): s for n, s in placement.node_to_stage.items()},
+    )
+    return fabric, placement
+
+
 def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     """Receiver role (cmd/main.go:183-215)."""
-    placement = build_placement(args, conf)
+    fabric, placement = build_spmd_fabric(args, conf)
+    if fabric is None:
+        placement = build_placement(args, conf)
     # A config with a Model section is boot-capable: receivers boot by
     # default so the leader's boot wait can't hang on a missing flag.
     boot_cfg = boot_config(args.boot or conf.model)
     codec = conf.model_codec
+    common = dict(heartbeat_interval=args.hb, stage_hbm=args.hbm,
+                  placement=placement, boot_cfg=boot_cfg, boot_codec=codec,
+                  fabric=fabric)
     if args.m == 0:
-        receiver = ReceiverNode(node, layers, args.s or ".",
-                                heartbeat_interval=args.hb,
-                                stage_hbm=args.hbm, placement=placement,
-                                boot_cfg=boot_cfg, boot_codec=codec)
+        receiver = ReceiverNode(node, layers, args.s or ".", **common)
     elif args.m in (1, 2):
         receiver = RetransmitReceiverNode(node, layers, args.s or ".",
-                                          heartbeat_interval=args.hb,
-                                          stage_hbm=args.hbm,
-                                          placement=placement,
-                                          boot_cfg=boot_cfg,
-                                          boot_codec=codec)
+                                          **common)
     else:
         receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
-                                              heartbeat_interval=args.hb,
                                               checkpoint_dir=args.ckpt,
-                                              stage_hbm=args.hbm,
-                                              placement=placement,
-                                              boot_cfg=boot_cfg,
-                                              boot_codec=codec)
+                                              **common)
 
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "
@@ -263,18 +284,23 @@ def main(argv=None) -> int:
     if args.c:
         return run_client(args, conf)
 
-    if conf.mesh is not None and conf.mesh.fabric:
+    if (conf.mesh is not None and conf.mesh.fabric
+            and conf.distributed is None):
         # One OS process per node cannot share an in-process FabricPlane;
         # refusing beats silently running the TCP data plane the config
         # opted out of.  Checked BEFORE any distributed init: joining the
         # pod runtime blocks on every rank, and a doomed run must fail
-        # fast instead.
+        # fast instead.  WITH a Distributed section the processes join one
+        # JAX runtime and the multi-controller SPMD fabric
+        # (parallel/spmd_fabric.py) carries the layer bytes instead.
         raise SystemExit(
-            "config has Mesh.Fabric=true: the pod-fabric data plane runs "
-            "all nodes under one controller — use "
+            "config has Mesh.Fabric=true but no Distributed section: the "
+            "in-process pod-fabric data plane runs all nodes under one "
+            "controller — use "
             "`python -m distributed_llm_dissemination_tpu.cli.podrun "
-            f"-f {args.f} -m {args.m}` (or drop the Fabric flag to run "
-            "per-node processes over TCP)"
+            f"-f {args.f} -m {args.m}`, add a Distributed section for the "
+            "multi-controller SPMD fabric, or drop the Fabric flag to run "
+            "per-node processes over TCP"
         )
 
     if conf.distributed is not None:

@@ -50,7 +50,6 @@ def shard_along(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P(axis)))
 
 
-@functools.lru_cache(maxsize=64)
 def gather_tiles(mesh: Mesh, axis: str, sizes: Tuple[int, ...]):
     """Compiled: each device holds one PADDED tile of a byte blob (tile i
     is ``sizes[i]`` real elements); one ``all_gather`` + static re-splice
@@ -61,11 +60,26 @@ def gather_tiles(mesh: Mesh, axis: str, sizes: Tuple[int, ...]):
     device program) and ``ingest.ShardedLayerIngest.finalize`` (the
     receiver's incremental HBM ingest) compile through here — unequal
     flow-job splits are padded to the largest tile, and the re-splice
-    uses static slice bounds so XLA fuses it into the gather epilogue."""
+    uses static slice bounds so XLA fuses it into the gather epilogue.
+
+    The identity-order case of ``gather_tiles_at`` (one shared builder,
+    one compile cache)."""
+    return gather_tiles_at(mesh, axis, sizes, tuple(range(len(sizes))))
+
+
+@functools.lru_cache(maxsize=64)
+def gather_tiles_at(mesh: Mesh, axis: str, sizes: Tuple[int, ...],
+                    order: Tuple[int, ...]):
+    """``gather_tiles`` with an explicit re-splice permutation: the blob's
+    k-th byte range (in offset order) lives on device rank ``order[k]``.
+    The multi-controller SPMD fabric needs this because contributions sit
+    on their SENDER's stage devices — whichever mesh ranks those are —
+    not on ranks sorted by offset."""
 
     def per_device(frag):
         g = lax.all_gather(frag, axis)  # (n, pad)
-        parts = [lax.slice(g[i], (0,), (sizes[i],)) for i in range(len(sizes))]
+        parts = [lax.slice(g[r], (0,), (sizes[r],))
+                 for r in order if sizes[r] > 0]
         return jnp.concatenate(parts)
 
     @jax.jit

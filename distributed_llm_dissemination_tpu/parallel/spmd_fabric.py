@@ -1,0 +1,294 @@
+"""Multi-controller SPMD fabric: layer bytes over the device mesh when
+every node is its OWN OS process (one per TPU host).
+
+The single-controller ``FabricPlane`` (``parallel/fabric.py``) hands
+device arrays between threads of one process.  On a real pod there is no
+such process: each host runs its own controller (the reference's
+per-host process model, ``/root/reference/cmd/main.go:113-146``), all of
+them joined into one JAX runtime by ``parallel/multihost.py``.  Data can
+then only move between hosts through a COLLECTIVE that every process
+enters with the same program — the multi-controller discipline of
+jax.distributed.
+
+This module is that discipline applied to dissemination:
+
+- The leader turns a scheduled transfer into a ``DevicePlanMsg`` carrying
+  a global sequence number and broadcasts it to EVERY node (not just the
+  participants — all processes must enter the collective).
+- Each process runs one ``SpmdFabric`` executor thread that executes
+  plans strictly in seq order.  For plan k, every process derives the
+  SAME slot assignment from the message alone (deterministic: layout
+  entry -> an unused device rank of the sender's stage), uploads the byte
+  ranges it owns onto its own local devices, assembles the global sharded
+  array, and enters one compiled gather
+  (``collectives.gather_tiles_at``): the layer materializes replicated on
+  every device, the byte traffic riding ICI on real hardware.
+- The plan's dest keeps its local copy (stage-replicated, exactly the
+  ``-hbm`` terminal state); everyone else drops theirs immediately.
+
+An empty-layout plan is a CANCELLATION: the leader aborted dispatch
+mid-broadcast, and every process advances past the seq without entering
+a collective — a process that entered while another skipped would hang
+the pod, so cancellation must be globally ordered too.
+
+Failure domain: a process that never receives seq k stalls the fabric
+(later plans queue behind it).  That is inherent to lockstep SPMD — the
+control plane (ordered, retried TCP) is the reliability layer, and the
+executor logs loudly when a gap persists past ``gap_timeout``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import log
+
+PLAN_WAIT_S = 120.0  # dest-side wait for its plan's collective
+
+
+class PlanFailed(RuntimeError):
+    pass
+
+
+class _Result:
+    """One plan's outcome: a device array (dest), None (cancelled /
+    not-dest), or an exception."""
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+
+    def resolve(self, value=None, error: Optional[BaseException] = None):
+        self.value = value
+        self.error = error
+        self.event.set()
+
+    def get(self, timeout: float):
+        if not self.event.wait(timeout):
+            raise PlanFailed(f"no collective result after {timeout}s")
+        if self.error is not None:
+            raise PlanFailed(str(self.error)) from self.error
+        return self.value
+
+
+class SpmdFabric:
+    """Per-process executor of globally-ordered fabric plans.
+
+    ``placement`` must cover every node (``parallel.mesh.fabric_placement``)
+    and be identical on all processes (host-aligned device order makes it
+    so).  ``bind_store(layers, lock)`` is called by the node constructor:
+    the executor reads ONLY this node's own byte ranges through it."""
+
+    kind = "spmd"
+
+    def __init__(self, placement, my_node: int, gap_timeout: float = 60.0):
+        self.placement = placement
+        self.my_node = my_node
+        self.gap_timeout = gap_timeout
+        self._layers = None
+        self._layers_lock: Optional[threading.Lock] = None
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: Dict[int, object] = {}  # seq -> DevicePlanMsg
+        self._results: Dict[str, _Result] = {}  # plan_id -> result
+        self._next_seq = 0
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="spmd-fabric", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- wiring
+
+    def bind_store(self, layers, lock: threading.Lock) -> None:
+        self._layers = layers
+        self._layers_lock = lock
+
+    def _read_span(self, layer_id: int, off: int, size: int) -> Optional[bytes]:
+        if self._layers is None:
+            return None
+        with self._layers_lock:
+            layer = self._layers.get(layer_id)
+        if layer is None:
+            return None
+        return layer.read_span(off, size)
+
+    # ------------------------------------------------------------ protocol
+
+    def submit(self, msg) -> _Result:
+        """Enqueue one plan (any role); returns its result handle.  The
+        dest waits on it; everyone else may drop it.
+
+        A CANCELLATION (empty layout) for a still-pending seq replaces the
+        original: the leader cancels when its broadcast partially failed,
+        and a process that kept the original would enter a collective some
+        peer never will.  (A plan already being executed can no longer be
+        cancelled — that residual window is part of the pod failure
+        domain, see the module docstring.)"""
+        with self._cond:
+            if self._closed:
+                raise PlanFailed("fabric closed")
+            res = self._results.get(msg.plan_id)
+            if res is None:
+                res = self._results[msg.plan_id] = _Result()
+            if msg.seq < self._next_seq:
+                return res  # already executed (or executing)
+            if msg.seq in self._pending:
+                if not msg.layout:
+                    self._pending[msg.seq] = msg  # cancel overrides
+                return res
+            self._pending[msg.seq] = msg
+            self._cond.notify_all()
+        return res
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------ executor
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                waited = self._cond.wait_for(
+                    lambda: self._closed or self._next_seq in self._pending,
+                    timeout=self.gap_timeout,
+                )
+                if self._closed:
+                    for res in self._results.values():
+                        if not res.event.is_set():
+                            res.resolve(error=PlanFailed("fabric closed"))
+                    return
+                if not waited:
+                    if self._pending:
+                        # Later seqs queued behind a gap: the pod-wide
+                        # lockstep is stalled.  Only the control plane can
+                        # fix this; make it loud.
+                        log.error(
+                            "spmd fabric stalled waiting for plan seq",
+                            next_seq=self._next_seq,
+                            queued=sorted(self._pending),
+                        )
+                    continue
+                msg = self._pending.pop(self._next_seq)
+                self._next_seq += 1
+                # Kept (resolved) in _results so late duplicate deliveries
+                # get the settled handle instead of a dangling fresh one;
+                # the map grows by one small entry per plan per run.
+                res = self._results[msg.plan_id]
+            try:
+                value = self._execute(msg)
+            except Exception as e:  # noqa: BLE001 — resolve, don't die
+                log.error("spmd fabric plan failed", plan=msg.plan_id,
+                          err=repr(e))
+                res.resolve(error=e)
+                continue
+            res.resolve(value=value)
+
+    # ----------------------------------------------------------- collective
+
+    def _slot_assignment(
+        self, layout: List[Tuple[int, int, int]]
+    ) -> Tuple[Tuple[int, ...], Tuple[int, ...], Dict[int, Tuple[int, int, int]]]:
+        """Deterministic (message-only) mapping of layout entries to mesh
+        device ranks: each contribution lands on an unused device of its
+        sender's stage.  Returns (sizes by rank, ranks in offset order,
+        rank -> (sender, offset, size))."""
+        import numpy as np
+
+        flat = list(np.ravel(self.placement.mesh.devices))
+        rank_of = {id(d): i for i, d in enumerate(flat)}
+        used: set = set()
+        by_rank: Dict[int, Tuple[int, int, int]] = {}
+        order: List[int] = []
+        for sender, off, size in sorted(layout, key=lambda e: e[1]):
+            stage_ranks = [rank_of[id(d)]
+                           for d in self.placement.devices_for_node(sender)]
+            free = [r for r in stage_ranks if r not in used]
+            if not free:
+                raise PlanFailed(
+                    f"sender {sender} has more ranges than stage devices"
+                )
+            r = free[0]
+            used.add(r)
+            by_rank[r] = (sender, off, size)
+            order.append(r)
+        sizes = tuple(
+            by_rank[r][2] if r in by_rank else 0 for r in range(len(flat))
+        )
+        return sizes, tuple(order), by_rank
+
+    def _execute(self, msg):
+        if not msg.layout:
+            log.info("spmd fabric plan cancelled", plan=msg.plan_id)
+            return None
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .collectives import gather_tiles_at
+        from .ingest import flat_mesh
+
+        sizes, order, by_rank = self._slot_assignment(msg.layout)
+        total = sum(sizes)
+        if total != msg.total_size:
+            raise PlanFailed(
+                f"layout covers {total} bytes, plan says {msg.total_size}"
+            )
+        pad = max(sizes)
+        flat = list(np.ravel(self.placement.mesh.devices))
+        mesh = flat_mesh(flat, axis="fabric")
+        proc = jax.process_index()
+
+        # My ranges MUST sit on my local devices (one stage == one host
+        # under the host-aligned order) — otherwise this process would
+        # silently contribute zeros.  Checked before any device work so
+        # the failure is loud, not corrupt.
+        for rank, (sender, _, _) in by_rank.items():
+            if sender == self.my_node and flat[rank].process_index != proc:
+                raise PlanFailed(
+                    f"my range's slot (rank {rank}) is not a local device; "
+                    "placement is not host-aligned"
+                )
+
+        shards = []
+        for rank, dev in enumerate(flat):
+            if dev.process_index != proc:
+                continue
+            buf = np.zeros(pad, np.uint8)
+            entry = by_rank.get(rank)
+            if entry is not None and entry[0] == self.my_node:
+                _, off, size = entry
+                data = self._read_span(msg.layer_id, off, size)
+                if data is None:
+                    raise PlanFailed(
+                        f"no local bytes for layer {msg.layer_id}"
+                    )
+                buf[:size] = np.frombuffer(data, np.uint8)
+            shards.append(jax.device_put(buf, dev))
+
+        v = jax.make_array_from_single_device_arrays(
+            (len(flat) * pad,), NamedSharding(mesh, P("fabric")), shards
+        )
+        out = gather_tiles_at(mesh, "fabric", sizes, order)(v)
+        jax.block_until_ready(out)
+        if msg.dest_id != self.my_node:
+            return None
+        # Keep the LOCAL copy: the gather left the full layer replicated
+        # on every device; this node's addressable shards are its stage's
+        # devices (host-aligned order) — re-wrap them as a local
+        # stage-replicated array, the -hbm terminal state.
+        local_shards = [s.data for s in out.addressable_shards]
+        stage = self.placement.node_to_stage[self.my_node]
+        stage_mesh = self.placement.stage_mesh(stage)
+        try:
+            arr = jax.make_array_from_single_device_arrays(
+                out.shape, NamedSharding(stage_mesh, P()), local_shards
+            )
+        except Exception:  # noqa: BLE001 — single-device copy still correct
+            arr = local_shards[0]
+        return arr

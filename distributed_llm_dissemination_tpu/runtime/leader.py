@@ -126,6 +126,11 @@ class LeaderNode:
         self.expected_nodes = set(expected_nodes or ())
         self.status: Status = {}
         self._lock = threading.Lock()
+        # SPMD fabric: declared crashes break pod-wide lockstep, so later
+        # transfers fall back to the host path (_fabric_ok).
+        self._fabric_disabled = False
+        if fabric is not None and hasattr(fabric, "bind_store"):
+            fabric.bind_store(layers, self._lock)
         self._start_q: "queue.Queue[Assignment]" = queue.Queue()
         self._ready_q: "queue.Queue[Assignment]" = queue.Queue()
         self._started = False
@@ -298,6 +303,18 @@ class LeaderNode:
             return
         if reannounce:
             log.info("node re-announced; re-planning", node=msg.src_id)
+            if (getattr(self.fabric, "kind", "") == "spmd"
+                    and not self._fabric_disabled):
+                # Either the process restarted (fresh executor at seq 0,
+                # possibly outside the jax.distributed runtime — a fabric
+                # plan would hang every survivor inside the collective) or
+                # a live dest is reporting a failed fabric plan.  Both
+                # mean the lockstep is no longer trustworthy: the rest of
+                # the run rides the host path.
+                log.error("re-announce under spmd fabric; disabling the "
+                          "device plane for the rest of the run",
+                          node=msg.src_id)
+                self._fabric_disabled = True
             self._maybe_finish()
             with self._lock:
                 finished = self._startup_sent
@@ -393,12 +410,21 @@ class LeaderNode:
         if self.fabric is None or self.placement is None:
             log.error("device plan but no fabric wired", plan=msg.plan_id)
             return
+        if getattr(self.fabric, "kind", "") == "spmd":
+            # Multi-controller lockstep: the leader's process enters every
+            # collective too (seeder or not).
+            try:
+                self.fabric.submit(msg)
+            except Exception as e:  # noqa: BLE001
+                log.error("spmd fabric submit failed", plan=msg.plan_id,
+                          err=repr(e))
+            return
         contribute_device_plan(self.node, self.layers, self._lock,
                                self.fabric, self.placement, msg)
 
     def _fabric_ok(
         self, layer_id: LayerID, layout: List[Tuple[NodeID, int, int]],
-        dest: NodeID,
+        dest: NodeID, total: int = -1,
     ) -> bool:
         """Whether one scheduled transfer can ride the device fabric:
         fabric + placement wired, every participant mapped to a stage, and
@@ -407,6 +433,22 @@ class LeaderNode:
         unlocked, matching the other scheduler-side reads."""
         if self.fabric is None or self.placement is None:
             return False
+        if self._fabric_disabled:
+            # SPMD lockstep needs every process alive; after a declared
+            # crash the remaining transfers ride the host path.
+            return False
+        if getattr(self.fabric, "kind", "") == "spmd" and total >= 0:
+            # The SPMD collective reassembles the WHOLE layer from the
+            # plan alone — it has no dest-side coverage seeding, so a
+            # resumed dest's gaps-only layout (mode-3 checkpoint resume)
+            # must ride the host path, not livelock the fabric.
+            pos = 0
+            for _, off, size in sorted(layout, key=lambda t: t[1]):
+                if off != pos:
+                    return False
+                pos += size
+            if pos != total:
+                return False
         if dest == self.node.my_id or dest not in self.placement.node_to_stage:
             return False
         for sender, _, _ in layout:
@@ -427,9 +469,11 @@ class LeaderNode:
         deliver over the host path instead (liveness: an incomplete plan
         would strand the dest waiting on contributions that never come,
         or pin seeders' uploads that nobody collects)."""
-        plan_id = f"{layer_id}.{dest}.{next(self._plan_seq)}"
+        seq = next(self._plan_seq)
+        plan_id = f"{layer_id}.{dest}.{seq}"
+        spmd = getattr(self.fabric, "kind", "") == "spmd"
         msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
-                            total, list(layout))
+                            total, list(layout), seq=seq if spmd else -1)
         with self._lock:
             active = not self._startup_sent
         if active:
@@ -437,31 +481,70 @@ class LeaderNode:
             # re-armed update()): upload retention may re-arm — the next
             # startup will release again.
             reopen_upload_cache()
+        if spmd:
+            ok = self._broadcast_spmd_plan(msg)
+        else:
+            ok = self._send_inproc_plan(msg)
+        if ok:
+            log.info("dispatching device plan", plan=plan_id, layer=layer_id,
+                     dest=dest, senders=sorted({s for s, _, _ in layout}),
+                     total_bytes=total, spmd=spmd)
+        return ok
+
+    def _send_inproc_plan(self, msg: DevicePlanMsg) -> bool:
         # Dest first: if the dest never learns of the plan, abort before
         # any seeder uploads a contribution nobody will collect.
         try:
-            self.node.transport.send(dest, msg)
+            self.node.transport.send(msg.dest_id, msg)
         except (OSError, KeyError) as e:
             log.error("couldn't send device plan to dest; host path",
-                      plan=plan_id, dest=dest, err=repr(e))
+                      plan=msg.plan_id, dest=msg.dest_id, err=repr(e))
             return False
         ok = True
-        for participant in sorted({s for s, _, _ in layout} - {dest}):
+        for participant in sorted(
+            {s for s, _, _ in msg.layout} - {msg.dest_id}
+        ):
             try:
                 self.node.transport.send(participant, msg)
             except (OSError, KeyError) as e:
                 log.error("couldn't send device plan to seeder; host path",
-                          plan=plan_id, dest=participant, err=repr(e))
+                          plan=msg.plan_id, dest=participant, err=repr(e))
                 ok = False
-        if not ok:
-            # The dest's collect for this plan will time out and discard
-            # any partial contributions; the host-path duplicate delivery
-            # is tolerated by every receiver.
-            return False
-        log.info("dispatching device plan", plan=plan_id, layer=layer_id,
-                 dest=dest, senders=sorted({s for s, _, _ in layout}),
-                 total_bytes=total)
-        return True
+        # On partial failure the dest's collect for this plan will time
+        # out and discard any partial contributions; the host-path
+        # duplicate delivery is tolerated by every receiver.
+        return ok
+
+    def _broadcast_spmd_plan(self, msg: DevicePlanMsg) -> bool:
+        """SPMD lockstep: EVERY process (self included, via the transport
+        self-delivery short-circuit) must receive every plan — all of them
+        enter the collective.  On any send failure the seq must still be
+        consumed everywhere, so a best-effort CANCELLATION (empty layout,
+        same seq) follows; a process missing both stalls the fabric and
+        logs loudly (``parallel/spmd_fabric.py``)."""
+        with self._lock:
+            recipients = sorted(set(self.status)
+                                | {msg.dest_id, self.node.my_id})
+        failed = []
+        for r in recipients:
+            try:
+                self.node.transport.send(r, msg)
+            except (OSError, KeyError) as e:
+                log.error("couldn't send spmd plan; cancelling seq",
+                          plan=msg.plan_id, dest=r, err=repr(e))
+                failed.append(r)
+        if not failed:
+            return True
+        cancel = DevicePlanMsg(self.node.my_id, msg.plan_id, msg.layer_id,
+                               msg.dest_id, 0, [], seq=msg.seq)
+        for r in recipients:
+            try:
+                self.node.transport.send(r, cancel)
+            except (OSError, KeyError) as e:
+                log.error("spmd plan cancel undeliverable; fabric may "
+                          "stall until the node is declared crashed",
+                          plan=msg.plan_id, dest=r, err=repr(e))
+        return False
 
     def _try_fabric_full_layer(
         self, layer_id: LayerID, sender: NodeID, dest: NodeID
@@ -476,7 +559,7 @@ class LeaderNode:
         if size <= 0:
             return False
         layout = [(sender, 0, size)]
-        if not self._fabric_ok(layer_id, layout, dest):
+        if not self._fabric_ok(layer_id, layout, dest, size):
             return False
         return self._dispatch_device_plan(layer_id, dest, layout, size)
 
@@ -558,6 +641,15 @@ class LeaderNode:
         if node_id == self.node.my_id:
             log.error("refusing to declare self crashed")
             return
+        if getattr(self.fabric, "kind", "") == "spmd":
+            # Every process must enter every collective; one is gone, so
+            # remaining transfers take the host path.  Already-queued
+            # plans referencing the dead node stall their executors — the
+            # dests' plan waits time out and re-plan over TCP.
+            log.error("node crashed under spmd fabric; disabling the "
+                      "device plane for the rest of the run",
+                      node=node_id)
+            self._fabric_disabled = True
         self.detector.forget(node_id)
         with self._lock:
             self.status.pop(node_id, None)
@@ -1159,7 +1251,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             )
             with self._lock:
                 total = self._layer_size_locked(layer_id)
-            if (total > 0 and self._fabric_ok(layer_id, layout, dest)
+            if (total > 0 and self._fabric_ok(layer_id, layout, dest, total)
                     and self._dispatch_device_plan(layer_id, dest, layout,
                                                    total)):
                 continue

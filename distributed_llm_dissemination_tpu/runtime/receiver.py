@@ -129,6 +129,10 @@ class ReceiverNode:
             self._mover = WeightMover(dtype=_np.uint8)
         self._ready_q: "queue.Queue[object]" = queue.Queue()
         self._lock = threading.Lock()
+        if fabric is not None and hasattr(fabric, "bind_store"):
+            # SPMD fabric: the executor reads this node's own byte ranges
+            # straight from the layer store when serving plans.
+            fabric.bind_store(self.layers, self._lock)
         # layer -> Event: staging-in-progress marker so a re-plan duplicate
         # completing concurrently never double-stages a multi-GB layer
         # (check-and-mark happens under self._lock; the duplicate waits).
@@ -287,6 +291,9 @@ class ReceiverNode:
         if self.fabric is None or self.placement is None:
             log.error("device plan but no fabric wired", plan=msg.plan_id)
             return
+        if getattr(self.fabric, "kind", "") == "spmd":
+            self._handle_spmd_plan(msg)
+            return
         # Opportunistic GC: plans whose dest died before collecting would
         # otherwise pin full-layer device buffers forever.
         self.fabric.gc()
@@ -296,6 +303,52 @@ class ReceiverNode:
             threading.Thread(
                 target=self._receive_device_plan, args=(msg,), daemon=True
             ).start()
+
+    def _handle_spmd_plan(self, msg: DevicePlanMsg) -> None:
+        """Multi-controller fabric (``parallel/spmd_fabric.py``): enqueue
+        the plan on this process's lockstep executor; when it is addressed
+        to me, await the collective's result on a dedicated thread (the
+        handler pool must stay free to enqueue later plans — the executor
+        can only reach mine after running everything before it)."""
+        try:
+            res = self.fabric.submit(msg)
+        except Exception as e:  # noqa: BLE001 — closed/duplicate races
+            log.error("spmd fabric submit failed", plan=msg.plan_id,
+                      err=repr(e))
+            if msg.dest_id == self.node.my_id and msg.layout:
+                self._request_replan()
+            return
+        if msg.dest_id != self.node.my_id or not msg.layout:
+            return
+        threading.Thread(
+            target=self._await_spmd_plan, args=(msg, res), daemon=True
+        ).start()
+
+    def _await_spmd_plan(self, msg: DevicePlanMsg, res) -> None:
+        from ..parallel.spmd_fabric import PLAN_WAIT_S, PlanFailed
+
+        try:
+            arr = res.get(PLAN_WAIT_S)
+        except PlanFailed as e:
+            log.error("spmd fabric plan failed for dest; requesting "
+                      "re-plan", plan=msg.plan_id, layerID=msg.layer_id,
+                      err=repr(e))
+            self._request_replan()
+            return
+        if arr is None:
+            log.error("spmd fabric plan yielded no layer; requesting "
+                      "re-plan", plan=msg.plan_id, layerID=msg.layer_id)
+            self._request_replan()
+            return
+        self._fabric_store(msg.layer_id, msg.total_size, device_arr=arr)
+        # A duplicate plan for an already-held layer no-ops in the store:
+        # ack whatever location the layer ACTUALLY has (a host-path copy
+        # stays INMEM; claiming HBM would corrupt the leader's status).
+        with self._lock:
+            loc = self.layers[msg.layer_id].meta.location
+        log.info("layer landed over device fabric", layerID=msg.layer_id,
+                 plan=msg.plan_id, total_bytes=msg.total_size, spmd=True)
+        self._send_ack(msg.layer_id, loc)
 
     def _local_coverage(self, layer_id):
         """Byte ranges of an in-progress layer this node already holds

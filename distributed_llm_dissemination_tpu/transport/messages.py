@@ -332,6 +332,14 @@ class DevicePlanMsg:
     dest_id: NodeID
     total_size: int
     layout: list  # [(sender_id, offset, size), ...]
+    # Global plan order for the multi-controller SPMD fabric
+    # (parallel/spmd_fabric.py): every process must enter the same
+    # collective programs in the same order, so plans execute strictly by
+    # seq.  An EMPTY layout with a seq is a cancellation — the leader
+    # aborted dispatch mid-way and every process must advance past the
+    # seq without entering a collective.  -1 = unordered (the in-process
+    # FabricPlane ignores it).
+    seq: int = -1
 
     msg_type = MsgType.DEVICE_PLAN
 
@@ -343,6 +351,7 @@ class DevicePlanMsg:
             "DestID": self.dest_id,
             "TotalSize": self.total_size,
             "Layout": [[int(s), int(o), int(z)] for s, o, z in self.layout],
+            "Seq": self.seq,
         }
 
     @classmethod
@@ -354,6 +363,7 @@ class DevicePlanMsg:
             int(d["DestID"]),
             int(d.get("TotalSize", 0)),
             [(int(s), int(o), int(z)) for s, o, z in d.get("Layout") or []],
+            int(d.get("Seq", -1)),
         )
 
 

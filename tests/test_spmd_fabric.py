@@ -1,0 +1,299 @@
+"""Multi-controller SPMD fabric (parallel/spmd_fabric.py).
+
+Units cover the lockstep executor (seq ordering, cancellation override,
+deterministic slot assignment) with a stubbed collective; the e2e tests
+run TWO real OS processes through the real CLI — one JAX runtime via
+jax.distributed, layer bytes as collectives, zero layer bytes on TCP.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core import config as cfg
+from distributed_llm_dissemination_tpu.parallel.mesh import (
+    fabric_placement,
+    make_mesh,
+)
+from distributed_llm_dissemination_tpu.parallel.spmd_fabric import (
+    PlanFailed,
+    SpmdFabric,
+)
+from distributed_llm_dissemination_tpu.transport.messages import DevicePlanMsg
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _plan(seq, layout, plan_id=None, dest=1, layer=0, total=None):
+    total = sum(s for _, _, s in layout) if total is None else total
+    return DevicePlanMsg(0, plan_id or f"{layer}.{dest}.{seq}", layer, dest,
+                         total, layout, seq=seq)
+
+
+@pytest.fixture
+def placement(cpu_devices):
+    mesh = make_mesh((2, 4), ("nodes", "tp"))
+    return fabric_placement([0, 1], {1: {0: None}}, mesh, "nodes")
+
+
+def test_slot_assignment_puts_ranges_on_sender_stage(placement):
+    fab = SpmdFabric(placement, my_node=0)
+    try:
+        sizes, order, by_rank = fab._slot_assignment(
+            [(1, 100, 50), (0, 0, 100)]
+        )
+        # The assignee (node 1) owns stage 0 = ranks 0-3; the extra
+        # (node 0) fills stage 1 = ranks 4-7.  Offset order: node 0's
+        # range first (rank 4), then node 1's (rank 0).
+        assert order == (4, 0)
+        assert sizes[4] == 100 and sizes[0] == 50
+        assert sum(sizes) == 150
+        assert by_rank[4][0] == 0 and by_rank[0][0] == 1
+    finally:
+        fab.close()
+
+
+def test_slot_assignment_round_robins_within_stage(placement):
+    fab = SpmdFabric(placement, my_node=0)
+    try:
+        sizes, order, _ = fab._slot_assignment(
+            [(0, 0, 10), (0, 10, 10), (0, 20, 10)]
+        )
+        assert order == (4, 5, 6)  # node 0's stage is ranks 4-7
+        # A 5th range from a 4-device stage must fail deterministically.
+        with pytest.raises(PlanFailed, match="more ranges"):
+            fab._slot_assignment([(0, i * 10, 10) for i in range(5)])
+    finally:
+        fab.close()
+
+
+def test_executor_runs_plans_in_seq_order(placement, monkeypatch):
+    fab = SpmdFabric(placement, my_node=0)
+    ran = []
+    monkeypatch.setattr(
+        fab, "_execute", lambda msg: ran.append(msg.seq) or f"v{msg.seq}"
+    )
+    try:
+        # Submit out of order: 2, 0, 1.
+        r2 = fab.submit(_plan(2, [(0, 0, 4)]))
+        r0 = fab.submit(_plan(0, [(0, 0, 4)]))
+        r1 = fab.submit(_plan(1, [(0, 0, 4)]))
+        assert r0.get(10.0) == "v0"
+        assert r1.get(10.0) == "v1"
+        assert r2.get(10.0) == "v2"
+        assert ran == [0, 1, 2]
+    finally:
+        fab.close()
+
+
+def test_cancellation_overrides_pending_plan(placement, monkeypatch):
+    fab = SpmdFabric(placement, my_node=0)
+    ran = []
+    real_execute = fab._execute
+    monkeypatch.setattr(
+        fab, "_execute",
+        lambda msg: ran.append((msg.seq, len(msg.layout)))
+        or real_execute(msg) if not msg.layout else None,
+    )
+    try:
+        # seq 1 arrives first (queued behind the gap), then its cancel,
+        # then seq 0: the executor must run 0, then the CANCELLED 1.
+        fab.submit(_plan(1, [(0, 0, 4)], plan_id="p1"))
+        fab.submit(_plan(1, [], plan_id="p1"))
+        r0 = fab.submit(_plan(0, [], plan_id="p0"))
+        assert r0.get(10.0) is None
+        deadline = time.monotonic() + 10
+        while len(ran) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ran == [(0, 0), (1, 0)]
+    finally:
+        fab.close()
+
+
+def test_duplicate_submit_returns_same_handle(placement, monkeypatch):
+    fab = SpmdFabric(placement, my_node=0)
+    monkeypatch.setattr(fab, "_execute", lambda msg: "x")
+    try:
+        a = fab.submit(_plan(0, [(0, 0, 4)], plan_id="p"))
+        b = fab.submit(_plan(0, [(0, 0, 4)], plan_id="p"))
+        assert a is b
+        assert a.get(10.0) == "x"
+        # A late duplicate after execution gets the settled handle.
+        c = fab.submit(_plan(0, [(0, 0, 4)], plan_id="p"))
+        assert c.get(0.1) == "x"
+    finally:
+        fab.close()
+
+
+def test_layout_total_mismatch_fails_the_plan(placement):
+    fab = SpmdFabric(placement, my_node=0)
+    try:
+        res = fab.submit(_plan(0, [(0, 0, 8)], total=16))
+        with pytest.raises(PlanFailed, match="plan says 16"):
+            res.get(10.0)
+    finally:
+        fab.close()
+
+
+# ---------------------------------------------------------- 2-process e2e
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spmd_conf(mode, layers=2, size=262144):
+    p0, p1 = _free_port(), _free_port()
+    return {
+        "Nodes": [
+            {"Id": 0, "Addr": f"127.0.0.1:{p0}", "IsLeader": True,
+             "NetworkBW": 12500000000, "Sources": {"2": 0},
+             "InitialLayers": {"2": {str(i): {"LayerSize": size}
+                                     for i in range(layers)}}},
+            {"Id": 1, "Addr": f"127.0.0.1:{p1}",
+             "NetworkBW": 12500000000, "Sources": {"2": 0},
+             "InitialLayers": {}},
+        ],
+        "Assignment": {"1": {str(i): {} for i in range(layers)}},
+        "LayerSize": size,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [2],
+                 "PipelineAxis": "nodes", "Fabric": True},
+        "Distributed": {"Coordinator": f"127.0.0.1:{_free_port()}",
+                        "CpuCollectives": "gloo"},
+    }
+
+
+def _run_two_process(conf_json, mode):
+    conf_path = os.path.join(REPO, f".pytest-spmd-{mode}.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf_json, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per process
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", str(mode)]
+    try:
+        recv = subprocess.Popen(cli + ["-id", "1"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env, text=True)
+        lead = subprocess.Popen(cli + ["-id", "0"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env, text=True)
+        try:
+            lead_out, lead_err = lead.communicate(timeout=240)
+            recv_out, recv_err = recv.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            lead.kill()
+            recv.kill()
+            raise
+        return (lead.returncode, lead_out, lead_err,
+                recv.returncode, recv_out, recv_err)
+    finally:
+        for p in (locals().get("recv"), locals().get("lead")):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if os.path.exists(conf_path):
+            os.remove(conf_path)
+
+
+@pytest.mark.parametrize("mode", [0, 3])
+def test_two_process_spmd_fabric_dissemination(mode):
+    """Layer bytes move between two real OS processes as collectives over
+    the shared JAX runtime; the TCP transport carries control only."""
+    rc0, lead_out, lead_err, rc1, recv_out, recv_err = _run_two_process(
+        _spmd_conf(mode), mode
+    )
+    assert rc0 == 0, f"leader failed:\n{lead_err[-3000:]}"
+    assert rc1 == 0, f"receiver failed:\n{recv_err[-3000:]}"
+    assert "Time to deliver" in lead_out
+    assert "ready" in recv_out
+    # The layers landed over the SPMD fabric, on the receiver's device.
+    assert "layer landed over device fabric" in recv_err
+    assert '"spmd": true' in recv_err
+    # Zero layer bytes on the wire: the TCP data plane never ran.
+    assert "layer received" not in recv_err
+    assert "dispatching device plan" in lead_err
+
+
+# ------------------------------------------------- leader gating (units)
+
+
+class _FakeSpmdFabric:
+    kind = "spmd"
+
+    def bind_store(self, layers, lock):
+        pass
+
+
+class _FakePlacement:
+    def __init__(self, nodes):
+        self.node_to_stage = {n: i for i, n in enumerate(nodes)}
+
+
+def _leader_with_spmd(nodes=(0, 1, 2)):
+    from distributed_llm_dissemination_tpu.core.types import (
+        LayerLocation,
+        LayerMeta,
+    )
+    from distributed_llm_dissemination_tpu.runtime import LeaderNode, Node
+    from distributed_llm_dissemination_tpu.transport import (
+        InmemTransport,
+        reset_registry,
+    )
+
+    reset_registry()
+    t = InmemTransport("0")
+    leader = LeaderNode(Node(0, 0, t), {}, {1: {0: LayerMeta()}},
+                        start_loop=False, fabric=_FakeSpmdFabric(),
+                        placement=_FakePlacement(nodes))
+    for n in nodes[1:]:
+        leader.status[n] = {
+            0: LayerMeta(location=LayerLocation.INMEM, data_size=100)
+        }
+    return leader, t
+
+
+def test_fabric_ok_rejects_gaps_only_layout_under_spmd():
+    # A resumed dest's plan covers only its gaps; the SPMD collective
+    # rebuilds the WHOLE layer from the plan, so such a transfer must
+    # ride the host path (not livelock on a deterministic PlanFailed).
+    leader, t = _leader_with_spmd()
+    try:
+        assert leader._fabric_ok(0, [(1, 0, 100)], 2, 100)
+        assert not leader._fabric_ok(0, [(1, 40, 60)], 2, 100)  # gap at 0
+        assert not leader._fabric_ok(0, [(1, 0, 60)], 2, 100)  # short tail
+        assert not leader._fabric_ok(
+            0, [(1, 0, 30), (1, 50, 50)], 2, 100  # hole in the middle
+        )
+        # Without a total (legacy call shape) the tiling check is skipped.
+        assert leader._fabric_ok(0, [(1, 40, 60)], 2)
+    finally:
+        leader.close()
+        t.close()
+
+
+def test_reannounce_disables_spmd_fabric():
+    # A restarted process has a fresh executor (seq 0) and may be outside
+    # the jax.distributed runtime: one more fabric plan would hang every
+    # survivor inside the collective.  Any re-announce flips to host path.
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AnnounceMsg,
+    )
+
+    leader, t = _leader_with_spmd()
+    try:
+        leader._started = True
+        assert not leader._fabric_disabled
+        leader.handle_announce(AnnounceMsg(1, {}))
+        assert leader._fabric_disabled
+        assert not leader._fabric_ok(0, [(1, 0, 100)], 2, 100)
+    finally:
+        leader.close()
+        t.close()
