@@ -152,6 +152,41 @@ def run_once_pod(conf_path: str, mode: int, timeout: float = 240.0) -> float:
     return float(m.group(1))
 
 
+def _spmd_config(out_path: str, scale: int) -> None:
+    """A 2-process multi-controller SPMD fabric topology (leader seeds,
+    node 1 assigned): one OS process per node, one jax.distributed
+    runtime, layer bytes as lockstep collectives
+    (``parallel/spmd_fabric.py``)."""
+    layers = 3
+    conf = {
+        "Nodes": [
+            {"Id": 0, "Addr": f"127.0.0.1:{_free_port()}", "IsLeader": True,
+             "NetworkBW": 12500000000, "Sources": {"2": 0},
+             "InitialLayers": {"2": {str(i): {"LayerSize": scale}
+                                     for i in range(layers)}}},
+            {"Id": 1, "Addr": f"127.0.0.1:{_free_port()}",
+             "NetworkBW": 12500000000, "Sources": {"2": 0},
+             "InitialLayers": {}},
+        ],
+        "Assignment": {"1": {str(i): {} for i in range(layers)}},
+        "LayerSize": scale,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [2],
+                 "PipelineAxis": "nodes", "Fabric": True},
+        "Distributed": {"Coordinator": f"127.0.0.1:{_free_port()}",
+                        "CpuCollectives": "gloo"},
+    }
+    with open(out_path, "w") as f:
+        json.dump(conf, f)
+
+
+def run_once_spmd(conf_path: str, mode: int, timeout: float = 240.0) -> float:
+    """One dissemination over the multi-controller SPMD fabric: the REAL
+    per-node CLI, one OS process per node, collectives over gloo."""
+    env = _cpu_env()
+    env.pop("XLA_FLAGS", None)  # one device per process
+    return run_once(conf_path, mode, timeout, env=env)
+
+
 def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
                timeout: float = 240.0) -> dict:
     with tempfile.TemporaryDirectory() as td:
@@ -163,10 +198,13 @@ def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
         fabric = os.path.join(td, "pod_fabric_4node.json")
         _localize_config(os.path.join(CONF_DIR, "pod_fabric_4node.json"),
                          fabric, scale_to=scale)
+        spmd = os.path.join(td, "spmd_2proc.json")
+        _spmd_config(spmd, scale)
         scenarios = {
             "local_4node": (local4, run_once),
             f"reference_8node@{scale >> 20}MiB": (scaled, run_once),
             f"pod_fabric_4node@{scale >> 20}MiB": (fabric, run_once_pod),
+            f"spmd_fabric_2proc@{scale >> 20}MiB": (spmd, run_once_spmd),
         }
         results: dict = {"scenarios": {}, "scale_bytes": scale,
                          "trials": trials}
@@ -274,7 +312,11 @@ def to_markdown(results: dict) -> str:
         f"{results['trials']} runs). TCP scenarios run the real CLI over "
         "loopback, one process per node; the pod_fabric scenario runs "
         "cli.podrun on a virtual 8-device mesh with layer bytes on the "
-        "device plane (zero TCP layer bytes). North-star secondary "
+        "device plane (zero TCP layer bytes); the spmd_fabric scenario "
+        "runs the per-node CLI as TWO real OS processes joined into one "
+        "jax.distributed runtime, layer bytes as lockstep collectives "
+        "(gloo on CPU — the absolute number is dominated by per-plan "
+        "compile+collective latency, not bandwidth). North-star secondary "
         "target: mode 1 ≈ mode 0 — note that at loopback-scaled layer "
         "sizes fixed per-transfer overhead (connection setup, protocol "
         "round-trips) dominates both numbers, so ratios within ~1.5x "

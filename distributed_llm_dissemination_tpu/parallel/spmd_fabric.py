@@ -84,6 +84,18 @@ class SpmdFabric:
     kind = "spmd"
 
     def __init__(self, placement, my_node: int, gap_timeout: float = 60.0):
+        stages = list(placement.node_to_stage.values())
+        if len(set(stages)) != len(stages):
+            # Two nodes (= two processes) sharing a stage means some
+            # node's byte ranges would sit on another process's devices:
+            # that process can't fill them, and the owner would raise
+            # mid-lockstep while peers hang in the collective.  Refuse
+            # deterministically at startup on EVERY process instead.
+            raise ValueError(
+                "spmd fabric needs one stage per node (one stage == one "
+                f"host); got node_to_stage={placement.node_to_stage} — "
+                "size the mesh pipeline axis to the node count"
+            )
         self.placement = placement
         self.my_node = my_node
         self.gap_timeout = gap_timeout
@@ -142,6 +154,23 @@ class SpmdFabric:
             self._pending[msg.seq] = msg
             self._cond.notify_all()
         return res
+
+    def wait_result(self, res: _Result, base_timeout: float = PLAN_WAIT_S):
+        """Dest-side wait that tolerates a deep queue: a fixed wall clock
+        would spuriously fail a late-seq plan during a healthy large
+        startup (k earlier plans each pay compile + upload + a pod-wide
+        collective).  The timeout only counts windows WITHOUT progress —
+        as long as the executor keeps retiring seqs, keep waiting."""
+        while True:
+            with self._lock:
+                seen = self._next_seq
+            try:
+                return res.get(base_timeout)
+            except PlanFailed:
+                with self._lock:
+                    progressed = self._next_seq > seen
+                if not progressed:
+                    raise
 
     def close(self) -> None:
         with self._cond:

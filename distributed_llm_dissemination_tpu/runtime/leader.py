@@ -126,6 +126,8 @@ class LeaderNode:
         self.expected_nodes = set(expected_nodes or ())
         self.status: Status = {}
         self._lock = threading.Lock()
+        # Multi-controller lockstep fabric?  Fixed at construction.
+        self._spmd = getattr(fabric, "kind", "") == "spmd"
         # SPMD fabric: declared crashes break pod-wide lockstep, so later
         # transfers fall back to the host path (_fabric_ok).
         self._fabric_disabled = False
@@ -303,8 +305,7 @@ class LeaderNode:
             return
         if reannounce:
             log.info("node re-announced; re-planning", node=msg.src_id)
-            if (getattr(self.fabric, "kind", "") == "spmd"
-                    and not self._fabric_disabled):
+            if self._spmd and not self._fabric_disabled:
                 # Either the process restarted (fresh executor at seq 0,
                 # possibly outside the jax.distributed runtime — a fabric
                 # plan would hang every survivor inside the collective) or
@@ -410,7 +411,7 @@ class LeaderNode:
         if self.fabric is None or self.placement is None:
             log.error("device plan but no fabric wired", plan=msg.plan_id)
             return
-        if getattr(self.fabric, "kind", "") == "spmd":
+        if self._spmd:
             # Multi-controller lockstep: the leader's process enters every
             # collective too (seeder or not).
             try:
@@ -424,7 +425,7 @@ class LeaderNode:
 
     def _fabric_ok(
         self, layer_id: LayerID, layout: List[Tuple[NodeID, int, int]],
-        dest: NodeID, total: int = -1,
+        dest: NodeID, total: int,
     ) -> bool:
         """Whether one scheduled transfer can ride the device fabric:
         fabric + placement wired, every participant mapped to a stage, and
@@ -437,7 +438,7 @@ class LeaderNode:
             # SPMD lockstep needs every process alive; after a declared
             # crash the remaining transfers ride the host path.
             return False
-        if getattr(self.fabric, "kind", "") == "spmd" and total >= 0:
+        if self._spmd:
             # The SPMD collective reassembles the WHOLE layer from the
             # plan alone — it has no dest-side coverage seeding, so a
             # resumed dest's gaps-only layout (mode-3 checkpoint resume)
@@ -449,6 +450,19 @@ class LeaderNode:
                 pos += size
             if pos != total:
                 return False
+            # Each sender's ranges must fit its stage's device slots:
+            # the executor would otherwise raise deterministically on
+            # every process and the dest's recovery re-announce would
+            # disable the fabric for the whole run — reject the one
+            # transfer here instead.
+            ranges_per: Dict[NodeID, int] = {}
+            for sender, _, _ in layout:
+                ranges_per[sender] = ranges_per.get(sender, 0) + 1
+            for sender, count in ranges_per.items():
+                if sender not in self.placement.node_to_stage:
+                    return False
+                if count > len(self.placement.devices_for_node(sender)):
+                    return False
         if dest == self.node.my_id or dest not in self.placement.node_to_stage:
             return False
         for sender, _, _ in layout:
@@ -471,7 +485,7 @@ class LeaderNode:
         or pin seeders' uploads that nobody collects)."""
         seq = next(self._plan_seq)
         plan_id = f"{layer_id}.{dest}.{seq}"
-        spmd = getattr(self.fabric, "kind", "") == "spmd"
+        spmd = self._spmd
         msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
                             total, list(layout), seq=seq if spmd else -1)
         with self._lock:
@@ -641,7 +655,7 @@ class LeaderNode:
         if node_id == self.node.my_id:
             log.error("refusing to declare self crashed")
             return
-        if getattr(self.fabric, "kind", "") == "spmd":
+        if self._spmd:
             # Every process must enter every collective; one is gone, so
             # remaining transfers take the host path.  Already-queued
             # plans referencing the dead node stall their executors — the

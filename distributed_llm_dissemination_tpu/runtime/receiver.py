@@ -129,6 +129,7 @@ class ReceiverNode:
             self._mover = WeightMover(dtype=_np.uint8)
         self._ready_q: "queue.Queue[object]" = queue.Queue()
         self._lock = threading.Lock()
+        self._spmd = getattr(fabric, "kind", "") == "spmd"
         if fabric is not None and hasattr(fabric, "bind_store"):
             # SPMD fabric: the executor reads this node's own byte ranges
             # straight from the layer store when serving plans.
@@ -291,7 +292,7 @@ class ReceiverNode:
         if self.fabric is None or self.placement is None:
             log.error("device plan but no fabric wired", plan=msg.plan_id)
             return
-        if getattr(self.fabric, "kind", "") == "spmd":
+        if self._spmd:
             self._handle_spmd_plan(msg)
             return
         # Opportunistic GC: plans whose dest died before collecting would
@@ -325,10 +326,12 @@ class ReceiverNode:
         ).start()
 
     def _await_spmd_plan(self, msg: DevicePlanMsg, res) -> None:
-        from ..parallel.spmd_fabric import PLAN_WAIT_S, PlanFailed
+        from ..parallel.spmd_fabric import PlanFailed
 
         try:
-            arr = res.get(PLAN_WAIT_S)
+            # Progress-aware: a deep plan queue (large startup) extends
+            # the wait as long as the executor keeps retiring seqs.
+            arr = self.fabric.wait_result(res)
         except PlanFailed as e:
             log.error("spmd fabric plan failed for dest; requesting "
                       "re-plan", plan=msg.plan_id, layerID=msg.layer_id,

@@ -233,8 +233,12 @@ class _FakeSpmdFabric:
 
 
 class _FakePlacement:
-    def __init__(self, nodes):
+    def __init__(self, nodes, per_stage=4):
         self.node_to_stage = {n: i for i, n in enumerate(nodes)}
+        self._per_stage = per_stage
+
+    def devices_for_node(self, node):
+        return [object()] * self._per_stage
 
 
 def _leader_with_spmd(nodes=(0, 1, 2)):
@@ -272,8 +276,13 @@ def test_fabric_ok_rejects_gaps_only_layout_under_spmd():
         assert not leader._fabric_ok(
             0, [(1, 0, 30), (1, 50, 50)], 2, 100  # hole in the middle
         )
-        # Without a total (legacy call shape) the tiling check is skipped.
-        assert leader._fabric_ok(0, [(1, 40, 60)], 2)
+        # A sender with more ranges than its stage has device slots would
+        # fail deterministically in every executor: host path instead.
+        five = [(1, i * 20, 20) for i in range(5)]
+        assert not leader._fabric_ok(0, five, 2, 100)
+        # total is REQUIRED — a legacy call must not skip the checks.
+        with pytest.raises(TypeError):
+            leader._fabric_ok(0, [(1, 40, 60)], 2)
     finally:
         leader.close()
         t.close()
