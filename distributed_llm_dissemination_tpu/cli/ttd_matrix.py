@@ -152,13 +152,14 @@ def run_once_pod(conf_path: str, mode: int, timeout: float = 240.0) -> float:
     return float(m.group(1))
 
 
-def _spmd_config(out_path: str, scale: int) -> None:
+def spmd_two_proc_config(scale: int, layers: int = 3) -> dict:
     """A 2-process multi-controller SPMD fabric topology (leader seeds,
     node 1 assigned): one OS process per node, one jax.distributed
     runtime, layer bytes as lockstep collectives
-    (``parallel/spmd_fabric.py``)."""
-    layers = 3
-    conf = {
+    (``parallel/spmd_fabric.py``).  Free loopback ports are assigned
+    here.  THE shared builder: the recorded matrix row and the 2-process
+    e2e tests (tests/test_spmd_fabric.py) exercise the same topology."""
+    return {
         "Nodes": [
             {"Id": 0, "Addr": f"127.0.0.1:{_free_port()}", "IsLeader": True,
              "NetworkBW": 12500000000, "Sources": {"2": 0},
@@ -175,8 +176,11 @@ def _spmd_config(out_path: str, scale: int) -> None:
         "Distributed": {"Coordinator": f"127.0.0.1:{_free_port()}",
                         "CpuCollectives": "gloo"},
     }
+
+
+def _spmd_config(out_path: str, scale: int) -> None:
     with open(out_path, "w") as f:
-        json.dump(conf, f)
+        json.dump(spmd_two_proc_config(scale), f)
 
 
 def run_once_spmd(conf_path: str, mode: int, timeout: float = 240.0) -> float:

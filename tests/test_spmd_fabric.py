@@ -150,24 +150,12 @@ def _free_port() -> int:
 
 
 def _spmd_conf(mode, layers=2, size=262144):
-    p0, p1 = _free_port(), _free_port()
-    return {
-        "Nodes": [
-            {"Id": 0, "Addr": f"127.0.0.1:{p0}", "IsLeader": True,
-             "NetworkBW": 12500000000, "Sources": {"2": 0},
-             "InitialLayers": {"2": {str(i): {"LayerSize": size}
-                                     for i in range(layers)}}},
-            {"Id": 1, "Addr": f"127.0.0.1:{p1}",
-             "NetworkBW": 12500000000, "Sources": {"2": 0},
-             "InitialLayers": {}},
-        ],
-        "Assignment": {"1": {str(i): {} for i in range(layers)}},
-        "LayerSize": size,
-        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [2],
-                 "PipelineAxis": "nodes", "Fabric": True},
-        "Distributed": {"Coordinator": f"127.0.0.1:{_free_port()}",
-                        "CpuCollectives": "gloo"},
-    }
+    # The same topology the recorded matrix row measures — one builder.
+    from distributed_llm_dissemination_tpu.cli.ttd_matrix import (
+        spmd_two_proc_config,
+    )
+
+    return spmd_two_proc_config(size, layers=layers)
 
 
 def _run_two_process(conf_json, mode):
@@ -220,6 +208,38 @@ def test_two_process_spmd_fabric_dissemination(mode):
     # Zero layer bytes on the wire: the TCP data plane never ran.
     assert "layer received" not in recv_err
     assert "dispatching device plan" in lead_err
+
+
+def test_two_process_spmd_int8_boot():
+    """Codec x SPMD x boot: int8 blobs cross two real OS processes as
+    collectives, and the dest boots the model from the HBM-landed bytes
+    with on-device dequantization."""
+    from distributed_llm_dissemination_tpu.models import quant
+    from distributed_llm_dissemination_tpu.models.llama import CONFIGS
+
+    mcfg = CONFIGS["tiny"]
+    conf = _spmd_conf(3, layers=0)
+    conf["Model"] = "tiny"
+    conf["ModelSeed"] = 0
+    conf["ModelCodec"] = "int8"
+    blob_ids = list(range(mcfg.n_layers + 1))
+    conf["Nodes"][0]["InitialLayers"] = {
+        "2": {str(b): {"LayerSize": quant.blob_nbytes_codec(mcfg, b, "int8")}
+              for b in blob_ids}
+    }
+    conf["Assignment"] = {"1": {str(b): {} for b in blob_ids}}
+    rc0, lead_out, lead_err, rc1, recv_out, recv_err = _run_two_process(
+        conf, 3
+    )
+    assert rc0 == 0, f"leader failed:\n{lead_err[-3000:]}"
+    assert rc1 == 0, f"receiver failed:\n{recv_err[-3000:]}"
+    assert "Time to deliver" in lead_out
+    assert "Time to first token" in lead_out
+    assert '"spmd": true' in recv_err
+    assert "layer received" not in recv_err  # zero TCP layer bytes
+    # The boot dequantized on-device from the fabric-landed blobs.
+    assert "device int8 dequant" in recv_err
+    assert '"kind": "full"' in recv_err
 
 
 # ------------------------------------------------- leader gating (units)
