@@ -8,7 +8,6 @@ jax.distributed, layer bytes as collectives, zero layer bytes on TCP.
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -143,12 +142,6 @@ def test_layout_total_mismatch_fails_the_plan(placement):
 # ---------------------------------------------------------- 2-process e2e
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def _spmd_conf(mode, layers=2, size=262144):
     # The same topology the recorded matrix row measures — one builder.
     from distributed_llm_dissemination_tpu.cli.ttd_matrix import (
@@ -158,8 +151,9 @@ def _spmd_conf(mode, layers=2, size=262144):
     return spmd_two_proc_config(size, layers=layers)
 
 
-def _run_two_process(conf_json, mode):
-    conf_path = os.path.join(REPO, f".pytest-spmd-{mode}.json")
+def _run_two_process(conf_json, mode, tag=""):
+    # Unique per (mode, tag): concurrent tests must not share the file.
+    conf_path = os.path.join(REPO, f".pytest-spmd-{mode}{tag}.json")
     with open(conf_path, "w") as f:
         json.dump(conf_json, f)
     env = dict(os.environ)
@@ -229,7 +223,7 @@ def test_two_process_spmd_int8_boot():
     }
     conf["Assignment"] = {"1": {str(b): {} for b in blob_ids}}
     rc0, lead_out, lead_err, rc1, recv_out, recv_err = _run_two_process(
-        conf, 3
+        conf, 3, tag="-int8"
     )
     assert rc0 == 0, f"leader failed:\n{lead_err[-3000:]}"
     assert rc1 == 0, f"receiver failed:\n{recv_err[-3000:]}"
