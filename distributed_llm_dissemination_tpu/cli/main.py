@@ -81,13 +81,23 @@ def boot_config(name: str):
         # "-boot none" opts a boot-capable topology (a Model section) out
         # of booting: dissemination-only runs, e.g. wire benchmarks.
         return None
+    from ..models import hf
+
+    if hf.is_hf(name):
+        # A Hugging Face Llama checkpoint directory (models/hf.py): the
+        # booted engine runs the actual checkpoint's weights.
+        try:
+            return hf.config_from_name(name)
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"bad hf checkpoint for -boot {name!r}: {e}")
     from ..models.llama import CONFIGS
 
     try:
         return CONFIGS[name]
     except KeyError:
         raise SystemExit(
-            f"unknown -boot model {name!r}; known: {sorted(CONFIGS)}, none"
+            f"unknown -boot model {name!r}; known: {sorted(CONFIGS)}, "
+            "none, hf:<checkpoint-dir>"
         )
 
 

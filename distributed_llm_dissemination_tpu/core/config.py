@@ -259,16 +259,23 @@ def create_layers(
     bytes; the blob's true size overrides the configured LayerSize."""
     blob_fn = None
     if model:
-        from ..models.llama import CONFIGS
+        from ..models import hf
         from ..models.quant import encode_blob
-        from ..models.serde import seeded_blob
 
-        mcfg = CONFIGS[model]
+        if hf.is_hf(model):
+            # Real weights: blobs come from the Hugging Face checkpoint
+            # the config names (models/hf.py), not a seeded init.
+            mcfg = hf.config_from_name(model)
+            raw_fn = lambda lid: hf.blob_from_name(model, lid)  # noqa: E731
+        else:
+            from ..models.llama import CONFIGS
+            from ..models.serde import seeded_blob
+
+            mcfg = CONFIGS[model]
+            raw_fn = lambda lid: seeded_blob(mcfg, lid, model_seed)  # noqa: E731
 
         def blob_fn(lid):
-            return encode_blob(
-                mcfg, lid, seeded_blob(mcfg, lid, model_seed), model_codec
-            )
+            return encode_blob(mcfg, lid, raw_fn(lid), model_codec)
     layers: LayersSrc = {}
     for source_type, by_layer in my_conf.initial_layers.items():
         for layer_id, size in by_layer.items():
