@@ -1,0 +1,170 @@
+"""Autoregressive decoding with a KV cache: the booted engine serves.
+
+The reference's startup hook gestures at "launching an inference engine"
+(``/root/reference/distributor/message.go:216-241``); ``runtime/boot.py``
+makes the hook assemble the model and produce logits.  This module is
+the serving half: a jitted, TPU-shaped decode loop —
+
+- **prefill**: one full-attention pass over the prompt that also writes
+  every layer's K/V into a preallocated cache (``lax.dynamic_update_
+  slice`` at static offsets);
+- **decode**: ``lax.scan`` over steps, each step attending the single
+  new query against the cache under a position mask (static shapes —
+  the cache is sized to ``prompt + max_new`` up front, so XLA compiles
+  ONE step program and reuses it every token).
+
+Greedy decoding is exact: ``tests/test_hf.py`` pins the generated token
+ids to the ``transformers`` implementation's ``generate`` on the same
+checkpoint.  Sampling takes a temperature + PRNG key.
+
+MoE configs are rejected (dense SwiGLU only — the dissemination-side
+MoE model is a training-path feature; extending the cache loop to
+routed experts is mechanical but untested, and silently wrong serving
+would be worse than a loud error).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import ModelConfig, dense_ffn, gqa_attention, rms_norm, rope
+
+KVCache = Dict[str, jax.Array]  # {"k","v"}: [n_layers, b, max_len, kvh, hd]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _layer_with_cache(
+    p: Dict[str, jax.Array], x, positions, k_cache, v_cache, cfg: ModelConfig,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One layer over ``x`` [b, s, d]: writes this block's K/V into the
+    cache at ``positions`` and attends against the WHOLE (masked) cache
+    — the same ``gqa_attention``/``dense_ffn`` kernels as the cache-less
+    forward, with the causal mask generalized to cache-row validity.
+    Returns (x_out, k_cache, v_cache)."""
+    b, s, d = x.shape
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    xn = rms_norm(x, p["ln1"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dq->bsq", xn, p["wq"]).reshape(b, s, h, hd)
+    k = jnp.einsum("bsd,dq->bsq", xn, p["wk"]).reshape(b, s, kv, hd)
+    v = jnp.einsum("bsd,dq->bsq", xn, p["wv"]).reshape(b, s, kv, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    # Contiguous block write at the first position (prefill writes the
+    # prompt at 0; a decode step writes one row at pos).
+    start = positions[0]
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, start, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, start, 0, 0))
+
+    max_len = k_cache.shape[1]
+    # Valid: the cache row holds a key at position <= this query's.
+    k_valid = jnp.arange(max_len)[None, :] <= positions[:, None]  # [s, max]
+    mask = jnp.where(k_valid, 0.0, -jnp.inf).astype(jnp.float32)
+    out = gqa_attention(q, k_cache, v_cache, mask)
+    x = x + jnp.einsum("bsq,qd->bsd", out.reshape(b, s, h * hd), p["wo"])
+    return dense_ffn(p, x, cfg), k_cache, v_cache
+
+
+def _forward_with_cache(params, tokens, positions, cache, cfg: ModelConfig):
+    """Stacked-layer forward that threads the KV cache; returns
+    (logits for the LAST position, updated cache)."""
+    x = params["embed"][tokens]
+
+    def body(x, scanned):
+        layer_p, k_cache, v_cache = scanned
+        x, k_cache, v_cache = _layer_with_cache(
+            layer_p, x, positions, k_cache, v_cache, cfg
+        )
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["ln_f"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, -1, :], params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": k_new, "v": v_new}
+
+
+def _pick(logits, step_key, temperature: float):
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        step_key, logits / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=32)
+def _prefill_fn(cfg: ModelConfig, p: int):
+    @jax.jit
+    def prefill(params, prompt, cache):
+        return _forward_with_cache(params, prompt, jnp.arange(p), cache, cfg)
+
+    return prefill
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_fn(cfg: ModelConfig, p: int, max_new: int, temperature: float):
+    @jax.jit
+    def decode(params, cache, first, keys):
+        def step(carry, scanned):
+            cache, token, pos = carry
+            step_key, = scanned
+            logits, cache = _forward_with_cache(
+                params, token[:, None], pos[None], cache, cfg
+            )
+            nxt = _pick(logits, step_key, temperature)
+            return (cache, nxt, pos + 1), token
+
+        (_, last, _), toks = jax.lax.scan(
+            step, (cache, first, jnp.asarray(p, jnp.int32)),
+            (keys,), length=max_new - 1,
+        )
+        # toks holds tokens emitted BEFORE each step: [first, ...]; the
+        # final pick is `last`.
+        return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+    return decode
+
+
+def generate(
+    params: Dict[str, Any],
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Decode ``max_new`` tokens after ``prompt`` [b, p] (int32).
+
+    temperature 0 = greedy (exact — parity-tested against transformers);
+    otherwise softmax sampling with ``key``.  Returns [b, max_new].
+
+    The prefill and decode programs are built per (cfg, shapes,
+    temperature) and cached — repeated serving calls on a booted model
+    reuse the compiled step, they don't re-trace."""
+    if cfg.n_experts:
+        raise NotImplementedError("generate() serves dense models only")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling needs a PRNG key")
+    b, p = prompt.shape
+    cache = init_cache(cfg, b, p + max_new)
+
+    logits, cache = _prefill_fn(cfg, p)(params, prompt, cache)
+    keys = (jax.random.split(key, max_new) if key is not None
+            else jnp.zeros((max_new, 2), jnp.uint32))
+    first = _pick(logits, keys[0], temperature)
+    if max_new == 1:
+        return first[:, None]
+    return _decode_fn(cfg, p, max_new, temperature)(
+        params, cache, first, keys[1:]
+    )

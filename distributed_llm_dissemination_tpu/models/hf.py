@@ -50,7 +50,15 @@ def is_hf(name: str) -> bool:
 
 
 def _np_dtype(torch_dtype: str):
-    dt = _DTYPES.get(torch_dtype or "float32", np.float32)
+    name = torch_dtype or "float32"
+    if name not in _DTYPES:
+        # FP8/int-quantized checkpoints etc.: silently coercing to f32
+        # would surface later as wrong-sized blobs — reject at config
+        # time like every other unsupported checkpoint feature.
+        raise ValueError(
+            f"unsupported torch_dtype {name!r}; known: {sorted(_DTYPES)}"
+        )
+    dt = _DTYPES[name]
     if dt == "bfloat16":
         import ml_dtypes
 

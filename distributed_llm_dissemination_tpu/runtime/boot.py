@@ -38,6 +38,7 @@ class BootResult:
     layer_ids: Sequence[int]
     logits: Any = None  # full boots only
     activations: Any = None  # stage boots only
+    tokens: Any = None  # full boots with generate_tokens > 0
 
 
 def _device_blob(src) -> Optional[Any]:
@@ -62,6 +63,7 @@ def boot_from_layers(
     node_id=None,
     tokens=None,
     codec: str = "raw",
+    generate_tokens: int = 0,
 ) -> BootResult:
     """Assemble delivered blobs into model params and run one forward.
 
@@ -148,10 +150,30 @@ def boot_from_layers(
             tokens = jnp.zeros((1, 16), jnp.int32)
         logits = jax.jit(forward, static_argnums=2)(params, tokens, cfg)
         jax.block_until_ready(logits)
+        # TTFT stops HERE: the decode below is serving time, not boot
+        # time — it must not contaminate the metric reported next to TTD.
         dt = time.monotonic() - t0
+        generated = None
+        decode_ms = 0.0
+        if generate_tokens > 0:
+            # The booted engine SERVES: KV-cached greedy decode
+            # (models/generate.py) — dissemination ends at emitted
+            # tokens, not just a logits tensor.  MoE configs raise there
+            # (loud beats a silent tokens=None).
+            from ..models.generate import generate
+
+            t_gen = time.monotonic()
+            generated = generate(params, tokens, cfg,
+                                 max_new=generate_tokens)
+            jax.block_until_ready(generated)
+            decode_ms = (time.monotonic() - t_gen) * 1000
         log.info("model booted from disseminated layers", kind="full",
-                 layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1))
-        return BootResult("full", dt, layer_ids, logits=logits)
+                 layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1),
+                 generated=(int(generated.shape[1])
+                            if generated is not None else 0),
+                 decode_ms=round(decode_ms, 1))
+        return BootResult("full", dt, layer_ids, logits=logits,
+                          tokens=generated)
 
     # Stage boot: run this stage's slice on dummy activations.
     def stage_forward(stacked, x):

@@ -231,3 +231,11 @@ def test_opted_out_receiver_reports_skipped():
         leader.close(); receiver.close()
         for t in ts.values():
             t.close()
+
+
+def test_boot_can_generate_tokens():
+    # Full boot + the serving loop: dissemination ends at emitted tokens.
+    layers = {bid: blob_layer(b) for bid, b in all_blobs().items()}
+    res = boot_from_layers(CFG, layers, generate_tokens=4)
+    assert res.kind == "full"
+    assert res.tokens is not None and res.tokens.shape == (1, 4)

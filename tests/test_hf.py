@@ -169,3 +169,31 @@ def test_rope_scaling_checkpoint_rejected(tmp_path):
     (tmp_path / "config.json").write_text(_json.dumps(d))
     with pytest.raises(ValueError, match="rope_scaling"):
         hf.config_from_dir(str(tmp_path))
+
+
+def test_greedy_generate_matches_transformers(hf_dir):
+    """The serving loop: our KV-cached greedy decode must emit the SAME
+    token ids as transformers' generate on the same checkpoint."""
+    import torch
+    from transformers import LlamaForCausalLM
+
+    from distributed_llm_dissemination_tpu.models.generate import generate
+
+    cfg = hf.config_from_dir(hf_dir)
+    params = jax.tree.map(jnp.asarray, hf.params_from_dir(hf_dir))
+    prompt = np.array([[11, 42, 7, 199]], np.int32)
+    max_new = 12
+
+    ours = np.asarray(
+        generate(params, jnp.asarray(prompt), cfg, max_new=max_new)
+    )
+
+    model = LlamaForCausalLM.from_pretrained(hf_dir).eval()
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=max_new, do_sample=False,
+            pad_token_id=0,
+        )
+    theirs = out[:, prompt.shape[1]:].numpy()
+    np.testing.assert_array_equal(ours, theirs)
