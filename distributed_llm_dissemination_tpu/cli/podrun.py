@@ -156,6 +156,18 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
             print(f"Time to first token: {ttft:.6f}s", flush=True)
             summary["ttft_s"] = round(ttft, 6)
             summary["boot_nodes"] = len(booted)
+            # When the stage boots partition the model, the POD serves as
+            # one pipelined model from the landed weights (pp_serve).
+            from ..runtime.pp_serve import pod_forward
+
+            results = {r.node.my_id: r.boot_result for r in receivers}
+            stores = {r.node.my_id: r.layers for r in receivers}
+            served = pod_forward(boot_cfg, placement, results, stores,
+                                 codec=conf.model_codec)
+            if served is not None:
+                _, pod_s = served
+                summary["pod_forward_s"] = round(pod_s, 6)
+                print(f"Pod pipelined forward: {pod_s:.6f}s", flush=True)
         print(json.dumps(summary), flush=True)
         return summary
     finally:

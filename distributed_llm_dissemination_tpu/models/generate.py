@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .llama import ModelConfig, dense_ffn, gqa_attention, rms_norm, rope
+from .llama import ModelConfig, dense_ffn, gqa_attention, qkv_proj, rms_norm
 
 KVCache = Dict[str, jax.Array]  # {"k","v"}: [n_layers, b, max_len, kvh, hd]
 
@@ -50,13 +50,9 @@ def _layer_with_cache(
     forward, with the causal mask generalized to cache-row validity.
     Returns (x_out, k_cache, v_cache)."""
     b, s, d = x.shape
-    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h, hd = cfg.n_heads, cfg.head_dim
     xn = rms_norm(x, p["ln1"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dq->bsq", xn, p["wq"]).reshape(b, s, h, hd)
-    k = jnp.einsum("bsd,dq->bsq", xn, p["wk"]).reshape(b, s, kv, hd)
-    v = jnp.einsum("bsd,dq->bsq", xn, p["wv"]).reshape(b, s, kv, hd)
-    q = rope(q, positions, cfg.rope_theta)
-    k = rope(k, positions, cfg.rope_theta)
+    q, k, v = qkv_proj(p, xn, positions, cfg)
     # Contiguous block write at the first position (prefill writes the
     # prompt at 0; a decode step writes one row at pos).
     start = positions[0]
@@ -154,6 +150,8 @@ def generate(
     reuse the compiled step, they don't re-trace."""
     if cfg.n_experts:
         raise NotImplementedError("generate() serves dense models only")
+    if max_new <= 0:
+        raise ValueError(f"max_new must be positive, got {max_new}")
     if temperature > 0 and key is None:
         raise ValueError("sampling needs a PRNG key")
     b, p = prompt.shape

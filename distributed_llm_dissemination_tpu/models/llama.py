@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -192,17 +192,30 @@ def gqa_attention(
     return out.reshape(b, sq, h, hd)
 
 
-def attention_block(
-    p: Dict[str, jax.Array], x: jax.Array, positions: jax.Array, cfg: ModelConfig
-) -> jax.Array:
-    b, s, d = x.shape
+def qkv_proj(
+    p: Dict[str, jax.Array], xn: jax.Array, positions: jax.Array,
+    cfg: ModelConfig,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Project the normed hidden state to rotary-encoded q/k/v — shared
+    by the training/forward path and the KV-cached serving path
+    (models/generate.py), so the two can't drift."""
+    b, s, _ = xn.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    xn = rms_norm(x, p["ln1"], cfg.norm_eps)
     q = jnp.einsum("bsd,dq->bsq", xn, p["wq"]).reshape(b, s, h, hd)
     k = jnp.einsum("bsd,dq->bsq", xn, p["wk"]).reshape(b, s, kv, hd)
     v = jnp.einsum("bsd,dq->bsq", xn, p["wv"]).reshape(b, s, kv, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def attention_block(
+    p: Dict[str, jax.Array], x: jax.Array, positions: jax.Array, cfg: ModelConfig
+) -> jax.Array:
+    b, s, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+    xn = rms_norm(x, p["ln1"], cfg.norm_eps)
+    q, k, v = qkv_proj(p, xn, positions, cfg)
     mask = jnp.where(
         positions[:, None] >= positions[None, :], 0.0, -jnp.inf
     ).astype(jnp.float32)

@@ -276,3 +276,54 @@ def example_batch(cfg: ModelConfig, mesh: Mesh, batch: int = 0, seq: int = 0):
     inputs = jax.device_put(jnp.asarray(tokens[:, :-1]), sharding)
     targets = jax.device_put(jnp.asarray(tokens[:, 1:]), sharding)
     return inputs, targets
+
+
+# ------------------------------------------------------------ pp inference
+
+
+def build_pp_forward(cfg: ModelConfig, mesh: Mesh, pp_axis: str):
+    """jitted (layers, head, tokens) -> logits over a pipeline-sharded
+    mesh: each stage holds its n_layers/pp stacked slice resident (the
+    Assignment's placement — what dissemination landed), head leaves are
+    replicated, and activations hand off stage→stage by ``ppermute``
+    exactly like the train step's pipeline fill.  Logits are valid on
+    stage 0 after the wrap-around and broadcast by psum.
+
+    Any extra mesh axes (e.g. tp) replicate the computation — this is the
+    serving form of the staged placement, not the full 5-axis program."""
+    from .llama import layer_apply
+
+    pp = mesh.shape[pp_axis]
+    fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def per_device(layers_local, head, tokens):
+        positions = jnp.arange(tokens.shape[1])
+        x = head["embed"][tokens]
+
+        def body(h, layer_p):
+            return layer_apply(layer_p, h, positions, cfg), None
+
+        for _ in range(pp):
+            x = lax.scan(body, x, layers_local)[0]
+            if pp > 1:
+                x = lax.ppermute(x, pp_axis, fwd)
+
+        if pp > 1:
+            # Broadcast the valid (stage-0) HIDDEN STATE, not the logits:
+            # [b, s, d_model] over ICI instead of [b, s, vocab] — ~vocab/d
+            # times less collective traffic for the same result.
+            idx = lax.axis_index(pp_axis)
+            x = lax.psum(jnp.where(idx == 0, x, 0.0), pp_axis)
+        xn = rms_norm(x, head["ln_f"], cfg.norm_eps)
+        return jnp.einsum(
+            "bsd,dv->bsv", xn, head["lm_head"]
+        ).astype(jnp.float32)
+
+    f = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(pp_axis), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(f)

@@ -59,6 +59,14 @@ def eligible(sq: int, t: int, hd: int) -> bool:
 
 
 def _use_pallas(sq: int, t: int, hd: int) -> bool:
+    import os
+
+    if os.environ.get("DLD_DISABLE_PALLAS_ATTN", "").lower() not in (
+        "", "0", "false", "no",
+    ):
+        # Field escape hatch: flip to the lax oracle without a code
+        # change (e.g. a Mosaic regression on a new TPU generation).
+        return False
     if not eligible(sq, t, hd):
         return False
     return FORCE_PALLAS or jax.default_backend() == "tpu"

@@ -39,6 +39,11 @@ class BootResult:
     logits: Any = None  # full boots only
     activations: Any = None  # stage boots only
     tokens: Any = None  # full boots with generate_tokens > 0
+    # The assembled params stay RESIDENT — they are the product of the
+    # dissemination (full boots: the whole pytree; stage boots: this
+    # stage's stacked layer dict, on its stage's devices) and what
+    # pod-level pipelined serving (runtime/pp_serve.py) consumes.
+    params: Any = None
 
 
 def _device_blob(src) -> Optional[Any]:
@@ -173,7 +178,7 @@ def boot_from_layers(
                             if generated is not None else 0),
                  decode_ms=round(decode_ms, 1))
         return BootResult("full", dt, layer_ids, logits=logits,
-                          tokens=generated)
+                          tokens=generated, params=params)
 
     # Stage boot: run this stage's slice on dummy activations.
     def stage_forward(stacked, x):
@@ -193,4 +198,5 @@ def boot_from_layers(
     dt = time.monotonic() - t0
     log.info("pipeline stage booted from disseminated layers", kind="stage",
              layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1))
-    return BootResult("stage", dt, layer_ids, activations=acts)
+    return BootResult("stage", dt, layer_ids, activations=acts,
+                      params=stacked)

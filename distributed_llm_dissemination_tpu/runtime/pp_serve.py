@@ -1,0 +1,149 @@
+"""Pod-level pipelined serving from disseminated stage weights.
+
+The Assignment IS a pipeline placement (SURVEY §2.3): dissemination lands
+each stage's layer slice on that stage's devices and the per-node boot
+proves the slice usable (``runtime/boot.py`` stage boots).  This module
+closes the last gap — the POD serves as one model:
+
+1. ``assemble_pp_params`` lifts each stage's resident stacked params
+   (``BootResult.params``, already on the stage's devices) into global
+   pipeline-sharded arrays — ``make_array_from_single_device_arrays``
+   over the full mesh, so NO weight bytes move; the head leaves (held by
+   whichever stage received the head blob) are broadcast mesh-wide over
+   ICI (the one small replicated piece).
+2. ``pod_forward`` runs ``models.sharded.build_pp_forward``: activations
+   hand off stage→stage by ``ppermute``, logits valid on stage 0.
+
+Single-controller scope (``cli/podrun.py``), like the pod fabric: one
+process addresses the mesh.  The multi-controller analogue is the same
+program entered by every process — the lockstep machinery exists
+(``parallel/spmd_fabric.py``) but serving over it is future work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..utils.logging import log
+
+
+def _stage_order(cfg, placement, results) -> Optional[list]:
+    """Stage-ordered list of (node, stacked-params) when the boots form a
+    full, even partition of the layers; None (with a log) otherwise."""
+    staged = {n: r for n, r in results.items()
+              if r is not None and r.kind == "stage" and r.params is not None}
+    if not staged:
+        return None
+    by_stage = sorted(staged, key=lambda n: placement.node_to_stage[n])
+    covered = [lid for n in by_stage for lid in staged[n].layer_ids]
+    if covered != list(range(cfg.n_layers)):
+        log.info("pod serve skipped: stage boots don't partition the "
+                 "layers", covered=covered)
+        return None
+    counts = {len(staged[n].layer_ids) for n in by_stage}
+    if len(counts) != 1:
+        log.info("pod serve skipped: uneven stage sizes", counts=counts)
+        return None
+    return [(n, staged[n].params) for n in by_stage]
+
+
+def _head_leaves(cfg, stores, codec: str):
+    """Decode embed/ln_f/lm_head from whichever node's store holds the
+    head blob (device path when it landed in HBM)."""
+    from ..models import quant, serde
+    from .boot import _device_blob
+
+    head_id = serde.head_blob_id(cfg)
+    for node_id, layers in stores.items():
+        src = layers.get(head_id)
+        if src is None:
+            continue
+        dev = _device_blob(src)
+        if dev is not None:
+            return quant.head_from_device(cfg, dev, codec)
+        data = (src.inmem_data if src.inmem_data is not None
+                else src.read_bytes())
+        return quant.head_from_blob_host(cfg, data, codec)
+    return None
+
+
+def assemble_pp_params(cfg, placement, results: Dict[int, Any],
+                       stores: Dict[int, Any], codec: str = "raw"):
+    """Global pipeline-sharded params from the stage boots' resident
+    arrays; None when the pod doesn't form a servable pipeline."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    order = _stage_order(cfg, placement, results)
+    if order is None:
+        return None
+    head = _head_leaves(cfg, stores, codec)
+    if head is None:
+        log.info("pod serve skipped: no node holds the head blob")
+        return None
+    pp_axis = placement.pipeline_axis
+    # Serve on the SUB-mesh of exactly the booted stages: a pod fabric
+    # maps seeders and the leader onto stages too, and those hold no
+    # model slice.
+    from jax.sharding import Mesh
+
+    k = list(placement.mesh.axis_names).index(pp_axis)
+    stage_idx = [placement.node_to_stage[n] for n, _ in order]
+    mesh = Mesh(np.take(placement.mesh.devices, stage_idx, axis=k),
+                placement.mesh.axis_names)
+
+    flat_devices = list(np.ravel(mesh.devices))
+    layers_global = {}
+    leaf_names = list(order[0][1].keys())
+    for name in leaf_names:
+        shards = {}
+        for node_id, stacked in order:
+            stage = placement.node_to_stage[node_id]
+            leaf = jax.device_put(
+                stacked[name],
+                NamedSharding(placement.stage_mesh(stage), P()),
+            )
+            for s in leaf.addressable_shards:
+                shards[s.device] = s.data
+        per_dev = [shards[d] for d in flat_devices]
+        slice_shape = per_dev[0].shape
+        global_shape = (cfg.n_layers,) + slice_shape[1:]
+        spec = P(*([pp_axis] + [None] * (len(slice_shape) - 1)))
+        layers_global[name] = jax.make_array_from_single_device_arrays(
+            global_shape, NamedSharding(mesh, spec), per_dev
+        )
+    head = {
+        name: jax.device_put(jnp.asarray(a), NamedSharding(mesh, P()))
+        for name, a in head.items()
+    }
+    return mesh, layers_global, head
+
+
+def pod_forward(cfg, placement, results, stores, tokens=None,
+                codec: str = "raw"):
+    """One pipelined forward across the pod's stages from the landed
+    weights; returns (logits, seconds) or None when not servable."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.sharded import build_pp_forward
+
+    assembled = assemble_pp_params(cfg, placement, results, stores, codec)
+    if assembled is None:
+        return None
+    mesh, layers_global, head = assembled
+    if tokens is None:
+        tokens = jnp.zeros((1, 16), jnp.int32)
+    t0 = time.monotonic()
+    fwd = build_pp_forward(cfg, mesh, placement.pipeline_axis)
+    logits = fwd(layers_global, head, tokens)
+    jax.block_until_ready(logits)
+    dt = time.monotonic() - t0
+    log.info("pod pipelined forward from staged weights",
+             stages=mesh.shape[placement.pipeline_axis],
+             seconds=round(dt, 3))
+    return logits, dt
