@@ -61,6 +61,20 @@ def _device_blob(src) -> Optional[Any]:
     return None
 
 
+def decode_head(cfg, src, codec: str = "raw"):
+    """embed/ln_f/lm_head leaves from a head-blob ``LayerSrc`` — the
+    device path when the blob is HBM-resident (jax arrays), the host
+    path otherwise (numpy).  Shared by the full boot and pod serving
+    (``runtime/pp_serve.py``) so the decode dispatch lives once."""
+    from ..models import quant
+
+    dev = _device_blob(src)
+    if dev is not None:
+        return quant.head_from_device(cfg, dev, codec)
+    data = src.inmem_data if src.inmem_data is not None else src.read_bytes()
+    return quant.head_from_blob_host(cfg, data, codec)
+
+
 def boot_from_layers(
     cfg,
     layers: LayersSrc,
@@ -133,17 +147,13 @@ def boot_from_layers(
         via = "host assembly"
 
     if full:
-        if dev_blobs[head_id] is not None:
-            head = quant.head_from_device(cfg, dev_blobs[head_id], codec)
-        else:
-            data = (layers[head_id].inmem_data
-                    if layers[head_id].inmem_data is not None
-                    else layers[head_id].read_bytes())
-            host_head = quant.head_from_blob_host(cfg, data, codec)
+        head = decode_head(cfg, layers[head_id], codec)
+        if dev_blobs[head_id] is None:
+            # Host-decoded leaves: place per the stage sharding.
             head = {
                 name: jax.device_put(a, sharding) if sharding is not None
                 else jnp.asarray(a)
-                for name, a in host_head.items()
+                for name, a in head.items()
             }
         params = {
             "embed": head["embed"],

@@ -50,20 +50,14 @@ def _stage_order(cfg, placement, results) -> Optional[list]:
 def _head_leaves(cfg, stores, codec: str):
     """Decode embed/ln_f/lm_head from whichever node's store holds the
     head blob (device path when it landed in HBM)."""
-    from ..models import quant, serde
-    from .boot import _device_blob
+    from ..models import serde
+    from .boot import decode_head
 
     head_id = serde.head_blob_id(cfg)
     for node_id, layers in stores.items():
         src = layers.get(head_id)
-        if src is None:
-            continue
-        dev = _device_blob(src)
-        if dev is not None:
-            return quant.head_from_device(cfg, dev, codec)
-        data = (src.inmem_data if src.inmem_data is not None
-                else src.read_bytes())
-        return quant.head_from_blob_host(cfg, data, codec)
+        if src is not None:
+            return decode_head(cfg, src, codec)
     return None
 
 

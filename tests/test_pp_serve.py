@@ -132,11 +132,8 @@ def test_podrun_pipeline_assignment_serves(cpu_devices):
     """podrun end-to-end: a fabric topology whose Assignment splits the
     model across two stages — after the stage boots, the pod serves (the
     summary carries pod_forward_s)."""
-    import json
-
     from distributed_llm_dissemination_tpu.cli.podrun import run_pod
     from distributed_llm_dissemination_tpu.core import config as cfg_mod
-    from distributed_llm_dissemination_tpu.models import serde
 
     head_id = serde.head_blob_id(CFG)
     cut = CFG.n_layers // 2
