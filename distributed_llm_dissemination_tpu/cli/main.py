@@ -283,6 +283,23 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     receiver.ready().get()
     ulog.log.info("received startup: ready")
     print("ready", flush=True)
+    if receiver.expect_serve:
+        # Multi-controller serving: a ServeMsg follows startup; stay
+        # alive to enter the pod-wide pipelined forward (pp_serve).
+        # Two clocks on purpose: a bounded wait for the MESSAGE (the
+        # leader cancels explicitly if the pod became unservable), then
+        # a long one for the collective itself — a big model's stage
+        # boots + first compile can take minutes, and exiting
+        # mid-collective would crash the healthy members.
+        import queue as _queue
+
+        if not receiver.serve_started.wait(timeout=300.0):
+            ulog.log.error("expected ServeMsg never arrived")
+        else:
+            try:
+                receiver.serve_done().get(timeout=3600.0)
+            except _queue.Empty:
+                ulog.log.error("pod serve never completed")
     return 0
 
 

@@ -55,6 +55,7 @@ from ..transport.messages import (
     HeartbeatMsg,
     LayerMsg,
     RetransmitMsg,
+    ServeMsg,
     StartupMsg,
 )
 from ..utils import intervals
@@ -141,6 +142,7 @@ class LeaderNode:
         # the whole run (see send_startup); the CLI sets this False for
         # dissemination-only runs of boot-capable topologies (-boot none).
         self.boot_enabled = True
+        self._serve_promised = False  # StartupMsg said a ServeMsg follows
         # Model-boot completion tracking (BootReadyMsg is an extension:
         # the reference's startup hook has no completion signal).
         self._boot_q: "queue.Queue[Dict[NodeID, float]]" = queue.Queue()
@@ -225,7 +227,90 @@ class LeaderNode:
                     if self._t_start is not None else 0.0)
             booted = dict(self._booted)
         log.info("timer stop: first token", seconds=round(ttft, 6))
+        self._dispatch_serve()
         self._boot_q.put(booted)
+
+    def _dispatch_serve(self) -> None:
+        """Broadcast the ServeMsg (multi-controller serving) — or its
+        CANCELLATION (empty members) when startup promised serving but
+        the pod can no longer serve (a crash changed the assignment, the
+        fabric got disabled): receivers told ``serve=True`` are waiting
+        and must be released, not left to a timeout."""
+        members = self.serve_members()
+        if members is None and not self._serve_promised:
+            return
+        serve = ServeMsg(self.node.my_id, members or [])
+        with self._lock:
+            recipients = sorted(
+                (set(self.status) | set(members or ()))
+                - {self.node.my_id}
+            )
+        failed_member = False
+        for r in recipients:
+            try:
+                self.node.transport.send(r, serve)
+            except (OSError, KeyError) as e:
+                log.error("failed to send serveMsg", dest=r, err=repr(e))
+                failed_member = failed_member or r in (members or ())
+        if members and failed_member:
+            # A member never got the ServeMsg: the others would block in
+            # the collective on the absent peer.  Best-effort cancel
+            # (a member that already ENTERED can't be recalled — the
+            # same residual window as plan cancellation, see
+            # parallel/spmd_fabric.py).
+            cancel = ServeMsg(self.node.my_id, [])
+            for r in recipients:
+                try:
+                    self.node.transport.send(r, cancel)
+                except (OSError, KeyError) as e:
+                    log.error("serve cancel undeliverable", dest=r,
+                              err=repr(e))
+            log.error("pod serve aborted: a member missed the ServeMsg")
+            return
+        if members:
+            log.info("pod serve dispatched", members=members)
+        else:
+            log.warn("pod serve cancelled: pod no longer servable")
+
+    def serve_members(self):
+        """Stage-ordered member nodes for multi-controller serving, or
+        None.  The leader is model-agnostic, so the check is structural
+        (blob ids only): the max assigned id H is the head blob, every
+        member holds H (a process can only decode what its store has),
+        and the members' remaining ids are equal contiguous slices
+        partitioning [0, H).  Receivers re-validate against the model."""
+        if not self._spmd or self.placement is None or not self.boot_enabled:
+            return None
+        with self._lock:
+            assignment = {n: set(lids) for n, lids in self.assignment.items()}
+        if len(assignment) < 2:
+            return None
+        all_ids = set().union(*assignment.values())
+        if not all_ids:
+            return None
+        head = max(all_ids)
+        if self._fabric_disabled:
+            # Same hazard as device plans: a restarted member is outside
+            # the runtime and one more collective hangs every survivor.
+            return None
+        slices = {}
+        for n, lids in assignment.items():
+            if head not in lids:
+                return None
+            body = sorted(lids - {head})
+            if not body or body != list(range(body[0], body[-1] + 1)):
+                return None
+            slices[n] = (body[0], body[-1] + 1)
+        spans = sorted(slices.values())
+        sizes = {e - s for s, e in spans}
+        pos = 0
+        for s, e in spans:
+            if s != pos:
+                return None
+            pos = e
+        if pos != head or len(sizes) != 1:
+            return None
+        return sorted(slices, key=lambda n: slices[n][0])
 
     def close(self) -> None:
         self.detector.stop()
@@ -681,11 +766,14 @@ class LeaderNode:
     def send_startup(self) -> None:
         with self._lock:
             receivers = list(self.status)
+        serve = self.serve_members() is not None
+        self._serve_promised = serve  # a later cancel must release waiters
         for node_id in receivers:
             try:
                 self.node.transport.send(
                     node_id,
-                    StartupMsg(self.node.my_id, boot=self.boot_enabled),
+                    StartupMsg(self.node.my_id, boot=self.boot_enabled,
+                               serve=serve),
                 )
             except (OSError, KeyError) as e:
                 log.error("failed to send startup", dest=node_id, err=repr(e))

@@ -14,10 +14,18 @@ closes the last gap — the POD serves as one model:
 2. ``pod_forward`` runs ``models.sharded.build_pp_forward``: activations
    hand off stage→stage by ``ppermute``, logits valid on stage 0.
 
-Single-controller scope (``cli/podrun.py``), like the pod fabric: one
-process addresses the mesh.  The multi-controller analogue is the same
-program entered by every process — the lockstep machinery exists
-(``parallel/spmd_fabric.py``) but serving over it is future work.
+Two controller shapes, like the fabric itself:
+
+- single-controller (``cli/podrun.py``): ``pod_forward`` — one process
+  addresses the whole mesh;
+- multi-controller (``spmd_pod_forward``): after boots, the leader
+  broadcasts a ``ServeMsg`` and every MEMBER process (one per stage)
+  enters the same compiled pipelined forward over the sub-mesh of the
+  member stages, feeding its local shards — the serving analogue of the
+  SPMD fabric's lockstep (``parallel/spmd_fabric.py``).  The head blob
+  must be assigned to EVERY stage (the config convention for
+  multi-controller serving), since a process can only decode what its
+  own store holds.
 """
 
 from __future__ import annotations
@@ -81,12 +89,7 @@ def assemble_pp_params(cfg, placement, results: Dict[int, Any],
     # Serve on the SUB-mesh of exactly the booted stages: a pod fabric
     # maps seeders and the leader onto stages too, and those hold no
     # model slice.
-    from jax.sharding import Mesh
-
-    k = list(placement.mesh.axis_names).index(pp_axis)
-    stage_idx = [placement.node_to_stage[n] for n, _ in order]
-    mesh = Mesh(np.take(placement.mesh.devices, stage_idx, axis=k),
-                placement.mesh.axis_names)
+    mesh = _submesh(placement, [placement.node_to_stage[n] for n, _ in order])
 
     flat_devices = list(np.ravel(mesh.devices))
     layers_global = {}
@@ -113,6 +116,84 @@ def assemble_pp_params(cfg, placement, results: Dict[int, Any],
         for name, a in head.items()
     }
     return mesh, layers_global, head
+
+
+def _submesh(placement, stage_idx):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    k = list(placement.mesh.axis_names).index(placement.pipeline_axis)
+    return Mesh(np.take(placement.mesh.devices, stage_idx, axis=k),
+                placement.mesh.axis_names)
+
+
+def spmd_pod_forward(cfg, placement, members, my_node, stacked, store,
+                     codec: str = "raw", batch: int = 1, seq_len: int = 16):
+    """Multi-controller serving: called by EVERY member process on
+    ``ServeMsg``.  ``stacked`` is this process's resident stage params
+    (``BootResult.params``); ``store`` its layer store (holds the head
+    blob — assigned to every stage by convention).  Returns
+    (logits, seconds) on members, None on non-members."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import serde
+    from ..models.sharded import build_pp_forward
+    from .boot import decode_head
+
+    if my_node not in members:
+        return None
+    pp_axis = placement.pipeline_axis
+    mesh = _submesh(placement,
+                    [placement.node_to_stage[n] for n in members])
+
+    def replicated(a):
+        """A mesh-global replicated array from this process's local value
+        (each process contributes identical content for its devices)."""
+        local = [d for d in np.ravel(mesh.devices)
+                 if d.process_index == jax.process_index()]
+        arr = jnp.asarray(a)
+        shards = [jax.device_put(arr, d) for d in local]
+        return jax.make_array_from_single_device_arrays(
+            arr.shape, NamedSharding(mesh, P()), shards
+        )
+
+    t0 = time.monotonic()
+    stage = placement.node_to_stage[my_node]
+    stage_sharding = NamedSharding(placement.stage_mesh(stage), P())
+    layers_global = {}
+    for name, leaf in stacked.items():
+        leaf = jax.device_put(leaf, stage_sharding)
+        shards = {s.device: s.data for s in leaf.addressable_shards}
+        local = [d for d in np.ravel(mesh.devices) if d in shards]
+        global_shape = (cfg.n_layers,) + tuple(leaf.shape[1:])
+        spec = P(*([pp_axis] + [None] * (leaf.ndim - 1)))
+        layers_global[name] = jax.make_array_from_single_device_arrays(
+            global_shape, NamedSharding(mesh, spec),
+            [shards[d] for d in local],
+        )
+
+    head_src = store.get(serde.head_blob_id(cfg))
+    if head_src is None:
+        raise RuntimeError(
+            "multi-controller serving needs the head blob assigned to "
+            "every stage; this node's store has none"
+        )
+    head = {name: replicated(a)
+            for name, a in decode_head(cfg, head_src, codec).items()}
+    tokens = replicated(jnp.zeros((batch, seq_len), jnp.int32))
+
+    fwd = build_pp_forward(cfg, mesh, pp_axis)
+    logits = fwd(layers_global, head, tokens)
+    jax.block_until_ready(logits)
+    dt = time.monotonic() - t0
+    log.info("pod pipelined forward from staged weights", spmd=True,
+             stages=len(members), seconds=round(dt, 3))
+    return logits, dt
 
 
 def pod_forward(cfg, placement, results, stores, tokens=None,

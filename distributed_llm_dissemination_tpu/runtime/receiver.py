@@ -40,6 +40,7 @@ from ..transport.messages import (
     FlowRetransmitMsg,
     LayerMsg,
     RetransmitMsg,
+    ServeMsg,
     StartupMsg,
 )
 from ..utils import intervals
@@ -118,6 +119,13 @@ class ReceiverNode:
         self.fabric = fabric
         self.boot_result = None  # BootResult after a successful boot
         self._boot_started = False
+        self._boot_finished = threading.Event()  # set after _boot (any outcome)
+        # Multi-controller serving (runtime/pp_serve.py): startup said a
+        # ServeMsg will follow; the CLI keeps the process alive until
+        # serve_done() fires (or times out).
+        self.expect_serve = False
+        self.serve_started = threading.Event()  # a ServeMsg arrived
+        self._serve_q: "queue.Queue[object]" = queue.Queue()
         # Eager when enabled: handlers run on a 16-worker pool, so a lazy
         # check-then-set would race; raw byte blobs stage as uint8 so
         # odd-length layers round-trip exactly (bf16 would pad a byte).
@@ -150,6 +158,7 @@ class ReceiverNode:
         self.loop.register(LayerMsg, self.handle_layer)
         self.loop.register(StartupMsg, self.handle_startup)
         self.loop.register(DevicePlanMsg, self.handle_device_plan)
+        self.loop.register(ServeMsg, self.handle_serve)
 
     def announce(self) -> None:
         """Tell the leader what I already hold, routed via the next hop
@@ -541,6 +550,7 @@ class ReceiverNode:
         (``-boot none``) reports a "skipped" BootReadyMsg instead of
         silence — the leader's boot wait can never deadlock on a flag
         mismatch."""
+        self.expect_serve = msg.serve  # before ready(): the CLI reads it
         self._ready_q.put(object())
         if self.fabric is not None:
             # Dissemination is over: the cached fabric uploads' HBM now
@@ -582,6 +592,8 @@ class ReceiverNode:
         except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
             log.error("model boot failed", err=repr(e))
             return
+        finally:
+            self._boot_finished.set()  # serve waiters proceed either way
         self.boot_result = res
         try:
             self.node.transport.send(
@@ -590,6 +602,52 @@ class ReceiverNode:
             )
         except (OSError, KeyError) as e:
             log.error("failed to send bootReadyMsg", err=repr(e))
+
+    # ------------------------------------------------- pod serving (spmd)
+
+    def serve_done(self) -> "queue.Queue[object]":
+        """Fires once after a ServeMsg is handled: the member's
+        (logits, seconds), or None (not a member / serve failed)."""
+        return self._serve_q
+
+    def handle_serve(self, msg: ServeMsg) -> None:
+        """Multi-controller serving: every member enters the pipelined
+        forward across the stages (runtime/pp_serve.py).  An EMPTY
+        members list is the leader's cancellation (the pod became
+        unservable) — waiters are released immediately.  Runs on a
+        dedicated thread — the collective blocks until all members are
+        in, which must not starve the message pool."""
+        self.serve_started.set()
+        threading.Thread(
+            target=self._serve, args=(msg,), daemon=True
+        ).start()
+
+    def _serve(self, msg: ServeMsg) -> None:
+        from .pp_serve import spmd_pod_forward
+
+        out = None
+        try:
+            if self.node.my_id not in msg.members:
+                return
+            if self.boot_cfg is None or self.placement is None:
+                log.error("serveMsg but no boot_cfg/placement")
+                return
+            self._boot_finished.wait(timeout=300.0)
+            res = self.boot_result
+            if res is None or res.kind != "stage" or res.params is None:
+                log.error("serveMsg but no stage boot to serve from",
+                          kind=getattr(res, "kind", None))
+                return
+            out = spmd_pod_forward(
+                self.boot_cfg, self.placement, msg.members,
+                self.node.my_id, res.params, self.layers,
+                codec=self.boot_codec, batch=msg.batch,
+                seq_len=msg.seq_len,
+            )
+        except Exception as e:  # noqa: BLE001 — serve failure is loud, non-fatal
+            log.error("pod serve failed", err=repr(e))
+        finally:
+            self._serve_q.put(out)
 
 
 class RetransmitReceiverNode(ReceiverNode):

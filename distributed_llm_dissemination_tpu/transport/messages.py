@@ -46,9 +46,13 @@ class MsgType(enum.IntEnum):
     # device traffic (ICI), so the control plane replaces the reference's
     # per-transfer TCP byte stream (transport.go:267-274) with this one
     # small message.
+    # SERVE — multi-controller pod serving: after the stage boots, every
+    # member process enters one pipelined forward across the stages
+    # (runtime/pp_serve.py).
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
+    SERVE = 11
 
 
 @dataclasses.dataclass
@@ -247,15 +251,19 @@ class StartupMsg:
 
     src_id: NodeID
     boot: bool = True
+    # Multi-controller serving will follow (a ServeMsg after all boots):
+    # receivers must stay alive past ready() to enter the collective.
+    serve: bool = False
 
     msg_type = MsgType.STARTUP
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id, "Boot": self.boot}
+        return {"SrcID": self.src_id, "Boot": self.boot, "Serve": self.serve}
 
     @classmethod
     def from_payload(cls, d: dict) -> "StartupMsg":
-        return cls(int(d["SrcID"]), bool(d.get("Boot", True)))
+        return cls(int(d["SrcID"]), bool(d.get("Boot", True)),
+                   bool(d.get("Serve", False)))
 
 
 @dataclasses.dataclass
@@ -311,6 +319,32 @@ class BootReadyMsg:
     def from_payload(cls, d: dict) -> "BootReadyMsg":
         return cls(int(d["SrcID"]), float(d.get("Seconds", 0.0)),
                    str(d.get("Kind", "")))
+
+
+@dataclasses.dataclass
+class ServeMsg:
+    """Leader → all (multi-controller SPMD): the stage boots partition
+    the model — every ``members`` process must now enter the SAME
+    pipelined-forward collective (``runtime/pp_serve.py``) with its
+    resident stage weights.  Non-members ignore it."""
+
+    src_id: NodeID
+    members: list  # stage-ordered node ids
+    batch: int = 1
+    seq_len: int = 16
+
+    msg_type = MsgType.SERVE
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id,
+                "Members": [int(m) for m in self.members],
+                "Batch": self.batch, "SeqLen": self.seq_len}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "ServeMsg":
+        return cls(int(d["SrcID"]),
+                   [int(m) for m in d.get("Members") or []],
+                   int(d.get("Batch", 1)), int(d.get("SeqLen", 16)))
 
 
 @dataclasses.dataclass
@@ -379,6 +413,7 @@ Message = Union[
     HeartbeatMsg,
     BootReadyMsg,
     DevicePlanMsg,
+    ServeMsg,
 ]
 
 _DECODERS = {
@@ -392,6 +427,7 @@ _DECODERS = {
     MsgType.HEARTBEAT: HeartbeatMsg,
     MsgType.BOOT_READY: BootReadyMsg,
     MsgType.DEVICE_PLAN: DevicePlanMsg,
+    MsgType.SERVE: ServeMsg,
 }
 
 

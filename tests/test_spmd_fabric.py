@@ -320,3 +320,84 @@ def test_reannounce_disables_spmd_fabric():
     finally:
         leader.close()
         t.close()
+
+
+def test_three_process_spmd_pipeline_serves():
+    """Multi-controller serving: three real OS processes (leader seeds,
+    two stage assignees), dissemination over the SPMD fabric, stage
+    boots, then BOTH members enter the pod-wide pipelined forward.  The
+    head blob is assigned to every stage (the serving convention)."""
+    from distributed_llm_dissemination_tpu.cli.ttd_matrix import _free_port
+    from distributed_llm_dissemination_tpu.models import serde
+    from distributed_llm_dissemination_tpu.models.llama import CONFIGS
+
+    mcfg = CONFIGS["tiny"]
+    head_id = serde.head_blob_id(mcfg)
+    cut = mcfg.n_layers // 2
+    conf = {
+        "Model": "tiny", "ModelSeed": 0,
+        "Nodes": [
+            {"Id": 0, "Addr": f"127.0.0.1:{_free_port()}", "IsLeader": True,
+             "NetworkBW": 10**9, "Sources": {"2": 0},
+             "InitialLayers": {"2": {str(b): {} for b in range(head_id + 1)}}},
+            {"Id": 1, "Addr": f"127.0.0.1:{_free_port()}",
+             "NetworkBW": 10**9, "Sources": {"2": 0}, "InitialLayers": {}},
+            {"Id": 2, "Addr": f"127.0.0.1:{_free_port()}",
+             "NetworkBW": 10**9, "Sources": {"2": 0}, "InitialLayers": {}},
+        ],
+        "Assignment": {
+            "1": {str(b): {} for b in list(range(cut)) + [head_id]},
+            "2": {str(b): {} for b in list(range(cut, head_id))
+                  + [head_id]},
+        },
+        "LayerSize": 1,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [3],
+                 "PipelineAxis": "nodes", "Fabric": True},
+        "Distributed": {"Coordinator": f"127.0.0.1:{_free_port()}",
+                        "CpuCollectives": "gloo"},
+    }
+    conf_path = os.path.join(REPO, ".pytest-spmd-serve.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per process
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "3"]
+    procs = {}
+    try:
+        for i in (1, 2):
+            procs[i] = subprocess.Popen(
+                cli + ["-id", str(i)], stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=env, text=True)
+        procs[0] = subprocess.Popen(
+            cli + ["-id", "0"], stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env, text=True)
+        outs = {}
+        for i, p in procs.items():
+            try:
+                outs[i] = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs.values():
+                    q.kill()
+                raise
+        for i, p in procs.items():
+            assert p.returncode == 0, (
+                f"node {i} failed:\n{outs[i][1][-3000:]}"
+            )
+        assert "Time to first token" in outs[0][0]
+        for i in (1, 2):
+            err = outs[i][1]
+            assert "pod pipelined forward from staged weights" in err, (
+                f"node {i} never served:\n{err[-3000:]}"
+            )
+            assert '"spmd": true' in err
+            assert "layer received" not in err  # zero TCP layer bytes
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        if os.path.exists(conf_path):
+            os.remove(conf_path)
