@@ -286,14 +286,15 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     if receiver.expect_serve:
         # Multi-controller serving: a ServeMsg follows startup; stay
         # alive to enter the pod-wide pipelined forward (pp_serve).
-        # Two clocks on purpose: a bounded wait for the MESSAGE (the
-        # leader cancels explicitly if the pod became unservable), then
-        # a long one for the collective itself — a big model's stage
-        # boots + first compile can take minutes, and exiting
+        # Two clocks on purpose.  The first spans EVERY member's stage
+        # boot (the leader dispatches ServeMsg — or an explicit cancel —
+        # only after the last BootReadyMsg), so it is generous; it is a
+        # backstop against a dead leader, not the normal release path.
+        # The second covers the collective itself — exiting
         # mid-collective would crash the healthy members.
         import queue as _queue
 
-        if not receiver.serve_started.wait(timeout=300.0):
+        if not receiver.serve_started.wait(timeout=1800.0):
             ulog.log.error("expected ServeMsg never arrived")
         else:
             try:

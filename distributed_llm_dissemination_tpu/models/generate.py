@@ -17,10 +17,10 @@ Greedy decoding is exact: ``tests/test_hf.py`` pins the generated token
 ids to the ``transformers`` implementation's ``generate`` on the same
 checkpoint.  Sampling takes a temperature + PRNG key.
 
-MoE configs are rejected (dense SwiGLU only — the dissemination-side
-MoE model is a training-path feature; extending the cache loop to
-routed experts is mechanical but untested, and silently wrong serving
-would be worse than a loud error).
+MoE configs serve too: the cache layer dispatches to the same
+``moe_ffn`` as the full forward (each token routes through its top-k
+experts), so the dense and MoE paths share one attention/cache
+implementation.
 """
 
 from __future__ import annotations
@@ -31,7 +31,14 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from .llama import ModelConfig, dense_ffn, gqa_attention, qkv_proj, rms_norm
+from .llama import (
+    ModelConfig,
+    dense_ffn,
+    gqa_attention,
+    moe_ffn,
+    qkv_proj,
+    rms_norm,
+)
 
 KVCache = Dict[str, jax.Array]  # {"k","v"}: [n_layers, b, max_len, kvh, hd]
 
@@ -65,7 +72,8 @@ def _layer_with_cache(
     mask = jnp.where(k_valid, 0.0, -jnp.inf).astype(jnp.float32)
     out = gqa_attention(q, k_cache, v_cache, mask)
     x = x + jnp.einsum("bsq,qd->bsd", out.reshape(b, s, h * hd), p["wo"])
-    return dense_ffn(p, x, cfg), k_cache, v_cache
+    ffn = moe_ffn if cfg.n_experts else dense_ffn
+    return ffn(p, x, cfg), k_cache, v_cache
 
 
 def _forward_with_cache(params, tokens, positions, cache, cfg: ModelConfig):
@@ -148,8 +156,6 @@ def generate(
     The prefill and decode programs are built per (cfg, shapes,
     temperature) and cached — repeated serving calls on a booted model
     reuse the compiled step, they don't re-trace."""
-    if cfg.n_experts:
-        raise NotImplementedError("generate() serves dense models only")
     if max_new <= 0:
         raise ValueError(f"max_new must be positive, got {max_new}")
     if temperature > 0 and key is None:

@@ -173,8 +173,7 @@ def boot_from_layers(
         if generate_tokens > 0:
             # The booted engine SERVES: KV-cached greedy decode
             # (models/generate.py) — dissemination ends at emitted
-            # tokens, not just a logits tensor.  MoE configs raise there
-            # (loud beats a silent tokens=None).
+            # tokens, not just a logits tensor (dense and MoE alike).
             from ..models.generate import generate
 
             t_gen = time.monotonic()

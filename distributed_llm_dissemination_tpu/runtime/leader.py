@@ -147,6 +147,7 @@ class LeaderNode:
         # the reference's startup hook has no completion signal).
         self._boot_q: "queue.Queue[Dict[NodeID, float]]" = queue.Queue()
         self._booted: Dict[NodeID, float] = {}
+        self._boot_kinds: Dict[NodeID, str] = {}  # serve needs "stage"
         self._boot_reported = False
         self._t_start: Optional[float] = None
         # node -> {layer: {"Total": n, "Covered": [[s, e], ...]}} from
@@ -220,6 +221,7 @@ class LeaderNode:
                 # report (it holds no assigned model) is just liveness.
                 return
             self._booted[msg.src_id] = msg.seconds
+            self._boot_kinds[msg.src_id] = msg.kind
             if self._boot_reported or set(self.assignment) - set(self._booted):
                 return
             self._boot_reported = True
@@ -237,6 +239,18 @@ class LeaderNode:
         fabric got disabled): receivers told ``serve=True`` are waiting
         and must be released, not left to a timeout."""
         members = self.serve_members()
+        if members is not None:
+            # Every member must have REALLY booted a stage model: a
+            # "skipped" (opted-out) or "full" report can't enter the
+            # collective, and dispatching anyway would park the others
+            # inside it — cancel instead.
+            with self._lock:
+                kinds = {m: self._boot_kinds.get(m) for m in members}
+            if any(k != "stage" for k in kinds.values()):
+                log.warn("pod serve cancelled: not all members stage-"
+                         "booted", kinds={str(k): v for k, v in
+                                          kinds.items()})
+                members = None
         if members is None and not self._serve_promised:
             return
         serve = ServeMsg(self.node.my_id, members or [])

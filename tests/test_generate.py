@@ -67,8 +67,22 @@ def test_sampling_requires_key(params):
                  max_new=2, temperature=0.5)
 
 
-def test_moe_rejected():
-    cfg = CONFIGS["tiny-moe"]
-    params = init_params(cfg, jax.random.key(0))
-    with pytest.raises(NotImplementedError):
-        generate(params, jnp.zeros((1, 2), jnp.int32), cfg, max_new=2)
+def test_moe_greedy_matches_full_forward():
+    # The cache layer dispatches to the same moe_ffn as the full forward:
+    # MoE models serve too, exactly.
+    cfg = dataclasses.replace(CONFIGS["tiny-moe"], dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(2))
+    prompt = jnp.asarray([[5, 4, 3, 2]], jnp.int32)
+    got = np.asarray(generate(params, prompt, cfg, max_new=6))
+    toks = prompt
+    for _ in range(6):
+        logits = forward_jit(params, toks, cfg)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.asarray(toks[:, 4:]))
+
+
+def test_max_new_must_be_positive():
+    params = init_params(CFG, jax.random.key(0))
+    with pytest.raises(ValueError, match="max_new"):
+        generate(params, jnp.zeros((1, 2), jnp.int32), CFG, max_new=0)
