@@ -68,11 +68,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="receiver: stage each delivered layer into TPU HBM "
                         "(jax.Array) before acking")
     p.add_argument("-boot", type=str, default="",
-                   help="model config name (models.llama.CONFIGS): receivers "
-                        "boot the model from the delivered layer blobs on "
-                        "startup; the leader waits for every assignee's boot "
-                        "and prints Time to first token (give the flag to "
-                        "both roles)")
+                   help="model config name (models.llama.CONFIGS), "
+                        "hf:<checkpoint-dir>, or 'none': receivers boot the "
+                        "model from the delivered layer blobs on startup; "
+                        "the leader waits for every assignee's boot and "
+                        "prints Time to first token (give the flag to both "
+                        "roles)")
+    p.add_argument("-gen", type=int, default=0,
+                   help="receiver: after a full boot, greedily decode this "
+                        "many tokens with the KV-cached serving loop "
+                        "(models/generate.py) and log them — dissemination "
+                        "ends at emitted tokens")
     return p
 
 
@@ -263,7 +269,7 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     codec = conf.model_codec
     common = dict(heartbeat_interval=args.hb, stage_hbm=args.hbm,
                   placement=placement, boot_cfg=boot_cfg, boot_codec=codec,
-                  fabric=fabric)
+                  fabric=fabric, boot_generate=args.gen)
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".", **common)
     elif args.m in (1, 2):

@@ -82,13 +82,16 @@ class ReceiverNode:
         boot_cfg=None,
         fabric=None,
         boot_codec: str = "raw",
+        boot_generate: int = 0,
     ):
         """``boot_cfg``: a ``models.llama.ModelConfig``; when set, the
         startup message boots the model from the delivered layer blobs
         (``runtime.boot``) and reports a ``BootReadyMsg`` to the leader —
         the inference engine the reference's startup hook only gestures at
         (message.go:216-241).  ``boot_codec``: the transfer codec the
-        blobs were encoded with (``models/quant.py``).
+        blobs were encoded with (``models/quant.py``); ``boot_generate``
+        > 0 additionally decodes that many tokens after a full boot (the
+        KV-cached serving loop).
 
         ``stage_hbm``: stage each delivered layer into device HBM (a
         jax.Array) before acking — the TPU-native terminal state; the
@@ -116,6 +119,7 @@ class ReceiverNode:
         self.placement = placement
         self.boot_cfg = boot_cfg
         self.boot_codec = boot_codec
+        self.boot_generate = boot_generate
         self.fabric = fabric
         self.boot_result = None  # BootResult after a successful boot
         self._boot_started = False
@@ -588,6 +592,7 @@ class ReceiverNode:
                 self.boot_cfg, self.layers,
                 placement=self.placement, node_id=self.node.my_id,
                 codec=self.boot_codec,
+                generate_tokens=self.boot_generate,
             )
         except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
             log.error("model boot failed", err=repr(e))
@@ -683,7 +688,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
                  checkpoint_dir: str = "", stage_hbm: bool = False,
                  placement=None, boot_cfg=None, fabric=None,
-                 boot_codec: str = "raw"):
+                 boot_codec: str = "raw", boot_generate: int = 0):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -725,7 +730,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                          heartbeat_interval=heartbeat_interval,
                          stage_hbm=stage_hbm, placement=placement,
                          boot_cfg=boot_cfg, fabric=fabric,
-                         boot_codec=boot_codec)
+                         boot_codec=boot_codec, boot_generate=boot_generate)
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:
