@@ -266,6 +266,13 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     # A config with a Model section is boot-capable: receivers boot by
     # default so the leader's boot wait can't hang on a missing flag.
     boot_cfg = boot_config(args.boot or conf.model)
+    if args.gen < 0:
+        raise SystemExit(f"-gen must be >= 0, got {args.gen}")
+    if args.gen > 0 and boot_cfg is None:
+        raise SystemExit(
+            "-gen needs a bootable model: give -boot <name> or a config "
+            "with a Model section"
+        )
     codec = conf.model_codec
     common = dict(heartbeat_interval=args.hb, stage_hbm=args.hbm,
                   placement=placement, boot_cfg=boot_cfg, boot_codec=codec,

@@ -592,7 +592,6 @@ class ReceiverNode:
                 self.boot_cfg, self.layers,
                 placement=self.placement, node_id=self.node.my_id,
                 codec=self.boot_codec,
-                generate_tokens=self.boot_generate,
             )
         except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
             log.error("model boot failed", err=repr(e))
@@ -607,6 +606,32 @@ class ReceiverNode:
             )
         except (OSError, KeyError) as e:
             log.error("failed to send bootReadyMsg", err=repr(e))
+        if (self.boot_generate > 0 and res.kind == "full"
+                and res.params is not None):
+            # Decode AFTER reporting: the leader's TTFT clock stops at
+            # the last BootReadyMsg, and serving time must not
+            # contaminate it.
+            import time as _time
+
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            from ..models.generate import generate
+
+            try:
+                t_gen = _time.monotonic()
+                toks = generate(res.params,
+                                _jnp.zeros((1, 16), _jnp.int32),
+                                self.boot_cfg,
+                                max_new=self.boot_generate)
+                _jax.block_until_ready(toks)
+                res.tokens = toks
+                log.info("decoded tokens after boot",
+                         generated=int(toks.shape[1]),
+                         decode_ms=round(
+                             (_time.monotonic() - t_gen) * 1000, 1))
+            except Exception as e:  # noqa: BLE001 — serving is best-effort here
+                log.error("post-boot decode failed", err=repr(e))
 
     # ------------------------------------------------- pod serving (spmd)
 

@@ -5,6 +5,7 @@ conf/config.json; these tests cover our equivalents end to end.
 """
 
 import json
+import os
 import subprocess
 import sys
 
@@ -254,6 +255,59 @@ def test_local_4node_runs_end_to_end(tmp_path):
         assert b"Time to deliver" in leader.stdout, leader.stderr[-2000:]
         for p in procs:
             assert p.wait(timeout=30) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_boot_cli_generates_tokens(tmp_path):
+    """The full CLI serving loop: boot_tiny topology with -gen — the
+    assignee boots the delivered model AND decodes tokens; the leader
+    prints Time to first token."""
+    import socket
+
+    with open(f"{CONF_DIR}/boot_tiny_4node.json") as f:
+        conf = json.load(f)
+    # Hold every probe socket until all ports are collected: closing one
+    # at a time leaves a window where another process claims it.
+    socks = [socket.socket() for _ in conf["Nodes"]]
+    try:
+        for s_, n in zip(socks, conf["Nodes"]):
+            s_.bind(("127.0.0.1", 0))
+            n["Addr"] = f"127.0.0.1:{s_.getsockname()[1]}"
+    finally:
+        for s_ in socks:
+            s_.close()
+    conf_path = str(tmp_path / "boot.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "3", "-gen", "2"]
+    procs = []
+    try:
+        for i in range(1, 4):
+            procs.append(subprocess.Popen(
+                cli + ["-id", str(i)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                env=env, text=True))
+        leader = subprocess.run(
+            cli + ["-id", "0"], stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, timeout=180, env=env, text=True,
+        )
+        assert "Time to deliver" in leader.stdout
+        assert "Time to first token" in leader.stdout
+        errs = {}
+        for i, p in enumerate(procs, start=1):
+            _, errs[i] = p.communicate(timeout=30)
+            assert p.returncode == 0, errs[i][-2000:]
+        # The assignee (node 3) decoded tokens after its full boot.
+        assert '"generated": 2' in errs[3], errs[3][-2000:]
     finally:
         for p in procs:
             if p.poll() is None:
