@@ -75,6 +75,36 @@ def decode_head(cfg, src, codec: str = "raw"):
     return quant.head_from_blob_host(cfg, data, codec)
 
 
+def decode_after_boot(cfg, res, n: int, tokens=None):
+    """Greedy-decode ``n`` tokens from a FULL boot's resident params
+    (the KV-cached serving loop, models/generate.py); records
+    ``res.tokens``.  THE shared post-boot decode: ``boot_from_layers``'s
+    ``generate_tokens`` and the receiver's ``-gen`` both route here, and
+    both keep it out of the TTFT clock — serving time, not boot time."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.generate import generate
+
+    if n <= 0:
+        return None
+    if res.kind != "full" or res.params is None:
+        log.warn("decode skipped: -gen needs a FULL boot (this node "
+                 "booted a pipeline stage)", kind=res.kind, requested=n)
+        return None
+    t_gen = time.monotonic()
+    if tokens is None:
+        tokens = jnp.zeros((1, 16), jnp.int32)
+    toks = generate(res.params, tokens, cfg, max_new=n)
+    jax.block_until_ready(toks)
+    res.tokens = toks
+    log.info("decoded tokens after boot", generated=int(toks.shape[1]),
+             decode_ms=round((time.monotonic() - t_gen) * 1000, 1))
+    return toks
+
+
 def boot_from_layers(
     cfg,
     layers: LayersSrc,
@@ -168,26 +198,12 @@ def boot_from_layers(
         # TTFT stops HERE: the decode below is serving time, not boot
         # time — it must not contaminate the metric reported next to TTD.
         dt = time.monotonic() - t0
-        generated = None
-        decode_ms = 0.0
-        if generate_tokens > 0:
-            # The booted engine SERVES: KV-cached greedy decode
-            # (models/generate.py) — dissemination ends at emitted
-            # tokens, not just a logits tensor (dense and MoE alike).
-            from ..models.generate import generate
-
-            t_gen = time.monotonic()
-            generated = generate(params, tokens, cfg,
-                                 max_new=generate_tokens)
-            jax.block_until_ready(generated)
-            decode_ms = (time.monotonic() - t_gen) * 1000
         log.info("model booted from disseminated layers", kind="full",
-                 layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1),
-                 generated=(int(generated.shape[1])
-                            if generated is not None else 0),
-                 decode_ms=round(decode_ms, 1))
-        return BootResult("full", dt, layer_ids, logits=logits,
-                          tokens=generated, params=params)
+                 layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1))
+        res = BootResult("full", dt, layer_ids, logits=logits,
+                         params=params)
+        decode_after_boot(cfg, res, generate_tokens)
+        return res
 
     # Stage boot: run this stage's slice on dummy activations.
     def stage_forward(stacked, x):

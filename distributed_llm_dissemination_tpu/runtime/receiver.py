@@ -606,30 +606,14 @@ class ReceiverNode:
             )
         except (OSError, KeyError) as e:
             log.error("failed to send bootReadyMsg", err=repr(e))
-        if (self.boot_generate > 0 and res.kind == "full"
-                and res.params is not None):
+        if self.boot_generate > 0:
             # Decode AFTER reporting: the leader's TTFT clock stops at
             # the last BootReadyMsg, and serving time must not
             # contaminate it.
-            import time as _time
-
-            import jax as _jax
-            import jax.numpy as _jnp
-
-            from ..models.generate import generate
+            from .boot import decode_after_boot
 
             try:
-                t_gen = _time.monotonic()
-                toks = generate(res.params,
-                                _jnp.zeros((1, 16), _jnp.int32),
-                                self.boot_cfg,
-                                max_new=self.boot_generate)
-                _jax.block_until_ready(toks)
-                res.tokens = toks
-                log.info("decoded tokens after boot",
-                         generated=int(toks.shape[1]),
-                         decode_ms=round(
-                             (_time.monotonic() - t_gen) * 1000, 1))
+                decode_after_boot(self.boot_cfg, res, self.boot_generate)
             except Exception as e:  # noqa: BLE001 — serving is best-effort here
                 log.error("post-boot decode failed", err=repr(e))
 

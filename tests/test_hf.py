@@ -197,3 +197,72 @@ def test_greedy_generate_matches_transformers(hf_dir):
         )
     theirs = out[:, prompt.shape[1]:].numpy()
     np.testing.assert_array_equal(ours, theirs)
+
+
+def test_hf_checkpoint_two_stage_pod_serve(hf_dir, cpu_devices):
+    """Composition: a real HF checkpoint disseminated across TWO pipeline
+    stages, then ONE forward across the pod from the staged weights —
+    logits must match the transformers implementation."""
+    from distributed_llm_dissemination_tpu.parallel.mesh import (
+        assignment_to_placement,
+        make_mesh,
+    )
+    from distributed_llm_dissemination_tpu.runtime.pp_serve import pod_forward
+
+    name = "hf:" + hf_dir
+    cfg = hf.config_from_dir(hf_dir)
+    head_id = serde.head_blob_id(cfg)
+    cut = cfg.n_layers // 2
+
+    mesh = make_mesh((2, 4), ("pp", "tp"))
+    assignment = {
+        1: {b: LayerMeta() for b in range(cut)},
+        2: {b: LayerMeta() for b in range(cut, head_id + 1)},
+    }
+    placement = assignment_to_placement(assignment, mesh, "pp")
+
+    nc = cfg_mod.NodeConf(
+        id=0, addr="0",
+        initial_layers={SourceType.MEM: {b: 0 for b in range(head_id + 1)}},
+        sources={SourceType.MEM: 0},
+    )
+    seed_layers = cfg_mod.create_layers(nc, save_disk=False, model=name)
+
+    ts = {i: InmemTransport(str(i)) for i in range(3)}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), seed_layers, assignment,
+        {i: 10**9 for i in range(3)}, expected_nodes={1, 2},
+    )
+    receivers = {
+        i: FlowRetransmitReceiverNode(
+            Node(i, 0, ts[i]), {}, stage_hbm=True, placement=placement,
+            boot_cfg=cfg,
+        )
+        for i in (1, 2)
+    }
+    try:
+        for r in receivers.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        booted = leader.boot_ready().get(timeout=60)
+        assert set(booted) == {1, 2}
+
+        results = {i: r.boot_result for i, r in receivers.items()}
+        stores = {i: r.layers for i, r in receivers.items()}
+        tokens = np.arange(32, dtype=np.int32).reshape(2, 16) % cfg.vocab
+        out = pod_forward(cfg, placement, results, stores,
+                          jnp.asarray(tokens))
+        assert out is not None
+        logits, _ = out
+        theirs = _hf_logits(hf_dir, tokens)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(logits)), theirs,
+            rtol=2e-3, atol=2e-3,
+        )
+    finally:
+        leader.close()
+        for r in receivers.values():
+            r.close()
+        for t in ts.values():
+            t.close()
