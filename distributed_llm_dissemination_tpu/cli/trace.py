@@ -36,6 +36,7 @@ _DURATION_RULES = {
     "(a fraction of) layer received": ("receive layer", "duration_ms"),
     "finished sending layer": ("send layer", "send_dur_ms"),
     "Job assignment completed": ("flow solve", "computation_ms"),
+    "decoded tokens after boot": ("decode", "decode_ms"),
 }
 
 _INSTANT_MESSAGES = {
@@ -61,6 +62,13 @@ _INSTANT_MESSAGES = {
     "model booted from disseminated layers",
     "pipeline stage booted from disseminated layers",
     "released fabric upload cache",
+    # Multi-controller fabric + serving lifecycle:
+    "spmd fabric up",
+    "spmd fabric plan cancelled",
+    "spmd fabric stalled waiting for plan seq",
+    "pod serve dispatched",
+    "pod serve cancelled: pod no longer servable",
+    "pod pipelined forward from staged weights",
 }
 
 

@@ -81,8 +81,6 @@ def decode_after_boot(cfg, res, n: int, tokens=None):
     ``res.tokens``.  THE shared post-boot decode: ``boot_from_layers``'s
     ``generate_tokens`` and the receiver's ``-gen`` both route here, and
     both keep it out of the TTFT clock — serving time, not boot time."""
-    import time
-
     import jax
     import jax.numpy as jnp
 
@@ -202,7 +200,7 @@ def boot_from_layers(
                  layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1))
         res = BootResult("full", dt, layer_ids, logits=logits,
                          params=params)
-        decode_after_boot(cfg, res, generate_tokens)
+        decode_after_boot(cfg, res, generate_tokens, tokens=tokens)
         return res
 
     # Stage boot: run this stage's slice on dummy activations.
