@@ -7,10 +7,11 @@ What runs is exactly what a mode-3 receiver runs on delivery
 (``runtime/receiver.py`` → ``parallel/ingest.py``): a Llama-3-8B-sized
 layer (~416 MiB) arrives as 8 byte-range fragments (the multi-sender
 flow-job splits of the reference's mode 3, flow.go:193-211), each fragment
-is written through ``ShardedLayerIngest.write`` (host→HBM DMA into its
-span's device shard at the right offset), and ``finalize`` runs the
-completion collective that materializes the layer replicated on the device
-set.  The clock covers write+finalize end to end — no proxy kernels.
+is written through ``ShardedLayerIngest.write`` (accelerator: an async
+host→HBM DMA per span piece; CPU backend: a memcpy into the aligned host
+buffer that finalize adopts zero-copy), and ``finalize`` materializes the
+layer on the device set.  The clock covers write+finalize end to end — no
+proxy kernels.
 
 Honest denominators, both reported:
 - ``vs_baseline``: against the reference's modeled per-node NIC line rate,
@@ -61,36 +62,60 @@ def ingest_once(total, frags, devices):
     return arr
 
 
-def ensure_live_backend(probe_timeout: float = 120.0) -> str:
+PROBE_ATTEMPT_TIMEOUT_S = 75.0
+PROBE_BUDGET_S = 240.0  # keep retrying the tunnel for up to ~4 minutes
+PROBE_RETRY_PAUSE_S = 10.0
+
+
+def ensure_live_backend() -> tuple:
     """The accelerator arrives via a tunnel that can wedge hard: even
     ``jax.devices()`` then blocks forever (and JAX_PLATFORMS=cpu alone
     doesn't help — plugin init still touches the relay).  Probe device
-    init in a THROWAWAY subprocess first; if it can't come up in time,
-    re-exec this benchmark pinned to the CPU backend so the run records
-    a marked fallback number instead of hanging the harness."""
+    init in a THROWAWAY subprocess first.  The tunnel also RECOVERS on
+    minute scales, so one failed probe must not condemn the whole run to
+    the CPU number: retry across a probe budget (round 3 lost its
+    hardware number to a single-shot probe), and only then re-exec pinned
+    to the CPU backend so the run records a marked fallback instead of
+    hanging the harness.  Returns (backend, probe_attempts)."""
     if os.environ.get("_BENCH_BACKEND"):  # re-exec'd child: decided
-        return os.environ["_BENCH_BACKEND"]
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print(jax.default_backend())"],
-            timeout=probe_timeout, capture_output=True, text=True,
-        )
-        backend = probe.stdout.strip().splitlines()[-1] if probe.returncode == 0 else ""
-    except subprocess.TimeoutExpired:
-        backend = ""
-    if backend:
-        os.environ["_BENCH_BACKEND"] = backend
-        return backend
+        return (os.environ["_BENCH_BACKEND"],
+                json.loads(os.environ.get("_BENCH_PROBE_ATTEMPTS", "[]")))
+    attempts = []
+    probe_t0 = time.monotonic()
+    while True:
+        t0 = time.monotonic()
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print(jax.default_backend())"],
+                timeout=PROBE_ATTEMPT_TIMEOUT_S, capture_output=True,
+                text=True,
+            )
+            lines = probe.stdout.strip().splitlines()
+            # Empty stdout on rc=0 is still a failed probe, not a crash.
+            backend = (lines[-1] if probe.returncode == 0 and lines else "")
+            outcome = backend or f"rc={probe.returncode}"
+        except subprocess.TimeoutExpired:
+            backend, outcome = "", "timeout"
+        attempts.append(
+            {"outcome": outcome,
+             "seconds": round(time.monotonic() - t0, 1)})
+        if backend:
+            os.environ["_BENCH_BACKEND"] = backend
+            return backend, attempts
+        if time.monotonic() - probe_t0 > PROBE_BUDGET_S:
+            break
+        time.sleep(PROBE_RETRY_PAUSE_S)
     from distributed_llm_dissemination_tpu.utils.env import cpu_pinned_env
 
     env = cpu_pinned_env()
     env["_BENCH_BACKEND"] = "cpu-fallback"
+    env["_BENCH_PROBE_ATTEMPTS"] = json.dumps(attempts)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
 def main() -> None:
-    backend = ensure_live_backend()
+    backend, probe_attempts = ensure_live_backend()
     # jax only becomes importable-safe once the backend decision is made
     # (under a wedged tunnel even the import can block on the relay).
     global jax, np
@@ -121,8 +146,8 @@ def main() -> None:
         jax.block_until_ready(jax.device_put(bulk, devices[0]))
         return time.monotonic() - t0
 
-    # Warm both paths (compiles _write_1d per fragment-cut shape and the
-    # finalize gather; first DMA maps buffers), then alternate timings.
+    # Warm both paths (compiles the finalize splice on the stream arm;
+    # first DMA maps buffers), then alternate timings.
     # The budget clock starts BEFORE the warmup: in a slow link phase the
     # warmup itself costs a pair's worth of transfers, and a budget that
     # ignored it could still blow a CI timeout.
@@ -172,13 +197,19 @@ def main() -> None:
                 "link_fraction": round(link_fraction, 3),
                 "link_fraction_spread": [
                     round(min(ratios), 3), round(max(ratios), 3)],
+                "probe_attempts": probe_attempts,
                 "note": "absolute GB/s is bound by this host's measured "
                         "device link (raw_dma_gbps); link_fraction is the "
                         "framework's efficiency on it — the median of "
                         "per-trial raw/ingest pair ratios (pairing cancels "
                         "the link's minute-scale bandwidth drift); >1 means "
-                        "the fragment-pipelined ingest outperforms a single "
-                        "bulk DMA of the same bytes",
+                        "the fragment ingest beats a single bulk DMA of the "
+                        "same bytes.  On an accelerator the ingest streams "
+                        "per-fragment async DMAs and splices on-device; on "
+                        "the CPU backend it assembles once into an aligned "
+                        "host buffer and adopts it zero-copy (there is no "
+                        "host->device link to cross), so >1 is the design "
+                        "working, not a measurement artifact",
             }
         )
     )

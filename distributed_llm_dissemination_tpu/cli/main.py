@@ -82,6 +82,22 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def validate_boot_choice(args, conf) -> None:
+    """`-boot <name>` naming a model different from the config's Model is
+    a config error: the disseminated bytes are sized/laid out (and codec-
+    encoded, conf.model_codec) for the config's model, so booting another
+    one can only fail later as a swallowed boot error.  Fail fast at
+    argument validation instead (like the -gen checks).  `-boot none`
+    (opt out of booting) always passes."""
+    if (args.boot and args.boot != "none" and conf.model
+            and args.boot != conf.model):
+        raise SystemExit(
+            f"-boot {args.boot!r} names a different model than the "
+            f"config's Model {conf.model!r}: the layer bytes on the wire "
+            f"are the config model's; drop -boot or fix the config"
+        )
+
+
 def boot_config(name: str):
     if not name or name == "none":
         # "-boot none" opts a boot-capable topology (a Model section) out
@@ -155,6 +171,7 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
 
     # One flag governs the run: the leader's decision rides StartupMsg,
     # so receivers can never boot (or skip) against the leader's wait.
+    validate_boot_choice(args, conf)
     leader.boot_enabled = boot_config(args.boot or conf.model) is not None
 
     print(
@@ -265,6 +282,7 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
         placement = build_placement(args, conf)
     # A config with a Model section is boot-capable: receivers boot by
     # default so the leader's boot wait can't hang on a missing flag.
+    validate_boot_choice(args, conf)
     boot_cfg = boot_config(args.boot or conf.model)
     if args.gen < 0:
         raise SystemExit(f"-gen must be >= 0, got {args.gen}")

@@ -278,7 +278,12 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig) -> jax.
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["ln_f"], cfg.norm_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    # f32 accumulation, matching the KV-cached decode head
+    # (generate.py:_step_fn) — on bf16 checkpoints a lower-precision
+    # accumulation here could make greedy argmax diverge between the
+    # full forward and the decode loop.
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(params: Dict[str, Any], tokens: jax.Array, cfg: ModelConfig) -> jax.Array:

@@ -191,7 +191,8 @@ def _local_loss(cfg: ModelConfig, pp_size: int, params, inputs, targets):
             x = lax.ppermute(x, "pp", fwd)
 
     xn = rms_norm(x, params["ln_f"], cfg.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", xn, params["lm_head"]).astype(jnp.float32)
+    logits = jnp.einsum("bsd,dv->bsv", xn, params["lm_head"],
+                        preferred_element_type=jnp.float32)
 
     # Cross-entropy over the tp-sharded vocab: global logsumexp via
     # pmax+psum; the target logit is owned by exactly one tp member.
@@ -316,8 +317,9 @@ def build_pp_forward(cfg: ModelConfig, mesh: Mesh, pp_axis: str):
             x = lax.psum(jnp.where(idx == 0, x, 0.0), pp_axis)
         xn = rms_norm(x, head["ln_f"], cfg.norm_eps)
         return jnp.einsum(
-            "bsd,dv->bsv", xn, head["lm_head"]
-        ).astype(jnp.float32)
+            "bsd,dv->bsv", xn, head["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
 
     f = jax.shard_map(
         per_device,

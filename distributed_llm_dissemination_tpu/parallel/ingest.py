@@ -6,16 +6,28 @@ TPU-native terminal state is the layer replicated in the HBM of its
 pipeline stage's devices.  The naive way to get there — assemble on host,
 then ``device_put`` the full layer replicated — pays the host→device link
 ``layer_size × n_devices`` bytes and only starts after the last network
-byte.  This module does it the TPU way:
+byte.  This module does it the TPU way, with a platform-split terminal
+hop (the two physical situations want opposite designs):
 
-- The layer's byte range is tiled across the stage's devices (the same
-  offset/size shape as a mode-3 flow plan, flow.go:193-211).
-- Each arriving network fragment is cut against that tiling and each piece
-  is DMA'd to exactly ONE device, into a preallocated shard buffer at its
-  local offset (``lax.dynamic_update_slice`` under donation) — so PCIe
-  carries ``layer_size`` bytes total, overlapped with the network receive.
-- On completion, one tiled ``all_gather`` replicates the layer across the
-  stage over ICI — the fast fabric does the ×n, not the host link.
+- **Accelerator (stream)**: the layer's byte range is tiled across the
+  stage's devices (the same offset/size shape as a mode-3 flow plan,
+  flow.go:193-211); each arriving fragment is cut against that tiling and
+  each piece is DMA'd to its device immediately as its OWN buffer
+  (``jax.device_put`` is asynchronous, so piece k+1's host-side staging
+  overlaps piece k's DMA — and all of it overlaps the network receive).
+  ``finalize`` splices the pieces with one on-device concat per device —
+  HBM-bandwidth work, negligible next to the host-link DMA — then one
+  tiled ``all_gather`` replicates the layer across the stage over ICI.
+  PCIe carries ``layer_size`` bytes exactly once, pipelined; no
+  preallocated zero-fill, no per-piece read-modify-write of a big buffer.
+- **CPU backend (host-accumulate)**: there is no host→device link —
+  "device memory" IS host memory, so any ``device_put`` is pure-overhead
+  copying (measured ~5× slower than a plain memcpy on the bench host).
+  Fragments are memcpy'd into a preallocated 64-byte-aligned host buffer
+  per span, and ``finalize`` adopts each buffer zero-copy as its device's
+  array via DLPack (``utils.hostmem``).  The full layer materializes with
+  ONE host memcpy total — faster than the naive bulk ``device_put`` of
+  the same bytes, which is exactly the bar ``bench.py`` measures.
 
 ``ingest_bytes`` is the one-shot form (whole buffer already on host) used
 by mode-0/1/2 receivers; it routes through
@@ -26,6 +38,7 @@ its terminal hop as a flow plan on the mesh.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -34,9 +47,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.reassembly import _write_1d, split_offsets
+from ..ops.reassembly import split_offsets
 from ..sched.flow import FlowJob
-from ..utils import intervals
+from ..utils import hostmem, intervals
 from .collectives import gather_tiles
 from .plan import execute_flow_plan
 
@@ -60,10 +73,18 @@ def synthesize_jobs(total_bytes: int, n: int, layer_id: int = 0) -> List[FlowJob
 def ingest_bytes(data, devices: Sequence[jax.Device]) -> jax.Array:
     """One-shot sharded ingest: split ``data`` across ``devices`` (1/n of
     the host→device traffic each) and all-gather over ICI so the full
-    layer lands replicated on all of them.  Returns a uint8 jax.Array."""
+    layer lands replicated on all of them.  Returns a uint8 jax.Array.
+
+    Single-CPU-device fast path: copy once into an aligned buffer and
+    adopt it zero-copy (``utils.hostmem``) — a plain ``device_put`` here
+    would memcpy the same bytes twice as slowly for no semantic gain."""
     data = memoryview(data)
     n = len(devices)
     if n == 1:
+        if devices[0].platform == "cpu":
+            buf = hostmem.aligned_empty(len(data))
+            buf[:] = np.frombuffer(data, dtype=np.uint8)
+            return hostmem.adopt_as_device_array(buf, devices[0])
         return jax.device_put(np.frombuffer(data, dtype=np.uint8), devices[0])
     if len(data) < n:
         # Too small to tile one byte per device; still must land replicated
@@ -78,22 +99,42 @@ def ingest_bytes(data, devices: Sequence[jax.Device]) -> jax.Array:
     return execute_flow_plan(jobs, frags, mesh, "ingest", dtype=jnp.uint8)
 
 
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _concat_pad(pieces, pad: int):
+    """Splice offset-ordered pieces into one padded span buffer — a single
+    compiled HBM-local concat (cached per piece-shape tuple, which repeats
+    across a run's layers: every layer of a model shares its flow split)."""
+    buf = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+    if buf.shape[0] < pad:
+        buf = jnp.pad(buf, (0, pad - buf.shape[0]))
+    return buf
+
+
 class ShardedLayerIngest:
     """Incremental device ingest of one layer onto a device set.
 
     Fragments arrive in any order with byte offsets (the mode-3 receive
     path, node.go:1520-1567); ``write`` lands each piece on its span's
     device immediately — overlapping HBM ingest with the network receive —
-    and ``finalize`` runs the gather collective once coverage is complete.
+    and ``finalize`` runs the splice + gather once coverage is complete.
 
     Thread-safe: the receiver's handler pool may deliver fragments
-    concurrently.  The ingest keeps its OWN byte-coverage intervals, and
-    ``finalize`` blocks until they cover the layer — so a completion
-    handler racing a sibling fragment handler (host coverage counted, device
-    write not yet executed) can never gather a buffer with holes.
+    concurrently.  ``write`` CLAIMS its uncovered byte ranges under the
+    lock before moving any bytes (so overlapping duplicates never copy
+    twice, and concurrent writers can't both land the same range), then
+    does the heavy byte movement outside the lock; ``_inflight`` tracks
+    claims whose bytes are still moving (a failed claim rolls its
+    coverage back), and ``finalize`` blocks until coverage is complete
+    AND no claim is outstanding — so a completion handler racing a
+    sibling fragment handler can never splice a buffer with holes.
+
+    Peak device footprint is ~2× the layer's span bytes during the splice
+    (pieces + concat output), same order as the gather epilogue the
+    multi-device path already pays.
     """
 
-    def __init__(self, total_bytes: int, devices: Sequence[jax.Device]):
+    def __init__(self, total_bytes: int, devices: Sequence[jax.Device],
+                 stream: Optional[bool] = None):
         if total_bytes <= 0:
             raise ValueError("empty layer")
         self.total = total_bytes
@@ -103,21 +144,36 @@ class ShardedLayerIngest:
         # to the largest so the final gather is one tiled collective.
         self.spans: List[Tuple[int, int]] = list(split_offsets(total_bytes, n))
         self.pad = max(size for _, size in self.spans)
+        # ``stream`` overrides the platform auto-split (None): tests and
+        # CPU-mesh dryruns use it to exercise the accelerator arm.
+        if stream is None:
+            stream = not all(d.platform == "cpu" for d in self.devices)
+        self._cpu = not stream
         self._lock = threading.Lock()
         self._complete = threading.Condition(self._lock)
         self._covered: List[Tuple[int, int]] = []
+        # Claims whose bytes are still being moved: token -> claimed
+        # ranges.  Tracked as ranges (not a bare count) so a failed claim
+        # rolls its coverage back and salvage can exclude in-flight ones.
+        self._inflight: dict = {}
+        self._claim_tok = 0
         self._failed = False
-        self._closed = False  # finalize ran: late duplicate writes no-op
-        # Zeros are created ON each device (no host materialization, no
-        # host->device transfer of bytes that are about to be overwritten).
-        self._bufs: List[jax.Array] = []
-        for d in self.devices:
-            with jax.default_device(d):
-                self._bufs.append(jnp.zeros(self.pad, dtype=jnp.uint8))
+        self._closed = False  # finalize/salvage ran: late writes no-op
+        if self._cpu:
+            # Host-accumulate (see module docstring).  pad-sized so the
+            # multi-device gather needs no reallocation; the tail past the
+            # span's real size is never read (gather_tiles slices it off).
+            self._host: Optional[List[np.ndarray]] = [
+                hostmem.aligned_empty(self.pad) for _ in range(n)
+            ]
+            self._pieces: Optional[List[List[Tuple[int, jax.Array]]]] = None
+        else:
+            self._host = None
+            self._pieces = [[] for _ in range(n)]  # (local_off, piece)
 
     def write(self, offset: int, data) -> None:
         """Cut ``data`` (at absolute byte ``offset``) against the device
-        tiling; move each piece to its device's shard buffer.
+        tiling; move each piece toward its device's span.
 
         ``data`` is either a host buffer (bytes/bytearray/memoryview —
         the TCP receive path: pieces are host→device DMAs) or a 1-D uint8
@@ -137,44 +193,70 @@ class ShardedLayerIngest:
             raise ValueError(
                 f"fragment [{offset}, {end}) outside layer of {self.total} bytes"
             )
-        if self._closed:
-            # Cheap early exit for a late duplicate racing finalize (benign
-            # race: _closed only transitions False→True; the locked check
-            # below still guards the donation chain).
-            return
-        # Cut against the tiling and issue the host→device DMAs OUTSIDE the
-        # lock: the 16-worker handler pool must not serialize behind device
-        # transfers (nor block finalize waiters on them).  The lock is then
-        # held only to swap the donated shard buffers (dispatch-only; the
-        # donation chain requires exclusive ownership of _bufs) and to
-        # update coverage.
-        pieces = []
-        for r, (s_off, s_size) in enumerate(self.spans):
-            lo = max(offset, s_off)
-            hi = min(end, s_off + s_size)
-            if lo >= hi:
-                continue
-            if is_device:
-                piece = data[lo - offset : hi - offset]  # lazy on-src slice
-            else:
-                piece = np.frombuffer(data[lo - offset : hi - offset], np.uint8)
-            pieces.append(
-                (r, lo - s_off, jax.device_put(piece, self.devices[r]))
-            )
         with self._lock:
             if self._closed:
                 # A late duplicate racing finalize: its bytes are already
-                # covered (finalize only runs at full coverage), and a
-                # donating write here would invalidate the buffers the
-                # gather is consuming.
+                # covered (finalize only runs at full coverage).
                 return
-            for r, local_off, dev_piece in pieces:
-                self._bufs[r] = _write_1d(
-                    self._bufs[r], dev_piece, jnp.asarray(local_off, jnp.int32)
-                )
-            self._covered = intervals.insert(self._covered, offset, end)
-            if intervals.covered(self._covered) >= self.total:
+            claims = intervals.uncovered(self._covered, offset, end)
+            if not claims:
+                return  # full duplicate — idempotent
+            for lo, hi in claims:
+                self._covered = intervals.insert(self._covered, lo, hi)
+            tok = self._claim_tok
+            self._claim_tok += 1
+            self._inflight[tok] = claims
+        landed: List[Tuple[int, int, jax.Array]] = []
+        try:
+            for lo, hi in claims:
+                for r, (s_off, s_size) in enumerate(self.spans):
+                    a = max(lo, s_off)
+                    b = min(hi, s_off + s_size)
+                    if a >= b:
+                        continue
+                    if self._cpu:
+                        src = data[a - offset : b - offset]
+                        piece = (np.asarray(src) if is_device
+                                 else np.frombuffer(src, np.uint8))
+                        # Claimed ranges are exclusive: concurrent writers
+                        # memcpy into disjoint slices, safely lock-free.
+                        self._host[r][a - s_off : b - s_off] = piece
+                    else:
+                        if is_device:
+                            src = data[a - offset : b - offset]  # on-src slice
+                        else:
+                            src = np.frombuffer(
+                                data[a - offset : b - offset], np.uint8)
+                        landed.append(
+                            (r, a - s_off,
+                             jax.device_put(src, self.devices[r]))
+                        )
+        except Exception:
+            with self._lock:
+                # Roll the claim's coverage back (its bytes never landed —
+                # salvage must not report them) and poison the ingest so
+                # finalize falls back to bulk staging.
+                del self._inflight[tok]
+                for lo, hi in claims:
+                    self._covered = intervals.remove(self._covered, lo, hi)
+                self._failed = True
                 self._complete.notify_all()
+            raise
+        with self._lock:
+            del self._inflight[tok]
+            if not self._closed and self._pieces is not None:
+                for r, local_off, piece in landed:
+                    self._pieces[r].append((local_off, piece))
+            if not self._inflight:
+                # Wakes finalize (full coverage) and salvage (quiescence).
+                self._complete.notify_all()
+
+    def _quiesce(self, timeout: float = 30.0) -> None:
+        """Wait until no write claim is in flight (test/diagnostic hook;
+        does NOT wait for full coverage)."""
+        with self._lock:
+            self._complete.wait_for(lambda: not self._inflight,
+                                    timeout=timeout)
 
     def fail(self) -> None:
         """Mark the ingest broken (a device write failed); wakes any
@@ -185,50 +267,90 @@ class ShardedLayerIngest:
             self._complete.notify_all()
 
     def salvage(self) -> List[Tuple[int, bytes]]:
-        """Read the covered byte ranges back out of the shard buffers
-        (device→host) — the escape hatch when the gather collective (or a
-        later write) fails: everything successfully written is already on
-        the dest's devices, so a host-side fallback assembly needs no
-        retained copies of the in-flight fragments.  Closes the ingest."""
+        """Read the covered byte ranges back out of the span buffers —
+        the escape hatch when the gather collective (or a later write)
+        fails: everything successfully written is already staged, so a
+        host-side fallback assembly needs no retained copies of the
+        in-flight fragments.  Closes the ingest."""
         with self._lock:
+            # Quiesce in-flight claims first: coverage is reserved BEFORE
+            # bytes move, so reading mid-claim could return holes.
+            self._complete.wait_for(lambda: not self._inflight, timeout=30.0)
             self._closed = True
             covered = list(self._covered)
-            bufs = [np.asarray(jax.device_get(b)) for b in self._bufs]
-        out: List[Tuple[int, bytes]] = []
-        for s, e in covered:
-            for r, (s_off, s_size) in enumerate(self.spans):
-                lo = max(s, s_off)
-                hi = min(e, s_off + s_size)
-                if lo < hi:
-                    out.append((lo, bufs[r][lo - s_off : hi - s_off].tobytes()))
+            # A claim still in flight past the timeout must not be read
+            # as landed bytes — subtract it from the salvage view.
+            for claims in self._inflight.values():
+                for lo, hi in claims:
+                    covered = intervals.remove(covered, lo, hi)
+            if self._cpu:
+                out: List[Tuple[int, bytes]] = []
+                for s, e in covered:
+                    for r, (s_off, s_size) in enumerate(self.spans):
+                        lo = max(s, s_off)
+                        hi = min(e, s_off + s_size)
+                        if lo < hi:
+                            out.append((
+                                lo,
+                                self._host[r][lo - s_off : hi - s_off]
+                                .tobytes(),
+                            ))
+                return out
+            pieces = [sorted(p) for p in self._pieces]
+        out = []
+        for r, (s_off, _) in enumerate(self.spans):
+            for local_off, piece in pieces[r]:
+                out.append((s_off + local_off, jax.device_get(piece).tobytes()))
         return out
 
+    def _splice(self, r: int, pieces: List[Tuple[int, jax.Array]]) -> jax.Array:
+        """One device's offset-ordered pieces → its padded span buffer.
+        Full coverage + exclusive claims guarantee the pieces tile the
+        span exactly, so this is a straight concat (+ tail pad)."""
+        if not pieces:  # a zero-size span (more devices than bytes)
+            with jax.default_device(self.devices[r]):
+                return jnp.zeros(self.pad, dtype=jnp.uint8)
+        if len(pieces) == 1 and pieces[0][1].shape[0] == self.pad:
+            return pieces[0][1]  # whole span arrived as one piece: no copy
+        return _concat_pad([p for _, p in pieces], self.pad)
+
     def finalize(self, timeout: float = 120.0) -> jax.Array:
-        """All-gather the shard buffers into the full layer, replicated on
-        every device of the set.  Blocks until the ingest's own coverage is
-        complete (in-flight sibling writes), then gathers."""
+        """Splice the spans and (multi-device) all-gather them into the
+        full layer, replicated on every device of the set.  Blocks until
+        the ingest's own coverage is complete and no write is in flight."""
         with self._lock:
             self._complete.wait_for(
                 lambda: self._failed
-                or intervals.covered(self._covered) >= self.total,
+                or (not self._inflight
+                    and intervals.covered(self._covered) >= self.total),
                 timeout=timeout,
             )
             self._closed = True  # any write from here on is a no-op
             if self._failed:
                 raise RuntimeError("ingest failed; fall back to bulk staging")
-            if intervals.covered(self._covered) < self.total:
+            if (self._inflight
+                    or intervals.covered(self._covered) < self.total):
                 raise RuntimeError(
                     f"ingest incomplete after {timeout}s: "
                     f"{intervals.covered(self._covered)}/{self.total} bytes"
                 )
-            bufs = list(self._bufs)
-        if len(self.devices) == 1:
-            # split_offsets(total, 1) gives one exact span, so pad == total
-            # and the shard buffer IS the layer — a [:total] slice here
-            # would be a full-layer HBM copy for nothing.
-            return bufs[0] if self.pad == self.total else bufs[0][: self.total]
-        mesh = flat_mesh(self.devices)
+            pieces = (None if self._pieces is None
+                      else [sorted(p) for p in self._pieces])
         n = len(self.devices)
+        if self._cpu:
+            # Zero-copy adoption: the aligned host buffers BECOME the
+            # device arrays (the write memcpy was the only byte movement).
+            # _closed guarantees nothing writes the buffers ever again.
+            if n == 1:  # split_offsets(total, 1): pad == total
+                return hostmem.adopt_as_device_array(
+                    self._host[0], self.devices[0])
+            bufs = [hostmem.adopt_as_device_array(b, d)
+                    for b, d in zip(self._host, self.devices)]
+        else:
+            bufs = [self._splice(r, pieces[r]) for r in range(n)]
+            if n == 1:
+                return bufs[0]
+        mesh = flat_mesh(self.devices)
         global_shape = (n * self.pad,)
         v = jax.make_array_from_single_device_arrays(
             global_shape, NamedSharding(mesh, P("ingest")), bufs

@@ -17,14 +17,28 @@ This module is that discipline applied to dissemination:
   participants — all processes must enter the collective).
 - Each process runs one ``SpmdFabric`` executor thread that executes
   plans strictly in seq order.  For plan k, every process derives the
-  SAME slot assignment from the message alone (deterministic: layout
-  entry -> an unused device rank of the sender's stage), uploads the byte
-  ranges it owns onto its own local devices, assembles the global sharded
-  array, and enters one compiled gather
-  (``collectives.gather_tiles_at``): the layer materializes replicated on
-  every device, the byte traffic riding ICI on real hardware.
+  SAME scope and slot assignment from the message alone: the collective
+  runs on the SUB-MESH of the participating stages (the senders' stages
+  ∪ the dest's stage), each layout entry landing on an unused device
+  rank of its sender's stage within that scope.  A process with no
+  device in the scope advances the seq WITHOUT entering any collective
+  — so the layer replicates onto the participants only (a 2-stage
+  transfer on a 32-stage pod pays a 2-stage gather, not a pod-wide
+  one), and plans with disjoint participants genuinely overlap across
+  the pod.
+- Participants upload the byte ranges they own onto their own local
+  devices, assemble the scoped sharded array, and enter one compiled
+  gather (``collectives.gather_tiles_at``); the byte traffic rides ICI
+  on real hardware.
 - The plan's dest keeps its local copy (stage-replicated, exactly the
-  ``-hbm`` terminal state); everyone else drops theirs immediately.
+  ``-hbm`` terminal state); other participants drop theirs immediately.
+- Execution is PIPELINED: the executor dispatches a plan's uploads and
+  gather asynchronously and only blocks when a small in-flight window
+  fills (or the queue idles), so plan k+1's host→device uploads overlap
+  plan k's collective.  Per-process seq order — and therefore the
+  cross-process enqueue order every pair of participants agrees on — is
+  unchanged; a plan's result resolves only once its device work really
+  finished, so a dest never acks bytes that could still fail.
 
 An empty-layout plan is a CANCELLATION: the leader aborted dispatch
 mid-broadcast, and every process advances past the seq without entering
@@ -39,12 +53,14 @@ executor logs loudly when a gap persists past ``gap_timeout``.
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.logging import log
 
 PLAN_WAIT_S = 120.0  # dest-side wait for its plan's collective
+MAX_INFLIGHT = 2  # dispatched-but-unretired plans (bounds device memory)
 
 
 class PlanFailed(RuntimeError):
@@ -180,56 +196,96 @@ class SpmdFabric:
 
     # ------------------------------------------------------------ executor
 
+    def _retire_oldest(self, inflight) -> None:
+        """Block until the oldest dispatched plan's device work finished,
+        then resolve its result — success and failure both surface HERE,
+        so a dest only ever acks bytes that really landed."""
+        import jax
+
+        plan_id, res, value, out = inflight.popleft()
+        try:
+            jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001 — resolve, don't die
+            log.error("spmd fabric plan failed", plan=plan_id, err=repr(e))
+            res.resolve(error=e)
+            return
+        res.resolve(value=value)
+
     def _run(self) -> None:
+        # (plan_id, result, dest value, gathered array) dispatched but not
+        # yet known-finished.  The deque IS the pipeline: dispatch runs
+        # ahead of completion by up to MAX_INFLIGHT collectives.
+        inflight = collections.deque()
         while True:
             with self._cond:
                 waited = self._cond.wait_for(
                     lambda: self._closed or self._next_seq in self._pending,
-                    timeout=self.gap_timeout,
+                    # With work in flight, don't sleep the whole gap:
+                    # retire it while the queue is idle.
+                    timeout=0.02 if inflight else self.gap_timeout,
                 )
                 if self._closed:
                     for res in self._results.values():
                         if not res.event.is_set():
                             res.resolve(error=PlanFailed("fabric closed"))
                     return
-                if not waited:
-                    if self._pending:
-                        # Later seqs queued behind a gap: the pod-wide
-                        # lockstep is stalled.  Only the control plane can
-                        # fix this; make it loud.
-                        log.error(
-                            "spmd fabric stalled waiting for plan seq",
-                            next_seq=self._next_seq,
-                            queued=sorted(self._pending),
-                        )
-                    continue
-                msg = self._pending.pop(self._next_seq)
-                self._next_seq += 1
-                # Kept (resolved) in _results so late duplicate deliveries
-                # get the settled handle instead of a dangling fresh one;
-                # the map grows by one small entry per plan per run.
-                res = self._results[msg.plan_id]
+                msg = None
+                stalled_on = sorted(self._pending) if self._pending else []
+                if waited:
+                    msg = self._pending.pop(self._next_seq)
+                    self._next_seq += 1
+                    # Kept (resolved) in _results so late duplicate
+                    # deliveries get the settled handle instead of a
+                    # dangling fresh one; the map grows by one small entry
+                    # per plan per run.
+                    res = self._results[msg.plan_id]
+            if msg is None:
+                if inflight:
+                    self._retire_oldest(inflight)
+                elif stalled_on:
+                    # Later seqs queued behind a gap: the pod-wide
+                    # lockstep is stalled.  Only the control plane can
+                    # fix this; make it loud.
+                    log.error(
+                        "spmd fabric stalled waiting for plan seq",
+                        next_seq=self._next_seq,
+                        queued=stalled_on,
+                    )
+                continue
             try:
-                value = self._execute(msg)
+                value, out = self._execute(msg)
             except Exception as e:  # noqa: BLE001 — resolve, don't die
                 log.error("spmd fabric plan failed", plan=msg.plan_id,
                           err=repr(e))
                 res.resolve(error=e)
                 continue
-            res.resolve(value=value)
+            if out is None:  # cancelled / not a participant: no device work
+                res.resolve(value=value)
+                continue
+            inflight.append((msg.plan_id, res, value, out))
+            while len(inflight) > MAX_INFLIGHT:
+                self._retire_oldest(inflight)
 
     # ----------------------------------------------------------- collective
 
-    def _slot_assignment(
-        self, layout: List[Tuple[int, int, int]]
-    ) -> Tuple[Tuple[int, ...], Tuple[int, ...], Dict[int, Tuple[int, int, int]]]:
-        """Deterministic (message-only) mapping of layout entries to mesh
-        device ranks: each contribution lands on an unused device of its
-        sender's stage.  Returns (sizes by rank, ranks in offset order,
-        rank -> (sender, offset, size))."""
-        import numpy as np
+    def _plan_scope(self, msg) -> list:
+        """The sub-mesh of a plan: the participating stages' devices (the
+        senders' stages ∪ the dest's stage) in stage order — identical on
+        every process (the placement is).  The collective runs on exactly
+        these devices; everyone else sits the plan out."""
+        stages = sorted(
+            {self.placement.node_to_stage[s] for s, _, _ in msg.layout}
+            | {self.placement.node_to_stage[msg.dest_id]}
+        )
+        return [d for st in stages for d in self.placement.stage_devices(st)]
 
-        flat = list(np.ravel(self.placement.mesh.devices))
+    def _slot_assignment(
+        self, layout: List[Tuple[int, int, int]], flat: list
+    ) -> Tuple[Tuple[int, ...], Tuple[int, ...], Dict[int, Tuple[int, int, int]]]:
+        """Deterministic (message-only) mapping of layout entries to device
+        ranks WITHIN the plan's scope: each contribution lands on an unused
+        device of its sender's stage.  Returns (sizes by rank, ranks in
+        offset order, rank -> (sender, offset, size))."""
         rank_of = {id(d): i for i, d in enumerate(flat)}
         used: set = set()
         by_rank: Dict[int, Tuple[int, int, int]] = {}
@@ -252,9 +308,13 @@ class SpmdFabric:
         return sizes, tuple(order), by_rank
 
     def _execute(self, msg):
+        """Dispatch one plan's uploads + gather.  Returns (dest value,
+        gathered array) — the array is a live device-work handle the
+        caller retires later — or (None, None) when there is nothing to
+        enter (cancellation, or this process is outside the scope)."""
         if not msg.layout:
             log.info("spmd fabric plan cancelled", plan=msg.plan_id)
-            return None
+            return None, None
         import jax
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -262,16 +322,20 @@ class SpmdFabric:
         from .collectives import gather_tiles_at
         from .ingest import flat_mesh
 
-        sizes, order, by_rank = self._slot_assignment(msg.layout)
+        flat = self._plan_scope(msg)
+        proc = jax.process_index()
+        if not any(d.process_index == proc for d in flat):
+            # Out of scope: the participants' collective doesn't involve
+            # this process's devices; just advance the seq.
+            return None, None
+        sizes, order, by_rank = self._slot_assignment(msg.layout, flat)
         total = sum(sizes)
         if total != msg.total_size:
             raise PlanFailed(
                 f"layout covers {total} bytes, plan says {msg.total_size}"
             )
         pad = max(sizes)
-        flat = list(np.ravel(self.placement.mesh.devices))
         mesh = flat_mesh(flat, axis="fabric")
-        proc = jax.process_index()
 
         # My ranges MUST sit on my local devices (one stage == one host
         # under the host-aligned order) — otherwise this process would
@@ -303,13 +367,14 @@ class SpmdFabric:
         v = jax.make_array_from_single_device_arrays(
             (len(flat) * pad,), NamedSharding(mesh, P("fabric")), shards
         )
+        # NOT blocked here: the caller's in-flight window retires it, so
+        # the next plan's uploads overlap this gather on the device queue.
         out = gather_tiles_at(mesh, "fabric", sizes, order)(v)
-        jax.block_until_ready(out)
         if msg.dest_id != self.my_node:
-            return None
-        # Keep the LOCAL copy: the gather left the full layer replicated
-        # on every device; this node's addressable shards are its stage's
-        # devices (host-aligned order) — re-wrap them as a local
+            return None, out
+        # Keep the LOCAL copy: the gather leaves the full layer replicated
+        # on every scope device; this node's addressable shards are its
+        # stage's devices (host-aligned order) — re-wrap them as a local
         # stage-replicated array, the -hbm terminal state.
         local_shards = [s.data for s in out.addressable_shards]
         stage = self.placement.node_to_stage[self.my_node]
@@ -320,4 +385,4 @@ class SpmdFabric:
             )
         except Exception:  # noqa: BLE001 — single-device copy still correct
             arr = local_shards[0]
-        return arr
+        return arr, out

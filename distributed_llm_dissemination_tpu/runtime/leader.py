@@ -529,8 +529,10 @@ class LeaderNode:
         """Whether one scheduled transfer can ride the device fabric:
         fabric + placement wired, every participant mapped to a stage, and
         no sender serving the layer from an external client (a client's
-        bytes live outside the fabric — host path).  Status reads are
-        unlocked, matching the other scheduler-side reads."""
+        bytes live outside the fabric — host path).  Status rows are read
+        under ``_lock``: pool-concurrent handlers insert rows
+        (``handle_ack``) and pop them (``crash``), and a torn ``LayerMeta``
+        view here could route a CLIENT-held layer onto the fabric."""
         if self.fabric is None or self.placement is None:
             return False
         if self._fabric_disabled:
@@ -567,9 +569,11 @@ class LeaderNode:
         for sender, _, _ in layout:
             if sender not in self.placement.node_to_stage:
                 return False
-            meta = self.status.get(sender, {}).get(layer_id)
-            if meta is None or meta.location == LayerLocation.CLIENT:
-                return False
+        with self._lock:
+            for sender, _, _ in layout:
+                meta = self.status.get(sender, {}).get(layer_id)
+                if meta is None or meta.location == LayerLocation.CLIENT:
+                    return False
         return True
 
     def _dispatch_device_plan(
@@ -664,11 +668,12 @@ class LeaderNode:
     ) -> bool:
         """Route a single-source full-layer send (modes 0-2) over the
         fabric; returns False when it must go the host path."""
-        meta = self.status.get(sender, {}).get(layer_id)
-        size = meta.data_size if meta is not None else 0
-        if size <= 0 and sender == self.node.my_id:
-            src = self.layers.get(layer_id)
-            size = src.data_size if src is not None else 0
+        with self._lock:
+            meta = self.status.get(sender, {}).get(layer_id)
+            size = meta.data_size if meta is not None else 0
+            if size <= 0 and sender == self.node.my_id:
+                src = self.layers.get(layer_id)
+                size = src.data_size if src is not None else 0
         if size <= 0:
             return False
         layout = [(sender, 0, size)]
@@ -1385,9 +1390,10 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         jobs = self._split_fabric_jobs(jobs)
         for dest, job_list in self_jobs.items():
             for job in job_list:
-                rate = self.status.get(job.sender_id, {}).get(
-                    job.layer_id, LayerMeta()
-                ).limit_rate
+                with self._lock:
+                    rate = self.status.get(job.sender_id, {}).get(
+                        job.layer_id, LayerMeta()
+                    ).limit_rate
                 self.node.transport.send(
                     job.sender_id,
                     FlowRetransmitMsg(

@@ -593,12 +593,15 @@ class ReceiverNode:
                 placement=self.placement, node_id=self.node.my_id,
                 codec=self.boot_codec,
             )
+            # Assign BEFORE the finally sets the event: _serve() waits on
+            # _boot_finished and then reads boot_result, so the event must
+            # guarantee the assignment is visible.
+            self.boot_result = res
         except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
             log.error("model boot failed", err=repr(e))
             return
         finally:
             self._boot_finished.set()  # serve waiters proceed either way
-        self.boot_result = res
         try:
             self.node.transport.send(
                 self.node.leader_id,

@@ -1,0 +1,63 @@
+"""Aligned host buffers and zero-copy adoption onto the CPU backend.
+
+On an accelerator, landing bytes in device memory means a real DMA over
+the host link.  On the CPU backend there is no link: "device memory" IS
+host memory, and ``jax.device_put`` of a numpy array is a pure-overhead
+copy (measured ~5x slower than a plain memcpy on the bench host).  XLA
+will alias an external host buffer as a device array zero-copy via
+DLPack — but only when the buffer is 64-byte aligned, which numpy's
+allocator does not guarantee.  So: allocate ingest buffers aligned
+(``aligned_empty``), assemble bytes in place, and adopt the buffer as
+the device array with no copy at all (``adopt_as_device_array``).
+
+Safety contract for adoption: the jax.Array aliases the numpy buffer,
+so the caller must never write to the buffer afterwards.  The DLPack
+capsule keeps the buffer alive for the array's lifetime.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ALIGN = 64  # XLA's zero-copy import requires 64-byte alignment
+
+
+def aligned_empty(nbytes: int, align: int = ALIGN) -> np.ndarray:
+    """An uninitialized uint8 buffer whose data pointer is ``align``-byte
+    aligned (numpy gives no alignment guarantee; over-allocate + offset)."""
+    raw = np.empty(nbytes + align, dtype=np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off : off + nbytes]
+
+
+def is_adoptable(buf: np.ndarray) -> bool:
+    return (
+        buf.dtype == np.uint8
+        and buf.flags["C_CONTIGUOUS"]
+        and buf.ctypes.data % ALIGN == 0
+    )
+
+
+def adopt_as_device_array(buf: np.ndarray, device) -> "jax.Array":
+    """Materialize ``buf`` as a jax.Array on ``device`` without copying
+    when possible (CPU backend + aligned buffer); fall back to a plain
+    ``device_put``.  The caller forfeits write access to ``buf``."""
+    import jax
+
+    if device.platform == "cpu" and is_adoptable(buf):
+        try:
+            arr = jax.dlpack.from_dlpack(buf, device=device, copy=False)
+        except Exception:  # noqa: BLE001 — alignment/backend corner: copy
+            arr = None
+        if arr is None:
+            try:  # without the placement hint (single-device CPU)
+                arr = jax.dlpack.from_dlpack(buf, copy=False)
+            except Exception:  # noqa: BLE001
+                arr = None
+        if arr is not None:
+            if device in arr.devices():
+                return arr
+            # A virtual multi-CPU mesh wants a specific device id; the
+            # cross-device put is still host memory either way.
+            return jax.device_put(arr, device)
+    return jax.device_put(buf, device)

@@ -39,6 +39,45 @@ def covered(intervals: List[Interval]) -> int:
     return sum(e - s for s, e in intervals)
 
 
+def uncovered(
+    intervals: List[Interval], start: int, end: int
+) -> List[Interval]:
+    """Subranges of ``[start, end)`` NOT covered by the (sorted, disjoint)
+    interval list — what a duplicate-tolerant writer still has to land."""
+    out: List[Interval] = []
+    pos = start
+    for s, e in intervals:
+        if e <= pos:
+            continue
+        if s >= end:
+            break
+        if s > pos:
+            out.append((pos, min(s, end)))
+        pos = max(pos, min(e, end))
+        if pos >= end:
+            break
+    if pos < end:
+        out.append((pos, end))
+    return out
+
+
+def remove(intervals: List[Interval], start: int, end: int) -> List[Interval]:
+    """Subtract ``[start, end)`` from a sorted disjoint interval list —
+    the rollback of a failed write claim."""
+    if start >= end:
+        return intervals
+    out: List[Interval] = []
+    for s, e in intervals:
+        if e <= start or s >= end:
+            out.append((s, e))
+            continue
+        if s < start:
+            out.append((s, start))
+        if e > end:
+            out.append((end, e))
+    return out
+
+
 def complement(intervals: List[Interval], total: int) -> List[Interval]:
     """The gaps: ranges of ``[0, total)`` NOT covered — the byte ranges a
     resumed transfer still needs."""

@@ -2,6 +2,7 @@
 
 import io
 import json
+import os
 import time
 
 import pytest
@@ -93,6 +94,80 @@ def test_assignment_json_roundtrip():
     back = assignment_from_json(assignment_to_json(a))
     assert set(back) == {7}
     assert set(back[7]) == set(range(8))
+
+
+REFERENCE_CONFIG = "/root/reference/conf/config.json"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REFERENCE_CONFIG),
+    reason="reference checkout not present",
+)
+def test_reference_config_verbatim():
+    """Parse the reference's OWN shipped benchmark config — the file the Go
+    loader reads (cmd/config.go:14-45) — not a schema lookalike: 8 nodes,
+    10.18 GiB layers, seven seeders each holding disk layers 0-7, node 7
+    the sole (empty-handed) assignee.  Round-trips the Assignment through
+    the wire encoding for good measure."""
+    conf = read_json(REFERENCE_CONFIG)
+    assert len(conf.nodes) == 8
+    leader = get_leader_conf(conf)
+    assert leader.id == 0 and leader.addr == ":8080"
+    # Every node models the same 12.5 Gbit/s NIC (the BASELINE.md rate).
+    assert all(nc.network_bw == 1562500000 for nc in conf.nodes)
+    # Nodes 0-6 seed all 8 layers from disk at 10930691768 bytes each
+    # (~10.18 GiB); node 7 starts with nothing.
+    for nc in conf.nodes[:7]:
+        assert nc.sources[SourceType.DISK] == 209715200
+        assert set(nc.initial_layers[SourceType.DISK]) == set(range(8))
+        assert all(
+            sz == 10930691768
+            for sz in nc.initial_layers[SourceType.DISK].values()
+        )
+    assert not conf.nodes[7].initial_layers
+    # The goal: node 7 must end holding layers 0-7.
+    assert set(conf.assignment) == {7}
+    assert set(conf.assignment[7]) == set(range(8))
+    back = assignment_from_json(assignment_to_json(conf.assignment))
+    assert set(back[7]) == set(range(8))
+
+
+def test_intervals_uncovered():
+    """intervals.uncovered: the write-claim primitive of the sharded
+    ingest — exact complement of the covered set within a range."""
+    from distributed_llm_dissemination_tpu.utils import intervals
+
+    ivals = []
+    assert intervals.uncovered(ivals, 10, 20) == [(10, 20)]
+    ivals = intervals.insert(ivals, 0, 5)
+    ivals = intervals.insert(ivals, 12, 15)
+    ivals = intervals.insert(ivals, 30, 40)
+    assert intervals.uncovered(ivals, 10, 20) == [(10, 12), (15, 20)]
+    assert intervals.uncovered(ivals, 0, 5) == []
+    assert intervals.uncovered(ivals, 3, 13) == [(5, 12)]
+    assert intervals.uncovered(ivals, 35, 50) == [(40, 50)]
+    assert intervals.uncovered(ivals, 5, 5) == []
+    # Random cross-check against insert/covered.
+    import random
+
+    rng = random.Random(7)
+    ivals = []
+    for _ in range(50):
+        s = rng.randrange(0, 1000)
+        e = s + rng.randrange(1, 60)
+        for lo, hi in intervals.uncovered(ivals, s, e):
+            assert intervals.uncovered(ivals, lo, hi) == [(lo, hi)]
+            ivals = intervals.insert(ivals, lo, hi)
+        assert intervals.uncovered(ivals, s, e) == []
+    # remove is insert's inverse: claim rollback restores the complement.
+    before = list(ivals)
+    ivals = intervals.insert(ivals, 100, 300)
+    ivals = intervals.remove(ivals, 100, 300)
+    for lo, hi in intervals.uncovered(before, 100, 300):
+        assert intervals.uncovered(ivals, lo, hi) == [(lo, hi)]
+    assert intervals.remove([(0, 10)], 3, 7) == [(0, 3), (7, 10)]
+    assert intervals.remove([(0, 10)], 0, 10) == []
+    assert intervals.remove([(0, 10)], 20, 30) == [(0, 10)]
 
 
 def test_delivered_semantics():

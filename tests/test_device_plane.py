@@ -343,7 +343,10 @@ def test_ingest_bytes_replicates_across_devices(cpu_devices):
     assert np.asarray(arr).tobytes() == data
 
 
-def test_sharded_ingest_out_of_order_overlap(cpu_devices):
+@pytest.mark.parametrize("stream", [False, True])
+def test_sharded_ingest_out_of_order_overlap(cpu_devices, stream):
+    """Both terminal-hop arms (CPU host-accumulate and accelerator
+    stream-splice) handle out-of-order + overlapping fragments."""
     from distributed_llm_dissemination_tpu.parallel.ingest import (
         ShardedLayerIngest,
     )
@@ -351,7 +354,7 @@ def test_sharded_ingest_out_of_order_overlap(cpu_devices):
     devices = list(cpu_devices[:3])
     total = 1000
     want = bytes([(7 * i) % 256 for i in range(total)])
-    ing = ShardedLayerIngest(total, devices)
+    ing = ShardedLayerIngest(total, devices, stream=stream)
     # Out-of-order fragments with an overlapping duplicate spanning the
     # device-span boundaries (spans are ~334/333/333).
     for off, size in [(600, 400), (0, 350), (300, 400), (200, 200)]:
@@ -381,3 +384,84 @@ def test_sharded_ingest_tiny_layer_many_devices(cpu_devices):
     ing.write(0, b"abc")
     arr = ing.finalize()
     assert np.asarray(arr).tobytes() == b"abc"
+
+
+def test_sharded_ingest_stream_tiny_layer_many_devices(cpu_devices):
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    ing = ShardedLayerIngest(3, list(cpu_devices), stream=True)
+    ing.write(0, b"abc")
+    arr = ing.finalize()
+    assert np.asarray(arr).tobytes() == b"abc"
+
+
+@pytest.mark.parametrize("stream", [False, True])
+def test_sharded_ingest_concurrent_writers(cpu_devices, stream):
+    """The claim/commit scheme under a real handler pool: concurrent
+    overlapping writers land a byte-exact layer, each claimed range is
+    copied exactly once, and finalize never splices a hole."""
+    import concurrent.futures
+
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    devices = list(cpu_devices[:4])
+    total = 1 << 16
+    want = bytes([(11 * i) % 256 for i in range(total)])
+    ing = ShardedLayerIngest(total, devices, stream=stream)
+    # 64 fragments, every one duplicated, submitted shuffled.
+    frags = [(off, want[off : off + 1024]) for off in range(0, total, 1024)]
+    work = frags * 2
+    rng = np.random.default_rng(3)
+    rng.shuffle(work)
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        list(pool.map(lambda fr: ing.write(*fr), work))
+    arr = ing.finalize()
+    assert np.asarray(arr).tobytes() == want
+
+
+@pytest.mark.parametrize("stream", [False, True])
+def test_sharded_ingest_failed_write_rolls_back_claim(
+    cpu_devices, stream, monkeypatch
+):
+    """A write that dies mid-claim must not leave its ranges marked
+    covered: salvage reports only bytes that really landed, and the
+    ingest is poisoned for finalize."""
+    from distributed_llm_dissemination_tpu.parallel import ingest as ingest_mod
+
+    ing = ingest_mod.ShardedLayerIngest(
+        1000, [cpu_devices[0]], stream=stream)
+    ing.write(0, b"a" * 100)
+
+    def boom(*a, **k):  # fail the copy AFTER the claim was taken
+        raise RuntimeError("simulated copy failure")
+
+    monkeypatch.setattr(ingest_mod.np, "frombuffer", boom)
+    with pytest.raises(RuntimeError, match="simulated"):
+        ing.write(300, b"b" * 200)
+    monkeypatch.undo()
+    got = dict(ing.salvage())
+    assert got == {0: b"a" * 100}  # the failed claim's range is NOT covered
+    assert ing._failed
+
+
+def test_sharded_ingest_cpu_finalize_is_zero_copy(cpu_devices):
+    """The CPU arm's whole point: finalize adopts the aligned host buffer
+    as the device array without copying (single-device case)."""
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    total = 1 << 20
+    data = bytes(range(256)) * (total // 256)
+    ing = ShardedLayerIngest(total, [cpu_devices[0]])
+    ing.write(0, data)
+    host_ptr = ing._host[0].ctypes.data
+    arr = ing.finalize()
+    assert np.asarray(arr).tobytes() == data
+    # Zero-copy: the jax.Array aliases the ingest's host buffer.
+    alias = arr.addressable_shards[0].data.unsafe_buffer_pointer()
+    assert alias == host_ptr

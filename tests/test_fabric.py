@@ -217,10 +217,11 @@ def test_fabric_placement_shares_stages_when_short(cpu_devices):
 # ------------------------------------------------- device-fed sharded ingest
 
 
-def test_sharded_ingest_accepts_device_fragments(cpu_devices):
+@pytest.mark.parametrize("stream", [False, True])
+def test_sharded_ingest_accepts_device_fragments(cpu_devices, stream):
     total = 4096
     data = layer_bytes(9, total)
-    ing = ShardedLayerIngest(total, cpu_devices[:4])
+    ing = ShardedLayerIngest(total, cpu_devices[:4], stream=stream)
     # Mixed feeding: a host fragment and two device-resident fragments
     # (what the fabric dest does), out of order.
     ing.write(1024, data[1024:3000])
@@ -233,13 +234,14 @@ def test_sharded_ingest_accepts_device_fragments(cpu_devices):
     assert set(arr.devices()) == set(cpu_devices[:4])
 
 
-def test_sharded_ingest_salvage_reads_back_written_bytes(cpu_devices):
+@pytest.mark.parametrize("stream", [False, True])
+def test_sharded_ingest_salvage_reads_back_written_bytes(cpu_devices, stream):
     """salvage(): the fallback assembly source when the gather fails —
     covered ranges come back byte-exact from the shard buffers, and
     uncovered ranges are not claimed."""
     total = 4096
     data = layer_bytes(5, total)
-    ing = ShardedLayerIngest(total, cpu_devices[:4])
+    ing = ShardedLayerIngest(total, cpu_devices[:4], stream=stream)
     ing.write(0, data[:1000])
     ing.write(2500, data[2500:4096])
     got = ing.salvage()

@@ -278,7 +278,10 @@ def test_large_layer_ingest_overlaps_receive(cpu_devices):
             ing.write(off, data[off : off + frag])
             write_s += time.monotonic() - t0
         t0 = time.monotonic()
-        jax.block_until_ready(ing._bufs)  # device work pending at last byte
+        ing._quiesce()  # claims still copying at last byte
+        if ing._pieces is not None:  # stream path: device work pending too
+            jax.block_until_ready(
+                [p for ps in ing._pieces for _, p in ps])
         residual = time.monotonic() - t0
         arr = ing.finalize()
         arr.block_until_ready()

@@ -40,11 +40,17 @@ def placement(cpu_devices):
     return fabric_placement([0, 1], {1: {0: None}}, mesh, "nodes")
 
 
+def _whole_mesh(placement):
+    import numpy as np
+
+    return list(np.ravel(placement.mesh.devices))
+
+
 def test_slot_assignment_puts_ranges_on_sender_stage(placement):
     fab = SpmdFabric(placement, my_node=0)
     try:
         sizes, order, by_rank = fab._slot_assignment(
-            [(1, 100, 50), (0, 0, 100)]
+            [(1, 100, 50), (0, 0, 100)], _whole_mesh(placement)
         )
         # The assignee (node 1) owns stage 0 = ranks 0-3; the extra
         # (node 0) fills stage 1 = ranks 4-7.  Offset order: node 0's
@@ -61,12 +67,13 @@ def test_slot_assignment_round_robins_within_stage(placement):
     fab = SpmdFabric(placement, my_node=0)
     try:
         sizes, order, _ = fab._slot_assignment(
-            [(0, 0, 10), (0, 10, 10), (0, 20, 10)]
+            [(0, 0, 10), (0, 10, 10), (0, 20, 10)], _whole_mesh(placement)
         )
         assert order == (4, 5, 6)  # node 0's stage is ranks 4-7
         # A 5th range from a 4-device stage must fail deterministically.
         with pytest.raises(PlanFailed, match="more ranges"):
-            fab._slot_assignment([(0, i * 10, 10) for i in range(5)])
+            fab._slot_assignment([(0, i * 10, 10) for i in range(5)],
+                                 _whole_mesh(placement))
     finally:
         fab.close()
 
@@ -75,7 +82,8 @@ def test_executor_runs_plans_in_seq_order(placement, monkeypatch):
     fab = SpmdFabric(placement, my_node=0)
     ran = []
     monkeypatch.setattr(
-        fab, "_execute", lambda msg: ran.append(msg.seq) or f"v{msg.seq}"
+        fab, "_execute",
+        lambda msg: ran.append(msg.seq) or (f"v{msg.seq}", None),
     )
     try:
         # Submit out of order: 2, 0, 1.
@@ -97,7 +105,7 @@ def test_cancellation_overrides_pending_plan(placement, monkeypatch):
     monkeypatch.setattr(
         fab, "_execute",
         lambda msg: ran.append((msg.seq, len(msg.layout)))
-        or real_execute(msg) if not msg.layout else None,
+        or real_execute(msg) if not msg.layout else (None, None),
     )
     try:
         # seq 1 arrives first (queued behind the gap), then its cancel,
@@ -116,7 +124,7 @@ def test_cancellation_overrides_pending_plan(placement, monkeypatch):
 
 def test_duplicate_submit_returns_same_handle(placement, monkeypatch):
     fab = SpmdFabric(placement, my_node=0)
-    monkeypatch.setattr(fab, "_execute", lambda msg: "x")
+    monkeypatch.setattr(fab, "_execute", lambda msg: ("x", None))
     try:
         a = fab.submit(_plan(0, [(0, 0, 4)], plan_id="p"))
         b = fab.submit(_plan(0, [(0, 0, 4)], plan_id="p"))
