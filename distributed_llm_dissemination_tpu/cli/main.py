@@ -166,8 +166,9 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
         leader = PullRetransmitLeaderNode(node, layers, assignment, **common)
     else:
         bw = {nc.id: nc.network_bw for nc in conf.nodes}
+        topo = conf.mesh.topology() if conf.mesh is not None else None
         leader = FlowRetransmitLeaderNode(node, layers, assignment, bw,
-                                          **common)
+                                          topology=topo, **common)
 
     # One flag governs the run: the leader's decision rides StartupMsg,
     # so receivers can never boot (or skip) against the leader's wait.

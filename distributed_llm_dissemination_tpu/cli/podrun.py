@@ -129,7 +129,9 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
                               fabric=fabric, placement=placement)
                 if mode == 3:
                     leader = _LEADERS[3](node, layers, conf.assignment,
-                                         fabric_bandwidths(conf), **kwargs)
+                                         fabric_bandwidths(conf),
+                                         topology=conf.mesh.topology(),
+                                         **kwargs)
                 else:
                     leader = _LEADERS[mode](node, layers, conf.assignment,
                                             **kwargs)

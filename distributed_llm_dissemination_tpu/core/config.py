@@ -61,6 +61,13 @@ class MeshConf:
     # Per-source LimitRates still cap seeders (host→HBM or disk reads
     # remain the source-side bottleneck).  0 = plan with NetworkBW.
     ici_bw: int = 0
+    # Multi-slice pods: node id -> slice index ("Slices": {"0": 0, ...}).
+    # Nodes on the same slice exchange bytes over ICI; nodes on different
+    # slices share the DCN.  The mode-3 solver then adds one DcnBW-capped
+    # edge per ordered slice pair (sched/flow.PodTopology) — the reference
+    # models only flat per-node NICs (flow.go:221-270).  Empty = one slice.
+    slices: Dict[int, int] = dataclasses.field(default_factory=dict)
+    dcn_bw: int = 0  # bytes/s per ordered slice pair; 0 = no DCN modeling
 
     @classmethod
     def from_json(cls, d: dict) -> "MeshConf":
@@ -70,7 +77,19 @@ class MeshConf:
             pipeline_axis=_jget(d, "PipelineAxis", "nodes"),
             fabric=bool(_jget(d, "Fabric", False)),
             ici_bw=int(_jget(d, "IciBW", 0)),
+            slices={int(k): int(v)
+                    for k, v in (_jget(d, "Slices", {}) or {}).items()},
+            dcn_bw=int(_jget(d, "DcnBW", 0)),
         )
+
+    def topology(self):
+        """The solver-facing ``PodTopology`` (None when single-slice or
+        DCN-unmodeled)."""
+        if not self.slices or self.dcn_bw <= 0:
+            return None
+        from ..sched.flow import PodTopology
+
+        return PodTopology.make(self.slices, self.dcn_bw)
 
 
 @dataclasses.dataclass
@@ -316,9 +335,12 @@ def create_disk_layer(
     d = os.path.join(storage_path, "layers", str(my_id))
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, f"{layer_id}.layer")
-    if not os.path.exists(path) or (
-        content is not None and os.path.getsize(path) != layer_size
-    ):
+    if not os.path.exists(path) or os.path.getsize(path) != layer_size:
+        # A size mismatch is ALWAYS refabricated, dummy bytes included: a
+        # stale file from an earlier topology under the same storage path
+        # would otherwise be served as this layer — the sender then
+        # streams fewer bytes than it announced and the dest waits
+        # forever on coverage that can't complete.
         with open(path, "wb") as f:
             f.write(content if content is not None else b"\x00" * layer_size)
     return LayerSrc(

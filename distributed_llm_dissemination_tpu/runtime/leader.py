@@ -1245,8 +1245,13 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         failure_timeout: float = 0.0,
         fabric=None,
         placement=None,
+        topology=None,
     ):
+        """``topology``: optional ``sched.flow.PodTopology`` — multi-slice
+        pods plan cross-slice transfers against the per-pair DCN
+        capacity instead of pretending every edge is ICI."""
         self.node_network_bw = dict(node_network_bw)
+        self.topology = topology
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
                          failure_timeout=failure_timeout,
@@ -1320,7 +1325,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             t0 = time.monotonic()
             graph = make_flow_graph(
                 modified, self.status, layer_sizes, self.node_network_bw,
-                remaining=remaining_sizes,
+                remaining=remaining_sizes, topology=self.topology,
             )
             t, jobs = graph.get_job_assignment()
         if gaps_by_pair:

@@ -25,6 +25,28 @@ Deviations from the reference, on purpose:
   targeting <10 s can't live with 1 s granularity).  Capacities are
   ``rate × t // 1000`` — floor keeps them integral and monotone in t, so
   the exponential+binary search is unchanged in shape.
+
+TPU topology (``PodTopology``): the reference models only per-node NIC
+bandwidth (flow.go:221-270) — adequate for a flat datacenter LAN, wrong
+for a multi-slice pod where intra-slice bytes ride ICI but cross-slice
+bytes share a thin DCN path.  The per-(A,B) DCN capacity is a BUNDLE
+constraint over the (sender→dest) arcs crossing that slice pair, which a
+plain single-commodity flow graph cannot carry exactly (flow through a
+shared edge loses its (sender, layer) labels).  Two solvers:
+
+- **Exact (scipy present)**: the schedule at candidate time ``t`` is a
+  small LP — one variable per admissible (sender-class, layer, dest)
+  arc, per-class/per-NIC/per-demand/per-DCN-pair row constraints,
+  maximize delivered bytes (HiGHS).  The usual exponential+binary time
+  search runs over LP feasibility; the final solution rounds to an
+  exact byte tiling.
+- **Fallback (no scipy)**: the graph grows one capacity edge per
+  ordered slice pair (``xin(A,B) → xout(A,B)``); cross-slice flow routes
+  through it, and after max-flow the pair's aggregate flow is
+  re-attributed along true holdings by a transportation max-flow.  The
+  relaxation can pick unattributable flows on adversarial holdings —
+  then the solver logs and replans flat (NIC-only) rather than emit an
+  invalid tiling.
 """
 
 from __future__ import annotations
@@ -47,6 +69,27 @@ def rate_for(data_size: int, t_ms: int) -> int:
     return data_size * TIME_SCALE // max(1, t_ms)
 
 
+@dataclasses.dataclass(frozen=True)
+class PodTopology:
+    """Multi-slice pod shape for the flow solve.
+
+    ``slice_of``: node → slice index (a slice = one ICI domain, e.g. a
+    v5e-32 slice; nodes of one slice exchange bytes over ICI).
+    ``dcn_bw``: bytes/s available to EACH ordered slice pair over the
+    data-center network (the thin path the solver must route around).
+    Per-node rates (NIC or ``Mesh.IciBW``) still cap the endpoints."""
+
+    slice_of: Tuple[Tuple[NodeID, int], ...]  # sorted (node, slice) pairs
+    dcn_bw: int
+
+    @classmethod
+    def make(cls, slice_of: Dict[NodeID, int], dcn_bw: int) -> "PodTopology":
+        return cls(tuple(sorted(slice_of.items())), dcn_bw)
+
+    def slices(self) -> Dict[NodeID, int]:
+        return dict(self.slice_of)
+
+
 @dataclasses.dataclass
 class FlowJob:
     """One partial-layer send command (flow.go:30-39), extended with the
@@ -64,14 +107,80 @@ class FlowJob:
 FlowJobsMap = Dict[NodeID, List[FlowJob]]
 
 
+def _have_lp() -> bool:
+    try:
+        from scipy.optimize import linprog  # noqa: F401
+    except Exception:  # noqa: BLE001 — scipy is optional
+        return False
+    return True
+
+
+def _transport(supplies, demands, admissible):
+    """Tiny transportation max-flow: split ``supplies`` (key, amount)
+    onto ``demands`` (key, amount) along ``admissible(sup_key, dem_key)``
+    arcs.  Returns [(sup_key, dem_key, amount), ...] saturating every
+    supply, or None if the arcs can't absorb the totals (a Hall
+    violation).  Deterministic: Edmonds–Karp over sorted inputs."""
+    ns, nd = len(supplies), len(demands)
+    n = ns + nd + 2
+    src, sink = n - 2, n - 1
+    cap = [[0] * n for _ in range(n)]
+    for i, (_, amt) in enumerate(supplies):
+        cap[src][i] = amt
+    for j, (_, amt) in enumerate(demands):
+        cap[ns + j][sink] = amt
+    for i, (skey, _) in enumerate(supplies):
+        for j, (dkey, _) in enumerate(demands):
+            if admissible(skey, dkey):
+                cap[i][ns + j] = _INF
+    total = sum(amt for _, amt in supplies)
+    pushed = 0
+    while True:
+        parent = [-1] * n
+        parent[src] = src
+        q = deque([src])
+        while q:
+            u = q.popleft()
+            for v in range(n):
+                if parent[v] < 0 and cap[u][v] > 0:
+                    parent[v] = u
+                    q.append(v)
+        if parent[sink] < 0:
+            break
+        path_flow = _INF
+        v = sink
+        while v != src:
+            path_flow = min(path_flow, cap[parent[v]][v])
+            v = parent[v]
+        pushed += path_flow
+        v = sink
+        while v != src:
+            cap[parent[v]][v] -= path_flow
+            cap[v][parent[v]] += path_flow
+            v = parent[v]
+    if pushed < total:
+        return None
+    out = []
+    for i, (skey, _) in enumerate(supplies):
+        for j, (dkey, _) in enumerate(demands):
+            f = cap[ns + j][i]  # reverse residual = assigned amount
+            if f > 0:
+                out.append((skey, dkey, f))
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class _V:
     """Flow-graph vertex key (flow.go:23-28).  Unlike the reference, a
     "layer" vertex is per (layer, dest) pair — that is what lets one
     layer be scheduled to multiple receivers (each needing its own full
-    copy) while per-sender flows stay attributable."""
+    copy) while per-sender flows stay attributable.
 
-    kind: str  # source | sender | class | layer | receiver | sink
+    For the topology vertices ``xin``/``xout`` (the two halves of one
+    slice-pair DCN capacity edge), ``node_id`` carries the source slice
+    and ``layer_id`` the dest slice."""
+
+    kind: str  # source | sender | class | layer | receiver | xin | xout | sink
     node_id: NodeID = 0  # sender/receiver id; for "layer": the dest
     layer_id: LayerID = 0
     source_type: int = 0
@@ -89,14 +198,21 @@ class FlowGraph:
         layer_sizes: Dict[LayerID, int],
         node_network_bw: Dict[NodeID, int],
         remaining: Optional[Dict[Tuple[LayerID, NodeID], int]] = None,
+        topology: Optional[PodTopology] = None,
     ):
         """``remaining``: optional per-(layer, dest) byte overrides — a
-        resumed dest needs only its gap bytes, not the full layer."""
+        resumed dest needs only its gap bytes, not the full layer.
+        ``topology``: multi-slice shape; cross-slice flow then shares the
+        per-pair DCN capacity edges (module docstring)."""
         self.assignment = assignment
         self.status = status
         self.layer_sizes = layer_sizes
         self.node_network_bw = node_network_bw
         self.remaining = remaining or {}
+        self.topology = topology
+        self._slice: Dict[NodeID, int] = (
+            topology.slices() if topology is not None else {}
+        )
 
         # (layer, dest) pairs to deliver; dests_of inverts them so sender
         # edges can fan a held layer out to every receiver that wants it.
@@ -125,6 +241,21 @@ class FlowGraph:
             add(_V("layer", layer_id=layer_id, node_id=dest))
         for node_id in sorted(assignment):
             add(_V("receiver", node_id=node_id))
+        # One split capacity edge per ordered slice pair that some
+        # scheduled (sender, dest) crosses.
+        self.x_pairs: List[Tuple[int, int]] = []
+        if topology is not None:
+            crossed = set()
+            for node_id, layer_metas in status.items():
+                for layer_id in layer_metas:
+                    for dest in self.dests_of.get(layer_id, ()):
+                        if self._cross(node_id, dest):
+                            crossed.add((self._slice[node_id],
+                                         self._slice[dest]))
+            self.x_pairs = sorted(crossed)
+            for a, b in self.x_pairs:
+                add(_V("xin", node_id=a, layer_id=b))
+                add(_V("xout", node_id=a, layer_id=b))
         add(_V("sink"))
 
         self.n = len(self.idx)
@@ -133,6 +264,13 @@ class FlowGraph:
         self.cap: Optional[List[List[int]]] = None
 
     # ------------------------------------------------------------- capacities
+
+    def _cross(self, sender: NodeID, dest: NodeID) -> bool:
+        """Whether sender→dest traffic crosses slices (rides the DCN).
+        Nodes without a slice mapping are unconstrained (treated local)."""
+        a = self._slice.get(sender)
+        b = self._slice.get(dest)
+        return a is not None and b is not None and a != b
 
     def _class_capacity(self, node_id: NodeID, limit_rate: int, t: int) -> int:
         """Bytes deliverable by this source class in ``t`` ms."""
@@ -180,7 +318,19 @@ class FlowGraph:
                     layer = self.idx[
                         _V("layer", layer_id=layer_id, node_id=dest)
                     ]
-                    self.cap[cls][layer] = _INF
+                    if self._cross(node_id, dest):
+                        # Cross-slice: through the pair's DCN edge.
+                        a, b = self._slice[node_id], self._slice[dest]
+                        xin = self.idx[_V("xin", node_id=a, layer_id=b)]
+                        xout = self.idx[_V("xout", node_id=a, layer_id=b)]
+                        self.cap[cls][xin] = _INF
+                        self.cap[xout][layer] = _INF
+                    else:
+                        self.cap[cls][layer] = _INF
+        for a, b in self.x_pairs:
+            xin = self.idx[_V("xin", node_id=a, layer_id=b)]
+            xout = self.idx[_V("xout", node_id=a, layer_id=b)]
+            self.cap[xin][xout] = self.topology.dcn_bw * t // TIME_SCALE
 
         for node_id, layer_ids in self.assignment.items():
             receiver = self.idx[_V("receiver", node_id=node_id)]
@@ -233,13 +383,222 @@ class FlowGraph:
                 self.cap[v][parent[v]] += path_flow
                 v = parent[v]
 
+    # ----------------------------------------------------- cross attribution
+
+    def _attribute_cross(
+        self,
+    ) -> Optional[Dict[Tuple[NodeID, int, LayerID, NodeID], int]]:
+        """Re-attribute the cross-slice flow of the LAST ``max_flow`` run
+        to holdings-valid (sender-class → (layer, dest)) arcs.
+
+        The relaxed pair vertices aggregate flow, so the residuals only
+        say how much each class pushed INTO a pair edge and how much each
+        (layer, dest) drew OUT of it; a small transportation max-flow per
+        pair re-splits those totals along arcs a sender actually holds.
+        Returns {(sender, source_type, layer, dest): bytes}, or None when
+        some pair's flow cannot be absorbed by true holdings — the caller
+        must then treat the candidate time as infeasible."""
+        out: Dict[Tuple[NodeID, int, LayerID, NodeID], int] = {}
+        for a, b in self.x_pairs:
+            xin = self.idx[_V("xin", node_id=a, layer_id=b)]
+            xout = self.idx[_V("xout", node_id=a, layer_id=b)]
+            supplies: List[Tuple[Tuple[NodeID, int], int]] = []
+            for node_id in sorted(self.status):
+                if self._slice.get(node_id) != a:
+                    continue
+                for st in sorted({int(m.source_type)
+                                  for m in self.status[node_id].values()}):
+                    cls = self.idx[_V("class", node_id=node_id,
+                                      source_type=st)]
+                    f = self.cap[xin][cls]  # reverse residual = flow
+                    if f > 0:
+                        supplies.append(((node_id, st), f))
+            demands: List[Tuple[Tuple[LayerID, NodeID], int]] = []
+            for lid, dest in self.pairs:
+                if self._slice.get(dest) != b:
+                    continue
+                layer = self.idx[_V("layer", layer_id=lid, node_id=dest)]
+                f = self.cap[layer][xout]
+                if f > 0:
+                    demands.append(((lid, dest), f))
+
+            def holds(sup: Tuple[NodeID, int],
+                      dem: Tuple[LayerID, NodeID]) -> bool:
+                node_id, st = sup
+                lid, _ = dem
+                meta = self.status.get(node_id, {}).get(lid)
+                return meta is not None and int(meta.source_type) == st
+
+            split = _transport(supplies, demands, holds)
+            if split is None:
+                return None
+            for (node_id, st), (lid, dest), nbytes in split:
+                key = (node_id, st, lid, dest)
+                out[key] = out.get(key, 0) + nbytes
+        return out
+
+    # ------------------------------------------------------- LP (topology)
+
+    def _lp_arcs(self) -> List[Tuple[NodeID, int, LayerID, NodeID]]:
+        """Admissible (sender, source_type, layer, dest) arcs, sorted."""
+        arcs = []
+        for node_id in sorted(self.status):
+            for layer_id in sorted(self.status[node_id]):
+                meta = self.status[node_id][layer_id]
+                for dest in self.dests_of.get(layer_id, ()):
+                    arcs.append(
+                        (node_id, int(meta.source_type), layer_id, dest))
+        return arcs
+
+    def _lp_schedule(
+        self, t: int
+    ) -> Optional[Dict[Tuple[NodeID, int, LayerID, NodeID], int]]:
+        """Exact topology-aware schedule at candidate time ``t`` (module
+        docstring): returns integral per-arc bytes meeting every demand,
+        or None when ``t`` is infeasible."""
+        from scipy.optimize import linprog
+        from scipy.sparse import csr_matrix
+
+        arcs = self._lp_arcs()
+        required = sum(self._pair_size(lid, d) for lid, d in self.pairs)
+        if required == 0:
+            return {}
+        if not arcs:
+            return None
+        groups: Dict[Tuple, List[int]] = {}
+        for i, (s, st, lid, d) in enumerate(arcs):
+            groups.setdefault(("class", s, st), []).append(i)
+            groups.setdefault(("snic", s), []).append(i)
+            groups.setdefault(("rnic", d), []).append(i)
+            groups.setdefault(("pair", lid, d), []).append(i)
+            if self._cross(s, d):
+                groups.setdefault(
+                    ("dcn", self._slice[s], self._slice[d]), []).append(i)
+        rows, cols, caps = [], [], []
+        for r, (key, idxs) in enumerate(sorted(groups.items())):
+            kind = key[0]
+            if kind == "class":
+                _, s, st = key
+                # Same rule as _build: per-layer metadata disagreeing on
+                # the class rate takes the max CAPACITY (deterministic,
+                # not announcement-order; rate 0 means NIC-bound).
+                cap = max(self._class_capacity(s, m.limit_rate, t)
+                          for m in self.status[s].values()
+                          if int(m.source_type) == st)
+            elif kind == "snic" or kind == "rnic":
+                cap = self.node_network_bw.get(key[1], 0) * t // TIME_SCALE
+            elif kind == "pair":
+                cap = self._pair_size(key[1], key[2])
+            else:  # dcn
+                cap = self.topology.dcn_bw * t // TIME_SCALE
+            for i in idxs:
+                rows.append(r)
+                cols.append(i)
+            caps.append(cap)
+        a_ub = csr_matrix(([1.0] * len(rows), (rows, cols)),
+                          shape=(len(caps), len(arcs)))
+        res = linprog([-1.0] * len(arcs), A_ub=a_ub, b_ub=caps,
+                      bounds=(0, None), method="highs")
+        if not res.success or -res.fun + 0.5 < required:
+            return None
+        # Round to an exact integral tiling: per (layer, dest), floor each
+        # arc and hand the remainder to the largest fractional parts
+        # (deterministic tie-break by arc order).  Caps are pacing rates,
+        # not hard walls — the ≤#arcs rounding slack is immaterial.
+        out: Dict[Tuple[NodeID, int, LayerID, NodeID], int] = {}
+        for lid, dest in self.pairs:
+            idxs = groups[("pair", lid, dest)]
+            vals = [(i, float(res.x[i])) for i in idxs]
+            floors = {i: int(v) for i, v in vals}
+            short = self._pair_size(lid, dest) - sum(floors.values())
+            order = sorted(vals, key=lambda iv: (-(iv[1] - int(iv[1])), iv[0]))
+            for i, _ in order:
+                if short <= 0:
+                    break
+                floors[i] += 1
+                short -= 1
+            if short > 0:
+                return None  # numerically infeasible despite LP success
+            for i, nbytes in floors.items():
+                if nbytes > 0:
+                    out[arcs[i]] = nbytes
+        return out
+
+    def _flat_replan(self, why: str) -> Tuple[int, FlowJobsMap]:
+        """Last-resort degrade: plan without the topology (the flat path
+        also handles partial deliverability by decomposing whatever flow
+        exists instead of starving every pair)."""
+        log.error("topology solve degraded to flat replan", why=why)
+        flat = FlowGraph(self.assignment, self.status, self.layer_sizes,
+                         self.node_network_bw, remaining=self.remaining)
+        return flat.get_job_assignment()
+
+    @staticmethod
+    def _emit_jobs(
+        items, jobs: FlowJobsMap,
+        pair_offset: Dict[Tuple[LayerID, NodeID], int],
+    ) -> None:
+        """Append (sender, layer, dest, bytes) contributions as FlowJobs,
+        continuing each (layer, dest)'s running byte offset."""
+        for sender_id, layer_id, dest, nbytes in items:
+            offset = pair_offset.get((layer_id, dest), 0)
+            jobs.setdefault(sender_id, []).append(
+                FlowJob(sender_id, layer_id, nbytes, offset, dest)
+            )
+            pair_offset[(layer_id, dest)] = offset + nbytes
+
+    def _lp_job_assignment(self) -> Tuple[int, FlowJobsMap]:
+        """Time search + decomposition over the exact LP (topology mode)."""
+        sched: Dict = {}
+
+        def feasible(t: int) -> bool:
+            nonlocal sched
+            s = self._lp_schedule(t)
+            if s is None:
+                return False
+            sched = s
+            return True
+
+        t_upper = 1
+        while not feasible(t_upper):
+            if t_upper > _INF // 2:
+                # Some pair can never be fully delivered; the flat solver
+                # still schedules every deliverable byte.
+                return self._flat_replan("no feasible t under the LP")
+            t_upper *= 2
+        lo, hi, t = 1, t_upper, t_upper
+        best = sched
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if not feasible(mid):
+                lo = mid + 1
+            else:
+                if mid < t:
+                    t, best = mid, sched
+                hi = mid - 1
+
+        jobs: FlowJobsMap = {}
+        pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
+        self._emit_jobs(
+            ((s, lid, d, n) for (s, _st, lid, d), n in sorted(best.items())),
+            jobs, pair_offset,
+        )
+        log.info("job assignment calculated (topology LP)", min_time_ms=t)
+        return t, jobs
+
     # ------------------------------------------------------------ scheduling
 
     def get_job_assignment(self) -> Tuple[int, FlowJobsMap]:
         """Minimum feasible completion time (MILLISECONDS) + per-sender
         byte-range jobs (flow.go:146-218, at 1000× finer granularity)."""
+        if self.topology is not None and self.x_pairs and _have_lp():
+            return self._lp_job_assignment()
         required = sum(self._pair_size(lid, dest) for lid, dest in self.pairs)
 
+        # Pure max-flow feasibility only: it is monotone in t (capacities
+        # scale with t), which the binary search requires.  Whether the
+        # particular EK-chosen flow re-attributes along true holdings is
+        # NOT monotone, so attribution is checked once at the final t.
         t_upper = 1
         while self.max_flow(t_upper) < required:
             if t_upper > _INF // 2:
@@ -257,6 +616,12 @@ class FlowGraph:
                 hi = mid - 1
 
         self.max_flow(t)  # leave residuals for decomposition
+        cross = self._attribute_cross() if self.x_pairs else {}
+        if cross is None:
+            # The relaxation chose an unattributable flow (module
+            # docstring): replan flat rather than emit an invalid tiling.
+            return self._flat_replan(
+                f"cross-slice attribution failed at t={t}")
 
         jobs: FlowJobsMap = {}
         pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
@@ -273,11 +638,15 @@ class FlowGraph:
                     # contributes toward (layer, dest).
                     flow = self.cap[layer][cls]
                     if flow > 0:
-                        offset = pair_offset.get((layer_id, dest), 0)
-                        jobs.setdefault(sender_id, []).append(
-                            FlowJob(sender_id, layer_id, flow, offset, dest)
-                        )
-                        pair_offset[(layer_id, dest)] = offset + flow
+                        self._emit_jobs([(sender_id, layer_id, dest, flow)],
+                                        jobs, pair_offset)
+
+        # Cross-slice contributions continue each (layer, dest)'s offsets
+        # after the intra-slice ones (deterministic order).
+        self._emit_jobs(
+            ((s, lid, d, n) for (s, _st, lid, d), n in sorted(cross.items())),
+            jobs, pair_offset,
+        )
 
         log.info("job assignment calculated", min_time_ms=t)
         return t, jobs

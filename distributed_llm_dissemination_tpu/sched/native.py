@@ -158,8 +158,16 @@ def make_flow_graph(
     layer_sizes: Dict[LayerID, int],
     node_network_bw: Dict[NodeID, int],
     remaining=None,
+    topology=None,
 ) -> FlowGraph:
-    """The fastest available mode-3 scheduler for this environment."""
+    """The fastest available mode-3 scheduler for this environment.
+
+    A ``PodTopology`` routes to the Python solver: the C++ Dinic search
+    doesn't carry the per-pair DCN vertices or the holdings
+    re-attribution pass (``flow.FlowGraph._attribute_cross``)."""
+    if topology is not None:
+        return FlowGraph(assignment, status, layer_sizes, node_network_bw,
+                         remaining=remaining, topology=topology)
     cls = FlowGraph if load_flow_solver() is None else NativeFlowGraph
     return cls(assignment, status, layer_sizes, node_network_bw,
                remaining=remaining)

@@ -87,6 +87,15 @@ def test_create_layers_inmem_and_disk(tmp_path):
     # Re-fabrication reuses the existing file.
     disk_layers2 = create_layers(leader, save_disk=True, storage_path=str(tmp_path))
     assert disk_layers2[0].fp == disk_layers[0].fp
+    # ...but NEVER one of the wrong size (a stale file from an earlier
+    # topology would make the sender stream fewer bytes than announced
+    # and wedge the dest).
+    import os
+
+    with open(disk_layers[0].fp, "wb") as f:
+        f.write(b"x" * 10)
+    disk_layers3 = create_layers(leader, save_disk=True, storage_path=str(tmp_path))
+    assert os.path.getsize(disk_layers3[0].fp) == 1048576
 
 
 def test_assignment_json_roundtrip():

@@ -778,6 +778,30 @@ def test_fabric_bandwidths_prefer_ici():
     assert fabric_bandwidths(conf) == {0: 111, 1: 222}
 
 
+def test_mesh_slices_build_pod_topology():
+    """Mesh.Slices + DcnBW parse into the solver's PodTopology; either
+    missing means single-slice (no DCN modeling)."""
+    from distributed_llm_dissemination_tpu.core import config as cfg
+
+    base = {
+        "Nodes": [{"Id": 0, "Addr": ":1", "IsLeader": True, "NetworkBW": 1},
+                  {"Id": 4, "Addr": ":2", "NetworkBW": 1}],
+        "Assignment": {}, "LayerSize": 1,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [2], "Fabric": True,
+                 "Slices": {"0": 0, "4": 1}, "DcnBW": 12_500_000_000},
+    }
+    topo = cfg.Config.from_json(base).mesh.topology()
+    assert topo is not None
+    assert topo.slices() == {0: 0, 4: 1}
+    assert topo.dcn_bw == 12_500_000_000
+    base["Mesh"].pop("DcnBW")
+    assert cfg.Config.from_json(base).mesh.topology() is None
+    # The shipped 2-slice example config round-trips through the loader.
+    conf = cfg.read_json("conf/tpu_2slice_dcn.json")
+    topo = conf.mesh.topology()
+    assert topo is not None and len(set(topo.slices().values())) == 2
+
+
 def test_podrun_fabric_v5e32_shape(tmp_path):
     """The north-star topology at virtual scale: the shipped v5e-32
     Llama-3-70B pipeline placement (8 hosts x 4 chips, 80 layers, every

@@ -137,6 +137,95 @@ def test_duplicate_submit_returns_same_handle(placement, monkeypatch):
         fab.close()
 
 
+def test_plan_scope_is_participating_stages_only(cpu_devices):
+    """The collective's sub-mesh is senders' stages ∪ dest's stage — a
+    2-party transfer on a wider pod must not drag every stage into the
+    gather (the round-3 pod-wide replication this replaces)."""
+    mesh = make_mesh((4, 2), ("nodes", "tp"))
+    p = fabric_placement([0, 1, 2, 3], {3: {0: None}}, mesh, "nodes")
+    fab = SpmdFabric(p, my_node=0)
+    try:
+        scope = fab._plan_scope(_plan(0, [(1, 0, 64)], dest=3))
+        want = set(p.devices_for_node(1)) | set(p.devices_for_node(3))
+        assert set(scope) == want and len(scope) == 4
+        # Multi-sender: all senders' stages join.
+        scope = fab._plan_scope(
+            _plan(1, [(0, 0, 32), (2, 32, 32)], dest=3))
+        assert set(scope) == (set(p.devices_for_node(0))
+                              | set(p.devices_for_node(2))
+                              | set(p.devices_for_node(3)))
+    finally:
+        fab.close()
+
+
+def test_out_of_scope_process_advances_seq_without_collective(
+    placement, monkeypatch
+):
+    """A process with no device in a plan's scope must skip the
+    collective entirely and still retire the seq (lockstep liveness)."""
+    import jax
+
+    fab = SpmdFabric(placement, my_node=0)
+    monkeypatch.setattr(jax, "process_index", lambda: 99)  # nothing local
+    try:
+        r0 = fab.submit(_plan(0, [(0, 0, 8)]))
+        assert r0.get(10.0) is None  # skipped, not executed
+        # The seq advanced: a later plan isn't stuck behind it.  (Sender
+        # 1 == dest 1 keeps my node a zero-contributing participant, so
+        # no layer store is needed.)
+        monkeypatch.undo()
+        r1 = fab.submit(_plan(1, [(1, 0, 8)], dest=1, layer=1))
+        assert fab.wait_result(r1) is None  # my_node=0 is not the dest
+    finally:
+        fab.close()
+
+
+def test_executor_pipelines_dispatch_ahead_of_completion(
+    placement, monkeypatch
+):
+    """The in-flight window: plan k+1 (and k+2) dispatch BEFORE plan k's
+    device work completes — N plans' wall-clock is bounded by the
+    collective stream, not N × (upload + collective + block)."""
+    import threading
+
+    events = []
+    release = threading.Event()
+
+    class FakeOut:
+        def __init__(self, seq):
+            self.seq = seq
+
+        def block_until_ready(self):
+            release.wait(10.0)
+            events.append(("retired", self.seq))
+
+    fab = SpmdFabric(placement, my_node=0)
+    monkeypatch.setattr(
+        fab, "_execute",
+        lambda msg: events.append(("dispatched", msg.seq))
+        or (f"v{msg.seq}", FakeOut(msg.seq)),
+    )
+    try:
+        rs = [fab.submit(_plan(k, [(0, 0, 4)], layer=k)) for k in range(4)]
+        deadline = time.monotonic() + 10
+        # MAX_INFLIGHT=2: plans 0,1,2 all dispatch while 0 is still
+        # unfinished (the 3rd dispatch forces the first retire, which
+        # blocks on the unreleased FakeOut).
+        while (events.count(("dispatched", 2)) == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert ("dispatched", 0) in events
+        assert ("dispatched", 1) in events
+        assert ("dispatched", 2) in events
+        assert ("retired", 0) not in events  # 0 still in flight
+        release.set()
+        assert [r.get(10.0) for r in rs] == ["v0", "v1", "v2", "v3"]
+        assert events.index(("dispatched", 2)) < events.index(("retired", 0))
+    finally:
+        release.set()
+        fab.close()
+
+
 def test_layout_total_mismatch_fails_the_plan(placement):
     fab = SpmdFabric(placement, my_node=0)
     try:
