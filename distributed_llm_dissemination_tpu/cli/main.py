@@ -174,6 +174,9 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     # so receivers can never boot (or skip) against the leader's wait.
     validate_boot_choice(args, conf)
     leader.boot_enabled = boot_config(args.boot or conf.model) is not None
+    # Pod serving decodes -gen tokens (rides the ServeMsg): the leader's
+    # flag governs the whole pod, like the boot decision.
+    leader.serve_generate = max(0, args.gen)
 
     print(
         f"launching leader...\n[addr: {node.transport.get_address()}, "

@@ -66,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-boot", type=str, default="",
                    help="model config name: boot the model from the "
                         "fabric-delivered blobs and report TTFT")
+    p.add_argument("-gen", type=int, default=0,
+                   help="after a servable pipeline boot, greedy-decode "
+                        "this many tokens across the pod (KV-cached)")
     p.add_argument("-v", action="store_true", help="output debug messages")
     return p
 
@@ -82,7 +85,7 @@ def fabric_bandwidths(conf: cfg.Config) -> Dict[int, int]:
 
 
 def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
-            timeout: float = 600.0) -> Dict[str, float]:
+            timeout: float = 600.0, gen: int = 0) -> Dict[str, float]:
     """Drive one full pod dissemination; returns the timing summary.
 
     Callable from tests/benchmarks; the fabric and placement span every
@@ -160,16 +163,31 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
             summary["boot_nodes"] = len(booted)
             # When the stage boots partition the model, the POD serves as
             # one pipelined model from the landed weights (pp_serve).
-            from ..runtime.pp_serve import pod_forward
+            from ..runtime.pp_serve import assemble_pp_params, pod_forward
 
             results = {r.node.my_id: r.boot_result for r in receivers}
             stores = {r.node.my_id: r.layers for r in receivers}
+            assembled = assemble_pp_params(boot_cfg, placement, results,
+                                           stores, conf.model_codec)
             served = pod_forward(boot_cfg, placement, results, stores,
-                                 codec=conf.model_codec)
+                                 codec=conf.model_codec,
+                                 assembled=assembled)
             if served is not None:
                 _, pod_s = served
                 summary["pod_forward_s"] = round(pod_s, 6)
                 print(f"Pod pipelined forward: {pod_s:.6f}s", flush=True)
+            if served is not None and gen > 0:
+                from ..runtime.pp_serve import pod_decode
+
+                dec = pod_decode(boot_cfg, placement, results, stores,
+                                 max_new=gen, codec=conf.model_codec,
+                                 assembled=assembled)
+                if dec is not None:
+                    toks, dec_s = dec
+                    summary["pod_decode_s"] = round(dec_s, 6)
+                    summary["tokens"] = [int(t) for t in toks[0]]
+                    print(f"Pod decoded {toks.shape[1]} tokens: "
+                          f"{summary['tokens']}", flush=True)
         print(json.dumps(summary), flush=True)
         return summary
     finally:
@@ -185,7 +203,7 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     ulog.configure(node="pod", verbose=args.v)
     conf = cfg.read_json(args.f)
-    run_pod(conf, mode=args.m, boot=args.boot)
+    run_pod(conf, mode=args.m, boot=args.boot, gen=max(0, args.gen))
     return 0
 
 

@@ -283,12 +283,17 @@ def example_batch(cfg: ModelConfig, mesh: Mesh, batch: int = 0, seq: int = 0):
 
 
 def build_pp_forward(cfg: ModelConfig, mesh: Mesh, pp_axis: str):
-    """jitted (layers, head, tokens) -> logits over a pipeline-sharded
-    mesh: each stage holds its n_layers/pp stacked slice resident (the
-    Assignment's placement — what dissemination landed), head leaves are
-    replicated, and activations hand off stage→stage by ``ppermute``
+    """jitted (layers, counts, head, tokens) -> logits over a
+    pipeline-sharded mesh: each stage holds its stacked slice resident
+    (the Assignment's placement — what dissemination landed), head leaves
+    are replicated, and activations hand off stage→stage by ``ppermute``
     exactly like the train step's pipeline fill.  Logits are valid on
     stage 0 after the wrap-around and broadcast by psum.
+
+    UNEVEN contiguous partitions serve too: slices arrive PADDED to the
+    deepest stage and ``counts`` [pp] (sharded along ``pp_axis``) gives
+    each stage's real depth — the padded tail passes the hidden state
+    through unchanged.
 
     Any extra mesh axes (e.g. tp) replicate the computation — this is the
     serving form of the staged placement, not the full 5-axis program."""
@@ -297,15 +302,19 @@ def build_pp_forward(cfg: ModelConfig, mesh: Mesh, pp_axis: str):
     pp = mesh.shape[pp_axis]
     fwd = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def per_device(layers_local, head, tokens):
+    def per_device(layers_local, counts_local, head, tokens):
+        count = counts_local[0]
+        l_max = jax.tree.leaves(layers_local)[0].shape[0]
         positions = jnp.arange(tokens.shape[1])
         x = head["embed"][tokens]
 
-        def body(h, layer_p):
-            return layer_apply(layer_p, h, positions, cfg), None
+        def body(h, scanned):
+            layer_p, li = scanned
+            h_new = layer_apply(layer_p, h, positions, cfg)
+            return jnp.where(li < count, h_new, h), None
 
         for _ in range(pp):
-            x = lax.scan(body, x, layers_local)[0]
+            x = lax.scan(body, x, (layers_local, jnp.arange(l_max)))[0]
             if pp > 1:
                 x = lax.ppermute(x, pp_axis, fwd)
 
@@ -324,7 +333,95 @@ def build_pp_forward(cfg: ModelConfig, mesh: Mesh, pp_axis: str):
     f = jax.shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(pp_axis), P(), P()),
+        in_specs=(P(pp_axis), P(pp_axis), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(f)
+
+
+def build_pp_decode(cfg: ModelConfig, mesh: Mesh, pp_axis: str,
+                    max_new: int):
+    """jitted (layers, counts, head, prompt) -> greedy token ids
+    [b, max_new]: the KV-cached decode loop (``models/generate.py``) run
+    as a lockstep pipeline collective over the staged placement — the
+    multi-controller serving analogue of the reference's startup
+    inference hook (message.go:216-241).
+
+    Mechanics: in pipeline-rotation round r only stage r's application
+    is REAL (the rotated copies other stages chew are in-fill garbage,
+    same as ``build_pp_forward``), so each stage masks its per-layer KV
+    cache writes to ``(round == my_stage) & (layer < count)`` — the
+    cache stays exact while every process executes the identical
+    program.  The final hidden state wraps to stage 0, is psum-broadcast
+    as [b, d_model], and argmax picks the next token identically on
+    every device, so the replicated decode loop can never diverge.
+    Uneven padded slices work exactly as in ``build_pp_forward``."""
+    from .generate import _layer_with_cache
+
+    pp = mesh.shape[pp_axis]
+    fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def per_device(layers_local, counts_local, head, prompt):
+        count = counts_local[0]
+        idx = lax.axis_index(pp_axis)
+        b, p = prompt.shape
+        l_max = jax.tree.leaves(layers_local)[0].shape[0]
+        max_len = p + max_new
+        kc = jnp.zeros((l_max, b, max_len, cfg.n_kv_heads, cfg.head_dim),
+                       cfg.dtype)
+        vc = jnp.zeros_like(kc)
+
+        def pipeline(x, positions, kc, vc):
+            """One full pipelined pass; returns (last-pos logits, caches)."""
+            for r in range(pp):
+                real = idx == r
+
+                def body(h, scanned):
+                    layer_p, k_l, v_l, li = scanned
+                    h_new, k_new, v_new = _layer_with_cache(
+                        layer_p, h, positions, k_l, v_l, cfg)
+                    valid = real & (li < count)
+                    return (
+                        jnp.where(valid, h_new, h),
+                        (jnp.where(valid, k_new, k_l),
+                         jnp.where(valid, v_new, v_l)),
+                    )
+
+                x, (kc, vc) = lax.scan(
+                    body, x, (layers_local, kc, vc, jnp.arange(l_max)))
+                if pp > 1:
+                    x = lax.ppermute(x, pp_axis, fwd)
+            if pp > 1:
+                x = lax.psum(jnp.where(idx == 0, x, 0.0), pp_axis)
+            xn = rms_norm(x[:, -1, :], head["ln_f"], cfg.norm_eps)
+            logits = jnp.einsum("bd,dv->bv", xn, head["lm_head"],
+                                preferred_element_type=jnp.float32)
+            return logits, kc, vc
+
+        logits, kc, vc = pipeline(
+            head["embed"][prompt], jnp.arange(p), kc, vc)
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if max_new == 1:
+            return first[:, None]
+
+        def step(carry, _):
+            kc, vc, token, pos = carry
+            logits, kc, vc = pipeline(
+                head["embed"][token[:, None]], pos[None], kc, vc)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (kc, vc, nxt, pos + 1), token
+
+        (_, _, last, _), toks = lax.scan(
+            step, (kc, vc, first, jnp.asarray(p, jnp.int32)),
+            None, length=max_new - 1,
+        )
+        return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+    f = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(pp_axis), P(pp_axis), P(), P()),
         out_specs=P(),
         check_vma=False,
     )

@@ -143,6 +143,7 @@ class LeaderNode:
         # dissemination-only runs of boot-capable topologies (-boot none).
         self.boot_enabled = True
         self._serve_promised = False  # StartupMsg said a ServeMsg follows
+        self.serve_generate = 0  # >0: pod serving decodes N tokens (-gen)
         # Model-boot completion tracking (BootReadyMsg is an extension:
         # the reference's startup hook has no completion signal).
         self._boot_q: "queue.Queue[Dict[NodeID, float]]" = queue.Queue()
@@ -238,7 +239,8 @@ class LeaderNode:
         the pod can no longer serve (a crash changed the assignment, the
         fabric got disabled): receivers told ``serve=True`` are waiting
         and must be released, not left to a timeout."""
-        members = self.serve_members()
+        served = self.serve_members()
+        members, counts = served if served is not None else (None, [])
         if members is not None:
             # Every member must have REALLY booted a stage model: a
             # "skipped" (opted-out) or "full" report can't enter the
@@ -253,7 +255,9 @@ class LeaderNode:
                 members = None
         if members is None and not self._serve_promised:
             return
-        serve = ServeMsg(self.node.my_id, members or [])
+        serve = ServeMsg(self.node.my_id, members or [],
+                         counts=counts if members else [],
+                         gen=self.serve_generate)
         with self._lock:
             recipients = sorted(
                 (set(self.status) | set(members or ()))
@@ -287,12 +291,16 @@ class LeaderNode:
             log.warn("pod serve cancelled: pod no longer servable")
 
     def serve_members(self):
-        """Stage-ordered member nodes for multi-controller serving, or
-        None.  The leader is model-agnostic, so the check is structural
-        (blob ids only): the max assigned id H is the head blob, every
-        member holds H (a process can only decode what its store has),
-        and the members' remaining ids are equal contiguous slices
-        partitioning [0, H).  Receivers re-validate against the model."""
+        """(Stage-ordered member nodes, their stage depths) for
+        multi-controller serving, or None.  The leader is model-agnostic,
+        so the check is structural (blob ids only): the max assigned id H
+        is the head blob, every member holds H (a process can only decode
+        what its store has), and the members' remaining ids are contiguous
+        slices partitioning [0, H) — UNEVEN slices are fine (the members
+        pad to the deepest stage, ``pp_serve``).  Counts come from the
+        SAME assignment snapshot the membership was validated on (a
+        concurrent update()/crash must not desynchronize them).
+        Receivers re-validate against the model."""
         if not self._spmd or self.placement is None or not self.boot_enabled:
             return None
         with self._lock:
@@ -316,15 +324,15 @@ class LeaderNode:
                 return None
             slices[n] = (body[0], body[-1] + 1)
         spans = sorted(slices.values())
-        sizes = {e - s for s, e in spans}
         pos = 0
         for s, e in spans:
             if s != pos:
                 return None
             pos = e
-        if pos != head or len(sizes) != 1:
+        if pos != head:
             return None
-        return sorted(slices, key=lambda n: slices[n][0])
+        members = sorted(slices, key=lambda n: slices[n][0])
+        return members, [slices[m][1] - slices[m][0] for m in members]
 
     def close(self) -> None:
         self.detector.stop()

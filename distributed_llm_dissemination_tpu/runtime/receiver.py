@@ -640,7 +640,7 @@ class ReceiverNode:
         ).start()
 
     def _serve(self, msg: ServeMsg) -> None:
-        from .pp_serve import spmd_pod_forward
+        from .pp_serve import spmd_pod_decode, spmd_pod_forward
 
         out = None
         try:
@@ -655,12 +655,28 @@ class ReceiverNode:
                 log.error("serveMsg but no stage boot to serve from",
                           kind=getattr(res, "kind", None))
                 return
-            out = spmd_pod_forward(
-                self.boot_cfg, self.placement, msg.members,
-                self.node.my_id, res.params, self.layers,
-                codec=self.boot_codec, batch=msg.batch,
-                seq_len=msg.seq_len,
-            )
+            counts = msg.counts or None
+            if msg.gen > 0:
+                # Pod generation: every member enters the lockstep
+                # KV-cached greedy decode and emits IDENTICAL token ids.
+                out = spmd_pod_decode(
+                    self.boot_cfg, self.placement, msg.members,
+                    self.node.my_id, res.params, self.layers,
+                    max_new=msg.gen, codec=self.boot_codec,
+                    batch=msg.batch, prompt_len=msg.seq_len,
+                    member_counts=counts,
+                )
+                if out is not None:
+                    toks, _ = out
+                    log.info("pod generated token ids",
+                             tokens=[int(t) for t in toks[0]])
+            else:
+                out = spmd_pod_forward(
+                    self.boot_cfg, self.placement, msg.members,
+                    self.node.my_id, res.params, self.layers,
+                    codec=self.boot_codec, batch=msg.batch,
+                    seq_len=msg.seq_len, member_counts=counts,
+                )
         except Exception as e:  # noqa: BLE001 — serve failure is loud, non-fatal
             log.error("pod serve failed", err=repr(e))
         finally:

@@ -325,26 +325,35 @@ class BootReadyMsg:
 class ServeMsg:
     """Leader → all (multi-controller SPMD): the stage boots partition
     the model — every ``members`` process must now enter the SAME
-    pipelined-forward collective (``runtime/pp_serve.py``) with its
-    resident stage weights.  Non-members ignore it."""
+    serving collective (``runtime/pp_serve.py``) with its resident stage
+    weights: one pipelined forward, or (``gen`` > 0) a KV-cached greedy
+    decode of ``gen`` tokens.  ``counts`` carries each member's stage
+    depth (aligned with ``members``) so uneven partitions assemble
+    identically on every process.  Non-members ignore it."""
 
     src_id: NodeID
     members: list  # stage-ordered node ids
     batch: int = 1
     seq_len: int = 16
+    counts: list = dataclasses.field(default_factory=list)
+    gen: int = 0  # >0: decode this many tokens instead of one forward
 
     msg_type = MsgType.SERVE
 
     def to_payload(self) -> dict:
         return {"SrcID": self.src_id,
                 "Members": [int(m) for m in self.members],
-                "Batch": self.batch, "SeqLen": self.seq_len}
+                "Batch": self.batch, "SeqLen": self.seq_len,
+                "Counts": [int(c) for c in self.counts],
+                "Gen": self.gen}
 
     @classmethod
     def from_payload(cls, d: dict) -> "ServeMsg":
         return cls(int(d["SrcID"]),
                    [int(m) for m in d.get("Members") or []],
-                   int(d.get("Batch", 1)), int(d.get("SeqLen", 16)))
+                   int(d.get("Batch", 1)), int(d.get("SeqLen", 16)),
+                   [int(c) for c in d.get("Counts") or []],
+                   int(d.get("Gen", 0)))
 
 
 @dataclasses.dataclass

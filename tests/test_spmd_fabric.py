@@ -498,3 +498,122 @@ def test_three_process_spmd_pipeline_serves():
                 p.kill()
         if os.path.exists(conf_path):
             os.remove(conf_path)
+
+
+def test_serve_members_accepts_uneven_partition():
+    """Round-4 lift: contiguous but UNEVEN slices (all holding the head)
+    are servable; gaps still aren't."""
+    leader, t = _leader_with_spmd()
+    try:
+        head = 4
+        leader.boot_enabled = True
+        leader.assignment = {
+            1: {b: None for b in [0, 1, 2, head]},
+            2: {b: None for b in [3, head]},
+        }
+        assert leader.serve_members() == ([1, 2], [3, 1])
+        # A gap (layer 2 unassigned) cancels serving.
+        leader.assignment = {
+            1: {b: None for b in [0, 1, head]},
+            2: {b: None for b in [3, head]},
+        }
+        assert leader.serve_members() is None
+    finally:
+        leader.close()
+        t.close()
+
+
+def test_three_process_spmd_uneven_pod_decode():
+    """Multi-controller GENERATION: three real OS processes, an UNEVEN
+    stage partition (3/1 of tiny's 4 layers), dissemination over the
+    SPMD fabric, stage boots, then -gen 5 makes every member enter the
+    lockstep KV-cached greedy decode — both members must emit EXACTLY
+    the token ids the single-process decode loop produces."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llm_dissemination_tpu.cli.ttd_matrix import _free_port
+    from distributed_llm_dissemination_tpu.models import serde
+    from distributed_llm_dissemination_tpu.models.generate import generate
+    from distributed_llm_dissemination_tpu.models.llama import (
+        CONFIGS,
+        init_params,
+    )
+
+    mcfg = CONFIGS["tiny"]
+    head_id = serde.head_blob_id(mcfg)
+    cut = 3  # stages of depth 3 and 1
+    conf = {
+        "Model": "tiny", "ModelSeed": 0,
+        "Nodes": [
+            {"Id": 0, "Addr": f"127.0.0.1:{_free_port()}", "IsLeader": True,
+             "NetworkBW": 10**9, "Sources": {"2": 0},
+             "InitialLayers": {"2": {str(b): {} for b in range(head_id + 1)}}},
+            {"Id": 1, "Addr": f"127.0.0.1:{_free_port()}",
+             "NetworkBW": 10**9, "Sources": {"2": 0}, "InitialLayers": {}},
+            {"Id": 2, "Addr": f"127.0.0.1:{_free_port()}",
+             "NetworkBW": 10**9, "Sources": {"2": 0}, "InitialLayers": {}},
+        ],
+        "Assignment": {
+            "1": {str(b): {} for b in list(range(cut)) + [head_id]},
+            "2": {str(b): {} for b in list(range(cut, head_id))
+                  + [head_id]},
+        },
+        "LayerSize": 1,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [3],
+                 "PipelineAxis": "nodes", "Fabric": True},
+        "Distributed": {"Coordinator": f"127.0.0.1:{_free_port()}",
+                        "CpuCollectives": "gloo"},
+    }
+    conf_path = os.path.join(REPO, ".pytest-spmd-decode.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per process
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "3"]
+    procs = {}
+    try:
+        for i in (1, 2):
+            procs[i] = subprocess.Popen(
+                cli + ["-id", str(i)], stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=env, text=True)
+        procs[0] = subprocess.Popen(
+            cli + ["-id", "0", "-gen", "5"], stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env, text=True)
+        outs = {}
+        for i, p in procs.items():
+            try:
+                outs[i] = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs.values():
+                    q.kill()
+                raise
+        for i, p in procs.items():
+            assert p.returncode == 0, (
+                f"node {i} failed:\n{outs[i][1][-3000:]}"
+            )
+        want = generate(init_params(mcfg, jax.random.key(0)),
+                        jnp.zeros((1, 16), jnp.int32), mcfg, max_new=5)
+        want_ids = [int(t) for t in np.asarray(want)[0]]
+        for i in (1, 2):
+            err = outs[i][1]
+            assert "pod decoded tokens from staged weights" in err, (
+                f"node {i} never decoded:\n{err[-3000:]}"
+            )
+            m = re.search(r'"tokens": \[([0-9, ]+)\]', err)
+            assert m, f"node {i} logged no token ids:\n{err[-2000:]}"
+            got = [int(t) for t in m.group(1).split(",")]
+            assert got == want_ids, (i, got, want_ids)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        if os.path.exists(conf_path):
+            os.remove(conf_path)
