@@ -1260,10 +1260,28 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         capacity instead of pretending every edge is ICI."""
         self.node_network_bw = dict(node_network_bw)
         self.topology = topology
+        if topology is not None:
+            # Pre-warm the LP solver import (scipy + HiGHS, ~1-2 s cold)
+            # off the critical path: the first assign_jobs otherwise pays
+            # it inside the TTD clock.
+            threading.Thread(
+                target=self._warm_lp, name="lp-warm", daemon=True
+            ).start()
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
                          failure_timeout=failure_timeout,
                          fabric=fabric, placement=placement)
+
+    @staticmethod
+    def _warm_lp() -> None:
+        try:
+            from scipy.optimize import linprog
+            from scipy.sparse import csr_matrix
+
+            linprog([1.0], A_ub=csr_matrix([[1.0]]), b_ub=[1.0],
+                    bounds=(0, None), method="highs")
+        except Exception:  # noqa: BLE001 — warm-up is best-effort
+            pass
 
     def _register_handlers(self) -> None:
         super()._register_handlers()

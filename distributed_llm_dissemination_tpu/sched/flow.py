@@ -107,6 +107,29 @@ class FlowJob:
 FlowJobsMap = Dict[NodeID, List[FlowJob]]
 
 
+def _search_min_time(feasible, lo: int = 1):
+    """Smallest feasible t >= lo (exponential doubling + binary search —
+    the reference's search shape, flow.go:155-187, shared by the flat,
+    relaxed-seed, and LP paths so they can't drift).  Returns (t, True),
+    or (t_stop, False) when nothing up to ~_INF/2 is feasible — the
+    caller degrades immediately instead of binary-searching a range the
+    doubling already proved infeasible."""
+    t = max(1, lo)
+    while not feasible(t):
+        if t > _INF // 2:
+            return t, False
+        t *= 2
+    lo_b, hi, best = max(1, lo), t, t
+    while lo_b <= hi:
+        mid = (lo_b + hi) // 2
+        if feasible(mid):
+            best = min(best, mid)
+            hi = mid - 1
+        else:
+            lo_b = mid + 1
+    return best, True
+
+
 def _have_lp() -> bool:
     try:
         from scipy.optimize import linprog  # noqa: F401
@@ -479,12 +502,17 @@ class FlowGraph:
             kind = key[0]
             if kind == "class":
                 _, s, st = key
-                # Same rule as _build: per-layer metadata disagreeing on
-                # the class rate takes the max CAPACITY (deterministic,
-                # not announcement-order; rate 0 means NIC-bound).
+                # EXACTLY _build's rule (line-for-line semantics): only
+                # layers that still have dests contribute, disagreeing
+                # metadata takes the max CAPACITY (deterministic, not
+                # announcement-order; rate 0 means NIC-bound).  Matching
+                # _build keeps the relaxed max-flow a true bound for the
+                # LP — a delivered (dest-less) layer's rate must not leak
+                # into the class cap of either solver.
                 cap = max(self._class_capacity(s, m.limit_rate, t)
-                          for m in self.status[s].values()
-                          if int(m.source_type) == st)
+                          for lid, m in self.status[s].items()
+                          if int(m.source_type) == st
+                          and self.dests_of.get(lid))
             elif kind == "snic" or kind == "rnic":
                 cap = self.node_network_bw.get(key[1], 0) * t // TIME_SCALE
             elif kind == "pair":
@@ -559,23 +587,27 @@ class FlowGraph:
             sched = s
             return True
 
-        t_upper = 1
-        while not feasible(t_upper):
-            if t_upper > _INF // 2:
-                # Some pair can never be fully delivered; the flat solver
-                # still schedules every deliverable byte.
-                return self._flat_replan("no feasible t under the LP")
-            t_upper *= 2
-        lo, hi, t = 1, t_upper, t_upper
+        # Seed the LP search from the RELAXED max-flow bound: the
+        # relaxation only loosens constraints (same class/NIC caps, the
+        # holdings structure dropped at the pair vertices), so its
+        # minimum time is a valid lower bound for the LP — starting
+        # there skips the small candidates (each a wasted LP solve) and
+        # keeps leader planning latency out of the TTD.
+        required = sum(self._pair_size(lid, d) for lid, d in self.pairs)
+        t_lb, relaxed_ok = _search_min_time(
+            lambda t: self.max_flow(t) >= required)
+        if not relaxed_ok:
+            # Even the relaxation can't deliver everything; the flat
+            # solver still schedules every deliverable byte.
+            return self._flat_replan("no feasible t under the relaxation")
+        t, ok = _search_min_time(feasible, lo=t_lb)
+        if not ok:
+            return self._flat_replan("no feasible t under the LP")
+        # The search's last solve may not have been at t; re-solve once
+        # so the emitted schedule is exactly the optimum's.
+        if not feasible(t):
+            return self._flat_replan("LP optimum became infeasible")
         best = sched
-        while lo <= hi:
-            mid = (lo + hi) // 2
-            if not feasible(mid):
-                lo = mid + 1
-            else:
-                if mid < t:
-                    t, best = mid, sched
-                hi = mid - 1
 
         jobs: FlowJobsMap = {}
         pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
@@ -599,21 +631,11 @@ class FlowGraph:
         # scale with t), which the binary search requires.  Whether the
         # particular EK-chosen flow re-attributes along true holdings is
         # NOT monotone, so attribution is checked once at the final t.
-        t_upper = 1
-        while self.max_flow(t_upper) < required:
-            if t_upper > _INF // 2:
-                log.error("t_upper not found")
-                break
-            t_upper *= 2
-
-        lo, hi, t = 1, t_upper, t_upper
-        while lo <= hi:
-            mid = (lo + hi) // 2
-            if self.max_flow(mid) < required:
-                lo = mid + 1
-            else:
-                t = min(t, mid)
-                hi = mid - 1
+        t, ok = _search_min_time(lambda t: self.max_flow(t) >= required)
+        if not ok:
+            # Undeliverable pair(s): decompose the partial flow at the
+            # search ceiling — every deliverable byte still schedules.
+            log.error("t_upper not found")
 
         self.max_flow(t)  # leave residuals for decomposition
         cross = self._attribute_cross() if self.x_pairs else {}

@@ -404,3 +404,25 @@ def test_topology_fallback_without_scipy(monkeypatch):
     for sender, js in jobs2.items():
         for j in js:
             assert j.layer_id in {0: {0}, 1: {1}}[sender]
+
+
+def test_topology_delivered_layer_rate_does_not_leak_into_class_cap():
+    """Regression (round-4 review): a DELIVERED (dest-less) layer's
+    metadata must not inflate its source class's capacity in either
+    solver — the LP and the flat graph must agree on the completion
+    time, and the relaxed seed must stay a valid lower bound."""
+    from distributed_llm_dissemination_tpu.sched.flow import PodTopology
+
+    kwargs = dict(
+        assignment={1: {0: _meta()}},
+        # Layer 1 is already delivered (no dests) and announces a huge
+        # rate on the same source class; layer 0 is the real work.
+        status={0: {0: _meta(rate=1_000), 1: _meta(rate=10**9)}},
+        layer_sizes={0: 10_000, 1: 10_000},
+        node_network_bw={0: 10**9, 1: 10**9},
+    )
+    t_flat, jobs_flat = FlowGraph(**kwargs).get_job_assignment()
+    topo = PodTopology.make({0: 0, 1: 1}, dcn_bw=10**9)
+    t_topo, jobs_topo = FlowGraph(topology=topo, **kwargs).get_job_assignment()
+    assert t_flat == t_topo == 10_000  # 10 KB at the class's real 1 KB/s
+    check_tiling(jobs_topo, {0: 10_000})
