@@ -308,6 +308,142 @@ def run_baseline_scenarios(scale: int, timeout: float = 600.0) -> dict:
     return out
 
 
+_TTFT_RE = re.compile(r"Time to first token: ([0-9.]+)s")
+
+
+def physical_config() -> tuple:
+    """PHYSICAL-size scenario: 2 seeders hold the ``llama3-8b-d4v8k``
+    blobs — four ~416 MiB layers (EXACTLY the per-layer bytes ``bench.py``
+    measures: the full 8B layer shape) plus a vocab-trimmed head — and
+    one cold dest is assigned everything, mode 3 with ``-hbm`` staging
+    and a model boot (TTFT).  Returns (conf dict, per-layer bytes, the
+    dest's total assigned bytes)."""
+    from ..models import quant, serde
+    from ..models.llama import CONFIGS
+
+    mcfg = CONFIGS["llama3-8b-d4v8k"]
+    head_id = serde.head_blob_id(mcfg)
+    nodes = []
+    for i in range(3):
+        nodes.append({
+            "Id": i, "Addr": f"127.0.0.1:{_free_port()}",
+            "NetworkBW": 10**10, "IsLeader": i == 0,
+            "Sources": {"1": 0},
+            "InitialLayers": (
+                {"1": {str(b): {} for b in range(head_id + 1)}}
+                if i < 2 else {}),
+        })
+    conf = {
+        "Model": mcfg.name, "ModelSeed": 0,
+        "Nodes": nodes,
+        "Assignment": {"2": {str(b): {} for b in range(head_id + 1)}},
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [1]},
+    }
+    layer_bytes = quant.blob_nbytes_codec(mcfg, 0, "raw")
+    total = sum(quant.blob_nbytes_codec(mcfg, b, "raw")
+                for b in range(head_id + 1))
+    return conf, layer_bytes, total
+
+
+def _live_backend(probe_timeout: float = 60.0) -> str:
+    """'tpu'/... when the accelerator answers within the probe window,
+    else '' (the caller pins CPU) — same throwaway-subprocess discipline
+    as bench.py (a wedged tunnel blocks even jax.devices())."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print(jax.default_backend())"],
+            timeout=probe_timeout, capture_output=True, text=True,
+        )
+        lines = probe.stdout.strip().splitlines()
+        return lines[-1] if probe.returncode == 0 and lines else ""
+    except subprocess.TimeoutExpired:
+        return ""
+
+
+def run_physical(timeout: float = 1200.0) -> dict:
+    """One recorded run at PHYSICAL layer size (no -scale): ties the TTD
+    story to the bench's measured ingest bandwidth — TTD, TTFT, and the
+    achieved dest ingest rate on whatever backend is live (recorded)."""
+    backend = _live_backend()
+    env = dict(os.environ) if backend else _cpu_env()
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "physical_3node.json")
+        conf, layer_bytes, total = physical_config()
+        with open(path, "w") as f:
+            json.dump(conf, f)
+        receiver_ids = [n["Id"] for n in conf["Nodes"]
+                        if not n.get("IsLeader")]
+        leader_addr = next(n["Addr"] for n in conf["Nodes"]
+                           if n.get("IsLeader"))
+
+        def spawn(node_id):
+            return subprocess.Popen(
+                [sys.executable, "-m",
+                 "distributed_llm_dissemination_tpu.cli.main",
+                 "-id", str(node_id), "-f", path, "-m", "3", "-hbm"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            )
+
+        def wait_listening(proc, addr: str, budget: float) -> None:
+            # The leader fabricates ~2 GiB of seeded blobs BEFORE it
+            # listens; receivers only retry dialing for ~10 s, so spawn
+            # them once the port actually answers.  A leader that DIED
+            # during fabrication must fail the run now, not after the
+            # whole budget.
+            import socket
+
+            host, port = addr.rsplit(":", 1)
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"leader exited rc={proc.returncode} before "
+                        "listening (fabrication failure?)")
+                try:
+                    with socket.create_connection((host, int(port)),
+                                                  timeout=2.0):
+                        return
+                except OSError:
+                    time.sleep(1.0)
+            raise RuntimeError(f"leader never listened on {addr}")
+
+        procs = []
+        try:
+            leader = spawn(0)
+            procs.append(leader)
+            wait_listening(leader, leader_addr, budget=600.0)
+            for rid in receiver_ids:
+                procs.append(spawn(rid))
+            out, _ = leader.communicate(timeout=timeout)
+            text = out.decode()
+            ttd_m = _TTD_RE.search(text)
+            ttft_m = _TTFT_RE.search(text)
+            if not ttd_m:
+                raise RuntimeError(
+                    f"no TTD in physical run output: {text[-2000:]!r}")
+            ttd = float(ttd_m.group(1))
+            rec = {
+                "scenario": "physical_3node_llama8b-d4@416MiB-layers",
+                "mode": 3, "hbm": True,
+                "backend": backend or "cpu-fallback",
+                "layer_bytes": layer_bytes,
+                "total_bytes": total,
+                "ttd_s": round(ttd, 4),
+                "achieved_gbps": round(total / ttd / 1e9, 3),
+            }
+            if ttft_m:
+                rec["ttft_s"] = round(float(ttft_m.group(1)), 4)
+            print(f"physical: TTD {ttd:.2f}s "
+                  f"({rec['achieved_gbps']} GB/s into the dest, "
+                  f"backend {rec['backend']})", file=sys.stderr, flush=True)
+            return rec
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+
 def to_markdown(results: dict) -> str:
     lines = [
         "# TTD matrix",
@@ -356,6 +492,28 @@ def to_markdown(results: dict) -> str:
             f"| int8 | {ab['int8']['ttd_s']}s | {ab['int8_vs_raw']} |",
             "",
         ]
+    phys = results.get("physical")
+    if phys:
+        lines += [
+            "## Physical-size run (ties the TTD story to the bench)",
+            "",
+            "Mode 3 with `-hbm`: two seeders co-send the "
+            "`llama3-8b-d4v8k` model — four ~416 MiB layers, the exact "
+            "per-layer bytes `bench.py` measures (full 8B layer shape; "
+            "vocab-trimmed head so it doesn't dwarf the layers) — to one "
+            "cold dest that stages into device memory and boots "
+            "(TTFT).  Loopback TCP; the achieved rate is the dest's "
+            "whole-model ingest, network receive + device staging "
+            "end to end.",
+            "",
+            "| scenario | backend | TTD | TTFT | achieved ingest |",
+            "|---|---|---|---|---|",
+            f"| {phys['scenario']} | {phys['backend']} | "
+            f"{phys['ttd_s']}s | "
+            + (f"{phys['ttft_s']}s" if "ttft_s" in phys else "—")
+            + f" | {phys['achieved_gbps']} GB/s |",
+            "",
+        ]
     baseline = results.get("baseline_scenarios")
     if baseline:
         lines += [
@@ -383,23 +541,31 @@ def main(argv=None) -> int:
     p.add_argument("-baseline", action="store_true",
                    help="also run the BASELINE.json scenarios #2-#5 "
                         "(8-64 processes; minutes of wall time)")
+    p.add_argument("-physical", action="store_true",
+                   help="also run the physical-size scenario (~1.8 GiB "
+                        "over loopback + device staging + a boot)")
     args = p.parse_args(argv)
     results = run_matrix(args.scale, args.trials)
     results["codec_ab"] = run_codec_ab(args.trials)
+    prior_doc = None
+    if os.path.exists(args.o):
+        try:
+            with open(args.o) as f:
+                prior_doc = json.load(f)
+        except (OSError, ValueError):
+            prior_doc = None
     if args.baseline:
         results["baseline_scenarios"] = run_baseline_scenarios(
             min(args.scale, 256 << 10)
         )
-    elif os.path.exists(args.o):
+    elif prior_doc and prior_doc.get("baseline_scenarios"):
         # A refresh without -baseline must not erase the recorded
         # BASELINE scenario results (minutes of 64-process wall time).
-        try:
-            with open(args.o) as f:
-                prior = json.load(f).get("baseline_scenarios")
-        except (OSError, ValueError):
-            prior = None
-        if prior:
-            results["baseline_scenarios"] = prior
+        results["baseline_scenarios"] = prior_doc["baseline_scenarios"]
+    if args.physical:
+        results["physical"] = run_physical()
+    elif prior_doc and prior_doc.get("physical"):
+        results["physical"] = prior_doc["physical"]
     with open(args.o, "w") as f:
         json.dump(results, f, indent=1)
     md = os.path.splitext(args.o)[0] + ".md"

@@ -73,6 +73,19 @@ CONFIGS: Dict[str, ModelConfig] = {
         name="llama3-8b", vocab=128256, d_model=4096, n_layers=32,
         n_heads=32, n_kv_heads=8, d_ff=14336,
     ),
+    # Flagship at reduced depth: full 8B layer SHAPE (so each layer blob
+    # is the physical ~416 MiB the bench measures) but 4 layers, fitting
+    # one chip next to activations.  The driver's entry() compile check
+    # and the TTD matrix's physical-size scenario share it; "v8k" trims
+    # the vocab so the head blob doesn't dwarf the layers it escorts.
+    "llama3-8b-d4": ModelConfig(
+        name="llama3-8b-d4", vocab=128256, d_model=4096, n_layers=4,
+        n_heads=32, n_kv_heads=8, d_ff=14336,
+    ),
+    "llama3-8b-d4v8k": ModelConfig(
+        name="llama3-8b-d4v8k", vocab=8192, d_model=4096, n_layers=4,
+        n_heads=32, n_kv_heads=8, d_ff=14336,
+    ),
     "llama3-70b": ModelConfig(
         name="llama3-70b", vocab=128256, d_model=8192, n_layers=80,
         n_heads=64, n_kv_heads=8, d_ff=28672,

@@ -14,6 +14,21 @@ import time
 # Reference uses a 256 KiB burst bucket (distributor/transport.go:409).
 DEFAULT_BURST = 256 * 1024
 
+# One bucket quantum must represent at least this much wall time of
+# traffic: time.sleep's OS granularity is ~1 ms, so a fixed 256 KiB
+# bucket silently caps ANY commanded rate at ~burst/1ms (~256 MB/s) —
+# a 10 GB/s ICI-class budget would ship at 1/40th of it.  Scaling the
+# burst UP for fast rates keeps the pacing overhead bounded while
+# leaving slow rates (where 256 KiB already spans many ms) at exact
+# reference-parity burst semantics.
+MIN_QUANTUM_S = 0.005
+
+
+def effective_burst(rate: float, burst: int = DEFAULT_BURST) -> int:
+    if rate <= 0:
+        return burst
+    return max(int(burst), int(rate * MIN_QUANTUM_S))
+
 
 class TokenBucket:
     """Thread-safe token bucket: ``wait_n(n)`` blocks until n tokens exist.
@@ -23,8 +38,10 @@ class TokenBucket:
 
     def __init__(self, rate: float, burst: int = DEFAULT_BURST):
         self.rate = float(rate)
-        # burst must be positive when limited, or wait_n's chunking spins.
-        self.burst = max(1, int(burst)) if rate > 0 else 0
+        # burst must be positive when limited, or wait_n's chunking spins;
+        # fast rates scale it so sleep granularity can't cap throughput.
+        self.burst = (max(1, effective_burst(rate, burst))
+                      if rate > 0 else 0)
         self._tokens = float(self.burst)
         self._last = time.monotonic()
         self._lock = threading.Lock()
