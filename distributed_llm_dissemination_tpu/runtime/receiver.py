@@ -21,6 +21,7 @@ into one source class.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 from typing import Dict, Tuple
@@ -724,6 +725,13 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # layer -> (reassembly buffer, disjoint covered [start, end) ranges)
         self._partial: Dict[int, Tuple[bytearray, list]] = {}
         self._partial_total: Dict[int, int] = {}
+        # layer -> {token: claimed ranges}: fragment byte copies run
+        # OUTSIDE self._lock (a 16 MiB memcpy under the lock serializes
+        # every other handler); coverage is claimed first, so completion
+        # and coverage readers must treat in-flight claims as not-yet-real
+        # bytes.  Same discipline as parallel/ingest.ShardedLayerIngest.
+        self._copying: Dict[int, Dict[int, list]] = {}
+        self._copy_tok = itertools.count()
         # layer -> DURABLY-covered ranges: only ranges whose .part write has
         # fsync'd merge in (under self._lock), so the journal can never
         # claim bytes another handler thread hasn't landed on disk yet.
@@ -815,25 +823,38 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             self._ingests.pop(layer_id, None)
 
     def _announce_partial(self) -> dict:
+        """Partial coverage for the announce — EXCLUDING in-flight copy
+        claims, exactly like ``_local_coverage``: a range announced as
+        held is a range the leader won't re-plan, so it must only ever
+        name bytes that have really landed in the buffer."""
         with self._lock:
-            return {
-                lid: {
+            out = {}
+            for lid, (_, covered) in self._partial.items():
+                if lid not in self._partial_total:
+                    continue
+                for claims in self._copying.get(lid, {}).values():
+                    for lo, hi in claims:
+                        covered = intervals.remove(covered, lo, hi)
+                out[lid] = {
                     "Total": self._partial_total[lid],
                     "Covered": [list(iv) for iv in covered],
                 }
-                for lid, (_, covered) in self._partial.items()
-                if lid in self._partial_total
-            }
+            return out
 
     def _local_coverage(self, layer_id):
         """Checkpoint-restored bytes seed a resumed fabric ingest: the
         leader's plan covers only the gaps (leader.assign_jobs), so what
-        this node already holds must enter the shard buffers locally."""
+        this node already holds must enter the shard buffers locally.
+        Ranges whose copy is still in flight (claimed, not committed) are
+        excluded — their buffer bytes aren't real yet."""
         with self._lock:
             entry = self._partial.get(layer_id)
             if entry is None:
                 return []
             buf, covered = entry
+            for claims in self._copying.get(layer_id, {}).values():
+                for lo, hi in claims:
+                    covered = intervals.remove(covered, lo, hi)
             return [(s, bytes(memoryview(buf)[s:e])) for s, e in covered]
 
     def _fabric_store(self, layer_id, total: int, device_arr=None,
@@ -862,27 +883,39 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         overlapping fragments from a crash-triggered re-plan can never ack
         a layer full of holes.
 
+        The byte copy runs OUTSIDE ``self._lock`` under a claim/commit
+        discipline (``_copying``): the lock is held only to claim the
+        fragment's uncovered ranges and, after the copy, to commit —
+        concurrent senders' fragments assemble in parallel instead of
+        serializing a 16 MiB memcpy each behind one lock, which matters
+        exactly at physical layer sizes.  Completion (promote + ack) fires
+        at the commit that sees full coverage with no copy in flight.
+
         Device staging is incremental: each fragment is also written to its
         span's device through the layer's ``ShardedLayerIngest`` as it
         arrives, so HBM ingest overlaps the network receive; completion
         runs one ICI all-gather instead of a full-layer device_put."""
+        lid = msg.layer_id
         with self._lock:
-            already_done = msg.layer_id in self.layers
+            already_done = lid in self.layers
         # Ingest creation dispatches device allocations — do it before
         # (and outside) the main critical section.
         ing = None
         if not already_done:
-            ing = self._get_or_create_ingest(msg.layer_id, msg.total_size)
-        frag_off = frag_data = None
-        ckpt_args = None
+            ing = self._get_or_create_ingest(lid, msg.total_size)
+        frag = msg.layer_src
+        claims: list = []
+        tok = None
+        journal = False
+        dup_done = False
         with self._lock:
-            if msg.layer_id in self.layers:
+            if lid in self.layers:
                 # A re-plan duplicate of a finished layer: drop the bytes
                 # but re-ack below — the re-send happened precisely because
                 # the leader never saw our ack.
-                complete = True
+                dup_done = True
             else:
-                entry = self._partial.get(msg.layer_id)
+                entry = self._partial.get(lid)
                 if entry is None:
                     # Allocate lazily (an eager dict.get default would
                     # build a full layer-sized buffer on *every* fragment)
@@ -892,49 +925,70 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     # exposed).
                     entry = (alloc_recv_buffer(msg.total_size), [])
                 buf, covered = entry
-                frag = msg.layer_src
-                data = frag.read_bytes()
-                # memoryview: the one right-hand side both ndarray buffers
-                # (which reject raw bytes) and checkpoint-restored
-                # bytearrays (which reject ndarrays) accept.
-                buf[frag.offset : frag.offset + frag.data_size] = memoryview(data)
-                covered = intervals.insert(
+                claims = intervals.uncovered(
                     covered, frag.offset, frag.offset + frag.data_size
                 )
-                self._partial[msg.layer_id] = (buf, covered)
-                self._partial_total[msg.layer_id] = msg.total_size
-                if self.ckpt is not None:
-                    # Journaled OUTSIDE the lock below: two fsyncs per
-                    # fragment must not serialize every other handler.
-                    ckpt_args = (msg.layer_id, frag.offset, data, msg.total_size)
-                received = intervals.covered(covered)
+                for lo, hi in claims:
+                    covered = intervals.insert(covered, lo, hi)
+                self._partial[lid] = (buf, covered)
+                self._partial_total[lid] = msg.total_size
+                if claims:
+                    tok = next(self._copy_tok)
+                    self._copying.setdefault(lid, {})[tok] = claims
+                # Journaled OUTSIDE the lock below (two fsyncs per
+                # fragment must not serialize every other handler), and
+                # only for fragments that landed NEW bytes — a full
+                # re-plan duplicate's ranges were journaled by their
+                # claim-holders already.
+                journal = self.ckpt is not None and bool(claims)
                 log.info(
                     "layer fragment stored",
-                    layerID=msg.layer_id, received=received, total=msg.total_size,
+                    layerID=lid, received=intervals.covered(covered),
+                    total=msg.total_size,
                 )
-                frag_off, frag_data = frag.offset, data
-                complete = received >= msg.total_size
-                if complete:
-                    self.layers[msg.layer_id] = LayerSrc(
-                        inmem_data=buf,
-                        data_size=msg.total_size,
-                        meta=LayerMeta(location=LayerLocation.INMEM),
-                    )
-                    del self._partial[msg.layer_id]
-                    self._partial_total.pop(msg.layer_id, None)
-                    self._durable.pop(msg.layer_id, None)
-                    if self.ckpt is not None:
-                        self.ckpt.complete(msg.layer_id)
-                    log.info("layer fully received", layer=msg.layer_id,
-                             total_bytes=msg.total_size)
-        if ckpt_args is not None and not complete:
+        if dup_done:
+            self._ack_completed(lid)
+            return
+        # One zero-copy view of the fragment for every consumer below
+        # (read_bytes would duplicate the 16 MiB buffer per use).
+        raw = (frag.inmem_data if frag.inmem_data is not None
+               else frag.read_bytes())
+        data_mv = memoryview(raw)
+        # Ingest first: on an accelerator this dispatches the async DMA,
+        # which then overlaps the host-side assembly copy right below.
+        if ing is not None:
+            try:
+                ing.write(frag.offset, raw)
+            except Exception as e:  # noqa: BLE001 — delivery beats staging
+                self._ingest_write_failed(lid, ing, e)
+                ing = None
+        if tok is not None:
+            try:
+                for lo, hi in claims:
+                    buf[lo:hi] = data_mv[lo - frag.offset : hi - frag.offset]
+            except Exception:
+                with self._lock:
+                    m = self._copying.get(lid)
+                    if m is not None:
+                        m.pop(tok, None)
+                        if not m:
+                            self._copying.pop(lid, None)
+                    entry = self._partial.get(lid)
+                    if entry is not None:
+                        b2, cov2 = entry
+                        for lo, hi in claims:
+                            cov2 = intervals.remove(cov2, lo, hi)
+                        self._partial[lid] = (b2, cov2)
+                raise
+        complete = self._commit_fragment(lid, tok, msg.total_size)
+        if journal and not complete:
             # (The completing fragment skips the journal: its completion
-            # branch already deleted the checkpoint files.)  Bytes first,
+            # already deleted the checkpoint files.)  Bytes first,
             # fsync'd; then merge ONLY this fragment's range into the
             # durable-coverage union under the lock — the meta can never
             # claim ranges whose .part writes are still pending in sibling
             # handler threads (which a crash would restore as zeros).
-            lid, off, data, total = ckpt_args
+            off, data, total = frag.offset, bytes(data_mv), msg.total_size
             self.ckpt.write_bytes(lid, off, data, total)
             with self._lock:
                 raced_completion = lid in self.layers
@@ -953,25 +1007,57 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 self.ckpt.complete(lid)
                 with self._lock:
                     self._durable.pop(lid, None)
-        # Device write OUTSIDE the receiver lock: the DMA dispatch must not
-        # serialize other fragments' network receive (the ingest has its
-        # own lock).
-        if ing is not None and frag_data is not None:
-            try:
-                ing.write(frag_off, frag_data)
-            except Exception as e:  # noqa: BLE001 — delivery beats staging
-                self._ingest_write_failed(msg.layer_id, ing, e)
-        if not complete:
+        if complete:
+            self._ack_completed(lid)
+
+    def _commit_fragment(self, lid, tok, total: int) -> bool:
+        """Release this fragment's copy claim; promote the layer when
+        coverage is full AND no sibling copy is in flight.  Returns
+        whether THIS commit performed the promotion (exactly one does —
+        the caller then stages + acks)."""
+        with self._lock:
+            if tok is not None:
+                m = self._copying.get(lid)
+                if m is not None:
+                    m.pop(tok, None)
+                    if not m:
+                        self._copying.pop(lid, None)
+            if lid in self.layers:
+                return False  # a sibling already promoted (and acked)
+            entry = self._partial.get(lid)
+            if entry is None:
+                return False
+            buf, covered = entry
+            if (intervals.covered(covered) < total
+                    or self._copying.get(lid)):
+                return False
+            self.layers[lid] = LayerSrc(
+                inmem_data=buf, data_size=total,
+                meta=LayerMeta(location=LayerLocation.INMEM),
+            )
+            del self._partial[lid]
+            self._partial_total.pop(lid, None)
+            self._durable.pop(lid, None)
+        if self.ckpt is not None:
+            self.ckpt.complete(lid)
+        log.info("layer fully received", layer=lid, total_bytes=total)
+        return True
+
+    def _ack_completed(self, lid) -> None:
+        """Stage (finalizing any incremental ingest) + ack a completed
+        layer; also the re-ack path for a re-plan duplicate."""
+        with self._lock:
+            src = self.layers.get(lid)
+        if src is None:
             return
-        src = self.layers[msg.layer_id]
         with self._ingests_lock:
-            self._ingest_done.add(msg.layer_id)
-            ing = self._ingests.pop(msg.layer_id, None)
-        loc = self._stage_to_hbm(msg.layer_id, src, ingest=ing)
+            self._ingest_done.add(lid)
+            ing = self._ingests.pop(lid, None)
+        loc = self._stage_to_hbm(lid, src, ingest=ing)
         try:
             self.node.transport.send(
                 self.node.leader_id,
-                AckMsg(self.node.my_id, msg.layer_id, loc),
+                AckMsg(self.node.my_id, lid, loc),
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))

@@ -228,3 +228,26 @@ def test_paced_writer_delivers_all_bytes():
     payload = bytes(range(256)) * 1024  # 256 KiB
     assert w.write(payload) == len(payload)
     assert bytes(out) == payload
+
+
+def test_token_bucket_burst_scales_with_fast_rates():
+    """Regression: a fixed 256 KiB burst + ~1 ms sleep granularity capped
+    every commanded rate at ~256 MB/s.  Fast rates scale the bucket so
+    one quantum covers >=5 ms of traffic; slow rates keep the exact
+    reference-parity 256 KiB."""
+    from distributed_llm_dissemination_tpu.utils.rate import (
+        DEFAULT_BURST,
+        effective_burst,
+    )
+
+    assert effective_burst(4 << 20) == DEFAULT_BURST  # 4 MiB/s: unchanged
+    assert effective_burst(0) == DEFAULT_BURST  # unlimited: n/a
+    assert effective_burst(10**10) == 10**10 // 200  # 5 ms of 10 GB/s
+    # The throughput proof: 32 MiB at a commanded 10 GB/s must not take
+    # the ~128 ms the old fixed bucket forced (32 MiB / 256 MB/s).
+    sink = bytearray()
+    w = PacedWriter(sink.extend, rate=10**10)
+    t0 = time.monotonic()
+    w.write(bytes(32 << 20))
+    assert time.monotonic() - t0 < 0.12, "sleep-granularity cap is back"
+    assert len(sink) == 32 << 20

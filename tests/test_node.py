@@ -299,3 +299,51 @@ def test_receiver_already_has_layers_short_circuit(kind):
         assert bytes(receivers[0].layers[1].inmem_data) == layer_bytes(1)
     finally:
         close_all(leader, receivers, ts)
+
+
+def test_mode3_concurrent_fragment_assembly_byte_exact():
+    """The round-4 out-of-lock fragment copy: a handler-pool's worth of
+    threads deliver overlapping, shuffled fragments concurrently — the
+    layer must assemble byte-exact, promote exactly once, and ack once."""
+    import concurrent.futures
+    import random
+
+    from distributed_llm_dissemination_tpu.core.types import LayerSrc
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AckMsg,
+        LayerMsg,
+    )
+
+    ts, _ = make_transports("inmem", [0, 1])
+    recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {}, start_loop=False)
+    acks = []
+    orig_send = ts[1].send
+    ts[1].send = lambda dest, m, _o=orig_send: (
+        acks.append(m) if isinstance(m, AckMsg) else _o(dest, m))
+    try:
+        total = 1 << 20
+        want = bytes([(i * 31) % 256 for i in range(total)])
+        frags = [(off, want[off : off + 64 << 10])
+                 for off in range(0, total, 64 << 10)]
+        frags += frags[::2]  # duplicates, like a crash-triggered re-plan
+        rng = random.Random(5)
+        rng.shuffle(frags)
+
+        def deliver(fr):
+            off, data = fr
+            src = LayerSrc(inmem_data=bytearray(data),
+                           data_size=len(data), offset=off)
+            recv.handle_layer(LayerMsg(0, 7, src, total))
+
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            list(pool.map(deliver, frags))
+        assert 7 in recv.layers
+        assert bytes(memoryview(recv.layers[7].inmem_data)) == want
+        assert len(acks) >= 1  # the promoting commit acked
+        # ...and exactly one promotion: every ack reports the same layer.
+        assert all(a.layer_id == 7 for a in acks)
+        assert not recv._partial and not recv._copying
+    finally:
+        recv.close()
+        for t in ts.values():
+            t.close()
