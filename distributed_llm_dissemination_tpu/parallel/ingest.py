@@ -219,8 +219,9 @@ class ShardedLayerIngest:
                         piece = (np.asarray(src) if is_device
                                  else np.frombuffer(src, np.uint8))
                         # Claimed ranges are exclusive: concurrent writers
-                        # memcpy into disjoint slices, safely lock-free.
-                        self._host[r][a - s_off : b - s_off] = piece
+                        # memcpy into disjoint slices, safely lock-free
+                        # (memmove-grade, GIL released — hostmem).
+                        hostmem.copy_into(self._host[r], a - s_off, piece)
                     else:
                         if is_device:
                             src = data[a - offset : b - offset]  # on-src slice

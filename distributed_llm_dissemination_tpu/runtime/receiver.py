@@ -44,7 +44,7 @@ from ..transport.messages import (
     ServeMsg,
     StartupMsg,
 )
-from ..utils import intervals
+from ..utils import hostmem, intervals
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from .checkpoint import LayerCheckpointStore
@@ -896,6 +896,16 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         arrives, so HBM ingest overlaps the network receive; completion
         runs one ICI all-gather instead of a full-layer device_put."""
         lid = msg.layer_id
+        frag = msg.layer_src
+        if (frag.offset < 0
+                or frag.offset + frag.data_size > msg.total_size):
+            # A malformed fragment must fail loudly BEFORE any claim: the
+            # memmove assembly below has no implicit bounds check (the
+            # old numpy slice assignment raised; ctypes.memmove corrupts).
+            log.error("fragment outside layer; dropped", layerID=lid,
+                      offset=frag.offset, size=frag.data_size,
+                      total=msg.total_size)
+            return
         with self._lock:
             already_done = lid in self.layers
         # Ingest creation dispatches device allocations — do it before
@@ -903,7 +913,14 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         ing = None
         if not already_done:
             ing = self._get_or_create_ingest(lid, msg.total_size)
-        frag = msg.layer_src
+        # Materialize the fragment's bytes BEFORE claiming (one zero-copy
+        # view for every consumer below; read_bytes would duplicate the
+        # buffer per use): a read failure here must leave no claim behind
+        # — a leaked claim wedges the layer forever (no commit can ever
+        # see an empty in-flight set again).
+        raw = (frag.inmem_data if frag.inmem_data is not None
+               else frag.read_bytes())
+        data_mv = memoryview(raw)
         claims: list = []
         tok = None
         journal = False
@@ -949,11 +966,6 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         if dup_done:
             self._ack_completed(lid)
             return
-        # One zero-copy view of the fragment for every consumer below
-        # (read_bytes would duplicate the 16 MiB buffer per use).
-        raw = (frag.inmem_data if frag.inmem_data is not None
-               else frag.read_bytes())
-        data_mv = memoryview(raw)
         # Ingest first: on an accelerator this dispatches the async DMA,
         # which then overlaps the host-side assembly copy right below.
         if ing is not None:
@@ -965,7 +977,10 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         if tok is not None:
             try:
                 for lo, hi in claims:
-                    buf[lo:hi] = data_mv[lo - frag.offset : hi - frag.offset]
+                    # memmove-grade copy (GIL released): concurrent
+                    # senders' fragments really assemble in parallel.
+                    hostmem.copy_into(
+                        buf, lo, data_mv[lo - frag.offset : hi - frag.offset])
             except Exception:
                 with self._lock:
                     m = self._copying.get(lid)

@@ -17,9 +17,37 @@ capsule keeps the buffer alive for the array's lifetime.
 
 from __future__ import annotations
 
+import ctypes
+
 import numpy as np
 
 ALIGN = 64  # XLA's zero-copy import requires 64-byte alignment
+
+# Below this, numpy's sliced assignment is fine; above it, the memmove
+# path's ~5x higher bandwidth (measured 7.3 vs 1.4 GB/s for 256 MiB on
+# the bench host — numpy's buffer-protocol assignment path is NOT a
+# plain memcpy) dominates the call overhead.
+_MEMMOVE_MIN = 64 * 1024
+
+
+def copy_into(dst, dst_off: int, src) -> None:
+    """``dst[dst_off : dst_off+len(src)] = src`` at memmove speed.
+
+    ``dst`` is a writable byte buffer (uint8 ndarray, or the bytearray a
+    checkpoint restore hands back); ``src`` any byte buffer
+    (bytes/bytearray/memoryview/ndarray).  The fragment-assembly hot
+    path of the receiver and the CPU ingest arm — big enough copies go
+    through ``ctypes.memmove`` (a real memcpy, GIL released during the
+    foreign call; numpy's buffer-protocol assignment measured ~5x
+    slower), small ones through plain numpy assignment."""
+    sv = np.frombuffer(src, dtype=np.uint8)  # zero-copy view
+    dv = (dst if isinstance(dst, np.ndarray)
+          else np.frombuffer(dst, dtype=np.uint8))  # writable for bytearray
+    n = sv.shape[0]
+    if n >= _MEMMOVE_MIN:
+        ctypes.memmove(dv.ctypes.data + dst_off, sv.ctypes.data, n)
+    else:
+        dv[dst_off : dst_off + n] = sv
 
 
 def aligned_empty(nbytes: int, align: int = ALIGN) -> np.ndarray:

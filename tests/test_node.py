@@ -347,3 +347,59 @@ def test_mode3_concurrent_fragment_assembly_byte_exact():
         recv.close()
         for t in ts.values():
             t.close()
+
+
+def test_mode3_rejects_out_of_bounds_fragment():
+    """A malformed fragment (past the announced total) is dropped BEFORE
+    any claim — the memmove assembly has no implicit bounds check, and a
+    leaked claim would wedge the layer forever."""
+    from distributed_llm_dissemination_tpu.core.types import LayerSrc
+    from distributed_llm_dissemination_tpu.transport.messages import LayerMsg
+
+    ts, _ = make_transports("inmem", [0, 1])
+    recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {}, start_loop=False)
+    try:
+        bad = LayerSrc(inmem_data=bytearray(b"x" * 100),
+                       data_size=100, offset=950)
+        recv.handle_layer(LayerMsg(0, 3, bad, 1000))  # [950, 1050) > 1000
+        assert 3 not in recv._partial and not recv._copying
+        # The layer still completes from well-formed fragments.
+        good = LayerSrc(inmem_data=bytearray(b"y" * 1000),
+                        data_size=1000, offset=0)
+        recv.handle_layer(LayerMsg(0, 3, good, 1000))
+        assert bytes(memoryview(recv.layers[3].inmem_data)) == b"y" * 1000
+    finally:
+        recv.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_mode3_unreadable_fragment_leaves_no_claim():
+    """A fragment whose bytes can't be read (dead disk file) must fail
+    before claiming: a retransmit of the same range then completes the
+    layer (a leaked claim would block every later commit)."""
+    import pytest as _pytest
+
+    from distributed_llm_dissemination_tpu.core.types import (
+        LayerLocation,
+        LayerMeta,
+        LayerSrc,
+    )
+    from distributed_llm_dissemination_tpu.transport.messages import LayerMsg
+
+    ts, _ = make_transports("inmem", [0, 1])
+    recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {}, start_loop=False)
+    try:
+        dead = LayerSrc(fp="/nonexistent/layer.bin", data_size=500, offset=0,
+                        meta=LayerMeta(location=LayerLocation.DISK))
+        with _pytest.raises(OSError):
+            recv.handle_layer(LayerMsg(0, 4, dead, 500))
+        assert not recv._copying  # no leaked claim
+        ok = LayerSrc(inmem_data=bytearray(b"z" * 500), data_size=500,
+                      offset=0)
+        recv.handle_layer(LayerMsg(0, 4, ok, 500))
+        assert bytes(memoryview(recv.layers[4].inmem_data)) == b"z" * 500
+    finally:
+        recv.close()
+        for t in ts.values():
+            t.close()
