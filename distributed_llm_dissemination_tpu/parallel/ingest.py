@@ -83,7 +83,7 @@ def ingest_bytes(data, devices: Sequence[jax.Device]) -> jax.Array:
     if n == 1:
         if devices[0].platform == "cpu":
             buf = hostmem.aligned_empty(len(data))
-            buf[:] = np.frombuffer(data, dtype=np.uint8)
+            hostmem.copy_into(buf, 0, data)
             return hostmem.adopt_as_device_array(buf, devices[0])
         return jax.device_put(np.frombuffer(data, dtype=np.uint8), devices[0])
     if len(data) < n:
@@ -122,8 +122,8 @@ class ShardedLayerIngest:
     concurrently.  ``write`` CLAIMS its uncovered byte ranges under the
     lock before moving any bytes (so overlapping duplicates never copy
     twice, and concurrent writers can't both land the same range), then
-    does the heavy byte movement outside the lock; ``_inflight`` tracks
-    claims whose bytes are still moving (a failed claim rolls its
+    does the heavy byte movement outside the lock
+    (``utils.intervals.ClaimedCoverage`` — a failed claim rolls its
     coverage back), and ``finalize`` blocks until coverage is complete
     AND no claim is outstanding — so a completion handler racing a
     sibling fragment handler can never splice a buffer with holes.
@@ -151,12 +151,10 @@ class ShardedLayerIngest:
         self._cpu = not stream
         self._lock = threading.Lock()
         self._complete = threading.Condition(self._lock)
-        self._covered: List[Tuple[int, int]] = []
-        # Claims whose bytes are still being moved: token -> claimed
-        # ranges.  Tracked as ranges (not a bare count) so a failed claim
-        # rolls its coverage back and salvage can exclude in-flight ones.
-        self._inflight: dict = {}
-        self._claim_tok = 0
+        # Claim/commit coverage (shared discipline with the receiver's
+        # fragment assembly): failed claims roll back, salvage reads only
+        # committed ranges.
+        self._cov = intervals.ClaimedCoverage()
         self._failed = False
         self._closed = False  # finalize/salvage ran: late writes no-op
         if self._cpu:
@@ -198,14 +196,9 @@ class ShardedLayerIngest:
                 # A late duplicate racing finalize: its bytes are already
                 # covered (finalize only runs at full coverage).
                 return
-            claims = intervals.uncovered(self._covered, offset, end)
-            if not claims:
+            tok, claims = self._cov.claim(offset, end)
+            if tok is None:
                 return  # full duplicate — idempotent
-            for lo, hi in claims:
-                self._covered = intervals.insert(self._covered, lo, hi)
-            tok = self._claim_tok
-            self._claim_tok += 1
-            self._inflight[tok] = claims
         landed: List[Tuple[int, int, jax.Array]] = []
         try:
             for lo, hi in claims:
@@ -237,18 +230,16 @@ class ShardedLayerIngest:
                 # Roll the claim's coverage back (its bytes never landed —
                 # salvage must not report them) and poison the ingest so
                 # finalize falls back to bulk staging.
-                del self._inflight[tok]
-                for lo, hi in claims:
-                    self._covered = intervals.remove(self._covered, lo, hi)
+                self._cov.abort(tok)
                 self._failed = True
                 self._complete.notify_all()
             raise
         with self._lock:
-            del self._inflight[tok]
+            self._cov.commit(tok)
             if not self._closed and self._pieces is not None:
                 for r, local_off, piece in landed:
                     self._pieces[r].append((local_off, piece))
-            if not self._inflight:
+            if self._cov.idle():
                 # Wakes finalize (full coverage) and salvage (quiescence).
                 self._complete.notify_all()
 
@@ -256,8 +247,7 @@ class ShardedLayerIngest:
         """Wait until no write claim is in flight (test/diagnostic hook;
         does NOT wait for full coverage)."""
         with self._lock:
-            self._complete.wait_for(lambda: not self._inflight,
-                                    timeout=timeout)
+            self._complete.wait_for(self._cov.idle, timeout=timeout)
 
     def fail(self) -> None:
         """Mark the ingest broken (a device write failed); wakes any
@@ -275,15 +265,12 @@ class ShardedLayerIngest:
         in-flight fragments.  Closes the ingest."""
         with self._lock:
             # Quiesce in-flight claims first: coverage is reserved BEFORE
-            # bytes move, so reading mid-claim could return holes.
-            self._complete.wait_for(lambda: not self._inflight, timeout=30.0)
+            # bytes move, so reading mid-claim could return holes; a
+            # claim still in flight past the timeout is excluded by
+            # committed().
+            self._complete.wait_for(self._cov.idle, timeout=30.0)
             self._closed = True
-            covered = list(self._covered)
-            # A claim still in flight past the timeout must not be read
-            # as landed bytes — subtract it from the salvage view.
-            for claims in self._inflight.values():
-                for lo, hi in claims:
-                    covered = intervals.remove(covered, lo, hi)
+            covered = self._cov.committed()
             if self._cpu:
                 out: List[Tuple[int, bytes]] = []
                 for s, e in covered:
@@ -321,19 +308,17 @@ class ShardedLayerIngest:
         the ingest's own coverage is complete and no write is in flight."""
         with self._lock:
             self._complete.wait_for(
-                lambda: self._failed
-                or (not self._inflight
-                    and intervals.covered(self._covered) >= self.total),
+                lambda: self._failed or self._cov.complete(self.total),
                 timeout=timeout,
             )
             self._closed = True  # any write from here on is a no-op
             if self._failed:
                 raise RuntimeError("ingest failed; fall back to bulk staging")
-            if (self._inflight
-                    or intervals.covered(self._covered) < self.total):
+            if not self._cov.complete(self.total):
+                landed = intervals.covered(self._cov.committed())
                 raise RuntimeError(
                     f"ingest incomplete after {timeout}s: "
-                    f"{intervals.covered(self._covered)}/{self.total} bytes"
+                    f"{landed}/{self.total} bytes landed"
                 )
             pieces = (None if self._pieces is None
                       else [sorted(p) for p in self._pieces])

@@ -21,7 +21,6 @@ into one source class.
 
 from __future__ import annotations
 
-import itertools
 import queue
 import threading
 from typing import Dict, Tuple
@@ -722,16 +721,14 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
         process, node.go:1542-1554)."""
-        # layer -> (reassembly buffer, disjoint covered [start, end) ranges)
-        self._partial: Dict[int, Tuple[bytearray, list]] = {}
+        # layer -> (reassembly buffer, ClaimedCoverage): fragment byte
+        # copies run OUTSIDE self._lock (a 16 MiB memcpy under the lock
+        # serializes every other handler) under the claim/commit
+        # discipline shared with parallel/ingest.ShardedLayerIngest —
+        # completion and coverage readers see only committed bytes.
+        self._partial: Dict[int, Tuple[bytearray,
+                                       intervals.ClaimedCoverage]] = {}
         self._partial_total: Dict[int, int] = {}
-        # layer -> {token: claimed ranges}: fragment byte copies run
-        # OUTSIDE self._lock (a 16 MiB memcpy under the lock serializes
-        # every other handler); coverage is claimed first, so completion
-        # and coverage readers must treat in-flight claims as not-yet-real
-        # bytes.  Same discipline as parallel/ingest.ShardedLayerIngest.
-        self._copying: Dict[int, Dict[int, list]] = {}
-        self._copy_tok = itertools.count()
         # layer -> DURABLY-covered ranges: only ranges whose .part write has
         # fsync'd merge in (under self._lock), so the journal can never
         # claim bytes another handler thread hasn't landed on disk yet.
@@ -757,7 +754,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     )
                     self.ckpt.complete(lid)
                 else:
-                    self._partial[lid] = (buf, covered)
+                    self._partial[lid] = (
+                        buf, intervals.ClaimedCoverage(covered))
                     self._partial_total[lid] = total
                     self._durable[lid] = list(covered)  # restored = on disk
         # Loop start is deferred past the checkpoint replay below so no
@@ -770,12 +768,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:
-            for lid, (buf, covered) in self._partial.items():
+            for lid, (buf, cov) in self._partial.items():
                 ing = self._get_or_create_ingest(lid, self._partial_total[lid])
                 if ing is None:
                     continue
                 try:
-                    for s, e in covered:
+                    for s, e in cov.committed():
                         ing.write(s, memoryview(buf)[s:e])
                 except Exception as err:  # noqa: BLE001
                     self._ingest_write_failed(lid, ing, err)
@@ -828,18 +826,14 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         held is a range the leader won't re-plan, so it must only ever
         name bytes that have really landed in the buffer."""
         with self._lock:
-            out = {}
-            for lid, (_, covered) in self._partial.items():
-                if lid not in self._partial_total:
-                    continue
-                for claims in self._copying.get(lid, {}).values():
-                    for lo, hi in claims:
-                        covered = intervals.remove(covered, lo, hi)
-                out[lid] = {
+            return {
+                lid: {
                     "Total": self._partial_total[lid],
-                    "Covered": [list(iv) for iv in covered],
+                    "Covered": [list(iv) for iv in cov.committed()],
                 }
-            return out
+                for lid, (_, cov) in self._partial.items()
+                if lid in self._partial_total
+            }
 
     def _local_coverage(self, layer_id):
         """Checkpoint-restored bytes seed a resumed fabric ingest: the
@@ -851,11 +845,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             entry = self._partial.get(layer_id)
             if entry is None:
                 return []
-            buf, covered = entry
-            for claims in self._copying.get(layer_id, {}).values():
-                for lo, hi in claims:
-                    covered = intervals.remove(covered, lo, hi)
-            return [(s, bytes(memoryview(buf)[s:e])) for s, e in covered]
+            buf, cov = entry
+            return [(s, bytes(memoryview(buf)[s:e]))
+                    for s, e in cov.committed()]
 
     def _fabric_store(self, layer_id, total: int, device_arr=None,
                       host_buf=None) -> None:
@@ -884,7 +876,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         a layer full of holes.
 
         The byte copy runs OUTSIDE ``self._lock`` under a claim/commit
-        discipline (``_copying``): the lock is held only to claim the
+        discipline (``utils.intervals.ClaimedCoverage``): the lock is
+        held only to claim the
         fragment's uncovered ranges and, after the copy, to commit —
         concurrent senders' fragments assemble in parallel instead of
         serializing a 16 MiB memcpy each behind one lock, which matters
@@ -940,18 +933,13 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     # hundreds of ms at real layer sizes; coverage is
                     # tracked by intervals, so unwritten bytes are never
                     # exposed).
-                    entry = (alloc_recv_buffer(msg.total_size), [])
-                buf, covered = entry
-                claims = intervals.uncovered(
-                    covered, frag.offset, frag.offset + frag.data_size
-                )
-                for lo, hi in claims:
-                    covered = intervals.insert(covered, lo, hi)
-                self._partial[lid] = (buf, covered)
+                    entry = (alloc_recv_buffer(msg.total_size),
+                             intervals.ClaimedCoverage())
+                buf, cov = entry
+                tok, claims = cov.claim(
+                    frag.offset, frag.offset + frag.data_size)
+                self._partial[lid] = (buf, cov)
                 self._partial_total[lid] = msg.total_size
-                if claims:
-                    tok = next(self._copy_tok)
-                    self._copying.setdefault(lid, {})[tok] = claims
                 # Journaled OUTSIDE the lock below (two fsyncs per
                 # fragment must not serialize every other handler), and
                 # only for fragments that landed NEW bytes — a full
@@ -960,7 +948,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 journal = self.ckpt is not None and bool(claims)
                 log.info(
                     "layer fragment stored",
-                    layerID=lid, received=intervals.covered(covered),
+                    layerID=lid, received=cov.covered_bytes(),
                     total=msg.total_size,
                 )
         if dup_done:
@@ -983,17 +971,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                         buf, lo, data_mv[lo - frag.offset : hi - frag.offset])
             except Exception:
                 with self._lock:
-                    m = self._copying.get(lid)
-                    if m is not None:
-                        m.pop(tok, None)
-                        if not m:
-                            self._copying.pop(lid, None)
-                    entry = self._partial.get(lid)
-                    if entry is not None:
-                        b2, cov2 = entry
-                        for lo, hi in claims:
-                            cov2 = intervals.remove(cov2, lo, hi)
-                        self._partial[lid] = (b2, cov2)
+                    cov.abort(tok)
                 raise
         complete = self._commit_fragment(lid, tok, msg.total_size)
         if journal and not complete:
@@ -1031,20 +1009,15 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         whether THIS commit performed the promotion (exactly one does —
         the caller then stages + acks)."""
         with self._lock:
-            if tok is not None:
-                m = self._copying.get(lid)
-                if m is not None:
-                    m.pop(tok, None)
-                    if not m:
-                        self._copying.pop(lid, None)
+            entry = self._partial.get(lid)
+            if entry is not None:
+                entry[1].commit(tok)
             if lid in self.layers:
                 return False  # a sibling already promoted (and acked)
-            entry = self._partial.get(lid)
             if entry is None:
                 return False
-            buf, covered = entry
-            if (intervals.covered(covered) < total
-                    or self._copying.get(lid)):
+            buf, cov = entry
+            if not cov.complete(total):
                 return False
             self.layers[lid] = LayerSrc(
                 inmem_data=buf, data_size=total,

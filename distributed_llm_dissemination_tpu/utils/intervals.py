@@ -11,7 +11,8 @@ transfers to report precise missing ranges.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import itertools
+from typing import Dict, List, Optional, Tuple
 
 Interval = Tuple[int, int]  # [start, end)
 
@@ -90,3 +91,71 @@ def complement(intervals: List[Interval], total: int) -> List[Interval]:
     if pos < total:
         gaps.append((pos, total))
     return gaps
+
+
+class ClaimedCoverage:
+    """Claim/commit coverage accounting for out-of-lock byte movement.
+
+    THE shared discipline of the incremental device ingest
+    (``parallel/ingest.ShardedLayerIngest``) and the mode-3 receiver's
+    fragment assembly (``runtime/receiver``): a writer CLAIMS its
+    still-uncovered subranges (reserving them so concurrent duplicates
+    never copy twice), moves the bytes outside the caller's lock, then
+    COMMITS — or ABORTS, rolling the reservation back so failed copies
+    are never reported as landed bytes.  ``committed()`` is the honest
+    view (covered minus in-flight claims); ``complete()`` is the
+    promotion/finalize gate (full coverage, nothing in flight).
+
+    NOT itself thread-safe: callers mutate it under their own lock — the
+    point is precisely that the byte movement happens OUTSIDE that lock,
+    bracketed by claim/commit.
+    """
+
+    __slots__ = ("_covered", "_inflight", "_tok")
+
+    def __init__(self, covered: Optional[List[Interval]] = None):
+        self._covered: List[Interval] = list(covered or [])
+        self._inflight: Dict[int, List[Interval]] = {}
+        self._tok = itertools.count()
+
+    def claim(self, start: int, end: int):
+        """Reserve the uncovered subranges of ``[start, end)``.  Returns
+        ``(token, ranges)``; ``(None, [])`` when fully covered already (a
+        duplicate — nothing to move)."""
+        ranges = uncovered(self._covered, start, end)
+        if not ranges:
+            return None, []
+        for lo, hi in ranges:
+            self._covered = insert(self._covered, lo, hi)
+        tok = next(self._tok)
+        self._inflight[tok] = ranges
+        return tok, ranges
+
+    def commit(self, tok: Optional[int]) -> None:
+        if tok is not None:
+            self._inflight.pop(tok, None)
+
+    def abort(self, tok: Optional[int]) -> None:
+        """Roll a failed claim's reservation back out of the coverage."""
+        if tok is None:
+            return
+        for lo, hi in self._inflight.pop(tok, ()):
+            self._covered = remove(self._covered, lo, hi)
+
+    def covered_bytes(self) -> int:
+        return covered(self._covered)
+
+    def idle(self) -> bool:
+        return not self._inflight
+
+    def complete(self, total: int) -> bool:
+        return not self._inflight and covered(self._covered) >= total
+
+    def committed(self) -> List[Interval]:
+        """Covered ranges whose bytes REALLY landed (in-flight claims
+        excluded) — what salvage/announce/seed may read."""
+        out = list(self._covered)
+        for ranges in self._inflight.values():
+            for lo, hi in ranges:
+                out = remove(out, lo, hi)
+        return out

@@ -135,7 +135,7 @@ def test_resume_after_restart_sends_only_gaps(tmp_path):
     resumed = FlowRetransmitReceiverNode(Node(4, 0, ts[4]), {},
                                          checkpoint_dir=str(tmp_path))
     # The restored partial is visible before any network traffic.
-    assert intervals.covered(resumed._partial[0][1]) == 3000 + 3192
+    assert resumed._partial[0][1].covered_bytes() == 3000 + 3192
 
     try:
         seeder.announce()

@@ -342,7 +342,7 @@ def test_mode3_concurrent_fragment_assembly_byte_exact():
         assert len(acks) >= 1  # the promoting commit acked
         # ...and exactly one promotion: every ack reports the same layer.
         assert all(a.layer_id == 7 for a in acks)
-        assert not recv._partial and not recv._copying
+        assert not recv._partial  # promoted; no partial state left
     finally:
         recv.close()
         for t in ts.values():
@@ -362,7 +362,7 @@ def test_mode3_rejects_out_of_bounds_fragment():
         bad = LayerSrc(inmem_data=bytearray(b"x" * 100),
                        data_size=100, offset=950)
         recv.handle_layer(LayerMsg(0, 3, bad, 1000))  # [950, 1050) > 1000
-        assert 3 not in recv._partial and not recv._copying
+        assert 3 not in recv._partial
         # The layer still completes from well-formed fragments.
         good = LayerSrc(inmem_data=bytearray(b"y" * 1000),
                         data_size=1000, offset=0)
@@ -394,7 +394,7 @@ def test_mode3_unreadable_fragment_leaves_no_claim():
                         meta=LayerMeta(location=LayerLocation.DISK))
         with _pytest.raises(OSError):
             recv.handle_layer(LayerMsg(0, 4, dead, 500))
-        assert not recv._copying  # no leaked claim
+        assert 4 not in recv._partial  # no leaked claim/state
         ok = LayerSrc(inmem_data=bytearray(b"z" * 500), data_size=500,
                       offset=0)
         recv.handle_layer(LayerMsg(0, 4, ok, 500))
