@@ -251,8 +251,13 @@ def test_hf_checkpoint_two_stage_pod_serve(hf_dir, cpu_devices):
         results = {i: r.boot_result for i, r in receivers.items()}
         stores = {i: r.layers for i, r in receivers.items()}
         tokens = np.arange(32, dtype=np.int32).reshape(2, 16) % cfg.vocab
+        from distributed_llm_dissemination_tpu.runtime.pp_serve import (
+            assemble_pp_params,
+        )
+
+        assembled = assemble_pp_params(cfg, placement, results, stores)
         out = pod_forward(cfg, placement, results, stores,
-                          jnp.asarray(tokens))
+                          jnp.asarray(tokens), assembled=assembled)
         assert out is not None
         logits, _ = out
         theirs = _hf_logits(hf_dir, tokens)
@@ -260,6 +265,29 @@ def test_hf_checkpoint_two_stage_pod_serve(hf_dir, cpu_devices):
             np.asarray(jax.device_get(logits)), theirs,
             rtol=2e-3, atol=2e-3,
         )
+
+        # ...and the pod GENERATES from the same staged weights: the
+        # pipelined KV-cached decode must emit transformers' exact ids.
+        import torch
+        from transformers import LlamaForCausalLM
+
+        from distributed_llm_dissemination_tpu.runtime.pp_serve import (
+            pod_decode,
+        )
+
+        prompt = np.array([[11, 42, 7, 199]], np.int32)
+        dec = pod_decode(cfg, placement, results, stores, max_new=6,
+                         prompt=jnp.asarray(prompt), assembled=assembled)
+        assert dec is not None
+        toks, _ = dec
+        model = LlamaForCausalLM.from_pretrained(hf_dir).eval()
+        with torch.no_grad():
+            want = model.generate(
+                torch.tensor(prompt, dtype=torch.long),
+                max_new_tokens=6, do_sample=False, pad_token_id=0,
+            )
+        np.testing.assert_array_equal(
+            np.asarray(toks), want[:, prompt.shape[1]:].numpy())
     finally:
         leader.close()
         for r in receivers.values():
