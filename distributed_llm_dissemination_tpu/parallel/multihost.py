@@ -19,7 +19,7 @@ code path.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
 from ..core.config import Config
 from ..core.types import NodeID

@@ -55,7 +55,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import log
 

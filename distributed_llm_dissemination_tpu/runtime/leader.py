@@ -49,7 +49,6 @@ from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
     BootReadyMsg,
-    ClientReqMsg,
     DevicePlanMsg,
     FlowRetransmitMsg,
     HeartbeatMsg,

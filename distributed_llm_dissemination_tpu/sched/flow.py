@@ -55,7 +55,7 @@ import dataclasses
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from ..core.types import Assignment, LayerID, NodeID, SourceType, Status
+from ..core.types import Assignment, LayerID, NodeID, Status
 from ..utils.logging import log
 
 _INF = 1 << 62

@@ -28,7 +28,6 @@ Re-design of the reference's ``TcpTransport``
 from __future__ import annotations
 
 import json
-import os
 import queue
 import socket
 import struct
