@@ -563,8 +563,12 @@ def test_three_process_spmd_uneven_pod_decode():
                   + [head_id]},
         },
         "LayerSize": 1,
+        # Slices + DcnBW compose with the SPMD fabric: the leader plans
+        # cross-slice transfers through the topology LP while the bytes
+        # ride the lockstep collectives.
         "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [3],
-                 "PipelineAxis": "nodes", "Fabric": True},
+                 "PipelineAxis": "nodes", "Fabric": True,
+                 "Slices": {"0": 0, "1": 0, "2": 1}, "DcnBW": 10**9},
         "Distributed": {"Coordinator": f"127.0.0.1:{_free_port()}",
                         "CpuCollectives": "gloo"},
     }
@@ -599,6 +603,14 @@ def test_three_process_spmd_uneven_pod_decode():
             assert p.returncode == 0, (
                 f"node {i} failed:\n{outs[i][1][-3000:]}"
             )
+        # The leader planned through the topology solver (Slices + DcnBW
+        # in the Mesh section) — composition with the SPMD fabric.  The
+        # "(topology LP)" tag needs scipy; without it the relaxed
+        # fallback plans (same schedule here) with the plain log line.
+        from distributed_llm_dissemination_tpu.sched.flow import _have_lp
+
+        if _have_lp():
+            assert "topology LP" in outs[0][1], outs[0][1][-2000:]
         want = generate(init_params(mcfg, jax.random.key(0)),
                         jnp.zeros((1, 16), jnp.int32), mcfg, max_new=5)
         want_ids = [int(t) for t in np.asarray(want)[0]]
