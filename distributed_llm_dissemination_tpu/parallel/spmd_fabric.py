@@ -60,7 +60,15 @@ from typing import Dict, List, Optional, Tuple
 from ..utils.logging import log
 
 PLAN_WAIT_S = 120.0  # dest-side wait for its plan's collective
-MAX_INFLIGHT = 2  # dispatched-but-unretired plans (bounds device memory)
+# Dispatched-but-unretired plans (bounds device memory).  Big layers get
+# a shallow window — two multi-GiB gathers in flight is already the
+# memory ceiling — while small plans (where per-collective latency, not
+# bytes, dominates) pipeline deeper.  Window depth is a LOCAL pacing
+# choice: it never changes the per-process enqueue order, so processes
+# with different depths still interoperate.
+MAX_INFLIGHT = 2
+MAX_INFLIGHT_SMALL = 8
+SMALL_PLAN_BYTES = 4 << 20
 
 
 class PlanFailed(RuntimeError):
@@ -202,7 +210,7 @@ class SpmdFabric:
         so a dest only ever acks bytes that really landed."""
         import jax
 
-        plan_id, res, value, out = inflight.popleft()
+        plan_id, res, value, out, _sz = inflight.popleft()
         try:
             jax.block_until_ready(out)
         except Exception as e:  # noqa: BLE001 — resolve, don't die
@@ -212,9 +220,11 @@ class SpmdFabric:
         res.resolve(value=value)
 
     def _run(self) -> None:
-        # (plan_id, result, dest value, gathered array) dispatched but not
-        # yet known-finished.  The deque IS the pipeline: dispatch runs
-        # ahead of completion by up to MAX_INFLIGHT collectives.
+        # (plan_id, result, dest value, gathered array, bytes)
+        # dispatched but not yet known-finished.  The deque IS the
+        # pipeline: dispatch runs ahead of completion by up to the
+        # size-aware window (MAX_INFLIGHT, or MAX_INFLIGHT_SMALL when
+        # everything in flight is small).
         inflight = collections.deque()
         while True:
             with self._cond:
@@ -262,8 +272,12 @@ class SpmdFabric:
             if out is None:  # cancelled / not a participant: no device work
                 res.resolve(value=value)
                 continue
-            inflight.append((msg.plan_id, res, value, out))
-            while len(inflight) > MAX_INFLIGHT:
+            inflight.append((msg.plan_id, res, value, out, msg.total_size))
+            window = (MAX_INFLIGHT_SMALL
+                      if all(sz < SMALL_PLAN_BYTES
+                             for *_, sz in inflight)
+                      else MAX_INFLIGHT)
+            while len(inflight) > window:
                 self._retire_oldest(inflight)
 
     # ----------------------------------------------------------- collective

@@ -2,6 +2,8 @@
 across two pipeline stages, then run ONE forward across the pod from the
 landed stage weights and compare with the unsharded reference."""
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -52,9 +54,6 @@ def blob_layer(data: bytes) -> LayerSrc:
         meta=LayerMeta(location=LayerLocation.INMEM,
                        source_type=SourceType.MEM),
     )
-
-
-import contextlib
 
 
 @contextlib.contextmanager

@@ -208,9 +208,10 @@ def test_executor_pipelines_dispatch_ahead_of_completion(
     try:
         rs = [fab.submit(_plan(k, [(0, 0, 4)], layer=k)) for k in range(4)]
         deadline = time.monotonic() + 10
-        # MAX_INFLIGHT=2: plans 0,1,2 all dispatch while 0 is still
-        # unfinished (the 3rd dispatch forces the first retire, which
-        # blocks on the unreleased FakeOut).
+        # The in-flight window (small plans pipeline up to
+        # MAX_INFLIGHT_SMALL deep): plans 0,1,2 all dispatch while 0 is
+        # still unfinished; retires happen when the window fills or the
+        # queue idles — never before a later plan's dispatch here.
         while (events.count(("dispatched", 2)) == 0
                and time.monotonic() < deadline):
             time.sleep(0.01)
