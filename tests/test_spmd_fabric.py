@@ -630,3 +630,69 @@ def test_three_process_spmd_uneven_pod_decode():
                 p.kill()
         if os.path.exists(conf_path):
             os.remove(conf_path)
+
+
+def _spy_serves(t):
+    """Capture ServeMsgs the transport would deliver."""
+    from distributed_llm_dissemination_tpu.transport.messages import ServeMsg
+
+    sent = []
+    orig = t.send
+
+    def spy(dest, msg):
+        if isinstance(msg, ServeMsg):
+            sent.append((dest, msg))
+        else:
+            orig(dest, msg)
+
+    t.send = spy
+    return sent
+
+
+def test_dispatch_serve_carries_snapshot_counts_and_gen():
+    """The ServeMsg's member depths come from the SAME assignment
+    snapshot the membership was validated on, plus the leader's -gen."""
+    leader, t = _leader_with_spmd()
+    sent = _spy_serves(t)
+    try:
+        head = 4
+        leader.boot_enabled = True
+        leader.serve_generate = 7
+        leader.assignment = {
+            1: {b: None for b in [0, 1, 2, head]},
+            2: {b: None for b in [3, head]},
+        }
+        leader._boot_kinds = {1: "stage", 2: "stage"}
+        leader._dispatch_serve()
+        members_msgs = [m for _, m in sent if m.members]
+        assert members_msgs, "no ServeMsg with members broadcast"
+        m = members_msgs[0]
+        assert m.members == [1, 2]
+        assert m.counts == [3, 1]
+        assert m.gen == 7
+    finally:
+        leader.close()
+        t.close()
+
+
+def test_dispatch_serve_cancels_when_a_member_boot_is_not_stage():
+    """A member that reported a non-stage boot can't enter the serving
+    collective: promised receivers get the CANCELLATION (empty members)
+    instead of hanging in a collective the member never joins."""
+    leader, t = _leader_with_spmd()
+    sent = _spy_serves(t)
+    try:
+        head = 4
+        leader.boot_enabled = True
+        leader.assignment = {
+            1: {b: None for b in [0, 1, head]},
+            2: {b: None for b in [2, 3, head]},
+        }
+        leader._boot_kinds = {1: "stage", 2: "full"}  # 2 booted FULL
+        leader._serve_promised = True
+        leader._dispatch_serve()
+        assert sent, "promised receivers must be released"
+        assert all(m.members == [] for _, m in sent)
+    finally:
+        leader.close()
+        t.close()
