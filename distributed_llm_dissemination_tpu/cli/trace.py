@@ -69,6 +69,16 @@ _INSTANT_MESSAGES = {
     "pod serve dispatched",
     "pod serve cancelled: pod no longer servable",
     "pod pipelined forward from staged weights",
+    # Round 4: pod generation + topology planning markers.  (All three
+    # solver variants are marked so comparing solver modes in a trace
+    # never loses the event; the leader-level "Job assignment completed"
+    # duration slice still carries the timing for every mode.)
+    "pod decoded tokens from staged weights",
+    "pod generated token ids",
+    "job assignment calculated",
+    "job assignment calculated (native)",
+    "job assignment calculated (topology LP)",
+    "topology solve degraded to flat replan",
 }
 
 
