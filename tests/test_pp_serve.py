@@ -36,7 +36,7 @@ from distributed_llm_dissemination_tpu.transport import (
     reset_registry,
 )
 
-TIMEOUT = 30.0
+TIMEOUT = 60.0  # generous: suites run 3-wide on loaded CI hosts
 CFG = CONFIGS["tiny"]
 SEED = 0
 

@@ -476,7 +476,7 @@ def test_three_process_spmd_pipeline_serves():
         outs = {}
         for i, p in procs.items():
             try:
-                outs[i] = p.communicate(timeout=300)
+                outs[i] = p.communicate(timeout=420)
             except subprocess.TimeoutExpired:
                 for q in procs.values():
                     q.kill()
@@ -595,7 +595,7 @@ def test_three_process_spmd_uneven_pod_decode():
         outs = {}
         for i, p in procs.items():
             try:
-                outs[i] = p.communicate(timeout=300)
+                outs[i] = p.communicate(timeout=420)
             except subprocess.TimeoutExpired:
                 for q in procs.values():
                     q.kill()
