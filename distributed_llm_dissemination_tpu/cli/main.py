@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "many tokens with the KV-cached serving loop "
                         "(models/generate.py) and log them — dissemination "
                         "ends at emitted tokens")
+    p.add_argument("-bw", type=float, default=3600.0,
+                   help="boot-wait bound in seconds: how long the leader "
+                        "waits for missing boot reports (then exits 1) and "
+                        "a receiver drains its own in-flight boot before "
+                        "exiting; size to the slowest expected boot")
     return p
 
 
@@ -192,11 +197,30 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     if leader.boot_enabled:
         # Receivers boot their model from the delivered blobs and report
         # back; TTFT = timer start → last boot report (includes TTD).
-        booted = leader.boot_ready().get()
+        # Bounded: failed boots now report (kind "failed") and crashes
+        # shrink the wait, but a hard-killed dest with failure detection
+        # off (-ft 0) still can't unblock it — exit loudly instead of
+        # hanging the whole deployment.
+        import queue as _queue
+
+        try:
+            booted = leader.boot_ready().get(timeout=args.bw)
+        except _queue.Empty:
+            ulog.log.error("boot wait timed out; missing reports",
+                           booted=sorted(leader.boots_seen()))
+            print(f"Boot wait timed out after {args.bw:g}s", flush=True)
+            return 1
         ttft = time.monotonic() - t0
+        kinds = leader.boot_kinds()
         ulog.log.info("Time to first token", seconds=round(ttft, 6),
-                      nodes={str(n): round(s, 3) for n, s in booted.items()})
+                      nodes={str(n): round(s, 3) for n, s in booted.items()},
+                      kinds={str(n): k for n, k in kinds.items()})
         print(f"Time to first token: {ttft:.6f}s", flush=True)
+        failed = sorted(n for n, k in kinds.items()
+                        if k in ("failed", "crashed"))
+        if failed:
+            print(f"Boot FAILED on nodes {failed}", flush=True)
+            return 1
     return 0
 
 
@@ -336,6 +360,10 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
                 receiver.serve_done().get(timeout=3600.0)
             except _queue.Empty:
                 ulog.log.error("pod serve never completed")
+    # A started boot runs on daemon threads: exiting now would kill it
+    # silently and strand the leader's TTFT wait on the missing report.
+    if not receiver.wait_boot_drain(timeout=args.bw):
+        ulog.log.error("boot still running at exit timeout; leaving")
     return 0
 
 

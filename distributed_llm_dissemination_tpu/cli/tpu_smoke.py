@@ -1,0 +1,302 @@
+"""Live-hardware validation: prove the compute path on the real chip.
+
+The test suite deliberately pins the CPU backend (tests/conftest.py) so it
+is deterministic and runs anywhere; ``bench.py`` measures exactly one
+thing (the terminal ingest hop).  What neither covers is evidence that
+the FRAMEWORK'S KERNELS are correct and fast on physical TPU silicon —
+the Mosaic-compiled pallas attention kernel, the flagship model forward,
+and the device ingest path all behave subtly differently on a real MXU
+(bf16 truncation inside f32 matmuls, VMEM tiling, async DMA) than on the
+virtual CPU mesh.
+
+This harness runs on whatever backend is live (recorded in the report —
+a CPU run is a dry pass, not evidence) and emits ONE JSON report:
+
+- ``pallas_block_attention``: the ring-attention hot op
+  (``ops/flash_attention.py``) against the pure-lax oracle on the same
+  device AND a float64 host oracle.  On TPU both device paths truncate
+  matmul inputs to bf16 in the MXU (expected, models run bf16), so the
+  bar is relative error vs the f64 oracle — and the pallas and lax
+  errors should be the SAME ORDER (a kernel bug shows up as pallas
+  diverging from lax, not as shared truncation noise).
+- ``flagship_forward``: ``__graft_entry__.entry()`` — compile + execute
+  the reduced-depth Llama-3-8B forward, finite-logits check, steady-state
+  step time.
+- ``decode``: the KV-cached greedy serving loop
+  (``models/generate.py``) on the flagship config — steady-state
+  tokens/s, in-vocab ids, bit-identical on re-run.
+- ``ingest_link``: a scaled-down ``ShardedLayerIngest`` vs one bulk
+  ``device_put`` of the same bytes, paired (the full-size honest number
+  is ``bench.py``'s; this is the quick in-harness cross-check).
+
+Usage: ``python -m distributed_llm_dissemination_tpu.cli.tpu_smoke
+[-o report.json] [--size-mib 64]``.  Exit 0 iff every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Callable, Dict, List
+
+
+def _median_time(fn: Callable[[], object], trials: int = 5) -> float:
+    import jax
+
+    times: List[float] = []
+    for _ in range(trials):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn())
+        times.append(time.monotonic() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def check_pallas_block_attention() -> Dict:
+    import jax
+    import numpy as np
+
+    from ..ops import flash_attention as fa
+
+    b, kvh, g, sq, t, hd = 1, 2, 4, 512, 512, 128
+    rng = np.random.default_rng(0)
+    qg_n = rng.standard_normal((b, kvh, g, sq, hd))
+    k_n = rng.standard_normal((b, kvh, t, hd))
+    v_n = rng.standard_normal((b, kvh, t, hd))
+    import jax.numpy as jnp
+
+    qg, k, v = (jnp.asarray(x, jnp.float32) for x in (qg_n, k_n, v_n))
+    zero = jnp.float32(0.0)
+
+    on_tpu = jax.default_backend() == "tpu"
+    t0 = time.monotonic()
+    pv_p, m_p, l_p = jax.block_until_ready(
+        fa._block_attention_pallas(qg, k, v, zero, zero,
+                                   interpret=not on_tpu))
+    compile_s = time.monotonic() - t0
+    pv_r, m_r, l_r = jax.block_until_ready(
+        fa._block_attention_ref(qg, k, v, zero, zero))
+
+    # Float64 host oracle (the causal square block at offset 0).
+    s = np.einsum("bhgqd,bhtd->bhgqt", qg_n, k_n) / np.sqrt(hd)
+    mask = np.tril(np.ones((sq, t), bool))
+    s = np.where(mask[None, None, None], s, -1e30)
+    m64 = s.max(-1)
+    p = np.exp(s - m64[..., None])
+    pv64 = np.einsum("bhgqt,bhtd->bhgqd", p, v_n)
+    scale = float(np.abs(pv64).max())
+
+    rel_pallas = float(np.abs(np.asarray(pv_p) - pv64).max() / scale)
+    rel_lax = float(np.abs(np.asarray(pv_r) - pv64).max() / scale)
+    rel_cross = float(
+        np.abs(np.asarray(pv_p) - np.asarray(pv_r)).max() / scale)
+
+    rec = {
+        "selected_pallas": bool(fa._use_pallas(sq, t, hd)),
+        "interpret_mode": not on_tpu,
+        "compile_s": round(compile_s, 2),
+        "rel_err_pallas_vs_f64": rel_pallas,
+        "rel_err_lax_vs_f64": rel_lax,
+        "rel_err_pallas_vs_lax": rel_cross,
+    }
+    if on_tpu:
+        # Per-call dispatch through the device relay is ~50 ms — far more
+        # than the kernel itself — so time STEPS INSIDE ONE JIT: a scan
+        # whose carry feeds each step's pv back into the next step's
+        # query (a real data dependency, so XLA can't fold the loop).
+        steps = 16
+
+        def _loop(impl):
+            def body(c, _):
+                pv, m, l = impl(c, k, v, zero, zero)
+                return c + 1e-3 * pv, m[..., 0].sum() + l[..., 0].sum()
+            @jax.jit
+            def run(q0):
+                out, aux = jax.lax.scan(body, q0, None, length=steps)
+                return out, aux
+            return run
+
+        for label, impl in (
+            ("pallas", lambda a, b_, c, d, e:
+                fa._block_attention_pallas(a, b_, c, d, e, False)),
+            ("lax", fa._block_attention_ref),
+        ):
+            run = _loop(impl)
+            jax.block_until_ready(run(qg))  # compile
+            per_call = _median_time(lambda: run(qg), trials=5) / steps
+            rec[f"{label}_median_ms"] = round(1e3 * per_call, 3)
+    # bf16 MXU truncation is ~6e-3 relative at these shapes; 2e-2 flags a
+    # real kernel defect while tolerating precision-mode drift.  The
+    # cross-check is tighter: pallas and lax share the truncation, so
+    # they must agree with each other well below the f64 gap.
+    rec["ok"] = (rel_pallas < 2e-2 and rel_cross <= max(rel_lax, 5e-3)
+                 and (rec["selected_pallas"] or not on_tpu))
+    if on_tpu:
+        # Perf bar: production routes attention through pallas at these
+        # shapes (_use_pallas), so the kernel being SLOWER than its own
+        # lax fallback is a regression this harness must fail, not
+        # green-light.  20% headroom for measurement noise.
+        rec["ok"] = rec["ok"] and (
+            rec["pallas_median_ms"] <= 1.2 * rec["lax_median_ms"])
+    return rec
+
+
+def check_flagship_forward() -> Dict:
+    import importlib.util
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__",
+        os.path.join(os.path.dirname(__file__), "..", "..",
+                     "__graft_entry__.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    jitted = jax.jit(fn)
+    t0 = time.monotonic()
+    out = jax.block_until_ready(jitted(*args))
+    compile_s = time.monotonic() - t0
+    finite = bool(jnp.isfinite(out).all())
+    step_s = _median_time(lambda: jitted(*args), trials=3)
+    return {
+        "logits_shape": list(out.shape),
+        "dtype": str(out.dtype),
+        "compile_s": round(compile_s, 1),
+        "step_median_s": round(step_s, 4),
+        "finite": finite,
+        "ok": finite,
+    }
+
+
+def check_decode() -> Dict:
+    """KV-cached greedy decode on the flagship config: the serving loop
+    (``models/generate.py``) compiled and timed on the live backend.
+    Correctness bars that need no oracle: token ids in-vocab, and the
+    whole decode bit-identical when re-run (greedy is deterministic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.generate import generate
+    from ..models.llama import CONFIGS, init_params
+
+    cfg = CONFIGS["llama3-8b-d4"]
+    params = init_params(cfg, jax.random.key(0))
+    prompt = jnp.ones((1, 16), jnp.int32)
+    max_new = 32
+    t0 = time.monotonic()
+    toks = jax.block_until_ready(generate(params, prompt, cfg, max_new))
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    again = jax.block_until_ready(generate(params, prompt, cfg, max_new))
+    steady_s = time.monotonic() - t0
+    in_vocab = bool(((toks >= 0) & (toks < cfg.vocab)).all())
+    deterministic = bool((toks == again).all())
+    return {
+        "config": cfg.name,
+        "tokens": max_new,
+        "compile_s": round(compile_s, 1),
+        "steady_tokens_per_s": round(max_new / steady_s, 1),
+        "in_vocab": in_vocab,
+        "deterministic": deterministic,
+        "ok": in_vocab and deterministic,
+    }
+
+
+def check_ingest_link(size_mib: int) -> Dict:
+    import jax
+    import numpy as np
+
+    from ..parallel.ingest import ShardedLayerIngest
+
+    total = size_mib << 20
+    parts = 8
+    devices = jax.devices()[:1]
+    rng = np.random.default_rng(0)
+    blob = rng.integers(0, 256, total, dtype=np.uint8)
+    bounds = [i * total // parts for i in range(parts)] + [total]
+    frags = [(bounds[i], blob[bounds[i]:bounds[i + 1]].tobytes())
+             for i in range(parts)]
+
+    def ingest_once():
+        ing = ShardedLayerIngest(total, devices)
+        for off, data in frags:
+            ing.write(off, data)
+        return ing.finalize()
+
+    def raw_once():
+        return jax.device_put(blob, devices[0])
+
+    # Warm both (compiles the splice), then pair raw/ingest so link drift
+    # cancels in the ratio (same discipline as bench.py).
+    jax.block_until_ready(raw_once())
+    jax.block_until_ready(ingest_once())
+    ratios = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        jax.block_until_ready(raw_once())
+        raw_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        jax.block_until_ready(ingest_once())
+        ing_s = time.monotonic() - t0
+        ratios.append(raw_s / ing_s)
+    link_fraction = sorted(ratios)[len(ratios) // 2]
+    return {
+        "size_mib": size_mib,
+        "fragments": parts,
+        "link_fraction": round(link_fraction, 3),
+        "link_fraction_spread": [round(min(ratios), 3),
+                                 round(max(ratios), 3)],
+        # In-harness cross-check at reduced size: the bar is "same order
+        # as bulk DMA" (>=0.7); the full-size >=0.95 claim is bench.py's.
+        "ok": link_fraction >= 0.7,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu_smoke")
+    p.add_argument("-o", type=str, default="",
+                   help="also write the JSON report to this path")
+    p.add_argument("--size-mib", type=int, default=64,
+                   help="ingest cross-check size")
+    p.add_argument("--skip-forward", action="store_true",
+                   help="skip the flagship forward (the slow compile)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    report = {
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "checks": {},
+    }
+    checks = [("pallas_block_attention", check_pallas_block_attention),
+              ("ingest_link", lambda: check_ingest_link(args.size_mib))]
+    if not args.skip_forward:
+        checks.append(("flagship_forward", check_flagship_forward))
+        checks.append(("decode", check_decode))
+    for name, fn in checks:
+        t0 = time.monotonic()
+        try:
+            rec = fn()
+        except Exception as e:  # a crashed check fails the report
+            rec = {"ok": False, "error": repr(e)}
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        report["checks"][name] = rec
+        print(f"{name}: {'ok' if rec.get('ok') else 'FAIL'} "
+              f"({rec['wall_s']}s)", file=sys.stderr, flush=True)
+    report["ok"] = all(c.get("ok") for c in report["checks"].values())
+    out = json.dumps(report)
+    print(out)
+    if args.o:
+        with open(args.o, "w") as f:
+            f.write(out + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

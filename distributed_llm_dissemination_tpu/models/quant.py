@@ -131,23 +131,32 @@ def decode_blob_host(
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
-def _decode_stacked_q(blobs_u8, specs: Tuple[Spec, ...], dtype_name: str):
-    """(n, qblob_len) uint8 → {name: (n, *shape) dtype}, all on device."""
+def _decode_qblobs(blobs_u8, specs: Tuple[Spec, ...], dtype_name: str):
+    """n separate 1-D uint8 qblobs → {name: (n, *shape) dtype} on device.
+
+    Per-blob 1-D slices, leaf-shaped bitcasts, dequant multiply, then a
+    per-leaf stack — same layout discipline as ``serde._decode_blobs``
+    (a stacked (n, blob_len) intermediate provoked a dim0-minor tiled
+    layout on TPU that padded n to the 128 tile: the physical-size boot
+    OOM)."""
     dt = jnp.dtype(dtype_name)
-    n_blobs = blobs_u8.shape[0]
+    sdt = jnp.dtype(_SCALE_DT)
     out = {}
     off = 0
     for name, shape in specs:
         rows, cols = _rows_cols(shape)
         sb = rows * _SCALE_DT().itemsize  # one wire format: host's widths
-        sraw = jax.lax.slice_in_dim(blobs_u8, off, off + sb, axis=1)
-        scale = serde._bitcast_leaf(sraw, jnp.dtype(_SCALE_DT))
-        off += sb
-        qraw = jax.lax.slice_in_dim(blobs_u8, off, off + rows * cols, axis=1)
-        q = serde._bitcast_leaf(qraw, jnp.int8).reshape(n_blobs, rows, cols)
-        off += rows * cols
-        x = (q.astype(jnp.float32) * scale.reshape(n_blobs, rows, 1)).astype(dt)
-        out[name] = x.reshape((n_blobs,) + shape)
+        leaves = []
+        for blob in blobs_u8:
+            sraw = jax.lax.slice(blob, (off,), (off + sb,))
+            scale = serde._bytes_to_wide(sraw, sdt)  # (rows,)
+            qraw = jax.lax.slice(blob, (off + sb,),
+                                 (off + sb + rows * cols,))
+            q = serde._bytes_to_wide(qraw, jnp.int8).reshape(rows, cols)
+            x = (q.astype(jnp.float32) * scale[:, None]).astype(dt)
+            leaves.append(x.reshape(shape))
+        out[name] = jnp.stack(leaves)
+        off += sb + rows * cols
     return out
 
 
@@ -157,16 +166,16 @@ def stacked_from_device_qblobs(
     """Device path: stacked layer params from HBM-resident int8-codec
     blobs — slices, bitcasts and the dequant multiply fused in one jit;
     the disseminated bytes never leave the accelerator."""
-    stacked = jnp.stack(list(blob_arrays))
-    return _decode_stacked_q(
-        stacked, tuple(layer_param_specs(cfg)), np.dtype(cfg.dtype).name
+    return _decode_qblobs(
+        tuple(blob_arrays), tuple(layer_param_specs(cfg)),
+        np.dtype(cfg.dtype).name,
     )
 
 
 def head_from_device_qblob(cfg: ModelConfig, blob_u8) -> Dict[str, Any]:
     """Device path: embed/ln_f/lm_head from the HBM-resident head blob."""
-    decoded = _decode_stacked_q(
-        blob_u8[None, :], tuple(head_param_specs(cfg)),
+    decoded = _decode_qblobs(
+        (blob_u8,), tuple(head_param_specs(cfg)),
         np.dtype(cfg.dtype).name,
     )
     return {name: arr[0] for name, arr in decoded.items()}

@@ -186,28 +186,56 @@ def seeded_blob(cfg: ModelConfig, blob_id: int, seed: int = 0) -> bytes:
 
 # ------------------------------------------------------------- device path
 
-def _bitcast_leaf(flat_u8: jax.Array, dtype) -> jax.Array:
-    """uint8[..., n*k] → dtype[..., n] on device (k = itemsize)."""
-    itemsize = np.dtype(dtype).itemsize
-    if itemsize == 1:
+def _bytes_to_wide(flat_u8: jax.Array, dtype) -> jax.Array:
+    """1-D uint8[n*k] → 1-D dtype[n] on device (k = itemsize).
+
+    Widening via k strided byte slices + integer shifts, then a
+    SAME-WIDTH bitcast.  The direct route — reshape to (..., k) and a
+    widening ``bitcast_convert_type`` — materializes the k-minor
+    intermediate in a tiled TPU layout that pads k to the 128 lane tile
+    (64x the logical bytes for bf16: a 27.9 GiB allocation per physical
+    416 MiB blob — the boot OOM).  Strided 1-D slices and the same-width
+    bitcast never change rank or minor-dim size, so no such layout
+    exists to choose."""
+    dt = np.dtype(dtype)
+    k = dt.itemsize
+    if k == 1:
         return jax.lax.bitcast_convert_type(flat_u8, dtype)
-    grouped = flat_u8.reshape(*flat_u8.shape[:-1], -1, itemsize)
-    return jax.lax.bitcast_convert_type(grouped, dtype)
+    wide = {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[k]
+    n = flat_u8.shape[0] // k
+    word = None
+    for i in range(k):
+        b = jax.lax.slice(flat_u8, (i,), (i + (n - 1) * k + 1,), (k,))
+        piece = b.astype(wide) << (8 * i)  # little-endian byte order
+        word = piece if word is None else word | piece
+    return jax.lax.bitcast_convert_type(word, dtype)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
-def _decode_stacked(blobs_u8: jax.Array, specs: Tuple[Spec, ...], dtype_name: str):
-    """(n, blob_len) uint8 → {name: (n, *shape) dtype} without leaving the
-    device: static slices + bitcasts, fused by XLA."""
+def _decode_blobs(blobs_u8: Tuple[jax.Array, ...], specs: Tuple[Spec, ...],
+                  dtype_name: str):
+    """n separate 1-D uint8 blobs → {name: (n, *shape) dtype} on device.
+
+    Each blob's leaves are sliced 1-D, widened 1-D
+    (``_bytes_to_wide``), reshaped to the leaf's shape, and only then
+    stacked per leaf.  An earlier form stacked the blobs into one
+    (n, blob_len) array and sliced along axis 1; at physical layer
+    sizes the TPU compiler laid the widening bitcast's intermediate out
+    with a tiny minor dim padded to the 128 tile — 32-64x the logical
+    bytes, a ~30 GiB allocation for four 416 MiB layers (the
+    physical-size boot OOM).  With every intermediate strictly 1-D or
+    leaf-shaped (minor dims the leaf's own, large ones), no degenerate
+    layout choice exists."""
     dt = jnp.dtype(dtype_name)
     out = {}
     off = 0
     for name, shape in specs:
         n = int(np.prod(shape)) * dt.itemsize
-        leaf = jax.lax.slice_in_dim(blobs_u8, off, off + n, axis=1)
-        out[name] = _bitcast_leaf(leaf, dt).reshape(
-            (blobs_u8.shape[0],) + shape
-        )
+        leaves = []
+        for blob in blobs_u8:
+            leaf = jax.lax.slice(blob, (off,), (off + n,))
+            leaves.append(_bytes_to_wide(leaf, dt).reshape(shape))
+        out[name] = jnp.stack(leaves)
         off += n
     return out
 
@@ -220,9 +248,8 @@ def stacked_from_device_blobs(
     Each input is one delivered layer blob already on device (the ingest
     path's terminal artifact); the reinterpret runs entirely on the
     accelerator."""
-    stacked_u8 = jnp.stack([a for a in blob_arrays])
-    return _decode_stacked(
-        stacked_u8,
+    return _decode_blobs(
+        tuple(blob_arrays),
         tuple(layer_param_specs(cfg)),
         np.dtype(cfg.dtype).name,
     )
@@ -232,8 +259,8 @@ def head_from_device_blob(
     cfg: ModelConfig, blob_u8: jax.Array
 ) -> Dict[str, jax.Array]:
     """Device path: embed/ln_f/lm_head from the HBM-resident head blob."""
-    decoded = _decode_stacked(
-        blob_u8[None, :],
+    decoded = _decode_blobs(
+        (blob_u8,),
         tuple(head_param_specs(cfg)),
         np.dtype(cfg.dtype).name,
     )

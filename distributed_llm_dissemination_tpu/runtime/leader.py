@@ -211,10 +211,22 @@ class LeaderNode:
         as "timer stop: first token"."""
         return self._boot_q
 
+    def boots_seen(self):
+        """Node ids that reported a boot outcome so far (diagnostics)."""
+        with self._lock:
+            return list(self._booted)
+
+    def boot_kinds(self):
+        """Reported boot kinds per node ("full"/"stage"/"skipped"/
+        "failed") — the CLI surfaces failures in its exit status."""
+        with self._lock:
+            return dict(self._boot_kinds)
+
     def handle_boot_ready(self, msg: BootReadyMsg) -> None:
         self.detector.touch(msg.src_id)
-        log.info("node booted its model", node=msg.src_id, kind=msg.kind,
-                 boot_seconds=round(msg.seconds, 6))
+        logger = log.error if msg.kind == "failed" else log.info
+        logger("node booted its model", node=msg.src_id, kind=msg.kind,
+               boot_seconds=round(msg.seconds, 6))
         with self._lock:
             if msg.src_id not in self.assignment:
                 # Only assignees gate the boot wait; a seeder's "skipped"
@@ -222,7 +234,20 @@ class LeaderNode:
                 return
             self._booted[msg.src_id] = msg.seconds
             self._boot_kinds[msg.src_id] = msg.kind
-            if self._boot_reported or set(self.assignment) - set(self._booted):
+        self._maybe_complete_boot_wait()
+
+    def _maybe_complete_boot_wait(self) -> None:
+        """Fire the boot/TTFT wait exactly once, when every REMAINING
+        assignee has reported a boot outcome (incl. "failed"/"skipped").
+        Called from ``handle_boot_ready`` and from ``crash`` — a dead
+        assignee shrinks the assignment, which can be what completes the
+        wait (found live: a dest whose boot died silently left the
+        leader blocked in ``boot_ready().get()`` forever)."""
+        with self._lock:
+            if (self._boot_reported or not self._startup_sent
+                    or not self.boot_enabled):
+                return
+            if set(self.assignment) - set(self._booted):
                 return
             self._boot_reported = True
             ttft = (time.monotonic() - self._t_start
@@ -747,6 +772,12 @@ class LeaderNode:
         log.info("timer stop: startup")
         self.send_startup()
         self._ready_q.put(self.assignment)
+        # Startup may have been unblocked by crashes that already emptied
+        # the boot wait's remaining set (every assignee dead before
+        # announcing): nothing will ever report, so complete it here —
+        # with the crashes visible in boot_kinds — instead of holding the
+        # CLI for its whole -bw timeout.
+        self._maybe_complete_boot_wait()
 
     # ------------------------------------------------------------- failures
 
@@ -784,10 +815,23 @@ class LeaderNode:
                 # gets its layers back (resume after declared death).
                 self._dropped_assignment[node_id] = dropped
             self.expected_nodes.discard(node_id)
+            # A dead assignee that never reported must stay VISIBLE as
+            # "crashed" — erasing it would let the CLI report a
+            # successful TTFT (exit 0) for a run where the model never
+            # booted anywhere.  One that DID report keeps its record: a
+            # receiver that booted fine, exited, and then had its lease
+            # expire is a completed deployment, not a failure.
+            if node_id not in self._boot_kinds:
+                self._booted.pop(node_id, None)
+                if dropped:
+                    self._boot_kinds[node_id] = "crashed"
         if dropped:
             log.error("crashed node was an assignee; dropping its layers",
                       node=node_id, layers=sorted(dropped))
         self._drive(self._recover)
+        # The crash may have removed the last assignee the boot/TTFT wait
+        # was blocked on.
+        self._maybe_complete_boot_wait()
 
     def send_startup(self) -> None:
         with self._lock:

@@ -124,6 +124,14 @@ class ReceiverNode:
         self.boot_result = None  # BootResult after a successful boot
         self._boot_started = False
         self._boot_finished = threading.Event()  # set after _boot (any outcome)
+        # Set when the _boot TASK has fully drained (report sent, any
+        # -gen decode done) — the CLI must not exit the process while a
+        # boot runs on this daemon pool (it would die silently and the
+        # leader's boot wait would hang on the missing report).
+        self._boot_drained = threading.Event()
+        # (seconds, kind) of the boot outcome, for re-answering a
+        # re-sent startup when the first BootReadyMsg was lost.
+        self._boot_report = None
         # Multi-controller serving (runtime/pp_serve.py): startup said a
         # ServeMsg will follow; the CLI keeps the process alive until
         # serve_done() fires (or times out).
@@ -555,6 +563,27 @@ class ReceiverNode:
         silence — the leader's boot wait can never deadlock on a flag
         mismatch."""
         self.expect_serve = msg.serve  # before ready(): the CLI reads it
+        # Latch the boot decision BEFORE ready() fires: the CLI's
+        # exit-time wait_boot_drain reads _boot_started the moment
+        # ready() returns, and a latch set after the put would race it —
+        # the process could exit before the boot task was ever submitted
+        # (killing it silently: the hang class this latch exists for).
+        boot_pending = False
+        prior_report = None
+        if msg.boot and self.boot_cfg is not None:
+            with self._lock:
+                if self._boot_started:
+                    # A re-sent startup must not re-boot — but it MUST
+                    # re-answer (below, outside the lock): the leader
+                    # re-sends startup precisely when it suspects the
+                    # first exchange was lost, and a completed boot whose
+                    # BootReadyMsg send failed would otherwise be
+                    # unrecoverable.  A boot still in flight (report
+                    # None) reports when it finishes.
+                    prior_report = self._boot_report
+                else:
+                    self._boot_started = True
+                    boot_pending = True
         self._ready_q.put(object())
         if self.fabric is not None:
             # Dissemination is over: the cached fabric uploads' HBM now
@@ -563,11 +592,9 @@ class ReceiverNode:
         if not msg.boot:
             return
         if self.boot_cfg is None:
-            # Outside the _boot_started latch ON PURPOSE: the report is
-            # idempotent and cheap, and a leader that re-sends startup
-            # (after an update/re-plan, or because this send failed)
-            # must get it again — latching it once would re-open the
-            # boot-wait hang on a transient send failure.
+            # No latch ON PURPOSE: the report is idempotent and cheap,
+            # and a leader that re-sends startup (after an update/re-plan,
+            # or because this send failed) must get it again.
             log.info("startup asked for boot but this node opted out; "
                      "reporting skipped")
             try:
@@ -578,13 +605,36 @@ class ReceiverNode:
             except (OSError, KeyError) as e:
                 log.error("failed to send bootReadyMsg", err=repr(e))
             return
-        with self._lock:
-            if self._boot_started:  # a re-sent startup must not re-boot
-                return
-            self._boot_started = True
-        self.loop.submit(self._boot)
+        if boot_pending:
+            self.loop.submit(self._boot)
+        elif prior_report is not None:
+            try:
+                self.node.transport.send(
+                    self.node.leader_id,
+                    BootReadyMsg(self.node.my_id, *prior_report),
+                )
+            except (OSError, KeyError) as e:
+                log.error("failed to re-send bootReadyMsg", err=repr(e))
 
     def _boot(self) -> None:
+        try:
+            self._boot_inner()
+        finally:
+            self._boot_drained.set()
+
+    def wait_boot_drain(self, timeout: float) -> bool:
+        """Block until any started boot task has fully drained (report
+        sent, -gen decode done).  True immediately when no boot started.
+        The CLI calls this before process exit: the boot runs on daemon
+        threads, and exiting mid-boot kills it silently — the leader
+        then hangs waiting for a BootReadyMsg that never comes."""
+        with self._lock:
+            started = self._boot_started
+        if not started:
+            return True
+        return self._boot_drained.wait(timeout=timeout)
+
+    def _boot_inner(self) -> None:
         from .boot import boot_from_layers
 
         try:
@@ -599,9 +649,25 @@ class ReceiverNode:
             self.boot_result = res
         except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
             log.error("model boot failed", err=repr(e))
+            # The failure must still REPORT: the leader's TTFT wait gates
+            # on every assignee's BootReadyMsg, and silence would hang it
+            # (found live: a physical-size boot OOM left the leader
+            # blocked in boot_ready().get() forever).
+            with self._lock:
+                self._boot_report = (0.0, "failed")
+            try:
+                self.node.transport.send(
+                    self.node.leader_id,
+                    BootReadyMsg(self.node.my_id, 0.0, "failed"),
+                )
+            except (OSError, KeyError) as e2:
+                log.error("failed to send failed-boot bootReadyMsg",
+                          err=repr(e2))
             return
         finally:
             self._boot_finished.set()  # serve waiters proceed either way
+        with self._lock:
+            self._boot_report = (res.seconds, res.kind)
         try:
             self.node.transport.send(
                 self.node.leader_id,

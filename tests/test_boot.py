@@ -239,3 +239,140 @@ def test_boot_can_generate_tokens():
     res = boot_from_layers(CFG, layers, generate_tokens=4)
     assert res.kind == "full"
     assert res.tokens is not None and res.tokens.shape == (1, 4)
+
+
+def test_failed_boot_reports_and_unblocks_leader(monkeypatch):
+    # A boot that RAISES (found live: a physical-size compile OOM) must
+    # still send a BootReadyMsg — kind "failed" — so the leader's TTFT
+    # wait completes instead of hanging forever.
+    from distributed_llm_dissemination_tpu.runtime import boot as boot_mod
+
+    def explode(*a, **k):
+        raise RuntimeError("boot OOM (synthetic)")
+
+    monkeypatch.setattr(boot_mod, "boot_from_layers", explode)
+    leader, receiver, ts = _tiny_run(leader_boot=True, receiver_boot_cfg=CFG)
+    try:
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert booted == {1: 0.0}
+        assert leader.boot_kinds() == {1: "failed"}
+        assert receiver.boot_result is None
+        # The boot task fully drained (report sent) — the CLI's
+        # exit-time drain must not block.
+        assert receiver.wait_boot_drain(timeout=TIMEOUT)
+    finally:
+        leader.close(); receiver.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_crash_unblocks_boot_wait():
+    # Two assignees; one boots, the other is declared crashed before it
+    # ever reports.  The crash shrinks the assignment, which must
+    # complete the boot wait (not strand the leader).
+    from distributed_llm_dissemination_tpu.runtime import LeaderNode, ReceiverNode
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    blobs = all_blobs()
+    assignment = {
+        1: {bid: LayerMeta() for bid in blobs},
+        2: {bid: LayerMeta() for bid in blobs},
+    }
+    ts = {i: InmemTransport(str(i)) for i in (0, 1, 2)}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(b) for bid, b in blobs.items()},
+        assignment, expected_nodes={1, 2},
+    )
+    # Node 2 boots; node 1 opts out but we drop its "skipped" report by
+    # crashing it first — the wait must complete via the crash path.
+    r1 = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=None)
+    r2 = ReceiverNode(Node(2, 0, ts[2]), {}, boot_cfg=CFG)
+    try:
+        # Patch node 1's transport so its BootReadyMsg never arrives
+        # (the "hard-killed dest" shape: delivery done, report lost).
+        orig_send = ts[1].send
+
+        def drop_boot_ready(dest, msg):
+            if type(msg).__name__ == "BootReadyMsg":
+                return
+            orig_send(dest, msg)
+
+        ts[1].send = drop_boot_ready
+        r1.announce()
+        r2.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        import queue as _q
+
+        with pytest.raises(_q.Empty):
+            leader.boot_ready().get(timeout=0.5)  # genuinely blocked
+        leader.crash(1)
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert set(booted) == {2}
+        assert leader.boot_kinds()[2] in ("full", "stage")
+        # The dead assignee stays VISIBLE as crashed — the CLI exits
+        # nonzero on it instead of laundering the run as a success.
+        assert leader.boot_kinds()[1] == "crashed"
+    finally:
+        leader.close(); r1.close(); r2.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_wait_boot_drain_trivial_without_boot():
+    from distributed_llm_dissemination_tpu.runtime import ReceiverNode
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    t = InmemTransport("9")
+    r = ReceiverNode(Node(9, 0, t), {}, boot_cfg=None)
+    try:
+        assert r.wait_boot_drain(timeout=0.01)  # no boot started: instant
+    finally:
+        r.close(); t.close()
+
+
+def test_resent_startup_reanswers_with_prior_boot_report():
+    # A booted receiver whose BootReadyMsg was lost must re-answer a
+    # re-sent startup with its recorded outcome — otherwise a one-packet
+    # loss strands the leader's boot wait until its timeout.
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        BootReadyMsg,
+        StartupMsg,
+    )
+    from distributed_llm_dissemination_tpu.runtime import ReceiverNode
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    ts = {i: InmemTransport(str(i)) for i in (0, 1)}
+    r = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    try:
+        # Simulate a completed boot whose first report send was lost.
+        with r._lock:
+            r._boot_started = True
+            r._boot_report = (1.25, "full")
+        r._boot_drained.set()
+        r.handle_startup(StartupMsg(0, boot=True))
+        msg = ts[0].deliver().get(timeout=TIMEOUT)
+        assert isinstance(msg, BootReadyMsg)
+        assert (msg.src_id, msg.seconds, msg.kind) == (1, 1.25, "full")
+    finally:
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_crash_after_boot_report_keeps_success():
+    # A receiver that booted, reported, and exited (heartbeats stop, the
+    # detector later declares it crashed) is a COMPLETED deployment: the
+    # crash must not overwrite its "full" report with "crashed".
+    leader, receiver, ts = _tiny_run(leader_boot=True, receiver_boot_cfg=CFG)
+    try:
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert set(booted) == {1}
+        assert leader.boot_kinds()[1] == "full"
+        leader.crash(1)
+        assert leader.boot_kinds()[1] == "full"  # record survives
+    finally:
+        leader.close(); receiver.close()
+        for t in ts.values():
+            t.close()
