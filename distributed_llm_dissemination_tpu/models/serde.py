@@ -201,7 +201,11 @@ def _bytes_to_wide(flat_u8: jax.Array, dtype) -> jax.Array:
     k = dt.itemsize
     if k == 1:
         return jax.lax.bitcast_convert_type(flat_u8, dtype)
-    wide = {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[k]
+    if k not in (2, 4):
+        # 8-byte widths would need jax_enable_x64 (without it uint64
+        # silently truncates to 32 bits); no model config uses them.
+        raise ValueError(f"unsupported decode itemsize {k} for {dt}")
+    wide = {2: jnp.uint16, 4: jnp.uint32}[k]
     n = flat_u8.shape[0] // k
     word = None
     for i in range(k):

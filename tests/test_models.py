@@ -132,3 +132,36 @@ def test_param_specs_cover_all_leaves(cpu_devices):
         keys = [getattr(k, "key", None) for k in path[0]]
         if "layers" in keys:
             assert spec[0] == "pp"
+
+
+def test_bytes_to_wide_bit_exact_all_widths():
+    # The decode primitive behind every device blob assembly
+    # (serde._bytes_to_wide): strided byte combine + same-width bitcast
+    # must reproduce a little-endian memory view BIT-exactly.  Compared
+    # through integer dtypes — the TPU float path canonicalizes NaN bit
+    # patterns, and this pin must hold on every backend.
+    import numpy as np
+
+    from distributed_llm_dissemination_tpu.models import serde
+
+    rng = np.random.default_rng(7)
+    buf = rng.integers(0, 256, 4096, dtype=np.uint8)
+    for dt in (jnp.int8, jnp.uint16, jnp.uint32):
+        got = np.asarray(serde._bytes_to_wide(jnp.asarray(buf), dt))
+        want = buf.view(np.dtype(dt))
+        np.testing.assert_array_equal(got, want, err_msg=str(dt))
+    # 8-byte widths are rejected loudly (uint64 silently truncates
+    # without jax_enable_x64; no config uses them).
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="itemsize 8"):
+        serde._bytes_to_wide(jnp.asarray(buf), jnp.float64)
+    # And the float widths used by real checkpoints, viewed as ints.
+    got16 = np.asarray(
+        serde._bytes_to_wide(jnp.asarray(buf), jnp.bfloat16)
+    ).view(np.uint16)
+    np.testing.assert_array_equal(got16, buf.view(np.uint16))
+    got32 = np.asarray(
+        serde._bytes_to_wide(jnp.asarray(buf), jnp.float32)
+    ).view(np.uint32)
+    np.testing.assert_array_equal(got32, buf.view(np.uint32))
