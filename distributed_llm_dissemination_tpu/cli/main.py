@@ -412,13 +412,18 @@ def main(argv=None) -> int:
         ulog.log.info("external client not found in config")
 
     save_disk = bool(args.s)
-    layers = cfg.create_layers(node_conf, save_disk, args.s or ".",
-                               model=conf.model, model_seed=conf.model_seed,
-                               model_codec=conf.model_codec)
-    if my_client_conf is not None:
-        cfg.add_client_layers(my_client_conf, conf.layer_size, layers)
+
+    def fabricate():
+        layers = cfg.create_layers(node_conf, save_disk, args.s or ".",
+                                   model=conf.model,
+                                   model_seed=conf.model_seed,
+                                   model_codec=conf.model_codec)
+        if my_client_conf is not None:
+            cfg.add_client_layers(my_client_conf, conf.layer_size, layers)
+        return layers
 
     if args.l:
+        fabricate()
         ulog.log.info("layer set up")
         return 0
 
@@ -426,10 +431,15 @@ def main(argv=None) -> int:
     if my_client_conf is not None:
         addr_registry[CLIENT_ID] = my_client_conf.addr
 
+    # Bind the port BEFORE fabricating: seeding physical-size blobs takes
+    # minutes, and a leader that only listens afterwards forces every
+    # receiver (whose dial retry budget is ~10 s) to be spawned against a
+    # polled port.  The transport's delivery queue simply buffers any
+    # announces that arrive while fabrication runs.
     transport = TcpTransport(node_conf.addr, addr_registry=addr_registry)
-    node = Node(args.id, cfg.get_leader_conf(conf).id, transport)
-
     try:
+        layers = fabricate()
+        node = Node(args.id, cfg.get_leader_conf(conf).id, transport)
         if node_conf.is_leader:
             return run_leader(args, conf, node, layers)
         return run_receiver(args, conf, node, layers)
