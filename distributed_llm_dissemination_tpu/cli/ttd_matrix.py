@@ -248,12 +248,13 @@ def _codec_variant(src_path: str, out_path: str, codec: str,
 
 def run_codec_ab(trials: int, rate: int = 4 << 20, mode: int = 3,
                  timeout: float = 240.0) -> dict:
-    """Measured int8-codec benefit: the same model topology disseminated
-    raw vs int8 at a fixed source rate (models/quant.py halves the blob
-    bytes, so mode-3 completion time should roughly halve with it; the
-    transport's reference-parity 256 KiB burst bucket gives each job a
-    free head start, so at tiny2's ~2 MiB layers the measured ratio sits
-    a bit below the pure size ratio)."""
+    """Measured codec benefit: the same model topology disseminated
+    raw vs int8 vs int4 at a fixed source rate (models/quant.py shrinks
+    the blob bytes ~0.51x / ~0.27x, so mode-3 completion time should
+    shrink by roughly the same ratio; the transport's reference-parity
+    256 KiB burst bucket gives each job a free head start, so at tiny2's
+    ~2 MiB layers the measured ratios sit a bit below the pure size
+    ratios)."""
     out: dict = {"rate_bytes_per_s": rate, "mode": mode, "model": "tiny2"}
     # Blob fabrication imports jax in the receivers: CPU-pinned so the
     # row measures the rate-limited wire, not the device.  -boot none
@@ -261,7 +262,7 @@ def run_codec_ab(trials: int, rate: int = 4 << 20, mode: int = 3,
     # TTD timer doesn't even see).
     env = _cpu_env()
     with tempfile.TemporaryDirectory() as td:
-        for codec in ("raw", "int8"):
+        for codec in ("raw", "int8", "int4"):
             path = os.path.join(td, f"boot_{codec}.json")
             _codec_variant(os.path.join(CONF_DIR, "boot_tiny_4node.json"),
                            path, codec, rate)
@@ -272,9 +273,10 @@ def run_codec_ab(trials: int, rate: int = 4 << 20, mode: int = 3,
                           "all": [round(t, 4) for t in ts]}
             print(f"codec {codec}: TTD {out[codec]['ttd_s']}s",
                   file=sys.stderr, flush=True)
-    out["int8_vs_raw"] = round(
-        out["int8"]["ttd_s"] / max(out["raw"]["ttd_s"], 1e-9), 3
-    )
+    for codec in ("int8", "int4"):
+        out[f"{codec}_vs_raw"] = round(
+            out[codec]["ttd_s"] / max(out["raw"]["ttd_s"], 1e-9), 3
+        )
     return out
 
 
@@ -476,22 +478,26 @@ def to_markdown(results: dict) -> str:
     ab = results.get("codec_ab")
     if ab:
         lines += [
-            "## Transfer codec A/B (measured int8 benefit)",
+            "## Transfer codec A/B (measured quantization benefit)",
             "",
             "boot_tiny_4node's topology retargeted at the "
             f"`{ab.get('model', 'tiny2')}` model (~2 MiB layers, so the "
             "256 KiB burst bucket is noise), every source rate-limited "
             f"to {ab['rate_bytes_per_s'] >> 20} MiB/s, mode {ab['mode']}: "
-            "TTD is bytes over a fixed rate, so the int8 codec's ~0.51x "
-            "wire size appears as the TTD ratio (slightly below it: each "
-            "job's burst head start is codec-independent).",
+            "TTD is bytes over a fixed rate, so each codec's wire-size "
+            "ratio (~0.51x int8, ~0.27x int4) appears as the TTD ratio "
+            "(slightly below it: each job's burst head start is "
+            "codec-independent).",
             "",
-            "| codec | TTD | int8/raw |",
+            "| codec | TTD | vs raw |",
             "|---|---|---|",
             f"| raw | {ab['raw']['ttd_s']}s | |",
             f"| int8 | {ab['int8']['ttd_s']}s | {ab['int8_vs_raw']} |",
-            "",
         ]
+        if "int4" in ab:
+            lines.append(
+                f"| int4 | {ab['int4']['ttd_s']}s | {ab['int4_vs_raw']} |")
+        lines.append("")
     phys = results.get("physical")
     if phys:
         lines += [

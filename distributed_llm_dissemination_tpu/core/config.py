@@ -190,9 +190,10 @@ class Config:
     # inference engine after delivery (-boot).
     model: str = ""
     model_seed: int = 0
-    # Transfer codec for the fabricated blobs ("raw" | "int8"): int8
-    # halves the bytes every schedule ships (models/quant.py); receivers
-    # dequantize after landing, on-device when ingest staged to HBM.
+    # Transfer codec for the fabricated blobs ("raw" | "int8" | "int4"):
+    # int8 halves the bytes every schedule ships, int4 quarters them
+    # (models/quant.py); receivers dequantize after landing, on-device
+    # when ingest staged to HBM.
     model_codec: str = "raw"
 
     @classmethod

@@ -1,21 +1,35 @@
-"""Quantized transfer codecs: halve the bytes a layer costs on the wire.
+"""Quantized transfer codecs: shrink the bytes a layer costs on the wire.
 
 Dissemination is bandwidth-bound — TTD is bytes over line rate
 (SURVEY §6; the reference models it exactly that way in its flow solver,
 ``/root/reference/distributor/flow.go:221-270``).  A transfer codec
-attacks the numerator: seeders encode each layer blob into a symmetric
-per-row int8 form (scales + values, ~2x smaller than bf16), the wire and
-every scheduler see only the smaller opaque blob, and the receiver
-dequantizes AFTER the bytes land — on the accelerator, when the ``-hbm``
-ingest staged them, so the host never touches decoded weights.  The
-reference has no equivalent; it ships raw bytes only.
+attacks the numerator: seeders encode each layer blob into a quantized
+form (scales + narrow values), the wire and every scheduler see only the
+smaller opaque blob, and the receiver dequantizes AFTER the bytes land —
+on the accelerator, when the ``-hbm`` ingest staged them, so the host
+never touches decoded weights.  The reference has no equivalent; it
+ships raw bytes only.
 
-Format of an encoded blob (leaves in the same canonical order as
-``serde``): per leaf, ``rows`` f32 scales followed by ``rows x cols``
-int8 values, where a leaf of shape ``(..., cols)`` is flattened to
-``(rows, cols)`` — per-output-row symmetric absmax scaling,
-``x_hat = q * scale``, deterministic round-to-nearest (every seeder
-fabricating the same seeded blob must agree byte-for-byte).
+Two quantized formats (leaves in ``serde``'s canonical order):
+
+- **int8** (~0.50x bf16): per leaf, ``rows`` f32 scales then
+  ``rows x cols`` int8 values, where a leaf of shape ``(..., cols)`` is
+  flattened to ``(rows, cols)`` — per-output-row symmetric absmax
+  scaling, ``x_hat = q * scale``.
+- **int4** (~0.27x bf16): per leaf, ``rows x groups`` f32 scales
+  (group = 128 columns when the leaf allows, else one group per row)
+  then ``rows x cols/2`` packed bytes.  Packing pairs COLUMN HALVES,
+  not neighbors: byte ``j`` of a row holds column ``j``'s nibble (low)
+  and column ``j + cols/2``'s (high), so the device decode rebuilds the
+  leaf with one large ``concatenate([lo, hi], axis=1)`` — a
+  neighbor-interleave would need a ``(rows, cols/2, 2)`` intermediate
+  whose tiny minor dim provokes the TPU tiled-layout padding blowup
+  (the documented physical-size OOM class, see ``serde``).  Leaves that
+  can't pack (1-D norm gains, odd columns) ride raw inside the blob —
+  a negligible fraction of layer bytes.
+
+Both are deterministic round-to-nearest (every seeder fabricating the
+same seeded blob must agree byte-for-byte).
 
 Decode paths mirror ``serde``'s two:
 - host: numpy over the blob bytes;
@@ -47,9 +61,11 @@ from .serde import (
     layer_param_specs,
 )
 
-CODECS = ("raw", "int8")
+CODECS = ("raw", "int8", "int4")
 _SCALE_DT = np.float32
 _QMAX = 127.0
+_QMAX4 = 7.0
+_GROUP4 = 128  # int4 scale-group width (one TPU lane tile of columns)
 
 
 def _blob_specs(cfg: ModelConfig, blob_id: int) -> List[Spec]:
@@ -63,10 +79,38 @@ def _rows_cols(shape: Tuple[int, ...]) -> Tuple[int, int]:
     return int(np.prod(shape[:-1])), shape[-1]
 
 
+def _q4_layout(shape: Tuple[int, ...], itemsize: int):
+    """One leaf's int4 wire layout: ``("raw", nbytes)`` for leaves that
+    can't pack (1-D norm gains, odd columns), else
+    ``("q4", rows, cols, groups)`` with groups of ``cols // groups``
+    columns sharing one f32 scale."""
+    rows, cols = _rows_cols(shape)
+    if len(shape) == 1 or cols % 2:
+        return ("raw", rows * cols * itemsize)
+    # Scale groups and nibble packing are independent (packing pairs
+    # column j with j + cols/2; dequant multiplies AFTER unpacking), so
+    # grouping only needs the group width to divide cols.
+    g = _GROUP4 if cols % _GROUP4 == 0 else cols
+    return ("q4", rows, cols, cols // g)
+
+
+def _q4_leaf_nbytes(layout) -> int:
+    if layout[0] == "raw":
+        return layout[1]
+    _, rows, cols, groups = layout
+    return rows * groups * _SCALE_DT().itemsize + rows * (cols // 2)
+
+
 def blob_nbytes_codec(cfg: ModelConfig, blob_id: int, codec: str) -> int:
     """Exact wire size of a blob under ``codec``."""
     if codec == "raw":
         return blob_nbytes(cfg, blob_id)
+    if codec == "int4":
+        itemsize = np.dtype(cfg.dtype).itemsize
+        return sum(
+            _q4_leaf_nbytes(_q4_layout(shape, itemsize))
+            for _, shape in _blob_specs(cfg, blob_id)
+        )
     if codec != "int8":
         raise ValueError(f"unknown codec {codec!r}; known: {CODECS}")
     total = 0
@@ -80,6 +124,8 @@ def encode_blob(cfg: ModelConfig, blob_id: int, raw: bytes, codec: str) -> bytes
     """Encode a raw (cfg.dtype) blob into its wire form under ``codec``."""
     if codec == "raw":
         return raw
+    if codec == "int4":
+        return _encode_blob_q4(cfg, blob_id, raw)
     if codec != "int8":
         raise ValueError(f"unknown codec {codec!r}; known: {CODECS}")
     dt = np.dtype(cfg.dtype)
@@ -108,6 +154,8 @@ def decode_blob_host(
     specs = _blob_specs(cfg, blob_id)
     if codec == "raw":
         return serde._split_blob(cfg, data, specs)
+    if codec == "int4":
+        return _decode_blob_q4_host(cfg, blob_id, data)
     if codec != "int8":
         raise ValueError(f"unknown codec {codec!r}; known: {CODECS}")
     dt = np.dtype(cfg.dtype)
@@ -122,6 +170,78 @@ def decode_blob_host(
         q = buf[off : off + rows * cols].view(np.int8).reshape(rows, cols)
         off += rows * cols
         out[name] = (q.astype(np.float32) * scale).astype(dt).reshape(shape)
+    if off != len(buf):
+        raise ValueError(f"wire blob size {len(buf)} != expected {off}")
+    return out
+
+
+# ---------------------------------------------------------- int4 host path
+
+
+def _encode_blob_q4(cfg: ModelConfig, blob_id: int, raw: bytes) -> bytes:
+    """Host encode under the int4 format (see module docstring)."""
+    dt = np.dtype(cfg.dtype)
+    buf = np.frombuffer(memoryview(raw), dtype=np.uint8)
+    parts: List[bytes] = []
+    off = 0
+    for _, shape in _blob_specs(cfg, blob_id):
+        layout = _q4_layout(shape, dt.itemsize)
+        rows, cols = _rows_cols(shape)
+        n = rows * cols * dt.itemsize
+        if layout[0] == "raw":
+            parts.append(buf[off : off + n].tobytes())
+            off += n
+            continue
+        _, rows, cols, groups = layout
+        g = cols // groups
+        x = (buf[off : off + n].view(dt).reshape(rows, cols)
+             .astype(np.float32))
+        off += n
+        scale = np.abs(x).reshape(rows, groups, g).max(axis=2) / _QMAX4
+        scale = np.where(scale > 0, scale, 1.0).astype(_SCALE_DT)
+        q = np.clip(
+            np.rint(x.reshape(rows, groups, g) / scale[:, :, None]),
+            -_QMAX4, _QMAX4,
+        ).astype(np.int8).reshape(rows, cols)
+        c2 = cols // 2
+        packed = (((q[:, :c2] + 8) & 0xF)
+                  | (((q[:, c2:] + 8) & 0xF) << 4)).astype(np.uint8)
+        parts.append(scale.tobytes())
+        parts.append(packed.tobytes())
+    if off != len(buf):
+        raise ValueError(f"raw blob size {len(buf)} != expected {off}")
+    return b"".join(parts)
+
+
+def _decode_blob_q4_host(
+    cfg: ModelConfig, blob_id: int, data
+) -> Dict[str, np.ndarray]:
+    """Host decode of one int4 wire blob into {name: cfg.dtype array}."""
+    dt = np.dtype(cfg.dtype)
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8)
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for name, shape in _blob_specs(cfg, blob_id):
+        layout = _q4_layout(shape, dt.itemsize)
+        if layout[0] == "raw":
+            n = layout[1]
+            out[name] = buf[off : off + n].view(dt).reshape(shape)
+            off += n
+            continue
+        _, rows, cols, groups = layout
+        g = cols // groups
+        sb = rows * groups * _SCALE_DT().itemsize
+        scale = buf[off : off + sb].view(_SCALE_DT).reshape(rows, groups)
+        off += sb
+        c2 = cols // 2
+        packed = buf[off : off + rows * c2].view(np.uint8).reshape(rows, c2)
+        off += rows * c2
+        q = np.concatenate(
+            [(packed & 0xF).astype(np.int8) - 8,
+             (packed >> 4).astype(np.int8) - 8], axis=1)
+        x = (q.astype(np.float32).reshape(rows, groups, g)
+             * scale[:, :, None])
+        out[name] = x.reshape(rows, cols).astype(dt).reshape(shape)
     if off != len(buf):
         raise ValueError(f"wire blob size {len(buf)} != expected {off}")
     return out
@@ -160,6 +280,50 @@ def _decode_qblobs(blobs_u8, specs: Tuple[Spec, ...], dtype_name: str):
     return out
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _decode_q4blobs(blobs_u8, specs: Tuple[Spec, ...], dtype_name: str):
+    """n separate 1-D uint8 int4-codec blobs → {name: (n, *shape) dtype}
+    on device.  Same layout discipline as ``_decode_qblobs``; the packed
+    column-halves format means deinterleave is one big
+    ``concatenate([lo, hi], axis=1)`` — no tiny-minor-dim intermediates
+    (the TPU tiled-layout padding class, see module docstring)."""
+    dt = jnp.dtype(dtype_name)
+    sdt = jnp.dtype(_SCALE_DT)
+    itemsize = dt.itemsize
+    out = {}
+    off = 0
+    for name, shape in specs:
+        layout = _q4_layout(shape, itemsize)
+        leaves = []
+        if layout[0] == "raw":
+            n = layout[1]
+            for blob in blobs_u8:
+                raw = jax.lax.slice(blob, (off,), (off + n,))
+                leaves.append(serde._bytes_to_wide(raw, dt).reshape(shape))
+            out[name] = jnp.stack(leaves)
+            off += n
+            continue
+        _, rows, cols, groups = layout
+        g = cols // groups
+        c2 = cols // 2
+        sb = rows * groups * _SCALE_DT().itemsize
+        for blob in blobs_u8:
+            sraw = jax.lax.slice(blob, (off,), (off + sb,))
+            scale = serde._bytes_to_wide(sraw, sdt).reshape(rows, groups)
+            praw = jax.lax.slice(blob, (off + sb,),
+                                 (off + sb + rows * c2,))
+            packed = praw.reshape(rows, c2)
+            q = jnp.concatenate(
+                [(packed & 0xF).astype(jnp.int8) - 8,
+                 (packed >> 4).astype(jnp.int8) - 8], axis=1)
+            x = (q.astype(jnp.float32).reshape(rows, groups, g)
+                 * scale[:, :, None]).astype(dt)
+            leaves.append(x.reshape(shape))
+        out[name] = jnp.stack(leaves)
+        off += sb + rows * c2
+    return out
+
+
 def stacked_from_device_qblobs(
     cfg: ModelConfig, blob_arrays: Sequence[Any]
 ) -> Dict[str, Any]:
@@ -170,6 +334,25 @@ def stacked_from_device_qblobs(
         tuple(blob_arrays), tuple(layer_param_specs(cfg)),
         np.dtype(cfg.dtype).name,
     )
+
+
+def stacked_from_device_q4blobs(
+    cfg: ModelConfig, blob_arrays: Sequence[Any]
+) -> Dict[str, Any]:
+    """Device path: stacked layer params from HBM int4-codec blobs."""
+    return _decode_q4blobs(
+        tuple(blob_arrays), tuple(layer_param_specs(cfg)),
+        np.dtype(cfg.dtype).name,
+    )
+
+
+def head_from_device_q4blob(cfg: ModelConfig, blob_u8) -> Dict[str, Any]:
+    """Device path: embed/ln_f/lm_head from the HBM int4 head blob."""
+    decoded = _decode_q4blobs(
+        (blob_u8,), tuple(head_param_specs(cfg)),
+        np.dtype(cfg.dtype).name,
+    )
+    return {name: arr[0] for name, arr in decoded.items()}
 
 
 def head_from_device_qblob(cfg: ModelConfig, blob_u8) -> Dict[str, Any]:
@@ -214,6 +397,8 @@ def stacked_from_device(
     """Device path: stacked layer params from HBM wire blobs."""
     if codec == "raw":
         return serde.stacked_from_device_blobs(cfg, blob_arrays)
+    if codec == "int4":
+        return stacked_from_device_q4blobs(cfg, blob_arrays)
     return stacked_from_device_qblobs(cfg, blob_arrays)
 
 
@@ -221,4 +406,6 @@ def head_from_device(cfg: ModelConfig, blob_u8, codec: str) -> Dict[str, Any]:
     """Device path: head leaves from the HBM wire head blob."""
     if codec == "raw":
         return serde.head_from_device_blob(cfg, blob_u8)
+    if codec == "int4":
+        return head_from_device_q4blob(cfg, blob_u8)
     return head_from_device_qblob(cfg, blob_u8)

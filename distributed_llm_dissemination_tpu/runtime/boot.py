@@ -118,8 +118,9 @@ def boot_from_layers(
     when given (with ``node_id``), params land replicated on this node's
     stage devices via ``StagePlacement``; otherwise the default device.
     ``codec``: the transfer codec the blobs were encoded with
-    (``models/quant.py``); "int8" blobs are dequantized during assembly —
-    on-device when they were ingested to HBM.
+    (``models/quant.py``); quantized ("int8"/"int4") blobs are
+    dequantized during assembly — on-device when they were ingested to
+    HBM.
     Returns a BootResult whose ``seconds`` is the time from blob assembly
     to the first forward's output being ready (includes jit compile — the
     honest time-to-first-token a cold boot pays)."""
