@@ -24,11 +24,46 @@ blobs``) — the disseminated bytes never make a host round-trip.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any, Optional, Sequence
 
 from ..core.types import LayersSrc
 from ..utils.logging import log
+
+# The boot's jitted programs are MODULE-LEVEL singletons (llama.forward_jit
+# and _stage_forward_jitted below): precompile_boot lowers + compiles the
+# same callables boot_from_layers calls, so a precompile during
+# dissemination turns the boot-time jit call into a cache hit.
+_stage_fwd_lock = threading.Lock()
+_stage_fwd = None
+
+
+def _stage_forward_jitted():
+    """The stage boot's forward (a scan of layer_apply over the stacked
+    stage params), jitted once per process."""
+    global _stage_fwd
+    with _stage_fwd_lock:
+        if _stage_fwd is None:
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+
+            from ..models.llama import layer_apply
+
+            @functools.partial(jax.jit, static_argnums=(2,))
+            def stage_forward(stacked, x, cfg):
+                positions = jnp.arange(x.shape[1])
+
+                def body(x, layer_p):
+                    return layer_apply(layer_p, x, positions, cfg), None
+
+                out, _ = jax.lax.scan(body, x, stacked)
+                return out
+
+            _stage_fwd = stage_forward
+    return _stage_fwd
 
 
 @dataclasses.dataclass
@@ -44,6 +79,25 @@ class BootResult:
     # stage's stacked layer dict, on its stage's devices) and what
     # pod-level pipelined serving (runtime/pp_serve.py) consumes.
     params: Any = None
+
+
+def classify_held_blobs(cfg, held_ids) -> tuple:
+    """The boot's view of a held blob-id set: ``(layer_ids, full)``.
+    Raises ValueError for sets no boot shape accepts (no layers, or a
+    non-contiguous slice).  THE shared classifier: ``boot_from_layers``
+    and ``precompile_boot`` must agree on what a set means, or a hint-
+    time precompile warms the wrong program."""
+    from ..models import serde
+
+    head_id = serde.head_blob_id(cfg)
+    held = sorted(b for b in set(held_ids) if b <= head_id)
+    layer_ids = [b for b in held if b < head_id]
+    if not layer_ids:
+        raise ValueError(f"no model layer blobs among held layers {held}")
+    if layer_ids != list(range(layer_ids[0], layer_ids[0] + len(layer_ids))):
+        raise ValueError(f"held layer blobs are not contiguous: {layer_ids}")
+    full = set(held) >= set(range(head_id + 1))
+    return layer_ids, full
 
 
 def _device_blob(src) -> Optional[Any]:
@@ -129,17 +183,11 @@ def boot_from_layers(
     import numpy as np
 
     from ..models import quant, serde
-    from ..models.llama import forward, layer_apply
+    from ..models.llama import forward_jit
 
     t0 = time.monotonic()
     head_id = serde.head_blob_id(cfg)
-    held = sorted(lid for lid in layers if lid <= head_id)
-    layer_ids = [lid for lid in held if lid < head_id]
-    full = set(held) >= set(range(head_id + 1))
-    if not layer_ids:
-        raise ValueError(f"no model layer blobs among held layers {held}")
-    if layer_ids != list(range(layer_ids[0], layer_ids[0] + len(layer_ids))):
-        raise ValueError(f"held layer blobs are not contiguous: {layer_ids}")
+    layer_ids, full = classify_held_blobs(cfg, layers)
 
     sharding = None
     if placement is not None and node_id in placement.node_to_stage:
@@ -152,6 +200,7 @@ def boot_from_layers(
 
     # Assembly: device blobs stay on device; host blobs go up in one
     # device_put per leaf-stack.
+    held = layer_ids + ([head_id] if head_id in layers else [])
     dev_blobs = {lid: _device_blob(layers[lid]) for lid in held}
     if all(dev_blobs[lid] is not None for lid in layer_ids):
         stacked = quant.stacked_from_device(
@@ -192,7 +241,10 @@ def boot_from_layers(
         }
         if tokens is None:
             tokens = jnp.zeros((1, 16), jnp.int32)
-        logits = jax.jit(forward, static_argnums=2)(params, tokens, cfg)
+        # forward_jit is the module-level jitted forward: when a
+        # BootHintMsg precompile already lowered this shape, the call
+        # below is a cache hit and TTFT drops by the compile time.
+        logits = forward_jit(params, tokens, cfg)
         jax.block_until_ready(logits)
         # TTFT stops HERE: the decode below is serving time, not boot
         # time — it must not contaminate the metric reported next to TTD.
@@ -204,23 +256,142 @@ def boot_from_layers(
         decode_after_boot(cfg, res, generate_tokens, tokens=tokens)
         return res
 
-    # Stage boot: run this stage's slice on dummy activations.
-    def stage_forward(stacked, x):
-        positions = jnp.arange(x.shape[1])
-
-        def body(x, layer_p):
-            return layer_apply(layer_p, x, positions, cfg), None
-
-        out, _ = jax.lax.scan(body, x, stacked)
-        return out
-
+    # Stage boot: run this stage's slice on dummy activations (the
+    # module-level jit, so a hint-time precompile makes this a cache hit).
     x = jnp.zeros((1, 16, cfg.d_model), cfg.dtype)
     if sharding is not None:
         x = jax.device_put(x, sharding)
-    acts = jax.jit(stage_forward)(stacked, x)
+    acts = _stage_forward_jitted()(stacked, x, cfg)
     jax.block_until_ready(acts)
     dt = time.monotonic() - t0
     log.info("pipeline stage booted from disseminated layers", kind="stage",
              layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1))
     return BootResult("stage", dt, layer_ids, activations=acts,
                       params=stacked)
+
+
+def precompile_boot(
+    cfg,
+    blob_ids: Sequence[int],
+    placement=None,
+    node_id=None,
+    codec: str = "raw",
+    device_blobs: bool = False,
+) -> dict:
+    """Lower + compile the boot's jitted programs for the held set
+    ``blob_ids`` BEFORE the bytes arrive — XLA compiles from shapes
+    alone, so a receiver that gets a ``BootHintMsg`` at distribution
+    start can overlap the whole compile with the network transfer and
+    the post-startup boot hits warm caches.
+
+    Compiles the same module-level callables ``boot_from_layers`` calls
+    (``llama.forward_jit`` / ``_stage_forward_jitted`` and, for
+    ``device_blobs``, the codec decode jits), so the warm-up needs no
+    handle passing.  Returns {"compiled": [...]} naming what was warmed
+    (for logs and tests).  Best-effort by design: any mismatch with the
+    real boot (different path, sharding, shapes) is only a cache miss."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import quant, serde
+    from ..models.llama import forward_jit
+
+    head_id = serde.head_blob_id(cfg)
+    try:
+        layer_ids, full = classify_held_blobs(cfg, blob_ids)
+    except ValueError:
+        return {"compiled": []}  # boot_from_layers would reject this set
+    n = len(layer_ids)
+    dt = cfg.dtype
+    dt_name = np.dtype(dt).name
+
+    # Sharding discipline mirrors boot_from_layers EXACTLY — jit cache
+    # keys include argument shardings (committed-ness included), and the
+    # two real paths differ:
+    # - host assembly: every leaf is device_put with the stage sharding
+    #   (committed) when a placement maps this node, else jnp.asarray
+    #   (uncommitted);
+    # - device (-hbm) assembly: the staged wire blobs are COMMITTED to
+    #   the stage device (device_put / host-buffer adoption / the
+    #   make_array gather — every ingest arm), and jit outputs inherit
+    #   their inputs' commitment, so the decode outputs feeding the
+    #   forward are committed to the same device.  The stage boot's
+    #   dummy activations are still device_put with the stage sharding.
+    stage_sharding = None
+    stage_devs = None
+    if placement is not None and node_id in placement.node_to_stage:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        stage_sharding = NamedSharding(
+            placement.stage_mesh(placement.node_to_stage[node_id]), P()
+        )
+        stage_devs = list(placement.devices_for_node(node_id))
+    if device_blobs:
+        devs = stage_devs or [jax.devices()[0]]
+        if len(devs) == 1:
+            dev_sharding = jax.sharding.SingleDeviceSharding(devs[0])
+        else:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.ingest import flat_mesh
+
+            dev_sharding = NamedSharding(flat_mesh(devs), P())
+        leaf_sharding = dev_sharding
+    else:
+        dev_sharding = None
+        leaf_sharding = stage_sharding
+    x_sharding = stage_sharding
+
+    def sds(shape, dtype, sharding):
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    compiled = []
+    t0 = time.monotonic()
+    layer_specs = tuple(serde.layer_param_specs(cfg))
+    stacked_abs = {name: sds((n, *shape), dt, leaf_sharding)
+                   for name, shape in layer_specs}
+
+    if device_blobs:
+        # The -hbm path decodes HBM-resident wire blobs under these jits.
+        decode = {"raw": serde._decode_blobs,
+                  "int8": quant._decode_qblobs,
+                  "int4": quant._decode_q4blobs}[codec]
+        blob_abs = tuple(
+            sds((quant.blob_nbytes_codec(cfg, lid, codec),),
+                jnp.uint8, dev_sharding)
+            for lid in layer_ids
+        )
+        decode.lower(blob_abs, layer_specs, dt_name).compile()
+        compiled.append(f"decode[{codec}]x{n}")
+        if full:
+            head_abs = (sds(
+                (quant.blob_nbytes_codec(cfg, head_id, codec),),
+                jnp.uint8, dev_sharding),)
+            decode.lower(
+                head_abs, tuple(serde.head_param_specs(cfg)), dt_name
+            ).compile()
+            compiled.append(f"decode[{codec}]head")
+
+    if full:
+        head_abs = {name: sds(shape, dt, leaf_sharding)
+                    for name, shape in serde.head_param_specs(cfg)}
+        params_abs = {
+            "embed": head_abs["embed"],
+            "layers": stacked_abs,
+            "ln_f": head_abs["ln_f"],
+            "lm_head": head_abs["lm_head"],
+        }
+        tok_abs = jax.ShapeDtypeStruct((1, 16), jnp.int32)
+        forward_jit.lower(params_abs, tok_abs, cfg).compile()
+        compiled.append("forward")
+    else:
+        x_abs = sds((1, 16, cfg.d_model), dt, x_sharding)
+        _stage_forward_jitted().lower(stacked_abs, x_abs, cfg).compile()
+        compiled.append("stage_forward")
+    return {"compiled": compiled,
+            "compile_s": round(time.monotonic() - t0, 2)}

@@ -48,6 +48,7 @@ from ..sched.native import make_flow_graph
 from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
+    BootHintMsg,
     BootReadyMsg,
     DevicePlanMsg,
     FlowRetransmitMsg,
@@ -376,7 +377,39 @@ class LeaderNode:
             self._t_start = time.monotonic()
         log.info("timer start")
         self._start_q.put(self.assignment)
+        self._send_boot_hints()
         return True
+
+    def _send_boot_hints(self) -> None:
+        """Tell each assignee what it will hold, so it can compile its
+        boot programs while the bytes are still on the wire (shapes are
+        all XLA needs).  Advisory: a lost hint only costs the overlap."""
+        if not self.boot_enabled:
+            return
+        with self._lock:
+            per_dest = {dest: sorted(ids)
+                        for dest, ids in self.assignment.items()
+                        if dest != self.node.my_id and ids}
+        for dest, blob_ids in per_dest.items():
+            try:
+                self.node.transport.send(
+                    dest, BootHintMsg(self.node.my_id, blob_ids))
+            except (OSError, KeyError) as e:
+                log.warn("boot hint send failed", dest=dest, err=repr(e))
+
+    def _send_boot_hint_to(self, dest: NodeID) -> None:
+        """One assignee's hint (re-announce / update paths)."""
+        if not self.boot_enabled or dest == self.node.my_id:
+            return
+        with self._lock:
+            ids = sorted(self.assignment.get(dest) or {})
+        if not ids:
+            return
+        try:
+            self.node.transport.send(
+                dest, BootHintMsg(self.node.my_id, ids))
+        except (OSError, KeyError) as e:
+            log.warn("boot hint send failed", dest=dest, err=repr(e))
 
     def handle_announce(self, msg: AnnounceMsg) -> None:
         """Register the peer; once everyone announced, start sending
@@ -451,6 +484,11 @@ class LeaderNode:
             with self._lock:
                 finished = self._startup_sent
             if not finished:
+                # A restarted assignee lost its warm jit caches with its
+                # process — and has the longest re-transfer window to
+                # overlap a fresh precompile with.  (Receivers latch the
+                # first hint, so a repeat to a live process is a no-op.)
+                self._send_boot_hint_to(msg.src_id)
                 self._on_reannounce(msg.src_id)
 
     def _on_reannounce(self, node_id: NodeID) -> None:
@@ -485,6 +523,13 @@ class LeaderNode:
             if node_id != self.node.my_id and node_id not in self.status:
                 self.detector.touch(node_id)
         log.info("assignment updated", dests=sorted(assignment))
+        with self._lock:
+            started = self._started
+        if started:
+            # New goal, possibly new assignees (or new held-sets for old
+            # ones): re-hint everyone.  Receivers latch the first hint,
+            # so live processes ignore the repeat.
+            self._send_boot_hints()
         self._drive(self._update_replan)
 
     def _update_replan(self) -> None:

@@ -35,6 +35,7 @@ from ..core.types import (
 from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
+    BootHintMsg,
     BootReadyMsg,
     DevicePlanMsg,
     FlowRetransmitMsg,
@@ -132,6 +133,12 @@ class ReceiverNode:
         # (seconds, kind) of the boot outcome, for re-answering a
         # re-sent startup when the first BootReadyMsg was lost.
         self._boot_report = None
+        # One hint-time warmup per process: repeat hints (re-announce,
+        # update) are no-ops for a live receiver — an update() that
+        # changes this node's held-set shape boots cold, by design
+        # (advisory feature; the latch keeps compile threads bounded).
+        self._precompile_started = False
+        self._precompile_done = threading.Event()
         # Multi-controller serving (runtime/pp_serve.py): startup said a
         # ServeMsg will follow; the CLI keeps the process alive until
         # serve_done() fires (or times out).
@@ -171,6 +178,7 @@ class ReceiverNode:
         self.loop.register(StartupMsg, self.handle_startup)
         self.loop.register(DevicePlanMsg, self.handle_device_plan)
         self.loop.register(ServeMsg, self.handle_serve)
+        self.loop.register(BootHintMsg, self.handle_boot_hint)
 
     def announce(self) -> None:
         """Tell the leader what I already hold, routed via the next hop
@@ -550,6 +558,41 @@ class ReceiverNode:
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
+
+    def handle_boot_hint(self, msg: BootHintMsg) -> None:
+        """Overlap the boot's XLA compiles with the dissemination: the
+        leader says what this node will hold, and shapes are all the
+        compiler needs — so by the time the bytes land and startup asks
+        for the boot, its jit calls hit warm caches.  Runs on its OWN
+        daemon thread: a compile takes seconds and must not occupy a
+        handler-pool slot that fragment delivery needs."""
+        if self.boot_cfg is None or not msg.blob_ids:
+            return
+        with self._lock:
+            if self._precompile_started:
+                return
+            self._precompile_started = True
+        threading.Thread(
+            target=self._precompile_boot, args=(list(msg.blob_ids),),
+            daemon=True, name=f"boot-precompile-{self.node.my_id}",
+        ).start()
+
+    def _precompile_boot(self, blob_ids) -> None:
+        from .boot import precompile_boot
+
+        try:
+            rec = precompile_boot(
+                self.boot_cfg, blob_ids,
+                placement=self.placement, node_id=self.node.my_id,
+                codec=self.boot_codec, device_blobs=self.stage_hbm,
+            )
+            log.info("boot programs precompiled during dissemination",
+                     **rec)
+        except Exception as e:  # noqa: BLE001 — advisory: boot compiles cold
+            log.warn("boot precompile failed; boot will compile at "
+                     "startup instead", err=repr(e))
+        finally:
+            self._precompile_done.set()
 
     def handle_startup(self, msg: StartupMsg) -> None:
         """The inference-engine boot hook (node.go:1387-1389) — with

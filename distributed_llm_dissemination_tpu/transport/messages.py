@@ -49,10 +49,14 @@ class MsgType(enum.IntEnum):
     # SERVE — multi-controller pod serving: after the stage boots, every
     # member process enters one pipelined forward across the stages
     # (runtime/pp_serve.py).
+    # BOOT_HINT — leader → assignee at distribution start: the blob ids
+    # the dest will end up holding, so its boot programs can COMPILE
+    # while the bytes are still on the wire (XLA needs only shapes).
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
     SERVE = 11
+    BOOT_HINT = 12
 
 
 @dataclasses.dataclass
@@ -322,6 +326,31 @@ class BootReadyMsg:
 
 
 @dataclasses.dataclass
+class BootHintMsg:
+    """Leader → assignee, sent when distribution starts: the blob ids
+    this dest's Assignment will deliver.  Purely advisory — the receiver
+    uses it to lower + compile its boot programs (decode jits, the
+    forward) on a background thread while the layer bytes are still in
+    flight, so the post-startup boot hits warm caches and TTFT shrinks
+    by the compile time.  Shapes are all XLA needs; the weights aren't.
+    Losing or ignoring the hint costs nothing but the overlap."""
+
+    src_id: NodeID
+    blob_ids: list  # the dest's assigned blob ids
+
+    msg_type = MsgType.BOOT_HINT
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id,
+                "BlobIDs": [int(b) for b in self.blob_ids]}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "BootHintMsg":
+        return cls(int(d["SrcID"]),
+                   [int(b) for b in d.get("BlobIDs") or []])
+
+
+@dataclasses.dataclass
 class ServeMsg:
     """Leader → all (multi-controller SPMD): the stage boots partition
     the model — every ``members`` process must now enter the SAME
@@ -437,6 +466,7 @@ _DECODERS = {
     MsgType.BOOT_READY: BootReadyMsg,
     MsgType.DEVICE_PLAN: DevicePlanMsg,
     MsgType.SERVE: ServeMsg,
+    MsgType.BOOT_HINT: BootHintMsg,
 }
 
 

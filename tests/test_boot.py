@@ -376,3 +376,175 @@ def test_crash_after_boot_report_keeps_success():
         leader.close(); receiver.close()
         for t in ts.values():
             t.close()
+
+
+# ---------------------------------------------------- boot precompile overlap
+
+
+import contextlib
+import dataclasses
+import logging
+import time as _time
+
+from distributed_llm_dissemination_tpu.runtime.boot import precompile_boot
+
+
+@contextlib.contextmanager
+def _compile_log():
+    """Capture XLA 'Compiling jit(<name>)' records — the honest oracle
+    for whether a jit call hit the executable cache or compiled cold."""
+    records = []
+
+    class H(logging.Handler):
+        def emit(self, r):
+            records.append(r.getMessage())
+
+    h = H()
+    lg = logging.getLogger("jax._src.interpreters.pxla")
+    old_level = lg.level
+    lg.addHandler(h)
+    lg.setLevel(logging.DEBUG)
+    jax.config.update("jax_log_compiles", True)
+    try:
+        yield records
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        lg.removeHandler(h)
+        lg.setLevel(old_level)
+
+
+def _compiled(records, name):
+    return [r for r in records if r.startswith(f"Compiling jit({name})")]
+
+
+def test_precompile_boot_warms_the_forward_cache():
+    """precompile_boot from shapes alone, then the real boot: the boot's
+    forward_jit call must be an executable-cache HIT.  A control boot on
+    a different (unwarmed) config first proves the oracle detects cold
+    compiles — guarding against logger-name drift making the assertion
+    vacuous."""
+    # Control: unique shapes, no precompile → the compile IS logged.
+    cfg_cold = dataclasses.replace(CFG, vocab=352)
+    blobs_cold = {
+        bid: blob_layer(serde.seeded_blob(cfg_cold, bid, SEED))
+        for bid in list(range(cfg_cold.n_layers))
+        + [serde.head_blob_id(cfg_cold)]
+    }
+    with _compile_log() as records:
+        res = boot_from_layers(cfg_cold, blobs_cold)
+    assert res.kind == "full"
+    assert _compiled(records, "forward_jit"), (
+        "oracle broken: cold boot logged no forward compile")
+
+    # Warmed: same flow on another unique config, precompiled first.
+    cfg = dataclasses.replace(CFG, vocab=320)
+    ids = list(range(cfg.n_layers)) + [serde.head_blob_id(cfg)]
+    rec = precompile_boot(cfg, ids)
+    assert rec["compiled"] == ["forward"]
+    blobs = {bid: blob_layer(serde.seeded_blob(cfg, bid, SEED))
+             for bid in ids}
+    with _compile_log() as records:
+        res = boot_from_layers(cfg, blobs)
+    assert res.kind == "full"
+    assert not _compiled(records, "forward_jit"), (
+        "boot recompiled the forward despite the precompile")
+
+
+def test_precompile_boot_warms_the_stage_cache():
+    cfg = dataclasses.replace(CFG, vocab=288)
+    rec = precompile_boot(cfg, [1, 2])
+    assert rec["compiled"] == ["stage_forward"]
+    blobs = {bid: blob_layer(serde.seeded_blob(cfg, bid, SEED))
+             for bid in (1, 2)}
+    with _compile_log() as records:
+        res = boot_from_layers(cfg, blobs)
+    assert res.kind == "stage"
+    assert not _compiled(records, "stage_forward"), (
+        "stage boot recompiled despite the precompile")
+
+
+def test_precompile_boot_device_path_warms_decode_jits(cpu_devices):
+    """-hbm receivers decode HBM wire blobs under the codec jits; the
+    hint-time precompile lowers those too, and a subsequent device-path
+    boot must hit every warm cache (same oracle as the host tests —
+    the name-list assertion alone once hid a systematic sharding
+    mismatch)."""
+    from distributed_llm_dissemination_tpu.models import quant
+
+    cfg = dataclasses.replace(CFG, vocab=384)
+    ids = list(range(cfg.n_layers)) + [serde.head_blob_id(cfg)]
+    rec = precompile_boot(cfg, ids, codec="int8", device_blobs=True)
+    assert rec["compiled"] == [
+        f"decode[int8]x{cfg.n_layers}", "decode[int8]head", "forward"]
+
+    # The real -hbm shape: wire blobs resident as committed device
+    # arrays (the ingest's single-piece fast path), decoded on device.
+    dev = jax.devices()[0]
+    layers = {}
+    for bid in ids:
+        enc = quant.encode_blob(
+            cfg, bid, serde.seeded_blob(cfg, bid, SEED), "int8")
+        src = blob_layer(enc)
+        src.device_array = jax.device_put(
+            np.frombuffer(enc, np.uint8), dev)
+        layers[bid] = src
+    with _compile_log() as records:
+        res = boot_from_layers(cfg, layers, codec="int8")
+    assert res.kind == "full"
+    for name in ("forward_jit", "_decode_qblobs"):
+        assert not _compiled(records, name), (
+            f"device-path boot recompiled {name} despite the precompile: "
+            + "; ".join(_compiled(records, name)))
+
+
+def test_precompile_boot_rejects_unbootable_sets():
+    assert precompile_boot(CFG, []) == {"compiled": []}
+    assert precompile_boot(CFG, [0, 2]) == {"compiled": []}  # gap
+    head = serde.head_blob_id(CFG)
+    assert precompile_boot(CFG, [head]) == {"compiled": []}  # head only
+
+
+def test_boot_hint_triggers_receiver_precompile():
+    """E2E: the leader sends BootHintMsg at distribution start and the
+    dest's precompile thread starts while bytes are still moving."""
+    from distributed_llm_dissemination_tpu.runtime import (
+        LeaderNode,
+        ReceiverNode,
+    )
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    blobs = all_blobs()
+    assignment = {1: {bid: LayerMeta() for bid in blobs}}
+    ts = {i: InmemTransport(str(i)) for i in range(2)}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(blobs[bid]) for bid in blobs},
+        assignment,
+    )
+    dest = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    try:
+        dest.announce()
+        assert leader.start_distribution().get(timeout=TIMEOUT) == assignment
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            with dest._lock:
+                if dest._precompile_started:
+                    break
+            _time.sleep(0.02)
+        else:
+            raise AssertionError("BootHintMsg never started a precompile")
+        assert leader.ready().get(timeout=TIMEOUT) == assignment
+        dest.ready().get(timeout=TIMEOUT)
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert set(booted) == {1}
+        assert dest.boot_result is not None
+        assert dest.boot_result.kind == "full"
+    finally:
+        # Quiesce the precompile daemon before leaving: its compiles log
+        # process-globally and would pollute a later test's compile-log
+        # oracle (the suite runs 3-wide).
+        dest._precompile_done.wait(timeout=30.0)
+        leader.close()
+        dest.close()
+        for t in ts.values():
+            t.close()
