@@ -204,9 +204,21 @@ def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
                          fabric, scale_to=scale)
         spmd = os.path.join(td, "spmd_2proc.json")
         _spmd_config(spmd, scale)
+
+        def drop_fabric(conf):
+            # Host-path run of the 2-slice topology: the mode-3 leader
+            # still receives Mesh.Slices/DcnBW (the topology LP paces
+            # cross-slice senders to the pair capacity) but no process
+            # needs the 32-device fabric mesh.
+            conf.get("Mesh", {}).pop("Fabric", None)
+
+        dcn = os.path.join(td, "tpu_2slice_dcn.json")
+        _localize_config(os.path.join(CONF_DIR, "tpu_2slice_dcn.json"),
+                         dcn, scale_to=scale, mutate=drop_fabric)
         scenarios = {
             "local_4node": (local4, run_once),
             f"reference_8node@{scale >> 20}MiB": (scaled, run_once),
+            f"dcn_2slice_8node@{scale >> 20}MiB": (dcn, run_once),
             f"pod_fabric_4node@{scale >> 20}MiB": (fabric, run_once_pod),
             f"spmd_fabric_2proc@{scale >> 20}MiB": (spmd, run_once_spmd),
         }
@@ -458,7 +470,12 @@ def to_markdown(results: dict) -> str:
         "runs the per-node CLI as TWO real OS processes joined into one "
         "jax.distributed runtime, layer bytes as lockstep collectives "
         "(gloo on CPU — the absolute number is dominated by per-plan "
-        "compile+collective latency, not bandwidth). North-star secondary "
+        "compile+collective latency, not bandwidth); the dcn_2slice "
+        "scenario keeps Mesh.Slices/DcnBW so mode 3 runs the topology-"
+        "aware solve — whose ~0.8 s LP cost dominates that one cell at "
+        "loopback scale (the C++ Dinic fast path has no topology edges; "
+        "at physical layer sizes the solve amortizes into minutes of "
+        "transfer). North-star secondary "
         "target: mode 1 ≈ mode 0 — note that at loopback-scaled layer "
         "sizes fixed per-transfer overhead (connection setup, protocol "
         "round-trips) dominates both numbers, so ratios within ~1.5x "
