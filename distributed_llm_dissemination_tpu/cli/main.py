@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "waits for missing boot reports (then exits 1) and "
                         "a receiver drains its own in-flight boot before "
                         "exiting; size to the slowest expected boot")
+    p.add_argument("-serve", type=float, default=0.0,
+                   help="receiver: after a successful boot, stay alive "
+                        "this many seconds answering GenerateReqMsg "
+                        "inference requests (cli.genreq) from the "
+                        "resident params; 0 = exit after boot as before")
     return p
 
 
@@ -157,8 +162,19 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     assignment = conf.assignment
     # Wait for every configured node to announce, seeders included, so the
     # schedule sees all sources (the reference waits only for assignees and
-    # races seeder announcements).
-    expected = {nc.id for nc in conf.nodes}
+    # races seeder announcements).  IDLE SEATS — nodes seeding nothing
+    # (neither initial layers nor an attached external client), assigned
+    # nothing — are excluded: they can't affect the schedule, and they may
+    # not run cli.main at all (e.g. a cli.genreq requester seat that only
+    # needs a dialable address in the topology).
+    client_nodes = {cc.id for cc in conf.clients}
+    expected = {
+        nc.id for nc in conf.nodes
+        if nc.is_leader
+        or nc.id in assignment
+        or nc.id in client_nodes
+        or any((nc.initial_layers or {}).values())
+    }
     ft = args.ft
     fabric, placement = build_spmd_fabric(args, conf)
     common = dict(expected_nodes=expected, failure_timeout=ft,
@@ -364,6 +380,13 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     # silently and strand the leader's TTFT wait on the missing report.
     if not receiver.wait_boot_drain(timeout=args.bw):
         ulog.log.error("boot still running at exit timeout; leaving")
+    if args.serve > 0 and receiver.boot_result is not None:
+        # Inference window: the booted engine answers GenerateReqMsg
+        # (cli.genreq) from its resident params until the window closes.
+        ulog.log.info("serving generation requests",
+                      window_s=args.serve)
+        print(f"serving for {args.serve:g}s", flush=True)
+        time.sleep(args.serve)
     return 0
 
 

@@ -52,6 +52,8 @@ from ..transport.messages import (
     BootReadyMsg,
     DevicePlanMsg,
     FlowRetransmitMsg,
+    GenerateReqMsg,
+    GenerateRespMsg,
     HeartbeatMsg,
     LayerMsg,
     RetransmitMsg,
@@ -193,6 +195,22 @@ class LeaderNode:
         )
         self.loop.register(BootReadyMsg, self.handle_boot_ready)
         self.loop.register(DevicePlanMsg, self.handle_device_plan)
+        self.loop.register(GenerateReqMsg, self.handle_generate_req)
+
+    def handle_generate_req(self, msg: GenerateReqMsg) -> None:
+        """The leader seat serves no model — refuse immediately so a
+        misdirected request gets an error, not a requester timeout (the
+        serving invariant: every reachable seat ANSWERS)."""
+        try:
+            self.node.transport.send(
+                msg.src_id,
+                GenerateRespMsg(self.node.my_id, msg.req_id, [],
+                                "the leader seat serves no model; ask a "
+                                "booted assignee"),
+            )
+        except (OSError, KeyError, ConnectionError) as e:
+            log.error("generate refusal send failed", requester=msg.src_id,
+                      err=repr(e))
 
     # ------------------------------------------------------------- lifecycle
 

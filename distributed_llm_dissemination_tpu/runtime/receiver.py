@@ -39,6 +39,8 @@ from ..transport.messages import (
     BootReadyMsg,
     DevicePlanMsg,
     FlowRetransmitMsg,
+    GenerateReqMsg,
+    GenerateRespMsg,
     LayerMsg,
     RetransmitMsg,
     ServeMsg,
@@ -179,6 +181,7 @@ class ReceiverNode:
         self.loop.register(DevicePlanMsg, self.handle_device_plan)
         self.loop.register(ServeMsg, self.handle_serve)
         self.loop.register(BootHintMsg, self.handle_boot_hint)
+        self.loop.register(GenerateReqMsg, self.handle_generate_req)
 
     def announce(self) -> None:
         """Tell the leader what I already hold, routed via the next hop
@@ -558,6 +561,91 @@ class ReceiverNode:
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
+
+    def handle_generate_req(self, msg: GenerateReqMsg) -> None:
+        """Serve an inference request from this node's RESIDENT booted
+        params — the startup hook's engine, reachable over the same
+        transport that delivered its weights.  Full boots only (a stage
+        boot alone can't produce logits; pod serving is the ServeMsg
+        lockstep path).  Every outcome ANSWERS — the requester's timeout
+        is for lost messages, not policy.  Post-boot, the decode runs on
+        the handler pool (one slot; dissemination is over by then); a
+        request RACING the boot moves to its own daemon thread first —
+        parking pool slots on the boot wait could starve the very
+        control messages (acks, startup) the boot depends on."""
+        if not self._boot_finished.is_set() and self.boot_cfg is not None:
+            threading.Thread(
+                target=self._serve_generate_req, args=(msg,), daemon=True,
+                name=f"genreq-{self.node.my_id}-{msg.req_id}",
+            ).start()
+            return
+        self._serve_generate_req(msg)
+
+    def _serve_generate_req(self, msg: GenerateReqMsg) -> None:
+        import time as _time
+
+        t0 = _time.monotonic()
+
+        def reply(tokens=None, error=""):
+            try:
+                self.node.transport.send(
+                    msg.src_id,
+                    GenerateRespMsg(self.node.my_id, msg.req_id,
+                                    tokens or [], error),
+                )
+            except (OSError, KeyError, ConnectionError) as e:
+                log.error("generate response send failed",
+                          requester=msg.src_id, req=msg.req_id, err=repr(e))
+
+        if self.boot_cfg is None:
+            reply(error="no booted model at this node (no boot config)")
+            return
+        # Requests can race the boot; wait for it, bounded (a physical-
+        # size boot compiles + first-forwards in seconds — minutes only
+        # when the precompile overlap was lost).
+        if not self._boot_finished.wait(timeout=300.0):
+            reply(error="no booted model at this node "
+                        "(boot still in flight)")
+            return
+        res = self.boot_result
+        if res is None or res.kind != "full" or res.params is None:
+            reply(error="no booted model at this node "
+                        f"(kind={getattr(res, 'kind', None)})")
+            return
+        cfg = self.boot_cfg
+        if msg.max_new <= 0:
+            reply(error=f"max_new must be positive, got {msg.max_new}")
+            return
+        if not msg.prompt:
+            reply(error="empty prompt")
+            return
+        bad = [t for t in msg.prompt if t < 0 or t >= cfg.vocab]
+        if bad:
+            reply(error=f"prompt tokens outside vocab [0, {cfg.vocab}): "
+                        f"{bad[:8]}")
+            return
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from ..models.generate import generate
+
+            toks = generate(
+                res.params, jnp.asarray([list(msg.prompt)], jnp.int32),
+                cfg, int(msg.max_new),
+            )
+            out = [int(t) for t in jax.device_get(toks)[0]]
+        except Exception as e:  # noqa: BLE001 — must answer, not vanish
+            log.error("generation request failed", requester=msg.src_id,
+                      req=msg.req_id, err=repr(e))
+            reply(error=f"decode failed: {e!r}")
+            return
+        dt = _time.monotonic() - t0
+        log.info("served generation request", requester=msg.src_id,
+                 req=msg.req_id, prompt_tokens=len(msg.prompt),
+                 new_tokens=len(out), decode_ms=round(dt * 1000, 1),
+                 tokens_per_s=round(len(out) / max(dt, 1e-9), 1))
+        reply(tokens=out)
 
     def handle_boot_hint(self, msg: BootHintMsg) -> None:
         """Overlap the boot's XLA compiles with the dissemination: the

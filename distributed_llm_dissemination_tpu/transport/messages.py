@@ -52,11 +52,17 @@ class MsgType(enum.IntEnum):
     # BOOT_HINT — leader → assignee at distribution start: the blob ids
     # the dest will end up holding, so its boot programs can COMPILE
     # while the bytes are still on the wire (XLA needs only shapes).
+    # GENERATE_REQ / GENERATE_RESP — post-boot inference service: a peer
+    # sends prompt token ids, the booted node decodes with its RESIDENT
+    # params and answers — the startup hook's engine, actually servable
+    # over the same transport that delivered its weights.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
     SERVE = 11
     BOOT_HINT = 12
+    GENERATE_REQ = 13
+    GENERATE_RESP = 14
 
 
 @dataclasses.dataclass
@@ -351,6 +357,56 @@ class BootHintMsg:
 
 
 @dataclasses.dataclass
+class GenerateReqMsg:
+    """Requester → booted node: decode ``max_new`` greedy tokens after
+    ``prompt`` (token ids) with the node's resident params and answer
+    with a ``GenerateRespMsg`` echoing ``req_id``.  ``src_id`` must be
+    addressable by the serving node's transport (a topology node id, or
+    the client role's id)."""
+
+    src_id: NodeID
+    req_id: int
+    prompt: list  # token ids
+    max_new: int
+
+    msg_type = MsgType.GENERATE_REQ
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "ReqID": self.req_id,
+                "Prompt": [int(t) for t in self.prompt],
+                "MaxNew": self.max_new}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "GenerateReqMsg":
+        return cls(int(d["SrcID"]), int(d["ReqID"]),
+                   [int(t) for t in d.get("Prompt") or []],
+                   int(d.get("MaxNew", 0)))
+
+
+@dataclasses.dataclass
+class GenerateRespMsg:
+    """Booted node → requester: the decoded token ids (or why not)."""
+
+    src_id: NodeID
+    req_id: int
+    tokens: list = dataclasses.field(default_factory=list)
+    error: str = ""
+
+    msg_type = MsgType.GENERATE_RESP
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "ReqID": self.req_id,
+                "Tokens": [int(t) for t in self.tokens],
+                "Error": self.error}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "GenerateRespMsg":
+        return cls(int(d["SrcID"]), int(d["ReqID"]),
+                   [int(t) for t in d.get("Tokens") or []],
+                   str(d.get("Error", "")))
+
+
+@dataclasses.dataclass
 class ServeMsg:
     """Leader → all (multi-controller SPMD): the stage boots partition
     the model — every ``members`` process must now enter the SAME
@@ -467,6 +523,8 @@ _DECODERS = {
     MsgType.DEVICE_PLAN: DevicePlanMsg,
     MsgType.SERVE: ServeMsg,
     MsgType.BOOT_HINT: BootHintMsg,
+    MsgType.GENERATE_REQ: GenerateReqMsg,
+    MsgType.GENERATE_RESP: GenerateRespMsg,
 }
 
 

@@ -1,0 +1,212 @@
+"""Post-boot inference serving over the dissemination transport.
+
+The reference's endpoint is a stub startup hook; here the booted engine
+is a servable one: any peer (the external client's natural next role)
+sends a ``GenerateReqMsg`` with prompt token ids and the booted node
+answers with the decoded ids from its RESIDENT params — the closed loop
+weights-dissemination → engine boot → inference service, over the same
+two-plane transport.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    SourceType,
+)
+from distributed_llm_dissemination_tpu.models import serde
+from distributed_llm_dissemination_tpu.models.generate import generate
+from distributed_llm_dissemination_tpu.models.llama import CONFIGS, init_params
+from distributed_llm_dissemination_tpu.runtime import (
+    LeaderNode,
+    Node,
+    ReceiverNode,
+)
+from distributed_llm_dissemination_tpu.runtime.client import GenRequester
+from distributed_llm_dissemination_tpu.transport import (
+    InmemTransport,
+    reset_registry,
+)
+from distributed_llm_dissemination_tpu.transport.messages import (
+    GenerateReqMsg,
+    GenerateRespMsg,
+    MsgType,
+    decode_msg,
+)
+
+TIMEOUT = 60.0
+CFG = CONFIGS["tiny"]
+SEED = 0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def blob_layer(data: bytes) -> LayerSrc:
+    return LayerSrc(
+        inmem_data=bytearray(data),
+        data_size=len(data),
+        meta=LayerMeta(location=LayerLocation.INMEM,
+                       source_type=SourceType.MEM),
+    )
+
+
+def all_ids():
+    return list(range(CFG.n_layers)) + [serde.head_blob_id(CFG)]
+
+
+def test_generate_messages_roundtrip_json():
+    req = GenerateReqMsg(3, req_id=7, prompt=[1, 2, 3], max_new=4)
+    back = decode_msg(MsgType.GENERATE_REQ, req.to_payload())
+    assert (back.src_id, back.req_id, back.prompt, back.max_new) == (
+        3, 7, [1, 2, 3], 4)
+    resp = GenerateRespMsg(1, req_id=7, tokens=[9, 8], error="")
+    back = decode_msg(MsgType.GENERATE_RESP, resp.to_payload())
+    assert (back.src_id, back.req_id, back.tokens, back.error) == (
+        1, 7, [9, 8], "")
+
+
+def _disseminated_booted_pair():
+    """Leader seeds the full tiny model; node 1 receives and boots it."""
+    blobs = serde.blobs_from_params(CFG, init_params(CFG, jax.random.key(SEED)))
+    assignment = {1: {bid: LayerMeta() for bid in blobs}}
+    ts = {i: InmemTransport(str(i)) for i in range(3)}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(blobs[bid]) for bid in blobs},
+        assignment,
+    )
+    dest = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    return leader, dest, ts
+
+
+def test_booted_node_serves_generation_requests():
+    leader, dest, ts = _disseminated_booted_pair()
+    try:
+        dest.announce()
+        assert leader.start_distribution().get(timeout=TIMEOUT)
+        assert leader.ready().get(timeout=TIMEOUT)
+        dest.ready().get(timeout=TIMEOUT)
+        assert set(leader.boot_ready().get(timeout=TIMEOUT)) == {1}
+
+        requester = GenRequester(ts[2])
+        try:
+            prompt = [5, 7, 11, 13]
+            got = requester.request(1, prompt, max_new=6, timeout=TIMEOUT)
+            want = generate(
+                init_params(CFG, jax.random.key(SEED)),
+                jnp.asarray([prompt], jnp.int32), CFG, max_new=6)
+            assert got == np.asarray(jax.device_get(want))[0].tolist()
+
+            # Repeated requests reuse the compiled step (no re-boot):
+            # same prompt, same ids — the serving loop is deterministic.
+            again = requester.request(1, prompt, max_new=6, timeout=TIMEOUT)
+            assert again == got
+        finally:
+            requester.close()
+    finally:
+        leader.close()
+        dest.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_generation_request_over_real_tcp():
+    """The wire path: request + response as JSON control messages over
+    real sockets, requester addressed as its own topology node."""
+    from distributed_llm_dissemination_tpu.transport import TcpTransport
+
+    blobs = serde.blobs_from_params(CFG, init_params(CFG, jax.random.key(SEED)))
+    assignment = {1: {bid: LayerMeta() for bid in blobs}}
+    ts = {i: TcpTransport("127.0.0.1:0") for i in range(3)}
+    registry = {i: t.get_address() for i, t in ts.items()}
+    for t in ts.values():
+        t.addr_registry.update(registry)
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(blobs[bid]) for bid in blobs},
+        assignment,
+    )
+    dest = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    requester = GenRequester(ts[2], my_id=2)
+    try:
+        dest.announce()
+        assert leader.ready().get(timeout=TIMEOUT)
+        assert set(leader.boot_ready().get(timeout=TIMEOUT)) == {1}
+        prompt = [3, 1, 4, 1, 5]
+        got = requester.request(1, prompt, max_new=4, timeout=TIMEOUT)
+        want = generate(
+            init_params(CFG, jax.random.key(SEED)),
+            jnp.asarray([prompt], jnp.int32), CFG, max_new=4)
+        assert got == np.asarray(jax.device_get(want))[0].tolist()
+    finally:
+        requester.close()
+        leader.close()
+        dest.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_generation_request_to_unbooted_node_errors():
+    # A node with no boot config answers with an error, not silence —
+    # the requester's timeout is for LOST messages, not policy.
+    ts = {i: InmemTransport(str(i)) for i in range(2)}
+    r = ReceiverNode(Node(1, 0, ts[1]), {})
+    requester = GenRequester(ts[0])
+    try:
+        with pytest.raises(RuntimeError, match="no booted model"):
+            requester.request(1, [1, 2], max_new=2, timeout=TIMEOUT)
+    finally:
+        requester.close()
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_generation_request_to_leader_is_refused_not_dropped():
+    # The leader seat serves no model; a misdirected request must get an
+    # immediate error, not burn the requester's timeout.
+    ts = {i: InmemTransport(str(i)) for i in range(2)}
+    leader = LeaderNode(Node(0, 0, ts[0]), {}, {1: {0: LayerMeta()}})
+    requester = GenRequester(ts[1], my_id=1)
+    try:
+        with pytest.raises(RuntimeError, match="leader seat serves no"):
+            requester.request(0, [1, 2], max_new=2, timeout=TIMEOUT)
+    finally:
+        requester.close()
+        leader.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_generation_request_rejects_bad_prompts():
+    leader, dest, ts = _disseminated_booted_pair()
+    try:
+        dest.announce()
+        assert leader.ready().get(timeout=TIMEOUT)
+        assert set(leader.boot_ready().get(timeout=TIMEOUT)) == {1}
+        requester = GenRequester(ts[2])
+        try:
+            with pytest.raises(RuntimeError, match="prompt"):
+                requester.request(1, [], max_new=2, timeout=TIMEOUT)
+            with pytest.raises(RuntimeError, match="vocab"):
+                requester.request(1, [CFG.vocab + 5], max_new=2,
+                                  timeout=TIMEOUT)
+            with pytest.raises(RuntimeError, match="max_new"):
+                requester.request(1, [1], max_new=0, timeout=TIMEOUT)
+        finally:
+            requester.close()
+    finally:
+        leader.close()
+        dest.close()
+        for t in ts.values():
+            t.close()

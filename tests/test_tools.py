@@ -312,3 +312,82 @@ def test_boot_cli_generates_tokens(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_genreq_cli_serves_inference(tmp_path):
+    """The terminal pipeline step over the real CLI: disseminate + boot
+    with a -serve window, then cli.genreq asks the booted node for
+    tokens from an idle topology seat and gets the engine's greedy ids."""
+    import socket
+
+    with open(f"{CONF_DIR}/boot_tiny_4node.json") as f:
+        conf = json.load(f)
+    conf["Nodes"].append({
+        "Id": 4, "Addr": "", "NetworkBW": 12500000000,
+        "Sources": {"2": 0}, "InitialLayers": {},
+    })
+    socks = [socket.socket() for _ in conf["Nodes"]]
+    try:
+        for s_, n in zip(socks, conf["Nodes"]):
+            s_.bind(("127.0.0.1", 0))
+            n["Addr"] = f"127.0.0.1:{s_.getsockname()[1]}"
+    finally:
+        for s_ in socks:
+            s_.close()
+    conf_path = str(tmp_path / "boot_serve.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "3", "-serve", "120"]
+    procs = []
+    try:
+        for i in range(1, 4):
+            procs.append(subprocess.Popen(
+                cli + ["-id", str(i)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env))
+        leader = subprocess.run(
+            cli + ["-id", "0"], stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, timeout=180, env=env, text=True,
+        )
+        assert "Time to first token" in leader.stdout
+
+        prompt = [5, 7, 11]
+        req = subprocess.run(
+            [sys.executable, "-m",
+             "distributed_llm_dissemination_tpu.cli.genreq",
+             "-f", conf_path, "-node", "3",
+             "-prompt", ",".join(map(str, prompt)), "-n", "4"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=120, env=env, text=True,
+        )
+        assert req.returncode == 0, req.stderr[-2000:]
+        rec = json.loads(req.stdout.strip().splitlines()[-1])
+        assert rec["node"] == 3 and rec["prompt"] == prompt
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from distributed_llm_dissemination_tpu.models.generate import (
+            generate,
+        )
+        from distributed_llm_dissemination_tpu.models.llama import (
+            CONFIGS,
+            init_params,
+        )
+
+        mcfg = CONFIGS[conf["Model"]]
+        want = generate(
+            init_params(mcfg, jax.random.key(conf.get("ModelSeed", 0))),
+            jnp.asarray([prompt], jnp.int32), mcfg, max_new=4)
+        assert rec["tokens"] == np.asarray(jax.device_get(want))[0].tolist()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
