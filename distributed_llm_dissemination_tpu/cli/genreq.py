@@ -43,9 +43,17 @@ def main(argv=None) -> int:
     p.add_argument("-f", type=str, required=True, help="topology JSON")
     p.add_argument("-node", type=int, required=True,
                    help="the booted node to ask")
-    p.add_argument("-prompt", type=str, required=True,
+    p.add_argument("-prompt", type=str, default="",
                    help="comma-separated prompt token ids")
+    p.add_argument("-text", type=str, default="",
+                   help="prompt as text — needs an hf:<dir> Model whose "
+                        "checkpoint dir has a tokenizer; the reply then "
+                        "also carries decoded text")
     p.add_argument("-n", type=int, default=16, help="tokens to decode")
+    p.add_argument("-temp", type=float, default=0.0,
+                   help="sampling temperature (0 = greedy)")
+    p.add_argument("-seed", type=int, default=0,
+                   help="sampling seed (same seed, same tokens)")
     p.add_argument("-id", type=int, default=-1,
                    help="this requester's node seat (default: the "
                         "highest idle node in the topology)")
@@ -61,14 +69,30 @@ def main(argv=None) -> int:
         raise SystemExit(f"-id {my_id} is not a topology node")
     if args.node not in by_id:
         raise SystemExit(f"-node {args.node} is not a topology node")
-    prompt = [int(t) for t in args.prompt.split(",") if t.strip()]
+    if bool(args.prompt) == bool(args.text):
+        raise SystemExit("give exactly one of -prompt (token ids) or "
+                         "-text (needs an hf: Model)")
+
+    tokenizer = None
+    if args.text:
+        if not conf.model.startswith("hf:"):
+            raise SystemExit(
+                f"-text needs an hf:<dir> Model (config has "
+                f"{conf.model!r}); use -prompt with token ids")
+        from transformers import AutoTokenizer  # noqa: PLC0415
+
+        tokenizer = AutoTokenizer.from_pretrained(conf.model[3:])
+        prompt = [int(t) for t in tokenizer.encode(args.text)]
+    else:
+        prompt = [int(t) for t in args.prompt.split(",") if t.strip()]
 
     transport = TcpTransport(by_id[my_id].addr)
     transport.addr_registry.update({nc.id: nc.addr for nc in conf.nodes})
     requester = GenRequester(transport, my_id=my_id)
     try:
         tokens = requester.request(args.node, prompt, args.n,
-                                   timeout=args.t)
+                                   timeout=args.t, temperature=args.temp,
+                                   seed=args.seed)
     except (RuntimeError, TimeoutError, OSError, ConnectionError) as e:
         log.error("generation request failed", err=str(e))
         print(json.dumps({"error": str(e)}))
@@ -76,8 +100,10 @@ def main(argv=None) -> int:
     finally:
         requester.close()
         transport.close()
-    print(json.dumps({"node": args.node, "prompt": prompt,
-                      "tokens": tokens}))
+    rec = {"node": args.node, "prompt": prompt, "tokens": tokens}
+    if tokenizer is not None:
+        rec["text"] = tokenizer.decode(tokens)
+    print(json.dumps(rec))
     return 0
 
 

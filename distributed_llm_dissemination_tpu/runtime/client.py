@@ -100,11 +100,13 @@ class GenRequester:
         q.put(msg)
 
     def request(self, dest: NodeID, prompt, max_new: int,
-                timeout: float = 120.0) -> list:
+                timeout: float = 120.0, temperature: float = 0.0,
+                seed: int = 0) -> list:
         """Decode ``max_new`` tokens after ``prompt`` on node ``dest``.
-        Returns the new token ids; raises RuntimeError on a served error
-        and TimeoutError when no answer arrives (lost message / dead
-        node)."""
+        ``temperature`` 0 = greedy; > 0 samples with ``seed`` (same seed,
+        same tokens).  Returns the new token ids; raises RuntimeError on
+        a served error and TimeoutError when no answer arrives (lost
+        message / dead node)."""
         req_id = next(self._req_ids)
         q: "queue.Queue" = queue.Queue()
         with self._lock:
@@ -113,7 +115,8 @@ class GenRequester:
             self.transport.send(
                 dest,
                 GenerateReqMsg(self.my_id, req_id, list(prompt),
-                               int(max_new)),
+                               int(max_new), float(temperature),
+                               int(seed)),
             )
             try:
                 resp = q.get(timeout=timeout)

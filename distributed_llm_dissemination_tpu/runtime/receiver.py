@@ -624,15 +624,27 @@ class ReceiverNode:
             reply(error=f"prompt tokens outside vocab [0, {cfg.vocab}): "
                         f"{bad[:8]}")
             return
+        import math
+
+        # NOT `< 0`: NaN compares False both ways and would reach the
+        # sampler keyless (garbage tokens as a "success"), and NaN/inf
+        # also defeat the decode-program cache (NaN != NaN) — re-jitting
+        # per request.
+        if not (math.isfinite(msg.temperature) and msg.temperature >= 0):
+            reply(error="temperature must be finite and >= 0, "
+                        f"got {msg.temperature}")
+            return
         try:
             import jax
             import jax.numpy as jnp
 
             from ..models.generate import generate
 
+            temp = float(msg.temperature)
             toks = generate(
                 res.params, jnp.asarray([list(msg.prompt)], jnp.int32),
-                cfg, int(msg.max_new),
+                cfg, int(msg.max_new), temperature=temp,
+                key=(jax.random.key(int(msg.seed)) if temp > 0 else None),
             )
             out = [int(t) for t in jax.device_get(toks)[0]]
         except Exception as e:  # noqa: BLE001 — must answer, not vanish

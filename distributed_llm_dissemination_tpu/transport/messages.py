@@ -358,29 +358,36 @@ class BootHintMsg:
 
 @dataclasses.dataclass
 class GenerateReqMsg:
-    """Requester → booted node: decode ``max_new`` greedy tokens after
+    """Requester → booted node: decode ``max_new`` tokens after
     ``prompt`` (token ids) with the node's resident params and answer
-    with a ``GenerateRespMsg`` echoing ``req_id``.  ``src_id`` must be
-    addressable by the serving node's transport (a topology node id, or
-    the client role's id)."""
+    with a ``GenerateRespMsg`` echoing ``req_id``.  ``temperature`` 0 is
+    greedy (deterministic); > 0 samples with ``seed`` (the same seed
+    reproduces the same tokens).  ``src_id`` must be addressable by the
+    serving node's transport (a topology node id, or the client role's
+    id)."""
 
     src_id: NodeID
     req_id: int
     prompt: list  # token ids
     max_new: int
+    temperature: float = 0.0
+    seed: int = 0
 
     msg_type = MsgType.GENERATE_REQ
 
     def to_payload(self) -> dict:
         return {"SrcID": self.src_id, "ReqID": self.req_id,
                 "Prompt": [int(t) for t in self.prompt],
-                "MaxNew": self.max_new}
+                "MaxNew": self.max_new,
+                "Temperature": self.temperature, "Seed": self.seed}
 
     @classmethod
     def from_payload(cls, d: dict) -> "GenerateReqMsg":
         return cls(int(d["SrcID"]), int(d["ReqID"]),
                    [int(t) for t in d.get("Prompt") or []],
-                   int(d.get("MaxNew", 0)))
+                   int(d.get("MaxNew", 0)),
+                   float(d.get("Temperature", 0.0)),
+                   int(d.get("Seed", 0)))
 
 
 @dataclasses.dataclass

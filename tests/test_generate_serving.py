@@ -120,6 +120,32 @@ def test_booted_node_serves_generation_requests():
             t.close()
 
 
+def test_sampled_generation_is_seed_deterministic():
+    leader, dest, ts = _disseminated_booted_pair()
+    try:
+        dest.announce()
+        assert leader.ready().get(timeout=TIMEOUT)
+        assert set(leader.boot_ready().get(timeout=TIMEOUT)) == {1}
+        requester = GenRequester(ts[2], my_id=2)
+        try:
+            a = requester.request(1, [3, 5], max_new=8, timeout=TIMEOUT,
+                                  temperature=0.8, seed=42)
+            b = requester.request(1, [3, 5], max_new=8, timeout=TIMEOUT,
+                                  temperature=0.8, seed=42)
+            assert a == b  # same seed, same sampled tokens
+            assert all(0 <= t < CFG.vocab for t in a)
+            with pytest.raises(RuntimeError, match="temperature"):
+                requester.request(1, [3], max_new=2, timeout=TIMEOUT,
+                                  temperature=-1.0)
+        finally:
+            requester.close()
+    finally:
+        leader.close()
+        dest.close()
+        for t in ts.values():
+            t.close()
+
+
 def test_generation_request_over_real_tcp():
     """The wire path: request + response as JSON control messages over
     real sockets, requester addressed as its own topology node."""
