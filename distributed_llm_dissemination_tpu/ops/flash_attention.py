@@ -59,11 +59,12 @@ MAX_TILE = 512  # largest tile edge (VMEM-safe, empirically fastest on v5e)
 
 def _tile_edge(n: int) -> int:
     """Largest multiple of TILE up to MAX_TILE that divides ``n``."""
-    for cand in range(min(n, MAX_TILE), TILE - 1, -TILE):
+    start = min(n, MAX_TILE) // TILE * TILE  # candidates: 128-multiples only
+    for cand in range(start, TILE - 1, -TILE):
         if n % cand == 0:
             return cand
     # eligible() gates the public path; a direct caller with a non-128-
-    # multiple block must fail loudly, not drop its trailing rows.
+    # multiple block must fail loudly, not get a non-MXU-tileable spec.
     raise ValueError(f"block edge {n} is not a multiple of {TILE}")
 
 # Test hook: force the pallas path (interpret mode) off-TPU.

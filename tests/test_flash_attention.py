@@ -196,3 +196,33 @@ def test_ring_grads_match_dense_oracle(n, kvh, h):
     gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gr, gd):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+
+
+def test_tile_edge_selection():
+    # Largest 128-multiple <= 512 dividing the block edge.
+    assert fa._tile_edge(128) == 128
+    assert fa._tile_edge(256) == 256
+    assert fa._tile_edge(384) == 384
+    assert fa._tile_edge(512) == 512
+    assert fa._tile_edge(640) == 128   # 640 has no larger 128-mult divisor
+    assert fa._tile_edge(1024) == 512  # capped at MAX_TILE
+    with pytest.raises(ValueError, match="multiple of 128"):
+        fa._tile_edge(200)  # non-128-multiple must fail loudly, not
+        # silently drop trailing rows (grid floor-division)
+
+
+@pytest.mark.parametrize(
+    "sq,t",  # shapes whose q/kv tile edges DIFFER (the dynamic-tile paths)
+    [
+        (256, 512),  # tile_k > tile_q
+        (640, 256),  # 640 -> 128-edge q tiles next to 256-edge kv tiles
+    ],
+)
+def test_block_parity_mixed_tile_edges(force_pallas, sq, t):
+    qg, k, v = _rand_qkv(jax.random.PRNGKey(3), sq=sq, t=t)
+    offs = (jnp.float32(0), jnp.float32(0))
+    pv_p, m_p, l_p = fa.block_attention(qg, k, v, *offs)
+    pv_r, m_r, l_r = fa._block_attention_ref(qg, k, v, *offs)
+    np.testing.assert_allclose(m_p, m_r, rtol=1e-6)
+    np.testing.assert_allclose(l_p, l_r, rtol=1e-5)
+    np.testing.assert_allclose(pv_p, pv_r, rtol=1e-5, atol=1e-5)
