@@ -61,6 +61,11 @@ from .send import (
     send_layer,
 )
 
+# Max distinct hinted held-sets a receiver will background-compile for:
+# hints are unauthenticated, and each warmup is a seconds-long XLA
+# compile thread — a well-behaved run re-targets a handful of times.
+_PRECOMPILE_MAX_SETS = 4
+
 
 class ReceiverNode:
     """Mode 0 receiver (node.go:1299-1418).
@@ -135,12 +140,18 @@ class ReceiverNode:
         # (seconds, kind) of the boot outcome, for re-answering a
         # re-sent startup when the first BootReadyMsg was lost.
         self._boot_report = None
-        # One hint-time warmup per process: repeat hints (re-announce,
-        # update) are no-ops for a live receiver — an update() that
-        # changes this node's held-set shape boots cold, by design
-        # (advisory feature; the latch keeps compile threads bounded).
-        self._precompile_started = False
+        # One hint-time warmup per DISTINCT held-set: repeat hints for
+        # the same set (re-announce, update) are no-ops, while an
+        # update() that changes this node's held-set shape warms the new
+        # program too.  Hard-capped (_PRECOMPILE_MAX_SETS): hints are
+        # unauthenticated control messages, and unbounded distinct sets
+        # would mean unbounded concurrent XLA compile threads.
+        # _precompile_done is set exactly when NO warmup is in flight
+        # (an in-flight counter, not a per-thread pulse).
+        self._precompiled_sets: set = set()
+        self._precompile_inflight = 0
         self._precompile_done = threading.Event()
+        self._precompile_done.set()
         # Multi-controller serving (runtime/pp_serve.py): startup said a
         # ServeMsg will follow; the CLI keeps the process alive until
         # serve_done() fires (or times out).
@@ -668,12 +679,19 @@ class ReceiverNode:
         handler-pool slot that fragment delivery needs."""
         if self.boot_cfg is None or not msg.blob_ids:
             return
+        hinted = frozenset(int(b) for b in msg.blob_ids)
         with self._lock:
-            if self._precompile_started:
+            if hinted in self._precompiled_sets:
                 return
-            self._precompile_started = True
+            if len(self._precompiled_sets) >= _PRECOMPILE_MAX_SETS:
+                log.warn("precompile set budget exhausted; new hinted "
+                         "set boots cold", sets=len(self._precompiled_sets))
+                return
+            self._precompiled_sets.add(hinted)
+            self._precompile_inflight += 1
+            self._precompile_done.clear()
         threading.Thread(
-            target=self._precompile_boot, args=(list(msg.blob_ids),),
+            target=self._precompile_boot, args=(sorted(hinted),),
             daemon=True, name=f"boot-precompile-{self.node.my_id}",
         ).start()
 
@@ -692,7 +710,10 @@ class ReceiverNode:
             log.warn("boot precompile failed; boot will compile at "
                      "startup instead", err=repr(e))
         finally:
-            self._precompile_done.set()
+            with self._lock:
+                self._precompile_inflight -= 1
+                if self._precompile_inflight == 0:
+                    self._precompile_done.set()
 
     def handle_startup(self, msg: StartupMsg) -> None:
         """The inference-engine boot hook (node.go:1387-1389) — with

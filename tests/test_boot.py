@@ -504,6 +504,29 @@ def test_precompile_boot_rejects_unbootable_sets():
     assert precompile_boot(CFG, [head]) == {"compiled": []}  # head only
 
 
+def test_repeat_hints_warm_each_distinct_set():
+    # Same set twice: one warmup.  A changed set (update() re-target):
+    # a second warmup for the new shape.
+    from distributed_llm_dissemination_tpu.runtime import ReceiverNode
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        BootHintMsg,
+    )
+
+    ts = {1: InmemTransport("1")}
+    r = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    try:
+        r.handle_boot_hint(BootHintMsg(0, [0, 1]))
+        r.handle_boot_hint(BootHintMsg(0, [1, 0]))  # same set, reordered
+        assert len(r._precompiled_sets) == 1
+        r.handle_boot_hint(BootHintMsg(0, [1, 2]))
+        assert len(r._precompiled_sets) == 2
+        r._precompile_done.wait(timeout=30.0)
+    finally:
+        r.close()
+        ts[1].close()
+
+
 def test_boot_hint_triggers_receiver_precompile():
     """E2E: the leader sends BootHintMsg at distribution start and the
     dest's precompile thread starts while bytes are still moving."""
@@ -528,7 +551,7 @@ def test_boot_hint_triggers_receiver_precompile():
         deadline = _time.monotonic() + 10.0
         while _time.monotonic() < deadline:
             with dest._lock:
-                if dest._precompile_started:
+                if dest._precompiled_sets:
                     break
             _time.sleep(0.02)
         else:
