@@ -198,6 +198,51 @@ def test_generation_request_to_unbooted_node_errors():
             t.close()
 
 
+def test_serving_from_int4_booted_model():
+    """Codec x serving: the engine booted from int4 wire blobs serves
+    requests; its greedy ids equal a local decode on the same
+    dequantized params (the codec is part of the served model)."""
+    from distributed_llm_dissemination_tpu.models import quant
+
+    ids = all_ids()
+    raw = serde.blobs_from_params(CFG, init_params(CFG, jax.random.key(SEED)))
+    enc = {bid: quant.encode_blob(CFG, bid, raw[bid], "int4")
+           for bid in ids}
+    assignment = {1: {bid: LayerMeta() for bid in enc}}
+    ts = {i: InmemTransport(str(i)) for i in range(3)}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(enc[bid]) for bid in enc},
+        assignment,
+    )
+    dest = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG,
+                        boot_codec="int4")
+    requester = GenRequester(ts[2], my_id=2)
+    try:
+        dest.announce()
+        assert leader.ready().get(timeout=TIMEOUT)
+        assert set(leader.boot_ready().get(timeout=TIMEOUT)) == {1}
+        got = requester.request(1, [9, 4], max_new=5, timeout=TIMEOUT)
+        # Oracle: decode locally on the SAME dequantized params.
+        stacked = quant.stacked_from_blobs_host(
+            CFG, enc, list(range(CFG.n_layers)), "int4")
+        head = quant.head_from_blob_host(
+            CFG, enc[serde.head_blob_id(CFG)], "int4")
+        params = {"embed": jnp.asarray(head["embed"]),
+                  "layers": {k: jnp.asarray(v) for k, v in stacked.items()},
+                  "ln_f": jnp.asarray(head["ln_f"]),
+                  "lm_head": jnp.asarray(head["lm_head"])}
+        want = generate(params, jnp.asarray([[9, 4]], jnp.int32), CFG,
+                        max_new=5)
+        assert got == np.asarray(jax.device_get(want))[0].tolist()
+    finally:
+        requester.close()
+        leader.close()
+        dest.close()
+        for t in ts.values():
+            t.close()
+
+
 def test_generation_request_to_leader_is_refused_not_dropped():
     # The leader seat serves no model; a misdirected request must get an
     # immediate error, not burn the requester's timeout.

@@ -325,9 +325,10 @@ def test_disseminate_int8_then_boot_close_logits(cpu_devices):
             t.close()
 
 
-def test_int8_over_pod_fabric_boots(cpu_devices):
-    """Codec x fabric: int8 blobs ride the device plane (zero TCP layer
-    bytes) and the dest dequantizes on-device at boot."""
+@pytest.mark.parametrize("codec", ["int8", "int4"])
+def test_quantized_over_pod_fabric_boots(cpu_devices, codec):
+    """Codec x fabric: quantized blobs ride the device plane (zero TCP
+    layer bytes) and the dest dequantizes on-device at boot."""
     import json
 
     from distributed_llm_dissemination_tpu.cli.podrun import run_pod
@@ -336,7 +337,7 @@ def test_int8_over_pod_fabric_boots(cpu_devices):
         d = json.load(f)
     d["Model"] = "tiny"
     d["ModelSeed"] = SEED
-    d["ModelCodec"] = "int8"
+    d["ModelCodec"] = codec
     blob_ids = [str(b) for b in all_ids()]
     # Leader seeds every blob; cold node 3 is assigned the full model.
     d["Nodes"][0]["InitialLayers"] = {"2": {b: {} for b in blob_ids}}
