@@ -375,10 +375,13 @@ def _live_backend(probe_timeout: float = 60.0) -> str:
         return ""
 
 
-def run_physical(timeout: float = 1200.0) -> dict:
+def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
     """One recorded run at PHYSICAL layer size (no -scale): ties the TTD
     story to the bench's measured ingest bandwidth — TTD, TTFT, and the
-    achieved dest ingest rate on whatever backend is live (recorded)."""
+    achieved dest ingest rate on whatever backend is live (recorded).
+    ``trace_out``: also merge the per-node JSON logs and write a
+    Chrome-trace of the run there (the observability pipeline exercised
+    on the recorded scenario itself)."""
     backend = _live_backend()
     env = dict(os.environ) if backend else _cpu_env()
     with tempfile.TemporaryDirectory() as td:
@@ -390,13 +393,22 @@ def run_physical(timeout: float = 1200.0) -> dict:
                         if not n.get("IsLeader")]
         leader_addr = next(n["Addr"] for n in conf["Nodes"]
                            if n.get("IsLeader"))
+        logdir = os.path.join(td, "logs")
+        os.makedirs(logdir)
+
+        errfs = []
 
         def spawn(node_id):
+            # Per-node JSON logs (zerolog-style, on stderr) captured to
+            # files: the same artifacts a deployment's collect_logs
+            # gathers, here feeding the committed trace.
+            errf = open(os.path.join(logdir, f"node{node_id}.jsonl"), "wb")
+            errfs.append(errf)
             return subprocess.Popen(
                 [sys.executable, "-m",
                  "distributed_llm_dissemination_tpu.cli.main",
                  "-id", str(node_id), "-f", path, "-m", "3", "-hbm"],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+                stdout=subprocess.PIPE, stderr=errf, env=env,
             )
 
         def wait_listening(proc, addr: str, budget: float) -> None:
@@ -448,6 +460,28 @@ def run_physical(timeout: float = 1200.0) -> dict:
             }
             if ttft_m:
                 rec["ttft_s"] = round(float(ttft_m.group(1)), 4)
+            if trace_out:
+                # Receivers exit shortly after their boot reports; wait
+                # so the trace gets their final events too.
+                for p in procs[1:]:
+                    try:
+                        p.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        pass
+                try:
+                    from . import collect_logs, trace as trace_mod
+
+                    # Same pipeline as `cli.trace logs/` (to_trace_events
+                    # sorts internally; merge() would leak rel_ms into
+                    # every event's args and diverge from that path).
+                    events = trace_mod.to_trace_events(
+                        collect_logs.iter_records([logdir]))
+                    with open(trace_out, "w") as f:
+                        json.dump({"traceEvents": events,
+                                   "displayTimeUnit": "ms"}, f)
+                    rec["trace_events"] = len(events)
+                except Exception as e:  # noqa: BLE001 — trace is a bonus
+                    print(f"trace export failed: {e!r}", file=sys.stderr)
             print(f"physical: TTD {ttd:.2f}s "
                   f"({rec['achieved_gbps']} GB/s into the dest, "
                   f"backend {rec['backend']})", file=sys.stderr, flush=True)
@@ -456,6 +490,8 @@ def run_physical(timeout: float = 1200.0) -> dict:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+            for f in errfs:
+                f.close()
 
 
 def to_markdown(results: dict) -> str:
@@ -567,7 +603,12 @@ def main(argv=None) -> int:
     p.add_argument("-physical", action="store_true",
                    help="also run the physical-size scenario (~1.8 GiB "
                         "over loopback + device staging + a boot)")
+    p.add_argument("-trace", type=str, default="",
+                   help="with -physical: also write a Chrome trace of "
+                        "the run (merged per-node logs) to this path")
     args = p.parse_args(argv)
+    if args.trace and not args.physical:
+        p.error("-trace needs -physical (it traces that run)")
     results = run_matrix(args.scale, args.trials)
     results["codec_ab"] = run_codec_ab(args.trials)
     prior_doc = None
@@ -586,7 +627,7 @@ def main(argv=None) -> int:
         # BASELINE scenario results (minutes of 64-process wall time).
         results["baseline_scenarios"] = prior_doc["baseline_scenarios"]
     if args.physical:
-        results["physical"] = run_physical()
+        results["physical"] = run_physical(trace_out=args.trace)
     elif prior_doc and prior_doc.get("physical"):
         results["physical"] = prior_doc["physical"]
     with open(args.o, "w") as f:
