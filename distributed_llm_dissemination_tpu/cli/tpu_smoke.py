@@ -107,9 +107,9 @@ def check_pallas_block_attention() -> Dict:
         # query (a real data dependency, so XLA can't fold the loop).
         steps = 16
 
-        def _loop(impl):
+        def _loop(impl, k_, v_):
             def body(c, _):
-                pv, m, l = impl(c, k, v, zero, zero)
+                pv, m, l = impl(c, k_, v_, zero, zero)
                 return c + 1e-3 * pv, m[..., 0].sum() + l[..., 0].sum()
             @jax.jit
             def run(q0):
@@ -117,15 +117,28 @@ def check_pallas_block_attention() -> Dict:
                 return out, aux
             return run
 
-        for label, impl in (
+        impls = (
             ("pallas", lambda a, b_, c, d, e:
                 fa._block_attention_pallas(a, b_, c, d, e, False)),
             ("lax", fa._block_attention_ref),
-        ):
-            run = _loop(impl)
-            jax.block_until_ready(run(qg))  # compile
-            per_call = _median_time(lambda: run(qg), trials=5) / steps
-            rec[f"{label}_median_ms"] = round(1e3 * per_call, 3)
+        )
+        # Two shapes under ONE timing protocol: the short smoke block,
+        # and the ring path's realistic 2048 block — where the 512-edge
+        # tiling pays and the kernel must WIN, not just match.
+        sq2 = t2 = 2048
+        qg2 = jnp.asarray(
+            rng.standard_normal((1, 8, 4, sq2, hd)), jnp.float32)
+        k2 = jnp.asarray(rng.standard_normal((1, 8, t2, hd)), jnp.float32)
+        v2 = jnp.asarray(rng.standard_normal((1, 8, t2, hd)), jnp.float32)
+        for suffix, q_, k_, v_ in (("", qg, k, v), ("_2k", qg2, k2, v2)):
+            for label, impl in impls:
+                run = _loop(impl, k_, v_)
+                jax.block_until_ready(run(q_))  # compile
+                per_call = _median_time(lambda: run(q_), trials=5) / steps
+                rec[f"{label}{suffix}_median_ms"] = round(1e3 * per_call, 3)
+        rec["pallas_2k_speedup_vs_lax"] = round(
+            rec["lax_2k_median_ms"] / max(rec["pallas_2k_median_ms"], 1e-9),
+            3)
     # bf16 MXU truncation is ~6e-3 relative at these shapes; 2e-2 flags a
     # real kernel defect while tolerating precision-mode drift.  The
     # cross-check is tighter: pallas and lax share the truncation, so
@@ -133,12 +146,15 @@ def check_pallas_block_attention() -> Dict:
     rec["ok"] = (rel_pallas < 2e-2 and rel_cross <= max(rel_lax, 5e-3)
                  and (rec["selected_pallas"] or not on_tpu))
     if on_tpu:
-        # Perf bar: production routes attention through pallas at these
-        # shapes (_use_pallas), so the kernel being SLOWER than its own
-        # lax fallback is a regression this harness must fail, not
-        # green-light.  20% headroom for measurement noise.
+        # Perf bars: production routes attention through pallas at these
+        # shapes (_use_pallas), so a kernel slower than its own lax
+        # fallback is a regression this harness must fail, not
+        # green-light.  Short block: parity within 20% noise headroom.
+        # 2k block: the kernel must actually WIN (>= 1.0x; the tuned
+        # measurement is 1.23x, so parity already flags a regression).
         rec["ok"] = rec["ok"] and (
-            rec["pallas_median_ms"] <= 1.2 * rec["lax_median_ms"])
+            rec["pallas_median_ms"] <= 1.2 * rec["lax_median_ms"]
+            and rec["pallas_2k_speedup_vs_lax"] >= 1.0)
     return rec
 
 
