@@ -527,6 +527,52 @@ def test_repeat_hints_warm_each_distinct_set():
         ts[1].close()
 
 
+def test_update_rehints_the_new_held_set():
+    """update() re-targets the goal after distribution started; the new
+    assignment's hint reaches the assignee and warms the NEW shape."""
+    from distributed_llm_dissemination_tpu.runtime import (
+        LeaderNode,
+        ReceiverNode,
+    )
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    blobs = all_blobs()
+    first = {1: {0: LayerMeta(), 1: LayerMeta()}}
+    ts = {i: InmemTransport(str(i)) for i in range(2)}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(blobs[bid]) for bid in blobs},
+        {k: dict(v) for k, v in first.items()},
+    )
+    dest = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    try:
+        dest.announce()
+        assert leader.ready().get(timeout=TIMEOUT)
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            with dest._lock:
+                if len(dest._precompiled_sets) >= 1:
+                    break
+            _time.sleep(0.02)
+        assert frozenset({0, 1}) in dest._precompiled_sets
+
+        leader.update({1: {bid: LayerMeta() for bid in blobs}})
+        assert leader.ready().get(timeout=TIMEOUT)
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            with dest._lock:
+                if len(dest._precompiled_sets) >= 2:
+                    break
+            _time.sleep(0.02)
+        assert frozenset(blobs) in dest._precompiled_sets
+        dest._precompile_done.wait(timeout=30.0)
+    finally:
+        leader.close()
+        dest.close()
+        for t in ts.values():
+            t.close()
+
+
 def test_boot_hint_triggers_receiver_precompile():
     """E2E: the leader sends BootHintMsg at distribution start and the
     dest's precompile thread starts while bytes are still moving."""
