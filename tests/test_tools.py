@@ -181,6 +181,7 @@ def test_span_logs_duration():
     ("local_4node.json", 5, 4),
     ("tpu_v5e32_llama70b.json", 8, 80),
     ("boot_tiny_4node_int8.json", 4, 5),
+    ("boot_tiny_4node_int4.json", 4, 5),
 ])
 def test_shipped_configs_load(name, nodes, layers):
     conf = cfg.read_json(f"{CONF_DIR}/{name}")
@@ -201,17 +202,18 @@ def test_shipped_configs_load(name, nodes, layers):
     assert assigned <= seeded
 
 
-def test_int8_config_sizes_match_codec():
+@pytest.mark.parametrize("codec", ["int8", "int4"])
+def test_quantized_config_sizes_match_codec(codec):
     from distributed_llm_dissemination_tpu.models import quant
     from distributed_llm_dissemination_tpu.models.llama import CONFIGS
 
-    conf = cfg.read_json(f"{CONF_DIR}/boot_tiny_4node_int8.json")
-    assert conf.model_codec == "int8"
+    conf = cfg.read_json(f"{CONF_DIR}/boot_tiny_4node_{codec}.json")
+    assert conf.model_codec == codec
     mcfg = CONFIGS[conf.model]
     for nc in conf.nodes:
         for by_layer in nc.initial_layers.values():
             for lid, size in by_layer.items():
-                assert size == quant.blob_nbytes_codec(mcfg, lid, "int8")
+                assert size == quant.blob_nbytes_codec(mcfg, lid, codec)
 
 
 def test_v5e32_config_matches_llama70b():
