@@ -29,10 +29,15 @@ from ..utils.logging import log
 
 
 def _idle_seat(conf) -> int:
-    """The highest node id holding nothing and assigned nothing."""
+    """The highest node id holding nothing, assigned nothing, and with
+    no attached external client — client-attached seats DO run cli.main
+    (the leader awaits them), so their address is already bound by a
+    live node process and binding it here would fail or hijack replies."""
+    client_ids = {cc.id for cc in conf.clients}
     for nc in sorted(conf.nodes, key=lambda n: -n.id):
         holds = any(nc.initial_layers.values()) if nc.initial_layers else False
-        if not holds and nc.id not in conf.assignment and not nc.is_leader:
+        if (not holds and nc.id not in conf.assignment
+                and not nc.is_leader and nc.id not in client_ids):
             return nc.id
     raise SystemExit(
         "no idle node seat in the topology; pass -id explicitly")

@@ -61,10 +61,15 @@ from .send import (
     send_layer,
 )
 
-# Max distinct hinted held-sets a receiver will background-compile for:
-# hints are unauthenticated, and each warmup is a seconds-long XLA
-# compile thread — a well-behaved run re-targets a handful of times.
+# Max hinted held-sets a receiver keeps warm at once: hints are
+# unauthenticated, and each warmup is a seconds-long XLA compile
+# thread — a well-behaved run re-targets a handful of times.  Kept as
+# an insertion-ordered window: when a new distinct set arrives at the
+# cap, the OLDEST hinted set is evicted (superseded re-targets must
+# not consume the budget forever — a long-lived receiver crossing
+# many update()s still warms its newest target).
 _PRECOMPILE_MAX_SETS = 4
+
 
 
 class ReceiverNode:
@@ -77,6 +82,20 @@ class ReceiverNode:
     # How long a fabric dest waits for a plan's contributions before
     # requesting a re-plan (class attribute: tests and deployments tune it).
     FABRIC_COLLECT_TIMEOUT = 120.0
+
+    # Serve-time request bounds (class attributes: deployments tune
+    # them).  GenerateReqMsg is as unauthenticated as BootHintMsg, and
+    # each request allocates a KV cache proportional to prompt+max_new
+    # AND compiles one decode program per distinct (prompt_len,
+    # max_new) shape — so both dimensions are hard-capped, mirroring
+    # the _PRECOMPILE_MAX_SETS budget on the other unauthenticated
+    # control path.  SERVE_MAX_CONCURRENT bounds simultaneous decodes
+    # (each runs on its own daemon thread so the one-slot handler pool
+    # stays free for control traffic); excess requests get an
+    # immediate "busy" refusal — every outcome answers.
+    SERVE_MAX_PROMPT = 4096
+    SERVE_MAX_NEW = 1024
+    SERVE_MAX_CONCURRENT = 2
 
     def __init__(
         self,
@@ -148,8 +167,10 @@ class ReceiverNode:
         # would mean unbounded concurrent XLA compile threads.
         # _precompile_done is set exactly when NO warmup is in flight
         # (an in-flight counter, not a per-thread pulse).
-        self._precompiled_sets: set = set()
+        # Insertion-ordered (dict keys): newest-N window, oldest evicted.
+        self._precompiled_sets: dict = {}
         self._precompile_inflight = 0
+        self._serve_active = 0
         self._precompile_done = threading.Event()
         self._precompile_done.set()
         # Multi-controller serving (runtime/pp_serve.py): startup said a
@@ -579,18 +600,44 @@ class ReceiverNode:
         transport that delivered its weights.  Full boots only (a stage
         boot alone can't produce logits; pod serving is the ServeMsg
         lockstep path).  Every outcome ANSWERS — the requester's timeout
-        is for lost messages, not policy.  Post-boot, the decode runs on
-        the handler pool (one slot; dissemination is over by then); a
-        request RACING the boot moves to its own daemon thread first —
-        parking pool slots on the boot wait could starve the very
-        control messages (acks, startup) the boot depends on."""
-        if not self._boot_finished.is_set() and self.boot_cfg is not None:
-            threading.Thread(
-                target=self._serve_generate_req, args=(msg,), daemon=True,
-                name=f"genreq-{self.node.my_id}-{msg.req_id}",
-            ).start()
+        is for lost messages, not policy.  ALWAYS decodes on its own
+        daemon thread: the handler pool has one slot, and a decode (or
+        a boot wait) parked there would serialize every other control
+        message — re-sent Startup re-answers, ServeMsg, concurrent
+        generate requests — for the full decode duration.  Concurrent
+        decodes are bounded (SERVE_MAX_CONCURRENT): each holds a KV
+        cache, so an unauthenticated flood must hit an immediate
+        "busy" refusal, not an unbounded thread/HBM pile-up."""
+        with self._lock:
+            if self._serve_active >= self.SERVE_MAX_CONCURRENT:
+                busy = True
+            else:
+                busy = False
+                self._serve_active += 1
+        if busy:
+            try:
+                self.node.transport.send(
+                    msg.src_id,
+                    GenerateRespMsg(self.node.my_id, msg.req_id, [],
+                                    f"busy: {self.SERVE_MAX_CONCURRENT} "
+                                    "decodes already in flight"),
+                )
+            except (OSError, KeyError, ConnectionError) as e:
+                log.error("busy refusal send failed",
+                          requester=msg.src_id, req=msg.req_id, err=repr(e))
             return
-        self._serve_generate_req(msg)
+
+        def _run():
+            try:
+                self._serve_generate_req(msg)
+            finally:
+                with self._lock:
+                    self._serve_active -= 1
+
+        threading.Thread(
+            target=_run, daemon=True,
+            name=f"genreq-{self.node.my_id}-{msg.req_id}",
+        ).start()
 
     def _serve_generate_req(self, msg: GenerateReqMsg) -> None:
         import time as _time
@@ -627,8 +674,16 @@ class ReceiverNode:
         if msg.max_new <= 0:
             reply(error=f"max_new must be positive, got {msg.max_new}")
             return
+        if msg.max_new > self.SERVE_MAX_NEW:
+            reply(error=f"max_new {msg.max_new} exceeds this node's serve "
+                        f"limit {self.SERVE_MAX_NEW}")
+            return
         if not msg.prompt:
             reply(error="empty prompt")
+            return
+        if len(msg.prompt) > self.SERVE_MAX_PROMPT:
+            reply(error=f"prompt length {len(msg.prompt)} exceeds this "
+                        f"node's serve limit {self.SERVE_MAX_PROMPT}")
             return
         bad = [t for t in msg.prompt if t < 0 or t >= cfg.vocab]
         if bad:
@@ -683,11 +738,21 @@ class ReceiverNode:
         with self._lock:
             if hinted in self._precompiled_sets:
                 return
-            if len(self._precompiled_sets) >= _PRECOMPILE_MAX_SETS:
-                log.warn("precompile set budget exhausted; new hinted "
-                         "set boots cold", sets=len(self._precompiled_sets))
+            # The window re-admits evicted sets, so the eviction alone
+            # no longer bounds CONCURRENT warmups — an attacker cycling
+            # distinct sets faster than compiles finish would otherwise
+            # spawn a compile thread per hint.  Saturated = boot cold.
+            if self._precompile_inflight >= _PRECOMPILE_MAX_SETS:
+                log.warn("precompile warmups saturated; hinted set "
+                         "boots cold", inflight=self._precompile_inflight)
                 return
-            self._precompiled_sets.add(hinted)
+            while len(self._precompiled_sets) >= _PRECOMPILE_MAX_SETS:
+                evicted = next(iter(self._precompiled_sets))
+                del self._precompiled_sets[evicted]
+                log.info("precompile window full; evicting oldest hinted "
+                         "set (its jit caches stay warm until XLA drops "
+                         "them)", evicted=sorted(evicted))
+            self._precompiled_sets[hinted] = True
             self._precompile_inflight += 1
             self._precompile_done.clear()
         threading.Thread(

@@ -263,7 +263,32 @@ class TcpTransport(Transport):
                 except OSError:
                     self._evict(dest_addr, pconn)
                     raise
+                threading.Thread(
+                    target=self._drain_control, args=(dest_addr, pconn),
+                    daemon=True,
+                ).start()
         return pconn
+
+    def _drain_control(self, dest_addr: str, pconn: _PConn) -> None:
+        """Evict a dialed control connection the moment the peer closes.
+
+        Dialed control conns are write-only by protocol (replies arrive
+        on the PEER'S dial to OUR listener), so a recv() here only ever
+        returns on FIN/RST.  Without this, a peer restart leaves a
+        half-closed socket in the pool and the NEXT send to it succeeds
+        silently (TCP buffers the bytes, the RST arrives later) — one
+        message vanishes without tripping the send path's evict-and-
+        redial retry.  A rebound seat (a genreq requester reusing an
+        idle seat's address, a restarted node) would lose exactly its
+        first reply that way."""
+        sock = pconn.sock
+        try:
+            while sock.recv(4096):
+                pass  # peers never write here; discard until EOF
+        except OSError:
+            pass
+        if not self._closed.is_set():
+            self._evict(dest_addr, pconn)
 
     def _evict(self, dest_addr: str, pconn: _PConn) -> None:
         """Drop a broken control connection so the next send re-dials."""
@@ -464,19 +489,15 @@ class TcpTransport(Transport):
             self._data_pool.clear()
             accepted = list(self._accepted)
             self._accepted.clear()
-        for sock in pooled:
-            try:
-                sock.close()
-            except OSError:
-                pass
-        for pconn in conns:
-            try:
-                if pconn.sock is not None:
-                    pconn.sock.close()
-            except OSError:
-                pass
-        for sock in accepted:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        # shutdown() before close(), for the same reason as the listener
+        # above: a thread blocked in recv() on the socket holds the
+        # kernel file reference, so close() alone sends NO FIN until
+        # that syscall returns — peers would never learn we went away
+        # (their drain threads keep the stale conn pooled, and their
+        # next send to this seat's address silently vanishes).
+        for sock in pooled + [p.sock for p in conns if p.sock] + accepted:
+            for op in (lambda: sock.shutdown(socket.SHUT_RDWR), sock.close):
+                try:
+                    op()
+                except OSError:
+                    pass

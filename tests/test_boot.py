@@ -527,6 +527,51 @@ def test_repeat_hints_warm_each_distinct_set():
         ts[1].close()
 
 
+def test_precompile_window_evicts_oldest_not_newest():
+    """The hinted-set budget is a sliding window, not a lifetime cap: a
+    long-lived receiver crossing many update() re-targets must still
+    warm its NEWEST target — the oldest (superseded) set is evicted."""
+    from distributed_llm_dissemination_tpu.runtime import ReceiverNode
+    from distributed_llm_dissemination_tpu.runtime import receiver as rmod
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        BootHintMsg,
+    )
+
+    ts = {1: InmemTransport("1")}
+    r = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    try:
+        sets = [[0, 1], [1, 2], [2, 3], [0, 1, 2],
+                [1, 2, 3], [0, 1, 2, 3]]
+        for s in sets:
+            r.handle_boot_hint(BootHintMsg(0, s))
+        with r._lock:
+            assert len(r._precompiled_sets) == rmod._PRECOMPILE_MAX_SETS
+            kept = set(r._precompiled_sets)
+        # The newest N survive; the oldest (count - N) are evicted.
+        want = {frozenset(s) for s in sets[-rmod._PRECOMPILE_MAX_SETS:]}
+        assert kept == want
+        # A re-hint of the newest set is still a no-op (latched).
+        before = len(r._precompiled_sets)
+        r.handle_boot_hint(BootHintMsg(0, sets[-1]))
+        assert len(r._precompiled_sets) == before
+        r._precompile_done.wait(timeout=60.0)
+
+        # Saturation: the window re-admits evicted sets, so CONCURRENT
+        # warmups are capped separately — cycling distinct sets faster
+        # than compiles finish must not spawn unbounded compile threads.
+        with r._lock:
+            r._precompile_inflight = rmod._PRECOMPILE_MAX_SETS
+        window_before = dict(r._precompiled_sets)
+        r.handle_boot_hint(BootHintMsg(0, [0, 3]))  # novel set
+        assert dict(r._precompiled_sets) == window_before  # not admitted
+        with r._lock:
+            r._precompile_inflight = 0
+    finally:
+        r.close()
+        ts[1].close()
+
+
 def test_update_rehints_the_new_held_set():
     """update() re-targets the goal after distribution started; the new
     assignment's hint reaches the assignee and warms the NEW shape."""

@@ -274,6 +274,33 @@ def test_generation_request_rejects_bad_prompts():
                                   timeout=TIMEOUT)
             with pytest.raises(RuntimeError, match="max_new"):
                 requester.request(1, [1], max_new=0, timeout=TIMEOUT)
+            # Upper bounds: one misbehaving peer must not be able to
+            # allocate an arbitrarily large KV cache or force a fresh
+            # decode compile per giant shape (cf. the bounded
+            # precompile-set budget on the BootHintMsg path).
+            with pytest.raises(RuntimeError, match="serve limit"):
+                requester.request(1, [1], max_new=10**6, timeout=TIMEOUT)
+            with pytest.raises(RuntimeError, match="serve limit"):
+                requester.request(1, [1] * 10**5, max_new=2,
+                                  timeout=TIMEOUT)
+            # Concurrency gate: with the budget exhausted, a request
+            # gets an immediate busy refusal (answers, never queues
+            # unboundedly); restoring the budget restores service.
+            dest.SERVE_MAX_CONCURRENT = 0
+            try:
+                with pytest.raises(RuntimeError, match="busy"):
+                    requester.request(1, [1], max_new=2, timeout=TIMEOUT)
+            finally:
+                del dest.SERVE_MAX_CONCURRENT  # back to the class attr
+            assert requester.request(1, [1], max_new=2,
+                                     timeout=TIMEOUT) is not None
+            # Budget returns after the decode thread's finally (the
+            # reply races it by design — poll briefly).
+            import time as _t
+            deadline = _t.monotonic() + 5.0
+            while dest._serve_active and _t.monotonic() < deadline:
+                _t.sleep(0.01)
+            assert dest._serve_active == 0
         finally:
             requester.close()
     finally:

@@ -316,6 +316,27 @@ def test_boot_cli_generates_tokens(tmp_path):
                 p.kill()
 
 
+def test_genreq_default_seat_skips_client_attached_nodes():
+    """A client-attached seat DOES run cli.main (the leader awaits it),
+    so its address is live — the default requester seat must not pick
+    it, or the bind fails / hijacks that seat's replies."""
+    from distributed_llm_dissemination_tpu.cli.genreq import _idle_seat
+    from distributed_llm_dissemination_tpu.core.config import Config
+
+    conf = Config.from_json({
+        "Nodes": [
+            {"Id": 0, "Addr": "a:1", "IsLeader": True},
+            {"Id": 1, "Addr": "a:2"},   # assignee
+            {"Id": 2, "Addr": "a:3"},   # idle — the right default
+            {"Id": 3, "Addr": "a:4"},   # client-attached: must be skipped
+        ],
+        "Clients": [{"Id": 3, "Addr": "a:5"}],
+        "Assignment": {"1": {"0": {}}},
+        "LayerSize": 4,
+    })
+    assert _idle_seat(conf) == 2
+
+
 def test_genreq_cli_serves_inference(tmp_path):
     """The terminal pipeline step over the real CLI: disseminate + boot
     with a -serve window, then cli.genreq asks the booted node for

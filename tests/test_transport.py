@@ -291,6 +291,39 @@ def test_control_conn_recovers_after_peer_restart():
         t1.close()
 
 
+def test_control_conn_evicted_on_peer_close_no_lost_message():
+    """The drain thread must evict a pooled control conn on peer FIN —
+    BEFORE the next send, so no message silently vanishes into the
+    half-closed socket.  This is the one-lost-reply window a rebound
+    seat hits (e.g. two sequential genreq requesters on the same idle
+    seat: the booted node's reply to the second one rode the stale conn
+    from the first and was lost)."""
+    t0 = TcpTransport("127.0.0.1:0")
+    t1 = TcpTransport("127.0.0.1:0")
+    addr1 = t1.get_address()
+    t0.addr_registry[1] = addr1
+    t1_new = None
+    try:
+        t0.send(1, SimpleMsg(t0.get_address(), "warm"))
+        assert t1.deliver().get(timeout=RECV_TIMEOUT).payload_str == "warm"
+        assert addr1 in t0._conns
+        t1.close()  # peer seat goes away
+        deadline = time.monotonic() + 5.0
+        while addr1 in t0._conns and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert addr1 not in t0._conns, (
+            "pooled control conn not evicted on peer close")
+        # Same seat, new process: ONE send must land (fresh dial).
+        t1_new = TcpTransport(addr1)
+        t0.send(1, SimpleMsg(t0.get_address(), "rebound"))
+        assert t1_new.deliver().get(
+            timeout=RECV_TIMEOUT).payload_str == "rebound"
+    finally:
+        t0.close()
+        if t1_new is not None:
+            t1_new.close()
+
+
 def test_data_connection_pooling(monkeypatch):
     """Sequential layer transfers to one dest share ONE pooled data
     connection (a flow job's fragments used to dial per fragment —
