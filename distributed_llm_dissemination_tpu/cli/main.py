@@ -428,6 +428,19 @@ def main(argv=None) -> int:
         maybe_initialize(conf, args.id)
 
     node_conf = cfg.get_node_conf(conf, args.id)
+    if (args.m == 3 and node_conf.is_leader and conf.mesh is not None
+            and conf.mesh.topology() is not None):
+        # Adversarial-holdings topology solves need the exact LP; its
+        # ~2 s one-time scipy/HiGHS initialization starts here — the
+        # earliest possible moment — so it overlaps fabrication and the
+        # announce round-trips instead of the TTD clock.  (The common
+        # attribution-first path never touches scipy at all.)
+        import threading as _threading
+
+        from ..sched.flow import warm_lp
+
+        _threading.Thread(target=warm_lp, name="lp-warm",
+                          daemon=True).start()
     try:
         my_client_conf = cfg.get_client_conf(conf, args.id)
     except ValueError:

@@ -77,6 +77,7 @@ _INSTANT_MESSAGES = {
     "pod generated token ids",
     "job assignment calculated",
     "job assignment calculated (native)",
+    "job assignment calculated (topology)",
     "job assignment calculated (topology LP)",
     "topology solve degraded to flat replan",
 }

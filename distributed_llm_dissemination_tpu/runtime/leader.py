@@ -1380,14 +1380,9 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
 
     @staticmethod
     def _warm_lp() -> None:
-        try:
-            from scipy.optimize import linprog
-            from scipy.sparse import csr_matrix
+        from ..sched.flow import warm_lp
 
-            linprog([1.0], A_ub=csr_matrix([[1.0]]), b_ub=[1.0],
-                    bounds=(0, None), method="highs")
-        except Exception:  # noqa: BLE001 — warm-up is best-effort
-            pass
+        warm_lp()
 
     def _register_handlers(self) -> None:
         super()._register_handlers()

@@ -138,6 +138,36 @@ def _have_lp() -> bool:
     return True
 
 
+_warmed = False
+
+
+def warm_lp() -> None:
+    """Pre-initialize the LP solver stack off the critical path.
+
+    The first ``linprog`` call in a process pays ~2 s of one-time cost
+    (scipy.optimize import machinery + HiGHS initialization); the warm
+    solve is ~75 ms.  A mode-3 leader with a ``PodTopology`` calls this
+    from a daemon thread at startup, so by the time receivers have
+    announced and the real solve runs, the cost has overlapped with
+    fabrication/dial/announce instead of landing inside TTD.
+    Idempotent and safe to call from any thread (the work is behind
+    Python's import lock + a module flag)."""
+    global _warmed
+    if _warmed or not _have_lp():
+        return
+    try:
+        from scipy.optimize import linprog
+        from scipy.sparse import csr_matrix
+
+        a = csr_matrix(([1.0], ([0], [0])), shape=(1, 1))
+        linprog([-1.0], A_ub=a, b_ub=[1.0], bounds=(0, None),
+                method="highs")
+        _warmed = True
+    except Exception as e:  # noqa: BLE001 — warmup is advisory
+        log.warn("LP warmup failed; first topology solve runs cold",
+                 err=repr(e))
+
+
 def _transport(supplies, demands, admissible):
     """Tiny transportation max-flow: split ``supplies`` (key, amount)
     onto ``demands`` (key, amount) along ``admissible(sup_key, dem_key)``
@@ -555,10 +585,11 @@ class FlowGraph:
     def _flat_replan(self, why: str) -> Tuple[int, FlowJobsMap]:
         """Last-resort degrade: plan without the topology (the flat path
         also handles partial deliverability by decomposing whatever flow
-        exists instead of starving every pair)."""
+        exists instead of starving every pair).  ``type(self)`` keeps a
+        NativeFlowGraph's degrade on the C++ Dinic."""
         log.error("topology solve degraded to flat replan", why=why)
-        flat = FlowGraph(self.assignment, self.status, self.layer_sizes,
-                         self.node_network_bw, remaining=self.remaining)
+        flat = type(self)(self.assignment, self.status, self.layer_sizes,
+                          self.node_network_bw, remaining=self.remaining)
         return flat.get_job_assignment()
 
     @staticmethod
@@ -575,8 +606,22 @@ class FlowGraph:
             )
             pair_offset[(layer_id, dest)] = offset + nbytes
 
-    def _lp_job_assignment(self) -> Tuple[int, FlowJobsMap]:
-        """Time search + decomposition over the exact LP (topology mode)."""
+    def _relaxed_bound(self, required: int) -> Tuple[int, bool]:
+        """Minimum t at which the RELAXED graph (topology pair edges
+        shared, holdings labels dropped) routes ``required`` bytes.
+        ``self.cap`` is left holding the residuals of whatever probe ran
+        LAST — which the binary search does NOT guarantee to be the
+        returned t — so callers that decompose flows must re-run
+        ``max_flow(t)`` first (``get_job_assignment`` does).
+        ``NativeFlowGraph`` overrides this with the C++ Dinic search,
+        which never touches ``self.cap`` at all."""
+        return _search_min_time(lambda t: self.max_flow(t) >= required)
+
+    def _lp_job_assignment(self, seed: Optional[int] = None
+                           ) -> Tuple[int, FlowJobsMap]:
+        """Time search + decomposition over the exact LP (topology mode).
+        ``seed``: a known relaxed lower bound (the caller already ran the
+        relaxed search); None recomputes it."""
         sched: Dict = {}
 
         def feasible(t: int) -> bool:
@@ -594,12 +639,14 @@ class FlowGraph:
         # there skips the small candidates (each a wasted LP solve) and
         # keeps leader planning latency out of the TTD.
         required = sum(self._pair_size(lid, d) for lid, d in self.pairs)
-        t_lb, relaxed_ok = _search_min_time(
-            lambda t: self.max_flow(t) >= required)
-        if not relaxed_ok:
-            # Even the relaxation can't deliver everything; the flat
-            # solver still schedules every deliverable byte.
-            return self._flat_replan("no feasible t under the relaxation")
+        if seed is None:
+            t_lb, relaxed_ok = self._relaxed_bound(required)
+            if not relaxed_ok:
+                # Even the relaxation can't deliver everything; the flat
+                # solver still schedules every deliverable byte.
+                return self._flat_replan("no feasible t under the relaxation")
+        else:
+            t_lb = seed
         t, ok = _search_min_time(feasible, lo=t_lb)
         if not ok:
             return self._flat_replan("no feasible t under the LP")
@@ -622,16 +669,23 @@ class FlowGraph:
 
     def get_job_assignment(self) -> Tuple[int, FlowJobsMap]:
         """Minimum feasible completion time (MILLISECONDS) + per-sender
-        byte-range jobs (flow.go:146-218, at 1000× finer granularity)."""
-        if self.topology is not None and self.x_pairs and _have_lp():
-            return self._lp_job_assignment()
+        byte-range jobs (flow.go:146-218, at 1000× finer granularity).
+
+        Topology instances run ATTRIBUTION-FIRST: the relaxed search's
+        minimum time is a lower bound for the exact problem, so when the
+        transportation re-split lands the cross-slice flow on true
+        holdings, that plan achieves the bound and IS optimal — no LP
+        needed.  The LP runs only when attribution fails (adversarial
+        holdings), which keeps scipy's ~2 s one-time initialization off
+        the common path entirely (it still warms in the background,
+        ``warm_lp``)."""
         required = sum(self._pair_size(lid, dest) for lid, dest in self.pairs)
 
         # Pure max-flow feasibility only: it is monotone in t (capacities
         # scale with t), which the binary search requires.  Whether the
         # particular EK-chosen flow re-attributes along true holdings is
         # NOT monotone, so attribution is checked once at the final t.
-        t, ok = _search_min_time(lambda t: self.max_flow(t) >= required)
+        t, ok = self._relaxed_bound(required)
         if not ok:
             # Undeliverable pair(s): decompose the partial flow at the
             # search ceiling — every deliverable byte still schedules.
@@ -641,7 +695,11 @@ class FlowGraph:
         cross = self._attribute_cross() if self.x_pairs else {}
         if cross is None:
             # The relaxation chose an unattributable flow (module
-            # docstring): replan flat rather than emit an invalid tiling.
+            # docstring): the exact LP recovers a holdings-valid optimum
+            # when available; otherwise replan flat rather than emit an
+            # invalid tiling.
+            if ok and _have_lp():
+                return self._lp_job_assignment(seed=t)
             return self._flat_replan(
                 f"cross-slice attribution failed at t={t}")
 
@@ -670,5 +728,6 @@ class FlowGraph:
             jobs, pair_offset,
         )
 
-        log.info("job assignment calculated", min_time_ms=t)
+        log.info("job assignment calculated (topology)" if self.x_pairs
+                 else "job assignment calculated", min_time_ms=t)
         return t, jobs

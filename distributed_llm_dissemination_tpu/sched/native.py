@@ -46,6 +46,7 @@ class NativeFlowGraph(FlowGraph):
         per_t: List[int] = []
         contrib: Dict[Tuple[NodeID, LayerID, NodeID], int] = {}
         class_edge: Dict[Tuple[int, int], int] = {}
+        seen: set = set()  # dedup for the topology's shared INF edges
 
         src = self.idx[_V("source")]
         sink = self.idx[_V("sink")]
@@ -82,11 +83,37 @@ class NativeFlowGraph(FlowGraph):
                     layer = self.idx[
                         _V("layer", layer_id=layer_id, node_id=dest)
                     ]
-                    contrib[(node_id, layer_id, dest)] = len(eu)
-                    eu.append(cls)
-                    ev.append(layer)
-                    const.append(_INF)
-                    per_t.append(0)
+                    if self._cross(node_id, dest):
+                        # Topology: cross-slice arcs route through the
+                        # pair's shared xin→xout DCN edge, mirroring
+                        # FlowGraph._build — the relaxation (labels
+                        # dropped at the pair vertex) is identical, so
+                        # the native min time IS the Python bound.  No
+                        # contrib entry: cross flow is attributed by the
+                        # caller (LP or transportation re-split), never
+                        # read off these edges.
+                        a = self._slice[node_id]
+                        b = self._slice[dest]
+                        xin = self.idx[_V("xin", node_id=a, layer_id=b)]
+                        xout = self.idx[_V("xout", node_id=a, layer_id=b)]
+                        for u, v in ((cls, xin), (xout, layer)):
+                            if (u, v) not in seen:
+                                seen.add((u, v))
+                                eu.append(u)
+                                ev.append(v)
+                                const.append(_INF)
+                                per_t.append(0)
+                    else:
+                        contrib[(node_id, layer_id, dest)] = len(eu)
+                        eu.append(cls)
+                        ev.append(layer)
+                        const.append(_INF)
+                        per_t.append(0)
+        for a, b in self.x_pairs:
+            eu.append(self.idx[_V("xin", node_id=a, layer_id=b)])
+            ev.append(self.idx[_V("xout", node_id=a, layer_id=b)])
+            const.append(0)
+            per_t.append(self.topology.dcn_bw)
 
         for node_id in sorted(self.assignment):
             receiver = self.idx[_V("receiver", node_id=node_id)]
@@ -103,9 +130,33 @@ class NativeFlowGraph(FlowGraph):
 
         return eu, ev, const, per_t, contrib
 
-    def get_job_assignment(self) -> Tuple[int, FlowJobsMap]:
+    def _relaxed_bound(self, required: int) -> Tuple[int, bool]:
+        """The C++ Dinic search over the (topology-aware) relaxed graph:
+        one C call instead of ~2·log2(t) Python Edmonds–Karp runs.  Does
+        NOT populate ``self.cap`` residuals — callers that decompose
+        flows re-run ``max_flow`` at the returned t (one Python solve at
+        a known time, not a search)."""
         lib = load_flow_solver()
         if lib is None:
+            return super()._relaxed_bound(required)
+        eu, ev, const, per_t, _ = self._edge_list()
+        m = len(eu)
+        achieved = ctypes.c_int64(0)
+        t = lib.flow_min_time_schedule(
+            self.n, m, (ctypes.c_int32 * m)(*eu), (ctypes.c_int32 * m)(*ev),
+            (ctypes.c_int64 * m)(*const), (ctypes.c_int64 * m)(*per_t),
+            self.idx[_V("source")], self.idx[_V("sink")],
+            required, TIME_SCALE, (ctypes.c_int64 * m)(),
+            ctypes.byref(achieved),
+        )
+        return t, achieved.value >= required
+
+    def get_job_assignment(self) -> Tuple[int, FlowJobsMap]:
+        lib = load_flow_solver()
+        if lib is None or self.topology is not None:
+            # Topology planning stays in the parent (LP for exactness,
+            # transportation re-attribution otherwise) — but its relaxed
+            # time searches ride the native solver via _relaxed_bound.
             return super().get_job_assignment()
 
         required = sum(self._pair_size(lid, dest) for lid, dest in self.pairs)
@@ -162,12 +213,11 @@ def make_flow_graph(
 ) -> FlowGraph:
     """The fastest available mode-3 scheduler for this environment.
 
-    A ``PodTopology`` routes to the Python solver: the C++ Dinic search
-    doesn't carry the per-pair DCN vertices or the holdings
-    re-attribution pass (``flow.FlowGraph._attribute_cross``)."""
-    if topology is not None:
-        return FlowGraph(assignment, status, layer_sizes, node_network_bw,
-                         remaining=remaining, topology=topology)
+    With a ``PodTopology``, planning itself stays in the Python solver
+    (the LP carries the holdings structure the relaxed graph drops) but
+    every relaxed time search — the LP's seed bound and the no-scipy
+    fallback's search — runs in the C++ Dinic, which now carries the
+    per-pair DCN ``xin``/``xout`` edges."""
     cls = FlowGraph if load_flow_solver() is None else NativeFlowGraph
     return cls(assignment, status, layer_sizes, node_network_bw,
-               remaining=remaining)
+               remaining=remaining, topology=topology)

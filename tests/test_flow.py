@@ -406,6 +406,73 @@ def test_topology_fallback_without_scipy(monkeypatch):
             assert j.layer_id in {0: {0}, 1: {1}}[sender]
 
 
+@needs_native
+def test_native_topology_matches_python_on_random_instances():
+    """Property test (the round-5 native-topology path): with a
+    PodTopology, the native Dinic relaxed search and the Python one must
+    agree on the minimum completion time, and the full planning paths
+    must emit identical min times with valid, holdings-true tilings."""
+    from distributed_llm_dissemination_tpu.sched.flow import PodTopology
+
+    rng = random.Random(11)
+    for _ in range(20):
+        n_senders = rng.randint(1, 5)
+        n_layers = rng.randint(1, 4)
+        n_slices = rng.randint(2, 3)
+        layer_sizes = {lid: rng.randint(1, 10_000)
+                       for lid in range(n_layers)}
+        status = {}
+        for s in range(n_senders):
+            held = rng.sample(range(n_layers), rng.randint(1, n_layers))
+            status[s] = {lid: _meta(rate=rng.choice([0, 50, 100, 1000]))
+                         for lid in held}
+        for lid in range(n_layers):
+            if not any(lid in held for held in status.values()):
+                status[rng.randrange(n_senders)][lid] = _meta(rate=100)
+        receivers = [100, 101][: rng.randint(1, 2)]
+        assignment = {r: {lid: _meta() for lid in range(n_layers)}
+                      for r in receivers}
+        bw = {i: rng.choice([100, 500, 2000]) for i in status}
+        for r in receivers:
+            bw[r] = rng.choice([100, 500, 2000])
+        slice_of = {i: rng.randrange(n_slices) for i in bw}
+        topo = PodTopology.make(slice_of, dcn_bw=rng.choice([10, 100, 1000]))
+
+        kwargs = dict(assignment=assignment, status=status,
+                      layer_sizes=layer_sizes, node_network_bw=bw,
+                      topology=topo)
+        required = sum(layer_sizes[lid] for r in receivers
+                       for lid in assignment[r])
+        gp = FlowGraph(**kwargs)
+        gn = NativeFlowGraph(**kwargs)
+        tb_py = gp._relaxed_bound(required)
+        tb_nat = gn._relaxed_bound(required)
+        assert tb_py == tb_nat, (tb_py, tb_nat, slice_of)
+
+        t_py, jobs_py = FlowGraph(**kwargs).get_job_assignment()
+        t_nat, jobs_nat = NativeFlowGraph(**kwargs).get_job_assignment()
+        assert t_py == t_nat
+        for jobs in (jobs_py, jobs_nat):
+            # Per (layer, dest): a contiguous non-overlapping tiling of
+            # [0, size) — each dest needs its own full copy.
+            by_pair = {}
+            for js in jobs.values():
+                for j in js:
+                    by_pair.setdefault((j.layer_id, j.dest_id), []).append(j)
+            assert set(by_pair) == {(lid, r) for r in receivers
+                                    for lid in range(n_layers)}
+            for (lid, _r), chunks in by_pair.items():
+                spans = sorted((c.offset, c.offset + c.data_size)
+                               for c in chunks)
+                assert spans[0][0] == 0
+                assert spans[-1][1] == layer_sizes[lid]
+                for (_, e1), (s2, _) in zip(spans, spans[1:]):
+                    assert e1 == s2
+            for sender, js in jobs.items():
+                for j in js:
+                    assert j.layer_id in status[sender]
+
+
 def test_topology_delivered_layer_rate_does_not_leak_into_class_cap():
     """Regression (round-4 review): a DELIVERED (dest-less) layer's
     metadata must not inflate its source class's capacity in either
