@@ -123,6 +123,14 @@ class LayerSrc:
     upload_failed: bool = dataclasses.field(
         default=False, repr=False, compare=False
     )
+    # Zero-copy receive: the transport landed this fragment's bytes
+    # DIRECTLY in the destination's reassembly buffer (TcpTransport
+    # ``layer_sink``).  ``inmem_data`` is then None and this carries the
+    # already-held coverage claim token the fragment handler must commit
+    # — the bytes were never materialized anywhere else.
+    placed_token: object = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def _host_resident(self) -> bool:
         """Host bytes available?  True for INMEM, and for HBM-staged layers

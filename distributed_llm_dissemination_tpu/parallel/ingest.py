@@ -169,6 +169,46 @@ class ShardedLayerIngest:
             self._host = None
             self._pieces = [[] for _ in range(n)]  # (local_off, piece)
 
+    def share_host_buffer(self, buf) -> bool:
+        """Adopt the caller's reassembly buffer as this ingest's (single)
+        span buffer — the zero-copy CPU arm.
+
+        When it succeeds, the caller's own assembly writes ARE the ingest
+        (it reports them via :meth:`mark`), ``write`` is never needed,
+        and ``finalize`` adopts the very same memory as the device array:
+        the layer is staged with zero ingest-side copies.  Only valid on
+        the CPU arm with one span (multi-span tilings place different
+        byte ranges on different devices), with an adoptable buffer, and
+        before any coverage landed.  Idempotent for the same buffer."""
+        if not self._cpu or len(self.spans) != 1:
+            return False
+        with self._lock:
+            if self._closed or self._failed:
+                return False
+            if self._host is not None and self._host[0] is buf:
+                return True
+            if self._cov.committed() or not self._cov.idle():
+                return False  # bytes already landed in the old buffer
+            if not (isinstance(buf, np.ndarray)
+                    and hostmem.is_adoptable(buf)
+                    and buf.nbytes == self.pad):
+                return False
+            self._host = [buf]
+            return True
+
+    def mark(self, offset: int, end: int) -> None:
+        """Record externally-written coverage (shared-buffer mode): the
+        caller already placed ``[offset, end)`` into the shared span
+        buffer; only the coverage accounting remains."""
+        with self._lock:
+            if self._closed:
+                return
+            tok, _ = self._cov.claim(offset, end)
+            if tok is not None:
+                self._cov.commit(tok)
+            if self._cov.idle():
+                self._complete.notify_all()
+
     def write(self, offset: int, data) -> None:
         """Cut ``data`` (at absolute byte ``offset``) against the device
         tiling; move each piece toward its device's span.

@@ -1039,6 +1039,10 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # receiver lock during device work.
         self._ingests: Dict[int, object] = {}
         self._ingests_lock = threading.Lock()
+        # layer -> whether its ingest shares the reassembly buffer
+        # (zero-copy CPU arm); memoized so only the first fragment pays
+        # the share attempt.
+        self._ingest_share: Dict[int, bool] = {}
         # layer -> phase accumulators (first-fragment wall time, summed
         # assembly-copy and ingest-write seconds): the per-layer phase
         # breakdown the completion log emits, so a physical-size run's
@@ -1081,8 +1085,53 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                         ing.write(s, memoryview(buf)[s:e])
                 except Exception as err:  # noqa: BLE001
                     self._ingest_write_failed(lid, ing, err)
+        # Zero-copy receive: let the transport land fragment bytes
+        # straight in the reassembly buffers (TcpTransport.layer_sink).
+        # Registered after super().__init__ — the sink uses locks the
+        # base constructor creates; fragments racing the registration
+        # just take the bounce path.
+        if hasattr(node.transport, "layer_sink"):
+            node.transport.layer_sink = self._layer_sink
         if start_loop:
             self.loop.start()
+
+    def _layer_sink(self, layer_id, total_size, offset, size):
+        """Transport hook: claim the fragment's byte range and expose it
+        as a writable view into the reassembly buffer, so ``recv_into``
+        lands the bytes IN PLACE — socket→assembly in one copy, no
+        bounce buffer, no handler memcpy.  Returns None (bounce path)
+        for duplicates, overlaps, or anything unusual — correctness
+        never depends on the sink engaging."""
+        end = offset + size
+        if size <= 0 or offset < 0 or end > total_size:
+            return None
+        with self._lock:
+            if layer_id in self.layers:
+                return None  # finished layer: bounce path re-acks dups
+            entry = self._partial.get(layer_id)
+            if entry is None:
+                entry = (alloc_recv_buffer(total_size),
+                         intervals.ClaimedCoverage())
+            buf, cov = entry
+            tok, claims = cov.claim(offset, end)
+            if tok is None:
+                return None  # full duplicate
+            if claims != [(offset, end)]:
+                # Partial overlap: a contiguous recv target would clobber
+                # committed bytes — hand it to the claim-splitting path.
+                cov.abort(tok)
+                return None
+            self._partial[layer_id] = entry
+            self._partial_total[layer_id] = total_size
+            # Phase accounting happens at COMMIT time in handle_layer
+            # for both paths — an aborted recv must not skew the
+            # fragment counts or the span the breakdown reports.
+
+        def abort():
+            with self._lock:
+                cov.abort(tok)
+
+        return memoryview(buf)[offset:end], tok, abort
 
     def _get_or_create_ingest(self, layer_id, total_size):
         """The layer's incremental device ingest, created on first use;
@@ -1210,14 +1259,19 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         ing = None
         if not already_done:
             ing = self._get_or_create_ingest(lid, msg.total_size)
+        placed = frag.placed_token is not None
         # Materialize the fragment's bytes BEFORE claiming (one zero-copy
         # view for every consumer below; read_bytes would duplicate the
         # buffer per use): a read failure here must leave no claim behind
         # — a leaked claim wedges the layer forever (no commit can ever
-        # see an empty in-flight set again).
-        raw = (frag.inmem_data if frag.inmem_data is not None
-               else frag.read_bytes())
-        data_mv = memoryview(raw)
+        # see an empty in-flight set again).  A PLACED fragment's bytes
+        # are already in the reassembly buffer (the transport sink
+        # landed them there, claim held) — there is nothing to read.
+        raw = None
+        if not placed:
+            raw = (frag.inmem_data if frag.inmem_data is not None
+                   else frag.read_bytes())
+        data_mv = memoryview(raw) if raw is not None else None
         claims: list = []
         tok = None
         journal = False
@@ -1226,7 +1280,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             if lid in self.layers:
                 # A re-plan duplicate of a finished layer: drop the bytes
                 # but re-ack below — the re-send happened precisely because
-                # the leader never saw our ack.
+                # the leader never saw our ack.  (A placed fragment can't
+                # get here: its in-flight claim blocks completion.)
                 dup_done = True
             else:
                 entry = self._partial.get(lid)
@@ -1240,13 +1295,19 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     entry = (alloc_recv_buffer(msg.total_size),
                              intervals.ClaimedCoverage())
                 buf, cov = entry
-                tok, claims = cov.claim(
-                    frag.offset, frag.offset + frag.data_size)
-                self._partial[lid] = (buf, cov)
-                self._partial_total[lid] = msg.total_size
+                if placed:
+                    # The sink already claimed exactly this range and the
+                    # bytes are in ``buf``; this handler owns the commit.
+                    tok = frag.placed_token
+                    claims = [(frag.offset, frag.offset + frag.data_size)]
+                else:
+                    tok, claims = cov.claim(
+                        frag.offset, frag.offset + frag.data_size)
                 self._phase.setdefault(lid, {
                     "t0": _time.monotonic(), "copy_s": 0.0,
                     "ingest_s": 0.0, "frags": 0})["frags"] += 1
+                self._partial[lid] = (buf, cov)
+                self._partial_total[lid] = msg.total_size
                 # Journaled OUTSIDE the lock below (two fsyncs per
                 # fragment must not serialize every other handler), and
                 # only for fragments that landed NEW bytes — a full
@@ -1261,12 +1322,24 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         if dup_done:
             self._ack_completed(lid)
             return
+        if placed:
+            # The fragment's bytes live in the reassembly buffer; every
+            # consumer below (ingest, journal) reads them from there.
+            data_mv = memoryview(buf)[
+                frag.offset : frag.offset + frag.data_size]
+        # Zero-copy CPU arm: the ingest adopts the reassembly buffer
+        # itself (first fragment pays the attempt; memoized).  The
+        # assembly write then IS the ingest — only coverage accounting
+        # remains (``mark`` below, after the bytes are really in place).
+        shared = False
+        if ing is not None:
+            shared = self._ingest_try_share(lid, ing, buf)
         # Ingest first: on an accelerator this dispatches the async DMA,
         # which then overlaps the host-side assembly copy right below.
-        if ing is not None:
+        if ing is not None and not shared:
             try:
                 t_ing = _time.monotonic()
-                ing.write(frag.offset, raw)
+                ing.write(frag.offset, data_mv)
                 t_ing = _time.monotonic() - t_ing
                 with self._lock:
                     ph = self._phase.get(lid)
@@ -1275,7 +1348,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             except Exception as e:  # noqa: BLE001 — delivery beats staging
                 self._ingest_write_failed(lid, ing, e)
                 ing = None
-        if tok is not None:
+        if tok is not None and not placed:
             try:
                 t_cp = _time.monotonic()
                 for lo, hi in claims:
@@ -1292,6 +1365,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 with self._lock:
                     cov.abort(tok)
                 raise
+        if ing is not None and shared and tok is not None:
+            # Bytes are in the shared buffer now (copied above, or placed
+            # by the transport): record the coverage with the ingest.
+            for lo, hi in claims:
+                ing.mark(lo, hi)
         complete = self._commit_fragment(lid, tok, msg.total_size)
         if journal and not complete:
             # (The completing fragment skips the journal: its completion
@@ -1321,6 +1399,22 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     self._durable.pop(lid, None)
         if complete:
             self._ack_completed(lid)
+
+    def _ingest_try_share(self, lid, ing, buf) -> bool:
+        """Once per layer: try to make the ingest adopt the reassembly
+        buffer (``ShardedLayerIngest.share_host_buffer``).  Memoized —
+        only the first fragment pays the attempt; all later fragments
+        read the cached verdict."""
+        with self._ingests_lock:
+            cached = self._ingest_share.get(lid)
+            if cached is not None:
+                return cached
+            try:
+                ok = bool(ing.share_host_buffer(buf))
+            except Exception:  # noqa: BLE001 — sharing is an optimization
+                ok = False
+            self._ingest_share[lid] = ok
+            return ok
 
     def _commit_fragment(self, lid, tok, total: int) -> bool:
         """Release this fragment's copy claim; promote the layer when
@@ -1372,6 +1466,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         with self._ingests_lock:
             self._ingest_done.add(lid)
             ing = self._ingests.pop(lid, None)
+            self._ingest_share.pop(lid, None)
         loc = self._stage_to_hbm(lid, src, ingest=ing)
         try:
             self.node.transport.send(

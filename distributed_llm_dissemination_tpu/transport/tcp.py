@@ -139,6 +139,15 @@ class TcpTransport(Transport):
         self._pipes: Dict[LayerID, NodeID] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
+        # Zero-copy receive hook (set by a reassembling receiver):
+        # sink(layer_id, total_size, offset, size) -> None, or
+        # (view, token, abort_fn) — a writable memoryview straight into
+        # the destination reassembly buffer, the coverage claim token
+        # the handler will commit, and the rollback for a failed recv.
+        # When it engages, fragment bytes go socket→assembly in ONE
+        # copy (no bounce buffer, no handler memcpy) — the hot path at
+        # physical layer sizes on memory-bandwidth-bound hosts.
+        self.layer_sink = None
 
         host, port = _parse_addr(addr)
         self._listener = socket.create_server((host, port), reuse_port=False)
@@ -194,6 +203,41 @@ class TcpTransport(Transport):
         t0 = time.monotonic()
 
         pipe_sock = self._get_and_unregister_pipe(header.layer_id)
+        placed = None
+        if pipe_sock is None and self.layer_sink is not None:
+            placed = self.layer_sink(header.layer_id, header.total_size,
+                                     header.offset, header.layer_size)
+        if placed is not None:
+            view, token, abort = placed
+            try:
+                got = 0
+                while got < header.layer_size:
+                    r = conn.recv_into(view[got:],
+                                       header.layer_size - got)
+                    if r == 0:
+                        raise ConnectionError("connection closed mid-layer")
+                    got += r
+            except BaseException:
+                abort()  # roll the claim back or the layer wedges forever
+                raise
+            dur_ms = (time.monotonic() - t0) * 1000
+            log.info(
+                "(a fraction of) layer received",
+                layerID=header.layer_id,
+                layer_size=header.layer_size,
+                total_size=header.total_size,
+                duration_ms=round(dur_ms, 3),
+                placed=True,
+            )
+            src = LayerSrc(
+                inmem_data=None, data_size=header.layer_size,
+                offset=header.offset,
+                meta=LayerMeta(location=LayerLocation.INMEM),
+            )
+            src.placed_token = token
+            self._queue.put(LayerMsg(header.src_id, header.layer_id, src,
+                                     header.total_size))
+            return
         buf = alloc_recv_buffer(header.layer_size)
         view = memoryview(buf)
         if pipe_sock is not None:

@@ -13,9 +13,13 @@ handling is unchanged.
 
 from __future__ import annotations
 
-import numpy as np
+from . import hostmem
 
 
-def alloc_recv_buffer(n: int) -> np.ndarray:
-    """An n-byte write-once receive buffer (unzeroed, instant)."""
-    return np.empty(n, np.uint8)
+def alloc_recv_buffer(n: int):
+    """An n-byte write-once receive buffer (unzeroed, instant).
+
+    Aligned (``hostmem.ALIGN``) so a completed reassembly buffer is
+    directly adoptable as a CPU device array — the shared-buffer ingest
+    then stages the layer with ZERO additional copies."""
+    return hostmem.aligned_empty(n)
