@@ -557,10 +557,9 @@ def to_markdown(results: dict) -> str:
         "(gloo on CPU — the absolute number is dominated by per-plan "
         "compile+collective latency, not bandwidth); the dcn_2slice "
         "scenario keeps Mesh.Slices/DcnBW so mode 3 runs the topology-"
-        "aware solve — whose ~0.8 s LP cost dominates that one cell at "
-        "loopback scale (the C++ Dinic fast path has no topology edges; "
-        "at physical layer sizes the solve amortizes into minutes of "
-        "transfer). North-star secondary "
+        "aware solve — attribution-first on the native Dinic (round 5), "
+        "so the common case never touches scipy and the solve costs "
+        "~10 ms cold. North-star secondary "
         "target: mode 1 ≈ mode 0 — note that at loopback-scaled layer "
         "sizes fixed per-transfer overhead (connection setup, protocol "
         "round-trips) dominates both numbers, so ratios within ~1.5x "
@@ -622,6 +621,23 @@ def to_markdown(results: dict) -> str:
             + f" | {phys['achieved_gbps']} GB/s |",
             "",
         ]
+        ph = phys.get("phases")
+        if ph:
+            lines += [
+                "Phase breakdown from the dest's log (thread-time sums; "
+                "concurrent fragment handlers overlap, so sums can "
+                "exceed the TTD wall clock).  Zero copy_ms/ingest_ms = "
+                "the zero-copy receive landed socket bytes directly in "
+                "the reassembly buffer and staging adopted that buffer:",
+                "",
+                "| wire recv | assembly copy | ingest write | stage | "
+                "boot |",
+                "|---|---|---|---|---|",
+                f"| {ph['wire_recv_ms']}ms | {ph['assembly_copy_ms']}ms "
+                f"| {ph['ingest_write_ms']}ms | {ph['stage_ms']}ms | "
+                f"{ph['boot_ms']}ms |",
+                "",
+            ]
     baseline = results.get("baseline_scenarios")
     if baseline:
         lines += [
