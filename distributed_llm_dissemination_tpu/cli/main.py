@@ -16,6 +16,7 @@ client role for that ID, cmd/main.go:69-91).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -311,7 +312,10 @@ def build_spmd_fabric(args, conf: cfg.Config):
         [nc.id for nc in conf.nodes], conf.assignment, mesh,
         conf.mesh.pipeline_axis,
     )
-    fabric = SpmdFabric(placement, args.id)
+    fabric = SpmdFabric(
+        placement, args.id,
+        gap_timeout=float(os.environ.get("DLD_SPMD_GAP_TIMEOUT", "60")),
+    )
     ulog.log.info(
         "spmd fabric up",
         stages={str(n): s for n, s in placement.node_to_stage.items()},

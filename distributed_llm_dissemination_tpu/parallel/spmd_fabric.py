@@ -123,6 +123,13 @@ class SpmdFabric:
         self.placement = placement
         self.my_node = my_node
         self.gap_timeout = gap_timeout
+        # Stall-recovery hook (set by the owning node): called with the
+        # ascending list of MISSING seqs each time the executor sits a
+        # full gap_timeout on a hole — the node reports them to the
+        # leader (PlanResendReqMsg) so the lockstep self-heals instead
+        # of relying on a human reading "stalled" logs.  Rate-limited
+        # naturally: one call per gap_timeout window.
+        self.on_gap = None
         self._layers = None
         self._layers_lock: Optional[threading.Lock] = None
         self._lock = threading.Lock()
@@ -254,13 +261,25 @@ class SpmdFabric:
                     self._retire_oldest(inflight)
                 elif stalled_on:
                     # Later seqs queued behind a gap: the pod-wide
-                    # lockstep is stalled.  Only the control plane can
-                    # fix this; make it loud.
+                    # lockstep is stalled.  Make it loud AND ask the
+                    # control plane to heal it (on_gap → the leader
+                    # re-sends its retained plan, or cancels the seq).
+                    missing = sorted(
+                        set(range(self._next_seq, max(stalled_on) + 1))
+                        - set(stalled_on)
+                    )
                     log.error(
                         "spmd fabric stalled waiting for plan seq",
                         next_seq=self._next_seq,
                         queued=stalled_on,
+                        missing=missing,
                     )
+                    hook = self.on_gap
+                    if hook is not None and missing:
+                        try:
+                            hook(missing)
+                        except Exception as e:  # noqa: BLE001 — advisory
+                            log.error("on_gap hook failed", err=repr(e))
                 continue
             try:
                 value, out = self._execute(msg)

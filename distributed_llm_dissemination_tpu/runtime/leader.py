@@ -56,6 +56,7 @@ from ..transport.messages import (
     GenerateRespMsg,
     HeartbeatMsg,
     LayerMsg,
+    PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
     StartupMsg,
@@ -126,6 +127,10 @@ class LeaderNode:
         self.fabric = fabric
         self.placement = placement
         self._plan_seq = itertools.count()
+        # seq -> the operative DevicePlanMsg broadcast for it (plan, or
+        # the cancel that superseded it): the re-send store for SPMD
+        # gap recovery (handle_plan_resend).  Insertion-ordered, bounded.
+        self._sent_plans: Dict[int, DevicePlanMsg] = {}
         self.expected_nodes = set(expected_nodes or ())
         self.status: Status = {}
         self._lock = threading.Lock()
@@ -186,6 +191,11 @@ class LeaderNode:
             self.loop.start()
             self.detector.start()
 
+    # How many broadcast plans the leader retains for gap re-sends; a
+    # goal's plan count is bounded by its (layer, dest) pairs, so this
+    # comfortably covers any in-flight window while bounding memory.
+    SENT_PLAN_RETENTION = 4096
+
     def _register_handlers(self) -> None:
         self.loop.register(AnnounceMsg, self.handle_announce)
         self.loop.register(AckMsg, self.handle_ack)
@@ -196,6 +206,7 @@ class LeaderNode:
         self.loop.register(BootReadyMsg, self.handle_boot_ready)
         self.loop.register(DevicePlanMsg, self.handle_device_plan)
         self.loop.register(GenerateReqMsg, self.handle_generate_req)
+        self.loop.register(PlanResendReqMsg, self.handle_plan_resend)
 
     def handle_generate_req(self, msg: GenerateReqMsg) -> None:
         """The leader seat serves no model — refuse immediately so a
@@ -732,11 +743,16 @@ class LeaderNode:
         self-delivery short-circuit) must receive every plan — all of them
         enter the collective.  On any send failure the seq must still be
         consumed everywhere, so a best-effort CANCELLATION (empty layout,
-        same seq) follows; a process missing both stalls the fabric and
-        logs loudly (``parallel/spmd_fabric.py``)."""
+        same seq) follows.  Either way, the OPERATIVE message for the seq
+        is retained (``_sent_plans``) so a process that missed its copy
+        can ask for a re-send (``handle_plan_resend``) instead of
+        stalling the pod until a human reads the logs."""
         with self._lock:
             recipients = sorted(set(self.status)
                                 | {msg.dest_id, self.node.my_id})
+            self._sent_plans[msg.seq] = msg
+            while len(self._sent_plans) > self.SENT_PLAN_RETENTION:
+                self._sent_plans.pop(next(iter(self._sent_plans)))
         failed = []
         for r in recipients:
             try:
@@ -749,14 +765,41 @@ class LeaderNode:
             return True
         cancel = DevicePlanMsg(self.node.my_id, msg.plan_id, msg.layer_id,
                                msg.dest_id, 0, [], seq=msg.seq)
+        with self._lock:
+            # The cancel supersedes the plan for this seq: a late
+            # re-send of the ORIGINAL would have the gap process enter a
+            # collective its peers already skipped.
+            self._sent_plans[msg.seq] = cancel
         for r in recipients:
             try:
                 self.node.transport.send(r, cancel)
             except (OSError, KeyError) as e:
-                log.error("spmd plan cancel undeliverable; fabric may "
-                          "stall until the node is declared crashed",
+                log.error("spmd plan cancel undeliverable; the gap "
+                          "process will request a re-send",
                           plan=msg.plan_id, dest=r, err=repr(e))
         return False
+
+    def handle_plan_resend(self, msg) -> None:
+        """A fabric process's executor is stalled on missing plan seqs:
+        re-send the retained message for each (the plan, or the cancel
+        that superseded it).  An unknown seq gets a fresh CANCELLATION —
+        advancing the requester past the hole is always safe, because a
+        plan the leader no longer knows is one whose outcome the goal
+        no longer depends on (its dest either acked or re-announced)."""
+        with self._lock:
+            stored = {s: self._sent_plans.get(s) for s in msg.seqs}
+        for seq, plan in sorted(stored.items()):
+            if plan is None:
+                plan = DevicePlanMsg(self.node.my_id, f"cancel.{seq}",
+                                     0, msg.src_id, 0, [], seq=seq)
+            try:
+                self.node.transport.send(msg.src_id, plan)
+                log.info("re-sent spmd plan after gap report",
+                         seq=seq, dest=msg.src_id,
+                         cancelled=not plan.layout)
+            except (OSError, KeyError) as e:
+                log.error("plan re-send failed", seq=seq,
+                          dest=msg.src_id, err=repr(e))
 
     def _try_fabric_full_layer(
         self, layer_id: LayerID, sender: NodeID, dest: NodeID

@@ -43,6 +43,7 @@ from ..transport.messages import (
     GenerateReqMsg,
     GenerateRespMsg,
     LayerMsg,
+    PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
     StartupMsg,
@@ -196,6 +197,13 @@ class ReceiverNode:
             # SPMD fabric: the executor reads this node's own byte ranges
             # straight from the layer store when serving plans.
             fabric.bind_store(self.layers, self._lock)
+        if self._spmd:
+            # Stall self-healing: a persistent seq gap (a DevicePlanMsg
+            # this process never received) reports the missing seqs to
+            # the leader, which re-sends its retained plan — or cancels
+            # the seq — so the pod lockstep never waits forever on one
+            # lost control message.
+            fabric.on_gap = self._report_plan_gap
         # layer -> Event: staging-in-progress marker so a re-plan duplicate
         # completing concurrently never double-stages a multi-GB layer
         # (check-and-mark happens under self._lock; the duplicate waits).
@@ -374,12 +382,46 @@ class ReceiverNode:
                 target=self._receive_device_plan, args=(msg,), daemon=True
             ).start()
 
+    def _report_plan_gap(self, missing) -> None:
+        """SpmdFabric ``on_gap`` hook: ask the leader to re-send the
+        plans this process never received (executor thread; one call per
+        gap_timeout window)."""
+        log.warn("requesting re-send of missing spmd plans",
+                 seqs=list(missing))
+        try:
+            self.node.transport.send(
+                self.node.leader_id,
+                PlanResendReqMsg(self.node.my_id, list(missing)),
+            )
+        except (OSError, KeyError) as e:
+            log.error("plan re-send request failed", err=repr(e))
+
+    # Fault injection (tests): comma-separated plan seqs whose FIRST
+    # delivery this process drops — the lost-control-message scenario
+    # the gap recovery exists for.  Parsed lazily from the env.
+    _drop_seqs = None
+
+    def _should_drop_plan(self, msg) -> bool:
+        if self._drop_seqs is None:
+            import os
+
+            raw = os.environ.get("DLD_TEST_DROP_PLAN_SEQS", "")
+            self._drop_seqs = {int(s) for s in raw.split(",") if s.strip()}
+        if msg.seq in self._drop_seqs:
+            self._drop_seqs = self._drop_seqs - {msg.seq}
+            log.warn("TEST fault injection: dropping spmd plan",
+                     seq=msg.seq, plan=msg.plan_id)
+            return True
+        return False
+
     def _handle_spmd_plan(self, msg: DevicePlanMsg) -> None:
         """Multi-controller fabric (``parallel/spmd_fabric.py``): enqueue
         the plan on this process's lockstep executor; when it is addressed
         to me, await the collective's result on a dedicated thread (the
         handler pool must stay free to enqueue later plans — the executor
         can only reach mine after running everything before it)."""
+        if self._should_drop_plan(msg):
+            return
         try:
             res = self.fabric.submit(msg)
         except Exception as e:  # noqa: BLE001 — closed/duplicate races

@@ -56,6 +56,12 @@ class MsgType(enum.IntEnum):
     # sends prompt token ids, the booted node decodes with its RESIDENT
     # params and answers — the startup hook's engine, actually servable
     # over the same transport that delivered its weights.
+    # PLAN_RESEND_REQ — SPMD-fabric self-healing: a process whose
+    # executor detects a persistent seq gap (it never received some
+    # DevicePlanMsg; later plans queue behind the hole, stalling the
+    # pod lockstep) asks the leader for the missing seqs.  The leader
+    # re-sends its retained copy — or a cancellation when it has none —
+    # so no transfer waits forever on one lost control message.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -63,6 +69,7 @@ class MsgType(enum.IntEnum):
     BOOT_HINT = 12
     GENERATE_REQ = 13
     GENERATE_RESP = 14
+    PLAN_RESEND_REQ = 15
 
 
 @dataclasses.dataclass
@@ -502,6 +509,24 @@ class DevicePlanMsg:
         )
 
 
+@dataclasses.dataclass
+class PlanResendReqMsg:
+    """Fabric process → leader: my SPMD executor is stalled on a seq gap
+    — re-send (or cancel) these plan seqs.  See MsgType.PLAN_RESEND_REQ."""
+
+    src_id: NodeID
+    seqs: list  # missing plan sequence numbers, ascending
+
+    msg_type = MsgType.PLAN_RESEND_REQ
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "Seqs": [int(s) for s in self.seqs]}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "PlanResendReqMsg":
+        return cls(int(d["SrcID"]), [int(s) for s in d.get("Seqs") or []])
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -515,6 +540,7 @@ Message = Union[
     BootReadyMsg,
     DevicePlanMsg,
     ServeMsg,
+    PlanResendReqMsg,
 ]
 
 _DECODERS = {
@@ -532,6 +558,7 @@ _DECODERS = {
     MsgType.BOOT_HINT: BootHintMsg,
     MsgType.GENERATE_REQ: GenerateReqMsg,
     MsgType.GENERATE_RESP: GenerateRespMsg,
+    MsgType.PLAN_RESEND_REQ: PlanResendReqMsg,
 }
 
 

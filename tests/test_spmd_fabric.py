@@ -237,6 +237,88 @@ def test_layout_total_mismatch_fails_the_plan(placement):
         fab.close()
 
 
+def test_executor_gap_reports_missing_seqs(placement, monkeypatch):
+    """A hole in the seq stream (a plan this process never received,
+    with later plans queued behind it) fires the on_gap hook with the
+    missing seqs — the leader-report half of the stall recovery."""
+    fab = SpmdFabric(placement, my_node=0, gap_timeout=0.2)
+    reports = []
+    fab.on_gap = reports.append
+    try:
+        # seqs 1 and 3 arrive; 0 and 2 never do.
+        fab.submit(_plan(1, []))  # cancellations: no device work needed
+        fab.submit(_plan(3, []))
+        deadline = time.monotonic() + 10.0
+        while not reports and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert reports and reports[0] == [0, 2], reports
+        # Healing the first hole advances past seq 1; the next report
+        # names only the remaining hole.
+        fab.submit(_plan(0, []))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(r == [2] for r in reports):
+                break
+            time.sleep(0.02)
+        assert any(r == [2] for r in reports), reports
+    finally:
+        fab.close()
+
+
+def test_leader_resends_retained_plan_on_gap_report():
+    """handle_plan_resend: a known seq re-sends the retained plan to the
+    requester; an unknown seq gets a cancellation so the requester can
+    advance past the hole either way."""
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        PlanResendReqMsg,
+    )
+
+    leader, t0 = _leader_with_spmd()
+    t1 = InmemTransport("1")
+    try:
+        plan = _plan(5, [(0, 0, 100)], dest=1)
+        with leader._lock:
+            leader._sent_plans[5] = plan
+        leader.handle_plan_resend(PlanResendReqMsg(1, [5, 99]))
+        got = [t1.deliver().get(timeout=5.0) for _ in range(2)]
+        by_seq = {m.seq: m for m in got}
+        assert set(by_seq) == {5, 99}
+        assert by_seq[5].plan_id == plan.plan_id
+        assert by_seq[5].layout == [(0, 0, 100)]
+        assert by_seq[99].layout == []  # unknown: cancellation
+    finally:
+        leader.close()
+        t0.close()
+        t1.close()
+
+
+def test_broadcast_retains_operative_message_per_seq():
+    """The re-send store must hold the plan normally, and the CANCEL
+    when the broadcast partially failed (re-sending the original after
+    peers skipped the seq would wedge the requester in a collective)."""
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    leader, t0 = _leader_with_spmd()
+    peers = [InmemTransport(str(i)) for i in (1, 2)]
+    try:
+        ok = leader._broadcast_spmd_plan(_plan(0, [(0, 0, 10)], dest=1))
+        assert ok
+        assert leader._sent_plans[0].layout == [(0, 0, 10)]
+
+        # Unsendable participant (no registered transport for node 9):
+        # broadcast fails, cancel supersedes.
+        leader.status[9] = dict(leader.status[1])
+        ok = leader._broadcast_spmd_plan(_plan(1, [(9, 0, 10)], dest=1))
+        assert not ok
+        assert leader._sent_plans[1].layout == []
+    finally:
+        leader.close()
+        t0.close()
+        for t in peers:
+            t.close()
+
+
 # ---------------------------------------------------------- 2-process e2e
 
 
@@ -300,6 +382,56 @@ def test_two_process_spmd_fabric_dissemination(mode):
     # Zero layer bytes on the wire: the TCP data plane never ran.
     assert "layer received" not in recv_err
     assert "dispatching device plan" in lead_err
+
+
+def test_two_process_spmd_heals_dropped_plan():
+    """VERDICT r4 ask#7 e2e: one participant's DevicePlanMsg is dropped
+    (fault injection) — the executor detects the seq gap, reports it,
+    the leader re-sends its retained plan, and the run still reaches
+    ready() with the layers over the FABRIC (not the host path)."""
+    conf = _spmd_conf(3, layers=3)
+    conf_path = os.path.join(REPO, ".pytest-spmd-heal.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["DLD_SPMD_GAP_TIMEOUT"] = "1.5"
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "3"]
+    recv = lead = None
+    try:
+        recv_env = dict(env)
+        # The receiver process drops its FIRST delivery of plan seq 0;
+        # seqs 1-2 queue behind the hole.
+        recv_env["DLD_TEST_DROP_PLAN_SEQS"] = "0"
+        recv = subprocess.Popen(cli + ["-id", "1"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=recv_env,
+                                text=True)
+        lead = subprocess.Popen(cli + ["-id", "0"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env, text=True)
+        lead_out, lead_err = lead.communicate(timeout=240)
+        recv_out, recv_err = recv.communicate(timeout=60)
+        assert lead.returncode == 0, f"leader failed:\n{lead_err[-3000:]}"
+        assert recv.returncode == 0, f"receiver failed:\n{recv_err[-3000:]}"
+        assert "Time to deliver" in lead_out
+        assert "ready" in recv_out
+        # The fault actually fired, the gap was detected and reported,
+        # and the leader healed it.
+        assert "fault injection: dropping spmd plan" in recv_err
+        assert "requesting re-send of missing spmd plans" in recv_err
+        assert "re-sent spmd plan after gap report" in lead_err
+        # Delivery still rode the device fabric — zero TCP layer bytes.
+        assert "layer landed over device fabric" in recv_err
+        assert "layer received" not in recv_err
+    finally:
+        for p in (recv, lead):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if os.path.exists(conf_path):
+            os.remove(conf_path)
 
 
 def test_two_process_spmd_int8_boot():
