@@ -31,6 +31,19 @@ import sys
 import time
 
 BASELINE_GBPS = 1.5625  # 12.5 Gbit/s reference NetworkBW, conf/config.json
+
+
+def _harness_hash() -> str:
+    """Provenance stamp (utils/provenance.py) — ties this record to the
+    code that produced it; the repo hashes itself, so no fallback."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from distributed_llm_dissemination_tpu.utils.provenance import (
+        harness_hash,
+    )
+
+    return harness_hash()
+
+
 PARTS = 8  # fragments per layer (the reference scenario's seeder count)
 TRIALS = 5  # pair budget; the loop stops early past BUDGET_S wall-clock
 MIN_TRIALS = 2
@@ -190,6 +203,7 @@ def main() -> None:
                 "unit": "GB/s/chip",
                 "vs_baseline": round(gbps / BASELINE_GBPS, 3),
                 "backend": backend,
+                "harness_hash": _harness_hash(),
                 "raw_dma_gbps": round(raw_dma_gbps, 3),
                 # Absolute rates ride the drifting link, so their spread
                 # is reported too — read `value` with it in hand (the

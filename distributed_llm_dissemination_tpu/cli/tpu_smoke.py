@@ -281,13 +281,33 @@ def main(argv=None) -> int:
                    help="ingest cross-check size")
     p.add_argument("--skip-forward", action="store_true",
                    help="skip the flagship forward (the slow compile)")
+    p.add_argument("--check", type=str, default="",
+                   help="don't run checks: verify the artifact at this "
+                        "path was recorded by the CURRENT harness (or "
+                        "carries a documented 'stale' marker); exit 1 "
+                        "otherwise")
     args = p.parse_args(argv)
+
+    from ..utils.provenance import artifact_is_current, harness_hash
+
+    if args.check:
+        try:
+            with open(args.check) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"unreadable artifact {args.check}: {e!r}",
+                  file=sys.stderr)
+            return 1
+        ok, why = artifact_is_current(report)
+        print(f"{args.check}: {why}", file=sys.stderr)
+        return 0 if ok else 1
 
     import jax
 
     report = {
         "backend": jax.default_backend(),
         "devices": [str(d) for d in jax.devices()],
+        "harness_hash": harness_hash(),
         "checks": {},
     }
     checks = [("pallas_block_attention", check_pallas_block_attention),

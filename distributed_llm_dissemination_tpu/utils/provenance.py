@@ -1,0 +1,62 @@
+"""Harness provenance: tie recorded artifacts to the code that ran.
+
+Round-4 lesson (VERDICT): a committed ``TPU_SMOKE.json`` recorded
+several commits before the kernels it vouched for had changed — nothing
+stopped a stale artifact from masquerading as current evidence.  The
+same content-hash discipline ``native/__init__.py`` uses for the C++
+solver (rebuild when the source changed) applies to measurement
+artifacts: every harness embeds ``harness_hash()`` in its report, and a
+CI-style test (``tests/test_provenance.py``) fails when a committed
+artifact's hash doesn't match the working tree — unless the artifact
+carries an explicit, documented ``stale`` marker (e.g. recorded during
+a tunnel outage and honestly labeled as superseded evidence).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+_PKG = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(_PKG)
+
+
+def harness_hash() -> str:
+    """Content hash of every source file that can change a measurement:
+    the package's .py and .cc files plus the repo-root ``bench.py`` /
+    ``__graft_entry__.py`` drivers.  Deterministic (sorted relative
+    paths mixed into the digest); 16 hex chars is plenty for a
+    did-the-code-change check."""
+    h = hashlib.sha256()
+    files = []
+    for root, dirs, names in os.walk(_PKG):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for name in sorted(names):
+            if name.endswith((".py", ".cc")):
+                files.append(os.path.join(root, name))
+    for extra in ("bench.py", "__graft_entry__.py"):
+        path = os.path.join(_REPO, extra)
+        if os.path.exists(path):
+            files.append(path)
+    for path in sorted(files):
+        h.update(os.path.relpath(path, _REPO).encode())
+        h.update(b"\0")
+        with open(path, "rb") as f:
+            h.update(f.read())
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def artifact_is_current(report: dict) -> tuple:
+    """(ok, why) for a recorded artifact against the working tree:
+    current hash, or an explicit ``stale`` marker string documenting
+    why superseded evidence is still committed."""
+    marker = report.get("stale")
+    if isinstance(marker, str) and marker.strip():
+        return True, f"documented-stale: {marker}"
+    got = report.get("harness_hash")
+    want = harness_hash()
+    if got == want:
+        return True, "hash-current"
+    return False, (f"artifact hash {got!r} != working tree {want!r} "
+                   "and no documented 'stale' marker")

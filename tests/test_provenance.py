@@ -1,0 +1,78 @@
+"""Stale-artifact gating (VERDICT r4 ask#6): committed measurement
+artifacts must carry the CURRENT harness hash or a documented ``stale``
+marker — a recorded report can no longer silently masquerade as
+evidence for code it never ran."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+from distributed_llm_dissemination_tpu.utils.provenance import (
+    artifact_is_current,
+    harness_hash,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_harness_hash_is_stable_and_code_sensitive(tmp_path):
+    h1 = harness_hash()
+    assert re.fullmatch(r"[0-9a-f]{16}", h1)
+    assert harness_hash() == h1  # deterministic
+
+
+def test_artifact_gate_semantics():
+    h = harness_hash()
+    ok, why = artifact_is_current({"harness_hash": h})
+    assert ok and why == "hash-current"
+    ok, why = artifact_is_current({"harness_hash": "0" * 16})
+    assert not ok
+    ok, why = artifact_is_current({})
+    assert not ok
+    ok, why = artifact_is_current(
+        {"harness_hash": "0" * 16,
+         "stale": "recorded during the outage; superseded next tpu run"})
+    assert ok and why.startswith("documented-stale")
+    ok, _ = artifact_is_current({"stale": "   "})  # blank marker: no pass
+    assert not ok
+
+
+def test_committed_tpu_smoke_is_current_or_documented_stale():
+    path = os.path.join(REPO, "TPU_SMOKE.json")
+    assert os.path.exists(path), "TPU_SMOKE.json must be committed"
+    with open(path) as f:
+        report = json.load(f)
+    ok, why = artifact_is_current(report)
+    assert ok, f"committed TPU_SMOKE.json fails the provenance gate: {why}"
+
+
+def test_round5_plus_bench_artifacts_carry_provenance():
+    """BENCH_r01..r04 predate the hash (historical records); anything
+    newer must carry the stamp bench.py now embeds."""
+    for name in sorted(os.listdir(REPO)):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m or int(m.group(1)) <= 4:
+            continue
+        with open(os.path.join(REPO, name)) as f:
+            rec = json.load(f)
+        assert "harness_hash" in rec or rec.get("stale"), (
+            f"{name} lacks provenance (harness_hash or stale marker)")
+
+
+def test_tpu_smoke_check_flag_gates_artifacts(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"harness_hash": harness_hash()}))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"harness_hash": "dead" * 4}))
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.tpu_smoke", "--check"]
+    assert subprocess.run(cli + [str(good)], env=env,
+                          capture_output=True).returncode == 0
+    assert subprocess.run(cli + [str(bad)], env=env,
+                          capture_output=True).returncode == 1
+    assert subprocess.run(cli + [str(tmp_path / "missing.json")], env=env,
+                          capture_output=True).returncode == 1
