@@ -68,6 +68,14 @@ class MeshConf:
     # models only flat per-node NICs (flow.go:221-270).  Empty = one slice.
     slices: Dict[int, int] = dataclasses.field(default_factory=dict)
     dcn_bw: int = 0  # bytes/s per ordered slice pair; 0 = no DCN modeling
+    # Per-slice torus interior (SURVEY §7 hard part): each slice's
+    # members (sorted by id, row-major) sit on a torus of this shape,
+    # and every directed torus link carries IciLinkBW bytes/s.  The
+    # mode-3 solver then budgets each intra-slice transfer's
+    # dimension-ordered route per LINK — multi-sender plans spread
+    # across links, not just nodes.  Empty shape / 0 = unmodeled.
+    slice_shape: List[int] = dataclasses.field(default_factory=list)
+    ici_link_bw: int = 0
 
     @classmethod
     def from_json(cls, d: dict) -> "MeshConf":
@@ -80,16 +88,23 @@ class MeshConf:
             slices={int(k): int(v)
                     for k, v in (_jget(d, "Slices", {}) or {}).items()},
             dcn_bw=int(_jget(d, "DcnBW", 0)),
+            slice_shape=[int(s) for s in _jget(d, "SliceShape", []) or []],
+            ici_link_bw=int(_jget(d, "IciLinkBW", 0)),
         )
 
     def topology(self):
-        """The solver-facing ``PodTopology`` (None when single-slice or
-        DCN-unmodeled)."""
-        if not self.slices or self.dcn_bw <= 0:
+        """The solver-facing ``PodTopology`` (None when nothing beyond
+        flat per-node rates is modeled: no DCN pairs AND no torus)."""
+        if not self.slices:
+            return None
+        torus = bool(self.slice_shape) and self.ici_link_bw > 0
+        if self.dcn_bw <= 0 and not torus:
             return None
         from ..sched.flow import PodTopology
 
-        return PodTopology.make(self.slices, self.dcn_bw)
+        return PodTopology.make(self.slices, self.dcn_bw,
+                                slice_shape=self.slice_shape,
+                                ici_link_bw=self.ici_link_bw)
 
 
 @dataclasses.dataclass

@@ -77,17 +77,100 @@ class PodTopology:
     v5e-32 slice; nodes of one slice exchange bytes over ICI).
     ``dcn_bw``: bytes/s available to EACH ordered slice pair over the
     data-center network (the thin path the solver must route around).
-    Per-node rates (NIC or ``Mesh.IciBW``) still cap the endpoints."""
+    Per-node rates (NIC or ``Mesh.IciBW``) still cap the endpoints.
+
+    ``slice_shape`` + ``ici_link_bw`` (SURVEY §7 hard part, round 5):
+    model each slice's INTERIOR as a torus of per-link capacities
+    instead of one scalar per-node rate.  A slice's members (sorted by
+    node id) occupy torus coordinates row-major into ``slice_shape``;
+    an intra-slice transfer consumes ``ici_link_bw`` capacity on every
+    directed link of its dimension-ordered (shorter-wrap, ties upward)
+    route.  The exact LP carries one bundle constraint per directed
+    link, so a plan provably spreads across links — a sender whose
+    route shares a hot link gets fewer bytes (the reference's flat
+    model, flow.go:221-270, cannot see this).  Cross-slice arcs are
+    capped by the DCN pair edge only (their intra-slice hops to the
+    egress are not modeled)."""
 
     slice_of: Tuple[Tuple[NodeID, int], ...]  # sorted (node, slice) pairs
     dcn_bw: int
+    slice_shape: Tuple[int, ...] = ()
+    ici_link_bw: int = 0
 
     @classmethod
-    def make(cls, slice_of: Dict[NodeID, int], dcn_bw: int) -> "PodTopology":
-        return cls(tuple(sorted(slice_of.items())), dcn_bw)
+    def make(cls, slice_of: Dict[NodeID, int], dcn_bw: int,
+             slice_shape=(), ici_link_bw: int = 0) -> "PodTopology":
+        topo = cls(tuple(sorted(slice_of.items())), dcn_bw,
+                   tuple(int(s) for s in slice_shape), int(ici_link_bw))
+        if topo.torus_modeled():
+            cells = 1
+            for s in topo.slice_shape:
+                if s <= 0:
+                    raise ValueError(f"bad slice_shape {topo.slice_shape}")
+                cells *= s
+            counts: Dict[int, int] = {}
+            for _, sl in topo.slice_of:
+                counts[sl] = counts.get(sl, 0) + 1
+            for sl, n in counts.items():
+                if n > cells:
+                    raise ValueError(
+                        f"slice {sl} has {n} nodes but slice_shape "
+                        f"{topo.slice_shape} holds only {cells}")
+        return topo
 
     def slices(self) -> Dict[NodeID, int]:
         return dict(self.slice_of)
+
+    def torus_modeled(self) -> bool:
+        return bool(self.slice_shape) and self.ici_link_bw > 0
+
+    def _coord(self, node: NodeID) -> Tuple[int, Tuple[int, ...]]:
+        """(slice, torus coordinates) of a node: its rank among the
+        slice's sorted members, row-major into ``slice_shape``."""
+        by_slice: Dict[int, List[NodeID]] = {}
+        for n, sl in self.slice_of:
+            by_slice.setdefault(sl, []).append(n)
+        sl = dict(self.slice_of)[node]
+        rank = by_slice[sl].index(node)  # slice_of is sorted
+        coord = []
+        for dim in reversed(self.slice_shape):
+            coord.append(rank % dim)
+            rank //= dim
+        return sl, tuple(reversed(coord))
+
+    def ici_path(self, sender: NodeID, dest: NodeID) -> Tuple:
+        """Directed torus links of the sender→dest route (dimension
+        order; per dimension the shorter wrap direction, ties upward).
+        Link key: ``(slice, from_flat, to_flat)``.  Empty when the
+        torus isn't modeled, endpoints differ in slice, either endpoint
+        is unmapped, or sender == dest."""
+        if not self.torus_modeled() or sender == dest:
+            return ()
+        mapping = dict(self.slice_of)
+        if sender not in mapping or dest not in mapping:
+            return ()
+        sl_a, a = self._coord(sender)
+        sl_b, b = self._coord(dest)
+        if sl_a != sl_b:
+            return ()
+
+        def flat(c: Tuple[int, ...]) -> int:
+            out = 0
+            for v, dim in zip(c, self.slice_shape):
+                out = out * dim + v
+            return out
+
+        links = []
+        cur = list(a)
+        for axis, dim in enumerate(self.slice_shape):
+            delta = (b[axis] - cur[axis]) % dim
+            step = 1 if delta * 2 <= dim else -1
+            hops = delta if step == 1 else dim - delta
+            for _ in range(hops):
+                frm = flat(tuple(cur))
+                cur[axis] = (cur[axis] + step) % dim
+                links.append((sl_a, frm, flat(tuple(cur))))
+        return tuple(links)
 
 
 @dataclasses.dataclass
@@ -266,6 +349,7 @@ class FlowGraph:
         self._slice: Dict[NodeID, int] = (
             topology.slices() if topology is not None else {}
         )
+        self._torus = (topology is not None and topology.torus_modeled())
 
         # (layer, dest) pairs to deliver; dests_of inverts them so sender
         # edges can fan a held layer out to every receiver that wants it.
@@ -527,6 +611,13 @@ class FlowGraph:
             if self._cross(s, d):
                 groups.setdefault(
                     ("dcn", self._slice[s], self._slice[d]), []).append(i)
+            elif self._torus and s != d:
+                # Intra-slice: the arc consumes capacity on EVERY
+                # directed torus link of its DOR route — one bundle
+                # row per link, so arcs sharing a hot link share its
+                # budget and the optimum spreads across links.
+                for link in self.topology.ici_path(s, d):
+                    groups.setdefault(("ici",) + link, []).append(i)
         rows, cols, caps = [], [], []
         for r, (key, idxs) in enumerate(sorted(groups.items())):
             kind = key[0]
@@ -547,6 +638,8 @@ class FlowGraph:
                 cap = self.node_network_bw.get(key[1], 0) * t // TIME_SCALE
             elif kind == "pair":
                 cap = self._pair_size(key[1], key[2])
+            elif kind == "ici":
+                cap = self.topology.ici_link_bw * t // TIME_SCALE
             else:  # dcn
                 cap = self.topology.dcn_bw * t // TIME_SCALE
             for i in idxs:
@@ -678,7 +771,13 @@ class FlowGraph:
         needed.  The LP runs only when attribution fails (adversarial
         holdings), which keeps scipy's ~2 s one-time initialization off
         the common path entirely (it still warms in the background,
-        ``warm_lp``)."""
+        ``warm_lp``).
+
+        EXCEPT when per-link torus ICI is modeled: the relaxation (and
+        attribution) know nothing of link bundles, so a successful
+        attribution no longer implies feasibility — those instances go
+        straight to the LP, seeded by the relaxed bound.  Without scipy,
+        link constraints degrade (loudly) to the per-node model."""
         required = sum(self._pair_size(lid, dest) for lid, dest in self.pairs)
 
         # Pure max-flow feasibility only: it is monotone in t (capacities
@@ -690,6 +789,12 @@ class FlowGraph:
             # Undeliverable pair(s): decompose the partial flow at the
             # search ceiling — every deliverable byte still schedules.
             log.error("t_upper not found")
+
+        if self._torus and ok:
+            if _have_lp():
+                return self._lp_job_assignment(seed=t)
+            log.warn("torus ICI links configured but scipy is "
+                     "unavailable; planning without per-link constraints")
 
         self.max_flow(t)  # leave residuals for decomposition
         cross = self._attribute_cross() if self.x_pairs else {}

@@ -105,6 +105,32 @@ def test_assignment_json_roundtrip():
     assert set(back[7]) == set(range(8))
 
 
+def test_mesh_torus_fields_parse_and_build_topology():
+    from distributed_llm_dissemination_tpu.core.config import Config
+
+    conf = Config.from_json({
+        "Nodes": [{"Id": i, "Addr": f"a:{i}"} for i in range(4)],
+        "LayerSize": 8,
+        "Mesh": {"AxisNames": ["nodes"], "AxisSizes": [4],
+                 "Slices": {"0": 0, "1": 0, "2": 0, "3": 0},
+                 "SliceShape": [4], "IciLinkBW": 45_000_000_000},
+    })
+    assert conf.mesh.slice_shape == [4]
+    topo = conf.mesh.topology()
+    assert topo is not None and topo.torus_modeled()
+    assert topo.ici_link_bw == 45_000_000_000
+    assert topo.ici_path(0, 2) == ((0, 0, 1), (0, 1, 2))
+    # Torus alone (no DcnBW) is enough to model; neither is none.
+    conf.mesh.slice_shape = []
+    assert conf.mesh.topology() is None
+    # The shipped example parses into a torus-modeled topology.
+    shipped = read_json(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "conf", "tpu_2slice_torus.json"))
+    st = shipped.mesh.topology()
+    assert st is not None and st.torus_modeled() and st.dcn_bw > 0
+
+
 REFERENCE_CONFIG = "/root/reference/conf/config.json"
 
 

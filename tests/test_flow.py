@@ -406,6 +406,83 @@ def test_topology_fallback_without_scipy(monkeypatch):
             assert j.layer_id in {0: {0}, 1: {1}}[sender]
 
 
+def test_torus_path_dimension_ordered_shorter_wrap():
+    from distributed_llm_dissemination_tpu.sched.flow import PodTopology
+
+    # Ring of 4 (one slice): 0..3 at coords 0..3.
+    topo = PodTopology.make({0: 0, 1: 0, 2: 0, 3: 0}, dcn_bw=0,
+                            slice_shape=[4], ici_link_bw=10)
+    assert topo.ici_path(1, 2) == ((0, 1, 2),)
+    assert topo.ici_path(3, 2) == ((0, 3, 2),)  # shorter wrap: downward
+    # Distance-2 tie breaks upward: 0→1→2, not 0→3→2.
+    assert topo.ici_path(0, 2) == ((0, 0, 1), (0, 1, 2))
+    assert topo.ici_path(2, 0) == ((0, 2, 3), (0, 3, 0))
+    assert topo.ici_path(1, 1) == ()
+    # 2-D torus: dimension order (rows first), per-dim shorter wrap.
+    topo2 = PodTopology.make({i: 0 for i in range(6)}, dcn_bw=0,
+                             slice_shape=[2, 3], ici_link_bw=10)
+    # node 0 = (0,0), node 5 = (1,2): row 0→1 then col 0→2 via wrap.
+    assert topo2.ici_path(0, 5) == ((0, 0, 3), (0, 3, 5))
+
+
+def test_torus_link_bottleneck_spreads_bytes_across_links():
+    """SURVEY §7 hard part (the DCN test's shape, one level down): a
+    ring of 4 where two senders' routes share the dest's one in-link —
+    the plan must give the third sender (whose route uses the other
+    in-link) its full share, and cap the sharing pair to one link's
+    budget.  The flat model (huge NICs) would miss the deadline ~50x."""
+    from distributed_llm_dissemination_tpu.sched.flow import PodTopology
+
+    topo = PodTopology.make({i: 0 for i in range(4)}, dcn_bw=0,
+                            slice_shape=[4], ici_link_bw=10_000)
+    kwargs = dict(
+        assignment={2: {0: _meta()}},
+        # Senders 0, 1, 3 hold the layer; dest is node 2.  Routes:
+        # 1→2 on link (1,2); 3→2 on link (3,2); 0 ties and goes up
+        # 0→1→2 — SHARING link (1,2) with sender 1.
+        status={0: {0: _meta(rate=1_000_000)},
+                1: {0: _meta(rate=1_000_000)},
+                3: {0: _meta(rate=1_000_000)}},
+        layer_sizes={0: 100_000},
+        node_network_bw={i: 1_000_000 for i in range(4)},
+    )
+    g = FlowGraph(topology=topo, **kwargs)
+    t, jobs = g.get_job_assignment()
+    check_tiling(jobs, {0: 100_000})
+    # Two in-links to the dest at 10 kB/s each → 20 kB/s aggregate →
+    # 100 kB needs ~5000 ms (vs ~100 ms for the link-blind plan).
+    assert 4990 <= t <= 5015, t
+    by_sender = {s: sum(j.data_size for j in js) for s, js in jobs.items()}
+    # Sender 3 owns the uncontended in-link: half the bytes.
+    assert by_sender.get(3, 0) >= 49_000, by_sender
+    # Senders 0+1 share link (1,2): combined at most its budget.
+    shared = by_sender.get(0, 0) + by_sender.get(1, 0)
+    assert shared <= 10_000 * t // 1000 + len(jobs) + 1, (shared, t)
+
+    # The link-blind solver (same instance, no torus) is ~50x faster in
+    # its own model — the gap the per-link edges exist to close.
+    t_flat, _ = FlowGraph(**kwargs).get_job_assignment()
+    assert t_flat <= 150
+
+
+def test_torus_without_scipy_degrades_loudly_but_validly(monkeypatch):
+    from distributed_llm_dissemination_tpu.sched import flow as flow_mod
+
+    monkeypatch.setattr(flow_mod, "_have_lp", lambda: False)
+    topo = flow_mod.PodTopology.make({i: 0 for i in range(4)}, dcn_bw=0,
+                                     slice_shape=[4], ici_link_bw=10_000)
+    g = FlowGraph(
+        assignment={2: {0: _meta()}},
+        status={1: {0: _meta(rate=100_000)}},
+        layer_sizes={0: 100_000},
+        node_network_bw={i: 1_000_000 for i in range(4)},
+        topology=topo,
+    )
+    t, jobs = g.get_job_assignment()
+    check_tiling(jobs, {0: 100_000})  # valid plan, link caps dropped
+    assert t == 1000  # the per-node model's answer
+
+
 @needs_native
 def test_native_topology_matches_python_on_random_instances():
     """Property test (the round-5 native-topology path): with a
