@@ -178,6 +178,14 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     }
     ft = args.ft
     fabric, placement = build_spmd_fabric(args, conf)
+    if os.environ.get("DLD_PLAN_ACK_TIMEOUT"):
+        # Test knob: shrink the SPMD plan watchdog's ack timeout (and
+        # check period with it) so tail-gap recovery runs in test time.
+        LeaderNode.PLAN_ACK_TIMEOUT = float(
+            os.environ["DLD_PLAN_ACK_TIMEOUT"])
+        LeaderNode.PLAN_WATCH_PERIOD = min(
+            LeaderNode.PLAN_WATCH_PERIOD,
+            LeaderNode.PLAN_ACK_TIMEOUT / 2 or 1.0)
     common = dict(expected_nodes=expected, failure_timeout=ft,
                   fabric=fabric, placement=placement)
     if args.m == 0:

@@ -131,6 +131,16 @@ class LeaderNode:
         # the cancel that superseded it): the re-send store for SPMD
         # gap recovery (handle_plan_resend).  Insertion-ordered, bounded.
         self._sent_plans: Dict[int, DevicePlanMsg] = {}
+        # seq -> {"t": dispatch time, "retries": n} for SPMD plans whose
+        # (layer, dest) ack hasn't arrived: the WATCHDOG side of the gap
+        # recovery.  The receiver-side gap report only fires when LATER
+        # seqs queue behind a hole — a dropped TAIL plan stalls silently
+        # (nothing queues, the dest never learned of it), so the leader
+        # also re-broadcasts unacked plans on a timer and cancels after
+        # PLAN_REBROADCASTS tries (the dest's collect-timeout
+        # re-announce then re-plans the bytes, host path).
+        self._plan_watch: Dict[int, dict] = {}
+        self._watch_stop = threading.Event()
         self.expected_nodes = set(expected_nodes or ())
         self.status: Status = {}
         self._lock = threading.Lock()
@@ -190,11 +200,68 @@ class LeaderNode:
         if start_loop:
             self.loop.start()
             self.detector.start()
+            if self._spmd:
+                threading.Thread(target=self._plan_watchdog,
+                                 name="plan-watchdog", daemon=True).start()
 
     # How many broadcast plans the leader retains for gap re-sends; a
     # goal's plan count is bounded by its (layer, dest) pairs, so this
     # comfortably covers any in-flight window while bounding memory.
     SENT_PLAN_RETENTION = 4096
+    # Watchdog knobs (class attrs: tests tune them): how long an SPMD
+    # plan may sit unacked before a re-broadcast, how often to check,
+    # and how many re-broadcasts before the seq is cancelled.
+    PLAN_ACK_TIMEOUT = 60.0
+    PLAN_WATCH_PERIOD = 5.0
+    PLAN_REBROADCASTS = 3
+
+    def _plan_watchdog(self) -> None:
+        """Tail-gap liveness (the receiver-side gap report's blind
+        spot): re-broadcast unacked SPMD plans, cancel after the retry
+        budget.  Duplicate deliveries are free — the executor returns
+        the settled/pending handle for any seq it already saw."""
+        while not self._watch_stop.wait(self.PLAN_WATCH_PERIOD):
+            now = time.monotonic()
+            due = []
+            with self._lock:
+                for seq, rec in list(self._plan_watch.items()):
+                    if now - rec["t"] < self.PLAN_ACK_TIMEOUT:
+                        continue
+                    msg = self._sent_plans.get(seq)
+                    if msg is None:
+                        del self._plan_watch[seq]
+                        continue
+                    if rec["retries"] >= self.PLAN_REBROADCASTS:
+                        del self._plan_watch[seq]
+                        due.append((seq, msg, True))
+                    else:
+                        rec["retries"] += 1
+                        rec["t"] = now
+                        due.append((seq, msg, False))
+                recipients = sorted(set(self.status)
+                                    | {self.node.my_id})
+            for seq, msg, give_up in due:
+                if give_up:
+                    log.error("spmd plan unacked after re-broadcasts; "
+                              "cancelling seq (dest re-announce will "
+                              "re-plan the bytes)", seq=seq,
+                              plan=msg.plan_id)
+                    cancel = DevicePlanMsg(self.node.my_id, msg.plan_id,
+                                           msg.layer_id, msg.dest_id, 0,
+                                           [], seq=seq)
+                    with self._lock:
+                        self._sent_plans[seq] = cancel
+                    out = cancel
+                else:
+                    log.warn("re-broadcasting unacked spmd plan",
+                             seq=seq, plan=msg.plan_id)
+                    out = msg
+                for r in sorted(set(recipients) | {msg.dest_id}):
+                    try:
+                        self.node.transport.send(r, out)
+                    except (OSError, KeyError) as e:
+                        log.error("plan watchdog send failed", seq=seq,
+                                  dest=r, err=repr(e))
 
     def _register_handlers(self) -> None:
         self.loop.register(AnnounceMsg, self.handle_announce)
@@ -389,6 +456,7 @@ class LeaderNode:
         return members, [slices[m][1] - slices[m][0] for m in members]
 
     def close(self) -> None:
+        self._watch_stop.set()
         self.detector.stop()
         self.loop.stop()
 
@@ -752,7 +820,11 @@ class LeaderNode:
                                 | {msg.dest_id, self.node.my_id})
             self._sent_plans[msg.seq] = msg
             while len(self._sent_plans) > self.SENT_PLAN_RETENTION:
-                self._sent_plans.pop(next(iter(self._sent_plans)))
+                dropped = next(iter(self._sent_plans))
+                self._sent_plans.pop(dropped)
+                self._plan_watch.pop(dropped, None)
+            self._plan_watch[msg.seq] = {"t": time.monotonic(),
+                                         "retries": 0}
         failed = []
         for r in recipients:
             try:
@@ -853,6 +925,12 @@ class LeaderNode:
                 size = self._layer_size_locked(msg.layer_id)
             row[msg.layer_id] = LayerMeta(location=msg.location,
                                           data_size=size)
+            # The watchdog stops chasing any plan this ack settles.
+            for seq, _rec in list(self._plan_watch.items()):
+                plan = self._sent_plans.get(seq)
+                if (plan is not None and plan.dest_id == msg.src_id
+                        and plan.layer_id == msg.layer_id):
+                    del self._plan_watch[seq]
         self._maybe_finish()
 
     def _layer_size_locked(self, layer_id: LayerID) -> int:
@@ -915,6 +993,12 @@ class LeaderNode:
         self.detector.forget(node_id)
         with self._lock:
             self.status.pop(node_id, None)
+            # Stop chasing acks a dead dest will never send (the fabric
+            # is disabled anyway; its layers re-plan over the host path).
+            for seq in list(self._plan_watch):
+                plan = self._sent_plans.get(seq)
+                if plan is not None and plan.dest_id == node_id:
+                    del self._plan_watch[seq]
             dropped = self.assignment.pop(node_id, None)
             if dropped:
                 # Remembered so a restarted incarnation that re-announces

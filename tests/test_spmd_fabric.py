@@ -319,6 +319,79 @@ def test_broadcast_retains_operative_message_per_seq():
             t.close()
 
 
+def test_plan_watchdog_rebroadcasts_then_cancels(monkeypatch):
+    """Tail-gap liveness: a plan nobody acks is re-broadcast on a timer
+    and finally CANCELLED — a dropped LAST plan (nothing queues behind
+    it, so no receiver ever reports a gap) cannot wedge the goal."""
+    from distributed_llm_dissemination_tpu.core.types import (
+        LayerLocation,
+        LayerMeta,
+    )
+    from distributed_llm_dissemination_tpu.runtime import LeaderNode, Node
+    from distributed_llm_dissemination_tpu.runtime.leader import (
+        LeaderNode as _LN,
+    )
+    from distributed_llm_dissemination_tpu.transport import (
+        InmemTransport,
+        reset_registry,
+    )
+
+    monkeypatch.setattr(_LN, "PLAN_ACK_TIMEOUT", 0.25)
+    monkeypatch.setattr(_LN, "PLAN_WATCH_PERIOD", 0.05)
+    monkeypatch.setattr(_LN, "PLAN_REBROADCASTS", 2)
+    reset_registry()
+    t0 = InmemTransport("0")
+    t1 = InmemTransport("1")
+    leader = LeaderNode(Node(0, 0, t0), {}, {1: {0: LayerMeta()}},
+                        start_loop=True, fabric=_FakeSpmdFabric(),
+                        placement=_FakePlacement([0, 1]))
+    leader.status[1] = {
+        0: LayerMeta(location=LayerLocation.INMEM, data_size=100)
+    }
+    try:
+        assert leader._broadcast_spmd_plan(_plan(0, [(0, 0, 100)], dest=1))
+        got = []
+        deadline = time.monotonic() + 10.0
+        while len(got) < 4 and time.monotonic() < deadline:
+            try:
+                m = t1.deliver().get(timeout=0.5)
+            except Exception:  # noqa: BLE001 — queue.Empty
+                continue
+            if isinstance(m, DevicePlanMsg):
+                got.append(m)
+        # Original + 2 re-broadcasts + the final cancellation.
+        assert len(got) == 4, [(m.seq, m.layout) for m in got]
+        assert [bool(m.layout) for m in got] == [True, True, True, False]
+        assert all(m.seq == 0 for m in got)
+        with leader._lock:
+            assert 0 not in leader._plan_watch  # chase abandoned
+            assert leader._sent_plans[0].layout == []  # cancel retained
+
+        # An ACKED plan is never chased: broadcast + ack, then silence.
+        assert leader._broadcast_spmd_plan(_plan(1, [(0, 0, 100)], dest=1))
+        assert isinstance(t1.deliver().get(timeout=2.0), DevicePlanMsg)
+        from distributed_llm_dissemination_tpu.transport.messages import (
+            AckMsg,
+        )
+
+        leader.handle_ack(AckMsg(1, 0, LayerLocation.INMEM))
+        with leader._lock:
+            assert 1 not in leader._plan_watch
+        deadline = time.monotonic() + 0.8
+        while time.monotonic() < deadline:
+            try:
+                extra = t1.deliver().get(timeout=0.2)
+            except Exception:  # noqa: BLE001 — queue.Empty
+                continue
+            # The satisfying ack legitimately triggers StartupMsg etc.;
+            # only a DevicePlanMsg would be a spurious re-broadcast.
+            assert not isinstance(extra, DevicePlanMsg), extra
+    finally:
+        leader.close()
+        t0.close()
+        t1.close()
+
+
 # ---------------------------------------------------------- 2-process e2e
 
 
@@ -424,6 +497,49 @@ def test_two_process_spmd_heals_dropped_plan():
         assert "requesting re-send of missing spmd plans" in recv_err
         assert "re-sent spmd plan after gap report" in lead_err
         # Delivery still rode the device fabric — zero TCP layer bytes.
+        assert "layer landed over device fabric" in recv_err
+        assert "layer received" not in recv_err
+    finally:
+        for p in (recv, lead):
+            if p is not None and p.poll() is None:
+                p.kill()
+        if os.path.exists(conf_path):
+            os.remove(conf_path)
+
+
+def test_two_process_spmd_heals_dropped_tail_plan():
+    """The receiver-side gap report can't see a dropped LAST plan
+    (nothing queues behind it) — the leader's watchdog re-broadcast
+    must heal it.  One layer = one plan = seq 0 IS the tail."""
+    conf = _spmd_conf(3, layers=1)
+    conf_path = os.path.join(REPO, ".pytest-spmd-tail.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["DLD_PLAN_ACK_TIMEOUT"] = "2.0"
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "3"]
+    recv = lead = None
+    try:
+        recv_env = dict(env)
+        recv_env["DLD_TEST_DROP_PLAN_SEQS"] = "0"
+        recv = subprocess.Popen(cli + ["-id", "1"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=recv_env,
+                                text=True)
+        lead = subprocess.Popen(cli + ["-id", "0"], stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env, text=True)
+        lead_out, lead_err = lead.communicate(timeout=240)
+        recv_out, recv_err = recv.communicate(timeout=60)
+        assert lead.returncode == 0, f"leader failed:\n{lead_err[-3000:]}"
+        assert recv.returncode == 0, f"receiver failed:\n{recv_err[-3000:]}"
+        assert "Time to deliver" in lead_out
+        assert "fault injection: dropping spmd plan" in recv_err
+        assert "re-broadcasting unacked spmd plan" in lead_err
+        # Healed over the fabric, no TCP layer bytes.
         assert "layer landed over device fabric" in recv_err
         assert "layer received" not in recv_err
     finally:
