@@ -189,6 +189,105 @@ def test_shared_ingest_stages_reassembly_buffer_zero_copy(
             t.close()
 
 
+def test_sink_and_bounce_interleave_fuzz():
+    """Property test: random fragments (overlapping, duplicated,
+    out of order) land through WHICHEVER path engages — the sink when
+    the range is fresh, the bounce path otherwise — and the assembled
+    layer is byte-exact.  The claim discipline must make the interleave
+    invisible."""
+    import random
+
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        LayerMsg,
+    )
+
+    rng = random.Random(1234)
+    for trial in range(8):
+        total = rng.randint(1, 40_000)
+        want = bytes(rng.getrandbits(8) for _ in range(total))
+        ts = tcp_transports([1])
+        r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                       start_loop=False)
+        try:
+            spans = []
+            pos = 0
+            while pos < total:  # a covering tiling...
+                n = rng.randint(1, max(1, total // 3))
+                spans.append((pos, min(total, pos + n)))
+                pos += n
+            for _ in range(rng.randint(0, 6)):  # ...plus random overlaps
+                a = rng.randrange(total)
+                b = rng.randint(a + 1, total)
+                spans.append((a, b))
+            rng.shuffle(spans)
+            for a, b in spans:
+                placed = r._layer_sink(7, total, a, b - a)
+                if placed is not None:
+                    view, tok, _abort = placed
+                    view[:] = want[a:b]
+                    src = LayerSrc(
+                        inmem_data=None, data_size=b - a, offset=a,
+                        meta=LayerMeta(location=LayerLocation.INMEM))
+                    src.placed_token = tok
+                else:
+                    src = LayerSrc(
+                        inmem_data=bytearray(want[a:b]), data_size=b - a,
+                        offset=a,
+                        meta=LayerMeta(location=LayerLocation.INMEM))
+                r.handle_layer(LayerMsg(0, 7, src, total))
+            got = r.layers.get(7)
+            assert got is not None, (trial, total, spans)
+            assert bytes(got.inmem_data) == want, (trial, total)
+        finally:
+            r.close()
+            ts[1].close()
+
+
+def test_sink_composes_with_checkpoint_resume(tmp_path):
+    """A checkpoint-restored partial layer (bytearray buffer) + the
+    zero-copy sink for the remaining gap bytes: the resumed buffer IS
+    the sink's target, and the layer completes byte-exactly."""
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        LayerMsg,
+    )
+
+    total = 10_000
+    want = bytes((i * 31) % 256 for i in range(total))
+    ts = tcp_transports([1])
+    ckpt = str(tmp_path / "ck")
+    r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {}, start_loop=False,
+                                   checkpoint_dir=ckpt)
+    try:
+        # First incarnation journals [0, 4000).
+        src = LayerSrc(inmem_data=bytearray(want[:4000]), data_size=4000,
+                       offset=0,
+                       meta=LayerMeta(location=LayerLocation.INMEM))
+        r.handle_layer(LayerMsg(0, 3, src, total))
+    finally:
+        r.close()
+        ts[1].close()
+
+    ts2 = tcp_transports([1])
+    r2 = FlowRetransmitReceiverNode(Node(1, 0, ts2[1]), {},
+                                    start_loop=False, checkpoint_dir=ckpt)
+    try:
+        assert 3 in r2._partial  # restored in-progress layer
+        # The sink serves the gap range against the RESTORED buffer.
+        placed = r2._layer_sink(3, total, 4000, total - 4000)
+        assert placed is not None
+        view, tok, _abort = placed
+        view[:] = want[4000:]
+        src = LayerSrc(inmem_data=None, data_size=total - 4000,
+                       offset=4000,
+                       meta=LayerMeta(location=LayerLocation.INMEM))
+        src.placed_token = tok
+        r2.handle_layer(LayerMsg(0, 3, src, total))
+        assert bytes(r2.layers[3].inmem_data) == want
+    finally:
+        r2.close()
+        ts2[1].close()
+
+
 def test_sink_claim_survives_concurrent_bounce_duplicates():
     """A placed fragment's in-flight claim + a racing duplicate via the
     bounce path must neither double-count coverage nor wedge the layer:
