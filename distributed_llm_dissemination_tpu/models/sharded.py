@@ -166,19 +166,27 @@ def _local_layer(cfg: ModelConfig, p, x, q_pos):
     return x
 
 
-def _local_loss(cfg: ModelConfig, pp_size: int, params, inputs, targets):
+def _local_loss(cfg: ModelConfig, pp_size: int, params, inputs, targets,
+                remat: bool = False):
     """Per-device loss: embedding → pipeline loop → vocab-sharded CE.
     ``inputs``/``targets`` arrive pre-shifted on host so sequence sharding
-    over sp never straddles the shift boundary."""
+    over sp never straddles the shift boundary.  ``remat``: checkpoint
+    each scanned layer so the backward recomputes its activations
+    instead of keeping every layer's live (O(1) vs O(n_layers) layer
+    activations; bit-identical results)."""
     b, s_loc = inputs.shape
     sp_idx = lax.axis_index("sp")
     q_pos = sp_idx * s_loc + jnp.arange(s_loc)
 
     x = params["embed"][inputs]
 
+    layer_fn = functools.partial(_local_layer, cfg)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
     def run_stage(x):
         def body(h, layer_p):
-            return _local_layer(cfg, layer_p, h, q_pos), None
+            return layer_fn(layer_p, h, q_pos), None
 
         return lax.scan(body, x, params["layers"])[0]
 
@@ -229,16 +237,23 @@ def _local_loss(cfg: ModelConfig, pp_size: int, params, inputs, targets):
     return jnp.where(pp_idx == 0, nll, 0.0) / denom
 
 
-def build_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-3):
-    """jitted (params, tokens) -> (params, loss) over the 5-axis mesh."""
+def build_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-3,
+                     remat: bool = True):
+    """jitted (params, tokens) -> (params, loss) over the 5-axis mesh.
+
+    ``remat``: rematerialize each layer's activations in the backward
+    pass (``jax.checkpoint`` on the scanned layer body) — the standard
+    TPU memory/FLOPs trade: per-layer activations are not kept live
+    across the whole backward, at the cost of one extra forward.
+    Numerics are identical (tested)."""
     pp_size = mesh.shape["pp"]
     specs = param_specs(cfg)
     flat_specs = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    loss_fn = functools.partial(_local_loss, cfg, pp_size, remat=remat)
 
     def per_device(params, inputs, targets):
-        loss_share, grads = jax.value_and_grad(
-            functools.partial(_local_loss, cfg, pp_size)
-        )(params, inputs, targets)
+        loss_share, grads = jax.value_and_grad(loss_fn)(
+            params, inputs, targets)
         loss = lax.psum(loss_share, AXES)  # shares sum to the global mean
         flat_grads, treedef = jax.tree.flatten(grads)
         flat_grads = [
@@ -263,6 +278,85 @@ def build_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-3):
         check_vma=False,
     )
     return jax.jit(step, donate_argnums=(0,))
+
+
+def init_adamw_state(params):
+    """AdamW moments, one (m, v) pair per leaf — f32 regardless of the
+    param dtype (bf16 moments lose the small-update tail), sharded
+    EXACTLY like their leaves (the state specs mirror param_specs)."""
+    zeros = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
+    return {
+        "m": jax.tree.map(zeros, params),
+        "v": jax.tree.map(zeros, params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def adamw_state_specs(cfg: ModelConfig):
+    """PartitionSpecs for ``init_adamw_state``'s tree: moments shard
+    like params; the step counter is replicated."""
+    specs = param_specs(cfg)
+    return {"m": specs, "v": specs, "step": P()}
+
+
+def build_adamw_train_step(cfg: ModelConfig, mesh: Mesh, lr: float = 1e-3,
+                           betas=(0.9, 0.999), eps: float = 1e-8,
+                           weight_decay: float = 0.01, remat: bool = True):
+    """jitted (params, opt_state, inputs, targets) -> (params, opt_state,
+    loss): AdamW with bias correction and decoupled weight decay, the
+    moments sharded exactly like the params (each leaf's m/v live on the
+    same devices as the leaf — no extra collectives beyond the gradient
+    psums the SGD step already pays).  Params and state are donated."""
+    pp_size = mesh.shape["pp"]
+    specs = param_specs(cfg)
+    state_specs = adamw_state_specs(cfg)
+    flat_specs = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    b1, b2 = betas
+    loss_fn = functools.partial(_local_loss, cfg, pp_size, remat=remat)
+
+    def per_device(params, opt_state, inputs, targets):
+        loss_share, grads = jax.value_and_grad(loss_fn)(
+            params, inputs, targets)
+        loss = lax.psum(loss_share, AXES)
+        flat_grads, treedef = jax.tree.flatten(grads)
+        flat_grads = [
+            lax.psum(g, axes) if (axes := _grad_reduce_axes(s)) else g
+            for g, s in zip(flat_grads, flat_specs)
+        ]
+        grads = jax.tree.unflatten(treedef, flat_grads)
+        t = opt_state["step"] + 1
+        c1 = 1.0 - b1 ** t.astype(jnp.float32)
+        c2 = 1.0 - b2 ** t.astype(jnp.float32)
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            step_dir = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            new_p = (p.astype(jnp.float32)
+                     - lr * (step_dir + weight_decay * p.astype(jnp.float32))
+                     ).astype(p.dtype)
+            return new_p, m, v
+
+        out = jax.tree.map(upd, params, grads,
+                           opt_state["m"], opt_state["v"])
+        # tree of (p, m, v) tuples -> three trees
+        new_params = jax.tree.map(lambda o: o[0], out,
+                                  is_leaf=lambda o: isinstance(o, tuple))
+        new_m = jax.tree.map(lambda o: o[1], out,
+                             is_leaf=lambda o: isinstance(o, tuple))
+        new_v = jax.tree.map(lambda o: o[2], out,
+                             is_leaf=lambda o: isinstance(o, tuple))
+        return new_params, {"m": new_m, "v": new_v, "step": t}, loss
+
+    step = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(specs, state_specs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=(specs, state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(step, donate_argnums=(0, 1))
 
 
 def example_batch(cfg: ModelConfig, mesh: Mesh, batch: int = 0, seq: int = 0):
