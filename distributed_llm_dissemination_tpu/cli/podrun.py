@@ -85,7 +85,8 @@ def fabric_bandwidths(conf: cfg.Config) -> Dict[int, int]:
 
 
 def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
-            timeout: float = 600.0, gen: int = 0) -> Dict[str, float]:
+            timeout: float = 600.0, gen: int = 0,
+            on_delivered=None) -> Dict[str, float]:
     """Drive one full pod dissemination; returns the timing summary.
 
     Callable from tests/benchmarks; the fabric and placement span every
@@ -188,6 +189,10 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
                     summary["tokens"] = [int(t) for t in toks[0]]
                     print(f"Pod decoded {toks.shape[1]} tokens: "
                           f"{summary['tokens']}", flush=True)
+        if on_delivered is not None:
+            # Harvest hook (cli.train): read the DELIVERED layer stores
+            # while the nodes are still alive; runs before any close.
+            on_delivered(leader, receivers)
         print(json.dumps(summary), flush=True)
         return summary
     finally:
