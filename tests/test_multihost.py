@@ -310,3 +310,80 @@ def test_two_process_cpu_smoke():
     for o in outs:
         assert o["local"] == 1
         assert o["global"] == 2, f"devices not federated: {o}"
+
+
+_TRAIN_CHILD = textwrap.dedent("""
+    import json, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from distributed_llm_dissemination_tpu.core import config as cfg
+    from distributed_llm_dissemination_tpu.parallel.multihost import (
+        maybe_initialize,
+    )
+    from distributed_llm_dissemination_tpu.models.llama import (
+        CONFIGS, init_params,
+    )
+    from distributed_llm_dissemination_tpu.models.sharded import (
+        build_adamw_train_step, example_batch, init_adamw_state,
+        make_train_mesh, shard_params,
+    )
+
+    conf = cfg.Config.from_json(json.loads(sys.argv[1]))
+    my_id = int(sys.argv[2])
+    layout = maybe_initialize(conf, my_id)
+    assert layout is not None
+    n = len(jax.devices())
+    assert n == 8, f"devices not federated: {n}"
+    mcfg = CONFIGS["tiny"]
+    mesh = make_train_mesh(n, mcfg)
+    params = shard_params(init_params(mcfg, jax.random.key(0)), mesh, mcfg)
+    opt = init_adamw_state(params)
+    step = build_adamw_train_step(mcfg, mesh, lr=3e-3)
+    inputs, targets = example_batch(mcfg, mesh)
+    losses = []
+    for _ in range(2):
+        params, opt, loss = step(params, opt, inputs, targets)
+        losses.append(round(float(loss), 6))
+    print(json.dumps({"id": my_id, "global": n, "losses": losses}),
+          flush=True)
+""")
+
+
+def test_two_process_training_step():
+    """TRAINING across processes: two OS processes join one runtime
+    (4 virtual CPU devices each), build ONE global 8-device train mesh,
+    and run AdamW steps whose gradient psums cross the process boundary
+    (gloo) — both report identical, decreasing losses."""
+    port = _free_port()
+    conf_json = json.dumps({
+        "Nodes": [
+            {"Id": 0, "Addr": "127.0.0.1:9082", "IsLeader": True},
+            {"Id": 1, "Addr": "127.0.0.1:9083"},
+        ],
+        "Assignment": {},
+        "LayerSize": 1,
+        "Distributed": {"Coordinator": f"127.0.0.1:{port}",
+                        "CpuCollectives": "gloo"},
+    })
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _TRAIN_CHILD, conf_json, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, text=True)
+        for i in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert outs[0]["losses"] == outs[1]["losses"]
+    assert outs[0]["losses"][1] < outs[0]["losses"][0]
