@@ -243,6 +243,80 @@ def test_sink_and_bounce_interleave_fuzz():
             ts[1].close()
 
 
+def test_sink_and_bounce_threaded_fuzz():
+    """CONCURRENT interleave: 6 writer threads race random overlapping
+    fragments through sink and bounce paths simultaneously — the
+    claim/commit discipline must yield a byte-exact layer with no
+    wedge (all claims settled) regardless of schedule."""
+    import random
+
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        LayerMsg,
+    )
+
+    for trial in range(3):
+        rng = random.Random(500 + trial)
+        total = 120_000
+        want = bytes(rng.getrandbits(8) for _ in range(total))
+        ts = tcp_transports([1])
+        r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                       start_loop=False)
+        try:
+            spans = []
+            pos = 0
+            while pos < total:
+                n = rng.randint(1, 20_000)
+                spans.append((pos, min(total, pos + n)))
+                pos += n
+            for _ in range(10):
+                a = rng.randrange(total)
+                spans.append((a, rng.randint(a + 1, total)))
+            rng.shuffle(spans)
+            chunks = [spans[i::6] for i in range(6)]
+            errs = []
+
+            def writer(my_spans, seed):
+                try:
+                    my_rng = random.Random(seed)
+                    for a, b in my_spans:
+                        if my_rng.random() < 0.5:
+                            placed = r._layer_sink(9, total, a, b - a)
+                        else:
+                            placed = None
+                        if placed is not None:
+                            view, tok, _abort = placed
+                            view[:] = want[a:b]
+                            src = LayerSrc(
+                                inmem_data=None, data_size=b - a,
+                                offset=a,
+                                meta=LayerMeta(
+                                    location=LayerLocation.INMEM))
+                            src.placed_token = tok
+                        else:
+                            src = LayerSrc(
+                                inmem_data=bytearray(want[a:b]),
+                                data_size=b - a, offset=a,
+                                meta=LayerMeta(
+                                    location=LayerLocation.INMEM))
+                        r.handle_layer(LayerMsg(0, 9, src, total))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = [threading.Thread(target=writer, args=(c, i))
+                       for i, c in enumerate(chunks)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errs, errs
+            got = r.layers.get(9)
+            assert got is not None, f"trial {trial}: layer never completed"
+            assert bytes(got.inmem_data) == want, f"trial {trial}"
+        finally:
+            r.close()
+            ts[1].close()
+
+
 def test_sink_composes_with_checkpoint_resume(tmp_path):
     """A checkpoint-restored partial layer (bytearray buffer) + the
     zero-copy sink for the remaining gap bytes: the resumed buffer IS
