@@ -193,6 +193,12 @@ def main() -> None:
 
     gbps = total / statistics.median(times) / 1e9
     link_fraction = statistics.median(ratios)
+    # Compiled-collective reuse across the trials: every ingest after the
+    # warmup must HIT the executable cache (same tiling shape), which is
+    # the amortization the device plane banks on at multi-layer scale.
+    from distributed_llm_dissemination_tpu.parallel import plan_cache
+
+    cache_stats = plan_cache.stats()
     print(
         json.dumps(
             {
@@ -214,6 +220,7 @@ def main() -> None:
                 "link_fraction": round(link_fraction, 3),
                 "link_fraction_spread": [
                     round(min(ratios), 3), round(max(ratios), 3)],
+                "collective_cache": cache_stats,
                 "probe_attempts": probe_attempts,
                 "note": "absolute GB/s is bound by this host's measured "
                         "device link (raw_dma_gbps); link_fraction is the "

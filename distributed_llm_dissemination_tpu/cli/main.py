@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "waits for missing boot reports (then exits 1) and "
                         "a receiver drains its own in-flight boot before "
                         "exiting; size to the slowest expected boot")
+    p.add_argument("-test-drop-plan-seqs", type=str, default="",
+                   help="TEST ONLY: comma-separated SPMD plan seqs whose "
+                        "first delivery this receiver drops (fault "
+                        "injection for the gap-recovery tests); fault "
+                        "injection is armed exclusively by this flag — "
+                        "environment variables cannot enable it")
     p.add_argument("-serve", type=float, default=0.0,
                    help="receiver: after a successful boot, stay alive "
                         "this many seconds answering GenerateReqMsg "
@@ -348,9 +354,12 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
             "with a Model section"
         )
     codec = conf.model_codec
+    drop_seqs = tuple(int(s) for s in args.test_drop_plan_seqs.split(",")
+                      if s.strip())
     common = dict(heartbeat_interval=args.hb, stage_hbm=args.hbm,
                   placement=placement, boot_cfg=boot_cfg, boot_codec=codec,
-                  fabric=fabric, boot_generate=args.gen)
+                  fabric=fabric, boot_generate=args.gen,
+                  test_drop_plan_seqs=drop_seqs)
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".", **common)
     elif args.m in (1, 2):
@@ -369,6 +378,12 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     receiver.announce()
     receiver.ready().get()
     ulog.log.info("received startup: ready")
+    if fabric is not None or args.hbm:
+        # Executable-reuse evidence for this process's device plane
+        # (harnesses grep the structured record).
+        from ..parallel import plan_cache
+
+        plan_cache.log_stats()
     print("ready", flush=True)
     if receiver.expect_serve:
         # Multi-controller serving: a ServeMsg follows startup; stay

@@ -153,8 +153,17 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
         ttd = time.monotonic() - t0
         ulog.log.info("Time to deliver", seconds=round(ttd, 6))
         print(f"Time to deliver: {ttd:.6f}s", flush=True)
+        # Executable reuse + phase attribution for THIS dissemination,
+        # sampled at ready (before any boot compiles muddy the water):
+        # the ttd_matrix fabric row reads these out of the summary line.
+        from ..parallel import plan_cache
+        from ..utils import trace as utrace
+
+        plan_cache.log_stats()
         summary = {"mode": mode, "ttd_s": round(ttd, 6),
-                   "nodes": len(node_ids), "fabric": True}
+                   "nodes": len(node_ids), "fabric": True,
+                   "collective_cache": plan_cache.stats(),
+                   "plan_phases": utrace.phase_totals()}
         if boot_cfg is not None:
             booted = leader.boot_ready().get(timeout=timeout)
             ttft = time.monotonic() - t0

@@ -1,13 +1,15 @@
+from . import plan_cache  # noqa: F401
 from .collectives import (  # noqa: F401
     allgather_shards,
     gather_tiles,
+    gather_tiles_batched,
     one_to_all,
     permute_blocks,
     replicate,
     ring_broadcast,
     shard_along,
 )
-from .fabric import FabricPlane  # noqa: F401
+from .fabric import FabricPlane, PlanWindow  # noqa: F401
 from .mesh import (  # noqa: F401
     StagePlacement,
     assignment_to_placement,

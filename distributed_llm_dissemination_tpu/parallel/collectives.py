@@ -29,13 +29,36 @@ blocks for topology-aware schedules.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .compat import shard_map
+
+# XLA's CPU backend deadlocks when two collective EXECUTIONS over
+# overlapping device sets interleave: each execution's per-device worker
+# threads can join the other's rendezvous (observed live on the 0.4.x
+# line — "waiting for all participants to arrive at rendezvous
+# RendezvousKey{run_id=861}" next to run_id=862, both wedged forever).
+# Concurrent plans DO dispatch gathers concurrently (handler threads,
+# the in-flight window), so on the CPU backend every gather runs
+# dispatch→completion under one process-wide lock.  Accelerator
+# backends keep the fully-async pipeline — ordered device streams make
+# concurrent dispatch safe there.
+_CPU_COLLECTIVE_LOCK = threading.Lock()
+
+
+def _collective_guard():
+    if jax.default_backend() == "cpu":
+        return _CPU_COLLECTIVE_LOCK
+    return contextlib.nullcontext()
 
 
 def replicate(x: jax.Array, mesh: Mesh) -> jax.Array:
@@ -50,7 +73,7 @@ def shard_along(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P(axis)))
 
 
-def gather_tiles(mesh: Mesh, axis: str, sizes: Tuple[int, ...]):
+def gather_tiles(mesh: Mesh, axis: str, sizes: Tuple[int, ...], pad=None):
     """Compiled: each device holds one PADDED tile of a byte blob (tile i
     is ``sizes[i]`` real elements); one ``all_gather`` + static re-splice
     yields the full blob replicated on every device of the mesh.
@@ -59,36 +82,120 @@ def gather_tiles(mesh: Mesh, axis: str, sizes: Tuple[int, ...]):
     ``plan.execute_flow_plan`` (a mode-3 flow schedule executed as one
     device program) and ``ingest.ShardedLayerIngest.finalize`` (the
     receiver's incremental HBM ingest) compile through here — unequal
-    flow-job splits are padded to the largest tile, and the re-splice
-    uses static slice bounds so XLA fuses it into the gather epilogue.
+    flow-job splits are padded to the largest tile (or ``pad``), and the
+    re-splice slices the real sizes back out.
 
     The identity-order case of ``gather_tiles_at`` (one shared builder,
     one compile cache)."""
-    return gather_tiles_at(mesh, axis, sizes, tuple(range(len(sizes))))
+    return gather_tiles_at(mesh, axis, sizes, tuple(range(len(sizes))),
+                           pad=pad)
 
 
-@functools.lru_cache(maxsize=64)
+def _gather_padded(mesh: Mesh, axis: str, pad: int, k: int, dtype):
+    """The cached COLLECTIVE program: every device contributes ``k``
+    padded tiles; one tiled ``all_gather`` replicates all n*k tiles as a
+    ``(n, k, pad)`` array on every device.  Keyed ONLY by (mesh, axis,
+    pad, k, dtype) — the tile sizes are deliberately absent, so every
+    plan whose pads land in the same ``plan_cache.bucket_pad`` bucket
+    reuses one executable instead of compiling its own."""
+    from .plan_cache import GATHER_CACHE
+
+    n = mesh.shape[axis]
+    key = (mesh, axis, n, pad, k, np.dtype(dtype).str)
+
+    def build():
+        def per_device(frag):
+            return lax.all_gather(frag.reshape(k, pad), axis)  # (n, k, pad)
+
+        fn = jax.jit(
+            lambda v: shard_map(
+                per_device, mesh=mesh,
+                in_specs=P(axis), out_specs=P(),
+                check_vma=False,
+            )(v)
+        )
+        # Compile EAGERLY when the runtime supports it, so the build
+        # time in the cache stats is the real XLA compile (and the first
+        # plan's dispatch doesn't pay it inside its collective phase).
+        try:
+            spec = jax.ShapeDtypeStruct(
+                (n * k * pad,), np.dtype(dtype),
+                sharding=NamedSharding(mesh, P(axis)))
+            return fn.lower(spec).compile()
+        except Exception:  # noqa: BLE001 — lazy jit is still correct
+            return fn
+
+    return GATHER_CACHE.get(key, build)
+
+
+def _splice_tiles(sizes: Tuple[int, ...], order: Tuple[int, ...], k: int):
+    """The cached RE-SPLICE program: slice each gathered tile back to its
+    real size and concatenate in offset order — device-local HBM work,
+    no collective.  Keyed by the exact sizes (a static-shape program),
+    but cheap to compile next to the gather."""
+    from .plan_cache import SPLICE_CACHE
+
+    def build():
+        def fn(g):  # (n, k, pad) replicated
+            outs = []
+            for kk in range(k):
+                parts = [lax.slice(g[r, kk], (0,), (sizes[r],))
+                         for r in order if sizes[r] > 0]
+                outs.append(jnp.concatenate(parts) if len(parts) > 1
+                            else parts[0])
+            return outs[0] if k == 1 else jnp.stack(outs)
+
+        return jax.jit(fn)
+
+    return SPLICE_CACHE.get((sizes, order, k), build)
+
+
 def gather_tiles_at(mesh: Mesh, axis: str, sizes: Tuple[int, ...],
-                    order: Tuple[int, ...]):
+                    order: Tuple[int, ...], pad=None):
     """``gather_tiles`` with an explicit re-splice permutation: the blob's
     k-th byte range (in offset order) lives on device rank ``order[k]``.
     The multi-controller SPMD fabric needs this because contributions sit
     on their SENDER's stage devices — whichever mesh ranks those are —
-    not on ranks sorted by offset."""
+    not on ranks sorted by offset.
 
-    def per_device(frag):
-        g = lax.all_gather(frag, axis)  # (n, pad)
-        parts = [lax.slice(g[r], (0,), (sizes[r],))
-                 for r in order if sizes[r] > 0]
-        return jnp.concatenate(parts)
+    ``pad``: the per-tile padded element count the caller staged its
+    buffers at (>= max(sizes)); defaults to max(sizes).  Callers bucket
+    it (``plan_cache.bucket_pad``) so same-bucket plans share ONE
+    compiled collective; the splice slices the real sizes back out."""
+    pad_ = int(pad) if pad else (max(sizes) if sizes else 0)
 
-    @jax.jit
     def run(v):
-        return jax.shard_map(
-            per_device, mesh=mesh,
-            in_specs=P(axis), out_specs=P(),
-            check_vma=False,
-        )(v)
+        with _collective_guard():
+            g = _gather_padded(mesh, axis, pad_, 1, v.dtype)(v)
+            out = _splice_tiles(tuple(sizes), tuple(order), 1)(g)
+            if jax.default_backend() == "cpu":
+                jax.block_until_ready(out)  # execution ends inside the lock
+            return out
+
+    return run
+
+
+def gather_tiles_batched(mesh: Mesh, axis: str, sizes: Tuple[int, ...],
+                         order: Tuple[int, ...], k: int, pad=None):
+    """Plan batching: K same-tiling blobs move as ONE collective.
+
+    Each device stages its K tiles back to back (``(k * pad,)`` per
+    device, tile j of blob i at ``i * pad``); one ``all_gather``
+    replicates all of them and the splice returns ``(k, total)`` — blob
+    i is row i.  One dispatch + one executable for K layers, which is
+    exactly what amortizes per-plan latency when a model's same-shape
+    layers ship together."""
+    if k <= 0:
+        raise ValueError(f"batch size must be positive, got {k}")
+    pad_ = int(pad) if pad else (max(sizes) if sizes else 0)
+
+    def run(v):
+        with _collective_guard():
+            g = _gather_padded(mesh, axis, pad_, k, v.dtype)(v)
+            out = _splice_tiles(tuple(sizes), tuple(order), k)(g)
+            if jax.default_backend() == "cpu":
+                jax.block_until_ready(out)
+            return out if k > 1 else out.reshape(1, -1)
 
     return run
 
@@ -97,7 +204,7 @@ def gather_tiles_at(mesh: Mesh, axis: str, sizes: Tuple[int, ...],
 def _allgather_fn(mesh: Mesh, axis: str):
     @jax.jit
     def gather(v):
-        return jax.shard_map(
+        return shard_map(
             lambda s: lax.all_gather(s, axis, tiled=True),
             mesh=mesh,
             in_specs=P(axis),
@@ -134,7 +241,7 @@ def _ring_broadcast_fn(mesh: Mesh, axis: str, src: int):
 
     @jax.jit
     def broadcast(v):
-        return jax.shard_map(
+        return shard_map(
             per_device,
             mesh=mesh,
             in_specs=P(axis),
@@ -162,7 +269,7 @@ def ring_broadcast(
 def _permute_fn(mesh: Mesh, axis: str, perm: Tuple[Tuple[int, int], ...]):
     @jax.jit
     def permute(v):
-        return jax.shard_map(
+        return shard_map(
             lambda s: lax.ppermute(s, axis, perm),
             mesh=mesh,
             in_specs=P(axis),
@@ -195,7 +302,7 @@ def _one_to_all_fn(mesh: Mesh, axis: str, src: int):
             contrib = jnp.where(idx == src, s, jnp.zeros_like(s))
             return lax.psum(contrib, axis)
 
-        return jax.shard_map(
+        return shard_map(
             per_device, mesh=mesh, in_specs=P(axis), out_specs=P(),
             check_vma=False,
         )(v)

@@ -1,0 +1,47 @@
+"""JAX API compatibility: one import site for renamed entry points.
+
+The device plane targets the current ``jax.shard_map(f, mesh=...,
+in_specs=..., out_specs=..., check_vma=...)`` API.  Older runtimes (the
+0.4.x line some containers bake in) only ship it as
+``jax.experimental.shard_map.shard_map`` with the replication check
+named ``check_rep``.  Every collective in this package routes through
+THIS wrapper so the whole data plane works on both, instead of each
+call site dying with ``module 'jax' has no attribute 'shard_map'`` and
+silently demoting fabric transfers to the host path.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+
+else:  # pre-alias runtimes: the experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+if hasattr(jax.lax, "axis_size"):
+
+    def axis_size(axis) -> int:
+        """Static size of a named mesh axis inside a manual context."""
+        return jax.lax.axis_size(axis)
+
+else:
+
+    def axis_size(axis) -> int:
+        """Static size of a named mesh axis inside a manual context.
+        Pre-``lax.axis_size`` runtimes record it in the trace's axis
+        environment: late 0.4.x ``axis_frame`` returns the size itself
+        (an int), earlier releases return a frame object carrying it."""
+        import jax.core as _core
+
+        frame = _core.axis_frame(axis)
+        return frame if isinstance(frame, int) else frame.size

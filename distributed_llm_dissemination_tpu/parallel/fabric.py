@@ -35,9 +35,13 @@ analogue of the reference opening one fresh connection per transfer
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
-from typing import Dict, Iterator, List, Tuple
+from typing import Callable, Dict, Iterator, List, Tuple
+
+from ..utils import trace
+from ..utils.logging import log
 
 
 class FabricPlane:
@@ -112,3 +116,110 @@ class FabricPlane:
     def pending(self) -> int:
         with self._cond:
             return len(self._contribs)
+
+
+class PlanWindow:
+    """Full in-flight window over dispatched plan collectives.
+
+    JAX dispatch is async, but the legacy dest path round-tripped per
+    plan: dispatch the gather, ``block_until_ready``, ack, next plan —
+    so plan k+1's host staging and uploads idled behind plan k's
+    collective.  This window keeps up to ``max_plans`` (and
+    ``byte_budget`` bytes) of dispatched collectives in flight: callers
+    ``submit`` the un-blocked array with completion callbacks and move
+    straight on to the next plan's staging; a retirement thread blocks
+    on the OLDEST array and fires ``on_ready`` only once its device work
+    really finished — an ack can never name bytes that might still
+    fail.  ``submit`` blocks (backpressure) when the window is full, so
+    device memory stays bounded.
+
+    The collective wall time of each plan (submit → device-ready) lands
+    in the ``collective`` phase bucket (``utils.trace``)."""
+
+    def __init__(self, max_plans: int = 4, byte_budget: int = 2 << 30):
+        self.max_plans = max(1, max_plans)
+        self.byte_budget = byte_budget
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q: collections.deque = collections.deque()
+        self._bytes = 0
+        self._retiring = False  # a popped entry's callback is running
+        self._closed = False
+        # ONE retirement thread, started eagerly: a lazy check-and-start
+        # from submit() could race two first submitters into two threads
+        # both retiring the same queue head (double-ack + a dropped
+        # callback).  The window itself is created lazily by its owner,
+        # so idle receivers never pay for the thread.
+        self._thread = threading.Thread(
+            target=self._run, name="plan-window", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, label: str, arr, nbytes: int,
+               on_ready: Callable, on_error: Callable) -> None:
+        """Enqueue one dispatched collective; blocks while the window is
+        full (the caller IS the backpressure point)."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._closed
+                or (len(self._q) < self.max_plans
+                    and (self._bytes + nbytes <= self.byte_budget
+                         or not self._q))
+            )
+            if self._closed:
+                raise RuntimeError("plan window closed")
+            self._q.append((label, arr, nbytes, on_ready, on_error,
+                            time.monotonic()))
+            self._bytes += nbytes
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        import jax
+
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: self._q or self._closed)
+                if not self._q and self._closed:
+                    return
+                label, arr, nbytes, on_ready, on_error, t0 = self._q[0]
+            err = None
+            try:
+                jax.block_until_ready(arr)
+            except Exception as e:  # noqa: BLE001 — surface via callback
+                err = e
+            dt = time.monotonic() - t0
+            trace.add_phase("collective", dt)
+            with self._cond:
+                # Popped for CAPACITY before the callback runs (the next
+                # submit may proceed), but drain() also waits on
+                # _retiring so "drained" really means the callback —
+                # store + ack — finished, not just the pop.
+                self._q.popleft()
+                self._bytes -= nbytes
+                self._retiring = True
+                self._cond.notify_all()
+            try:
+                if err is None:
+                    on_ready(arr, dt)
+                else:
+                    on_error(err)
+            except Exception as e:  # noqa: BLE001 — a callback must not
+                log.error("plan window callback failed", plan=label,
+                          err=repr(e))  # kill the retirement loop
+            finally:
+                with self._cond:
+                    self._retiring = False
+                    self._cond.notify_all()
+
+    def drain(self, timeout: float = 120.0) -> bool:
+        """Block until every submitted plan retired — queue empty AND the
+        last retirement's callback returned (tests/shutdown: an ack may
+        ride that callback)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: not self._q and not self._retiring, timeout=timeout)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

@@ -49,9 +49,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.reassembly import split_offsets
 from ..sched.flow import FlowJob
-from ..utils import hostmem, intervals
-from .collectives import gather_tiles
+from ..utils import hostmem, intervals, trace
+from .collectives import gather_tiles, gather_tiles_batched
 from .plan import execute_flow_plan
+from .plan_cache import bucket_pad
 
 
 def flat_mesh(devices: Sequence[jax.Device], axis: str = "ingest") -> Mesh:
@@ -144,6 +145,11 @@ class ShardedLayerIngest:
         # to the largest so the final gather is one tiled collective.
         self.spans: List[Tuple[int, int]] = list(split_offsets(total_bytes, n))
         self.pad = max(size for _, size in self.spans)
+        # Gather pad: bucketed (plan_cache.bucket_pad) so near-equal
+        # layers share ONE compiled gather executable.  Single-device
+        # sets skip the gather entirely — their buffer must stay exactly
+        # total-sized (zero-copy adoption depends on it).
+        self.gpad = bucket_pad(self.pad) if n > 1 else self.pad
         # ``stream`` overrides the platform auto-split (None): tests and
         # CPU-mesh dryruns use it to exercise the accelerator arm.
         if stream is None:
@@ -158,11 +164,11 @@ class ShardedLayerIngest:
         self._failed = False
         self._closed = False  # finalize/salvage ran: late writes no-op
         if self._cpu:
-            # Host-accumulate (see module docstring).  pad-sized so the
+            # Host-accumulate (see module docstring).  gpad-sized so the
             # multi-device gather needs no reallocation; the tail past the
             # span's real size is never read (gather_tiles slices it off).
             self._host: Optional[List[np.ndarray]] = [
-                hostmem.aligned_empty(self.pad) for _ in range(n)
+                hostmem.aligned_empty(self.gpad) for _ in range(n)
             ]
             self._pieces: Optional[List[List[Tuple[int, jax.Array]]]] = None
         else:
@@ -337,15 +343,16 @@ class ShardedLayerIngest:
         span exactly, so this is a straight concat (+ tail pad)."""
         if not pieces:  # a zero-size span (more devices than bytes)
             with jax.default_device(self.devices[r]):
-                return jnp.zeros(self.pad, dtype=jnp.uint8)
-        if len(pieces) == 1 and pieces[0][1].shape[0] == self.pad:
+                return jnp.zeros(self.gpad, dtype=jnp.uint8)
+        if len(pieces) == 1 and pieces[0][1].shape[0] == self.gpad:
             return pieces[0][1]  # whole span arrived as one piece: no copy
-        return _concat_pad([p for _, p in pieces], self.pad)
+        return _concat_pad([p for _, p in pieces], self.gpad)
 
-    def finalize(self, timeout: float = 120.0) -> jax.Array:
-        """Splice the spans and (multi-device) all-gather them into the
-        full layer, replicated on every device of the set.  Blocks until
-        the ingest's own coverage is complete and no write is in flight."""
+    def _span_buffers(self, timeout: float = 120.0) -> List[jax.Array]:
+        """Block until coverage is complete, close the ingest, and return
+        one gpad-sized device-resident span buffer per device — the
+        staged halves of the terminal gather.  The shared head of
+        ``finalize`` and ``finalize_many``."""
         with self._lock:
             self._complete.wait_for(
                 lambda: self._failed or self._cov.complete(self.total),
@@ -367,19 +374,72 @@ class ShardedLayerIngest:
             # Zero-copy adoption: the aligned host buffers BECOME the
             # device arrays (the write memcpy was the only byte movement).
             # _closed guarantees nothing writes the buffers ever again.
-            if n == 1:  # split_offsets(total, 1): pad == total
-                return hostmem.adopt_as_device_array(
-                    self._host[0], self.devices[0])
-            bufs = [hostmem.adopt_as_device_array(b, d)
+            return [hostmem.adopt_as_device_array(b, d)
                     for b, d in zip(self._host, self.devices)]
-        else:
-            bufs = [self._splice(r, pieces[r]) for r in range(n)]
-            if n == 1:
-                return bufs[0]
+        return [self._splice(r, pieces[r]) for r in range(n)]
+
+    def finalize(self, timeout: float = 120.0) -> jax.Array:
+        """Splice the spans and (multi-device) all-gather them into the
+        full layer, replicated on every device of the set.  Blocks until
+        the ingest's own coverage is complete and no write is in flight.
+        The returned array's device work may still be in flight — callers
+        that must not ack unreal bytes block on it (or hand it to a
+        ``fabric.PlanWindow``)."""
+        with trace.phase("splice"):
+            bufs = self._span_buffers(timeout)
+        n = len(self.devices)
+        if n == 1:  # split_offsets(total, 1): pad == gpad == total
+            return bufs[0]
         mesh = flat_mesh(self.devices)
-        global_shape = (n * self.pad,)
+        global_shape = (n * self.gpad,)
         v = jax.make_array_from_single_device_arrays(
             global_shape, NamedSharding(mesh, P("ingest")), bufs
         )
         sizes = tuple(size for _, size in self.spans)
-        return gather_tiles(mesh, "ingest", sizes)(v)
+        return gather_tiles(mesh, "ingest", sizes, pad=self.gpad)(v)
+
+
+def finalize_many(ingests: Sequence["ShardedLayerIngest"],
+                  timeout: float = 120.0) -> List[jax.Array]:
+    """Plan batching at the terminal hop: K same-tiling ingests finish as
+    ONE batched gather — one collective dispatch and one compiled
+    executable for the whole batch, instead of K serial finalizes.
+
+    All ingests must share the device set and span tiling (equal-size
+    layers — the common mode-3 case; ``runtime/receiver.py`` groups them
+    by the leader's batch hints).  Each device concatenates its K span
+    buffers locally (HBM-bandwidth work) and the batched gather
+    replicates every layer on every device.  Returns one replicated
+    layer per ingest, in order; raises if any ingest failed or the
+    tilings differ — the caller then falls back to per-plan finalize."""
+    if not ingests:
+        return []
+    first = ingests[0]
+    if len(ingests) == 1:
+        return [first.finalize(timeout)]
+    for ing in ingests[1:]:
+        if (ing.devices != first.devices or ing.spans != first.spans
+                or ing._cpu != first._cpu or ing.gpad != first.gpad):
+            raise ValueError("batched ingests must share device tiling")
+    n = len(first.devices)
+    if n == 1:
+        # No gather to batch: each finalize is already collective-free.
+        return [ing.finalize(timeout) for ing in ingests]
+    k = len(ingests)
+    with trace.phase("splice"):
+        per_ingest = [ing._span_buffers(timeout) for ing in ingests]
+        # Device-local stacking: K gpad-sized tiles back to back.  The
+        # inputs are committed to device r, so the concat runs there.
+        shards = [
+            jnp.concatenate([per_ingest[i][r] for i in range(k)])
+            for r in range(n)
+        ]
+    mesh = flat_mesh(first.devices)
+    v = jax.make_array_from_single_device_arrays(
+        (n * k * first.gpad,), NamedSharding(mesh, P("ingest")), shards
+    )
+    sizes = tuple(size for _, size in first.spans)
+    out = gather_tiles_batched(
+        mesh, "ingest", sizes, tuple(range(n)), k, pad=first.gpad
+    )(v)
+    return [out[i] for i in range(k)]

@@ -26,7 +26,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..sched.flow import FlowJob
-from .collectives import gather_tiles
+from .collectives import gather_tiles, gather_tiles_batched
+from .plan_cache import bucket_pad
 
 
 def plan_layout(jobs: Sequence[FlowJob]) -> List[Tuple[int, int, int]]:
@@ -74,7 +75,9 @@ def execute_flow_plan(
     if any(size % itemsize for _, _, size in layout):
         raise ValueError(f"fragment sizes must be multiples of {itemsize}")
     sizes += [0] * (n - len(layout))  # idle devices
-    pad = max(sizes)
+    # Bucketed pad (plan_cache.bucket_pad): near-equal layers land on the
+    # same compiled gather instead of each paying its own XLA compile.
+    pad = bucket_pad(max(sizes)) if n > 1 else max(sizes)
 
     devices = mesh.devices.reshape(-1)
     shards = []
@@ -88,4 +91,58 @@ def execute_flow_plan(
     v = jax.make_array_from_single_device_arrays(
         global_shape, NamedSharding(mesh, P(axis)), shards
     )
-    return gather_tiles(mesh, axis, tuple(sizes))(v)
+    return gather_tiles(mesh, axis, tuple(sizes), pad=pad)(v)
+
+
+def execute_flow_plans(
+    plans: Sequence[Tuple[Sequence[FlowJob], Sequence[bytes]]],
+    mesh: Mesh,
+    axis: str,
+    dtype=jnp.uint8,
+) -> List[jax.Array]:
+    """Plan batching: K same-tiling flow plans as ONE device collective.
+
+    ``plans`` is ``[(jobs, fragment_bytes), ...]``; every plan must tile
+    the same total with the same per-job split (a model's equal-size
+    layers under one schedule — the common mode-3 case).  Each device
+    stages its K tiles back to back and a single batched gather
+    replicates all K layers everywhere: one dispatch and one compiled
+    executable amortized over the whole batch, instead of K serial
+    collectives.  Returns one replicated layer per plan, in order."""
+    if not plans:
+        return []
+    if len(plans) == 1:
+        jobs, frags = plans[0]
+        return [execute_flow_plan(jobs, frags, mesh, axis, dtype=dtype)]
+    layouts = [plan_layout(jobs) for jobs, _ in plans]
+    shape0 = [(off, size) for _, off, size in layouts[0]]
+    for lay in layouts[1:]:
+        if [(off, size) for _, off, size in lay] != shape0:
+            raise ValueError("batched plans must share one tiling shape")
+    n = mesh.shape[axis]
+    if len(shape0) > n:
+        raise ValueError(f"{len(shape0)} fragments > {n} devices on '{axis}'")
+    itemsize = np.dtype(dtype).itemsize
+    if any(size % itemsize for _, size in shape0):
+        raise ValueError(f"fragment sizes must be multiples of {itemsize}")
+    sizes = [size // itemsize for _, size in shape0]
+    sizes += [0] * (n - len(shape0))
+    k = len(plans)
+    pad = bucket_pad(max(sizes)) if n > 1 else max(sizes)
+
+    devices = mesh.devices.reshape(-1)
+    shards = []
+    for rank in range(n):
+        buf = np.zeros(k * pad, dtype=dtype)
+        if rank < len(shape0):
+            for i, (_, frags) in enumerate(plans):
+                frag = np.frombuffer(frags[rank], dtype=dtype)
+                buf[i * pad : i * pad + sizes[rank]] = frag
+        shards.append(jax.device_put(buf, devices[rank]))
+    v = jax.make_array_from_single_device_arrays(
+        (n * k * pad,), NamedSharding(mesh, P(axis)), shards
+    )
+    out = gather_tiles_batched(
+        mesh, axis, tuple(sizes), tuple(range(n)), k, pad=pad
+    )(v)
+    return [out[i] for i in range(k)]

@@ -37,6 +37,7 @@ import numpy as np
 from jax import lax
 
 from ..ops.flash_attention import _NEG_INF, block_attention, merge_partials
+from .compat import axis_size
 
 
 def _vary(axis, *xs):
@@ -50,7 +51,7 @@ def _vary(axis, *xs):
 def _ring_forward(q, k, v, axis, s_local):
     """The forward ring; returns out plus the per-row log-sum-exp and the
     kernel-layout tensors the custom backward needs."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     b, sq, h, hd = q.shape
     kvh = k.shape[2]
@@ -147,7 +148,7 @@ def _ring_attention_bwd(axis, s_local, res, dout):
     """Flash-style ring backward: p = exp(s - lse) is recomputed per
     block; dK/dV ride the rotating carry and return home after n hops."""
     qg, kt, vt, out_g, lse = res
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     b, kvh, group, sq, hd = qg.shape
     scale = 1.0 / np.sqrt(hd)

@@ -54,21 +54,24 @@ executor logs loudly when a gap persists past ``gap_timeout``.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import log
 
 PLAN_WAIT_S = 120.0  # dest-side wait for its plan's collective
-# Dispatched-but-unretired plans (bounds device memory).  Big layers get
-# a shallow window — two multi-GiB gathers in flight is already the
-# memory ceiling — while small plans (where per-collective latency, not
-# bytes, dominates) pipeline deeper.  Window depth is a LOCAL pacing
+# Dispatched-but-unretired plans (bounds device memory).  The window is
+# BYTE-budgeted: many small plans pipeline deep (their cost is per-plan
+# dispatch latency, which the window amortizes), while multi-GiB plans
+# keep only as many gathers in flight as the budget allows — one rule
+# instead of a small/large mode switch.  Window depth is a LOCAL pacing
 # choice: it never changes the per-process enqueue order, so processes
 # with different depths still interoperate.
-MAX_INFLIGHT = 2
-MAX_INFLIGHT_SMALL = 8
-SMALL_PLAN_BYTES = 4 << 20
+MAX_INFLIGHT = 16            # hard cap on dispatched-but-unretired plans
+MAX_INFLIGHT_SMALL = MAX_INFLIGHT  # retained alias (older tests/docs)
+INFLIGHT_BYTE_BUDGET = int(os.environ.get(
+    "DLD_INFLIGHT_BYTE_BUDGET", 1 << 30))
 
 
 class PlanFailed(RuntimeError):
@@ -215,15 +218,20 @@ class SpmdFabric:
         """Block until the oldest dispatched plan's device work finished,
         then resolve its result — success and failure both surface HERE,
         so a dest only ever acks bytes that really landed."""
+        import time as _time
+
         import jax
 
-        plan_id, res, value, out, _sz = inflight.popleft()
+        from ..utils import trace
+
+        plan_id, res, value, out, _sz, t0 = inflight.popleft()
         try:
             jax.block_until_ready(out)
         except Exception as e:  # noqa: BLE001 — resolve, don't die
             log.error("spmd fabric plan failed", plan=plan_id, err=repr(e))
             res.resolve(error=e)
             return
+        trace.add_phase("collective", _time.monotonic() - t0)
         res.resolve(value=value)
 
     def _run(self) -> None:
@@ -291,12 +299,17 @@ class SpmdFabric:
             if out is None:  # cancelled / not a participant: no device work
                 res.resolve(value=value)
                 continue
-            inflight.append((msg.plan_id, res, value, out, msg.total_size))
-            window = (MAX_INFLIGHT_SMALL
-                      if all(sz < SMALL_PLAN_BYTES
-                             for *_, sz in inflight)
-                      else MAX_INFLIGHT)
-            while len(inflight) > window:
+            import time as _time
+
+            inflight.append((msg.plan_id, res, value, out, msg.total_size,
+                             _time.monotonic()))
+            # Byte-budgeted window: retire the oldest until the in-flight
+            # set fits the budget (always keeping at least one dispatched
+            # plan — a single over-budget plan still pipelines with the
+            # next one's host staging) and the hard count cap.
+            while (len(inflight) > MAX_INFLIGHT
+                   or (sum(e[4] for e in inflight) > INFLIGHT_BYTE_BUDGET
+                       and len(inflight) > 1)):
                 self._retire_oldest(inflight)
 
     # ----------------------------------------------------------- collective
@@ -361,13 +374,17 @@ class SpmdFabric:
             # Out of scope: the participants' collective doesn't involve
             # this process's devices; just advance the seq.
             return None, None
+        from .plan_cache import bucket_pad
+
         sizes, order, by_rank = self._slot_assignment(msg.layout, flat)
         total = sum(sizes)
         if total != msg.total_size:
             raise PlanFailed(
                 f"layout covers {total} bytes, plan says {msg.total_size}"
             )
-        pad = max(sizes)
+        # Bucketed tile pad: plans with near-equal splits reuse ONE
+        # compiled gather (plan_cache) instead of compiling per layer.
+        pad = bucket_pad(max(sizes))
         mesh = flat_mesh(flat, axis="fabric")
 
         # My ranges MUST sit on my local devices (one stage == one host
@@ -381,28 +398,31 @@ class SpmdFabric:
                     "placement is not host-aligned"
                 )
 
+        from ..utils import trace
+
         shards = []
-        for rank, dev in enumerate(flat):
-            if dev.process_index != proc:
-                continue
-            buf = np.zeros(pad, np.uint8)
-            entry = by_rank.get(rank)
-            if entry is not None and entry[0] == self.my_node:
-                _, off, size = entry
-                data = self._read_span(msg.layer_id, off, size)
-                if data is None:
-                    raise PlanFailed(
-                        f"no local bytes for layer {msg.layer_id}"
-                    )
-                buf[:size] = np.frombuffer(data, np.uint8)
-            shards.append(jax.device_put(buf, dev))
+        with trace.phase("upload"):
+            for rank, dev in enumerate(flat):
+                if dev.process_index != proc:
+                    continue
+                buf = np.zeros(pad, np.uint8)
+                entry = by_rank.get(rank)
+                if entry is not None and entry[0] == self.my_node:
+                    _, off, size = entry
+                    data = self._read_span(msg.layer_id, off, size)
+                    if data is None:
+                        raise PlanFailed(
+                            f"no local bytes for layer {msg.layer_id}"
+                        )
+                    buf[:size] = np.frombuffer(data, np.uint8)
+                shards.append(jax.device_put(buf, dev))
 
         v = jax.make_array_from_single_device_arrays(
             (len(flat) * pad,), NamedSharding(mesh, P("fabric")), shards
         )
         # NOT blocked here: the caller's in-flight window retires it, so
         # the next plan's uploads overlap this gather on the device queue.
-        out = gather_tiles_at(mesh, "fabric", sizes, order)(v)
+        out = gather_tiles_at(mesh, "fabric", sizes, order, pad=pad)(v)
         if msg.dest_id != self.my_node:
             return None, out
         # Keep the LOCAL copy: the gather leaves the full layer replicated

@@ -127,6 +127,12 @@ class LeaderNode:
         self.fabric = fabric
         self.placement = placement
         self._plan_seq = itertools.count()
+        # Batch-hint ids must be unique across DISPATCH ROUNDS (a
+        # re-plan within the dest's batch wait would otherwise collide
+        # with a still-open group and fire a mixed batch); separate from
+        # _plan_seq on purpose — consuming plan seqs for ids would punch
+        # holes in the SPMD lockstep ordering.
+        self._batch_seq = itertools.count()
         # seq -> the operative DevicePlanMsg broadcast for it (plan, or
         # the cancel that superseded it): the re-send store for SPMD
         # gap recovery (handle_plan_resend).  Insertion-ordered, bounded.
@@ -219,7 +225,19 @@ class LeaderNode:
         """Tail-gap liveness (the receiver-side gap report's blind
         spot): re-broadcast unacked SPMD plans, cancel after the retry
         budget.  Duplicate deliveries are free — the executor returns
-        the settled/pending handle for any seq it already saw."""
+        the settled/pending handle for any seq it already saw.
+
+        RESIDUAL WEDGE (known, documented): the give-up cancel only
+        advances processes that have NOT yet entered the seq's
+        collective.  Peers already blocked INSIDE the original plan's
+        collective (they received the plan; some other participant
+        didn't) cannot be recalled — a lockstep collective has no abort
+        — so they stay wedged until the failure detector declares a
+        participant crashed and ``crash()`` disables the fabric, or
+        their own plan-wait timeout fires and the dest re-plans over the
+        host path.  The cancel is therefore a liveness aid for the GAP
+        process, not a pod-wide rollback; see docs/fabric.md
+        ("Failure domain and the cancel wedge")."""
         while not self._watch_stop.wait(self.PLAN_WATCH_PERIOD):
             now = time.monotonic()
             due = []
@@ -753,18 +771,24 @@ class LeaderNode:
     def _dispatch_device_plan(
         self, layer_id: LayerID, dest: NodeID,
         layout: List[Tuple[NodeID, int, int]], total: int,
+        batch_id: str = "", batch_n: int = 1,
     ) -> bool:
         """Send the plan to every participant; the layer bytes themselves
         never touch the transport (the fabric carries them).  Returns
         False when any participant missed the plan — the caller must then
         deliver over the host path instead (liveness: an incomplete plan
         would strand the dest waiting on contributions that never come,
-        or pin seeders' uploads that nobody collects)."""
+        or pin seeders' uploads that nobody collects).
+
+        ``batch_id``/``batch_n``: plan-batching hint (mode 3 groups
+        same-dest equal-size plans) — the dest finishes the whole group
+        as one batched gather instead of N serial collectives."""
         seq = next(self._plan_seq)
         plan_id = f"{layer_id}.{dest}.{seq}"
         spmd = self._spmd
         msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
-                            total, list(layout), seq=seq if spmd else -1)
+                            total, list(layout), seq=seq if spmd else -1,
+                            batch_id=batch_id, batch_n=batch_n)
         with self._lock:
             active = not self._startup_sent
         if active:
@@ -1609,6 +1633,10 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     )
         return out
 
+    # Max plans per batch hint: each batched gather holds K layers'
+    # tiles in flight at once, so K bounds the dest's peak HBM.
+    PLAN_BATCH_MAX = 4
+
     def _split_fabric_jobs(self, jobs: FlowJobsMap) -> FlowJobsMap:
         """Dispatch every fabric-eligible (layer, dest) job group as ONE
         device plan — the plan's multi-sender byte-range split executes as
@@ -1616,7 +1644,15 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         ingest gathers them over ICI) — and return the jobs the fabric
         can't carry for the host-path dispatch below.  A resumed dest's
         plan covers only its gaps; the dest seeds its ingest from the
-        checkpointed bytes it already holds."""
+        checkpointed bytes it already holds.
+
+        Plan batching: eligible plans with the same dest and total size
+        (a model's equal-size layers — the dest's ingest tiling is a
+        function of the total alone) are stamped with one batch id, so
+        the dest finishes the whole group as a single batched gather
+        (``parallel.ingest.finalize_many``) instead of N serial
+        collectives — the per-plan dispatch latency that dominated the
+        physical fabric row amortizes over the batch."""
         if self.fabric is None or self.placement is None:
             return jobs
         groups: Dict[Tuple[LayerID, NodeID], List[FlowJob]] = {}
@@ -1624,6 +1660,10 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             for job in job_list:
                 groups.setdefault((job.layer_id, job.dest_id), []).append(job)
         host_jobs: FlowJobsMap = {}
+        # First pass: decide eligibility per (layer, dest) group so the
+        # batch sizes stamped below are exact (a plan counted into a
+        # batch but sent host-path would strand the dest's batch wait).
+        eligible: List[Tuple[LayerID, NodeID, list, int]] = []
         for (layer_id, dest), group in sorted(groups.items()):
             layout = sorted(
                 ((j.sender_id, j.offset, j.data_size) for j in group),
@@ -1631,12 +1671,36 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             )
             with self._lock:
                 total = self._layer_size_locked(layer_id)
-            if (total > 0 and self._fabric_ok(layer_id, layout, dest, total)
-                    and self._dispatch_device_plan(layer_id, dest, layout,
-                                                   total)):
-                continue
-            for j in group:
-                host_jobs.setdefault(j.sender_id, []).append(j)
+            if total > 0 and self._fabric_ok(layer_id, layout, dest, total):
+                eligible.append((layer_id, dest, layout, total))
+            else:
+                for j in group:
+                    host_jobs.setdefault(j.sender_id, []).append(j)
+        # Same-dest, same-size plans batch together (bounded).
+        batches: Dict[Tuple[NodeID, int], List[int]] = {}
+        for i, (_, dest, _, total) in enumerate(eligible):
+            batches.setdefault((dest, total), []).append(i)
+        batch_of: Dict[int, Tuple[str, int]] = {}
+        for (dest, total), idxs in sorted(batches.items()):
+            for start in range(0, len(idxs), self.PLAN_BATCH_MAX):
+                chunk = idxs[start : start + self.PLAN_BATCH_MAX]
+                if len(chunk) < 2:
+                    continue  # nothing to amortize
+                bid = f"b{dest}.{total}.{next(self._batch_seq)}"
+                for i in chunk:
+                    batch_of[i] = (bid, len(chunk))
+        for i, (layer_id, dest, layout, total) in enumerate(eligible):
+            bid, bn = batch_of.get(i, ("", 1))
+            if not self._dispatch_device_plan(layer_id, dest, layout, total,
+                                              batch_id=bid, batch_n=bn):
+                # A mid-batch dispatch failure leaves earlier members
+                # stamped with the full batch_n; the dest's bounded
+                # FABRIC_BATCH_WAIT flush processes the present members
+                # — a one-off, bounded delay on a rare failure path
+                # (re-stamping already-sent plans would need a second
+                # protocol round for a case the flush already covers).
+                for j in groups[(layer_id, dest)]:
+                    host_jobs.setdefault(j.sender_id, []).append(j)
         return host_jobs
 
     def _dispatch(self, min_time_ms: int, self_jobs: FlowJobsMap,

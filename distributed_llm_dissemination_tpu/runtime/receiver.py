@@ -85,6 +85,11 @@ class ReceiverNode:
     # requesting a re-plan (class attribute: tests and deployments tune it).
     FABRIC_COLLECT_TIMEOUT = 120.0
 
+    # How long a dest holds an incomplete plan batch before processing
+    # the members that did arrive (a participant's dispatch failed and
+    # its plan went host-path).
+    FABRIC_BATCH_WAIT = 10.0
+
     # Serve-time request bounds (class attributes: deployments tune
     # them).  GenerateReqMsg is as unauthenticated as BootHintMsg, and
     # each request allocates a KV cache proportional to prompt+max_new
@@ -112,6 +117,7 @@ class ReceiverNode:
         fabric=None,
         boot_codec: str = "raw",
         boot_generate: int = 0,
+        test_drop_plan_seqs=(),
     ):
         """``boot_cfg``: a ``models.llama.ModelConfig``; when set, the
         startup message boots the model from the delivered layer blobs
@@ -208,6 +214,15 @@ class ReceiverNode:
         # completing concurrently never double-stages a multi-GB layer
         # (check-and-mark happens under self._lock; the duplicate waits).
         self._hbm_staging: Dict[int, threading.Event] = {}
+        # Fabric dest pipeline state: the shared in-flight window (lazy —
+        # only fabric dests pay for the retirement thread) and the
+        # batch-accumulation groups for leader-stamped plan batches.
+        self._plan_window = None
+        self._plan_batches: Dict[str, dict] = {}
+        # Fault injection is CONSTRUCTION-gated (ADVICE r5): only an
+        # explicit test flag arms it — a stray DLD_TEST_DROP_PLAN_SEQS
+        # in a production environment can never drop real plans.
+        self._drop_seqs = {int(s) for s in test_drop_plan_seqs}
         self.heartbeat = HeartbeatSender(
             node.transport, node.my_id, node.leader_id, heartbeat_interval
         )
@@ -260,6 +275,13 @@ class ReceiverNode:
     def close(self) -> None:
         self.heartbeat.stop()
         self.loop.stop()
+        with self._lock:
+            window = self._plan_window
+        if window is not None:
+            # Let in-flight plans retire (their acks may still matter to
+            # a live leader), then stop the retirement thread.
+            window.drain(timeout=5.0)
+            window.close()
 
     def _stage_to_hbm(self, layer_id, src, ingest=None) -> "LayerLocation":
         """Move a completed layer host→HBM when enabled; returns the
@@ -378,6 +400,8 @@ class ReceiverNode:
         contribute_device_plan(self.node, self.layers, self._lock,
                                self.fabric, self.placement, msg)
         if msg.dest_id == self.node.my_id:
+            if self._batch_enqueue(msg):
+                return  # a batch thread finishes the whole group
             threading.Thread(
                 target=self._receive_device_plan, args=(msg,), daemon=True
             ).start()
@@ -396,19 +420,18 @@ class ReceiverNode:
         except (OSError, KeyError) as e:
             log.error("plan re-send request failed", err=repr(e))
 
-    # Fault injection (tests): comma-separated plan seqs whose FIRST
-    # delivery this process drops — the lost-control-message scenario
-    # the gap recovery exists for.  Parsed lazily from the env.
-    _drop_seqs = None
-
     def _should_drop_plan(self, msg) -> bool:
-        if self._drop_seqs is None:
-            import os
-
-            raw = os.environ.get("DLD_TEST_DROP_PLAN_SEQS", "")
-            self._drop_seqs = {int(s) for s in raw.split(",") if s.strip()}
+        """Fault injection (tests ONLY): drop the FIRST delivery of the
+        plan seqs named at CONSTRUCTION (``test_drop_plan_seqs``; the
+        CLI's ``-test-drop-plan-seqs``) — the lost-control-message
+        scenario the gap recovery exists for.  Armed exclusively by that
+        explicit flag: production receivers construct with an empty set,
+        so this is one falsy check on the hot path and no environment
+        variable can silently drop real plans (ADVICE r5)."""
+        if not self._drop_seqs:
+            return False
         if msg.seq in self._drop_seqs:
-            self._drop_seqs = self._drop_seqs - {msg.seq}
+            self._drop_seqs.discard(msg.seq)
             log.warn("TEST fault injection: dropping spmd plan",
                      seq=msg.seq, plan=msg.plan_id)
             return True
@@ -504,6 +527,158 @@ class ReceiverNode:
         fails too), the dest RE-ANNOUNCES: the leader's re-announce path
         re-plans its missing layers, so the transfer is retried instead
         of stranded."""
+        res = self._collect_plan(msg)
+        if res is None:
+            return
+        kind, payload = res
+        if kind == "ingest":
+            self._finalize_one(msg, *payload)
+        else:
+            self._fabric_host_assemble(msg, *payload)
+
+    def _fabric_window(self):
+        """The dest's shared in-flight window: finalize collectives from
+        successive plans stay dispatched together (upload and collective
+        phases overlap across plans) instead of round-tripping per plan;
+        acks fire at retirement, once the device work really finished."""
+        with self._lock:
+            if self._plan_window is None:
+                from ..parallel.fabric import PlanWindow
+
+                self._plan_window = PlanWindow()
+            return self._plan_window
+
+    def _batch_enqueue(self, msg: DevicePlanMsg) -> bool:
+        """Admit a batch-stamped plan into its accumulation group; when
+        the group is complete (or ``FABRIC_BATCH_WAIT`` expires with
+        members missing — a participant's dispatch failed), one thread
+        finishes the WHOLE group as a single batched gather.  Returns
+        False for unbatched plans (the solo path handles them)."""
+        if self._spmd or msg.batch_n <= 1 or not msg.batch_id:
+            return False
+        timer = None
+        msgs = None
+        with self._lock:
+            rec = self._plan_batches.get(msg.batch_id)
+            if rec is not None and rec["fired"]:
+                # Late member of an already-processed batch: straight to
+                # the solo path, NOT another batch wait.  Fired records
+                # stay as tombstones precisely for this check.
+                return False
+            if rec is None:
+                rec = self._plan_batches[msg.batch_id] = {
+                    "msgs": [], "fired": False, "timer": None}
+                timer = threading.Timer(
+                    self.FABRIC_BATCH_WAIT, self._flush_batch,
+                    args=(msg.batch_id,))
+                timer.daemon = True
+                rec["timer"] = timer
+            rec["msgs"].append(msg)
+            if len(rec["msgs"]) >= msg.batch_n:
+                rec["fired"] = True
+                msgs = rec["msgs"]
+                rec["msgs"] = []  # tombstone keeps no message refs
+                if rec["timer"] is not None:
+                    rec["timer"].cancel()
+                    rec["timer"] = None
+                self._prune_batches_locked()
+        if msgs is not None:
+            threading.Thread(
+                target=self._receive_device_batch, args=(msgs,), daemon=True
+            ).start()
+        elif timer is not None:
+            timer.start()
+        return True
+
+    def _prune_batches_locked(self) -> None:
+        """Bound the tombstone map (fired batch records are kept so late
+        members skip the batch wait); oldest fired records drop first.
+        Caller holds ``self._lock``."""
+        fired = [b for b, r in self._plan_batches.items() if r["fired"]]
+        for b in fired[:max(0, len(fired) - 256)]:
+            del self._plan_batches[b]
+
+    def _flush_batch(self, batch_id: str) -> None:
+        """Batch-wait expiry: some member plans never arrived (their
+        dispatch failed and went host-path) — process what did, so the
+        present plans aren't stranded behind the absent ones."""
+        with self._lock:
+            rec = self._plan_batches.get(batch_id)
+            if rec is None or rec["fired"]:
+                return
+            rec["fired"] = True
+            msgs = rec["msgs"]
+            rec["msgs"] = []
+            rec["timer"] = None
+            self._prune_batches_locked()
+        log.warn("fabric plan batch incomplete; processing present plans",
+                 batch=batch_id, got=len(msgs))
+        threading.Thread(
+            target=self._receive_device_batch, args=(msgs,), daemon=True
+        ).start()
+
+    def _receive_device_batch(self, msgs) -> None:
+        """Finish a batch of same-size plans with ONE batched gather
+        (``parallel.ingest.finalize_many``): collect each plan's
+        contributions into its own ingest, then a single collective
+        replicates every layer — per-plan dispatch latency amortizes
+        over the batch.  Any plan that can't ride the batch (duplicate,
+        dead ingest, tiling mismatch) takes its usual solo path."""
+        # Collect members CONCURRENTLY (matching the solo path's
+        # per-plan threads): one member whose contributions never come
+        # must cost the batch one FABRIC_COLLECT_TIMEOUT, not one per
+        # member — the healthy members' collects complete in parallel.
+        ordered = sorted(msgs, key=lambda m: m.layer_id)
+        results: Dict[int, object] = {}
+
+        def collect_one(i, m):
+            results[i] = self._collect_plan(m)
+
+        threads = [threading.Thread(target=collect_one, args=(i, m),
+                                    daemon=True)
+                   for i, m in enumerate(ordered)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ready = []  # (msg, ingest, host_frags, local, upload_s)
+        for i, msg in enumerate(ordered):
+            res = results.get(i)
+            if res is None:
+                continue
+            kind, payload = res
+            if kind == "ingest":
+                ready.append((msg,) + payload)
+            else:
+                self._fabric_host_assemble(msg, *payload)
+        if not ready:
+            return
+        arrs = None
+        if len(ready) > 1:
+            from ..parallel.ingest import finalize_many
+
+            try:
+                arrs = finalize_many([ing for _, ing, _, _, _ in ready])
+            except Exception as e:  # noqa: BLE001 — solo finalize still works
+                log.warn("batched finalize unavailable; per-plan gathers",
+                         batch=msgs[0].batch_id, err=repr(e))
+        if arrs is not None:
+            for (msg, ing, host_frags, local, up_s), arr in zip(ready, arrs):
+                self._submit_fabric_result(msg, ing, host_frags, local,
+                                           arr, up_s, batched=len(ready))
+        else:
+            for msg, ing, host_frags, local, up_s in ready:
+                self._finalize_one(msg, ing, host_frags, local, up_s)
+
+    def _collect_plan(self, msg: DevicePlanMsg):
+        """Collect one plan's contributions into a fresh ingest.
+
+        Returns ``None`` when the plan is fully handled here (a re-plan
+        duplicate drained + re-acked, or a collect failure that already
+        requested a re-plan); ``("ingest", (ingest, host_frags, local,
+        upload_s))`` when the ingest holds every contribution; or
+        ``("host", (local, ingest, host_frags))`` when device staging
+        died and the caller must assemble on host."""
         with self._lock:
             existing = self.layers.get(msg.layer_id)
         if existing is not None:
@@ -523,7 +698,7 @@ class ReceiverNode:
             finally:
                 self.fabric.discard(msg.plan_id)
             self._send_ack(msg.layer_id, existing.meta.location)
-            return
+            return None
 
         local = self._local_coverage(msg.layer_id)
         ingest = None
@@ -545,6 +720,7 @@ class ReceiverNode:
         # arrive after a failure.
         ingest_alive = ingest is not None
         host_frags: list = []
+        upload_s = 0.0
         try:
             try:
                 for off, arr in self.fabric.collect(
@@ -553,7 +729,9 @@ class ReceiverNode:
                 ):
                     if ingest_alive:
                         try:
+                            t_up = _time.monotonic()
                             ingest.write(off, arr)
+                            upload_s += _time.monotonic() - t_up
                             continue
                         except Exception as e:  # noqa: BLE001
                             log.error("fabric ingest write failed; will "
@@ -572,53 +750,100 @@ class ReceiverNode:
             log.error("fabric collect failed; requesting re-plan",
                       layerID=msg.layer_id, plan=msg.plan_id, err=repr(e))
             self._request_replan()
-            return
-        device_arr = None
+            return None
+        if upload_s:
+            from ..utils import trace as _trace
+
+            _trace.add_phase("upload", upload_s)
         if ingest_alive:
-            try:
-                device_arr = ingest.finalize()
-                device_arr.block_until_ready()
-            except Exception as e:  # noqa: BLE001
-                log.error("fabric finalize failed; assembling on host",
-                          layerID=msg.layer_id, err=repr(e))
-        if device_arr is not None:
-            self._fabric_store(msg.layer_id, msg.total_size,
-                               device_arr=device_arr)
-            loc = LayerLocation.HBM
+            return "ingest", (ingest, host_frags, local, upload_s)
+        return "host", (local, ingest, host_frags)
+
+    def _finalize_one(self, msg: DevicePlanMsg, ingest, host_frags, local,
+                      upload_s: float = 0.0) -> None:
+        """Dispatch one plan's finalize gather and hand it to the shared
+        in-flight window (the ack fires at retirement)."""
+        try:
+            device_arr = ingest.finalize()
+        except Exception as e:  # noqa: BLE001
+            log.error("fabric finalize failed; assembling on host",
+                      layerID=msg.layer_id, err=repr(e))
+            self._fabric_host_assemble(msg, local, ingest, host_frags)
+            return
+        self._submit_fabric_result(msg, ingest, host_frags, local,
+                                   device_arr, upload_s, batched=1)
+
+    def _submit_fabric_result(self, msg, ingest, host_frags, local,
+                              device_arr, upload_s: float,
+                              batched: int) -> None:
+        """Queue a dispatched finalize on the in-flight window: the next
+        plan's staging overlaps this collective; store + phase log + ack
+        happen at retirement (bytes proven on device), and a device-side
+        failure falls back to the host assembly path."""
+
+        def on_ready(arr, collective_s):
+            self._fabric_store(msg.layer_id, msg.total_size, device_arr=arr)
             log.info("layer landed over device fabric", layerID=msg.layer_id,
-                     plan=msg.plan_id, total_bytes=msg.total_size)
-        else:
-            buf = bytearray(msg.total_size)
-            covered: list = []
+                     plan=msg.plan_id, total_bytes=msg.total_size,
+                     upload_ms=round(upload_s * 1000, 1),
+                     collective_ms=round(collective_s * 1000, 1),
+                     batched=batched)
+            self._send_ack(msg.layer_id, LayerLocation.HBM)
 
-            def place(off, data):
-                nonlocal covered
-                buf[off : off + len(data)] = data
-                covered = intervals.insert(covered, off, off + len(data))
+        def on_error(e):
+            log.error("fabric collective failed; assembling on host",
+                      layerID=msg.layer_id, plan=msg.plan_id, err=repr(e))
+            self._fabric_host_assemble(msg, local, ingest, host_frags)
 
-            for off, data in local:
-                place(off, data)
-            if ingest is not None:
-                try:
-                    for off, data in ingest.salvage():
-                        place(off, data)
-                except Exception as e:  # noqa: BLE001
-                    log.error("shard-buffer salvage failed",
-                              layerID=msg.layer_id, err=repr(e))
-            for off, data in host_frags:
-                place(off, data)
-            if intervals.covered(covered) < msg.total_size:
-                log.error("host fallback incomplete; requesting re-plan",
-                          layerID=msg.layer_id, plan=msg.plan_id,
-                          have=intervals.covered(covered),
-                          total=msg.total_size)
-                self._request_replan()
+        try:
+            self._fabric_window().submit(
+                msg.plan_id, device_arr, msg.total_size, on_ready, on_error)
+        except Exception as e:  # noqa: BLE001 — window closed: sync path
+            log.error("plan window rejected submit; blocking inline",
+                      plan=msg.plan_id, err=repr(e))
+            try:
+                device_arr.block_until_ready()
+            except Exception as e2:  # noqa: BLE001
+                on_error(e2)
                 return
-            self._fabric_store(msg.layer_id, msg.total_size, host_buf=buf)
-            loc = LayerLocation.INMEM
-            log.warn("layer assembled on host after fabric failure",
-                     layerID=msg.layer_id, plan=msg.plan_id)
-        self._send_ack(msg.layer_id, loc)
+            on_ready(device_arr, 0.0)
+
+    def _fabric_host_assemble(self, msg: DevicePlanMsg, local, ingest,
+                              host_frags) -> None:
+        """Delivery-beats-staging fallback: assemble the layer on host
+        from checkpointed local bytes + salvaged shard buffers + host
+        fragment copies, ack INMEM — or re-announce when even that can't
+        complete."""
+        buf = bytearray(msg.total_size)
+        covered: list = []
+
+        def place(off, data):
+            nonlocal covered
+            buf[off : off + len(data)] = data
+            covered = intervals.insert(covered, off, off + len(data))
+
+        for off, data in local:
+            place(off, data)
+        if ingest is not None:
+            try:
+                for off, data in ingest.salvage():
+                    place(off, data)
+            except Exception as e:  # noqa: BLE001
+                log.error("shard-buffer salvage failed",
+                          layerID=msg.layer_id, err=repr(e))
+        for off, data in host_frags:
+            place(off, data)
+        if intervals.covered(covered) < msg.total_size:
+            log.error("host fallback incomplete; requesting re-plan",
+                      layerID=msg.layer_id, plan=msg.plan_id,
+                      have=intervals.covered(covered),
+                      total=msg.total_size)
+            self._request_replan()
+            return
+        self._fabric_store(msg.layer_id, msg.total_size, host_buf=buf)
+        log.warn("layer assembled on host after fabric failure",
+                 layerID=msg.layer_id, plan=msg.plan_id)
+        self._send_ack(msg.layer_id, LayerLocation.INMEM)
 
     def _request_replan(self) -> None:
         """A delivery this node could not complete (failed fabric plan)
@@ -1056,7 +1281,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
                  checkpoint_dir: str = "", stage_hbm: bool = False,
                  placement=None, boot_cfg=None, fabric=None,
-                 boot_codec: str = "raw", boot_generate: int = 0):
+                 boot_codec: str = "raw", boot_generate: int = 0,
+                 test_drop_plan_seqs=()):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -1114,7 +1340,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                          heartbeat_interval=heartbeat_interval,
                          stage_hbm=stage_hbm, placement=placement,
                          boot_cfg=boot_cfg, fabric=fabric,
-                         boot_codec=boot_codec, boot_generate=boot_generate)
+                         boot_codec=boot_codec, boot_generate=boot_generate,
+                         test_drop_plan_seqs=test_drop_plan_seqs)
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:

@@ -482,11 +482,18 @@ class DevicePlanMsg:
     # seq without entering a collective.  -1 = unordered (the in-process
     # FabricPlane ignores it).
     seq: int = -1
+    # Plan batching (advisory): the leader groups same-dest, same-size
+    # plans and stamps each member with one batch id + the member count;
+    # the dest then finishes the whole group as ONE batched gather
+    # (parallel.ingest.finalize_many) instead of N serial collectives.
+    # Empty/1 = unbatched; receivers that predate the hint ignore it.
+    batch_id: str = ""
+    batch_n: int = 1
 
     msg_type = MsgType.DEVICE_PLAN
 
     def to_payload(self) -> dict:
-        return {
+        payload = {
             "SrcID": self.src_id,
             "PlanID": self.plan_id,
             "LayerID": self.layer_id,
@@ -495,6 +502,10 @@ class DevicePlanMsg:
             "Layout": [[int(s), int(o), int(z)] for s, o, z in self.layout],
             "Seq": self.seq,
         }
+        if self.batch_id:
+            payload["BatchID"] = self.batch_id
+            payload["BatchN"] = self.batch_n
+        return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "DevicePlanMsg":
@@ -506,6 +517,8 @@ class DevicePlanMsg:
             int(d.get("TotalSize", 0)),
             [(int(s), int(o), int(z)) for s, o, z in d.get("Layout") or []],
             int(d.get("Seq", -1)),
+            str(d.get("BatchID", "")),
+            int(d.get("BatchN", 1)),
         )
 
 

@@ -12,6 +12,7 @@ reference's jq pipeline.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 from .logging import log
@@ -38,3 +39,53 @@ def span(name: str, **fields):
     else:
         log.info(name, duration_ms=round((time.monotonic() - t0) * 1000, 3),
                  **fields)
+
+
+# ----------------------------------------------------------- phase markers
+#
+# Cheap in-process phase accounting for the device-fabric plane: the
+# per-plan pipeline (compile / upload / collective / splice) runs across
+# handler threads and async device queues, so wall-clock spans alone
+# can't attribute where a TTD went.  Timed sections call ``add_phase``
+# (or use the ``phase`` context manager); harnesses read the summed
+# totals via ``phase_totals`` — podrun folds them into its summary line,
+# and ``cli/ttd_matrix.py`` renders the fabric row's phase-breakdown
+# table from them.  Sums are thread-time: concurrent phases overlap, so
+# totals may exceed the run's wall clock (the tables say so).
+
+_phase_lock = threading.Lock()
+_phase_s: dict = {}
+_phase_n: dict = {}
+
+
+def add_phase(name: str, seconds: float) -> None:
+    """Accumulate ``seconds`` into the named phase bucket."""
+    with _phase_lock:
+        _phase_s[name] = _phase_s.get(name, 0.0) + seconds
+        _phase_n[name] = _phase_n.get(name, 0) + 1
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Time a block into the named phase bucket (recorded even when the
+    block raises — failed work is still attributable work)."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        add_phase(name, time.monotonic() - t0)
+
+
+def phase_totals() -> dict:
+    """``{name: {"ms": summed_milliseconds, "n": samples}}`` so far."""
+    with _phase_lock:
+        return {
+            name: {"ms": round(s * 1000, 1), "n": _phase_n[name]}
+            for name, s in sorted(_phase_s.items())
+        }
+
+
+def reset_phases() -> None:
+    with _phase_lock:
+        _phase_s.clear()
+        _phase_n.clear()

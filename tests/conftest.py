@@ -23,6 +23,9 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import signal  # noqa: E402
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -31,3 +34,33 @@ def cpu_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
     return devices
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Per-test wall-clock bound: ``@pytest.mark.timeout(seconds)``.
+
+    The multi-process e2e tests spawn real OS processes whose
+    ``communicate(timeout=...)`` calls usually bound them — but a hang
+    BEFORE those calls (a wedged subprocess spawn, a stuck collective
+    in-process) would eat the whole suite budget.  SIGALRM-based, so it
+    needs no plugin and fires even inside a blocking syscall; only
+    armed on the main thread (signals can't interrupt workers)."""
+    marker = item.get_closest_marker("timeout")
+    if (marker and marker.args and hasattr(signal, "SIGALRM")
+            and threading.current_thread() is threading.main_thread()):
+        limit = float(marker.args[0])
+
+        def _alarm(signum, frame):
+            raise TimeoutError(
+                f"{item.nodeid} exceeded its {limit:g}s timeout")
+
+        old = signal.signal(signal.SIGALRM, _alarm)
+        signal.setitimer(signal.ITIMER_REAL, limit)
+        try:
+            yield
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old)
+    else:
+        yield

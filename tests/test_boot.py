@@ -414,7 +414,12 @@ def _compile_log():
 
 
 def _compiled(records, name):
-    return [r for r in records if r.startswith(f"Compiling jit({name})")]
+    # jax's compile-log wording varies by version: "Compiling jit(f) ..."
+    # (current) vs "Compiling f with global shapes..." (0.4.x).  The
+    # cold-boot control in each test keeps this oracle honest.
+    return [r for r in records
+            if r.startswith(f"Compiling jit({name})")
+            or r.startswith(f"Compiling {name} ")]
 
 
 def test_precompile_boot_warms_the_forward_cache():
@@ -527,17 +532,26 @@ def test_repeat_hints_warm_each_distinct_set():
         ts[1].close()
 
 
-def test_precompile_window_evicts_oldest_not_newest():
+def test_precompile_window_evicts_oldest_not_newest(monkeypatch):
     """The hinted-set budget is a sliding window, not a lifetime cap: a
     long-lived receiver crossing many update() re-targets must still
-    warm its NEWEST target — the oldest (superseded) set is evicted."""
+    warm its NEWEST target — the oldest (superseded) set is evicted.
+
+    The warmup itself is stubbed (windowing is what's under test) and
+    each hint drains before the next: real multi-second XLA compiles
+    would trip the SEPARATE saturation guard on slow hosts and make the
+    eviction assertion timing-dependent (observed live: the last hints
+    'boot cold' and never enter the window)."""
     from distributed_llm_dissemination_tpu.runtime import ReceiverNode
+    from distributed_llm_dissemination_tpu.runtime import boot as bmod
     from distributed_llm_dissemination_tpu.runtime import receiver as rmod
     from distributed_llm_dissemination_tpu.transport import InmemTransport
     from distributed_llm_dissemination_tpu.transport.messages import (
         BootHintMsg,
     )
 
+    monkeypatch.setattr(bmod, "precompile_boot",
+                        lambda *a, **k: {"compiled": []})
     ts = {1: InmemTransport("1")}
     r = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
     try:
@@ -545,6 +559,7 @@ def test_precompile_window_evicts_oldest_not_newest():
                 [1, 2, 3], [0, 1, 2, 3]]
         for s in sets:
             r.handle_boot_hint(BootHintMsg(0, s))
+            assert r._precompile_done.wait(timeout=30.0)
         with r._lock:
             assert len(r._precompiled_sets) == rmod._PRECOMPILE_MAX_SETS
             kept = set(r._precompiled_sets)

@@ -495,3 +495,219 @@ def test_hostmem_copy_and_adopt(cpu_devices):
     unaligned[:] = 9
     arr2 = hostmem.adopt_as_device_array(unaligned, cpu_devices[0])
     assert np.asarray(arr2).tobytes() == bytes([9]) * len(unaligned)
+
+
+# ------------------------------------------- compiled-collective cache
+
+
+def _flow_plan(total, sizes, seed=0):
+    """(jobs, frags, full) for a contiguous multi-sender split."""
+    from distributed_llm_dissemination_tpu.sched.flow import FlowJob
+
+    full = np.random.default_rng(seed).integers(
+        0, 256, total, dtype=np.uint8)
+    jobs, frags, off = [], [], 0
+    for i, size in enumerate(sizes):
+        jobs.append(FlowJob(i + 1, 0, size, off, 9))
+        frags.append(full[off : off + size].tobytes())
+        off += size
+    assert off == total
+    return jobs, frags, full
+
+
+def test_bucket_pad_small_set_and_bounded_waste():
+    from distributed_llm_dissemination_tpu.parallel.plan_cache import (
+        bucket_pad,
+    )
+
+    assert bucket_pad(1) == 64 and bucket_pad(64) == 64
+    for pad in (65, 1000, 12345, 1 << 20, (1 << 20) + 1, 436_000_000):
+        b = bucket_pad(pad)
+        assert b >= pad
+        assert b - pad <= pad * 0.125 + 64  # bounded waste
+        assert bucket_pad(b) == b  # idempotent (stable bucket set)
+
+
+def test_same_shape_plans_compile_once(mesh):
+    """(a) Two same-shape plans reuse ONE compiled gather: the second
+    execution is a pure cache hit — zero new compiles."""
+    from distributed_llm_dissemination_tpu.parallel import plan_cache
+    from distributed_llm_dissemination_tpu.parallel.plan import (
+        execute_flow_plan,
+    )
+
+    sizes = [300, 500, 200]
+    jobs, frags1, full1 = _flow_plan(1000, sizes, seed=1)
+    _, frags2, full2 = _flow_plan(1000, sizes, seed=2)
+    plan_cache.reset_stats()
+    out1 = execute_flow_plan(jobs, frags1, mesh, "nodes")
+    after_first = plan_cache.stats()
+    out2 = execute_flow_plan(jobs, frags2, mesh, "nodes")
+    after_second = plan_cache.stats()
+    assert after_first["misses"] >= 1  # the first plan really compiled
+    assert after_second["misses"] == after_first["misses"]  # no recompile
+    assert after_second["hits"] >= after_first["hits"] + 1
+    np.testing.assert_array_equal(np.asarray(out1), full1)
+    np.testing.assert_array_equal(np.asarray(out2), full2)
+
+
+def test_bucketed_pads_share_one_gather_executable(mesh):
+    """Near-equal layers (different totals, same pad bucket) hit the
+    SAME gather executable; only the cheap splice re-specializes."""
+    from distributed_llm_dissemination_tpu.parallel import plan_cache
+    from distributed_llm_dissemination_tpu.parallel.plan import (
+        execute_flow_plan,
+    )
+    from distributed_llm_dissemination_tpu.parallel.plan_cache import (
+        bucket_pad,
+    )
+
+    sizes_a, sizes_b = [400, 400, 200], [392, 392, 208]
+    assert bucket_pad(max(sizes_a)) == bucket_pad(max(sizes_b))
+    jobs_a, frags_a, full_a = _flow_plan(1000, sizes_a, seed=3)
+    jobs_b, frags_b, full_b = _flow_plan(992, sizes_b, seed=4)
+    plan_cache.reset_stats()
+    out_a = execute_flow_plan(jobs_a, frags_a, mesh, "nodes")
+    gather_after_a = plan_cache.GATHER_CACHE.stats()
+    out_b = execute_flow_plan(jobs_b, frags_b, mesh, "nodes")
+    gather_after_b = plan_cache.GATHER_CACHE.stats()
+    assert gather_after_b["misses"] == gather_after_a["misses"]
+    assert gather_after_b["hits"] >= gather_after_a["hits"] + 1
+    np.testing.assert_array_equal(np.asarray(out_a), full_a)
+    np.testing.assert_array_equal(np.asarray(out_b), full_b)
+
+
+def test_cache_output_byte_exact_cold_vs_warm(mesh):
+    """(b) Byte-exact output with the cache cold (fresh compile) vs warm
+    (reused executable) — reuse can never change the bytes."""
+    from distributed_llm_dissemination_tpu.parallel import plan_cache
+    from distributed_llm_dissemination_tpu.parallel.plan import (
+        execute_flow_plan,
+    )
+
+    jobs, frags, full = _flow_plan(1000, [300, 500, 200], seed=5)
+    plan_cache.reset_stats()  # cold: caches emptied
+    cold = np.asarray(execute_flow_plan(jobs, frags, mesh, "nodes"))
+    warm = np.asarray(execute_flow_plan(jobs, frags, mesh, "nodes"))
+    np.testing.assert_array_equal(cold, full)
+    np.testing.assert_array_equal(warm, full)
+    assert plan_cache.stats()["hits"] >= 1  # the warm run really hit
+
+
+def test_cache_keyed_by_sub_mesh(cpu_devices):
+    """(c) Distinct sub-meshes NEVER share an executable (a program is
+    compiled for its device set), and each lands on its own devices."""
+    from distributed_llm_dissemination_tpu.parallel import plan_cache
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    total = 4096
+    want = bytes([(3 * i) % 256 for i in range(total)])
+    plan_cache.reset_stats()
+    arrs = []
+    for devices in (list(cpu_devices[:2]), list(cpu_devices[2:4])):
+        ing = ShardedLayerIngest(total, devices, stream=True)
+        ing.write(0, want)
+        arr = ing.finalize()
+        arr.block_until_ready()
+        assert set(arr.devices()) == set(devices)
+        assert np.asarray(arr).tobytes() == want
+        arrs.append(arr)
+    stats = plan_cache.GATHER_CACHE.stats()
+    # Same tiling shape, different sub-mesh: two compiles, no sharing.
+    assert stats["misses"] >= 2
+
+
+def test_execute_flow_plans_batched_equivalence(mesh):
+    """(d) K same-shape plans through ONE batched gather produce exactly
+    the bytes the per-plan path produces."""
+    from distributed_llm_dissemination_tpu.parallel.plan import (
+        execute_flow_plan,
+        execute_flow_plans,
+    )
+
+    sizes = [300, 500, 200]
+    plans, fulls = [], []
+    for seed in (7, 8, 9):
+        jobs, frags, full = _flow_plan(1000, sizes, seed=seed)
+        plans.append((jobs, frags))
+        fulls.append(full)
+    batched = execute_flow_plans(plans, mesh, "nodes")
+    assert len(batched) == 3
+    for out, full, (jobs, frags) in zip(batched, fulls, plans):
+        solo = execute_flow_plan(jobs, frags, mesh, "nodes")
+        np.testing.assert_array_equal(np.asarray(out), full)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(solo))
+    with pytest.raises(ValueError, match="share one tiling"):
+        jobs_odd, frags_odd, _ = _flow_plan(1000, [500, 300, 200], seed=1)
+        execute_flow_plans([plans[0], (jobs_odd, frags_odd)], mesh, "nodes")
+
+
+def test_finalize_many_batched_ingest_equivalence(cpu_devices):
+    """K same-tiling ingests finish as one batched gather, byte-exact
+    and replicated on the shared device set."""
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+        finalize_many,
+    )
+
+    devices = list(cpu_devices[:3])
+    total = 3000
+    wants, ingests = [], []
+    for k in range(3):
+        want = bytes([(k * 11 + 5 * i) % 256 for i in range(total)])
+        ing = ShardedLayerIngest(total, devices)
+        # Out-of-order fragments, like a real fabric collect.
+        for off, size in [(2000, 1000), (0, 1200), (1200, 800)]:
+            ing.write(off, want[off : off + size])
+        wants.append(want)
+        ingests.append(ing)
+    arrs = finalize_many(ingests)
+    assert len(arrs) == 3
+    for arr, want in zip(arrs, wants):
+        arr.block_until_ready()
+        assert set(arr.devices()) == set(devices)
+        assert np.asarray(arr).tobytes() == want
+
+
+def test_plan_window_retires_in_order_and_reports_errors(cpu_devices):
+    """The in-flight window: completions fire in submit order with the
+    device work proven done; an error routes to on_error, and later
+    plans still retire."""
+    import jax.numpy as jnp
+
+    from distributed_llm_dissemination_tpu.parallel.fabric import PlanWindow
+
+    window = PlanWindow(max_plans=2)
+    done, errs = [], []
+    lock = threading.Lock()
+
+    class Boom:
+        def block_until_ready(self):
+            raise RuntimeError("synthetic device failure")
+
+    try:
+        for i in range(4):
+            arr = jnp.full((64,), i, dtype=jnp.uint8)
+            window.submit(
+                f"p{i}", arr, 64,
+                lambda a, dt, _i=i: done.append(_i) if lock else None,
+                lambda e: errs.append(repr(e)),
+            )
+        window.submit("bad", Boom(), 64,
+                      lambda a, dt: done.append("bad"),
+                      lambda e: errs.append("bad"))
+        arr = jnp.zeros((8,), jnp.uint8)
+        window.submit("after", arr, 8,
+                      lambda a, dt: done.append("after"),
+                      lambda e: errs.append("after"))
+        assert window.drain(timeout=20.0)
+        assert done[:4] == [0, 1, 2, 3]
+        assert done[-1] == "after"
+        assert errs == ["bad"]
+    finally:
+        window.close()
+
+
+import threading  # noqa: E402  (used by the window test above)

@@ -802,6 +802,8 @@ def test_mesh_slices_build_pod_topology():
     assert topo is not None and len(set(topo.slices().values())) == 2
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_podrun_fabric_v5e32_shape(tmp_path):
     """The north-star topology at virtual scale: the shipped v5e-32
     Llama-3-70B pipeline placement (8 hosts x 4 chips, 80 layers, every
@@ -862,3 +864,42 @@ def test_podrun_cli(tmp_path, cpu_devices):
     assert summary["fabric"] is True
     assert summary["ttd_s"] > 0
     assert summary["nodes"] == 4
+
+
+def test_mode3_equal_layers_batch_into_one_gather(cpu_devices):
+    """Plan batching e2e: equal-size layers to one dest get stamped with
+    one batch id by the leader and land byte-exact in HBM — the dest
+    finishes the group through ONE batched gather (finalize_many)."""
+    from distributed_llm_dissemination_tpu.parallel import plan_cache
+
+    ids = range(4)
+    ts = inmem_transports(ids)
+    sent_plans = []
+    for i, t in ts.items():
+        orig = t.send
+
+        def spy(dest, msg, _orig=orig):
+            if isinstance(msg, DevicePlanMsg):
+                sent_plans.append(msg)
+            _orig(dest, msg)
+
+        t.send = spy
+    assignment = {3: {0: LayerMeta(), 1: LayerMeta(), 2: LayerMeta()}}
+    leader, receivers, placement = _fabric_cluster(
+        3, ids, assignment, seeders={1, 2}, transports=ts, layer_count=3)
+    plan_cache.reset_stats()
+    try:
+        run_distribution(leader, receivers, assignment)
+        check_fabric_landing(receivers[-1], placement, [0, 1, 2])
+        # The leader stamped same-dest equal-size plans as one batch.
+        stamped = {m.plan_id: (m.batch_id, m.batch_n) for m in sent_plans
+                   if m.batch_id}
+        assert stamped, "no batch hints on equal-size same-dest plans"
+        batch_ns = {bn for _, bn in stamped.values()}
+        assert max(batch_ns) >= 2
+        # Amortization: one batched gather for the whole group — fewer
+        # compiled collectives than delivered layers.
+        stats = plan_cache.GATHER_CACHE.stats()
+        assert stats["misses"] < 3, stats
+    finally:
+        close_all(leader, receivers, ts)

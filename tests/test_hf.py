@@ -284,6 +284,8 @@ def test_hf_checkpoint_through_int4_disseminate_boot_decode(hf_dir):
             t.close()
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_hf_checkpoint_two_stage_pod_serve(hf_dir, cpu_devices):
     """Composition: a real HF checkpoint disseminated across TWO pipeline
     stages, then ONE forward across the pod from the staged weights —

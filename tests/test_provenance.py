@@ -50,14 +50,18 @@ def test_committed_tpu_smoke_is_current_or_documented_stale():
 
 def test_round5_plus_bench_artifacts_carry_provenance():
     """BENCH_r01..r04 predate the hash (historical records); anything
-    newer must carry the stamp bench.py now embeds."""
+    newer must carry the stamp bench.py now embeds.  The driver wraps
+    bench.py's JSON line under a 'parsed' key, so a freshly captured
+    artifact may carry the hash there — accepted, same provenance."""
     for name in sorted(os.listdir(REPO)):
         m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
         if not m or int(m.group(1)) <= 4:
             continue
         with open(os.path.join(REPO, name)) as f:
             rec = json.load(f)
-        assert "harness_hash" in rec or rec.get("stale"), (
+        parsed = rec.get("parsed") or {}
+        assert ("harness_hash" in rec or rec.get("stale")
+                or "harness_hash" in parsed), (
             f"{name} lacks provenance (harness_hash or stale marker)")
 
 

@@ -476,13 +476,13 @@ def test_two_process_spmd_heals_dropped_plan():
            "-f", conf_path, "-m", "3"]
     recv = lead = None
     try:
-        recv_env = dict(env)
-        # The receiver process drops its FIRST delivery of plan seq 0;
-        # seqs 1-2 queue behind the hole.
-        recv_env["DLD_TEST_DROP_PLAN_SEQS"] = "0"
-        recv = subprocess.Popen(cli + ["-id", "1"], stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, env=recv_env,
-                                text=True)
+        # The receiver process drops its FIRST delivery of plan seq 0
+        # (the EXPLICIT construction-gated fault flag; seqs 1-2 queue
+        # behind the hole).
+        recv = subprocess.Popen(
+            cli + ["-id", "1", "-test-drop-plan-seqs", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
         lead = subprocess.Popen(cli + ["-id", "0"], stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, env=env, text=True)
         lead_out, lead_err = lead.communicate(timeout=240)
@@ -525,11 +525,10 @@ def test_two_process_spmd_heals_dropped_tail_plan():
            "-f", conf_path, "-m", "3"]
     recv = lead = None
     try:
-        recv_env = dict(env)
-        recv_env["DLD_TEST_DROP_PLAN_SEQS"] = "0"
-        recv = subprocess.Popen(cli + ["-id", "1"], stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, env=recv_env,
-                                text=True)
+        recv = subprocess.Popen(
+            cli + ["-id", "1", "-test-drop-plan-seqs", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True)
         lead = subprocess.Popen(cli + ["-id", "0"], stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, env=env, text=True)
         lead_out, lead_err = lead.communicate(timeout=240)
@@ -550,6 +549,8 @@ def test_two_process_spmd_heals_dropped_tail_plan():
             os.remove(conf_path)
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_two_process_spmd_int8_boot():
     """Codec x SPMD x boot: int8 blobs cross two real OS processes as
     collectives, and the dest boots the model from the HBM-landed bytes
@@ -668,6 +669,8 @@ def test_reannounce_disables_spmd_fabric():
         t.close()
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_three_process_spmd_pipeline_serves():
     """Multi-controller serving: three real OS processes (leader seeds,
     two stage assignees), dissemination over the SPMD fabric, stage
@@ -772,6 +775,8 @@ def test_serve_members_accepts_uneven_partition():
         t.close()
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_three_process_spmd_uneven_pod_decode():
     """Multi-controller GENERATION: three real OS processes, an UNEVEN
     stage partition (3/1 of tiny's 4 layers), dissemination over the

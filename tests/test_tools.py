@@ -264,6 +264,8 @@ def test_local_4node_runs_end_to_end(tmp_path):
                 p.kill()
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_boot_cli_generates_tokens(tmp_path):
     """The full CLI serving loop: boot_tiny topology with -gen — the
     assignee boots the delivered model AND decodes tokens; the leader
@@ -338,6 +340,8 @@ def test_genreq_default_seat_skips_client_attached_nodes():
     assert _idle_seat(conf) == 2
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_genreq_cli_serves_inference(tmp_path):
     """The terminal pipeline step over the real CLI: disseminate + boot
     with a -serve window, then cli.genreq asks the booted node for
@@ -417,6 +421,8 @@ def test_genreq_cli_serves_inference(tmp_path):
                 p.kill()
 
 
+@pytest.mark.slow
+@pytest.mark.timeout(420)
 def test_train_cli_disseminates_then_trains_and_resumes(tmp_path):
     """cli.train end to end: mode-3 pod dissemination lands the blobs,
     the delivered bytes become sharded params, AdamW steps run (loss
