@@ -46,6 +46,86 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def measure_loopback_gbps(streams: int = 1, per_stream: int = 192 << 20,
+                          chunk: int = 1 << 20) -> float:
+    """This host's RAW loopback TCP bandwidth: ``streams`` concurrent
+    sender/receiver thread pairs move ``per_stream`` bytes each through
+    plain sockets (sendall / recv_into, no framing, no assembly) and the
+    aggregate bytes-over-wall-clock is the ceiling the physical rows are
+    judged against — the same honest-denominator pattern as bench.py's
+    ``raw_dma_gbps``/``link_fraction``.  Multi-stream probes measure what
+    the STRIPED data plane can draw on; on small hosts the loopback is
+    CPU-bound, so more streams than cores can come back SLOWER than one —
+    which is exactly why the ceiling must be measured, not assumed."""
+    import socket
+    import threading
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def sender():
+        with socket.create_connection(("127.0.0.1", port)) as s:
+            buf = memoryview(bytearray(chunk))
+            sent = 0
+            while sent < per_stream:
+                s.sendall(buf[: min(chunk, per_stream - sent)])
+                sent += chunk
+
+    # Bytes each receiver REALLY got: a sender thread dying mid-stream
+    # (its exception is swallowed by the thread) must shrink the
+    # numerator, not silently inflate the recorded ceiling.
+    delivered = [0] * streams
+
+    def receiver(conn, slot):
+        with conn:
+            buf = bytearray(4 << 20)
+            while delivered[slot] < per_stream:
+                r = conn.recv_into(buf)
+                if r == 0:
+                    return
+                delivered[slot] += r
+
+    senders = [threading.Thread(target=sender, daemon=True)
+               for _ in range(streams)]
+    t0 = time.monotonic()
+    for t in senders:
+        t.start()
+    # A sender whose connect fails dies with its exception swallowed by
+    # the thread; without a timeout the accept() below would then hang
+    # the whole harness before any node process even spawns.  A failed
+    # probe returns 0.0 and the caller skips the ceiling columns.
+    srv.settimeout(30.0)
+    receivers = []
+    accepted = []
+    try:
+        for i in range(streams):
+            conn = srv.accept()[0]
+            accepted.append(conn)
+            receivers.append(threading.Thread(
+                target=receiver, args=(conn, i)))
+    except OSError:
+        print("loopback ceiling probe failed (accept timeout); "
+              "skipping ceiling columns", file=sys.stderr)
+        # Release everything or the stuck senders outlive the probe:
+        # closing the accepted conns fails their peers' sendall, and
+        # closing the listener fails any connect still retrying.
+        for conn in accepted:
+            conn.close()
+        srv.close()
+        for t in senders:
+            t.join(timeout=5.0)
+        return 0.0
+    for t in receivers:
+        t.start()
+    for t in receivers:
+        t.join()
+    dt = time.monotonic() - t0
+    for t in senders:
+        t.join(timeout=10.0)
+    srv.close()
+    return round(sum(delivered) / max(dt, 1e-9) / 1e9, 3)
+
+
 def _cpu_env() -> dict:
     from distributed_llm_dissemination_tpu.utils.env import cpu_pinned_env
 
@@ -503,16 +583,23 @@ def _physical_phases(dest_log: str) -> dict:
     went, per phase (VERDICT r4 asked exactly this of the 19.6 s run).
 
     - ``wire_recv_ms``: summed per-fragment socket receive durations
-      (the transport's own measurement, node.go:1180-1186 parity).
+      (the transport's own measurement, node.go:1180-1186 parity);
+      striped fragments log one entry per stripe, so concurrent stripes
+      each contribute their own wall time (thread-time sum).
     - ``assembly_copy_ms`` / ``ingest_write_ms``: summed host memcpy
       and device-ingest write time (receiver phase accumulators).
     - ``recv_span_ms``: max per-layer wall span first-fragment→complete.
     - ``stage_ms``: summed HBM staging (ingest finalize / bulk put).
     - ``boot_ms``: the model boot (startup hook → engine ready).
+    - ``fragments`` / ``placed_fragments``: delivered fragments (stripes
+      included) and how many of them the zero-copy sink landed directly
+      in the reassembly buffer — the receive-to-stage overlap evidence:
+      a placed fragment's bytes are already where staging adopts them,
+      so its device-ingest accounting runs DURING the wire receive.
     """
     wire = copy = ingest = stage = boot = 0.0
     span = 0.0
-    layers = 0
+    layers = frags = placed = 0
     with open(dest_log) as f:
         for line in f:
             try:
@@ -526,6 +613,8 @@ def _physical_phases(dest_log: str) -> dict:
                 copy += float(rec.get("copy_ms", 0.0))
                 ingest += float(rec.get("ingest_ms", 0.0))
                 span = max(span, float(rec.get("recv_span_ms", 0.0)))
+                frags += int(rec.get("fragments", 0))
+                placed += int(rec.get("placed_fragments", 0))
                 layers += 1
             elif m == "layer staged to HBM":
                 stage += float(rec.get("stage_ms", 0.0))
@@ -533,6 +622,8 @@ def _physical_phases(dest_log: str) -> dict:
                 boot += float(rec.get("ttft_ms", 0.0))
     return {
         "layers": layers,
+        "fragments": frags,
+        "placed_fragments": placed,
         "wire_recv_ms": round(wire, 1),
         "assembly_copy_ms": round(copy, 1),
         "ingest_write_ms": round(ingest, 1),
@@ -551,6 +642,17 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
     on the recorded scenario itself)."""
     backend = _live_backend()
     env = dict(os.environ) if backend else _cpu_env()
+    # The host's measured loopback ceiling: one raw stream, and the
+    # striped data plane's stream count — the denominator that makes the
+    # achieved rate attributable (bench.py's raw_dma_gbps/link_fraction
+    # pattern, applied to the wire).  Probed BEFORE the node processes
+    # spawn: the run saturates small hosts end to end (and the dest's
+    # boot outlives the TTD), so a probe next to live processes would
+    # understate the ceiling and flatter the fraction.
+    from ..transport.tcp import STRIPE_COUNT
+
+    loop_raw = measure_loopback_gbps(1)
+    loop_striped = measure_loopback_gbps(max(2, STRIPE_COUNT))
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "physical_3node.json")
         conf, layer_bytes, total = physical_config()
@@ -616,6 +718,7 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
                 raise RuntimeError(
                     f"no TTD in physical run output: {text[-2000:]!r}")
             ttd = float(ttd_m.group(1))
+            ceiling = max(loop_raw, loop_striped)
             rec = {
                 "scenario": "physical_3node_llama8b-d4@416MiB-layers",
                 "mode": 3, "hbm": True,
@@ -624,7 +727,17 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
                 "total_bytes": total,
                 "ttd_s": round(ttd, 4),
                 "achieved_gbps": round(total / ttd / 1e9, 3),
+                "stripes": STRIPE_COUNT,
             }
+            # 0.0 = that probe arm failed (accept timeout): record only
+            # the arms that really measured, never a bogus zero ceiling.
+            if loop_raw > 0:
+                rec["loopback_raw_gbps"] = loop_raw
+            if loop_striped > 0:
+                rec["loopback_striped_gbps"] = loop_striped
+            if ceiling > 0:
+                rec["link_fraction"] = round(
+                    total / ttd / 1e9 / ceiling, 3)
             if ttft_m:
                 rec["ttft_s"] = round(float(ttft_m.group(1)), 4)
             try:
@@ -756,18 +869,83 @@ def to_markdown(results: dict) -> str:
             "per-layer bytes `bench.py` measures (full 8B layer shape; "
             "vocab-trimmed head so it doesn't dwarf the layers) — to one "
             "cold dest that stages into device memory and boots "
-            "(TTFT).  Loopback TCP; the achieved rate is the dest's "
-            "whole-model ingest, network receive + device staging "
-            "end to end.",
+            "(TTFT).  Loopback TCP, STRIPED: each flow fragment past "
+            "the transport's stripe threshold rides "
+            f"{phys.get('stripes', '?')} pooled data connections in "
+            "parallel (`transport/tcp.py`).  The achieved rate is the "
+            "dest's whole-model ingest, network receive + device "
+            "staging end to end; the loopback ceiling columns are this "
+            "host's MEASURED raw socket bandwidth (1 stream / the "
+            "stripe count), probed next to the run — the fraction makes "
+            "the number attributable and regression-guarded the same "
+            "way bench.py's `link_fraction` does for the device hop.",
             "",
-            "| scenario | backend | TTD | TTFT | achieved ingest |",
-            "|---|---|---|---|---|",
+            "| scenario | backend | TTD | TTFT | achieved ingest | "
+            "loopback ceiling (1s / striped) | link fraction |",
+            "|---|---|---|---|---|---|---|",
             f"| {phys['scenario']} | {phys['backend']} | "
             f"{phys['ttd_s']}s | "
             + (f"{phys['ttft_s']}s" if "ttft_s" in phys else "—")
-            + f" | {phys['achieved_gbps']} GB/s |",
+            + f" | {phys['achieved_gbps']} GB/s | "
+            + (f"{phys.get('loopback_raw_gbps', '—')} / "
+               f"{phys.get('loopback_striped_gbps', '—')} GB/s"
+               if ("loopback_raw_gbps" in phys
+                   or "loopback_striped_gbps" in phys) else "—")
+            + " | "
+            + (f"{phys['link_fraction']}"
+               if "link_fraction" in phys else "—")
+            + " |",
             "",
         ]
+        prior = phys.get("prior")
+        same_backend = (not prior
+                        or prior.get("backend", phys.get("backend"))
+                        == phys.get("backend"))
+        if prior and "stripes" not in prior and same_backend:
+            # Only a PRE-striping, SAME-backend prior gets the striping
+            # attribution — a later regeneration carries a post-striping
+            # prior (it has a "stripes" field), and a backend flip
+            # (cpu-fallback vs live accelerator) would otherwise be
+            # reported as this PR's speedup.
+            lines += [
+                "**Before/after (the striped-data-plane PR):** the "
+                f"prior recorded row was {prior['ttd_s']}s at "
+                f"{prior['achieved_gbps']} GB/s — each (seeder, layer) "
+                "transfer was ONE serial socket stream.  With "
+                "multi-socket striping, scatter-gather framing, and "
+                "receive-to-stage streaming the re-measured row is "
+                f"{phys['ttd_s']}s at {phys['achieved_gbps']} GB/s "
+                f"({round(phys['achieved_gbps'] / max(prior['achieved_gbps'], 1e-9), 2)}x), "
+                "with the remaining gap to the measured loopback "
+                "ceiling attributed by the phase table below.",
+                "",
+            ]
+        elif prior:
+            lines += [
+                f"Previous recorded row: {prior['ttd_s']}s at "
+                f"{prior['achieved_gbps']} GB/s (run-to-run drift on "
+                "this host is dominated by its bursty CPU budget — "
+                "compare link fractions, not absolute rates).",
+                "",
+            ]
+        wire = phys.get("wire_only")
+        if wire:
+            lines += [
+                "Wire-only sibling (same topology, `-boot none`, "
+                "measured for attribution): "
+                f"TTD {wire['ttd_s']}s = {wire['achieved_gbps']} GB/s.  "
+                "The delta to the recorded row is the boot PRECOMPILE "
+                "overlap (BootHint fires at distribution start, so XLA "
+                "compiles the forward WHILE the bytes are on the wire) "
+                "— free concurrency on multi-core hosts, but on this "
+                "2-core container the compile threads and the wire "
+                "share cores, which is a host property, not a data-"
+                "plane regression; the ceiling columns carry the same "
+                "caveat (the container's CPU budget is bursty, so the "
+                "raw-socket ceiling itself drifts several-fold between "
+                "probes).",
+                "",
+            ]
         fab = results.get("physical_fabric")
         if fab:
             frags = fab.get("tcp_layer_fragments",
@@ -868,10 +1046,11 @@ def to_markdown(results: dict) -> str:
         if ph:
             lines += [
                 "Phase breakdown from the dest's log (thread-time sums; "
-                "concurrent fragment handlers overlap, so sums can "
-                "exceed the TTD wall clock).  Zero copy_ms/ingest_ms = "
-                "the zero-copy receive landed socket bytes directly in "
-                "the reassembly buffer and staging adopted that buffer:",
+                "concurrent fragment/stripe handlers overlap, so sums "
+                "can exceed the TTD wall clock).  Zero copy_ms/"
+                "ingest_ms = the zero-copy receive landed socket bytes "
+                "directly in the reassembly buffer and staging adopted "
+                "that buffer:",
                 "",
                 "| wire recv | assembly copy | ingest write | stage | "
                 "boot |",
@@ -881,6 +1060,25 @@ def to_markdown(results: dict) -> str:
                 f"{ph['boot_ms']}ms |",
                 "",
             ]
+            if "fragments" in ph:
+                span = ph.get("max_layer_recv_span_ms", 0.0)
+                tail = ph.get("stage_ms", 0.0)
+                lines += [
+                    "Receive/stage overlap: fragments (stripes "
+                    "included) whose bytes the sink PLACED directly in "
+                    "the reassembly buffer stage as offsets complete — "
+                    "their device-side accounting runs during the wire "
+                    "receive, so only the post-completion `stage tail` "
+                    "is serial with the wire:",
+                    "",
+                    "| fragments | placed (zero-copy) | in-recv ingest "
+                    "| max layer recv span | stage tail after recv |",
+                    "|---|---|---|---|---|",
+                    f"| {ph['fragments']} | {ph['placed_fragments']} | "
+                    f"{ph['ingest_write_ms']}ms | {span}ms | "
+                    f"{tail}ms |",
+                    "",
+                ]
     baseline = results.get("baseline_scenarios")
     if baseline:
         lines += [
@@ -936,7 +1134,31 @@ def main(argv=None) -> int:
         results["baseline_scenarios"] = prior_doc["baseline_scenarios"]
     if args.physical:
         results["physical"] = run_physical(trace_out=args.trace)
+        # Before/after: carry the superseded record's headline numbers so
+        # the regenerated markdown states the delta it claims.
+        prior_phys = (prior_doc or {}).get("physical")
+        if prior_phys and "ttd_s" in prior_phys:
+            results["physical"]["prior"] = {
+                "ttd_s": prior_phys["ttd_s"],
+                "achieved_gbps": prior_phys["achieved_gbps"],
+                "backend": prior_phys.get("backend", ""),
+            }
+            if "stripes" in prior_phys:
+                # Marks the prior as post-striping: the markdown then
+                # reports plain run-to-run drift instead of attributing
+                # the delta to the striping PR.
+                results["physical"]["prior"]["stripes"] = (
+                    prior_phys["stripes"])
+        if prior_phys and prior_phys.get("wire_only"):
+            # Hand-measured attribution sibling (-boot none): carried
+            # forward like baseline_scenarios, not re-measured here.
+            results["physical"].setdefault(
+                "wire_only", prior_phys["wire_only"])
         results["physical_fabric"] = run_physical_fabric()
+        fab_prior = (prior_doc or {}).get("physical_fabric") or {}
+        if fab_prior.get("prior"):
+            results["physical_fabric"].setdefault(
+                "prior", fab_prior["prior"])
     else:
         for key in ("physical", "physical_fabric"):
             if prior_doc and prior_doc.get(key):

@@ -2,5 +2,6 @@ from .reassembly import (  # noqa: F401
     alloc_layer_buffer,
     assemble_fragments,
     split_offsets,
+    stripe_offsets,
     write_fragment,
 )

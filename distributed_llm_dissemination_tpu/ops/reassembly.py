@@ -8,6 +8,13 @@ preallocated HBM buffer with ``lax.dynamic_update_slice`` under donation,
 so shards arriving from different seeders land at their element offsets
 without host round-trips.
 
+Import-light on purpose: the split helpers (``split_offsets``,
+``stripe_offsets``) are pure integer arithmetic shared with the HOST data
+plane — ``transport/tcp.py`` tiles striped sends with ``stripe_offsets``
+— so jax is imported lazily, only when a device write actually happens.
+A host-only node (a pure seeder, a control-plane process) can import
+this module without paying for (or even having) a jax backend.
+
 TPU index-width constraint: XLA's TPU backend rejects dynamic-update-slice
 on shapes whose indices exceed 32 bits ("While rewriting computation to not
 contain X64 element types..."), and on a buffer longer than 2^31-1 elements
@@ -23,32 +30,39 @@ large power-of-two factors).
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import functools
+from typing import List, Sequence, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 _INT32_MAX = np.iinfo(np.int32).max
 _MAX_SEG = 1 << 30  # elements per row of the segmented layout
 
 
-# Donation lets XLA write fragments into the existing HBM buffer instead of
-# allocating a copy per fragment — essential at multi-GiB layer sizes.
-_write_1d = jax.jit(
-    lambda buf, frag, off: lax.dynamic_update_slice(buf, frag, (off,)),
-    donate_argnums=(0,),
-)
+@functools.lru_cache(maxsize=1)
+def _writers():
+    """The jitted fragment writers, built on first device write (lazy so
+    importing this module never initializes a jax backend).
 
-# Segmented variant: 2-D buffer, (row, col) int32 indices.  The update is a
-# (1, n) row slice, so both clamp bounds (rows-1, seg-n) fit int32.
-_write_2d = jax.jit(
-    lambda buf, frag, row, col: lax.dynamic_update_slice(
-        buf, frag[None, :], (row, col)
-    ),
-    donate_argnums=(0,),
-)
+    Donation lets XLA write fragments into the existing HBM buffer
+    instead of allocating a copy per fragment — essential at multi-GiB
+    layer sizes.  The segmented variant takes (row, col) int32 indices on
+    a 2-D buffer; the update is a (1, n) row slice, so both clamp bounds
+    (rows-1, seg-n) fit int32."""
+    import jax
+    from jax import lax
+
+    write_1d = jax.jit(
+        lambda buf, frag, off: lax.dynamic_update_slice(buf, frag, (off,)),
+        donate_argnums=(0,),
+    )
+    write_2d = jax.jit(
+        lambda buf, frag, row, col: lax.dynamic_update_slice(
+            buf, frag[None, :], (row, col)
+        ),
+        donate_argnums=(0,),
+    )
+    return write_1d, write_2d
 
 
 def _pick_seg(n_elements: int) -> int:
@@ -66,12 +80,14 @@ class LayerBuffer:
     its absolute element offset; ``array()`` returns the contiguous 1-D
     layer (a free reshape — no copy, no re-layout)."""
 
-    def __init__(self, n_elements: int, dtype=jnp.bfloat16, sharding=None,
+    def __init__(self, n_elements: int, dtype=None, sharding=None,
                  max_flat: int = _INT32_MAX, seg_cap: int = _MAX_SEG):
         """``max_flat``/``seg_cap`` exist so tests can force the segmented
         layout at small sizes; production callers use the defaults."""
+        import jax.numpy as jnp
+
         self.n_elements = n_elements
-        self.dtype = dtype
+        self.dtype = jnp.bfloat16 if dtype is None else dtype
         if n_elements <= max_flat:
             self.seg = 0  # flat mode
             shape: Tuple[int, ...] = (n_elements,)
@@ -95,27 +111,31 @@ class LayerBuffer:
                 )
             shape = (rows, self.seg)
         if sharding is not None:
-            self.buf = jnp.zeros(shape, dtype=dtype, device=sharding)
+            self.buf = jnp.zeros(shape, dtype=self.dtype, device=sharding)
         else:
-            self.buf = jnp.zeros(shape, dtype=dtype)
+            self.buf = jnp.zeros(shape, dtype=self.dtype)
 
-    def write(self, offset: int, frag: jax.Array) -> None:
+    def write(self, offset: int, frag) -> None:
         """Write ``frag`` at absolute element ``offset`` (donating the
         previous buffer).  Fragments may span row boundaries; each
         row-aligned piece is one 32-bit-indexed update."""
+        import jax.numpy as jnp
+        from jax import lax
+
         if offset < 0 or offset + frag.size > self.n_elements:
             raise ValueError(
                 f"fragment [{offset}, {offset + frag.size}) outside layer "
                 f"of {self.n_elements} elements"
             )
+        write_1d, write_2d = _writers()
         if self.seg == 0:
-            self.buf = _write_1d(self.buf, frag, jnp.asarray(offset, jnp.int32))
+            self.buf = write_1d(self.buf, frag, jnp.asarray(offset, jnp.int32))
             return
         pos = 0
         while pos < frag.size:
             row, col = divmod(offset + pos, self.seg)
             n = min(frag.size - pos, self.seg - col)
-            self.buf = _write_2d(
+            self.buf = write_2d(
                 self.buf,
                 lax.dynamic_slice(frag, (pos,), (n,)) if (pos or n != frag.size) else frag,
                 jnp.asarray(row, jnp.int32),
@@ -123,23 +143,25 @@ class LayerBuffer:
             )
             pos += n
 
-    def array(self) -> jax.Array:
+    def array(self):
         """The assembled contiguous layer (free reshape in segmented mode)."""
         return self.buf if self.seg == 0 else self.buf.reshape(self.n_elements)
 
 
-def alloc_layer_buffer(n_elements: int, dtype=jnp.bfloat16, sharding=None) -> LayerBuffer:
+def alloc_layer_buffer(n_elements: int, dtype=None, sharding=None) -> LayerBuffer:
     """Preallocate the reassembly target in HBM."""
     return LayerBuffer(n_elements, dtype, sharding)
 
 
-def write_fragment(buf, frag: jax.Array, offset: int):
+def write_fragment(buf, frag, offset: int):
     """Write one fragment into ``buf``, donating the previous storage.
 
     ``buf`` may be a ``LayerBuffer`` (any size — the ``alloc_layer_buffer``
     return type) or a flat jax.Array of < 2^31 elements; a flat giant
     buffer cannot be dynamically indexed on TPU at all (module docstring).
     Returns the updated buffer, same type as given."""
+    import jax.numpy as jnp
+
     if isinstance(buf, LayerBuffer):
         buf.write(offset, frag)
         return buf
@@ -155,15 +177,16 @@ def write_fragment(buf, frag: jax.Array, offset: int):
             f"fragment [{offset}, {offset + frag.size}) outside buffer "
             f"of {buf.size} elements"
         )
-    return _write_1d(buf, frag, jnp.asarray(offset, jnp.int32))
+    write_1d, _ = _writers()
+    return write_1d(buf, frag, jnp.asarray(offset, jnp.int32))
 
 
 def assemble_fragments(
     n_elements: int,
-    fragments: Sequence[Tuple[int, jax.Array]],
-    dtype=jnp.bfloat16,
+    fragments: Sequence[Tuple[int, object]],
+    dtype=None,
     sharding=None,
-) -> jax.Array:
+):
     """Build a full layer in HBM from (element_offset, fragment) pairs —
     the device-side equivalent of the receiver's byte-range reassembly."""
     buf = LayerBuffer(n_elements, dtype, sharding)
@@ -184,3 +207,19 @@ def split_offsets(total: int, parts: int) -> Sequence[Tuple[int, int]]:
         spans.append((off, size))
         off += size
     return spans
+
+
+def stripe_offsets(total: int, parts: int,
+                   min_size: int = 1) -> List[Tuple[int, int]]:
+    """``split_offsets`` with a floor: the even tiling of ``total`` into
+    at most ``parts`` spans, each at least ``min_size`` (the whole thing
+    as one span when ``total < 2 * min_size``).  The stripe split of the
+    TCP data plane — a payload too small to give every stripe a
+    meaningful run of bytes just uses fewer stripes, so striping can
+    never fragment a transfer into slow-start-dominated slivers."""
+    if total <= 0:
+        return []
+    if min_size > 0:
+        parts = min(parts, total // min_size)
+    parts = max(1, parts)
+    return [s for s in split_offsets(total, parts) if s[1] > 0]

@@ -223,25 +223,28 @@ class LeaderNode:
 
     def _plan_watchdog(self) -> None:
         """Tail-gap liveness (the receiver-side gap report's blind
-        spot): re-broadcast unacked SPMD plans, cancel after the retry
-        budget.  Duplicate deliveries are free — the executor returns
-        the settled/pending handle for any seq it already saw.
+        spot): re-broadcast unacked SPMD plans on a timer.
 
-        RESIDUAL WEDGE (known, documented): the give-up cancel only
-        advances processes that have NOT yet entered the seq's
-        collective.  Peers already blocked INSIDE the original plan's
-        collective (they received the plan; some other participant
-        didn't) cannot be recalled — a lockstep collective has no abort
-        — so they stay wedged until the failure detector declares a
-        participant crashed and ``crash()`` disables the fabric, or
-        their own plan-wait timeout fires and the dest re-plans over the
-        host path.  The cancel is therefore a liveness aid for the GAP
-        process, not a pod-wide rollback; see docs/fabric.md
-        ("Failure domain and the cancel wedge")."""
+        The give-up CANCEL is crash-gated (round-5 advice residual): a
+        cancel advances processes that never entered the seq's
+        collective — but peers already blocked INSIDE it (they received
+        the plan; some other participant didn't, or the dest is merely
+        slow) cannot be recalled, so a cancel fired while the dest is
+        still alive would desynchronize the lockstep: the gap process
+        skips the seq while its peers sit in the collective waiting for
+        it.  So past the retry budget the watchdog only keeps
+        re-broadcasting (duplicate deliveries are free — the executor
+        returns the settled/pending handle for any seq it already saw)
+        until the failure detector declares a participant crashed and
+        ``crash()`` disables the fabric; ``crash()`` then cancels every
+        still-watched seq so gap processes stop waiting on plans that
+        can no longer execute.  See docs/fabric.md ("Failure domain and
+        the cancel wedge")."""
         while not self._watch_stop.wait(self.PLAN_WATCH_PERIOD):
             now = time.monotonic()
             due = []
             with self._lock:
+                fabric_down = self._fabric_disabled
                 for seq, rec in list(self._plan_watch.items()):
                     if now - rec["t"] < self.PLAN_ACK_TIMEOUT:
                         continue
@@ -250,8 +253,18 @@ class LeaderNode:
                         del self._plan_watch[seq]
                         continue
                     if rec["retries"] >= self.PLAN_REBROADCASTS:
-                        del self._plan_watch[seq]
-                        due.append((seq, msg, True))
+                        if fabric_down:
+                            # Crash declared: safe (and necessary) to
+                            # advance the gap processes past the seq.
+                            del self._plan_watch[seq]
+                            due.append((seq, msg, True))
+                        else:
+                            # Dest alive (no crash declared): a cancel
+                            # here could strand peers inside the
+                            # collective.  Keep re-broadcasting at the
+                            # ack-timeout cadence instead.
+                            rec["t"] = now
+                            due.append((seq, msg, False))
                     else:
                         rec["retries"] += 1
                         rec["t"] = now
@@ -260,26 +273,34 @@ class LeaderNode:
                                     | {self.node.my_id})
             for seq, msg, give_up in due:
                 if give_up:
-                    log.error("spmd plan unacked after re-broadcasts; "
+                    log.error("spmd plan unacked and fabric disabled; "
                               "cancelling seq (dest re-announce will "
                               "re-plan the bytes)", seq=seq,
                               plan=msg.plan_id)
-                    cancel = DevicePlanMsg(self.node.my_id, msg.plan_id,
-                                           msg.layer_id, msg.dest_id, 0,
-                                           [], seq=seq)
-                    with self._lock:
-                        self._sent_plans[seq] = cancel
-                    out = cancel
+                    out = self._make_plan_cancel(seq, msg)
                 else:
                     log.warn("re-broadcasting unacked spmd plan",
                              seq=seq, plan=msg.plan_id)
                     out = msg
-                for r in sorted(set(recipients) | {msg.dest_id}):
-                    try:
-                        self.node.transport.send(r, out)
-                    except (OSError, KeyError) as e:
-                        log.error("plan watchdog send failed", seq=seq,
-                                  dest=r, err=repr(e))
+                self._send_plan_to(out, set(recipients) | {msg.dest_id},
+                                   seq)
+
+    def _make_plan_cancel(self, seq: int, msg: DevicePlanMsg) -> DevicePlanMsg:
+        """Build (and retain for gap re-sends) the cancellation that
+        supersedes ``seq``."""
+        cancel = DevicePlanMsg(self.node.my_id, msg.plan_id,
+                               msg.layer_id, msg.dest_id, 0, [], seq=seq)
+        with self._lock:
+            self._sent_plans[seq] = cancel
+        return cancel
+
+    def _send_plan_to(self, out: DevicePlanMsg, recipients, seq: int) -> None:
+        for r in sorted(recipients):
+            try:
+                self.node.transport.send(r, out)
+            except (OSError, KeyError) as e:
+                log.error("plan watchdog send failed", seq=seq,
+                          dest=r, err=repr(e))
 
     def _register_handlers(self) -> None:
         self.loop.register(AnnounceMsg, self.handle_announce)
@@ -1015,14 +1036,24 @@ class LeaderNode:
                       node=node_id)
             self._fabric_disabled = True
         self.detector.forget(node_id)
+        cancels = []
         with self._lock:
             self.status.pop(node_id, None)
-            # Stop chasing acks a dead dest will never send (the fabric
-            # is disabled anyway; its layers re-plan over the host path).
-            for seq in list(self._plan_watch):
-                plan = self._sent_plans.get(seq)
-                if plan is not None and plan.dest_id == node_id:
+            # The crash broke pod lockstep for good (fabric disabled
+            # above): every still-unacked plan can no longer execute, so
+            # CANCEL each watched seq — this is the one moment the
+            # give-up cancel is safe AND useful (peers stuck inside a
+            # collective are already waiting on the dead participant;
+            # gap processes must not wait forever on plans that will
+            # never run).  The watchdog itself never cancels while no
+            # crash is declared (_plan_watchdog).
+            if self._spmd:
+                for seq in list(self._plan_watch):
+                    plan = self._sent_plans.get(seq)
                     del self._plan_watch[seq]
+                    if plan is not None and plan.layout:
+                        cancels.append((seq, plan))
+            recipients = set(self.status) | {self.node.my_id}
             dropped = self.assignment.pop(node_id, None)
             if dropped:
                 # Remembered so a restarted incarnation that re-announces
@@ -1039,6 +1070,11 @@ class LeaderNode:
                 self._booted.pop(node_id, None)
                 if dropped:
                     self._boot_kinds[node_id] = "crashed"
+        for seq, plan in cancels:
+            log.error("cancelling unacked spmd plan after declared crash",
+                      seq=seq, plan=plan.plan_id, crashed=node_id)
+            self._send_plan_to(self._make_plan_cancel(seq, plan),
+                               recipients | {plan.dest_id}, seq)
         if dropped:
             log.error("crashed node was an assignee; dropping its layers",
                       node=node_id, layers=sorted(dropped))

@@ -1572,9 +1572,15 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 else:
                     tok, claims = cov.claim(
                         frag.offset, frag.offset + frag.data_size)
-                self._phase.setdefault(lid, {
+                ph = self._phase.setdefault(lid, {
                     "t0": _time.monotonic(), "copy_s": 0.0,
-                    "ingest_s": 0.0, "frags": 0})["frags"] += 1
+                    "ingest_s": 0.0, "frags": 0, "placed": 0})
+                ph["frags"] += 1
+                if placed:
+                    # Zero-copy receive: the transport landed this
+                    # fragment (possibly one STRIPE of a striped
+                    # transfer) directly in the reassembly buffer.
+                    ph["placed"] = ph.get("placed", 0) + 1
                 self._partial[lid] = (buf, cov)
                 self._partial_total[lid] = msg.total_size
                 # Journaled OUTSIDE the lock below (two fsyncs per
@@ -1719,6 +1725,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 "copy_ms": round(ph["copy_s"] * 1000, 1),
                 "ingest_ms": round(ph["ingest_s"] * 1000, 1),
                 "fragments": ph["frags"],
+                "placed_fragments": ph.get("placed", 0),
                 "gbps": round(total / max(span, 1e-9) / 1e9, 3),
             }
         log.info("layer fully received", layer=lid, total_bytes=total,

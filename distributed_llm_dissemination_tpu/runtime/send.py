@@ -8,6 +8,7 @@ Re-design of the reference's send helpers: ``sendLayer``
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Dict
 
@@ -30,7 +31,25 @@ from .node import Node
 # fragments give receivers incremental progress: each one advances the
 # interval accounting and the durable checkpoint journal, so a transfer
 # killed mid-job loses at most one fragment, not the whole job.
-FLOW_FRAGMENT_BYTES = 16 << 20
+FLOW_FRAGMENT_BYTES = int(os.environ.get("DLD_FLOW_FRAGMENT_BYTES",
+                                         str(16 << 20)))
+
+
+def _fragment_bytes(rate: int) -> int:
+    """Fragment size for one flow job.  Jobs whose commanded rate the
+    transport will STRIPE (unlimited, or a budget-scale allotment —
+    tcp.STRIPE_PACED_MIN_RATE) use STRIPE_COUNT-times larger fragments:
+    each stripe is delivered/journaled/device-ingested as its own
+    fragment, so the progress granularity receivers see stays
+    ~FLOW_FRAGMENT_BYTES while the larger fragment amortizes the
+    per-fragment barrier (all of a fragment's stripes land before the
+    next fragment starts).  Slow modeled sources never stripe, so they
+    keep the exact 16 MiB loss/progress granularity."""
+    from ..transport.tcp import STRIPE_COUNT, STRIPE_PACED_MIN_RATE
+
+    if rate == 0 or rate >= STRIPE_PACED_MIN_RATE:
+        return FLOW_FRAGMENT_BYTES * max(1, STRIPE_COUNT)
+    return FLOW_FRAGMENT_BYTES
 
 
 def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc) -> None:
@@ -266,9 +285,10 @@ def handle_flow_retransmit(
     if send_loc == LayerLocation.HBM and layer.ensure_host_bytes():
         send_loc = LayerLocation.INMEM
     if send_loc in (LayerLocation.INMEM, LayerLocation.DISK):
+        frag_bytes = _fragment_bytes(msg.rate)
         sent = 0
         while sent < msg.data_size:
-            n = min(FLOW_FRAGMENT_BYTES, msg.data_size - sent)
+            n = min(frag_bytes, msg.data_size - sent)
             partial = LayerSrc(
                 inmem_data=layer.inmem_data,
                 fp=layer.fp,

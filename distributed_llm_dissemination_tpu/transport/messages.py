@@ -197,34 +197,70 @@ class LayerMsg:
     Never JSON-serialized whole: the transport writes a ``LayerHeader``
     then streams the bytes.  ``total_size`` is the full layer size so a
     receiver can account partial transfers (mode 3).
+
+    ``stripe_idx/stripe_n/stripe_off`` are ADVISORY stripe provenance
+    (defaults = un-striped): a TCP sender may split one logical payload
+    into N stripes riding N pooled data connections in parallel
+    (``transport/tcp.py``); a receiving transport stamps the delivered
+    fragment with which stripe it was.  Consumers never need them for
+    correctness — each stripe is a well-formed byte-range fragment that
+    the existing interval reassembly absorbs — they exist for logs,
+    tests, and transport-level regrouping.
     """
 
     src_id: NodeID
     layer_id: LayerID
     layer_src: LayerSrc
     total_size: int
+    stripe_idx: int = 0
+    stripe_n: int = 1
+    stripe_off: int = 0
 
     msg_type = MsgType.LAYER
 
 
 @dataclasses.dataclass
 class LayerHeader:
-    """Data-plane preamble (transport.go:47-54, sans the ``Offert`` typo)."""
+    """Data-plane preamble (transport.go:47-54, sans the ``Offert`` typo).
+
+    The ``stripe_*`` fields are ADVISORY and wire-compatible: an
+    un-striped transfer omits them entirely (the payload is identical to
+    the pre-striping wire format), and a peer that predates them sees
+    each stripe as an ordinary byte-range fragment at its absolute
+    ``offset`` — the existing fragment reassembly path absorbs it.  For
+    striped frames, ``stripe_off`` is the stripe's byte offset WITHIN
+    the original logical payload (so ``offset - stripe_off`` recovers
+    the payload's base offset), ``stripe_span`` the payload's total
+    bytes, and ``stripe_tid`` a sender-unique transfer id that groups
+    the stripes of one logical send (a retry re-uses the id, so a
+    half-landed stripe is simply overwritten)."""
 
     src_id: NodeID
     layer_id: LayerID
     layer_size: int
     total_size: int
     offset: int
+    stripe_idx: int = 0
+    stripe_n: int = 1
+    stripe_off: int = 0
+    stripe_span: int = 0
+    stripe_tid: str = ""
 
     def to_payload(self) -> dict:
-        return {
+        payload = {
             "SrcID": self.src_id,
             "LayerID": self.layer_id,
             "LayerSize": self.layer_size,
             "TotalSize": self.total_size,
             "Offset": self.offset,
         }
+        if self.stripe_n > 1:
+            payload["StripeIdx"] = self.stripe_idx
+            payload["StripeN"] = self.stripe_n
+            payload["StripeOff"] = self.stripe_off
+            payload["StripeSpan"] = self.stripe_span
+            payload["StripeTid"] = self.stripe_tid
+        return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "LayerHeader":
@@ -234,6 +270,11 @@ class LayerHeader:
             int(d["LayerSize"]),
             int(d.get("TotalSize", 0)),
             int(d.get("Offset", 0)),
+            int(d.get("StripeIdx", 0)),
+            int(d.get("StripeN", 1)),
+            int(d.get("StripeOff", 0)),
+            int(d.get("StripeSpan", 0)),
+            str(d.get("StripeTid", "")),
         )
 
 

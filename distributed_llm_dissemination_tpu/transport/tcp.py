@@ -27,15 +27,18 @@ Re-design of the reference's ``TcpTransport``
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import queue
 import socket
 import struct
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
+from ..ops.reassembly import stripe_offsets
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from ..utils.rate import PacedWriter
@@ -54,6 +57,35 @@ _CHUNK = 1 << 20  # 1 MiB receive/relay chunk
 # logged, node.go:345-348), so peers racing the leader's listener die.
 _DIAL_TIMEOUT = 10.0
 _DIAL_RETRY_DELAY = 0.2
+
+# --- layer striping -------------------------------------------------------
+# One (source, layer) transfer used to ride ONE pooled data connection: a
+# physical-size layer was a single serial byte stream, so end-to-end ingest
+# was capped by per-socket throughput while the link (and the device side)
+# could absorb multiples of it.  Payloads >= STRIPE_THRESHOLD split into up
+# to STRIPE_COUNT stripes sent CONCURRENTLY over that many pooled data
+# connections; each stripe is a well-formed byte-range fragment at its
+# absolute offset (wire-compatible — see LayerHeader.stripe_*), so a
+# receiver reassembles striped and un-striped frames through one path.
+# STRIPE_MIN keeps every stripe big enough that TCP slow-start and framing
+# overhead stay noise.  Rate-limited sends never stripe (N paced streams
+# would multiply the commanded rate).
+STRIPE_THRESHOLD = int(os.environ.get("DLD_TCP_STRIPE_THRESHOLD",
+                                      str(8 << 20)))
+STRIPE_COUNT = max(1, int(os.environ.get("DLD_TCP_STRIPES", "4")))
+STRIPE_MIN = 2 << 20
+# Rate-limited sends stripe only when the commanded rate is at least this
+# (1 GB/s): past it the rate is a capacity BUDGET (an ICI/NIC line rate
+# the flow solver allotted — the physical-size rows), which stripes split
+# proportionally so the aggregate still honors it.  Below it the rate is
+# a scarcity model (a slow source being simulated) whose burst semantics
+# tests and the codec A/B rows depend on — those never stripe.
+STRIPE_PACED_MIN_RATE = int(os.environ.get("DLD_TCP_STRIPE_MIN_RATE",
+                                           str(10 ** 9)))
+# Reassembly groups for striped transfers to a receiver WITHOUT a
+# zero-copy layer sink are pruned after this long without completing
+# (their sender died mid-transfer and gave up on the retry).
+_STRIPE_GROUP_TTL = 300.0
 
 
 def _dial(addr: Tuple[str, int], closed: threading.Event) -> socket.socket:
@@ -102,9 +134,32 @@ def _recv_frame(sock: socket.socket) -> Optional[dict]:
     return json.loads(_recv_exact(sock, size))
 
 
+def _sendmsg_all(sock: socket.socket, bufs) -> None:
+    """``sendall`` over a scatter-gather list: every buffer goes out, in
+    order, without ever concatenating them into a staging buffer —
+    ``socket.sendmsg`` hands the kernel an iovec, so a layer frame's
+    length prefix + JSON header + payload leave in one syscall with zero
+    host-side joins (the old framing paid a ``bytes`` concat per frame,
+    a full extra copy pass at physical layer sizes)."""
+    views: List[memoryview] = [
+        v for v in (memoryview(b).cast("B") for b in bufs) if len(v)
+    ]
+    while views:
+        sent = sock.sendmsg(views)
+        if sent == 0:
+            raise ConnectionError("connection closed mid-write")
+        while sent:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
 def _send_frame(sock: socket.socket, envelope: dict) -> None:
     body = json.dumps(envelope).encode()
-    sock.sendall(_LEN.pack(len(body)) + body)
+    _sendmsg_all(sock, (_LEN.pack(len(body)), body))
 
 
 class _PConn:
@@ -137,6 +192,26 @@ class TcpTransport(Transport):
         self._data_pool: Dict[str, list] = {}
         self._accepted: "set[socket.socket]" = set()
         self._pipes: Dict[LayerID, NodeID] = {}
+        # Striped receive state: (src_id, layer_id, tid) -> in-progress
+        # reassembly group (no-sink receivers regroup stripes into the
+        # original logical payload before delivery), completed-transfer
+        # tombstones (a late duplicate stripe — a sender retry whose
+        # first copy actually landed — must be drained, not resurrected
+        # as a phantom group pinning a payload-sized buffer), and the
+        # per-transfer relay countdowns for striped frames hitting a
+        # registered pipe.
+        self._stripe_groups: Dict[tuple, dict] = {}
+        self._stripe_done: Dict[tuple, float] = {}
+        self._stripe_relays: Dict[tuple, dict] = {}
+        # Lazy background sweeper for the striped-receive TTLs: arrival-
+        # time pruning alone would let the LAST abandoned transfer pin
+        # its payload-sized buffer forever (nothing striped arrives
+        # after it to trigger the sweep).  Started on first striped
+        # state, exits with the transport.
+        self._stripe_sweeper_started = False
+        self._stripe_tid = itertools.count(
+            int.from_bytes(os.urandom(4), "big") << 20
+        )
         self._lock = threading.Lock()
         self._closed = threading.Event()
         # Zero-copy receive hook (set by a reassembling receiver):
@@ -194,6 +269,9 @@ class TcpTransport(Transport):
 
     def _receive_layer(self, conn: socket.socket, envelope: dict) -> None:
         header = LayerHeader.from_payload(envelope["payload"])
+        if header.stripe_n > 1:
+            self._receive_stripe(conn, envelope, header)
+            return
         log.info(
             "start receiving layer",
             layerID=header.layer_id,
@@ -210,13 +288,7 @@ class TcpTransport(Transport):
         if placed is not None:
             view, token, abort = placed
             try:
-                got = 0
-                while got < header.layer_size:
-                    r = conn.recv_into(view[got:],
-                                       header.layer_size - got)
-                    if r == 0:
-                        raise ConnectionError("connection closed mid-layer")
-                    got += r
+                self._recv_body(conn, view, header.layer_size)
             except BaseException:
                 abort()  # roll the claim back or the layer wedges forever
                 raise
@@ -251,22 +323,11 @@ class TcpTransport(Transport):
             # at :152-164).
             try:
                 _send_frame(pipe_sock, envelope)
-                got = 0
-                while got < header.layer_size:
-                    r = conn.recv_into(view[got:], min(_CHUNK, header.layer_size - got))
-                    if r == 0:
-                        raise ConnectionError("connection closed mid-layer")
-                    pipe_sock.sendall(view[got : got + r])
-                    got += r
+                self._recv_body(conn, view, header.layer_size, pipe_sock)
             finally:
                 pipe_sock.close()
         else:
-            got = 0
-            while got < header.layer_size:
-                r = conn.recv_into(view[got:], header.layer_size - got)
-                if r == 0:
-                    raise ConnectionError("connection closed mid-layer")
-                got += r
+            self._recv_body(conn, view, header.layer_size)
 
         dur_ms = (time.monotonic() - t0) * 1000
         log.info(
@@ -284,6 +345,274 @@ class TcpTransport(Transport):
         )
         self._queue.put(
             LayerMsg(header.src_id, header.layer_id, layer_src, header.total_size)
+        )
+
+    # --------------------------------------------------------- striped rx
+
+    def _recv_body(self, conn: socket.socket, view: memoryview,
+                   n: int, pipe_sock=None) -> None:
+        """Land a frame body's bytes in ``view`` (socket → destination
+        buffer in ONE copy), optionally teeing each chunk to a
+        cut-through pipe downstream.  The one receive loop shared by the
+        striped and un-striped paths."""
+        got = 0
+        while got < n:
+            if pipe_sock is None:
+                r = conn.recv_into(view[got:], n - got)
+            else:
+                r = conn.recv_into(view[got:], min(_CHUNK, n - got))
+            if r == 0:
+                raise ConnectionError("connection closed mid-body")
+            if pipe_sock is not None:
+                pipe_sock.sendall(view[got : got + r])
+            got += r
+
+    def _stripe_pipe_sock(self, header: LayerHeader, envelope: dict):
+        """Cut-through relay for a STRIPED frame: every stripe of the
+        transfer relays over its own fresh downstream connection (they
+        arrive concurrently on different sockets), and the one-shot pipe
+        unregisters only once all ``stripe_n`` stripes relayed.  Returns
+        the dialed downstream socket with the stripe envelope already
+        forwarded, or None (no pipe / downstream unreachable)."""
+        key = (header.src_id, header.layer_id, header.stripe_tid)
+        with self._lock:
+            rec = self._stripe_relays.get(key)
+            if rec is None:
+                if header.layer_id not in self._pipes:
+                    return None
+                # Claim the one-shot pipe for this whole striped transfer.
+                dest_id = self._pipes.pop(header.layer_id)
+                rec = self._stripe_relays[key] = {
+                    "dest_id": dest_id, "done": set(),
+                    "n": header.stripe_n, "t": time.monotonic()}
+            else:
+                rec["t"] = time.monotonic()
+        # Failures below do NOT retire the stripe's relay slot: only a
+        # fully-relayed stripe counts (``_stripe_relay_done``), so a
+        # sender retry of a failed stripe gets relayed on its own fresh
+        # downstream connection instead of the transfer silently losing
+        # that byte range.  A record whose stripes never all land is
+        # TTL-pruned with the rest of the striped-receive state.
+        dest = self.addr_registry.get(rec["dest_id"])
+        if dest is None:
+            log.error("addr does not exist", dest=rec["dest_id"])
+            return None
+        try:
+            sock = _dial(_parse_addr(dest), self._closed)
+        except OSError as e:
+            log.error("failed to connect pipe dest", dest=rec["dest_id"],
+                      err=e)
+            return None
+        try:
+            _send_frame(sock, envelope)
+        except OSError as e:
+            log.error("failed to forward stripe header", err=e)
+            sock.close()
+            return None
+        return sock
+
+    def _stripe_relay_done(self, key, stripe_idx: int) -> None:
+        """Mark one DISTINCT stripe fully relayed; the claimed pipe's
+        record retires once every stripe index has been (duplicate
+        relays of a retried stripe collapse into the set)."""
+        with self._lock:
+            rec = self._stripe_relays.get(key)
+            if rec is not None:
+                rec["done"].add(stripe_idx)
+                if len(rec["done"]) >= rec["n"]:
+                    del self._stripe_relays[key]
+
+    def _receive_stripe(self, conn: socket.socket, envelope: dict,
+                        header: LayerHeader) -> None:
+        """One stripe of a striped layer transfer.
+
+        Three landings, in priority order (mirroring ``_receive_layer``):
+        a registered pipe relays the stripe downstream while receiving
+        (and still lands it locally); a zero-copy ``layer_sink`` places
+        the stripe DIRECTLY at its absolute offset in the receiver's
+        reassembly buffer and delivers it as its own fragment — so
+        device staging begins per-stripe, overlapping the tail of the
+        wire; otherwise stripes regroup transport-side into the original
+        logical payload (plain receivers expect whole messages), landing
+        each stripe at ``stripe_off`` in one shared buffer."""
+        t0 = time.monotonic()
+        with self._lock:
+            # First striped arrival arms the background sweeper — the
+            # TTL owner for ALL striped-receive state (groups,
+            # tombstones, relay records), including the last abandoned
+            # transfer that no later arrival would ever sweep.
+            if not self._stripe_sweeper_started:
+                self._stripe_sweeper_started = True
+                threading.Thread(target=self._stripe_sweep_loop,
+                                 daemon=True).start()
+        pipe_sock = self._stripe_pipe_sock(header, envelope)
+        key = (header.src_id, header.layer_id, header.stripe_tid)
+        landed = False
+        try:
+            placed = None
+            if self.layer_sink is not None:
+                placed = self.layer_sink(header.layer_id, header.total_size,
+                                         header.offset, header.layer_size)
+            if placed is not None:
+                view, token, abort = placed
+                try:
+                    self._recv_body(conn, view, header.layer_size,
+                                           pipe_sock)
+                except BaseException:
+                    abort()
+                    raise
+                landed = True
+                src = LayerSrc(
+                    inmem_data=None, data_size=header.layer_size,
+                    offset=header.offset,
+                    meta=LayerMeta(location=LayerLocation.INMEM),
+                )
+                src.placed_token = token
+                self._log_stripe(header, t0, placed=True)
+                self._queue.put(LayerMsg(
+                    header.src_id, header.layer_id, src, header.total_size,
+                    stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
+                    stripe_off=header.stripe_off))
+                return
+            if self.layer_sink is not None:
+                # Sink present but declined (duplicate/overlap/finished):
+                # bounce THIS stripe as its own fragment — the receiver's
+                # interval reassembly (or its re-ack path) absorbs it.
+                buf = alloc_recv_buffer(header.layer_size)
+                self._recv_body(conn, memoryview(buf),
+                                header.layer_size, pipe_sock)
+                landed = True
+                self._log_stripe(header, t0, placed=False)
+                self._queue.put(LayerMsg(
+                    header.src_id, header.layer_id,
+                    LayerSrc(inmem_data=buf, data_size=header.layer_size,
+                             offset=header.offset,
+                             meta=LayerMeta(location=LayerLocation.INMEM)),
+                    header.total_size,
+                    stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
+                    stripe_off=header.stripe_off))
+                return
+            # No sink: regroup stripes into the original logical payload
+            # so un-striped consumers (mode-0/1/2 receivers, raw
+            # transport users) see exactly the message the sender passed
+            # to send().  The group buffer is the final LayerSrc buffer —
+            # stripes still land socket→payload in one copy.
+            base = header.offset - header.stripe_off
+            span = header.stripe_span
+            done = None
+            with self._lock:
+                if key in self._stripe_done:
+                    # Late duplicate of an already-delivered transfer (a
+                    # sender retry whose first copy landed): drain the
+                    # body, never resurrect a group for it.
+                    rec = None
+                else:
+                    rec = self._stripe_groups.get(key)
+                    if rec is None:
+                        rec = self._stripe_groups[key] = {
+                            "buf": alloc_recv_buffer(span), "span": span,
+                            "base": base, "got": set(),
+                            "t": time.monotonic(), "inflight": 0,
+                            "total": header.total_size,
+                        }
+                    # The in-flight count keeps the prune off a group one
+                    # of whose stripes is still mid-receive (a slow link
+                    # can legitimately stream past the idle TTL).
+                    rec["inflight"] += 1
+                    rec["t"] = time.monotonic()
+            if rec is None:
+                self._drain_stripe_body(conn, header.layer_size, pipe_sock)
+                landed = True
+                return
+            view = memoryview(rec["buf"])[
+                header.stripe_off : header.stripe_off + header.layer_size]
+            try:
+                self._recv_body(conn, view, header.layer_size, pipe_sock)
+            except BaseException:
+                with self._lock:
+                    rec["inflight"] -= 1
+                raise
+            landed = True
+            self._log_stripe(header, t0, placed=False)
+            with self._lock:
+                rec["inflight"] -= 1
+                rec["got"].add(header.stripe_idx)
+                rec["t"] = time.monotonic()
+                if len(rec["got"]) >= header.stripe_n:
+                    done = self._stripe_groups.pop(key, None)
+                    if done is not None:
+                        self._stripe_done[key] = time.monotonic()
+            if done is not None:
+                self._queue.put(LayerMsg(
+                    header.src_id, header.layer_id,
+                    LayerSrc(inmem_data=done["buf"], data_size=done["span"],
+                             offset=done["base"],
+                             meta=LayerMeta(location=LayerLocation.INMEM)),
+                    done["total"],
+                    stripe_idx=0, stripe_n=1, stripe_off=0))
+        finally:
+            if pipe_sock is not None:
+                pipe_sock.close()
+                if landed:
+                    # Only a fully-relayed stripe retires its relay slot:
+                    # a failed receive means the downstream copy is
+                    # partial too, and the sender's retry must be relayed
+                    # again (per-idx, so a duplicate can't over-retire).
+                    self._stripe_relay_done(key, header.stripe_idx)
+
+    def _drain_stripe_body(self, conn: socket.socket, n: int,
+                           pipe_sock) -> None:
+        """Consume (and discard) a stripe body that has no local landing
+        — the connection framing must stay intact for whatever message
+        follows on it.  A teed pipe still gets the bytes."""
+        buf = memoryview(bytearray(min(n, _CHUNK)))
+        got = 0
+        while got < n:
+            r = conn.recv_into(buf[: min(len(buf), n - got)])
+            if r == 0:
+                raise ConnectionError("connection closed mid-stripe")
+            if pipe_sock is not None:
+                pipe_sock.sendall(buf[:r])
+            got += r
+
+    def _stripe_sweep_loop(self) -> None:
+        """Periodic TTL sweep of the striped-receive state (half-TTL
+        cadence); exits when the transport closes."""
+        while not self._closed.wait(_STRIPE_GROUP_TTL / 2):
+            with self._lock:
+                self._prune_stripe_groups_locked()
+
+    def _prune_stripe_groups_locked(self) -> None:
+        """Drop striped-receive state whose sender went silent (it died
+        after exhausting its per-stripe retry) so abandoned transfers
+        can't pin layer-sized buffers — or leak completion tombstones
+        and relay countdowns — forever.  Groups with a stripe mid-recv
+        (``inflight`` > 0) are never pruned.  Caller holds
+        ``self._lock``."""
+        now = time.monotonic()
+        for key in [k for k, r in self._stripe_groups.items()
+                    if r["inflight"] <= 0
+                    and now - r["t"] > _STRIPE_GROUP_TTL]:
+            log.warn("dropping stale stripe reassembly group", key=key)
+            del self._stripe_groups[key]
+        for key in [k for k, t in self._stripe_done.items()
+                    if now - t > _STRIPE_GROUP_TTL]:
+            del self._stripe_done[key]
+        for key in [k for k, r in self._stripe_relays.items()
+                    if now - r["t"] > _STRIPE_GROUP_TTL]:
+            log.warn("dropping stale stripe relay record", key=key)
+            del self._stripe_relays[key]
+
+    @staticmethod
+    def _log_stripe(header: LayerHeader, t0: float, placed: bool) -> None:
+        log.info(
+            "(a fraction of) layer received",
+            layerID=header.layer_id,
+            layer_size=header.layer_size,
+            total_size=header.total_size,
+            duration_ms=round((time.monotonic() - t0) * 1000, 3),
+            placed=placed,
+            stripe=f"{header.stripe_idx + 1}/{header.stripe_n}",
         )
 
     # ------------------------------------------------------------------ tx
@@ -376,7 +705,13 @@ class TcpTransport(Transport):
                     raise
 
     def _send_layer_pooled(self, dest: str, message: LayerMsg) -> None:
-        """One layer transfer over a pooled data connection.
+        """One layer transfer over pooled data connection(s).
+
+        Payloads past ``STRIPE_THRESHOLD`` split into stripes riding
+        several pooled connections CONCURRENTLY (``_send_layer_striped``)
+        so one logical transfer can saturate the link instead of one
+        socket; smaller (or rate-limited) payloads take the single-stream
+        path below.
 
         A pooled connection may be stale (peer restarted while it idled):
         the first attempt may fail mid-stream, in which case the transfer
@@ -384,13 +719,31 @@ class TcpTransport(Transport):
         connection is harmless — the receiver drops partial bodies on
         connection error, and interval reassembly tolerates the re-send.
         """
+        src = message.layer_src
+        if (STRIPE_COUNT > 1
+                and src.data_size >= max(STRIPE_THRESHOLD, 2 * STRIPE_MIN)
+                and (src.meta.limit_rate == 0
+                     or src.meta.limit_rate >= STRIPE_PACED_MIN_RATE)
+                and src.meta.location in (LayerLocation.INMEM,
+                                          LayerLocation.HBM,
+                                          LayerLocation.DISK)):
+            spans = stripe_offsets(src.data_size, STRIPE_COUNT, STRIPE_MIN)
+            if len(spans) > 1 and self._send_layer_striped(
+                    dest, message, spans):
+                return
+        self._send_one_stream(dest, message)
+
+    def _send_one_stream(self, dest: str, message: LayerMsg,
+                         stripe: Optional[dict] = None) -> None:
+        """One byte stream (a whole payload, or one stripe of one) over a
+        pooled data connection, with the stale-connection retry."""
         for attempt in (0, 1):
             fresh = attempt == 1
             sock = None
             try:
                 sock = (self._dial_data(dest) if fresh
                         else self._acquire_data_conn(dest))
-                self._send_layer(sock, message)
+                self._send_layer(sock, message, stripe=stripe)
             except OSError:
                 if sock is not None:
                     sock.close()  # state unknown: never pool a broken conn
@@ -406,6 +759,67 @@ class TcpTransport(Transport):
                 raise
             self._release_data_conn(dest, sock)
             return
+
+    def _send_layer_striped(self, dest: str, message: LayerMsg,
+                            spans) -> bool:
+        """Send one logical payload as ``len(spans)`` stripes over that
+        many pooled data connections in parallel.  Each stripe is an
+        independent single-stream send (own pooled checkout, own stale
+        retry); the first stripe runs on the calling thread.  Returns
+        False without touching the wire when the source can't serve
+        concurrent range reads (the caller then streams it whole)."""
+        src = message.layer_src
+        if src.meta.location == LayerLocation.HBM and src.inmem_data is None:
+            # One device→host fetch up front; stripes then slice host RAM.
+            if not src.ensure_host_bytes():
+                return False
+        if (src.meta.location in (LayerLocation.INMEM, LayerLocation.HBM)
+                and src.inmem_data is None):
+            return False
+        tid = f"{next(self._stripe_tid):x}"
+        n = len(spans)
+        errors: List[BaseException] = []
+
+        def send_stripe(idx: int, rel_off: int, size: int) -> None:
+            meta = src.meta
+            if meta.limit_rate > 0:
+                # Split the commanded budget proportionally: N paced
+                # stripes together still flow at (almost exactly) the
+                # allotted rate.
+                meta = LayerMeta(
+                    location=meta.location,
+                    limit_rate=max(1, meta.limit_rate * size
+                                   // src.data_size),
+                    source_type=meta.source_type,
+                )
+            sub = LayerSrc(
+                inmem_data=src.inmem_data, fp=src.fp, data_size=size,
+                offset=src.offset + rel_off, meta=meta,
+            )
+            stripe = {"idx": idx, "n": n, "off": rel_off,
+                      "span": src.data_size, "tid": tid}
+            try:
+                self._send_one_stream(
+                    dest,
+                    LayerMsg(message.src_id, message.layer_id, sub,
+                             message.total_size),
+                    stripe=stripe)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=send_stripe, args=(i, off, size),
+                             name=f"stripe-{message.layer_id}-{i}")
+            for i, (off, size) in enumerate(spans[1:], start=1)
+        ]
+        for t in threads:
+            t.start()
+        send_stripe(0, *spans[0])
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return True
 
     def _dial_data(self, dest: str) -> socket.socket:
         return _dial(_parse_addr(dest), self._closed)
@@ -424,8 +838,13 @@ class TcpTransport(Transport):
                 return
         sock.close()
 
-    def _send_layer(self, sock: socket.socket, message: LayerMsg) -> None:
-        """Header then raw body (transport.go:308-373)."""
+    def _send_layer(self, sock: socket.socket, message: LayerMsg,
+                    stripe: Optional[dict] = None) -> None:
+        """Header then raw body (transport.go:308-373).  In-memory bodies
+        ride the header's scatter-gather ``sendmsg`` (no concat, one
+        syscall batch); disk bodies keep the kernel ``sendfile`` path —
+        including disk-backed STRIPES, which sendfile serves by
+        (offset, count) with no host read at all."""
         src = message.layer_src
         header = LayerHeader(
             src_id=message.src_id,
@@ -434,14 +853,17 @@ class TcpTransport(Transport):
             total_size=message.total_size,
             offset=src.offset,
         )
-        _send_frame(
-            sock,
-            {
-                "type": int(MsgType.LAYER),
-                "src": str(message.src_id),
-                "payload": header.to_payload(),
-            },
-        )
+        if stripe is not None:
+            header.stripe_idx = stripe["idx"]
+            header.stripe_n = stripe["n"]
+            header.stripe_off = stripe["off"]
+            header.stripe_span = stripe["span"]
+            header.stripe_tid = stripe["tid"]
+        envelope = {
+            "type": int(MsgType.LAYER),
+            "src": str(message.src_id),
+            "payload": header.to_payload(),
+        }
 
         # HBM-staged layers keep their host buffer and serve like INMEM;
         # fabric-delivered layers never had one — materialize it from the
@@ -454,6 +876,7 @@ class TcpTransport(Transport):
                 and src.inmem_data is not None):
             data = memoryview(src.inmem_data)[src.offset : src.offset + src.data_size]
             if src.meta.limit_rate > 0:
+                _send_frame(sock, envelope)
                 log.debug(
                     "sending with limit",
                     layerID=message.layer_id,
@@ -461,10 +884,12 @@ class TcpTransport(Transport):
                 )
                 PacedWriter(sock.sendall, src.meta.limit_rate).write(data)
             else:
-                sock.sendall(data)
+                body = json.dumps(envelope).encode()
+                _sendmsg_all(sock, (_LEN.pack(len(body)), body, data))
         elif src.meta.location == LayerLocation.DISK:
             if not src.fp:
                 raise ValueError("no data source specified")
+            _send_frame(sock, envelope)
             # Zero-copy kernel sendfile, the io.Copy(SectionReader) path.
             with open(src.fp, "rb") as f:
                 sock.sendfile(f, offset=src.offset, count=src.data_size)

@@ -36,6 +36,40 @@ def cpu_devices():
     return devices
 
 
+# Tier-1 per-test wall budget (seconds): the whole tier-1 suite must fit
+# a ~10-minute CI wall, so any single test past this belongs in tier 2 —
+# mark it ``@pytest.mark.slow``.  The terminal summary below names
+# offenders explicitly (and always prints the 10 slowest tests) so a
+# creeping test can't silently eat the budget.
+TIER1_TEST_BUDGET_S = 30.0
+_test_durations: dict = {}  # nodeid -> [summed seconds, is_slow-marked]
+
+
+def pytest_runtest_logreport(report):
+    # Sum ALL phases (setup + call + teardown): a test whose cost lives
+    # in its fixtures must not evade the budget guard.
+    rec = _test_durations.setdefault(report.nodeid, [0.0, False])
+    rec[0] += report.duration
+    rec[1] = rec[1] or "slow" in report.keywords
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _test_durations:
+        return
+    ranked = sorted(((d, n) for n, (d, _) in _test_durations.items()),
+                    reverse=True)
+    terminalreporter.section("10 slowest tests (tier-1 budget check)")
+    for dur, nodeid in ranked[:10]:
+        terminalreporter.write_line(f"{dur:8.2f}s  {nodeid}")
+    over = [(d, n) for n, (d, is_slow) in _test_durations.items()
+            if d > TIER1_TEST_BUDGET_S and not is_slow]
+    for dur, nodeid in sorted(over, reverse=True):
+        terminalreporter.write_line(
+            f"WARNING: {nodeid} took {dur:.1f}s (> {TIER1_TEST_BUDGET_S:g}s "
+            "tier-1 per-test budget) and is not marked 'slow' — mark it "
+            "@pytest.mark.slow or make it faster.", red=True)
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
     """Per-test wall-clock bound: ``@pytest.mark.timeout(seconds)``.

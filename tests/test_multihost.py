@@ -349,6 +349,7 @@ _TRAIN_CHILD = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow  # ~33 s wall: over the 30 s tier-1 per-test budget
 def test_two_process_training_step():
     """TRAINING across processes: two OS processes join one runtime
     (4 virtual CPU devices each), build ONE global 8-device train mesh,

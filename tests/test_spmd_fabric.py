@@ -320,9 +320,12 @@ def test_broadcast_retains_operative_message_per_seq():
 
 
 def test_plan_watchdog_rebroadcasts_then_cancels(monkeypatch):
-    """Tail-gap liveness: a plan nobody acks is re-broadcast on a timer
-    and finally CANCELLED — a dropped LAST plan (nothing queues behind
-    it, so no receiver ever reports a gap) cannot wedge the goal."""
+    """Tail-gap liveness: a plan nobody acks is re-broadcast on a timer,
+    and past the retry budget the watchdog KEEPS re-broadcasting — the
+    give-up cancel is crash-gated (a cancel fired while the dest is
+    merely slow would advance gap processes while peers sit inside the
+    collective).  Only once a participant is declared crashed (fabric
+    disabled) is the seq cancelled."""
     from distributed_llm_dissemination_tpu.core.types import (
         LayerLocation,
         LayerMeta,
@@ -342,16 +345,20 @@ def test_plan_watchdog_rebroadcasts_then_cancels(monkeypatch):
     reset_registry()
     t0 = InmemTransport("0")
     t1 = InmemTransport("1")
+    t2 = InmemTransport("2")
     leader = LeaderNode(Node(0, 0, t0), {}, {1: {0: LayerMeta()}},
                         start_loop=True, fabric=_FakeSpmdFabric(),
-                        placement=_FakePlacement([0, 1]))
+                        placement=_FakePlacement([0, 1, 2]))
     leader.status[1] = {
         0: LayerMeta(location=LayerLocation.INMEM, data_size=100)
     }
+    leader.status[2] = {}
     try:
         assert leader._broadcast_spmd_plan(_plan(0, [(0, 0, 100)], dest=1))
         got = []
         deadline = time.monotonic() + 10.0
+        # Original + the 2 budgeted re-broadcasts + at least one PAST-
+        # budget re-broadcast: no cancel while nobody is declared dead.
         while len(got) < 4 and time.monotonic() < deadline:
             try:
                 m = t1.deliver().get(timeout=0.5)
@@ -359,17 +366,45 @@ def test_plan_watchdog_rebroadcasts_then_cancels(monkeypatch):
                 continue
             if isinstance(m, DevicePlanMsg):
                 got.append(m)
-        # Original + 2 re-broadcasts + the final cancellation.
         assert len(got) == 4, [(m.seq, m.layout) for m in got]
-        assert [bool(m.layout) for m in got] == [True, True, True, False]
+        assert [bool(m.layout) for m in got] == [True, True, True, True]
         assert all(m.seq == 0 for m in got)
+        with leader._lock:
+            assert 0 in leader._plan_watch  # still chasing, not cancelled
+            assert leader._sent_plans[0].layout  # plan retained, no cancel
+
+        # Declare a participant crashed: the fabric is disabled and the
+        # watched seq is cancelled so gap processes stop waiting on it.
+        leader.crash(2)
+        assert leader._fabric_disabled
+        cancel = None
+        deadline = time.monotonic() + 10.0
+        while cancel is None and time.monotonic() < deadline:
+            try:
+                m = t1.deliver().get(timeout=0.5)
+            except Exception:  # noqa: BLE001 — queue.Empty
+                continue
+            if isinstance(m, DevicePlanMsg) and not m.layout:
+                cancel = m
+        assert cancel is not None and cancel.seq == 0
         with leader._lock:
             assert 0 not in leader._plan_watch  # chase abandoned
             assert leader._sent_plans[0].layout == []  # cancel retained
 
         # An ACKED plan is never chased: broadcast + ack, then silence.
         assert leader._broadcast_spmd_plan(_plan(1, [(0, 0, 100)], dest=1))
-        assert isinstance(t1.deliver().get(timeout=2.0), DevicePlanMsg)
+        deadline = time.monotonic() + 2.0
+        plan1 = None
+        while plan1 is None and time.monotonic() < deadline:
+            try:
+                m = t1.deliver().get(timeout=0.5)
+            except Exception:  # noqa: BLE001 — queue.Empty
+                continue
+            # The crash above may interleave StartupMsg etc.; wait for
+            # the fresh plan specifically.
+            if isinstance(m, DevicePlanMsg) and m.seq == 1:
+                plan1 = m
+        assert plan1 is not None
         from distributed_llm_dissemination_tpu.transport.messages import (
             AckMsg,
         )

@@ -361,6 +361,251 @@ def test_data_connection_pooling(monkeypatch):
         close_all(ts)
 
 
+@pytest.fixture
+def small_stripes(monkeypatch):
+    """Shrink the striping thresholds so KiB-scale test payloads stripe."""
+    from distributed_llm_dissemination_tpu.transport import tcp as tcp_mod
+
+    monkeypatch.setattr(tcp_mod, "STRIPE_THRESHOLD", 64 * 1024)
+    monkeypatch.setattr(tcp_mod, "STRIPE_MIN", 16 * 1024)
+    monkeypatch.setattr(tcp_mod, "STRIPE_COUNT", 4)
+    return tcp_mod
+
+
+def test_striped_layer_transfer_reassembles(small_stripes, monkeypatch):
+    """A payload past the stripe threshold rides N pooled data
+    connections CONCURRENTLY and a no-sink receiver still delivers ONE
+    byte-exact LayerMsg (transport-side stripe regrouping)."""
+    tcp_mod = small_stripes
+    dials = []
+    real_dial = tcp_mod._dial
+
+    def counting_dial(addr, closed):
+        dials.append(addr)
+        return real_dial(addr, closed)
+
+    monkeypatch.setattr(tcp_mod, "_dial", counting_dial)
+    ts = make_transports("tcp", 2)
+    try:
+        stripes_seen = []
+        orig = ts[1]._receive_stripe
+
+        def spy(conn, envelope, header):
+            stripes_seen.append(header.stripe_idx)
+            return orig(conn, envelope, header)
+
+        ts[1]._receive_stripe = spy
+        payload = bytes(range(256)) * 2048  # 512 KiB >= 4 stripes
+        ts[0].send(1, LayerMsg(0, 7, _mem_layer(payload), len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert isinstance(got, LayerMsg)
+        assert bytes(got.layer_src.inmem_data) == payload
+        assert got.layer_src.offset == 0
+        assert got.total_size == len(payload)
+        # The transfer really striped (4 stripe frames), fanning out over
+        # pooled connections (exact dial count depends on thread timing —
+        # a fast stripe can finish before a sibling checks the pool).
+        assert sorted(stripes_seen) == [0, 1, 2, 3]
+        assert 2 <= len(dials) <= 4, dials
+        # Nothing half-assembled left behind.
+        assert ts[1]._stripe_groups == {}
+    finally:
+        close_all(ts)
+
+
+def test_striped_partial_range_transfer(small_stripes):
+    """A mode-3 byte-range fragment stripes too: the regrouped delivery
+    carries the ORIGINAL offset/size against the full layer."""
+    ts = make_transports("tcp", 2)
+    try:
+        full = bytes((i * 7) % 256 for i in range(400 * 1024))
+        src = _mem_layer(full)
+        src.offset, src.data_size = 50 * 1024, 300 * 1024
+        ts[0].send(1, LayerMsg(0, 3, src, len(full)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert got.layer_src.offset == 50 * 1024
+        assert got.layer_src.data_size == 300 * 1024
+        assert bytes(got.layer_src.inmem_data) == full[50 * 1024 : 350 * 1024]
+        assert got.total_size == len(full)
+    finally:
+        close_all(ts)
+
+
+def test_striped_disk_source(small_stripes, tmp_path):
+    """Disk-backed stripes keep the kernel sendfile path — each stripe
+    sendfiles its own (offset, count) — and reassemble byte-exactly."""
+    ts = make_transports("tcp", 2)
+    try:
+        payload = bytes((i * 13 + 5) % 256 for i in range(256 * 1024))
+        fp = tmp_path / "0.layer"
+        fp.write_bytes(payload)
+        src = LayerSrc(fp=str(fp), data_size=len(payload),
+                       meta=LayerMeta(location=LayerLocation.DISK))
+        ts[0].send(1, LayerMsg(0, 1, src, len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert bytes(got.layer_src.inmem_data) == payload
+    finally:
+        close_all(ts)
+
+
+def test_striped_rate_limited_low_rate_does_not_stripe(small_stripes):
+    """Slow rate-limited sends keep their single paced stream (striping
+    would change the modeled burst semantics); only budget-scale rates
+    (>= STRIPE_PACED_MIN_RATE) stripe, with the budget split."""
+    ts = make_transports("tcp", 2)
+    try:
+        stripes_seen = []
+        orig = ts[1]._receive_stripe
+
+        def spy(conn, envelope, header):
+            stripes_seen.append((header.layer_id, header.stripe_idx))
+            return orig(conn, envelope, header)
+
+        ts[1]._receive_stripe = spy
+        payload = b"z" * (512 * 1024)
+        ts[0].send(1, LayerMsg(
+            0, 2, _mem_layer(payload, rate=4 * 1024 * 1024), len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert bytes(got.layer_src.inmem_data) == payload
+        assert stripes_seen == []  # one paced stream, no striping
+
+        ts[0].send(1, LayerMsg(
+            0, 3, _mem_layer(payload, rate=10 ** 10), len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert bytes(got.layer_src.inmem_data) == payload
+        # Budget-scale rate striped into 4 stripes of layer 3.
+        assert sorted(stripes_seen) == [(3, 0), (3, 1), (3, 2), (3, 3)]
+    finally:
+        close_all(ts)
+
+
+def _stripe_envelope(header_payload: dict) -> dict:
+    from distributed_llm_dissemination_tpu.transport.messages import MsgType
+
+    return {"type": int(MsgType.LAYER), "src": "0",
+            "payload": header_payload}
+
+
+def test_striped_out_of_order_and_duplicate_reassembly(small_stripes):
+    """Hand-crafted stripe frames over raw sockets: stripes arriving out
+    of order, INTERLEAVED across connections, with one full duplicate —
+    the group delivers exactly one byte-exact payload."""
+    import socket as socket_mod
+
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        LayerHeader,
+    )
+    from distributed_llm_dissemination_tpu.transport.tcp import (
+        _parse_addr,
+        _send_frame,
+    )
+
+    ts = make_transports("tcp", 2)
+    try:
+        total = 120 * 1024
+        payload = bytes((i * 31 + 7) % 256 for i in range(total))
+        spans = [(0, 40 * 1024), (40 * 1024, 40 * 1024),
+                 (80 * 1024, 40 * 1024)]
+
+        def frame(idx, dup=False):
+            off, size = spans[idx]
+            hdr = LayerHeader(
+                src_id=0, layer_id=9, layer_size=size, total_size=total,
+                offset=off, stripe_idx=idx, stripe_n=3, stripe_off=off,
+                stripe_span=total, stripe_tid="t-ooo")
+            return hdr.to_payload(), payload[off : off + size]
+
+        conns = [socket_mod.create_connection(
+            _parse_addr(ts[1].get_address())) for _ in range(3)]
+        try:
+            # Out of order (2, 0, 1), with stripe 2 sent TWICE (a sender
+            # retry after a presumed-failed first attempt).
+            for conn, idx in ((conns[0], 2), (conns[1], 0), (conns[0], 2),
+                              (conns[2], 1)):
+                hdr, body = frame(idx)
+                _send_frame(conn, _stripe_envelope(hdr))
+                conn.sendall(body)
+            got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+            assert bytes(got.layer_src.inmem_data) == payload
+            assert got.layer_src.offset == 0 and got.total_size == total
+            # Exactly one delivery despite the duplicate stripe.
+            import queue as queue_mod
+            with pytest.raises(queue_mod.Empty):
+                ts[1].deliver().get(timeout=0.3)
+
+            # A LATE duplicate (sender retry whose first copy completed
+            # the group) is drained against the completion tombstone —
+            # no phantom group pinning a payload-sized buffer, and the
+            # connection's framing stays intact for the next transfer.
+            hdr, body = frame(1)
+            _send_frame(conns[1], _stripe_envelope(hdr))
+            conns[1].sendall(body)
+            hdr2, body2 = frame(0)
+            hdr2["StripeTid"] = "t-two"
+            hdr2["StripeN"] = 1
+            hdr2["LayerSize"] = hdr2["StripeSpan"] = len(body2)
+            _send_frame(conns[1], _stripe_envelope(hdr2))
+            conns[1].sendall(body2)
+            got2 = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+            assert bytes(got2.layer_src.inmem_data) == body2
+            with ts[1]._lock:
+                assert all(k[2] != "t-ooo" for k in ts[1]._stripe_groups)
+        finally:
+            for c in conns:
+                c.close()
+    finally:
+        close_all(ts)
+
+
+def test_stale_stripe_groups_pruned(small_stripes, monkeypatch):
+    """A stripe group whose sender died mid-transfer is dropped after
+    the TTL instead of pinning a payload-sized buffer forever."""
+    import socket as socket_mod
+
+    from distributed_llm_dissemination_tpu.transport import tcp as tcp_mod
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        LayerHeader,
+    )
+    from distributed_llm_dissemination_tpu.transport.tcp import (
+        _parse_addr,
+        _send_frame,
+    )
+
+    monkeypatch.setattr(tcp_mod, "_STRIPE_GROUP_TTL", 0.2)
+    ts = make_transports("tcp", 2)
+    try:
+        hdr = LayerHeader(src_id=0, layer_id=4, layer_size=1024,
+                          total_size=4096, offset=0, stripe_idx=0,
+                          stripe_n=4, stripe_off=0, stripe_span=4096,
+                          stripe_tid="t-dead")
+        with socket_mod.create_connection(
+                _parse_addr(ts[1].get_address())) as c:
+            _send_frame(c, _stripe_envelope(hdr.to_payload()))
+            c.sendall(b"x" * 1024)
+            deadline = time.monotonic() + RECV_TIMEOUT
+            while not ts[1]._stripe_groups and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ts[1]._stripe_groups  # group open, 3 stripes missing
+        # The background sweeper (armed by the first striped arrival,
+        # half-TTL cadence) prunes the abandoned group on its own — no
+        # later traffic required.
+        deadline = time.monotonic() + RECV_TIMEOUT
+        while time.monotonic() < deadline:
+            with ts[1]._lock:
+                if all(k[2] != "t-dead" for k in ts[1]._stripe_groups):
+                    break
+            time.sleep(0.05)
+        with ts[1]._lock:
+            assert all(k[2] != "t-dead" for k in ts[1]._stripe_groups)
+        # And striped traffic still flows normally afterwards.
+        payload = bytes(range(256)) * 512  # 128 KiB
+        ts[0].send(1, LayerMsg(0, 5, _mem_layer(payload), len(payload)))
+        got = ts[1].deliver().get(timeout=RECV_TIMEOUT)
+        assert bytes(got.layer_src.inmem_data) == payload
+    finally:
+        close_all(ts)
+
+
 def test_data_pool_retries_stale_connection():
     """A pooled connection whose peer died must not lose the transfer:
     the send retries once on a fresh dial."""
