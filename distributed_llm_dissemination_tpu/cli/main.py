@@ -225,6 +225,17 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     ttd = time.monotonic() - t0
     ulog.log.info("Time to deliver", seconds=round(ttd, 6))
     print(f"Time to deliver: {ttd:.6f}s", flush=True)
+    pred_ms = getattr(leader, "predicted_ttd_ms", 0)
+    if pred_ms:
+        # Mode 3 plan fidelity: the solver's min-time next to achieved
+        # TTD (VERDICT item 2's measurement half).  Machine-parsed by
+        # cli.ttd_matrix into predicted_s/solve_ms columns.
+        solve_ms = getattr(leader, "solve_ms", 0.0)
+        ulog.log.info("Predicted time to deliver",
+                      seconds=round(pred_ms / 1000.0, 6),
+                      solve_ms=round(solve_ms, 3))
+        print(f"Predicted time to deliver: {pred_ms / 1000.0:.6f}s "
+              f"(solve {solve_ms:.3f}ms)", flush=True)
     if leader.boot_enabled:
         # Receivers boot their model from the delivered blobs and report
         # back; TTFT = timer start → last boot report (includes TTD).

@@ -164,6 +164,11 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
                    "nodes": len(node_ids), "fabric": True,
                    "collective_cache": plan_cache.stats(),
                    "plan_phases": utrace.phase_totals()}
+        pred_ms = getattr(leader, "predicted_ttd_ms", 0)
+        if pred_ms:
+            # Mode-3 plan fidelity next to the achieved TTD.
+            summary["predicted_s"] = round(pred_ms / 1000.0, 6)
+            summary["solve_ms"] = round(getattr(leader, "solve_ms", 0.0), 3)
         if boot_cfg is not None:
             booted = leader.boot_ready().get(timeout=timeout)
             ttft = time.monotonic() - t0

@@ -36,6 +36,10 @@ import time
 CONF_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "conf")
 _TTD_RE = re.compile(r"Time to deliver: ([0-9.]+)s")
+# Mode-3 plan fidelity: the leader prints its solver's min-time next to
+# the achieved TTD (cli.main); recorded as predicted_s/solve_ms columns.
+_PRED_RE = re.compile(
+    r"Predicted time to deliver: ([0-9.]+)s \(solve ([0-9.]+)ms\)")
 
 
 def _free_port() -> int:
@@ -191,11 +195,15 @@ def run_once(conf_path: str, mode: int, timeout: float = 120.0,
         for cid in client_ids:
             procs.append(spawn(cid, ("-c",)))
         out, _ = leader.communicate(timeout=timeout)
-        m = _TTD_RE.search(out.decode())
+        text = out.decode()
+        m = _TTD_RE.search(text)
         if not m:
             raise RuntimeError(
                 f"no TTD in leader output (mode {mode}): {out[-2000:]!r}"
             )
+        pm = _PRED_RE.search(text)
+        run_once.last_predicted = (
+            (float(pm.group(1)), float(pm.group(2))) if pm else None)
         for p in procs[1:]:
             if p.args[-1] != "-c":  # clients run forever; killed below
                 p.wait(timeout=30)
@@ -248,7 +256,11 @@ def run_once_pod(conf_path: str, mode: int, timeout: float = 240.0) -> float:
         )
     # Stash the run's machine-readable summary (collective-cache stats,
     # phase totals) for run_matrix to fold into the scenario record.
-    run_once_pod.last_summary = _parse_summary_line(out)
+    summary = _parse_summary_line(out)
+    run_once_pod.last_summary = summary
+    run_once_pod.last_predicted = (
+        (summary["predicted_s"], summary.get("solve_ms", 0.0))
+        if summary and "predicted_s" in summary else None)
     return float(m.group(1))
 
 
@@ -336,6 +348,15 @@ def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
                 if summary and summary.get("collective_cache"):
                     per_mode[str(mode)]["collective_cache"] = (
                         summary["collective_cache"])
+                if mode == 3:
+                    # Plan fidelity: the last trial's solver prediction
+                    # (deterministic across trials) next to achieved TTD.
+                    pred = getattr(runner, "last_predicted", None)
+                    if pred is None and runner is run_once_spmd:
+                        pred = getattr(run_once, "last_predicted", None)
+                    if pred:
+                        per_mode["3"]["predicted_s"] = round(pred[0], 4)
+                        per_mode["3"]["solve_ms"] = round(pred[1], 3)
                 print(f"{name} mode {mode}: TTD {per_mode[str(mode)]['ttd_s']}s",
                       file=sys.stderr, flush=True)
             if "0" in per_mode and "1" in per_mode:
@@ -397,33 +418,158 @@ def run_codec_ab(trials: int, rate: int = 4 << 20, mode: int = 3,
 
 
 # The driver-provided BASELINE.json scenarios (#2-#5), materialized by
-# cli.genconf: (config file, the mode the scenario names).
+# cli.genconf: (config file, the modes to record).  The 64-node row runs
+# ALL FOUR modes so the mode-3 solver is exercised — and its solve time
+# recorded — at the scenario's full node count (VERDICT item 6).
 BASELINE_SCENARIOS = (
-    ("bench_8node_llama8b.json", 0),
-    ("bench_16node_llama70b.json", 1),
-    ("bench_32node_pipeline.json", 1),
-    ("bench_64node_llama405b.json", 1),
+    ("bench_8node_llama8b.json", (0,)),
+    ("bench_16node_llama70b.json", (1,)),
+    ("bench_32node_pipeline.json", (1,)),
+    ("bench_64node_llama405b.json", (0, 1, 2, 3)),
 )
 
 
-def run_baseline_scenarios(scale: int, timeout: float = 600.0) -> dict:
-    """One recorded TTD per BASELINE scenario, at loopback scale.
+def run_baseline_scenarios(scale: int = 64 << 20,
+                           timeout: float = 1200.0) -> dict:
+    """Recorded TTDs for the BASELINE scenarios, at ≥64 MiB layers.
 
-    Layer sizes scale down (64-node Llama-405B at physical size needs a
-    real cluster); node counts and schedules stay faithful — up to 64 OS
-    processes over loopback, the reference's own benchmark shape."""
+    Layer sizes scale down from physical (64-node Llama-405B at full
+    size needs a real cluster) but stay big enough that the bandwidth
+    term — not per-transfer overhead — dominates; node counts and
+    schedules stay faithful: up to 64 OS processes over loopback, the
+    reference's own benchmark shape.  Each scenario records its per-mode
+    rows with the layer bytes; mode-3 rows carry the solver's
+    predicted_s and solve_ms."""
+    if scale <= 0:
+        raise ValueError("baseline scale must be positive (bytes)")
     out = {}
     with tempfile.TemporaryDirectory() as td:
-        for name, mode in BASELINE_SCENARIOS:
+        for name, modes in BASELINE_SCENARIOS:
             local = os.path.join(td, name)
             _localize_config(os.path.join(CONF_DIR, name), local,
                              scale_to=scale)
-            ttd = run_once(local, mode, timeout)
-            key = f"{os.path.splitext(name)[0]}@{scale >> 10}KiB"
-            out[key] = {"mode": mode, "ttd_s": round(ttd, 4)}
-            print(f"{key} mode {mode}: TTD {ttd:.4f}s",
-                  file=sys.stderr, flush=True)
+            key = f"{os.path.splitext(name)[0]}@{scale >> 20}MiB"
+            rows = []
+            for mode in modes:
+                ttd = run_once(local, mode, timeout)
+                row = {"mode": mode, "ttd_s": round(ttd, 4),
+                       "layer_bytes": scale}
+                pred = getattr(run_once, "last_predicted", None)
+                if mode == 3 and pred:
+                    row["predicted_s"] = round(pred[0], 4)
+                    row["solve_ms"] = round(pred[1], 3)
+                rows.append(row)
+                print(f"{key} mode {mode}: TTD {ttd:.4f}s",
+                      file=sys.stderr, flush=True)
+            out[key] = rows
     return out
+
+
+def run_north_star(timeout_unused: float = 0.0) -> dict:
+    """VERDICT item 5: argue the BASELINE north-star target (<10 s /
+    ≥70% ICI utilization for Llama-70B's 80 layers on a v5e-32) by
+    MODEL — run the mode-3 solver on ``conf/tpu_v5e32_llama70b.json``
+    exactly as the leader would and record predicted completion time,
+    aggregate rate, and the dest-side ICI-utilization fraction.  No
+    hardware in the loop: the solver is the only instrument this
+    environment allows, and its prediction-vs-achieved fidelity is
+    regression-guarded separately (the predicted_s columns).
+
+    Three rows, same assignment (each of 8 hosts ends up holding its 10
+    pipeline-stage layers):
+    - ``shipped``: the config as checked in — ONE seeder whose 80 blobs
+      sit behind a 3 GB/s disk-class source;
+    - ``mem_seeder``: the same seeder's blobs re-typed in-RAM (source
+      uncapped, its 25 GB/s line rate is the ceiling);
+    - ``mem_4seeders``: hot-spare replicas — 4 of the 8 hosts hold the
+      full blob set in RAM, the paper's multi-seeder co-send shape.
+    The variants isolate WHERE the target lives: the solver hits <10 s
+    the moment sources stop being the bottleneck, and ≥70% dest-side
+    utilization with replicated in-RAM seeders."""
+    from ..core import config as cfgmod
+    from ..core.types import LayerLocation, LayerMeta, SourceType
+    from ..sched import make_flow_graph
+
+    conf = cfgmod.read_json(
+        os.path.join(CONF_DIR, "tpu_v5e32_llama70b.json"))
+    line_bw = {nc.id: nc.network_bw for nc in conf.nodes}
+    shipped_holdings = {}
+    sizes = {}
+    for nc in conf.nodes:
+        by_node = {}
+        for st, by_layer in (nc.initial_layers or {}).items():
+            rate = nc.sources.get(st, 0)
+            for lid, size in by_layer.items():
+                size = size or conf.layer_size
+                by_node[lid] = (st, rate, size)
+                sizes[lid] = size
+        if by_node:
+            shipped_holdings[nc.id] = by_node
+    topo = conf.mesh.topology() if conf.mesh is not None else None
+
+    def solve(label: str, holdings: dict) -> dict:
+        status = {nc.id: {} for nc in conf.nodes}
+        layer_sizes = {}
+        for node_id, by_node in holdings.items():
+            for lid, (st, rate, size) in by_node.items():
+                loc = (LayerLocation.DISK if st == SourceType.DISK
+                       else LayerLocation.INMEM)
+                status[node_id][lid] = LayerMeta(
+                    location=loc, limit_rate=rate, source_type=st,
+                    data_size=size)
+                layer_sizes[lid] = size
+        # The leader's assign_jobs discipline: pairs the dest already
+        # holds are satisfied, the solver plans the rest.
+        modified = {}
+        for dest, lids in conf.assignment.items():
+            for lid, meta in lids.items():
+                if lid in status.get(dest, {}):
+                    continue
+                modified.setdefault(dest, {})[lid] = meta
+        t0 = time.monotonic()
+        graph = make_flow_graph(modified, status, layer_sizes, line_bw,
+                                topology=topo)
+        t_ms, jobs = graph.get_job_assignment()
+        solve_ms = (time.monotonic() - t0) * 1000
+        wire = sum(j.data_size for jl in jobs.values() for j in jl)
+        pred_s = t_ms / 1000.0
+        dests = {j.dest_id for jl in jobs.values() for j in jl}
+        dest_cap = sum(line_bw[d] for d in sorted(dests))
+        agg_gbps = wire / max(pred_s, 1e-9) / 1e9
+        rec = {
+            "label": label,
+            "wire_bytes": wire,
+            "predicted_s": round(pred_s, 3),
+            "solve_ms": round(solve_ms, 1),
+            "aggregate_gbps": round(agg_gbps, 2),
+            "dest_line_gbps": round(dest_cap / 1e9, 1),
+            "ici_utilization": round(agg_gbps / max(dest_cap / 1e9, 1e-9),
+                                     3),
+        }
+        rec["meets_time"] = pred_s < 10.0
+        rec["meets_utilization"] = rec["ici_utilization"] >= 0.70
+        print(f"north_star {label}: predicted {pred_s:.2f}s, "
+              f"{rec['ici_utilization']:.0%} dest-side utilization "
+              f"(solve {solve_ms:.0f}ms)", file=sys.stderr, flush=True)
+        return rec
+
+    mem1 = {n: {lid: (SourceType.MEM, 0, size)
+                for lid, (_st, _r, size) in by.items()}
+            for n, by in shipped_holdings.items()}
+    seeders4 = sorted(line_bw)[:4]
+    mem4 = {n: {lid: (SourceType.MEM, 0, sizes[lid]) for lid in sizes}
+            for n in seeders4}
+    return {
+        "config": "tpu_v5e32_llama70b.json",
+        "layers": len(sizes),
+        "layer_bytes": next(iter(sizes.values())) if sizes else 0,
+        "target": {"time_s": 10.0, "utilization": 0.70},
+        "rows": [
+            solve("shipped (1 disk seeder @3GB/s)", shipped_holdings),
+            solve("mem_seeder (1 in-RAM seeder)", mem1),
+            solve("mem_4seeders (hot-spare replicas)", mem4),
+        ],
+    }
 
 
 _TTFT_RE = re.compile(r"Time to first token: ([0-9.]+)s")
@@ -598,8 +744,10 @@ def _physical_phases(dest_log: str) -> dict:
       so its device-ingest accounting runs DURING the wire receive.
     """
     wire = copy = ingest = stage = boot = 0.0
-    span = 0.0
-    layers = frags = placed = 0
+    span = stream_wait = precompile = stream = stream_wire = 0.0
+    layers = frags = placed = streamed = streamed_wire = 0
+    boot_via = ""
+    precompile_in_wire = None
     with open(dest_log) as f:
         for line in f:
             try:
@@ -620,6 +768,17 @@ def _physical_phases(dest_log: str) -> dict:
                 stage += float(rec.get("stage_ms", 0.0))
             elif m == "model booted from disseminated layers":
                 boot += float(rec.get("ttft_ms", 0.0))
+                stream_wait += float(rec.get("stream_wait_ms", 0.0))
+                boot_via = rec.get("via", boot_via)
+            elif m == "boot programs precompiled during dissemination":
+                precompile += float(rec.get("compile_s", 0.0)) * 1000
+                precompile_in_wire = bool(rec.get("in_wire", False))
+            elif m == "layer boot-staged (streamed)":
+                streamed += 1
+                stream += float(rec.get("stage_ms", 0.0))
+                if rec.get("in_wire"):
+                    streamed_wire += 1
+                    stream_wire += float(rec.get("stage_ms", 0.0))
     return {
         "layers": layers,
         "fragments": frags,
@@ -630,18 +789,39 @@ def _physical_phases(dest_log: str) -> dict:
         "max_layer_recv_span_ms": round(span, 1),
         "stage_ms": round(stage, 1),
         "boot_ms": round(boot, 1),
+        "boot_via": boot_via,
+        # TTFT pipeline evidence: hint-time compile (and whether it
+        # finished inside the wire window), per-blob streamed staging
+        # (and how much of it overlapped the wire), and the boot's wait
+        # for any staging tail.
+        "precompile_ms": round(precompile, 1),
+        "precompile_in_wire": precompile_in_wire,
+        "stream_stage_ms": round(stream, 1),
+        "stream_stage_in_wire_ms": round(stream_wire, 1),
+        "streamed_blobs": streamed,
+        "streamed_blobs_in_wire": streamed_wire,
+        "boot_stream_wait_ms": round(stream_wait, 1),
     }
 
 
-def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
+def run_physical(timeout: float = 1200.0, trace_out: str = "",
+                 cache_dir: str = "", label: str = "") -> dict:
     """One recorded run at PHYSICAL layer size (no -scale): ties the TTD
     story to the bench's measured ingest bandwidth — TTD, TTFT, and the
     achieved dest ingest rate on whatever backend is live (recorded).
     ``trace_out``: also merge the per-node JSON logs and write a
     Chrome-trace of the run there (the observability pipeline exercised
-    on the recorded scenario itself)."""
+    on the recorded scenario itself).
+    ``cache_dir``: persistent compilation cache directory handed to the
+    node processes (DLD_COMPILE_CACHE_DIR) — the cold run writes it, the
+    warm run's boot reads it; ``label`` tags the record ("cold"/"warm").
+    Seeders run ``-boot none``: only the DEST's boot is the metric, and
+    a seeder pointlessly booting its own full copy would contend for the
+    same cores during the measured window."""
     backend = _live_backend()
     env = dict(os.environ) if backend else _cpu_env()
+    if cache_dir:
+        env["DLD_COMPILE_CACHE_DIR"] = cache_dir
     # The host's measured loopback ceiling: one raw stream, and the
     # striped data plane's stream count — the denominator that makes the
     # achieved rate attributable (bench.py's raw_dma_gbps/link_fraction
@@ -667,7 +847,7 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
 
         errfs = []
 
-        def spawn(node_id):
+        def spawn(node_id, extra=()):
             # Per-node JSON logs (zerolog-style, on stderr) captured to
             # files: the same artifacts a deployment's collect_logs
             # gathers, here feeding the committed trace.
@@ -676,7 +856,8 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
             return subprocess.Popen(
                 [sys.executable, "-m",
                  "distributed_llm_dissemination_tpu.cli.main",
-                 "-id", str(node_id), "-f", path, "-m", "3", "-hbm"],
+                 "-id", str(node_id), "-f", path, "-m", "3", "-hbm",
+                 *extra],
                 stdout=subprocess.PIPE, stderr=errf, env=env,
             )
 
@@ -708,12 +889,17 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
             leader = spawn(0)
             procs.append(leader)
             wait_listening(leader, leader_addr, budget=600.0)
+            dest_ids = {int(k) for k in conf.get("Assignment", {})}
             for rid in receiver_ids:
-                procs.append(spawn(rid))
+                # Seeders opt out of booting (they report "skipped");
+                # only the dest's boot is measured.
+                procs.append(spawn(
+                    rid, () if rid in dest_ids else ("-boot", "none")))
             out, _ = leader.communicate(timeout=timeout)
             text = out.decode()
             ttd_m = _TTD_RE.search(text)
             ttft_m = _TTFT_RE.search(text)
+            pred_m = _PRED_RE.search(text)
             if not ttd_m:
                 raise RuntimeError(
                     f"no TTD in physical run output: {text[-2000:]!r}")
@@ -729,6 +915,11 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "") -> dict:
                 "achieved_gbps": round(total / ttd / 1e9, 3),
                 "stripes": STRIPE_COUNT,
             }
+            if label:
+                rec["cache"] = label
+            if pred_m:
+                rec["predicted_s"] = round(float(pred_m.group(1)), 4)
+                rec["solve_ms"] = round(float(pred_m.group(2)), 3)
             # 0.0 = that probe arm failed (accept timeout): record only
             # the arms that really measured, never a bogus zero ceiling.
             if loop_raw > 0:
@@ -832,7 +1023,14 @@ def to_markdown(results: dict) -> str:
     for name, per_mode in results["scenarios"].items():
         row = [name]
         for m in ("0", "1", "2", "3"):
-            row.append(f"{per_mode[m]['ttd_s']}s" if m in per_mode else "—")
+            if m not in per_mode:
+                row.append("—")
+                continue
+            cell = f"{per_mode[m]['ttd_s']}s"
+            if m == "3" and "predicted_s" in per_mode[m]:
+                # Plan fidelity: the solver's min-time next to achieved.
+                cell += f" (pred {per_mode[m]['predicted_s']}s)"
+            row.append(cell)
         row.append(str(per_mode.get("mode1_vs_mode0", "—")))
         lines.append("| " + " | ".join(row) + " |")
     lines.append("")
@@ -946,6 +1144,84 @@ def to_markdown(results: dict) -> str:
                 "probes).",
                 "",
             ]
+        cold = phys.get("cold")
+        if cold:
+            wph = phys.get("phases") or {}
+            cph = cold.get("phases") or {}
+
+            def ttft_row(tag, rec, ph):
+                boot_ms = ph.get("boot_ms", 0.0)
+                pre = ph.get("precompile_ms")
+                pre_cell = ("—" if pre is None else
+                            f"{pre}ms"
+                            + (" (in-wire)" if ph.get("precompile_in_wire")
+                               else " (post-startup)"))
+                streamed = ph.get("streamed_blobs", 0)
+                stream_cell = (
+                    f"{ph.get('stream_stage_ms', 0.0)}ms "
+                    f"({ph.get('streamed_blobs_in_wire', 0)}/{streamed} "
+                    "blobs in-wire)" if streamed else "—")
+                ttft = rec.get("ttft_s")
+                ttd = rec.get("ttd_s")
+                bar = (round(ttft / (ttd + boot_ms / 1000), 2)
+                       if ttft and ttd else None)
+                return (f"| {tag} | {ttd}s | "
+                        + (f"{ttft}s" if ttft else "—")
+                        + f" | {boot_ms}ms | {pre_cell} | {stream_cell} | "
+                        + (f"{bar}" if bar is not None else "—") + " |")
+
+            lines += [
+                "### TTFT: persistent compilation cache + streamed "
+                "staging (cold vs warm)",
+                "",
+                "The same scenario run twice against one "
+                "`DLD_COMPILE_CACHE_DIR`: the cold run compiles (and "
+                "writes the cache) — its one-time compile overlaps the "
+                "wire via the BootHint precompile; the warm run's "
+                "compiles are DISK READS, so its boot tail is assembly "
+                "+ forward only.  `streamed staging` is the per-layer "
+                "receive-to-device boot path "
+                "(`runtime/stream_boot.py`): each delivered layer's "
+                "decode/upload runs the moment its interval set "
+                "completes, concurrent with the remaining transfers.  "
+                "`TTFT/(TTD+boot)` is the acceptance ratio — the "
+                "leader-observed TTFT against delivery plus the dest's "
+                "own boot tail (protocol overhead is the remainder); "
+                "the VERDICT item 4 bar is warm TTFT ≤ TTD + decode "
+                "+ ~20%.  Seeders run `-boot none` in both rows (only "
+                "the dest's boot is the metric; a seeder booting its "
+                "own copy would contend for the same 2 cores).",
+                "",
+                "| cache | TTD | TTFT | boot tail | hint precompile | "
+                "streamed stage | TTFT/(TTD+boot) |",
+                "|---|---|---|---|---|---|---|",
+                ttft_row("cold", cold, cph),
+                ttft_row("warm", phys, wph),
+                "",
+            ]
+            prior = phys.get("prior")
+            if prior and prior.get("ttft_s"):
+                lines += [
+                    "**Before/after (this PR):** the prior recorded row "
+                    f"was TTD {prior['ttd_s']}s / TTFT "
+                    f"{prior['ttft_s']}s — the boot (XLA compile + "
+                    "whole-model staging, all after the last byte) was "
+                    f"~{round((prior['ttft_s'] - prior['ttd_s']) / max(prior['ttd_s'], 1e-9), 1)}x "
+                    "the transfer it followed.  With the persistent "
+                    "compilation cache, per-layer streamed staging, and "
+                    "donated staging, the re-measured rows are cold "
+                    f"TTD {cold.get('ttd_s')}s / TTFT "
+                    f"{cold.get('ttft_s')}s and warm TTD "
+                    f"{phys.get('ttd_s')}s / TTFT {phys.get('ttft_s')}s.  "
+                    "Attribution caveat: the harness changed alongside "
+                    "the code — seeders now run `-boot none`, so part "
+                    "of the cross-row delta is the removal of two "
+                    "seeder boots that contended for the prior row's 2 "
+                    "cores; the CONTROLLED evidence is within-run — "
+                    "the cold-vs-warm pair above (same harness both "
+                    "rows) and the TTFT/(TTD+boot) ratio.",
+                    "",
+                ]
         fab = results.get("physical_fabric")
         if fab:
             frags = fab.get("tcp_layer_fragments",
@@ -1079,20 +1355,72 @@ def to_markdown(results: dict) -> str:
                     f"{tail}ms |",
                     "",
                 ]
+    ns = results.get("north_star_model")
+    if ns:
+        tgt = ns.get("target", {})
+        lines += [
+            "## north_star_model: the v5e-32 / Llama-70B target, argued "
+            "by model",
+            "",
+            f"The mode-3 solver run on `conf/{ns['config']}` exactly as "
+            f"the leader would ({ns['layers']} layers x "
+            f"{ns['layer_bytes'] / 2**30:.2f} GiB, 8 hosts x 4 chips, "
+            "25 GB/s per-host line rate) — the hardware-independent way "
+            "this environment allows the BASELINE north-star row "
+            f"(<{tgt.get('time_s', 10):g} s at "
+            f">={tgt.get('utilization', 0.7):.0%} of ICI line rate) to "
+            "be argued.  `utilization` is dest-side: aggregate planned "
+            "ingest over the receiving hosts' summed line rate.  The "
+            "three rows isolate the bottleneck: the SHIPPED config is "
+            "source-bound (one seeder's 3 GB/s disk class caps the whole "
+            "pod — no schedule can beat bytes/rate), and the target is "
+            "met exactly when the blobs sit in RAM on replicated "
+            "seeders, the paper's multi-seeder co-send shape.",
+            "",
+            "| sources | predicted completion | aggregate | dest-side "
+            "ICI utilization | solve | <10s | >=70% |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        for row in ns.get("rows", []):
+            lines.append(
+                f"| {row['label']} | {row['predicted_s']}s | "
+                f"{row['aggregate_gbps']} GB/s | "
+                f"{row['ici_utilization']:.1%} of "
+                f"{row['dest_line_gbps']} GB/s | {row['solve_ms']}ms | "
+                f"{'yes' if row['meets_time'] else 'NO'} | "
+                f"{'yes' if row['meets_utilization'] else 'NO'} |")
+        lines.append("")
     baseline = results.get("baseline_scenarios")
     if baseline:
         lines += [
             "## BASELINE.json scenarios (#2-#5)",
             "",
-            "Driver-named benchmark topologies (cli.genconf), run at "
-            "loopback scale with faithful node counts and schedules — "
-            "8 to 64 OS processes:",
+            "Driver-named benchmark topologies (cli.genconf), run over "
+            "loopback with faithful node counts and schedules — 8 to 64 "
+            "OS processes — at >=64 MiB layers, so the bandwidth term "
+            "(not per-transfer overhead) dominates.  The 64-node row "
+            "runs ALL FOUR modes, exercising the mode-3 solver at the "
+            "scenario's full node count; its predicted_s/solve time are "
+            "recorded next to the achieved TTD.",
             "",
-            "| scenario | mode | TTD |",
-            "|---|---|---|",
+            "| scenario | mode | layer bytes | TTD | mode-3 predicted | "
+            "solve |",
+            "|---|---|---|---|---|---|",
         ]
-        for name, rec in baseline.items():
-            lines.append(f"| {name} | {rec['mode']} | {rec['ttd_s']}s |")
+        for name, rows in baseline.items():
+            if isinstance(rows, dict):  # pre-64MiB record (carried over)
+                rows = [rows]
+            for rec in rows:
+                size = rec.get("layer_bytes")
+                lines.append(
+                    f"| {name} | {rec['mode']} | "
+                    + (f"{size >> 20} MiB" if size else "—")
+                    + f" | {rec['ttd_s']}s | "
+                    + (f"{rec['predicted_s']}s" if "predicted_s" in rec
+                       else "—")
+                    + " | "
+                    + (f"{rec['solve_ms']}ms" if "solve_ms" in rec
+                       else "—") + " |")
         lines.append("")
     return "\n".join(lines)
 
@@ -1106,6 +1434,9 @@ def main(argv=None) -> int:
     p.add_argument("-baseline", action="store_true",
                    help="also run the BASELINE.json scenarios #2-#5 "
                         "(8-64 processes; minutes of wall time)")
+    p.add_argument("-baseline-scale", type=int, default=64 << 20,
+                   help="LayerSize bytes for the BASELINE scenarios "
+                        "(>=64 MiB so bandwidth dominates)")
     p.add_argument("-physical", action="store_true",
                    help="also run the physical-size scenario (~1.8 GiB "
                         "over loopback + device staging + a boot)")
@@ -1124,16 +1455,43 @@ def main(argv=None) -> int:
                 prior_doc = json.load(f)
         except (OSError, ValueError):
             prior_doc = None
+    # The solver-by-model north-star record is cheap (a few solves, no
+    # processes): regenerate it on every run.
+    results["north_star_model"] = run_north_star()
     if args.baseline:
+        if args.baseline_scale < 64 << 20:
+            # Smaller layers are fine for iterating, but the RECORDED
+            # matrix wants the bandwidth-dominated regime — say so
+            # instead of silently clamping.
+            print(f"note: -baseline-scale {args.baseline_scale} is below "
+                  "the 64 MiB bandwidth-dominated regime the recorded "
+                  "matrix uses", file=sys.stderr)
         results["baseline_scenarios"] = run_baseline_scenarios(
-            min(args.scale, 256 << 10)
-        )
+            args.baseline_scale)
     elif prior_doc and prior_doc.get("baseline_scenarios"):
         # A refresh without -baseline must not erase the recorded
         # BASELINE scenario results (minutes of 64-process wall time).
         results["baseline_scenarios"] = prior_doc["baseline_scenarios"]
     if args.physical:
-        results["physical"] = run_physical(trace_out=args.trace)
+        # Cold-then-warm against ONE persistent compilation cache: the
+        # cold run writes it (its compile overlaps the wire via the
+        # BootHint precompile), the warm run reads it — the pair is the
+        # TTFT cold/warm breakdown the markdown renders.
+        import shutil
+
+        cachedir = tempfile.mkdtemp(prefix="dld-compile-cache-")
+        try:
+            cold = run_physical(trace_out=args.trace, cache_dir=cachedir,
+                                label="cold")
+            warm = run_physical(cache_dir=cachedir, label="warm")
+        finally:
+            shutil.rmtree(cachedir, ignore_errors=True)
+        warm["cold"] = {
+            k: cold[k] for k in ("ttd_s", "ttft_s", "achieved_gbps",
+                                 "phases", "cache", "predicted_s")
+            if k in cold
+        }
+        results["physical"] = warm
         # Before/after: carry the superseded record's headline numbers so
         # the regenerated markdown states the delta it claims.
         prior_phys = (prior_doc or {}).get("physical")
@@ -1143,6 +1501,9 @@ def main(argv=None) -> int:
                 "achieved_gbps": prior_phys["achieved_gbps"],
                 "backend": prior_phys.get("backend", ""),
             }
+            if "ttft_s" in prior_phys:
+                results["physical"]["prior"]["ttft_s"] = (
+                    prior_phys["ttft_s"])
             if "stripes" in prior_phys:
                 # Marks the prior as post-striping: the markdown then
                 # reports plain run-to-run drift instead of attributing

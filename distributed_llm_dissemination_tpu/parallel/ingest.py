@@ -100,8 +100,7 @@ def ingest_bytes(data, devices: Sequence[jax.Device]) -> jax.Array:
     return execute_flow_plan(jobs, frags, mesh, "ingest", dtype=jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("pad",))
-def _concat_pad(pieces, pad: int):
+def _concat_pad_impl(pieces, pad: int):
     """Splice offset-ordered pieces into one padded span buffer — a single
     compiled HBM-local concat (cached per piece-shape tuple, which repeats
     across a run's layers: every layer of a model shares its flow split)."""
@@ -109,6 +108,17 @@ def _concat_pad(pieces, pad: int):
     if buf.shape[0] < pad:
         buf = jnp.pad(buf, (0, pad - buf.shape[0]))
     return buf
+
+
+_concat_pad_impl.__name__ = "_concat_pad"  # keep the traced name
+_concat_pad = functools.partial(
+    jax.jit, static_argnames=("pad",))(_concat_pad_impl)
+# (A donate_argnums twin was tried here and measured useless: XLA
+# donation is input→output ALIASING, and no concat output can alias an
+# input buffer — the warning fires and nothing frees early.  The early-
+# free that does work is reference-dropping: _span_buffers re-points the
+# retained piece lists at the spliced buffers, so the piece originals
+# free the moment the splice retires instead of living until close.)
 
 
 class ShardedLayerIngest:
@@ -131,7 +141,9 @@ class ShardedLayerIngest:
 
     Peak device footprint is ~2× the layer's span bytes during the splice
     (pieces + concat output), same order as the gather epilogue the
-    multi-device path already pays.
+    multi-device path already pays; the piece originals free the moment
+    the splice retires (``_span_buffers`` re-points their retained
+    references at the spliced buffers) instead of living until close.
     """
 
     def __init__(self, total_bytes: int, devices: Sequence[jax.Device],
@@ -332,9 +344,14 @@ class ShardedLayerIngest:
                 return out
             pieces = [sorted(p) for p in self._pieces]
         out = []
-        for r, (s_off, _) in enumerate(self.spans):
+        for r, (s_off, s_size) in enumerate(self.spans):
             for local_off, piece in pieces[r]:
-                out.append((s_off + local_off, jax.device_get(piece).tobytes()))
+                data = jax.device_get(piece).tobytes()
+                # Spliced pieces are gpad-padded past the span's real
+                # size; the pad tail is not layer bytes.
+                data = data[: max(0, s_size - local_off)]
+                if data:
+                    out.append((s_off + local_off, data))
         return out
 
     def _splice(self, r: int, pieces: List[Tuple[int, jax.Array]]) -> jax.Array:
@@ -376,7 +393,15 @@ class ShardedLayerIngest:
             # _closed guarantees nothing writes the buffers ever again.
             return [hostmem.adopt_as_device_array(b, d)
                     for b, d in zip(self._host, self.devices)]
-        return [self._splice(r, pieces[r]) for r in range(n)]
+        bufs = [self._splice(r, pieces[r]) for r in range(n)]
+        with self._lock:
+            # Early free: the piece originals are only retained for
+            # salvage; the spliced buffers carry the same committed
+            # bytes (salvage clamps their gpad tails to the span size),
+            # so re-pointing releases the originals' device memory now
+            # instead of at close.
+            self._pieces = [[(0, b)] for b in bufs]
+        return bufs
 
     def finalize(self, timeout: float = 120.0) -> jax.Array:
         """Splice the spans and (multi-device) all-gather them into the

@@ -24,11 +24,13 @@ blobs``) — the disseminated bytes never make a host round-trip.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
-from typing import Any, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 from ..core.types import LayersSrc
+from ..utils import env as env_util
 from ..utils.logging import log
 
 # The boot's jitted programs are MODULE-LEVEL singletons (llama.forward_jit
@@ -38,10 +40,94 @@ from ..utils.logging import log
 _stage_fwd_lock = threading.Lock()
 _stage_fwd = None
 
+# ---------------------------------------------- persistent compilation cache
+#
+# DLD_COMPILE_CACHE_DIR points JAX's persistent compilation cache at a
+# directory shared ACROSS runs: the hint-time precompile of run N writes
+# it, the boot of run N+1 reads it — a warm host pays zero XLA compile at
+# boot, and even a cold host's one-time compile overlaps the wire (the
+# BootHint precompile thread).  Thresholds are dropped to zero so the
+# boot's whole program set (decode jits included) is cached, not just the
+# multi-second forward.  Every boot entry point calls this; it is
+# idempotent and re-points (with a cache reset) when the env var changes
+# — tests isolate their cache dirs that way.
+_cache_lock = threading.Lock()
+_cache_applied: Optional[str] = None
+
+
+def ensure_compile_cache() -> str:
+    """Apply ``DLD_COMPILE_CACHE_DIR`` to JAX's persistent compilation
+    cache config (idempotent; safe pre- and post-backend-init).  Returns
+    the active cache dir ("" = disabled)."""
+    global _cache_applied
+    target = os.environ.get("DLD_COMPILE_CACHE_DIR", "")
+    with _cache_lock:
+        if _cache_applied == target:
+            return target
+        import jax
+
+        try:
+            if _cache_applied is not None:
+                # Re-point: drop the old singleton cache object so the
+                # new dir really takes (jax initializes it lazily once).
+                try:
+                    from jax._src import compilation_cache as _cc
+
+                    _cc.reset_cache()
+                except Exception:  # noqa: BLE001 — older jax: no reset
+                    pass
+            jax.config.update("jax_compilation_cache_dir", target or None)
+            if target:
+                for opt, val in (
+                    ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                    ("jax_persistent_cache_min_entry_size_bytes", -1),
+                ):
+                    try:
+                        jax.config.update(opt, val)
+                    except Exception:  # noqa: BLE001 — option drift: the
+                        pass  # defaults still cache the big programs
+                log.info("persistent compilation cache enabled", dir=target)
+        except Exception as e:  # noqa: BLE001 — caching is an optimization
+            log.warn("persistent compilation cache unavailable", err=repr(e))
+        _cache_applied = target
+    return target
+
+
+def blob_donate_ok(src) -> bool:
+    """Whether the boot may CONSUME this blob's device copy (donated
+    staging).  Policy (``utils.env.boot_donate_mode``): off = never;
+    force = always (tests/benchmarks — unsafe with CPU-adopted buffers);
+    auto = only when a host fallback survives the consumption (later
+    retransmits / update() cycles read ``inmem_data``/disk once
+    ``device_array`` is gone) AND the blob lives on a non-CPU device —
+    the CPU backend zero-copy-adopts host buffers, and donating an
+    adopted array lets XLA overwrite the memory ``inmem_data`` aliases."""
+    mode = env_util.boot_donate_mode()
+    if mode == "off":
+        return False
+    arr = getattr(src, "device_array", None)
+    if arr is None:
+        return False
+    if mode == "force":
+        return True
+    # A host RAM copy is the only fallback that survives: a bare disk
+    # path does NOT — read_span's DISK branch is gated on
+    # location == DISK, and an HBM-located record with device_array
+    # cleared and no inmem_data has no readable bytes at all.
+    if src.inmem_data is None:
+        return False
+    try:
+        return all(d.platform != "cpu" for d in arr.devices())
+    except Exception:  # noqa: BLE001 — unknown array kind: keep it
+        return False
+
 
 def _stage_forward_jitted():
     """The stage boot's forward (a scan of layer_apply over the stacked
-    stage params), jitted once per process."""
+    stage params), jitted once per process.  The dummy activation input
+    is DONATED (it is boot-local and dead after the call) so XLA can run
+    the scan's carry in place; the stacked params are NOT — they are the
+    boot's product (``BootResult.params``) and must stay resident."""
     global _stage_fwd
     with _stage_fwd_lock:
         if _stage_fwd is None:
@@ -52,7 +138,8 @@ def _stage_forward_jitted():
 
             from ..models.llama import layer_apply
 
-            @functools.partial(jax.jit, static_argnums=(2,))
+            @functools.partial(jax.jit, static_argnums=(2,),
+                               donate_argnums=(1,))
             def stage_forward(stacked, x, cfg):
                 positions = jnp.arange(x.shape[1])
 
@@ -115,16 +202,62 @@ def _device_blob(src) -> Optional[Any]:
     return None
 
 
-def decode_head(cfg, src, codec: str = "raw"):
+def stage_blob_leaves(cfg, blob_id: int, src, codec: str = "raw",
+                      sharding=None) -> dict:
+    """ONE blob's share of the boot: its decoded leaves, each with a
+    leading length-1 axis so assembly is a uniform per-leaf concatenate.
+    THE shared per-blob staging: the streaming stager runs it mid-wire
+    (``runtime/stream_boot.py``) and ``boot_from_layers`` runs the same
+    code to infill any blob the stager missed — both must produce
+    identical bits and hit the same compiled programs.
+
+    Device path: the HBM-resident wire blob decodes under the PLAIN
+    (non-donated) 1-blob codec jit — callers release consumable blobs by
+    dropping references (``blob_donate_ok``), never by ``donate_argnums``
+    (a concurrent flow-retransmit reader holding the array would crash
+    on an XLA-deleted buffer).  Host path: numpy decode + async
+    ``device_put`` per leaf (under ``sharding`` when given)."""
+    import jax
+    import numpy as np
+
+    from ..models import quant, serde
+
+    head = blob_id == serde.head_blob_id(cfg)
+    specs = tuple(serde.head_param_specs(cfg) if head
+                  else serde.layer_param_specs(cfg))
+    arr = _device_blob(src)
+    if arr is not None:
+        decode = quant.device_decode_jit(codec, donate=False)
+        leaves = decode((arr,), specs, np.dtype(cfg.dtype).name)
+        if blob_donate_ok(src):
+            src.device_array = None
+        return leaves
+    data = (src.inmem_data if src.inmem_data is not None
+            else src.read_bytes())
+    host = quant.decode_blob_host(cfg, blob_id, data, codec)
+    out = {}
+    for name, _ in specs:
+        a = host[name][None]  # leading axis: uniform concat assembly
+        out[name] = (jax.device_put(a, sharding)
+                     if sharding is not None else jax.device_put(a))
+    return out
+
+
+def decode_head(cfg, src, codec: str = "raw", donate: bool = False):
     """embed/ln_f/lm_head leaves from a head-blob ``LayerSrc`` — the
     device path when the blob is HBM-resident (jax arrays), the host
     path otherwise (numpy).  Shared by the full boot and pod serving
-    (``runtime/pp_serve.py``) so the decode dispatch lives once."""
+    (``runtime/pp_serve.py``) so the decode dispatch lives once.
+    ``donate``: consume the device blob in place (the record's
+    ``device_array`` is cleared — host fallback serves later readers)."""
     from ..models import quant
 
     dev = _device_blob(src)
     if dev is not None:
-        return quant.head_from_device(cfg, dev, codec)
+        out = quant.head_from_device(cfg, dev, codec, donate=donate)
+        if donate:
+            src.device_array = None
+        return out
     data = src.inmem_data if src.inmem_data is not None else src.read_bytes()
     return quant.head_from_blob_host(cfg, data, codec)
 
@@ -165,6 +298,7 @@ def boot_from_layers(
     tokens=None,
     codec: str = "raw",
     generate_tokens: int = 0,
+    stager=None,
 ) -> BootResult:
     """Assemble delivered blobs into model params and run one forward.
 
@@ -175,6 +309,13 @@ def boot_from_layers(
     (``models/quant.py``); quantized ("int8"/"int4") blobs are
     dequantized during assembly — on-device when they were ingested to
     HBM.
+    ``stager``: a ``runtime.stream_boot.StreamingBootStager`` that has
+    been decoding layers per-blob AS THEY ARRIVED; when it covers every
+    layer blob, assembly is one device-local concatenate per leaf (the
+    decode + host→device work already overlapped the wire) — bit-
+    identical to the bulk paths by construction, completion order
+    included (each blob decodes independently; the concat is in layer-id
+    order).
     Returns a BootResult whose ``seconds`` is the time from blob assembly
     to the first forward's output being ready (includes jit compile — the
     honest time-to-first-token a cold boot pays)."""
@@ -185,6 +326,7 @@ def boot_from_layers(
     from ..models import quant, serde
     from ..models.llama import forward_jit
 
+    ensure_compile_cache()
     t0 = time.monotonic()
     head_id = serde.head_blob_id(cfg)
     layer_ids, full = classify_held_blobs(cfg, layers)
@@ -198,16 +340,66 @@ def boot_from_layers(
             placement.stage_mesh(placement.node_to_stage[node_id]), P()
         )
 
-    # Assembly: device blobs stay on device; host blobs go up in one
-    # device_put per leaf-stack.
+    # Assembly: streamed per-layer leaves splice with one concat per
+    # leaf; otherwise device blobs stay on device (donated when safe —
+    # the wire blobs are consumed in place instead of doubling the
+    # footprint); otherwise host blobs go up in one device_put per
+    # leaf-stack.
     held = layer_ids + ([head_id] if head_id in layers else [])
     dev_blobs = {lid: _device_blob(layers[lid]) for lid in held}
-    if all(dev_blobs[lid] is not None for lid in layer_ids):
+    streamed: Dict[int, dict] = {}
+    stream_wait_s = 0.0
+    if stager is not None:
+        t_w = time.monotonic()
+        streamed = stager.collect(held)
+        stream_wait_s = time.monotonic() - t_w
+    stacked = None
+    via = ""
+    if streamed:
+        try:
+            missing = [lid for lid in layer_ids if lid not in streamed]
+            for lid in missing:
+                # Infill: the stager missed this blob (a per-blob
+                # failure, or collect hit its timeout) — run the SAME
+                # per-blob staging here, so one bad blob costs one
+                # inline decode, never a whole-model host reassembly
+                # (the stager may already have released the OTHER
+                # blobs' device copies).
+                streamed[lid] = stage_blob_leaves(
+                    cfg, lid, layers[lid], codec=codec, sharding=sharding)
+            specs = serde.layer_param_specs(cfg)
+            stacked = {
+                name: jnp.concatenate(
+                    [streamed[lid][name] for lid in layer_ids])
+                for name, _ in specs
+            }
+            # The decoded params exist: blobs whose device copy the boot
+            # may consume are released now (same bookkeeping as the
+            # donated bulk decode — host fallbacks keep serving late
+            # readers).
+            for lid in held:
+                if lid in streamed and blob_donate_ok(layers[lid]):
+                    layers[lid].device_array = None
+                    dev_blobs[lid] = None
+            via = ("streamed per-layer" if not missing
+                   else f"streamed per-layer (+{len(missing)} infilled)")
+        except Exception as e:  # noqa: BLE001 — bulk assembly still works
+            log.warn("streamed assembly failed; bulk assembly instead",
+                     err=repr(e))
+            stacked = None
+    if stacked is None and all(
+            dev_blobs[lid] is not None for lid in layer_ids):
+        donate = all(blob_donate_ok(layers[lid]) for lid in layer_ids)
         stacked = quant.stacked_from_device(
-            cfg, [dev_blobs[lid] for lid in layer_ids], codec
+            cfg, [dev_blobs[lid] for lid in layer_ids], codec, donate=donate
         )
         via = "device bitcast" if codec == "raw" else f"device {codec} dequant"
-    else:
+        if donate:
+            for lid in layer_ids:
+                layers[lid].device_array = None
+                dev_blobs[lid] = None
+            via += " (donated)"
+    elif stacked is None:
         blobs = {
             lid: (
                 layers[lid].inmem_data
@@ -225,8 +417,14 @@ def boot_from_layers(
         via = "host assembly"
 
     if full:
-        head = decode_head(cfg, layers[head_id], codec)
-        if dev_blobs[head_id] is None:
+        head_on_device = dev_blobs[head_id] is not None
+        if head_id in streamed:
+            head = {name: a[0] for name, a in streamed[head_id].items()}
+            head_on_device = True  # streamed leaves are already placed
+        else:
+            head = decode_head(cfg, layers[head_id], codec,
+                               donate=blob_donate_ok(layers[head_id]))
+        if not head_on_device:
             # Host-decoded leaves: place per the stage sharding.
             head = {
                 name: jax.device_put(a, sharding) if sharding is not None
@@ -250,7 +448,8 @@ def boot_from_layers(
         # time — it must not contaminate the metric reported next to TTD.
         dt = time.monotonic() - t0
         log.info("model booted from disseminated layers", kind="full",
-                 layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1))
+                 layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1),
+                 stream_wait_ms=round(stream_wait_s * 1000, 1))
         res = BootResult("full", dt, layer_ids, logits=logits,
                          params=params)
         decode_after_boot(cfg, res, generate_tokens, tokens=tokens)
@@ -265,7 +464,8 @@ def boot_from_layers(
     jax.block_until_ready(acts)
     dt = time.monotonic() - t0
     log.info("pipeline stage booted from disseminated layers", kind="stage",
-             layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1))
+             layers=len(layer_ids), via=via, ttft_ms=round(dt * 1000, 1),
+             stream_wait_ms=round(stream_wait_s * 1000, 1))
     return BootResult("stage", dt, layer_ids, activations=acts,
                       params=stacked)
 
@@ -277,19 +477,30 @@ def precompile_boot(
     node_id=None,
     codec: str = "raw",
     device_blobs: bool = False,
+    streamed: Optional[bool] = None,
 ) -> dict:
     """Lower + compile the boot's jitted programs for the held set
     ``blob_ids`` BEFORE the bytes arrive — XLA compiles from shapes
     alone, so a receiver that gets a ``BootHintMsg`` at distribution
     start can overlap the whole compile with the network transfer and
-    the post-startup boot hits warm caches.
+    the post-startup boot hits warm caches.  With a persistent
+    compilation cache (``DLD_COMPILE_CACHE_DIR``) the compiles also
+    WRITE that cache, so the next run's precompile — or boot — is a
+    disk hit instead of an XLA compile.
 
     Compiles the same module-level callables ``boot_from_layers`` calls
     (``llama.forward_jit`` / ``_stage_forward_jitted`` and, for
     ``device_blobs``, the codec decode jits), so the warm-up needs no
-    handle passing.  Returns {"compiled": [...]} naming what was warmed
-    (for logs and tests).  Best-effort by design: any mismatch with the
-    real boot (different path, sharding, shapes) is only a cache miss."""
+    handle passing.  ``streamed`` (default: the ``DLD_STREAM_BOOT`` env
+    gate, matching the receiver) picks which decode program to warm:
+    the streaming stager decodes ONE blob per call, the bulk boot
+    decodes all n under one jit — distinct cache entries.  The donated
+    decode twin is warmed when the donation mode resolves on for the
+    target devices (per-blob host-fallback checks at boot time can still
+    demote a blob — only a cache miss).  Returns {"compiled": [...]}
+    naming what was warmed (for logs and tests).  Best-effort by design:
+    any mismatch with the real boot (different path, sharding, shapes)
+    is only a cache miss."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -297,6 +508,9 @@ def precompile_boot(
     from ..models import quant, serde
     from ..models.llama import forward_jit
 
+    cache_dir = ensure_compile_cache()
+    if streamed is None:
+        streamed = env_util.stream_boot_enabled()
     head_id = serde.head_blob_id(cfg)
     try:
         layer_ids, full = classify_held_blobs(cfg, blob_ids)
@@ -357,17 +571,35 @@ def precompile_boot(
                    for name, shape in layer_specs}
 
     if device_blobs:
-        # The -hbm path decodes HBM-resident wire blobs under these jits.
-        decode = {"raw": serde._decode_blobs,
-                  "int8": quant._decode_qblobs,
-                  "int4": quant._decode_q4blobs}[codec]
-        blob_abs = tuple(
-            sds((quant.blob_nbytes_codec(cfg, lid, codec),),
-                jnp.uint8, dev_sharding)
-            for lid in layer_ids
-        )
-        decode.lower(blob_abs, layer_specs, dt_name).compile()
-        compiled.append(f"decode[{codec}]x{n}")
+        # The -hbm path decodes HBM-resident wire blobs under these
+        # jits.  The exact callable matters (donated and plain variants
+        # are distinct executables): the STREAMING stager always runs
+        # the PLAIN 1-blob program (it releases blobs by reference, not
+        # donate_argnums — stream_boot._stage_one), while the bulk boot
+        # runs the donated n-blob variant when donation resolves on for
+        # these devices (blob_donate_ok minus the per-blob host-fallback
+        # check, unknowable from shapes alone).
+        if streamed:
+            # One plain 1-blob program covers every layer blob; the
+            # head's is warmed below.
+            decode = quant.device_decode_jit(codec, donate=False)
+            one = (sds((quant.blob_nbytes_codec(cfg, layer_ids[0], codec),),
+                       jnp.uint8, dev_sharding),)
+            decode.lower(one, layer_specs, dt_name).compile()
+            compiled.append(f"decode[{codec}]x1")
+        else:
+            mode = env_util.boot_donate_mode()
+            donate = (mode == "force"
+                      or (mode == "auto"
+                          and all(d.platform != "cpu" for d in devs)))
+            decode = quant.device_decode_jit(codec, donate)
+            blob_abs = tuple(
+                sds((quant.blob_nbytes_codec(cfg, lid, codec),),
+                    jnp.uint8, dev_sharding)
+                for lid in layer_ids
+            )
+            decode.lower(blob_abs, layer_specs, dt_name).compile()
+            compiled.append(f"decode[{codec}]x{n}")
         if full:
             head_abs = (sds(
                 (quant.blob_nbytes_codec(cfg, head_id, codec),),
@@ -394,4 +626,5 @@ def precompile_boot(
         _stage_forward_jitted().lower(stacked_abs, x_abs, cfg).compile()
         compiled.append("stage_forward")
     return {"compiled": compiled,
-            "compile_s": round(time.monotonic() - t0, 2)}
+            "compile_s": round(time.monotonic() - t0, 2),
+            "persistent_cache": bool(cache_dir)}

@@ -1553,6 +1553,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         capacity instead of pretending every edge is ICI."""
         self.node_network_bw = dict(node_network_bw)
         self.topology = topology
+        # The INITIAL solve's predicted completion time (ms) and wall
+        # solve cost — prediction-vs-achieved is the plan-fidelity
+        # record the CLI prints next to TTD (re-plans keep the first
+        # full solve: that is the prediction the TTD clock started on).
+        self.predicted_ttd_ms = 0
+        self.solve_ms = 0.0
         if topology is not None:
             # Pre-warm the LP solver import (scipy + HiGHS, ~1-2 s cold)
             # off the critical path: the first assign_jobs otherwise pays
@@ -1644,9 +1650,15 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             t, jobs = graph.get_job_assignment()
         if gaps_by_pair:
             jobs = self._remap_resumed_jobs(jobs, gaps_by_pair)
+        solve_ms = round((time.monotonic() - t0) * 1000, 3)
+        with self._lock:
+            if not self.predicted_ttd_ms and t > 0:
+                self.predicted_ttd_ms = t
+                self.solve_ms = solve_ms
         log.info(
             "Job assignment completed",
-            computation_ms=round((time.monotonic() - t0) * 1000, 3),
+            computation_ms=solve_ms,
+            predicted_s=round(t / 1000.0, 6),
         )
         return t, self_jobs, jobs
 

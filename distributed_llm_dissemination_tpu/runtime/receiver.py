@@ -48,7 +48,7 @@ from ..transport.messages import (
     ServeMsg,
     StartupMsg,
 )
-from ..utils import hostmem, intervals
+from ..utils import env as env_util, hostmem, intervals, trace
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from .checkpoint import LayerCheckpointStore
@@ -181,6 +181,21 @@ class ReceiverNode:
         self._serve_active = 0
         self._precompile_done = threading.Event()
         self._precompile_done.set()
+        # Startup marker for overlap accounting: precompiles and streamed
+        # stagings that finish before this fires ran DURING the wire.
+        self._startup_seen = threading.Event()
+        # Per-layer streaming boot staging (runtime/stream_boot.py):
+        # each completed blob's decode + host→device placement runs the
+        # moment its interval set completes, concurrent with the
+        # remaining transfers, and the startup boot assembles the staged
+        # leaves with one concat per leaf.  Gated by DLD_STREAM_BOOT.
+        self._boot_stager = None
+        if boot_cfg is not None and env_util.stream_boot_enabled():
+            from .stream_boot import StreamingBootStager
+
+            self._boot_stager = StreamingBootStager(
+                boot_cfg, codec=boot_codec, placement=placement,
+                node_id=node.my_id)
         # Multi-controller serving (runtime/pp_serve.py): startup said a
         # ServeMsg will follow; the CLI keeps the process alive until
         # serve_done() fires (or times out).
@@ -272,9 +287,25 @@ class ReceiverNode:
     def ready(self) -> "queue.Queue[object]":
         return self._ready_q
 
+    def _boot_stream_submit(self, layer_id, src) -> None:
+        """Hand a freshly completed layer to the streaming boot stager
+        (idempotent; a late duplicate no-ops).  Advisory: any failure
+        here only costs the overlap — the startup boot's bulk assembly
+        still covers every blob."""
+        stager = self._boot_stager
+        if stager is None or src is None:
+            return
+        try:
+            stager.submit(layer_id, src)
+        except Exception as e:  # noqa: BLE001 — staging is an optimization
+            log.warn("streamed boot submit failed", layerID=layer_id,
+                     err=repr(e))
+
     def close(self) -> None:
         self.heartbeat.stop()
         self.loop.stop()
+        if self._boot_stager is not None:
+            self._boot_stager.close()
         with self._lock:
             window = self._plan_window
         if window is not None:
@@ -370,6 +401,9 @@ class ReceiverNode:
                 self.layers[msg.layer_id] = src
         log.debug("saved layer in memory", layerID=msg.layer_id)
         loc = self._stage_to_hbm(msg.layer_id, src)
+        # Streamed boot staging: this layer's decode + device placement
+        # starts NOW, overlapping the remaining layers' transfers.
+        self._boot_stream_submit(msg.layer_id, src)
         try:
             self.node.transport.send(
                 self.node.leader_id,
@@ -510,6 +544,10 @@ class ReceiverNode:
                                    else LayerLocation.INMEM),
                     device_array=device_arr,
                 )
+            src = self.layers[layer_id]
+        # Fabric deliveries stream into the boot too: the landed layer's
+        # decode overlaps the remaining plans.
+        self._boot_stream_submit(layer_id, src)
 
     def _receive_device_plan(self, msg: DevicePlanMsg) -> None:
         """The dest half: pull every contribution into my stage's shard
@@ -1034,13 +1072,22 @@ class ReceiverNode:
         from .boot import precompile_boot
 
         try:
+            t0 = _time.monotonic()
             rec = precompile_boot(
                 self.boot_cfg, blob_ids,
                 placement=self.placement, node_id=self.node.my_id,
                 codec=self.boot_codec, device_blobs=self.stage_hbm,
             )
+            # Compile-overlap accounting: the whole warmup counts into
+            # the precompile bucket; the run overlapped the wire exactly
+            # when it finished before startup arrived.
+            dt = _time.monotonic() - t0
+            overlapped = not self._startup_seen.is_set()
+            trace.add_phase("boot_precompile", dt)
+            if overlapped:
+                trace.add_phase("boot_precompile_in_wire", dt)
             log.info("boot programs precompiled during dissemination",
-                     **rec)
+                     in_wire=overlapped, **rec)
         except Exception as e:  # noqa: BLE001 — advisory: boot compiles cold
             log.warn("boot precompile failed; boot will compile at "
                      "startup instead", err=repr(e))
@@ -1062,6 +1109,11 @@ class ReceiverNode:
         silence — the leader's boot wait can never deadlock on a flag
         mismatch."""
         self.expect_serve = msg.serve  # before ready(): the CLI reads it
+        # Overlap accounting: precompiles/streamed stagings that finish
+        # after this point no longer ran during the wire.
+        self._startup_seen.set()
+        if self._boot_stager is not None:
+            self._boot_stager.mark_startup()
         # Latch the boot decision BEFORE ready() fires: the CLI's
         # exit-time wait_boot_drain reads _boot_started the moment
         # ready() returns, and a latch set after the put would race it —
@@ -1140,7 +1192,7 @@ class ReceiverNode:
             res = boot_from_layers(
                 self.boot_cfg, self.layers,
                 placement=self.placement, node_id=self.node.my_id,
-                codec=self.boot_codec,
+                codec=self.boot_codec, stager=self._boot_stager,
             )
             # Assign BEFORE the finally sets the event: _serve() waits on
             # _boot_finished and then reads boot_result, so the event must
@@ -1744,6 +1796,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             ing = self._ingests.pop(lid, None)
             self._ingest_share.pop(lid, None)
         loc = self._stage_to_hbm(lid, src, ingest=ing)
+        # Mid-wire boot staging: this layer's decode/upload overlaps the
+        # layers still on the wire (runtime/stream_boot.py).
+        self._boot_stream_submit(lid, src)
         try:
             self.node.transport.send(
                 self.node.leader_id,

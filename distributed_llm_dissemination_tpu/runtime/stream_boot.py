@@ -1,0 +1,197 @@
+"""Per-layer receive-to-device streaming boot staging.
+
+The boot used to be strictly SEQUENCED after delivery: every blob lands,
+startup fires, and only then does the boot decode all n wire blobs and
+place the params — at physical scale that serial tail is several times
+the transfer it follows (VERDICT r5 item 4).  Redistribution work hides
+that class of latency by overlapping data movement with the downstream
+compute (arXiv:2112.01075, arXiv:2412.14374); this module is the boot's
+version of the same move.
+
+``StreamingBootStager`` accepts each blob THE MOMENT its interval set
+completes (the receiver's completion commit — mid-wire for every blob
+but the last) and immediately runs that blob's share of the boot work on
+a dedicated worker thread:
+
+- **device path** (``-hbm``): the HBM-resident wire blob is decoded
+  per-blob under the same codec jits the bulk boot uses, 1-blob
+  programs — one compile (or persistent-cache read) covers every layer,
+  and each decode overlaps the remaining transfers;
+- **host path**: the blob is decoded on host (numpy views) and each
+  leaf ``device_put`` — asynchronous, so the host→device DMA of layer k
+  rides under the receive of layer k+1.
+
+``boot_from_layers`` then assembles the staged leaves with one
+device-local concatenate per leaf (HBM-bandwidth work) — bit-identical
+to the bulk assembly regardless of COMPLETION ORDER, because each blob
+decodes independently and the concat is in layer-id order.
+
+Leaves are stored with a leading length-1 axis (the decode jits'
+natural output for a 1-tuple; host leaves get ``[None]``) so assembly is
+a plain ``jnp.concatenate`` for both paths.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils import env as env_util, trace
+from ..utils.logging import log
+
+# Phase buckets (utils.trace): summed per-blob staging seconds, and the
+# subset that ran while the wire was still active (before startup) —
+# the stage-overlap-achieved evidence the TTFT breakdown table reads.
+PHASE_STREAM_STAGE = "boot_stream_stage"
+PHASE_STREAM_IN_WIRE = "boot_stream_in_wire"
+
+
+class StreamingBootStager:
+    """Decode/stage completed blobs concurrently with the receive.
+
+    Thread model: ``submit`` is called from receiver handler threads
+    (idempotent per blob — re-plan duplicates are no-ops) and enqueues;
+    ONE worker daemon drains the queue (per-blob decodes are big device
+    dispatches — a pool would just thrash the link).  ``collect`` blocks
+    until every submitted blob is processed and returns the staged
+    leaves; the boot calls it once at startup.  Failures are per-blob
+    and non-fatal: a blob that fails to stage is simply absent from
+    ``collect`` and the boot falls back to bulk assembly."""
+
+    def __init__(self, cfg, codec: str = "raw", placement=None,
+                 node_id=None):
+        self.cfg = cfg
+        self.codec = codec
+        self.placement = placement
+        self.node_id = node_id
+        self._q: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._done = threading.Condition(self._lock)
+        self._staged: Dict[int, dict] = {}
+        self._submitted: set = set()
+        self._pending = 0
+        self._closed = False
+        self._startup_seen = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, blob_id: int, src) -> bool:
+        """Queue a completed blob for staging; False for duplicates,
+        closed stagers, or ids the boot can never use."""
+        from ..models import serde
+
+        if blob_id > serde.head_blob_id(self.cfg):
+            return False
+        with self._lock:
+            if self._closed or blob_id in self._submitted:
+                return False
+            self._submitted.add(blob_id)
+            self._pending += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"boot-stream-{self.node_id}")
+                self._thread.start()
+            # Enqueue INSIDE the lock: a racing close() must not slot
+            # its None sentinel ahead of this item, or the worker exits
+            # with _pending stuck > 0 and collect() waits out its whole
+            # timeout.
+            self._q.put((blob_id, src))
+        return True
+
+    def mark_startup(self) -> None:
+        """Startup arrived: blobs staged from here on no longer overlap
+        the wire (accounting only — staging itself continues)."""
+        with self._lock:
+            self._startup_seen = True
+
+    @property
+    def staged_count(self) -> int:
+        with self._lock:
+            return len(self._staged)
+
+    # ------------------------------------------------------------ consume
+
+    def collect(self, blob_ids, timeout: float = 300.0) -> Dict[int, dict]:
+        """Wait for all in-flight staging, then return {blob_id: leaves}
+        for the requested ids that staged successfully.  The returned
+        leaves carry a leading length-1 axis (module docstring)."""
+        with self._lock:
+            self._done.wait_for(lambda: self._pending == 0, timeout=timeout)
+            if self._pending:
+                log.warn("streamed staging still in flight at collect; "
+                         "boot falls back to bulk assembly",
+                         pending=self._pending)
+                return {}
+            return {b: self._staged[b] for b in blob_ids
+                    if b in self._staged}
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            started = self._thread is not None
+        if started:
+            self._q.put(None)
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        from .boot import ensure_compile_cache
+
+        ensure_compile_cache()
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            blob_id, src = item
+            leaves = None
+            t0 = time.monotonic()
+            try:
+                leaves = self._stage_one(blob_id, src)
+            except Exception as e:  # noqa: BLE001 — boot falls back to bulk
+                log.warn("streamed boot staging failed for blob; bulk "
+                         "assembly will cover it", blobID=blob_id,
+                         err=repr(e))
+            dt = time.monotonic() - t0
+            with self._lock:
+                if leaves is not None:
+                    self._staged[blob_id] = leaves
+                in_wire = not self._startup_seen
+                self._pending -= 1
+                if self._pending == 0:
+                    self._done.notify_all()
+            if leaves is not None:
+                trace.add_phase(PHASE_STREAM_STAGE, dt)
+                if in_wire:
+                    trace.add_phase(PHASE_STREAM_IN_WIRE, dt)
+                log.info("layer boot-staged (streamed)", blobID=blob_id,
+                         stage_ms=round(dt * 1000, 1), in_wire=in_wire)
+
+    def _sharding(self):
+        if (self.placement is not None
+                and self.node_id in self.placement.node_to_stage):
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            return NamedSharding(
+                self.placement.stage_mesh(
+                    self.placement.node_to_stage[self.node_id]), P()
+            )
+        return None
+
+    def _stage_one(self, blob_id: int, src) -> dict:
+        """One blob's staging — ``boot.stage_blob_leaves`` verbatim, so
+        the mid-wire path and the boot's infill path share programs and
+        bits.  Consumable device blobs (``blob_donate_ok``: host
+        fallback retained) are released by reference inside the helper
+        the moment their decode is dispatched: HBM peaks at params-so-
+        far + the in-flight blob, not params + every wire blob."""
+        from .boot import stage_blob_leaves
+
+        return stage_blob_leaves(self.cfg, blob_id, src, codec=self.codec,
+                                 sharding=self._sharding())

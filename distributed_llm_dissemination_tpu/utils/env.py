@@ -14,3 +14,29 @@ def cpu_pinned_env(base: dict = None) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)
     return env
+
+
+def boot_donate_mode() -> str:
+    """The donated-staging knob (``DLD_BOOT_DONATE``): ``"off"`` (0),
+    ``"force"`` (1), or ``"auto"`` (unset/anything else).  Auto donates
+    only where it is both profitable and safe: non-CPU device blobs with
+    a retained host fallback — the CPU backend zero-copy-ADOPTS host
+    buffers as device arrays (``utils.hostmem``), and donating an adopted
+    array would let XLA scribble over the very memory ``inmem_data``
+    still serves retransmits from.  The consumers of this knob
+    (``runtime/boot.py``, ``parallel/ingest.py``) each apply their own
+    platform/aliasing checks on top of the mode."""
+    v = os.environ.get("DLD_BOOT_DONATE", "")
+    if v == "0":
+        return "off"
+    if v == "1":
+        return "force"
+    return "auto"
+
+
+def stream_boot_enabled() -> bool:
+    """Per-layer receive-to-device streaming boot staging
+    (``runtime/stream_boot.py``), default ON; ``DLD_STREAM_BOOT=0``
+    disables it (the boot then assembles everything after startup, the
+    pre-streaming behavior)."""
+    return os.environ.get("DLD_STREAM_BOOT", "1") != "0"

@@ -53,6 +53,16 @@ def span(name: str, **fields):
 # table from them.  Sums are thread-time: concurrent phases overlap, so
 # totals may exceed the run's wall clock (the tables say so).
 
+# TTFT buckets (the boot pipeline, ISSUE 3): writers in
+# ``runtime/receiver.py`` and ``runtime/stream_boot.py``; the
+# ``cli/ttd_matrix.py`` physical row renders them as the TTFT breakdown.
+# - ``boot_precompile``          hint-time XLA compile seconds (total)
+# - ``boot_precompile_in_wire``  the subset that finished BEFORE startup
+#                                — compile-overlap-achieved
+# - ``boot_stream_stage``        per-blob streamed decode/upload seconds
+# - ``boot_stream_in_wire``      the subset that ran before startup —
+#                                stage-overlap-achieved
+
 _phase_lock = threading.Lock()
 _phase_s: dict = {}
 _phase_n: dict = {}

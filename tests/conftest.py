@@ -36,6 +36,41 @@ def cpu_devices():
     return devices
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _compile_cache_tmpdir(tmp_path_factory):
+    """Point the boot's persistent compilation cache (DLD_COMPILE_CACHE_DIR,
+    runtime/boot.ensure_compile_cache) at a per-SESSION tmpdir: tier-1
+    tests exercise the cache code paths without polluting each other
+    across sessions or writing outside pytest's tmp tree.  Tests that
+    need an isolated cache dir (warm-vs-cold assertions) monkeypatch the
+    env var over this default — ensure_compile_cache re-points when the
+    value changes."""
+    prior = os.environ.get("DLD_COMPILE_CACHE_DIR")
+    os.environ["DLD_COMPILE_CACHE_DIR"] = str(
+        tmp_path_factory.mktemp("xla-pcache"))
+    yield
+    if prior is None:
+        os.environ.pop("DLD_COMPILE_CACHE_DIR", None)
+    else:
+        os.environ["DLD_COMPILE_CACHE_DIR"] = prior
+
+
+# Boot-path tests compile real XLA programs; a wedged compile (or a cache
+# deadlock) must burn one test's budget, not the suite's.  Applied here
+# so EVERY test in these files gets the SIGALRM bound without each
+# hand-annotating (explicit @pytest.mark.timeout markers still win).
+_BOOT_TEST_FILES = ("test_boot.py", "test_stream_boot.py")
+_BOOT_TEST_TIMEOUT_S = 120.0
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        fname = os.path.basename(str(getattr(item, "fspath", "")))
+        if (fname in _BOOT_TEST_FILES
+                and item.get_closest_marker("timeout") is None):
+            item.add_marker(pytest.mark.timeout(_BOOT_TEST_TIMEOUT_S))
+
+
 # Tier-1 per-test wall budget (seconds): the whole tier-1 suite must fit
 # a ~10-minute CI wall, so any single test past this belongs in tier 2 —
 # mark it ``@pytest.mark.slow``.  The terminal summary below names

@@ -478,7 +478,11 @@ def test_precompile_boot_device_path_warms_decode_jits(cpu_devices):
 
     cfg = dataclasses.replace(CFG, vocab=384)
     ids = list(range(cfg.n_layers)) + [serde.head_blob_id(cfg)]
-    rec = precompile_boot(cfg, ids, codec="int8", device_blobs=True)
+    # streamed=False: this test boots WITHOUT a streaming stager, so the
+    # bulk n-blob decode program is the one that must be warm (the
+    # streamed 1-blob warm path is covered in tests/test_stream_boot.py).
+    rec = precompile_boot(cfg, ids, codec="int8", device_blobs=True,
+                          streamed=False)
     assert rec["compiled"] == [
         f"decode[int8]x{cfg.n_layers}", "decode[int8]head", "forward"]
 

@@ -104,10 +104,10 @@ def test_int4_device_decode_matches_host(cpu_devices):
         host = quant.decode_blob_host(CFG, bid, enc, "int4")
         dev_blob = jnp.asarray(np.frombuffer(enc, np.uint8))
         if bid == serde.head_blob_id(CFG):
-            dev = quant.head_from_device_q4blob(CFG, dev_blob)
+            dev = quant.head_from_device(CFG, dev_blob, "int4")
             pick = lambda a: a  # noqa: E731
         else:
-            dev = quant.stacked_from_device_q4blobs(CFG, [dev_blob])
+            dev = quant.stacked_from_device(CFG, [dev_blob], "int4")
             pick = lambda a: a[0]  # noqa: E731
         for name in host:
             np.testing.assert_array_equal(
@@ -218,7 +218,7 @@ def test_device_decode_matches_host(cpu_devices):
     host = quant.decode_blob_host(CFG, bid, enc, "int8")
     dev_blob = jnp.frombuffer(enc, dtype=jnp.uint8) if hasattr(jnp, "frombuffer") \
         else jnp.asarray(np.frombuffer(enc, np.uint8))
-    dev = quant.stacked_from_device_qblobs(CFG, [dev_blob])
+    dev = quant.stacked_from_device(CFG, [dev_blob], "int8")
     for name, _ in serde.layer_param_specs(CFG):
         np.testing.assert_array_equal(
             np.asarray(jax.device_get(dev[name][0]), np.float32),

@@ -1,0 +1,496 @@
+"""The TTFT pipeline: persistent compilation cache, per-layer streamed
+staging, and donated staging.
+
+Three properties under test (ISSUE 3):
+- warm-vs-cold persistent cache: a boot whose in-memory jit caches are
+  gone still pays zero NEW compile-cache writes — every program is
+  served from ``DLD_COMPILE_CACHE_DIR``;
+- per-layer staging order-invariance: blobs streamed in ANY completion
+  order assemble to byte-identical params (and to the bulk, unstreamed
+  assembly);
+- donation correctness: forward output is unchanged with donation on or
+  off, and donation really consumes the wire blobs.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    SourceType,
+)
+from distributed_llm_dissemination_tpu.models import quant, serde
+from distributed_llm_dissemination_tpu.models.llama import CONFIGS, forward_jit, init_params
+from distributed_llm_dissemination_tpu.runtime.boot import (
+    boot_from_layers,
+    ensure_compile_cache,
+    precompile_boot,
+)
+from distributed_llm_dissemination_tpu.runtime.stream_boot import (
+    StreamingBootStager,
+)
+
+CFG = CONFIGS["tiny"]
+SEED = 0
+TIMEOUT = 30.0
+
+
+def blob_layer(data: bytes) -> LayerSrc:
+    return LayerSrc(
+        inmem_data=bytearray(data),
+        data_size=len(data),
+        meta=LayerMeta(location=LayerLocation.INMEM,
+                       source_type=SourceType.MEM),
+    )
+
+
+def seeded_layers(cfg, codec: str = "raw", device: bool = False):
+    """{blob_id: LayerSrc} for the full model, optionally with the wire
+    blob ALSO resident on device (the -hbm shape)."""
+    ids = list(range(cfg.n_layers)) + [serde.head_blob_id(cfg)]
+    out = {}
+    dev = jax.devices()[0]
+    for bid in ids:
+        enc = quant.encode_blob(
+            cfg, bid, serde.seeded_blob(cfg, bid, SEED), codec)
+        src = blob_layer(enc)
+        if device:
+            src.device_array = jax.device_put(
+                np.frombuffer(enc, np.uint8), dev)
+        out[bid] = src
+    return out
+
+
+def stage_all(cfg, layers, order, codec: str = "raw") -> StreamingBootStager:
+    stager = StreamingBootStager(cfg, codec=codec)
+    for bid in order:
+        assert stager.submit(bid, layers[bid])
+    return stager
+
+
+def leaves_bytes(params) -> dict:
+    return {name: np.asarray(jax.device_get(a)).tobytes()
+            for name, a in params["layers"].items()}
+
+
+# -------------------------------------------------- streamed staging parity
+
+
+def test_streamed_host_path_order_invariant_and_bulk_identical():
+    """Layers submitted forward vs REVERSED produce byte-identical
+    params, both equal to the bulk (unstreamed) assembly — completion
+    order cannot leak into the booted model."""
+    ids = list(range(CFG.n_layers)) + [serde.head_blob_id(CFG)]
+    runs = {}
+    for tag, order in (("fwd", ids), ("rev", list(reversed(ids)))):
+        layers = seeded_layers(CFG)
+        stager = stage_all(CFG, layers, order)
+        try:
+            res = boot_from_layers(CFG, layers, stager=stager)
+        finally:
+            stager.close()
+        assert res.kind == "full"
+        assert stager.staged_count == len(ids)
+        runs[tag] = res
+    bulk = boot_from_layers(CFG, seeded_layers(CFG))
+    want = leaves_bytes(bulk.params)
+    for tag, res in runs.items():
+        assert leaves_bytes(res.params) == want, tag
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(res.logits), np.float32),
+            np.asarray(jax.device_get(bulk.logits), np.float32))
+
+
+def test_streamed_device_path_matches_bulk(cpu_devices):
+    """-hbm shape: HBM-resident int8 wire blobs streamed per-blob boot to
+    the same logits as the bulk n-blob decode."""
+    cfg = dataclasses.replace(CFG, vocab=224)
+    layers = seeded_layers(cfg, codec="int8", device=True)
+    ids = sorted(layers)
+    stager = stage_all(cfg, layers, ids, codec="int8")
+    try:
+        res = boot_from_layers(cfg, layers, codec="int8", stager=stager)
+    finally:
+        stager.close()
+    assert res.kind == "full"
+    bulk = boot_from_layers(cfg, seeded_layers(cfg, codec="int8",
+                                               device=True), codec="int8")
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(res.logits), np.float32),
+        np.asarray(jax.device_get(bulk.logits), np.float32))
+
+
+def test_streamed_stage_boot_contiguous_slice():
+    blobs = {bid: blob_layer(serde.seeded_blob(CFG, bid, SEED))
+             for bid in (1, 2)}
+    stager = stage_all(CFG, blobs, [2, 1])
+    try:
+        res = boot_from_layers(CFG, blobs, stager=stager)
+    finally:
+        stager.close()
+    assert res.kind == "stage"
+    want = boot_from_layers(
+        CFG, {bid: blob_layer(serde.seeded_blob(CFG, bid, SEED))
+              for bid in (1, 2)})
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(res.activations), np.float32),
+        np.asarray(jax.device_get(want.activations), np.float32))
+
+
+def test_partial_stream_infills_missing_blobs():
+    """A stager that covered only SOME blobs must not force a bulk (or
+    host) reassembly: the boot infills the missing blobs with the same
+    per-blob staging and still produces bit-identical logits."""
+    ids = list(range(CFG.n_layers)) + [serde.head_blob_id(CFG)]
+    layers = seeded_layers(CFG)
+    stager = stage_all(CFG, layers, ids[::2])  # every other blob only
+    try:
+        res = boot_from_layers(CFG, layers, stager=stager)
+    finally:
+        stager.close()
+    assert res.kind == "full"
+    bulk = boot_from_layers(CFG, seeded_layers(CFG))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(res.logits), np.float32),
+        np.asarray(jax.device_get(bulk.logits), np.float32))
+
+
+def test_stager_rejects_duplicates_and_unknown_blobs():
+    layers = seeded_layers(CFG)
+    stager = StreamingBootStager(CFG)
+    try:
+        assert stager.submit(0, layers[0])
+        assert not stager.submit(0, layers[0])  # idempotent
+        assert not stager.submit(serde.head_blob_id(CFG) + 7, layers[0])
+        streamed = stager.collect([0])
+        assert set(streamed) == {0}
+    finally:
+        stager.close()
+
+
+# --------------------------------------------------------- donated staging
+
+
+def test_donation_on_off_forward_identical(monkeypatch):
+    """The acceptance property: forward output unchanged with donation
+    on/off — and the donated boot really CONSUMES the wire blobs (the
+    store's device references are cleared; XLA additionally aliases
+    wherever an output layout matches; later readers fall back to host
+    bytes)."""
+    cfg = dataclasses.replace(CFG, vocab=256)
+    monkeypatch.setenv("DLD_BOOT_DONATE", "0")
+    layers_off = seeded_layers(cfg, device=True)
+    arrs_off = [layers_off[lid].device_array for lid in sorted(layers_off)]
+    res_off = boot_from_layers(cfg, layers_off)
+    assert all(not a.is_deleted() for a in arrs_off)
+    assert all(layers_off[lid].device_array is not None
+               for lid in layers_off)
+
+    monkeypatch.setenv("DLD_BOOT_DONATE", "1")
+    layers_on = seeded_layers(cfg, device=True)
+    res_on = boot_from_layers(cfg, layers_on)
+    # Consumed: the store's references are cleared — later readers fall
+    # back to the host bytes.
+    assert all(layers_on[lid].device_array is None for lid in layers_on)
+    assert layers_on[0].read_bytes()  # host fallback intact
+
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(res_on.logits), np.float32),
+        np.asarray(jax.device_get(res_off.logits), np.float32))
+
+
+def test_streamed_staging_releases_consumable_blobs(monkeypatch):
+    """The streaming stager's per-blob release: with donation forced,
+    each decoded blob's device reference is dropped the moment its
+    decode is dispatched — mid-wire, not at boot — so HBM holds
+    params-so-far + the in-flight blob instead of every wire blob."""
+    monkeypatch.setenv("DLD_BOOT_DONATE", "1")
+    cfg = dataclasses.replace(CFG, vocab=240)
+    layers = seeded_layers(cfg, device=True)
+    ids = sorted(layers)
+    stager = stage_all(cfg, layers, ids)
+    try:
+        streamed = stager.collect(ids)
+        assert set(streamed) == set(ids)
+        assert all(layers[lid].device_array is None for lid in ids)
+        res = boot_from_layers(cfg, layers, stager=stager)
+    finally:
+        stager.close()
+    assert res.kind == "full"
+    want = boot_from_layers(cfg, seeded_layers(cfg))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(res.logits), np.float32),
+        np.asarray(jax.device_get(want.logits), np.float32))
+
+
+def test_auto_donation_skips_cpu_backend(monkeypatch):
+    """Auto mode must NOT donate on the CPU backend: staged arrays there
+    can be zero-copy adoptions of the very host buffers retransmits
+    read."""
+    monkeypatch.delenv("DLD_BOOT_DONATE", raising=False)
+    cfg = dataclasses.replace(CFG, vocab=272)
+    layers = seeded_layers(cfg, device=True)
+    arrs = [layers[lid].device_array for lid in sorted(layers)]
+    res = boot_from_layers(cfg, layers)
+    assert res.kind == "full"
+    assert all(not a.is_deleted() for a in arrs)
+    assert all(layers[lid].device_array is not None for lid in layers)
+
+
+def test_spliced_salvage_roundtrip(cpu_devices):
+    """After the splice, the piece originals are released (re-pointed at
+    the spliced span buffers) — and salvage reads those buffers clamped
+    to the real span size: no gpad-pad bytes leak into a host fallback
+    assembly."""
+    from distributed_llm_dissemination_tpu.parallel.ingest import (
+        ShardedLayerIngest,
+    )
+
+    total = 1000
+    data = bytes(os.urandom(total))
+    ing = ShardedLayerIngest(total, cpu_devices[:2], stream=True)
+    for off in range(0, total, 100):
+        ing.write(off, data[off:off + 100])
+    bufs = ing._span_buffers(timeout=TIMEOUT)
+    assert len(bufs) == 2
+    out = ing.salvage()
+    rebuilt = bytearray(total)
+    covered = 0
+    for off, chunk in out:
+        rebuilt[off:off + len(chunk)] = chunk
+        covered += len(chunk)
+    assert covered == total  # exactly the layer bytes, no pad tail
+    assert bytes(rebuilt) == data
+
+
+# ------------------------------------------------ persistent compile cache
+
+
+import contextlib
+import logging
+
+
+def _cache_entries(d) -> set:
+    return {f for f in os.listdir(d) if f.endswith("-cache")}
+
+
+@contextlib.contextmanager
+def _pcache_log():
+    """Capture jax's persistent-cache hit/miss records — the honest
+    oracle for whether a compile was served from disk."""
+    records = []
+
+    class H(logging.Handler):
+        def emit(self, r):
+            records.append(r.getMessage())
+
+    h = H()
+    lg = logging.getLogger("jax._src.compiler")
+    old = lg.level
+    lg.addHandler(h)
+    lg.setLevel(logging.DEBUG)
+    try:
+        yield records
+    finally:
+        lg.removeHandler(h)
+        lg.setLevel(old)
+
+
+def _hits(records, name):
+    return [r for r in records
+            if f"Persistent compilation cache hit for '{name}'" in r]
+
+
+def _misses(records, name):
+    return [r for r in records
+            if "CACHE MISS" in r.upper() and f"'{name}'" in r]
+
+
+def test_persistent_cache_warm_boot_serves_forward_from_disk(
+        monkeypatch, tmp_path):
+    """Cold boot populates DLD_COMPILE_CACHE_DIR; after clearing every
+    in-memory jit cache (the warm-HOST shape), a second boot's forward
+    is a persistent-cache HIT, never a miss — and the logits are
+    identical."""
+    cachedir = tmp_path / "pcache"
+    cachedir.mkdir()
+    monkeypatch.setenv("DLD_COMPILE_CACHE_DIR", str(cachedir))
+    cfg = dataclasses.replace(CFG, vocab=304)  # unique shapes: cold
+    ids = list(range(cfg.n_layers)) + [serde.head_blob_id(cfg)]
+    # Fabricate once: blob generation compiles its own (RNG) programs,
+    # which must not muddy the boot-program oracle below.
+    blobs = {bid: serde.seeded_blob(cfg, bid, SEED) for bid in ids}
+
+    def boot():
+        return boot_from_layers(
+            cfg, {bid: blob_layer(b) for bid, b in blobs.items()})
+
+    with _pcache_log() as records:
+        res1 = boot()
+    assert _misses(records, "jit_forward_jit"), (
+        "oracle broken: cold boot logged no forward cache miss")
+    assert _cache_entries(cachedir), "cold boot wrote no cache entries"
+
+    jax.clear_caches()  # the warm-HOST shape: no in-memory executables
+    with _pcache_log() as records:
+        res2 = boot()
+    assert _hits(records, "jit_forward_jit"), (
+        "warm boot's forward was not served from the persistent cache")
+    assert not _misses(records, "jit_forward_jit")
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(res1.logits), np.float32),
+        np.asarray(jax.device_get(res2.logits), np.float32))
+
+
+def test_precompile_writes_cache_boot_reads_it(monkeypatch, tmp_path):
+    """The cross-run story in one process: hint-time precompile_boot
+    WRITES the cache; with in-memory caches dropped, the boot's forward
+    comes from disk."""
+    cachedir = tmp_path / "pcache2"
+    cachedir.mkdir()
+    monkeypatch.setenv("DLD_COMPILE_CACHE_DIR", str(cachedir))
+    cfg = dataclasses.replace(CFG, vocab=336)
+    ids = list(range(cfg.n_layers)) + [serde.head_blob_id(cfg)]
+    rec = precompile_boot(cfg, ids)
+    assert rec["compiled"] == ["forward"]
+    assert rec["persistent_cache"] is True
+    assert _cache_entries(cachedir)
+    jax.clear_caches()
+    layers = {bid: blob_layer(serde.seeded_blob(cfg, bid, SEED))
+              for bid in ids}
+    with _pcache_log() as records:
+        res = boot_from_layers(cfg, layers)
+    assert res.kind == "full"
+    assert _hits(records, "jit_forward_jit"), (
+        "boot did not read the precompile's persistent-cache entry")
+
+
+def test_ensure_compile_cache_repoints_on_env_change(monkeypatch, tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    monkeypatch.setenv("DLD_COMPILE_CACHE_DIR", str(a))
+    assert ensure_compile_cache() == str(a)
+    monkeypatch.setenv("DLD_COMPILE_CACHE_DIR", str(b))
+    assert ensure_compile_cache() == str(b)
+    jax.jit(lambda x: x * 3 + jnp.float32(1.5))(jnp.arange(9.0))
+    assert _cache_entries(b), "re-pointed cache dir got no writes"
+
+
+# -------------------------------------------- streamed precompile coverage
+
+
+def test_precompile_streamed_warms_the_stager_decode(cpu_devices):
+    """streamed=True warms the 1-blob decode the stager actually calls:
+    the stager's decodes then hit the cache (compile-log oracle, with a
+    cold control via the unwarmed sibling config in test_boot)."""
+    import contextlib
+    import logging
+
+    @contextlib.contextmanager
+    def compile_log():
+        records = []
+
+        class H(logging.Handler):
+            def emit(self, r):
+                records.append(r.getMessage())
+
+        h = H()
+        lg = logging.getLogger("jax._src.interpreters.pxla")
+        old = lg.level
+        lg.addHandler(h)
+        lg.setLevel(logging.DEBUG)
+        jax.config.update("jax_log_compiles", True)
+        try:
+            yield records
+        finally:
+            jax.config.update("jax_log_compiles", False)
+            lg.removeHandler(h)
+            lg.setLevel(old)
+
+    cfg = dataclasses.replace(CFG, vocab=368)
+    ids = list(range(cfg.n_layers)) + [serde.head_blob_id(cfg)]
+    rec = precompile_boot(cfg, ids, codec="int8", device_blobs=True,
+                          streamed=True)
+    assert rec["compiled"] == ["decode[int8]x1", "decode[int8]head",
+                               "forward"]
+    layers = seeded_layers(cfg, codec="int8", device=True)
+    stager = StreamingBootStager(cfg, codec="int8")
+    try:
+        with compile_log() as records:
+            for bid in ids:
+                stager.submit(bid, layers[bid])
+            streamed = stager.collect(ids)
+        assert set(streamed) == set(ids)
+        hits = [r for r in records
+                if r.startswith("Compiling jit(_decode_qblobs)")]
+        assert not hits, f"stager decode recompiled: {hits}"
+    finally:
+        stager.close()
+
+
+# ------------------------------------------------------- receiver e2e path
+
+
+def test_receiver_streams_layers_into_the_boot():
+    """Dissemination end to end (inmem transport): every delivered layer
+    is submitted to the stager mid-run, and the startup boot's logits
+    match an independently initialized source model bit-for-bit."""
+    from distributed_llm_dissemination_tpu.runtime import (
+        LeaderNode,
+        Node,
+        ReceiverNode,
+    )
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    params = init_params(CFG, jax.random.key(SEED))
+    blobs = serde.blobs_from_params(CFG, params)
+    assignment = {1: {bid: LayerMeta() for bid in blobs}}
+    ts = {i: InmemTransport(str(i)) for i in (0, 1)}
+    leader = LeaderNode(
+        Node(0, 0, ts[0]),
+        {bid: blob_layer(b) for bid, b in blobs.items()},
+        assignment, expected_nodes={1},
+    )
+    leader.boot_enabled = True
+    receiver = ReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=CFG)
+    try:
+        assert receiver._boot_stager is not None  # stream boot default-on
+        receiver.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        receiver.ready().get(timeout=TIMEOUT)
+        booted = leader.boot_ready().get(timeout=TIMEOUT)
+        assert set(booted) == {1}
+        assert receiver._boot_stager.staged_count == len(blobs)
+        res = receiver.boot_result
+        assert res is not None and res.kind == "full"
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        want = forward_jit(params, tokens, CFG)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(res.logits), np.float32),
+            np.asarray(jax.device_get(want), np.float32))
+    finally:
+        leader.close()
+        receiver.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_stream_boot_env_gate(monkeypatch):
+    from distributed_llm_dissemination_tpu.runtime import Node, ReceiverNode
+    from distributed_llm_dissemination_tpu.transport import InmemTransport
+
+    monkeypatch.setenv("DLD_STREAM_BOOT", "0")
+    t = InmemTransport("5")
+    r = ReceiverNode(Node(5, 0, t), {}, boot_cfg=CFG)
+    try:
+        assert r._boot_stager is None
+    finally:
+        r.close()
+        t.close()
