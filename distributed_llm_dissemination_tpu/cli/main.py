@@ -87,10 +87,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "exiting; size to the slowest expected boot")
     p.add_argument("-test-drop-plan-seqs", type=str, default="",
                    help="TEST ONLY: comma-separated SPMD plan seqs whose "
-                        "first delivery this receiver drops (fault "
-                        "injection for the gap-recovery tests); fault "
-                        "injection is armed exclusively by this flag — "
-                        "environment variables cannot enable it")
+                        "first delivery this process drops (fault "
+                        "injection for the gap-recovery tests).  "
+                        "Implemented by wrapping the transport in the "
+                        "deterministic fault-injection layer "
+                        "(transport/faults.py); armed exclusively by this "
+                        "flag — environment variables cannot enable it")
+    p.add_argument("-test-faults", type=str, default="",
+                   help="TEST ONLY: deterministic fault-injection spec "
+                        "for this process's transport "
+                        "(transport/faults.rules_from_spec), e.g. "
+                        "'seed=7,corrupt=9,dropin=13,dup=11,times=8' — "
+                        "corrupt/drop inbound layer frames below the CRC "
+                        "check, dup/delay/reset outbound sends.  The "
+                        "integrity plane (docs/integrity.md) must recover "
+                        "byte-exactly; armed exclusively by this flag")
     p.add_argument("-serve", type=float, default=0.0,
                    help="receiver: after a successful boot, stay alive "
                         "this many seconds answering GenerateReqMsg "
@@ -365,12 +376,9 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
             "with a Model section"
         )
     codec = conf.model_codec
-    drop_seqs = tuple(int(s) for s in args.test_drop_plan_seqs.split(",")
-                      if s.strip())
     common = dict(heartbeat_interval=args.hb, stage_hbm=args.hbm,
                   placement=placement, boot_cfg=boot_cfg, boot_codec=codec,
-                  fabric=fabric, boot_generate=args.gen,
-                  test_drop_plan_seqs=drop_seqs)
+                  fabric=fabric, boot_generate=args.gen)
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".", **common)
     elif args.m in (1, 2):
@@ -511,6 +519,22 @@ def main(argv=None) -> int:
     # polled port.  The transport's delivery queue simply buffers any
     # announces that arrive while fabrication runs.
     transport = TcpTransport(node_conf.addr, addr_registry=addr_registry)
+    # TEST-ONLY deterministic fault injection (transport/faults.py):
+    # armed exclusively by explicit flags — construction-gated, so no
+    # environment variable can inject faults into a production run.
+    fault_spec = args.test_faults or ""
+    if args.test_drop_plan_seqs.strip():
+        seqs = ";".join(s.strip()
+                        for s in args.test_drop_plan_seqs.split(",")
+                        if s.strip())
+        fault_spec = (fault_spec + "," if fault_spec else "") + \
+            f"drop-plan-seqs={seqs}"
+    if fault_spec:
+        from ..transport.faults import FaultyTransport, rules_from_spec
+
+        seed, rules = rules_from_spec(fault_spec)
+        transport = FaultyTransport(transport, rules, seed=seed)
+        ulog.log.warn("TEST fault injection armed", spec=fault_spec)
     try:
         layers = fabricate()
         node = Node(args.id, cfg.get_leader_conf(conf).id, transport)
@@ -519,6 +543,14 @@ def main(argv=None) -> int:
         return run_receiver(args, conf, node, layers)
     finally:
         transport.close()
+        if conf.distributed is not None:
+            # Orderly pod-runtime teardown: interpreter exit destroying
+            # the coordination client's still-joinable C++ threads
+            # occasionally aborts (std::terminate) an otherwise-green
+            # run.
+            from ..parallel.multihost import maybe_shutdown
+
+            maybe_shutdown()
 
 
 if __name__ == "__main__":

@@ -575,6 +575,14 @@ def run_north_star(timeout_unused: float = 0.0) -> dict:
 _TTFT_RE = re.compile(r"Time to first token: ([0-9.]+)s")
 
 
+# Seeded fault schedule for the physical row's FAULTED sibling
+# (transport/faults.py): corrupt every 7th and drop every 11th inbound
+# layer frame below the CRC check, duplicate every 13th outbound layer
+# send — each capped at 6 firings per node so recovery cost is bounded
+# and the run stays deterministic.
+PHYSICAL_FAULT_SPEC = "seed=3,corrupt=7,dropin=11,dup=13,times=6"
+
+
 def physical_config() -> tuple:
     """PHYSICAL-size scenario: 2 seeders hold the ``llama3-8b-d4v8k``
     blobs — four ~416 MiB layers (EXACTLY the per-layer bytes ``bench.py``
@@ -746,6 +754,9 @@ def _physical_phases(dest_log: str) -> dict:
     wire = copy = ingest = stage = boot = 0.0
     span = stream_wait = precompile = stream = stream_wire = 0.0
     layers = frags = placed = streamed = streamed_wire = 0
+    crc_ms = digest_ms = 0.0
+    crc_dropped = nacks = 0
+    nacked_bytes = 0
     boot_via = ""
     precompile_in_wire = None
     with open(dest_log) as f:
@@ -755,8 +766,20 @@ def _physical_phases(dest_log: str) -> dict:
             except ValueError:
                 continue
             m = rec.get("message", "")
+            if m == "corrupt layer fragment dropped":
+                # TTL prunes share the message with reason="stale"; the
+                # table's column is CRC-detected corruption only, to
+                # match the integrity.crc_drop counter.
+                if rec.get("reason") != "stale":
+                    crc_dropped += 1
+            elif m == "layer fragment NACKed":
+                nacks += 1
+                nacked_bytes += int(rec.get("bytes", 0))
+            elif m == "layer digest verified":
+                digest_ms += float(rec.get("digest_ms", 0.0))
             if m == "(a fraction of) layer received":
                 wire += float(rec.get("duration_ms", 0.0))
+                crc_ms += float(rec.get("crc_ms", 0.0))
             elif m == "layer fully received":
                 copy += float(rec.get("copy_ms", 0.0))
                 ingest += float(rec.get("ingest_ms", 0.0))
@@ -801,11 +824,40 @@ def _physical_phases(dest_log: str) -> dict:
         "streamed_blobs": streamed,
         "streamed_blobs_in_wire": streamed_wire,
         "boot_stream_wait_ms": round(stream_wait, 1),
+        # Integrity plane (docs/integrity.md): per-fragment CRC verify
+        # (thread-time sum over all receive threads) and once-per-layer
+        # digest verify on the dest, plus corruption-recovery counters.
+        "crc_verify_ms": round(crc_ms, 1),
+        "digest_verify_ms": round(digest_ms, 1),
+        "crc_dropped_frames": crc_dropped,
+        "nacks_sent": nacks,
+        "nacked_bytes": nacked_bytes,
     }
 
 
+def _retransmits_from_logs(logdir: str) -> dict:
+    """Sum the SENDER-side NACK retransmit records across every node's
+    log (the dest NACKs; seeders/leader re-send)."""
+    frags = 0
+    total = 0
+    for name in sorted(os.listdir(logdir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(logdir, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("message") == "NACK retransmit":
+                    frags += 1
+                    total += int(rec.get("bytes", 0))
+    return {"retransmitted_fragments": frags, "retransmitted_bytes": total}
+
+
 def run_physical(timeout: float = 1200.0, trace_out: str = "",
-                 cache_dir: str = "", label: str = "") -> dict:
+                 cache_dir: str = "", label: str = "",
+                 faults: str = "", integrity_off: bool = False) -> dict:
     """One recorded run at PHYSICAL layer size (no -scale): ties the TTD
     story to the bench's measured ingest bandwidth — TTD, TTFT, and the
     achieved dest ingest rate on whatever backend is live (recorded).
@@ -817,11 +869,24 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "",
     warm run's boot reads it; ``label`` tags the record ("cold"/"warm").
     Seeders run ``-boot none``: only the DEST's boot is the metric, and
     a seeder pointlessly booting its own full copy would contend for the
-    same cores during the measured window."""
+    same cores during the measured window.
+    ``faults``: a ``transport/faults.py`` spec handed to every node
+    (``-test-faults``) — the FAULTED sibling row: seeded corruption/
+    drops below the CRC check plus duplicated sends, which the
+    integrity plane must recover byte-exactly (digests verified at the
+    dest); the record carries the NACK/retransmit counts and the TTD
+    degradation vs the clean row."""
     backend = _live_backend()
     env = dict(os.environ) if backend else _cpu_env()
     if cache_dir:
         env["DLD_COMPILE_CACHE_DIR"] = cache_dir
+    if integrity_off:
+        # The integrity-OFF sibling: same scenario with CRC stamping/
+        # verification and layer digests disabled — the wall-clock delta
+        # to the clean (integrity-on) row is the checksum overhead the
+        # ≤5%-of-TTD acceptance criterion measures.
+        env["DLD_WIRE_CRC"] = "0"
+        env["DLD_LAYER_DIGESTS"] = "0"
     # The host's measured loopback ceiling: one raw stream, and the
     # striped data plane's stream count — the denominator that makes the
     # achieved rate attributable (bench.py's raw_dma_gbps/link_fraction
@@ -853,11 +918,12 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "",
             # gathers, here feeding the committed trace.
             errf = open(os.path.join(logdir, f"node{node_id}.jsonl"), "wb")
             errfs.append(errf)
+            fault_flags = ("-test-faults", faults) if faults else ()
             return subprocess.Popen(
                 [sys.executable, "-m",
                  "distributed_llm_dissemination_tpu.cli.main",
                  "-id", str(node_id), "-f", path, "-m", "3", "-hbm",
-                 *extra],
+                 *fault_flags, *extra],
                 stdout=subprocess.PIPE, stderr=errf, env=env,
             )
 
@@ -917,6 +983,8 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "",
             }
             if label:
                 rec["cache"] = label
+            if faults:
+                rec["fault_spec"] = faults
             if pred_m:
                 rec["predicted_s"] = round(float(pred_m.group(1)), 4)
                 rec["solve_ms"] = round(float(pred_m.group(2)), 3)
@@ -934,6 +1002,21 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "",
             try:
                 rec["phases"] = _physical_phases(
                     os.path.join(logdir, "node2.jsonl"))
+                ph = rec["phases"]
+                integ = _retransmits_from_logs(logdir)
+                # The acceptance metric: dest-side checksum thread-time
+                # (per-fragment CRC + once-per-layer digest) over the
+                # TTD wall clock.  Thread-time over wall-time, so
+                # overlapped verification (concurrent stripe receivers)
+                # can honestly exceed its wall-clock share.
+                integ["crc_overhead_frac"] = round(
+                    (ph["crc_verify_ms"] + ph["digest_verify_ms"])
+                    / max(ttd * 1000.0, 1e-9), 4)
+                integ["verify_ms"] = round(
+                    ph["crc_verify_ms"] + ph["digest_verify_ms"], 1)
+                integ["crc_dropped_frames"] = ph["crc_dropped_frames"]
+                integ["nacks_sent"] = ph["nacks_sent"]
+                rec["integrity"] = integ
             except Exception as e:  # noqa: BLE001 — breakdown is a bonus
                 print(f"phase breakdown failed: {e!r}", file=sys.stderr)
             if trace_out:
@@ -1202,24 +1285,19 @@ def to_markdown(results: dict) -> str:
             prior = phys.get("prior")
             if prior and prior.get("ttft_s"):
                 lines += [
-                    "**Before/after (this PR):** the prior recorded row "
+                    "**Record vs prior:** the previously recorded row "
                     f"was TTD {prior['ttd_s']}s / TTFT "
-                    f"{prior['ttft_s']}s — the boot (XLA compile + "
-                    "whole-model staging, all after the last byte) was "
-                    f"~{round((prior['ttft_s'] - prior['ttd_s']) / max(prior['ttd_s'], 1e-9), 1)}x "
-                    "the transfer it followed.  With the persistent "
-                    "compilation cache, per-layer streamed staging, and "
-                    "donated staging, the re-measured rows are cold "
-                    f"TTD {cold.get('ttd_s')}s / TTFT "
-                    f"{cold.get('ttft_s')}s and warm TTD "
-                    f"{phys.get('ttd_s')}s / TTFT {phys.get('ttft_s')}s.  "
-                    "Attribution caveat: the harness changed alongside "
-                    "the code — seeders now run `-boot none`, so part "
-                    "of the cross-row delta is the removal of two "
-                    "seeder boots that contended for the prior row's 2 "
-                    "cores; the CONTROLLED evidence is within-run — "
-                    "the cold-vs-warm pair above (same harness both "
-                    "rows) and the TTFT/(TTD+boot) ratio.",
+                    f"{prior['ttft_s']}s; re-measured here as cold TTD "
+                    f"{cold.get('ttd_s')}s / TTFT {cold.get('ttft_s')}s "
+                    f"and warm TTD {phys.get('ttd_s')}s / TTFT "
+                    f"{phys.get('ttft_s')}s.  These rows run with the "
+                    "integrity plane ON (per-fragment wire checksum + "
+                    "per-layer digest verify — its measured cost and "
+                    "the integrity-OFF sibling are in the integrity "
+                    "table below); the rest of the row-to-row movement "
+                    "is this host's bursty CPU budget (compare "
+                    "within-run siblings, not absolute cross-run "
+                    "rates).",
                     "",
                 ]
         fab = results.get("physical_fabric")
@@ -1355,6 +1433,111 @@ def to_markdown(results: dict) -> str:
                     f"{tail}ms |",
                     "",
                 ]
+        integ = phys.get("integrity")
+        if integ:
+            lines += [
+                "### Integrity plane (docs/integrity.md)",
+                "",
+                "Every wire frame carries an advisory checksum "
+                "(xxh3-64 where the extension is importable, crc32 "
+                "otherwise — the hash-rate table below is the measured "
+                "why) verified before delivery; every completed layer "
+                "verifies its leader-stamped digest (xxh3-128/"
+                "blake2b-128, self-describing stamp) before it is acked "
+                "or staged.  `verify_ms` is dest-side checksum THREAD "
+                "time (concurrent stripe receivers verify in parallel); "
+                "`crc_overhead_frac` is that thread time over the TTD "
+                "wall clock — verification rides receive threads that "
+                "overlap the wire, so the WALL-clock cost (the ≤5% "
+                "acceptance metric) is the integrity-OFF row's delta "
+                "below.  The faulted "
+                "sibling runs the SAME scenario under a seeded schedule "
+                "of injected corruption/drops (below the CRC check) and "
+                "duplicated sends; delivery must still be byte-exact "
+                "(digests verified), with recovery cost visible as TTD "
+                "degradation + retransmitted bytes:",
+                "",
+                "| row | TTD | verify_ms (crc+digest) | "
+                "crc_overhead_frac | dropped frames | NACKs | "
+                "retransmitted bytes |",
+                "|---|---|---|---|---|---|---|",
+                f"| clean | {phys['ttd_s']}s | {integ['verify_ms']}ms | "
+                f"{integ['crc_overhead_frac']:.2%} | "
+                f"{integ['crc_dropped_frames']} | {integ['nacks_sent']} "
+                f"| {integ['retransmitted_bytes']} |",
+            ]
+            nc = phys.get("nocheck")
+            if nc:
+                delta = round(
+                    (phys["ttd_s"] - nc["ttd_s"])
+                    / max(nc["ttd_s"], 1e-9), 4)
+                lines.append(
+                    f"| integrity OFF (`DLD_WIRE_CRC=0 "
+                    f"DLD_LAYER_DIGESTS=0`) | {nc['ttd_s']}s "
+                    f"(wall-clock delta to clean: {delta:+.1%}) | — | — "
+                    "| — | — | — |")
+            fl = phys.get("faulted")
+            fi = (fl or {}).get("integrity")
+            if fl and fi:
+                degr = round(fl["ttd_s"] / max(phys["ttd_s"], 1e-9), 2)
+                lines.append(
+                    f"| faulted (`{fl.get('fault_spec', '?')}`) | "
+                    f"{fl['ttd_s']}s ({degr}x clean) | "
+                    f"{fi['verify_ms']}ms | "
+                    f"{fi['crc_overhead_frac']:.2%} | "
+                    f"{fi['crc_dropped_frames']} | {fi['nacks_sent']} | "
+                    f"{fi['retransmitted_bytes']} |")
+            cold = phys.get("cold") or {}
+            if nc and cold.get("ttd_s"):
+                spread = abs(phys["ttd_s"] - cold["ttd_s"]) / min(
+                    phys["ttd_s"], cold["ttd_s"])
+                met = (phys["ttd_s"] - nc["ttd_s"]) / nc["ttd_s"] <= 0.05
+                lines += [
+                    "",
+                    f"The ≤5% overhead bar is "
+                    f"{'MET' if met else 'NOT met'} as measured on this "
+                    "container — read the delta with its error bar: the "
+                    "clean row's same-config cold/warm spread in this "
+                    f"very run is {spread:.0%} (CFS burst-budget drift, "
+                    "the 0.36-2.7 GB/s raw-loopback band the striping "
+                    "PR recorded), the same order as the overhead being "
+                    "measured.  The drift-free attribution is the "
+                    "thread-time column: verification is DRAM-rate "
+                    "hashing sharing 2 CPUs with both seeder processes "
+                    "and the dest's boot, so its thread share shrinks "
+                    "wherever receive threads have an idle core to ride "
+                    "(any real multi-core host); the per-byte verify "
+                    "cost itself is bounded by the hash-rate table "
+                    "below, not by this box's contention.",
+                ]
+            lines.append("")
+    bench = results.get("integrity_bench")
+    if bench:
+        lines += [
+            "## Integrity hash rates (measured on this host)",
+            "",
+            f"Why `{bench.get('fragment_algo', 'crc32')}` per FRAGMENT "
+            f"and `{bench.get('digest_algo', 'blake2b')}`-128 per LAYER "
+            f"(`utils/integrity.hash_bench`, {bench['bytes'] >> 20} MiB "
+            "buffer): the fragment check sits on the per-stripe receive "
+            "hot path (thread-concurrent, must track wire rate), the "
+            "layer digest runs once per layer as the end-to-end "
+            "identity.  The threat model is corruption, not adversarial "
+            "substitution, so 128 random-collision bits are equivalent "
+            "across algorithms and the fastest wins "
+            "(`DLD_DIGEST_ALGO=blake2b` buys the cryptographic identity "
+            "at the measured cost):",
+            "",
+            "| crc32 | adler32 | xxh3-64 | xxh3-128 | blake2b-128 | "
+            "sha256 |",
+            "|---|---|---|---|---|---|",
+            f"| {bench['crc32_gbps']} GB/s | {bench['adler32_gbps']} "
+            f"GB/s | {bench.get('xxh3_64_gbps', 0.0)} GB/s | "
+            f"{bench.get('xxh3_128_gbps', 0.0)} GB/s | "
+            f"{bench['blake2b_gbps']} GB/s | "
+            f"{bench['sha256_gbps']} GB/s |",
+            "",
+        ]
     ns = results.get("north_star_model")
     if ns:
         tgt = ns.get("target", {})
@@ -1458,6 +1641,12 @@ def main(argv=None) -> int:
     # The solver-by-model north-star record is cheap (a few solves, no
     # processes): regenerate it on every run.
     results["north_star_model"] = run_north_star()
+    # Hash-rate micro-bench on THIS host: the measured justification for
+    # crc32 on the per-fragment hot path vs blake2b for the per-layer
+    # digest (docs/integrity.md).  Cheap; regenerated every run.
+    from ..utils.integrity import hash_bench
+
+    results["integrity_bench"] = hash_bench()
     if args.baseline:
         if args.baseline_scale < 64 << 20:
             # Smaller layers are fine for iterating, but the RECORDED
@@ -1484,6 +1673,36 @@ def main(argv=None) -> int:
             cold = run_physical(trace_out=args.trace, cache_dir=cachedir,
                                 label="cold")
             warm = run_physical(cache_dir=cachedir, label="warm")
+            # FAULTED sibling (integrity plane): same scenario, warm
+            # cache, with a seeded schedule of corruption/drops below
+            # the CRC check plus duplicated sends on every node — the
+            # recovery (NACK retransmits, digest verify) must deliver
+            # byte-exactly; the row records the TTD degradation.
+            try:
+                nocheck = run_physical(cache_dir=cachedir,
+                                       label="nocheck",
+                                       integrity_off=True)
+                warm["nocheck"] = {
+                    k: nocheck[k]
+                    for k in ("ttd_s", "ttft_s", "achieved_gbps")
+                    if k in nocheck
+                }
+            except Exception as e:  # noqa: BLE001 — clean rows still record
+                print(f"integrity-off physical run failed: {e!r}",
+                      file=sys.stderr)
+            try:
+                faulted = run_physical(cache_dir=cachedir,
+                                       label="faulted",
+                                       faults=PHYSICAL_FAULT_SPEC)
+                warm["faulted"] = {
+                    k: faulted[k]
+                    for k in ("ttd_s", "ttft_s", "achieved_gbps",
+                              "integrity", "fault_spec")
+                    if k in faulted
+                }
+            except Exception as e:  # noqa: BLE001 — clean rows still record
+                print(f"faulted physical run failed: {e!r}",
+                      file=sys.stderr)
         finally:
             shutil.rmtree(cachedir, ignore_errors=True)
         warm["cold"] = {

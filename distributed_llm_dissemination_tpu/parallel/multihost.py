@@ -188,3 +188,31 @@ def maybe_initialize(conf: Config, my_id: NodeID) -> Optional[ProcessLayout]:
              local_devices=len(jax.local_devices()),
              global_devices=len(jax.devices()))
     return layout
+
+
+def maybe_shutdown() -> None:
+    """Leave the pod-wide JAX runtime in an orderly way at process exit.
+
+    ``jax.distributed.initialize`` starts C++ service/heartbeat threads
+    that interpreter teardown destroys while still joinable — an
+    occasional ``std::terminate`` (SIGABRT) on an otherwise-successful
+    run.  Shutting the client down first joins them.  No-op when the
+    runtime was never initialized; peer-already-gone errors are expected
+    at exit (the other end of a finished run may close first) and only
+    logged."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        client = jax._src.distributed.global_state.client
+    except AttributeError:
+        client = None
+    if client is None:
+        return
+    try:
+        jax.distributed.shutdown()
+        log.info("pod-wide jax runtime shut down")
+    except Exception as e:  # noqa: BLE001 — exit path must not raise
+        log.warn("pod-wide jax runtime shutdown failed", err=repr(e))

@@ -202,6 +202,41 @@ def _device_blob(src) -> Optional[Any]:
     return None
 
 
+def verify_blob_digest(blob_id: int, src, digest_lookup,
+                       digest_verified) -> None:
+    """Integrity backstop at the boot boundary (docs/integrity.md):
+    verify a blob's HOST bytes against its expected layer digest
+    (stamp-described algorithm: xxh3-128 or blake2b-128) before any
+    decode/device placement dispatches.  Skips blobs the
+    receiver's ack gate already verified (``digest_verified``), blobs
+    without a known digest, and device-only blobs (their bytes were
+    verified before staging).  Raises ``ValueError`` on mismatch — the
+    streamed stager fails that blob's staging; the bulk boot fails
+    loudly (a corrupted model must never serve)."""
+    if digest_lookup is None:
+        return
+    if digest_verified is not None and blob_id in digest_verified:
+        return
+    expected = digest_lookup(blob_id)
+    if expected is None or src.inmem_data is None:
+        return
+    from ..utils import integrity, trace
+
+    ok, dt, got = integrity.digest_check(
+        memoryview(src.inmem_data)[src.offset : src.offset + src.data_size],
+        expected)
+    if ok is None:
+        return  # xxh3 stamp, no xxhash here: advisory skip
+    trace.add_phase("integrity_digest", dt)
+    if not ok:
+        trace.count("integrity.digest_mismatch")
+        raise ValueError(
+            f"blob {blob_id} failed its boot-time digest check "
+            f"(expected {expected}, got {got})")
+    if digest_verified is not None:
+        digest_verified.add(blob_id)
+
+
 def stage_blob_leaves(cfg, blob_id: int, src, codec: str = "raw",
                       sharding=None) -> dict:
     """ONE blob's share of the boot: its decoded leaves, each with a
@@ -299,6 +334,8 @@ def boot_from_layers(
     codec: str = "raw",
     generate_tokens: int = 0,
     stager=None,
+    digest_lookup=None,
+    digest_verified=None,
 ) -> BootResult:
     """Assemble delivered blobs into model params and run one forward.
 
@@ -330,6 +367,15 @@ def boot_from_layers(
     t0 = time.monotonic()
     head_id = serde.head_blob_id(cfg)
     layer_ids, full = classify_held_blobs(cfg, layers)
+
+    # Integrity gate: every host-readable blob verifies against its
+    # expected digest before device placement (blobs the receiver's ack
+    # path already verified skip in O(1) — ``digest_verified``).  A
+    # mismatch raises: a corrupted model must never serve.
+    if digest_lookup is not None:
+        for lid in sorted(set(layer_ids) | ({head_id} & set(layers))):
+            verify_blob_digest(lid, layers[lid], digest_lookup,
+                               digest_verified)
 
     sharding = None
     if placement is not None and node_id in placement.node_to_stage:

@@ -16,10 +16,10 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..core.types import LayerID
-from ..utils import intervals
+from ..utils import integrity, intervals, trace
 from ..utils.logging import log
 
 # layer -> (buffer, covered intervals, total size)
@@ -75,17 +75,29 @@ class LayerCheckpointStore:
         layer_id: LayerID,
         covered: List[Tuple[int, int]],
         total: int,
+        frag_crcs: Optional[List[Tuple[int, int, int]]] = None,
     ) -> None:
         """Journal the durably-covered ranges.  Callers must pass only
         ranges whose ``write_bytes`` has already returned — the journal can
         never claim bytes the disk might not hold (a racing older snapshot
         landing later only under-reports, which re-sending absorbs).  The
         tmp name is per-writer (pid + thread), so concurrent journalers of
-        one layer never truncate each other's half-written JSON."""
+        one layer never truncate each other's half-written JSON.
+
+        ``frag_crcs``: per-journaled-fragment ``(offset, length, crc32)``
+        records (integrity hardening, docs/integrity.md) — ``load``
+        re-reads each range from the ``.part`` file and verifies it, so
+        a corrupted disk (bit rot, torn write the fsync ordering can't
+        see, foreign truncation) can never resume as "covered": bad
+        ranges fall out of the restored coverage and are re-fetched."""
         tmp = (f"{self._meta(layer_id)}.{os.getpid()}"
                f".{threading.get_ident()}.tmp")
+        doc = {"Total": total, "Covered": [list(iv) for iv in covered]}
+        if frag_crcs:
+            doc["FragCrcs"] = [[int(o), int(n), int(c)]
+                               for o, n, c in frag_crcs]
         with open(tmp, "w") as f:
-            json.dump({"Total": total, "Covered": [list(iv) for iv in covered]}, f)
+            json.dump(doc, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._meta(layer_id))  # atomic journal update
@@ -97,12 +109,13 @@ class LayerCheckpointStore:
         data: bytes,
         covered: List[Tuple[int, int]],
         total: int,
+        frag_crcs: Optional[List[Tuple[int, int, int]]] = None,
     ) -> None:
         """Persist one fragment + coverage (single-writer convenience;
         concurrent writers must use write_bytes + write_meta with a
         durable-only coverage union)."""
         self.write_bytes(layer_id, offset, data, total)
-        self.write_meta(layer_id, covered, total)
+        self.write_meta(layer_id, covered, total, frag_crcs=frag_crcs)
 
     def complete(self, layer_id: LayerID) -> None:
         """Drop checkpoint state for a fully assembled layer."""
@@ -139,19 +152,28 @@ class LayerCheckpointStore:
                     meta = json.load(f)
                 total = int(meta["Total"])
                 covered = [(int(s), int(e)) for s, e in meta["Covered"]]
-                buf = bytearray(total)
+                frag_crcs = [(int(o), int(n), int(c))
+                             for o, n, c in meta.get("FragCrcs") or []]
                 with open(self._part(layer_id), "rb") as f:
+                    buf = bytearray(total)
                     for s, e in covered:
                         f.seek(s)
                         chunk = f.read(e - s)
-                        if len(chunk) != e - s:
+                        if len(chunk) != e - s and not frag_crcs:
                             # Truncated .part (disk full, partial copy):
                             # a short slice assignment would silently
-                            # SHRINK the buffer — corrupt restore.
+                            # SHRINK the buffer — corrupt restore.  With
+                            # CRCs the verify pass below demotes what
+                            # the short read left unfilled to missing.
                             raise ValueError(
                                 f"part file truncated: wanted [{s}, {e})"
                             )
-                        buf[s:e] = chunk
+                        buf[s:s + len(chunk)] = chunk
+                if frag_crcs:
+                    # Verify from the restored bytes — disk is read ONCE
+                    # (physical-size resumes were paying a doubled read).
+                    covered = self._verify_ranges(
+                        layer_id, buf, covered, frag_crcs)
             except (OSError, ValueError, KeyError) as e:
                 log.warn("dropping unreadable checkpoint", layer=layer_id,
                          err=repr(e))
@@ -162,6 +184,38 @@ class LayerCheckpointStore:
                      layer=layer_id, covered_bytes=intervals.covered(covered),
                      total=total)
         return state
+
+    @staticmethod
+    def _verify_ranges(layer_id, buf, covered, frag_crcs):
+        """Resume verification: check every journaled fragment range's
+        recorded crc32 against the restored bytes; the restored coverage
+        is the journal's coverage INTERSECTED with the union of ranges
+        that verified — tampered/rotted disk bytes fall back to
+        "missing" (re-fetched by the resumed plan) instead of resuming
+        as covered.  A range outside the filled coverage (torn meta,
+        truncated ``.part``) hashes unfilled zeroes and so demotes the
+        same way."""
+        ok_union: List[Tuple[int, int]] = []
+        bad = 0
+        view = memoryview(buf)
+        for off, n, crc in frag_crcs:
+            if (off + n <= len(buf)
+                    and integrity.fragment_crc(view[off:off + n]) == crc):
+                ok_union = intervals.insert(ok_union, off, off + n)
+            else:
+                bad += 1
+                log.error("checkpoint range failed CRC on resume; "
+                          "re-opening it", layer=layer_id, offset=off,
+                          size=n)
+                trace.count("integrity.journal_bad_range")
+                trace.count("integrity.journal_bad_bytes", n)
+        verified = intervals.intersect(covered, ok_union)
+        if bad:
+            log.warn("journal resume dropped corrupt ranges",
+                     layer=layer_id, bad_ranges=bad,
+                     kept_bytes=intervals.covered(verified),
+                     journaled_bytes=intervals.covered(covered))
+        return verified
 
 
 def map_through_gaps(

@@ -55,18 +55,21 @@ from ..transport.messages import (
     GenerateReqMsg,
     GenerateRespMsg,
     HeartbeatMsg,
+    LayerDigestsMsg,
     LayerMsg,
+    LayerNackMsg,
     PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
     StartupMsg,
 )
-from ..utils import intervals
+from ..utils import integrity, intervals, trace
 from ..utils.logging import log
 from .checkpoint import map_through_gaps
 from .failure import FailureDetector
 from .node import MessageLoop, Node
 from .send import (
+    NackRetransmitter,
     contribute_device_plan,
     fetch_from_client,
     handle_flow_retransmit,
@@ -160,6 +163,7 @@ class LeaderNode:
         self._start_q: "queue.Queue[Assignment]" = queue.Queue()
         self._ready_q: "queue.Queue[Assignment]" = queue.Queue()
         self._started = False
+        self._starting = False
         self._startup_sent = False
         # The leader's boot decision rides StartupMsg so one flag governs
         # the whole run (see send_startup); the CLI sets this False for
@@ -188,6 +192,24 @@ class LeaderNode:
         for node_id in set(self.assignment) | self.expected_nodes:
             if node_id != node.my_id:
                 self.detector.touch(node_id)
+
+        # Integrity plane (docs/integrity.md): layer_id -> self-
+        # describing digest of
+        # the layer's true bytes — collected from holders' announces plus
+        # the leader's own layers (hashed on a background thread: at
+        # physical sizes the hash takes seconds, all of them PRE-timer,
+        # overlapped with the announce round-trips).  Stamped per
+        # assignee at distribution start (LayerDigestsMsg); the NACK
+        # retransmit service serves corrupt-fragment re-requests for
+        # layers the leader itself sends (all four modes).
+        self.layer_digests: Dict[LayerID, str] = {}
+        self._digests_ready = threading.Event()
+        self.nacker = NackRetransmitter()
+        if integrity.digests_enabled():
+            threading.Thread(target=self._compute_own_digests,
+                             name="layer-digests", daemon=True).start()
+        else:
+            self._digests_ready.set()
 
         # The leader's own layers seed its status row (node.go:251-257);
         # carry sizes so the flow solver can size any layer from status.
@@ -313,6 +335,91 @@ class LeaderNode:
         self.loop.register(DevicePlanMsg, self.handle_device_plan)
         self.loop.register(GenerateReqMsg, self.handle_generate_req)
         self.loop.register(PlanResendReqMsg, self.handle_plan_resend)
+        self.loop.register(LayerNackMsg, self.handle_layer_nack)
+
+    # --------------------------------------------------------- integrity
+
+    def handle_layer_nack(self, msg: LayerNackMsg) -> None:
+        """A receiver's transport dropped a corrupt/abandoned fragment
+        this leader sent: retransmit the byte range (bounded)."""
+        self.nacker.handle(self.node, self.layers, self._lock, msg)
+
+    def _compute_own_digests(self) -> None:
+        """Hash the leader's own layers for the digest stamp (background
+        — the announce wait overlaps it; _send_digests waits briefly)."""
+        try:
+            for lid, src in list(self.layers.items()):
+                d = integrity.digest_layer_src(src)
+                if d is None:
+                    continue
+                with self._lock:
+                    prior = self.layer_digests.get(lid)
+                    if (prior is not None and prior != d
+                            and integrity.stamp_algo(prior)
+                            == integrity.stamp_algo(d)):
+                        # A holder's announce won the race against this
+                        # background hash and disagrees: one copy is
+                        # corrupt.  The leader's own digest wins — it
+                        # was just computed from local bytes, and
+                        # stamping the announcer's digest would let a
+                        # rotted seeder's delivery VERIFY against its
+                        # own rot.
+                        trace.count("integrity.digest_conflict")
+                        log.error("announced layer digest conflicts "
+                                  "with the leader's own copy; a "
+                                  "holder is corrupt (stamping the "
+                                  "LEADER's digest)", layerID=lid,
+                                  announced=prior, own=d)
+                    self.layer_digests[lid] = d
+        finally:
+            self._digests_ready.set()
+
+    def _merge_announced_digests(self, src_id, digests: dict) -> None:
+        """Collect a holder's announced digests (first writer wins); a
+        CONFLICT between two holders means one of them already holds
+        corrupt bytes — loud, counted, and the first stamp stands."""
+        if not digests:
+            return
+        with self._lock:
+            for lid, d in digests.items():
+                prior = self.layer_digests.get(lid)
+                if prior is None:
+                    self.layer_digests[lid] = d
+                elif (prior != d and integrity.stamp_algo(prior)
+                        == integrity.stamp_algo(d)):
+                    trace.count("integrity.digest_conflict")
+                    log.error("conflicting layer digest announced; a "
+                              "holder's copy is corrupt (keeping the "
+                              "first stamp)", layerID=lid, node=src_id,
+                              stamped=prior, announced=d)
+
+    def _send_digests(self) -> None:
+        """Stamp each assignee with its layers' expected digests.  Waits
+        (bounded, PRE-timer) for the leader's own background hash so the
+        first stamp is complete; advisory — a dest without a digest for
+        some layer simply skips end-to-end verification for it."""
+        if not integrity.digests_enabled():
+            return
+        self._digests_ready.wait(timeout=300.0)
+        with self._lock:
+            dests = list(self.assignment)
+        for dest in dests:
+            self._send_digests_to(dest)
+
+    def _send_digests_to(self, dest: NodeID) -> None:
+        if not integrity.digests_enabled() or dest == self.node.my_id:
+            return
+        with self._lock:
+            digests = {lid: self.layer_digests[lid]
+                       for lid in self.assignment.get(dest) or {}
+                       if lid in self.layer_digests}
+        if not digests:
+            return
+        try:
+            self.node.transport.send(
+                dest, LayerDigestsMsg(self.node.my_id, digests))
+        except (OSError, KeyError) as e:
+            log.warn("digest stamp send failed", dest=dest, err=repr(e))
 
     def handle_generate_req(self, msg: GenerateReqMsg) -> None:
         """The leader seat serves no model — refuse immediately so a
@@ -504,13 +611,28 @@ class LeaderNode:
     def _maybe_start(self) -> bool:
         """Flip to started when every awaited node has announced."""
         with self._lock:
-            if self._started:
+            if self._started or self._starting:
                 return False
             for node_id in set(self.assignment) | self.expected_nodes:
                 if node_id not in self.status:
                     return False
-            self._started = True
-            self._t_start = time.monotonic()
+            self._starting = True
+        # Digest stamps go out BEFORE the timer starts: the stamp (and
+        # any wait for the leader's own background hash) is announce-
+        # phase work, not delivery time.  _started stays False until the
+        # timer exists — an announce landing mid-hash must register as a
+        # fresh peer, not trigger a pre-start re-plan against a None
+        # _t_start.  The latch MUST clear even if the digest send
+        # raises, or every later announce bounces off it and the run
+        # wedges with no timer and no layers ever sent.
+        try:
+            self._send_digests()
+            with self._lock:
+                self._started = True
+                self._t_start = time.monotonic()
+        finally:
+            with self._lock:
+                self._starting = False
         log.info("timer start")
         self._start_q.put(self.assignment)
         self._send_boot_hints()
@@ -561,6 +683,7 @@ class LeaderNode:
                      node=msg.src_id)
             self.detector.revive(msg.src_id)
         self.detector.touch(msg.src_id)
+        self._merge_announced_digests(msg.src_id, msg.digests)
         with self._lock:
             # A re-plan is only for a node the run already has business
             # with: one that restarted (still in status), one returning
@@ -624,7 +747,10 @@ class LeaderNode:
                 # process — and has the longest re-transfer window to
                 # overlap a fresh precompile with.  (Receivers latch the
                 # first hint, so a repeat to a live process is a no-op.)
+                # It also lost its digest stamp: re-stamp before the
+                # re-plan re-sends its layers.
                 self._send_boot_hint_to(msg.src_id)
+                self._send_digests_to(msg.src_id)
                 self._on_reannounce(msg.src_id)
 
     def _on_reannounce(self, node_id: NodeID) -> None:
@@ -664,8 +790,10 @@ class LeaderNode:
         if started:
             # New goal, possibly new assignees (or new held-sets for old
             # ones): re-hint everyone.  Receivers latch the first hint,
-            # so live processes ignore the repeat.
+            # so live processes ignore the repeat.  Digest stamps are
+            # leader-authoritative and re-sent for the new goal.
             self._send_boot_hints()
+            self._send_digests()
         self._drive(self._update_replan)
 
     def _update_replan(self) -> None:

@@ -21,6 +21,7 @@ into one source class.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time as _time
@@ -42,19 +43,22 @@ from ..transport.messages import (
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
+    LayerDigestsMsg,
     LayerMsg,
+    LayerNackMsg,
     PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
     StartupMsg,
 )
-from ..utils import env as env_util, hostmem, intervals, trace
+from ..utils import env as env_util, hostmem, integrity, intervals, trace
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from .checkpoint import LayerCheckpointStore
 from .failure import HeartbeatSender
 from .node import MessageLoop, Node
 from .send import (
+    NackRetransmitter,
     contribute_device_plan,
     fetch_from_client,
     handle_flow_retransmit,
@@ -71,6 +75,29 @@ from .send import (
 # not consume the budget forever — a long-lived receiver crossing
 # many update()s still warms its newest target).
 _PRECOMPILE_MAX_SETS = 4
+
+# Integrity-plane bounds (docs/integrity.md).  NACKs per corrupt byte
+# range: a persistently corrupt path must go quiet and fail loudly, not
+# livelock the wire with retransmit requests.  Digest retries per layer:
+# each mismatch re-opens the layer's intervals and re-announces (a full
+# re-fetch), so a corrupt SOURCE is caught after a few rounds.
+_NACK_MAX_PER_RANGE = 8
+_DIGEST_MAX_RETRIES = 3
+# Quiet-gap watchdog cadence (seconds; DLD_GAP_NACK_S overrides, 0
+# disables): a partial mode-3 layer whose coverage sat unchanged — and
+# claim-idle — across a full interval has lost frames SILENTLY (eaten
+# retransmit, reset mid-flight, vanished sender): re-NACK its gaps to
+# the last-seen sender so recovery never depends on one NACK round-trip
+# surviving the same faulty path that ate the data.  Re-NACKs ride the
+# same per-range budget as first NACKs, so a dead path still goes quiet.
+_GAP_NACK_DEFAULT_S = 3.0
+# Per-layer cap on journal CRC records (each journaled fragment appends
+# one, and every meta write re-serializes the whole list): 1024 covers a
+# 16 GiB layer at the 16 MiB fragment floor.  Past it the layer's CRC
+# records are dropped (legacy journal — resume trusts the fsync
+# ordering, loudly) rather than letting a tiny-fragment flood make the
+# meta write O(n^2).
+_JOURNAL_CRC_MAX_RECORDS = 1024
 
 
 
@@ -117,7 +144,6 @@ class ReceiverNode:
         fabric=None,
         boot_codec: str = "raw",
         boot_generate: int = 0,
-        test_drop_plan_seqs=(),
     ):
         """``boot_cfg``: a ``models.llama.ModelConfig``; when set, the
         startup message boots the model from the delivered layer blobs
@@ -184,6 +210,20 @@ class ReceiverNode:
         # Startup marker for overlap accounting: precompiles and streamed
         # stagings that finish before this fires ran DURING the wire.
         self._startup_seen = threading.Event()
+        # Integrity plane (docs/integrity.md): expected per-layer
+        # self-describing
+        # digests stamped by the leader (LayerDigestsMsg), this node's
+        # own announced digests (cached — a re-announce must not
+        # re-hash gigabytes), layers whose digest already verified (a
+        # re-ack must not re-hash either), per-layer digest retry
+        # counts, per-range NACK budgets, and the retransmit service
+        # for NACKs this node receives as a SENDER.
+        self.layer_digests: Dict[int, str] = {}
+        self._own_digests: Dict[int, str] = {}
+        self._digest_ok: set = set()
+        self._digest_retries: Dict[int, int] = {}
+        self._nack_counts: Dict[Tuple[int, int], int] = {}
+        self.nacker = NackRetransmitter()
         # Per-layer streaming boot staging (runtime/stream_boot.py):
         # each completed blob's decode + host→device placement runs the
         # moment its interval set completes, concurrent with the
@@ -195,7 +235,9 @@ class ReceiverNode:
 
             self._boot_stager = StreamingBootStager(
                 boot_cfg, codec=boot_codec, placement=placement,
-                node_id=node.my_id)
+                node_id=node.my_id,
+                digest_lookup=self._expected_digest,
+                digest_verified=self._digest_ok)
         # Multi-controller serving (runtime/pp_serve.py): startup said a
         # ServeMsg will follow; the CLI keeps the process alive until
         # serve_done() fires (or times out).
@@ -234,13 +276,20 @@ class ReceiverNode:
         # batch-accumulation groups for leader-stamped plan batches.
         self._plan_window = None
         self._plan_batches: Dict[str, dict] = {}
-        # Fault injection is CONSTRUCTION-gated (ADVICE r5): only an
-        # explicit test flag arms it — a stray DLD_TEST_DROP_PLAN_SEQS
-        # in a production environment can never drop real plans.
-        self._drop_seqs = {int(s) for s in test_drop_plan_seqs}
+        # NOTE on fault injection: the old construction-gated
+        # ``test_drop_plan_seqs`` receiver knob is gone — deterministic
+        # fault injection now lives entirely in the transport wrapper
+        # (``transport/faults.FaultyTransport``), which the CLI arms via
+        # its explicit test flags.  Production receivers see a plain
+        # transport; no environment variable can drop real plans.
         self.heartbeat = HeartbeatSender(
             node.transport, node.my_id, node.leader_id, heartbeat_interval
         )
+        # Corrupt-fragment reports (a frame the transport dropped for a
+        # failed CRC, an injected drop, or a TTL-pruned stripe group)
+        # become bounded NACKs to the fragment's source.
+        if hasattr(node.transport, "on_corrupt"):
+            node.transport.on_corrupt = self._on_corrupt_fragment
         self.loop = MessageLoop(node.transport)
         self._register_handlers()
         if start_loop:
@@ -253,6 +302,7 @@ class ReceiverNode:
         self.loop.register(ServeMsg, self.handle_serve)
         self.loop.register(BootHintMsg, self.handle_boot_hint)
         self.loop.register(GenerateReqMsg, self.handle_generate_req)
+        self.loop.register(LayerDigestsMsg, self.handle_layer_digests)
 
     def announce(self) -> None:
         """Tell the leader what I already hold, routed via the next hop
@@ -272,12 +322,172 @@ class ReceiverNode:
             # (Re)entering a distribution cycle: uploads may be retained
             # again until the next startup releases them.
             reopen_upload_cache()
+        # Liveness BEFORE the digest hash: _announce_digests can run
+        # seconds-to-minutes at physical sizes on a crc32-only host,
+        # and the leader's failure-detector lease is already counting
+        # down — heartbeats must flow while we hash.
+        self.heartbeat.start()
         self.node.transport.send(
             next_hop,
             AnnounceMsg(self.node.my_id, layer_ids,
-                        partial=self._announce_partial()),
+                        partial=self._announce_partial(),
+                        digests=self._announce_digests()),
         )
-        self.heartbeat.start()
+
+    # ------------------------------------------------------- integrity plane
+
+    def _announce_digests(self) -> dict:
+        """Self-describing digests (``integrity.layer_digest``) of this
+        node's held full layers, cached (a
+        re-announce must not re-hash gigabytes).  Runs PRE-TIMER for
+        seeders (announce precedes the leader's start), so the hash cost
+        never lands inside TTD."""
+        if not integrity.digests_enabled():
+            return {}
+        with self._lock:
+            todo = [(lid, src) for lid, src in self.layers.items()
+                    if lid not in self._own_digests]
+        for lid, src in todo:
+            d = integrity.digest_layer_src(src)
+            if d is not None:
+                self._own_digests[lid] = d
+        with self._lock:
+            return dict(self._own_digests)
+
+    def handle_layer_digests(self, msg: LayerDigestsMsg) -> None:
+        """The leader's expected-digest stamp for this dest's layers;
+        leader-authoritative (a re-stamp after update() overwrites).
+
+        Handlers run on an unordered pool (and layer frames ride
+        separate data sockets), so a small layer can land — and ack —
+        BEFORE its stamp is processed.  Close the race by re-checking
+        already-held layers against the newly stamped digests: a
+        mismatch demotes the layer and re-announces so the leader
+        re-plans it, exactly like a mismatch at the ack gate."""
+        with self._lock:
+            self.layer_digests.update(msg.digests)
+        log.debug("layer digests stamped", n=len(msg.digests))
+        self._recheck_stamped(list(msg.digests))
+
+    def _recheck_stamped(self, lids) -> None:
+        """Retroactive digest verification for layers that landed before
+        their stamp arrived (no-op for already-verified ones)."""
+        for lid in lids:
+            with self._lock:
+                src = self.layers.get(lid)
+                done = lid in self._digest_ok
+            if src is None or done or src.inmem_data is None:
+                continue
+            if self._verify_layer_digest(lid, memoryview(src.inmem_data)):
+                continue
+            self._demote_corrupt_layer(lid)
+            log.error("stamped digest failed for an already-held layer; "
+                      "demoted", layerID=lid)
+            if self._bump_digest_retry(lid):
+                self._request_replan()
+
+    def _bump_digest_retry(self, lid) -> bool:
+        """Count one digest-mismatch recovery round for a layer; False
+        when the budget is spent — the layer stays undelivered and the
+        failure is loud (a corrupt SOURCE must never converge to a
+        successful run, and must not livelock retransmits either)."""
+        with self._lock:
+            n = self._digest_retries.get(lid, 0) + 1
+            self._digest_retries[lid] = n
+        if n > _DIGEST_MAX_RETRIES:
+            log.error("digest retry budget exhausted; layer stays "
+                      "undelivered", layerID=lid, tries=n)
+            trace.count("integrity.digest_given_up")
+            return False
+        return True
+
+    def _demote_corrupt_layer(self, lid) -> None:
+        """Remove a digest-failed layer from the store (the flow
+        receiver extends this with journal/partial/ingest teardown).
+        The cached own-digest drops with it: a later re-announce must
+        hash the REDELIVERED bytes, not re-announce the corrupt copy's
+        digest.  So does any streamed boot staging of the corrupt bytes
+        (stamp-race: a small layer can land, ack, and stage before its
+        digest stamp is processed) — the redelivered copy re-stages."""
+        with self._lock:
+            self.layers.pop(lid, None)
+            self._own_digests.pop(lid, None)
+        if self._boot_stager is not None:
+            self._boot_stager.invalidate(lid)
+
+    def _expected_digest(self, lid):
+        """The leader-stamped digest for a layer, falling back to this
+        node's own announced digest (a seeder re-verifying its copy)."""
+        with self._lock:
+            return self.layer_digests.get(lid) or self._own_digests.get(lid)
+
+    def _on_corrupt_fragment(self, src_id, layer_id, offset, size,
+                             total, reason) -> None:
+        """Transport hook: a frame was dropped before delivery (bad CRC,
+        injected drop, or a TTL-pruned stripe group).  NACK the source
+        for a byte-range retransmit — bounded per range, so a
+        persistently corrupt path fails loudly instead of livelocking."""
+        key = (layer_id, offset)
+        with self._lock:
+            n = self._nack_counts.get(key, 0) + 1
+            self._nack_counts[key] = n
+        if n > _NACK_MAX_PER_RANGE:
+            log.error("NACK budget exhausted for range; leaving recovery "
+                      "to crash detection", layerID=layer_id, offset=offset,
+                      size=size, reason=reason)
+            trace.count("integrity.nack_suppressed")
+            return
+        if src_id is None or src_id == self.node.my_id:
+            return
+        self._send_nack(src_id, layer_id, offset, size, total, reason)
+
+    def _send_nack(self, src_id, layer_id, offset, size, total,
+                   reason) -> None:
+        trace.count("integrity.nack_sent")
+        log.warn("layer fragment NACKed", layerID=layer_id, src=src_id,
+                 offset=offset, bytes=size, reason=reason)
+        try:
+            self.node.add_node(src_id)
+            self.node.transport.send(
+                src_id,
+                LayerNackMsg(self.node.my_id, layer_id, offset, size,
+                             total_size=total, reason=reason),
+            )
+        except (OSError, KeyError, ConnectionError) as e:
+            log.error("NACK send failed", dest=src_id, layerID=layer_id,
+                      err=repr(e))
+
+    def _verify_layer_digest(self, lid, data) -> bool:
+        """Check ``data`` against the layer's expected digest; True when
+        no digest is known or it matches (memoized — a re-ack never
+        re-hashes).  Counts + logs the outcome; the CALLER owns
+        recovery (drop/NACK for whole-layer frames, interval re-open +
+        re-announce for assembled mode-3 layers)."""
+        expected = self._expected_digest(lid)
+        if expected is None:
+            return True
+        with self._lock:
+            if lid in self._digest_ok:
+                return True
+        ok, dt, got = integrity.digest_check(data, expected)
+        if ok is None:
+            return True  # xxh3 stamp, no xxhash here: advisory skip
+        trace.add_phase("integrity_digest", dt)
+        if ok:
+            with self._lock:
+                self._digest_ok.add(lid)
+                # The bytes now provably hash to the stamp: seed the
+                # announce cache so a recovery re-announce (replan,
+                # digest retry) never re-hashes gigabytes it already
+                # verified on the handler thread.
+                self._own_digests[lid] = expected
+            log.info("layer digest verified", layerID=lid,
+                     digest_ms=round(dt * 1000, 1), bytes=len(data))
+            return True
+        trace.count("integrity.digest_mismatch")
+        log.error("layer digest MISMATCH", layerID=lid, expected=expected,
+                  got=got, bytes=len(data))
+        return False
 
     def _announce_partial(self) -> dict:
         """Checkpointed in-progress coverage to include in the announce;
@@ -391,14 +601,40 @@ class ReceiverNode:
         """Store to RAM, ack the leader (node.go:1354-1384).  A re-plan
         duplicate keeps the existing (possibly already HBM-staged) entry —
         overwriting it would orphan the staged device array and leave the
-        node acking HBM for a host-only copy."""
+        node acking HBM for a host-only copy.
+
+        Integrity gate: a whole-layer frame verifies against the
+        leader-stamped digest (the stamp names its own algorithm)
+        BEFORE it is stored or acked — a
+        mismatch (per-fragment CRC passed, so the SOURCE's bytes are
+        bad) drops the frame and NACKs the sender for a retransmit."""
         with self._lock:
             src = self.layers.get(msg.layer_id)
-            if src is None:
-                src = msg.layer_src
-                src.meta = LayerMeta(location=LayerLocation.INMEM)
-                src.offset = 0
-                self.layers[msg.layer_id] = src
+        if src is None:
+            fresh = msg.layer_src
+            # Digest-gate whole-layer frames only, and only when a
+            # digest is stamped — no byte copy on the unstamped path.
+            if (self._expected_digest(msg.layer_id) is not None
+                    and fresh.data_size == msg.total_size):
+                data = (memoryview(fresh.inmem_data)
+                        if fresh.inmem_data is not None
+                        else memoryview(fresh.read_bytes()))
+                if not self._verify_layer_digest(msg.layer_id, data):
+                    # Budgeted like every digest recovery: a corrupt
+                    # SOURCE re-serving the same bad bytes must go
+                    # loud-and-quiet, not NACK-ping-pong forever.
+                    if self._bump_digest_retry(msg.layer_id):
+                        self._send_nack(msg.src_id, msg.layer_id, 0,
+                                        msg.total_size, msg.total_size,
+                                        "digest")
+                    return
+            with self._lock:
+                src = self.layers.get(msg.layer_id)
+                if src is None:
+                    src = fresh
+                    src.meta = LayerMeta(location=LayerLocation.INMEM)
+                    src.offset = 0
+                    self.layers[msg.layer_id] = src
         log.debug("saved layer in memory", layerID=msg.layer_id)
         loc = self._stage_to_hbm(msg.layer_id, src)
         # Streamed boot staging: this layer's decode + device placement
@@ -454,31 +690,17 @@ class ReceiverNode:
         except (OSError, KeyError) as e:
             log.error("plan re-send request failed", err=repr(e))
 
-    def _should_drop_plan(self, msg) -> bool:
-        """Fault injection (tests ONLY): drop the FIRST delivery of the
-        plan seqs named at CONSTRUCTION (``test_drop_plan_seqs``; the
-        CLI's ``-test-drop-plan-seqs``) — the lost-control-message
-        scenario the gap recovery exists for.  Armed exclusively by that
-        explicit flag: production receivers construct with an empty set,
-        so this is one falsy check on the hot path and no environment
-        variable can silently drop real plans (ADVICE r5)."""
-        if not self._drop_seqs:
-            return False
-        if msg.seq in self._drop_seqs:
-            self._drop_seqs.discard(msg.seq)
-            log.warn("TEST fault injection: dropping spmd plan",
-                     seq=msg.seq, plan=msg.plan_id)
-            return True
-        return False
-
     def _handle_spmd_plan(self, msg: DevicePlanMsg) -> None:
         """Multi-controller fabric (``parallel/spmd_fabric.py``): enqueue
         the plan on this process's lockstep executor; when it is addressed
         to me, await the collective's result on a dedicated thread (the
         handler pool must stay free to enqueue later plans — the executor
-        can only reach mine after running everything before it)."""
-        if self._should_drop_plan(msg):
-            return
+        can only reach mine after running everything before it).
+
+        (Lost-plan fault injection for the gap-recovery tests lives in
+        ``transport/faults.FaultyTransport`` now — the CLI's
+        ``-test-drop-plan-seqs`` wraps the transport; this handler only
+        ever sees plans that "arrived".)"""
         try:
             res = self.fabric.submit(msg)
         except Exception as e:  # noqa: BLE001 — closed/duplicate races
@@ -878,6 +1100,14 @@ class ReceiverNode:
                       total=msg.total_size)
             self._request_replan()
             return
+        if not self._verify_layer_digest(msg.layer_id, memoryview(buf)):
+            # Salvaged/host-copied bytes failed the end-to-end digest:
+            # never store or ack them — re-plan re-fetches the layer.
+            log.error("host-assembled fabric layer failed digest; "
+                      "requesting re-plan", layerID=msg.layer_id,
+                      plan=msg.plan_id)
+            self._request_replan()
+            return
         self._fabric_store(msg.layer_id, msg.total_size, host_buf=buf)
         log.warn("layer assembled on host after fabric failure",
                  layerID=msg.layer_id, plan=msg.plan_id)
@@ -1193,6 +1423,8 @@ class ReceiverNode:
                 self.boot_cfg, self.layers,
                 placement=self.placement, node_id=self.node.my_id,
                 codec=self.boot_codec, stager=self._boot_stager,
+                digest_lookup=self._expected_digest,
+                digest_verified=self._digest_ok,
             )
             # Assign BEFORE the finally sets the event: _serve() waits on
             # _boot_finished and then reads boot_result, so the event must
@@ -1307,6 +1539,12 @@ class RetransmitReceiverNode(ReceiverNode):
     def _register_handlers(self) -> None:
         super()._register_handlers()
         self.loop.register(RetransmitMsg, self.handle_retransmit)
+        # Retransmit-capable receivers SERVE layers, so they also serve
+        # NACKs for fragments a peer's transport dropped as corrupt.
+        self.loop.register(LayerNackMsg, self.handle_layer_nack)
+
+    def handle_layer_nack(self, msg: LayerNackMsg) -> None:
+        self.nacker.handle(self.node, self.layers, self._lock, msg)
 
     def handle_retransmit(self, msg: RetransmitMsg) -> None:
         with self._lock:
@@ -1333,8 +1571,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
                  checkpoint_dir: str = "", stage_hbm: bool = False,
                  placement=None, boot_cfg=None, fabric=None,
-                 boot_codec: str = "raw", boot_generate: int = 0,
-                 test_drop_plan_seqs=()):
+                 boot_codec: str = "raw", boot_generate: int = 0):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -1351,6 +1588,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # fsync'd merge in (under self._lock), so the journal can never
         # claim bytes another handler thread hasn't landed on disk yet.
         self._durable: Dict[int, list] = {}
+        # layer -> [(offset, len, crc32), ...] of journaled fragments —
+        # recorded in the meta journal so resume re-VERIFIES the disk
+        # bytes (runtime/checkpoint.py): a corrupted disk can never
+        # resume as "covered".
+        self._durable_crcs: Dict[int, list] = {}
         # layer -> ShardedLayerIngest: incremental device staging, fed per
         # fragment so HBM ingest overlaps the network receive (the
         # reference-analogous alternative — one synchronous device_put
@@ -1386,14 +1628,22 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                         buf, intervals.ClaimedCoverage(covered))
                     self._partial_total[lid] = total
                     self._durable[lid] = list(covered)  # restored = on disk
+                    # Re-seed the journal CRC records from the VERIFIED
+                    # restored ranges: the next meta write replaces the
+                    # whole journal, and ranges without a CRC would fail
+                    # verification on the resume after next.
+                    self._durable_crcs[lid] = [
+                        (s, e - s,
+                         integrity.fragment_crc(memoryview(buf)[s:e]))
+                        for s, e in covered
+                    ]
         # Loop start is deferred past the checkpoint replay below so no
         # handler races the ingest reconstruction.
         super().__init__(node, layers, storage_path, start_loop=False,
                          heartbeat_interval=heartbeat_interval,
                          stage_hbm=stage_hbm, placement=placement,
                          boot_cfg=boot_cfg, fabric=fabric,
-                         boot_codec=boot_codec, boot_generate=boot_generate,
-                         test_drop_plan_seqs=test_drop_plan_seqs)
+                         boot_codec=boot_codec, boot_generate=boot_generate)
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:
@@ -1413,6 +1663,25 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # just take the bounce path.
         if hasattr(node.transport, "layer_sink"):
             node.transport.layer_sink = self._layer_sink
+        # Quiet-gap watchdog (docs/integrity.md): last sender seen per
+        # in-flight layer, and a ticker that re-NACKs gaps whose
+        # coverage sat silent for a full interval — silent frame loss
+        # (an eaten retransmit, a reset mid-flight) becomes a bounded
+        # re-request instead of a stall until crash detection.
+        self._frag_src: Dict[int, int] = {}
+        self._frag_t: Dict[int, float] = {}
+        self._gap_stop = threading.Event()
+        self._gap_thread = None
+        try:
+            gap_s = float(
+                os.environ.get("DLD_GAP_NACK_S", _GAP_NACK_DEFAULT_S))
+        except ValueError:
+            gap_s = _GAP_NACK_DEFAULT_S
+        if gap_s > 0:
+            self._gap_thread = threading.Thread(
+                target=self._gap_watchdog, args=(gap_s,),
+                daemon=True, name="gap-nack")
+            self._gap_thread.start()
         if start_loop:
             self.loop.start()
 
@@ -1453,6 +1722,78 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 cov.abort(tok)
 
         return memoryview(buf)[offset:end], tok, abort
+
+    def _gap_watchdog(self, gap_s: float) -> None:
+        """Quiet-gap re-NACK ticker (docs/integrity.md): a partial layer
+        whose coverage sat still — and claim-idle — for a full interval
+        has lost frames SILENTLY (an eaten retransmit, a reset
+        mid-flight), so its uncovered gaps are re-requested from the
+        last sender seen for it.  Re-NACKs ride the same
+        ``_NACK_MAX_PER_RANGE`` budget as first NACKs (via
+        ``_on_corrupt_fragment``), so a dead path still goes quiet
+        instead of livelocking; each round also re-arms the quiet timer
+        so a slow retransmit gets a full interval to land."""
+        while not self._gap_stop.wait(gap_s):
+            now = _time.monotonic()
+            stale = []
+            spent = []
+            with self._lock:
+                for lid, (_, cov) in self._partial.items():
+                    total = self._partial_total.get(lid)
+                    src = self._frag_src.get(lid)
+                    last = self._frag_t.get(lid)
+                    if (total is None or src is None or last is None
+                            or now - last < gap_s or not cov.idle()):
+                        continue
+                    gaps = intervals.complement(cov.committed(), total)
+                    gaps = [(s, e) for s, e in gaps
+                            if self._nack_counts.get((lid, s), 0)
+                            < _NACK_MAX_PER_RANGE]
+                    if gaps:
+                        stale.append((lid, src, total, gaps))
+                        self._frag_t[lid] = now
+                    elif intervals.complement(cov.committed(), total):
+                        # Every remaining gap's NACK budget is spent:
+                        # stand down for this layer — recovery belongs
+                        # to crash detection now, not a per-interval
+                        # error line for the rest of the process.
+                        self._frag_src.pop(lid, None)
+                        self._frag_t.pop(lid, None)
+                        spent.append(lid)
+            for lid in spent:
+                trace.count("integrity.gap_standdown")
+                log.error("gap watchdog standing down: NACK budget "
+                          "exhausted for every remaining gap; leaving "
+                          "recovery to crash detection", layerID=lid)
+            for lid, src, total, gaps in stale:
+                trace.count("integrity.gap_renack")
+                log.warn("layer coverage quiet past watchdog interval; "
+                         "re-NACKing gaps", layerID=lid, src=src,
+                         gaps=len(gaps),
+                         missing=sum(e - s for s, e in gaps))
+                for s, e in gaps:
+                    self._on_corrupt_fragment(src, lid, s, e - s, total,
+                                              "stale")
+
+    def _on_corrupt_fragment(self, src_id, layer_id, offset, size,
+                             total, reason) -> None:
+        # Arm the gap watchdog even when the FIRST frame of a layer is
+        # the corrupt one: no successful store may ever happen for it,
+        # and the re-NACK path needs a last-seen source + quiet timer.
+        # Re-arming the timer on every drop is right — a NACK just went
+        # out, so the retransmit gets a full quiet interval to land.
+        if src_id is not None and src_id != self.node.my_id:
+            with self._lock:
+                self._frag_src[layer_id] = src_id
+                self._frag_t[layer_id] = _time.monotonic()
+        super()._on_corrupt_fragment(src_id, layer_id, offset, size,
+                                     total, reason)
+
+    def close(self) -> None:
+        self._gap_stop.set()
+        if self._gap_thread is not None:
+            self._gap_thread.join(timeout=2.0)
+        super().close()
 
     def _get_or_create_ingest(self, layer_id, total_size):
         """The layer's incremental device ingest, created on first use;
@@ -1533,6 +1874,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             self._partial.pop(layer_id, None)
             self._partial_total.pop(layer_id, None)
             self._durable.pop(layer_id, None)
+            self._durable_crcs.pop(layer_id, None)
         if self.ckpt is not None:
             self.ckpt.complete(layer_id)
 
@@ -1635,6 +1977,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     ph["placed"] = ph.get("placed", 0) + 1
                 self._partial[lid] = (buf, cov)
                 self._partial_total[lid] = msg.total_size
+                # Gap-watchdog bookkeeping: who last fed this layer, and
+                # when — ANY fragment counts as progress (even a
+                # duplicate proves the path is alive).
+                self._frag_src[lid] = msg.src_id
+                self._frag_t[lid] = _time.monotonic()
                 # Journaled OUTSIDE the lock below (two fsyncs per
                 # fragment must not serialize every other handler), and
                 # only for fragments that landed NEW bytes — a full
@@ -1643,7 +1990,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 journal = self.ckpt is not None and bool(claims)
                 log.info(
                     "layer fragment stored",
-                    layerID=lid, received=cov.covered_bytes(),
+                    layerID=lid, offset=frag.offset, size=frag.data_size,
+                    received=cov.covered_bytes(),
                     total=msg.total_size,
                 )
         if dup_done:
@@ -1707,6 +2055,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             # handler threads (which a crash would restore as zeros).
             off, data, total = frag.offset, bytes(data_mv), msg.total_size
             self.ckpt.write_bytes(lid, off, data, total)
+            # The journaled range's crc32 rides the meta journal so
+            # resume re-verifies the DISK bytes (integrity hardening).
+            frag_crc = integrity.fragment_crc(data)
             with self._lock:
                 raced_completion = lid in self.layers
                 if not raced_completion:
@@ -1714,8 +2065,19 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                         self._durable.get(lid, []), off, off + len(data)
                     )
                     self._durable[lid] = durable
+                    crcs = self._durable_crcs.setdefault(lid, [])
+                    if crcs is not None:
+                        crcs.append((off, len(data), frag_crc))
+                        if len(crcs) > _JOURNAL_CRC_MAX_RECORDS:
+                            log.warn("journal CRC record cap hit; this "
+                                     "layer's journal falls back to the "
+                                     "un-verified legacy format",
+                                     layerID=lid)
+                            crcs = self._durable_crcs[lid] = None
+                    crcs_snapshot = list(crcs) if crcs is not None else None
             if not raced_completion:
-                self.ckpt.write_meta(lid, durable, total)
+                self.ckpt.write_meta(lid, durable, total,
+                                     frag_crcs=crcs_snapshot)
                 with self._lock:
                     raced_completion = lid in self.layers
             if raced_completion:
@@ -1724,6 +2086,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 self.ckpt.complete(lid)
                 with self._lock:
                     self._durable.pop(lid, None)
+                    self._durable_crcs.pop(lid, None)
         if complete:
             self._ack_completed(lid)
 
@@ -1766,6 +2129,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             del self._partial[lid]
             self._partial_total.pop(lid, None)
             self._durable.pop(lid, None)
+            self._durable_crcs.pop(lid, None)
+            self._frag_src.pop(lid, None)
+            self._frag_t.pop(lid, None)
             ph = self._phase.pop(lid, None)
         if self.ckpt is not None:
             self.ckpt.complete(lid)
@@ -1786,10 +2152,19 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
 
     def _ack_completed(self, lid) -> None:
         """Stage (finalizing any incremental ingest) + ack a completed
-        layer; also the re-ack path for a re-plan duplicate."""
+        layer; also the re-ack path for a re-plan duplicate.
+
+        Integrity gate FIRST: the assembled layer verifies against the
+        leader-stamped digest BEFORE any device placement, streamed boot
+        staging, or ack — a mismatch re-opens the covered intervals
+        (the layer demotes back to "missing" and the node re-announces,
+        so the leader re-plans the bytes) instead of acking corruption
+        into the goal state."""
         with self._lock:
             src = self.layers.get(lid)
         if src is None:
+            return
+        if not self._digest_gate(lid, src):
             return
         with self._ingests_lock:
             self._ingest_done.add(lid)
@@ -1806,6 +2181,49 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
+
+    def _demote_corrupt_layer(self, lid) -> None:
+        """Mode-3 demotion: beyond the store entry, also re-open the
+        layer's intervals (partial state), wipe its journal, and poison
+        any incremental device ingest — a re-delivery starts clean."""
+        super()._demote_corrupt_layer(lid)
+        with self._lock:
+            self._partial.pop(lid, None)
+            self._partial_total.pop(lid, None)
+            self._durable.pop(lid, None)
+            self._durable_crcs.pop(lid, None)
+            self._frag_src.pop(lid, None)
+            self._frag_t.pop(lid, None)
+        with self._ingests_lock:
+            self._ingest_done.discard(lid)
+            self._ingest_share.pop(lid, None)
+            ing = self._ingests.pop(lid, None)
+        if ing is not None:
+            try:
+                ing.fail()
+            except Exception:  # noqa: BLE001 — poison is best-effort
+                pass
+        if self.ckpt is not None:
+            self.ckpt.complete(lid)
+
+    def _digest_gate(self, lid, src) -> bool:
+        """Verify a completed layer's digest; on mismatch DEMOTE it
+        (``_demote_corrupt_layer``) and re-announce so the leader
+        re-plans the whole layer (mode-3 fragments come from several
+        senders, so there is no one peer to NACK).  Bounded: after
+        ``_DIGEST_MAX_RETRIES`` rounds the layer stays un-acked and the
+        failure is loud — corrupt SOURCE data must never converge to a
+        successful run."""
+        if src.inmem_data is None:
+            return True  # no host bytes to hash (fabric HBM delivery)
+        if self._verify_layer_digest(lid, memoryview(src.inmem_data)):
+            return True
+        self._demote_corrupt_layer(lid)
+        if self._bump_digest_retry(lid):
+            log.error("re-opening layer after digest mismatch; "
+                      "re-announcing for a re-plan", layerID=lid)
+            self._request_replan()
+        return False
 
     def handle_flow_retransmit(self, msg: FlowRetransmitMsg) -> None:
         import time as _time

@@ -22,6 +22,7 @@ from ..core.types import (
     NodeID,
 )
 from ..transport.messages import ClientReqMsg, FlowRetransmitMsg, LayerMsg
+from ..utils import trace
 from ..utils.logging import log
 from ..utils.rate import TokenBucket
 from .node import Node
@@ -70,6 +71,104 @@ def fetch_from_client(node: Node, layer_id: LayerID, dest: NodeID) -> None:
     log.debug("ask the client to send the layer", layerID=layer_id)
     node.transport.register_pipe(layer_id, dest)
     node.transport.send(CLIENT_ID, ClientReqMsg(node.my_id, layer_id, False))
+
+
+def _sendable_location(layer: LayerSrc) -> LayerLocation:
+    """The location a range-send should read from.  An HBM-staged layer
+    serves like INMEM: from its retained host buffer, or — for
+    fabric-delivered layers that never had one — from a host copy
+    materialized off the device array (one cached fetch)."""
+    loc = layer.meta.location
+    if loc == LayerLocation.HBM and layer.ensure_host_bytes():
+        loc = LayerLocation.INMEM
+    return loc
+
+
+def _sub_layer_src(layer: LayerSrc, send_loc: LayerLocation, offset: int,
+                   size: int, rate: int) -> LayerSrc:
+    """A byte-range view of a held layer for (re)transmission — the ONE
+    construction shared by flow sends and NACK retransmits, so the two
+    paths can't drift.  ``LayerSrc.offset`` doubles as the read position
+    in the backing store AND the wire fragment offset; held layers are
+    always constructed with ``offset == 0`` (core/config.py), which
+    keeps the two roles coincident."""
+    return LayerSrc(
+        inmem_data=layer.inmem_data, fp=layer.fp, data_size=size,
+        offset=layer.offset + offset,
+        meta=LayerMeta(location=send_loc, limit_rate=rate,
+                       source_type=layer.meta.source_type),
+    )
+
+
+class NackRetransmitter:
+    """Bounded-retry byte-range retransmit service for ``LayerNackMsg``
+    (docs/integrity.md) — the sender half of the integrity plane, shared
+    by every node that serves layers (leaders of all four modes and
+    retransmit-capable receivers).
+
+    A receiver whose transport dropped a corrupt fragment NACKs the
+    range; this re-sends exactly ``[offset, offset+size)`` of the named
+    layer as ONE logical send (the transport re-stripes large ranges
+    itself, so a regrouping plain receiver still sees one whole
+    message).  Retries are bounded per (dest, layer, offset): a
+    persistently corrupt path — bad RAM on the source, a broken NIC —
+    must surface as a loud failure for the crash/re-plan machinery, not
+    a silent retransmit livelock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[tuple, int] = {}
+        # Read at construction like the other integrity knobs
+        # (DLD_GAP_NACK_S, DLD_WIRE_CRC, ...), not at import time.
+        self.LIMIT = int(os.environ.get("DLD_NACK_RETRY_LIMIT", "6"))
+
+    def handle(self, node: Node, layers: LayersSrc, lock: threading.Lock,
+               msg) -> bool:
+        """Serve one NACK; True when the range was re-sent."""
+        key = (msg.src_id, msg.layer_id, msg.offset)
+        with self._lock:
+            n = self._counts.get(key, 0) + 1
+            self._counts[key] = n
+        if n > self.LIMIT:
+            log.error("NACK retry budget exhausted; giving up on range "
+                      "(crash detection / re-announce must recover it)",
+                      dest=msg.src_id, layerID=msg.layer_id,
+                      offset=msg.offset, size=msg.size, tries=n)
+            trace.count("integrity.nack_suppressed")
+            return False
+        with lock:
+            layer = layers.get(msg.layer_id)
+        if layer is None:
+            log.error("NACK for a layer this node doesn't hold",
+                      layerID=msg.layer_id, dest=msg.src_id)
+            return False
+        if layer.meta.location == LayerLocation.CLIENT:
+            log.error("NACK for a client-held layer; cannot range-serve "
+                      "it from here", layerID=msg.layer_id)
+            return False
+        send_loc = _sendable_location(layer)
+        size = min(msg.size, max(0, layer.data_size - msg.offset))
+        if size <= 0:
+            log.error("NACK names an out-of-range span", layerID=msg.layer_id,
+                      offset=msg.offset, size=msg.size,
+                      layer_size=layer.data_size)
+            return False
+        node.add_node(msg.src_id)
+        # Retransmits honor the holder's modeled source rate — a NACK
+        # must not let a rate-limited seeder exceed what its source
+        # could physically serve.
+        sub = _sub_layer_src(layer, send_loc, msg.offset, size,
+                             layer.meta.limit_rate)
+        log.warn("NACK retransmit", layerID=msg.layer_id, dest=msg.src_id,
+                 offset=msg.offset, bytes=size, reason=msg.reason,
+                 attempt=n)
+        trace.count("integrity.retransmit_frags")
+        trace.count("integrity.retransmit_bytes", size)
+        node.transport.send(
+            msg.src_id,
+            LayerMsg(node.my_id, msg.layer_id, sub, layer.data_size),
+        )
+        return True
 
 
 class _FabricUploadCache:
@@ -278,28 +377,14 @@ def handle_flow_retransmit(
         return
     node.add_node(msg.dest_id)
 
-    # An HBM-staged layer serves like INMEM: from its retained host buffer,
-    # or — for fabric-delivered layers that never had one — from a host
-    # copy materialized off the device array (one cached fetch).
-    send_loc = layer.meta.location
-    if send_loc == LayerLocation.HBM and layer.ensure_host_bytes():
-        send_loc = LayerLocation.INMEM
+    send_loc = _sendable_location(layer)
     if send_loc in (LayerLocation.INMEM, LayerLocation.DISK):
         frag_bytes = _fragment_bytes(msg.rate)
         sent = 0
         while sent < msg.data_size:
             n = min(frag_bytes, msg.data_size - sent)
-            partial = LayerSrc(
-                inmem_data=layer.inmem_data,
-                fp=layer.fp,
-                data_size=n,
-                offset=msg.offset + sent,
-                meta=LayerMeta(
-                    location=send_loc,
-                    limit_rate=msg.rate,
-                    source_type=layer.meta.source_type,
-                ),
-            )
+            partial = _sub_layer_src(layer, send_loc, msg.offset + sent, n,
+                                     msg.rate)
             node.transport.send(
                 msg.dest_id,
                 LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size),

@@ -61,11 +61,20 @@ class StreamingBootStager:
     ``collect`` and the boot falls back to bulk assembly."""
 
     def __init__(self, cfg, codec: str = "raw", placement=None,
-                 node_id=None):
+                 node_id=None, digest_lookup=None, digest_verified=None):
+        """``digest_lookup``/``digest_verified`` (integrity plane): a
+        ``blob_id -> expected hex digest (or None)`` callable and the
+        receiver's already-verified id set.  Each blob with host bytes
+        re-verifies before its decode is dispatched UNLESS the ack path
+        already verified it (the set) — defense in depth for bytes that
+        reached the stager without crossing the ack gate, at zero cost
+        on the normal path."""
         self.cfg = cfg
         self.codec = codec
         self.placement = placement
         self.node_id = node_id
+        self.digest_lookup = digest_lookup
+        self.digest_verified = digest_verified
         self._q: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
@@ -101,6 +110,17 @@ class StreamingBootStager:
             # timeout.
             self._q.put((blob_id, src))
         return True
+
+    def invalidate(self, blob_id: int) -> None:
+        """Forget a blob whose bytes turned out corrupt AFTER submission
+        (a digest stamp arriving late demotes the layer): drops both the
+        staged leaves and the dedup marker so the redelivered copy
+        re-stages.  The worker re-checks the marker before storing, so a
+        stage already in flight for the corrupt bytes is discarded
+        instead of landing in ``_staged``."""
+        with self._lock:
+            self._submitted.discard(blob_id)
+            self._staged.pop(blob_id, None)
 
     def mark_startup(self) -> None:
         """Startup arrived: blobs staged from here on no longer overlap
@@ -159,6 +179,13 @@ class StreamingBootStager:
                          err=repr(e))
             dt = time.monotonic() - t0
             with self._lock:
+                # Store only while the submission marker stands — an
+                # invalidate() (corrupt blob demoted mid-stage) discards
+                # this result; the redelivered copy re-stages.
+                if leaves is not None and blob_id not in self._submitted:
+                    log.warn("discarding staged leaves for invalidated "
+                             "blob", blobID=blob_id)
+                    leaves = None
                 if leaves is not None:
                     self._staged[blob_id] = leaves
                 in_wire = not self._startup_seen
@@ -191,7 +218,9 @@ class StreamingBootStager:
         fallback retained) are released by reference inside the helper
         the moment their decode is dispatched: HBM peaks at params-so-
         far + the in-flight blob, not params + every wire blob."""
-        from .boot import stage_blob_leaves
+        from .boot import stage_blob_leaves, verify_blob_digest
 
+        verify_blob_digest(blob_id, src, self.digest_lookup,
+                           self.digest_verified)
         return stage_blob_leaves(self.cfg, blob_id, src, codec=self.codec,
                                  sharding=self._sharding())

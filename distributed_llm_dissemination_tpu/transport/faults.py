@@ -1,0 +1,316 @@
+"""Deterministic fault-injection transport (docs/integrity.md).
+
+``FaultyTransport`` wraps any concrete ``Transport`` and injects a
+SEEDED, fully deterministic schedule of faults — the machinery that
+*proves* the integrity plane instead of trusting it:
+
+- **corrupt** (inbound layer frames): flips a payload byte below the
+  CRC check, via the wrapped transport's ``recv_tamper`` hook — exactly
+  where real wire/DMA corruption lands.  The transport must detect it
+  (advisory CRC), drop the frame, and NACK the source.
+- **drop** (inbound layer frames): discards the landed frame through the
+  same hook (the transport treats it like a CRC failure: claim rolled
+  back, NACK sent) — modeling a frame that arrived damaged beyond
+  reading.  Inbound drops of CONTROL messages (e.g. SPMD ``DevicePlanMsg``
+  by seq — the ported ``-test-drop-plan-seqs`` path) really vanish: their
+  loss-recovery is the gap-report/watchdog machinery, not a NACK.
+- **dup** (outbound): sends the message twice — reassembly and re-ack
+  paths must absorb it.
+- **delay** (outbound): sleeps before sending — reordering pressure.
+- **reset** (outbound): raises ``ConnectionError`` to the caller —
+  the path under test must survive a peer reset at send time.
+
+Determinism: every rule matches message events in arrival order and
+fires on every ``every``-th match with a phase derived from ``seed`` —
+no randomness, so a failing chaos run replays bit-for-bit from its seed.
+
+Construction-gated like the old ``-test-drop-plan-seqs`` (ADVICE r5): a
+production process never wraps its transport, so no environment variable
+can inject faults into a real run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..core.types import LayerID, NodeID
+from ..utils.logging import log
+from .base import Transport
+from .messages import DevicePlanMsg, LayerMsg, Message, MsgType
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One deterministic fault: WHAT to do, WHERE (out = this node's
+    sends, in = this node's receive path), WHICH messages match, and
+    WHEN to fire (every Nth match, at most ``times`` times)."""
+
+    kind: str  # "corrupt" | "drop" | "dup" | "delay" | "reset"
+    direction: str = "out"  # "out" (send-side) | "in" (receive-side)
+    # Matchers; None = wildcard.
+    msg_type: Optional[MsgType] = None
+    layer: Optional[LayerID] = None
+    src: Optional[NodeID] = None  # message src_id ("in" rules)
+    dest: Optional[NodeID] = None  # send destination ("out" rules)
+    offset_lo: int = 0  # fragment-range matchers ("in" layer rules):
+    offset_hi: int = 1 << 62  # match frames overlapping [lo, hi)
+    seq: Optional[int] = None  # DevicePlanMsg seq matcher
+    # Firing schedule.
+    every: int = 1  # fire on every Nth match...
+    times: int = 0  # ...at most this many times (0 = unlimited)
+    # Action parameters.
+    delay_s: float = 0.0  # "delay"
+    flip_at: int = 0  # "corrupt": byte index within the fragment
+    flip_mask: int = 0xFF  # "corrupt": XOR mask (non-zero)
+    # Mutable counters (per-rule; FaultyTransport guards with its lock).
+    matches: int = dataclasses.field(default=0, repr=False)
+    fired: int = dataclasses.field(default=0, repr=False)
+
+    def _matches_common(self, mtype, layer, seq) -> bool:
+        if self.msg_type is not None and mtype != self.msg_type:
+            return False
+        if self.layer is not None and layer != self.layer:
+            return False
+        if self.seq is not None and seq != self.seq:
+            return False
+        return True
+
+    def should_fire(self, phase: int) -> bool:
+        """Advance the match counter; True when this match is a firing
+        one.  Caller has already checked the matchers."""
+        self.matches += 1
+        if self.times and self.fired >= self.times:
+            return False
+        if (self.matches - 1) % max(1, self.every) != phase % max(
+                1, self.every):
+            return False
+        self.fired += 1
+        return True
+
+
+def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
+    """Parse the CLI's compact fault spec into rules.  Grammar:
+    comma-separated ``key=value`` pairs —
+
+    - ``seed=N``: deterministic phase for every periodic rule
+    - ``corrupt=N`` / ``dropin=N``: corrupt/drop every Nth INBOUND layer
+      frame (0 = off)
+    - ``drop=N`` / ``dup=N`` / ``reset=N``: every Nth OUTBOUND layer send
+    - ``delay=N:MS``: delay every Nth outbound layer send by MS ms
+    - ``times=K``: cap each generated rule at K firings (0 = unlimited)
+    - ``drop-plan-seqs=a;b;c``: drop the FIRST inbound delivery of the
+      named SPMD plan seqs (the ported ``-test-drop-plan-seqs``)
+
+    e.g. ``seed=7,corrupt=9,dropin=13,dup=11,times=8``.  Returns
+    ``(seed, rules)`` — hand both to ``FaultyTransport``."""
+    seed = 0
+    times = 0
+    pending = []  # (factory taking (seed, times))
+    for part in [p.strip() for p in spec.split(",") if p.strip()]:
+        key, _, val = part.partition("=")
+        key = key.strip().lower()
+        val = val.strip()
+        if key == "seed":
+            seed = int(val)
+            continue
+        if key == "times":
+            times = int(val)
+            continue
+        if key == "drop-plan-seqs":
+            for s in [x for x in val.split(";") if x.strip()]:
+                pending.append(lambda sd, tm, s=int(s): FaultRule(
+                    "drop", "in", msg_type=MsgType.DEVICE_PLAN,
+                    seq=s, times=1))
+            continue
+        if key == "delay":
+            n, _, ms = val.partition(":")
+            if int(n) > 0:
+                pending.append(lambda sd, tm, n=int(n),
+                               ms=float(ms or 1.0): FaultRule(
+                    "delay", "out", msg_type=MsgType.LAYER, every=n,
+                    times=tm, delay_s=ms / 1000.0))
+            continue
+        if key in ("corrupt", "dropin", "drop", "dup", "reset"):
+            n = int(val)
+            if n <= 0:
+                continue
+            kind = {"dropin": "drop"}.get(key, key)
+            direction = "in" if key in ("corrupt", "dropin") else "out"
+            pending.append(lambda sd, tm, k=kind, d=direction, n=n:
+                           FaultRule(k, d, msg_type=MsgType.LAYER,
+                                     every=n, times=tm))
+            continue
+        raise ValueError(f"unknown fault spec key: {key!r}")
+    return seed, [f(seed, times) for f in pending]
+
+
+class FaultyTransport(Transport):
+    """A seeded fault-injecting wrapper over any concrete transport.
+
+    Send-side ("out") rules intercept ``send``/``broadcast``; inbound
+    LAYER rules install a ``recv_tamper`` hook on the wrapped transport
+    (so corruption lands BELOW the CRC check, exactly like the wire);
+    inbound CONTROL rules run on a pump thread between the inner
+    delivery queue and this transport's own — a dropped control message
+    really vanishes.  Everything else (pipes, sinks, corruption
+    reporting, addressing) delegates to the wrapped transport, so
+    receivers wire their hooks through this wrapper unchanged."""
+
+    def __init__(self, inner: Transport, rules=(), seed: int = 0):
+        self.inner = inner
+        self.rules: List[FaultRule] = list(rules)
+        self.seed = seed
+        self._lock = threading.Lock()
+        self.stats = {"corrupt": 0, "drop": 0, "dup": 0, "delay": 0,
+                      "reset": 0}
+        self._q: "queue.Queue[Message]" = queue.Queue()
+        self._stop = threading.Event()
+        if any(r.direction == "in" and r.msg_type in (None, MsgType.LAYER)
+               for r in self.rules):
+            if hasattr(inner, "recv_tamper"):
+                inner.recv_tamper = self._tamper
+            else:
+                log.warn("inner transport has no recv_tamper hook; "
+                         "inbound layer faults will not fire")
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True,
+                                      name="fault-pump")
+        self._pump.start()
+
+    # ------------------------------------------------------------ matching
+
+    def _fire(self, kind: str, direction: str, mtype, layer=None,
+              seq=None, dest=None, src=None, offset=None,
+              size=None) -> Optional[FaultRule]:
+        """The first rule of ``kind``/``direction`` matching this event
+        that elects to fire (counters advance under the lock)."""
+        with self._lock:
+            for r in self.rules:
+                if r.kind != kind or r.direction != direction:
+                    continue
+                if not r._matches_common(mtype, layer, seq):
+                    continue
+                if direction == "out" and r.dest is not None and dest != r.dest:
+                    continue
+                if direction == "in" and r.src is not None and src != r.src:
+                    continue
+                if offset is not None and size is not None:
+                    if offset + size <= r.offset_lo or offset >= r.offset_hi:
+                        continue
+                if r.should_fire(self.seed):
+                    self.stats[kind] = self.stats.get(kind, 0) + 1
+                    return r
+        return None
+
+    # ------------------------------------------------------------- inbound
+
+    def _tamper(self, info: dict, view) -> bool:
+        """The wrapped transport's receive-path hook: corrupt or drop a
+        landed layer frame BEFORE its CRC verification.  Returning False
+        injects a drop (the transport treats it exactly like a CRC
+        failure: rollback + NACK)."""
+        layer = info.get("layer")
+        src = info.get("src")
+        off = info.get("offset", 0)
+        size = info.get("size", len(view))
+        if self._fire("drop", "in", MsgType.LAYER, layer=layer, src=src,
+                      offset=off, size=size) is not None:
+            log.warn("FAULT: dropping inbound layer frame", layerID=layer,
+                     offset=off, size=size)
+            return False
+        rule = self._fire("corrupt", "in", MsgType.LAYER, layer=layer,
+                          src=src, offset=off, size=size)
+        if rule is not None and len(view) > 0:
+            at = rule.flip_at % len(view)
+            view[at] = view[at] ^ (rule.flip_mask or 0xFF)
+            log.warn("FAULT: corrupted inbound layer frame", layerID=layer,
+                     offset=off, size=size, at=at)
+        return True
+
+    def _pump_loop(self) -> None:
+        inner_q = self.inner.deliver()
+        while not self._stop.is_set():
+            try:
+                msg = inner_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if not isinstance(msg, LayerMsg):
+                mtype = getattr(msg, "msg_type", None)
+                seq = (msg.seq if isinstance(msg, DevicePlanMsg) else None)
+                if self._fire("drop", "in", mtype, seq=seq,
+                              src=getattr(msg, "src_id", None)) is not None:
+                    log.warn("FAULT: dropping inbound control message",
+                             kind=type(msg).__name__, seq=seq)
+                    continue
+            self._q.put(msg)
+
+    # ----------------------------------------------------------- transport
+
+    def send(self, dest_id: NodeID, message: Message) -> None:
+        mtype = getattr(message, "msg_type", None)
+        layer = getattr(message, "layer_id", None)
+        seq = (message.seq if isinstance(message, DevicePlanMsg) else None)
+        if self._fire("drop", "out", mtype, layer=layer, seq=seq,
+                      dest=dest_id) is not None:
+            log.warn("FAULT: dropping outbound message",
+                     kind=type(message).__name__, dest=dest_id)
+            return
+        if self._fire("reset", "out", mtype, layer=layer, seq=seq,
+                      dest=dest_id) is not None:
+            log.warn("FAULT: injecting connection reset on send",
+                     kind=type(message).__name__, dest=dest_id)
+            raise ConnectionError("injected fault: peer reset")
+        rule = self._fire("delay", "out", mtype, layer=layer, seq=seq,
+                          dest=dest_id)
+        if rule is not None:
+            time.sleep(rule.delay_s)
+        self.inner.send(dest_id, message)
+        if self._fire("dup", "out", mtype, layer=layer, seq=seq,
+                      dest=dest_id) is not None:
+            log.warn("FAULT: duplicating outbound message",
+                     kind=type(message).__name__, dest=dest_id)
+            self.inner.send(dest_id, message)
+
+    def broadcast(self, message: Message) -> None:
+        # Broadcasts bypass per-dest out rules on purpose: they carry
+        # run-wide control (startup, serve) whose loss has no protocol
+        # recovery; targeted faults go through send().
+        self.inner.broadcast(message)
+
+    def register_pipe(self, layer_id: LayerID, dest_id: NodeID) -> None:
+        self.inner.register_pipe(layer_id, dest_id)
+
+    def deliver(self) -> "queue.Queue[Message]":
+        return self._q
+
+    def get_address(self) -> str:
+        return self.inner.get_address()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.inner.close()
+
+    # Hook pass-throughs: receivers set these on "the transport" without
+    # caring whether it is wrapped.
+    @property
+    def layer_sink(self):
+        return getattr(self.inner, "layer_sink", None)
+
+    @layer_sink.setter
+    def layer_sink(self, fn) -> None:
+        self.inner.layer_sink = fn
+
+    @property
+    def on_corrupt(self):
+        return getattr(self.inner, "on_corrupt", None)
+
+    @on_corrupt.setter
+    def on_corrupt(self, fn) -> None:
+        self.inner.on_corrupt = fn
+
+    @property
+    def addr_registry(self):
+        return self.inner.addr_registry

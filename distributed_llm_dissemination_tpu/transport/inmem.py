@@ -17,6 +17,7 @@ import threading
 from typing import Dict, Optional
 
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
+from ..utils import integrity, trace
 from ..utils.logging import log
 from .base import AddrRegistry, Transport
 from .messages import LayerMsg, Message
@@ -47,6 +48,14 @@ class InmemTransport(Transport):
         self._pipes: Dict[LayerID, NodeID] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # Integrity hooks, mirroring TcpTransport (docs/integrity.md):
+        # ``recv_tamper(info, view) -> bool`` is the TEST-ONLY fault hook
+        # (transport/faults.py) run on landed bytes BEFORE verification
+        # (False = inject a drop); ``on_corrupt(src_id, layer_id, offset,
+        # size, total_size, reason)`` fires when a frame is dropped for a
+        # failed check — the receiver runtime NACKs the source from it.
+        self.recv_tamper = None
+        self.on_corrupt = None
         with _registry_lock:
             _registry[addr] = self
 
@@ -68,12 +77,33 @@ class InmemTransport(Transport):
 
     def _receive_layer(self, message: LayerMsg) -> None:
         """Mimic the TCP receive path: materialize the byte range to RAM,
-        relay through a registered pipe if one exists, then deliver."""
+        verify the payload's advisory CRC (dropping + reporting corrupt
+        frames exactly like the wire transport), relay through a
+        registered pipe if one exists, then deliver."""
         src = message.layer_src
         # Materialize exactly the [offset, offset+data_size) range, like the
         # TCP wire does; the landed fragment keeps the offset so a mode-3
         # receiver can reassemble it into place.
         data = bytearray(src.read_range())
+        # The "wire" checksum: sender-stamped when present, else the
+        # bytes as sent (computed BEFORE the fault hook below —
+        # in-process there is no real wire, so this IS the sender-side
+        # stamp).  xxh3-64 where available, crc32 otherwise, exactly
+        # like the TCP sender (``integrity.fragment_checksum``).  With
+        # no tamper hook installed nothing can touch the bytearray
+        # between stamp and verify, so the self-stamp would be two
+        # tautological hash passes per frame — skip it; an inbound
+        # sender stamp is still verified either way.
+        crc, xxh3 = message.crc, message.xxh3
+        if (crc is None and xxh3 is None and self.recv_tamper is not None
+                and integrity.wire_crc_enabled()):
+            algo, value = integrity.fragment_checksum(data)
+            if algo == "xxh3":
+                xxh3 = value
+            else:
+                crc = value
+        if not self._frame_ok(message, data, crc, xxh3):
+            return
         landed = LayerSrc(
             inmem_data=data,
             data_size=len(data),
@@ -85,6 +115,8 @@ class InmemTransport(Transport):
             layer_id=message.layer_id,
             layer_src=landed,
             total_size=message.total_size,
+            crc=crc,
+            xxh3=xxh3,
         )
         with self._lock:
             pipe_dest = self._pipes.pop(message.layer_id, None)
@@ -96,6 +128,41 @@ class InmemTransport(Transport):
             except ConnectionError as e:
                 log.error("failed to relay layer", layer=message.layer_id, err=e)
         self._queue.put(relayed)
+
+    def _frame_ok(self, message: LayerMsg, data: bytearray,
+                  crc, xxh3) -> bool:
+        """Fault hook + checksum verification for one landed frame;
+        False means the frame was dropped (and reported via
+        ``on_corrupt``, through the reporter shared with the TCP
+        transport)."""
+        import time as _time
+
+        src = message.layer_src
+        reason = None
+        tamper = self.recv_tamper
+        if tamper is not None:
+            info = {"src": message.src_id, "layer": message.layer_id,
+                    "offset": src.offset, "size": len(data),
+                    "total": message.total_size}
+            try:
+                if tamper(info, memoryview(data)) is False:
+                    reason = "drop"
+            except Exception as e:  # noqa: BLE001 — test hook must not wedge rx
+                log.error("recv_tamper hook failed", err=repr(e))
+        if reason is None and integrity.wire_crc_enabled():
+            t0 = _time.thread_time()
+            ok = integrity.verify_stamp(data, crc=crc, xxh3=xxh3)
+            if ok is not None:
+                trace.add_phase("integrity_crc_recv",
+                                _time.thread_time() - t0)
+                if not ok:
+                    reason = "crc"
+        if reason is None:
+            return True
+        integrity.report_corrupt_frame(
+            self.on_corrupt, message.src_id, message.layer_id,
+            src.offset, len(data), message.total_size, reason)
+        return False
 
     # -- Transport API ------------------------------------------------------
 

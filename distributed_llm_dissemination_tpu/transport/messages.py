@@ -62,6 +62,16 @@ class MsgType(enum.IntEnum):
     # pod lockstep) asks the leader for the missing seqs.  The leader
     # re-sends its retained copy — or a cancellation when it has none —
     # so no transfer waits forever on one lost control message.
+    # LAYER_NACK — integrity plane (docs/integrity.md): a receiver whose
+    # transport dropped a corrupt layer fragment (bad advisory CRC, or a
+    # stale abandoned stripe group) asks the fragment's SOURCE for a
+    # byte-range retransmit — bounded-retry, so one flipped wire bit
+    # costs one fragment re-send instead of a crash-detection timeout.
+    # LAYER_DIGESTS — leader → assignee at distribution start: the
+    # self-describing digest (xxh3:<hex> / blake2b hex) of each layer
+    # the dest will receive (collected from
+    # the holders' announces), so completed layers are verified
+    # end-to-end BEFORE they are acked or staged to a device.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -70,6 +80,8 @@ class MsgType(enum.IntEnum):
     GENERATE_REQ = 13
     GENERATE_RESP = 14
     PLAN_RESEND_REQ = 15
+    LAYER_NACK = 16
+    LAYER_DIGESTS = 17
 
 
 @dataclasses.dataclass
@@ -79,11 +91,20 @@ class AnnounceMsg:
     ``partial`` is an extension the reference doesn't have: covered byte
     ranges of checkpointed in-progress layers,
     ``{layer_id: {"Total": n, "Covered": [[s, e), ...]}}`` — the mode-3
-    leader schedules only the gaps (checkpoint/resume)."""
+    leader schedules only the gaps (checkpoint/resume).
+
+    ``digests`` (integrity plane, docs/integrity.md): self-describing
+    hex digest (``xxh3:<hex>``, or bare blake2b hex)
+    per announced full layer, ``{layer_id: hex}`` — the leader collects
+    them and stamps each assignee's expected digests
+    (``LayerDigestsMsg``) so delivered layers verify end-to-end.
+    Advisory and omitted when empty (digests disabled, or the bytes are
+    client-held and unreadable here)."""
 
     src_id: NodeID
     layer_ids: LayerIDs
     partial: dict = dataclasses.field(default_factory=dict)
+    digests: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.ANNOUNCE
 
@@ -96,6 +117,10 @@ class AnnounceMsg:
             payload["Partial"] = {
                 str(lid): info for lid, info in self.partial.items()
             }
+        if self.digests:
+            payload["Digests"] = {
+                str(lid): str(d) for lid, d in self.digests.items()
+            }
         return payload
 
     @classmethod
@@ -105,6 +130,10 @@ class AnnounceMsg:
             layer_ids=layer_ids_from_json(d.get("LayerIDs") or {}),
             partial={
                 int(lid): info for lid, info in (d.get("Partial") or {}).items()
+            },
+            digests={
+                int(lid): str(h)
+                for lid, h in (d.get("Digests") or {}).items()
             },
         )
 
@@ -206,6 +235,14 @@ class LayerMsg:
     correctness — each stripe is a well-formed byte-range fragment that
     the existing interval reassembly absorbs — they exist for logs,
     tests, and transport-level regrouping.
+
+    ``crc``/``xxh3`` are the ADVISORY payload checksum (integrity
+    plane): at most one is stamped — xxh3-64 where the ``xxhash``
+    accelerator is importable, crc32 otherwise; both None means
+    unstamped (a sender predating the fields, or ``DLD_WIRE_CRC=0``).
+    Transports stamp it per frame on send and verify whichever is
+    present on receive BEFORE delivery — consumers above the transport
+    only ever see verified fragments.
     """
 
     src_id: NodeID
@@ -215,6 +252,8 @@ class LayerMsg:
     stripe_idx: int = 0
     stripe_n: int = 1
     stripe_off: int = 0
+    crc: Optional[int] = None
+    xxh3: Optional[int] = None
 
     msg_type = MsgType.LAYER
 
@@ -233,7 +272,15 @@ class LayerHeader:
     the payload's base offset), ``stripe_span`` the payload's total
     bytes, and ``stripe_tid`` a sender-unique transfer id that groups
     the stripes of one logical send (a retry re-uses the id, so a
-    half-landed stripe is simply overwritten)."""
+    half-landed stripe is simply overwritten).
+
+    ``crc``/``xxh3`` are the ADVISORY checksum of exactly this frame's
+    payload bytes (per stripe for striped transfers), omitted-field
+    style like the ``stripe_*`` fields: at most one is stamped (xxh3-64
+    where the ``xxhash`` accelerator is importable — ~6x the crc32 rate
+    on this host — crc32 otherwise), an unstamped frame is
+    byte-identical to the pre-CRC wire format, and a peer that predates
+    the fields (or can't compute xxh3) ignores the stamp."""
 
     src_id: NodeID
     layer_id: LayerID
@@ -245,6 +292,8 @@ class LayerHeader:
     stripe_off: int = 0
     stripe_span: int = 0
     stripe_tid: str = ""
+    crc: Optional[int] = None
+    xxh3: Optional[int] = None
 
     def to_payload(self) -> dict:
         payload = {
@@ -260,6 +309,10 @@ class LayerHeader:
             payload["StripeOff"] = self.stripe_off
             payload["StripeSpan"] = self.stripe_span
             payload["StripeTid"] = self.stripe_tid
+        if self.crc is not None:
+            payload["Crc"] = int(self.crc)
+        if self.xxh3 is not None:
+            payload["Xxh3"] = int(self.xxh3)
         return payload
 
     @classmethod
@@ -275,6 +328,8 @@ class LayerHeader:
             int(d.get("StripeOff", 0)),
             int(d.get("StripeSpan", 0)),
             str(d.get("StripeTid", "")),
+            int(d["Crc"]) if "Crc" in d else None,
+            int(d["Xxh3"]) if "Xxh3" in d else None,
         )
 
 
@@ -581,6 +636,67 @@ class PlanResendReqMsg:
         return cls(int(d["SrcID"]), [int(s) for s in d.get("Seqs") or []])
 
 
+@dataclasses.dataclass
+class LayerNackMsg:
+    """Receiver → fragment source: the byte range ``[offset,
+    offset+size)`` of ``layer_id`` arrived CORRUPT (advisory CRC
+    mismatch) — or was abandoned mid-transfer (a TTL-pruned stripe
+    group) — and was dropped before any accounting; please retransmit
+    it.  ``src_id`` is the NACKing receiver (the retransmit's dest).
+    Handled by every node that serves layers (leaders, retransmit
+    receivers) with a bounded per-(dest, layer, range) retry budget —
+    a persistently corrupt path must fail loudly, not livelock."""
+
+    src_id: NodeID
+    layer_id: LayerID
+    offset: int
+    size: int
+    total_size: int = 0
+    reason: str = "crc"  # "crc" | "drop" | "stale" | "digest"
+
+    msg_type = MsgType.LAYER_NACK
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "LayerID": self.layer_id,
+                "Offset": self.offset, "Size": self.size,
+                "TotalSize": self.total_size, "Reason": self.reason}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "LayerNackMsg":
+        return cls(int(d["SrcID"]), int(d["LayerID"]),
+                   int(d.get("Offset", 0)), int(d.get("Size", 0)),
+                   int(d.get("TotalSize", 0)),
+                   str(d.get("Reason", "crc")))
+
+
+@dataclasses.dataclass
+class LayerDigestsMsg:
+    """Leader → assignee: the expected self-describing digest of each
+    layer this
+    dest will receive, ``{layer_id: hex}`` (collected from the holders'
+    announces + the leader's own layers).  Advisory: a receiver verifies
+    a completed layer against the digest BEFORE acking/staging it, and a
+    mismatch re-opens the covered intervals (the layer is re-fetched)
+    instead of acking corrupt bytes.  Layers without a digest (unstamped
+    holder, digests disabled) verify by per-fragment CRC alone."""
+
+    src_id: NodeID
+    digests: dict  # {layer_id: hex digest}
+
+    msg_type = MsgType.LAYER_DIGESTS
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id,
+                "Digests": {str(lid): str(h)
+                            for lid, h in self.digests.items()}}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "LayerDigestsMsg":
+        return cls(int(d["SrcID"]),
+                   {int(lid): str(h)
+                    for lid, h in (d.get("Digests") or {}).items()})
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -595,6 +711,8 @@ Message = Union[
     DevicePlanMsg,
     ServeMsg,
     PlanResendReqMsg,
+    LayerNackMsg,
+    LayerDigestsMsg,
 ]
 
 _DECODERS = {
@@ -613,6 +731,8 @@ _DECODERS = {
     MsgType.GENERATE_REQ: GenerateReqMsg,
     MsgType.GENERATE_RESP: GenerateRespMsg,
     MsgType.PLAN_RESEND_REQ: PlanResendReqMsg,
+    MsgType.LAYER_NACK: LayerNackMsg,
+    MsgType.LAYER_DIGESTS: LayerDigestsMsg,
 }
 
 

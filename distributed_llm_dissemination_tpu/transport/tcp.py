@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
 from ..ops.reassembly import stripe_offsets
+from ..utils import integrity, trace
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from ..utils.rate import PacedWriter
@@ -223,6 +224,18 @@ class TcpTransport(Transport):
         # copy (no bounce buffer, no handler memcpy) — the hot path at
         # physical layer sizes on memory-bandwidth-bound hosts.
         self.layer_sink = None
+        # Integrity hooks (docs/integrity.md).  ``recv_tamper(info,
+        # view) -> bool`` is the TEST-ONLY fault-injection hook
+        # (transport/faults.py), run on a frame's landed bytes BEFORE
+        # CRC verification — it may flip bytes in place (simulating wire
+        # corruption below the checksum) or return False to inject a
+        # drop.  ``on_corrupt(src_id, layer_id, offset, size,
+        # total_size, reason)`` fires whenever a frame is dropped for a
+        # failed check (or a stripe group is TTL-pruned): the receiver
+        # runtime NACKs the source from it so the range is retransmitted
+        # instead of waiting out crash detection.
+        self.recv_tamper = None
+        self.on_corrupt = None
 
         host, port = _parse_addr(addr)
         self._listener = socket.create_server((host, port), reuse_port=False)
@@ -267,6 +280,61 @@ class TcpTransport(Transport):
                 self._accepted.discard(conn)
             conn.close()
 
+    def _frame_ok(self, header: LayerHeader, view,
+                  notify: bool = True) -> Tuple[bool, float]:
+        """Run the test-only tamper hook, then verify the frame's
+        advisory CRC; ``(ok, crc_ms)``.  On False the frame must be
+        DROPPED — the caller rolls back any sink claim; corruption is
+        reported via ``on_corrupt`` unless ``notify`` is False (the
+        regroup path reports the whole span instead, so the retransmit
+        regroups as the one logical message plain receivers expect)."""
+        reason = None
+        tamper = self.recv_tamper
+        if tamper is not None:
+            info = {"src": header.src_id, "layer": header.layer_id,
+                    "offset": header.offset, "size": header.layer_size,
+                    "total": header.total_size,
+                    "stripe_idx": header.stripe_idx,
+                    "stripe_n": header.stripe_n}
+            try:
+                if tamper(info, view) is False:
+                    reason = "drop"
+            except Exception as e:  # noqa: BLE001 — test hook must not wedge rx
+                log.error("recv_tamper hook failed", err=repr(e))
+        crc_ms = 0.0
+        if reason is None and integrity.wire_crc_enabled():
+            # Verify whichever stamp is present (xxh3 preferred); CPU
+            # seconds, not wall — on a contended host a wall span around
+            # a GIL-released hash mostly measures the scheduler.
+            t0 = time.thread_time()
+            ok = integrity.verify_stamp(view, crc=header.crc,
+                                        xxh3=header.xxh3)
+            if ok is not None:
+                crc_ms = (time.thread_time() - t0) * 1000
+                trace.add_phase("integrity_crc_recv", crc_ms / 1000)
+                if not ok:
+                    reason = "crc"
+        if reason is None:
+            return True, crc_ms
+        self._notify_corrupt(
+            header.src_id, header.layer_id, header.offset,
+            header.layer_size, header.total_size, reason,
+            stripe=(f"{header.stripe_idx + 1}/{header.stripe_n}"
+                    if header.stripe_n > 1 else ""),
+            silent=not notify)
+        return False, crc_ms
+
+    def _notify_corrupt(self, src_id, layer_id, offset: int, size: int,
+                        total: int, reason: str, stripe: str = "",
+                        silent: bool = False) -> None:
+        """Count + log + report one dropped byte range (the shared
+        reporter — one wording/counter scheme across transports); the
+        receiver runtime's ``on_corrupt`` hook turns the report into a
+        ``LayerNackMsg`` so the source retransmits the range."""
+        integrity.report_corrupt_frame(
+            self.on_corrupt, src_id, layer_id, offset, size, total,
+            reason, stripe=stripe, silent=silent)
+
     def _receive_layer(self, conn: socket.socket, envelope: dict) -> None:
         header = LayerHeader.from_payload(envelope["payload"])
         if header.stripe_n > 1:
@@ -292,13 +360,23 @@ class TcpTransport(Transport):
             except BaseException:
                 abort()  # roll the claim back or the layer wedges forever
                 raise
+            ok, crc_ms = self._frame_ok(header, view)
+            if not ok:
+                # The bytes in the reassembly buffer are garbage, but the
+                # claim rollback un-covers the range — the NACKed
+                # retransmit overwrites it and only committed bytes are
+                # ever read.
+                abort()
+                return
             dur_ms = (time.monotonic() - t0) * 1000
             log.info(
                 "(a fraction of) layer received",
                 layerID=header.layer_id,
+                offset=header.offset,
                 layer_size=header.layer_size,
                 total_size=header.total_size,
                 duration_ms=round(dur_ms, 3),
+                crc_ms=round(crc_ms, 3),
                 placed=True,
             )
             src = LayerSrc(
@@ -329,13 +407,21 @@ class TcpTransport(Transport):
         else:
             self._recv_body(conn, view, header.layer_size)
 
+        # The pipe already teed the bytes downstream chunk-by-chunk — a
+        # corrupt relay can't be recalled, but the downstream transport
+        # verifies the SAME forwarded CRC and drops/NACKs it itself.
+        ok, crc_ms = self._frame_ok(header, view)
+        if not ok:
+            return
         dur_ms = (time.monotonic() - t0) * 1000
         log.info(
             "(a fraction of) layer received",
             layerID=header.layer_id,
+            offset=header.offset,
             layer_size=header.layer_size,
             total_size=header.total_size,
             duration_ms=round(dur_ms, 3),
+            crc_ms=round(crc_ms, 3),
         )
         layer_src = LayerSrc(
             inmem_data=buf,
@@ -461,6 +547,13 @@ class TcpTransport(Transport):
                 except BaseException:
                     abort()
                     raise
+                ok, crc_ms = self._frame_ok(header, view)
+                if not ok:
+                    # Claim rolled back; ``landed`` stays False so the
+                    # relay slot isn't retired (the downstream copy is
+                    # corrupt too and the retransmit must re-relay).
+                    abort()
+                    return
                 landed = True
                 src = LayerSrc(
                     inmem_data=None, data_size=header.layer_size,
@@ -468,7 +561,7 @@ class TcpTransport(Transport):
                     meta=LayerMeta(location=LayerLocation.INMEM),
                 )
                 src.placed_token = token
-                self._log_stripe(header, t0, placed=True)
+                self._log_stripe(header, t0, placed=True, crc_ms=crc_ms)
                 self._queue.put(LayerMsg(
                     header.src_id, header.layer_id, src, header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
@@ -481,8 +574,11 @@ class TcpTransport(Transport):
                 buf = alloc_recv_buffer(header.layer_size)
                 self._recv_body(conn, memoryview(buf),
                                 header.layer_size, pipe_sock)
+                ok, crc_ms = self._frame_ok(header, memoryview(buf))
+                if not ok:
+                    return
                 landed = True
-                self._log_stripe(header, t0, placed=False)
+                self._log_stripe(header, t0, placed=False, crc_ms=crc_ms)
                 self._queue.put(LayerMsg(
                     header.src_id, header.layer_id,
                     LayerSrc(inmem_data=buf, data_size=header.layer_size,
@@ -532,8 +628,23 @@ class TcpTransport(Transport):
                 with self._lock:
                     rec["inflight"] -= 1
                 raise
+            ok, crc_ms = self._frame_ok(header, view, notify=False)
+            if not ok:
+                # A corrupt stripe poisons the whole regroup (plain
+                # receivers expect ONE whole message, so a range
+                # retransmit can't patch the group): tombstone it (late
+                # sibling stripes drain; the retransmit's fresh tid
+                # forms a new group) and NACK the WHOLE logical span.
+                with self._lock:
+                    rec["inflight"] -= 1
+                    self._stripe_groups.pop(key, None)
+                    self._stripe_done[key] = time.monotonic()
+                integrity.fire_on_corrupt(
+                    self.on_corrupt, header.src_id, header.layer_id,
+                    base, span, header.total_size, "crc")
+                return
             landed = True
-            self._log_stripe(header, t0, placed=False)
+            self._log_stripe(header, t0, placed=False, crc_ms=crc_ms)
             with self._lock:
                 rec["inflight"] -= 1
                 rec["got"].add(header.stripe_idx)
@@ -577,24 +688,40 @@ class TcpTransport(Transport):
 
     def _stripe_sweep_loop(self) -> None:
         """Periodic TTL sweep of the striped-receive state (half-TTL
-        cadence); exits when the transport closes."""
+        cadence); exits when the transport closes.  NACKs for pruned
+        groups fire OUTSIDE the lock — the receiver's ``on_corrupt``
+        hook sends over this same transport, whose send path briefly
+        takes ``self._lock``."""
         while not self._closed.wait(_STRIPE_GROUP_TTL / 2):
             with self._lock:
-                self._prune_stripe_groups_locked()
+                notices = self._prune_stripe_groups_locked()
+            for src_id, layer_id, base, span, total in notices:
+                self._notify_corrupt(src_id, layer_id, base, span, total,
+                                     "stale")
 
-    def _prune_stripe_groups_locked(self) -> None:
+    def _prune_stripe_groups_locked(self) -> list:
         """Drop striped-receive state whose sender went silent (it died
         after exhausting its per-stripe retry) so abandoned transfers
         can't pin layer-sized buffers — or leak completion tombstones
         and relay countdowns — forever.  Groups with a stripe mid-recv
         (``inflight`` > 0) are never pruned.  Caller holds
-        ``self._lock``."""
+        ``self._lock``.  Returns NACK notices ``(src, layer, base, span,
+        total)`` for each abandoned group: the dead sender's half-layer
+        is RE-REQUESTED from its source (best-effort — the source may be
+        the dead sender itself, in which case crash detection remains
+        the recovery) instead of silently discarded."""
         now = time.monotonic()
+        notices = []
         for key in [k for k, r in self._stripe_groups.items()
                     if r["inflight"] <= 0
                     and now - r["t"] > _STRIPE_GROUP_TTL]:
+            rec = self._stripe_groups.pop(key)
             log.warn("dropping stale stripe reassembly group", key=key)
-            del self._stripe_groups[key]
+            # Tombstone: straggler stripes of the pruned transfer drain
+            # instead of resurrecting a fresh group for a dead tid.
+            self._stripe_done[key] = now
+            notices.append((key[0], key[1], rec["base"], rec["span"],
+                            rec["total"]))
         for key in [k for k, t in self._stripe_done.items()
                     if now - t > _STRIPE_GROUP_TTL]:
             del self._stripe_done[key]
@@ -602,15 +729,19 @@ class TcpTransport(Transport):
                     if now - r["t"] > _STRIPE_GROUP_TTL]:
             log.warn("dropping stale stripe relay record", key=key)
             del self._stripe_relays[key]
+        return notices
 
     @staticmethod
-    def _log_stripe(header: LayerHeader, t0: float, placed: bool) -> None:
+    def _log_stripe(header: LayerHeader, t0: float, placed: bool,
+                    crc_ms: float = 0.0) -> None:
         log.info(
             "(a fraction of) layer received",
             layerID=header.layer_id,
+            offset=header.offset,
             layer_size=header.layer_size,
             total_size=header.total_size,
             duration_ms=round((time.monotonic() - t0) * 1000, 3),
+            crc_ms=round(crc_ms, 3),
             placed=placed,
             stripe=f"{header.stripe_idx + 1}/{header.stripe_n}",
         )
@@ -844,7 +975,11 @@ class TcpTransport(Transport):
         ride the header's scatter-gather ``sendmsg`` (no concat, one
         syscall batch); disk bodies keep the kernel ``sendfile`` path —
         including disk-backed STRIPES, which sendfile serves by
-        (offset, count) with no host read at all."""
+        (offset, count) with no host read at all.  Every frame is
+        stamped with the advisory checksum (xxh3-64 where available,
+        crc32 otherwise — ``integrity.fragment_checksum``) of exactly
+        its payload bytes (per stripe), computed BEFORE anything touches
+        the wire."""
         src = message.layer_src
         header = LayerHeader(
             src_id=message.src_id,
@@ -859,11 +994,6 @@ class TcpTransport(Transport):
             header.stripe_off = stripe["off"]
             header.stripe_span = stripe["span"]
             header.stripe_tid = stripe["tid"]
-        envelope = {
-            "type": int(MsgType.LAYER),
-            "src": str(message.src_id),
-            "payload": header.to_payload(),
-        }
 
         # HBM-staged layers keep their host buffer and serve like INMEM;
         # fabric-delivered layers never had one — materialize it from the
@@ -872,9 +1002,37 @@ class TcpTransport(Transport):
         if (src.meta.location == LayerLocation.HBM
                 and src.inmem_data is None):
             src.ensure_host_bytes()
+        data = None
         if (src.meta.location in (LayerLocation.INMEM, LayerLocation.HBM)
                 and src.inmem_data is not None):
             data = memoryview(src.inmem_data)[src.offset : src.offset + src.data_size]
+        if message.crc is not None or message.xxh3 is not None:
+            header.crc = message.crc  # caller-stamped (tests)
+            header.xxh3 = message.xxh3
+        elif integrity.wire_crc_enabled():
+            t_crc = time.thread_time()
+            stamp = None
+            if data is not None:
+                stamp = integrity.fragment_checksum(data)
+            elif src.meta.location == LayerLocation.DISK and src.fp:
+                # One warm page-cache checksum sweep; the body itself
+                # still leaves via kernel sendfile below.
+                stamp = integrity.file_checksum(src.fp, src.offset,
+                                                src.data_size)
+            if stamp is not None:
+                algo, value = stamp
+                if algo == "xxh3":
+                    header.xxh3 = value
+                else:
+                    header.crc = value
+                trace.add_phase("integrity_crc_send",
+                                time.thread_time() - t_crc)
+        envelope = {
+            "type": int(MsgType.LAYER),
+            "src": str(message.src_id),
+            "payload": header.to_payload(),
+        }
+        if data is not None:
             if src.meta.limit_rate > 0:
                 _send_frame(sock, envelope)
                 log.debug(

@@ -79,6 +79,24 @@ def remove(intervals: List[Interval], start: int, end: int) -> List[Interval]:
     return out
 
 
+def intersect(a: List[Interval], b: List[Interval]) -> List[Interval]:
+    """Ranges covered by BOTH sorted disjoint interval lists — what a
+    resume may trust when the journal's coverage and the disk bytes'
+    verified ranges disagree (checkpoint CRC hardening)."""
+    out: List[Interval] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo < hi:
+            out.append((lo, hi))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
 def complement(intervals: List[Interval], total: int) -> List[Interval]:
     """The gaps: ranges of ``[0, total)`` NOT covered — the byte ranges a
     resumed transfer still needs."""

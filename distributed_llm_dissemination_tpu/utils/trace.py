@@ -99,3 +99,33 @@ def reset_phases() -> None:
     with _phase_lock:
         _phase_s.clear()
         _phase_n.clear()
+
+
+# ------------------------------------------------------------ event counters
+#
+# Integrity-plane accounting (docs/integrity.md): how many fragments were
+# dropped for a bad CRC, how many NACKs went out, how many bytes were
+# retransmitted, how many digests mismatched.  Same shape as the phase
+# buckets — in-process sums the harness reads at the end of a run — but
+# counting EVENTS, not seconds.  Writers: transport/tcp.py,
+# transport/inmem.py, runtime/receiver.py, runtime/send.py.
+
+_counter_lock = threading.Lock()
+_counters: dict = {}
+
+
+def count(name: str, n: int = 1) -> None:
+    """Add ``n`` to the named event counter."""
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counter_totals() -> dict:
+    """``{name: total}`` so far."""
+    with _counter_lock:
+        return dict(sorted(_counters.items()))
+
+
+def reset_counters() -> None:
+    with _counter_lock:
+        _counters.clear()

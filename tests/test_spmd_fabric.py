@@ -526,9 +526,11 @@ def test_two_process_spmd_heals_dropped_plan():
         assert recv.returncode == 0, f"receiver failed:\n{recv_err[-3000:]}"
         assert "Time to deliver" in lead_out
         assert "ready" in recv_out
-        # The fault actually fired, the gap was detected and reported,
-        # and the leader healed it.
-        assert "fault injection: dropping spmd plan" in recv_err
+        # The fault actually fired (the fault-injection TRANSPORT now,
+        # transport/faults.py — the old receiver-side drop path is
+        # gone), the gap was detected and reported, and the leader
+        # healed it.
+        assert "FAULT: dropping inbound control message" in recv_err
         assert "requesting re-send of missing spmd plans" in recv_err
         assert "re-sent spmd plan after gap report" in lead_err
         # Delivery still rode the device fabric — zero TCP layer bytes.
@@ -571,7 +573,7 @@ def test_two_process_spmd_heals_dropped_tail_plan():
         assert lead.returncode == 0, f"leader failed:\n{lead_err[-3000:]}"
         assert recv.returncode == 0, f"receiver failed:\n{recv_err[-3000:]}"
         assert "Time to deliver" in lead_out
-        assert "fault injection: dropping spmd plan" in recv_err
+        assert "FAULT: dropping inbound control message" in recv_err
         assert "re-broadcasting unacked spmd plan" in lead_err
         # Healed over the fabric, no TCP layer bytes.
         assert "layer landed over device fabric" in recv_err
