@@ -107,6 +107,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "this many seconds answering GenerateReqMsg "
                         "inference requests (cli.genreq) from the "
                         "resident params; 0 = exit after boot as before")
+    p.add_argument("-lease", type=float, default=1.0,
+                   help="control-plane HA (docs/failover.md; only active "
+                        "when the config declares Standbys): the leader's "
+                        "lease beacon interval in seconds; standbys "
+                        "declare it dead after ~3x this (staggered by "
+                        "succession rank) and take over")
     return p
 
 
@@ -205,6 +211,11 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
             LeaderNode.PLAN_ACK_TIMEOUT / 2 or 1.0)
     common = dict(expected_nodes=expected, failure_timeout=ft,
                   fabric=fabric, placement=placement)
+    if conf.standbys:
+        # Control-plane HA (docs/failover.md): replicate control state
+        # to the declared standbys, beacon the lease, fence by epoch.
+        common.update(standbys=list(conf.standbys),
+                      lease_interval=max(args.lease, 0.05), epoch=0)
     if args.m == 0:
         leader = LeaderNode(node, layers, assignment, **common)
     elif args.m == 1:
@@ -389,6 +400,25 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
                                               checkpoint_dir=args.ckpt,
                                               **common)
 
+    standby_ctl = None
+    if args.id in conf.standbys:
+        # This seat is in the leader succession: shadow the control
+        # state and take over (at a bumped, fenced epoch) if the
+        # leader's lease expires (docs/failover.md).
+        from ..runtime import StandbyController
+
+        bw = {nc.id: nc.network_bw for nc in conf.nodes}
+        standby_ctl = StandbyController(
+            receiver, rank=conf.standbys.index(args.id),
+            lease_timeout=max(args.lease, 0.05) * 3,
+            standbys=list(conf.standbys), mode=args.m,
+            node_network_bw=bw, failure_timeout=args.ft,
+            lease_interval=max(args.lease, 0.05),
+        )
+        ulog.log.info("standby controller armed",
+                      rank=conf.standbys.index(args.id),
+                      succession=conf.standbys)
+
     print(
         f"launching receiver...\n[addr: {node.transport.get_address()}, "
         f"id: {args.id}, filename: {args.f}, storagePath: {args.s}, mode: {args.m}]",
@@ -396,6 +426,13 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     )
     receiver.announce()
     receiver.ready().get()
+    if standby_ctl is not None and standby_ctl.promoted.is_set():
+        # This process took over mid-run: it IS the leader now — report
+        # the recovery like a leader would report TTD.
+        leader = standby_ctl.leader
+        ulog.log.info("this process assumed leadership during the run",
+                      epoch=leader.epoch)
+        print(f"assumed leadership (epoch {leader.epoch})", flush=True)
     ulog.log.info("received startup: ready")
     if fabric is not None or args.hbm:
         # Executable-reuse evidence for this process's device plane

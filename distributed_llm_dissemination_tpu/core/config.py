@@ -210,6 +210,12 @@ class Config:
     # (models/quant.py); receivers dequantize after landing, on-device
     # when ingest staged to HBM.
     model_codec: str = "raw"
+    # Control-plane HA (docs/failover.md): ordered leader-succession
+    # list.  Non-empty arms state replication + lease fencing — the
+    # leader streams control deltas to these nodes and beacons its
+    # lease; on leader death the lowest-ranked live standby takes over
+    # at a bumped epoch.  Standby ids must name receiver seats.
+    standbys: List[NodeID] = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_json(cls, d: dict) -> "Config":
@@ -224,6 +230,7 @@ class Config:
             model=_jget(d, "Model", "") or "",
             model_seed=int(_jget(d, "ModelSeed", 0)),
             model_codec=_validated_codec(_jget(d, "ModelCodec", "raw") or "raw"),
+            standbys=[int(s) for s in _jget(d, "Standbys") or []],
         )
 
 

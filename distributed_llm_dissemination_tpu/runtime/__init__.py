@@ -1,5 +1,10 @@
 from .checkpoint import LayerCheckpointStore, map_through_gaps  # noqa: F401
 from .client import Client  # noqa: F401
+from .failover import (  # noqa: F401
+    ControlReplicator,
+    ShadowLeaderState,
+    StandbyController,
+)
 from .failure import FailureDetector, HeartbeatSender  # noqa: F401
 from .leader import (  # noqa: F401
     FlowRetransmitLeaderNode,

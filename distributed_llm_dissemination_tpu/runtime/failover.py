@@ -1,0 +1,407 @@
+"""Control-plane high availability: survive the leader (docs/failover.md).
+
+The reference leaves node fault handling TODO (``crash(n node)``,
+node.go:218-220); this repo added worker crash detection (PR on
+``runtime/failure.py``) — but nothing monitored the LEADER: its death
+froze every in-flight run.  This module closes that gap with three
+cooperating pieces:
+
+- :class:`ControlReplicator` — the leader half: every control-state
+  mutation (status rows, acks, partial coverage, dropped assignments,
+  digest stamps, mode-3 plan seq) streams to a config-declared ordered
+  list of standbys as epoch-stamped ``ControlDeltaMsg``s — a full
+  snapshot when a standby joins, deltas thereafter.
+- :class:`ShadowLeaderState` — the standby half: the replicated shadow
+  of the leader's control state, enough to construct a real leader of
+  the run's mode at takeover.
+- :class:`StandbyController` — lease watching + deterministic
+  succession: the leader beacons ``LeaderLeaseMsg``; each standby feeds
+  it to the existing :class:`~.failure.FailureDetector` with an expiry
+  staggered by its succession rank, so the lowest-ranked LIVE standby
+  fires first, promotes at ``epoch + 1``, and its first lease at the
+  higher epoch IS the takeover announcement.  Workers re-point their
+  leader and re-announce (inventory + checkpointed partials), so the
+  new leader resumes delivery from partial coverage instead of
+  restarting; every control message below the highest epoch seen is
+  FENCED — a zombie ex-leader's plans are rejected, not raced.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core.types import (
+    LayerLocation,
+    LayerMeta,
+    NodeID,
+    layer_ids_from_json,
+    layer_ids_to_json,
+)
+from ..transport.messages import ControlDeltaMsg, LeaderLeaseMsg
+from ..utils import trace
+from ..utils.logging import log
+from .failure import FailureDetector
+
+
+def _nested_layer_map_to_json(m: dict) -> dict:
+    return {str(n): layer_ids_to_json(row) for n, row in m.items()}
+
+
+def _nested_layer_map_from_json(d: dict) -> dict:
+    return {int(n): layer_ids_from_json(row or {})
+            for n, row in (d or {}).items()}
+
+
+def _partial_to_json(p: dict) -> dict:
+    # node -> {layer: {"Total": n, "Covered": [[s, e], ...]}}
+    return {str(n): {str(l): info for l, info in per.items()}
+            for n, per in p.items()}
+
+
+def _partial_from_json(d: dict) -> dict:
+    return {int(n): {int(l): info for l, info in (per or {}).items()}
+            for n, per in (d or {}).items()}
+
+
+class ControlReplicator:
+    """Streams the leader's control-state mutations to its standbys.
+
+    Best-effort by design: a delta lost to a dead standby only degrades
+    that standby's shadow, and takeover reconciliation (every worker
+    re-announces to the new leader) repairs any divergence — the shadow
+    buys recovery SPEED, the re-announce buys correctness.
+
+    Replication is ASYNCHRONOUS: publish() only enqueues; one drain
+    thread per standby does the actual (possibly slow, dial-retrying)
+    sends.  The hot control handlers that publish (handle_ack,
+    handle_announce) must never stall behind a dead standby's TCP dial
+    window — that would freeze the very control plane HA exists to
+    protect.  Per-standby queues keep per-target delta ORDER; a full
+    queue drops the delta (counted) rather than blocking the leader."""
+
+    QUEUE_DEPTH = 1024
+
+    def __init__(self, node, standbys: List[NodeID]):
+        import queue as _queue
+
+        self.node = node
+        self.standbys = [s for s in standbys if s != node.my_id]
+        self._seq = itertools.count()
+        self._stop = threading.Event()
+        self._queues = {}
+        for s in self.standbys:
+            self._queues[s] = _queue.Queue(maxsize=self.QUEUE_DEPTH)
+            threading.Thread(target=self._drain, args=(s,), daemon=True,
+                             name=f"replicate-{s}").start()
+
+    def publish(self, epoch: int, kind: str, data: dict) -> None:
+        for standby in self.standbys:
+            self.publish_to(standby, epoch, kind, data)
+
+    def publish_to(self, standby: NodeID, epoch: int, kind: str,
+                   data: dict) -> None:
+        import queue as _queue
+
+        q = self._queues.get(standby)
+        if q is None:
+            return
+        msg = ControlDeltaMsg(self.node.my_id, epoch, next(self._seq),
+                              kind, data)
+        try:
+            q.put_nowait(msg)
+        except _queue.Full:
+            # Best-effort by contract: the reconcile re-announce repairs
+            # a lossy shadow; blocking the leader would not.
+            trace.count("failover.replica_dropped")
+
+    def _drain(self, standby: NodeID) -> None:
+        import queue as _queue
+
+        q = self._queues[standby]
+        while not self._stop.is_set():
+            try:
+                msg = q.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            try:
+                self.node.add_node(standby)
+                self.node.transport.send(standby, msg)
+            except (OSError, KeyError) as e:
+                log.debug("control delta send failed", standby=standby,
+                          kind=msg.kind, err=repr(e))
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+class ShadowLeaderState:
+    """A standby's replicated view of the leader's control state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mode: Optional[int] = None
+        self.assignment: dict = {}
+        self.status: dict = {}
+        self.partial: dict = {}
+        self.dropped: dict = {}
+        self.digests: Dict[int, str] = {}
+        self.plan_seq = 0
+        self.startup_sent = False
+        self.network_bw: Dict[NodeID, int] = {}
+        self.failure_timeout = 0.0
+        self.boot_enabled = True
+        self.have_snapshot = False
+        self.deltas_applied = 0
+
+    def apply(self, msg: ControlDeltaMsg) -> None:
+        d = msg.data
+        with self._lock:
+            self.deltas_applied += 1
+            k = msg.kind
+            if k == "snapshot":
+                self.mode = int(d.get("Mode", 0))
+                self.assignment = _nested_layer_map_from_json(
+                    d.get("Assignment"))
+                self.status = _nested_layer_map_from_json(d.get("Status"))
+                self.partial = _partial_from_json(d.get("Partial"))
+                self.dropped = _nested_layer_map_from_json(d.get("Dropped"))
+                self.digests = {int(l): str(h)
+                                for l, h in (d.get("Digests") or {}).items()}
+                self.plan_seq = int(d.get("PlanSeq", 0))
+                self.startup_sent = bool(d.get("StartupSent", False))
+                self.network_bw = {int(n): int(b) for n, b in
+                                   (d.get("NetworkBw") or {}).items()}
+                self.failure_timeout = float(d.get("FailureTimeout", 0.0))
+                self.boot_enabled = bool(d.get("BootEnabled", True))
+                self.have_snapshot = True
+            elif k == "status":
+                self.status[int(d["Node"])] = layer_ids_from_json(
+                    d.get("Layers") or {})
+            elif k == "ack":
+                row = self.status.setdefault(int(d["Node"]), {})
+                row[int(d["Layer"])] = LayerMeta(
+                    location=LayerLocation(int(d.get("Location", 0))),
+                    data_size=int(d.get("Size", 0)))
+            elif k == "partial":
+                node = int(d["Node"])
+                per = d.get("Partial")
+                if per:
+                    self.partial[node] = {int(l): info
+                                          for l, info in per.items()}
+                else:
+                    self.partial.pop(node, None)
+            elif k == "crash":
+                node = int(d["Node"])
+                self.status.pop(node, None)
+                dropped = d.get("Dropped")
+                if dropped:
+                    self.dropped[node] = layer_ids_from_json(dropped)
+                    self.assignment.pop(node, None)
+            elif k == "assignment":
+                self.assignment = _nested_layer_map_from_json(
+                    d.get("Assignment"))
+                self.dropped = {}
+            elif k == "digests":
+                for l, h in (d.get("Digests") or {}).items():
+                    self.digests[int(l)] = str(h)
+            elif k == "startup":
+                self.startup_sent = bool(d.get("Sent", True))
+            elif k == "plan_seq":
+                self.plan_seq = max(self.plan_seq, int(d.get("Seq", 0)))
+            else:
+                log.warn("unknown control delta kind", kind=k)
+
+    def export(self) -> dict:
+        """A typed copy for :meth:`LeaderNode.adopt_shadow`."""
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "assignment": {n: dict(r)
+                               for n, r in self.assignment.items()},
+                "status": {n: dict(r) for n, r in self.status.items()},
+                "partial": {n: dict(p) for n, p in self.partial.items()},
+                "dropped": {n: dict(r) for n, r in self.dropped.items()},
+                "digests": dict(self.digests),
+                "plan_seq": self.plan_seq,
+                "startup_sent": self.startup_sent,
+                "network_bw": dict(self.network_bw),
+                "failure_timeout": self.failure_timeout,
+                "boot_enabled": self.boot_enabled,
+                "have_snapshot": self.have_snapshot,
+            }
+
+
+class StandbyController:
+    """Attach to a receiver to make its node a leader standby.
+
+    Registers the ``ControlDeltaMsg`` handler on the receiver's (already
+    running) message loop, hooks the receiver's lease path, and monitors
+    the leader's lease with an expiry staggered by succession rank —
+    rank r waits ``lease_timeout * (1 + r)``, so the lowest-ranked LIVE
+    standby always fires first and a dead first-in-line simply yields to
+    the next by timeout, with no extra election protocol.  Promotion is
+    deterministic takeover: build the run-mode leader over the worker's
+    own loop/layers, adopt the shadow, bump the epoch, and beacon the
+    new lease (workers re-point + re-announce = reconcile)."""
+
+    def __init__(self, receiver, rank: int = 0, lease_timeout: float = 5.0,
+                 standbys: Optional[List[NodeID]] = None,
+                 mode: Optional[int] = None,
+                 node_network_bw: Optional[Dict[NodeID, int]] = None,
+                 failure_timeout: Optional[float] = None,
+                 lease_interval: Optional[float] = None):
+        self.receiver = receiver
+        self.node = receiver.node
+        self.shadow = ShadowLeaderState()
+        self.rank = rank
+        self.lease_timeout = lease_timeout
+        self.lease_interval = lease_interval
+        self.standbys = list(standbys or [])
+        self._mode = mode
+        self._bw = node_network_bw
+        self._ft = failure_timeout
+        self.promoted = threading.Event()  # set once self.leader is live
+        self._promoting = False  # reentrancy latch (under self._lock)
+        self.leader = None  # the promoted leader instance, post-takeover
+        self.takeover_seconds: Optional[float] = None  # promote wall cost
+        self._lock = threading.Lock()
+        self._armed = False
+        self._epoch_seen = -1
+        timeout = lease_timeout * (1.0 + rank)
+        self.detector = FailureDetector(timeout, self._leader_expired)
+        receiver.loop.register(ControlDeltaMsg, self.handle_delta)
+        receiver.on_leader_lease = self.handle_lease
+
+    def close(self) -> None:
+        self.detector.stop()
+        if self.leader is not None:
+            self.leader.close()
+
+    # ------------------------------------------------------------- intake
+
+    def handle_delta(self, msg: ControlDeltaMsg) -> None:
+        with self._lock:
+            if msg.epoch < self._epoch_seen:
+                # A deposed leader's stale deltas must not pollute the
+                # shadow the CURRENT leader is feeding.
+                trace.count("failover.fenced_delta")
+                return
+            self._epoch_seen = max(self._epoch_seen, msg.epoch)
+        self.detector.touch(msg.src_id)  # deltas are leader liveness too
+        self.shadow.apply(msg)
+
+    def handle_lease(self, msg: LeaderLeaseMsg) -> None:
+        """Receiver hook — called AFTER the receiver's own fencing and
+        leader re-pointing, so ``node.leader_id`` is already current."""
+        with self._lock:
+            if msg.epoch < self._epoch_seen:
+                return  # zombie lease: the receiver fenced it already
+            self._epoch_seen = max(self._epoch_seen, msg.epoch)
+            if msg.standbys:
+                self.standbys = [int(s) for s in msg.standbys]
+                if self.node.my_id in self.standbys:
+                    new_rank = self.standbys.index(self.node.my_id)
+                    if new_rank != self.rank:
+                        # Succession shortened (a standby ahead of us
+                        # was promoted or dropped): tighten our expiry.
+                        self.rank = new_rank
+                        self.detector._timeout = self.lease_timeout * (
+                            1.0 + new_rank)
+            if msg.interval > 0:
+                # Size the expiry off the leader's advisory beacon
+                # period when it is longer than our config (never
+                # shorter: a slow beacon must not fake-expire).
+                floor = msg.interval * 3 * (1.0 + self.rank)
+                if floor > self.detector._timeout:
+                    self.detector._timeout = floor
+            arm = not self._armed
+            self._armed = True
+        self.detector.touch(msg.src_id)
+        if arm:
+            self.detector.start()
+        ldr = self.leader
+        if ldr is not None:
+            # The receiver owns the LeaderLeaseMsg handler on the shared
+            # loop; forward so a PROMOTED leader can still depose itself
+            # when a better claim (higher epoch, or equal-epoch lower
+            # id) appears — without this, a double promotion would leave
+            # two schedulers beaconing forever.
+            ldr.handle_leader_lease(msg)
+
+    # ----------------------------------------------------------- takeover
+
+    def _leader_expired(self, node_id: NodeID) -> None:
+        with self._lock:
+            if self._promoting:
+                return
+        if node_id != self.node.leader_id:
+            # A PREVIOUS leader's stale lease entry expired after a
+            # takeover we already followed; only the current leader's
+            # silence is a failover trigger.
+            return
+        self.promote(dead=node_id)
+
+    def promote(self, dead: Optional[NodeID] = None) -> None:
+        """Assume leadership: the deterministic takeover."""
+        with self._lock:
+            if self._promoting:
+                return
+            self._promoting = True
+            epoch = max(self._epoch_seen, 0) + 1
+            remaining = [s for s in self.standbys if s != self.node.my_id]
+        t0 = time.monotonic()
+        shadow = self.shadow.export()
+        if not shadow["have_snapshot"]:
+            log.error("promoting WITHOUT a replicated snapshot; recovery "
+                      "depends entirely on worker re-announces")
+        mode = self._mode if self._mode is not None else (
+            shadow["mode"] or 0)
+        ft = self._ft if self._ft is not None else shadow["failure_timeout"]
+        interval = self.lease_interval or max(self.lease_timeout / 3.0, 0.05)
+        log.error("leader lease expired; standby assuming leadership",
+                  dead=dead, epoch=epoch, mode=mode, rank=self.rank,
+                  shadow_deltas=self.shadow.deltas_applied)
+        trace.count("failover.takeover")
+        # Leadership is self-directed now: our own acks/announces must
+        # short-circuit back into our loop.
+        self.node.add_node(self.node.my_id)
+        self.node.update_leader(self.node.my_id)
+        self.receiver.note_leader_epoch(epoch)
+        from .leader import (
+            FlowRetransmitLeaderNode,
+            LeaderNode,
+            PullRetransmitLeaderNode,
+            RetransmitLeaderNode,
+        )
+
+        classes = [LeaderNode, RetransmitLeaderNode,
+                   PullRetransmitLeaderNode, FlowRetransmitLeaderNode]
+        cls = classes[mode]
+        kwargs = dict(start_loop=False, loop=self.receiver.loop,
+                      lock=self.receiver._lock,
+                      expected_nodes=set(), failure_timeout=ft,
+                      standbys=remaining, lease_interval=interval,
+                      epoch=epoch)
+        args = (self.node, self.receiver.layers, shadow["assignment"])
+        if mode == 3:
+            bw = self._bw if self._bw is not None else shadow["network_bw"]
+            leader = cls(*args, bw, **kwargs)
+        else:
+            leader = cls(*args, **kwargs)
+        leader.boot_enabled = shadow["boot_enabled"]
+        leader.adopt_shadow(shadow, dead_leader=dead)
+        self.leader = leader
+        self.promoted.set()  # only after self.leader is observable
+        leader.detector.start()
+        # First lease at the bumped epoch = the takeover announcement:
+        # workers re-point their leader, flush requeued messages, and
+        # re-announce (inventory + partials) — the reconcile channel.
+        leader.start_ha()
+        leader.resume_from_takeover()
+        self.takeover_seconds = time.monotonic() - t0
+        log.info("takeover complete; delivery resuming",
+                 epoch=epoch, takeover_ms=round(
+                     self.takeover_seconds * 1000, 1))

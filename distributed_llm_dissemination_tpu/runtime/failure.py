@@ -39,10 +39,15 @@ class HeartbeatSender:
         my_id: NodeID,
         leader_id: NodeID,
         interval: float,
+        leader_fn: Optional[Callable[[], NodeID]] = None,
     ):
+        """``leader_fn``: live leader lookup, re-read every beat — after
+        an epoch-fenced failover (docs/failover.md) the beacon must
+        follow the NEW leader, not keep feeding a dead seat's queue."""
         self._transport = transport
         self._my_id = my_id
-        self._leader_id = leader_id
+        self._leader_fn = leader_fn if leader_fn is not None else (
+            lambda: leader_id)
         self._interval = interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -56,7 +61,8 @@ class HeartbeatSender:
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
             try:
-                self._transport.send(self._leader_id, HeartbeatMsg(self._my_id))
+                self._transport.send(self._leader_fn(),
+                                     HeartbeatMsg(self._my_id))
             except (OSError, KeyError) as e:
                 log.warn("heartbeat send failed", err=repr(e))
 

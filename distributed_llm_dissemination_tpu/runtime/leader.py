@@ -42,8 +42,9 @@ from ..core.types import (
     NodeID,
     Status,
     delivered,
+    layer_ids_to_json,
 )
-from ..sched.flow import FlowJob, FlowJobsMap, rate_for
+from ..sched.flow import FlowJob, FlowJobsMap, pick_salvage_source, rate_for
 from ..sched.native import make_flow_graph
 from ..transport.messages import (
     AckMsg,
@@ -58,14 +59,21 @@ from ..transport.messages import (
     LayerDigestsMsg,
     LayerMsg,
     LayerNackMsg,
+    LeaderLeaseMsg,
     PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
+    SourceDeadMsg,
     StartupMsg,
 )
 from ..utils import integrity, intervals, trace
 from ..utils.logging import log
 from .checkpoint import map_through_gaps
+from .failover import (
+    ControlReplicator,
+    _nested_layer_map_to_json,
+    _partial_to_json,
+)
 from .failure import FailureDetector
 from .node import MessageLoop, Node
 from .send import (
@@ -94,6 +102,10 @@ def assignment_satisfied(a: Assignment, s: Status) -> bool:
 class LeaderNode:
     """Mode 0: naive leader broadcast."""
 
+    # Scheduler mode number, for the replicated snapshot (a promoted
+    # standby must construct the SAME scheduler class at takeover).
+    MODE = 0
+
     def __init__(
         self,
         node: Node,
@@ -104,6 +116,11 @@ class LeaderNode:
         failure_timeout: float = 0.0,
         fabric=None,
         placement=None,
+        standbys: Optional[List[NodeID]] = None,
+        lease_interval: float = 0.0,
+        epoch: int = -1,
+        loop=None,
+        lock=None,
     ):
         """``expected_nodes``: when given, distribution also waits for these
         nodes to announce — not just the assignment keys.  The reference
@@ -123,7 +140,18 @@ class LeaderNode:
         layer bytes move as device traffic (ICI) instead of TCP streams —
         the north-star data plane (SURVEY §5.8).  Transfers the fabric
         can't carry (client-held sources, unstaged nodes) fall back to the
-        host path per transfer."""
+        host path per transfer.
+
+        Control-plane HA (docs/failover.md): ``standbys`` is the ordered
+        succession list — when non-empty, every control-state mutation
+        replicates to them as ``ControlDeltaMsg``s; ``lease_interval``
+        > 0 beacons ``LeaderLeaseMsg`` liveness; ``epoch`` is this
+        leader's fencing epoch (stamped onto every control message it
+        emits; -1 = HA off, wire format unchanged).  ``loop``: share an
+        already-running MessageLoop instead of owning one — the
+        promoted-standby path, where the worker's loop keeps its
+        data-plane handlers and the leader fills the control-plane
+        gaps."""
         self.node = node
         self.layers = layers
         self.assignment = assignment
@@ -152,7 +180,10 @@ class LeaderNode:
         self._watch_stop = threading.Event()
         self.expected_nodes = set(expected_nodes or ())
         self.status: Status = {}
-        self._lock = threading.Lock()
+        # A promoted standby's leader shares the hosting WORKER's lock
+        # (``lock=``): both mutate the same ``layers`` store, and two
+        # locks over one dict would race.
+        self._lock = lock if lock is not None else threading.Lock()
         # Multi-controller lockstep fabric?  Fixed at construction.
         self._spmd = getattr(fabric, "kind", "") == "spmd"
         # SPMD fabric: declared crashes break pod-wide lockstep, so later
@@ -205,6 +236,21 @@ class LeaderNode:
         self.layer_digests: Dict[LayerID, str] = {}
         self._digests_ready = threading.Event()
         self.nacker = NackRetransmitter()
+
+        # Control-plane HA (docs/failover.md).
+        self.epoch = epoch
+        self.standbys: List[NodeID] = list(standbys or [])
+        self.lease_interval = lease_interval
+        self._lease_stop = threading.Event()
+        self._lease_inflight: Set[NodeID] = set()
+        self._deposed = False
+        self._plan_seq_hint = 0  # last issued plan seq + 1, for snapshots
+        # (layer, dest) pairs being range-salvaged after a source crash
+        # (mode 3): their acks clear them; re-plans skip them.
+        self._salvaging: Set[Tuple[LayerID, NodeID]] = set()
+        self.replicator = (ControlReplicator(node, self.standbys)
+                           if self.standbys else None)
+
         if integrity.digests_enabled():
             threading.Thread(target=self._compute_own_digests,
                              name="layer-digests", daemon=True).start()
@@ -223,11 +269,13 @@ class LeaderNode:
             for lid, src in self.layers.items()
         }
 
-        self.loop = MessageLoop(node.transport)
+        self._shared_loop = loop is not None
+        self.loop = loop if loop is not None else MessageLoop(node.transport)
         self._register_handlers()
         if start_loop:
             self.loop.start()
             self.detector.start()
+            self.start_ha()
             if self._spmd:
                 threading.Thread(target=self._plan_watchdog,
                                  name="plan-watchdog", daemon=True).start()
@@ -311,7 +359,8 @@ class LeaderNode:
         """Build (and retain for gap re-sends) the cancellation that
         supersedes ``seq``."""
         cancel = DevicePlanMsg(self.node.my_id, msg.plan_id,
-                               msg.layer_id, msg.dest_id, 0, [], seq=seq)
+                               msg.layer_id, msg.dest_id, 0, [], seq=seq,
+                               epoch=self.epoch)
         with self._lock:
             self._sent_plans[seq] = cancel
         return cancel
@@ -325,17 +374,200 @@ class LeaderNode:
                           dest=r, err=repr(e))
 
     def _register_handlers(self) -> None:
-        self.loop.register(AnnounceMsg, self.handle_announce)
-        self.loop.register(AckMsg, self.handle_ack)
-        self.loop.register(LayerMsg, self.handle_layer)
-        self.loop.register(
-            HeartbeatMsg, lambda msg: self.detector.touch(msg.src_id)
-        )
-        self.loop.register(BootReadyMsg, self.handle_boot_ready)
-        self.loop.register(DevicePlanMsg, self.handle_device_plan)
-        self.loop.register(GenerateReqMsg, self.handle_generate_req)
-        self.loop.register(PlanResendReqMsg, self.handle_plan_resend)
-        self.loop.register(LayerNackMsg, self.handle_layer_nack)
+        # A PROMOTED leader shares the worker's already-running loop:
+        # register-keep fills the control-plane gaps (announce / ack /
+        # heartbeat / ...) without clobbering the worker's data-plane
+        # handlers (layer reassembly, flow jobs, NACK service).
+        reg = (self.loop.register_keep if self._shared_loop
+               else self.loop.register)
+        reg(AnnounceMsg, self.handle_announce)
+        reg(AckMsg, self.handle_ack)
+        reg(LayerMsg, self.handle_layer)
+        reg(HeartbeatMsg, lambda msg: self.detector.touch(msg.src_id))
+        reg(BootReadyMsg, self.handle_boot_ready)
+        reg(DevicePlanMsg, self.handle_device_plan)
+        reg(GenerateReqMsg, self.handle_generate_req)
+        reg(PlanResendReqMsg, self.handle_plan_resend)
+        reg(LayerNackMsg, self.handle_layer_nack)
+        reg(LeaderLeaseMsg, self.handle_leader_lease)
+
+    # --------------------------------------------------- control-plane HA
+
+    def start_ha(self) -> None:
+        """Begin beaconing the leadership lease (no-op when HA is off).
+        The first beat goes out immediately — for a promoted standby it
+        IS the takeover announcement."""
+        if self.lease_interval > 0 and self.epoch >= 0:
+            threading.Thread(target=self._lease_loop, name="leader-lease",
+                             daemon=True).start()
+
+    def _lease_loop(self) -> None:
+        while True:
+            self._broadcast_lease()
+            if self._lease_stop.wait(self.lease_interval):
+                return
+
+    def _broadcast_lease(self) -> None:
+        with self._lock:
+            if self._deposed:
+                return
+            recipients = (set(self.status) | set(self.standbys)
+                          | self.expected_nodes | set(self.assignment))
+            recipients.discard(self.node.my_id)
+        msg = LeaderLeaseMsg(self.node.my_id, self.epoch,
+                             list(self.standbys), self.lease_interval)
+        for r in sorted(recipients):
+            self._lease_send_async(r, msg)
+
+    def _lease_send_async(self, dest: NodeID, msg: LeaderLeaseMsg) -> None:
+        """One NON-BLOCKING lease send per recipient, at most one in
+        flight each: a dead peer's TCP dial-retry window (seconds) must
+        not stall the single beacon thread past the standbys' expiry —
+        that would fake the leader's own death to every LIVE observer
+        and trigger a spurious (if benign) takeover."""
+        with self._lock:
+            if dest in self._lease_inflight:
+                return  # previous beat to this peer still dialing
+            self._lease_inflight.add(dest)
+
+        def run():
+            try:
+                self.node.add_node(dest)
+                self.node.transport.send(dest, msg)
+            except (OSError, KeyError) as e:
+                log.debug("lease send failed", dest=dest, err=repr(e))
+            finally:
+                with self._lock:
+                    self._lease_inflight.discard(dest)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"lease-{dest}").start()
+
+    def handle_leader_lease(self, msg: LeaderLeaseMsg) -> None:
+        """A lease from ANOTHER leader at a higher epoch means a standby
+        took over while this process was presumed dead: step down.  Our
+        stale-epoch control traffic is already fenced cluster-wide; the
+        step-down just stops this zombie from burning the wire and from
+        declaring live nodes crashed.  EQUAL epochs (two standbys fired
+        concurrently — pathological, but rank staggering is not
+        consensus) break deterministically: the LOWER node id keeps the
+        seat, the other deposes."""
+        if msg.src_id == self.node.my_id:
+            return
+        if msg.epoch < self.epoch or (msg.epoch == self.epoch
+                                      and msg.src_id > self.node.my_id):
+            return
+        with self._lock:
+            if self._deposed:
+                return
+            self._deposed = True
+        trace.count("failover.deposed")
+        log.error("a higher-epoch leader exists; stepping down",
+                  new_leader=msg.src_id, new_epoch=msg.epoch,
+                  my_epoch=self.epoch)
+        self._lease_stop.set()
+        self.detector.stop()
+
+    def _replicate(self, kind: str, **data) -> None:
+        """Stream one control-state mutation to the standbys (no-op when
+        HA is off).  Best-effort: takeover reconciliation repairs any
+        divergence — replication buys recovery SPEED, not correctness."""
+        rep = self.replicator
+        if rep is not None and self.epoch >= 0:
+            rep.publish(self.epoch, kind, data)
+
+    def _snapshot_payload(self) -> dict:
+        with self._lock:
+            return {
+                "Mode": self.MODE,
+                "Assignment": _nested_layer_map_to_json(self.assignment),
+                "Status": _nested_layer_map_to_json(self.status),
+                "Partial": _partial_to_json(self.partial_status),
+                "Dropped": _nested_layer_map_to_json(
+                    self._dropped_assignment),
+                "Digests": {str(l): d
+                            for l, d in self.layer_digests.items()},
+                "PlanSeq": self._plan_seq_hint,
+                "StartupSent": self._startup_sent,
+                "NetworkBw": {str(n): b for n, b in getattr(
+                    self, "node_network_bw", {}).items()},
+                "FailureTimeout": self.detector._timeout,
+                "BootEnabled": self.boot_enabled,
+            }
+
+    def _send_snapshot_to(self, standby: NodeID) -> None:
+        if self.replicator is None or self.epoch < 0:
+            return
+        self.replicator.publish_to(standby, self.epoch, "snapshot",
+                                   self._snapshot_payload())
+        log.info("control snapshot sent to standby", standby=standby)
+
+    def adopt_shadow(self, shadow: dict, dead_leader=None) -> None:
+        """Assume a dead leader's replicated control state (the takeover
+        half of ``runtime/failover.StandbyController``).  The dead
+        leader's own status row is dropped — its in-RAM layers died with
+        it; anything ONLY it held will surface as a loud "no owner"
+        during re-planning, exactly like any other crashed holder."""
+        with self._lock:
+            own_row = {
+                lid: LayerMeta(
+                    location=src.meta.location,
+                    limit_rate=src.meta.limit_rate,
+                    source_type=src.meta.source_type,
+                    data_size=src.data_size,
+                )
+                for lid, src in self.layers.items()
+            }
+            self.status = {n: dict(row)
+                           for n, row in shadow["status"].items()
+                           if n != dead_leader}
+            self.status[self.node.my_id] = own_row
+            self.assignment = {n: dict(r) for n, r in
+                               shadow["assignment"].items()
+                               if n != dead_leader}
+            self.partial_status = {n: dict(p) for n, p in
+                                   shadow["partial"].items()}
+            self._dropped_assignment = {n: dict(r) for n, r in
+                                        shadow["dropped"].items()}
+            for lid, dg in shadow["digests"].items():
+                self.layer_digests.setdefault(lid, dg)
+            self._plan_seq = itertools.count(shadow["plan_seq"])
+            self._plan_seq_hint = shadow["plan_seq"]
+            self._started = True
+            self._startup_sent = shadow["startup_sent"]
+            self._t_start = time.monotonic()
+            if self._spmd:
+                # An epoch change breaks any presumed pod lockstep; the
+                # rest of the run rides the host path.
+                self._fabric_disabled = True
+            peers = [n for n in self.status if n != self.node.my_id]
+        for n in peers:
+            self.detector.touch(n)
+        if dead_leader is not None:
+            self.detector.forget(dead_leader)
+
+    def resume_from_takeover(self) -> None:
+        """Re-drive delivery from the adopted shadow: finish immediately
+        when the goal is already met, else run the mode's re-planner.
+        Worker re-announces (triggered by the takeover lease) refine the
+        picture as they arrive — each one re-plans incrementally via the
+        existing re-announce machinery."""
+        log.info("resuming distribution from replicated shadow state",
+                 epoch=self.epoch,
+                 dests=sorted(self.assignment),
+                 partials=sorted(self.partial_status))
+        with self._lock:
+            already_done = self._startup_sent
+        if already_done:
+            # The dead leader finished delivery before dying (the
+            # replicated ``startup`` delta says so): _maybe_finish will
+            # never re-fire, so release this side's ready() waiters
+            # directly — a completed goal must not read as a hang.
+            log.info("takeover adopted a FINISHED distribution; "
+                     "nothing to re-drive")
+            self._ready_q.put(self.assignment)
+            return
+        self._drive(self._recover)
 
     # --------------------------------------------------------- integrity
 
@@ -403,6 +635,8 @@ class LeaderNode:
         self._digests_ready.wait(timeout=300.0)
         with self._lock:
             dests = list(self.assignment)
+            digests = {str(l): d for l, d in self.layer_digests.items()}
+        self._replicate("digests", Digests=digests)
         for dest in dests:
             self._send_digests_to(dest)
 
@@ -417,7 +651,8 @@ class LeaderNode:
             return
         try:
             self.node.transport.send(
-                dest, LayerDigestsMsg(self.node.my_id, digests))
+                dest, LayerDigestsMsg(self.node.my_id, digests,
+                                      epoch=self.epoch))
         except (OSError, KeyError) as e:
             log.warn("digest stamp send failed", dest=dest, err=repr(e))
 
@@ -524,7 +759,7 @@ class LeaderNode:
             return
         serve = ServeMsg(self.node.my_id, members or [],
                          counts=counts if members else [],
-                         gen=self.serve_generate)
+                         gen=self.serve_generate, epoch=self.epoch)
         with self._lock:
             recipients = sorted(
                 (set(self.status) | set(members or ()))
@@ -543,7 +778,7 @@ class LeaderNode:
             # (a member that already ENTERED can't be recalled — the
             # same residual window as plan cancellation, see
             # parallel/spmd_fabric.py).
-            cancel = ServeMsg(self.node.my_id, [])
+            cancel = ServeMsg(self.node.my_id, [], epoch=self.epoch)
             for r in recipients:
                 try:
                     self.node.transport.send(r, cancel)
@@ -603,8 +838,14 @@ class LeaderNode:
 
     def close(self) -> None:
         self._watch_stop.set()
+        self._lease_stop.set()
+        if self.replicator is not None:
+            self.replicator.close()
         self.detector.stop()
-        self.loop.stop()
+        if not self._shared_loop:
+            # A promoted leader borrows the worker's loop — closing it
+            # here would kill the worker's data plane too.
+            self.loop.stop()
 
     # -------------------------------------------------------------- handlers
 
@@ -651,7 +892,8 @@ class LeaderNode:
         for dest, blob_ids in per_dest.items():
             try:
                 self.node.transport.send(
-                    dest, BootHintMsg(self.node.my_id, blob_ids))
+                    dest, BootHintMsg(self.node.my_id, blob_ids,
+                                      epoch=self.epoch))
             except (OSError, KeyError) as e:
                 log.warn("boot hint send failed", dest=dest, err=repr(e))
 
@@ -665,7 +907,7 @@ class LeaderNode:
             return
         try:
             self.node.transport.send(
-                dest, BootHintMsg(self.node.my_id, ids))
+                dest, BootHintMsg(self.node.my_id, ids, epoch=self.epoch))
         except (OSError, KeyError) as e:
             log.warn("boot hint send failed", dest=dest, err=repr(e))
 
@@ -718,6 +960,22 @@ class LeaderNode:
                 # A re-announce without partials supersedes any stale ones
                 # (e.g. the checkpoint dir was wiped between restarts).
                 self.partial_status.pop(msg.src_id, None)
+            # A re-announcing dest's salvage pairs revert to the normal
+            # re-plan machinery — its announce carries the authoritative
+            # partial coverage the planner schedules around.
+            if reannounce:
+                self._salvaging = {p for p in self._salvaging
+                                   if p[1] != msg.src_id}
+        self._replicate("status", Node=msg.src_id,
+                        Layers=layer_ids_to_json(msg.layer_ids))
+        self._replicate(
+            "partial", Node=msg.src_id,
+            Partial=({str(l): info for l, info in msg.partial.items()}
+                     if msg.partial else None))
+        if msg.src_id in self.standbys:
+            # A standby joined (or re-joined): snapshot first, deltas
+            # thereafter.
+            self._send_snapshot_to(msg.src_id)
         if self._maybe_start():
             self.send_layers()
             # Announce metadata can already satisfy the assignment (every
@@ -785,6 +1043,8 @@ class LeaderNode:
             if node_id != self.node.my_id and node_id not in self.status:
                 self.detector.touch(node_id)
         log.info("assignment updated", dests=sorted(assignment))
+        self._replicate("assignment",
+                        Assignment=_nested_layer_map_to_json(assignment))
         with self._lock:
             started = self._started
         if started:
@@ -933,11 +1193,14 @@ class LeaderNode:
         same-dest equal-size plans) — the dest finishes the whole group
         as one batched gather instead of N serial collectives."""
         seq = next(self._plan_seq)
+        self._plan_seq_hint = seq + 1
+        self._replicate("plan_seq", Seq=seq + 1)
         plan_id = f"{layer_id}.{dest}.{seq}"
         spmd = self._spmd
         msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
                             total, list(layout), seq=seq if spmd else -1,
-                            batch_id=batch_id, batch_n=batch_n)
+                            batch_id=batch_id, batch_n=batch_n,
+                            epoch=self.epoch)
         with self._lock:
             active = not self._startup_sent
         if active:
@@ -1009,7 +1272,8 @@ class LeaderNode:
         if not failed:
             return True
         cancel = DevicePlanMsg(self.node.my_id, msg.plan_id, msg.layer_id,
-                               msg.dest_id, 0, [], seq=msg.seq)
+                               msg.dest_id, 0, [], seq=msg.seq,
+                               epoch=self.epoch)
         with self._lock:
             # The cancel supersedes the plan for this seq: a late
             # re-send of the ORIGINAL would have the gap process enter a
@@ -1036,7 +1300,8 @@ class LeaderNode:
         for seq, plan in sorted(stored.items()):
             if plan is None:
                 plan = DevicePlanMsg(self.node.my_id, f"cancel.{seq}",
-                                     0, msg.src_id, 0, [], seq=seq)
+                                     0, msg.src_id, 0, [], seq=seq,
+                                     epoch=self.epoch)
             try:
                 self.node.transport.send(msg.src_id, plan)
                 log.info("re-sent spmd plan after gap report",
@@ -1098,12 +1363,16 @@ class LeaderNode:
                 size = self._layer_size_locked(msg.layer_id)
             row[msg.layer_id] = LayerMeta(location=msg.location,
                                           data_size=size)
+            # A delivered (layer, dest) pair needs no more salvage.
+            self._salvaging.discard((msg.layer_id, msg.src_id))
             # The watchdog stops chasing any plan this ack settles.
             for seq, _rec in list(self._plan_watch.items()):
                 plan = self._sent_plans.get(seq)
                 if (plan is not None and plan.dest_id == msg.src_id
                         and plan.layer_id == msg.layer_id):
                     del self._plan_watch[seq]
+        self._replicate("ack", Node=msg.src_id, Layer=msg.layer_id,
+                        Location=int(msg.location), Size=size)
         self._maybe_finish()
 
     def _layer_size_locked(self, layer_id: LayerID) -> int:
@@ -1127,6 +1396,7 @@ class LeaderNode:
                 return
             self._startup_sent = True
         log.info("timer stop: startup")
+        self._replicate("startup", Sent=True)
         self.send_startup()
         self._ready_q.put(self.assignment)
         # Startup may have been unblocked by crashes that already emptied
@@ -1206,6 +1476,9 @@ class LeaderNode:
         if dropped:
             log.error("crashed node was an assignee; dropping its layers",
                       node=node_id, layers=sorted(dropped))
+        self._replicate("crash", Node=node_id,
+                        Dropped=(layer_ids_to_json(dropped)
+                                 if dropped else None))
         self._drive(self._recover)
         # The crash may have removed the last assignee the boot/TTFT wait
         # was blocked on.
@@ -1221,7 +1494,7 @@ class LeaderNode:
                 self.node.transport.send(
                     node_id,
                     StartupMsg(self.node.my_id, boot=self.boot_enabled,
-                               serve=serve),
+                               serve=serve, epoch=self.epoch),
                 )
             except (OSError, KeyError) as e:
                 log.error("failed to send startup", dest=node_id, err=repr(e))
@@ -1232,15 +1505,18 @@ class LeaderNode:
 class RetransmitLeaderNode(LeaderNode):
     """Mode 1: peers that already own a layer forward it (node.go:472-626)."""
 
+    MODE = 1
+
     def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
                  start_loop: bool = True,
                  expected_nodes: Optional[Set[NodeID]] = None,
-                 failure_timeout: float = 0.0, fabric=None, placement=None):
+                 failure_timeout: float = 0.0, fabric=None, placement=None,
+                 **ha):
         self.layer_owners: Dict[LayerID, Set[NodeID]] = {}
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
                          failure_timeout=failure_timeout,
-                         fabric=fabric, placement=placement)
+                         fabric=fabric, placement=placement, **ha)
 
     def crash(self, node_id: NodeID) -> None:
         """A dead node no longer serves its layers; re-run the owner
@@ -1310,7 +1586,8 @@ class RetransmitLeaderNode(LeaderNode):
             self.loop.submit(self._send_one, dest, layer_id, layer)
             return
         self.node.transport.send(
-            owner, RetransmitMsg(self.node.my_id, layer_id, dest)
+            owner, RetransmitMsg(self.node.my_id, layer_id, dest,
+                                 epoch=self.epoch)
         )
 
 
@@ -1335,10 +1612,13 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
     pending job from the slowest/overloaded sender (estimated by moving-
     average job duration × queue length)."""
 
+    MODE = 2
+
     def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
                  start_loop: bool = True,
                  expected_nodes: Optional[Set[NodeID]] = None,
-                 failure_timeout: float = 0.0, fabric=None, placement=None):
+                 failure_timeout: float = 0.0, fabric=None, placement=None,
+                 **ha):
         # layer -> dest -> job
         self.jobs: Dict[LayerID, Dict[NodeID, _JobInfo]] = {}
         self.sender_load: Dict[NodeID, int] = {}
@@ -1347,7 +1627,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
                          failure_timeout=failure_timeout,
-                         fabric=fabric, placement=placement)
+                         fabric=fabric, placement=placement, **ha)
 
     def crash(self, node_id: NodeID) -> None:
         """Surgical job-table repair: jobs destined for the dead node are
@@ -1663,6 +1943,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
     this).  Crash recovery needs no dest bookkeeping: the re-plan derives
     everything from assignment + status."""
 
+    MODE = 3
+
     def __init__(
         self,
         node: Node,
@@ -1675,12 +1957,17 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         fabric=None,
         placement=None,
         topology=None,
+        **ha,
     ):
         """``topology``: optional ``sched.flow.PodTopology`` — multi-slice
         pods plan cross-slice transfers against the per-pair DCN
         capacity instead of pretending every edge is ICI."""
         self.node_network_bw = dict(node_network_bw)
         self.topology = topology
+        # sender -> dispatched (not yet known-delivered) flow jobs: the
+        # range-salvage index — crash(sender) re-plans only its jobs'
+        # DESTS' uncovered byte ranges (docs/failover.md).
+        self._live_jobs: Dict[NodeID, List[FlowJob]] = {}
         # The INITIAL solve's predicted completion time (ms) and wall
         # solve cost — prediction-vs-achieved is the plan-fidelity
         # record the CLI prints next to TTD (re-plans keep the first
@@ -1697,7 +1984,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         super().__init__(node, layers, assignment, start_loop=start_loop,
                          expected_nodes=expected_nodes,
                          failure_timeout=failure_timeout,
-                         fabric=fabric, placement=placement)
+                         fabric=fabric, placement=placement, **ha)
 
     @staticmethod
     def _warm_lp() -> None:
@@ -1707,7 +1994,11 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
 
     def _register_handlers(self) -> None:
         super()._register_handlers()
-        self.loop.register(FlowRetransmitMsg, self.handle_flow_retransmit)
+        # register_keep on a shared loop: a promoted mode-3 worker keeps
+        # its own (equivalent) flow-job handler.
+        reg = (self.loop.register_keep if self._shared_loop
+               else self.loop.register)
+        reg(FlowRetransmitMsg, self.handle_flow_retransmit)
 
     def send_layers(self) -> None:
         t, self_jobs, jobs = self.assign_jobs()
@@ -1740,6 +2031,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 for layer_id, meta in layer_ids.items():
                     if layer_id not in layer_sizes:
                         log.error("no announced size for layer", layerID=layer_id)
+                        continue
+                    if (layer_id, dest) in self._salvaging:
+                        # A crashed source's uncovered ranges are being
+                        # re-fetched via the NACK retransmit plane
+                        # (crash() below); a whole-layer re-plan here
+                        # would defeat the point of range salvage.
                         continue
                     held = self.status.get(dest, {}).get(layer_id)
                     if held is not None:
@@ -1897,7 +2194,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     job.sender_id,
                     FlowRetransmitMsg(
                         self.node.my_id, job.layer_id, job.sender_id,
-                        job.data_size, job.offset, rate,
+                        job.data_size, job.offset, rate, epoch=self.epoch,
                     ),
                 )
         for sender, job_list in jobs.items():
@@ -1914,10 +2211,65 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         FlowRetransmitMsg(
                             self.node.my_id, job.layer_id, dest,
                             job.data_size, job.offset, rate,
+                            epoch=self.epoch,
                         ),
                     )
                 except (OSError, KeyError) as e:
                     log.error("couldn't dispatch job", layerID=job.layer_id, err=repr(e))
+                    continue
+                # Salvage index: a dispatched job is live until its
+                # (layer, dest) delivers — crash(sender) consults this
+                # to re-plan only the uncovered byte ranges.
+                with self._lock:
+                    self._live_jobs.setdefault(sender, []).append(job)
+
+    def crash(self, node_id: NodeID) -> None:
+        """Range-level salvage (docs/failover.md): a dead SOURCE's
+        in-flight jobs don't re-send their whole layers — each affected
+        dest is told to NACK its uncovered byte ranges of the layer to a
+        surviving holder (``SourceDeadMsg`` → ``LayerNackMsg`` → the
+        PR-4 byte-range retransmitter), so recovery costs exactly the
+        dead source's unsent bytes.  Pairs with no surviving holder fall
+        through to the base whole-layer re-plan."""
+        salvage = []
+        with self._lock:
+            jobs = self._live_jobs.pop(node_id, [])
+            # Jobs sent TO the dead node die with its assignment.
+            for job_list in self._live_jobs.values():
+                job_list[:] = [j for j in job_list
+                               if j.dest_id != node_id]
+            for job in jobs:
+                dest, lid = job.dest_id, job.layer_id
+                if dest == node_id or dest == self.node.my_id:
+                    continue
+                held = self.status.get(dest, {}).get(lid)
+                if held is not None and delivered(held):
+                    continue  # already landed whole
+                if (lid, dest) in self._salvaging:
+                    continue
+                alt = pick_salvage_source(self.status, lid,
+                                          exclude={node_id, dest})
+                if alt is None:
+                    continue  # no surviving holder: base re-plan covers it
+                self._salvaging.add((lid, dest))
+                salvage.append((dest, lid, alt))
+        for dest, lid, alt in salvage:
+            trace.count("failover.range_salvage")
+            log.warn("source crashed mid-transfer; salvaging the dest's "
+                     "uncovered ranges via NACK retransmit",
+                     dead=node_id, layerID=lid, dest=dest, alt=alt)
+            try:
+                self.node.add_node(dest)
+                self.node.transport.send(
+                    dest, SourceDeadMsg(self.node.my_id, lid, node_id,
+                                        alt, epoch=self.epoch))
+            except (OSError, KeyError) as e:
+                with self._lock:
+                    self._salvaging.discard((lid, dest))
+                log.error("source-dead notice undeliverable; falling "
+                          "back to whole-layer re-plan", dest=dest,
+                          layerID=lid, err=repr(e))
+        super().crash(node_id)
 
     def handle_flow_retransmit(self, msg: FlowRetransmitMsg) -> None:
         """The leader can be a sender in the plan too (node.go:1168-1187)."""

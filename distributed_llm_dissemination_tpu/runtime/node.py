@@ -74,6 +74,16 @@ class MessageLoop:
     def register(self, msg_cls: Type, handler: Callable) -> None:
         self._handlers[msg_cls] = handler
 
+    def register_keep(self, msg_cls: Type, handler: Callable) -> None:
+        """Register only when no handler exists for the type.  A standby
+        promoted to leader shares the WORKER's already-running loop
+        (two loops draining one transport queue would steal each other's
+        messages), and its leader-side registrations must fill the
+        control-plane gaps (announce/ack/heartbeat/...) without
+        clobbering the worker's data-plane handlers (layer reassembly,
+        flow jobs) — see runtime/failover.py."""
+        self._handlers.setdefault(msg_cls, handler)
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()

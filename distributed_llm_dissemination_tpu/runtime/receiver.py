@@ -21,6 +21,7 @@ into one source class.
 
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import threading
@@ -46,9 +47,11 @@ from ..transport.messages import (
     LayerDigestsMsg,
     LayerMsg,
     LayerNackMsg,
+    LeaderLeaseMsg,
     PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
+    SourceDeadMsg,
     StartupMsg,
 )
 from ..utils import env as env_util, hostmem, integrity, intervals, trace
@@ -282,8 +285,35 @@ class ReceiverNode:
         # (``transport/faults.FaultyTransport``), which the CLI arms via
         # its explicit test flags.  Production receivers see a plain
         # transport; no environment variable can drop real plans.
+        #
+        # Control-plane HA (docs/failover.md): the highest leader epoch
+        # seen (stale-epoch control traffic from a zombie ex-leader is
+        # FENCED against it), the requeue buffer for leader-routed
+        # messages that failed during a failover window (flushed on the
+        # next lease), and the StandbyController's lease hook.
+        self._leader_epoch = -1
+        # Epoch at which the CURRENT leader claimed its seat: a worker
+        # switches leaders only for a strictly better claim — higher
+        # epoch, or same epoch from a lower node id (the deterministic
+        # tiebreak for two concurrently-promoted standbys) — so
+        # alternating equal-epoch leases can never flip-flop the
+        # leader pointer.
+        self._leader_claim_epoch = -1
+        self._leader_pending: "collections.deque" = collections.deque(
+            maxlen=256)
+        self.on_leader_lease = None
+        # Latched by close(): a closed receiver's still-draining daemon
+        # work (a boot thread finishing late) must not emit leader-routed
+        # messages — its seat's address may already belong to a NEW
+        # incarnation's cluster, and a stale report would corrupt that
+        # run's control state.
+        self._closed_evt = threading.Event()
+        # The heartbeat follows the CURRENT leader: after a takeover the
+        # beacon must feed the successor's failure detector, not a dead
+        # seat's queue.
         self.heartbeat = HeartbeatSender(
-            node.transport, node.my_id, node.leader_id, heartbeat_interval
+            node.transport, node.my_id, node.leader_id, heartbeat_interval,
+            leader_fn=lambda: self.node.leader_id,
         )
         # Corrupt-fragment reports (a frame the transport dropped for a
         # failed CRC, an injected drop, or a TTL-pruned stripe group)
@@ -303,6 +333,112 @@ class ReceiverNode:
         self.loop.register(BootHintMsg, self.handle_boot_hint)
         self.loop.register(GenerateReqMsg, self.handle_generate_req)
         self.loop.register(LayerDigestsMsg, self.handle_layer_digests)
+        self.loop.register(LeaderLeaseMsg, self.handle_leader_lease)
+
+    # ------------------------------------------------- control-plane HA
+
+    def note_leader_epoch(self, epoch: int) -> None:
+        """Raise the fencing watermark (a promoting StandbyController
+        bumps its own worker past the old leader's epoch)."""
+        with self._lock:
+            if epoch > self._leader_epoch:
+                self._leader_epoch = epoch
+
+    def _fence_stale(self, msg) -> bool:
+        """True when ``msg`` carries a leader epoch BELOW the highest
+        seen — a zombie ex-leader's control traffic, which must be
+        rejected, not raced (docs/failover.md).  Messages without an
+        epoch (-1: HA off / legacy peer) always pass; higher epochs
+        raise the watermark."""
+        epoch = getattr(msg, "epoch", -1)
+        if epoch < 0:
+            return False
+        with self._lock:
+            cur = self._leader_epoch
+            if epoch >= cur:
+                self._leader_epoch = max(cur, epoch)
+                return False
+        trace.count("failover.fenced")
+        log.warn("fencing stale-epoch control message",
+                 kind=type(msg).__name__, src=getattr(msg, "src_id", None),
+                 epoch=epoch, current=cur)
+        return True
+
+    def handle_leader_lease(self, msg: LeaderLeaseMsg) -> None:
+        """The leader's liveness beacon.  A lease from a DIFFERENT node
+        at a current-or-higher epoch is a completed takeover: re-point
+        the leader, flush any messages requeued while the old leader was
+        unreachable, and re-announce — the announce carries this node's
+        authoritative inventory (checkpointed partials included), which
+        is exactly the reconcile the new leader resumes delivery from."""
+        if self._fence_stale(msg):
+            return
+        switched = False
+        with self._lock:
+            cur_leader = self.node.leader_id
+            if msg.src_id == cur_leader:
+                self._leader_claim_epoch = max(self._leader_claim_epoch,
+                                               msg.epoch)
+            elif (msg.epoch, -msg.src_id) > (self._leader_claim_epoch,
+                                             -cur_leader):
+                # Strictly better claim: higher epoch, or same epoch
+                # from the lower node id (concurrent-promotion tiebreak).
+                self._leader_claim_epoch = msg.epoch
+                switched = True
+        if switched:
+            self.node.add_node(msg.src_id)
+            self.node.update_leader(msg.src_id)
+        hook = self.on_leader_lease
+        if hook is not None:
+            try:
+                hook(msg)
+            except Exception as e:  # noqa: BLE001 — standby hook is advisory
+                log.error("leader-lease hook failed", err=repr(e))
+        self._flush_leader_pending()
+        if switched:
+            trace.count("failover.leader_switch")
+            log.warn("new leader lease observed; re-announcing to it",
+                     leader=msg.src_id, epoch=msg.epoch)
+            try:
+                self.announce()
+            except (OSError, KeyError) as e:
+                log.error("re-announce to new leader failed", err=repr(e))
+
+    def _send_to_leader(self, msg) -> None:
+        """Leader-routed send with failover-window requeue: a leader
+        that just died must not eat acks/boot reports — they queue
+        (bounded) and flush when the next lease names a live leader.
+        A CLOSED receiver sends nothing: its late daemon work (a boot
+        finishing after close) must not leak reports into whatever now
+        owns its old address."""
+        if self._closed_evt.is_set():
+            log.debug("suppressing leader-routed send after close",
+                      kind=type(msg).__name__)
+            return
+        try:
+            self.node.transport.send(self.node.leader_id, msg)
+        except (OSError, KeyError) as e:
+            trace.count("failover.leader_requeued")
+            with self._lock:
+                self._leader_pending.append(msg)
+            log.warn("leader unreachable; queued message for the "
+                     "failover window", kind=type(msg).__name__,
+                     err=repr(e))
+
+    def _flush_leader_pending(self) -> None:
+        while True:
+            with self._lock:
+                if not self._leader_pending:
+                    return
+                msg = self._leader_pending.popleft()
+            try:
+                self.node.transport.send(self.node.leader_id, msg)
+            except (OSError, KeyError) as e:
+                with self._lock:
+                    self._leader_pending.appendleft(msg)
+                log.warn("leader still unreachable; keeping queued "
+                         "messages", err=repr(e))
+                return
 
     def announce(self) -> None:
         """Tell the leader what I already hold, routed via the next hop
@@ -364,6 +500,8 @@ class ReceiverNode:
         already-held layers against the newly stamped digests: a
         mismatch demotes the layer and re-announces so the leader
         re-plans it, exactly like a mismatch at the ack gate."""
+        if self._fence_stale(msg):
+            return
         with self._lock:
             self.layer_digests.update(msg.digests)
         log.debug("layer digests stamped", n=len(msg.digests))
@@ -512,6 +650,7 @@ class ReceiverNode:
                      err=repr(e))
 
     def close(self) -> None:
+        self._closed_evt.set()
         self.heartbeat.stop()
         self.loop.stop()
         if self._boot_stager is not None:
@@ -640,13 +779,7 @@ class ReceiverNode:
         # Streamed boot staging: this layer's decode + device placement
         # starts NOW, overlapping the remaining layers' transfers.
         self._boot_stream_submit(msg.layer_id, src)
-        try:
-            self.node.transport.send(
-                self.node.leader_id,
-                AckMsg(self.node.my_id, msg.layer_id, loc),
-            )
-        except (OSError, KeyError) as e:
-            log.error("failed to send ackMsg", err=repr(e))
+        self._send_to_leader(AckMsg(self.node.my_id, msg.layer_id, loc))
 
     # --------------------------------------------------- device-fabric plane
 
@@ -658,6 +791,8 @@ class ReceiverNode:
         Dedicated because the ingest *waits* on other nodes' contributions:
         parked pool workers across many concurrent plans could otherwise
         starve the very contribution handlers they wait for."""
+        if self._fence_stale(msg):
+            return
         if self.fabric is None or self.placement is None:
             log.error("device plan but no fabric wired", plan=msg.plan_id)
             return
@@ -1126,13 +1261,7 @@ class ReceiverNode:
             log.error("re-announce for re-plan failed", err=repr(e))
 
     def _send_ack(self, layer_id, loc) -> None:
-        try:
-            self.node.transport.send(
-                self.node.leader_id,
-                AckMsg(self.node.my_id, layer_id, loc),
-            )
-        except (OSError, KeyError) as e:
-            log.error("failed to send ackMsg", err=repr(e))
+        self._send_to_leader(AckMsg(self.node.my_id, layer_id, loc))
 
     def handle_generate_req(self, msg: GenerateReqMsg) -> None:
         """Serve an inference request from this node's RESIDENT booted
@@ -1270,6 +1399,8 @@ class ReceiverNode:
         for the boot, its jit calls hit warm caches.  Runs on its OWN
         daemon thread: a compile takes seconds and must not occupy a
         handler-pool slot that fragment delivery needs."""
+        if self._fence_stale(msg):
+            return
         if self.boot_cfg is None or not msg.blob_ids:
             return
         hinted = frozenset(int(b) for b in msg.blob_ids)
@@ -1338,6 +1469,8 @@ class ReceiverNode:
         (``-boot none``) reports a "skipped" BootReadyMsg instead of
         silence — the leader's boot wait can never deadlock on a flag
         mismatch."""
+        if self._fence_stale(msg):
+            return
         self.expect_serve = msg.serve  # before ready(): the CLI reads it
         # Overlap accounting: precompiles/streamed stagings that finish
         # after this point no longer ran during the wire.
@@ -1378,24 +1511,14 @@ class ReceiverNode:
             # or because this send failed) must get it again.
             log.info("startup asked for boot but this node opted out; "
                      "reporting skipped")
-            try:
-                self.node.transport.send(
-                    self.node.leader_id,
-                    BootReadyMsg(self.node.my_id, 0.0, "skipped"),
-                )
-            except (OSError, KeyError) as e:
-                log.error("failed to send bootReadyMsg", err=repr(e))
+            self._send_to_leader(BootReadyMsg(self.node.my_id, 0.0,
+                                              "skipped"))
             return
         if boot_pending:
             self.loop.submit(self._boot)
         elif prior_report is not None:
-            try:
-                self.node.transport.send(
-                    self.node.leader_id,
-                    BootReadyMsg(self.node.my_id, *prior_report),
-                )
-            except (OSError, KeyError) as e:
-                log.error("failed to re-send bootReadyMsg", err=repr(e))
+            self._send_to_leader(
+                BootReadyMsg(self.node.my_id, *prior_report))
 
     def _boot(self) -> None:
         try:
@@ -1438,26 +1561,15 @@ class ReceiverNode:
             # blocked in boot_ready().get() forever).
             with self._lock:
                 self._boot_report = (0.0, "failed")
-            try:
-                self.node.transport.send(
-                    self.node.leader_id,
-                    BootReadyMsg(self.node.my_id, 0.0, "failed"),
-                )
-            except (OSError, KeyError) as e2:
-                log.error("failed to send failed-boot bootReadyMsg",
-                          err=repr(e2))
+            self._send_to_leader(BootReadyMsg(self.node.my_id, 0.0,
+                                              "failed"))
             return
         finally:
             self._boot_finished.set()  # serve waiters proceed either way
         with self._lock:
             self._boot_report = (res.seconds, res.kind)
-        try:
-            self.node.transport.send(
-                self.node.leader_id,
-                BootReadyMsg(self.node.my_id, res.seconds, res.kind),
-            )
-        except (OSError, KeyError) as e:
-            log.error("failed to send bootReadyMsg", err=repr(e))
+        self._send_to_leader(
+            BootReadyMsg(self.node.my_id, res.seconds, res.kind))
         if self.boot_generate > 0:
             # Decode AFTER reporting: the leader's TTFT clock stops at
             # the last BootReadyMsg, and serving time must not
@@ -1483,6 +1595,8 @@ class ReceiverNode:
         unservable) — waiters are released immediately.  Runs on a
         dedicated thread — the collective blocks until all members are
         in, which must not starve the message pool."""
+        if self._fence_stale(msg):
+            return
         self.serve_started.set()
         threading.Thread(
             target=self._serve, args=(msg,), daemon=True
@@ -1547,6 +1661,8 @@ class RetransmitReceiverNode(ReceiverNode):
         self.nacker.handle(self.node, self.layers, self._lock, msg)
 
     def handle_retransmit(self, msg: RetransmitMsg) -> None:
+        if self._fence_stale(msg):
+            return
         with self._lock:
             layer = self.layers.get(msg.layer_id)
         if layer is None:
@@ -1763,8 +1879,15 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             for lid in spent:
                 trace.count("integrity.gap_standdown")
                 log.error("gap watchdog standing down: NACK budget "
-                          "exhausted for every remaining gap; leaving "
-                          "recovery to crash detection", layerID=lid)
+                          "exhausted for every remaining gap; "
+                          "re-announcing so the leader re-plans",
+                          layerID=lid)
+            if spent:
+                # The NACK path is dead (a partitioned or crashed
+                # holder); the re-announce carries this node's partial
+                # coverage, so the leader re-plans ONLY the gaps — from
+                # any surviving source.
+                self._request_replan()
             for lid, src, total, gaps in stale:
                 trace.count("integrity.gap_renack")
                 log.warn("layer coverage quiet past watchdog interval; "
@@ -1881,6 +2004,57 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
     def _register_handlers(self) -> None:
         super()._register_handlers()
         self.loop.register(FlowRetransmitMsg, self.handle_flow_retransmit)
+        self.loop.register(SourceDeadMsg, self.handle_source_dead)
+
+    def handle_source_dead(self, msg: SourceDeadMsg) -> None:
+        """Range-level salvage (docs/failover.md): the leader declared a
+        mid-transfer SOURCE crashed.  Re-request ONLY this layer's
+        uncovered byte ranges from the surviving ``alt_id`` holder via
+        the PR-4 NACK retransmit plane — the committed bytes the dead
+        source (and everyone else) already delivered stay; recovery
+        costs exactly the unsent remainder.  The gap watchdog re-arms
+        against the alt holder, so a lost NACK round is re-requested
+        instead of stalling."""
+        if self._fence_stale(msg):
+            return
+        lid = msg.layer_id
+        with self._lock:
+            done = lid in self.layers
+            entry = self._partial.get(lid)
+            total = self._partial_total.get(lid)
+        if done:
+            # Completed while the notice was in flight: the leader
+            # missed our ack — re-ack instead of re-fetching anything.
+            self._ack_completed(lid)
+            return
+        if entry is None or total is None:
+            # No coverage at all: nothing to salvage — re-announce so
+            # the leader re-plans the whole layer.
+            log.warn("source dead but no partial coverage; requesting "
+                     "whole-layer re-plan", layerID=lid, dead=msg.dead_id)
+            self._request_replan()
+            return
+        _, cov = entry
+        with self._lock:
+            self._frag_src[lid] = msg.alt_id
+            self._frag_t[lid] = _time.monotonic()
+            # committed() on purpose: ranges with an in-flight claim are
+            # re-requested too.  A claim can still ABORT (failed copy),
+            # and a range requested twice is absorbed by interval
+            # reassembly — a range never requested is a stall until the
+            # gap watchdog notices.  Slightly over-counts salvage_bytes;
+            # never under-recovers.
+            gaps = intervals.complement(cov.committed(), total)
+        missing = sum(e - s for s, e in gaps)
+        trace.count("failover.salvage_ranges", len(gaps))
+        trace.count("failover.salvage_bytes", missing)
+        log.warn("source declared dead mid-layer; NACKing uncovered "
+                 "ranges to the surviving holder", layerID=lid,
+                 dead=msg.dead_id, alt=msg.alt_id, ranges=len(gaps),
+                 missing_bytes=missing, total=total)
+        for s, e in gaps:
+            self._on_corrupt_fragment(msg.alt_id, lid, s, e - s, total,
+                                      "source-dead")
 
     def handle_layer(self, msg: LayerMsg) -> None:
         """Write the fragment at its offset; ack when the layer is whole
@@ -1939,6 +2113,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         tok = None
         journal = False
         dup_done = False
+        foreign = False
         with self._lock:
             if lid in self.layers:
                 # A re-plan duplicate of a finished layer: drop the bytes
@@ -1946,6 +2121,16 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 # the leader never saw our ack.  (A placed fragment can't
                 # get here: its in-flight claim blocks completion.)
                 dup_done = True
+            elif placed and self._partial.get(lid) is None:
+                # A placed fragment whose claim belongs to a PREVIOUS
+                # incarnation's sink: a receiver replaced on a live
+                # transport (declared-dead revival) drains its
+                # predecessor's queued fragments, whose bytes live in
+                # the DEAD incarnation's buffers.  Our sink never
+                # claimed this range (the sink creates the _partial
+                # entry at claim time), so drop it — the leader's
+                # re-plan re-sends the range into OUR buffers.
+                foreign = True
             else:
                 entry = self._partial.get(lid)
                 if entry is None:
@@ -1996,6 +2181,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 )
         if dup_done:
             self._ack_completed(lid)
+            return
+        if foreign:
+            trace.count("failover.foreign_placed_dropped")
+            log.warn("dropping placed fragment claimed by a previous "
+                     "incarnation's sink", layerID=lid,
+                     offset=frag.offset, size=frag.data_size)
             return
         if placed:
             # The fragment's bytes live in the reassembly buffer; every
@@ -2228,6 +2419,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
     def handle_flow_retransmit(self, msg: FlowRetransmitMsg) -> None:
         import time as _time
 
+        if self._fence_stale(msg):
+            return
         t0 = _time.monotonic()
         log.info(
             "start sending layer",

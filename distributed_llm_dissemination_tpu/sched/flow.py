@@ -69,6 +69,31 @@ def rate_for(data_size: int, t_ms: int) -> int:
     return data_size * TIME_SCALE // max(1, t_ms)
 
 
+def pick_salvage_source(status: Status, layer_id: LayerID,
+                        exclude=frozenset()) -> Optional[NodeID]:
+    """The surviving holder a dest should re-fetch a dead source's
+    unsent byte ranges from (runtime/leader range salvage,
+    docs/failover.md): fastest modeled source rate first (0 =
+    unlimited), lowest node id as the deterministic tiebreak.  Client-
+    held copies can't serve byte-range NACK retransmits, so they never
+    qualify.  None = no survivor holds the layer — the caller falls
+    back to a whole-layer re-plan."""
+    from ..core.types import LayerLocation
+
+    best: Optional[NodeID] = None
+    best_rate = -1
+    for nid in sorted(status):
+        if nid in exclude:
+            continue
+        meta = status[nid].get(layer_id)
+        if meta is None or meta.location == LayerLocation.CLIENT:
+            continue
+        rate = meta.limit_rate if meta.limit_rate != 0 else _INF
+        if rate > best_rate:
+            best, best_rate = nid, rate
+    return best
+
+
 @dataclasses.dataclass(frozen=True)
 class PodTopology:
     """Multi-slice pod shape for the flow solve.

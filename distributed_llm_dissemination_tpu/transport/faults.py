@@ -47,9 +47,19 @@ from .messages import DevicePlanMsg, LayerMsg, Message, MsgType
 class FaultRule:
     """One deterministic fault: WHAT to do, WHERE (out = this node's
     sends, in = this node's receive path), WHICH messages match, and
-    WHEN to fire (every Nth match, at most ``times`` times)."""
+    WHEN to fire (every Nth match, at most ``times`` times).
+
+    Two TIME-SCHEDULED kinds ride the same record (docs/failover.md —
+    leader-kill and split-brain tests must be seeded, not sleep-based):
+    ``partition`` (bidirectional drop between this node and ``dest``
+    during [t_start, t_end), both directions, all message types) and
+    ``kill`` (hard-stop this node's whole transport at ``t_start``:
+    sends raise, inbound vanishes).  Both are evaluated against the
+    clock started at FaultyTransport construction, so a spec replays
+    the same failure timeline every run."""
 
     kind: str  # "corrupt" | "drop" | "dup" | "delay" | "reset"
+    #          | "partition" | "kill"
     direction: str = "out"  # "out" (send-side) | "in" (receive-side)
     # Matchers; None = wildcard.
     msg_type: Optional[MsgType] = None
@@ -66,6 +76,10 @@ class FaultRule:
     delay_s: float = 0.0  # "delay"
     flip_at: int = 0  # "corrupt": byte index within the fragment
     flip_mask: int = 0xFF  # "corrupt": XOR mask (non-zero)
+    # Time schedule ("partition"/"kill"): seconds since transport
+    # construction.  t_end None = forever.
+    t_start: float = 0.0
+    t_end: Optional[float] = None
     # Mutable counters (per-rule; FaultyTransport guards with its lock).
     matches: int = dataclasses.field(default=0, repr=False)
     fired: int = dataclasses.field(default=0, repr=False)
@@ -104,6 +118,15 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
     - ``times=K``: cap each generated rule at K firings (0 = unlimited)
     - ``drop-plan-seqs=a;b;c``: drop the FIRST inbound delivery of the
       named SPMD plan seqs (the ported ``-test-drop-plan-seqs``)
+    - ``resetany=N``: like ``reset`` but matching EVERY outbound message
+      type (control included) — the leader-routed requeue path's test
+      hook
+    - ``partition=P[@T1[-T2]]``: bidirectional drop between this node
+      and node P during [T1, T2) seconds after construction (defaults:
+      T1=0, T2=forever) — seeded split-brain, not sleep-based
+    - ``kill_after=T``: hard-stop this node's transport T seconds after
+      construction (sends raise ``ConnectionError``, inbound vanishes)
+      — the deterministic leader-kill switch
 
     e.g. ``seed=7,corrupt=9,dropin=13,dup=11,times=8``.  Returns
     ``(seed, rules)`` — hand both to ``FaultyTransport``."""
@@ -119,6 +142,25 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
             continue
         if key == "times":
             times = int(val)
+            continue
+        if key == "partition":
+            peer, _, window = val.partition("@")
+            t1s, _, t2s = window.partition("-")
+            t1 = float(t1s) if t1s else 0.0
+            t2 = float(t2s) if t2s else None
+            pending.append(lambda sd, tm, p=int(peer), a=t1, b=t2:
+                           FaultRule("partition", "out", dest=p,
+                                     t_start=a, t_end=b))
+            continue
+        if key == "kill_after":
+            pending.append(lambda sd, tm, t=float(val):
+                           FaultRule("kill", "out", t_start=t))
+            continue
+        if key == "resetany":
+            n = int(val)
+            if n > 0:
+                pending.append(lambda sd, tm, n=n: FaultRule(
+                    "reset", "out", every=n, times=tm))
             continue
         if key == "drop-plan-seqs":
             for s in [x for x in val.split(";") if x.strip()]:
@@ -162,15 +204,27 @@ class FaultyTransport(Transport):
 
     def __init__(self, inner: Transport, rules=(), seed: int = 0):
         self.inner = inner
-        self.rules: List[FaultRule] = list(rules)
+        self.rules: List[FaultRule] = [r for r in rules
+                                       if r.kind not in ("partition", "kill")]
         self.seed = seed
         self._lock = threading.Lock()
         self.stats = {"corrupt": 0, "drop": 0, "dup": 0, "delay": 0,
-                      "reset": 0}
+                      "reset": 0, "partition": 0, "kill": 0}
         self._q: "queue.Queue[Message]" = queue.Queue()
         self._stop = threading.Event()
-        if any(r.direction == "in" and r.msg_type in (None, MsgType.LAYER)
-               for r in self.rules):
+        # Time-scheduled faults (docs/failover.md): the clock starts NOW,
+        # so a spec's partition windows and kill time replay identically
+        # run to run.
+        self._t0 = time.monotonic()
+        self._partitions = [(r.dest, r.t_start, r.t_end) for r in rules
+                            if r.kind == "partition"]
+        kills = [r.t_start for r in rules if r.kind == "kill"]
+        self._kill_at = min(kills) if kills else None
+        need_tamper = (
+            any(r.direction == "in" and r.msg_type in (None, MsgType.LAYER)
+                for r in self.rules)
+            or self._partitions or self._kill_at is not None)
+        if need_tamper:
             if hasattr(inner, "recv_tamper"):
                 inner.recv_tamper = self._tamper
             else:
@@ -179,6 +233,23 @@ class FaultyTransport(Transport):
         self._pump = threading.Thread(target=self._pump_loop, daemon=True,
                                       name="fault-pump")
         self._pump.start()
+
+    # ------------------------------------------------- time-scheduled faults
+
+    def _killed(self) -> bool:
+        return (self._kill_at is not None
+                and time.monotonic() - self._t0 >= self._kill_at)
+
+    def _partitioned(self, peer) -> bool:
+        """Whether traffic between this node and ``peer`` is currently
+        inside an active partition window."""
+        if peer is None or not self._partitions:
+            return False
+        now = time.monotonic() - self._t0
+        for p, t1, t2 in self._partitions:
+            if p == peer and now >= t1 and (t2 is None or now < t2):
+                return True
+        return False
 
     # ------------------------------------------------------------ matching
 
@@ -216,6 +287,16 @@ class FaultyTransport(Transport):
         src = info.get("src")
         off = info.get("offset", 0)
         size = info.get("size", len(view))
+        if self._killed():
+            with self._lock:
+                self.stats["kill"] += 1
+            return False  # hard-stopped transport: nothing lands
+        if self._partitioned(src):
+            with self._lock:
+                self.stats["partition"] += 1
+            log.warn("FAULT: partition dropping inbound layer frame",
+                     layerID=layer, src=src)
+            return False
         if self._fire("drop", "in", MsgType.LAYER, layer=layer, src=src,
                       offset=off, size=size) is not None:
             log.warn("FAULT: dropping inbound layer frame", layerID=layer,
@@ -237,11 +318,22 @@ class FaultyTransport(Transport):
                 msg = inner_q.get(timeout=0.1)
             except queue.Empty:
                 continue
+            if self._killed():
+                with self._lock:
+                    self.stats["kill"] += 1
+                continue  # hard-stopped: inbound vanishes
             if not isinstance(msg, LayerMsg):
+                src = getattr(msg, "src_id", None)
+                if self._partitioned(src):
+                    with self._lock:
+                        self.stats["partition"] += 1
+                    log.warn("FAULT: partition dropping inbound control "
+                             "message", kind=type(msg).__name__, src=src)
+                    continue
                 mtype = getattr(msg, "msg_type", None)
                 seq = (msg.seq if isinstance(msg, DevicePlanMsg) else None)
                 if self._fire("drop", "in", mtype, seq=seq,
-                              src=getattr(msg, "src_id", None)) is not None:
+                              src=src) is not None:
                     log.warn("FAULT: dropping inbound control message",
                              kind=type(msg).__name__, seq=seq)
                     continue
@@ -253,6 +345,16 @@ class FaultyTransport(Transport):
         mtype = getattr(message, "msg_type", None)
         layer = getattr(message, "layer_id", None)
         seq = (message.seq if isinstance(message, DevicePlanMsg) else None)
+        if self._killed():
+            with self._lock:
+                self.stats["kill"] += 1
+            raise ConnectionError("injected fault: transport killed")
+        if self._partitioned(dest_id):
+            with self._lock:
+                self.stats["partition"] += 1
+            log.warn("FAULT: partition dropping outbound message",
+                     kind=type(message).__name__, dest=dest_id)
+            return
         if self._fire("drop", "out", mtype, layer=layer, seq=seq,
                       dest=dest_id) is not None:
             log.warn("FAULT: dropping outbound message",

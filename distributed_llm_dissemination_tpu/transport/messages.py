@@ -72,6 +72,23 @@ class MsgType(enum.IntEnum):
     # the dest will receive (collected from
     # the holders' announces), so completed layers are verified
     # end-to-end BEFORE they are acked or staged to a device.
+    # LEADER_LEASE — control-plane HA (docs/failover.md): the leader's
+    # liveness beacon, carrying the current EPOCH and the ordered
+    # standby succession list.  Standbys and workers feed it to a
+    # FailureDetector; on expiry the lowest-ranked live standby assumes
+    # leadership at epoch+1 and its first lease at the higher epoch IS
+    # the takeover announcement — workers re-point their leader and
+    # re-announce (the reconcile channel).
+    # CONTROL_DELTA — leader → standbys: one epoch-stamped control-state
+    # delta (status row, ack, partial coverage, dropped assignment,
+    # digest stamp, plan seq) or a full snapshot, applied to the
+    # standby's shadow leader state so takeover starts from replicated
+    # knowledge instead of a blank slate.
+    # SOURCE_DEAD — leader → dest (mode 3): a mid-transfer SOURCE was
+    # declared crashed; the dest must NACK its uncovered byte ranges of
+    # the named layer to the surviving ``alt_id`` holder (the PR-4
+    # byte-range retransmit plane) instead of waiting for a whole-layer
+    # re-send — recovery costs only the dead source's unsent bytes.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -82,6 +99,18 @@ class MsgType(enum.IntEnum):
     PLAN_RESEND_REQ = 15
     LAYER_NACK = 16
     LAYER_DIGESTS = 17
+    LEADER_LEASE = 18
+    CONTROL_DELTA = 19
+    SOURCE_DEAD = 20
+
+
+def _epoch_to_payload(payload: dict, epoch: int) -> dict:
+    """Stamp the leader EPOCH onto an envelope payload, omitted-field
+    style: -1 (HA off / legacy peer) adds nothing, so the wire format is
+    byte-identical to the pre-failover one unless HA is armed."""
+    if epoch >= 0:
+        payload["Epoch"] = int(epoch)
+    return payload
 
 
 @dataclasses.dataclass
@@ -167,20 +196,25 @@ class AckMsg:
 @dataclasses.dataclass
 class RetransmitMsg:
     """Leader → owner: forward your copy of a layer to dest
-    (message.go:94-118)."""
+    (message.go:94-118).  ``epoch``: the issuing leader's fencing epoch
+    (docs/failover.md); -1 = HA off."""
 
     src_id: NodeID
     layer_id: LayerID
     dest_id: NodeID
+    epoch: int = -1
 
     msg_type = MsgType.RETRANSMIT
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id, "LayerID": self.layer_id, "DestID": self.dest_id}
+        return _epoch_to_payload(
+            {"SrcID": self.src_id, "LayerID": self.layer_id,
+             "DestID": self.dest_id}, self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "RetransmitMsg":
-        return cls(int(d["SrcID"]), int(d["LayerID"]), int(d["DestID"]))
+        return cls(int(d["SrcID"]), int(d["LayerID"]), int(d["DestID"]),
+                   int(d.get("Epoch", -1)))
 
 
 @dataclasses.dataclass
@@ -194,18 +228,19 @@ class FlowRetransmitMsg:
     data_size: int
     offset: int
     rate: int
+    epoch: int = -1
 
     msg_type = MsgType.FLOW_RETRANSMIT
 
     def to_payload(self) -> dict:
-        return {
+        return _epoch_to_payload({
             "SrcID": self.src_id,
             "LayerID": self.layer_id,
             "DestID": self.dest_id,
             "DataSize": self.data_size,
             "Offset": self.offset,
             "Rate": self.rate,
-        }
+        }, self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "FlowRetransmitMsg":
@@ -216,6 +251,7 @@ class FlowRetransmitMsg:
             int(d.get("DataSize", 0)),
             int(d.get("Offset", 0)),
             int(d.get("Rate", 0)),
+            int(d.get("Epoch", -1)),
         )
 
 
@@ -367,16 +403,19 @@ class StartupMsg:
     # Multi-controller serving will follow (a ServeMsg after all boots):
     # receivers must stay alive past ready() to enter the collective.
     serve: bool = False
+    epoch: int = -1
 
     msg_type = MsgType.STARTUP
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id, "Boot": self.boot, "Serve": self.serve}
+        return _epoch_to_payload(
+            {"SrcID": self.src_id, "Boot": self.boot, "Serve": self.serve},
+            self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "StartupMsg":
         return cls(int(d["SrcID"]), bool(d.get("Boot", True)),
-                   bool(d.get("Serve", False)))
+                   bool(d.get("Serve", False)), int(d.get("Epoch", -1)))
 
 
 @dataclasses.dataclass
@@ -446,17 +485,20 @@ class BootHintMsg:
 
     src_id: NodeID
     blob_ids: list  # the dest's assigned blob ids
+    epoch: int = -1
 
     msg_type = MsgType.BOOT_HINT
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id,
-                "BlobIDs": [int(b) for b in self.blob_ids]}
+        return _epoch_to_payload(
+            {"SrcID": self.src_id,
+             "BlobIDs": [int(b) for b in self.blob_ids]}, self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "BootHintMsg":
         return cls(int(d["SrcID"]),
-                   [int(b) for b in d.get("BlobIDs") or []])
+                   [int(b) for b in d.get("BlobIDs") or []],
+                   int(d.get("Epoch", -1)))
 
 
 @dataclasses.dataclass
@@ -532,15 +574,17 @@ class ServeMsg:
     seq_len: int = 16
     counts: list = dataclasses.field(default_factory=list)
     gen: int = 0  # >0: decode this many tokens instead of one forward
+    epoch: int = -1
 
     msg_type = MsgType.SERVE
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id,
-                "Members": [int(m) for m in self.members],
-                "Batch": self.batch, "SeqLen": self.seq_len,
-                "Counts": [int(c) for c in self.counts],
-                "Gen": self.gen}
+        return _epoch_to_payload(
+            {"SrcID": self.src_id,
+             "Members": [int(m) for m in self.members],
+             "Batch": self.batch, "SeqLen": self.seq_len,
+             "Counts": [int(c) for c in self.counts],
+             "Gen": self.gen}, self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "ServeMsg":
@@ -548,7 +592,7 @@ class ServeMsg:
                    [int(m) for m in d.get("Members") or []],
                    int(d.get("Batch", 1)), int(d.get("SeqLen", 16)),
                    [int(c) for c in d.get("Counts") or []],
-                   int(d.get("Gen", 0)))
+                   int(d.get("Gen", 0)), int(d.get("Epoch", -1)))
 
 
 @dataclasses.dataclass
@@ -585,6 +629,7 @@ class DevicePlanMsg:
     # Empty/1 = unbatched; receivers that predate the hint ignore it.
     batch_id: str = ""
     batch_n: int = 1
+    epoch: int = -1
 
     msg_type = MsgType.DEVICE_PLAN
 
@@ -601,7 +646,7 @@ class DevicePlanMsg:
         if self.batch_id:
             payload["BatchID"] = self.batch_id
             payload["BatchN"] = self.batch_n
-        return payload
+        return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "DevicePlanMsg":
@@ -615,6 +660,7 @@ class DevicePlanMsg:
             int(d.get("Seq", -1)),
             str(d.get("BatchID", "")),
             int(d.get("BatchN", 1)),
+            int(d.get("Epoch", -1)),
         )
 
 
@@ -682,19 +728,112 @@ class LayerDigestsMsg:
 
     src_id: NodeID
     digests: dict  # {layer_id: hex digest}
+    epoch: int = -1
 
     msg_type = MsgType.LAYER_DIGESTS
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id,
-                "Digests": {str(lid): str(h)
-                            for lid, h in self.digests.items()}}
+        return _epoch_to_payload(
+            {"SrcID": self.src_id,
+             "Digests": {str(lid): str(h)
+                         for lid, h in self.digests.items()}}, self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "LayerDigestsMsg":
         return cls(int(d["SrcID"]),
                    {int(lid): str(h)
-                    for lid, h in (d.get("Digests") or {}).items()})
+                    for lid, h in (d.get("Digests") or {}).items()},
+                   int(d.get("Epoch", -1)))
+
+
+@dataclasses.dataclass
+class LeaderLeaseMsg:
+    """Leader → all: liveness lease + the fencing EPOCH + the ordered
+    standby succession (docs/failover.md).  Standbys and workers feed it
+    to a ``FailureDetector``; a lease at a HIGHER epoch from a different
+    node is a completed takeover (workers re-point their leader and
+    re-announce), and any control message below the highest epoch seen
+    is fenced — a zombie ex-leader's plans are rejected, not raced.
+    ``interval`` is the sender's advisory beacon period (receivers size
+    their expiry off it when they have no config of their own)."""
+
+    src_id: NodeID
+    epoch: int
+    standbys: list = dataclasses.field(default_factory=list)
+    interval: float = 0.0
+
+    msg_type = MsgType.LEADER_LEASE
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "Epoch": int(self.epoch),
+                "Standbys": [int(s) for s in self.standbys],
+                "Interval": float(self.interval)}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "LeaderLeaseMsg":
+        return cls(int(d["SrcID"]), int(d.get("Epoch", 0)),
+                   [int(s) for s in d.get("Standbys") or []],
+                   float(d.get("Interval", 0.0)))
+
+
+@dataclasses.dataclass
+class ControlDeltaMsg:
+    """Leader → standby: one epoch-stamped control-state delta (or a
+    full ``snapshot``), applied to the standby's shadow leader state
+    (``runtime/failover.ShadowLeaderState``).  ``kind`` names the
+    mutation ("snapshot" | "status" | "ack" | "partial" | "crash" |
+    "assignment" | "digests" | "startup" | "plan_seq"); ``data`` is the
+    kind-specific JSON payload; ``seq`` is a per-leader monotonic
+    counter (diagnostics — the shadow is reconciliation-corrected at
+    takeover, so ordering races only cost re-sent bytes, never
+    correctness)."""
+
+    src_id: NodeID
+    epoch: int
+    seq: int
+    kind: str
+    data: dict = dataclasses.field(default_factory=dict)
+
+    msg_type = MsgType.CONTROL_DELTA
+
+    def to_payload(self) -> dict:
+        return {"SrcID": self.src_id, "Epoch": int(self.epoch),
+                "Seq": int(self.seq), "Kind": self.kind,
+                "Data": self.data}
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "ControlDeltaMsg":
+        return cls(int(d["SrcID"]), int(d.get("Epoch", 0)),
+                   int(d.get("Seq", 0)), str(d.get("Kind", "")),
+                   dict(d.get("Data") or {}))
+
+
+@dataclasses.dataclass
+class SourceDeadMsg:
+    """Leader → dest (mode 3): the source ``dead_id`` of an in-flight
+    transfer of ``layer_id`` was declared crashed.  The dest must NACK
+    its UNCOVERED byte ranges of the layer to the surviving holder
+    ``alt_id`` (the PR-4 ``LayerNackMsg`` byte-range retransmit plane) —
+    recovery then costs exactly the dead source's unsent bytes instead
+    of a whole-layer re-send (docs/failover.md)."""
+
+    src_id: NodeID
+    layer_id: LayerID
+    dead_id: NodeID
+    alt_id: NodeID
+    epoch: int = -1
+
+    msg_type = MsgType.SOURCE_DEAD
+
+    def to_payload(self) -> dict:
+        return _epoch_to_payload(
+            {"SrcID": self.src_id, "LayerID": self.layer_id,
+             "DeadID": self.dead_id, "AltID": self.alt_id}, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "SourceDeadMsg":
+        return cls(int(d["SrcID"]), int(d["LayerID"]), int(d["DeadID"]),
+                   int(d["AltID"]), int(d.get("Epoch", -1)))
 
 
 Message = Union[
@@ -713,6 +852,9 @@ Message = Union[
     PlanResendReqMsg,
     LayerNackMsg,
     LayerDigestsMsg,
+    LeaderLeaseMsg,
+    ControlDeltaMsg,
+    SourceDeadMsg,
 ]
 
 _DECODERS = {
@@ -733,6 +875,9 @@ _DECODERS = {
     MsgType.PLAN_RESEND_REQ: PlanResendReqMsg,
     MsgType.LAYER_NACK: LayerNackMsg,
     MsgType.LAYER_DIGESTS: LayerDigestsMsg,
+    MsgType.LEADER_LEASE: LeaderLeaseMsg,
+    MsgType.CONTROL_DELTA: ControlDeltaMsg,
+    MsgType.SOURCE_DEAD: SourceDeadMsg,
 }
 
 

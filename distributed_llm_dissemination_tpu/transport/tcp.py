@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
 from ..ops.reassembly import stripe_offsets
 from ..utils import integrity, trace
+from ..utils.backoff import Backoff
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from ..utils.rate import PacedWriter
@@ -57,7 +58,14 @@ _CHUNK = 1 << 20  # 1 MiB receive/relay chunk
 # Dial retry window: the reference has no retries at all (errors are only
 # logged, node.go:345-348), so peers racing the leader's listener die.
 _DIAL_TIMEOUT = 10.0
-_DIAL_RETRY_DELAY = 0.2
+_DIAL_RETRY_DELAY = 0.1
+# Pooled send retries (utils/backoff.py): how many FRESH dials a failed
+# layer/control send gets — with jittered exponential delays between
+# them — before the OSError surfaces to the protocol layer.  Matters
+# during a failover window: every worker loses the leader at once, and
+# un-jittered immediate retries would stampede the successor in
+# lockstep.
+_SEND_RETRIES = max(1, int(os.environ.get("DLD_TCP_SEND_RETRIES", "3")))
 
 # --- layer striping -------------------------------------------------------
 # One (source, layer) transfer used to ride ONE pooled data connection: a
@@ -90,8 +98,14 @@ _STRIPE_GROUP_TTL = 300.0
 
 
 def _dial(addr: Tuple[str, int], closed: threading.Event) -> socket.socket:
-    """create_connection with retry/backoff until _DIAL_TIMEOUT elapses."""
+    """create_connection with jittered exponential retry until
+    _DIAL_TIMEOUT elapses (utils/backoff.py): a dead peer costs a
+    bounded, decaying probe sequence — not a tight 5 Hz loop — and
+    concurrent dialers racing a restarting listener don't stampede it
+    in lockstep."""
     deadline = time.monotonic() + _DIAL_TIMEOUT
+    delays = Backoff(base=_DIAL_RETRY_DELAY, factor=1.7, max_delay=1.0,
+                     retries=64, seed=hash(addr) & 0xFFFF).delays()
     while True:
         try:
             sock = socket.create_connection(addr, timeout=_DIAL_TIMEOUT)
@@ -100,7 +114,8 @@ def _dial(addr: Tuple[str, int], closed: threading.Event) -> socket.socket:
         except OSError:
             if closed.is_set() or time.monotonic() >= deadline:
                 raise
-            time.sleep(_DIAL_RETRY_DELAY)
+            delay = next(delays, _DIAL_RETRY_DELAY)
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
 
 
 def _normalize(addr: str) -> str:
@@ -820,8 +835,14 @@ class TcpTransport(Transport):
             "payload": message.to_payload(),
         }
         # A cached connection may have died (peer restart): evict and
-        # re-dial once.  The reference poisons the conn forever.
-        for attempt in (0, 1):
+        # re-dial with bounded jittered backoff (utils/backoff.py) —
+        # the reference poisons the conn forever; the pre-backoff code
+        # here retried exactly once, immediately, which a failover
+        # window (leader seat rebinding) routinely outlasted.
+        delays = Backoff(base=0.05, factor=2.0, max_delay=0.8,
+                         retries=_SEND_RETRIES,
+                         seed=hash(dest) & 0xFFFF).delays()
+        for attempt in range(_SEND_RETRIES + 1):
             pconn = self._get_or_connect(dest)
             if pconn is None:
                 self._queue.put(message)  # self-send short-circuit
@@ -832,8 +853,9 @@ class TcpTransport(Transport):
                 return
             except OSError:
                 self._evict(dest, pconn)
-                if attempt == 1:
+                if attempt >= _SEND_RETRIES:
                     raise
+                time.sleep(next(delays, 0.05))
 
     def _send_layer_pooled(self, dest: str, message: LayerMsg) -> None:
         """One layer transfer over pooled data connection(s).
@@ -867,9 +889,20 @@ class TcpTransport(Transport):
     def _send_one_stream(self, dest: str, message: LayerMsg,
                          stripe: Optional[dict] = None) -> None:
         """One byte stream (a whole payload, or one stripe of one) over a
-        pooled data connection, with the stale-connection retry."""
-        for attempt in (0, 1):
-            fresh = attempt == 1
+        pooled data connection, with the stale-connection retry: attempt
+        0 uses a pooled conn (free to fail — the peer may have restarted
+        while it idled), later attempts dial FRESH with jittered
+        exponential backoff (utils/backoff.py) before the OSError
+        surfaces.  A half-sent fragment on a dead connection is harmless
+        — the receiver drops partial bodies on connection error, and
+        interval reassembly tolerates the re-send."""
+        delays = Backoff(base=0.05, factor=2.0, max_delay=0.8,
+                         retries=_SEND_RETRIES,
+                         seed=(hash(dest) ^ message.layer_id) & 0xFFFF
+                         ).delays()
+        for attempt in range(_SEND_RETRIES + 1):
+            fresh = attempt > 0
+            last = attempt >= _SEND_RETRIES
             sock = None
             try:
                 sock = (self._dial_data(dest) if fresh
@@ -878,8 +911,9 @@ class TcpTransport(Transport):
             except OSError:
                 if sock is not None:
                     sock.close()  # state unknown: never pool a broken conn
-                if fresh:
+                if last:
                     raise
+                time.sleep(next(delays, 0.05))
                 continue
             except Exception:
                 # Non-socket failure (e.g. an unserveable LayerSrc) can

@@ -127,14 +127,23 @@ class ClaimedCoverage:
     NOT itself thread-safe: callers mutate it under their own lock — the
     point is precisely that the byte movement happens OUTSIDE that lock,
     bracketed by claim/commit.
+
+    Tokens are PROCESS-unique (one shared counter), not per-instance:
+    claim tokens travel outside their coverage object (a transport
+    sink's placed fragments carry them through the delivery queue), and
+    a receiver replaced on a live transport (declared-dead revival) can
+    drain a predecessor's queued tokens — per-instance counters would
+    let such a foreign token collide with a live claim and commit bytes
+    that never landed.  A foreign token now pops nothing, ever.
     """
 
-    __slots__ = ("_covered", "_inflight", "_tok")
+    __slots__ = ("_covered", "_inflight")
+
+    _TOKENS = itertools.count()  # process-unique: see docstring
 
     def __init__(self, covered: Optional[List[Interval]] = None):
         self._covered: List[Interval] = list(covered or [])
         self._inflight: Dict[int, List[Interval]] = {}
-        self._tok = itertools.count()
 
     def claim(self, start: int, end: int):
         """Reserve the uncovered subranges of ``[start, end)``.  Returns
@@ -145,7 +154,7 @@ class ClaimedCoverage:
             return None, []
         for lo, hi in ranges:
             self._covered = insert(self._covered, lo, hi)
-        tok = next(self._tok)
+        tok = next(ClaimedCoverage._TOKENS)
         self._inflight[tok] = ranges
         return tok, ranges
 

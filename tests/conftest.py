@@ -79,6 +79,21 @@ def pytest_collection_modifyitems(items):
 TIER1_TEST_BUDGET_S = 30.0
 _test_durations: dict = {}  # nodeid -> [summed seconds, is_slow-marked]
 
+# Seeded-chaos bookkeeping: tests register their fault-schedule seed (or
+# whole spec) via the ``chaos_seed`` fixture; a FAILING chaos test then
+# prints it in the terminal summary, so the run replays bit-for-bit from
+# the seed instead of being an unreproducible flake report.
+_chaos_seeds: dict = {}  # nodeid -> seed/spec
+_chaos_failed: "set[str]" = set()
+
+
+@pytest.fixture
+def chaos_seed(request):
+    """Record the deterministic fault seed/spec driving this test."""
+    def _record(seed):
+        _chaos_seeds[request.node.nodeid] = seed
+    return _record
+
 
 def pytest_runtest_logreport(report):
     # Sum ALL phases (setup + call + teardown): a test whose cost lives
@@ -86,9 +101,17 @@ def pytest_runtest_logreport(report):
     rec = _test_durations.setdefault(report.nodeid, [0.0, False])
     rec[0] += report.duration
     rec[1] = rec[1] or "slow" in report.keywords
+    if report.failed and report.nodeid in _chaos_seeds:
+        _chaos_failed.add(report.nodeid)
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if _chaos_failed:
+        terminalreporter.section("failing chaos seeds (replay with these)")
+        for nodeid in sorted(_chaos_failed):
+            terminalreporter.write_line(
+                f"CHAOS SEED  {nodeid}  ->  {_chaos_seeds[nodeid]!r}",
+                red=True)
     if not _test_durations:
         return
     ranked = sorted(((d, n) for n, (d, _) in _test_durations.items()),

@@ -1,0 +1,675 @@
+"""Control-plane HA tests: replicated leader state, epoch-fenced
+failover, and range-level re-plan around dead sources (docs/failover.md).
+
+The scenarios the tentpole demands:
+
+- leader killed MID-RUN in every mode (0-3) on both backends: a standby
+  takes over at a bumped epoch and delivery completes byte-exactly with
+  digests verified;
+- a zombie ex-leader's control traffic is provably FENCED (the test
+  asserts the zombie actually sent, and that the stale message changed
+  nothing);
+- a crashed mode-3 SOURCE costs only its unsent byte ranges (retransmit
+  counters < full layer size), via the PR-4 NACK retransmit plane;
+- a declared-dead receiver that restarts after a checkpoint-dir wipe
+  re-announces WITHOUT partials and the leader's stale partial_status is
+  superseded (leader.py's re-announce branch), byte-exact on tcp;
+- the seeded chaos smoke: modes 0 and 3 under reset+partition faults
+  with a deterministic leader kill (tier-1 fast; the failing seed prints
+  via the conftest hook), plus the slow leader-kill chaos soak.
+
+Leader-kill pattern: the leader's transport is wrapped in the seeded
+fault layer with an outbound-LAYER drop rule (a wedged NIC: control
+flows, layer bytes don't), so delivery is GUARANTEED to be in flight
+when ``leader.close()`` freezes the process — no sleep-based races on
+either backend.
+"""
+
+import queue
+import shutil
+import threading
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+)
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    LayerCheckpointStore,
+    LeaderNode,
+    Node,
+    PullRetransmitLeaderNode,
+    ReceiverNode,
+    RetransmitLeaderNode,
+    RetransmitReceiverNode,
+    ShadowLeaderState,
+    StandbyController,
+)
+from distributed_llm_dissemination_tpu.transport import reset_registry
+from distributed_llm_dissemination_tpu.transport.faults import (
+    FaultRule,
+    FaultyTransport,
+    rules_from_spec,
+)
+from distributed_llm_dissemination_tpu.transport.messages import (
+    ControlDeltaMsg,
+    LeaderLeaseMsg,
+    MsgType,
+    RetransmitMsg,
+    SourceDeadMsg,
+    StartupMsg,
+)
+from distributed_llm_dissemination_tpu.utils import integrity, trace
+from distributed_llm_dissemination_tpu.utils.backoff import Backoff, jitter_frac
+
+from test_node import close_all, layer_bytes, make_transports, mem_layer
+
+TIMEOUT = 15.0
+LEASE = 0.15          # leader lease beacon interval
+STANDBY_EXPIRY = 0.5  # rank-0 standby declares the leader dead after this
+HB = 0.1
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def _wait_for(cond, timeout=TIMEOUT, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _counters():
+    return dict(trace.counter_totals())
+
+
+def _delta(before, key):
+    return trace.counter_totals().get(key, 0) - before.get(key, 0)
+
+
+# ------------------------------------------------------------ unit pieces
+
+
+def test_backoff_deterministic_and_bounded():
+    b = Backoff(base=0.1, factor=2.0, max_delay=0.5, retries=5, seed=11)
+    d1, d2 = list(b.delays()), list(b.delays())
+    assert d1 == d2, "backoff must replay identically from its seed"
+    assert len(d1) == 5
+    raw = [0.1, 0.2, 0.4, 0.5, 0.5]
+    for got, cap in zip(d1, raw):
+        assert cap / 2 <= got < cap  # jitter scales into [1/2, 1) * base_k
+    assert list(Backoff(seed=1).delays()) != list(Backoff(seed=2).delays())
+    assert 0.0 <= jitter_frac(3, 4) < 1.0
+
+
+def test_backoff_run_retries_then_raises():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise OSError("nope")
+
+    slept = []
+    with pytest.raises(OSError):
+        Backoff(retries=3, seed=5).run(fn, sleep=slept.append)
+    assert len(calls) == 4  # initial + 3 retries
+    assert len(slept) == 3 and all(s > 0 for s in slept)
+
+
+def test_fault_spec_partition_and_kill_parse():
+    _, rules = rules_from_spec("partition=4@0.5-1.5,kill_after=2,resetany=3")
+    kinds = {r.kind: r for r in rules}
+    assert kinds["partition"].dest == 4
+    assert kinds["partition"].t_start == 0.5
+    assert kinds["partition"].t_end == 1.5
+    assert kinds["kill"].t_start == 2.0
+    assert kinds["reset"].msg_type is None  # resetany matches all types
+    _, rules = rules_from_spec("partition=7")
+    assert rules[0].t_start == 0.0 and rules[0].t_end is None
+
+
+def test_fault_partition_window_drops_both_directions():
+    ts, _ = make_transports("inmem", range(3))
+    f0 = FaultyTransport(
+        ts[0], [FaultRule("partition", "out", dest=1, t_start=0.0,
+                          t_end=0.3)])
+    # Outbound to the partitioned peer vanishes; to others it flows.
+    f0.send(1, StartupMsg(0))
+    f0.send(2, StartupMsg(0))
+    assert ts[1].deliver().qsize() == 0
+    assert ts[2].deliver().qsize() == 1
+    # Inbound from the partitioned peer vanishes too (via the pump).
+    ts[1].send(0, StartupMsg(1))
+    ts[2].send(0, StartupMsg(2))
+    deadline = time.monotonic() + 2.0
+    got = []
+    while time.monotonic() < deadline and len(got) < 1:
+        try:
+            got.append(f0.deliver().get(timeout=0.1))
+        except queue.Empty:
+            pass
+    assert [m.src_id for m in got] == [2]
+    assert f0.stats["partition"] >= 2
+    # The window HEALS: after t_end the pair exchanges traffic again.
+    time.sleep(0.35)
+    f0.send(1, StartupMsg(0))
+    assert ts[1].deliver().qsize() == 1
+    for t in list(ts.values()) + [f0]:
+        t.close()
+
+
+def test_fault_kill_after_hard_stops_transport():
+    ts, _ = make_transports("inmem", range(2))
+    f0 = FaultyTransport(ts[0], [FaultRule("kill", "out", t_start=0.15)])
+    f0.send(1, StartupMsg(0))  # pre-kill: flows
+    assert ts[1].deliver().qsize() == 1
+    time.sleep(0.2)
+    with pytest.raises(ConnectionError):
+        f0.send(1, StartupMsg(0))
+    ts[1].send(0, StartupMsg(1))  # inbound post-kill: vanishes
+    time.sleep(0.3)
+    assert f0.deliver().qsize() == 0
+    assert f0.stats["kill"] >= 2
+    for t in list(ts.values()) + [f0]:
+        t.close()
+
+
+def test_lease_and_delta_payload_roundtrip():
+    lease = LeaderLeaseMsg(3, 7, [1, 4], 0.25)
+    assert LeaderLeaseMsg.from_payload(lease.to_payload()) == lease
+    delta = ControlDeltaMsg(3, 7, 42, "ack",
+                            {"Node": 2, "Layer": 5, "Location": 0,
+                             "Size": 99})
+    assert ControlDeltaMsg.from_payload(delta.to_payload()) == delta
+    sd = SourceDeadMsg(0, 9, 4, 2, epoch=3)
+    assert SourceDeadMsg.from_payload(sd.to_payload()) == sd
+    # Epoch is an omitted field: HA-off messages keep the legacy wire.
+    assert "Epoch" not in RetransmitMsg(0, 1, 2).to_payload()
+    assert RetransmitMsg(0, 1, 2, epoch=0).to_payload()["Epoch"] == 0
+
+
+# --------------------------------------------------------- HA cluster rig
+
+
+def _build_ha_cluster(kind, mode, n_workers=2, layer_size=24 * 1024,
+                      worker_spec="", wedge_leader=True,
+                      standby_expiry=STANDBY_EXPIRY):
+    """Leader 0 (lease-beaconing, standby succession [1]) + standby 1
+    (holds replica copies of every assigned layer) + workers 2..  With
+    ``wedge_leader`` the leader's transport drops every outbound LAYER
+    frame (seeded fault layer): control flows, layer bytes don't — so a
+    later ``leader.close()`` is GUARANTEED to strike mid-delivery on
+    both backends, deterministically."""
+    ids = list(range(n_workers + 2))
+    raw, _ = make_transports(kind, ids)
+    ts = dict(raw)
+    if wedge_leader:
+        ts[0] = FaultyTransport(
+            raw[0], [FaultRule("drop", "out", msg_type=MsgType.LAYER)],
+            seed=1)
+    if worker_spec:
+        for i in range(2, n_workers + 2):
+            seed, rules = rules_from_spec(worker_spec)
+            ts[i] = FaultyTransport(raw[i], rules, seed=seed + i)
+    assignment = {w: {w - 2: LayerMeta()} for w in range(2, n_workers + 2)}
+    seed_layers = lambda: {i: mem_layer(i, layer_size)  # noqa: E731
+                           for i in range(n_workers)}
+    expected = set(ids[1:])
+    ha = dict(expected_nodes=expected, standbys=[1], lease_interval=LEASE,
+              epoch=0)
+    lnode = Node(0, 0, ts[0])
+    if mode == 0:
+        leader = LeaderNode(lnode, seed_layers(), assignment, **ha)
+    elif mode == 1:
+        leader = RetransmitLeaderNode(lnode, seed_layers(), assignment, **ha)
+    elif mode == 2:
+        leader = PullRetransmitLeaderNode(lnode, seed_layers(), assignment,
+                                          **ha)
+    else:
+        leader = FlowRetransmitLeaderNode(
+            lnode, seed_layers(), assignment,
+            {i: 10 ** 9 for i in ids}, **ha)
+    rcls = (ReceiverNode if mode == 0
+            else RetransmitReceiverNode if mode in (1, 2)
+            else FlowRetransmitReceiverNode)
+    standby = rcls(Node(1, 0, ts[1]), seed_layers(),
+                   heartbeat_interval=HB)
+    ctl = StandbyController(
+        standby, rank=0, lease_timeout=standby_expiry, standbys=[1],
+        mode=mode, node_network_bw={i: 10 ** 9 for i in ids},
+        failure_timeout=0.0, lease_interval=LEASE)
+    workers = [rcls(Node(w, 0, ts[w]), {}, heartbeat_interval=HB)
+               for w in range(2, n_workers + 2)]
+    return leader, standby, ctl, workers, ts, assignment
+
+
+def _close_ha(leader, standby, ctl, workers, ts):
+    ctl.close()
+    close_all(leader, [standby] + workers, ts)
+
+
+def _assert_ha_delivery(workers, assignment, kind, mode):
+    for w in workers:
+        for lid in assignment[w.node.my_id]:
+            src = w.layers.get(lid)
+            assert src is not None, (kind, mode, w.node.my_id, lid)
+            assert bytes(src.inmem_data) == layer_bytes(
+                lid, src.data_size), (kind, mode, lid)
+            expected = w._expected_digest(lid)
+            if expected is not None:
+                # "all layer digests verified": the stamped digest
+                # matched at the ack gate.
+                assert lid in w._digest_ok, (kind, mode, lid)
+
+
+# ------------------------------------------- leader killed mid-run (0-3)
+
+
+@pytest.mark.timeout(60)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+@pytest.mark.parametrize("mode", [0, 1, 2, 3])
+def test_leader_killed_mid_run_standby_takes_over(kind, mode):
+    """The acceptance scenario: leader dies with layer bytes still in
+    flight (its data plane is fault-wedged, so something is ALWAYS
+    undelivered at kill time); the standby must take over at a bumped
+    epoch and the promoted leader must complete delivery byte-exactly,
+    serving from its replica copies."""
+    before = _counters()
+    leader, standby, ctl, workers, ts, assignment = _build_ha_cluster(
+        kind, mode)
+    try:
+        standby.announce()
+        for w in workers:
+            w.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        # Let the control round-trips settle; the leader's own layer
+        # sends are dropping on the floor the whole time (wedged NIC).
+        time.sleep(0.4)
+        wedged = ts[0].stats["drop"]
+        assert wedged > 0, "leader sent no layers yet; kill not mid-run"
+        leader.close()  # the process freezes: no loop, no lease, no plans
+        _wait_for(ctl.promoted.is_set, what="standby promotion")
+        new_leader = ctl.leader
+        assert new_leader is not None and new_leader.epoch == 1
+        got = new_leader.ready().get(timeout=TIMEOUT)
+        assert set(got) == set(assignment)
+        for w in workers:
+            w.ready().get(timeout=TIMEOUT)
+        _assert_ha_delivery(workers, assignment, kind, mode)
+        assert _delta(before, "failover.takeover") >= 1
+        # Workers really switched: their heartbeats/acks follow id 1 now.
+        for w in workers:
+            assert w.node.leader_id == 1
+    finally:
+        _close_ha(leader, standby, ctl, workers, ts)
+
+
+# ------------------------------------------------------- zombie fencing
+
+
+@pytest.mark.timeout(60)
+def test_zombie_ex_leader_is_fenced_not_raced():
+    """A revived ex-leader (epoch 0) keeps commanding after the standby
+    took over at epoch 1: its control traffic must be REJECTED by every
+    worker.  Non-vacuous: the zombie's sends demonstrably reach the
+    workers (the fenced counter only advances on receipt), and the
+    stale RetransmitMsg provably changes nothing (its dest never gets
+    the layer)."""
+    before = _counters()
+    leader, standby, ctl, workers, ts, assignment = _build_ha_cluster(
+        "inmem", 1)
+    try:
+        standby.announce()
+        for w in workers:
+            w.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        time.sleep(0.3)
+        leader.close()
+        _wait_for(ctl.promoted.is_set, what="standby promotion")
+        ctl.leader.ready().get(timeout=TIMEOUT)
+        for w in workers:
+            w.ready().get(timeout=TIMEOUT)
+        _wait_for(lambda: all(w._leader_epoch >= 1 for w in workers),
+                  what="workers to observe the new epoch")
+        # The zombie rises: still believes it leads at epoch 0 and
+        # commands worker 2 to forward its layer 0 to worker 3 — a
+        # transfer the epoch-1 plan never asked for.
+        w2, w3 = workers[0], workers[1]
+        assert 0 in w2.layers and 0 not in w3.layers
+        ts[0].send(w2.node.my_id,
+                   RetransmitMsg(0, 0, w3.node.my_id, epoch=0))
+        ts[0].send(w2.node.my_id, StartupMsg(0, epoch=0))
+        _wait_for(lambda: _delta(before, "failover.fenced") >= 2,
+                  what="both stale messages to be fenced")
+        time.sleep(0.3)  # would-be forward time
+        # The stale command changed nothing: no rogue transfer happened.
+        assert 0 not in w3.layers
+        _assert_ha_delivery(workers, assignment, "inmem", 1)
+    finally:
+        _close_ha(leader, standby, ctl, workers, ts)
+
+
+@pytest.mark.timeout(30)
+def test_alive_ex_leader_steps_down_on_higher_epoch_lease():
+    """Split-brain heal: an ex-leader that is still RUNNING (it was
+    partitioned, not dead) must depose itself the moment it sees a
+    higher-epoch lease instead of keeping its detector/lease alive."""
+    ts, _ = make_transports("inmem", range(2))
+    leader = LeaderNode(Node(0, 0, ts[0]), {0: mem_layer(0)},
+                        {1: {0: LayerMeta()}}, standbys=[1],
+                        lease_interval=0.1, epoch=0)
+    try:
+        ts[1].send(0, LeaderLeaseMsg(1, 5, [], 0.1))
+        _wait_for(lambda: leader._deposed, what="leader step-down")
+        assert trace.counter_totals().get("failover.deposed", 0) >= 1
+    finally:
+        leader.close()
+        for t in ts.values():
+            t.close()
+
+
+# ------------------------------------------- replication / shadow state
+
+
+@pytest.mark.timeout(30)
+def test_control_deltas_build_matching_shadow():
+    """The standby's shadow converges to the leader's control state via
+    snapshot + deltas: status rows, acks, digests, startup."""
+    leader, standby, ctl, workers, ts, assignment = _build_ha_cluster(
+        "inmem", 0, wedge_leader=False)
+    try:
+        standby.announce()
+        for w in workers:
+            w.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        _wait_for(lambda: ctl.shadow.have_snapshot, what="snapshot")
+        _wait_for(lambda: ctl.shadow.startup_sent, what="startup delta")
+
+        def rows_match():
+            with leader._lock:
+                want = {n: {l: (int(m.location), m.data_size)
+                            for l, m in row.items()}
+                        for n, row in leader.status.items()}
+            got = {n: {l: (int(m.location), m.data_size)
+                       for l, m in row.items()}
+                   for n, row in ctl.shadow.status.items()}
+            return want == got
+
+        _wait_for(rows_match, what="shadow status to converge")
+        assert ctl.shadow.mode == 0
+        assert set(ctl.shadow.assignment) == set(assignment)
+        if integrity.digests_enabled():
+            with leader._lock:
+                assert ctl.shadow.digests == leader.layer_digests
+    finally:
+        _close_ha(leader, standby, ctl, workers, ts)
+
+
+# ----------------------------------- range salvage around a dead source
+
+
+@pytest.mark.timeout(60)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_mode3_source_crash_salvages_only_uncovered_ranges(kind):
+    """A mode-3 source dies mid-layer.  The dest must re-fetch ONLY the
+    dead source's unsent byte ranges from the surviving holder (via the
+    NACK retransmit plane) — asserted through the retransmitted-bytes
+    counter: 0 < retransmitted < full layer size — and land byte-exact."""
+    before = _counters()
+    ids = [0, 1, 2, 3]
+    ts, _ = make_transports(kind, ids)
+    size = 64 * 1024
+    lid = 7
+    assignment = {3: {lid: LayerMeta()}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, assignment,
+        {i: 100_000_000 for i in ids},
+        expected_nodes={1, 2, 3}, failure_timeout=0.7,
+    )
+    # Zombie source: announces (rate 1 MB/s — the solver gives it a
+    # share), then never serves its jobs.
+    zombie = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]), {lid: mem_layer(lid, size, rate=1_000_000)},
+        start_loop=False)
+    alt = FlowRetransmitReceiverNode(
+        Node(2, 0, ts[2]), {lid: mem_layer(lid, size, rate=3_000_000)},
+        heartbeat_interval=HB)
+    dest = FlowRetransmitReceiverNode(Node(3, 0, ts[3]), {},
+                                      heartbeat_interval=HB)
+    try:
+        zombie.announce()
+        alt.announce()
+        dest.announce()
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == assignment
+        dest.ready().get(timeout=TIMEOUT)
+        src = dest.layers[lid]
+        assert bytes(src.inmem_data) == layer_bytes(lid, size)
+        assert _delta(before, "failover.range_salvage") >= 1
+        retransmitted = _delta(before, "integrity.retransmit_bytes")
+        assert 0 < retransmitted < size, (
+            f"salvage must cost only the dead source's unsent ranges, "
+            f"not the whole layer: {retransmitted} vs {size}")
+    finally:
+        close_all(leader, [zombie, alt, dest], ts)
+
+
+# ----------------------- declared-dead revival with wiped checkpoints
+
+
+@pytest.mark.timeout(60)
+def test_tcp_revival_after_checkpoint_wipe_supersedes_stale_partials(
+        tmp_path):
+    """A mode-3 receiver announces checkpointed partial coverage, gets
+    declared dead, and restarts AFTER its cache dir was wiped: its fresh
+    announce carries no partials, so the leader's stale partial_status
+    must be superseded (leader.handle_announce's no-partial branch) and
+    the whole layer re-sent — byte-exact, on the tcp backend."""
+    ids = [0, 1, 2]
+    ts, _ = make_transports("tcp", ids)
+    size = 16 * 1024
+    ckpt = str(tmp_path / "ckpt")
+    # Pre-populate a checkpoint: the dead incarnation had [0, 4096).
+    store = LayerCheckpointStore(ckpt)
+    frag = layer_bytes(5, size)[:4096]
+    store.write_fragment(
+        5, 0, frag, [(0, 4096)], size,
+        frag_crcs=[(0, 4096, integrity.fragment_crc(frag))])
+    assignment = {1: {5: LayerMeta()}, 2: {6: LayerMeta()}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]),
+        {5: mem_layer(5, size), 6: mem_layer(6, size)}, assignment,
+        {i: 10 ** 9 for i in ids},
+        expected_nodes={1, 2}, failure_timeout=0.5,
+    )
+    # First incarnation: restores the partial, announces it, then
+    # freezes (no heartbeats, no handlers) until declared dead.
+    dead = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                      checkpoint_dir=ckpt,
+                                      start_loop=False)
+    # Worker 2 heartbeats (so it stays live) but announces only AFTER
+    # the revival: the distribution start is gated on its announce,
+    # which pins the whole death/wipe/revive dance BEFORE any plan —
+    # deterministic on tcp, no timing races.
+    w2 = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {},
+                                    heartbeat_interval=HB)
+    w2.heartbeat.start()
+    revived = None
+    try:
+        dead.announce()
+        _wait_for(lambda: leader.partial_status.get(1),
+                  what="partial announce to register")
+        assert [tuple(iv) for iv in
+                leader.partial_status[1][5]["Covered"]] == [(0, 4096)]
+        _wait_for(lambda: leader.detector.is_dead(1),
+                  what="zombie to be declared dead")
+        # "Restart" after the cache dir was wiped: no partials survive.
+        shutil.rmtree(ckpt)
+        revived = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                             checkpoint_dir=ckpt,
+                                             heartbeat_interval=HB)
+        revived.announce()
+        _wait_for(lambda: not leader.detector.is_dead(1),
+                  what="revival")
+        w2.announce()
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert set(got) == {1, 2}
+        revived.ready().get(timeout=TIMEOUT)
+        # The stale checkpoint coverage was superseded, not resumed.
+        assert leader.partial_status.get(1) is None
+        assert not leader._dropped_assignment
+        # Byte-exact despite the wiped journal: the WHOLE layer was
+        # re-sent (nothing trusted the dead incarnation's 4 KiB claim).
+        assert bytes(revived.layers[5].inmem_data) == layer_bytes(5, size)
+        assert bytes(w2.layers[6].inmem_data) == layer_bytes(6, size)
+    finally:
+        close_all(leader, [dead] + ([revived] if revived else [])
+                  + ([w2] if w2 else []), ts)
+
+
+# ------------------------------------------------- seeded chaos (smoke)
+
+
+SMOKE_SEED = 5
+SMOKE_WORKER_SPEC = f"seed={SMOKE_SEED},resetany=6,times=2," \
+                    "partition=1@0.2-1.0"
+
+
+@pytest.mark.timeout(120)
+@pytest.mark.parametrize("mode", [0, 3])
+def test_chaos_smoke_leader_kill_with_partition(mode, monkeypatch,
+                                                chaos_seed):
+    """Tier-1 chaos smoke (seeded, deterministic — no sleeps deciding
+    outcomes): modes 0 and 3 on inmem under worker reset faults + a
+    worker<->standby partition window + a mid-run leader kill.  The
+    failover plane must still deliver byte-exactly; a failure prints
+    the seed via the conftest hook for bit-exact replay."""
+    chaos_seed(SMOKE_WORKER_SPEC)
+    monkeypatch.setenv("DLD_GAP_NACK_S", "0.4")
+    before = _counters()
+    leader, standby, ctl, workers, ts, assignment = _build_ha_cluster(
+        "inmem", mode, worker_spec=SMOKE_WORKER_SPEC)
+    try:
+        standby.announce()
+        for w in workers:
+            # An injected reset can strike the announce itself; the
+            # retry is part of the scenario.
+            for _ in range(3):
+                try:
+                    w.announce()
+                    break
+                except (OSError, ConnectionError):
+                    time.sleep(0.05)
+        leader.start_distribution().get(timeout=TIMEOUT)
+        time.sleep(0.4)
+        leader.close()
+        _wait_for(ctl.promoted.is_set, timeout=TIMEOUT,
+                  what="standby promotion")
+        ctl.leader.ready().get(timeout=30.0)
+        for w in workers:
+            w.ready().get(timeout=TIMEOUT)
+        _assert_ha_delivery(workers, assignment, "inmem", mode)
+        fired = sum(t.stats["reset"] + t.stats["partition"]
+                    for t in ts.values()
+                    if isinstance(t, FaultyTransport))
+        assert fired > 0, "chaos smoke fired no faults; vacuous"
+        assert _delta(before, "failover.takeover") >= 1
+    finally:
+        _close_ha(leader, standby, ctl, workers, ts)
+
+
+# ------------------------------------------- slow leader-kill chaos soak
+
+
+CHAOS_SPEC = "seed=2,corrupt=5,dropin=7,dup=6,times=4"
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+@pytest.mark.parametrize("mode", [0, 1, 2, 3])
+def test_chaos_soak_leader_kill_byte_exact(kind, mode, chaos_seed):
+    """The slow soak extension: a mid-run leader kill layered ON TOP of
+    the PR-4 corruption/drop/dup schedule, across modes 0-3 on both
+    backends.  Takeover + integrity plane together must still converge
+    byte-exact with digests verified."""
+    chaos_seed(CHAOS_SPEC)
+    before = _counters()
+    leader, standby, ctl, workers, ts, assignment = _build_ha_cluster(
+        kind, mode, n_workers=3, worker_spec=CHAOS_SPEC)
+    try:
+        standby.announce()
+        for w in workers:
+            w.announce()
+        leader.start_distribution().get(timeout=60.0)
+        time.sleep(0.4)
+        leader.close()
+        _wait_for(ctl.promoted.is_set, timeout=30.0,
+                  what="standby promotion")
+        ctl.leader.ready().get(timeout=120.0)
+        for w in workers:
+            w.ready().get(timeout=TIMEOUT)
+        _assert_ha_delivery(workers, assignment, kind, mode)
+        fired = sum(t.stats["corrupt"] + t.stats["drop"] + t.stats["dup"]
+                    for t in ts.values()
+                    if isinstance(t, FaultyTransport))
+        assert fired > 0, "fault schedule never fired; soak is vacuous"
+        assert _delta(before, "failover.takeover") >= 1
+    finally:
+        _close_ha(leader, standby, ctl, workers, ts)
+
+
+# --------------------------------------------------- shadow unit pieces
+
+
+def test_shadow_applies_deltas_without_snapshot_order():
+    s = ShadowLeaderState()
+    s.apply(ControlDeltaMsg(0, 0, 0, "ack",
+                            {"Node": 2, "Layer": 5, "Location": 0,
+                             "Size": 123}))
+    s.apply(ControlDeltaMsg(0, 0, 1, "partial",
+                            {"Node": 3,
+                             "Partial": {"9": {"Total": 100,
+                                               "Covered": [[0, 10]]}}}))
+    s.apply(ControlDeltaMsg(0, 0, 2, "partial", {"Node": 3,
+                                                 "Partial": None}))
+    s.apply(ControlDeltaMsg(0, 0, 3, "plan_seq", {"Seq": 17}))
+    s.apply(ControlDeltaMsg(0, 0, 4, "plan_seq", {"Seq": 11}))
+    assert s.status[2][5].data_size == 123
+    assert 3 not in s.partial
+    assert s.plan_seq == 17  # monotonic: a late lower seq never rewinds
+    assert not s.have_snapshot
+    out = s.export()
+    assert out["status"][2][5].data_size == 123
+
+
+def test_shadow_crash_delta_moves_assignment_to_dropped():
+    s = ShadowLeaderState()
+    s.apply(ControlDeltaMsg(0, 0, 0, "snapshot", {
+        "Mode": 3,
+        "Assignment": {"4": {"7": LayerMeta().to_json()}},
+        "Status": {"4": {"7": LayerMeta().to_json()}},
+        "Partial": {}, "Dropped": {}, "Digests": {},
+        "PlanSeq": 3, "StartupSent": False,
+        "NetworkBw": {"4": 1000}, "FailureTimeout": 1.5,
+        "BootEnabled": False,
+    }))
+    s.apply(ControlDeltaMsg(0, 0, 1, "crash",
+                            {"Node": 4,
+                             "Dropped": {"7": LayerMeta().to_json()}}))
+    assert 4 not in s.status and 4 not in s.assignment
+    assert 7 in s.dropped[4]
+    assert s.mode == 3 and s.network_bw == {4: 1000}
+    assert s.failure_timeout == 1.5 and s.boot_enabled is False
