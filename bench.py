@@ -76,6 +76,36 @@ def ingest_once(total, frags, devices):
 
 
 PROBE_ATTEMPT_TIMEOUT_S = 75.0
+# The probe child announces each phase before entering it, so a TIMEOUT
+# attributes to the phase that never finished instead of reading as an
+# undiagnosable hang (the r04-r05 records carried exactly that).  The
+# diagnosis this instrumentation produced on this container is recorded
+# in BENCH_NOTES.md: `import jax` completes in ~2 s; it is the DEVICES
+# phase — accelerator plugin discovery, which blocks with no timeout
+# when the relay tunnel doesn't answer — that hangs.
+PROBE_CODE = (
+    "import time, sys\n"
+    "print('PHASE import', flush=True)\n"
+    "import jax\n"
+    "print('PHASE devices', flush=True)\n"
+    "jax.devices()\n"
+    "print('PHASE backend', flush=True)\n"
+    "print(jax.default_backend())\n"
+)
+
+
+def _probe_phase(stdout) -> str:
+    """The last phase the probe child ENTERED (its marks are printed
+    before each step), i.e. the one a timeout is stuck in."""
+    if not stdout:
+        return "spawn"
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode(errors="replace")
+    phase = "spawn"
+    for line in stdout.splitlines():
+        if line.startswith("PHASE "):
+            phase = line.split(None, 1)[1].strip()
+    return phase
 # Fast-failure probes (rc != 0 in seconds — a plugin/config error, which
 # sometimes clears when a racing sibling releases the device) may retry
 # across this budget.  A TIMEOUT never retries: a wedged tunnel holds for
@@ -153,30 +183,39 @@ def ensure_live_backend() -> tuple:
         probe_t0 = time.monotonic()
         while True:
             t0 = time.monotonic()
+            phase = ""
             try:
                 probe = subprocess.run(
-                    [sys.executable, "-c",
-                     "import jax; jax.devices(); "
-                     "print(jax.default_backend())"],
+                    [sys.executable, "-u", "-c", PROBE_CODE],
                     timeout=PROBE_ATTEMPT_TIMEOUT_S, capture_output=True,
                     text=True,
                 )
-                lines = probe.stdout.strip().splitlines()
+                lines = [ln for ln in probe.stdout.strip().splitlines()
+                         if not ln.startswith("PHASE ")]
                 # Empty stdout on rc=0 is still a failed probe, not a
                 # crash.
                 backend = (lines[-1]
                            if probe.returncode == 0 and lines else "")
+                if not backend:
+                    phase = _probe_phase(probe.stdout)
                 outcome = backend or f"rc={probe.returncode}"
-            except subprocess.TimeoutExpired:
-                backend, outcome = "", "timeout"
-            attempts.append(
-                {"outcome": outcome,
-                 "seconds": round(time.monotonic() - t0, 1)})
+            except subprocess.TimeoutExpired as e:
+                # Partial stdout names the phase the child is stuck in —
+                # the attribution that makes a hung probe diagnosable
+                # (BENCH_NOTES.md records the finding).
+                backend = ""
+                phase = _probe_phase(e.stdout)
+                outcome = f"timeout:{phase}"
+            rec = {"outcome": outcome,
+                   "seconds": round(time.monotonic() - t0, 1)}
+            if phase:
+                rec["phase"] = phase
+            attempts.append(rec)
             if backend:
                 _clear_probe_cache()
                 os.environ["_BENCH_BACKEND"] = backend
                 return backend, attempts
-            if (outcome == "timeout"
+            if (outcome.startswith("timeout")
                     or time.monotonic() - probe_t0 > PROBE_BUDGET_S):
                 break
             time.sleep(PROBE_RETRY_PAUSE_S)

@@ -107,6 +107,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "this many seconds answering GenerateReqMsg "
                         "inference requests (cli.genreq) from the "
                         "resident params; 0 = exit after boot as before")
+    p.add_argument("-report", type=str, default="",
+                   help="write RUN_REPORT.{json,md} at this path/prefix "
+                        "when the run completes (cli/report.py): TTD/"
+                        "TTFT, the per-(src,dest) link flight-recorder "
+                        "table, integrity/failover event counts, clock "
+                        "offsets, provenance hash.  Leader flag; a "
+                        "receiver that assumed leadership mid-run "
+                        "honors it too, so a failover run still yields "
+                        "a report")
+    p.add_argument("-watch", type=float, default=0.0,
+                   help="leader: log the folded cluster telemetry table "
+                        "('cluster telemetry' records) every N seconds "
+                        "mid-run — the live where-is-every-byte status "
+                        "hook (0: off; one dump always fires at "
+                        "delivery)")
     p.add_argument("-lease", type=float, default=1.0,
                    help="control-plane HA (docs/failover.md; only active "
                         "when the config declares Standbys): the leader's "
@@ -241,10 +256,58 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
         f"id: {args.id}, filename: {args.f}, storagePath: {args.s}, mode: {args.m}]",
         flush=True,
     )
+    if args.watch > 0:
+        # Mid-run status hook: the folded cluster table lands in the
+        # log stream every interval (daemon — dies with the process).
+        import threading as _threading
+
+        def _watch_loop():
+            while True:
+                time.sleep(args.watch)
+                try:
+                    leader.log_cluster_metrics()
+                except Exception as e:  # noqa: BLE001 — advisory hook
+                    ulog.log.debug("cluster metrics watch failed",
+                                   err=repr(e))
+
+        _threading.Thread(target=_watch_loop, daemon=True,
+                          name="telemetry-watch").start()
+
+    ttft = None
+    t_ready_mono = None
+
+    def write_run_report(ttd_s):
+        """RUN_REPORT.{json,md} from the leader's folded cluster
+        telemetry — written on every exit path that has a TTD, so a
+        failed boot still leaves the evidence behind."""
+        if not args.report:
+            return
+        from . import report as report_mod
+
+        # Freshness gate: receivers flush a final snapshot on startup;
+        # wait (bounded) until every known node's report post-dates the
+        # ready event so a fast run's report carries completion totals.
+        if t_ready_mono is not None:
+            leader.await_metrics(newer_than=t_ready_mono)
+        # One more dump with the final fold, so OFFLINE reconstruction
+        # from this process's log gets completion totals too.
+        leader.log_cluster_metrics()
+        try:
+            rep = report_mod.build_from_leader(leader, ttd_s=ttd_s,
+                                               ttft_s=ttft)
+            paths = report_mod.write_report(rep, args.report)
+        except OSError as e:
+            ulog.log.error("run report write failed", err=repr(e))
+            return
+        ulog.log.info("run report written", **paths)
+        print(f"Run report: {paths['json']} "
+              f"(provenance {paths['provenance']})", flush=True)
+
     leader.start_distribution().get()
     t0 = time.monotonic()
     leader.ready().get()
-    ttd = time.monotonic() - t0
+    t_ready_mono = time.monotonic()
+    ttd = t_ready_mono - t0
     ulog.log.info("Time to deliver", seconds=round(ttd, 6))
     print(f"Time to deliver: {ttd:.6f}s", flush=True)
     pred_ms = getattr(leader, "predicted_ttd_ms", 0)
@@ -273,6 +336,7 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
             ulog.log.error("boot wait timed out; missing reports",
                            booted=sorted(leader.boots_seen()))
             print(f"Boot wait timed out after {args.bw:g}s", flush=True)
+            write_run_report(ttd)
             return 1
         ttft = time.monotonic() - t0
         kinds = leader.boot_kinds()
@@ -284,7 +348,9 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
                         if k in ("failed", "crashed"))
         if failed:
             print(f"Boot FAILED on nodes {failed}", flush=True)
+            write_run_report(ttd)
             return 1
+    write_run_report(ttd)
     return 0
 
 
@@ -433,6 +499,22 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
         ulog.log.info("this process assumed leadership during the run",
                       epoch=leader.epoch)
         print(f"assumed leadership (epoch {leader.epoch})", flush=True)
+        if args.report:
+            # The dead leader can't write its RUN_REPORT; the adopted
+            # one can — its cluster table was replicated before the
+            # takeover and refreshed by every node's cumulative reports
+            # since (TTD is the dead leader's clock and stays unset).
+            from . import report as report_mod
+
+            try:
+                rep = report_mod.build_from_leader(leader)
+                paths = report_mod.write_report(rep, args.report)
+                ulog.log.info("run report written by adopted leader",
+                              **paths)
+                print(f"Run report: {paths['json']} "
+                      f"(provenance {paths['provenance']})", flush=True)
+            except OSError as e:
+                ulog.log.error("run report write failed", err=repr(e))
     ulog.log.info("received startup: ready")
     if fabric is not None or args.hbm:
         # Executable-reuse evidence for this process's device plane

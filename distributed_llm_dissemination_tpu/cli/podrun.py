@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-gen", type=int, default=0,
                    help="after a servable pipeline boot, greedy-decode "
                         "this many tokens across the pod (KV-cached)")
+    p.add_argument("-report", type=str, default="",
+                   help="write RUN_REPORT.{json,md} at this path/prefix "
+                        "when the run completes (cli/report.py)")
     p.add_argument("-v", action="store_true", help="output debug messages")
     return p
 
@@ -86,7 +89,7 @@ def fabric_bandwidths(conf: cfg.Config) -> Dict[int, int]:
 
 def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
             timeout: float = 600.0, gen: int = 0,
-            on_delivered=None) -> Dict[str, float]:
+            on_delivered=None, report: str = "") -> Dict[str, float]:
     """Drive one full pod dissemination; returns the timing summary.
 
     Callable from tests/benchmarks; the fabric and placement span every
@@ -157,13 +160,21 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
         # sampled at ready (before any boot compiles muddy the water):
         # the ttd_matrix fabric row reads these out of the summary line.
         from ..parallel import plan_cache
+        from ..utils import telemetry as utelemetry
         from ..utils import trace as utrace
 
         plan_cache.log_stats()
+        # The whole pod lives in this ONE process, so the process
+        # registry IS the cluster's flight recorder: counters +
+        # histograms ride the summary line (ttd_matrix embeds them in
+        # its rows), and the links feed the run report below.
+        tel_snap = utelemetry.snapshot()
         summary = {"mode": mode, "ttd_s": round(ttd, 6),
                    "nodes": len(node_ids), "fabric": True,
                    "collective_cache": plan_cache.stats(),
-                   "plan_phases": utrace.phase_totals()}
+                   "plan_phases": utrace.phase_totals(),
+                   "telemetry": {"counters": tel_snap.get("counters"),
+                                 "hists": tel_snap.get("hists")}}
         pred_ms = getattr(leader, "predicted_ttd_ms", 0)
         if pred_ms:
             # Mode-3 plan fidelity next to the achieved TTD.
@@ -207,6 +218,15 @@ def run_pod(conf: cfg.Config, mode: int = 3, boot: str = "",
             # Harvest hook (cli.train): read the DELIVERED layer stores
             # while the nodes are still alive; runs before any close.
             on_delivered(leader, receivers)
+        if report:
+            from . import report as report_mod
+
+            rep = report_mod.build_from_leader(
+                leader, ttd_s=ttd, ttft_s=summary.get("ttft_s"))
+            paths = report_mod.write_report(rep, report)
+            summary["run_report"] = paths["provenance"]
+            print(f"Run report: {paths['json']} "
+                  f"(provenance {paths['provenance']})", flush=True)
         print(json.dumps(summary), flush=True)
         return summary
     finally:
@@ -222,7 +242,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     ulog.configure(node="pod", verbose=args.v)
     conf = cfg.read_json(args.f)
-    run_pod(conf, mode=args.m, boot=args.boot, gen=max(0, args.gen))
+    run_pod(conf, mode=args.m, boot=args.boot, gen=max(0, args.gen),
+            report=args.report)
     return 0
 
 

@@ -80,7 +80,26 @@ _INSTANT_MESSAGES = {
     "job assignment calculated (topology)",
     "job assignment calculated (topology LP)",
     "topology solve degraded to flat replan",
+    # Telemetry plane (docs/observability.md):
+    "clock offset estimated",
+    "cluster telemetry",
 }
+
+
+def clock_offsets(records) -> dict:
+    """Per-node clock offsets (leader clock MINUS node clock, ms) from
+    the nodes' announce-time TimeSync estimates ("clock offset
+    estimated" records, runtime/receiver.py).  A node that logged
+    several (re-announce after a restart or takeover) keeps the LAST —
+    its clock may have been corrected, and the most recent probe is the
+    freshest estimate."""
+    offsets: dict = {}
+    for rec in records:
+        if rec.get("message") == "clock offset estimated":
+            off = rec.get("offset_ms")
+            if isinstance(off, (int, float)):
+                offsets[rec.get("node", "?")] = float(off)
+    return offsets
 
 
 def _layer_of(rec: dict):
@@ -90,7 +109,19 @@ def _layer_of(rec: dict):
     return None
 
 
-def to_trace_events(records: Iterable[dict]) -> List[dict]:
+def to_trace_events(records: Iterable[dict],
+                    align_clocks: bool = True) -> List[dict]:
+    """Chrome trace events from merged log records.
+
+    ``align_clocks`` (default on) applies each node's announce-time
+    clock-offset estimate ("clock offset estimated" records) to ALL of
+    that node's timestamps, so multi-HOST timelines — where wall clocks
+    can disagree by hundreds of ms — line up on the leader's clock
+    instead of rendering receives before their sends.  Nodes without an
+    estimate (the leader itself, pre-telemetry logs) pass through
+    unshifted, which is exactly the old behavior."""
+    records = list(records)
+    offsets = clock_offsets(records) if align_clocks else {}
     events: List[dict] = []
     seen_pids = set()
     for rec in records:
@@ -99,6 +130,9 @@ def to_trace_events(records: Iterable[dict]) -> List[dict]:
         if msg is None or not isinstance(t, (int, float)):
             continue
         pid = rec.get("node", "?")
+        # offset = leader clock - node clock, so node time + offset is
+        # the event on the LEADER's timeline.
+        t = t + offsets.get(pid, 0.0)
         ts_us = t * 1000.0  # unix-ms -> µs
         layer = _layer_of(rec)
         tid = int(layer) if layer is not None else 0
@@ -159,9 +193,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("paths", nargs="+", help="log files or directories")
     p.add_argument("-o", "--output", default="-",
                    help="trace JSON output (default: stdout)")
+    p.add_argument("--raw-clocks", action="store_true",
+                   help="skip clock-offset correction (render each "
+                        "node's timestamps as logged)")
     args = p.parse_args(argv)
 
-    events = to_trace_events(iter_records(args.paths))
+    events = to_trace_events(iter_records(args.paths),
+                             align_clocks=not args.raw_clocks)
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     if args.output == "-":
         json.dump(doc, sys.stdout)

@@ -348,6 +348,11 @@ def run_matrix(scale: int, trials: int, modes=(0, 1, 2, 3),
                 if summary and summary.get("collective_cache"):
                     per_mode[str(mode)]["collective_cache"] = (
                         summary["collective_cache"])
+                if summary and summary.get("telemetry"):
+                    # Each pod run's counter/histogram snapshot rides its
+                    # row — event counts come from the run's own flight
+                    # recorder, not hand-collected greps.
+                    per_mode[str(mode)]["telemetry"] = summary["telemetry"]
                 if mode == 3:
                     # Plan fidelity: the last trial's solver prediction
                     # (deterministic across trials) next to achieved TTD.
@@ -1000,6 +1005,23 @@ def run_physical(timeout: float = 1200.0, trace_out: str = "",
             if ttft_m:
                 rec["ttft_s"] = round(float(ttft_m.group(1)), 4)
             try:
+                # The run's own RUN_REPORT (cli/report.py), built from
+                # the same per-node logs: the row embeds its provenance
+                # hash + folded event counters, so the integrity/
+                # failover numbers in this record are traceable to one
+                # report artifact instead of hand-collected.
+                from . import collect_logs as _cl
+                from . import report as report_mod
+
+                rep = report_mod.build_from_records(
+                    _cl.iter_records([logdir]))
+                rec["run_report"] = {
+                    "provenance": rep.get("provenance"),
+                    "counters": rep.get("counters"),
+                }
+            except Exception as e:  # noqa: BLE001 — report is a bonus
+                print(f"run report build failed: {e!r}", file=sys.stderr)
+            try:
                 rec["phases"] = _physical_phases(
                     os.path.join(logdir, "node2.jsonl"))
                 ph = rec["phases"]
@@ -1149,6 +1171,12 @@ def run_failover(layer_bytes: int = 96 << 20, n_workers: int = 2,
             t.close()
 
     def one_run(kill_at_s=None):
+        # Run-scoped telemetry: both runs share this process, so each
+        # starts from a clean registry (the trace.py global-bleed fix) —
+        # the embedded counters below are THIS run's events only.
+        from ..utils import telemetry
+
+        telemetry.reset_run()
         leader, standby, ctl, workers, ts, assignment = build()
         try:
             standby.announce()
@@ -1188,6 +1216,19 @@ def run_failover(layer_bytes: int = 96 << 20, n_workers: int = 2,
                         raise AssertionError(
                             f"layer {lid} corrupt after failover")
             rec["byte_exact"] = True
+            # The row's event counts come from the run's own flight
+            # recorder + RUN_REPORT (cli/report.py) — the report is
+            # built from whichever leader FINISHED the run (the adopted
+            # one on the killed run: the replicated cluster picture is
+            # part of what this row evidences).
+            from . import report as report_mod
+
+            live = ctl.leader if kill_at_s is not None else leader
+            rep = report_mod.build_from_leader(live,
+                                               ttd_s=rec["total_s"])
+            rec["telemetry"] = telemetry.snapshot().get("counters")
+            rec["run_report"] = rep.get("provenance")
+            rec["report_links"] = len(rep.get("links") or [])
             return rec
         finally:
             teardown(leader, standby, ctl, workers, ts)
@@ -1209,6 +1250,83 @@ def run_failover(layer_bytes: int = 96 << 20, n_workers: int = 2,
         "killed": killed,
         "overhead_s": round(killed["total_s"] - clean["total_s"], 4),
     }
+
+
+def run_telemetry_overhead(scale: int = 64 << 20, trials: int = 3,
+                           scenario: str = "bench_8node_llama8b.json",
+                           mode: int = 0,
+                           timeout: float = 600.0) -> dict:
+    """The always-on telemetry plane's measured cost (docs/
+    observability.md acceptance): the same BASELINE scenario run with
+    the flight recorder + periodic reports ON (default) and OFF
+    (``DLD_TELEMETRY=0``), recorded as a TTD delta.  Medians over
+    ``trials``; the target is ≤2% — read with this container's CFS
+    drift error bar in mind (the markdown says so)."""
+    out: dict = {"scenario": f"{os.path.splitext(scenario)[0]}"
+                             f"@{scale >> 20}MiB",
+                 "mode": mode, "trials": trials}
+    with tempfile.TemporaryDirectory() as td:
+        local = os.path.join(td, scenario)
+        _localize_config(os.path.join(CONF_DIR, scenario), local,
+                         scale_to=scale)
+        for label, env_val in (("on", "1"), ("off", "0")):
+            env = dict(os.environ)
+            env["DLD_TELEMETRY"] = env_val
+            ts = [run_once(local, mode, timeout, env=env)
+                  for _ in range(trials)]
+            out[label] = {"ttd_s": round(statistics.median(ts), 4),
+                          "all": [round(t, 4) for t in ts]}
+            print(f"telemetry {label}: TTD {out[label]['ttd_s']}s",
+                  file=sys.stderr, flush=True)
+    out["delta_frac"] = round(
+        (out["on"]["ttd_s"] - out["off"]["ttd_s"])
+        / max(out["off"]["ttd_s"], 1e-9), 4)
+    out["meets_2pct"] = out["delta_frac"] <= 0.02
+    return out
+
+
+def _telemetry_overhead_md(lines, results) -> None:
+    ov = results.get("telemetry_overhead")
+    if not ov:
+        return
+    spread_on = ov["on"]["all"]
+    spread = round((max(spread_on) - min(spread_on))
+                   / max(min(spread_on), 1e-9), 3)
+    lines += [
+        "## Always-on telemetry overhead (docs/observability.md)",
+        "",
+        f"The `{ov['scenario']}` BASELINE scenario (mode {ov['mode']}, "
+        f"median of {ov['trials']}) with the per-link flight recorder + "
+        "periodic MetricsReportMsg shipping ON vs OFF "
+        "(`DLD_TELEMETRY=0`).  The instrumented hot path is one dict "
+        "update under a lock per MiB-scale frame; the ≤2% acceptance "
+        "bar is judged on the TTD delta below, read against this "
+        "container's run-to-run CFS drift (the ON-arm trial spread is "
+        "the error bar):",
+        "",
+        "| telemetry | TTD | trials | delta | ≤2%? |",
+        "|---|---|---|---|---|",
+        f"| on | {ov['on']['ttd_s']}s | {ov['on']['all']} | "
+        f"{ov['delta_frac']:+.1%} | "
+        f"{'yes' if ov['meets_2pct'] else 'NO'} |",
+        f"| off (`DLD_TELEMETRY=0`) | {ov['off']['ttd_s']}s | "
+        f"{ov['off']['all']} | — | — |",
+        "",
+        f"(on-arm trial spread: {spread:.1%} of the fastest trial.)",
+        "",
+    ]
+    if ov["delta_frac"] < -0.02:
+        lines += [
+            "A negative delta this large is NOT telemetry making the "
+            "run faster — it is the container's CFS burst-budget drift "
+            "dwarfing the effect under measurement (the per-arm trial "
+            "spreads above are of the same order).  The honest "
+            "conclusion is: the overhead is indistinguishable from "
+            "zero at this host's noise floor, which satisfies the ≤2% "
+            "bar; re-measure on quiet multi-core hardware for a tight "
+            "number.",
+            "",
+        ]
 
 
 def _failover_md(lines, results) -> None:
@@ -1238,6 +1356,16 @@ def _failover_md(lines, results) -> None:
         f"{k['kill_at_s']}s | {k['ttr_s']}s | {k['takeover_s']}s | "
         f"{k['byte_exact']} |")
     lines.append("")
+    if fo["killed"].get("run_report"):
+        lines.append(
+            "Event counts for both rows come from each run's own "
+            "telemetry snapshot; the killed run's RUN_REPORT was built "
+            "from the ADOPTED leader (provenance "
+            f"`{fo['killed']['run_report']}`, "
+            f"{fo['killed'].get('report_links', '?')} link rows — the "
+            "replicated cluster picture surviving the takeover is part "
+            "of what this row evidences).")
+        lines.append("")
     lines.append(
         f"Failover overhead vs clean: **{fo['overhead_s']}s** "
         f"(lease interval {fo['lease_interval_s']}s, standby expiry "
@@ -1781,6 +1909,7 @@ def to_markdown(results: dict) -> str:
                     + (f"{rec['solve_ms']}ms" if "solve_ms" in rec
                        else "—") + " |")
         lines.append("")
+    _telemetry_overhead_md(lines, results)
     _failover_md(lines, results)
     return "\n".join(lines)
 
@@ -1803,6 +1932,10 @@ def main(argv=None) -> int:
     p.add_argument("-trace", type=str, default="",
                    help="with -physical: also write a Chrome trace of "
                         "the run (merged per-node logs) to this path")
+    p.add_argument("-telemetry-overhead", action="store_true",
+                   help="also measure the always-on telemetry plane's "
+                        "TTD cost on a BASELINE scenario (ON vs "
+                        "DLD_TELEMETRY=0; docs/observability.md)")
     p.add_argument("-failover", action="store_true",
                    help="also measure control-plane failover at "
                         "physical-row sizes: clean HA-armed mode-3 run "
@@ -1925,6 +2058,10 @@ def main(argv=None) -> int:
         for key in ("physical", "physical_fabric"):
             if prior_doc and prior_doc.get(key):
                 results[key] = prior_doc[key]
+    if args.telemetry_overhead:
+        results["telemetry_overhead"] = run_telemetry_overhead()
+    elif prior_doc and prior_doc.get("telemetry_overhead"):
+        results["telemetry_overhead"] = prior_doc["telemetry_overhead"]
     if args.failover:
         results["failover"] = run_failover()
     elif prior_doc and prior_doc.get("failover"):

@@ -153,6 +153,10 @@ class ShadowLeaderState:
         self.network_bw: Dict[NodeID, int] = {}
         self.failure_timeout = 0.0
         self.boot_enabled = True
+        # Telemetry plane (docs/observability.md): the leader's folded
+        # per-node metric snapshots ride replication too, so a takeover
+        # keeps the cluster picture instead of starting blind.
+        self.metrics: dict = {}
         self.have_snapshot = False
         self.deltas_applied = 0
 
@@ -176,6 +180,8 @@ class ShadowLeaderState:
                                    (d.get("NetworkBw") or {}).items()}
                 self.failure_timeout = float(d.get("FailureTimeout", 0.0))
                 self.boot_enabled = bool(d.get("BootEnabled", True))
+                self.metrics = {int(n): dict(s) for n, s in
+                                (d.get("Metrics") or {}).items()}
                 self.have_snapshot = True
             elif k == "status":
                 self.status[int(d["Node"])] = layer_ids_from_json(
@@ -211,6 +217,14 @@ class ShadowLeaderState:
                 self.startup_sent = bool(d.get("Sent", True))
             elif k == "plan_seq":
                 self.plan_seq = max(self.plan_seq, int(d.get("Seq", 0)))
+            elif k == "metrics":
+                self.metrics[int(d["Node"])] = {
+                    "counters": dict(d.get("Counters") or {}),
+                    "gauges": dict(d.get("Gauges") or {}),
+                    "links": dict(d.get("Links") or {}),
+                    "t_wall_ms": float(d.get("T", 0.0)),
+                    "proc": str(d.get("Proc", "")),
+                }
             else:
                 log.warn("unknown control delta kind", kind=k)
 
@@ -230,6 +244,7 @@ class ShadowLeaderState:
                 "network_bw": dict(self.network_bw),
                 "failure_timeout": self.failure_timeout,
                 "boot_enabled": self.boot_enabled,
+                "metrics": {n: dict(s) for n, s in self.metrics.items()},
                 "have_snapshot": self.have_snapshot,
             }
 

@@ -60,13 +60,15 @@ from ..transport.messages import (
     LayerMsg,
     LayerNackMsg,
     LeaderLeaseMsg,
+    MetricsReportMsg,
     PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
     SourceDeadMsg,
     StartupMsg,
+    TimeSyncMsg,
 )
-from ..utils import integrity, intervals, trace
+from ..utils import integrity, intervals, telemetry, trace
 from ..utils.logging import log
 from .checkpoint import map_through_gaps
 from .failover import (
@@ -251,6 +253,13 @@ class LeaderNode:
         self.replicator = (ControlReplicator(node, self.standbys)
                            if self.standbys else None)
 
+        # Telemetry plane (docs/observability.md): the latest cumulative
+        # MetricsReportMsg snapshot per node.  Replace-per-node fold (the
+        # snapshots are run-scoped cumulative), replicated to standbys so
+        # a takeover keeps the cluster picture; the leader's own process
+        # metrics are read live from the registry at fold time.
+        self.cluster_metrics: Dict[NodeID, dict] = {}
+
         if integrity.digests_enabled():
             threading.Thread(target=self._compute_own_digests,
                              name="layer-digests", daemon=True).start()
@@ -390,6 +399,8 @@ class LeaderNode:
         reg(PlanResendReqMsg, self.handle_plan_resend)
         reg(LayerNackMsg, self.handle_layer_nack)
         reg(LeaderLeaseMsg, self.handle_leader_lease)
+        reg(MetricsReportMsg, self.handle_metrics_report)
+        reg(TimeSyncMsg, self.handle_time_sync)
 
     # --------------------------------------------------- control-plane HA
 
@@ -493,6 +504,13 @@ class LeaderNode:
                     self, "node_network_bw", {}).items()},
                 "FailureTimeout": self.detector._timeout,
                 "BootEnabled": self.boot_enabled,
+                # Private bookkeeping ("_recv_mono": THIS process's
+                # monotonic clock) must not cross the wire — a standby
+                # restoring it would compare a foreign clock against
+                # its own in await_metrics.
+                "Metrics": {str(n): {k: v for k, v in s.items()
+                                     if not k.startswith("_")}
+                            for n, s in self.cluster_metrics.items()},
             }
 
     def _send_snapshot_to(self, standby: NodeID) -> None:
@@ -531,6 +549,11 @@ class LeaderNode:
                                         shadow["dropped"].items()}
             for lid, dg in shadow["digests"].items():
                 self.layer_digests.setdefault(lid, dg)
+            # The replicated telemetry picture survives the takeover:
+            # the dead leader's fold is the starting table, and every
+            # live node's next cumulative report simply replaces its row.
+            self.cluster_metrics = {n: dict(s) for n, s in
+                                    shadow.get("metrics", {}).items()}
             self._plan_seq = itertools.count(shadow["plan_seq"])
             self._plan_seq_hint = shadow["plan_seq"]
             self._started = True
@@ -655,6 +678,109 @@ class LeaderNode:
                                       epoch=self.epoch))
         except (OSError, KeyError) as e:
             log.warn("digest stamp send failed", dest=dest, err=repr(e))
+
+    # ------------------------------------------------------ telemetry plane
+
+    def handle_time_sync(self, msg: TimeSyncMsg) -> None:
+        """Answer a node's clock probe with this leader's wall clock —
+        the reference clock multi-host traces align on (docs/
+        observability.md).  Replies (another seat answering a probe this
+        leader never sent) are ignored."""
+        if msg.reply:
+            return
+        try:
+            self.node.transport.send(
+                msg.src_id,
+                TimeSyncMsg(self.node.my_id, msg.t0_ms,
+                            t1_ms=time.time() * 1000.0, reply=True))
+        except (OSError, KeyError) as e:
+            log.debug("time-sync reply send failed", dest=msg.src_id,
+                      err=repr(e))
+
+    def handle_metrics_report(self, msg: MetricsReportMsg) -> None:
+        """Fold one node's cumulative telemetry snapshot into the
+        cluster table.  Epoch-fenced: a reporter still pointing at a
+        dead predecessor (its epoch is below this leader's) is stale
+        by definition — its next lease observation re-points it and the
+        following report carries the same cumulative totals, so nothing
+        is lost by dropping the stale one."""
+        if 0 <= msg.epoch < self.epoch:
+            trace.count("telemetry.fenced_report")
+            return
+        snap = {"counters": msg.counters, "gauges": msg.gauges,
+                "links": msg.links, "t_wall_ms": msg.t_wall_ms,
+                "proc": msg.proc, "_recv_mono": time.monotonic()}
+        with self._lock:
+            self.cluster_metrics[msg.src_id] = snap
+        self._replicate("metrics", Node=msg.src_id,
+                        Counters=msg.counters, Gauges=msg.gauges,
+                        Links=msg.links, T=msg.t_wall_ms, Proc=msg.proc)
+
+    def await_metrics(self, newer_than: float = 0.0,
+                      timeout: float = 5.0) -> bool:
+        """Block until every node in status has reported a metrics
+        snapshot received after ``newer_than`` (monotonic), or the
+        timeout elapses — the -report path's freshness gate, so a fast
+        run's report isn't written from pre-completion snapshots.
+        Receivers flush a final report on startup, so the common wait
+        is one control round-trip, not a report interval."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                peers = set(self.status) - {self.node.my_id}
+                fresh = {n for n, s in self.cluster_metrics.items()
+                         if s.get("_recv_mono", 0.0) >= newer_than}
+            if peers <= fresh:
+                return True
+            if time.monotonic() >= deadline:
+                log.warn("metrics reports missing at report time",
+                         missing=sorted(peers - fresh))
+                return False
+            time.sleep(0.05)
+
+    def cluster_telemetry(self) -> dict:
+        """The folded cluster view: per-node snapshots (the leader's own
+        process read live from the registry), cluster-summed counters,
+        and the per-(src, dest) link table with each field taken from
+        the endpoint that owns it (utils/telemetry.fold_links).  This is
+        what the -watch hook logs mid-run and what cli/report.py renders
+        into RUN_REPORT."""
+        own = telemetry.snapshot()
+        own_gauges = dict(own.get("gauges") or {})
+        for name, rec in (own.get("phases") or {}).items():
+            own_gauges[f"phase.{name}_ms"] = rec["ms"]
+        with self._lock:
+            reports = {n: {k: v for k, v in s.items()
+                           if not k.startswith("_")}
+                       for n, s in self.cluster_metrics.items()}
+        reports[self.node.my_id] = {
+            "proc": own.get("proc", ""),
+            "counters": own.get("counters") or {},
+            "gauges": own_gauges,
+            "links": own.get("links") or {},
+            # A live registry read is by definition the freshest view
+            # of this process — it must beat any shipped report from a
+            # co-resident node in the per-process counter fold.
+            "t_wall_ms": time.time() * 1000.0,
+        }
+        return {
+            "nodes": reports,
+            "counters": telemetry.fold_counters(reports),
+            "links": telemetry.fold_links(reports),
+        }
+
+    def log_cluster_metrics(self) -> dict:
+        """Log (and return) the folded cluster table — the mid-run
+        status hook behind ``cli.main -watch`` and the end-of-run dump
+        the offline run report is built from."""
+        table = self.cluster_telemetry()
+        log.info("cluster telemetry",
+                 nodes=sorted(table["nodes"]),
+                 counters=table["counters"],
+                 links=table["links"],
+                 gauges={str(n): s.get("gauges") or {}
+                         for n, s in table["nodes"].items()})
+        return table
 
     def handle_generate_req(self, msg: GenerateReqMsg) -> None:
         """The leader seat serves no model — refuse immediately so a
@@ -1398,6 +1524,12 @@ class LeaderNode:
         log.info("timer stop: startup")
         self._replicate("startup", Sent=True)
         self.send_startup()
+        # End-of-delivery telemetry dump: the folded cluster table goes
+        # into the log stream (the single source of truth the offline
+        # run report and trace tooling read).  Reports are periodic, so
+        # the last interval's bytes may still be in flight — the
+        # -report path re-folds later, at process exit.
+        self.log_cluster_metrics()
         self._ready_q.put(self.assignment)
         # Startup may have been unblocked by crashes that already emptied
         # the boot wait's remaining set (every assignee dead before

@@ -27,6 +27,16 @@ class Node:
         self.my_id = my_id
         self.leader_id = leader_id
         self.transport = transport
+        # Bind this node's identity onto the transport so its per-frame
+        # accounting can file rx bytes under a (src, MY id) link in the
+        # telemetry flight recorder (utils/telemetry.py) — the transport
+        # otherwise only knows addresses.  Advisory: a transport used
+        # without a Node (raw tests) records nothing rather than
+        # misfiling bytes.
+        try:
+            transport.node_id = my_id
+        except AttributeError:  # a wrapper may proxy it read-only
+            pass
         self.routing_table: Dict[NodeID, RoutingInfo] = {}
         self._lock = threading.Lock()
         if my_id != leader_id:

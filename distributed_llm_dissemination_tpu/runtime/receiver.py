@@ -48,13 +48,22 @@ from ..transport.messages import (
     LayerMsg,
     LayerNackMsg,
     LeaderLeaseMsg,
+    MetricsReportMsg,
     PlanResendReqMsg,
     RetransmitMsg,
     ServeMsg,
     SourceDeadMsg,
     StartupMsg,
+    TimeSyncMsg,
 )
-from ..utils import env as env_util, hostmem, integrity, intervals, trace
+from ..utils import (
+    env as env_util,
+    hostmem,
+    integrity,
+    intervals,
+    telemetry,
+    trace,
+)
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
 from .checkpoint import LayerCheckpointStore
@@ -315,6 +324,21 @@ class ReceiverNode:
             node.transport, node.my_id, node.leader_id, heartbeat_interval,
             leader_fn=lambda: self.node.leader_id,
         )
+        # Telemetry plane (docs/observability.md): periodic run-scoped
+        # metric snapshots to the leader (MetricsReportMsg; cumulative,
+        # so a lost report costs staleness, never skew), started with
+        # the first announce; and the clock-offset estimate from the
+        # announce-time TimeSyncMsg round trip (leader clock minus this
+        # node's — logged so cli/trace.py aligns multi-host timelines).
+        self.clock_offset_ms = None
+        self._metrics_stop = threading.Event()
+        self._metrics_thread = None
+        try:
+            interval = float(os.environ.get("DLD_METRICS_INTERVAL_S",
+                                            "2.0"))
+        except ValueError:
+            interval = 2.0
+        self._metrics_interval = interval if telemetry.enabled() else 0.0
         # Corrupt-fragment reports (a frame the transport dropped for a
         # failed CRC, an injected drop, or a TTL-pruned stripe group)
         # become bounded NACKs to the fragment's source.
@@ -334,6 +358,7 @@ class ReceiverNode:
         self.loop.register(GenerateReqMsg, self.handle_generate_req)
         self.loop.register(LayerDigestsMsg, self.handle_layer_digests)
         self.loop.register(LeaderLeaseMsg, self.handle_leader_lease)
+        self.loop.register(TimeSyncMsg, self.handle_time_sync)
 
     # ------------------------------------------------- control-plane HA
 
@@ -469,6 +494,85 @@ class ReceiverNode:
                         partial=self._announce_partial(),
                         digests=self._announce_digests()),
         )
+        # Telemetry plane: probe the leader's clock (request/response
+        # midpoint → the offset cli/trace.py aligns timelines with) and
+        # start the periodic metric reports.  Both advisory: a lost
+        # probe or report costs observability, never delivery.
+        try:
+            self.node.transport.send(
+                self.node.leader_id,
+                TimeSyncMsg(self.node.my_id, _time.time() * 1000.0))
+        except (OSError, KeyError) as e:
+            log.debug("time-sync probe send failed", err=repr(e))
+        self._start_metrics_reporter()
+
+    # ------------------------------------------------------ telemetry plane
+
+    def handle_time_sync(self, msg: TimeSyncMsg) -> None:
+        """Both halves of the clock-offset probe.  A REQUEST is answered
+        with this node's wall clock (any seat can answer; the leader's
+        answer is the one that matters, and after a takeover the
+        promoted worker answers with the new reference clock).  A REPLY
+        closes this node's own probe: offset = t1 - (t0 + t2)/2, the
+        NTP midpoint estimate, error-bounded by rtt/2 — both logged, so
+        the offline trace tooling has what it needs."""
+        now = _time.time() * 1000.0
+        if not msg.reply:
+            try:
+                self.node.transport.send(
+                    msg.src_id,
+                    TimeSyncMsg(self.node.my_id, msg.t0_ms, t1_ms=now,
+                                reply=True))
+            except (OSError, KeyError) as e:
+                log.debug("time-sync reply send failed", dest=msg.src_id,
+                          err=repr(e))
+            return
+        rtt_ms = now - msg.t0_ms
+        if rtt_ms < 0:
+            return  # this process's own clock stepped mid-probe
+        offset_ms = msg.t1_ms - (msg.t0_ms + now) / 2.0
+        self.clock_offset_ms = offset_ms
+        telemetry.gauge("clock_offset_ms", offset_ms)
+        log.info("clock offset estimated", offset_ms=round(offset_ms, 3),
+                 rtt_ms=round(rtt_ms, 3), reference=msg.src_id)
+
+    def _start_metrics_reporter(self) -> None:
+        if self._metrics_interval <= 0 or self._metrics_thread is not None:
+            return
+        self._metrics_thread = threading.Thread(
+            target=self._metrics_loop, daemon=True,
+            name=f"metrics-{self.node.my_id}")
+        self._metrics_thread.start()
+
+    def _metrics_loop(self) -> None:
+        while not self._metrics_stop.wait(self._metrics_interval):
+            self._send_metrics_report()
+
+    def _send_metrics_report(self) -> None:
+        """One cumulative run-scoped snapshot to the current leader.
+        Best-effort by design (NOT the requeue path — a stale metric is
+        worthless by the time a failover window drains): a failed send
+        is simply superseded by the next interval's snapshot."""
+        if self._closed_evt.is_set():
+            return
+        snap = telemetry.snapshot()
+        gauges = dict(snap.get("gauges") or {})
+        # Phase buckets ride as flat gauges so the leader's fold (and
+        # the run report's cluster phase table) sees per-node phase
+        # totals without a second wire vocabulary.
+        for name, rec in (snap.get("phases") or {}).items():
+            gauges[f"phase.{name}_ms"] = rec["ms"]
+        with self._lock:
+            epoch = self._leader_epoch
+        msg = MetricsReportMsg(
+            self.node.my_id, counters=snap.get("counters") or {},
+            gauges=gauges, links=snap.get("links") or {},
+            t_wall_ms=_time.time() * 1000.0, epoch=epoch,
+            proc=snap.get("proc", ""))
+        try:
+            self.node.transport.send(self.node.leader_id, msg)
+        except (OSError, KeyError) as e:
+            log.debug("metrics report send failed", err=repr(e))
 
     # ------------------------------------------------------- integrity plane
 
@@ -582,6 +686,7 @@ class ReceiverNode:
     def _send_nack(self, src_id, layer_id, offset, size, total,
                    reason) -> None:
         trace.count("integrity.nack_sent")
+        telemetry.link_add(src_id, self.node.my_id, nacks=1)
         log.warn("layer fragment NACKed", layerID=layer_id, src=src_id,
                  offset=offset, bytes=size, reason=reason)
         try:
@@ -651,6 +756,7 @@ class ReceiverNode:
 
     def close(self) -> None:
         self._closed_evt.set()
+        self._metrics_stop.set()
         self.heartbeat.stop()
         self.loop.stop()
         if self._boot_stager is not None:
@@ -767,6 +873,7 @@ class ReceiverNode:
                                         msg.total_size, msg.total_size,
                                         "digest")
                     return
+            stored = False
             with self._lock:
                 src = self.layers.get(msg.layer_id)
                 if src is None:
@@ -774,6 +881,14 @@ class ReceiverNode:
                     src.meta = LayerMeta(location=LayerLocation.INMEM)
                     src.offset = 0
                     self.layers[msg.layer_id] = src
+                    stored = True
+            if stored:
+                # Flight recorder: bytes COMMITTED to the store (a
+                # re-plan duplicate records nothing), so per-link
+                # delivered totals reconcile byte-exactly against the
+                # goal state in the run report.
+                telemetry.link_add(msg.src_id, self.node.my_id,
+                                   delivered_bytes=src.data_size)
         log.debug("saved layer in memory", layerID=msg.layer_id)
         loc = self._stage_to_hbm(msg.layer_id, src)
         # Streamed boot staging: this layer's decode + device placement
@@ -1472,6 +1587,12 @@ class ReceiverNode:
         if self._fence_stale(msg):
             return
         self.expect_serve = msg.serve  # before ready(): the CLI reads it
+        # Delivery is done: flush a FINAL cumulative metrics snapshot
+        # now (the periodic cadence could lag a fast run by a whole
+        # interval, and the leader's -report fold wants completion-time
+        # totals, not the last tick's).
+        if self._metrics_interval > 0:
+            self._send_metrics_report()
         # Overlap accounting: precompiles/streamed stagings that finish
         # after this point no longer ran during the wire.
         self._startup_seen.set()
@@ -2214,6 +2335,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             except Exception as e:  # noqa: BLE001 — delivery beats staging
                 self._ingest_write_failed(lid, ing, e)
                 ing = None
+            else:
+                telemetry.link_add(msg.src_id, self.node.my_id,
+                                   place_s=t_ing)
         if tok is not None and not placed:
             try:
                 t_cp = _time.monotonic()
@@ -2227,6 +2351,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     ph = self._phase.get(lid)
                     if ph is not None:
                         ph["copy_s"] += t_cp
+                telemetry.link_add(msg.src_id, self.node.my_id,
+                                   place_s=t_cp)
             except Exception:
                 with self._lock:
                     cov.abort(tok)
@@ -2236,6 +2362,14 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             # by the transport): record the coverage with the ingest.
             for lo, hi in claims:
                 ing.mark(lo, hi)
+        if tok is not None and claims:
+            # Flight recorder: exactly the NEW bytes this fragment's
+            # claims landed (duplicates and overlaps claim nothing), so
+            # per-link delivered totals reconcile byte-exactly against
+            # delivered layer bytes in the run report.
+            telemetry.link_add(
+                msg.src_id, self.node.my_id,
+                delivered_bytes=sum(hi - lo for lo, hi in claims))
         complete = self._commit_fragment(lid, tok, msg.total_size)
         if journal and not complete:
             # (The completing fragment skips the journal: its completion

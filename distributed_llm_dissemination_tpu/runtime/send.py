@@ -22,7 +22,7 @@ from ..core.types import (
     NodeID,
 )
 from ..transport.messages import ClientReqMsg, FlowRetransmitMsg, LayerMsg
-from ..utils import trace
+from ..utils import telemetry, trace
 from ..utils.logging import log
 from ..utils.rate import TokenBucket
 from .node import Node
@@ -164,6 +164,8 @@ class NackRetransmitter:
                  attempt=n)
         trace.count("integrity.retransmit_frags")
         trace.count("integrity.retransmit_bytes", size)
+        telemetry.link_add(node.my_id, msg.src_id,
+                           retransmit_frames=1, retransmit_bytes=size)
         node.transport.send(
             msg.src_id,
             LayerMsg(node.my_id, msg.layer_id, sub, layer.data_size),

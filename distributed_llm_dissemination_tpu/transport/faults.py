@@ -416,3 +416,13 @@ class FaultyTransport(Transport):
     @property
     def addr_registry(self):
         return self.inner.addr_registry
+
+    @property
+    def node_id(self):
+        return getattr(self.inner, "node_id", None)
+
+    @node_id.setter
+    def node_id(self, value) -> None:
+        # The INNER transport does the per-frame telemetry accounting
+        # (utils/telemetry.py), so the node identity must land there.
+        self.inner.node_id = value

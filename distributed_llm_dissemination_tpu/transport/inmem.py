@@ -17,7 +17,7 @@ import threading
 from typing import Dict, Optional
 
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
-from ..utils import integrity, trace
+from ..utils import integrity, telemetry, trace
 from ..utils.logging import log
 from .base import AddrRegistry, Transport
 from .messages import LayerMsg, Message
@@ -56,6 +56,9 @@ class InmemTransport(Transport):
         # failed check — the receiver runtime NACKs the source from it.
         self.recv_tamper = None
         self.on_corrupt = None
+        # Telemetry identity (utils/telemetry.py): bound by
+        # runtime.node.Node; None = record nothing.
+        self.node_id = None
         with _registry_lock:
             _registry[addr] = self
 
@@ -104,6 +107,11 @@ class InmemTransport(Transport):
                 crc = value
         if not self._frame_ok(message, data, crc, xxh3):
             return
+        # The verified frame lands on the (src, me) link of the flight
+        # recorder — in-process there is no wire to wait on, so only
+        # bytes/frames are filed (verify time is filed by _frame_ok).
+        telemetry.link_add(message.src_id, self.node_id,
+                           rx_bytes=len(data), rx_frames=1)
         landed = LayerSrc(
             inmem_data=data,
             data_size=len(data),
@@ -153,21 +161,28 @@ class InmemTransport(Transport):
             t0 = _time.thread_time()
             ok = integrity.verify_stamp(data, crc=crc, xxh3=xxh3)
             if ok is not None:
-                trace.add_phase("integrity_crc_recv",
-                                _time.thread_time() - t0)
+                dt = _time.thread_time() - t0
+                trace.add_phase("integrity_crc_recv", dt)
+                telemetry.link_add(message.src_id, self.node_id,
+                                   verify_s=dt)
                 if not ok:
                     reason = "crc"
         if reason is None:
             return True
         integrity.report_corrupt_frame(
             self.on_corrupt, message.src_id, message.layer_id,
-            src.offset, len(data), message.total_size, reason)
+            src.offset, len(data), message.total_size, reason,
+            dest_id=self.node_id)
         return False
 
     # -- Transport API ------------------------------------------------------
 
     def send(self, dest_id: NodeID, message: Message) -> None:
         self._resolve(dest_id)._deliver_local(message)
+        if isinstance(message, LayerMsg):
+            telemetry.link_add(message.src_id, dest_id,
+                               tx_bytes=message.layer_src.data_size,
+                               tx_frames=1)
 
     def broadcast(self, message: Message) -> None:
         with _registry_lock:

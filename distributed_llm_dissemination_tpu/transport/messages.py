@@ -89,6 +89,19 @@ class MsgType(enum.IntEnum):
     # the named layer to the surviving ``alt_id`` holder (the PR-4
     # byte-range retransmit plane) instead of waiting for a whole-layer
     # re-send — recovery costs only the dead source's unsent bytes.
+    # METRICS_REPORT — telemetry plane (docs/observability.md): a node's
+    # periodic run-scoped metric snapshot (counters + per-link flight
+    # recorder + gauges), folded by the leader into the cluster table
+    # that the -watch hook and the RUN_REPORT render.  Epoch-stamped so
+    # a failed-over cluster fences reporters still pointing at a dead
+    # leader's run view; omitted-field wire-compatible (every section is
+    # optional, an empty report is a liveness-sized envelope).
+    # TIME_SYNC — telemetry plane: the request/response clock-offset
+    # probe.  A node sends its wall clock (t0) at announce time; the
+    # answering leader echoes it with its own wall clock (t1); the node
+    # estimates offset = t1 - (t0 + t2)/2 (NTP's midpoint) and LOGS it,
+    # so cli/trace.py can line multi-host Perfetto timelines up on the
+    # leader's clock without any cross-host time sync daemon.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -102,6 +115,8 @@ class MsgType(enum.IntEnum):
     LEADER_LEASE = 18
     CONTROL_DELTA = 19
     SOURCE_DEAD = 20
+    METRICS_REPORT = 21
+    TIME_SYNC = 22
 
 
 def _epoch_to_payload(payload: dict, epoch: int) -> dict:
@@ -836,6 +851,101 @@ class SourceDeadMsg:
                    int(d["AltID"]), int(d.get("Epoch", -1)))
 
 
+@dataclasses.dataclass
+class MetricsReportMsg:
+    """Node → leader: one run-scoped telemetry snapshot (docs/
+    observability.md).  ``counters``/``gauges`` are flat name→number
+    maps; ``links`` is ``{"src->dest": {field: number}}`` — the node's
+    view of each link it touched (``utils/telemetry.py`` owns the field
+    vocabulary and the rx/tx ownership split the leader folds by).
+    Snapshots are CUMULATIVE for the run (the registry is run-scoped),
+    so the leader's fold is replace-per-node — a lost report costs
+    staleness, never skew, and a freshly promoted leader reconstructs
+    the whole table from one report round.  ``epoch``: the leader epoch
+    this reporter believes in (-1 = HA off); a failed-over leader fences
+    reports from nodes still pointing at its dead predecessor."""
+
+    src_id: NodeID
+    counters: dict = dataclasses.field(default_factory=dict)
+    gauges: dict = dataclasses.field(default_factory=dict)
+    links: dict = dataclasses.field(default_factory=dict)
+    t_wall_ms: float = 0.0
+    epoch: int = -1
+    # The reporter's process token (telemetry.PROC_TOKEN): co-resident
+    # nodes share one registry, so the cluster counter fold counts one
+    # snapshot per distinct token, not per node.  Omitted-field
+    # compatible ("" = legacy reporter, counted per node).
+    proc: str = ""
+
+    msg_type = MsgType.METRICS_REPORT
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id}
+        if self.proc:
+            payload["Proc"] = str(self.proc)
+        if self.counters:
+            payload["Counters"] = {str(k): int(v)
+                                   for k, v in self.counters.items()}
+        if self.gauges:
+            payload["Gauges"] = {str(k): float(v)
+                                 for k, v in self.gauges.items()}
+        if self.links:
+            payload["Links"] = {
+                str(k): {str(f): v for f, v in row.items()}
+                for k, row in self.links.items()
+            }
+        if self.t_wall_ms:
+            payload["T"] = float(self.t_wall_ms)
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "MetricsReportMsg":
+        return cls(
+            int(d["SrcID"]),
+            {str(k): int(v)
+             for k, v in (d.get("Counters") or {}).items()},
+            {str(k): float(v)
+             for k, v in (d.get("Gauges") or {}).items()},
+            {str(k): dict(row)
+             for k, row in (d.get("Links") or {}).items()},
+            float(d.get("T", 0.0)),
+            int(d.get("Epoch", -1)),
+            str(d.get("Proc", "")),
+        )
+
+
+@dataclasses.dataclass
+class TimeSyncMsg:
+    """Clock-offset probe (docs/observability.md).  Request: a node
+    sends its wall clock as ``t0_ms``.  Response (``reply=True``): the
+    leader echoes ``t0_ms`` and stamps its own wall clock as ``t1_ms``;
+    the requester, reading its clock again as t2, estimates
+    ``offset = t1 - (t0 + t2) / 2`` — the leader-minus-me clock offset,
+    assuming a symmetric path (the error bound is rtt/2, logged next to
+    the estimate).  Purely advisory: nothing in the protocol consumes
+    the offset; it exists so the LOGS carry enough to align multi-host
+    trace timelines offline (cli/trace.py)."""
+
+    src_id: NodeID
+    t0_ms: float
+    t1_ms: float = 0.0
+    reply: bool = False
+
+    msg_type = MsgType.TIME_SYNC
+
+    def to_payload(self) -> dict:
+        payload = {"SrcID": self.src_id, "T0": float(self.t0_ms)}
+        if self.reply:
+            payload["T1"] = float(self.t1_ms)
+            payload["Reply"] = True
+        return payload
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "TimeSyncMsg":
+        return cls(int(d["SrcID"]), float(d.get("T0", 0.0)),
+                   float(d.get("T1", 0.0)), bool(d.get("Reply", False)))
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -855,6 +965,8 @@ Message = Union[
     LeaderLeaseMsg,
     ControlDeltaMsg,
     SourceDeadMsg,
+    MetricsReportMsg,
+    TimeSyncMsg,
 ]
 
 _DECODERS = {
@@ -878,6 +990,8 @@ _DECODERS = {
     MsgType.LEADER_LEASE: LeaderLeaseMsg,
     MsgType.CONTROL_DELTA: ControlDeltaMsg,
     MsgType.SOURCE_DEAD: SourceDeadMsg,
+    MsgType.METRICS_REPORT: MetricsReportMsg,
+    MsgType.TIME_SYNC: TimeSyncMsg,
 }
 
 

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
 from ..ops.reassembly import stripe_offsets
-from ..utils import integrity, trace
+from ..utils import integrity, telemetry, trace
 from ..utils.backoff import Backoff
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
@@ -251,6 +251,10 @@ class TcpTransport(Transport):
         # instead of waiting out crash detection.
         self.recv_tamper = None
         self.on_corrupt = None
+        # Telemetry identity (utils/telemetry.py): the node id whose
+        # (src, dest) links this transport's frame accounting files
+        # under.  Bound by runtime.node.Node; None = record nothing.
+        self.node_id = None
 
         host, port = _parse_addr(addr)
         self._listener = socket.create_server((host, port), reuse_port=False)
@@ -348,7 +352,21 @@ class TcpTransport(Transport):
         ``LayerNackMsg`` so the source retransmits the range."""
         integrity.report_corrupt_frame(
             self.on_corrupt, src_id, layer_id, offset, size, total,
-            reason, stripe=stripe, silent=silent)
+            reason, stripe=stripe, silent=silent, dest_id=self.node_id)
+
+    def _telemetry_rx(self, header: LayerHeader, dur_ms: float,
+                      crc_ms: float, placed: bool) -> None:
+        """File one VERIFIED received frame on the (src, me) link of the
+        flight recorder: wire bytes/frames, stripe occupancy, zero-copy
+        placement, and the wire-wait vs verify stall split.  Dropped
+        frames are filed by ``_notify_corrupt`` instead."""
+        telemetry.link_add(
+            header.src_id, self.node_id,
+            rx_bytes=header.layer_size, rx_frames=1,
+            rx_stripe_frames=1 if header.stripe_n > 1 else 0,
+            rx_placed_frames=1 if placed else 0,
+            wire_s=dur_ms / 1000.0, verify_s=crc_ms / 1000.0)
+        telemetry.observe_ms("tcp.rx_frame_ms", dur_ms)
 
     def _receive_layer(self, conn: socket.socket, envelope: dict) -> None:
         header = LayerHeader.from_payload(envelope["payload"])
@@ -384,6 +402,7 @@ class TcpTransport(Transport):
                 abort()
                 return
             dur_ms = (time.monotonic() - t0) * 1000
+            self._telemetry_rx(header, dur_ms, crc_ms, placed=True)
             log.info(
                 "(a fraction of) layer received",
                 layerID=header.layer_id,
@@ -429,6 +448,7 @@ class TcpTransport(Transport):
         if not ok:
             return
         dur_ms = (time.monotonic() - t0) * 1000
+        self._telemetry_rx(header, dur_ms, crc_ms, placed=False)
         log.info(
             "(a fraction of) layer received",
             layerID=header.layer_id,
@@ -746,9 +766,10 @@ class TcpTransport(Transport):
             del self._stripe_relays[key]
         return notices
 
-    @staticmethod
-    def _log_stripe(header: LayerHeader, t0: float, placed: bool,
+    def _log_stripe(self, header: LayerHeader, t0: float, placed: bool,
                     crc_ms: float = 0.0) -> None:
+        self._telemetry_rx(header, (time.monotonic() - t0) * 1000,
+                           crc_ms, placed=placed)
         log.info(
             "(a fraction of) layer received",
             layerID=header.layer_id,
@@ -826,7 +847,14 @@ class TcpTransport(Transport):
             raise KeyError(f"addr of {dest_id} does not exist")
 
         if isinstance(message, LayerMsg):
-            self._send_layer_pooled(dest, message)
+            streams = self._send_layer_pooled(dest, message)
+            # Sent without raising: file the frame(s) on the (src, dest)
+            # link — ``tx_stripe_frames / tx_frames`` is the run's
+            # average stripe occupancy for the link.
+            telemetry.link_add(
+                message.src_id, dest_id,
+                tx_bytes=message.layer_src.data_size, tx_frames=1,
+                tx_stripe_frames=streams if streams > 1 else 0)
             return
 
         envelope = {
@@ -857,8 +885,10 @@ class TcpTransport(Transport):
                     raise
                 time.sleep(next(delays, 0.05))
 
-    def _send_layer_pooled(self, dest: str, message: LayerMsg) -> None:
-        """One layer transfer over pooled data connection(s).
+    def _send_layer_pooled(self, dest: str, message: LayerMsg) -> int:
+        """One layer transfer over pooled data connection(s); returns
+        the number of concurrent streams the payload rode (1 =
+        un-striped) for the sender-side stripe-occupancy accounting.
 
         Payloads past ``STRIPE_THRESHOLD`` split into stripes riding
         several pooled connections CONCURRENTLY (``_send_layer_striped``)
@@ -883,8 +913,9 @@ class TcpTransport(Transport):
             spans = stripe_offsets(src.data_size, STRIPE_COUNT, STRIPE_MIN)
             if len(spans) > 1 and self._send_layer_striped(
                     dest, message, spans):
-                return
+                return len(spans)
         self._send_one_stream(dest, message)
+        return 1
 
     def _send_one_stream(self, dest: str, message: LayerMsg,
                          stripe: Optional[dict] = None) -> None:

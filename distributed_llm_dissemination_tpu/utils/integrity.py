@@ -223,14 +223,17 @@ def digest_matches(data, expected: str) -> bool:
 
 def report_corrupt_frame(on_corrupt, src_id, layer_id, offset: int,
                          size: int, total: int, reason: str,
-                         stripe: str = "", silent: bool = False) -> None:
+                         stripe: str = "", silent: bool = False,
+                         dest_id=None) -> None:
     """THE shared drop-report for both transports: one log wording (the
     ttd harness greps it), one counter scheme, one ``on_corrupt`` firing
     discipline — so inmem- and tcp-backed runs account corruption
     identically.  ``silent`` counts+logs without firing the hook (the
-    regroup path reports the whole span itself)."""
+    regroup path reports the whole span itself).  ``dest_id``: the
+    dropping transport's bound node id, so the drop also lands on the
+    (src, dest) link of the telemetry flight recorder."""
     from .logging import log
-    from . import trace
+    from . import telemetry, trace
 
     extra = {"stripe": stripe} if stripe else {}
     log.error("corrupt layer fragment dropped", layerID=layer_id,
@@ -240,6 +243,8 @@ def report_corrupt_frame(on_corrupt, src_id, layer_id, offset: int,
     else:
         trace.count("integrity.crc_drop")
         trace.count("integrity.crc_drop_bytes", size)
+        telemetry.link_add(src_id, dest_id, crc_drops=1,
+                           crc_drop_bytes=size)
     if silent:
         return
     fire_on_corrupt(on_corrupt, src_id, layer_id, offset, size, total,

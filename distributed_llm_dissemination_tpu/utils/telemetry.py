@@ -1,0 +1,305 @@
+"""Run-scoped telemetry: the cluster's flight recorder (docs/observability.md).
+
+Every perf and robustness bar so far was judged by reading process-local
+sums at run end and guessing at cross-node attribution.  This module is
+the one registry behind all of that accounting, with three properties the
+old ``utils/trace.py`` globals lacked:
+
+- **Run-scoped**: ``reset_run()`` clears everything (phases, counters,
+  gauges, histograms, links), so back-to-back runs in one process —
+  tests, a promoted standby, the future multi-job service — never
+  inherit each other's totals.  ``snapshot()`` is a cheap consistent
+  copy; a report is "the run so far", and deltas are snapshots diffed by
+  the consumer.
+- **Per-link flight recorder**: every (src, dest) node pair accumulates
+  bytes, frames, stripe occupancy, CRC drops, NACKs, retransmit bytes,
+  and stall attribution (wire-wait vs verify vs placement vs
+  decode/stage seconds).  Writers are the transports (wire-level frames)
+  and the receiver runtime (committed delivered bytes — the byte-exact
+  number a run report reconciles against the goal state).
+- **Always-on and cheap**: a dict update under one lock per frame-scale
+  event (frames are MiB-scale, so the accounting is noise — measured in
+  TTD_MATRIX.md's telemetry-overhead row).  ``DLD_TELEMETRY=0`` disables
+  the LINK recorder and histograms (the overhead A/B knob); phase
+  buckets and event counters stay on — pre-existing harness tables
+  depend on them.
+
+The registry feeds three consumers: ``MetricsReportMsg`` (periodic
+node → leader shipping, ``runtime/receiver.MetricsReporter``), the
+leader's cluster table (``runtime/leader.py``), and the one-command run
+report (``cli/report.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+from typing import Dict, Optional, Tuple
+
+# Process identity for snapshot folding: every snapshot carries this
+# token, and ``fold_counters`` counts ONE snapshot per distinct token.
+# Nodes sharing a process (podrun, the in-process harnesses, tests)
+# share ONE registry, so their per-node reports are cumulative views of
+# the SAME counters — summing them would multiply every cluster total
+# by the co-resident node count.  One-process-per-node deployments get
+# distinct tokens and the plain sum.
+PROC_TOKEN = f"{os.getpid():x}-{secrets.token_hex(4)}"
+
+# Fixed histogram bucket upper bounds, in milliseconds (the last bucket
+# is unbounded).  Power-of-4 spacing spans one frame's syscall (~1 ms)
+# to a wedged multi-minute stall in 9 buckets — coarse on purpose: the
+# histograms attribute hangs to a phase, they don't profile kernels.
+HIST_BUCKETS_MS: Tuple[float, ...] = (
+    1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0, 65536.0)
+
+# Per-link field ownership: each field is written by exactly ONE end of
+# the link (rx-ish fields by the dest's process, tx-ish by the src's),
+# so the leader's cluster fold can union two nodes' reports of the same
+# link without double-counting (runtime/leader.py, cli/report.py).
+LINK_RX_FIELDS = frozenset((
+    "rx_bytes", "rx_frames", "rx_stripe_frames", "rx_placed_frames",
+    "delivered_bytes", "crc_drops", "crc_drop_bytes", "nacks",
+    "wire_s", "verify_s", "place_s", "stage_s",
+))
+LINK_TX_FIELDS = frozenset((
+    "tx_bytes", "tx_frames", "tx_stripe_frames",
+    "retransmit_frames", "retransmit_bytes",
+))
+LINK_FIELDS = LINK_RX_FIELDS | LINK_TX_FIELDS
+
+
+def _links_enabled() -> bool:
+    """The always-on link recorder's kill switch (``DLD_TELEMETRY=0``) —
+    exists for the overhead A/B row in TTD_MATRIX.md, read per call so
+    tests can flip it without re-importing."""
+    return os.environ.get("DLD_TELEMETRY", "1") != "0"
+
+
+class Telemetry:
+    """One run's metric state.  All methods are thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        # name -> [sum_s, n]  (the trace.py phase buckets live here now)
+        self._phases: Dict[str, list] = {}
+        # name -> {"buckets": [..], "sum_ms": float, "n": int}
+        self._hists: Dict[str, dict] = {}
+        # (src, dest) -> {field: number}
+        self._links: Dict[Tuple[int, int], Dict[str, float]] = {}
+
+    # ------------------------------------------------------------ scalars
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        with self._lock:
+            rec = self._phases.get(name)
+            if rec is None:
+                rec = self._phases[name] = [0.0, 0]
+            rec[0] += seconds
+            rec[1] += 1
+
+    def observe_ms(self, name: str, ms: float) -> None:
+        """One fixed-bucket histogram sample (milliseconds)."""
+        if not _links_enabled():
+            return
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = {
+                    "buckets": [0] * (len(HIST_BUCKETS_MS) + 1),
+                    "sum_ms": 0.0, "n": 0}
+            idx = 0
+            for idx, bound in enumerate(HIST_BUCKETS_MS):
+                if ms <= bound:
+                    break
+            else:
+                idx = len(HIST_BUCKETS_MS)
+            h["buckets"][idx] += 1
+            h["sum_ms"] += ms
+            h["n"] += 1
+
+    # -------------------------------------------------------------- links
+
+    def link_add(self, src, dest, **fields) -> None:
+        """Accumulate numeric fields onto the (src, dest) link.  Unknown
+        src/dest (a transport without a bound node id) records nothing —
+        an unattributable byte is better dropped than misfiled."""
+        if src is None or dest is None or not _links_enabled():
+            return
+        key = (int(src), int(dest))
+        with self._lock:
+            link = self._links.get(key)
+            if link is None:
+                link = self._links[key] = {}
+            for name, v in fields.items():
+                if v:
+                    link[name] = link.get(name, 0) + v
+
+    # ---------------------------------------------------------- snapshots
+
+    def snapshot(self) -> dict:
+        """A consistent copy of the run so far — JSON-ready (link keys
+        serialized ``"src->dest"``, seconds rounded)."""
+        with self._lock:
+            return {
+                "proc": PROC_TOKEN,
+                "counters": dict(self._counters),
+                "gauges": {k: round(v, 3)
+                           for k, v in self._gauges.items()},
+                "phases": {name: {"ms": round(s * 1000, 1), "n": n}
+                           for name, (s, n) in sorted(self._phases.items())},
+                "hists": {name: {"buckets": list(h["buckets"]),
+                                 "sum_ms": round(h["sum_ms"], 1),
+                                 "n": h["n"]}
+                          for name, h in sorted(self._hists.items())},
+                "links": {
+                    f"{s}->{d}": {k: (round(v, 4) if isinstance(v, float)
+                                      else v)
+                                  for k, v in sorted(fields.items())}
+                    for (s, d), fields in sorted(self._links.items())
+                },
+            }
+
+    def counter_totals(self) -> dict:
+        with self._lock:
+            return dict(sorted(self._counters.items()))
+
+    def phase_totals(self) -> dict:
+        with self._lock:
+            return {name: {"ms": round(s * 1000, 1), "n": n}
+                    for name, (s, n) in sorted(self._phases.items())}
+
+    # -------------------------------------------------------------- reset
+
+    def reset_run(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._phases.clear()
+            self._hists.clear()
+            self._links.clear()
+
+    def reset_phases(self) -> None:
+        with self._lock:
+            self._phases.clear()
+
+    def reset_counters(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+# The process default registry.  One per process on purpose: a process
+# IS a node, and run scoping comes from reset_run() between runs (the
+# tests' autouse fixture, a harness's per-trial reset) — not from
+# threading registries through every call site.
+_default = Telemetry()
+
+
+def default() -> Telemetry:
+    return _default
+
+
+def count(name: str, n: int = 1) -> None:
+    _default.count(name, n)
+
+
+def gauge(name: str, value: float) -> None:
+    _default.gauge(name, value)
+
+
+def add_phase(name: str, seconds: float) -> None:
+    _default.add_phase(name, seconds)
+
+
+def observe_ms(name: str, ms: float) -> None:
+    _default.observe_ms(name, ms)
+
+
+def link_add(src, dest, **fields) -> None:
+    _default.link_add(src, dest, **fields)
+
+
+def snapshot() -> dict:
+    return _default.snapshot()
+
+
+def reset_run() -> None:
+    _default.reset_run()
+
+
+def enabled() -> bool:
+    return _links_enabled()
+
+
+# ------------------------------------------------------- cluster folding
+
+
+def fold_links(reports: Dict[int, dict],
+               local: Optional[dict] = None) -> Dict[str, dict]:
+    """Merge per-node snapshots' link tables into one cluster view.
+
+    Each (src, dest) link is reported by up to two nodes — the dest owns
+    the rx-ish fields, the src the tx-ish fields (LINK_*_FIELDS) — so
+    the fold takes each field from the endpoint that owns it; a field
+    reported by a non-owner (shouldn't happen) is kept only when the
+    owner never reported.  ``local``: the folding process's own
+    snapshot, merged like any node's report."""
+    out: Dict[str, dict] = {}
+
+    def merge(node_id, snap) -> None:
+        for key, fields in (snap.get("links") or {}).items():
+            try:
+                src_s, dest_s = key.split("->", 1)
+                src, dest = int(src_s), int(dest_s)
+            except ValueError:
+                continue
+            row = out.setdefault(key, {"src": src, "dest": dest})
+            for name, v in fields.items():
+                owner = (dest if name in LINK_RX_FIELDS
+                         else src if name in LINK_TX_FIELDS else None)
+                if owner is None or owner == node_id or name not in row:
+                    row[name] = v
+
+    for node_id, snap in sorted(reports.items()):
+        merge(node_id, snap)
+    if local is not None:
+        merge(None, local)  # owner unknown: fill gaps only
+    return out
+
+
+def fold_counters(reports: Dict[int, dict],
+                  local: Optional[dict] = None) -> Dict[str, int]:
+    """Sum event counters into cluster totals, counting ONE snapshot
+    per process (``PROC_TOKEN``): co-resident nodes report cumulative
+    views of the same shared registry, and summing those would multiply
+    every total by the node count.  Per process the FRESHEST snapshot
+    wins (max ``t_wall_ms``; a ``local`` live read beats any shipped
+    report from the same process).  Legacy reports without a token
+    count per node, the pre-token behavior."""
+    by_proc: Dict[object, dict] = {}
+
+    def admit(key, snap, force=False):
+        prior = by_proc.get(key)
+        if (force or prior is None
+                or snap.get("t_wall_ms", 0) >= prior.get("t_wall_ms", 0)):
+            by_proc[key] = snap
+
+    for node_id, snap in sorted(reports.items()):
+        admit(snap.get("proc") or ("node", node_id), snap)
+    if local is not None:
+        admit(local.get("proc") or ("local",), local, force=True)
+    out: Dict[str, int] = {}
+    for snap in by_proc.values():
+        for name, v in (snap.get("counters") or {}).items():
+            out[name] = out.get(name, 0) + int(v)
+    return dict(sorted(out.items()))

@@ -12,7 +12,6 @@ reference's jq pipeline.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 
 from .logging import log
@@ -52,6 +51,15 @@ def span(name: str, **fields):
 # and ``cli/ttd_matrix.py`` renders the fabric row's phase-breakdown
 # table from them.  Sums are thread-time: concurrent phases overlap, so
 # totals may exceed the run's wall clock (the tables say so).
+#
+# STORAGE lives in the run-scoped ``utils/telemetry.py`` registry now —
+# these functions are the stable writer API (every instrumented call
+# site keeps ``trace.add_phase``/``trace.count``), but the sums are no
+# longer process-global module state: ``telemetry.reset_run()`` clears
+# them between runs (the tests' autouse fixture, a promoted standby, a
+# harness's per-trial reset), and ``telemetry.snapshot()`` ships them in
+# MetricsReportMsg / RUN_REPORT.  ``reset_run`` is re-exported here for
+# writers that already import ``trace``.
 
 # TTFT buckets (the boot pipeline, ISSUE 3): writers in
 # ``runtime/receiver.py`` and ``runtime/stream_boot.py``; the
@@ -63,16 +71,12 @@ def span(name: str, **fields):
 # - ``boot_stream_in_wire``      the subset that ran before startup —
 #                                stage-overlap-achieved
 
-_phase_lock = threading.Lock()
-_phase_s: dict = {}
-_phase_n: dict = {}
+from . import telemetry as _telemetry  # noqa: E402  (storage backend)
 
 
 def add_phase(name: str, seconds: float) -> None:
     """Accumulate ``seconds`` into the named phase bucket."""
-    with _phase_lock:
-        _phase_s[name] = _phase_s.get(name, 0.0) + seconds
-        _phase_n[name] = _phase_n.get(name, 0) + 1
+    _telemetry.add_phase(name, seconds)
 
 
 @contextlib.contextmanager
@@ -88,17 +92,11 @@ def phase(name: str):
 
 def phase_totals() -> dict:
     """``{name: {"ms": summed_milliseconds, "n": samples}}`` so far."""
-    with _phase_lock:
-        return {
-            name: {"ms": round(s * 1000, 1), "n": _phase_n[name]}
-            for name, s in sorted(_phase_s.items())
-        }
+    return _telemetry.default().phase_totals()
 
 
 def reset_phases() -> None:
-    with _phase_lock:
-        _phase_s.clear()
-        _phase_n.clear()
+    _telemetry.default().reset_phases()
 
 
 # ------------------------------------------------------------ event counters
@@ -108,24 +106,25 @@ def reset_phases() -> None:
 # retransmitted, how many digests mismatched.  Same shape as the phase
 # buckets — in-process sums the harness reads at the end of a run — but
 # counting EVENTS, not seconds.  Writers: transport/tcp.py,
-# transport/inmem.py, runtime/receiver.py, runtime/send.py.
-
-_counter_lock = threading.Lock()
-_counters: dict = {}
+# transport/inmem.py, runtime/receiver.py, runtime/send.py.  Stored in
+# the run-scoped telemetry registry (see the phase-marker note above).
 
 
 def count(name: str, n: int = 1) -> None:
     """Add ``n`` to the named event counter."""
-    with _counter_lock:
-        _counters[name] = _counters.get(name, 0) + n
+    _telemetry.count(name, n)
 
 
 def counter_totals() -> dict:
     """``{name: total}`` so far."""
-    with _counter_lock:
-        return dict(sorted(_counters.items()))
+    return _telemetry.default().counter_totals()
 
 
 def reset_counters() -> None:
-    with _counter_lock:
-        _counters.clear()
+    _telemetry.default().reset_counters()
+
+
+def reset_run() -> None:
+    """Clear ALL run-scoped accounting (phases, counters, gauges,
+    histograms, per-link flight recorder) — the between-runs reset."""
+    _telemetry.reset_run()

@@ -29,6 +29,21 @@ import threading  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _run_scoped_telemetry():
+    """Every test starts from a CLEAN telemetry registry (utils/
+    telemetry.py): the phase buckets and event counters used to be
+    process-global module state, so back-to-back runs in one process —
+    exactly what a test session is — double-counted each other's totals
+    and a test asserting `fired > 0` could pass on a PREDECESSOR's
+    events.  Reset BEFORE the test (not after), so a failed test's
+    state is still inspectable post-mortem."""
+    from distributed_llm_dissemination_tpu.utils import telemetry
+
+    telemetry.reset_run()
+    yield
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     devices = jax.devices()

@@ -439,19 +439,30 @@ def test_mode3_digest_mismatch_reopens_intervals(order, tmp_path):
                     meta=LayerMeta(location=LayerLocation.INMEM))
                 receiver.handle_layer(LayerMsg(0, 0, frag, size))
 
+        def next_protocol_msg():
+            # The announce path also emits advisory telemetry traffic
+            # (TimeSyncMsg probes, MetricsReportMsg snapshots —
+            # docs/observability.md); this test cares about the
+            # PROTOCOL sequence, so skip those.
+            while True:
+                msg = ts[0].deliver().get(timeout=TIMEOUT)
+                if type(msg).__name__ not in ("TimeSyncMsg",
+                                              "MetricsReportMsg"):
+                    return msg
+
         feed()
         assert 0 not in receiver.layers  # demoted, not acked
         assert 0 not in receiver._partial  # intervals re-opened
         assert not os.path.exists(
             str(tmp_path / "ckpt" / "0.meta.json"))  # journal wiped
         # The mismatch triggered a recovery re-announce to the leader.
-        ann = ts[0].deliver().get(timeout=TIMEOUT)
+        ann = next_protocol_msg()
         assert type(ann).__name__ == "AnnounceMsg"
         # Correct stamp -> re-delivery completes and acks.
         receiver.layer_digests[0] = integrity.layer_digest(data)
         feed()
         assert bytes(receiver.layers[0].inmem_data) == data
-        ack = ts[0].deliver().get(timeout=TIMEOUT)
+        ack = next_protocol_msg()
         assert type(ack).__name__ == "AckMsg" and ack.layer_id == 0
     finally:
         receiver.close()

@@ -1,0 +1,592 @@
+"""Telemetry-plane tests (docs/observability.md): the run-scoped
+registry, per-link flight recorder byte reconciliation (dual-backend),
+MetricsReportMsg aggregation + failover survival of the cluster picture,
+announce-time clock-offset estimation, the one-command RUN_REPORT, the
+clock-aligned Perfetto export (±500 ms injected skew), and the static
+drift check that pins every cli/trace.py rule string to the package
+source.
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.cli import collect_logs, report
+from distributed_llm_dissemination_tpu.cli import trace as cli_trace
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    SourceType,
+)
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    Node,
+    StandbyController,
+)
+from distributed_llm_dissemination_tpu.transport import (
+    InmemTransport,
+    TcpTransport,
+    reset_registry,
+)
+from distributed_llm_dissemination_tpu.transport.messages import (
+    MetricsReportMsg,
+    TimeSyncMsg,
+)
+from distributed_llm_dissemination_tpu.utils import telemetry, trace
+
+TIMEOUT = 15.0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+@pytest.fixture(autouse=True)
+def _fast_metrics(monkeypatch):
+    """Reports every 0.2 s so aggregation tests don't wait out the
+    production default."""
+    monkeypatch.setenv("DLD_METRICS_INTERVAL_S", "0.2")
+
+
+def layer_bytes(layer_id: int, size: int) -> bytes:
+    return bytes([(layer_id * 41 + i) % 256 for i in range(size)])
+
+
+def mem_layer(layer_id: int, size: int) -> LayerSrc:
+    return LayerSrc(
+        inmem_data=bytearray(layer_bytes(layer_id, size)),
+        data_size=size,
+        meta=LayerMeta(location=LayerLocation.INMEM,
+                       source_type=SourceType.MEM),
+    )
+
+
+def make_transports(kind, ids):
+    if kind == "inmem":
+        registry = {i: f"obs{i}" for i in ids}
+        return {i: InmemTransport(registry[i], addr_registry=registry)
+                for i in ids}
+    ts = {i: TcpTransport("127.0.0.1:0") for i in ids}
+    registry = {i: ts[i].get_address() for i in ids}
+    for t in ts.values():
+        t.addr_registry.update(registry)
+    return ts
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_counters_links_snapshot_reset():
+    reg = telemetry.Telemetry()
+    reg.count("integrity.crc_drop")
+    reg.count("integrity.crc_drop_bytes", 512)
+    reg.gauge("clock_offset_ms", -3.25)
+    reg.add_phase("upload", 0.25)
+    reg.add_phase("upload", 0.75)
+    reg.observe_ms("tcp.rx_frame_ms", 3.0)
+    reg.observe_ms("tcp.rx_frame_ms", 5000.0)
+    reg.link_add(0, 2, rx_bytes=1024, rx_frames=1)
+    reg.link_add(0, 2, rx_bytes=1024, rx_frames=1, wire_s=0.5)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"integrity.crc_drop": 1,
+                                "integrity.crc_drop_bytes": 512}
+    assert snap["gauges"]["clock_offset_ms"] == -3.25
+    assert snap["phases"]["upload"] == {"ms": 1000.0, "n": 2}
+    h = snap["hists"]["tcp.rx_frame_ms"]
+    assert h["n"] == 2 and sum(h["buckets"]) == 2
+    # 3 ms lands in the <=4ms bucket, 5000 ms in the <=16384ms bucket.
+    assert h["buckets"][1] == 1
+    assert h["buckets"][telemetry.HIST_BUCKETS_MS.index(16384.0)] == 1
+    link = snap["links"]["0->2"]
+    assert link["rx_bytes"] == 2048 and link["rx_frames"] == 2
+    assert link["wire_s"] == 0.5
+    reg.reset_run()
+    empty = reg.snapshot()
+    assert not empty["counters"] and not empty["links"]
+    assert not empty["phases"] and not empty["hists"]
+
+
+def test_link_recorder_unknown_endpoint_records_nothing():
+    reg = telemetry.Telemetry()
+    reg.link_add(None, 2, rx_bytes=10)
+    reg.link_add(0, None, tx_bytes=10)
+    assert reg.snapshot()["links"] == {}
+
+
+def test_telemetry_disabled_gates_links_not_counters(monkeypatch):
+    monkeypatch.setenv("DLD_TELEMETRY", "0")
+    reg = telemetry.Telemetry()
+    reg.link_add(0, 1, rx_bytes=10)
+    reg.observe_ms("h", 1.0)
+    reg.count("integrity.crc_drop")  # pre-existing planes stay on
+    snap = reg.snapshot()
+    assert snap["links"] == {} and snap["hists"] == {}
+    assert snap["counters"] == {"integrity.crc_drop": 1}
+
+
+def test_trace_api_delegates_to_run_scoped_registry():
+    """Satellite: the old process-global trace sums are gone — the
+    trace.py writer API lands in the run-scoped registry, and one
+    reset_run clears BOTH planes (phases and counters)."""
+    trace.count("integrity.nack_sent", 3)
+    trace.add_phase("integrity_crc_recv", 0.5)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["integrity.nack_sent"] == 3
+    assert snap["phases"]["integrity_crc_recv"]["ms"] == 500.0
+    assert trace.counter_totals()["integrity.nack_sent"] == 3
+    trace.reset_run()
+    assert trace.counter_totals() == {}
+    assert trace.phase_totals() == {}
+
+
+def test_fold_links_takes_each_field_from_its_owner():
+    reports = {
+        # Node 2 (the dest) reports rx fields for 0->2, plus a bogus
+        # tx_bytes it does not own.
+        2: {"links": {"0->2": {"rx_bytes": 100, "delivered_bytes": 100,
+                               "tx_bytes": 1}}},
+        # Node 0 (the src) reports the authoritative tx side.
+        0: {"links": {"0->2": {"tx_bytes": 128, "tx_frames": 2}}},
+    }
+    folded = telemetry.fold_links(reports)
+    row = folded["0->2"]
+    assert row["src"] == 0 and row["dest"] == 2
+    assert row["rx_bytes"] == 100 and row["delivered_bytes"] == 100
+    assert row["tx_bytes"] == 128 and row["tx_frames"] == 2
+    assert telemetry.fold_counters(
+        {1: {"counters": {"a": 1}}, 2: {"counters": {"a": 2, "b": 3}}}
+    ) == {"a": 3, "b": 3}
+
+
+def test_fold_counters_dedups_co_resident_processes():
+    """Nodes sharing one process report cumulative views of the SAME
+    registry — the fold must count one snapshot per proc token (the
+    freshest), or every cluster total is multiplied by the co-resident
+    node count.  Distinct processes still sum."""
+    shared_old = {"proc": "p1", "t_wall_ms": 100.0,
+                  "counters": {"integrity.crc_drop": 2}}
+    shared_new = {"proc": "p1", "t_wall_ms": 200.0,
+                  "counters": {"integrity.crc_drop": 3}}
+    other_proc = {"proc": "p2", "t_wall_ms": 150.0,
+                  "counters": {"integrity.crc_drop": 5}}
+    out = telemetry.fold_counters({1: shared_old, 2: shared_new,
+                                   3: other_proc})
+    assert out == {"integrity.crc_drop": 8}  # 3 (freshest of p1) + 5
+    # A local live read beats any shipped report from its own process.
+    out = telemetry.fold_counters(
+        {1: shared_new},
+        local={"proc": "p1", "t_wall_ms": 0.0,
+               "counters": {"integrity.crc_drop": 4}})
+    assert out == {"integrity.crc_drop": 4}
+    # Legacy snapshots without a token keep the per-node sum.
+    out = telemetry.fold_counters({1: {"counters": {"a": 1}},
+                                   2: {"counters": {"a": 1}}})
+    assert out == {"a": 2}
+
+
+# ------------------------------------- dual-backend byte reconciliation
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_run_report_link_bytes_reconcile_with_delivered(kind, tmp_path):
+    """Acceptance: the RUN_REPORT's per-(src, dest) link table byte
+    totals reconcile BYTE-EXACTLY with the delivered layer bytes, on
+    both backends."""
+    size = 48 * 1024
+    n_layers = 3
+    ids = range(3)
+    ts = make_transports(kind, ids)
+    assignment = {2: {i: LayerMeta() for i in range(n_layers)}}
+    # Leader holds layers 0..1; receiver 1 holds layer 2 — so the link
+    # table must show BOTH sources feeding dest 2.
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i, size) for i in range(2)},
+        assignment, node_network_bw={i: 10 ** 9 for i in ids})
+    helper = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]), {2: mem_layer(2, size)})
+    dest = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {})
+    try:
+        helper.announce()
+        dest.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        # Let at least one metrics interval fire so the leader's table
+        # also has SHIPPED reports (in-process the registry is shared,
+        # but the wire path must not corrupt the fold).
+        deadline = time.monotonic() + TIMEOUT
+        while time.monotonic() < deadline:
+            with leader._lock:
+                if set(leader.cluster_metrics) >= {1, 2}:
+                    break
+            time.sleep(0.05)
+        rep = report.build_from_leader(leader, ttd_s=1.0)
+        delivered = sum(row.get("delivered_bytes", 0)
+                        for row in rep["links"] if row["dest"] == 2)
+        assert delivered == n_layers * size
+        # And the per-source split is attributable: the helper's layer
+        # came over 1->2, the leader's over 0->2.
+        by_src = {row["src"]: row.get("delivered_bytes", 0)
+                  for row in rep["links"] if row["dest"] == 2}
+        assert by_src.get(1, 0) == size
+        assert by_src.get(0, 0) == 2 * size
+        # The one-command artifact: RUN_REPORT.{json,md} with a
+        # provenance hash that matches its content.
+        paths = report.write_report(rep, str(tmp_path / "RUN_REPORT"))
+        doc = json.loads(open(paths["json"]).read())
+        assert doc["provenance"] == report.report_hash(doc)
+        md = open(paths["md"]).read()
+        assert "Per-link flight recorder" in md
+        assert "0→2" in md and "1→2" in md
+    finally:
+        leader.close()
+        helper.close()
+        dest.close()
+        for t in ts.values():
+            t.close()
+
+
+# --------------------------------------------- aggregation + failover
+
+
+def test_metrics_reports_reach_leader_and_are_fenced():
+    ids = range(2)
+    ts = make_transports("inmem", ids)
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, 4096)},
+        {1: {0: LayerMeta()}}, node_network_bw={i: 10 ** 9 for i in ids})
+    recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        recv.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        deadline = time.monotonic() + TIMEOUT
+        while time.monotonic() < deadline:
+            with leader._lock:
+                if 1 in leader.cluster_metrics:
+                    break
+            time.sleep(0.05)
+        with leader._lock:
+            snap = leader.cluster_metrics[1]
+        assert "counters" in snap and "links" in snap
+        # Epoch fencing: a reporter still pointing at a dead
+        # predecessor (lower epoch) is dropped, not folded.
+        leader.epoch = 5
+        stale = MetricsReportMsg(1, counters={"x": 1}, epoch=3)
+        leader.handle_metrics_report(stale)
+        with leader._lock:
+            assert "x" not in (leader.cluster_metrics[1].get("counters")
+                               or {})
+        assert trace.counter_totals().get("telemetry.fenced_report") == 1
+        current = MetricsReportMsg(1, counters={"x": 2}, epoch=5)
+        leader.handle_metrics_report(current)
+        with leader._lock:
+            assert leader.cluster_metrics[1]["counters"] == {"x": 2}
+    finally:
+        leader.close()
+        recv.close()
+        for t in ts.values():
+            t.close()
+
+
+@pytest.mark.timeout(60)
+def test_adopted_leader_still_yields_complete_report():
+    """Acceptance: kill the leader mid-run — the promoted standby's
+    adopted leader still produces a complete RUN_REPORT (replicated +
+    report-refreshed cluster picture), with the link table reconciling
+    byte-exactly."""
+    size = 96 * 1024
+    ids = range(3)  # 0 leader, 1 standby, 2 worker
+    ts = make_transports("tcp", ids)
+    assignment = {2: {0: LayerMeta(), 1: LayerMeta()}}
+    lease = 0.1
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i, size) for i in range(2)},
+        assignment, node_network_bw={i: 10 ** 10 for i in ids},
+        expected_nodes={1, 2}, standbys=[1], lease_interval=lease,
+        epoch=0)
+    # The standby holds replica copies — after the kill it must be able
+    # to SERVE whatever the dead leader had not delivered.
+    standby = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]), {i: mem_layer(i, size) for i in range(2)},
+        heartbeat_interval=lease)
+    ctl = StandbyController(
+        standby, rank=0, lease_timeout=0.4, standbys=[1], mode=3,
+        node_network_bw={i: 10 ** 10 for i in ids}, failure_timeout=0.0,
+        lease_interval=lease)
+    worker = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {},
+                                        heartbeat_interval=lease)
+    try:
+        standby.announce()
+        worker.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.close()  # the mid-run death
+        assert ctl.promoted.wait(timeout=30.0), "standby never promoted"
+        ctl.leader.ready().get(timeout=30.0)
+        # Wait for a post-takeover report round so the adopted leader's
+        # table reflects completion.
+        deadline = time.monotonic() + TIMEOUT
+        rep = None
+        while time.monotonic() < deadline:
+            rep = report.build_from_leader(ctl.leader, ttd_s=1.0)
+            delivered = sum(row.get("delivered_bytes", 0)
+                            for row in rep["links"] if row["dest"] == 2)
+            if delivered >= 2 * size:
+                break
+            time.sleep(0.1)
+        delivered = sum(row.get("delivered_bytes", 0)
+                        for row in rep["links"] if row["dest"] == 2)
+        assert delivered == 2 * size
+        # Exactly 1 despite every in-process node reporting a view of
+        # the same shared registry: fold_counters counts ONE snapshot
+        # per PROC_TOKEN.
+        assert rep["counters"].get("failover.takeover", 0) == 1
+        assert rep["provenance"]
+    finally:
+        ctl.close()
+        leader.close()
+        standby.close()
+        worker.close()
+        for t in ts.values():
+            t.close()
+
+
+# ------------------------------------------------------------ time sync
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_clock_offset_estimated_at_announce(kind):
+    ids = range(2)
+    ts = make_transports(kind, ids)
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, 4096)},
+        {1: {0: LayerMeta()}}, node_network_bw={i: 10 ** 9 for i in ids})
+    recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        recv.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        deadline = time.monotonic() + TIMEOUT
+        while recv.clock_offset_ms is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert recv.clock_offset_ms is not None
+        # Same host, same clock: the estimate must be tiny.
+        assert abs(recv.clock_offset_ms) < 250.0
+        assert "clock_offset_ms" in telemetry.snapshot()["gauges"]
+    finally:
+        leader.close()
+        recv.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_time_sync_midpoint_math():
+    """The NTP midpoint: a replier whose clock is skewed +S relative to
+    the requester yields offset ≈ S regardless of symmetric delay."""
+    got = queue.Queue()
+
+    class _FakeTransport:
+        def send(self, dest, msg):
+            got.put((dest, msg))
+
+    class _FakeNode:
+        my_id = 7
+        transport = _FakeTransport()
+
+    r = FlowRetransmitReceiverNode.__new__(FlowRetransmitReceiverNode)
+    r.node = _FakeNode()
+    r.clock_offset_ms = None
+    now = time.time() * 1000.0
+    skew = 500.0
+    # Reply built as if the reference clock runs +500 ms ahead and the
+    # round trip took 20 ms symmetric.
+    msg = TimeSyncMsg(0, t0_ms=now - 20.0, t1_ms=now - 10.0 + skew,
+                      reply=True)
+    r.handle_time_sync(msg)
+    assert r.clock_offset_ms == pytest.approx(skew, abs=15.0)
+
+
+# ------------------------------------------------- offline report + md
+
+
+def test_offline_report_from_records(tmp_path):
+    records = [
+        {"time": 1000, "node": "0", "message": "timer start"},
+        {"time": 3500, "node": "0", "message": "timer stop: startup"},
+        {"time": 3600, "node": "0", "message": "timer stop: first token",
+         "seconds": 2.8},
+        {"time": 3400, "node": "0", "message": "Predicted time to deliver",
+         "seconds": 2.2, "solve_ms": 11.5},
+        {"time": 1400, "node": "2", "message": "clock offset estimated",
+         "offset_ms": -480.0, "rtt_ms": 3.0},
+        {"time": 3550, "node": "0", "message": "cluster telemetry",
+         "counters": {"integrity.crc_drop": 2, "failover.takeover": 1},
+         "links": {"0->2": {"delivered_bytes": 4096, "rx_frames": 3,
+                            "wire_s": 0.000002}},
+         "gauges": {"2": {"clock_offset_ms": -480.0}}},
+    ]
+    rep = report.build_from_records(records)
+    assert rep["ttd_s"] == pytest.approx(2.5)
+    assert rep["ttft_s"] == pytest.approx(2.8)
+    assert rep["predicted_s"] == pytest.approx(2.2)
+    assert rep["links"][0]["delivered_bytes"] == 4096
+    assert rep["links"][0]["wire_gbps"] == pytest.approx(2.048)
+    assert rep["planes"]["integrity"]["crc_drop"] == 2
+    assert rep["planes"]["failover"]["takeover"] == 1
+    assert rep["clock_offsets_ms"]["2"] == -480.0
+    paths = report.write_report(rep, str(tmp_path))
+    md = open(paths["md"]).read()
+    assert "0→2" in md and "Integrity events" in md
+    assert "Failover events" in md and "Clock offsets" in md
+
+
+# ----------------------------- clock-aligned Perfetto export (±500 ms)
+
+
+def _skewed_logs(tmp_path):
+    """Three nodes, leader clock = truth; node 1 logs +500 ms fast,
+    node 2 −500 ms slow, each with the announce-time offset record the
+    aligner consumes.  The receive on node 1 REALLY happened 100 ms
+    after the leader's send."""
+    base = 1_000_000
+    leader = [
+        {"time": base, "node": "0", "message": "timer start"},
+        {"time": base + 1000, "node": "0",
+         "message": "timer stop: startup"},
+    ]
+    n1 = [
+        # +500 skew: logged time = true time + 500.
+        {"time": base + 100 + 500, "node": "1",
+         "message": "clock offset estimated", "offset_ms": -500.0,
+         "rtt_ms": 2.0},
+        {"time": base + 600 + 500, "node": "1",
+         "message": "(a fraction of) layer received", "layerID": 3,
+         "layer_size": 64, "total_size": 64, "duration_ms": 50.0},
+        {"time": base + 650 + 500, "node": "1",
+         "message": "layer fragment stored", "layerID": 3,
+         "received": 64},
+    ]
+    n2 = [
+        {"time": base + 100 - 500, "node": "2",
+         "message": "clock offset estimated", "offset_ms": 500.0,
+         "rtt_ms": 2.0},
+        {"time": base + 700 - 500, "node": "2",
+         "message": "layer fully received", "layer": 4,
+         "total_bytes": 64},
+    ]
+    for name, recs in (("leader", leader), ("n1", n1), ("n2", n2)):
+        with open(tmp_path / f"{name}.jsonl", "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+    return base
+
+
+def test_trace_aligns_injected_500ms_skew(tmp_path):
+    """Acceptance: a multi-host trace whose nodes log with ±500 ms wall
+    skew renders ALIGNED once the announce-time offsets are applied —
+    every event lands at its true leader-clock time."""
+    base = _skewed_logs(tmp_path)
+    merged = collect_logs.merge(
+        list(collect_logs.iter_records([str(tmp_path)])))
+    events = cli_trace.to_trace_events(merged)
+    by_name = {e["name"]: e for e in events if e["ph"] != "M"}
+    # Slice placement: the duration slice starts at end - dur, on the
+    # LEADER's timeline (skew removed), on the layer's tid track.
+    slice_ = by_name["receive layer 3"]
+    assert slice_["ph"] == "X" and slice_["tid"] == 3
+    assert slice_["ts"] == pytest.approx((base + 600 - 50) * 1000.0)
+    assert slice_["dur"] == pytest.approx(50 * 1000.0)
+    # Counter track, aligned too.
+    counter = by_name["layer 3 bytes"]
+    assert counter["ph"] == "C"
+    assert counter["args"]["received"] == 64
+    assert counter["ts"] == pytest.approx((base + 650) * 1000.0)
+    # The −500 ms node's instant event comes back to its true time.
+    inst = by_name["layer fully received"]
+    assert inst["ph"] == "i"
+    assert inst["ts"] == pytest.approx((base + 700) * 1000.0)
+    # Ordering on the shared timeline is the physical ordering.
+    assert (by_name["timer start"]["ts"] < slice_["ts"]
+            < inst["ts"] < by_name["timer stop: startup"]["ts"]
+            + 1000 * 1000)
+    # And the raw (unaligned) render really was skewed — the alignment
+    # is doing work, not vacuously passing.
+    raw = {e["name"]: e
+           for e in cli_trace.to_trace_events(merged, align_clocks=False)
+           if e["ph"] != "M"}
+    assert raw["receive layer 3"]["ts"] == pytest.approx(
+        (base + 600 + 500 - 50) * 1000.0)
+
+
+def test_trace_events_still_work_without_offset_records():
+    recs = [
+        {"time": 5000, "node": "0", "message": "timer start"},
+        {"time": 5100, "node": "1",
+         "message": "layer fully received", "layer": 1, "total_bytes": 8},
+    ]
+    events = cli_trace.to_trace_events(recs)
+    inst = next(e for e in events
+                if e["ph"] == "i" and e["name"] == "layer fully received")
+    assert inst["ts"] == 5100 * 1000.0
+
+
+# ------------------------------------------------- static drift check
+
+
+def test_every_trace_rule_string_exists_in_package_source():
+    """Satellite: a log-message rename must FAIL here, not silently
+    drop timeline events.  Every string in cli/trace.py's rule tables
+    must appear verbatim somewhere in the package source (outside
+    trace.py itself)."""
+    import distributed_llm_dissemination_tpu as pkg
+
+    pkg_dir = os.path.dirname(os.path.abspath(pkg.__file__))
+    source = []
+    for root, dirs, names in os.walk(pkg_dir):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            if os.path.basename(root) == "cli" and name == "trace.py":
+                continue
+            with open(path) as f:
+                source.append(f.read())
+    blob = "\n".join(source)
+    missing = [s for s in sorted(cli_trace._DURATION_RULES)
+               if s not in blob]
+    missing += [s for s in sorted(cli_trace._INSTANT_MESSAGES)
+                if s not in blob]
+    assert not missing, (
+        f"cli/trace.py rules name log messages that no longer exist in "
+        f"the package source (renamed without updating the trace "
+        f"rules?): {missing}")
+
+
+# ---------------------------------------------- end-to-end offline CLI
+
+
+def test_report_cli_end_to_end(tmp_path, capsys):
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    with open(logdir / "leader.jsonl", "w") as f:
+        for rec in (
+            {"time": 1000, "node": "0", "message": "timer start"},
+            {"time": 2000, "node": "0", "message": "timer stop: startup"},
+            {"time": 1900, "node": "0", "message": "cluster telemetry",
+             "counters": {}, "links": {"0->1": {"delivered_bytes": 128}},
+             "gauges": {}},
+        ):
+            f.write(json.dumps(rec) + "\n")
+    out_prefix = str(tmp_path / "RR")
+    rc = report.main([str(logdir), "-o", out_prefix])
+    assert rc == 0
+    doc = json.loads(open(out_prefix + ".json").read())
+    assert doc["ttd_s"] == pytest.approx(1.0)
+    assert doc["links"][0]["delivered_bytes"] == 128
+    assert os.path.exists(out_prefix + ".md")
