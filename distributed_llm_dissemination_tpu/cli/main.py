@@ -128,6 +128,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "lease beacon interval in seconds; standbys "
                         "declare it dead after ~3x this (staggered by "
                         "succession rank) and take over")
+    # Dissemination service plane (docs/service.md): the leader as a
+    # long-lived multi-job daemon, plus the submitter/query tools.
+    p.add_argument("-daemon", type=float, default=0.0,
+                   help="leader: after the initial goal completes, stay "
+                        "alive this many seconds as a dissemination "
+                        "service accepting job submissions (-submit) — "
+                        "version pushes, repair refills, A/B variants — "
+                        "scheduled as one shared-capacity flow problem "
+                        "with priorities (0: exit after the run as "
+                        "before)")
+    p.add_argument("-submit", type=str, default="",
+                   help="submit one dissemination job to the running "
+                        "leader daemon and exit: a JSON file (or inline "
+                        "JSON) with JobID, Assignment ({dest: [layer "
+                        "ids]} or nested metas), optional Priority "
+                        "(higher preempts), Kind (push|repair|ab), and "
+                        "Digests ({layer: 'xxh3:<hex>'} — content keys "
+                        "for delta resolution).  Run from an idle seat: "
+                        "-id must not collide with a live node process")
+    p.add_argument("-jobs", action="store_true",
+                   help="query the running leader daemon's admitted-job "
+                        "table (states, remaining pairs, priorities) as "
+                        "JSON on stdout and exit; same seat rules as "
+                        "-submit")
     return p
 
 
@@ -170,6 +194,97 @@ def boot_config(name: str):
             f"unknown -boot model {name!r}; known: {sorted(CONFIGS)}, "
             "none, hf:<checkpoint-dir>"
         )
+
+
+def _parse_job_spec(raw: str) -> dict:
+    """A -submit spec: a JSON file path, or inline JSON.  Assignment
+    values may be layer-id LISTS (shorthand; default metas) or nested
+    ``{layer: meta}`` maps (the wire shape)."""
+    import json
+
+    from ..core.types import LayerMeta
+
+    text = raw
+    if os.path.exists(raw):
+        with open(raw) as f:
+            text = f.read()
+    try:
+        spec = json.loads(text)
+    except ValueError as e:
+        raise SystemExit(f"-submit spec is neither a file nor JSON: {e}")
+    if not spec.get("JobID"):
+        raise SystemExit("-submit spec needs a JobID")
+    asg_raw = spec.get("Assignment") or {}
+    if not asg_raw:
+        raise SystemExit("-submit spec needs a non-empty Assignment")
+    try:
+        assignment = {}
+        for dest, lids in asg_raw.items():
+            if isinstance(lids, dict):
+                assignment[int(dest)] = {
+                    int(l): LayerMeta.from_json(m or {})
+                    for l, m in lids.items()}
+            else:
+                assignment[int(dest)] = {int(l): LayerMeta()
+                                         for l in lids}
+        spec["Assignment"] = assignment
+        spec["Digests"] = {int(l): str(d)
+                           for l, d in (spec.get("Digests") or {}).items()}
+        spec["Avoid"] = [int(n) for n in spec.get("Avoid") or []]
+    except (TypeError, ValueError) as e:
+        raise SystemExit(
+            f"-submit spec has non-integer node/layer keys: {e}")
+    return spec
+
+
+def run_jobtool(args, conf: cfg.Config) -> int:
+    """The -submit / -jobs one-shot tools (docs/service.md): bind this
+    seat's address, send the request to the leader daemon, print its
+    JobStatusMsg reply as JSON, exit.  Like cli.genreq, -id must name a
+    topology seat NOT also running cli.main (the reply multiplexes on
+    the seat's address)."""
+    import json
+    import queue as _queue
+
+    from ..runtime.node import MessageLoop
+    from ..transport.messages import JobStatusMsg, JobSubmitMsg
+
+    node_conf = cfg.get_node_conf(conf, args.id)
+    leader_id = cfg.get_leader_conf(conf).id
+    if args.id == leader_id:
+        raise SystemExit("-submit/-jobs must run from a non-leader seat "
+                         "(the leader process owns that address)")
+    transport = TcpTransport(node_conf.addr,
+                             addr_registry={nc.id: nc.addr
+                                            for nc in conf.nodes})
+    loop = MessageLoop(transport)
+    replies: "_queue.Queue" = _queue.Queue()
+    loop.register(JobStatusMsg, replies.put)
+    loop.start()
+    try:
+        if args.submit:
+            spec = _parse_job_spec(args.submit)
+            transport.send(leader_id, JobSubmitMsg(
+                args.id, str(spec["JobID"]), spec["Assignment"],
+                priority=int(spec.get("Priority", 0)),
+                kind=str(spec.get("Kind", "push")),
+                digests=spec["Digests"], avoid=spec["Avoid"]))
+        else:
+            transport.send(leader_id, JobStatusMsg(args.id, query=True))
+        try:
+            resp = replies.get(timeout=30.0)
+        except _queue.Empty:
+            print(json.dumps({"error": "no reply from the leader daemon "
+                                       "(is it running with -daemon?)"}))
+            return 1
+        out = {"leader_epoch": resp.epoch, "jobs": resp.jobs}
+        if resp.error:
+            out["error"] = resp.error
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 1 if resp.error else 0
+    finally:
+        loop.stop()
+        transport.close()
 
 
 def run_client(args, conf: cfg.Config) -> int:
@@ -350,6 +465,29 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
             print(f"Boot FAILED on nodes {failed}", flush=True)
             write_run_report(ttd)
             return 1
+    if args.daemon > 0:
+        # Dissemination service (docs/service.md): stay alive as a
+        # long-lived daemon accepting -submit jobs; each completed job
+        # cycle re-fires ready() and logs the admitted-job table.
+        import json as _json
+        import queue as _queue
+
+        print(f"daemon: accepting job submissions for {args.daemon:g}s",
+              flush=True)
+        deadline = time.monotonic() + args.daemon
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            try:
+                goal = leader.ready().get(timeout=min(1.0, left))
+            except _queue.Empty:
+                continue
+            ulog.log.info("job cycle complete", dests=sorted(goal),
+                          jobs=leader.jobs.table())
+            print(f"jobs: {_json.dumps(leader.jobs.table(), sort_keys=True)}",
+                  flush=True)
+        t_ready_mono = time.monotonic()  # freshness-gate the final report
     write_run_report(ttd)
     return 0
 
@@ -552,6 +690,14 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
                       window_s=args.serve)
         print(f"serving for {args.serve:g}s", flush=True)
         time.sleep(args.serve)
+    if args.daemon > 0:
+        # Dissemination service (docs/service.md): the leader daemon
+        # keeps admitting jobs, so this seat keeps receiving (and
+        # serving) layers — its message loop stays live for the window.
+        ulog.log.info("daemon window: serving dissemination jobs",
+                      window_s=args.daemon)
+        print(f"daemon: serving jobs for {args.daemon:g}s", flush=True)
+        time.sleep(args.daemon)
     return 0
 
 
@@ -559,6 +705,11 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     ulog.configure(node=str(args.id), verbose=args.v)
     conf = cfg.read_json(args.f)
+
+    if args.submit or args.jobs:
+        # One-shot service tools: no fabrication, no role loop — talk
+        # to the running leader daemon and exit (docs/service.md).
+        return run_jobtool(args, conf)
 
     if args.c:
         return run_client(args, conf)

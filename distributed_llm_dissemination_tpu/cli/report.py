@@ -84,11 +84,14 @@ def _link_rows(links: dict) -> List[dict]:
             key=lambda kv: (kv[1].get("src", 0), kv[1].get("dest", 0))):
         row = dict(fields)
         if "src" not in row or "dest" not in row:
+            base, _, job = key.partition("#")
             try:
-                s, d = key.split("->", 1)
+                s, d = base.split("->", 1)
                 row["src"], row["dest"] = int(s), int(d)
             except ValueError:
                 continue
+            if job:
+                row["job"] = job
         wire_s = row.get("wire_s") or 0.0
         delivered = row.get("delivered_bytes") or 0
         if wire_s > 0 and delivered:
@@ -119,6 +122,15 @@ def build(cluster: dict, ttd_s: Optional[float] = None,
             if name.startswith("phase."):
                 phases.setdefault(str(node_id), {})[
                     name[len("phase."):]] = v
+    # Job plane (docs/service.md): rows tagged "src->dest#job" are the
+    # per-job ADDITIVE split of the base rows — they render in their own
+    # section so the base table still reconciles byte-exactly.
+    all_rows = _link_rows(cluster.get("links") or {})
+    base_rows = [r for r in all_rows if "job" not in r]
+    job_rows: dict = {}
+    for r in all_rows:
+        if "job" in r:
+            job_rows.setdefault(r["job"], []).append(r)
     report = {
         "schema": SCHEMA,
         "generated_unix_ms": int(time.time() * 1000),
@@ -128,7 +140,8 @@ def build(cluster: dict, ttd_s: Optional[float] = None,
         "predicted_s": (round(predicted_s, 6)
                         if predicted_s is not None else None),
         "solve_ms": round(solve_ms, 3) if solve_ms is not None else None,
-        "links": _link_rows(cluster.get("links") or {}),
+        "links": base_rows,
+        "job_links": job_rows,
         "counters": dict(sorted(counters.items())),
         "planes": _split_counters(counters),
         "phases_ms_by_node": phases,
@@ -151,6 +164,13 @@ def build_from_leader(leader, ttd_s: Optional[float] = None,
     replication carried the dead predecessor's table, and every live
     node's cumulative reports refreshed it since."""
     pred_ms = getattr(leader, "predicted_ttd_ms", 0)
+    # Admitted-job table (docs/service.md): rides the report whenever
+    # the leader ran as a service (empty single-run tables add nothing).
+    jobs = getattr(leader, "jobs", None)
+    table = jobs.table() if jobs is not None else {}
+    if table:
+        extra = dict(extra or {})
+        extra.setdefault("jobs", table)
     return build(
         leader.cluster_telemetry(), ttd_s=ttd_s, ttft_s=ttft_s,
         predicted_s=(pred_ms / 1000.0) if pred_ms else None,
@@ -267,6 +287,33 @@ def render_md(report: dict) -> str:
                 f"| {_fmt(row.get('crc_drops', 0))} "
                 f"| {_fmt(row.get('nacks', 0))} "
                 f"| {_fmt(row.get('retransmit_bytes', 0))} |")
+        lines.append("")
+    jobs = report.get("jobs") or {}
+    job_links = report.get("job_links") or {}
+    if jobs or job_links:
+        lines += [
+            "## Dissemination jobs (docs/service.md)",
+            "",
+            "Per-job link rows are an ADDITIVE split of the base table "
+            "above (frames serving a job file on both).",
+            "",
+        ]
+        for jid, row in sorted(jobs.items()):
+            lines.append(
+                f"- `{jid}`: {row.get('State')} "
+                f"(priority {row.get('Priority')}, kind "
+                f"{row.get('Kind')}, {row.get('RemainingPairs')}/"
+                f"{row.get('TotalPairs')} pairs remaining, "
+                f"{row.get('ResolvedAtAdmit')} resolved at admit, "
+                f"{row.get('DroppedPairs')} dropped)")
+        for jid, rows in sorted(job_links.items()):
+            delivered = sum(r.get("delivered_bytes") or 0 for r in rows)
+            per = ", ".join(
+                f"{r['src']}→{r['dest']}: "
+                f"{_fmt(r.get('delivered_bytes', 0))}B"
+                for r in rows)
+            lines.append(f"- `{jid}` links ({delivered} B delivered): "
+                         f"{per}")
         lines.append("")
     planes = report.get("planes") or {}
     for plane, doc in (("integrity", "docs/integrity.md"),

@@ -20,3 +20,4 @@ from .receiver import (  # noqa: F401
     RetransmitReceiverNode,
 )
 from .send import fetch_from_client, handle_flow_retransmit, send_layer  # noqa: F401
+from .store import ContentIndex, ContentStore  # noqa: F401

@@ -157,6 +157,12 @@ class ShadowLeaderState:
         # per-node metric snapshots ride replication too, so a takeover
         # keeps the cluster picture instead of starting blind.
         self.metrics: dict = {}
+        # Job plane (docs/service.md): the admitted-job table (raw
+        # replication records, ``sched.jobs.JobManager.record``) and the
+        # BASE single-run goal (``assignment`` above is the MERGED
+        # effective goal) — a promoted standby resumes every job.
+        self.jobs: dict = {}
+        self.base_assignment: Optional[dict] = None
         self.have_snapshot = False
         self.deltas_applied = 0
 
@@ -182,6 +188,11 @@ class ShadowLeaderState:
                 self.boot_enabled = bool(d.get("BootEnabled", True))
                 self.metrics = {int(n): dict(s) for n, s in
                                 (d.get("Metrics") or {}).items()}
+                self.jobs = {str(j): dict(rec) for j, rec in
+                             (d.get("Jobs") or {}).items()}
+                if d.get("BaseAssignment") is not None:
+                    self.base_assignment = _nested_layer_map_from_json(
+                        d.get("BaseAssignment"))
                 self.have_snapshot = True
             elif k == "status":
                 self.status[int(d["Node"])] = layer_ids_from_json(
@@ -217,6 +228,21 @@ class ShadowLeaderState:
                 self.startup_sent = bool(d.get("Sent", True))
             elif k == "plan_seq":
                 self.plan_seq = max(self.plan_seq, int(d.get("Seq", 0)))
+            elif k == "revive":
+                # A declared-dead node re-announced and was restored:
+                # it is no longer written off (the adopt-time job-pair
+                # re-drop must not hit a live dest).
+                self.dropped.pop(int(d["Node"]), None)
+            elif k == "base_assignment":
+                self.base_assignment = _nested_layer_map_from_json(
+                    d.get("Assignment"))
+            elif k == "job":
+                self.jobs[str(d["JobID"])] = dict(d)
+            elif k == "job_done":
+                rec = self.jobs.get(str(d.get("JobID", "")))
+                if rec is not None:
+                    rec["State"] = "done"
+                    rec["Remaining"] = []
             elif k == "metrics":
                 self.metrics[int(d["Node"])] = {
                     "counters": dict(d.get("Counters") or {}),
@@ -245,6 +271,10 @@ class ShadowLeaderState:
                 "failure_timeout": self.failure_timeout,
                 "boot_enabled": self.boot_enabled,
                 "metrics": {n: dict(s) for n, s in self.metrics.items()},
+                "jobs": {j: dict(rec) for j, rec in self.jobs.items()},
+                "base_assignment": (
+                    {n: dict(r) for n, r in self.base_assignment.items()}
+                    if self.base_assignment is not None else None),
                 "have_snapshot": self.have_snapshot,
             }
 

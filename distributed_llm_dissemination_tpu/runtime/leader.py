@@ -44,7 +44,14 @@ from ..core.types import (
     delivered,
     layer_ids_to_json,
 )
-from ..sched.flow import FlowJob, FlowJobsMap, pick_salvage_source, rate_for
+from ..sched.flow import (
+    FlowJob,
+    FlowJobsMap,
+    pick_salvage_source,
+    rate_for,
+    solve_joint,
+)
+from ..sched.jobs import Job, JobManager
 from ..sched.native import make_flow_graph
 from ..transport.messages import (
     AckMsg,
@@ -56,6 +63,8 @@ from ..transport.messages import (
     GenerateReqMsg,
     GenerateRespMsg,
     HeartbeatMsg,
+    JobStatusMsg,
+    JobSubmitMsg,
     LayerDigestsMsg,
     LayerMsg,
     LayerNackMsg,
@@ -78,6 +87,7 @@ from .failover import (
 )
 from .failure import FailureDetector
 from .node import MessageLoop, Node
+from .store import ContentIndex
 from .send import (
     NackRetransmitter,
     contribute_device_plan,
@@ -157,6 +167,22 @@ class LeaderNode:
         self.node = node
         self.layers = layers
         self.assignment = assignment
+        # Multi-job service plane (docs/service.md): the constructor's
+        # assignment is the BASE single-run goal; admitted jobs merge
+        # into ``self.assignment`` (the effective cluster goal every
+        # existing path reads) and are tracked per job.  Until a job is
+        # admitted the two are the SAME object — zero behavior change
+        # for single-run deployments.
+        self._base_assignment = assignment
+        self.jobs = JobManager()
+        # (layer, dest) pairs already reported as content-skipped (the
+        # counter/log fire once per pair, not once per replan).
+        self._content_skip_seen: Set[Tuple[LayerID, NodeID]] = set()
+        # Content-addressed holdings (runtime/store.py): digest →
+        # (node, layer) holders, fed by announces and acks — lets a
+        # delta-rollout job skip shipping layers whose bytes a dest
+        # already holds under another id.
+        self.content = ContentIndex()
         self.fabric = fabric
         self.placement = placement
         self._plan_seq = itertools.count()
@@ -401,6 +427,8 @@ class LeaderNode:
         reg(LeaderLeaseMsg, self.handle_leader_lease)
         reg(MetricsReportMsg, self.handle_metrics_report)
         reg(TimeSyncMsg, self.handle_time_sync)
+        reg(JobSubmitMsg, self.handle_job_submit)
+        reg(JobStatusMsg, self.handle_job_status)
 
     # --------------------------------------------------- control-plane HA
 
@@ -492,6 +520,11 @@ class LeaderNode:
             return {
                 "Mode": self.MODE,
                 "Assignment": _nested_layer_map_to_json(self.assignment),
+                "BaseAssignment": _nested_layer_map_to_json(
+                    self._base_assignment),
+                # The admitted-job table (docs/service.md): a promoted
+                # standby resumes EVERY job, not just one run.
+                "Jobs": self.jobs.to_json(),
                 "Status": _nested_layer_map_to_json(self.status),
                 "Partial": _partial_to_json(self.partial_status),
                 "Dropped": _nested_layer_map_to_json(
@@ -543,6 +576,25 @@ class LeaderNode:
             self.assignment = {n: dict(r) for n, r in
                                shadow["assignment"].items()
                                if n != dead_leader}
+            # Job plane (docs/service.md): restore the admitted-job
+            # table and the base goal so EVERY job resumes, not just
+            # the run.  The replicated merged assignment above already
+            # carries the jobs' pairs; keeping it (rather than
+            # re-merging) also survives lost best-effort job deltas.
+            base = shadow.get("base_assignment")
+            self._base_assignment = (
+                {n: dict(r) for n, r in base.items() if n != dead_leader}
+                if base is not None else self.assignment)
+            self.jobs.load(shadow.get("jobs") or {})
+            if dead_leader is not None:
+                self.jobs.drop_dest(dead_leader)
+            # Dests the DEAD leader declared crashed pre-takeover: the
+            # "crash" delta recorded them in dropped, but the per-job
+            # record re-replication may have been lost (best-effort) —
+            # re-apply the drops so no adopted job waits on a dest the
+            # cluster already wrote off.
+            for node_id in list(shadow["dropped"]):
+                self.jobs.drop_dest(node_id)
             self.partial_status = {n: dict(p) for n, p in
                                    shadow["partial"].items()}
             self._dropped_assignment = {n: dict(r) for n, r in
@@ -568,6 +620,14 @@ class LeaderNode:
             self.detector.touch(n)
         if dead_leader is not None:
             self.detector.forget(dead_leader)
+        # Replicated job deltas are best-effort: reconcile remaining
+        # pairs against the adopted status so a lost ack delta can't
+        # strand a pair the cluster already shows delivered.
+        with self._lock:
+            status_view = {n: dict(r) for n, r in self.status.items()}
+        for jid in self.jobs.credit_status(status_view):
+            log.info("adopted job already complete per replicated status",
+                     job=jid)
 
     def resume_from_takeover(self) -> None:
         """Re-drive delivery from the adopted shadow: finish immediately
@@ -1052,6 +1112,11 @@ class LeaderNode:
             self.detector.revive(msg.src_id)
         self.detector.touch(msg.src_id)
         self._merge_announced_digests(msg.src_id, msg.digests)
+        # Content index: an announce is the node's authoritative current
+        # inventory — replace its digest contribution wholesale (a
+        # restarted node no longer vouches for its dead incarnation's
+        # bytes); acks extend it as deliveries land.
+        self.content.reset_node(msg.src_id, msg.digests)
         with self._lock:
             # A re-plan is only for a node the run already has business
             # with: one that restarted (still in status), one returning
@@ -1098,6 +1163,12 @@ class LeaderNode:
             "partial", Node=msg.src_id,
             Partial=({str(l): info for l, info in msg.partial.items()}
                      if msg.partial else None))
+        if dropped:
+            # The node came back from declared death: purge it from the
+            # shadow's dropped map too, or a takeover would re-apply
+            # the job-pair drops against a LIVE dest (adopt_shadow
+            # re-drops for every still-dropped node).
+            self._replicate("revive", Node=msg.src_id)
         if msg.src_id in self.standbys:
             # A standby joined (or re-joined): snapshot first, deltas
             # thereafter.
@@ -1143,8 +1214,14 @@ class LeaderNode:
         self._recover()
 
     def _restore_assignment(self, node_id: NodeID, layers: LayerIDs) -> None:
-        """Re-admit a previously dropped assignee (called under _lock)."""
+        """Re-admit a previously dropped assignee (called under _lock).
+        The dropped set is the node's MERGED promise (base + any job
+        layers), so it restores into the base goal: jobs that completed
+        with the drop stay completed, but the returned node still gets
+        every layer it was promised."""
         self.assignment[node_id] = layers
+        if self._base_assignment is not self.assignment:
+            self._base_assignment[node_id] = layers
 
     def update(self, assignment: Assignment) -> None:
         """Re-target the distribution to a new goal state — the
@@ -1152,12 +1229,15 @@ class LeaderNode:
         (node.go:215-217).
 
         Declarative semantics: the new assignment wholly replaces the old
-        one.  Already-delivered layers are not re-sent; missing ones are
-        scheduled; if the new goal adds work after ``ready`` already
-        fired, the completion cycle re-arms and ``ready()`` delivers
-        again once the new goal is met."""
+        BASE goal (admitted jobs keep their own targets and re-merge on
+        top — a version rollout must not be cancelled by a base
+        re-target).  Already-delivered layers are not re-sent; missing
+        ones are scheduled; if the new goal adds work after ``ready``
+        already fired, the completion cycle re-arms and ``ready()``
+        delivers again once the new goal is met."""
         with self._lock:
-            self.assignment = assignment
+            self._base_assignment = assignment
+            self.assignment = self.jobs.merged_assignment(assignment)
             self._dropped_assignment.clear()
             if self._started:
                 # Re-arm: every update() answers with its own ready event,
@@ -1169,7 +1249,15 @@ class LeaderNode:
             if node_id != self.node.my_id and node_id not in self.status:
                 self.detector.touch(node_id)
         log.info("assignment updated", dests=sorted(assignment))
-        self._replicate("assignment",
+        with self._lock:
+            merged = _nested_layer_map_to_json(self.assignment)
+        # The shadow's "assignment" tracks the MERGED goal (it is what
+        # adopt_shadow resumes); the BASE re-target rides its own delta
+        # — a standby that attached before this update would otherwise
+        # restore the snapshot's stale base, and the first post-takeover
+        # goal recompute would silently revert the re-target.
+        self._replicate("assignment", Assignment=merged)
+        self._replicate("base_assignment",
                         Assignment=_nested_layer_map_to_json(assignment))
         with self._lock:
             started = self._started
@@ -1186,6 +1274,172 @@ class LeaderNode:
         """Schedule the new goal's missing deliveries; mode 2 overrides
         (its live job table needs incremental repair, not a rebuild)."""
         self._recover()
+
+    # ------------------------------------------------- multi-job service
+
+    def submit_job(self, job_id: str, assignment: Assignment,
+                   priority: int = 0, kind: str = "push",
+                   digests: Optional[Dict[LayerID, str]] = None,
+                   avoid: Optional[Set[NodeID]] = None) -> dict:
+        """Admit one dissemination job into the long-lived service plane
+        (docs/service.md) — the multi-job generalization of ``update()``.
+
+        The job's target merges into the effective cluster goal; its
+        remaining (dest, layer) demands are planned WITH every other
+        active job's in one shared-capacity flow solve (mode 3;
+        priorities preempt at the re-plan), and acks credit all jobs
+        wanting a pair.  ``digests`` keys the job's layers by content
+        (``xxh3:<hex>``): a dest already holding content-equal bytes
+        resolves the layer locally — zero wire bytes — via the
+        content store.  Idempotent per ``job_id``; returns the job's
+        status summary."""
+        digests = dict(digests or {})
+        with self._lock:
+            # A long-lived daemon's layer store GROWS between jobs (a
+            # rollout seeder loads v2 bytes): refresh the leader's own
+            # status row so the planner can size + source the new
+            # layers (the constructor only saw the boot-time store).
+            own = self.status.setdefault(self.node.my_id, {})
+            for lid, src in self.layers.items():
+                if lid not in own:
+                    own[lid] = LayerMeta(
+                        location=src.meta.location,
+                        limit_rate=src.meta.limit_rate,
+                        source_type=src.meta.source_type,
+                        data_size=src.data_size)
+            own_row = layer_ids_to_json(own)
+        self._replicate("status", Node=self.node.my_id, Layers=own_row)
+        if digests:
+            with self._lock:
+                for lid, d in digests.items():
+                    # Job digests are authoritative for NEW layer ids;
+                    # an existing stamp (e.g. a holder's announce) wins,
+                    # matching the first-writer rule of the integrity
+                    # plane.
+                    self.layer_digests.setdefault(lid, d)
+        with self._lock:
+            status_view = {n: dict(r) for n, r in self.status.items()}
+        job = self.jobs.admit(
+            Job(job_id=str(job_id), assignment=assignment,
+                priority=int(priority), kind=str(kind), digests=digests,
+                avoid_sources={int(n) for n in (avoid or ())},
+                admit_ms=time.time() * 1000.0),
+            status_view)
+        trace.count("jobs.admitted")
+        log.info("dissemination job admitted", job=job.job_id,
+                 priority=job.priority, kind=job.kind,
+                 dests=sorted(job.assignment),
+                 remaining=len(job.remaining),
+                 resolved_at_admit=job.resolved_at_admit)
+        with self._lock:
+            self.assignment = self.jobs.merged_assignment(
+                self._base_assignment)
+            rearmed = self._started and job.state == "active"
+            if rearmed:
+                # Like update(): the completion cycle re-arms; ready()
+                # fires again when the whole current goal (all jobs)
+                # is met.
+                self._startup_sent = False
+            merged = _nested_layer_map_to_json(self.assignment)
+        for node_id in job.assignment:
+            if node_id != self.node.my_id and node_id not in self.status:
+                self.detector.touch(node_id)
+        self._replicate("job", **self.jobs.record(job.job_id))
+        if digests:
+            self._replicate("digests",
+                            Digests={str(l): d
+                                     for l, d in digests.items()})
+        self._replicate("assignment", Assignment=merged)
+        if rearmed:
+            self._replicate("startup", Sent=False)
+        with self._lock:
+            started = self._started
+        if started:
+            for dest in sorted(job.assignment):
+                # A job's dests need their (possibly new) digest stamps
+                # BEFORE the re-plan: the stamp is what triggers the
+                # dest-side content resolve, and what the ack gate
+                # verifies shipped layers against.
+                self._send_digests_to(dest)
+                self._send_boot_hint_to(dest)
+        self._drive(self._update_replan)
+        job = self.jobs.get(job.job_id) or job
+        return job.summary()
+
+    def handle_job_submit(self, msg: JobSubmitMsg) -> None:
+        """Wire half of ``submit_job`` — the ``cli.main -submit`` entry
+        point.  Always answered (the serving invariant): admission
+        returns the job row, a refusal returns an error."""
+        if self._deposed:
+            reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
+                                 error="deposed: a higher-epoch leader "
+                                       "owns the job table")
+        elif not msg.job_id or not msg.assignment:
+            reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
+                                 error="job_id and a non-empty "
+                                       "assignment are required")
+        else:
+            try:
+                summary = self.submit_job(msg.job_id, msg.assignment,
+                                          priority=msg.priority,
+                                          kind=msg.kind,
+                                          digests=msg.digests,
+                                          avoid=msg.avoid)
+                reply = JobStatusMsg(self.node.my_id,
+                                     jobs={msg.job_id: summary},
+                                     epoch=self.epoch)
+            except Exception as e:  # noqa: BLE001 — ALWAYS answer
+                # The admission tail runs a synchronous replan; if it
+                # raises, the submitter must get the error, not a 30 s
+                # timeout misdiagnosing a live daemon as down.
+                log.error("job admission failed", job=msg.job_id,
+                          err=repr(e))
+                reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
+                                     error=f"admission failed: {e!r}")
+        try:
+            self.node.add_node(msg.src_id)
+            self.node.transport.send(msg.src_id, reply)
+        except (OSError, KeyError) as e:
+            log.error("job submit reply undeliverable", dest=msg.src_id,
+                      err=repr(e))
+
+    def handle_job_status(self, msg: JobStatusMsg) -> None:
+        """Answer a ``-jobs`` query with the full admitted-job table; a
+        non-query (someone's reply echoed here) is ignored."""
+        if not msg.query:
+            return
+        try:
+            self.node.add_node(msg.src_id)
+            self.node.transport.send(
+                msg.src_id,
+                JobStatusMsg(self.node.my_id, jobs=self.jobs.table(),
+                             epoch=self.epoch))
+        except (OSError, KeyError) as e:
+            log.error("job status reply undeliverable", dest=msg.src_id,
+                      err=repr(e))
+
+    def _content_skip_locked(self, dest: NodeID, layer_id: LayerID) -> bool:
+        """Lock held.  True when shipping (dest, layer) would be wasted
+        wire bytes: a job claims the pair AND the content index shows
+        the dest already holds content-equal bytes under another layer
+        id — the dest's own digest-stamp resolve acks it locally
+        (docs/service.md).  Gated on job ownership so pre-service peers
+        (which lack the resolve path) are never starved."""
+        if self.jobs.owner_of(dest, layer_id) is None:
+            return False
+        digest = self.layer_digests.get(layer_id)
+        if not digest or not self.content.node_has(dest, digest):
+            return False
+        # Count + log once per PAIR, not per replan consultation — the
+        # counter is "content-equal pairs never shipped", and replans
+        # re-consult every pair.
+        if (layer_id, dest) not in self._content_skip_seen:
+            self._content_skip_seen.add((layer_id, dest))
+            trace.count("store.leader_skipped")
+            log.info("content store: dest holds content-equal bytes; "
+                     "skipping the wire ship", layerID=layer_id,
+                     dest=dest, digest=digest)
+        return True
 
     def _drive(self, replan) -> None:
         """The shared goal-chasing tail of crash()/update(): start if the
@@ -1211,7 +1465,10 @@ class LeaderNode:
             for layer_id in layer_ids:
                 with self._lock:
                     meta = self.status.get(node_id, {}).get(layer_id)
-                if meta is not None and delivered(meta):
+                    skip = (meta is None
+                            and self._content_skip_locked(node_id,
+                                                          layer_id))
+                if (meta is not None and delivered(meta)) or skip:
                     continue
                 layer = self.layers.get(layer_id)
                 if layer is None:
@@ -1220,11 +1477,14 @@ class LeaderNode:
                 if self._try_fabric_full_layer(layer_id, self.node.my_id,
                                                node_id):
                     continue
-                self.loop.submit(self._send_one, node_id, layer_id, layer)
+                owner = self.jobs.owner_of(node_id, layer_id)
+                self.loop.submit(self._send_one, node_id, layer_id, layer,
+                                 owner[1] if owner else "")
 
-    def _send_one(self, dest: NodeID, layer_id: LayerID, layer) -> None:
+    def _send_one(self, dest: NodeID, layer_id: LayerID, layer,
+                  job_id: str = "") -> None:
         try:
-            send_layer(self.node, dest, layer_id, layer)
+            send_layer(self.node, dest, layer_id, layer, job_id=job_id)
         except Exception as e:  # noqa: BLE001
             log.error("couldn't send a layer", layerID=layer_id, err=repr(e))
 
@@ -1499,7 +1759,24 @@ class LeaderNode:
                     del self._plan_watch[seq]
         self._replicate("ack", Node=msg.src_id, Layer=msg.layer_id,
                         Location=int(msg.location), Size=size)
+        # Content index + job plane: the delivered copy verified against
+        # the stamped digest before acking, so the new owner vouches for
+        # those bytes; the ack credits every admitted job wanting the
+        # pair (docs/service.md).
+        with self._lock:
+            digest = self.layer_digests.get(msg.layer_id)
+        self.content.add(msg.src_id, msg.layer_id, digest)
+        self._jobs_completed(self.jobs.on_ack(msg.src_id, msg.layer_id))
         self._maybe_finish()
+
+    def _jobs_completed(self, job_ids) -> None:
+        """Log + replicate job completions (no-op on an empty list)."""
+        for jid in job_ids:
+            job = self.jobs.get(jid)
+            trace.count("jobs.completed")
+            log.info("dissemination job complete", job=jid,
+                     **(job.summary() if job is not None else {}))
+            self._replicate("job_done", JobID=jid)
 
     def _layer_size_locked(self, layer_id: LayerID) -> int:
         """A layer's full size: the max announced ``data_size`` across
@@ -1585,6 +1862,8 @@ class LeaderNode:
                         cancels.append((seq, plan))
             recipients = set(self.status) | {self.node.my_id}
             dropped = self.assignment.pop(node_id, None)
+            if self._base_assignment is not self.assignment:
+                self._base_assignment.pop(node_id, None)
             if dropped:
                 # Remembered so a restarted incarnation that re-announces
                 # gets its layers back (resume after declared death).
@@ -1611,6 +1890,18 @@ class LeaderNode:
         self._replicate("crash", Node=node_id,
                         Dropped=(layer_ids_to_json(dropped)
                                  if dropped else None))
+        # Job plane: the dead dest's pairs can never land — drop them
+        # from every admitted job (counted as dropped_pairs, so a job
+        # completed this way is visibly degraded) and stop trusting the
+        # node's content holdings.  Every MUTATED job record
+        # re-replicates: a standby restoring admit-time remaining sets
+        # would otherwise resurrect the dead dest's pairs at takeover
+        # and wedge the adopted goal.
+        self.content.drop_node(node_id)
+        affected, finished = self.jobs.drop_dest(node_id)
+        for jid in affected:
+            self._replicate("job", **self.jobs.record(jid))
+        self._jobs_completed(finished)
         self._drive(self._recover)
         # The crash may have removed the last assignee the boot/TTFT wait
         # was blocked on.
@@ -1674,6 +1965,11 @@ class RetransmitLeaderNode(LeaderNode):
             owners_by_layer = {k: set(v) for k, v in self.layer_owners.items()}
         for node_id, layer_ids in self.assignment.items():
             for layer_id in layer_ids:
+                with self._lock:
+                    if self._content_skip_locked(node_id, layer_id):
+                        continue
+                jid_owner = self.jobs.owner_of(node_id, layer_id)
+                jid = jid_owner[1] if jid_owner else ""
                 owners = owners_by_layer.get(layer_id, set())
                 if owners:
                     if node_id in owners:
@@ -1682,7 +1978,8 @@ class RetransmitLeaderNode(LeaderNode):
                     # map iteration, node.go:583-588).
                     owner = min(owners)
                     try:
-                        self.send_retransmit(layer_id, owner, node_id)
+                        self.send_retransmit(layer_id, owner, node_id,
+                                             job_id=jid)
                     except Exception as e:  # noqa: BLE001
                         log.error(
                             "couldn't send retransmit",
@@ -1696,9 +1993,11 @@ class RetransmitLeaderNode(LeaderNode):
                     if self._try_fabric_full_layer(layer_id, self.node.my_id,
                                                    node_id):
                         continue
-                    self.loop.submit(self._send_one, node_id, layer_id, layer)
+                    self.loop.submit(self._send_one, node_id, layer_id,
+                                     layer, jid)
 
-    def send_retransmit(self, layer_id: LayerID, owner: NodeID, dest: NodeID) -> None:
+    def send_retransmit(self, layer_id: LayerID, owner: NodeID,
+                        dest: NodeID, job_id: str = "") -> None:
         """Ask ``owner`` to forward ``layer_id`` to ``dest``; leader-owned
         layers go out directly (node.go:611-626).  With a fabric wired the
         forward becomes a one-source device plan — the owner's copy enters
@@ -1715,11 +2014,11 @@ class RetransmitLeaderNode(LeaderNode):
             # and an inline rate-paced send would serialize every
             # leader-owned transfer behind the previous one (mode 0's
             # sends are pooled for the same reason, node.go:343-349).
-            self.loop.submit(self._send_one, dest, layer_id, layer)
+            self.loop.submit(self._send_one, dest, layer_id, layer, job_id)
             return
         self.node.transport.send(
             owner, RetransmitMsg(self.node.my_id, layer_id, dest,
-                                 epoch=self.epoch)
+                                 epoch=self.epoch, job_id=job_id)
         )
 
 
@@ -1751,8 +2050,10 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                  expected_nodes: Optional[Set[NodeID]] = None,
                  failure_timeout: float = 0.0, fabric=None, placement=None,
                  **ha):
-        # layer -> dest -> job
-        self.jobs: Dict[LayerID, Dict[NodeID, _JobInfo]] = {}
+        # layer -> dest -> pull job (the mode-2 work-stealing table;
+        # renamed from ``jobs`` when the SERVICE job plane took that
+        # name on the base class, docs/service.md)
+        self._pull_jobs: Dict[LayerID, Dict[NodeID, _JobInfo]] = {}
         self.sender_load: Dict[NodeID, int] = {}
         # sender -> (avg job duration seconds, completed count)
         self.performance: Dict[NodeID, Tuple[float, int]] = {}
@@ -1770,8 +2071,8 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         with self._lock:
             self.sender_load.pop(node_id, None)
             self.performance.pop(node_id, None)
-            for layer_id in list(self.jobs):
-                dests = self.jobs[layer_id]
+            for layer_id in list(self._pull_jobs):
+                dests = self._pull_jobs[layer_id]
                 dests.pop(node_id, None)
                 for job in dests.values():
                     if job.sender == node_id:
@@ -1779,7 +2080,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                         job.status = _JobInfo.PENDING
                         job.t_start = None
                 if not dests:
-                    del self.jobs[layer_id]
+                    del self._pull_jobs[layer_id]
         super().crash(node_id)
 
     def _release_pending_load(self, job: "_JobInfo") -> None:
@@ -1805,7 +2106,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
             meta = held.get(layer_id)
             if meta is not None and delivered(meta):
                 continue
-            old = self.jobs.get(layer_id, {}).get(dest)
+            old = self._pull_jobs.get(layer_id, {}).get(dest)
             if old is not None and not replace_existing:
                 continue  # already queued or in flight
             sender = self._min_loaded_sender(layer_id)
@@ -1815,7 +2116,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                 continue
             if old is not None:
                 self._release_pending_load(old)
-            self.jobs.setdefault(layer_id, {})[dest] = _JobInfo(sender)
+            self._pull_jobs.setdefault(layer_id, {})[dest] = _JobInfo(sender)
             self.sender_load[sender] = self.sender_load.get(sender, 0) + 1
             kicked.add(sender)
         return kicked
@@ -1830,7 +2131,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         orphaned = False
         with self._lock:
             self._build_layer_owners()
-            for layer_id, dests in self.jobs.items():
+            for layer_id, dests in self._pull_jobs.items():
                 for dest, job in dests.items():
                     if job.sender != node_id or job.status != _JobInfo.SENDING:
                         continue
@@ -1861,15 +2162,15 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         kicked: Set[NodeID] = set()
         with self._lock:
             self._build_layer_owners()
-            for layer_id in list(self.jobs):
-                dests = self.jobs[layer_id]
+            for layer_id in list(self._pull_jobs):
+                dests = self._pull_jobs[layer_id]
                 for dest in list(dests):
                     job = dests[dest]
                     if (layer_id not in self.assignment.get(dest, {})
                             and job.status == _JobInfo.PENDING):
                         self._release_pending_load(dests.pop(dest))
                 if not dests:
-                    del self.jobs[layer_id]
+                    del self._pull_jobs[layer_id]
             for dest in self.assignment:
                 kicked |= self._schedule_missing_locked(dest)
         for sender in kicked:
@@ -1881,7 +2182,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         which would rebuild the live job table from scratch)."""
         kicked: Set[NodeID] = set()
         with self._lock:
-            for layer_id, dests in self.jobs.items():
+            for layer_id, dests in self._pull_jobs.items():
                 for dest, job in dests.items():
                     if job.sender is not None:
                         continue
@@ -1911,13 +2212,13 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                 for layer_id in layer_ids:
                     meta = held.get(layer_id)
                     if meta is None or not delivered(meta):
-                        self.jobs.setdefault(layer_id, {})[dest] = _JobInfo()
+                        self._pull_jobs.setdefault(layer_id, {})[dest] = _JobInfo()
             for node_id in self.status:
                 self.sender_load.setdefault(node_id, 0)
             for layer_id in sorted_layers:
-                for dest in sorted(self.jobs.get(layer_id, {})):
+                for dest in sorted(self._pull_jobs.get(layer_id, {})):
                     sender = self._min_loaded_sender(layer_id)
-                    self.jobs[layer_id][dest] = _JobInfo(sender)
+                    self._pull_jobs[layer_id][dest] = _JobInfo(sender)
                     self.sender_load[sender] += 1
                     log.info("job assignment", layer=layer_id, sender=sender)
             # Kick every node that might have work: assignment dests AND
@@ -1962,7 +2263,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         best = None
         min_owners = 1 << 62
         for layer_id in self.status.get(node_id, {}):
-            for dest, job in self.jobs.get(layer_id, {}).items():
+            for dest, job in self._pull_jobs.get(layer_id, {}).items():
                 if job.sender != node_id or job.status != _JobInfo.PENDING:
                     continue
                 owners = len(self.layer_owners.get(layer_id, ()))
@@ -1981,7 +2282,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         best = None  # (layer, dest, sender, owner_count, time_to_finish)
         for layer_id in self.status.get(node_id, {}):
             owner_count = len(self.layer_owners.get(layer_id, ()))
-            for dest, job in self.jobs.get(layer_id, {}).items():
+            for dest, job in self._pull_jobs.get(layer_id, {}).items():
                 sender = job.sender
                 if sender is None:
                     continue
@@ -2033,20 +2334,22 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                     return
                 layer_id, dest, prev_sender = stolen
                 self.sender_load[prev_sender] -= 1
-                job = self.jobs[layer_id][dest]
+                job = self._pull_jobs[layer_id][dest]
                 job.sender = node_id
                 job.status = _JobInfo.SENDING
                 job.t_start = time.monotonic()
                 sender = node_id
                 log.debug("steal a job", layer=layer_id, frm=prev_sender, to=node_id)
-        self.send_retransmit(layer_id, sender, dest)
+        jid_owner = self.jobs.owner_of(dest, layer_id)
+        self.send_retransmit(layer_id, sender, dest,
+                             job_id=jid_owner[1] if jid_owner else "")
 
     def handle_ack(self, msg: AckMsg) -> None:
         """Completion accounting + throughput tracking + re-scheduling
         (node.go:741-807)."""
         super().handle_ack(msg)
         with self._lock:
-            job = self.jobs.get(msg.layer_id, {}).get(msg.src_id)
+            job = self._pull_jobs.get(msg.layer_id, {}).get(msg.src_id)
             if job is None:
                 return  # e.g. a client-loaded layer: no tracked job
             log.info("job completed", node=job.sender, layerID=msg.layer_id)
@@ -2057,7 +2360,7 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
             self.performance[job.sender] = ((avg * count + dur) / (count + 1), count + 1)
             # The new owner can now serve this layer too.
             self.layer_owners.setdefault(msg.layer_id, set()).add(msg.src_id)
-            del self.jobs[msg.layer_id][msg.src_id]
+            del self._pull_jobs[msg.layer_id][msg.src_id]
             sender = job.sender
         if sender is not None:
             self._assign_new_job_safe(sender)
@@ -2106,6 +2409,9 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         # full solve: that is the prediction the TTD clock started on).
         self.predicted_ttd_ms = 0
         self.solve_ms = 0.0
+        # job_id -> its priority tier's solved min time (ms): per-job
+        # pacing for multi-job dispatches (docs/service.md).
+        self._tier_time: Dict[str, int] = {}
         if topology is not None:
             # Pre-warm the LP solver import (scipy + HiGHS, ~1-2 s cold)
             # off the critical path: the first assign_jobs otherwise pays
@@ -2170,6 +2476,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         # (crash() below); a whole-layer re-plan here
                         # would defeat the point of range salvage.
                         continue
+                    if self._content_skip_locked(dest, layer_id):
+                        # Content-addressed delta (docs/service.md): the
+                        # dest holds these exact bytes under another
+                        # layer id; its digest-stamp resolve acks the
+                        # pair with zero wire bytes.
+                        continue
                     held = self.status.get(dest, {}).get(layer_id)
                     if held is not None:
                         # Already in RAM/HBM: satisfaction counts it as-is
@@ -2200,11 +2512,46 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 log.info("No jobs to assign other than self-assignment")
                 return 0, self_jobs, {}
             t0 = time.monotonic()
-            graph = make_flow_graph(
-                modified, self.status, layer_sizes, self.node_network_bw,
-                remaining=remaining_sizes, topology=self.topology,
-            )
-            t, jobs = graph.get_job_assignment()
+            # Multi-job service (docs/service.md): when admitted jobs
+            # claim pairs, ALL demands — base run + every active job —
+            # solve as one shared-capacity flow problem per priority
+            # tier (sched.flow.solve_joint); higher tiers consume link
+            # budget first (preemption at the re-plan), equal tiers
+            # fair-share one graph.  With no jobs active this is the
+            # single-graph path, byte-identical to the pre-service
+            # planner.
+            by_tier: Dict[Tuple[int, str], Assignment] = {}
+            tagged = False
+            for dest, lids in modified.items():
+                for layer_id, meta in lids.items():
+                    owner = self.jobs.owner_of(dest, layer_id)
+                    key = owner if owner is not None else (0, "")
+                    tagged = tagged or owner is not None
+                    by_tier.setdefault(key, {}).setdefault(
+                        dest, {})[layer_id] = meta
+            if not tagged:
+                graph = make_flow_graph(
+                    modified, self.status, layer_sizes,
+                    self.node_network_bw,
+                    remaining=remaining_sizes, topology=self.topology,
+                )
+                t, jobs = graph.get_job_assignment()
+            else:
+                demands = [
+                    (prio, jid, asg,
+                     self._job_avoid_locked(jid, asg) if jid else set())
+                    for (prio, jid), asg in sorted(by_tier.items())]
+                t_by_prio, jobs = solve_joint(
+                    demands, self.status, layer_sizes,
+                    self.node_network_bw, remaining=remaining_sizes,
+                    topology=self.topology,
+                    graph_factory=make_flow_graph)
+                t = max(t_by_prio.values(), default=0)
+                # Per-job pacing: each send's rate budget comes from its
+                # OWN tier's min time (a preempting tier must not be
+                # slowed to the laggard tier's horizon).
+                self._tier_time = {d[1]: t_by_prio.get(d[0], t)
+                                   for d in demands}
         if gaps_by_pair:
             jobs = self._remap_resumed_jobs(jobs, gaps_by_pair)
         solve_ms = round((time.monotonic() - t0) * 1000, 3)
@@ -2218,6 +2565,33 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             predicted_s=round(t / 1000.0, 6),
         )
         return t, self_jobs, jobs
+
+    def _job_avoid_locked(self, jid: str, asg: Assignment) -> Set[NodeID]:
+        """Lock held.  The sender-avoid set for one job's tier: the
+        job's explicit ``avoid_sources``, plus — for "repair" jobs —
+        every rate-limited MODELED source of a wanted layer whenever an
+        unlimited delivered holder also exists (the refill policy: a
+        repaired node pulls from the nearest current holder, sparing
+        the busy origin seeder).  Advisory: solve_joint falls back to
+        all sources, loudly, if avoidance starves the tier."""
+        job = self.jobs.get(jid)
+        if job is None:
+            return set()
+        avoid = set(job.avoid_sources)
+        if job.kind != "repair":
+            return avoid
+        for lids in asg.values():
+            for lid in lids:
+                slow: Set[NodeID] = set()
+                free: Set[NodeID] = set()
+                for n, row in self.status.items():
+                    meta = row.get(lid)
+                    if meta is None or not delivered(meta):
+                        continue
+                    (slow if meta.limit_rate else free).add(n)
+                if free - avoid:
+                    avoid |= slow
+        return avoid
 
     @staticmethod
     def _remap_resumed_jobs(
@@ -2234,7 +2608,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     continue
                 for off, size in map_through_gaps(gaps, job.offset, job.data_size):
                     out.setdefault(sender, []).append(
-                        FlowJob(sender, job.layer_id, size, off, job.dest_id)
+                        FlowJob(sender, job.layer_id, size, off,
+                                job.dest_id, job_id=job.job_id)
                     )
         return out
 
@@ -2329,13 +2704,18 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         job.data_size, job.offset, rate, epoch=self.epoch,
                     ),
                 )
+        with self._lock:
+            tier_time = dict(self._tier_time)
         for sender, job_list in jobs.items():
             for job in job_list:
                 dest = job.dest_id
-                rate = rate_for(job.data_size, min_time_ms)
+                t_job = (tier_time.get(job.job_id, min_time_ms)
+                         if job.job_id else min_time_ms)
+                rate = rate_for(job.data_size, t_job or min_time_ms)
                 log.debug(
                     "dispatching a job",
                     layer=job.layer_id, sender=sender, rate_mibps=rate >> 20,
+                    job=job.job_id or None,
                 )
                 try:
                     self.node.transport.send(
@@ -2343,7 +2723,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         FlowRetransmitMsg(
                             self.node.my_id, job.layer_id, dest,
                             job.data_size, job.offset, rate,
-                            epoch=self.epoch,
+                            epoch=self.epoch, job_id=job.job_id,
                         ),
                     )
                 except (OSError, KeyError) as e:

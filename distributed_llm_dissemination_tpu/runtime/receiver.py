@@ -69,6 +69,7 @@ from ..utils.logging import log
 from .checkpoint import LayerCheckpointStore
 from .failure import HeartbeatSender
 from .node import MessageLoop, Node
+from .store import ContentStore
 from .send import (
     NackRetransmitter,
     contribute_device_plan,
@@ -236,6 +237,14 @@ class ReceiverNode:
         self._digest_retries: Dict[int, int] = {}
         self._nack_counts: Dict[Tuple[int, int], int] = {}
         self.nacker = NackRetransmitter()
+        # Content-addressed layer store (runtime/store.py,
+        # docs/service.md): digest -> locally held layer ids, fed by
+        # this node's own announce-time hashes and ack-gate verifies.
+        # When a digest stamp names an ASSIGNED layer this node doesn't
+        # hold but whose bytes it provably has under another id (a v2
+        # rollout's unchanged layer), the store aliases the bytes and
+        # acks instantly -- zero wire bytes.
+        self.content_store = ContentStore()
         # Per-layer streaming boot staging (runtime/stream_boot.py):
         # each completed blob's decode + host→device placement runs the
         # moment its interval set completes, concurrent with the
@@ -591,6 +600,7 @@ class ReceiverNode:
             d = integrity.digest_layer_src(src)
             if d is not None:
                 self._own_digests[lid] = d
+                self.content_store.index(lid, d)
         with self._lock:
             return dict(self._own_digests)
 
@@ -610,6 +620,7 @@ class ReceiverNode:
             self.layer_digests.update(msg.digests)
         log.debug("layer digests stamped", n=len(msg.digests))
         self._recheck_stamped(list(msg.digests))
+        self._try_content_resolve(sorted(msg.digests))
 
     def _recheck_stamped(self, lids) -> None:
         """Retroactive digest verification for layers that landed before
@@ -627,6 +638,73 @@ class ReceiverNode:
                       "demoted", layerID=lid)
             if self._bump_digest_retry(lid):
                 self._request_replan()
+
+    def _resolve_pending_for_layer(self, lid) -> None:
+        """A layer just COMMITTED to the store: it can be the DONOR a
+        stamped-but-missing layer was waiting for (the stamp arrived
+        before these bytes did).  Without this re-check the pair would
+        wedge — the leader's content index learns the holding from the
+        ack and skips shipping, while nothing else ever re-runs the
+        resolve.  Must not be called under ``self._lock``."""
+        with self._lock:
+            digest = (self._own_digests.get(lid)
+                      or self.layer_digests.get(lid))
+            pending = ([l for l, d in self.layer_digests.items()
+                        if d == digest and l not in self.layers]
+                       if digest else [])
+        if pending:
+            self._try_content_resolve(sorted(pending))
+
+    def _try_content_resolve(self, lids) -> None:
+        """Content-addressed instant resolve (docs/service.md): for each
+        stamped layer this node does NOT hold, check the content store
+        for locally held bytes with the SAME digest (a v2 rollout's
+        unchanged layer under a new id).  A hit aliases the held buffer
+        under the new layer id and acks immediately — zero wire bytes —
+        which is what lets a delta rollout ship only changed layers.
+        The alias shares the donor's buffer (received layers are never
+        mutated after commit) and inherits its verified digest."""
+        for lid in lids:
+            with self._lock:
+                if lid in self.layers:
+                    continue
+                digest = self.layer_digests.get(lid)
+            if not digest:
+                continue
+            donor_lid = self.content_store.lookup(digest)
+            if donor_lid is None:
+                continue
+            with self._lock:
+                if lid in self.layers:
+                    continue
+                donor = self.layers.get(donor_lid)
+                # Only a delivered-grade donor (host bytes in RAM, or
+                # HBM with the retained host buffer) can vouch: an ack
+                # means "in memory", and a DISK-only copy isn't.
+                if (donor is None or donor.inmem_data is None
+                        or donor.meta.location not in
+                        (LayerLocation.INMEM, LayerLocation.HBM)):
+                    continue
+                alias = LayerSrc(
+                    inmem_data=donor.inmem_data, fp=donor.fp,
+                    data_size=donor.data_size,
+                    meta=LayerMeta(location=LayerLocation.INMEM,
+                                   source_type=donor.meta.source_type),
+                )
+                self.layers[lid] = alias
+                self._own_digests[lid] = digest
+                self._digest_ok.add(lid)
+            self.content_store.index(lid, digest)
+            trace.count("store.resolved_layers")
+            trace.count("store.resolved_bytes", alias.data_size)
+            log.info("content store resolved layer from local bytes; "
+                     "no wire transfer", layerID=lid, donor=donor_lid,
+                     bytes=alias.data_size, digest=digest)
+            # Streamed boot staging treats the alias like any completed
+            # layer; then ack so the leader credits every job waiting
+            # on the pair.
+            self._boot_stream_submit(lid, alias)
+            self._send_ack(lid, alias.meta.location)
 
     def _bump_digest_retry(self, lid) -> bool:
         """Count one digest-mismatch recovery round for a layer; False
@@ -654,6 +732,7 @@ class ReceiverNode:
         with self._lock:
             self.layers.pop(lid, None)
             self._own_digests.pop(lid, None)
+        self.content_store.forget(lid)
         if self._boot_stager is not None:
             self._boot_stager.invalidate(lid)
 
@@ -724,6 +803,7 @@ class ReceiverNode:
                 # digest retry) never re-hashes gigabytes it already
                 # verified on the handler thread.
                 self._own_digests[lid] = expected
+            self.content_store.index(lid, expected)
             log.info("layer digest verified", layerID=lid,
                      digest_ms=round(dt * 1000, 1), bytes=len(data))
             return True
@@ -888,6 +968,7 @@ class ReceiverNode:
                 # delivered totals reconcile byte-exactly against the
                 # goal state in the run report.
                 telemetry.link_add(msg.src_id, self.node.my_id,
+                                   job=msg.job_id,
                                    delivered_bytes=src.data_size)
         log.debug("saved layer in memory", layerID=msg.layer_id)
         loc = self._stage_to_hbm(msg.layer_id, src)
@@ -895,6 +976,9 @@ class ReceiverNode:
         # starts NOW, overlapping the remaining layers' transfers.
         self._boot_stream_submit(msg.layer_id, src)
         self._send_to_leader(AckMsg(self.node.my_id, msg.layer_id, loc))
+        # The committed layer may be the donor a stamped-but-missing
+        # layer was waiting for (stamp-before-donor race).
+        self._resolve_pending_for_layer(msg.layer_id)
 
     # --------------------------------------------------- device-fabric plane
 
@@ -1795,7 +1879,8 @@ class RetransmitReceiverNode(ReceiverNode):
             fetch_from_client(self.node, msg.layer_id, msg.dest_id)
             return
         try:
-            send_layer(self.node, msg.dest_id, msg.layer_id, layer)
+            send_layer(self.node, msg.dest_id, msg.layer_id, layer,
+                       job_id=msg.job_id)
         except (OSError, KeyError) as e:
             log.error("failed to send layer", dest=msg.dest_id, err=repr(e))
 
@@ -2368,7 +2453,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             # per-link delivered totals reconcile byte-exactly against
             # delivered layer bytes in the run report.
             telemetry.link_add(
-                msg.src_id, self.node.my_id,
+                msg.src_id, self.node.my_id, job=msg.job_id,
                 delivered_bytes=sum(hi - lo for lo, hi in claims))
         complete = self._commit_fragment(lid, tok, msg.total_size)
         if journal and not complete:
@@ -2506,6 +2591,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
+        # Stamp-before-donor race: this completed layer may be the
+        # donor a stamped-but-missing layer was waiting for.
+        self._resolve_pending_for_layer(lid)
 
     def _demote_corrupt_layer(self, lid) -> None:
         """Mode-3 demotion: beyond the store entry, also re-open the

@@ -53,15 +53,19 @@ def _fragment_bytes(rate: int) -> int:
     return FLOW_FRAGMENT_BYTES
 
 
-def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc) -> None:
+def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
+               job_id: str = "") -> None:
     """Send one full layer to ``dest``; client-held layers are fetched via
-    the pipe mechanism instead (node.go:354-365)."""
+    the pipe mechanism instead (node.go:354-365).  ``job_id`` tags the
+    frames with the admitted dissemination job they serve ("" = the base
+    run) so link telemetry splits per job (docs/service.md)."""
     if layer.meta.location == LayerLocation.CLIENT:
         log.debug("loading layer from client", layer=layer_id)
         fetch_from_client(node, layer_id, dest)
         return
     node.transport.send(
-        dest, LayerMsg(node.my_id, layer_id, layer, layer.data_size)
+        dest, LayerMsg(node.my_id, layer_id, layer, layer.data_size,
+                       job_id=job_id)
     )
 
 
@@ -389,7 +393,8 @@ def handle_flow_retransmit(
                                      msg.rate)
             node.transport.send(
                 msg.dest_id,
-                LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size),
+                LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size,
+                         job_id=msg.job_id),
             )
             sent += n
     elif layer.meta.location == LayerLocation.CLIENT:
@@ -408,7 +413,8 @@ def handle_flow_retransmit(
                 meta=LayerMeta(location=LayerLocation.INMEM),
             )
             node.transport.deliver().put(
-                LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size)
+                LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size,
+                         job_id=msg.job_id)
             )
 
         threading.Thread(target=_simulate_client_fetch, daemon=True).start()

@@ -1,0 +1,140 @@
+"""Content-addressed layer store (docs/service.md).
+
+Layers are keyed by their PR-4 digest stamp (``xxh3:<hex>`` /
+blake2b hex — ``utils.integrity.layer_digest``), which turns the layer
+store into a content store: a v2 rollout whose layer 103 hashes the same
+as the v1 layer 3 a node already holds resolves LOCALLY — zero wire
+bytes — and a repaired node refills from whichever CURRENT holder is
+nearest/fastest, not the original seeder.
+
+Two small classes, two sides of the same key:
+
+- :class:`ContentStore` — the NODE half: digest → locally held layer
+  ids.  Populated wherever this process provably knows a layer's bytes
+  hash to a digest (its own announce-time hash, an ack-gate verify);
+  consulted when the leader's digest stamp names an ASSIGNED layer this
+  node doesn't hold — a digest hit aliases the held bytes under the new
+  layer id and acks instantly.
+- :class:`ContentIndex` — the LEADER half: digest → (node, layer)
+  holders, rebuilt from announces (authoritative per node) and extended
+  by acks (the leader stamped the digest, so a delivered copy provably
+  carries it).  The planner skips shipping a (dest, layer) pair whose
+  digest the dest already holds under ANY layer id — the dest's own
+  resolve-and-ack completes the pair.
+
+Digest trust model: both sides only index digests that were locally
+verified (node) or announced/stamped through the PR-4 integrity plane
+(leader) — the same trust the digest verification gate already places
+in those sources (docs/integrity.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core.types import LayerID, NodeID
+
+
+class ContentStore:
+    """digest → layer ids this node holds with those exact bytes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_digest: Dict[str, Set[LayerID]] = {}
+        self._by_layer: Dict[LayerID, str] = {}
+
+    def index(self, lid: LayerID, digest: str) -> None:
+        if not digest:
+            return
+        with self._lock:
+            old = self._by_layer.get(lid)
+            if old == digest:
+                return
+            if old is not None:
+                ids = self._by_digest.get(old)
+                if ids is not None:
+                    ids.discard(lid)
+                    if not ids:
+                        del self._by_digest[old]
+            self._by_layer[lid] = digest
+            self._by_digest.setdefault(digest, set()).add(lid)
+
+    def forget(self, lid: LayerID) -> None:
+        """Drop a layer (demoted as corrupt, evicted): its bytes can no
+        longer vouch for the digest."""
+        with self._lock:
+            digest = self._by_layer.pop(lid, None)
+            if digest is not None:
+                ids = self._by_digest.get(digest)
+                if ids is not None:
+                    ids.discard(lid)
+                    if not ids:
+                        del self._by_digest[digest]
+
+    def lookup(self, digest: str) -> Optional[LayerID]:
+        """A local layer id holding these bytes (lowest id for
+        determinism), or None."""
+        with self._lock:
+            ids = self._by_digest.get(digest)
+            return min(ids) if ids else None
+
+    def digest_of(self, lid: LayerID) -> Optional[str]:
+        with self._lock:
+            return self._by_layer.get(lid)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._by_layer)
+
+
+class ContentIndex:
+    """Leader-side digest → holders map.
+
+    An announce is the node's authoritative inventory, so
+    :meth:`reset_node` replaces that node's contribution wholesale
+    (a restarted node no longer vouches for its dead incarnation's
+    bytes); an ack extends it (the delivered copy verified against the
+    stamped digest before acking)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # node -> {layer: digest}; the digest->holders view is derived.
+        self._node_layers: Dict[NodeID, Dict[LayerID, str]] = {}
+
+    def reset_node(self, node: NodeID,
+                   digests: Optional[Dict[LayerID, str]] = None) -> None:
+        with self._lock:
+            if digests:
+                self._node_layers[node] = {
+                    int(l): str(d) for l, d in digests.items()}
+            else:
+                self._node_layers.pop(node, None)
+
+    def add(self, node: NodeID, lid: LayerID, digest: Optional[str]) -> None:
+        if not digest:
+            return
+        with self._lock:
+            self._node_layers.setdefault(node, {})[lid] = digest
+
+    def drop_node(self, node: NodeID) -> None:
+        with self._lock:
+            self._node_layers.pop(node, None)
+
+    def node_has(self, node: NodeID, digest: str) -> bool:
+        """Whether ``node`` provably holds bytes hashing to ``digest``
+        under ANY layer id."""
+        if not digest:
+            return False
+        with self._lock:
+            return digest in (self._node_layers.get(node) or {}).values()
+
+    def holders(self, digest: str) -> List[Tuple[NodeID, LayerID]]:
+        """Every (node, layer) currently vouched for the digest, sorted."""
+        out: List[Tuple[NodeID, LayerID]] = []
+        with self._lock:
+            for node in sorted(self._node_layers):
+                for lid, d in sorted(self._node_layers[node].items()):
+                    if d == digest:
+                        out.append((node, lid))
+        return out

@@ -1,2 +1,3 @@
-from .flow import FlowGraph, FlowJob, FlowJobsMap  # noqa: F401
+from .flow import FlowGraph, FlowJob, FlowJobsMap, solve_joint  # noqa: F401
+from .jobs import Job, JobManager, merge_assignments  # noqa: F401
 from .native import NativeFlowGraph, make_flow_graph  # noqa: F401

@@ -202,17 +202,146 @@ class PodTopology:
 class FlowJob:
     """One partial-layer send command (flow.go:30-39), extended with the
     destination — the reference supports only one dest per layer
-    (node.go:1078); carrying the dest on the job lifts that."""
+    (node.go:1078); carrying the dest on the job lifts that.
+
+    ``job_id`` tags the admitted dissemination job this send serves
+    (docs/service.md; "" = the base single-run goal) — it rides the
+    dispatch command onto the wire so link telemetry can split per
+    job."""
 
     sender_id: NodeID
     layer_id: LayerID
     data_size: int
     offset: int
     dest_id: NodeID  # required: dispatch trusts it unconditionally
+    job_id: str = ""
 
 
 # sender -> its jobs
 FlowJobsMap = Dict[NodeID, List[FlowJob]]
+
+
+# The preemption floor: a lower priority tier keeps at least 1/16 of
+# every node's bandwidth even when higher tiers booked it all — weighted
+# preemption, not absolute starvation, so every admitted job always gets
+# a feasible (if slow) plan and completes without waiting for a
+# completion-triggered re-plan that mode 3 doesn't have.
+PREEMPT_FLOOR_SHIFT = 4
+
+
+def solve_joint(
+    demands,
+    status: Status,
+    layer_sizes: Dict[LayerID, int],
+    node_network_bw: Dict[NodeID, int],
+    remaining: Optional[Dict[Tuple[LayerID, NodeID], int]] = None,
+    topology: Optional["PodTopology"] = None,
+    graph_factory=None,
+) -> Tuple[Dict[int, int], FlowJobsMap]:
+    """All active jobs' remaining demands as ONE flow problem per
+    priority tier (docs/service.md) — the multi-job generalization of a
+    single ``FlowGraph.get_job_assignment`` call.
+
+    ``demands``: ``[(priority, job_id, assignment), ...]`` or
+    ``[(priority, job_id, assignment, avoid_sources), ...]`` — each
+    entry one job's remaining (dest → layers) demand; ``avoid_sources``
+    (a set of node ids) excludes those nodes as SENDERS for this job's
+    tier (the repair-refill policy: spare the busy origin seeder when
+    current holders can serve), falling back to all sources — loudly —
+    if avoidance leaves the tier undeliverable.
+
+    Tiers solve in DESCENDING priority order; each tier sees the node
+    bandwidths minus the rates already committed to higher tiers
+    (bytes/t of the tier's own plan) — floored at 1/2^4 of each node's
+    bandwidth (``PREEMPT_FLOOR_SHIFT``), so a high-priority job preempts
+    by reclaiming link budget at the re-plan while lower tiers are
+    slowed, never starved.  EQUAL priorities (with equal avoid sets)
+    merge into one graph — the max-flow's fair share over the common
+    links is the measured capacity split between them.  Within a tier,
+    a (dest, layer) pair two jobs both want is planned ONCE (one
+    delivery satisfies both); the pair is attributed to the
+    lexically-first job id for telemetry.
+
+    Returns ``({priority: tier_min_time_ms}, jobs)`` with every emitted
+    ``FlowJob`` tagged by its owning job id.  Multiple avoid-groups at
+    one priority report the group max under that priority key."""
+    factory = graph_factory if graph_factory is not None else FlowGraph
+    remaining = remaining or {}
+    tiers: Dict[Tuple[int, Tuple[NodeID, ...]],
+                List[Tuple[str, Assignment]]] = {}
+    for entry in demands:
+        prio, jid, asg = entry[0], entry[1], entry[2]
+        avoid = tuple(sorted(entry[3])) if len(entry) > 3 and entry[3] \
+            else ()
+        tiers.setdefault((int(prio), avoid), []).append((str(jid), asg))
+    used_rate: Dict[NodeID, int] = {}
+    out_jobs: FlowJobsMap = {}
+    t_by_prio: Dict[int, int] = {}
+    # Descending priority; within one priority, the un-avoiding group
+    # first (deterministic).
+    for prio, avoid in sorted(tiers, key=lambda k: (-k[0], k[1])):
+        merged: Assignment = {}
+        owner: Dict[Tuple[LayerID, NodeID], str] = {}
+        for jid, asg in sorted(tiers[(prio, avoid)], key=lambda x: x[0]):
+            for dest, lids in asg.items():
+                row = merged.setdefault(dest, {})
+                for lid, meta in lids.items():
+                    if lid not in row:
+                        row[lid] = meta
+                        owner[(lid, dest)] = jid
+        if not merged:
+            continue
+        bw_res = {n: max(bw - used_rate.get(n, 0),
+                         bw >> PREEMPT_FLOOR_SHIFT)
+                  for n, bw in node_network_bw.items()}
+        rem = {(lid, dest): v for (lid, dest), v in remaining.items()
+               if lid in merged.get(dest, {})}
+        required = sum(
+            rem.get((lid, dest), layer_sizes.get(lid, 0))
+            for dest, lids in merged.items() for lid in lids)
+        status_view = status
+        if avoid:
+            status_view = {n: row for n, row in status.items()
+                           if n not in set(avoid)}
+        graph = factory(merged, status_view, layer_sizes, bw_res,
+                        remaining=rem, topology=topology)
+        t, jobs = graph.get_job_assignment()
+        planned = sum(j.data_size for jl in jobs.values() for j in jl)
+        if avoid and planned < required:
+            # Avoidance starved the tier (the spared seeder was the
+            # only holder of something): deliverability beats the
+            # politeness policy — replan over every source, loudly.
+            log.warn("avoid_sources left a tier undeliverable; "
+                     "replanning over all sources", priority=prio,
+                     avoided=list(avoid), planned=planned,
+                     required=required)
+            graph = factory(merged, status, layer_sizes, bw_res,
+                            remaining=rem, topology=topology)
+            t, jobs = graph.get_job_assignment()
+        t_by_prio[prio] = max(t_by_prio.get(prio, 0), t)
+        per_dest: Dict[NodeID, int] = {}
+        for sender, job_list in jobs.items():
+            sent = 0
+            for job in job_list:
+                job.job_id = owner.get((job.layer_id, job.dest_id), "")
+                out_jobs.setdefault(sender, []).append(job)
+                sent += job.data_size
+                per_dest[job.dest_id] = (per_dest.get(job.dest_id, 0)
+                                         + job.data_size)
+            if t > 0:
+                # This tier's plan consumes sender NIC at bytes/t for
+                # its duration; the next (lower) tier plans over the
+                # residue — the preemption mechanism.
+                used_rate[sender] = (used_rate.get(sender, 0)
+                                     + sent * TIME_SCALE // max(1, t))
+        if t > 0:
+            for dest, nbytes in per_dest.items():
+                used_rate[dest] = (used_rate.get(dest, 0)
+                                   + nbytes * TIME_SCALE // max(1, t))
+        log.info("joint tier solved", priority=prio, min_time_ms=t,
+                 jobs=sorted({jid for jid, _ in tiers[(prio, avoid)]}),
+                 avoided=list(avoid))
+    return t_by_prio, out_jobs
 
 
 def _search_min_time(feasible, lo: int = 1):

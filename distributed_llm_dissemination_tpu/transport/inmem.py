@@ -111,6 +111,7 @@ class InmemTransport(Transport):
         # recorder — in-process there is no wire to wait on, so only
         # bytes/frames are filed (verify time is filed by _frame_ok).
         telemetry.link_add(message.src_id, self.node_id,
+                           job=message.job_id,
                            rx_bytes=len(data), rx_frames=1)
         landed = LayerSrc(
             inmem_data=data,
@@ -125,6 +126,7 @@ class InmemTransport(Transport):
             total_size=message.total_size,
             crc=crc,
             xxh3=xxh3,
+            job_id=message.job_id,
         )
         with self._lock:
             pipe_dest = self._pipes.pop(message.layer_id, None)
@@ -181,6 +183,7 @@ class InmemTransport(Transport):
         self._resolve(dest_id)._deliver_local(message)
         if isinstance(message, LayerMsg):
             telemetry.link_add(message.src_id, dest_id,
+                               job=message.job_id,
                                tx_bytes=message.layer_src.data_size,
                                tx_frames=1)
 

@@ -102,6 +102,13 @@ class MsgType(enum.IntEnum):
     # estimates offset = t1 - (t0 + t2)/2 (NTP's midpoint) and LOGS it,
     # so cli/trace.py can line multi-host Perfetto timelines up on the
     # leader's clock without any cross-host time sync daemon.
+    # JOB_SUBMIT / JOB_STATUS — the dissemination service plane
+    # (docs/service.md): a submitter asks the long-lived leader daemon
+    # to admit one dissemination job (a target Assignment + priority +
+    # optional per-layer content digests for delta resolution); the
+    # leader answers — and answers `-jobs` queries — with the admitted
+    # job table (states, remaining pairs, drop counts).  Omitted-field
+    # wire-compatible like every extension.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -117,6 +124,8 @@ class MsgType(enum.IntEnum):
     SOURCE_DEAD = 20
     METRICS_REPORT = 21
     TIME_SYNC = 22
+    JOB_SUBMIT = 23
+    JOB_STATUS = 24
 
 
 def _epoch_to_payload(payload: dict, epoch: int) -> dict:
@@ -208,28 +217,39 @@ class AckMsg:
         )
 
 
+def _job_to_payload(payload: dict, job_id: str) -> dict:
+    """Stamp the dissemination-job tag, omitted-field style: the base
+    single-run goal ("" — every pre-service run) adds nothing, so the
+    wire format is byte-identical unless a job plane is active."""
+    if job_id:
+        payload["Job"] = str(job_id)
+    return payload
+
+
 @dataclasses.dataclass
 class RetransmitMsg:
     """Leader → owner: forward your copy of a layer to dest
     (message.go:94-118).  ``epoch``: the issuing leader's fencing epoch
-    (docs/failover.md); -1 = HA off."""
+    (docs/failover.md); -1 = HA off.  ``job_id``: the admitted job this
+    forward serves (docs/service.md; "" = the base run)."""
 
     src_id: NodeID
     layer_id: LayerID
     dest_id: NodeID
     epoch: int = -1
+    job_id: str = ""
 
     msg_type = MsgType.RETRANSMIT
 
     def to_payload(self) -> dict:
-        return _epoch_to_payload(
+        return _job_to_payload(_epoch_to_payload(
             {"SrcID": self.src_id, "LayerID": self.layer_id,
-             "DestID": self.dest_id}, self.epoch)
+             "DestID": self.dest_id}, self.epoch), self.job_id)
 
     @classmethod
     def from_payload(cls, d: dict) -> "RetransmitMsg":
         return cls(int(d["SrcID"]), int(d["LayerID"]), int(d["DestID"]),
-                   int(d.get("Epoch", -1)))
+                   int(d.get("Epoch", -1)), str(d.get("Job", "")))
 
 
 @dataclasses.dataclass
@@ -244,18 +264,19 @@ class FlowRetransmitMsg:
     offset: int
     rate: int
     epoch: int = -1
+    job_id: str = ""  # the admitted job this send serves ("" = base run)
 
     msg_type = MsgType.FLOW_RETRANSMIT
 
     def to_payload(self) -> dict:
-        return _epoch_to_payload({
+        return _job_to_payload(_epoch_to_payload({
             "SrcID": self.src_id,
             "LayerID": self.layer_id,
             "DestID": self.dest_id,
             "DataSize": self.data_size,
             "Offset": self.offset,
             "Rate": self.rate,
-        }, self.epoch)
+        }, self.epoch), self.job_id)
 
     @classmethod
     def from_payload(cls, d: dict) -> "FlowRetransmitMsg":
@@ -267,6 +288,7 @@ class FlowRetransmitMsg:
             int(d.get("Offset", 0)),
             int(d.get("Rate", 0)),
             int(d.get("Epoch", -1)),
+            str(d.get("Job", "")),
         )
 
 
@@ -305,6 +327,11 @@ class LayerMsg:
     stripe_off: int = 0
     crc: Optional[int] = None
     xxh3: Optional[int] = None
+    # Dissemination-job tag (docs/service.md): which admitted job this
+    # fragment serves ("" = the base run).  Advisory, telemetry-only —
+    # the flight recorder splits link rows per job so overlapping jobs
+    # stop sharing one undifferentiated counter pool.
+    job_id: str = ""
 
     msg_type = MsgType.LAYER
 
@@ -345,6 +372,10 @@ class LayerHeader:
     stripe_tid: str = ""
     crc: Optional[int] = None
     xxh3: Optional[int] = None
+    # Advisory dissemination-job tag (omitted when ""): lets the
+    # receiving transport file this frame's bytes on the per-job link
+    # row (docs/service.md).  A peer predating the field ignores it.
+    job_id: str = ""
 
     def to_payload(self) -> dict:
         payload = {
@@ -364,6 +395,8 @@ class LayerHeader:
             payload["Crc"] = int(self.crc)
         if self.xxh3 is not None:
             payload["Xxh3"] = int(self.xxh3)
+        if self.job_id:
+            payload["Job"] = str(self.job_id)
         return payload
 
     @classmethod
@@ -381,6 +414,7 @@ class LayerHeader:
             str(d.get("StripeTid", "")),
             int(d["Crc"]) if "Crc" in d else None,
             int(d["Xxh3"]) if "Xxh3" in d else None,
+            str(d.get("Job", "")),
         )
 
 
@@ -797,7 +831,10 @@ class ControlDeltaMsg:
     full ``snapshot``), applied to the standby's shadow leader state
     (``runtime/failover.ShadowLeaderState``).  ``kind`` names the
     mutation ("snapshot" | "status" | "ack" | "partial" | "crash" |
-    "assignment" | "digests" | "startup" | "plan_seq"); ``data`` is the
+    "assignment" | "digests" | "startup" | "plan_seq" | "revive" |
+    "metrics" | "base_assignment" | "job" | "job_done" — the last two
+    carry the dissemination service's admitted-job records,
+    docs/service.md); ``data`` is the
     kind-specific JSON payload; ``seq`` is a per-leader monotonic
     counter (diagnostics — the shadow is reconciliation-corrected at
     takeover, so ordering races only cost re-sent bytes, never
@@ -946,6 +983,101 @@ class TimeSyncMsg:
                    float(d.get("T1", 0.0)), bool(d.get("Reply", False)))
 
 
+@dataclasses.dataclass
+class JobSubmitMsg:
+    """Submitter → leader daemon: admit one dissemination job
+    (docs/service.md).  ``assignment`` is the job's goal state (the
+    single-run ``Assignment`` vocabulary — dest → layers it must end up
+    holding); ``priority`` (higher preempts) and ``kind`` ("push" |
+    "repair" | "ab" | ...) drive scheduling and reporting; ``digests``
+    optionally names each layer's content stamp (``xxh3:<hex>``) so the
+    content-addressed store ships only layers whose digest changed.
+    Idempotent per ``job_id``: a retried submit returns the existing
+    job's status.  The leader answers with a ``JobStatusMsg``."""
+
+    src_id: NodeID
+    job_id: str
+    assignment: dict  # Assignment: {dest: {layer_id: LayerMeta}}
+    priority: int = 0
+    kind: str = "push"
+    digests: dict = dataclasses.field(default_factory=dict)
+    avoid: list = dataclasses.field(default_factory=list)
+    epoch: int = -1
+
+    msg_type = MsgType.JOB_SUBMIT
+
+    def to_payload(self) -> dict:
+        payload = {
+            "SrcID": self.src_id,
+            "JobID": str(self.job_id),
+            "Assignment": {str(n): layer_ids_to_json(r)
+                           for n, r in self.assignment.items()},
+        }
+        if self.priority:
+            payload["Priority"] = int(self.priority)
+        if self.kind and self.kind != "push":
+            payload["Kind"] = str(self.kind)
+        if self.digests:
+            payload["Digests"] = {str(l): str(d)
+                                  for l, d in self.digests.items()}
+        if self.avoid:
+            payload["Avoid"] = [int(n) for n in self.avoid]
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "JobSubmitMsg":
+        return cls(
+            int(d["SrcID"]),
+            str(d["JobID"]),
+            {int(n): layer_ids_from_json(r or {})
+             for n, r in (d.get("Assignment") or {}).items()},
+            int(d.get("Priority", 0)),
+            str(d.get("Kind", "push")),
+            {int(l): str(h) for l, h in (d.get("Digests") or {}).items()},
+            [int(n) for n in d.get("Avoid") or []],
+            int(d.get("Epoch", -1)),
+        )
+
+
+@dataclasses.dataclass
+class JobStatusMsg:
+    """Job-table query/response (docs/service.md).  ``query=True`` asks
+    the leader for the full admitted-job table; the response carries
+    ``jobs`` — ``{job_id: summary}`` rows (``sched.jobs.Job.summary``:
+    state, priority, remaining/total pairs, drop counts).  Also the
+    leader's acknowledgement of a ``JobSubmitMsg`` (one row)."""
+
+    src_id: NodeID
+    jobs: dict = dataclasses.field(default_factory=dict)
+    query: bool = False
+    error: str = ""
+    epoch: int = -1
+
+    msg_type = MsgType.JOB_STATUS
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id}
+        if self.query:
+            payload["Query"] = True
+        if self.jobs:
+            payload["Jobs"] = {str(j): dict(row)
+                               for j, row in self.jobs.items()}
+        if self.error:
+            payload["Error"] = str(self.error)
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "JobStatusMsg":
+        return cls(
+            int(d["SrcID"]),
+            {str(j): dict(row)
+             for j, row in (d.get("Jobs") or {}).items()},
+            bool(d.get("Query", False)),
+            str(d.get("Error", "")),
+            int(d.get("Epoch", -1)),
+        )
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -967,6 +1099,8 @@ Message = Union[
     SourceDeadMsg,
     MetricsReportMsg,
     TimeSyncMsg,
+    JobSubmitMsg,
+    JobStatusMsg,
 ]
 
 _DECODERS = {
@@ -992,6 +1126,8 @@ _DECODERS = {
     MsgType.SOURCE_DEAD: SourceDeadMsg,
     MsgType.METRICS_REPORT: MetricsReportMsg,
     MsgType.TIME_SYNC: TimeSyncMsg,
+    MsgType.JOB_SUBMIT: JobSubmitMsg,
+    MsgType.JOB_STATUS: JobStatusMsg,
 }
 
 

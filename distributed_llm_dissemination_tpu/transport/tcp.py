@@ -361,7 +361,7 @@ class TcpTransport(Transport):
         placement, and the wire-wait vs verify stall split.  Dropped
         frames are filed by ``_notify_corrupt`` instead."""
         telemetry.link_add(
-            header.src_id, self.node_id,
+            header.src_id, self.node_id, job=header.job_id,
             rx_bytes=header.layer_size, rx_frames=1,
             rx_stripe_frames=1 if header.stripe_n > 1 else 0,
             rx_placed_frames=1 if placed else 0,
@@ -420,7 +420,8 @@ class TcpTransport(Transport):
             )
             src.placed_token = token
             self._queue.put(LayerMsg(header.src_id, header.layer_id, src,
-                                     header.total_size))
+                                     header.total_size,
+                                     job_id=header.job_id))
             return
         buf = alloc_recv_buffer(header.layer_size)
         view = memoryview(buf)
@@ -465,7 +466,8 @@ class TcpTransport(Transport):
             meta=LayerMeta(location=LayerLocation.INMEM),
         )
         self._queue.put(
-            LayerMsg(header.src_id, header.layer_id, layer_src, header.total_size)
+            LayerMsg(header.src_id, header.layer_id, layer_src,
+                     header.total_size, job_id=header.job_id)
         )
 
     # --------------------------------------------------------- striped rx
@@ -600,7 +602,7 @@ class TcpTransport(Transport):
                 self._queue.put(LayerMsg(
                     header.src_id, header.layer_id, src, header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
-                    stripe_off=header.stripe_off))
+                    stripe_off=header.stripe_off, job_id=header.job_id))
                 return
             if self.layer_sink is not None:
                 # Sink present but declined (duplicate/overlap/finished):
@@ -621,7 +623,7 @@ class TcpTransport(Transport):
                              meta=LayerMeta(location=LayerLocation.INMEM)),
                     header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
-                    stripe_off=header.stripe_off))
+                    stripe_off=header.stripe_off, job_id=header.job_id))
                 return
             # No sink: regroup stripes into the original logical payload
             # so un-striped consumers (mode-0/1/2 receivers, raw
@@ -695,7 +697,8 @@ class TcpTransport(Transport):
                              offset=done["base"],
                              meta=LayerMeta(location=LayerLocation.INMEM)),
                     done["total"],
-                    stripe_idx=0, stripe_n=1, stripe_off=0))
+                    stripe_idx=0, stripe_n=1, stripe_off=0,
+                    job_id=header.job_id))
         finally:
             if pipe_sock is not None:
                 pipe_sock.close()
@@ -852,7 +855,7 @@ class TcpTransport(Transport):
             # link — ``tx_stripe_frames / tx_frames`` is the run's
             # average stripe occupancy for the link.
             telemetry.link_add(
-                message.src_id, dest_id,
+                message.src_id, dest_id, job=message.job_id,
                 tx_bytes=message.layer_src.data_size, tx_frames=1,
                 tx_stripe_frames=streams if streams > 1 else 0)
             return
@@ -998,7 +1001,7 @@ class TcpTransport(Transport):
                 self._send_one_stream(
                     dest,
                     LayerMsg(message.src_id, message.layer_id, sub,
-                             message.total_size),
+                             message.total_size, job_id=message.job_id),
                     stripe=stripe)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
@@ -1052,6 +1055,7 @@ class TcpTransport(Transport):
             layer_size=src.data_size,
             total_size=message.total_size,
             offset=src.offset,
+            job_id=message.job_id,
         )
         if stripe is not None:
             header.stripe_idx = stripe["idx"]

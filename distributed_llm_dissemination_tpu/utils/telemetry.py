@@ -87,8 +87,11 @@ class Telemetry:
         self._phases: Dict[str, list] = {}
         # name -> {"buckets": [..], "sum_ms": float, "n": int}
         self._hists: Dict[str, dict] = {}
-        # (src, dest) -> {field: number}
-        self._links: Dict[Tuple[int, int], Dict[str, float]] = {}
+        # (src, dest, job) -> {field: number}.  job "" is the base link
+        # row (every field files there); a non-empty job ADDITIONALLY
+        # files on its own row, so per-job splits are an additive view
+        # of the base totals, never a replacement (docs/service.md).
+        self._links: Dict[Tuple[int, int, str], Dict[str, float]] = {}
 
     # ------------------------------------------------------------ scalars
 
@@ -130,20 +133,31 @@ class Telemetry:
 
     # -------------------------------------------------------------- links
 
-    def link_add(self, src, dest, **fields) -> None:
+    def link_add(self, src, dest, job: str = "", **fields) -> None:
         """Accumulate numeric fields onto the (src, dest) link.  Unknown
         src/dest (a transport without a bound node id) records nothing —
-        an unattributable byte is better dropped than misfiled."""
+        an unattributable byte is better dropped than misfiled.
+
+        ``job``: the dissemination-job tag riding the frame
+        (docs/service.md).  Tagged fields file on the BASE (src, dest)
+        row as always — cluster totals and the byte-exact delivered
+        reconciliation are unchanged — and additionally on the
+        (src, dest, job) row, serialized ``"src->dest#job"`` in
+        snapshots, so overlapping jobs' bytes split instead of pooling
+        into one undifferentiated counter."""
         if src is None or dest is None or not _links_enabled():
             return
-        key = (int(src), int(dest))
+        keys = [(int(src), int(dest), "")]
+        if job:
+            keys.append((int(src), int(dest), str(job)))
         with self._lock:
-            link = self._links.get(key)
-            if link is None:
-                link = self._links[key] = {}
-            for name, v in fields.items():
-                if v:
-                    link[name] = link.get(name, 0) + v
+            for key in keys:
+                link = self._links.get(key)
+                if link is None:
+                    link = self._links[key] = {}
+                for name, v in fields.items():
+                    if v:
+                        link[name] = link.get(name, 0) + v
 
     # ---------------------------------------------------------- snapshots
 
@@ -163,10 +177,10 @@ class Telemetry:
                                  "n": h["n"]}
                           for name, h in sorted(self._hists.items())},
                 "links": {
-                    f"{s}->{d}": {k: (round(v, 4) if isinstance(v, float)
-                                      else v)
-                                  for k, v in sorted(fields.items())}
-                    for (s, d), fields in sorted(self._links.items())
+                    (f"{s}->{d}#{j}" if j else f"{s}->{d}"): {
+                        k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in sorted(fields.items())}
+                    for (s, d, j), fields in sorted(self._links.items())
                 },
             }
 
@@ -258,12 +272,15 @@ def fold_links(reports: Dict[int, dict],
 
     def merge(node_id, snap) -> None:
         for key, fields in (snap.get("links") or {}).items():
+            base, _, job = key.partition("#")
             try:
-                src_s, dest_s = key.split("->", 1)
+                src_s, dest_s = base.split("->", 1)
                 src, dest = int(src_s), int(dest_s)
             except ValueError:
                 continue
             row = out.setdefault(key, {"src": src, "dest": dest})
+            if job:
+                row["job"] = job
             for name, v in fields.items():
                 owner = (dest if name in LINK_RX_FIELDS
                          else src if name in LINK_TX_FIELDS else None)

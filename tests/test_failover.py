@@ -316,6 +316,72 @@ def test_leader_killed_mid_run_standby_takes_over(kind, mode):
         _close_ha(leader, standby, ctl, workers, ts)
 
 
+# ------------------------------- leader killed with ≥2 admitted jobs
+
+
+@pytest.mark.timeout(90)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_leader_killed_with_two_admitted_jobs_standby_resumes_both(kind):
+    """The multi-job acceptance scenario (docs/service.md): the leader
+    admits TWO dissemination jobs (different priorities), replicates
+    the job table, and dies with every job's bytes still in flight (its
+    data plane is fault-wedged).  The promoted standby must resume BOTH
+    jobs from its shadow and complete them byte-exact — not just the
+    base run."""
+    before = _counters()
+    leader, standby, ctl, workers, ts, assignment = _build_ha_cluster(
+        kind, 3, layer_size=16 * 1024)
+    try:
+        standby.announce()
+        for w in workers:
+            w.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        s1 = leader.submit_job(
+            "push-w2", {2: {5: LayerMeta()}}, priority=2, kind="push")
+        s2 = leader.submit_job(
+            "push-w3", {3: {5: LayerMeta(), 6: LayerMeta()}}, priority=1)
+        assert s1["State"] == "active" and s2["State"] == "active"
+        # The job table provably reached the shadow BEFORE the kill.
+        _wait_for(lambda: {"push-w2", "push-w3"} <= set(ctl.shadow.jobs),
+                  what="job replication to the standby shadow")
+        # The standby must have OBSERVED a lease before the kill, or
+        # its expiry detector was never armed and no promotion can
+        # fire (the job deltas can outrun the first lease beacon).
+        _wait_for(lambda: ctl._armed, what="standby lease observation")
+        # Both jobs are provably IN FLIGHT at kill time: no live holder
+        # of layers 5/6 exists yet (the dead leader never shipped
+        # them), so neither job can have completed.
+        pre_kill = leader.jobs.table()
+        assert pre_kill["push-w2"]["State"] == "active"
+        assert pre_kill["push-w3"]["State"] == "active"
+        leader.close()
+        # The standby "loads" the v-next layers: by promotion time its
+        # own store holds what the jobs need (a rollout seeder seat).
+        for lid in (5, 6):
+            standby.layers[lid] = mem_layer(lid, 16 * 1024)
+        _wait_for(ctl.promoted.is_set, what="standby promotion")
+        new_leader = ctl.leader
+        assert new_leader is not None and new_leader.epoch == 1
+        got = new_leader.ready().get(timeout=TIMEOUT)
+        # The resumed goal carries the BASE assignment and BOTH jobs.
+        assert set(got) == {2, 3}
+        assert set(got[2]) == {0, 5} and set(got[3]) == {1, 5, 6}
+        table = new_leader.jobs.table()
+        assert table["push-w2"]["State"] == "done", table
+        assert table["push-w3"]["State"] == "done", table
+        w2, w3 = workers
+        for w, lids in ((w2, [0, 5]), (w3, [1, 5, 6])):
+            for lid in lids:
+                src = w.layers.get(lid)
+                assert src is not None, (kind, w.node.my_id, lid)
+                assert bytes(src.inmem_data) == layer_bytes(
+                    lid, 16 * 1024), (kind, lid)
+        assert _delta(before, "failover.takeover") >= 1
+        assert _delta(before, "jobs.completed") >= 2
+    finally:
+        _close_ha(leader, standby, ctl, workers, ts)
+
+
 # ------------------------------------------------------- zombie fencing
 
 
@@ -603,8 +669,10 @@ CHAOS_SPEC = "seed=2,corrupt=5,dropin=7,dup=6,times=4"
 def test_chaos_soak_leader_kill_byte_exact(kind, mode, chaos_seed):
     """The slow soak extension: a mid-run leader kill layered ON TOP of
     the PR-4 corruption/drop/dup schedule, across modes 0-3 on both
-    backends.  Takeover + integrity plane together must still converge
-    byte-exact with digests verified."""
+    backends — now with TWO concurrent dissemination jobs admitted
+    before the kill (docs/service.md).  Takeover + integrity plane
+    together must still converge byte-exact with digests verified, and
+    the promoted standby must recover BOTH jobs from its shadow."""
     chaos_seed(CHAOS_SPEC)
     before = _counters()
     leader, standby, ctl, workers, ts, assignment = _build_ha_cluster(
@@ -614,6 +682,13 @@ def test_chaos_soak_leader_kill_byte_exact(kind, mode, chaos_seed):
         for w in workers:
             w.announce()
         leader.start_distribution().get(timeout=60.0)
+        # Two concurrent jobs cross-assign existing layers to extra
+        # dests; their state must ride replication through the kill.
+        leader.submit_job("soak-a", {2: {1: LayerMeta()}}, priority=2)
+        leader.submit_job("soak-b", {3: {0: LayerMeta(),
+                                         2: LayerMeta()}}, priority=1)
+        _wait_for(lambda: {"soak-a", "soak-b"} <= set(ctl.shadow.jobs),
+                  what="job replication to the standby shadow")
         time.sleep(0.4)
         leader.close()
         _wait_for(ctl.promoted.is_set, timeout=30.0,
@@ -622,6 +697,16 @@ def test_chaos_soak_leader_kill_byte_exact(kind, mode, chaos_seed):
         for w in workers:
             w.ready().get(timeout=TIMEOUT)
         _assert_ha_delivery(workers, assignment, kind, mode)
+        # BOTH jobs recovered byte-exact from the standby's shadow.
+        table = ctl.leader.jobs.table()
+        assert table["soak-a"]["State"] == "done", table
+        assert table["soak-b"]["State"] == "done", table
+        for w, lids in ((workers[0], [1]), (workers[1], [0, 2])):
+            for lid in lids:
+                src = w.layers.get(lid)
+                assert src is not None, (kind, mode, w.node.my_id, lid)
+                assert bytes(src.inmem_data) == layer_bytes(
+                    lid, src.data_size), (kind, mode, lid)
         fired = sum(t.stats["corrupt"] + t.stats["drop"] + t.stats["dup"]
                     for t in ts.values()
                     if isinstance(t, FaultyTransport))
@@ -653,6 +738,49 @@ def test_shadow_applies_deltas_without_snapshot_order():
     assert not s.have_snapshot
     out = s.export()
     assert out["status"][2][5].data_size == 123
+
+
+def test_shadow_job_and_base_assignment_deltas():
+    """The service plane's replication kinds (docs/service.md): a `job`
+    delta lands the full record, a later `job` delta for the same id
+    REPLACES it (a dest crash re-replicates the mutated record — the
+    resurrection fix), `job_done` finalizes, and `base_assignment`
+    carries an update()'s base re-target past the join-time snapshot."""
+    s = ShadowLeaderState()
+    s.apply(ControlDeltaMsg(0, 0, 0, "job", {
+        "JobID": "j1", "Priority": 2, "Kind": "push",
+        "Assignment": {"2": {"7": LayerMeta().to_json()},
+                       "3": {"8": LayerMeta().to_json()}},
+        "Remaining": [[2, 7], [3, 8]], "State": "active"}))
+    # Dest 3 crashed: the leader re-replicates the mutated record.
+    s.apply(ControlDeltaMsg(0, 0, 1, "job", {
+        "JobID": "j1", "Priority": 2, "Kind": "push",
+        "Assignment": {"2": {"7": LayerMeta().to_json()}},
+        "Remaining": [[2, 7]], "State": "active", "DroppedPairs": 1}))
+    assert s.jobs["j1"]["Remaining"] == [[2, 7]]
+    assert "3" not in s.jobs["j1"]["Assignment"]
+    s.apply(ControlDeltaMsg(0, 0, 2, "job_done", {"JobID": "j1"}))
+    assert s.jobs["j1"]["State"] == "done"
+    s.apply(ControlDeltaMsg(0, 0, 3, "base_assignment", {
+        "Assignment": {"4": {"9": LayerMeta().to_json()}}}))
+    assert set(s.base_assignment) == {4}
+    # crash → revive: a restored node leaves the dropped map, so the
+    # adopt-time job-pair re-drop can't hit a live dest.
+    s.apply(ControlDeltaMsg(0, 0, 4, "crash",
+                            {"Node": 6,
+                             "Dropped": {"7": LayerMeta().to_json()}}))
+    assert 6 in s.dropped
+    s.apply(ControlDeltaMsg(0, 0, 5, "revive", {"Node": 6}))
+    assert 6 not in s.dropped
+    out = s.export()
+    assert out["jobs"]["j1"]["State"] == "done"
+    assert set(out["base_assignment"]) == {4}
+    # Restoring the records honors the re-replicated (shrunk) state.
+    from distributed_llm_dissemination_tpu.sched import JobManager
+
+    mgr = JobManager()
+    mgr.load(out["jobs"])
+    assert mgr.get("j1").state == "done"
 
 
 def test_shadow_crash_delta_moves_assignment_to_dropped():

@@ -113,7 +113,7 @@ def test_mode2_sender_crash_job_reassigned():
         # The zombie's job table entries are gone.
         assert all(
             job.sender != 1
-            for dests in leader.jobs.values()
+            for dests in leader._pull_jobs.values()
             for job in dests.values()
         )
     finally:

@@ -1,0 +1,179 @@
+"""Wire-compatibility guard for the control-plane protocol (tier-1).
+
+Every message in ``transport/messages.py`` must satisfy two invariants
+so a NEW build can keep talking to an OLD peer:
+
+1. **Omitted optional fields**: an instance with every optional field at
+   its default serializes WITHOUT the optional wire keys — the payload
+   is byte-identical to what a legacy build emits — and round-trips.
+2. **Legacy-dict decode**: ``from_payload`` must decode a payload
+   containing ONLY the class's REQUIRED keys (what a legacy peer sends)
+   — a new field read as ``d["New"]`` instead of ``d.get("New", ...)``
+   fails here before it fails in production.
+
+The test is enumeration-complete on purpose: it walks the decoder
+registry, so adding a message type WITHOUT a compat entry below fails
+loudly — new messages can't silently skip the guard.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import LayerMeta
+from distributed_llm_dissemination_tpu.transport.messages import (
+    _DECODERS,
+    AckMsg,
+    AnnounceMsg,
+    BootHintMsg,
+    BootReadyMsg,
+    ClientReqMsg,
+    ControlDeltaMsg,
+    DevicePlanMsg,
+    FlowRetransmitMsg,
+    GenerateReqMsg,
+    GenerateRespMsg,
+    HeartbeatMsg,
+    JobStatusMsg,
+    JobSubmitMsg,
+    LayerDigestsMsg,
+    LayerHeader,
+    LayerNackMsg,
+    LeaderLeaseMsg,
+    MetricsReportMsg,
+    MsgType,
+    PlanResendReqMsg,
+    RetransmitMsg,
+    ServeMsg,
+    SimpleMsg,
+    SourceDeadMsg,
+    StartupMsg,
+    TimeSyncMsg,
+    decode_msg,
+)
+
+# One entry per wire message: (a minimal instance — only required ctor
+# args — and the payload keys a LEGACY peer is guaranteed to send).
+# LAYER is absent from the registry on purpose (it rides the binary
+# stream via LayerHeader, covered separately below).
+CASES = {
+    MsgType.ANNOUNCE: (
+        lambda: AnnounceMsg(1, {7: LayerMeta()}), {"SrcID"}),
+    MsgType.ACK: (lambda: AckMsg(1, 7), {"SrcID", "LayerID"}),
+    MsgType.RETRANSMIT: (
+        lambda: RetransmitMsg(1, 7, 2), {"SrcID", "LayerID", "DestID"}),
+    MsgType.FLOW_RETRANSMIT: (
+        lambda: FlowRetransmitMsg(1, 7, 2, 64, 0, 1000),
+        {"SrcID", "LayerID", "DestID"}),
+    MsgType.CLIENT_REQ: (
+        lambda: ClientReqMsg(1, 7), {"SrcID", "LayerID"}),
+    MsgType.STARTUP: (lambda: StartupMsg(1), {"SrcID"}),
+    MsgType.SIMPLE: (lambda: SimpleMsg("a", "b"), set()),
+    MsgType.HEARTBEAT: (lambda: HeartbeatMsg(1), {"SrcID"}),
+    MsgType.BOOT_READY: (lambda: BootReadyMsg(1), {"SrcID"}),
+    MsgType.DEVICE_PLAN: (
+        lambda: DevicePlanMsg(1, "p", 7, 2, 64, [(1, 0, 64)]),
+        {"SrcID", "PlanID", "LayerID", "DestID"}),
+    MsgType.SERVE: (lambda: ServeMsg(1, [2, 3]), {"SrcID"}),
+    MsgType.BOOT_HINT: (lambda: BootHintMsg(1, [7]), {"SrcID"}),
+    MsgType.GENERATE_REQ: (
+        lambda: GenerateReqMsg(1, 5, [1, 2], 4), {"SrcID", "ReqID"}),
+    MsgType.GENERATE_RESP: (
+        lambda: GenerateRespMsg(1, 5), {"SrcID", "ReqID"}),
+    MsgType.PLAN_RESEND_REQ: (
+        lambda: PlanResendReqMsg(1, [3, 4]), {"SrcID"}),
+    MsgType.LAYER_NACK: (
+        lambda: LayerNackMsg(1, 7, 0, 64), {"SrcID", "LayerID"}),
+    MsgType.LAYER_DIGESTS: (
+        lambda: LayerDigestsMsg(1, {7: "xxh3:ab"}), {"SrcID"}),
+    MsgType.LEADER_LEASE: (lambda: LeaderLeaseMsg(1, 3), {"SrcID"}),
+    MsgType.CONTROL_DELTA: (
+        lambda: ControlDeltaMsg(1, 3, 0, "status"), {"SrcID"}),
+    MsgType.SOURCE_DEAD: (
+        lambda: SourceDeadMsg(1, 7, 2, 3),
+        {"SrcID", "LayerID", "DeadID", "AltID"}),
+    MsgType.METRICS_REPORT: (lambda: MetricsReportMsg(1), {"SrcID"}),
+    MsgType.TIME_SYNC: (lambda: TimeSyncMsg(1, 123.0), {"SrcID"}),
+    MsgType.JOB_SUBMIT: (
+        lambda: JobSubmitMsg(1, "j1", {2: {7: LayerMeta()}}),
+        {"SrcID", "JobID"}),
+    MsgType.JOB_STATUS: (lambda: JobStatusMsg(1), {"SrcID"}),
+}
+
+# Optional wire keys that must be OMITTED at their defaults, per type:
+# the extension fields layered onto the legacy formats over PRs 2-7.
+OMITTED_AT_DEFAULT = {
+    MsgType.ANNOUNCE: {"Partial", "Digests"},
+    MsgType.RETRANSMIT: {"Epoch", "Job"},
+    MsgType.FLOW_RETRANSMIT: {"Epoch", "Job"},
+    MsgType.STARTUP: {"Epoch"},
+    MsgType.DEVICE_PLAN: {"Epoch", "BatchID", "BatchN"},
+    MsgType.SERVE: {"Epoch"},
+    MsgType.BOOT_HINT: {"Epoch"},
+    MsgType.LAYER_DIGESTS: {"Epoch"},
+    MsgType.SOURCE_DEAD: {"Epoch"},
+    MsgType.METRICS_REPORT: {"Epoch", "Counters", "Gauges", "Links",
+                             "T", "Proc"},
+    MsgType.TIME_SYNC: {"T1", "Reply"},
+    MsgType.JOB_SUBMIT: {"Epoch", "Priority", "Kind", "Digests", "Avoid"},
+    MsgType.JOB_STATUS: {"Epoch", "Query", "Jobs", "Error"},
+}
+
+
+def test_every_registered_message_has_a_compat_case():
+    """Enumeration completeness: a new MsgType can't skip the guard."""
+    assert set(_DECODERS) == set(CASES), (
+        "transport/messages.py and this guard disagree on the message "
+        "set; add a CASES entry (and OMITTED_AT_DEFAULT if the new type "
+        "has optional wire fields) for every new message")
+
+
+@pytest.mark.parametrize("msg_type", sorted(CASES))
+def test_roundtrip_and_legacy_decode(msg_type):
+    make, required = CASES[msg_type]
+    msg = make()
+    payload = msg.to_payload()
+    # The payload must survive real JSON (the wire encoding).
+    wire = json.loads(json.dumps(payload))
+    back = decode_msg(msg_type, wire)
+    assert back == msg, f"{msg_type.name}: JSON round-trip drifted"
+    # Omitted-field discipline: optional fields at defaults add NO keys.
+    omitted = OMITTED_AT_DEFAULT.get(msg_type, set())
+    present = omitted & set(payload)
+    assert not present, (
+        f"{msg_type.name}: optional fields {sorted(present)} serialized "
+        f"at their defaults — legacy peers would see unknown keys on "
+        f"every message")
+    # Legacy decode: a payload with ONLY the required keys (what an old
+    # peer sends) must still decode — new fields must be d.get()-read.
+    legacy = {k: v for k, v in payload.items() if k in required}
+    try:
+        old = decode_msg(msg_type, legacy)
+    except KeyError as e:
+        raise AssertionError(
+            f"{msg_type.name}: from_payload requires key {e} a legacy "
+            f"peer never sends — read it with .get() and a default")
+    for key in required:
+        assert key in msg.to_payload()
+    assert type(old) is type(msg)
+
+
+def test_layer_header_wire_compat():
+    """The data-plane preamble: un-striped, un-stamped, un-tagged frames
+    keep the original five-key wire format; decoration is additive."""
+    h = LayerHeader(1, 7, 64, 128, 0)
+    payload = h.to_payload()
+    assert set(payload) == {"SrcID", "LayerID", "LayerSize", "TotalSize",
+                            "Offset"}
+    assert LayerHeader.from_payload(json.loads(json.dumps(payload))) == h
+    # Fully decorated round-trips too (stripes + checksum + job tag).
+    full = LayerHeader(1, 7, 64, 128, 32, stripe_idx=1, stripe_n=2,
+                       stripe_off=16, stripe_span=64, stripe_tid="t1",
+                       crc=99, job_id="v2-push")
+    assert LayerHeader.from_payload(
+        json.loads(json.dumps(full.to_payload()))) == full
+    # Legacy decode: the five-key payload is all an old peer sends.
+    legacy = {"SrcID": 1, "LayerID": 7, "LayerSize": 64,
+              "TotalSize": 128, "Offset": 0}
+    assert LayerHeader.from_payload(legacy) == h

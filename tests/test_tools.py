@@ -264,6 +264,87 @@ def test_local_4node_runs_end_to_end(tmp_path):
                 p.kill()
 
 
+@pytest.mark.timeout(240)
+def test_daemon_submit_jobs_cli_end_to_end(tmp_path):
+    """The dissemination service CLI (docs/service.md): a -daemon
+    leader + daemon-held receivers finish the boot run, then a one-shot
+    `-submit` seat admits a job over the wire and `-jobs` polls the
+    table until the job is done — the full from-run-to-service loop,
+    real processes, real TCP."""
+    import socket
+    import time as _time
+
+    with open(f"{CONF_DIR}/local_4node.json") as f:
+        conf = json.load(f)
+    # Dynamic ports + one extra IDLE seat (id 5) for the submitter.
+    conf["Nodes"].append({"Id": 5, "Addr": ":0", "NetworkBW": 12500000000})
+    socks = [socket.socket() for _ in conf["Nodes"]]
+    try:
+        for s_, n in zip(socks, conf["Nodes"]):
+            s_.bind(("127.0.0.1", 0))
+            n["Addr"] = f"127.0.0.1:{s_.getsockname()[1]}"
+    finally:
+        for s_ in socks:
+            s_.close()
+    conf_path = str(tmp_path / "daemon.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+    spec_path = str(tmp_path / "job.json")
+    with open(spec_path, "w") as f:
+        # Node 2 doesn't hold layer 0; holders: the leader and node 4.
+        json.dump({"JobID": "cli-push", "Priority": 1,
+                   "Assignment": {"2": [0]}}, f)
+
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main", "-f", conf_path,
+           "-m", "3", "-daemon", "150"]
+    procs = []
+    try:
+        for i in range(1, 5):
+            procs.append(subprocess.Popen(
+                cli + ["-id", str(i)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL))
+        leader = subprocess.Popen(
+            cli + ["-id", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        procs.append(leader)
+
+        def jobtool(*extra):
+            return subprocess.run(
+                [sys.executable, "-m",
+                 "distributed_llm_dissemination_tpu.cli.main",
+                 "-f", conf_path, "-id", "5", *extra],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                timeout=60)
+
+        # Submit retries until the daemon window is open (the initial
+        # delivery may still be running).  Generous: every probe below
+        # is a fresh interpreter (~seconds each on this loaded 2-core
+        # box), and the budget is shared with the completion poll.
+        deadline = _time.monotonic() + 140
+        while True:
+            sub = jobtool("-submit", spec_path)
+            if sub.returncode == 0:
+                break
+            assert _time.monotonic() < deadline, sub.stdout[-2000:]
+            _time.sleep(0.5)
+        admitted = json.loads(sub.stdout)
+        assert "cli-push" in admitted["jobs"], admitted
+
+        while True:
+            q = jobtool("-jobs")
+            assert q.returncode == 0, q.stdout[-2000:]
+            table = json.loads(q.stdout)["jobs"]
+            if table.get("cli-push", {}).get("State") == "done":
+                break
+            assert _time.monotonic() < deadline, table
+            _time.sleep(0.5)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 @pytest.mark.slow
 @pytest.mark.timeout(420)
 def test_boot_cli_generates_tokens(tmp_path):

@@ -127,6 +127,86 @@ def test_update_adds_assignee_that_announces_later(mode):
         close_all(leader, [r1], ts)
 
 
+@pytest.mark.timeout(60)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+@pytest.mark.parametrize("mode", ["m0", "m3"])
+def test_update_rearms_while_delivery_in_flight(kind, mode):
+    """update() DURING an active delivery — the seed for job admission
+    (docs/service.md).  Deterministic in-flight state, no sleeps: the
+    receiver's message loop is STOPPED, so the first goal's layers are
+    on the wire (buffered in its transport) but can never ack while
+    update() lands.  Starting the loop afterwards releases the acks;
+    the completion cycle must be re-armed and ready() must fire exactly
+    once, with the POST-update goal, byte-exact on both layers."""
+    ids = [0, 1]
+    ts, _ = make_transports(kind, ids)
+    first = {1: {0: LayerMeta()}}
+    layers = {i: mem_layer(i) for i in range(2)}
+    if mode == "m0":
+        leader = LeaderNode(Node(0, 0, ts[0]), layers, first)
+        r1 = ReceiverNode(Node(1, 0, ts[1]), {}, start_loop=False)
+    else:
+        leader = FlowRetransmitLeaderNode(
+            Node(0, 0, ts[0]), layers, first,
+            {i: 10_000_000 for i in ids})
+        r1 = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                        start_loop=False)
+    try:
+        r1.announce()
+        # Delivery is now provably IN FLIGHT: the leader started (all
+        # assignees announced) and dispatched, but the frozen receiver
+        # cannot ack, so the first goal cannot complete.
+        leader.start_distribution().get(timeout=TIMEOUT)
+        assert leader.ready().qsize() == 0
+        with leader._lock:
+            assert leader._started and not leader._startup_sent
+
+        second = {1: {0: LayerMeta(), 1: LayerMeta()}}
+        leader.update(second)  # mid-flight re-target
+        assert leader.ready().qsize() == 0  # still nothing acked
+
+        r1.loop.start()  # release the buffered deliveries + acks
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == second, (kind, mode)
+        assert bytes(r1.layers[0].inmem_data) == layer_bytes(0)
+        assert bytes(r1.layers[1].inmem_data) == layer_bytes(1)
+        # Exactly one completion event: the pre-update goal never fired
+        # a stale ready of its own.
+        assert leader.ready().qsize() == 0
+    finally:
+        close_all(leader, [r1], ts)
+
+
+@pytest.mark.timeout(60)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_mode3_update_narrowing_mid_flight_completes_immediately(kind):
+    """The other half of the in-flight gap: an update() that NARROWS
+    the goal mid-delivery (drops the undeliverable layer) must complete
+    as soon as the remaining goal is met — the re-armed cycle answers
+    with the narrowed assignment."""
+    ids = [0, 1]
+    ts, _ = make_transports(kind, ids)
+    first = {1: {0: LayerMeta(), 1: LayerMeta()}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0)}, first,  # layer 1 missing!
+        {i: 10_000_000 for i in ids})
+    r1 = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                    start_loop=False)
+    try:
+        r1.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        assert leader.ready().qsize() == 0
+        narrowed = {1: {0: LayerMeta()}}
+        leader.update(narrowed)  # drop the undeliverable layer 1
+        r1.loop.start()
+        got = leader.ready().get(timeout=TIMEOUT)
+        assert got == narrowed
+        assert bytes(r1.layers[0].inmem_data) == layer_bytes(0)
+        assert 1 not in r1.layers
+    finally:
+        close_all(leader, [r1], ts)
+
+
 def test_mode3_update_replans_flow():
     ids = [0, 1, 2]
     ts, _ = make_transports("inmem", ids)
