@@ -1466,6 +1466,118 @@ def run_delta_rollout(layer_bytes: int = 16 << 20, n_layers: int = 4,
         _service_teardown(leader, dests, ts)
 
 
+def run_sharded_delivery(layer_bytes: int = 64 << 20, n_layers: int = 2,
+                         n_shards: int = 4, bw: int = 10 ** 9,
+                         timeout: float = 600.0) -> dict:
+    """Sharded delivery vs full-layer delivery (docs/sharding.md): the
+    same multi-dest goal — ``n_shards`` dests, ``n_layers`` ×
+    ``layer_bytes`` layers from one leader — run twice, once with every
+    dest pulling FULL layers and once with each dest's target the
+    ``1/n@k`` shard spec.  Records wire bytes per dest (must be ≈ the
+    shard fraction, within 10%), TTD + predicted-vs-achieved for both
+    runs, and the post-gather on-mesh layer's byte-exactness against
+    the stamped full-layer digest — the acceptance bars of ROADMAP
+    item 1."""
+    from ..core.types import LayerMeta, shard_range, shard_specs_for
+    from ..parallel.collectives import gather_byte_shards
+    from ..utils import telemetry
+    from ..utils.provenance import harness_hash
+    from . import report as report_mod
+
+    specs = shard_specs_for(n_shards)
+
+    def one_run(sharded: bool) -> dict:
+        telemetry.reset_run()
+        assignment = {
+            k + 1: {lid: LayerMeta(shard=specs[k] if sharded else "")
+                    for lid in range(n_layers)}
+            for k in range(n_shards)
+        }
+        leader, dests, ts, mem_layer = _service_rig(
+            n_layers, layer_bytes, assignment, bw, n_dests=n_shards)
+        try:
+            t0 = time.monotonic()
+            for r in dests:
+                r.announce()
+            leader.ready().get(timeout=timeout)
+            ttd = round(time.monotonic() - t0, 4)
+            links = telemetry.snapshot()["links"]
+            per_dest = {}
+            for k, r in enumerate(dests):
+                me = r.node.my_id
+                rx = sum(row.get("rx_bytes", 0)
+                         for key, row in links.items()
+                         if "#" not in key and key.endswith(f"->{me}"))
+                delivered = sum(row.get("delivered_bytes", 0)
+                                for key, row in links.items()
+                                if "#" not in key
+                                and key.endswith(f"->{me}"))
+                per_dest[me] = {"rx_bytes": rx,
+                                "delivered_bytes": delivered}
+            rec = {
+                "ttd_s": ttd,
+                "predicted_s": round(leader.predicted_ttd_ms / 1000.0, 4),
+                "solve_ms": leader.solve_ms,
+                "wire_bytes_per_dest": per_dest,
+            }
+            if sharded:
+                # The acceptance gate: the dests' shards gather on-mesh
+                # into layers byte-exact against the stamped digests.
+                gathered_ok = 0
+                for lid in range(n_layers):
+                    parts = []
+                    for k, r in enumerate(dests):
+                        off, size = shard_range(specs[k], layer_bytes)
+                        parts.append((k, bytes(
+                            memoryview(r.layers[lid].inmem_data)
+                            [off:off + size])))
+                    out = gather_byte_shards(
+                        parts, layer_bytes,
+                        verify_digest=leader.layer_digests.get(lid))
+                    if out != bytes(mem_layer(lid).inmem_data):
+                        raise AssertionError(
+                            f"gathered layer {lid} not byte-exact")
+                    gathered_ok += 1
+                rec["gathered_layers_byte_exact"] = gathered_ok
+            else:
+                # Byte-exactness of the full-layer sibling.
+                for lid in range(n_layers):
+                    for r in dests:
+                        if bytes(r.layers[lid].inmem_data) != bytes(
+                                mem_layer(lid).inmem_data):
+                            raise AssertionError(
+                                f"full layer {lid} corrupt at "
+                                f"{r.node.my_id}")
+            rep = report_mod.build_from_leader(leader)
+            rec["run_report"] = rep.get("provenance")
+            return rec
+        finally:
+            _service_teardown(leader, dests, ts)
+
+    full = one_run(sharded=False)
+    shard = one_run(sharded=True)
+    frac_bytes = sum(shard_range(specs[k], layer_bytes)[1]
+                     for k in range(n_shards)) // n_shards * n_layers
+    bound_lo, bound_hi = frac_bytes, round(frac_bytes * 1.1)
+    within = all(bound_lo <= d["rx_bytes"] <= bound_hi
+                 for d in shard["wire_bytes_per_dest"].values())
+    return {
+        "harness_hash": harness_hash(),
+        "backend": "tcp-loopback",
+        "mode": 3,
+        "layer_bytes": layer_bytes,
+        "n_layers": n_layers,
+        "n_dests": n_shards,
+        "shard_fraction": f"1/{n_shards}",
+        "modeled_bw_bps": bw,
+        "full": full,
+        "sharded": shard,
+        "shard_bytes_per_dest_bound": [bound_lo, bound_hi],
+        "wire_within_10pct": within,
+        "ttd_ratio": round(shard["ttd_s"] / max(full["ttd_s"], 1e-9), 4),
+    }
+
+
 def run_telemetry_overhead(scale: int = 64 << 20, trials: int = 3,
                            scenario: str = "bench_8node_llama8b.json",
                            mode: int = 0,
@@ -1659,6 +1771,56 @@ def _failover_md(lines, results) -> None:
         "worker re-announces then re-ack what already landed, and "
         "duplicate sends are absorbed by interval reassembly).")
     lines.append("")
+
+
+def _sharded_md(lines, results) -> None:
+    sd = results.get("sharded_delivery")
+    if not sd:
+        return
+    lb, nl = sd["layer_bytes"], sd["n_layers"]
+    full, shard = sd["full"], sd["sharded"]
+    lo, hi = sd["shard_bytes_per_dest_bound"]
+    lines += [
+        "## Sharded delivery: disseminate into the destination sharding "
+        "(docs/sharding.md)",
+        "",
+        f"The same multi-dest goal — {sd['n_dests']} dests × {nl} × "
+        f"{lb >> 20} MiB layers from one leader over "
+        f"{sd['backend']} (mode {sd['mode']}) — run with FULL-layer "
+        f"targets vs `{sd['shard_fraction']}@k` shard targets.  Wire "
+        "bytes per dest must land within 10% of fraction × layer bytes "
+        f"× layers (bound [{lo >> 20}, {hi >> 20}] MiB); the dests' "
+        "shards must gather on-mesh into layers byte-exact against the "
+        "stamped full-layer digests.",
+        "",
+        "| targets | TTD | predicted | wire bytes/dest | gathered "
+        "byte-exact |",
+        "|---|---|---|---|---|",
+    ]
+
+    def _per_dest(rec):
+        vals = sorted(d["rx_bytes"]
+                      for d in rec["wire_bytes_per_dest"].values())
+        return f"{vals[0] >> 20}–{vals[-1] >> 20} MiB"
+
+    lines.append(f"| full layers | {full['ttd_s']}s | "
+                 f"{full['predicted_s']}s | {_per_dest(full)} | — |")
+    lines.append(
+        f"| `{sd['shard_fraction']}` shards | {shard['ttd_s']}s | "
+        f"{shard['predicted_s']}s | {_per_dest(shard)} | "
+        f"{shard.get('gathered_layers_byte_exact', 0)}/{nl} layers |")
+    lines += [
+        "",
+        f"Wire-bytes-per-dest within 10% of the fraction: "
+        f"**{'yes' if sd['wire_within_10pct'] else 'NO'}**; TTD ratio "
+        f"sharded/full = {sd['ttd_ratio']} (the proportional-improvement "
+        "check — on this 2-core container the CPU, not the modeled "
+        "link, can bound small runs; read against the trial spread).  "
+        f"RUN_REPORT provenance full `{full.get('run_report')}`, "
+        f"sharded `{shard.get('run_report')}` "
+        f"(harness `{sd.get('harness_hash')}`).",
+        "",
+    ]
 
 
 def to_markdown(results: dict) -> str:
@@ -2192,6 +2354,7 @@ def to_markdown(results: dict) -> str:
     _telemetry_overhead_md(lines, results)
     _failover_md(lines, results)
     _service_md(lines, results)
+    _sharded_md(lines, results)
     return "\n".join(lines)
 
 
@@ -2228,6 +2391,12 @@ def main(argv=None) -> int:
                         "the per-link priority split, and a v2 delta "
                         "rollout's shipped bytes vs changed-fraction × "
                         "model bytes against the content store")
+    p.add_argument("-sharded", action="store_true",
+                   help="also measure sharded delivery "
+                        "(docs/sharding.md): the multi-dest 64 MiB "
+                        "full-layer vs 1/4-shard comparison — wire "
+                        "bytes per dest, TTD, predicted-vs-achieved, "
+                        "and the post-gather digest check")
     args = p.parse_args(argv)
     if args.trace and not args.physical:
         p.error("-trace needs -physical (it traces that run)")
@@ -2360,6 +2529,10 @@ def main(argv=None) -> int:
         for key in ("service_jobs", "delta_rollout"):
             if prior_doc and prior_doc.get(key):
                 results[key] = prior_doc[key]
+    if args.sharded:
+        results["sharded_delivery"] = run_sharded_delivery()
+    elif prior_doc and prior_doc.get("sharded_delivery"):
+        results["sharded_delivery"] = prior_doc["sharded_delivery"]
     # Regenerate the cache-reuse evidence from THIS run's records;
     # fall back to the prior document's (e.g. hand-recorded SPMD rows)
     # when the run produced none.

@@ -14,11 +14,91 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 # Reference: distributor/node.go:128-129 — uint identifiers.
 NodeID = int
 LayerID = int
+
+# ---------------------------------------------------------------------------
+# Shard specs (docs/sharding.md)
+#
+# A delivery target is (layer, shard spec): the spec names a DETERMINISTIC
+# byte-range slice of the layer, so every plane — planner, wire, digest
+# stamp, ack — can derive the same [offset, offset+size) from the spec and
+# the layer's total size alone.  Grammar: ``"1/N@K"`` = slice K (0-based)
+# of the layer split into N floor-bounded equal ranges (boundary i sits at
+# ``i * total // N`` — the same split rule as the transport's stripe
+# offsets, so shard edges are stable under any total).  ``""`` = the whole
+# layer (the pre-sharding vocabulary; every legacy peer speaks it).
+# ---------------------------------------------------------------------------
+
+ShardSpec = str  # "" (full layer) or "1/N@K"
+
+
+def parse_shard_spec(spec: ShardSpec) -> Optional[Tuple[int, int]]:
+    """``"1/N@K"`` → ``(N, K)``; ``""`` → None (full layer).  Raises
+    ``ValueError`` on malformed or out-of-range specs — a typo'd spec
+    must fail at the plane that first reads it, not deliver the wrong
+    byte range."""
+    if not spec:
+        return None
+    try:
+        frac, idx = spec.split("@", 1)
+        num, den = frac.split("/", 1)
+        n, k, one = int(den), int(idx), int(num)
+    except (ValueError, AttributeError):
+        raise ValueError(f"malformed shard spec {spec!r} (want '1/N@K')")
+    if one != 1 or n < 1 or not 0 <= k < n:
+        raise ValueError(f"shard spec {spec!r} out of range (want 1/N@K "
+                         f"with 0 <= K < N)")
+    return n, k
+
+
+def shard_range(spec: ShardSpec, total: int) -> Tuple[int, int]:
+    """The spec's byte range ``(offset, size)`` of a ``total``-byte
+    layer.  Floor-bounded equal split: slice K covers
+    ``[K*total//N, (K+1)*total//N)``."""
+    parsed = parse_shard_spec(spec)
+    if parsed is None:
+        return 0, total
+    n, k = parsed
+    start = k * total // n
+    end = (k + 1) * total // n
+    return start, end - start
+
+
+def shard_fraction(spec: ShardSpec) -> float:
+    """The spec's share of the layer (1.0 = full)."""
+    parsed = parse_shard_spec(spec)
+    return 1.0 if parsed is None else 1.0 / parsed[0]
+
+
+def shard_covers(held: ShardSpec, want: ShardSpec) -> bool:
+    """Whether a holder of shard ``held`` provably holds every byte of
+    shard ``want``, for ANY layer total.  ``""`` (full layer) covers
+    everything.  Cross-multiplied rational bounds: range(N, K) =
+    [K*T/N, (K+1)*T/N), and floor() preserves the ordering of the
+    rational endpoints, so K1/N1 <= K2/N2 and (K1+1)/N1 >= (K2+1)/N2
+    imply byte-range containment at every T."""
+    h = parse_shard_spec(held)
+    if h is None:
+        return True
+    w = parse_shard_spec(want)
+    if w is None:
+        return False  # a shard never covers the full layer
+    n1, k1 = h
+    n2, k2 = w
+    return k1 * n2 <= k2 * n1 and (k1 + 1) * n2 >= (k2 + 1) * n1
+
+
+def shard_specs_for(n: int) -> List[ShardSpec]:
+    """The N specs of an N-way split — what a planner targeting a dest
+    mesh of N shards (one per PartitionSpec slot along the sharded axis)
+    hands out, one per participant."""
+    if n <= 1:
+        return [""] if n == 1 else []
+    return [f"1/{n}@{k}" for k in range(n)]
 
 # Reference: distributor/node.go:132 — a set of node IDs.
 NodeIDs = Set[NodeID]
@@ -58,20 +138,31 @@ class LayerMeta:
     ``data_size`` is an extension over the reference: announce messages
     carry each layer's size so a mode-3 leader can schedule layers it does
     not itself hold (the reference's announce drops sizes, so its flow
-    solver zero-sizes peer-only layers)."""
+    solver zero-sizes peer-only layers).
+
+    ``shard`` (docs/sharding.md): the shard spec this entry refers to.
+    In an *assignment*, the target — the dest must end up holding that
+    byte range; in a *status/announce* row, the holding — the node holds
+    ONLY that range (``data_size`` stays the FULL layer size; the spec
+    qualifies which bytes of it are real).  ``""`` = the whole layer.
+    Omitted-at-default on the wire (legacy peers never see the key)."""
 
     location: LayerLocation = LayerLocation.INMEM
     limit_rate: int = 0  # bytes/sec; 0 = unlimited
     source_type: SourceType = SourceType.MEM
     data_size: int = 0  # bytes; 0 = unknown
+    shard: ShardSpec = ""  # "" = full layer
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "Location": int(self.location),
             "LimitRate": self.limit_rate,
             "SourceType": int(self.source_type),
             "DataSize": self.data_size,
         }
+        if self.shard:
+            out["Shard"] = str(self.shard)
+        return out
 
     @classmethod
     def from_json(cls, d: dict) -> "LayerMeta":
@@ -80,6 +171,7 @@ class LayerMeta:
             limit_rate=int(d.get("LimitRate", 0)),
             source_type=SourceType(d.get("SourceType", 0)),
             data_size=int(d.get("DataSize", 0)),
+            shard=str(d.get("Shard", "")),
         )
 
 
@@ -230,5 +322,19 @@ def delivered(meta: LayerMeta) -> bool:
     The reference requires ``InmemLayer`` (distributor/node.go:435-446);
     the TPU build additionally accepts HBM, which is strictly "more
     delivered" — the bytes are already on the accelerator.
+
+    NOTE: location only.  A sharded target's satisfaction additionally
+    requires the held shard to COVER the assigned one — use
+    :func:`satisfies` wherever an assignment meta is being checked
+    against a status meta.
     """
     return meta.location in (LayerLocation.INMEM, LayerLocation.HBM)
+
+
+def satisfies(held: Optional[LayerMeta], want: LayerMeta) -> bool:
+    """Whether a status entry ``held`` satisfies the assignment target
+    ``want``: delivered-grade location AND the held shard covers the
+    wanted one (a shard-holder never satisfies a full-layer target;
+    docs/sharding.md)."""
+    return (held is not None and delivered(held)
+            and shard_covers(held.shard, want.shard))

@@ -200,6 +200,74 @@ def gather_tiles_batched(mesh: Mesh, axis: str, sizes: Tuple[int, ...],
     return run
 
 
+def gather_byte_shards(parts, total: int, verify_digest=None) -> bytes:
+    """Materialize a FULL layer from its byte-range shards on-mesh
+    (docs/sharding.md): each ``(shard_index, bytes)`` part is one
+    ``1/N@K`` floor-split slice of a ``total``-byte layer; the N tiles
+    land one-per-device on an N-device mesh and ONE tiled ``all_gather``
+    (the existing ``gather_tiles`` path — padded tiles, static
+    re-splice) replicates the layer, which is then read back byte-exact.
+    On a real pod the hop is ICI at bisection bandwidth — the wire never
+    carried more than each dest's shard.
+
+    ``parts``: iterable of ``(k, data)`` covering ALL of [0, N) in any
+    order.  ``verify_digest``: optional stamped full-layer digest — the
+    gathered layer is checked against it before being returned (the
+    acceptance gate: post-gather bytes must match the pre-shard stamp).
+
+    Falls back to a host-side concatenation — loudly, counted on
+    ``shard.gather_host_fallback`` — when the runtime has fewer devices
+    than shards (the gather is then still byte-exact, just not an ICI
+    collective)."""
+    from ..core.types import shard_range
+    from ..utils import trace
+    from ..utils.logging import log
+
+    by_k = {}
+    for k, data in parts:
+        by_k[int(k)] = data
+    n = len(by_k)
+    if n == 0 or sorted(by_k) != list(range(n)):
+        raise ValueError(f"shard set incomplete: have {sorted(by_k)}")
+    sizes = []
+    for k in range(n):
+        off, size = shard_range(f"1/{n}@{k}" if n > 1 else "", total)
+        if len(by_k[k]) != size:
+            raise ValueError(
+                f"shard {k}/{n} is {len(by_k[k])} bytes; spec says {size}")
+        sizes.append(size)
+
+    if n == 1:
+        out = bytes(by_k[0])
+    elif len(jax.devices()) < n:
+        trace.count("shard.gather_host_fallback")
+        log.warn("fewer devices than shards; gathering on host instead "
+                 "of the mesh", shards=n, devices=len(jax.devices()))
+        out = b"".join(bytes(by_k[k]) for k in range(n))
+    else:
+        devices = jax.devices()[:n]
+        mesh = Mesh(np.array(devices), ("shards",))
+        pad = max(sizes)
+        staged = np.zeros((n, pad), dtype=np.uint8)
+        for k in range(n):
+            staged[k, : sizes[k]] = np.frombuffer(bytes(by_k[k]), np.uint8)
+        v = jax.device_put(
+            staged.reshape(n * pad),
+            NamedSharding(mesh, P("shards")))
+        gathered = gather_tiles(mesh, "shards", tuple(sizes), pad=pad)(v)
+        out = np.asarray(jax.device_get(gathered)).tobytes()[:total]
+    if len(out) != total:
+        raise ValueError(f"gathered {len(out)} bytes; layer is {total}")
+    if verify_digest:
+        from ..utils import integrity
+
+        if not integrity.digest_matches(out, verify_digest):
+            raise ValueError("gathered layer failed the stamped "
+                             "full-layer digest")
+    trace.count("shard.gathered_layers")
+    return out
+
+
 @functools.lru_cache(maxsize=64)
 def _allgather_fn(mesh: Mesh, axis: str):
     @jax.jit

@@ -201,7 +201,8 @@ class ShadowLeaderState:
                 row = self.status.setdefault(int(d["Node"]), {})
                 row[int(d["Layer"])] = LayerMeta(
                     location=LayerLocation(int(d.get("Location", 0))),
-                    data_size=int(d.get("Size", 0)))
+                    data_size=int(d.get("Size", 0)),
+                    shard=str(d.get("Shard", "")))
             elif k == "partial":
                 node = int(d["Node"])
                 per = d.get("Partial")

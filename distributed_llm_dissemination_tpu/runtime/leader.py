@@ -43,6 +43,9 @@ from ..core.types import (
     Status,
     delivered,
     layer_ids_to_json,
+    satisfies,
+    shard_covers,
+    shard_range,
 )
 from ..sched.flow import (
     FlowJob,
@@ -101,12 +104,13 @@ from .send import (
 
 def assignment_satisfied(a: Assignment, s: Status) -> bool:
     """Every assigned layer is held in RAM/HBM by its node
-    (node.go:435-446)."""
+    (node.go:435-446) — at a shard that COVERS the assigned one for
+    sharded targets (docs/sharding.md; a shard-holder never satisfies a
+    full-layer demand)."""
     for node_id, layers in a.items():
         held = s.get(node_id, {})
-        for layer_id in layers:
-            meta = held.get(layer_id)
-            if meta is None or not delivered(meta):
+        for layer_id, want in layers.items():
+            if not satisfies(held.get(layer_id), want):
                 return False
     return True
 
@@ -263,6 +267,14 @@ class LeaderNode:
         # layers the leader itself sends (all four modes).
         self.layer_digests: Dict[LayerID, str] = {}
         self._digests_ready = threading.Event()
+        # (layer, shard spec) -> digest of exactly that byte range — the
+        # sharded-delivery stamp cache (docs/sharding.md); replans and
+        # per-dest stamps must not re-hash gigabytes.
+        self._range_digest_cache: Dict[Tuple[LayerID, str], str] = {}
+        # Sticky once any sharded target/holding has been seen: with
+        # digests disabled, stamps then carry explicit ""-spec entries
+        # so a widened target still reconciles at the dest.
+        self._sharding_seen = False
         self.nacker = NackRetransmitter()
 
         # Control-plane HA (docs/failover.md).
@@ -712,30 +724,89 @@ class LeaderNode:
         """Stamp each assignee with its layers' expected digests.  Waits
         (bounded, PRE-timer) for the leader's own background hash so the
         first stamp is complete; advisory — a dest without a digest for
-        some layer simply skips end-to-end verification for it."""
-        if not integrity.digests_enabled():
-            return
-        self._digests_ready.wait(timeout=300.0)
+        some layer simply skips end-to-end verification for it.
+
+        Sharded targets ride the same stamp (docs/sharding.md) even
+        with digests disabled: the shard SPEC is what tells a dest its
+        interval set completes at shard coverage, so it must flow
+        whenever any target is sub-layer."""
+        if integrity.digests_enabled():
+            self._digests_ready.wait(timeout=300.0)
         with self._lock:
             dests = list(self.assignment)
             digests = {str(l): d for l, d in self.layer_digests.items()}
-        self._replicate("digests", Digests=digests)
+        if digests:
+            self._replicate("digests", Digests=digests)
         for dest in dests:
             self._send_digests_to(dest)
 
+    def _assigned_shards_locked(self, dest: NodeID) -> Dict[LayerID, str]:
+        """Lock held.  The dest's sub-layer targets: {layer: spec}."""
+        return {lid: meta.shard
+                for lid, meta in (self.assignment.get(dest) or {}).items()
+                if meta.shard}
+
+    def _range_digests_for(self, shards: Dict[LayerID, str]
+                           ) -> Dict[LayerID, str]:
+        """Per-range digests for a dest's shard targets — the digest of
+        exactly the target's byte range, so the shard verifies without
+        the dest ever holding the full layer (docs/sharding.md).  Only
+        computable for layers whose bytes this leader can read; absent
+        entries verify by per-fragment CRC alone (honest limit).
+        Cached per (layer, spec): replans must not re-hash gigabytes."""
+        if not integrity.digests_enabled():
+            return {}
+        out: Dict[LayerID, str] = {}
+        for lid, spec in shards.items():
+            key = (lid, spec)
+            with self._lock:
+                cached = self._range_digest_cache.get(key)
+                layer = self.layers.get(lid)
+            if cached is not None:
+                out[lid] = cached
+                continue
+            if layer is None or layer.meta.shard:
+                continue  # unreadable here (or leader holds a shard only)
+            off, size = shard_range(spec, layer.data_size)
+            d = integrity.digest_layer_src_range(layer, off, size)
+            if d is None:
+                continue
+            with self._lock:
+                self._range_digest_cache[key] = d
+            out[lid] = d
+        return out
+
     def _send_digests_to(self, dest: NodeID) -> None:
-        if not integrity.digests_enabled() or dest == self.node.my_id:
+        if dest == self.node.my_id:
             return
         with self._lock:
-            digests = {lid: self.layer_digests[lid]
-                       for lid in self.assignment.get(dest) or {}
-                       if lid in self.layer_digests}
-        if not digests:
+            digests = ({lid: self.layer_digests[lid]
+                        for lid in self.assignment.get(dest) or {}
+                        if lid in self.layer_digests}
+                       if integrity.digests_enabled() else {})
+            shards = self._assigned_shards_locked(dest)
+            # Sticky: once ANY sharded target or shard holding exists,
+            # later stamps must keep carrying the dest's target picture
+            # even after widening removed the specs.
+            self._sharding_seen = (
+                self._sharding_seen or bool(shards)
+                or any(m.shard for row in self.status.values()
+                       for m in row.values()))
+            if self._sharding_seen and not integrity.digests_enabled():
+                # With digests OFF the shards map is the ONLY channel
+                # that can tell a dest its target reverted to the full
+                # layer (the digest-keyed widen detection has nothing
+                # to iterate): explicit "" entries carry the reconcile.
+                for lid in self.assignment.get(dest) or {}:
+                    shards.setdefault(lid, "")
+        if not digests and not shards:
             return
         try:
             self.node.transport.send(
-                dest, LayerDigestsMsg(self.node.my_id, digests,
-                                      epoch=self.epoch))
+                dest, LayerDigestsMsg(
+                    self.node.my_id, digests, epoch=self.epoch,
+                    shards=shards,
+                    range_digests=self._range_digests_for(shards)))
         except (OSError, KeyError) as e:
             log.warn("digest stamp send failed", dest=dest, err=repr(e))
 
@@ -1424,7 +1495,13 @@ class LeaderNode:
         the dest already holds content-equal bytes under another layer
         id — the dest's own digest-stamp resolve acks it locally
         (docs/service.md).  Gated on job ownership so pre-service peers
-        (which lack the resolve path) are never starved."""
+        (which lack the resolve path) are never starved.  Sharded
+        targets never content-skip: their resolve key is the (digest,
+        range) pair, and full-layer vouching doesn't carry it
+        (docs/sharding.md, honest limits)."""
+        want = (self.assignment.get(dest) or {}).get(layer_id)
+        if want is not None and want.shard:
+            return False
         if self.jobs.owner_of(dest, layer_id) is None:
             return False
         digest = self.layer_digests.get(layer_id)
@@ -1460,31 +1537,35 @@ class LeaderNode:
 
     def send_layers(self) -> None:
         """Leader sends every missing assigned layer itself
-        (node.go:326-352) — over the device fabric when one is wired."""
+        (node.go:326-352) — over the device fabric when one is wired.
+        A sharded target (docs/sharding.md) ships as exactly its shard's
+        byte range over the host path (the fabric plane speaks whole
+        layers only)."""
         for node_id, layer_ids in self.assignment.items():
-            for layer_id in layer_ids:
+            for layer_id, want in layer_ids.items():
                 with self._lock:
                     meta = self.status.get(node_id, {}).get(layer_id)
                     skip = (meta is None
                             and self._content_skip_locked(node_id,
                                                           layer_id))
-                if (meta is not None and delivered(meta)) or skip:
+                if satisfies(meta, want) or skip:
                     continue
                 layer = self.layers.get(layer_id)
                 if layer is None:
                     log.warn("no layers found", layerID=layer_id)
                     continue
-                if self._try_fabric_full_layer(layer_id, self.node.my_id,
-                                               node_id):
+                if not want.shard and self._try_fabric_full_layer(
+                        layer_id, self.node.my_id, node_id):
                     continue
                 owner = self.jobs.owner_of(node_id, layer_id)
                 self.loop.submit(self._send_one, node_id, layer_id, layer,
-                                 owner[1] if owner else "")
+                                 owner[1] if owner else "", want.shard)
 
     def _send_one(self, dest: NodeID, layer_id: LayerID, layer,
-                  job_id: str = "") -> None:
+                  job_id: str = "", shard: str = "") -> None:
         try:
-            send_layer(self.node, dest, layer_id, layer, job_id=job_id)
+            send_layer(self.node, dest, layer_id, layer, job_id=job_id,
+                       shard=shard)
         except Exception as e:  # noqa: BLE001
             log.error("couldn't send a layer", layerID=layer_id, err=repr(e))
 
@@ -1747,8 +1828,15 @@ class LeaderNode:
             size = prev.data_size if prev is not None else 0
             if size <= 0:
                 size = self._layer_size_locked(msg.layer_id)
+            # Shard-qualified holding (docs/sharding.md): a shard ack
+            # records a PARTIAL holding; never let it narrow a wider one
+            # the row already has (a full copy covers every shard).
+            shard = msg.shard
+            if (prev is not None and delivered(prev)
+                    and shard_covers(prev.shard, msg.shard)):
+                shard = prev.shard
             row[msg.layer_id] = LayerMeta(location=msg.location,
-                                          data_size=size)
+                                          data_size=size, shard=shard)
             # A delivered (layer, dest) pair needs no more salvage.
             self._salvaging.discard((msg.layer_id, msg.src_id))
             # The watchdog stops chasing any plan this ack settles.
@@ -1758,15 +1846,22 @@ class LeaderNode:
                         and plan.layer_id == msg.layer_id):
                     del self._plan_watch[seq]
         self._replicate("ack", Node=msg.src_id, Layer=msg.layer_id,
-                        Location=int(msg.location), Size=size)
+                        Location=int(msg.location), Size=size,
+                        Shard=shard)
         # Content index + job plane: the delivered copy verified against
         # the stamped digest before acking, so the new owner vouches for
         # those bytes; the ack credits every admitted job wanting the
-        # pair (docs/service.md).
+        # pair (docs/service.md).  A SHARD ack vouches for its (range
+        # digest, shard) key only — it can never alias-complete a
+        # full-layer pair (docs/sharding.md).
         with self._lock:
-            digest = self.layer_digests.get(msg.layer_id)
-        self.content.add(msg.src_id, msg.layer_id, digest)
-        self._jobs_completed(self.jobs.on_ack(msg.src_id, msg.layer_id))
+            if shard:
+                digest = self._range_digest_cache.get((msg.layer_id, shard))
+            else:
+                digest = self.layer_digests.get(msg.layer_id)
+        self.content.add(msg.src_id, msg.layer_id, digest, shard=shard)
+        self._jobs_completed(
+            self.jobs.on_ack(msg.src_id, msg.layer_id, shard=shard))
         self._maybe_finish()
 
     def _jobs_completed(self, job_ids) -> None:
@@ -1953,10 +2048,14 @@ class RetransmitLeaderNode(LeaderNode):
     def _build_layer_owners(self) -> None:
         """(Re)index layer → owner set from live status (node.go:558-571).
         Rebuilt from scratch: status is the source of truth, and a
-        restarted node no longer owns what its dead incarnation held."""
+        restarted node no longer owns what its dead incarnation held.
+        FULL holdings only: a shard-holder (docs/sharding.md) can't
+        forward a whole layer, so it never enters the owner pool."""
         self.layer_owners = {}
         for node_id, layer_ids in self.status.items():
-            for layer_id in layer_ids:
+            for layer_id, meta in layer_ids.items():
+                if meta.shard:
+                    continue
                 self.layer_owners.setdefault(layer_id, set()).add(node_id)
 
     def send_layers(self) -> None:
@@ -1964,22 +2063,24 @@ class RetransmitLeaderNode(LeaderNode):
             self._build_layer_owners()
             owners_by_layer = {k: set(v) for k, v in self.layer_owners.items()}
         for node_id, layer_ids in self.assignment.items():
-            for layer_id in layer_ids:
+            for layer_id, want in layer_ids.items():
                 with self._lock:
+                    held = self.status.get(node_id, {}).get(layer_id)
                     if self._content_skip_locked(node_id, layer_id):
                         continue
+                if satisfies(held, want):
+                    continue  # dest already holds its target (shard-aware)
                 jid_owner = self.jobs.owner_of(node_id, layer_id)
                 jid = jid_owner[1] if jid_owner else ""
                 owners = owners_by_layer.get(layer_id, set())
+                owners = owners - {node_id}
                 if owners:
-                    if node_id in owners:
-                        continue  # dest already has it
                     # Deterministic owner pick (reference picks randomly via
                     # map iteration, node.go:583-588).
                     owner = min(owners)
                     try:
                         self.send_retransmit(layer_id, owner, node_id,
-                                             job_id=jid)
+                                             job_id=jid, shard=want.shard)
                     except Exception as e:  # noqa: BLE001
                         log.error(
                             "couldn't send retransmit",
@@ -1990,20 +2091,23 @@ class RetransmitLeaderNode(LeaderNode):
                     if layer is None:
                         log.warn("no layers found", layerID=layer_id)
                         continue
-                    if self._try_fabric_full_layer(layer_id, self.node.my_id,
-                                                   node_id):
+                    if not want.shard and self._try_fabric_full_layer(
+                            layer_id, self.node.my_id, node_id):
                         continue
                     self.loop.submit(self._send_one, node_id, layer_id,
-                                     layer, jid)
+                                     layer, jid, want.shard)
 
     def send_retransmit(self, layer_id: LayerID, owner: NodeID,
-                        dest: NodeID, job_id: str = "") -> None:
+                        dest: NodeID, job_id: str = "",
+                        shard: str = "") -> None:
         """Ask ``owner`` to forward ``layer_id`` to ``dest``; leader-owned
         layers go out directly (node.go:611-626).  With a fabric wired the
         forward becomes a one-source device plan — the owner's copy enters
         the fabric from its own stage and lands in the dest's HBM with no
-        TCP byte stream (modes 1 and 2 share this path)."""
-        if self._try_fabric_full_layer(layer_id, owner, dest):
+        TCP byte stream (modes 1 and 2 share this path).  ``shard``:
+        forward only that byte-range slice (host path only — the fabric
+        plane speaks whole layers)."""
+        if not shard and self._try_fabric_full_layer(layer_id, owner, dest):
             return
         if owner == self.node.my_id:
             layer = self.layers.get(layer_id)
@@ -2014,11 +2118,13 @@ class RetransmitLeaderNode(LeaderNode):
             # and an inline rate-paced send would serialize every
             # leader-owned transfer behind the previous one (mode 0's
             # sends are pooled for the same reason, node.go:343-349).
-            self.loop.submit(self._send_one, dest, layer_id, layer, job_id)
+            self.loop.submit(self._send_one, dest, layer_id, layer, job_id,
+                             shard)
             return
         self.node.transport.send(
             owner, RetransmitMsg(self.node.my_id, layer_id, dest,
-                                 epoch=self.epoch, job_id=job_id)
+                                 epoch=self.epoch, job_id=job_id,
+                                 shard=shard)
         )
 
 
@@ -2102,9 +2208,8 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         for node_id in self.status:
             self.sender_load.setdefault(node_id, 0)
         held = self.status.get(dest, {})
-        for layer_id in self.assignment.get(dest, {}):
-            meta = held.get(layer_id)
-            if meta is not None and delivered(meta):
+        for layer_id, want in self.assignment.get(dest, {}).items():
+            if satisfies(held.get(layer_id), want):
                 continue
             old = self._pull_jobs.get(layer_id, {}).get(dest)
             if old is not None and not replace_existing:
@@ -2209,9 +2314,8 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
             )
             for dest, layer_ids in self.assignment.items():
                 held = self.status.get(dest, {})
-                for layer_id in layer_ids:
-                    meta = held.get(layer_id)
-                    if meta is None or not delivered(meta):
+                for layer_id, want in layer_ids.items():
+                    if not satisfies(held.get(layer_id), want):
                         self._pull_jobs.setdefault(layer_id, {})[dest] = _JobInfo()
             for node_id in self.status:
                 self.sender_load.setdefault(node_id, 0)
@@ -2245,7 +2349,9 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         for sender in sorted(self.sender_load):
             count = self.sender_load[sender]
             meta = self.status.get(sender, {}).get(layer_id)
-            if meta is None:
+            if meta is None or meta.shard:
+                # A shard-holder can't forward the whole layer
+                # (docs/sharding.md); it never enters the sender pool.
                 continue
             rate = meta.limit_rate if meta.limit_rate != 0 else 1 << 62
             if rate > best_rate or (
@@ -2262,7 +2368,9 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         (node.go:981-1010)."""
         best = None
         min_owners = 1 << 62
-        for layer_id in self.status.get(node_id, {}):
+        for layer_id, own_meta in self.status.get(node_id, {}).items():
+            if own_meta.shard:
+                continue  # shard holders don't forward (docs/sharding.md)
             for dest, job in self._pull_jobs.get(layer_id, {}).items():
                 if job.sender != node_id or job.status != _JobInfo.PENDING:
                     continue
@@ -2280,7 +2388,9 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
         """A pending job owned by a slower/overloaded sender that this node
         could serve instead (node.go:1012-1073)."""
         best = None  # (layer, dest, sender, owner_count, time_to_finish)
-        for layer_id in self.status.get(node_id, {}):
+        for layer_id, own_meta in self.status.get(node_id, {}).items():
+            if own_meta.shard:
+                continue  # shard holders don't forward (docs/sharding.md)
             owner_count = len(self.layer_owners.get(layer_id, ()))
             for dest, job in self._pull_jobs.get(layer_id, {}).items():
                 sender = job.sender
@@ -2341,8 +2451,11 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
                 sender = node_id
                 log.debug("steal a job", layer=layer_id, frm=prev_sender, to=node_id)
         jid_owner = self.jobs.owner_of(dest, layer_id)
+        with self._lock:
+            want = (self.assignment.get(dest) or {}).get(layer_id)
         self.send_retransmit(layer_id, sender, dest,
-                             job_id=jid_owner[1] if jid_owner else "")
+                             job_id=jid_owner[1] if jid_owner else "",
+                             shard=want.shard if want is not None else "")
 
     def handle_ack(self, msg: AckMsg) -> None:
         """Completion accounting + throughput tracking + re-scheduling
@@ -2358,8 +2471,11 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
             )
             avg, count = self.performance.get(job.sender, (0.0, 0))
             self.performance[job.sender] = ((avg * count + dur) / (count + 1), count + 1)
-            # The new owner can now serve this layer too.
-            self.layer_owners.setdefault(msg.layer_id, set()).add(msg.src_id)
+            # The new owner can now serve this layer too — unless it
+            # holds only a shard of it (docs/sharding.md).
+            if not msg.shard:
+                self.layer_owners.setdefault(msg.layer_id, set()).add(
+                    msg.src_id)
             del self._pull_jobs[msg.layer_id][msg.src_id]
             sender = job.sender
         if sender is not None:
@@ -2483,12 +2599,14 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         # pair with zero wire bytes.
                         continue
                     held = self.status.get(dest, {}).get(layer_id)
-                    if held is not None:
-                        # Already in RAM/HBM: satisfaction counts it as-is
-                        # — a self-job would re-send the layer to itself
-                        # for nothing.  DISK/CLIENT copies DO need the
-                        # self-fetch (delivery means in-memory,
-                        # node.go:435-446; self-jobs at :1205-1217).
+                    if held is not None and shard_covers(held.shard,
+                                                         meta.shard):
+                        # Already in RAM/HBM (at covering shard):
+                        # satisfaction counts it as-is — a self-job would
+                        # re-send the layer to itself for nothing.
+                        # DISK/CLIENT copies DO need the self-fetch
+                        # (delivery means in-memory, node.go:435-446;
+                        # self-jobs at :1205-1217).
                         if not delivered(held):
                             self_jobs.setdefault(dest, []).append(
                                 FlowJob(dest, layer_id,
@@ -2498,7 +2616,13 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     info = self.partial_status.get(dest, {}).get(layer_id)
                     if info:
                         covered = [(int(s), int(e)) for s, e in info["Covered"]]
-                        gaps = intervals.complement(covered, int(info["Total"]))
+                        # Sharded targets resume within their shard's
+                        # range: only the SHARD's uncovered bytes plan
+                        # (docs/sharding.md) — coverage outside it is
+                        # irrelevant to this target.
+                        s0, s_sz = shard_range(meta.shard,
+                                               int(info["Total"]))
+                        gaps = intervals.uncovered(covered, s0, s0 + s_sz)
                         remaining = intervals.covered(gaps)
                         if remaining <= 0:
                             continue  # fully covered; receiver will re-ack
@@ -2506,7 +2630,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         remaining_sizes[(layer_id, dest)] = remaining
                         log.info("resuming partial layer", layer=layer_id,
                                  dest=dest, remaining=remaining,
-                                 total=info["Total"])
+                                 total=info["Total"], shard=meta.shard)
                     modified.setdefault(dest, {})[layer_id] = meta
             if not modified:
                 log.info("No jobs to assign other than self-assignment")
@@ -2651,6 +2775,14 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             )
             with self._lock:
                 total = self._layer_size_locked(layer_id)
+                want = (self.assignment.get(dest) or {}).get(layer_id)
+            if want is not None and want.shard:
+                # Sharded targets ride the host path: the fabric plane's
+                # ingest/collectives materialize WHOLE layers only
+                # (docs/sharding.md, honest limits).
+                for j in group:
+                    host_jobs.setdefault(j.sender_id, []).append(j)
+                continue
             if total > 0 and self._fabric_ok(layer_id, layout, dest, total):
                 eligible.append((layer_id, dest, layout, total))
             else:
@@ -2754,13 +2886,20 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 dest, lid = job.dest_id, job.layer_id
                 if dest == node_id or dest == self.node.my_id:
                     continue
+                want = (self.assignment.get(dest) or {}).get(lid)
                 held = self.status.get(dest, {}).get(lid)
-                if held is not None and delivered(held):
-                    continue  # already landed whole
+                if (held is not None and delivered(held)
+                        and (want is None
+                             or shard_covers(held.shard, want.shard))):
+                    continue  # already landed whole (target shard covered)
                 if (lid, dest) in self._salvaging:
                     continue
-                alt = pick_salvage_source(self.status, lid,
-                                          exclude={node_id, dest})
+                # The salvage source must really hold the bytes being
+                # re-requested: the target's shard for sharded pairs,
+                # the whole layer otherwise.
+                alt = pick_salvage_source(
+                    self.status, lid, exclude={node_id, dest},
+                    need_shard=want.shard if want is not None else "")
                 if alt is None:
                     continue  # no surviving holder: base re-plan covers it
                 self._salvaging.add((lid, dest))

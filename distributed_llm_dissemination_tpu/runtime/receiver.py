@@ -34,6 +34,8 @@ from ..core.types import (
     LayerMeta,
     LayerSrc,
     LayersSrc,
+    shard_covers,
+    shard_range,
 )
 from ..transport.messages import (
     AckMsg,
@@ -232,6 +234,12 @@ class ReceiverNode:
         # counts, per-range NACK budgets, and the retransmit service
         # for NACKs this node receives as a SENDER.
         self.layer_digests: Dict[int, str] = {}
+        # Sharded targets (docs/sharding.md): leader-stamped shard spec
+        # per assigned layer (the interval set is complete — and acks —
+        # at SHARD coverage), and the per-RANGE digest a shard verifies
+        # against without ever holding the full layer.
+        self._shard_specs: Dict[int, str] = {}
+        self._range_digests: Dict[int, str] = {}
         self._own_digests: Dict[int, str] = {}
         self._digest_ok: set = set()
         self._digest_retries: Dict[int, int] = {}
@@ -484,6 +492,7 @@ class ReceiverNode:
                     limit_rate=src.meta.limit_rate,
                     source_type=src.meta.source_type,
                     data_size=src.data_size,
+                    shard=src.meta.shard,
                 )
                 for lid, src in self.layers.items()
             }
@@ -594,15 +603,23 @@ class ReceiverNode:
         if not integrity.digests_enabled():
             return {}
         with self._lock:
+            # SHARD holdings never announce a layer digest: their buffer
+            # is only real inside the shard's range, and hashing it as a
+            # full layer would poison the leader's stamp collection
+            # (docs/sharding.md).  Their range digest is indexed at
+            # verify time instead.
             todo = [(lid, src) for lid, src in self.layers.items()
-                    if lid not in self._own_digests]
+                    if lid not in self._own_digests
+                    and not src.meta.shard]
         for lid, src in todo:
             d = integrity.digest_layer_src(src)
             if d is not None:
                 self._own_digests[lid] = d
                 self.content_store.index(lid, d)
         with self._lock:
-            return dict(self._own_digests)
+            return {lid: d for lid, d in self._own_digests.items()
+                    if not (self.layers.get(lid) is not None
+                            and self.layers[lid].meta.shard)}
 
     def handle_layer_digests(self, msg: LayerDigestsMsg) -> None:
         """The leader's expected-digest stamp for this dest's layers;
@@ -616,11 +633,75 @@ class ReceiverNode:
         re-plans it, exactly like a mismatch at the ack gate."""
         if self._fence_stale(msg):
             return
+        widened = []
         with self._lock:
             self.layer_digests.update(msg.digests)
-        log.debug("layer digests stamped", n=len(msg.digests))
+            # The stamp is leader-authoritative per dest: a layer
+            # stamped with a FULL digest and no shard entry — or an
+            # explicit ``""`` entry in the shards map (the digests-off
+            # form) — had its target WIDENED (e.g. a second job wanting
+            # a disjoint shard merged the pair to full); one stamped
+            # with a DIFFERENT spec the held shard doesn't cover was
+            # RE-TARGETED.  Either way the stale spec must not keep
+            # completing (and re-acking) at the old shard's coverage,
+            # and an already-promoted shard holding must reopen as a
+            # partial so the redelivered remainder completes the new
+            # target (the replan/re-ack livelock this breaks: the
+            # leader plans the new range forever while the dest's
+            # dup-done path re-acks the old shard forever).
+            def _reconcile(lid, new_spec):
+                src = self.layers.get(lid)
+                if (src is not None and src.meta.shard
+                        and not shard_covers(src.meta.shard, new_spec)):
+                    widened.append(lid)
+
+            for lid in msg.digests:
+                if lid not in msg.shards:
+                    self._shard_specs.pop(lid, None)
+                    self._range_digests.pop(lid, None)
+                    _reconcile(lid, "")
+            for lid, spec in msg.shards.items():
+                if not spec:
+                    self._shard_specs.pop(lid, None)
+                    self._range_digests.pop(lid, None)
+                _reconcile(lid, spec)
+            self._shard_specs.update(
+                {l: s for l, s in msg.shards.items() if s})
+            self._range_digests.update(msg.range_digests)
+        log.debug("layer digests stamped", n=len(msg.digests),
+                  shards=len(msg.shards))
+        if widened:
+            self._reopen_widened(widened)
         self._recheck_stamped(list(msg.digests))
         self._try_content_resolve(sorted(msg.digests))
+        if msg.shards:
+            # Fragments can land BEFORE their shard stamp: a layer whose
+            # coverage already satisfies the just-learned shard must
+            # promote now — no later fragment will re-run the check.
+            self._on_shard_specs(sorted(msg.shards))
+
+    def _reopen_widened(self, lids) -> None:
+        """Hook: these SHARD holdings' targets widened (or re-targeted
+        to a shard the held one doesn't cover).  The flow receiver
+        demotes them back to partial coverage (keeping the shard's
+        landed bytes); the base receiver can't reassemble fragments, so
+        it drops the holding — the re-plan re-ships the whole target."""
+        for lid in lids:
+            with self._lock:
+                src = self.layers.get(lid)
+                if src is None or not src.meta.shard:
+                    continue
+                del self.layers[lid]
+                self._own_digests.pop(lid, None)
+                self._digest_ok.discard(lid)
+            self.content_store.forget(lid)
+            log.warn("shard holding's target widened/re-targeted; "
+                     "dropped for redelivery", layerID=lid)
+
+    def _on_shard_specs(self, lids) -> None:
+        """Hook: shard specs were (re)stamped for these layers.  The
+        flow receiver re-checks completion; the base receiver has no
+        partial state to promote."""
 
     def _recheck_stamped(self, lids) -> None:
         """Retroactive digest verification for layers that landed before
@@ -630,6 +711,11 @@ class ReceiverNode:
                 src = self.layers.get(lid)
                 done = lid in self._digest_ok
             if src is None or done or src.inmem_data is None:
+                continue
+            if src.meta.shard:
+                # A shard holding verified against its RANGE digest at
+                # the shard gate; the full-layer stamp doesn't apply to
+                # its buffer (only the shard's range is real).
                 continue
             if self._verify_layer_digest(lid, memoryview(src.inmem_data)):
                 continue
@@ -647,10 +733,15 @@ class ReceiverNode:
         ack and skips shipping, while nothing else ever re-runs the
         resolve.  Must not be called under ``self._lock``."""
         with self._lock:
+            if (self._shard_specs.get(lid)
+                    or (self.layers.get(lid) is not None
+                        and self.layers[lid].meta.shard)):
+                return  # a shard holding can't donate full-layer bytes
             digest = (self._own_digests.get(lid)
                       or self.layer_digests.get(lid))
             pending = ([l for l, d in self.layer_digests.items()
-                        if d == digest and l not in self.layers]
+                        if d == digest and l not in self.layers
+                        and not self._shard_specs.get(l)]
                        if digest else [])
         if pending:
             self._try_content_resolve(sorted(pending))
@@ -667,6 +758,12 @@ class ReceiverNode:
         for lid in lids:
             with self._lock:
                 if lid in self.layers:
+                    continue
+                if self._shard_specs.get(lid):
+                    # Sharded targets resolve by the (digest, range)
+                    # key, which full-layer vouching doesn't carry —
+                    # no content resolve for them (docs/sharding.md,
+                    # honest limits).
                     continue
                 digest = self.layer_digests.get(lid)
             if not digest:
@@ -738,8 +835,15 @@ class ReceiverNode:
 
     def _expected_digest(self, lid):
         """The leader-stamped digest for a layer, falling back to this
-        node's own announced digest (a seeder re-verifying its copy)."""
+        node's own announced digest (a seeder re-verifying its copy).
+        For a SHARDED target the expected digest is the RANGE digest —
+        the digest of exactly the shard's bytes (docs/sharding.md);
+        callers hash the shard's slice against it.  None when the
+        sharded stamp carried no range digest (the shard then verifies
+        by per-fragment CRC alone)."""
         with self._lock:
+            if self._shard_specs.get(lid):
+                return self._range_digests.get(lid)
             return self.layer_digests.get(lid) or self._own_digests.get(lid)
 
     def _on_corrupt_fragment(self, src_id, layer_id, offset, size,
@@ -779,12 +883,16 @@ class ReceiverNode:
             log.error("NACK send failed", dest=src_id, layerID=layer_id,
                       err=repr(e))
 
-    def _verify_layer_digest(self, lid, data) -> bool:
+    def _verify_layer_digest(self, lid, data, shard: str = "") -> bool:
         """Check ``data`` against the layer's expected digest; True when
         no digest is known or it matches (memoized — a re-ack never
         re-hashes).  Counts + logs the outcome; the CALLER owns
         recovery (drop/NACK for whole-layer frames, interval re-open +
-        re-announce for assembled mode-3 layers)."""
+        re-announce for assembled mode-3 layers).  ``shard``: the spec
+        ``data`` spans (the caller sliced the shard's range; the
+        expected digest is then the stamped RANGE digest, and the
+        verified bytes are content-indexed under the (digest, shard)
+        key — docs/sharding.md)."""
         expected = self._expected_digest(lid)
         if expected is None:
             return True
@@ -801,9 +909,12 @@ class ReceiverNode:
                 # The bytes now provably hash to the stamp: seed the
                 # announce cache so a recovery re-announce (replan,
                 # digest retry) never re-hashes gigabytes it already
-                # verified on the handler thread.
-                self._own_digests[lid] = expected
-            self.content_store.index(lid, expected)
+                # verified on the handler thread.  (Shard holdings skip
+                # it — their cache entry would be a RANGE digest the
+                # announce must not present as a layer digest.)
+                if not shard:
+                    self._own_digests[lid] = expected
+            self.content_store.index(lid, expected, shard=shard)
             log.info("layer digest verified", layerID=lid,
                      digest_ms=round(dt * 1000, 1), bytes=len(data))
             return True
@@ -937,6 +1048,18 @@ class ReceiverNode:
             src = self.layers.get(msg.layer_id)
         if src is None:
             fresh = msg.layer_src
+            if 0 < fresh.data_size < msg.total_size:
+                # A byte-range fragment (a shard-target send, or a
+                # range retransmit) at a whole-layer receiver: this
+                # class has no interval reassembly — storing it as the
+                # layer would ack a buffer full of holes.  Flow-capable
+                # receivers (mode 3's class) override this handler.
+                log.error("byte-range fragment at a whole-layer "
+                          "receiver; dropped (sharded targets need a "
+                          "flow-capable receiver)", layerID=msg.layer_id,
+                          offset=fresh.offset, size=fresh.data_size,
+                          total=msg.total_size)
+                return
             # Digest-gate whole-layer frames only, and only when a
             # digest is stamped — no byte copy on the unstamped path.
             if (self._expected_digest(msg.layer_id) is not None
@@ -1880,7 +2003,7 @@ class RetransmitReceiverNode(ReceiverNode):
             return
         try:
             send_layer(self.node, msg.dest_id, msg.layer_id, layer,
-                       job_id=msg.job_id)
+                       job_id=msg.job_id, shard=msg.shard)
         except (OSError, KeyError) as e:
             log.error("failed to send layer", dest=msg.dest_id, err=repr(e))
 
@@ -2067,14 +2190,17 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     if (total is None or src is None or last is None
                             or now - last < gap_s or not cov.idle()):
                         continue
-                    gaps = intervals.complement(cov.committed(), total)
-                    gaps = [(s, e) for s, e in gaps
+                    s0, s_sz = shard_range(
+                        self._shard_specs.get(lid, ""), total)
+                    all_gaps = intervals.uncovered(cov.committed(),
+                                                   s0, s0 + s_sz)
+                    gaps = [(s, e) for s, e in all_gaps
                             if self._nack_counts.get((lid, s), 0)
                             < _NACK_MAX_PER_RANGE]
                     if gaps:
                         stale.append((lid, src, total, gaps))
                         self._frag_t[lid] = now
-                    elif intervals.complement(cov.committed(), total):
+                    elif all_gaps:
                         # Every remaining gap's NACK budget is spent:
                         # stand down for this layer — recovery belongs
                         # to crash detection now, not a per-interval
@@ -2179,6 +2305,48 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 if lid in self._partial_total
             }
 
+    def _reopen_widened(self, lids) -> None:
+        """A promoted SHARD holding whose target widened (or
+        re-targeted to a non-covered shard) demotes back to PARTIAL
+        coverage — the shard's landed bytes stay (the buffer already is
+        the full-size reassembly buffer), and the re-planned remainder
+        completes the new target through the normal fragment path."""
+        for lid in lids:
+            with self._lock:
+                src = self.layers.get(lid)
+                if src is None or not src.meta.shard:
+                    continue
+                total = src.data_size
+                s0, s_sz = shard_range(src.meta.shard, total)
+                del self.layers[lid]
+                self._own_digests.pop(lid, None)
+                self._digest_ok.discard(lid)
+                self._partial[lid] = (
+                    src.inmem_data,
+                    intervals.ClaimedCoverage([(s0, s0 + s_sz)]))
+                self._partial_total[lid] = total
+            self.content_store.forget(lid)
+            with self._ingests_lock:
+                self._ingest_done.discard(lid)
+            log.warn("shard holding's target widened/re-targeted; "
+                     "reopened as partial coverage", layerID=lid,
+                     kept_bytes=s_sz, total=total)
+
+    def _on_shard_specs(self, lids) -> None:
+        """Shard specs just (re)stamped: promote any layer whose
+        existing coverage already satisfies its shard — fragments can
+        land before the stamp, and no later fragment would re-run the
+        completion check (docs/sharding.md)."""
+        for lid in lids:
+            with self._lock:
+                total = self._partial_total.get(lid)
+            if total is None:
+                continue
+            # commit(None) is a no-op: this reuses the promotion gate
+            # without releasing anyone's claim.
+            if self._commit_fragment(lid, None, total):
+                self._ack_completed(lid)
+
     def _local_coverage(self, layer_id):
         """Checkpoint-restored bytes seed a resumed fabric ingest: the
         leader's plan covers only the gaps (leader.assign_jobs), so what
@@ -2249,8 +2417,10 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             # and a range requested twice is absorbed by interval
             # reassembly — a range never requested is a stall until the
             # gap watchdog notices.  Slightly over-counts salvage_bytes;
-            # never under-recovers.
-            gaps = intervals.complement(cov.committed(), total)
+            # never under-recovers.  Sharded targets salvage only their
+            # shard's range (docs/sharding.md).
+            s0, s_sz = shard_range(self._shard_specs.get(lid, ""), total)
+            gaps = intervals.uncovered(cov.committed(), s0, s0 + s_sz)
         missing = sum(e - s for s, e in gaps)
         trace.count("failover.salvage_ranges", len(gaps))
         trace.count("failover.salvage_bytes", missing)
@@ -2520,7 +2690,13 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         """Release this fragment's copy claim; promote the layer when
         coverage is full AND no sibling copy is in flight.  Returns
         whether THIS commit performed the promotion (exactly one does —
-        the caller then stages + acks)."""
+        the caller then stages + acks).
+
+        SHARDED targets (docs/sharding.md) promote at SHARD coverage:
+        the stamped spec's byte range is all this dest was ever promised
+        — the holding is recorded shard-qualified (``meta.shard``), its
+        buffer real only inside the range (the rest is unfaulted pages,
+        so host RAM stays ≈ the shard fraction)."""
         with self._lock:
             entry = self._partial.get(lid)
             if entry is not None:
@@ -2530,11 +2706,16 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             if entry is None:
                 return False
             buf, cov = entry
-            if not cov.complete(total):
+            spec = self._shard_specs.get(lid, "")
+            if spec:
+                s0, s_sz = shard_range(spec, total)
+                if not cov.complete_range(s0, s0 + s_sz):
+                    return False
+            elif not cov.complete(total):
                 return False
             self.layers[lid] = LayerSrc(
                 inmem_data=buf, data_size=total,
-                meta=LayerMeta(location=LayerLocation.INMEM),
+                meta=LayerMeta(location=LayerLocation.INMEM, shard=spec),
             )
             del self._partial[lid]
             self._partial_total.pop(lid, None)
@@ -2580,14 +2761,22 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             self._ingest_done.add(lid)
             ing = self._ingests.pop(lid, None)
             self._ingest_share.pop(lid, None)
-        loc = self._stage_to_hbm(lid, src, ingest=ing)
-        # Mid-wire boot staging: this layer's decode/upload overlaps the
-        # layers still on the wire (runtime/stream_boot.py).
-        self._boot_stream_submit(lid, src)
+        shard = src.meta.shard
+        if shard:
+            # A SHARD holding stays host-resident and un-booted: its
+            # buffer is only real inside the shard's range — the full
+            # layer materializes on-mesh via the shard gather when the
+            # target sharding demands it (docs/sharding.md).
+            loc = LayerLocation.INMEM
+        else:
+            loc = self._stage_to_hbm(lid, src, ingest=ing)
+            # Mid-wire boot staging: this layer's decode/upload overlaps
+            # the layers still on the wire (runtime/stream_boot.py).
+            self._boot_stream_submit(lid, src)
         try:
             self.node.transport.send(
                 self.node.leader_id,
-                AckMsg(self.node.my_id, lid, loc),
+                AckMsg(self.node.my_id, lid, loc, shard=shard),
             )
         except (OSError, KeyError) as e:
             log.error("failed to send ackMsg", err=repr(e))
@@ -2629,7 +2818,16 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         successful run."""
         if src.inmem_data is None:
             return True  # no host bytes to hash (fabric HBM delivery)
-        if self._verify_layer_digest(lid, memoryview(src.inmem_data)):
+        shard = src.meta.shard
+        if shard:
+            # A shard verifies over EXACTLY its range's bytes, against
+            # the stamped RANGE digest — the full layer never has to be
+            # held here (docs/sharding.md).
+            s0, s_sz = shard_range(shard, src.data_size)
+            view = memoryview(src.inmem_data)[s0:s0 + s_sz]
+        else:
+            view = memoryview(src.inmem_data)
+        if self._verify_layer_digest(lid, view, shard=shard):
             return True
         self._demote_corrupt_layer(lid)
         if self._bump_digest_retry(lid):

@@ -20,6 +20,7 @@ from ..core.types import (
     LayerSrc,
     LayersSrc,
     NodeID,
+    shard_range,
 )
 from ..transport.messages import ClientReqMsg, FlowRetransmitMsg, LayerMsg
 from ..utils import telemetry, trace
@@ -54,14 +55,31 @@ def _fragment_bytes(rate: int) -> int:
 
 
 def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
-               job_id: str = "") -> None:
+               job_id: str = "", shard: str = "") -> None:
     """Send one full layer to ``dest``; client-held layers are fetched via
     the pipe mechanism instead (node.go:354-365).  ``job_id`` tags the
     frames with the admitted dissemination job they serve ("" = the base
-    run) so link telemetry splits per job (docs/service.md)."""
+    run) so link telemetry splits per job (docs/service.md).
+
+    ``shard`` (docs/sharding.md): send only that shard spec's byte
+    range of the layer, as a byte-range fragment (``total_size`` stays
+    the full layer size, so the dest's interval accounting speaks
+    absolute layer coordinates) — the whole-layer path for modes 0-2
+    honoring a sharded target.  Client-held layers can't range-serve
+    and fall back to the full-layer pipe fetch (over-delivery is safe)."""
     if layer.meta.location == LayerLocation.CLIENT:
         log.debug("loading layer from client", layer=layer_id)
         fetch_from_client(node, layer_id, dest)
+        return
+    if shard:
+        off, size = shard_range(shard, layer.data_size)
+        sub = _sub_layer_src(layer, _sendable_location(layer), off, size,
+                             layer.meta.limit_rate)
+        trace.count("shard.range_sends")
+        node.transport.send(
+            dest, LayerMsg(node.my_id, layer_id, sub, layer.data_size,
+                           job_id=job_id, shard=shard)
+        )
         return
     node.transport.send(
         dest, LayerMsg(node.my_id, layer_id, layer, layer.data_size,
@@ -157,6 +175,17 @@ class NackRetransmitter:
                       offset=msg.offset, size=msg.size,
                       layer_size=layer.data_size)
             return False
+        if layer.meta.shard:
+            # A SHARD holder's buffer is only real inside its shard's
+            # range — serving bytes outside it would retransmit garbage
+            # as verified-looking frames (docs/sharding.md).
+            s0, sz = shard_range(layer.meta.shard, layer.data_size)
+            if msg.offset < s0 or msg.offset + size > s0 + sz:
+                log.error("NACK names bytes outside this holder's shard; "
+                          "cannot range-serve them from here",
+                          layerID=msg.layer_id, offset=msg.offset,
+                          size=size, shard=layer.meta.shard)
+                return False
         node.add_node(msg.src_id)
         # Retransmits honor the holder's modeled source rate — a NACK
         # must not let a rate-limited seeder exceed what its source

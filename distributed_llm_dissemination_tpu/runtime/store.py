@@ -22,6 +22,13 @@ Two small classes, two sides of the same key:
   digest the dest already holds under ANY layer id — the dest's own
   resolve-and-ack completes the pair.
 
+Shard scoping (docs/sharding.md): every entry is keyed by
+``(digest, shard)`` — a SHARD holding's digest is the digest of its
+byte RANGE, verified over exactly those bytes, so it can only ever
+vouch for (and alias to) a target with the SAME range.  A full-layer
+query (``shard=""``) never matches a shard-vouched entry: a
+shard-holder can never ack a full-layer pair.
+
 Digest trust model: both sides only index digests that were locally
 verified (node) or announced/stamped through the PR-4 integrity plane
 (leader) — the same trust the digest verification gate already places
@@ -35,53 +42,65 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..core.types import LayerID, NodeID
 
+# The (digest, shard) content key; shard "" = the whole layer.
+ContentKey = Tuple[str, str]
+
 
 class ContentStore:
-    """digest → layer ids this node holds with those exact bytes."""
+    """(digest, shard) → layer ids this node holds with those exact
+    bytes over exactly that range."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._by_digest: Dict[str, Set[LayerID]] = {}
-        self._by_layer: Dict[LayerID, str] = {}
+        self._by_key: Dict[ContentKey, Set[LayerID]] = {}
+        self._by_layer: Dict[LayerID, ContentKey] = {}
 
-    def index(self, lid: LayerID, digest: str) -> None:
+    def index(self, lid: LayerID, digest: str, shard: str = "") -> None:
         if not digest:
             return
+        key = (str(digest), str(shard))
         with self._lock:
             old = self._by_layer.get(lid)
-            if old == digest:
+            if old == key:
                 return
             if old is not None:
-                ids = self._by_digest.get(old)
+                ids = self._by_key.get(old)
                 if ids is not None:
                     ids.discard(lid)
                     if not ids:
-                        del self._by_digest[old]
-            self._by_layer[lid] = digest
-            self._by_digest.setdefault(digest, set()).add(lid)
+                        del self._by_key[old]
+            self._by_layer[lid] = key
+            self._by_key.setdefault(key, set()).add(lid)
 
     def forget(self, lid: LayerID) -> None:
         """Drop a layer (demoted as corrupt, evicted): its bytes can no
         longer vouch for the digest."""
         with self._lock:
-            digest = self._by_layer.pop(lid, None)
-            if digest is not None:
-                ids = self._by_digest.get(digest)
+            key = self._by_layer.pop(lid, None)
+            if key is not None:
+                ids = self._by_key.get(key)
                 if ids is not None:
                     ids.discard(lid)
                     if not ids:
-                        del self._by_digest[digest]
+                        del self._by_key[key]
 
-    def lookup(self, digest: str) -> Optional[LayerID]:
-        """A local layer id holding these bytes (lowest id for
-        determinism), or None."""
+    def lookup(self, digest: str, shard: str = "") -> Optional[LayerID]:
+        """A local layer id holding these bytes over this exact range
+        (lowest id for determinism), or None.  A full-layer lookup
+        (``shard=""``) only matches full-layer holdings."""
         with self._lock:
-            ids = self._by_digest.get(digest)
+            ids = self._by_key.get((str(digest), str(shard)))
             return min(ids) if ids else None
 
     def digest_of(self, lid: LayerID) -> Optional[str]:
         with self._lock:
-            return self._by_layer.get(lid)
+            key = self._by_layer.get(lid)
+            return key[0] if key is not None else None
+
+    def shard_of(self, lid: LayerID) -> Optional[str]:
+        with self._lock:
+            key = self._by_layer.get(lid)
+            return key[1] if key is not None else None
 
     def size(self) -> int:
         with self._lock:
@@ -89,7 +108,7 @@ class ContentStore:
 
 
 class ContentIndex:
-    """Leader-side digest → holders map.
+    """Leader-side (digest, shard) → holders map.
 
     An announce is the node's authoritative inventory, so
     :meth:`reset_node` replaces that node's contribution wholesale
@@ -99,42 +118,52 @@ class ContentIndex:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # node -> {layer: digest}; the digest->holders view is derived.
-        self._node_layers: Dict[NodeID, Dict[LayerID, str]] = {}
+        # node -> {layer: (digest, shard)}; digest->holders is derived.
+        self._node_layers: Dict[NodeID, Dict[LayerID, ContentKey]] = {}
 
     def reset_node(self, node: NodeID,
                    digests: Optional[Dict[LayerID, str]] = None) -> None:
+        """Replace a node's vouching with its announce-time FULL-layer
+        digests (shard holdings announce no layer digest — a range hash
+        as a layer digest would poison the stamp collection)."""
         with self._lock:
             if digests:
                 self._node_layers[node] = {
-                    int(l): str(d) for l, d in digests.items()}
+                    int(l): (str(d), "") for l, d in digests.items()}
             else:
                 self._node_layers.pop(node, None)
 
-    def add(self, node: NodeID, lid: LayerID, digest: Optional[str]) -> None:
+    def add(self, node: NodeID, lid: LayerID, digest: Optional[str],
+            shard: str = "") -> None:
         if not digest:
             return
         with self._lock:
-            self._node_layers.setdefault(node, {})[lid] = digest
+            self._node_layers.setdefault(node, {})[lid] = (str(digest),
+                                                           str(shard))
 
     def drop_node(self, node: NodeID) -> None:
         with self._lock:
             self._node_layers.pop(node, None)
 
-    def node_has(self, node: NodeID, digest: str) -> bool:
+    def node_has(self, node: NodeID, digest: str, shard: str = "") -> bool:
         """Whether ``node`` provably holds bytes hashing to ``digest``
-        under ANY layer id."""
+        over exactly ``shard``'s range, under ANY layer id.  A
+        full-layer query never matches a shard-vouched holding."""
         if not digest:
             return False
+        key = (str(digest), str(shard))
         with self._lock:
-            return digest in (self._node_layers.get(node) or {}).values()
+            return key in (self._node_layers.get(node) or {}).values()
 
-    def holders(self, digest: str) -> List[Tuple[NodeID, LayerID]]:
-        """Every (node, layer) currently vouched for the digest, sorted."""
+    def holders(self, digest: str,
+                shard: str = "") -> List[Tuple[NodeID, LayerID]]:
+        """Every (node, layer) currently vouched for (digest, shard),
+        sorted."""
+        key = (str(digest), str(shard))
         out: List[Tuple[NodeID, LayerID]] = []
         with self._lock:
             for node in sorted(self._node_layers):
-                for lid, d in sorted(self._node_layers[node].items()):
-                    if d == digest:
+                for lid, k in sorted(self._node_layers[node].items()):
+                    if k == key:
                         out.append((node, lid))
         return out

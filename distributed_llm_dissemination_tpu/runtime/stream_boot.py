@@ -79,6 +79,10 @@ class StreamingBootStager:
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
         self._staged: Dict[int, dict] = {}
+        # Shard-gather state (docs/sharding.md): blob -> accumulated
+        # shard parts, and blob -> the materialized full layer's bytes.
+        self._shards: Dict[int, dict] = {}
+        self._gathered: Dict[int, bytes] = {}
         self._submitted: set = set()
         self._pending = 0
         self._closed = False
@@ -168,6 +172,9 @@ class StreamingBootStager:
             item = self._q.get()
             if item is None:
                 return
+            if item[0] == "gather":
+                self._gather_one(item[1])
+                continue
             blob_id, src = item
             leaves = None
             t0 = time.monotonic()
@@ -210,6 +217,98 @@ class StreamingBootStager:
                     self.placement.node_to_stage[self.node_id]), P()
             )
         return None
+
+    def submit_shard(self, blob_id: int, spec: str, data, total: int,
+                     expected_digest: str = "") -> bool:
+        """Feed one completed SHARD of a layer to the shard gather
+        (docs/sharding.md) — callable the moment the shard's interval
+        set completes, in ANY completion order across shards.  Returns
+        False for duplicates/closed stagers.  When the last shard of a
+        layer arrives, the worker thread runs the on-mesh all-gather
+        (``parallel.collectives.gather_byte_shards``) and the
+        materialized FULL layer becomes available via
+        ``collect_gathered`` — verified against ``expected_digest``
+        (the stamped full-layer digest) when one is known."""
+        from ..core.types import parse_shard_spec
+
+        parsed = parse_shard_spec(spec)
+        n, k = parsed if parsed is not None else (1, 0)
+        with self._lock:
+            if self._closed:
+                return False
+            rec = self._shards.setdefault(
+                blob_id, {"n": n, "total": int(total), "parts": {},
+                          "digest": "", "queued": False})
+            if rec["n"] != n or rec["total"] != int(total):
+                log.error("conflicting shard geometry submitted",
+                          blobID=blob_id, have_n=rec["n"], got_n=n)
+                return False
+            if k in rec["parts"]:
+                return False
+            rec["parts"][k] = bytes(data)
+            if expected_digest:
+                rec["digest"] = expected_digest
+            ready = len(rec["parts"]) >= n and not rec["queued"]
+            if not ready:
+                return True
+            rec["queued"] = True
+            self._pending += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"boot-stream-{self.node_id}")
+                self._thread.start()
+            self._q.put(("gather", blob_id))
+        return True
+
+    def collect_gathered(self, blob_ids, timeout: float = 300.0
+                         ) -> Dict[int, bytes]:
+        """Wait for in-flight gathers, then return {blob_id: full layer
+        bytes} for the requested ids whose shard sets materialized."""
+        with self._lock:
+            self._done.wait_for(lambda: self._pending == 0, timeout=timeout)
+            if self._pending:
+                log.warn("shard gathers still in flight at collect",
+                         pending=self._pending)
+                return {}
+            return {b: self._gathered[b] for b in blob_ids
+                    if b in self._gathered}
+
+    def _gather_one(self, blob_id: int) -> None:
+        from ..parallel.collectives import gather_byte_shards
+
+        t0 = time.monotonic()
+        with self._lock:
+            rec = self._shards.get(blob_id)
+            if rec is None:
+                parts, total, digest = None, 0, ""
+            else:
+                parts = sorted(rec["parts"].items())
+                total, digest = rec["total"], rec["digest"]
+        out = None
+        if parts is not None:
+            try:
+                out = gather_byte_shards(parts, total,
+                                         verify_digest=digest or None)
+            except Exception as e:  # noqa: BLE001 — loud, never wedge
+                log.error("on-mesh shard gather failed", blobID=blob_id,
+                          err=repr(e))
+        dt = time.monotonic() - t0
+        with self._lock:
+            if out is not None and blob_id in self._shards:
+                self._gathered[blob_id] = out
+            in_wire = not self._startup_seen
+            self._pending -= 1
+            if self._pending == 0:
+                self._done.notify_all()
+        if out is not None:
+            trace.add_phase(PHASE_STREAM_STAGE, dt)
+            if in_wire:
+                trace.add_phase(PHASE_STREAM_IN_WIRE, dt)
+            log.info("layer materialized from shards (on-mesh gather)",
+                     blobID=blob_id, gather_ms=round(dt * 1000, 1),
+                     in_wire=in_wire, bytes=len(out),
+                     digest_verified=bool(digest))
 
     def _stage_one(self, blob_id: int, src) -> dict:
         """One blob's staging — ``boot.stage_blob_leaves`` verbatim, so

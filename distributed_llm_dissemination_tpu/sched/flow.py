@@ -55,7 +55,14 @@ import dataclasses
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from ..core.types import Assignment, LayerID, NodeID, Status
+from ..core.types import (
+    Assignment,
+    LayerID,
+    NodeID,
+    Status,
+    shard_covers,
+    shard_range,
+)
 from ..utils.logging import log
 
 _INF = 1 << 62
@@ -70,14 +77,17 @@ def rate_for(data_size: int, t_ms: int) -> int:
 
 
 def pick_salvage_source(status: Status, layer_id: LayerID,
-                        exclude=frozenset()) -> Optional[NodeID]:
+                        exclude=frozenset(),
+                        need_shard: str = "") -> Optional[NodeID]:
     """The surviving holder a dest should re-fetch a dead source's
     unsent byte ranges from (runtime/leader range salvage,
     docs/failover.md): fastest modeled source rate first (0 =
     unlimited), lowest node id as the deterministic tiebreak.  Client-
     held copies can't serve byte-range NACK retransmits, so they never
-    qualify.  None = no survivor holds the layer — the caller falls
-    back to a whole-layer re-plan."""
+    qualify; neither does a shard-holder whose shard doesn't cover the
+    range being salvaged (``need_shard`` — "" means the whole layer is
+    needed, so only full holders qualify).  None = no survivor holds the
+    layer — the caller falls back to a whole-layer re-plan."""
     from ..core.types import LayerLocation
 
     best: Optional[NodeID] = None
@@ -87,6 +97,8 @@ def pick_salvage_source(status: Status, layer_id: LayerID,
             continue
         meta = status[nid].get(layer_id)
         if meta is None or meta.location == LayerLocation.CLIENT:
+            continue
+        if not shard_covers(meta.shard, need_shard):
             continue
         rate = meta.limit_rate if meta.limit_rate != 0 else _INF
         if rate > best_rate:
@@ -257,10 +269,13 @@ def solve_joint(
     by reclaiming link budget at the re-plan while lower tiers are
     slowed, never starved.  EQUAL priorities (with equal avoid sets)
     merge into one graph — the max-flow's fair share over the common
-    links is the measured capacity split between them.  Within a tier,
-    a (dest, layer) pair two jobs both want is planned ONCE (one
-    delivery satisfies both); the pair is attributed to the
-    lexically-first job id for telemetry.
+    links is the measured capacity split between them.  A (dest,
+    layer/shard) pair two jobs both want is planned ONCE — within a
+    tier AND across tiers (a lower tier never re-ships bytes a higher
+    tier already planned this solve when the planned shard covers its
+    target; the ack credits every job wanting the pair) — attributed to
+    the first-planning tier's lexically-first job id and counted on
+    ``jobs.deduped_pairs``.
 
     Returns ``({priority: tier_min_time_ms}, jobs)`` with every emitted
     ``FlowJob`` tagged by its owning job id.  Multiple avoid-groups at
@@ -274,9 +289,15 @@ def solve_joint(
         avoid = tuple(sorted(entry[3])) if len(entry) > 3 and entry[3] \
             else ()
         tiers.setdefault((int(prio), avoid), []).append((str(jid), asg))
+    from ..utils import trace
+
     used_rate: Dict[NodeID, int] = {}
     out_jobs: FlowJobsMap = {}
     t_by_prio: Dict[int, int] = {}
+    # (layer, dest) -> shard spec already planned by a HIGHER tier this
+    # solve: the cross-tier in-flight dedup (docs/service.md "remaining
+    # openings") — one delivery satisfies every job wanting the pair.
+    planned_pairs: Dict[Tuple[LayerID, NodeID], str] = {}
     # Descending priority; within one priority, the un-avoiding group
     # first (deterministic).
     for prio, avoid in sorted(tiers, key=lambda k: (-k[0], k[1])):
@@ -286,9 +307,33 @@ def solve_joint(
             for dest, lids in asg.items():
                 row = merged.setdefault(dest, {})
                 for lid, meta in lids.items():
-                    if lid not in row:
+                    spec = getattr(meta, "shard", "")
+                    prior = planned_pairs.get((lid, dest))
+                    if prior is not None and shard_covers(prior, spec):
+                        # A higher tier already ships (>=) these bytes
+                        # to this dest; the ack will credit this job
+                        # too — planning it again would be duplicate
+                        # in-flight wire bytes.
+                        trace.count("jobs.deduped_pairs")
+                        log.info("cross-tier dedup: pair already "
+                                 "planned by a higher tier this solve",
+                                 layerID=lid, dest=dest, job=jid)
+                        continue
+                    held = row.get(lid)
+                    if held is None:
                         row[lid] = meta
                         owner[(lid, dest)] = jid
+                    elif shard_covers(getattr(held, "shard", ""), spec):
+                        trace.count("jobs.deduped_pairs")
+                    elif shard_covers(spec, getattr(held, "shard", "")):
+                        # The wider target subsumes the narrower one.
+                        row[lid] = meta
+                    else:
+                        # Two jobs want DISJOINT shards of one (dest,
+                        # layer): a single spec can't name the union, so
+                        # widen to the full layer — over-delivery is
+                        # safe, under-delivery wedges a job.
+                        row[lid] = dataclasses.replace(meta, shard="")
         if not merged:
             continue
         bw_res = {n: max(bw - used_rate.get(n, 0),
@@ -296,9 +341,18 @@ def solve_joint(
                   for n, bw in node_network_bw.items()}
         rem = {(lid, dest): v for (lid, dest), v in remaining.items()
                if lid in merged.get(dest, {})}
+
+        def _pair_bytes(lid: LayerID, dest: NodeID, meta) -> int:
+            v = rem.get((lid, dest))
+            if v is not None:
+                return v
+            total = layer_sizes.get(lid, 0)
+            spec = getattr(meta, "shard", "")
+            return shard_range(spec, total)[1] if spec else total
+
         required = sum(
-            rem.get((lid, dest), layer_sizes.get(lid, 0))
-            for dest, lids in merged.items() for lid in lids)
+            _pair_bytes(lid, dest, meta)
+            for dest, lids in merged.items() for lid, meta in lids.items())
         status_view = status
         if avoid:
             status_view = {n: row for n, row in status.items()
@@ -338,6 +392,14 @@ def solve_joint(
             for dest, nbytes in per_dest.items():
                 used_rate[dest] = (used_rate.get(dest, 0)
                                    + nbytes * TIME_SCALE // max(1, t))
+        # Record this tier's planned pairs (shard-qualified) so LOWER
+        # tiers dedup against them instead of re-shipping in-flight
+        # bytes.  First (highest) tier's spec stands — the dedup test is
+        # coverage, not equality.
+        for dest, lids in merged.items():
+            for lid, meta in lids.items():
+                planned_pairs.setdefault((lid, dest),
+                                         getattr(meta, "shard", ""))
         log.info("joint tier solved", priority=prio, min_time_ms=t,
                  jobs=sorted({jid for jid, _ in tiers[(prio, avoid)]}),
                  avoided=list(avoid))
@@ -495,7 +557,6 @@ class FlowGraph:
         ``topology``: multi-slice shape; cross-slice flow then shares the
         per-pair DCN capacity edges (module docstring)."""
         self.assignment = assignment
-        self.status = status
         self.layer_sizes = layer_sizes
         self.node_network_bw = node_network_bw
         self.remaining = remaining or {}
@@ -515,6 +576,23 @@ class FlowGraph:
         self.dests_of: Dict[LayerID, List[NodeID]] = {}
         for lid, dest in self.pairs:
             self.dests_of.setdefault(lid, []).append(dest)
+
+        # Sharded targets (docs/sharding.md): each pair's target shard
+        # spec, read from the assignment meta.  Demands size by SHARD
+        # bytes (``_pair_size``) and decompose starting at the shard's
+        # base offset (``seed_pair_offsets``), so mode-3 budgets,
+        # predictions, and tier preemption all shrink to the shard
+        # fraction.  Shard-HOLDING status rows are filtered out of the
+        # sender side unless their shard covers every requested shard of
+        # that layer — a 1/8 holder can serve a matching 1/8 target but
+        # must never be planned as a full-layer source.
+        self._pair_shard: Dict[Tuple[LayerID, NodeID], str] = {}
+        for dest, layers in assignment.items():
+            for lid, meta in layers.items():
+                spec = getattr(meta, "shard", "")
+                if spec:
+                    self._pair_shard[(lid, dest)] = spec
+        self.status = status = self._filter_shard_senders(status)
 
         self.idx: Dict[_V, int] = {}
 
@@ -554,6 +632,53 @@ class FlowGraph:
         # lazily in _build so NativeFlowGraph never pays for it.
         self.cap: Optional[List[List[int]]] = None
 
+    # ----------------------------------------------------------- shard specs
+
+    def _filter_shard_senders(self, status: Status) -> Status:
+        """A status view safe to plan senders from: a SHARD-holding row
+        entry stays only when its shard covers every requested shard of
+        that layer (then any planned range for the layer is within the
+        holder's real bytes).  Full holdings always stay.  The filter
+        copies only rows it changes — the common unsharded cluster plans
+        over the caller's dicts untouched."""
+        if not any(getattr(m, "shard", "")
+                   for row in status.values() for m in row.values()):
+            return status
+        out: Status = {}
+        for node_id, row in status.items():
+            keep = {}
+            for lid, meta in row.items():
+                if meta.shard and not all(
+                    shard_covers(meta.shard,
+                                 self._pair_shard.get((lid, d), ""))
+                    for d in self.dests_of.get(lid, ())
+                ):
+                    continue
+                keep[lid] = meta
+            out[node_id] = keep if len(keep) != len(row) else row
+        return out
+
+    def _pair_base(self, layer_id: LayerID, dest: NodeID) -> int:
+        """Absolute byte offset the pair's delivery starts at: the shard
+        base for sharded targets, 0 otherwise."""
+        spec = self._pair_shard.get((layer_id, dest))
+        if not spec:
+            return 0
+        return shard_range(spec, self.layer_sizes[layer_id])[0]
+
+    def seed_pair_offsets(self) -> Dict[Tuple[LayerID, NodeID], int]:
+        """Initial per-pair byte offsets for job decomposition.  Pairs
+        with a ``remaining`` override decompose in remaining-space (the
+        caller remaps them through its gap list — leader resume path);
+        all others decompose in absolute layer space, starting at the
+        shard base for sharded targets."""
+        return {
+            (lid, dest): self._pair_base(lid, dest)
+            for lid, dest in self.pairs
+            if (lid, dest) not in self.remaining
+            and self._pair_shard.get((lid, dest))
+        }
+
     # ------------------------------------------------------------- capacities
 
     def _cross(self, sender: NodeID, dest: NodeID) -> bool:
@@ -571,8 +696,16 @@ class FlowGraph:
         return self.node_network_bw.get(node_id, 0) * t // TIME_SCALE
 
     def _pair_size(self, layer_id: LayerID, dest: NodeID) -> int:
-        """Bytes still needed by ``dest`` for ``layer_id``."""
-        return self.remaining.get((layer_id, dest), self.layer_sizes[layer_id])
+        """Bytes still needed by ``dest`` for ``layer_id``: a resume
+        override if the caller gave one, else the target SHARD's bytes
+        (docs/sharding.md), else the full layer."""
+        override = self.remaining.get((layer_id, dest))
+        if override is not None:
+            return override
+        spec = self._pair_shard.get((layer_id, dest))
+        if spec:
+            return shard_range(spec, self.layer_sizes[layer_id])[1]
+        return self.layer_sizes[layer_id]
 
     def _build(self, t: int) -> None:
         """(Re)build edge capacities for candidate time t (flow.go:221-270)."""
@@ -904,7 +1037,7 @@ class FlowGraph:
         best = sched
 
         jobs: FlowJobsMap = {}
-        pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
+        pair_offset = self.seed_pair_offsets()
         self._emit_jobs(
             ((s, lid, d, n) for (s, _st, lid, d), n in sorted(best.items())),
             jobs, pair_offset,
@@ -963,7 +1096,7 @@ class FlowGraph:
                 f"cross-slice attribution failed at t={t}")
 
         jobs: FlowJobsMap = {}
-        pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
+        pair_offset = self.seed_pair_offsets()
         for sender_id in sorted(self.status):
             for layer_id in sorted(self.status[sender_id]):
                 meta = self.status[sender_id][layer_id]

@@ -35,6 +35,8 @@ from ..core.types import (
     delivered,
     layer_ids_from_json,
     layer_ids_to_json,
+    satisfies,
+    shard_covers,
 )
 from ..utils.logging import log
 
@@ -97,13 +99,29 @@ def merge_assignments(base: Assignment, others) -> Assignment:
     """Union of goal states: every (dest, layer) any of them wants.
     Base metas win on conflicts (they carry the run's source modeling);
     the result is a NEW nested dict — mutating it never aliases a job's
-    own target."""
+    own target.
+
+    Shard widening (docs/sharding.md): when two wants name DIFFERENT
+    shards of one (dest, layer) and neither covers the other, the
+    merged target widens to the full layer — a single spec can't name
+    the union, and over-delivery is safe where under-delivery wedges a
+    job."""
     out: Assignment = {n: dict(r) for n, r in base.items()}
     for extra in others:
         for dest, lids in extra.items():
             row = out.setdefault(dest, {})
             for lid, meta in lids.items():
-                row.setdefault(lid, meta)
+                held = row.get(lid)
+                if held is None:
+                    row[lid] = meta
+                    continue
+                h, w = getattr(held, "shard", ""), getattr(meta, "shard", "")
+                if shard_covers(h, w):
+                    continue  # existing target already covers this want
+                if shard_covers(w, h):
+                    row[lid] = dataclasses.replace(held, shard=w)
+                else:
+                    row[lid] = dataclasses.replace(held, shard="")
     return out
 
 
@@ -134,7 +152,7 @@ class JobManager:
             job.remaining = set()
             for dest, lid in pairs:
                 held = status.get(dest, {}).get(lid)
-                if held is not None and delivered(held):
+                if satisfies(held, job.assignment[dest][lid]):
                     job.resolved_at_admit += 1
                 else:
                     job.remaining.add((dest, lid))
@@ -145,13 +163,22 @@ class JobManager:
 
     # ----------------------------------------------------------- accounting
 
-    def on_ack(self, dest: NodeID, lid: LayerID) -> List[str]:
+    def on_ack(self, dest: NodeID, lid: LayerID,
+               shard: str = "") -> List[str]:
         """Credit one delivered (dest, layer) pair against every active
-        job that wants it; returns the job ids the ack completed."""
+        job that wants it; returns the job ids the ack completed.
+        ``shard``: the delivered shard spec ("" = whole layer) — a
+        shard ack only credits jobs whose target shard it COVERS, so a
+        shard-holder can never complete a full-layer demand
+        (docs/sharding.md)."""
         finished: List[str] = []
         with self._lock:
             for job in self._jobs.values():
-                if job.state != ACTIVE:
+                if job.state != ACTIVE or (dest, lid) not in job.remaining:
+                    continue
+                want = job.assignment.get(dest, {}).get(lid)
+                want_shard = getattr(want, "shard", "") if want else ""
+                if not shard_covers(shard, want_shard):
                     continue
                 job.remaining.discard((dest, lid))
                 if not job.remaining:
@@ -197,7 +224,10 @@ class JobManager:
                     continue
                 for dest, lid in list(job.remaining):
                     held = status.get(dest, {}).get(lid)
-                    if held is not None and delivered(held):
+                    want = job.assignment.get(dest, {}).get(lid)
+                    if (held is not None
+                            and (satisfies(held, want) if want is not None
+                                 else delivered(held))):
                         job.remaining.discard((dest, lid))
                 if not job.remaining:
                     job.state = DONE

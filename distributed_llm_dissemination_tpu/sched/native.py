@@ -180,7 +180,11 @@ class NativeFlowGraph(FlowGraph):
                       required=required, achieved=achieved.value)
 
         jobs: FlowJobsMap = {}
-        pair_offset: Dict[Tuple[LayerID, NodeID], int] = {}
+        # Sharded targets decompose from their shard's base offset
+        # (resume-override pairs stay in remaining-space; the leader
+        # remaps those) — same seeding as the Python decompositions.
+        pair_offset: Dict[Tuple[LayerID, NodeID], int] = (
+            self.seed_pair_offsets())
         for sender_id in sorted(self.status):
             for layer_id in sorted(self.status[sender_id]):
                 for dest in self.dests_of.get(layer_id, ()):

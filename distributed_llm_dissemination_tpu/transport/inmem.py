@@ -127,6 +127,7 @@ class InmemTransport(Transport):
             crc=crc,
             xxh3=xxh3,
             job_id=message.job_id,
+            shard=message.shard,
         )
         with self._lock:
             pipe_dest = self._pipes.pop(message.layer_id, None)

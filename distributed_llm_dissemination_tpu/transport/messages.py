@@ -193,20 +193,30 @@ class AnnounceMsg:
 
 @dataclasses.dataclass
 class AckMsg:
-    """Receiver → leader: layer landed (message.go:62-91)."""
+    """Receiver → leader: layer landed (message.go:62-91).
+
+    ``shard`` (docs/sharding.md): the delivered shard spec — a dest
+    whose target was a byte-range slice acks at SHARD coverage, and the
+    leader records the holding as partial (a shard-holder never
+    satisfies a full-layer demand).  "" = whole layer, omitted on the
+    wire (legacy format unchanged)."""
 
     src_id: NodeID
     layer_id: LayerID
     location: LayerLocation = LayerLocation.INMEM
+    shard: str = ""
 
     msg_type = MsgType.ACK
 
     def to_payload(self) -> dict:
-        return {
+        payload = {
             "SrcID": self.src_id,
             "LayerID": self.layer_id,
             "Location": int(self.location),
         }
+        if self.shard:
+            payload["Shard"] = str(self.shard)
+        return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "AckMsg":
@@ -214,6 +224,7 @@ class AckMsg:
             src_id=int(d["SrcID"]),
             layer_id=int(d["LayerID"]),
             location=LayerLocation(d.get("Location", 0)),
+            shard=str(d.get("Shard", "")),
         )
 
 
@@ -231,25 +242,33 @@ class RetransmitMsg:
     """Leader → owner: forward your copy of a layer to dest
     (message.go:94-118).  ``epoch``: the issuing leader's fencing epoch
     (docs/failover.md); -1 = HA off.  ``job_id``: the admitted job this
-    forward serves (docs/service.md; "" = the base run)."""
+    forward serves (docs/service.md; "" = the base run).  ``shard``
+    (docs/sharding.md): forward only this shard's byte range ("" = the
+    whole layer; omitted on the wire — a legacy owner ships the full
+    layer, which still covers the target)."""
 
     src_id: NodeID
     layer_id: LayerID
     dest_id: NodeID
     epoch: int = -1
     job_id: str = ""
+    shard: str = ""
 
     msg_type = MsgType.RETRANSMIT
 
     def to_payload(self) -> dict:
-        return _job_to_payload(_epoch_to_payload(
+        payload = _job_to_payload(_epoch_to_payload(
             {"SrcID": self.src_id, "LayerID": self.layer_id,
              "DestID": self.dest_id}, self.epoch), self.job_id)
+        if self.shard:
+            payload["Shard"] = str(self.shard)
+        return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "RetransmitMsg":
         return cls(int(d["SrcID"]), int(d["LayerID"]), int(d["DestID"]),
-                   int(d.get("Epoch", -1)), str(d.get("Job", "")))
+                   int(d.get("Epoch", -1)), str(d.get("Job", "")),
+                   str(d.get("Shard", "")))
 
 
 @dataclasses.dataclass
@@ -332,6 +351,11 @@ class LayerMsg:
     # the flight recorder splits link rows per job so overlapping jobs
     # stop sharing one undifferentiated counter pool.
     job_id: str = ""
+    # Advisory shard-target tag (docs/sharding.md): the shard spec this
+    # fragment serves ("" = a full-layer target).  Correctness rides the
+    # byte ranges alone (offset/size are absolute layer coordinates
+    # either way); the tag exists for logs and telemetry.
+    shard: str = ""
 
     msg_type = MsgType.LAYER
 
@@ -376,6 +400,8 @@ class LayerHeader:
     # receiving transport file this frame's bytes on the per-job link
     # row (docs/service.md).  A peer predating the field ignores it.
     job_id: str = ""
+    # Advisory shard-target tag (omitted when ""; docs/sharding.md).
+    shard: str = ""
 
     def to_payload(self) -> dict:
         payload = {
@@ -397,6 +423,8 @@ class LayerHeader:
             payload["Xxh3"] = int(self.xxh3)
         if self.job_id:
             payload["Job"] = str(self.job_id)
+        if self.shard:
+            payload["Shard"] = str(self.shard)
         return payload
 
     @classmethod
@@ -415,6 +443,7 @@ class LayerHeader:
             int(d["Crc"]) if "Crc" in d else None,
             int(d["Xxh3"]) if "Xxh3" in d else None,
             str(d.get("Job", "")),
+            str(d.get("Shard", "")),
         )
 
 
@@ -773,26 +802,54 @@ class LayerDigestsMsg:
     a completed layer against the digest BEFORE acking/staging it, and a
     mismatch re-opens the covered intervals (the layer is re-fetched)
     instead of acking corrupt bytes.  Layers without a digest (unstamped
-    holder, digests disabled) verify by per-fragment CRC alone."""
+    holder, digests disabled) verify by per-fragment CRC alone.
+
+    Sharded targets (docs/sharding.md) ride this stamp too — it is the
+    one leader→dest channel that precedes the bytes:
+
+    - ``shards``: ``{layer_id: shard_spec}`` — the dest's target is
+      THIS byte-range slice; its interval set is complete (and it acks)
+      at shard coverage, not layer coverage.
+    - ``range_digests``: ``{layer_id: hex}`` — the digest of exactly
+      the dest's shard range, so a shard verifies end-to-end WITHOUT
+      holding the full layer.  Stamped only when the leader can read
+      the layer's bytes; absent, the shard verifies by per-fragment
+      CRC alone (honest limit, docs/sharding.md).
+
+    Both omitted-at-default: an unsharded run's stamp is byte-identical
+    to the legacy format."""
 
     src_id: NodeID
     digests: dict  # {layer_id: hex digest}
     epoch: int = -1
+    shards: dict = dataclasses.field(default_factory=dict)
+    range_digests: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.LAYER_DIGESTS
 
     def to_payload(self) -> dict:
-        return _epoch_to_payload(
-            {"SrcID": self.src_id,
-             "Digests": {str(lid): str(h)
-                         for lid, h in self.digests.items()}}, self.epoch)
+        payload = {"SrcID": self.src_id,
+                   "Digests": {str(lid): str(h)
+                               for lid, h in self.digests.items()}}
+        if self.shards:
+            payload["Shards"] = {str(lid): str(s)
+                                 for lid, s in self.shards.items()}
+        if self.range_digests:
+            payload["RangeDigests"] = {
+                str(lid): str(h)
+                for lid, h in self.range_digests.items()}
+        return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
     def from_payload(cls, d: dict) -> "LayerDigestsMsg":
         return cls(int(d["SrcID"]),
                    {int(lid): str(h)
                     for lid, h in (d.get("Digests") or {}).items()},
-                   int(d.get("Epoch", -1)))
+                   int(d.get("Epoch", -1)),
+                   {int(lid): str(s)
+                    for lid, s in (d.get("Shards") or {}).items()},
+                   {int(lid): str(h)
+                    for lid, h in (d.get("RangeDigests") or {}).items()})
 
 
 @dataclasses.dataclass

@@ -421,7 +421,8 @@ class TcpTransport(Transport):
             src.placed_token = token
             self._queue.put(LayerMsg(header.src_id, header.layer_id, src,
                                      header.total_size,
-                                     job_id=header.job_id))
+                                     job_id=header.job_id,
+                                     shard=header.shard))
             return
         buf = alloc_recv_buffer(header.layer_size)
         view = memoryview(buf)
@@ -467,7 +468,8 @@ class TcpTransport(Transport):
         )
         self._queue.put(
             LayerMsg(header.src_id, header.layer_id, layer_src,
-                     header.total_size, job_id=header.job_id)
+                     header.total_size, job_id=header.job_id,
+                     shard=header.shard)
         )
 
     # --------------------------------------------------------- striped rx
@@ -602,7 +604,8 @@ class TcpTransport(Transport):
                 self._queue.put(LayerMsg(
                     header.src_id, header.layer_id, src, header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
-                    stripe_off=header.stripe_off, job_id=header.job_id))
+                    stripe_off=header.stripe_off, job_id=header.job_id,
+                    shard=header.shard))
                 return
             if self.layer_sink is not None:
                 # Sink present but declined (duplicate/overlap/finished):
@@ -623,7 +626,8 @@ class TcpTransport(Transport):
                              meta=LayerMeta(location=LayerLocation.INMEM)),
                     header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
-                    stripe_off=header.stripe_off, job_id=header.job_id))
+                    stripe_off=header.stripe_off, job_id=header.job_id,
+                    shard=header.shard))
                 return
             # No sink: regroup stripes into the original logical payload
             # so un-striped consumers (mode-0/1/2 receivers, raw
@@ -698,7 +702,8 @@ class TcpTransport(Transport):
                              meta=LayerMeta(location=LayerLocation.INMEM)),
                     done["total"],
                     stripe_idx=0, stripe_n=1, stripe_off=0,
-                    job_id=header.job_id))
+                    job_id=header.job_id,
+                    shard=header.shard))
         finally:
             if pipe_sock is not None:
                 pipe_sock.close()
@@ -1001,7 +1006,8 @@ class TcpTransport(Transport):
                 self._send_one_stream(
                     dest,
                     LayerMsg(message.src_id, message.layer_id, sub,
-                             message.total_size, job_id=message.job_id),
+                             message.total_size, job_id=message.job_id,
+                             shard=message.shard),
                     stripe=stripe)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
@@ -1056,6 +1062,7 @@ class TcpTransport(Transport):
             total_size=message.total_size,
             offset=src.offset,
             job_id=message.job_id,
+            shard=message.shard,
         )
         if stripe is not None:
             header.stripe_idx = stripe["idx"]

@@ -313,6 +313,31 @@ def digest_layer_src(src) -> Optional[str]:
     return None
 
 
+def digest_layer_src_range(src, off: int, size: int) -> Optional[str]:
+    """Digest of the byte range ``[off, off+size)`` of a LayerSrc — the
+    per-RANGE digest the sharded-delivery plane stamps so a shard
+    verifies without holding the full layer (docs/sharding.md).  Same
+    readability rules as :func:`digest_layer_src`; None when the bytes
+    aren't locally readable."""
+    from ..core.types import LayerLocation
+
+    loc = src.meta.location
+    if loc == LayerLocation.CLIENT:
+        return None
+    try:
+        if src.inmem_data is not None:
+            base = src.offset + off
+            return layer_digest(memoryview(src.inmem_data)[base:base + size])
+        if loc == LayerLocation.DISK and src.fp:
+            return digest_file_range(src.fp, src.offset + off, size)
+        if src.ensure_host_bytes():
+            base = src.offset + off
+            return layer_digest(memoryview(src.inmem_data)[base:base + size])
+    except (OSError, ValueError):
+        return None
+    return None
+
+
 def hash_bench(nbytes: int = 64 << 20) -> dict:
     """Micro-bench the candidate integrity hashes on THIS host — the
     measured justification for the per-fragment and per-layer algorithm

@@ -178,6 +178,13 @@ class ClaimedCoverage:
     def complete(self, total: int) -> bool:
         return not self._inflight and covered(self._covered) >= total
 
+    def complete_range(self, start: int, end: int) -> bool:
+        """Promotion gate for a SHARDED target (docs/sharding.md): the
+        range ``[start, end)`` is fully covered and nothing is in
+        flight — coverage outside the range is irrelevant."""
+        return not self._inflight and not uncovered(self._covered,
+                                                    start, end)
+
     def committed(self) -> List[Interval]:
         """Covered ranges whose bytes REALLY landed (in-flight claims
         excluded) — what salvage/announce/seed may read."""

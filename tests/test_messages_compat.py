@@ -105,13 +105,14 @@ CASES = {
 # the extension fields layered onto the legacy formats over PRs 2-7.
 OMITTED_AT_DEFAULT = {
     MsgType.ANNOUNCE: {"Partial", "Digests"},
-    MsgType.RETRANSMIT: {"Epoch", "Job"},
+    MsgType.ACK: {"Shard"},
+    MsgType.RETRANSMIT: {"Epoch", "Job", "Shard"},
     MsgType.FLOW_RETRANSMIT: {"Epoch", "Job"},
     MsgType.STARTUP: {"Epoch"},
     MsgType.DEVICE_PLAN: {"Epoch", "BatchID", "BatchN"},
     MsgType.SERVE: {"Epoch"},
     MsgType.BOOT_HINT: {"Epoch"},
-    MsgType.LAYER_DIGESTS: {"Epoch"},
+    MsgType.LAYER_DIGESTS: {"Epoch", "Shards", "RangeDigests"},
     MsgType.SOURCE_DEAD: {"Epoch"},
     MsgType.METRICS_REPORT: {"Epoch", "Counters", "Gauges", "Links",
                              "T", "Proc"},
@@ -167,13 +168,52 @@ def test_layer_header_wire_compat():
     assert set(payload) == {"SrcID", "LayerID", "LayerSize", "TotalSize",
                             "Offset"}
     assert LayerHeader.from_payload(json.loads(json.dumps(payload))) == h
-    # Fully decorated round-trips too (stripes + checksum + job tag).
+    # Fully decorated round-trips too (stripes + checksum + job + shard).
     full = LayerHeader(1, 7, 64, 128, 32, stripe_idx=1, stripe_n=2,
                        stripe_off=16, stripe_span=64, stripe_tid="t1",
-                       crc=99, job_id="v2-push")
+                       crc=99, job_id="v2-push", shard="1/4@2")
     assert LayerHeader.from_payload(
         json.loads(json.dumps(full.to_payload()))) == full
     # Legacy decode: the five-key payload is all an old peer sends.
     legacy = {"SrcID": 1, "LayerID": 7, "LayerSize": 64,
               "TotalSize": 128, "Offset": 0}
     assert LayerHeader.from_payload(legacy) == h
+
+
+def test_shard_fields_interop_with_unsharded_peers():
+    """The sharded-delivery extension (docs/sharding.md) must keep an
+    unsharded cluster interoperable with a sharded leader: every shard
+    field is omitted at default (asserted type-by-type above), the
+    nested LayerMeta codec omits ``Shard`` when empty, and a sharded
+    instance round-trips through real JSON."""
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AckMsg as _Ack,
+        LayerDigestsMsg as _Digests,
+        RetransmitMsg as _Rtx,
+    )
+
+    # LayerMeta: the Assignment/status nested codec.
+    assert "Shard" not in LayerMeta().to_json()
+    m = LayerMeta(data_size=128, shard="1/8@3")
+    back = LayerMeta.from_json(json.loads(json.dumps(m.to_json())))
+    assert back == m
+    # A legacy meta payload (no Shard key) decodes to a full holding.
+    legacy = {k: v for k, v in m.to_json().items() if k != "Shard"}
+    assert LayerMeta.from_json(legacy).shard == ""
+
+    # Shard-carrying instances round-trip via the envelope codec.
+    for msg in (
+        _Ack(1, 7, shard="1/4@1"),
+        _Rtx(1, 7, 2, shard="1/2@0"),
+        _Digests(1, {7: "xxh3:ab"}, shards={7: "1/4@1"},
+                 range_digests={7: "xxh3:cd"}),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        assert decode_msg(msg.msg_type, wire) == msg
+        # An unsharded peer's payload (shard keys stripped) must decode
+        # into the legacy (full-layer) reading, never KeyError.
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("Shard", "Shards", "RangeDigests")}
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "shard", "") == ""
+        assert getattr(old, "shards", {}) in ({}, None) or old.shards == {}

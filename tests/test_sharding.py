@@ -1,0 +1,573 @@
+"""Sharded delivery tests (docs/sharding.md).
+
+The tentpole invariants:
+
+- the shard-spec vocabulary is deterministic and tiles every layer
+  exactly, at every fraction;
+- the flow solver sizes a sharded demand by SHARD bytes (budgets,
+  predictions, and emitted byte ranges all shrink to the fraction) and
+  never plans a shard-holder as a source it can't be;
+- end-to-end: N dests each pull ONLY their shard's bytes (wire bytes
+  per dest ≈ the fraction), verify their RANGE digest, ack
+  shard-qualified, and the telemetry link table reconciles byte-exactly
+  with delivered SHARD bytes — the PR 6 invariant under sub-layer
+  targets (the tier-1 reconciliation guard);
+- the on-mesh gather materializes the full layer from the shards,
+  byte-exact against the stamped full-layer digest, in forward AND
+  reverse completion order through the streaming stager;
+- cross-job dedup: two jobs wanting one (dest, layer/range) pair plan
+  it once (``jobs.deduped_pairs``) and one ack credits both;
+- a shard-holder can never ack (or vouch for) a full-layer pair.
+"""
+
+import queue
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    parse_shard_spec,
+    satisfies,
+    shard_covers,
+    shard_fraction,
+    shard_range,
+    shard_specs_for,
+)
+from distributed_llm_dissemination_tpu.runtime import (
+    ContentIndex,
+    ContentStore,
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    LeaderNode,
+    Node,
+    RetransmitLeaderNode,
+)
+from distributed_llm_dissemination_tpu.runtime.stream_boot import (
+    StreamingBootStager,
+)
+from distributed_llm_dissemination_tpu.sched import Job, JobManager, solve_joint
+from distributed_llm_dissemination_tpu.sched.flow import FlowGraph
+from distributed_llm_dissemination_tpu.transport import reset_registry
+from distributed_llm_dissemination_tpu.utils import integrity, telemetry, trace
+
+from test_node import close_all, layer_bytes, make_transports, mem_layer
+
+TIMEOUT = 20.0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def _wait_for(cond, timeout=TIMEOUT, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------ spec vocabulary
+
+
+def test_shard_spec_vocabulary():
+    assert parse_shard_spec("") is None
+    assert parse_shard_spec("1/8@3") == (8, 3)
+    for bad in ("8@3", "1/8", "2/8@1", "1/8@8", "1/0@0", "1/8@-1", "x"):
+        with pytest.raises(ValueError):
+            parse_shard_spec(bad)
+    # Ranges tile the layer exactly, at every fraction and awkward total.
+    for total in (1, 7, 64, 1000, (1 << 20) + 13):
+        for n in (1, 2, 4, 8):
+            pos = 0
+            for spec in shard_specs_for(n):
+                off, size = shard_range(spec, total)
+                assert off == pos
+                pos = off + size
+            assert pos == total
+    assert shard_fraction("1/4@2") == 0.25 and shard_fraction("") == 1.0
+    # Coverage is rational, total-independent, and asymmetric.
+    assert shard_covers("", "1/8@5") and not shard_covers("1/8@5", "")
+    assert shard_covers("1/4@1", "1/8@2") and shard_covers("1/4@1", "1/8@3")
+    assert not shard_covers("1/4@1", "1/8@4")
+    assert not shard_covers("1/8@2", "1/4@1")
+    # satisfies(): location AND coverage.
+    held = LayerMeta(location=LayerLocation.INMEM, shard="1/4@1")
+    assert satisfies(held, LayerMeta(shard="1/4@1"))
+    assert satisfies(held, LayerMeta(shard="1/8@2"))
+    assert not satisfies(held, LayerMeta())  # shard can't cover full
+    assert not satisfies(LayerMeta(location=LayerLocation.DISK,
+                                   shard="1/4@1"),
+                         LayerMeta(shard="1/4@1"))
+
+
+# ------------------------------------------------------------- planner
+
+
+def _solve(assignment, total=1 << 20, bw=1 << 20):
+    status = {0: {7: LayerMeta(location=LayerLocation.INMEM,
+                               data_size=total)}}
+    nodes = {0} | set(assignment)
+    graph = FlowGraph(assignment, status, {7: total},
+                      {n: bw for n in nodes})
+    return graph.get_job_assignment()
+
+
+def test_flow_solver_sizes_demands_by_shard_bytes():
+    total = 1 << 20
+    t_full, jobs_full = _solve({1: {7: LayerMeta()}}, total)
+    t_shard, jobs_shard = _solve({1: {7: LayerMeta(shard="1/4@2")}}, total)
+    # The demand (and therefore the predicted min time) shrinks to the
+    # shard fraction — the mode-3 budget/prediction lever.
+    assert sum(j.data_size for jl in jobs_shard.values() for j in jl) \
+        == total // 4
+    assert t_shard <= t_full // 2  # 1/4 of the bytes, same link
+    # The emitted ranges are EXACTLY the shard's absolute byte range.
+    (job,) = [j for jl in jobs_shard.values() for j in jl]
+    off, size = shard_range("1/4@2", total)
+    assert (job.offset, job.data_size) == (off, size)
+    assert job.dest_id == 1 and job.layer_id == 7
+
+
+def test_flow_solver_multi_dest_shards_partition_the_layer():
+    total = 1 << 20
+    assignment = {d + 1: {7: LayerMeta(shard=f"1/4@{d}")}
+                  for d in range(4)}
+    _, jobs = _solve(assignment, total)
+    ranges = sorted((j.offset, j.offset + j.data_size, j.dest_id)
+                    for jl in jobs.values() for j in jl)
+    # Four dests, four disjoint ranges, tiling [0, total) exactly.
+    pos = 0
+    for s, e, dest in ranges:
+        assert s == pos
+        pos = e
+    assert pos == total
+    assert len({dest for _, _, dest in ranges}) == 4
+
+
+def test_flow_solver_never_plans_a_shard_holder_as_full_source():
+    total = 1 << 16
+    # Node 2 holds only shard 1/4@0 of layer 7; node 0 holds it whole.
+    status = {
+        0: {7: LayerMeta(location=LayerLocation.INMEM, data_size=total)},
+        2: {7: LayerMeta(location=LayerLocation.INMEM, data_size=total,
+                         shard="1/4@0")},
+    }
+    graph = FlowGraph({1: {7: LayerMeta()}}, status, {7: total},
+                      {0: 1 << 20, 1: 1 << 20, 2: 1 << 30})
+    _, jobs = graph.get_job_assignment()
+    senders = {j.sender_id for jl in jobs.values() for j in jl}
+    assert senders == {0}  # the shard holder never serves the full pair
+    # But it MAY serve a target its shard covers.
+    graph2 = FlowGraph({1: {7: LayerMeta(shard="1/8@1")}}, status,
+                       {7: total}, {0: 1 << 20, 1: 1 << 20, 2: 1 << 30})
+    _, jobs2 = graph2.get_job_assignment()
+    assert sum(j.data_size for jl in jobs2.values() for j in jl) \
+        == shard_range("1/8@1", total)[1]
+
+
+def test_solve_joint_cross_tier_dedup_counts_and_plans_once():
+    telemetry.reset_run()
+    total = 1 << 16
+    status = {0: {7: LayerMeta(location=LayerLocation.INMEM,
+                               data_size=total)}}
+    bw = {0: 1 << 20, 1: 1 << 20}
+    demands = [
+        (2, "hi", {1: {7: LayerMeta()}}),
+        (1, "lo", {1: {7: LayerMeta()}}),
+    ]
+    before = trace.counter_totals().get("jobs.deduped_pairs", 0)
+    _, jobs = solve_joint(demands, status, {7: total}, bw)
+    planned = [(j.layer_id, j.dest_id)
+               for jl in jobs.values() for j in jl]
+    assert planned.count((7, 1)) == 1  # planned once, not per tier
+    assert sum(j.data_size for jl in jobs.values() for j in jl) == total
+    assert trace.counter_totals().get("jobs.deduped_pairs", 0) \
+        == before + 1
+    # ...and one shard-qualified ack credits every job wanting the pair.
+    mgr = JobManager()
+    mgr.admit(Job("hi", {1: {7: LayerMeta()}}, priority=2), {})
+    mgr.admit(Job("lo", {1: {7: LayerMeta()}}, priority=1), {})
+    assert sorted(mgr.on_ack(1, 7, shard="")) == ["hi", "lo"]
+
+
+def test_job_manager_shard_ack_never_credits_full_demand():
+    mgr = JobManager()
+    mgr.admit(Job("full", {1: {7: LayerMeta()}}), {})
+    mgr.admit(Job("slice", {1: {7: LayerMeta(shard="1/4@1")}}), {})
+    # A shard ack credits only the covered target.
+    assert mgr.on_ack(1, 7, shard="1/4@1") == ["slice"]
+    assert mgr.get("full").remaining == {(1, 7)}
+    # The full ack then credits the full job.
+    assert mgr.on_ack(1, 7) == ["full"]
+
+
+# ------------------------------------------------------- content store
+
+
+def test_content_store_keys_by_digest_and_range():
+    store = ContentStore()
+    store.index(3, "xxh3:aa")             # full holding
+    store.index(9, "xxh3:bb", shard="1/4@1")  # shard holding
+    assert store.lookup("xxh3:aa") == 3
+    assert store.lookup("xxh3:bb") is None          # full query, range key
+    assert store.lookup("xxh3:bb", shard="1/4@1") == 9
+    assert store.shard_of(9) == "1/4@1"
+    idx = ContentIndex()
+    idx.add(2, 9, "xxh3:bb", shard="1/4@1")
+    assert not idx.node_has(2, "xxh3:bb")           # never aliases full
+    assert idx.node_has(2, "xxh3:bb", shard="1/4@1")
+    assert idx.holders("xxh3:bb", shard="1/4@1") == [(2, 9)]
+
+
+# --------------------------------------------------------- end to end
+
+
+FRACTIONS = [1, 2, 4, 8]
+
+
+def _run_sharded(kind, n_shards, layer_size=1 << 18, n_layers=2,
+                 mode3=True):
+    """Mode-3 leader 0 holding ``n_layers`` layers; ``n_shards`` dests
+    each assigned every layer at shard ``1/n@k``.  Returns
+    (leader, receivers, transports, assignment)."""
+    ids = list(range(n_shards + 1))
+    ts, _ = make_transports(kind, ids)
+    specs = shard_specs_for(n_shards)
+    assignment = {
+        k + 1: {lid: LayerMeta(shard=specs[k]) for lid in range(n_layers)}
+        for k in range(n_shards)
+    }
+    layers = {lid: mem_layer(lid, layer_size) for lid in range(n_layers)}
+    if mode3:
+        leader = FlowRetransmitLeaderNode(
+            Node(0, 0, ts[0]), layers, assignment,
+            {i: 1 << 30 for i in ids})
+    else:
+        leader = LeaderNode(Node(0, 0, ts[0]), layers, assignment)
+    receivers = [FlowRetransmitReceiverNode(Node(i, 0, ts[i]), {})
+                 for i in ids[1:]]
+    return leader, receivers, ts, assignment
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+@pytest.mark.parametrize("n_shards", FRACTIONS)
+def test_sharded_delivery_end_to_end(kind, n_shards):
+    telemetry.reset_run()
+    layer_size, n_layers = 1 << 18, 2
+    leader, receivers, ts, assignment = _run_sharded(
+        kind, n_shards, layer_size, n_layers)
+    try:
+        for r in receivers:
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        specs = shard_specs_for(n_shards)
+        for k, r in enumerate(receivers):
+            spec = specs[k]
+            off, size = shard_range(spec, layer_size)
+            for lid in range(n_layers):
+                src = r.layers[lid]
+                # Byte-exact over EXACTLY the shard's range.
+                want = layer_bytes(lid, layer_size)[off:off + size]
+                assert bytes(memoryview(src.inmem_data)[off:off + size]) \
+                    == want, f"shard {spec} of layer {lid} corrupt"
+                assert src.meta.shard == spec
+                # Range digest verified before the ack (integrity gate).
+                if integrity.digests_enabled() and spec:
+                    assert lid in r._digest_ok
+                # The leader recorded the holding shard-qualified.
+                held = leader.status[r.node.my_id][lid]
+                assert held.shard == spec
+        # Wire accounting: each dest received ≈ its shard's bytes, and
+        # the folded link table reconciles BYTE-EXACTLY with delivered
+        # shard bytes (the PR 6 invariant under sub-layer targets).
+        links = telemetry.snapshot()["links"]
+        for k, r in enumerate(receivers):
+            me = r.node.my_id
+            expect = sum(shard_range(specs[k], layer_size)[1]
+                         for _ in range(n_layers))
+            delivered = sum(row.get("delivered_bytes", 0)
+                            for key, row in links.items()
+                            if "#" not in key
+                            and key.endswith(f"->{me}"))
+            assert delivered == expect, (
+                f"dest {me}: delivered {delivered} != shard bytes "
+                f"{expect}")
+            rx = sum(row.get("rx_bytes", 0)
+                     for key, row in links.items()
+                     if "#" not in key and key.endswith(f"->{me}"))
+            # Wire bytes per dest ≈ the shard fraction (±10%: framing
+            # granularity, never re-sends at this size).
+            assert expect <= rx <= expect * 1.1, (
+                f"dest {me}: rx {rx} vs shard bytes {expect}")
+    finally:
+        close_all(leader, receivers, ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_sharded_delivery_mode0_and_mode1(kind):
+    """Modes 0/1 honor shard targets too: the leader (or the picked
+    owner) ships only the shard's byte range as a fragment; flow-capable
+    receivers complete at shard coverage."""
+    layer_size = 1 << 16
+    for leader_cls in (LeaderNode, RetransmitLeaderNode):
+        reset_registry()
+        telemetry.reset_run()
+        ids = [0, 1, 2]
+        ts, _ = make_transports(kind, ids)
+        assignment = {1: {0: LayerMeta(shard="1/2@0")},
+                      2: {0: LayerMeta(shard="1/2@1")}}
+        leader = leader_cls(Node(0, 0, ts[0]),
+                            {0: mem_layer(0, layer_size)}, assignment)
+        receivers = [FlowRetransmitReceiverNode(Node(i, 0, ts[i]), {})
+                     for i in (1, 2)]
+        try:
+            for r in receivers:
+                r.announce()
+            leader.start_distribution().get(timeout=TIMEOUT)
+            leader.ready().get(timeout=TIMEOUT)
+            for k, r in enumerate(receivers):
+                off, size = shard_range(f"1/2@{k}", layer_size)
+                got = bytes(memoryview(r.layers[0].inmem_data)
+                            [off:off + size])
+                assert got == layer_bytes(0, layer_size)[off:off + size]
+                assert r.layers[0].meta.shard == f"1/2@{k}"
+            links = telemetry.snapshot()["links"]
+            for r in receivers:
+                rx = sum(row.get("rx_bytes", 0)
+                         for key, row in links.items()
+                         if "#" not in key
+                         and key.endswith(f"->{r.node.my_id}"))
+                assert rx <= layer_size // 2 * 1.1
+        finally:
+            close_all(leader, receivers, ts)
+
+
+def test_fragments_before_shard_stamp_promote_on_stamp():
+    """Stamp race: a shard's fragments can all land BEFORE the dest
+    learns its target is a shard — the stamp must then promote the
+    already-complete coverage (no later fragment re-runs the check)."""
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        LayerDigestsMsg,
+    )
+
+    telemetry.reset_run()
+    ids = [0, 1]
+    ts, _ = make_transports("inmem", ids)
+    layer_size = 1 << 16
+    r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        from distributed_llm_dissemination_tpu.core.types import LayerSrc
+        from distributed_llm_dissemination_tpu.transport.messages import (
+            LayerMsg,
+        )
+
+        data = layer_bytes(0, layer_size)
+        off, size = shard_range("1/4@1", layer_size)
+        # LayerSrc fragment convention (_sub_layer_src): the backing
+        # buffer is the FULL layer; offset is both read position and
+        # wire offset.
+        frag = LayerSrc(inmem_data=bytearray(data),
+                        data_size=size, offset=off,
+                        meta=LayerMeta(location=LayerLocation.INMEM))
+        ts[0].send(1, LayerMsg(0, 0, frag, layer_size, shard="1/4@1"))
+        _wait_for(lambda: r._partial.get(0) is not None
+                  and r._partial[0][1].covered_bytes() == size,
+                  what="fragment landed")
+        assert 0 not in r.layers  # no spec yet: full coverage expected
+        rd = integrity.layer_digest(data[off:off + size])
+        ts[0].send(1, LayerDigestsMsg(0, {}, shards={0: "1/4@1"},
+                                      range_digests={0: rd}))
+        _wait_for(lambda: 0 in r.layers, what="stamp-triggered promotion")
+        assert r.layers[0].meta.shard == "1/4@1"
+        assert bytes(memoryview(r.layers[0].inmem_data)[off:off + size]) \
+            == data[off:off + size]
+    finally:
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_widened_target_completes_full_layer():
+    """A delivered SHARD holding whose target widens to the full layer
+    (an update(), or a second job wanting a disjoint shard) must reopen
+    and complete the WHOLE layer — the stale spec must not keep acking
+    at shard coverage."""
+    telemetry.reset_run()
+    layer_size = 1 << 16
+    ids = [0, 1]
+    ts, _ = make_transports("inmem", ids)
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, layer_size)},
+        {1: {0: LayerMeta(shard="1/2@0")}}, {0: 1 << 30, 1: 1 << 30})
+    r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        assert r.layers[0].meta.shard == "1/2@0"
+        assert leader.status[1][0].shard == "1/2@0"
+        leader.update({1: {0: LayerMeta()}})  # widen to the full layer
+        leader.ready().get(timeout=TIMEOUT)
+        _wait_for(lambda: r.layers.get(0) is not None
+                  and not r.layers[0].meta.shard,
+                  what="full-layer completion after widening")
+        assert bytes(r.layers[0].inmem_data) == layer_bytes(0, layer_size)
+        assert leader.status[1][0].shard == ""
+    finally:
+        leader.close()
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_retargeted_shard_completes_new_shard():
+    """A delivered shard holding RE-TARGETED to a different shard the
+    held one doesn't cover must reopen and complete the new target —
+    not livelock on dup-done re-acks of the old shard (review
+    finding)."""
+    telemetry.reset_run()
+    layer_size = 1 << 16
+    ids = [0, 1]
+    ts, _ = make_transports("inmem", ids)
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, layer_size)},
+        {1: {0: LayerMeta(shard="1/2@0")}}, {0: 1 << 30, 1: 1 << 30})
+    r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        assert r.layers[0].meta.shard == "1/2@0"
+        leader.update({1: {0: LayerMeta(shard="1/2@1")}})
+        leader.ready().get(timeout=TIMEOUT)
+        _wait_for(lambda: (r.layers.get(0) is not None
+                           and shard_covers(r.layers[0].meta.shard,
+                                            "1/2@1")),
+                  what="re-targeted shard completion")
+        off, size = shard_range("1/2@1", layer_size)
+        assert bytes(memoryview(r.layers[0].inmem_data)[off:off + size]) \
+            == layer_bytes(0, layer_size)[off:off + size]
+        assert shard_covers(leader.status[1][0].shard, "1/2@1")
+    finally:
+        leader.close()
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_widening_reconciles_with_digests_disabled(monkeypatch):
+    """With DLD_LAYER_DIGESTS=0 the digest map is empty, so widening
+    must reconcile through explicit \"\"-spec entries in the shards map
+    (review finding) — the stamp is the ONLY pre-byte leader→dest
+    channel either way."""
+    monkeypatch.setenv("DLD_LAYER_DIGESTS", "0")
+    telemetry.reset_run()
+    layer_size = 1 << 16
+    ids = [0, 1]
+    ts, _ = make_transports("inmem", ids)
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, layer_size)},
+        {1: {0: LayerMeta(shard="1/2@0")}}, {0: 1 << 30, 1: 1 << 30})
+    r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        assert r.layers[0].meta.shard == "1/2@0"
+        leader.update({1: {0: LayerMeta()}})  # widen, digests OFF
+        leader.ready().get(timeout=TIMEOUT)
+        _wait_for(lambda: (r.layers.get(0) is not None
+                           and not r.layers[0].meta.shard),
+                  what="digests-off widening completion")
+        assert bytes(r.layers[0].inmem_data) == layer_bytes(0, layer_size)
+    finally:
+        leader.close()
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+# ------------------------------------------------------ on-mesh gather
+
+
+@pytest.mark.parametrize("n_shards", FRACTIONS)
+@pytest.mark.parametrize("order", ["fwd", "rev"])
+def test_shard_gather_materializes_full_layer(n_shards, order):
+    """Every fraction, both completion orders, through the streaming
+    stager: the on-mesh all-gather materializes the full layer
+    byte-exact against the stamped FULL-layer digest."""
+    total = (1 << 18) + 7  # awkward total: unequal floor-split tiles
+    data = layer_bytes(3, total)
+    digest = integrity.layer_digest(data)
+    stager = StreamingBootStager(None)
+    try:
+        specs = shard_specs_for(n_shards)
+        parts = list(enumerate(specs))
+        if order == "rev":
+            parts = parts[::-1]
+        for k, spec in parts:
+            off, size = shard_range(spec, total)
+            ok = stager.submit_shard(3, spec, data[off:off + size], total,
+                                     expected_digest=digest)
+            assert ok
+        out = stager.collect_gathered([3])
+        assert 3 in out, "gather did not materialize"
+        assert out[3] == data
+        # Duplicate shard submissions are no-ops.
+        assert not stager.submit_shard(3, specs[0], b"", total)
+    finally:
+        stager.close()
+
+
+def test_shard_gather_rejects_corrupt_layer():
+    total = 1 << 12
+    data = layer_bytes(5, total)
+    digest = integrity.layer_digest(data)
+    from distributed_llm_dissemination_tpu.parallel.collectives import (
+        gather_byte_shards,
+    )
+
+    half = shard_range("1/2@0", total)[1]
+    good = [(0, data[:half]), (1, data[half:])]
+    assert gather_byte_shards(good, total, verify_digest=digest) == data
+    bad0 = bytearray(data[:half])
+    bad0[0] ^= 0xFF
+    with pytest.raises(ValueError):
+        gather_byte_shards([(0, bytes(bad0)), (1, data[half:])], total,
+                           verify_digest=digest)
+    with pytest.raises(ValueError):
+        gather_byte_shards([(0, data[:half])], total)  # incomplete set
+
+
+def test_gathered_layer_matches_delivered_shards_end_to_end():
+    """The acceptance gate end to end: after a sharded mode-3 delivery,
+    the dests' shards gather on-mesh into a layer byte-exact against
+    the full-layer digest the leader stamped."""
+    telemetry.reset_run()
+    layer_size, n = 1 << 18, 4
+    leader, receivers, ts, _ = _run_sharded("inmem", n, layer_size, 1)
+    try:
+        for r in receivers:
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        specs = shard_specs_for(n)
+        stamped = leader.layer_digests.get(0)
+        parts = []
+        for k, r in enumerate(receivers):
+            off, size = shard_range(specs[k], layer_size)
+            parts.append(
+                (k, bytes(memoryview(r.layers[0].inmem_data)
+                          [off:off + size])))
+        from distributed_llm_dissemination_tpu.parallel.collectives import (
+            gather_byte_shards,
+        )
+
+        out = gather_byte_shards(parts, layer_size, verify_digest=stamped)
+        assert out == layer_bytes(0, layer_size)
+    finally:
+        close_all(leader, receivers, ts)
