@@ -268,7 +268,13 @@ def run_jobtool(args, conf: cfg.Config) -> int:
                 args.id, str(spec["JobID"]), spec["Assignment"],
                 priority=int(spec.get("Priority", 0)),
                 kind=str(spec.get("Kind", "push")),
-                digests=spec["Digests"], avoid=spec["Avoid"]))
+                digests=spec["Digests"], avoid=spec["Avoid"],
+                version=str(spec.get("Version", "")),
+                swap_base=int(spec.get("SwapBase", -1)),
+                # Admission control (docs/service.md): a token-armed
+                # leader daemon rejects unauthenticated submits; the
+                # operator exports the same secret on both sides.
+                auth=os.environ.get("DLD_JOB_TOKEN", "")))
         else:
             transport.send(leader_id, JobStatusMsg(args.id, query=True))
         try:

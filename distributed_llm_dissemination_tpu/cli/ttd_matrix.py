@@ -1578,6 +1578,188 @@ def run_sharded_delivery(layer_bytes: int = 64 << 20, n_layers: int = 2,
     }
 
 
+def run_live_swap(warm_s: float = 1.5, after_s: float = 1.5,
+                  timeout: float = 300.0) -> dict:
+    """Zero-downtime weight swap under live traffic (docs/swap.md, the
+    ROADMAP item-4 acceptance row): a tiny-model replica serves
+    generation requests continuously while a ``kind="swap"`` job
+    disseminates v2 under version-tagged ids; the epoch-fenced commit
+    flips the serving params atomically.  Records tokens/s and p99
+    request latency BEFORE / DURING / AFTER the swap, the request
+    failure count (the bar: zero), per-blob v2 digest verification,
+    and RUN_REPORT provenance.  Runs in-process over the inmem
+    backend: the row measures the SERVING dip attributable to the
+    swap machinery, not loopback-TCP scheduling noise (the dual-
+    backend wire path is tier-1-tested in tests/test_swap.py)."""
+    import threading
+
+    import jax
+
+    from ..core.types import (
+        LayerLocation,
+        LayerMeta,
+        LayerSrc,
+        SourceType,
+    )
+    from ..models import serde
+    from ..models.llama import CONFIGS, init_params
+    from ..runtime import (
+        FlowRetransmitLeaderNode,
+        FlowRetransmitReceiverNode,
+        Node,
+    )
+    from ..runtime.client import GenRequester
+    from ..transport import InmemTransport
+    from ..utils import integrity, telemetry, trace
+    from ..utils.provenance import harness_hash
+    from . import report as report_mod
+
+    telemetry.reset_run()
+    cfg = CONFIGS["tiny"]
+    swap_base = 1000
+    v1 = serde.blobs_from_params(cfg, init_params(cfg, jax.random.key(0)))
+    v2 = serde.blobs_from_params(cfg, init_params(cfg, jax.random.key(1)))
+
+    def blob_layer(data: bytes) -> LayerSrc:
+        return LayerSrc(inmem_data=bytearray(data), data_size=len(data),
+                        meta=LayerMeta(location=LayerLocation.INMEM,
+                                       source_type=SourceType.MEM))
+
+    ids = [0, 1, 9]
+    ts = {i: InmemTransport(str(i)) for i in ids}
+    seed = {b: blob_layer(v1[b]) for b in v1}
+    seed.update({swap_base + b: blob_layer(v2[b]) for b in v2})
+    base = {1: {b: LayerMeta() for b in v1}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), seed, base, {i: 10 ** 9 for i in ids},
+        expected_nodes={1})
+    dest = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {}, boot_cfg=cfg)
+    requester = GenRequester(ts[9], my_id=9)
+    prompt, max_new = [3, 5, 7], 8
+    lat: dict = {"before": [], "during": [], "after": []}
+    failures: list = []
+    phase = ["before"]
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                requester.request(1, prompt, max_new, timeout=timeout)
+                lat[phase[0]].append(time.monotonic() - t0)
+            except Exception as e:  # noqa: BLE001 — any failure counts
+                failures.append(repr(e))
+            time.sleep(0.01)
+
+    def stats(xs):
+        if not xs:
+            return {"requests": 0}
+        xs = sorted(xs)
+        p99 = xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+        return {"requests": len(xs),
+                "tokens_per_s": round(max_new * len(xs) / sum(xs), 2),
+                "p50_ms": round(xs[len(xs) // 2] * 1000, 1),
+                "p99_ms": round(p99 * 1000, 1)}
+
+    try:
+        dest.announce()
+        leader.ready().get(timeout=timeout)
+        leader.boot_ready().get(timeout=timeout)
+        requester.request(1, prompt, max_new, timeout=timeout)  # warm jit
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        time.sleep(warm_s)
+        phase[0] = "during"
+        t_swap = time.monotonic()
+        leader.submit_job(
+            "swap-v2",
+            {1: {swap_base + b: LayerMeta() for b in v2}},
+            priority=2, kind="swap", version="v2", swap_base=swap_base)
+        deadline = time.monotonic() + timeout
+        while dest.serving_version != "v2":
+            if time.monotonic() > deadline:
+                raise TimeoutError("swap never flipped")
+            time.sleep(0.02)
+        swap_s = time.monotonic() - t_swap
+        phase[0] = "after"
+        time.sleep(after_s)
+        stop.set()
+        t.join(timeout=timeout)
+        table = leader.swap_table()["v2"]
+        digests_ok = (all(swap_base + b in dest._digest_ok for b in v2)
+                      if integrity.digests_enabled() else None)
+        counters = trace.counter_totals()
+        rep = report_mod.build_from_leader(leader)
+        before, during, after = (stats(lat[k])
+                                 for k in ("before", "during", "after"))
+        dip = None
+        if before.get("tokens_per_s") and during.get("tokens_per_s"):
+            dip = round(1 - during["tokens_per_s"]
+                        / before["tokens_per_s"], 4)
+        return {
+            "harness_hash": harness_hash(),
+            "backend": "inmem",
+            "mode": 3,
+            "model": "tiny",
+            "v2_model_bytes": sum(len(b) for b in v2.values()),
+            "swap_wall_s": round(swap_s, 4),
+            "request_failures": len(failures),
+            "zero_failures": not failures,
+            "before": before,
+            "during": during,
+            "after": after,
+            "tokens_per_s_dip_frac": dip,
+            "v2_digests_verified": digests_ok,
+            "flips": counters.get("swap.flips", 0),
+            "served_version_after": dest.serving_version,
+            "swap_table": table,
+            "run_report": rep.get("provenance"),
+        }
+    finally:
+        stop.set()
+        requester.close()
+        _service_teardown(leader, [dest], ts)
+
+
+def _swap_md(lines, results) -> None:
+    sw = results.get("live_swap")
+    if not sw:
+        return
+    lines += [
+        "## Zero-downtime weight swap (docs/swap.md)",
+        "",
+        f"A tiny-model replica serves generation traffic continuously "
+        f"({sw['backend']} backend, mode {sw['mode']}) while a "
+        "`kind=\"swap\"` job disseminates v2 under version-tagged ids "
+        "and the epoch-fenced `SwapCommitMsg` flips the serving params "
+        "atomically between requests — "
+        f"**{sw['request_failures']} failed requests** "
+        f"(bar: zero → {'MET' if sw['zero_failures'] else 'NOT MET'}), "
+        f"v2 digests verified: {sw['v2_digests_verified']}, swap wall "
+        f"{sw['swap_wall_s']}s:",
+        "",
+        "| phase | requests | tokens/s | p50 | p99 |",
+        "|---|---|---|---|---|",
+    ]
+    for k in ("before", "during", "after"):
+        ph = sw[k]
+        if not ph.get("requests"):
+            lines.append(f"| {k} | 0 | — | — | — |")
+            continue
+        lines.append(
+            f"| {k} | {ph['requests']} | {ph['tokens_per_s']} | "
+            f"{ph['p50_ms']}ms | {ph['p99_ms']}ms |")
+    dip = sw.get("tokens_per_s_dip_frac")
+    lines += [
+        "",
+        (f"tokens/s dip during the swap: {dip:+.1%} vs before "
+         if dip is not None else "tokens/s dip: n/a ")
+        + f"(served version after: `{sw['served_version_after']}`; "
+        f"run report `{sw.get('run_report')}`).",
+        "",
+    ]
+
+
 def run_telemetry_overhead(scale: int = 64 << 20, trials: int = 3,
                            scenario: str = "bench_8node_llama8b.json",
                            mode: int = 0,
@@ -2355,6 +2537,7 @@ def to_markdown(results: dict) -> str:
     _failover_md(lines, results)
     _service_md(lines, results)
     _sharded_md(lines, results)
+    _swap_md(lines, results)
     return "\n".join(lines)
 
 
@@ -2391,6 +2574,10 @@ def main(argv=None) -> int:
                         "the per-link priority split, and a v2 delta "
                         "rollout's shipped bytes vs changed-fraction × "
                         "model bytes against the content store")
+    p.add_argument("-swap", action="store_true",
+                   help="also measure the zero-downtime weight swap "
+                        "row (tokens/s + p99 before/during/after a "
+                        "mid-serve v1→v2 swap; docs/swap.md)")
     p.add_argument("-sharded", action="store_true",
                    help="also measure sharded delivery "
                         "(docs/sharding.md): the multi-dest 64 MiB "
@@ -2533,6 +2720,10 @@ def main(argv=None) -> int:
         results["sharded_delivery"] = run_sharded_delivery()
     elif prior_doc and prior_doc.get("sharded_delivery"):
         results["sharded_delivery"] = prior_doc["sharded_delivery"]
+    if args.swap:
+        results["live_swap"] = run_live_swap()
+    elif prior_doc and prior_doc.get("live_swap"):
+        results["live_swap"] = prior_doc["live_swap"]
     # Regenerate the cache-reuse evidence from THIS run's records;
     # fall back to the prior document's (e.g. hand-recorded SPMD rows)
     # when the run produced none.

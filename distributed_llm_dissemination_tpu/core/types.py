@@ -145,13 +145,22 @@ class LayerMeta:
     byte range; in a *status/announce* row, the holding — the node holds
     ONLY that range (``data_size`` stays the FULL layer size; the spec
     qualifies which bytes of it are real).  ``""`` = the whole layer.
-    Omitted-at-default on the wire (legacy peers never see the key)."""
+    Omitted-at-default on the wire (legacy peers never see the key).
+
+    ``version`` (docs/swap.md): the model-rollout version this entry
+    belongs to.  In an *assignment*, the target version — only a
+    holding tagged with the SAME version satisfies it (a stale
+    unversioned copy of a reused layer id can never complete a v2
+    rollout pair); in a *status/announce* row, the version the holder
+    verified the bytes under.  ``""`` = the pre-swap vocabulary (every
+    legacy peer); omitted-at-default on the wire."""
 
     location: LayerLocation = LayerLocation.INMEM
     limit_rate: int = 0  # bytes/sec; 0 = unlimited
     source_type: SourceType = SourceType.MEM
     data_size: int = 0  # bytes; 0 = unknown
     shard: ShardSpec = ""  # "" = full layer
+    version: str = ""  # "" = unversioned (pre-swap)
 
     def to_json(self) -> dict:
         out = {
@@ -162,6 +171,8 @@ class LayerMeta:
         }
         if self.shard:
             out["Shard"] = str(self.shard)
+        if self.version:
+            out["Version"] = str(self.version)
         return out
 
     @classmethod
@@ -172,6 +183,7 @@ class LayerMeta:
             source_type=SourceType(d.get("SourceType", 0)),
             data_size=int(d.get("DataSize", 0)),
             shard=str(d.get("Shard", "")),
+            version=str(d.get("Version", "")),
         )
 
 
@@ -335,6 +347,14 @@ def satisfies(held: Optional[LayerMeta], want: LayerMeta) -> bool:
     """Whether a status entry ``held`` satisfies the assignment target
     ``want``: delivered-grade location AND the held shard covers the
     wanted one (a shard-holder never satisfies a full-layer target;
-    docs/sharding.md)."""
+    docs/sharding.md) AND the version matches (docs/swap.md).
+
+    Version semantics mirror shard coverage: a VERSIONED target is met
+    only by a holding verified under exactly that version, while an
+    UNVERSIONED target ("" — every pre-swap job) accepts any verified
+    holding of the id, versioned or not — a later push/repair job over
+    already-swapped layer ids must not wedge on the tag (the digest
+    plane, not the tag, governs content)."""
     return (held is not None and delivered(held)
-            and shard_covers(held.shard, want.shard))
+            and shard_covers(held.shard, want.shard)
+            and (not want.version or held.version == want.version))

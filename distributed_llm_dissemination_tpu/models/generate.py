@@ -43,6 +43,33 @@ from .llama import (
 KVCache = Dict[str, jax.Array]  # {"k","v"}: [n_layers, b, max_len, kvh, hd]
 
 
+class MixedVersionError(ValueError):
+    """A serving tree was about to assemble from blobs of more than one
+    rollout version — a forward across mixed layer versions would emit
+    garbage that LOOKS like a healthy decode (docs/swap.md)."""
+
+
+def ensure_uniform_version(versions: Dict[int, str],
+                           expected: str = "") -> str:
+    """The live-swap version guard: every blob entering a serving
+    params tree must carry the SAME rollout version tag (and, when
+    ``expected`` is non-empty, exactly that one).  Raises
+    :class:`MixedVersionError` otherwise; returns the uniform version.
+    Runs where params are ASSEMBLED — the one chokepoint every flip
+    goes through — so no decode step can ever span two versions."""
+    tags = set(versions.values())
+    if len(tags) > 1:
+        raise MixedVersionError(
+            f"refusing to assemble serving params across mixed layer "
+            f"versions {sorted(tags)!r}: {dict(sorted(versions.items()))}")
+    got = next(iter(tags)) if tags else ""
+    if expected and got != expected:
+        raise MixedVersionError(
+            f"serving params version {got!r} does not match the "
+            f"committed version {expected!r}")
+    return got
+
+
 def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}

@@ -468,3 +468,28 @@ def finalize_many(ingests: Sequence["ShardedLayerIngest"],
         mesh, "ingest", sizes, tuple(range(n)), k, pad=first.gpad
     )(v)
     return [out[i] for i in range(k)]
+
+
+def hbm_headroom_bytes(device=None):
+    """Free HBM on ``device`` (default: the first local device), or
+    ``None`` when the platform doesn't report memory stats (CPU
+    backend, some plugins).  The zero-downtime swap's staging policy
+    reads this per layer (docs/swap.md): a v2 blob decodes straight
+    into HBM only when the headroom comfortably covers it, and falls
+    back to host-RAM staging when tight — ``None`` means "unknown",
+    which callers treat per their own risk posture (the swap treats it
+    as roomy: on the CPU backend device memory IS host memory)."""
+    try:
+        import jax
+
+        d = device if device is not None else jax.devices()[0]
+        stats = d.memory_stats()
+        if not stats:
+            return None
+        limit = stats.get("bytes_limit")
+        used = stats.get("bytes_in_use")
+        if limit is None or used is None:
+            return None
+        return max(0, int(limit) - int(used))
+    except Exception:  # noqa: BLE001 — a probe must never break staging
+        return None

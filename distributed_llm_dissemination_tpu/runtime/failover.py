@@ -163,6 +163,10 @@ class ShadowLeaderState:
         # effective goal) — a promoted standby resumes every job.
         self.jobs: dict = {}
         self.base_assignment: Optional[dict] = None
+        # Live-swap driver records (docs/swap.md): version -> record,
+        # so a promoted standby resumes (or re-fences) a half-finished
+        # weight swap instead of stranding the fleet mid-rollout.
+        self.swaps: dict = {}
         self.have_snapshot = False
         self.deltas_applied = 0
 
@@ -190,6 +194,8 @@ class ShadowLeaderState:
                                 (d.get("Metrics") or {}).items()}
                 self.jobs = {str(j): dict(rec) for j, rec in
                              (d.get("Jobs") or {}).items()}
+                self.swaps = {str(v): dict(rec) for v, rec in
+                              (d.get("Swaps") or {}).items()}
                 if d.get("BaseAssignment") is not None:
                     self.base_assignment = _nested_layer_map_from_json(
                         d.get("BaseAssignment"))
@@ -202,7 +208,8 @@ class ShadowLeaderState:
                 row[int(d["Layer"])] = LayerMeta(
                     location=LayerLocation(int(d.get("Location", 0))),
                     data_size=int(d.get("Size", 0)),
-                    shard=str(d.get("Shard", "")))
+                    shard=str(d.get("Shard", "")),
+                    version=str(d.get("Version", "") or ""))
             elif k == "partial":
                 node = int(d["Node"])
                 per = d.get("Partial")
@@ -239,6 +246,8 @@ class ShadowLeaderState:
                     d.get("Assignment"))
             elif k == "job":
                 self.jobs[str(d["JobID"])] = dict(d)
+            elif k == "swap":
+                self.swaps[str(d["Version"])] = dict(d)
             elif k == "job_done":
                 rec = self.jobs.get(str(d.get("JobID", "")))
                 if rec is not None:
@@ -273,6 +282,7 @@ class ShadowLeaderState:
                 "boot_enabled": self.boot_enabled,
                 "metrics": {n: dict(s) for n, s in self.metrics.items()},
                 "jobs": {j: dict(rec) for j, rec in self.jobs.items()},
+                "swaps": {v: dict(rec) for v, rec in self.swaps.items()},
                 "base_assignment": (
                     {n: dict(r) for n, r in self.base_assignment.items()}
                     if self.base_assignment is not None else None),
@@ -439,6 +449,10 @@ class StandbyController:
             leader = cls(*args, **kwargs)
         leader.boot_enabled = shadow["boot_enabled"]
         leader.adopt_shadow(shadow, dead_leader=dead)
+        # Leader-bound swap traffic (confirm/query/error) arrives on the
+        # shared loop's RECEIVER handler; forward it to the promoted
+        # leader's swap driver (docs/swap.md).
+        self.receiver.on_swap_leader_msg = leader.handle_swap_commit
         self.leader = leader
         self.promoted.set()  # only after self.leader is observable
         leader.detector.start()

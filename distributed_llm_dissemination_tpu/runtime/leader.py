@@ -26,7 +26,10 @@ Deviations from the reference, on purpose:
 
 from __future__ import annotations
 
+import dataclasses
+import hmac
 import itertools
+import os
 import queue
 import threading
 import time
@@ -66,6 +69,7 @@ from ..transport.messages import (
     GenerateReqMsg,
     GenerateRespMsg,
     HeartbeatMsg,
+    JobRevokeMsg,
     JobStatusMsg,
     JobSubmitMsg,
     LayerDigestsMsg,
@@ -78,6 +82,7 @@ from ..transport.messages import (
     ServeMsg,
     SourceDeadMsg,
     StartupMsg,
+    SwapCommitMsg,
     TimeSyncMsg,
 )
 from ..utils import integrity, intervals, telemetry, trace
@@ -93,6 +98,7 @@ from .node import MessageLoop, Node
 from .store import ContentIndex
 from .send import (
     NackRetransmitter,
+    RevokeRegistry,
     contribute_device_plan,
     fetch_from_client,
     handle_flow_retransmit,
@@ -179,6 +185,17 @@ class LeaderNode:
         # for single-run deployments.
         self._base_assignment = assignment
         self.jobs = JobManager()
+        # Live-swap driver state (docs/swap.md): version -> record
+        # {"version", "job_id", "swap_base", "dests", "state"
+        # (rolling|committed|aborted), "confirmed"} — replicated to
+        # standbys (delta kind "swap" + snapshot section) so a promoted
+        # leader resumes a half-finished rollout.
+        self._swaps: Dict[str, dict] = {}
+        self._swaps_by_job: Dict[str, str] = {}
+        # Admission control (docs/service.md): the shared-secret job
+        # token.  Read at construction like the other env knobs; empty
+        # = open admission (the legacy behavior).
+        self._job_token = os.environ.get("DLD_JOB_TOKEN", "")
         # (layer, dest) pairs already reported as content-skipped (the
         # counter/log fire once per pair, not once per replan).
         self._content_skip_seen: Set[Tuple[LayerID, NodeID]] = set()
@@ -276,6 +293,10 @@ class LeaderNode:
         # so a widened target still reconciles at the dest.
         self._sharding_seen = False
         self.nacker = NackRetransmitter()
+        # Preemption revoke (docs/service.md): the leader is a sender
+        # too — its own queued flow sends honor revokes via this
+        # registry (remote senders get JobRevokeMsg).
+        self.revokes = RevokeRegistry()
 
         # Control-plane HA (docs/failover.md).
         self.epoch = epoch
@@ -312,6 +333,7 @@ class LeaderNode:
                 limit_rate=src.meta.limit_rate,
                 source_type=src.meta.source_type,
                 data_size=src.data_size,
+                version=src.meta.version,
             )
             for lid, src in self.layers.items()
         }
@@ -441,6 +463,7 @@ class LeaderNode:
         reg(TimeSyncMsg, self.handle_time_sync)
         reg(JobSubmitMsg, self.handle_job_submit)
         reg(JobStatusMsg, self.handle_job_status)
+        reg(SwapCommitMsg, self.handle_swap_commit)
 
     # --------------------------------------------------- control-plane HA
 
@@ -537,6 +560,10 @@ class LeaderNode:
                 # The admitted-job table (docs/service.md): a promoted
                 # standby resumes EVERY job, not just one run.
                 "Jobs": self.jobs.to_json(),
+                # Live-swap driver records (docs/swap.md): a promoted
+                # standby resumes a half-finished rollout's fence.
+                "Swaps": {v: self._swap_record_locked(v)
+                          for v in sorted(self._swaps)},
                 "Status": _nested_layer_map_to_json(self.status),
                 "Partial": _partial_to_json(self.partial_status),
                 "Dropped": _nested_layer_map_to_json(
@@ -578,6 +605,7 @@ class LeaderNode:
                     limit_rate=src.meta.limit_rate,
                     source_type=src.meta.source_type,
                     data_size=src.data_size,
+                    version=src.meta.version,
                 )
                 for lid, src in self.layers.items()
             }
@@ -598,6 +626,22 @@ class LeaderNode:
                 {n: dict(r) for n, r in base.items() if n != dead_leader}
                 if base is not None else self.assignment)
             self.jobs.load(shadow.get("jobs") or {})
+            # Live-swap records (docs/swap.md): the promoted leader owns
+            # every half-finished rollout's fence now.
+            self._swaps = {}
+            self._swaps_by_job = {}
+            for v, rec in (shadow.get("swaps") or {}).items():
+                r = {"version": str(rec.get("Version", v)),
+                     "job_id": str(rec.get("JobID", "")),
+                     "swap_base": int(rec.get("SwapBase", -1)),
+                     "dests": [int(d) for d in rec.get("Dests") or []
+                               if int(d) != dead_leader],
+                     "state": str(rec.get("State", "rolling")),
+                     "confirmed": {int(d) for d in
+                                   rec.get("Confirmed") or []}}
+                self._swaps[r["version"]] = r
+                if r["job_id"]:
+                    self._swaps_by_job[r["job_id"]] = r["version"]
             if dead_leader is not None:
                 self.jobs.drop_dest(dead_leader)
             # Dests the DEAD leader declared crashed pre-takeover: the
@@ -651,6 +695,7 @@ class LeaderNode:
                  epoch=self.epoch,
                  dests=sorted(self.assignment),
                  partials=sorted(self.partial_status))
+        self._resume_swaps()
         with self._lock:
             already_done = self._startup_sent
         if already_done:
@@ -663,6 +708,35 @@ class LeaderNode:
             self._ready_q.put(self.assignment)
             return
         self._drive(self._recover)
+
+    def _resume_swaps(self) -> None:
+        """Re-drive every adopted swap at the bumped epoch: committed
+        fences re-send to unconfirmed nodes, aborted ones re-announce
+        the release, and a rolling swap whose job the adopted status
+        already shows complete fires its fence — otherwise the resumed
+        job plane carries the rollout and the usual completion path
+        commits (docs/swap.md)."""
+        with self._lock:
+            states = {v: rec["state"] for v, rec in self._swaps.items()}
+        for version, state in sorted(states.items()):
+            if state == "committed":
+                log.warn("adopted a committed swap; re-driving its "
+                         "fence at the new epoch", version=version)
+                self._swap_send_round(version)
+                threading.Thread(target=self._swap_watchdog,
+                                 args=(version,), daemon=True,
+                                 name=f"swap-fence-{version}").start()
+            elif state == "aborted":
+                self._swap_send_round(version)
+            else:  # rolling
+                with self._lock:
+                    jid = self._swaps[version]["job_id"]
+                job = self.jobs.get(jid)
+                if job is not None and job.state == "done":
+                    self._on_swap_job_done(jid)
+                else:
+                    log.info("adopted swap still rolling; the resumed "
+                             "job plane carries it", version=version)
 
     # --------------------------------------------------------- integrity
 
@@ -785,6 +859,13 @@ class LeaderNode:
                         if lid in self.layer_digests}
                        if integrity.digests_enabled() else {})
             shards = self._assigned_shards_locked(dest)
+            # Versioned rollout targets (docs/swap.md): the stamp is
+            # the one leader→dest channel preceding the bytes, so the
+            # dest's holdings and acks carry the version tag.
+            versions = {lid: meta.version
+                        for lid, meta in
+                        (self.assignment.get(dest) or {}).items()
+                        if meta.version}
             # Sticky: once ANY sharded target or shard holding exists,
             # later stamps must keep carrying the dest's target picture
             # even after widening removed the specs.
@@ -799,14 +880,15 @@ class LeaderNode:
                 # to iterate): explicit "" entries carry the reconcile.
                 for lid in self.assignment.get(dest) or {}:
                     shards.setdefault(lid, "")
-        if not digests and not shards:
+        if not digests and not shards and not versions:
             return
         try:
             self.node.transport.send(
                 dest, LayerDigestsMsg(
                     self.node.my_id, digests, epoch=self.epoch,
                     shards=shards,
-                    range_digests=self._range_digests_for(shards)))
+                    range_digests=self._range_digests_for(shards),
+                    versions=versions))
         except (OSError, KeyError) as e:
             log.warn("digest stamp send failed", dest=dest, err=repr(e))
 
@@ -1234,6 +1316,22 @@ class LeaderNode:
             "partial", Node=msg.src_id,
             Partial=({str(l): info for l, info in msg.partial.items()}
                      if msg.partial else None))
+        if self._started and self.jobs.has_active():
+            # An announce is authoritative inventory, and an ACK can be
+            # LOST in a failover window (sent to the dead leader before
+            # the worker re-pointed): reconcile active jobs against the
+            # refreshed status so a delivered-but-unacked pair credits
+            # here instead of wedging the job (and any swap fence
+            # waiting on it) forever — the same repair adopt_shadow
+            # runs at takeover.
+            with self._lock:
+                status_view = {n: dict(r) for n, r in self.status.items()}
+            finished = self.jobs.credit_status(status_view)
+            if finished:
+                log.info("announce reconciled job pairs a lost ack "
+                         "left uncredited", jobs=finished,
+                         node=msg.src_id)
+                self._jobs_completed(finished)
         if dropped:
             # The node came back from declared death: purge it from the
             # shadow's dropped map too, or a takeover would re-apply
@@ -1312,8 +1410,12 @@ class LeaderNode:
             self._dropped_assignment.clear()
             if self._started:
                 # Re-arm: every update() answers with its own ready event,
-                # immediate when the new goal is already met.
+                # immediate when the new goal is already met.  Replicated
+                # under THIS lock — see _maybe_finish: the shadow's
+                # startup flag must flip in write order, or a takeover
+                # adopts "FINISHED" and never re-drives the new goal.
                 self._startup_sent = False
+                self._replicate("startup", Sent=False)
         # New assignees that haven't announced get liveness leases, so one
         # that never shows up is still detected (as in __init__'s seeding).
         for node_id in assignment:
@@ -1351,7 +1453,8 @@ class LeaderNode:
     def submit_job(self, job_id: str, assignment: Assignment,
                    priority: int = 0, kind: str = "push",
                    digests: Optional[Dict[LayerID, str]] = None,
-                   avoid: Optional[Set[NodeID]] = None) -> dict:
+                   avoid: Optional[Set[NodeID]] = None,
+                   version: str = "", swap_base: int = -1) -> dict:
         """Admit one dissemination job into the long-lived service plane
         (docs/service.md) — the multi-job generalization of ``update()``.
 
@@ -1363,8 +1466,21 @@ class LeaderNode:
         (``xxh3:<hex>``): a dest already holding content-equal bytes
         resolves the layer locally — zero wire bytes — via the
         content store.  Idempotent per ``job_id``; returns the job's
-        status summary."""
+        status summary.
+
+        ``version``/``swap_base`` (docs/swap.md): a ``kind="swap"`` job
+        tags every target meta with the rollout version — only
+        deliveries verified under that version complete its pairs —
+        and registers the swap driver record; on the job's clean
+        completion the epoch-fenced commit fence flips every replica."""
         digests = dict(digests or {})
+        if version:
+            # Stamp the rollout version onto every target: the merged
+            # goal, the digest stamps, and the acks all carry it.
+            assignment = {
+                dest: {lid: dataclasses.replace(meta, version=version)
+                       for lid, meta in lids.items()}
+                for dest, lids in assignment.items()}
         with self._lock:
             # A long-lived daemon's layer store GROWS between jobs (a
             # rollout seeder loads v2 bytes): refresh the leader's own
@@ -1377,7 +1493,8 @@ class LeaderNode:
                         location=src.meta.location,
                         limit_rate=src.meta.limit_rate,
                         source_type=src.meta.source_type,
-                        data_size=src.data_size)
+                        data_size=src.data_size,
+                        version=src.meta.version)
             own_row = layer_ids_to_json(own)
         self._replicate("status", Node=self.node.my_id, Layers=own_row)
         if digests:
@@ -1386,15 +1503,22 @@ class LeaderNode:
                     # Job digests are authoritative for NEW layer ids;
                     # an existing stamp (e.g. a holder's announce) wins,
                     # matching the first-writer rule of the integrity
-                    # plane.
-                    self.layer_digests.setdefault(lid, d)
+                    # plane — EXCEPT for a swap job, which owns its v2
+                    # ids outright: a retry after a bad-digest abort
+                    # must be able to supersede the poisoned stamp, or
+                    # no corrected rollout can ever verify.
+                    if kind == "swap":
+                        self.layer_digests[lid] = d
+                    else:
+                        self.layer_digests.setdefault(lid, d)
         with self._lock:
             status_view = {n: dict(r) for n, r in self.status.items()}
         job = self.jobs.admit(
             Job(job_id=str(job_id), assignment=assignment,
                 priority=int(priority), kind=str(kind), digests=digests,
                 avoid_sources={int(n) for n in (avoid or ())},
-                admit_ms=time.time() * 1000.0),
+                admit_ms=time.time() * 1000.0,
+                version=str(version), swap_base=int(swap_base)),
             status_view)
         trace.count("jobs.admitted")
         log.info("dissemination job admitted", job=job.job_id,
@@ -1409,8 +1533,11 @@ class LeaderNode:
             if rearmed:
                 # Like update(): the completion cycle re-arms; ready()
                 # fires again when the whole current goal (all jobs)
-                # is met.
+                # is met.  Replicated under THIS lock so the delta
+                # order matches the flag-write order (_maybe_finish's
+                # in-lock Sent=True is the other writer).
                 self._startup_sent = False
+                self._replicate("startup", Sent=False)
             merged = _nested_layer_map_to_json(self.assignment)
         for node_id in job.assignment:
             if node_id != self.node.my_id and node_id not in self.status:
@@ -1421,8 +1548,6 @@ class LeaderNode:
                             Digests={str(l): d
                                      for l, d in digests.items()})
         self._replicate("assignment", Assignment=merged)
-        if rearmed:
-            self._replicate("startup", Sent=False)
         with self._lock:
             started = self._started
         if started:
@@ -1433,9 +1558,24 @@ class LeaderNode:
                 # verifies shipped layers against.
                 self._send_digests_to(dest)
                 self._send_boot_hint_to(dest)
+        if kind == "swap" and version:
+            self._register_swap(job)
+        # Preemption revoke (docs/service.md): queued sends of tiers
+        # this admission demotes are dropped at their senders before
+        # the re-plan reclaims their budget (mode-3 override).
+        self._preempt_revoke(job)
         self._drive(self._update_replan)
         job = self.jobs.get(job.job_id) or job
+        if kind == "swap" and version and job.state == "done":
+            # Admission found every pair already satisfied (all v2
+            # bytes verified): the fence can fire right now.
+            self._on_swap_job_done(job.job_id)
         return job.summary()
+
+    def _preempt_revoke(self, job: Job) -> None:
+        """Hook: a newly admitted job may demote lower tiers' queued
+        sends.  Only mode 3 tracks dispatched sends (``_live_jobs``);
+        the base scheduler has nothing to revoke."""
 
     def handle_job_submit(self, msg: JobSubmitMsg) -> None:
         """Wire half of ``submit_job`` — the ``cli.main -submit`` entry
@@ -1445,6 +1585,20 @@ class LeaderNode:
             reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
                                  error="deposed: a higher-epoch leader "
                                        "owns the job table")
+        elif self._job_token and not hmac.compare_digest(
+                msg.auth.encode(), self._job_token.encode()):
+            # Admission control (docs/service.md): the job plane now
+            # MUTATES cluster state (swaps flip serving models), so a
+            # token-armed leader refuses unauthenticated submitters —
+            # constant-time compare (no timing oracle), counted, and
+            # ANSWERED (the serving invariant).
+            trace.count("jobs.unauthorized")
+            log.warn("unauthorized job submit rejected",
+                     job=msg.job_id, submitter=msg.src_id)
+            reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
+                                 error="unauthorized: this leader "
+                                       "requires a job token "
+                                       "(DLD_JOB_TOKEN)")
         elif not msg.job_id or not msg.assignment:
             reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
                                  error="job_id and a non-empty "
@@ -1455,7 +1609,9 @@ class LeaderNode:
                                           priority=msg.priority,
                                           kind=msg.kind,
                                           digests=msg.digests,
-                                          avoid=msg.avoid)
+                                          avoid=msg.avoid,
+                                          version=msg.version,
+                                          swap_base=msg.swap_base)
                 reply = JobStatusMsg(self.node.my_id,
                                      jobs={msg.job_id: summary},
                                      epoch=self.epoch)
@@ -1488,6 +1644,251 @@ class LeaderNode:
         except (OSError, KeyError) as e:
             log.error("job status reply undeliverable", dest=msg.src_id,
                       err=repr(e))
+
+    # ------------------------------------------- zero-downtime swap driver
+
+    # Commit-fence watchdog knobs (class attrs: tests tune them): how
+    # often to re-send the fence to unconfirmed nodes, and how many
+    # rounds before going quiet (the node-side query path can still
+    # re-request later).
+    SWAP_RESEND_S = 2.0
+    SWAP_RESENDS = 10
+
+    def _register_swap(self, job: Job) -> None:
+        """Track a ``kind="swap"`` job as a live rollout and announce
+        the version + blob mapping to every serving dest (the PREPARE
+        notice: staging overlaps the rollout, docs/swap.md)."""
+        with self._lock:
+            prior = self._swaps.get(job.version)
+            if prior is not None:
+                if prior["job_id"] == job.job_id:
+                    return  # idempotent re-submit of the same job
+                if prior["state"] != "aborted":
+                    # A live (rolling/committed) version name belongs to
+                    # its job; a second job may not hijack its fence.
+                    # LOUD, never silent: the new job still delivers as
+                    # a plain rollout, but no flip will fire for it.
+                    log.error("swap version already owned by another "
+                              "job; refusing to re-register (pick a new "
+                              "version name)", version=job.version,
+                              owner=prior["job_id"], job=job.job_id)
+                    return
+                # Retrying an ABORTED rollout under the same version is
+                # the mainline operator path: replace the dead record.
+                log.warn("re-registering previously aborted swap "
+                         "version for a retry job", version=job.version,
+                         prior_job=prior["job_id"], job=job.job_id)
+            self._swaps[job.version] = {
+                "version": job.version,
+                "job_id": job.job_id,
+                "swap_base": job.swap_base,
+                "dests": sorted(job.assignment),
+                "state": "rolling",
+                "confirmed": set(),
+            }
+            self._swaps_by_job[job.job_id] = job.version
+        trace.count("swap.registered")
+        log.info("live swap registered; v2 disseminating while v1 "
+                 "serves", version=job.version, job=job.job_id,
+                 swap_base=job.swap_base, dests=sorted(job.assignment))
+        self._replicate_swap(job.version)
+        self._swap_send_round(job.version, prepare=True)
+
+    def _swap_record_locked(self, version: str) -> dict:
+        rec = self._swaps[version]
+        return {"Version": rec["version"], "JobID": rec["job_id"],
+                "SwapBase": rec["swap_base"], "Dests": list(rec["dests"]),
+                "State": rec["state"],
+                "Confirmed": sorted(rec["confirmed"])}
+
+    def _replicate_swap(self, version: str) -> None:
+        with self._lock:
+            if version not in self._swaps:
+                return
+            data = self._swap_record_locked(version)
+        self._replicate("swap", **data)
+
+    def _swap_send_round(self, version: str, prepare: bool = False,
+                         only: Optional[Set[NodeID]] = None) -> None:
+        """One fence round: the operative message (prepare / commit /
+        abort, per the record's state) to each dest — unconfirmed ones
+        only, unless ``only`` narrows it further."""
+        with self._lock:
+            rec = self._swaps.get(version)
+            if rec is None:
+                return
+            state = rec["state"]
+            targets = [d for d in rec["dests"]
+                       if d not in rec["confirmed"]
+                       and (only is None or d in only)
+                       and d != self.node.my_id]
+            swap_base = rec["swap_base"]
+        for dest in targets:
+            msg = SwapCommitMsg(self.node.my_id, version,
+                                swap_base=swap_base,
+                                abort=(state == "aborted"),
+                                prepare=prepare and state == "rolling",
+                                epoch=self.epoch)
+            try:
+                self.node.add_node(dest)
+                self.node.transport.send(dest, msg)
+            except (OSError, KeyError) as e:
+                log.warn("swap fence send failed", dest=dest,
+                         version=version, err=repr(e))
+
+    def _on_swap_job_done(self, job_id: str) -> None:
+        """A swap job finished rolling: clean completion commits the
+        fence; any dropped pair (dest crashed, pair cancelled) aborts —
+        v1 keeps serving everywhere."""
+        with self._lock:
+            version = self._swaps_by_job.get(job_id)
+            rec = self._swaps.get(version) if version else None
+            if rec is None or rec["state"] != "rolling":
+                return
+        job = self.jobs.get(job_id)
+        if job is None or job.dropped_pairs > 0 or job.cancelled:
+            self._abort_swap(version, "rollout degraded: "
+                             f"{job.dropped_pairs if job else '?'} pairs "
+                             "dropped")
+            return
+        self._commit_swap(version)
+
+    def _commit_swap(self, version: str) -> None:
+        with self._lock:
+            rec = self._swaps.get(version)
+            if rec is None or rec["state"] != "rolling":
+                return
+            rec["state"] = "committed"
+        trace.count("swap.committed")
+        log.info("swap rollout verified on every replica; issuing the "
+                 "commit fence", version=version, epoch=self.epoch)
+        self._replicate_swap(version)
+        self._swap_send_round(version)
+        threading.Thread(target=self._swap_watchdog, args=(version,),
+                         daemon=True,
+                         name=f"swap-fence-{version}").start()
+
+    def _abort_swap(self, version: str, reason: str) -> None:
+        """Rollback = never flip: cancel the job (remaining pairs drop
+        VISIBLY), tell every dest to release its staged v2, keep v1
+        serving."""
+        with self._lock:
+            rec = self._swaps.get(version)
+            if rec is None or rec["state"] in ("aborted",):
+                return
+            if rec["state"] == "committed":
+                log.error("abort requested for an already-committed "
+                          "swap; refusing (the fleet flipped)",
+                          version=version, reason=reason)
+                return
+            rec["state"] = "aborted"
+            rec["confirmed"] = set()
+            job_id = rec["job_id"]
+        trace.count("swap.aborts")
+        log.error("live swap ABORTED; v1 keeps serving", version=version,
+                  reason=reason)
+        if self.jobs.cancel(job_id):
+            self._replicate("job", **self.jobs.record(job_id))
+            with self._lock:
+                self.assignment = self.jobs.merged_assignment(
+                    self._base_assignment)
+                merged = _nested_layer_map_to_json(self.assignment)
+            self._replicate("assignment", Assignment=merged)
+        self._replicate_swap(version)
+        self._swap_send_round(version)
+        self._maybe_finish()
+
+    def _swap_watchdog(self, version: str) -> None:
+        """Bounded fence re-send: a node that lost the commit gets it
+        again until every dest confirmed (the node-side query path
+        covers the long tail past the budget)."""
+        for _ in range(self.SWAP_RESENDS):
+            time.sleep(self.SWAP_RESEND_S)
+            with self._lock:
+                rec = self._swaps.get(version)
+                if rec is None or rec["state"] != "committed":
+                    return
+                missing = [d for d in rec["dests"]
+                           if d not in rec["confirmed"]]
+                if not missing:
+                    return
+            if self._deposed or self._closed():
+                return
+            trace.count("swap.fence_resent")
+            log.warn("swap fence unconfirmed; re-sending",
+                     version=version, missing=missing)
+            self._swap_send_round(version)
+        log.error("swap fence re-send budget exhausted; remaining nodes "
+                  "must query", version=version)
+
+    def _closed(self) -> bool:
+        # close() and a depose both set the lease stop — either way
+        # this process must stop driving fences.
+        return self._lease_stop.is_set()
+
+    def handle_swap_commit(self, msg: SwapCommitMsg) -> None:
+        """Node → leader swap traffic: flip confirmations, fence
+        re-requests, and staging-failure reports.
+
+        Gated to the swap's REGISTERED replica set: confirm/query/error
+        are serving-state mutations (a forged error is a one-message
+        rollout DoS; a forged confirm fakes a flip the fence watchdog
+        would otherwise keep chasing), so a node outside the rollout's
+        dest set is refused, loudly — the same posture as the
+        DLD_JOB_TOKEN admission gate.  Honest limit: a compromised
+        replica can still lie about ITSELF (inherent without
+        per-message signatures; docs/swap.md)."""
+        with self._lock:
+            rec = self._swaps.get(msg.version)
+            member = rec is not None and msg.src_id in rec["dests"]
+        if (msg.applied or msg.query or msg.error) and not member:
+            trace.count("swap.foreign_ctrl_dropped")
+            log.warn("swap control message from a node outside the "
+                     "rollout's replica set; dropped",
+                     version=msg.version, node=msg.src_id,
+                     applied=msg.applied, query=msg.query,
+                     err=msg.error or None)
+            return
+        if msg.applied:
+            with self._lock:
+                rec = self._swaps.get(msg.version)
+                if rec is None:
+                    return
+                rec["confirmed"].add(msg.src_id)
+                done = (rec["state"] == "committed"
+                        and set(rec["dests"]) <= rec["confirmed"])
+            self._replicate_swap(msg.version)
+            if done:
+                trace.count("swap.fleet_flipped")
+                log.info("every replica confirmed the flip; swap "
+                         "complete", version=msg.version)
+            return
+        if msg.query:
+            # A staged node that never saw its fence: answer with the
+            # operative state (commit/abort); a still-rolling swap has
+            # nothing to say yet.
+            with self._lock:
+                rec = self._swaps.get(msg.version)
+                state = rec["state"] if rec is not None else None
+            if state in ("committed", "aborted"):
+                self._swap_send_round(msg.version, only={msg.src_id})
+            elif state is None:
+                log.warn("fence query for an unknown swap version",
+                         version=msg.version, node=msg.src_id)
+            return
+        if msg.error:
+            log.error("replica reports unrecoverable swap staging; "
+                      "aborting rollout", version=msg.version,
+                      node=msg.src_id, err=msg.error)
+            self._abort_swap(msg.version,
+                             f"node {msg.src_id}: {msg.error}")
+            return
+
+    def swap_table(self) -> Dict[str, dict]:
+        """JSON-ready swap driver state (reports, tests, -jobs)."""
+        with self._lock:
+            return {v: self._swap_record_locked(v)
+                    for v in sorted(self._swaps)}
 
     def _content_skip_locked(self, dest: NodeID, layer_id: LayerID) -> bool:
         """Lock held.  True when shipping (dest, layer) would be wasted
@@ -1835,8 +2236,16 @@ class LeaderNode:
             if (prev is not None and delivered(prev)
                     and shard_covers(prev.shard, msg.shard)):
                 shard = prev.shard
+            # Version-qualified holding (docs/swap.md): an unversioned
+            # duplicate re-ack must not strip a versioned holding's tag
+            # (that would un-satisfy the swap pair and re-plan it).
+            version = msg.version
+            if (not version and prev is not None and delivered(prev)
+                    and prev.version):
+                version = prev.version
             row[msg.layer_id] = LayerMeta(location=msg.location,
-                                          data_size=size, shard=shard)
+                                          data_size=size, shard=shard,
+                                          version=version)
             # A delivered (layer, dest) pair needs no more salvage.
             self._salvaging.discard((msg.layer_id, msg.src_id))
             # The watchdog stops chasing any plan this ack settles.
@@ -1847,7 +2256,7 @@ class LeaderNode:
                     del self._plan_watch[seq]
         self._replicate("ack", Node=msg.src_id, Layer=msg.layer_id,
                         Location=int(msg.location), Size=size,
-                        Shard=shard)
+                        Shard=shard, Version=version)
         # Content index + job plane: the delivered copy verified against
         # the stamped digest before acking, so the new owner vouches for
         # those bytes; the ack credits every admitted job wanting the
@@ -1861,17 +2270,21 @@ class LeaderNode:
                 digest = self.layer_digests.get(msg.layer_id)
         self.content.add(msg.src_id, msg.layer_id, digest, shard=shard)
         self._jobs_completed(
-            self.jobs.on_ack(msg.src_id, msg.layer_id, shard=shard))
+            self.jobs.on_ack(msg.src_id, msg.layer_id, shard=shard,
+                             version=version))
         self._maybe_finish()
 
     def _jobs_completed(self, job_ids) -> None:
-        """Log + replicate job completions (no-op on an empty list)."""
+        """Log + replicate job completions (no-op on an empty list).
+        A completed ``kind="swap"`` job drives its fence: clean
+        completion commits, a degraded one aborts (docs/swap.md)."""
         for jid in job_ids:
             job = self.jobs.get(jid)
             trace.count("jobs.completed")
             log.info("dissemination job complete", job=jid,
                      **(job.summary() if job is not None else {}))
             self._replicate("job_done", JobID=jid)
+            self._on_swap_job_done(jid)
 
     def _layer_size_locked(self, layer_id: LayerID) -> int:
         """A layer's full size: the max announced ``data_size`` across
@@ -1893,8 +2306,15 @@ class LeaderNode:
             ):
                 return
             self._startup_sent = True
+            # Replicate INSIDE the lock (publish only enqueues): every
+            # writer of _startup_sent enqueues its delta under this
+            # lock, so the standbys' shadow sees the flag flips in
+            # write order — a completion racing a submit_job/update
+            # re-arm must not land its Sent=True AFTER the re-arm's
+            # Sent=False (a takeover would then adopt "FINISHED" and
+            # never re-drive the admitted work).
+            self._replicate("startup", Sent=True)
         log.info("timer stop: startup")
-        self._replicate("startup", Sent=True)
         self.send_startup()
         # End-of-delivery telemetry dump: the folded cluster table goes
         # into the log stream (the single source of truth the offline
@@ -1993,6 +2413,29 @@ class LeaderNode:
         # would otherwise resurrect the dead dest's pairs at takeover
         # and wedge the adopted goal.
         self.content.drop_node(node_id)
+        # A serving replica died mid-rollout: the swap can no longer
+        # land everywhere — abort (v1 keeps serving on the survivors)
+        # BEFORE the job drops mark it "done with drops" (docs/swap.md).
+        pruned = []
+        with self._lock:
+            dead_swaps = [v for v, rec in self._swaps.items()
+                          if rec["state"] == "rolling"
+                          and node_id in rec["dests"]]
+            for v, rec in self._swaps.items():
+                # A COMMITTED swap's dead dest can never confirm: drop
+                # it from the fence set so the watchdog completes on
+                # the survivors.
+                if rec["state"] == "committed" and node_id in rec["dests"]:
+                    rec["dests"] = [d for d in rec["dests"]
+                                    if d != node_id]
+                    pruned.append(v)
+        for version in pruned:
+            # The prune must REPLICATE like every other swap mutation,
+            # or a promoted standby re-adopts the dead dest and chases
+            # its confirmation through the whole re-send budget.
+            self._replicate_swap(version)
+        for version in dead_swaps:
+            self._abort_swap(version, f"dest {node_id} crashed mid-rollout")
         affected, finished = self.jobs.drop_dest(node_id)
         for jid in affected:
             self._replicate("job", **self.jobs.record(jid))
@@ -2690,6 +3133,55 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         )
         return t, self_jobs, jobs
 
+    def _preempt_revoke(self, job: Job) -> None:
+        """Mode-3 preemption revoke (docs/service.md): the new job's
+        tier reclaims budget at the re-plan, but commands DISPATCHED
+        under the old solve are already queued at their senders —
+        without a revoke, a high-priority swap job stalls behind
+        in-flight bulk traffic the solver thinks it preempted.  Revoke
+        every LOWER-tier job's dispatched-but-undelivered pairs at
+        their senders; the re-plan that follows re-dispatches them at
+        the demoted budget."""
+        if job.state != "active":
+            return
+        targets: Dict[NodeID, Dict[str, Set[Tuple[NodeID, LayerID]]]] = {}
+        with self._lock:
+            for sender, job_list in self._live_jobs.items():
+                for fj in job_list:
+                    if not fj.job_id or fj.job_id == job.job_id:
+                        continue
+                    other = self.jobs.get(fj.job_id)
+                    if (other is None or other.state != "active"
+                            or other.priority >= job.priority):
+                        continue
+                    held = self.status.get(fj.dest_id, {}).get(fj.layer_id)
+                    want = (self.assignment.get(fj.dest_id)
+                            or {}).get(fj.layer_id)
+                    if (held is not None and want is not None
+                            and satisfies(held, want)):
+                        continue  # already landed: nothing to revoke
+                    targets.setdefault(sender, {}).setdefault(
+                        fj.job_id, set()).add((fj.dest_id, fj.layer_id))
+        for sender, by_job in sorted(targets.items()):
+            for jid, pairs in sorted(by_job.items()):
+                trace.count("jobs.revokes_sent")
+                log.info("revoking demoted tier's queued sends",
+                         sender=sender, job=jid, pairs=sorted(pairs),
+                         preempting=job.job_id)
+                if sender == self.node.my_id:
+                    # The leader's own queue honors the registry
+                    # directly — no wire round-trip to itself.
+                    self.revokes.add(jid, sorted(pairs))
+                    continue
+                try:
+                    self.node.transport.send(
+                        sender, JobRevokeMsg(self.node.my_id, jid,
+                                             sorted(pairs),
+                                             epoch=self.epoch))
+                except (OSError, KeyError) as e:
+                    log.warn("revoke send failed (the demoted sends "
+                             "simply run)", sender=sender, err=repr(e))
+
     def _job_avoid_locked(self, jid: str, asg: Assignment) -> Set[NodeID]:
         """Lock held.  The sender-avoid set for one job's tier: the
         job's explicit ``avoid_sources``, plus — for "repair" jobs —
@@ -2933,6 +3425,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         handle_flow_retransmit(
             self.node, self.layers, self._lock,
             lambda lid, dest: fetch_from_client(self.node, lid, dest), msg,
+            revokes=self.revokes,
         )
         dur = time.monotonic() - t0
         log.info(

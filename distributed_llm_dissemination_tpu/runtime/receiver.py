@@ -46,6 +46,7 @@ from ..transport.messages import (
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
+    JobRevokeMsg,
     LayerDigestsMsg,
     LayerMsg,
     LayerNackMsg,
@@ -56,6 +57,7 @@ from ..transport.messages import (
     ServeMsg,
     SourceDeadMsg,
     StartupMsg,
+    SwapCommitMsg,
     TimeSyncMsg,
 )
 from ..utils import (
@@ -72,8 +74,10 @@ from .checkpoint import LayerCheckpointStore
 from .failure import HeartbeatSender
 from .node import MessageLoop, Node
 from .store import ContentStore
+from .swap import SwapController
 from .send import (
     NackRetransmitter,
+    RevokeRegistry,
     contribute_device_plan,
     fetch_from_client,
     handle_flow_retransmit,
@@ -240,11 +244,27 @@ class ReceiverNode:
         # against without ever holding the full layer.
         self._shard_specs: Dict[int, str] = {}
         self._range_digests: Dict[int, str] = {}
+        # Versioned rollout targets (docs/swap.md): leader-stamped
+        # version per assigned layer — stored holdings and acks carry
+        # the tag, so a v2 delivery can never be mistaken for (or
+        # clobbered by) an unversioned copy under the same id.
+        self._layer_versions: Dict[int, str] = {}
+        # The rollout version the serving params were assembled under
+        # ("" until a swap commits here).
+        self.serving_version = ""
+        # Live-swap state machine (runtime/swap.py): stages v2 sets
+        # concurrently with v1 serving and applies the epoch-fenced
+        # commit flip.  Only serving-capable nodes carry one.
+        self.swap = (SwapController(self) if boot_cfg is not None
+                     else None)
         self._own_digests: Dict[int, str] = {}
         self._digest_ok: set = set()
         self._digest_retries: Dict[int, int] = {}
         self._nack_counts: Dict[Tuple[int, int], int] = {}
         self.nacker = NackRetransmitter()
+        # Preemption revoke registry (docs/service.md): queued sends a
+        # re-plan demoted; consulted by the flow-job executor.
+        self.revokes = RevokeRegistry()
         # Content-addressed layer store (runtime/store.py,
         # docs/service.md): digest -> locally held layer ids, fed by
         # this node's own announce-time hashes and ack-gate verifies.
@@ -328,6 +348,10 @@ class ReceiverNode:
         self._leader_pending: "collections.deque" = collections.deque(
             maxlen=256)
         self.on_leader_lease = None
+        # Set by a promoting StandbyController: leader-bound swap
+        # messages (confirm/query/error) forward to the promoted
+        # leader's driver — the shared loop keeps THIS handler.
+        self.on_swap_leader_msg = None
         # Latched by close(): a closed receiver's still-draining daemon
         # work (a boot thread finishing late) must not emit leader-routed
         # messages — its seat's address may already belong to a NEW
@@ -376,6 +400,7 @@ class ReceiverNode:
         self.loop.register(LayerDigestsMsg, self.handle_layer_digests)
         self.loop.register(LeaderLeaseMsg, self.handle_leader_lease)
         self.loop.register(TimeSyncMsg, self.handle_time_sync)
+        self.loop.register(SwapCommitMsg, self.handle_swap_commit)
 
     # ------------------------------------------------- control-plane HA
 
@@ -493,6 +518,7 @@ class ReceiverNode:
                     source_type=src.meta.source_type,
                     data_size=src.data_size,
                     shard=src.meta.shard,
+                    version=src.meta.version,
                 )
                 for lid, src in self.layers.items()
             }
@@ -635,7 +661,21 @@ class ReceiverNode:
             return
         widened = []
         with self._lock:
+            # A CHANGED stamp (a swap retry superseding a poisoned
+            # digest, docs/swap.md) resets the layer's verification
+            # state: the old verdict and the spent retry budget belong
+            # to the old expectation — without the reset, a corrected
+            # rollout gives up instantly on the exhausted counter.
+            for lid, d in msg.digests.items():
+                prior = self.layer_digests.get(lid)
+                if prior is not None and prior != d:
+                    self._digest_retries.pop(lid, None)
+                    self._digest_ok.discard(lid)
             self.layer_digests.update(msg.digests)
+            # Rollout version stamps (docs/swap.md): which version each
+            # assigned layer belongs to — stored holdings and acks
+            # carry the tag from here on.
+            self._layer_versions.update(msg.versions)
             # The stamp is leader-authoritative per dest: a layer
             # stamped with a FULL digest and no shard entry — or an
             # explicit ``""`` entry in the shards map (the digests-off
@@ -679,6 +719,29 @@ class ReceiverNode:
             # coverage already satisfies the just-learned shard must
             # promote now — no later fragment will re-run the check.
             self._on_shard_specs(sorted(msg.shards))
+        if msg.versions:
+            # Version stamps can lose the race against small layers the
+            # same way: a layer that landed (and acked, unversioned)
+            # before its stamp re-acks with the tag — the leader's swap
+            # fence needs the versioned ack, and nothing else re-runs it.
+            self._reack_versioned(sorted(msg.versions))
+
+    def _reack_versioned(self, lids) -> None:
+        for lid in lids:
+            with self._lock:
+                src = self.layers.get(lid)
+                stamped = self._layer_versions.get(lid, "")
+            if src is None or src.meta.shard:
+                continue
+            if src.meta.version == stamped:
+                # Already acked under this tag (the stamp is re-sent on
+                # every admission/replan): a re-ack here would make
+                # every long-lived dest volley acks per new job.
+                continue
+            if (self._expected_digest(lid) is not None
+                    and lid not in self._digest_ok):
+                continue  # the ack gate will stamp + ack when it passes
+            self._send_ack(lid, src.meta.location)
 
     def _reopen_widened(self, lids) -> None:
         """Hook: these SHARD holdings' targets widened (or re-targeted
@@ -815,6 +878,12 @@ class ReceiverNode:
             log.error("digest retry budget exhausted; layer stays "
                       "undelivered", layerID=lid, tries=n)
             trace.count("integrity.digest_given_up")
+            if self.swap is not None:
+                # A versioned layer that can never verify here means the
+                # swap can never complete on this replica: report it so
+                # the leader aborts cluster-wide (v1 keeps serving)
+                # instead of waiting out the rollout forever.
+                self.swap.on_staging_failed(lid, "digest retries exhausted")
             return False
         return True
 
@@ -1098,7 +1167,7 @@ class ReceiverNode:
         # Streamed boot staging: this layer's decode + device placement
         # starts NOW, overlapping the remaining layers' transfers.
         self._boot_stream_submit(msg.layer_id, src)
-        self._send_to_leader(AckMsg(self.node.my_id, msg.layer_id, loc))
+        self._send_ack(msg.layer_id, loc)
         # The committed layer may be the donor a stamped-but-missing
         # layer was waiting for (stamp-before-donor race).
         self._resolve_pending_for_layer(msg.layer_id)
@@ -1582,8 +1651,24 @@ class ReceiverNode:
         except (OSError, KeyError) as e:
             log.error("re-announce for re-plan failed", err=repr(e))
 
-    def _send_ack(self, layer_id, loc) -> None:
-        self._send_to_leader(AckMsg(self.node.my_id, layer_id, loc))
+    def _send_ack(self, layer_id, loc, shard: str = "") -> None:
+        """THE ack chokepoint: every completion path (whole-layer
+        frames, flow reassembly, fabric delivery, content resolve,
+        re-acks) funnels here so the version tag (docs/swap.md) is
+        stamped exactly once — onto the stored holding (announce after
+        a restart keeps it) and onto the wire ack (the leader's swap
+        fence counts versioned acks) — and the live-swap controller
+        sees every completed layer."""
+        version = self._layer_versions.get(layer_id, "")
+        if version:
+            with self._lock:
+                src = self.layers.get(layer_id)
+                if src is not None:
+                    src.meta.version = version
+        self._send_to_leader(AckMsg(self.node.my_id, layer_id, loc,
+                                    shard=shard, version=version))
+        if self.swap is not None and version:
+            self.swap.on_layer(layer_id)
 
     def handle_generate_req(self, msg: GenerateReqMsg) -> None:
         """Serve an inference request from this node's RESIDENT booted
@@ -1713,6 +1798,64 @@ class ReceiverNode:
                  new_tokens=len(out), decode_ms=round(dt * 1000, 1),
                  tokens_per_s=round(len(out) / max(dt, 1e-9), 1))
         reply(tokens=out)
+
+    # ---------------------------------------------- zero-downtime swap
+
+    def handle_swap_commit(self, msg: SwapCommitMsg) -> None:
+        """The live-swap control channel (docs/swap.md): prepare
+        notices, the epoch-fenced commit flip, and aborts — all routed
+        to the SwapController.  Confirm/query/error roles are
+        leader-bound; one that reaches a receiver (misroute) is
+        ignored.  A node with no serving engine answers with an error
+        so the leader aborts instead of re-sending the fence forever."""
+        if self._fence_stale(msg):
+            return
+        if msg.applied or msg.query or msg.error:
+            # Leader-bound roles.  On a shared loop (this worker was
+            # PROMOTED to leader) the receiver owns the handler — hand
+            # the message to the promoted leader's swap driver so
+            # confirms/queries keep flowing across a takeover.
+            fwd = self.on_swap_leader_msg
+            if fwd is not None:
+                fwd(msg)
+            return
+        if self.swap is None:
+            log.error("swap commit at a node with no serving engine",
+                      version=msg.version)
+            try:
+                self._send_to_leader(SwapCommitMsg(
+                    self.node.my_id, msg.version,
+                    error="no serving engine at this node"))
+            except Exception as e:  # noqa: BLE001 — advisory
+                log.error("swap refusal send failed", err=repr(e))
+            return
+        if msg.prepare:
+            self.swap.on_prepare(msg.version, msg.swap_base)
+            return
+        self.swap.on_commit(msg)
+
+    def _apply_swap_result(self, version: str, params) -> None:
+        """The atomic flip: replace the serving params pointer under the
+        receiver lock.  In-flight decodes finish on the v1 tree they
+        captured (the old params object is immutable and refcounted);
+        every request admitted after this line decodes on v2 — no
+        request is ever dropped, and no forward spans both versions."""
+        from .boot import BootResult
+
+        cfg = self.boot_cfg
+        res = BootResult(kind="full", seconds=0.0,
+                         layer_ids=list(range(cfg.n_layers)),
+                         params=params)
+        with self._lock:
+            self.boot_result = res
+            self.serving_version = version
+            self._boot_started = True
+            self._boot_report = (0.0, "full")
+        # A swap can land on a node that never booted v1 (it joined the
+        # fleet mid-rollout): the flip IS its boot — serve waiters
+        # proceed.
+        self._boot_finished.set()
+        self._boot_drained.set()
 
     def handle_boot_hint(self, msg: BootHintMsg) -> None:
         """Overlap the boot's XLA compiles with the dissemination: the
@@ -1879,8 +2022,18 @@ class ReceiverNode:
             )
             # Assign BEFORE the finally sets the event: _serve() waits on
             # _boot_finished and then reads boot_result, so the event must
-            # guarantee the assignment is visible.
-            self.boot_result = res
+            # guarantee the assignment is visible.  A swap FLIP can race
+            # a slow v1 boot (the v2 delta verified + committed while v1
+            # was still compiling): the flipped tree wins — a late v1
+            # boot must never overwrite the serving v2 params while
+            # serving_version says v2 (docs/swap.md).
+            with self._lock:
+                if not self.serving_version:
+                    self.boot_result = res
+                else:
+                    log.warn("boot finished after a swap flip; keeping "
+                             "the swapped serving params",
+                             serving=self.serving_version)
         except Exception as e:  # noqa: BLE001 — boot failure must be loud but non-fatal
             log.error("model boot failed", err=repr(e))
             # The failure must still REPORT: the leader's TTFT wait gates
@@ -1982,11 +2135,23 @@ class RetransmitReceiverNode(ReceiverNode):
         super()._register_handlers()
         self.loop.register(RetransmitMsg, self.handle_retransmit)
         # Retransmit-capable receivers SERVE layers, so they also serve
-        # NACKs for fragments a peer's transport dropped as corrupt.
+        # NACKs for fragments a peer's transport dropped as corrupt —
+        # and honor preemption revokes for their queued sends.
         self.loop.register(LayerNackMsg, self.handle_layer_nack)
+        self.loop.register(JobRevokeMsg, self.handle_job_revoke)
 
     def handle_layer_nack(self, msg: LayerNackMsg) -> None:
         self.nacker.handle(self.node, self.layers, self._lock, msg)
+
+    def handle_job_revoke(self, msg: JobRevokeMsg) -> None:
+        """Preemption revoke (docs/service.md): a re-plan demoted this
+        job's tier — queued sends for the named pairs must not start
+        (and in-flight ones stop between fragments)."""
+        if self._fence_stale(msg):
+            return
+        n = self.revokes.add(msg.job_id, msg.pairs)
+        log.info("preemption revoke registered", job=msg.job_id,
+                 pairs=len(msg.pairs), registry=n)
 
     def handle_retransmit(self, msg: RetransmitMsg) -> None:
         if self._fence_stale(msg):
@@ -2773,13 +2938,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             # Mid-wire boot staging: this layer's decode/upload overlaps
             # the layers still on the wire (runtime/stream_boot.py).
             self._boot_stream_submit(lid, src)
-        try:
-            self.node.transport.send(
-                self.node.leader_id,
-                AckMsg(self.node.my_id, lid, loc, shard=shard),
-            )
-        except (OSError, KeyError) as e:
-            log.error("failed to send ackMsg", err=repr(e))
+        self._send_ack(lid, loc, shard=shard)
         # Stamp-before-donor race: this completed layer may be the
         # donor a stamped-but-missing layer was waiting for.
         self._resolve_pending_for_layer(lid)
@@ -2849,6 +3008,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         handle_flow_retransmit(
             self.node, self.layers, self._lock,
             lambda lid, dest: fetch_from_client(self.node, lid, dest), msg,
+            revokes=self.revokes,
         )
         dur = _time.monotonic() - t0
         log.info(

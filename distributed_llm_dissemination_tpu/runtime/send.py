@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 from ..core.types import (
     CLIENT_ID,
@@ -120,6 +120,48 @@ def _sub_layer_src(layer: LayerSrc, send_loc: LayerLocation, offset: int,
         meta=LayerMeta(location=send_loc, limit_rate=rate,
                        source_type=layer.meta.source_type),
     )
+
+
+class RevokeRegistry:
+    """Sender-side preemption revoke (docs/service.md): the leader's
+    ``JobRevokeMsg`` names a demoted job's (dest, layer) pairs whose
+    queued sends should not burn the reclaimed link budget.  Entries
+    are CONSUMED on first match (the re-plan that triggered the revoke
+    re-dispatches the same pair at the demoted rate — the fresh command
+    must not be eaten too) and TTL-bounded (a revocation whose send
+    already finished must not linger to eat a future command)."""
+
+    TTL_S = 30.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._revoked: Dict[tuple, float] = {}  # (job, dest, layer) -> t
+
+    def add(self, job_id: str, pairs) -> int:
+        import time
+
+        now = time.time()
+        with self._lock:
+            for dest, lid in pairs:
+                self._revoked[(str(job_id), int(dest), int(lid))] = now
+            return len(self._revoked)
+
+    def consume(self, job_id: str, dest: NodeID, lid: LayerID) -> bool:
+        """True when (job, dest, layer) is revoked; the entry is spent
+        by the check."""
+        import time
+
+        if not job_id:
+            return False  # base-run sends are never revoked
+        key = (str(job_id), int(dest), int(lid))
+        now = time.time()
+        with self._lock:
+            t = self._revoked.pop(key, None)
+            if t is None:
+                return False
+            if now - t > self.TTL_S:
+                return False  # expired: treat as never revoked
+            return True
 
 
 class NackRetransmitter:
@@ -396,9 +438,17 @@ def handle_flow_retransmit(
     lock: threading.Lock,
     fetch_fn: Callable[[LayerID, NodeID], None],
     msg: FlowRetransmitMsg,
+    revokes: "Optional[RevokeRegistry]" = None,
 ) -> None:
     """Execute one flow job: send ``[offset, offset+data_size)`` of a layer
     to the dest at the commanded rate (node.go:1592-1643).
+
+    ``revokes``: the sender's preemption-revoke registry.  A queued job
+    whose (job, dest, layer) the leader revoked before it started is
+    dropped whole (counted on ``jobs.revoked_pairs``); a revocation
+    landing mid-job stops the remaining fragments — either way the
+    re-plan that issued the revoke re-dispatches the pair at the
+    demoted tier's budget.
 
     The ClientLayer branch simulates a rate-limited fetch from the node's
     own external client, then loops the partial layer back into the node's
@@ -410,6 +460,12 @@ def handle_flow_retransmit(
     if layer is None:
         log.error("no layer for flow job", layerID=msg.layer_id)
         return
+    if (revokes is not None
+            and revokes.consume(msg.job_id, msg.dest_id, msg.layer_id)):
+        trace.count("jobs.revoked_pairs")
+        log.warn("queued flow send revoked by preemption; dropped",
+                 layerID=msg.layer_id, dest=msg.dest_id, job=msg.job_id)
+        return
     node.add_node(msg.dest_id)
 
     send_loc = _sendable_location(layer)
@@ -417,6 +473,14 @@ def handle_flow_retransmit(
         frag_bytes = _fragment_bytes(msg.rate)
         sent = 0
         while sent < msg.data_size:
+            if (sent > 0 and revokes is not None
+                    and revokes.consume(msg.job_id, msg.dest_id,
+                                        msg.layer_id)):
+                trace.count("jobs.revoked_pairs")
+                log.warn("in-flight flow send revoked mid-job; stopping",
+                         layerID=msg.layer_id, dest=msg.dest_id,
+                         job=msg.job_id, sent=sent)
+                return
             n = min(frag_bytes, msg.data_size - sent)
             partial = _sub_layer_src(layer, send_loc, msg.offset + sent, n,
                                      msg.rate)

@@ -68,6 +68,16 @@ class Job:
     kind: str = "push"
     digests: Dict[LayerID, str] = dataclasses.field(default_factory=dict)
     state: str = ACTIVE
+    # Rollout version (docs/swap.md): a ``kind="swap"`` job's v2 tag —
+    # stamped onto every target meta at admission, so only deliveries
+    # verified under this version complete its pairs.  ``swap_base``:
+    # blob-id base of the v2 set (v2 id = swap_base + model slot).
+    version: str = ""
+    swap_base: int = -1
+    # True when the job was cancelled (swap abort, operator action):
+    # its undelivered pairs moved to ``dropped_pairs`` — visibly
+    # degraded, never silently "done".
+    cancelled: bool = False
     # Sender node ids this job must NOT pull from (the repair-refill
     # politeness policy: spare the busy origin seeder when current
     # holders can serve).  Advisory: deliverability wins — the solver
@@ -82,7 +92,7 @@ class Job:
 
     def summary(self) -> dict:
         """JSON-ready status row (JobStatusMsg / -jobs / run report)."""
-        return {
+        out = {
             "JobID": self.job_id,
             "State": self.state,
             "Priority": self.priority,
@@ -93,6 +103,11 @@ class Job:
             "DroppedPairs": self.dropped_pairs,
             "Dests": sorted(self.assignment),
         }
+        if self.version:
+            out["Version"] = self.version
+        if self.cancelled:
+            out["Cancelled"] = True
+        return out
 
 
 def merge_assignments(base: Assignment, others) -> Assignment:
@@ -164,13 +179,19 @@ class JobManager:
     # ----------------------------------------------------------- accounting
 
     def on_ack(self, dest: NodeID, lid: LayerID,
-               shard: str = "") -> List[str]:
+               shard: str = "", version: str = "") -> List[str]:
         """Credit one delivered (dest, layer) pair against every active
         job that wants it; returns the job ids the ack completed.
         ``shard``: the delivered shard spec ("" = whole layer) — a
         shard ack only credits jobs whose target shard it COVERS, so a
         shard-holder can never complete a full-layer demand
-        (docs/sharding.md)."""
+        (docs/sharding.md).  ``version``: the delivered rollout version
+        — a VERSIONED pair is only credited by an ack carrying the
+        SAME tag (docs/swap.md: a stale unversioned copy can never
+        complete a swap job's demand), while an unversioned pair
+        accepts any verified delivery of the id (mirroring
+        ``satisfies``: a post-swap push job must not wedge on the
+        tag)."""
         finished: List[str] = []
         with self._lock:
             for job in self._jobs.values():
@@ -178,13 +199,31 @@ class JobManager:
                     continue
                 want = job.assignment.get(dest, {}).get(lid)
                 want_shard = getattr(want, "shard", "") if want else ""
+                want_version = getattr(want, "version", "") if want else ""
                 if not shard_covers(shard, want_shard):
+                    continue
+                if want_version and version != want_version:
                     continue
                 job.remaining.discard((dest, lid))
                 if not job.remaining:
                     job.state = DONE
                     finished.append(job.job_id)
         return finished
+
+    def cancel(self, job_id: str) -> bool:
+        """Cancel an active job (a swap abort, docs/swap.md): its
+        undelivered pairs move to ``dropped_pairs`` — the job completes
+        VISIBLY degraded — and the merged goal shrinks at the next
+        recompute.  Returns whether the call changed anything."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.state != ACTIVE:
+                return False
+            job.dropped_pairs += len(job.remaining)
+            job.remaining = set()
+            job.state = DONE
+            job.cancelled = True
+            return True
 
     def drop_dest(self, dest: NodeID) -> Tuple[List[str], List[str]]:
         """A dest was declared crashed: its pairs can never land.  Drop
@@ -293,6 +332,9 @@ class JobManager:
                 "ResolvedAtAdmit": job.resolved_at_admit,
                 "DroppedPairs": job.dropped_pairs,
                 "AdmitMs": job.admit_ms,
+                "Version": job.version,
+                "SwapBase": job.swap_base,
+                "Cancelled": job.cancelled,
             }
 
     def to_json(self) -> Dict[str, dict]:
@@ -318,6 +360,9 @@ class JobManager:
             resolved_at_admit=int(rec.get("ResolvedAtAdmit", 0)),
             dropped_pairs=int(rec.get("DroppedPairs", 0)),
             admit_ms=float(rec.get("AdmitMs", 0.0)),
+            version=str(rec.get("Version", "")),
+            swap_base=int(rec.get("SwapBase", -1)),
+            cancelled=bool(rec.get("Cancelled", False)),
         )
 
     def load(self, records: Dict[str, dict]) -> None:

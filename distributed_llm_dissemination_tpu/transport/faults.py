@@ -74,6 +74,7 @@ class FaultRule:
     times: int = 0  # ...at most this many times (0 = unlimited)
     # Action parameters.
     delay_s: float = 0.0  # "delay"
+    rate: int = 0  # "slow": bytes/second for the injected link limit
     flip_at: int = 0  # "corrupt": byte index within the fragment
     flip_mask: int = 0xFF  # "corrupt": XOR mask (non-zero)
     # Time schedule ("partition"/"kill"): seconds since transport
@@ -127,6 +128,11 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
     - ``kill_after=T``: hard-stop this node's transport T seconds after
       construction (sends raise ``ConnectionError``, inbound vanishes)
       — the deterministic leader-kill switch
+    - ``slow=RATE[@P]``: rate-limit this node's outbound LAYER sends to
+      peer P (all peers when omitted) to RATE bytes/second via a token
+      bucket — the deterministic straggler-link injection the live-swap
+      chaos case needs (a replica whose v2 staging lags the fleet while
+      v1 keeps serving, docs/swap.md)
 
     e.g. ``seed=7,corrupt=9,dropin=13,dup=11,times=8``.  Returns
     ``(seed, rules)`` — hand both to ``FaultyTransport``."""
@@ -155,6 +161,14 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
         if key == "kill_after":
             pending.append(lambda sd, tm, t=float(val):
                            FaultRule("kill", "out", t_start=t))
+            continue
+        if key == "slow":
+            rate_s, _, peer = val.partition("@")
+            pending.append(lambda sd, tm, r=int(rate_s),
+                           p=(int(peer) if peer else None):
+                           FaultRule("slow", "out",
+                                     msg_type=MsgType.LAYER,
+                                     dest=p, rate=r))
             continue
         if key == "resetany":
             n = int(val)
@@ -203,13 +217,20 @@ class FaultyTransport(Transport):
     receivers wire their hooks through this wrapper unchanged."""
 
     def __init__(self, inner: Transport, rules=(), seed: int = 0):
+        from ..utils.rate import TokenBucket
+
         self.inner = inner
-        self.rules: List[FaultRule] = [r for r in rules
-                                       if r.kind not in ("partition", "kill")]
+        self.rules: List[FaultRule] = [
+            r for r in rules
+            if r.kind not in ("partition", "kill", "slow")]
         self.seed = seed
         self._lock = threading.Lock()
         self.stats = {"corrupt": 0, "drop": 0, "dup": 0, "delay": 0,
-                      "reset": 0, "partition": 0, "kill": 0}
+                      "reset": 0, "partition": 0, "kill": 0, "slow": 0}
+        # slow=RATE@P: a persistent per-link rate limit (token bucket),
+        # not an every-Nth rule — the injected straggler link.
+        self._slow = [(r.dest, TokenBucket(r.rate)) for r in rules
+                      if r.kind == "slow" and r.rate > 0]
         self._q: "queue.Queue[Message]" = queue.Queue()
         self._stop = threading.Event()
         # Time-scheduled faults (docs/failover.md): the clock starts NOW,
@@ -369,6 +390,13 @@ class FaultyTransport(Transport):
                           dest=dest_id)
         if rule is not None:
             time.sleep(rule.delay_s)
+        if self._slow and isinstance(message, LayerMsg):
+            size = getattr(message.layer_src, "data_size", 0)
+            for peer, bucket in self._slow:
+                if peer is None or peer == dest_id:
+                    with self._lock:
+                        self.stats["slow"] += 1
+                    bucket.wait_n(size)
         self.inner.send(dest_id, message)
         if self._fire("dup", "out", mtype, layer=layer, seq=seq,
                       dest=dest_id) is not None:

@@ -109,6 +109,21 @@ class MsgType(enum.IntEnum):
     # leader answers — and answers `-jobs` queries — with the admitted
     # job table (states, remaining pairs, drop counts).  Omitted-field
     # wire-compatible like every extension.
+    # SWAP_COMMIT — zero-downtime weight swap (docs/swap.md): the
+    # epoch-fenced commit fence of a ``kind="swap"`` job.  Once a
+    # replica's full v2 layer set is digest-verified (every versioned
+    # ack landed), the leader tells each serving node to atomically
+    # flip its serving params to the staged v2 set; the node confirms
+    # with ``applied=True``, re-requests a fence it suspects it missed
+    # with ``query=True``, and reports an unrecoverable staging failure
+    # (digest retries exhausted) via ``error`` — which aborts the swap
+    # cluster-wide (``abort=True``: keep serving v1, release staged v2).
+    # JOB_REVOKE — preemption revoke (docs/service.md): when a newly
+    # admitted higher-priority job demotes a lower tier at the re-plan,
+    # the leader revokes that job's not-yet-started queued sends at
+    # each sender — the sender drops the pending (job, dest, layer)
+    # pairs (counted on ``jobs.revoked_pairs``) instead of burning the
+    # reclaimed link budget on superseded commands.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -126,6 +141,8 @@ class MsgType(enum.IntEnum):
     TIME_SYNC = 22
     JOB_SUBMIT = 23
     JOB_STATUS = 24
+    SWAP_COMMIT = 25
+    JOB_REVOKE = 26
 
 
 def _epoch_to_payload(payload: dict, epoch: int) -> dict:
@@ -199,12 +216,20 @@ class AckMsg:
     whose target was a byte-range slice acks at SHARD coverage, and the
     leader records the holding as partial (a shard-holder never
     satisfies a full-layer demand).  "" = whole layer, omitted on the
-    wire (legacy format unchanged)."""
+    wire (legacy format unchanged).
+
+    ``version`` (docs/swap.md): the rollout version the delivered
+    layer was stamped with (``LayerDigestsMsg.versions``) — the leader
+    records the holding version-qualified, so a v2 swap pair is only
+    ever completed by bytes verified under v2, and the swap commit
+    fence knows exactly when a replica's v2 set is whole.  "" =
+    unversioned (every pre-swap ack), omitted on the wire."""
 
     src_id: NodeID
     layer_id: LayerID
     location: LayerLocation = LayerLocation.INMEM
     shard: str = ""
+    version: str = ""
 
     msg_type = MsgType.ACK
 
@@ -216,6 +241,8 @@ class AckMsg:
         }
         if self.shard:
             payload["Shard"] = str(self.shard)
+        if self.version:
+            payload["Version"] = str(self.version)
         return payload
 
     @classmethod
@@ -225,6 +252,7 @@ class AckMsg:
             layer_id=int(d["LayerID"]),
             location=LayerLocation(d.get("Location", 0)),
             shard=str(d.get("Shard", "")),
+            version=str(d.get("Version", "")),
         )
 
 
@@ -816,14 +844,21 @@ class LayerDigestsMsg:
       the layer's bytes; absent, the shard verifies by per-fragment
       CRC alone (honest limit, docs/sharding.md).
 
-    Both omitted-at-default: an unsharded run's stamp is byte-identical
-    to the legacy format."""
+    Versioned rollout targets (docs/swap.md) ride the stamp the same
+    way: ``versions`` — ``{layer_id: version}`` — tells the dest which
+    rollout version each assigned layer belongs to, so its ack (and
+    its stored holding) carries the tag and the leader's swap fence
+    can tell a v2 delivery from a stale copy under the same id.
+
+    All omitted-at-default: an unsharded, unversioned run's stamp is
+    byte-identical to the legacy format."""
 
     src_id: NodeID
     digests: dict  # {layer_id: hex digest}
     epoch: int = -1
     shards: dict = dataclasses.field(default_factory=dict)
     range_digests: dict = dataclasses.field(default_factory=dict)
+    versions: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.LAYER_DIGESTS
 
@@ -838,6 +873,9 @@ class LayerDigestsMsg:
             payload["RangeDigests"] = {
                 str(lid): str(h)
                 for lid, h in self.range_digests.items()}
+        if self.versions:
+            payload["Versions"] = {str(lid): str(v)
+                                   for lid, v in self.versions.items()}
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -849,7 +887,9 @@ class LayerDigestsMsg:
                    {int(lid): str(s)
                     for lid, s in (d.get("Shards") or {}).items()},
                    {int(lid): str(h)
-                    for lid, h in (d.get("RangeDigests") or {}).items()})
+                    for lid, h in (d.get("RangeDigests") or {}).items()},
+                   {int(lid): str(v)
+                    for lid, v in (d.get("Versions") or {}).items()})
 
 
 @dataclasses.dataclass
@@ -1050,7 +1090,18 @@ class JobSubmitMsg:
     optionally names each layer's content stamp (``xxh3:<hex>``) so the
     content-addressed store ships only layers whose digest changed.
     Idempotent per ``job_id``: a retried submit returns the existing
-    job's status.  The leader answers with a ``JobStatusMsg``."""
+    job's status.  The leader answers with a ``JobStatusMsg``.
+
+    ``version``/``swap_base`` (docs/swap.md): a ``kind="swap"`` job
+    names the rollout version it delivers and the blob-id base of the
+    v2 set — v2 blob ``swap_base + slot`` carries model slot ``slot``,
+    so the commit-time flip can map staged ids back to model blobs.
+
+    ``auth`` (docs/service.md, admission control): the shared-secret
+    job token.  A leader started with ``DLD_JOB_TOKEN`` set rejects
+    (and counts) any submit whose token does not constant-time-compare
+    equal; omitted on the wire when empty, so open clusters keep the
+    legacy format."""
 
     src_id: NodeID
     job_id: str
@@ -1060,6 +1111,9 @@ class JobSubmitMsg:
     digests: dict = dataclasses.field(default_factory=dict)
     avoid: list = dataclasses.field(default_factory=list)
     epoch: int = -1
+    version: str = ""
+    swap_base: int = -1
+    auth: str = ""
 
     msg_type = MsgType.JOB_SUBMIT
 
@@ -1079,6 +1133,12 @@ class JobSubmitMsg:
                                   for l, d in self.digests.items()}
         if self.avoid:
             payload["Avoid"] = [int(n) for n in self.avoid]
+        if self.version:
+            payload["Version"] = str(self.version)
+        if self.swap_base >= 0:
+            payload["SwapBase"] = int(self.swap_base)
+        if self.auth:
+            payload["Auth"] = str(self.auth)
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -1093,6 +1153,9 @@ class JobSubmitMsg:
             {int(l): str(h) for l, h in (d.get("Digests") or {}).items()},
             [int(n) for n in d.get("Avoid") or []],
             int(d.get("Epoch", -1)),
+            str(d.get("Version", "")),
+            int(d.get("SwapBase", -1)),
+            str(d.get("Auth", "")),
         )
 
 
@@ -1135,6 +1198,117 @@ class JobStatusMsg:
         )
 
 
+@dataclasses.dataclass
+class SwapCommitMsg:
+    """The zero-downtime weight-swap fence (docs/swap.md) — one message
+    type, four protocol roles, disambiguated by its flags:
+
+    - **commit** (leader → serving node; no flags): every v2 layer of
+      ``version`` verified on every replica — atomically flip the
+      serving params to the staged v2 set (mapped ``blob = id -
+      swap_base``) between decode steps, then release v1.  The leader
+      re-sends an unconfirmed commit on a bounded watchdog, so a lost
+      fence is re-delivered instead of leaving one node serving v1.
+    - **prepare** (leader → serving node; ``prepare=True``, sent at
+      swap-job admission): the version + blob mapping announcement —
+      the node stages each v2 layer the moment it verifies, so the
+      decode/device work overlaps the rollout's remaining transfers
+      and the later flip is (headroom permitting) a pure pointer swap.
+      Advisory: a lost prepare only costs the overlap — the commit
+      carries the same mapping.
+    - **abort** (leader → serving node; ``abort=True``): the rollout
+      failed (digest mismatch, dest crash) — do NOT flip; release the
+      staged v2 set and keep serving v1 uninterrupted.
+    - **confirm** (node → leader; ``applied=True``): the flip (or the
+      abort release) completed on this node.
+    - **query** (node → leader; ``query=True``): this node staged its
+      full v2 set but never saw the fence (it suspects a lost commit)
+      — the leader answers with the operative commit/abort, so a node
+      that missed the fence re-requests it instead of serving a stale
+      version indefinitely.
+
+    ``error`` (node → leader): an unrecoverable v2 staging failure
+    (digest retry budget exhausted) — the leader aborts the swap.
+    ``epoch``: leader fencing epoch (docs/failover.md); a promoted
+    standby re-drives an adopted swap at its bumped epoch."""
+
+    src_id: NodeID
+    version: str
+    swap_base: int = -1
+    abort: bool = False
+    query: bool = False
+    applied: bool = False
+    prepare: bool = False
+    error: str = ""
+    epoch: int = -1
+
+    msg_type = MsgType.SWAP_COMMIT
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id,
+                         "Version": str(self.version)}
+        if self.swap_base >= 0:
+            payload["SwapBase"] = int(self.swap_base)
+        if self.abort:
+            payload["Abort"] = True
+        if self.query:
+            payload["Query"] = True
+        if self.applied:
+            payload["Applied"] = True
+        if self.prepare:
+            payload["Prepare"] = True
+        if self.error:
+            payload["Error"] = str(self.error)
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "SwapCommitMsg":
+        return cls(
+            int(d["SrcID"]),
+            str(d["Version"]),
+            int(d.get("SwapBase", -1)),
+            bool(d.get("Abort", False)),
+            bool(d.get("Query", False)),
+            bool(d.get("Applied", False)),
+            bool(d.get("Prepare", False)),
+            str(d.get("Error", "")),
+            int(d.get("Epoch", -1)),
+        )
+
+
+@dataclasses.dataclass
+class JobRevokeMsg:
+    """Leader → sender: a re-plan demoted a lower priority tier — drop
+    the named job's queued-but-not-yet-started sends to these (dest,
+    layer) pairs (docs/service.md).  Best-effort and advisory: a send
+    already completed simply ignores the revocation (the registry entry
+    is consumed on first match and TTL-bounded), and a send wrongly
+    dropped is re-planned by the very re-plan that triggered the
+    revoke.  Dropped pairs count on ``jobs.revoked_pairs``."""
+
+    src_id: NodeID
+    job_id: str
+    pairs: list = dataclasses.field(default_factory=list)  # [[dest, layer]]
+    epoch: int = -1
+
+    msg_type = MsgType.JOB_REVOKE
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id, "JobID": str(self.job_id)}
+        if self.pairs:
+            payload["Pairs"] = [[int(d), int(l)] for d, l in self.pairs]
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "JobRevokeMsg":
+        return cls(
+            int(d["SrcID"]),
+            str(d["JobID"]),
+            [[int(p[0]), int(p[1])] for p in d.get("Pairs") or []],
+            int(d.get("Epoch", -1)),
+        )
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -1158,6 +1332,8 @@ Message = Union[
     TimeSyncMsg,
     JobSubmitMsg,
     JobStatusMsg,
+    SwapCommitMsg,
+    JobRevokeMsg,
 ]
 
 _DECODERS = {
@@ -1185,6 +1361,8 @@ _DECODERS = {
     MsgType.TIME_SYNC: TimeSyncMsg,
     MsgType.JOB_SUBMIT: JobSubmitMsg,
     MsgType.JOB_STATUS: JobStatusMsg,
+    MsgType.SWAP_COMMIT: SwapCommitMsg,
+    MsgType.JOB_REVOKE: JobRevokeMsg,
 }
 
 

@@ -35,6 +35,7 @@ from distributed_llm_dissemination_tpu.transport.messages import (
     GenerateReqMsg,
     GenerateRespMsg,
     HeartbeatMsg,
+    JobRevokeMsg,
     JobStatusMsg,
     JobSubmitMsg,
     LayerDigestsMsg,
@@ -49,6 +50,7 @@ from distributed_llm_dissemination_tpu.transport.messages import (
     SimpleMsg,
     SourceDeadMsg,
     StartupMsg,
+    SwapCommitMsg,
     TimeSyncMsg,
     decode_msg,
 )
@@ -99,26 +101,35 @@ CASES = {
         lambda: JobSubmitMsg(1, "j1", {2: {7: LayerMeta()}}),
         {"SrcID", "JobID"}),
     MsgType.JOB_STATUS: (lambda: JobStatusMsg(1), {"SrcID"}),
+    MsgType.SWAP_COMMIT: (
+        lambda: SwapCommitMsg(1, "v2"), {"SrcID", "Version"}),
+    MsgType.JOB_REVOKE: (
+        lambda: JobRevokeMsg(1, "j1"), {"SrcID", "JobID"}),
 }
 
 # Optional wire keys that must be OMITTED at their defaults, per type:
 # the extension fields layered onto the legacy formats over PRs 2-7.
 OMITTED_AT_DEFAULT = {
     MsgType.ANNOUNCE: {"Partial", "Digests"},
-    MsgType.ACK: {"Shard"},
+    MsgType.ACK: {"Shard", "Version"},
     MsgType.RETRANSMIT: {"Epoch", "Job", "Shard"},
     MsgType.FLOW_RETRANSMIT: {"Epoch", "Job"},
     MsgType.STARTUP: {"Epoch"},
     MsgType.DEVICE_PLAN: {"Epoch", "BatchID", "BatchN"},
     MsgType.SERVE: {"Epoch"},
     MsgType.BOOT_HINT: {"Epoch"},
-    MsgType.LAYER_DIGESTS: {"Epoch", "Shards", "RangeDigests"},
+    MsgType.LAYER_DIGESTS: {"Epoch", "Shards", "RangeDigests",
+                            "Versions"},
     MsgType.SOURCE_DEAD: {"Epoch"},
     MsgType.METRICS_REPORT: {"Epoch", "Counters", "Gauges", "Links",
                              "T", "Proc"},
     MsgType.TIME_SYNC: {"T1", "Reply"},
-    MsgType.JOB_SUBMIT: {"Epoch", "Priority", "Kind", "Digests", "Avoid"},
+    MsgType.JOB_SUBMIT: {"Epoch", "Priority", "Kind", "Digests", "Avoid",
+                         "Version", "SwapBase", "Auth"},
     MsgType.JOB_STATUS: {"Epoch", "Query", "Jobs", "Error"},
+    MsgType.SWAP_COMMIT: {"Epoch", "SwapBase", "Abort", "Query",
+                          "Applied", "Prepare", "Error"},
+    MsgType.JOB_REVOKE: {"Epoch", "Pairs"},
 }
 
 
@@ -217,3 +228,45 @@ def test_shard_fields_interop_with_unsharded_peers():
         old = decode_msg(msg.msg_type, stripped)
         assert getattr(old, "shard", "") == ""
         assert getattr(old, "shards", {}) in ({}, None) or old.shards == {}
+
+
+def test_version_fields_interop_with_preswap_peers():
+    """The live-swap extension (docs/swap.md) must keep a pre-swap
+    cluster interoperable: every Version field is omitted at default
+    (asserted type-by-type above), the nested LayerMeta codec omits
+    ``Version`` when empty, and versioned instances round-trip through
+    real JSON while a stripped (legacy-peer) payload decodes to the
+    unversioned reading."""
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AckMsg as _Ack,
+        JobSubmitMsg as _Submit,
+        LayerDigestsMsg as _Digests,
+    )
+
+    # LayerMeta: the Assignment/status/announce nested codec.
+    assert "Version" not in LayerMeta().to_json()
+    m = LayerMeta(data_size=64, version="v2")
+    assert LayerMeta.from_json(json.loads(json.dumps(m.to_json()))) == m
+    legacy = {k: v for k, v in m.to_json().items() if k != "Version"}
+    assert LayerMeta.from_json(legacy).version == ""
+
+    for msg in (
+        _Ack(1, 7, version="v2"),
+        _Digests(1, {7: "xxh3:ab"}, versions={7: "v2"}),
+        _Submit(1, "swap-v2", {2: {7: LayerMeta(version="v2")}},
+                kind="swap", version="v2", swap_base=1000,
+                auth="secret"),
+        SwapCommitMsg(1, "v2", swap_base=1000, prepare=True),
+        SwapCommitMsg(1, "v2", abort=True, error="boom"),
+        JobRevokeMsg(1, "j-lo", pairs=[[2, 7], [3, 8]], epoch=4),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        assert decode_msg(msg.msg_type, wire) == msg
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("Version", "Versions", "SwapBase",
+                                 "Auth")}
+        if msg.msg_type is MsgType.SWAP_COMMIT:
+            continue  # Version is REQUIRED on the fence itself
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "version", "") == ""
+        assert getattr(old, "versions", {}) == {}
