@@ -171,29 +171,58 @@ def validate_boot_choice(args, conf) -> None:
         )
 
 
+def _resolve_model_config(name: str):
+    """THE model-name resolution (CONFIGS entry or ``hf:<dir>``) —
+    shared by the boot path and the wire-codec plane so a new naming
+    scheme can't silently reach one and miss the other.  Raises
+    KeyError/OSError/ValueError for unresolvable names; callers own the
+    error policy (boot fails fast, the codec plane degrades to None)."""
+    from ..models import hf
+
+    if hf.is_hf(name):
+        # A Hugging Face Llama checkpoint directory (models/hf.py).
+        return hf.config_from_name(name)
+    from ..models.llama import CONFIGS
+
+    return CONFIGS[name]
+
+
 def boot_config(name: str):
     if not name or name == "none":
         # "-boot none" opts a boot-capable topology (a Model section) out
         # of booting: dissemination-only runs, e.g. wire benchmarks.
         return None
-    from ..models import hf
-
-    if hf.is_hf(name):
-        # A Hugging Face Llama checkpoint directory (models/hf.py): the
-        # booted engine runs the actual checkpoint's weights.
-        try:
-            return hf.config_from_name(name)
-        except (OSError, ValueError, KeyError) as e:
-            raise SystemExit(f"bad hf checkpoint for -boot {name!r}: {e}")
-    from ..models.llama import CONFIGS
-
     try:
-        return CONFIGS[name]
+        return _resolve_model_config(name)
     except KeyError:
+        from ..models.llama import CONFIGS
+
         raise SystemExit(
             f"unknown -boot model {name!r}; known: {sorted(CONFIGS)}, "
             "none, hf:<checkpoint-dir>"
         )
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"bad hf checkpoint for -boot {name!r}: {e}")
+
+
+def build_codec_plane(conf: cfg.Config):
+    """The node's wire-codec plane (docs/codec.md): built for every
+    role of a model run — leaders use it to CHOOSE quantized transfers
+    (conf.wire_codec governs), receivers to advertise decode capability
+    and encode-serve as senders.  None for model-less topologies (codec
+    sizes derive from the blob layouts)."""
+    if not conf.model:
+        return None
+    from ..runtime.codec import WireCodecPlane
+
+    try:
+        mcfg = _resolve_model_config(conf.model)
+    except (OSError, ValueError, KeyError) as e:
+        ulog.log.warn("wire-codec plane unavailable for this model",
+                      model=conf.model, err=repr(e))
+        return None
+    return WireCodecPlane(mcfg, model_codec=conf.model_codec,
+                          wire_codec=conf.wire_codec)
 
 
 def _parse_job_spec(raw: str) -> dict:
@@ -346,7 +375,8 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
             LeaderNode.PLAN_WATCH_PERIOD,
             LeaderNode.PLAN_ACK_TIMEOUT / 2 or 1.0)
     common = dict(expected_nodes=expected, failure_timeout=ft,
-                  fabric=fabric, placement=placement)
+                  fabric=fabric, placement=placement,
+                  codecs=build_codec_plane(conf))
     if conf.standbys:
         # Control-plane HA (docs/failover.md): replicate control state
         # to the declared standbys, beacon the lease, fence by epoch.
@@ -599,7 +629,8 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
     codec = conf.model_codec
     common = dict(heartbeat_interval=args.hb, stage_hbm=args.hbm,
                   placement=placement, boot_cfg=boot_cfg, boot_codec=codec,
-                  fabric=fabric, boot_generate=args.gen)
+                  fabric=fabric, boot_generate=args.gen,
+                  codecs=build_codec_plane(conf))
     if args.m == 0:
         receiver = ReceiverNode(node, layers, args.s or ".", **common)
     elif args.m in (1, 2):

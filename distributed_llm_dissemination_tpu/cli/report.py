@@ -171,6 +171,15 @@ def build_from_leader(leader, ttd_s: Optional[float] = None,
     if table:
         extra = dict(extra or {})
         extra.setdefault("jobs", table)
+    # Per-dest wire-vs-decoded byte columns (docs/codec.md): the link
+    # table reconciles against WIRE bytes; the decoded side is its own
+    # column, never conflated.
+    dest_fn = getattr(leader, "dest_bytes_table", None)
+    if dest_fn is not None:
+        dests = dest_fn()
+        if dests:
+            extra = dict(extra or {})
+            extra.setdefault("dests", dests)
     return build(
         leader.cluster_telemetry(), ttd_s=ttd_s, ttft_s=ttft_s,
         predicted_s=(pred_ms / 1000.0) if pred_ms else None,
@@ -287,6 +296,27 @@ def render_md(report: dict) -> str:
                 f"| {_fmt(row.get('crc_drops', 0))} "
                 f"| {_fmt(row.get('nacks', 0))} "
                 f"| {_fmt(row.get('retransmit_bytes', 0))} |")
+        lines.append("")
+    dests = report.get("dests") or {}
+    if dests:
+        lines += [
+            "## Per-dest wire vs decoded bytes (docs/codec.md)",
+            "",
+            "`wire` is what crossed the network for each delivered "
+            "pair (the ENCODED size for quantized transfers — the "
+            "column the link table reconciles against); `decoded` is "
+            "what the dest materializes.  Two columns on purpose: the "
+            "two are never conflated.",
+            "",
+            "| dest | wire bytes | decoded bytes | layers (quantized) |",
+            "|---|---|---|---|",
+        ]
+        for dest, row in sorted(dests.items(), key=lambda kv: kv[0]):
+            lines.append(
+                f"| {dest} | {_fmt(row.get('wire_bytes'))} "
+                f"| {_fmt(row.get('decoded_bytes'))} "
+                f"| {_fmt(row.get('layers'))} "
+                f"({_fmt(row.get('codec_layers', 0))}) |")
         lines.append("")
     jobs = report.get("jobs") or {}
     job_links = report.get("job_links") or {}

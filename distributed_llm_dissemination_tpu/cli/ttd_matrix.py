@@ -422,6 +422,96 @@ def run_codec_ab(trials: int, rate: int = 4 << 20, mode: int = 3,
     return out
 
 
+def _codec_wire_variant(src_path: str, out_path: str, wire_codec: str,
+                        rate: int) -> None:
+    """boot_tiny_4node retargeted at tiny2 with RAW canonical blobs and
+    every in-RAM source rate-limited — the rate-limited BASELINE the
+    negotiated wire codec exists for.  ``wire_codec`` "" leaves the
+    run canonical (the A side)."""
+    def mutate(conf):
+        conf["Model"] = "tiny2"
+        if wire_codec:
+            conf["WireCodec"] = wire_codec
+        for n in conf["Nodes"]:
+            n["Sources"] = {"2": rate}
+
+    _localize_config(src_path, out_path, mutate=mutate)
+
+
+def run_codec_wire(trials: int, rate: int = 4 << 20, mode: int = 3,
+                   timeout: float = 240.0) -> dict:
+    """The NEGOTIATED wire-codec row (docs/codec.md): the same
+    raw-canonical tiny2 topology disseminated with and without
+    ``WireCodec: int8`` at a fixed slow source rate.  Unlike
+    ``run_codec_ab`` (which re-fabricates the whole run's blobs in the
+    codec), here the SEEDERS HOLD RAW BYTES and the leader chooses the
+    encoded form per transfer — encode-on-send, decode-at-staging,
+    codec-qualified digests — so the TTD ratio measures the negotiated
+    plane end to end.  The RUN_REPORT's per-dest table cross-checks the
+    wire bytes against ``quant.blob_nbytes_codec`` exactly."""
+    from ..models import quant
+    from ..models.llama import CONFIGS
+
+    mcfg = CONFIGS["tiny2"]
+    blob_ids = list(range(5))  # boot_tiny_4node assigns blobs 0-4
+    raw_bytes = sum(quant.blob_nbytes_codec(mcfg, b, "raw")
+                    for b in blob_ids)
+    int8_bytes = sum(quant.blob_nbytes_codec(mcfg, b, "int8")
+                     for b in blob_ids)
+    out: dict = {"rate_bytes_per_s": rate, "mode": mode, "model": "tiny2",
+                 "raw_bytes_per_dest": raw_bytes,
+                 "int8_bytes_per_dest": int8_bytes,
+                 "ratio": round(raw_bytes / int8_bytes, 4)}
+    env = _cpu_env()
+    with tempfile.TemporaryDirectory() as td:
+        for label, wire in (("raw_wire", ""), ("int8_wire", "int8")):
+            path = os.path.join(td, f"wire_{label}.json")
+            _codec_wire_variant(
+                os.path.join(CONF_DIR, "boot_tiny_4node.json"),
+                path, wire, rate)
+            report = os.path.join(td, f"report_{label}")
+            ts = []
+            for k in range(trials):
+                extra = ["-boot", "none"]
+                if k == 0:
+                    extra += ["-report", report]
+                ts.append(run_once(path, mode, timeout, env=env,
+                                   extra_args=tuple(extra)))
+            row = {"ttd_s": round(statistics.median(ts), 4),
+                   "all": [round(t, 4) for t in ts]}
+            try:
+                with open(report + ".json") as f:
+                    rep = json.load(f)
+                row["dests"] = rep.get("dests") or {}
+                row["codec_counters"] = {
+                    k: v for k, v in (rep.get("counters") or {}).items()
+                    if k.startswith("codec.")}
+                row["provenance"] = rep.get("provenance", "")
+            except (OSError, ValueError):
+                row["dests"] = {}
+            ts_str = row["ttd_s"]
+            print(f"codec_wire {label}: TTD {ts_str}s",
+                  file=sys.stderr, flush=True)
+            out[label] = row
+    out["int8_vs_raw"] = round(
+        out["int8_wire"]["ttd_s"] / max(out["raw_wire"]["ttd_s"], 1e-9), 3)
+    # The acceptance cross-check: every dest's delivered wire bytes
+    # must be EXACTLY the blob_nbytes_codec sums (int8 run), and the
+    # TTD must drop ~proportionally to the compression ratio.
+    dests = out["int8_wire"].get("dests") or {}
+    out["wire_bytes_exact"] = bool(dests) and all(
+        row.get("wire_bytes") == int8_bytes for row in dests.values())
+    expect = 1.0 / out["ratio"]
+    out["bound"] = {
+        "expected_ttd_fraction": round(expect, 4),
+        # The transport's reference-parity 256 KiB burst bucket gives
+        # each job a free head start at these ~1-2 MiB layers, so allow
+        # a generous margin above the pure size ratio.
+        "met": out["int8_vs_raw"] <= expect * 1.35 + 0.05,
+    }
+    return out
+
+
 # The driver-provided BASELINE.json scenarios (#2-#5), materialized by
 # cli.genconf: (config file, the modes to record).  The 64-node row runs
 # ALL FOUR modes so the mode-3 solver is exercised — and its solve time
@@ -2068,6 +2158,67 @@ def to_markdown(results: dict) -> str:
             lines.append(
                 f"| int4 | {ab['int4']['ttd_s']}s | {ab['int4_vs_raw']} |")
         lines.append("")
+    cw = results.get("codec_wire")
+    if cw:
+        dests = (cw.get("int8_wire") or {}).get("dests") or {}
+        exact = ("byte-exact" if cw.get("wire_bytes_exact")
+                 else "NOT byte-exact")
+        lines += [
+            "## Negotiated wire codec (docs/codec.md)",
+            "",
+            "Same rate-limited tiny2 topology, but the seeders hold RAW "
+            "canonical blobs and the leader negotiates the wire form "
+            "per transfer (`WireCodec: int8`): encode-on-send at the "
+            "seeder, decode-at-staging at the dest, codec-qualified "
+            "digests/acks, and the flow solver sizing each pair by its "
+            "ENCODED bytes (effective capacity = bandwidth x ratio).  "
+            f"Wire bytes per dest (RUN_REPORT `dests` table): {exact} "
+            f"against `quant.blob_nbytes_codec` "
+            f"({cw.get('int8_bytes_per_dest')} B int8 vs "
+            f"{cw.get('raw_bytes_per_dest')} B raw, ratio "
+            f"{cw.get('ratio')}x).",
+            "",
+            "| wire | TTD | vs raw | bound (≤ ~1/ratio + burst margin) |",
+            "|---|---|---|---|",
+            f"| raw | {cw['raw_wire']['ttd_s']}s | | |",
+            f"| int8 | {cw['int8_wire']['ttd_s']}s "
+            f"| {cw['int8_vs_raw']} "
+            f"| {'MET' if cw['bound']['met'] else 'NOT MET'} "
+            f"(expected ≲ {cw['bound']['expected_ttd_fraction']}) |",
+            "",
+        ]
+        if dests:
+            lines += ["Per-dest wire vs decoded bytes (int8 run):", ""]
+            for dest, row in sorted(dests.items()):
+                lines.append(
+                    f"- dest {dest}: wire {row.get('wire_bytes')} B, "
+                    f"decoded {row.get('decoded_bytes')} B "
+                    f"({row.get('codec_layers')}/{row.get('layers')} "
+                    "layers quantized)")
+            lines.append("")
+    cb = results.get("codec_bench")
+    if cb:
+        lines += [
+            "## Wire-codec micro-bench (encode/decode GB/s on this host)",
+            "",
+            "`quant.codec_bench` over one tiny2 layer blob "
+            f"({cb.get('raw_bytes', 0)} B raw); rates are RAW bytes "
+            "per second (the side the wire saves).  The codec-choice "
+            "threshold `DLD_CODEC_MIN_RATE` should sit well below the "
+            "slowest of these — a link faster than the codec pass "
+            "gains nothing from quantized shipping.",
+            "",
+            "| codec | ratio | encode | host decode | device decode |",
+            "|---|---|---|---|---|",
+        ]
+        for codec in ("int8", "int4"):
+            row = cb.get(codec) or {}
+            lines.append(
+                f"| {codec} | {row.get('ratio')}x "
+                f"| {row.get('encode_gbps')} GB/s "
+                f"| {row.get('decode_host_gbps')} GB/s "
+                f"| {row.get('decode_device_gbps')} GB/s |")
+        lines.append("")
     phys = results.get("physical")
     if phys:
         lines += [
@@ -2584,6 +2735,13 @@ def main(argv=None) -> int:
                         "full-layer vs 1/4-shard comparison — wire "
                         "bytes per dest, TTD, predicted-vs-achieved, "
                         "and the post-gather digest check")
+    p.add_argument("-codec-wire", action="store_true",
+                   help="also measure the NEGOTIATED wire codec "
+                        "(docs/codec.md): raw-canonical seeders, "
+                        "leader-chosen int8 wire over a rate-limited "
+                        "topology — TTD vs raw, byte-exact wire "
+                        "accounting, plus the encode/decode "
+                        "micro-bench")
     args = p.parse_args(argv)
     if args.trace and not args.physical:
         p.error("-trace needs -physical (it traces that run)")
@@ -2724,6 +2882,15 @@ def main(argv=None) -> int:
         results["live_swap"] = run_live_swap()
     elif prior_doc and prior_doc.get("live_swap"):
         results["live_swap"] = prior_doc["live_swap"]
+    if args.codec_wire:
+        results["codec_wire"] = run_codec_wire(args.trials)
+        from ..models.quant import codec_bench
+
+        results["codec_bench"] = codec_bench()
+    else:
+        for key in ("codec_wire", "codec_bench"):
+            if prior_doc and prior_doc.get(key):
+                results[key] = prior_doc[key]
     # Regenerate the cache-reuse evidence from THIS run's records;
     # fall back to the prior document's (e.g. hand-recorded SPMD rows)
     # when the run produced none.

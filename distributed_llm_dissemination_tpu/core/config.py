@@ -210,6 +210,16 @@ class Config:
     # (models/quant.py); receivers dequantize after landing, on-device
     # when ingest staged to HBM.
     model_codec: str = "raw"
+    # NEGOTIATED per-transfer wire codec (docs/codec.md): when set, the
+    # leader may ship individual (dest, layer) transfers in this
+    # quantized form over SLOW links (bottleneck rate below
+    # DLD_CODEC_MIN_RATE) while fast links keep shipping canonical
+    # bytes — the flow solver sizes each pair by its encoded bytes, so
+    # a quantized copy's effective link capacity is
+    # bandwidth x (raw/encoded).  Requires ModelCodec == "raw" (the
+    # canonical form must be the raw dtype blob; double quantization is
+    # refused at parse time) and a Model (codec sizes derive from it).
+    wire_codec: str = "raw"
     # Control-plane HA (docs/failover.md): ordered leader-succession
     # list.  Non-empty arms state replication + lease fencing — the
     # leader streams control deltas to these nodes and beacons its
@@ -219,7 +229,7 @@ class Config:
 
     @classmethod
     def from_json(cls, d: dict) -> "Config":
-        return cls(
+        conf = cls(
             nodes=[NodeConf.from_json(n) for n in _jget(d, "Nodes") or []],
             clients=[ClientConf.from_json(c) for c in _jget(d, "Clients") or []],
             assignment=assignment_from_json(_jget(d, "Assignment") or {}),
@@ -230,8 +240,24 @@ class Config:
             model=_jget(d, "Model", "") or "",
             model_seed=int(_jget(d, "ModelSeed", 0)),
             model_codec=_validated_codec(_jget(d, "ModelCodec", "raw") or "raw"),
+            wire_codec=_validated_codec(_jget(d, "WireCodec", "raw") or "raw"),
             standbys=[int(s) for s in _jget(d, "Standbys") or []],
         )
+        if conf.wire_codec != "raw":
+            # Fail at PARSE time like an unknown codec: a wire codec
+            # re-encodes the CANONICAL blob, so the canonical form must
+            # be the raw dtype (double quantization silently degrades
+            # weights twice) and a model must name the blob layouts.
+            if conf.model_codec != "raw":
+                raise ValueError(
+                    f"WireCodec {conf.wire_codec!r} requires ModelCodec "
+                    f"'raw' (got {conf.model_codec!r}): wire codecs "
+                    "re-encode the canonical raw blobs per transfer")
+            if not conf.model:
+                raise ValueError(
+                    f"WireCodec {conf.wire_codec!r} requires a Model "
+                    "(encoded sizes derive from the blob layouts)")
+        return conf
 
 
 def _validated_codec(codec: str) -> str:

@@ -100,6 +100,31 @@ def shard_specs_for(n: int) -> List[ShardSpec]:
         return [""] if n == 1 else []
     return [f"1/{n}@{k}" for k in range(n)]
 
+
+# ---------------------------------------------------------------------------
+# Wire codecs (docs/codec.md)
+#
+# A transfer may ship a layer in a quantized wire form (``models/quant.py``:
+# int8 ~0.50x, int4 ~0.27x of the canonical bytes).  The codec is an
+# IDENTITY property of the bytes, not a transport detail: a holding tagged
+# ``codec="int8"`` holds the int8-encoded form — a different byte string
+# with a different digest — and can only ever satisfy (or re-seed) a target
+# planned at that same codec.  ``""`` = the canonical (raw) form.
+# ---------------------------------------------------------------------------
+
+WireCodec = str  # "" (canonical bytes) or "int8" / "int4"
+
+
+def codec_accepts(held: WireCodec, want: WireCodec) -> bool:
+    """Whether a holding in wire-codec form ``held`` satisfies a target
+    planned at codec ``want``.  Canonical bytes (``""``) satisfy every
+    target — raw is the lossless superset any codec can be derived from
+    — while a quantized holding satisfies ONLY a target planned at
+    exactly that codec: int8 bytes can never complete a raw (or int4)
+    demand, which is the "a quantized copy cannot ack as a raw one"
+    invariant (docs/codec.md)."""
+    return not held or held == want
+
 # Reference: distributor/node.go:132 — a set of node IDs.
 NodeIDs = Set[NodeID]
 
@@ -153,7 +178,15 @@ class LayerMeta:
     unversioned copy of a reused layer id can never complete a v2
     rollout pair); in a *status/announce* row, the version the holder
     verified the bytes under.  ``""`` = the pre-swap vocabulary (every
-    legacy peer); omitted-at-default on the wire."""
+    legacy peer); omitted-at-default on the wire.
+
+    ``codec`` (docs/codec.md): the wire-codec form of the bytes.  In an
+    *assignment*, the codec the leader CHOSE for this transfer (the
+    dest will receive — and is satisfied by — the encoded form); in a
+    *status/announce* row, the form the holder actually holds
+    (``data_size`` is then the ENCODED byte count — the bytes that
+    exist and can be range-served).  ``""`` = canonical bytes (every
+    pre-codec peer); omitted-at-default on the wire."""
 
     location: LayerLocation = LayerLocation.INMEM
     limit_rate: int = 0  # bytes/sec; 0 = unlimited
@@ -161,6 +194,7 @@ class LayerMeta:
     data_size: int = 0  # bytes; 0 = unknown
     shard: ShardSpec = ""  # "" = full layer
     version: str = ""  # "" = unversioned (pre-swap)
+    codec: WireCodec = ""  # "" = canonical bytes (pre-codec)
 
     def to_json(self) -> dict:
         out = {
@@ -173,6 +207,8 @@ class LayerMeta:
             out["Shard"] = str(self.shard)
         if self.version:
             out["Version"] = str(self.version)
+        if self.codec:
+            out["Codec"] = str(self.codec)
         return out
 
     @classmethod
@@ -184,6 +220,7 @@ class LayerMeta:
             data_size=int(d.get("DataSize", 0)),
             shard=str(d.get("Shard", "")),
             version=str(d.get("Version", "")),
+            codec=str(d.get("Codec", "")),
         )
 
 
@@ -354,7 +391,13 @@ def satisfies(held: Optional[LayerMeta], want: LayerMeta) -> bool:
     UNVERSIONED target ("" — every pre-swap job) accepts any verified
     holding of the id, versioned or not — a later push/repair job over
     already-swapped layer ids must not wedge on the tag (the digest
-    plane, not the tag, governs content)."""
+    plane, not the tag, governs content).
+
+    Codec semantics (docs/codec.md) are STRICT the other way: the
+    target's codec is the leader's chosen wire form for the pair, and a
+    quantized holding satisfies only that exact codec (canonical bytes
+    satisfy everything) — int8 bytes must never complete a raw demand."""
     return (held is not None and delivered(held)
             and shard_covers(held.shard, want.shard)
-            and (not want.version or held.version == want.version))
+            and (not want.version or held.version == want.version)
+            and codec_accepts(held.codec, want.codec))

@@ -45,7 +45,7 @@ receiver — derives identical blob sizes from (model, codec) alone.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -337,6 +337,82 @@ _decode_qblobs_donated = jax.jit(
     _decode_qblobs_impl, static_argnums=(1, 2), donate_argnums=(0,))
 _decode_q4blobs_donated = jax.jit(
     _decode_q4blobs_impl, static_argnums=(1, 2), donate_argnums=(0,))
+
+
+def decode_to_raw(cfg: ModelConfig, blob_id: int, data, codec: str) -> bytes:
+    """Re-materialize the CANONICAL raw blob bytes from a wire-codec
+    blob: host decode, then the leaves concatenated back in ``serde``'s
+    spec order (a raw blob IS exactly that concatenation).  The wire
+    receiver's normalization path (docs/codec.md): a holding delivered
+    as int8/int4 becomes servable to any raw consumer — at the
+    quantization error the operator opted into, not byte-identity with
+    the original."""
+    if codec == "raw":
+        return bytes(data)
+    decoded = decode_blob_host(cfg, blob_id, data, codec)
+    return b"".join(
+        np.ascontiguousarray(decoded[name]).tobytes()
+        for name, _ in _blob_specs(cfg, blob_id)
+    )
+
+
+def codec_bench(cfg: Optional[ModelConfig] = None, blob_id: int = 0,
+                device: bool = True) -> dict:
+    """Micro-bench the wire codecs on THIS host — the measured basis of
+    the codec-choice threshold (``DLD_CODEC_MIN_RATE``): a codec only
+    pays when the link is slower than the encode/decode path, and that
+    crossover is a property of the running container, not a guess.
+    Returns {codec: {encode_gbps, decode_host_gbps, decode_device_gbps,
+    ratio}} over one layer blob of ``cfg`` (default: the "tiny2" test
+    model); rates are raw-bytes-per-second (the side the wire saves).
+    ``device=False`` skips the jit decode (hosts without a warm XLA)."""
+    import time
+
+    if cfg is None:
+        from .llama import CONFIGS
+
+        cfg = CONFIGS["tiny2"]
+    from .serde import seeded_blob
+
+    raw = seeded_blob(cfg, blob_id, 0)
+
+    def rate(fn, nbytes: int) -> float:
+        fn()  # warm (jit compile / numpy allocator)
+        t0 = time.monotonic()
+        n = 0
+        while time.monotonic() - t0 < 0.2:
+            fn()
+            n += 1
+        dt = time.monotonic() - t0
+        return round(nbytes * n / max(dt, 1e-9) / 1e9, 3)
+
+    out: dict = {"raw_bytes": len(raw)}
+    for codec in ("int8", "int4"):
+        enc = encode_blob(cfg, blob_id, raw, codec)
+        row = {
+            "encoded_bytes": len(enc),
+            "ratio": round(len(raw) / len(enc), 3),
+            "encode_gbps": rate(
+                lambda c=codec: encode_blob(cfg, blob_id, raw, c),
+                len(raw)),
+            "decode_host_gbps": rate(
+                lambda c=codec, e=enc: decode_blob_host(cfg, blob_id, e, c),
+                len(raw)),
+            "decode_device_gbps": 0.0,
+        }
+        if device:
+            specs = tuple(layer_param_specs(cfg))
+            dt_name = np.dtype(cfg.dtype).name
+            arr = jnp.asarray(np.frombuffer(enc, np.uint8))
+            fn = device_decode_jit(codec)
+
+            def dev_decode(a=arr, s=specs, c=codec, f=fn):
+                leaves = f((a,), s, dt_name)
+                jax.block_until_ready(leaves)
+
+            row["decode_device_gbps"] = rate(dev_decode, len(raw))
+        out[codec] = row
+    return out
 
 
 def device_decode_jit(codec: str, donate: bool = False):

@@ -377,6 +377,35 @@ def boot_from_layers(
             verify_blob_digest(lid, layers[lid], digest_lookup,
                                digest_verified)
 
+    # Wire-codec holdings (docs/codec.md): a blob delivered under a
+    # NEGOTIATED per-transfer codec differs in form from the run codec
+    # the bulk decode paths below assume.  The streaming stager decodes
+    # those per-blob under their own codec (the fast path); any such
+    # blob that reaches the bulk/infill paths is normalized here to the
+    # canonical raw form on host (wire codecs require a raw-canonical
+    # run, core/config.py) so every downstream path sees one uniform
+    # codec.  The overlay is local — the receiver's store keeps the
+    # encoded holding it announced and serves.
+    mixed = [lid for lid in (layer_ids
+                             + ([head_id] if head_id in layers else []))
+             if getattr(layers[lid].meta, "codec", "")
+             and layers[lid].meta.codec != codec]
+    if mixed:
+        from ..core.types import LayerLocation as _Loc
+        from ..core.types import LayerMeta as _Meta
+        from ..core.types import LayerSrc as _Src
+
+        layers = dict(layers)
+        for lid in mixed:
+            src = layers[lid]
+            raw = quant.decode_to_raw(cfg, lid, src.read_bytes(),
+                                      src.meta.codec)
+            layers[lid] = _Src(
+                inmem_data=bytearray(raw), data_size=len(raw),
+                meta=_Meta(location=_Loc.INMEM))
+        log.info("normalized wire-codec blobs for bulk assembly",
+                 blobs=mixed)
+
     sharding = None
     if placement is not None and node_id in placement.node_to_stage:
         from jax.sharding import PartitionSpec as P

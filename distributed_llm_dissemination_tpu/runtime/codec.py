@@ -1,0 +1,209 @@
+"""The negotiated wire-codec plane (docs/codec.md).
+
+``models/quant.py`` defines the codecs (deterministic int8/int4 encodings
+of a model blob); this module is the RUNTIME half every node role shares:
+
+- **capability**: what codecs this process can encode/decode — announced
+  to the leader (``AnnounceMsg.codecs``), consulted when the leader
+  chooses a codec per (dest, layer) transfer;
+- **sender service**: the bounded encoded-form cache.  A raw holder
+  commanded to ship (or NACK-retransmit) a layer at codec ``c`` encodes
+  ONCE and serves every byte range — flow fragments, stripe splits,
+  retransmits — from the cached encoded blob, so the encoded byte space
+  is stable across re-sends (a re-encoded range must be byte-identical,
+  which quant's deterministic round-to-nearest guarantees, but caching
+  also keeps the encode cost off every retransmit);
+- **identity**: the codec-qualified digest of a layer's encoded form,
+  cached per (layer, codec) — what the leader stamps so a quantized
+  copy verifies (and acks) under its OWN byte identity, never raw's.
+
+Everything degrades to raw: a plane that can't encode a layer (size
+mismatch — dummy bytes, not model blobs), a dest that never advertised
+the codec, or a missing model config all leave the transfer canonical.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc
+from ..utils import integrity, trace
+from ..utils.logging import log
+
+# Links whose modeled bottleneck rate (bytes/s) is at or below this ship
+# quantized when a WireCodec is configured; faster links stay raw — at
+# NIC rates the wire is cheaper than the encode/decode pass (measure on
+# the running host with quant.codec_bench; TTD_MATRIX records it).
+CODEC_MIN_RATE_DEFAULT = 64 << 20  # 64 MiB/s
+
+# Sender-side encoded-form cache budget (bytes).  One entry per
+# (layer, codec) actively being served; eviction is LRU.
+CODEC_CACHE_BYTES_DEFAULT = 1 << 30
+
+
+class WireCodecPlane:
+    """Per-process wire-codec capability + encoded-form cache."""
+
+    def __init__(self, cfg, model_codec: str = "raw",
+                 wire_codec: str = "raw"):
+        """``cfg``: the run's ``models.llama.ModelConfig`` (blob layouts
+        — encoded sizes derive from it).  ``model_codec``: the canonical
+        form the run's blobs are fabricated in; wire codecs only apply
+        over raw canonicals (core/config.py refuses the combination at
+        parse time, this just re-checks).  ``wire_codec``: the codec
+        this run ALLOWS on slow links ("raw" = the plane is
+        capability-only: this node can decode/serve codecs a leader
+        chooses, but a leader built with it never chooses one)."""
+        self.cfg = cfg
+        self.model_codec = model_codec
+        self.wire_codec = wire_codec if model_codec == "raw" else "raw"
+        self._lock = threading.Lock()
+        self._cache: Dict[Tuple[LayerID, str], bytes] = {}
+        self._cache_bytes = 0
+        self._digests: Dict[Tuple[LayerID, str], str] = {}
+        try:
+            self.min_rate = int(os.environ.get(
+                "DLD_CODEC_MIN_RATE", str(CODEC_MIN_RATE_DEFAULT)))
+        except ValueError:
+            self.min_rate = CODEC_MIN_RATE_DEFAULT
+        try:
+            self.cache_budget = int(os.environ.get(
+                "DLD_CODEC_CACHE_BYTES", str(CODEC_CACHE_BYTES_DEFAULT)))
+        except ValueError:
+            self.cache_budget = CODEC_CACHE_BYTES_DEFAULT
+
+    # ------------------------------------------------------------ capability
+
+    @property
+    def enabled(self) -> bool:
+        """Whether this run may CHOOSE quantized transfers (leader
+        side).  Capability (decode/serve) is independent — see
+        :meth:`decode_codecs`."""
+        return (self.wire_codec in ("int8", "int4")
+                and self.model_codec == "raw"
+                and os.environ.get("DLD_WIRE_CODEC", "1") != "0")
+
+    def decode_codecs(self) -> List[str]:
+        """The codecs this process can DECODE (and encode — both need
+        only quant + the model config), announced to the leader.  Empty
+        when the canonical form isn't raw (a decoded int8-of-int8 blob
+        would be meaningless) or the plane is env-disabled."""
+        if (self.model_codec != "raw"
+                or os.environ.get("DLD_WIRE_CODEC", "1") == "0"):
+            return []
+        return ["int8", "int4"]
+
+    # --------------------------------------------------------------- sizing
+
+    def nbytes(self, lid: LayerID, codec: str) -> Optional[int]:
+        """Exact wire size of layer ``lid`` under ``codec``, or None for
+        ids outside the model's blob range (those transfers stay raw)."""
+        from ..models import quant, serde
+
+        if lid > serde.head_blob_id(self.cfg):
+            return None
+        try:
+            return quant.blob_nbytes_codec(self.cfg, lid, codec)
+        except (ValueError, KeyError):
+            return None
+
+    def decoded_nbytes(self, lid: LayerID) -> Optional[int]:
+        """The canonical (raw) byte count of layer ``lid`` — what a
+        quantized delivery decodes back into."""
+        return self.nbytes(lid, "raw")
+
+    # ------------------------------------------------------- encoded serving
+
+    def encoded_src(self, lid: LayerID, layer: LayerSrc,
+                    codec: str) -> Optional[LayerSrc]:
+        """A ``LayerSrc`` over the ENCODED form of a raw holding —
+        cached, so flow fragments, stripes, and NACK retransmits all
+        read byte ranges of ONE stable encoded blob.  None when the
+        layer can't encode (wrong size for the model's blob layout, or
+        unreadable bytes) — the caller must refuse, loudly, rather than
+        ship raw bytes a dest will account in encoded space."""
+        enc = self._encoded_bytes(lid, layer, codec)
+        if enc is None:
+            return None
+        return LayerSrc(
+            inmem_data=enc, data_size=len(enc), offset=0,
+            meta=LayerMeta(location=LayerLocation.INMEM,
+                           limit_rate=layer.meta.limit_rate,
+                           source_type=layer.meta.source_type,
+                           codec=codec),
+        )
+
+    def _encoded_bytes(self, lid: LayerID, layer: LayerSrc,
+                       codec: str) -> Optional[bytearray]:
+        want = self.nbytes(lid, codec)
+        raw_size = self.decoded_nbytes(lid)
+        if want is None or raw_size is None:
+            return None
+        if getattr(layer.meta, "codec", ""):
+            return None  # only canonical bytes encode
+        key = (lid, codec)
+        # One canonical content per layer id per process (the layer
+        # store holds one record per id), so (lid, codec) keys the
+        # cache; the deterministic encode makes every producer agree.
+        with self._lock:
+            enc = self._cache.get(key)
+            if enc is not None:
+                self._cache[key] = self._cache.pop(key)  # LRU touch
+                return enc
+        try:
+            raw = layer.read_range()
+        except (OSError, ValueError) as e:
+            log.error("wire-codec encode: layer bytes unreadable",
+                      layerID=lid, err=repr(e))
+            return None
+        if len(raw) != raw_size:
+            log.error("wire-codec encode refused: holding is not a "
+                      "model blob (size mismatch)", layerID=lid,
+                      have=len(raw), want=raw_size)
+            return None
+        from ..models import quant
+
+        t0 = time.monotonic()
+        enc = bytearray(quant.encode_blob(self.cfg, lid, raw, codec))
+        dt = time.monotonic() - t0
+        trace.count("codec.encoded_blobs")
+        trace.count("codec.encoded_bytes", len(enc))
+        trace.add_phase("codec_encode", dt)
+        log.info("layer encoded for wire codec", layerID=lid, codec=codec,
+                 raw_bytes=len(raw), encoded_bytes=len(enc),
+                 encode_ms=round(dt * 1000, 1))
+        with self._lock:
+            if key not in self._cache:
+                self._cache[key] = enc
+                self._cache_bytes += len(enc)
+                while (self._cache_bytes > self.cache_budget
+                       and len(self._cache) > 1):
+                    old_key = next(iter(self._cache))
+                    if old_key == key:
+                        break
+                    self._cache_bytes -= len(self._cache.pop(old_key))
+            return self._cache[key]
+
+    # -------------------------------------------------------------- identity
+
+    def encoded_digest(self, lid: LayerID, layer: LayerSrc,
+                       codec: str) -> Optional[str]:
+        """The codec-qualified digest the leader stamps for a quantized
+        transfer: the digest of exactly the encoded bytes, cached per
+        (layer, codec).  None when the layer can't encode here — the
+        pair then stays raw (docs/codec.md, honest limits)."""
+        key = (lid, codec)
+        with self._lock:
+            d = self._digests.get(key)
+        if d is not None:
+            return d
+        enc = self._encoded_bytes(lid, layer, codec)
+        if enc is None:
+            return None
+        d = integrity.layer_digest(memoryview(enc))
+        with self._lock:
+            self._digests[key] = d
+        return d

@@ -167,8 +167,25 @@ class ShadowLeaderState:
         # so a promoted standby resumes (or re-fences) a half-finished
         # weight swap instead of stranding the fleet mid-rollout.
         self.swaps: dict = {}
+        # Wire-codec plane (docs/codec.md): the leader's per-(dest,
+        # layer) codec choices and the cluster capability table, so a
+        # promoted leader keeps planning the byte spaces in-flight
+        # encoded partials live in.
+        self.wire_codecs: Dict[Tuple[NodeID, int], str] = {}
+        self.node_codecs: Dict[NodeID, list] = {}
         self.have_snapshot = False
         self.deltas_applied = 0
+
+    @staticmethod
+    def _codec_choices(d: dict) -> Dict[Tuple[NodeID, int], str]:
+        out: Dict[Tuple[NodeID, int], str] = {}
+        for key, c in (d or {}).items():
+            try:
+                dest, lid = str(key).split(":", 1)
+                out[(int(dest), int(lid))] = str(c)
+            except ValueError:
+                continue
+        return out
 
     def apply(self, msg: ControlDeltaMsg) -> None:
         d = msg.data
@@ -196,6 +213,10 @@ class ShadowLeaderState:
                              (d.get("Jobs") or {}).items()}
                 self.swaps = {str(v): dict(rec) for v, rec in
                               (d.get("Swaps") or {}).items()}
+                self.wire_codecs = self._codec_choices(d.get("WireCodecs"))
+                self.node_codecs = {
+                    int(n): [str(c) for c in caps]
+                    for n, caps in (d.get("NodeCodecs") or {}).items()}
                 if d.get("BaseAssignment") is not None:
                     self.base_assignment = _nested_layer_map_from_json(
                         d.get("BaseAssignment"))
@@ -209,7 +230,8 @@ class ShadowLeaderState:
                     location=LayerLocation(int(d.get("Location", 0))),
                     data_size=int(d.get("Size", 0)),
                     shard=str(d.get("Shard", "")),
-                    version=str(d.get("Version", "") or ""))
+                    version=str(d.get("Version", "") or ""),
+                    codec=str(d.get("Codec", "") or ""))
             elif k == "partial":
                 node = int(d["Node"])
                 per = d.get("Partial")
@@ -244,6 +266,16 @@ class ShadowLeaderState:
             elif k == "base_assignment":
                 self.base_assignment = _nested_layer_map_from_json(
                     d.get("Assignment"))
+            elif k == "codecs":
+                # Wire-codec choices + capability table (docs/codec.md).
+                # REPLACE, don't merge: the delta always carries the
+                # leader's full current maps, and a revoked capability
+                # (or a reverted choice) is exactly an ABSENT entry —
+                # an update-merge would resurrect it at takeover.
+                self.wire_codecs = self._codec_choices(d.get("Choices"))
+                self.node_codecs = {
+                    int(n): [str(c) for c in caps]
+                    for n, caps in (d.get("NodeCodecs") or {}).items()}
             elif k == "job":
                 self.jobs[str(d["JobID"])] = dict(d)
             elif k == "swap":
@@ -286,6 +318,9 @@ class ShadowLeaderState:
                 "base_assignment": (
                     {n: dict(r) for n, r in self.base_assignment.items()}
                     if self.base_assignment is not None else None),
+                "wire_codecs": dict(self.wire_codecs),
+                "node_codecs": {n: list(c)
+                                for n, c in self.node_codecs.items()},
                 "have_snapshot": self.have_snapshot,
             }
 
@@ -440,7 +475,12 @@ class StandbyController:
                       lock=self.receiver._lock,
                       expected_nodes=set(), failure_timeout=ft,
                       standbys=remaining, lease_interval=interval,
-                      epoch=epoch)
+                      epoch=epoch,
+                      # The promoted leader inherits this seat's
+                      # wire-codec plane (docs/codec.md): adopted codec
+                      # choices stay plannable/stampable in encoded
+                      # byte space across the takeover.
+                      codecs=getattr(self.receiver, "codec_plane", None))
         args = (self.node, self.receiver.layers, shadow["assignment"])
         if mode == 3:
             bw = self._bw if self._bw is not None else shadow["network_bw"]

@@ -44,6 +44,7 @@ from ..core.types import (
     LayersSrc,
     NodeID,
     Status,
+    codec_accepts,
     delivered,
     layer_ids_to_json,
     satisfies,
@@ -143,6 +144,7 @@ class LeaderNode:
         epoch: int = -1,
         loop=None,
         lock=None,
+        codecs=None,
     ):
         """``expected_nodes``: when given, distribution also waits for these
         nodes to announce — not just the assignment keys.  The reference
@@ -196,6 +198,41 @@ class LeaderNode:
         # token.  Read at construction like the other env knobs; empty
         # = open admission (the legacy behavior).
         self._job_token = os.environ.get("DLD_JOB_TOKEN", "")
+        # Per-submitter quotas/rate limits (docs/service.md), keyed by
+        # the DLD_JOB_TOKEN identity: DLD_JOB_QUOTA caps a submitter's
+        # concurrently ACTIVE jobs, DLD_JOB_RATE ("N/SECONDS") caps its
+        # submit attempts per window.  0/empty = unlimited (legacy).
+        # Refusals are counted (jobs.quota_refused) and always ANSWER.
+        try:
+            self._job_quota = int(os.environ.get("DLD_JOB_QUOTA", "0"))
+        except ValueError:
+            self._job_quota = 0
+        self._job_rate_n, self._job_rate_s = 0, 0.0
+        rate_spec = os.environ.get("DLD_JOB_RATE", "")
+        if rate_spec:
+            try:
+                n, s = rate_spec.split("/", 1)
+                self._job_rate_n, self._job_rate_s = int(n), float(s)
+            except ValueError:
+                log.error("malformed DLD_JOB_RATE (want 'N/SECONDS'); "
+                          "rate limiting disabled", value=rate_spec)
+        self._submit_times: Dict[str, List[float]] = {}
+        # Wire-codec plane (docs/codec.md): this leader's own
+        # encode/decode capability + each announced node's (the
+        # negotiation's capability table), the memoized per-(dest,
+        # layer) codec CHOICE (stable across re-merges and re-plans),
+        # and the codec-qualified digest cache the stamp reads.
+        self.codecs = codecs
+        self.node_codecs: Dict[NodeID, frozenset] = {}
+        if codecs is not None:
+            self.node_codecs[node.my_id] = frozenset(
+                codecs.decode_codecs())
+        self._codec_choice: Dict[Tuple[NodeID, LayerID], str] = {}
+        self._codec_digest_cache: Dict[Tuple[LayerID, str], str] = {}
+        # Sticky once ANY pair was ever chosen quantized: digests-off
+        # stamps then carry explicit ""-codec entries so a REVERTED
+        # pair still reconciles at the dest (mirrors _sharding_seen).
+        self._codec_seen = False
         # (layer, dest) pairs already reported as content-skipped (the
         # counter/log fire once per pair, not once per replan).
         self._content_skip_seen: Set[Tuple[LayerID, NodeID]] = set()
@@ -334,6 +371,7 @@ class LeaderNode:
                 source_type=src.meta.source_type,
                 data_size=src.data_size,
                 version=src.meta.version,
+                codec=src.meta.codec,
             )
             for lid, src in self.layers.items()
         }
@@ -570,6 +608,14 @@ class LeaderNode:
                     self._dropped_assignment),
                 "Digests": {str(l): d
                             for l, d in self.layer_digests.items()},
+                # Wire-codec plane (docs/codec.md): the per-pair codec
+                # choices and node capabilities — a promoted leader
+                # must keep planning the SAME byte spaces mid-transfer
+                # partials live in.
+                "WireCodecs": {f"{d}:{l}": c for (d, l), c
+                               in self._codec_choice.items() if c},
+                "NodeCodecs": {str(n): sorted(s)
+                               for n, s in self.node_codecs.items()},
                 "PlanSeq": self._plan_seq_hint,
                 "StartupSent": self._startup_sent,
                 "NetworkBw": {str(n): b for n, b in getattr(
@@ -606,6 +652,7 @@ class LeaderNode:
                     source_type=src.meta.source_type,
                     data_size=src.data_size,
                     version=src.meta.version,
+                    codec=src.meta.codec,
                 )
                 for lid, src in self.layers.items()
             }
@@ -657,6 +704,34 @@ class LeaderNode:
                                         shadow["dropped"].items()}
             for lid, dg in shadow["digests"].items():
                 self.layer_digests.setdefault(lid, dg)
+            # Wire-codec plane (docs/codec.md): adopt the dead leader's
+            # codec choices and the cluster's capability table, so the
+            # resumed plans keep the byte spaces in-flight partials are
+            # accounted in (and the re-sent stamps carry the same
+            # codec-qualified expectations).
+            for key, c in (shadow.get("wire_codecs") or {}).items():
+                self._codec_choice[key] = c
+                if c:
+                    self._codec_seen = True
+            for n, caps in (shadow.get("node_codecs") or {}).items():
+                if n != dead_leader:
+                    self.node_codecs.setdefault(n, frozenset(caps))
+            if self.codecs is None and self._codec_choice:
+                # No codec plane here (a promoted seat without the
+                # model): chosen pairs can't be sized or digest-stamped
+                # in encoded space — revert them to raw, loudly.  The
+                # re-sent stamp clears each dest's codec expectation
+                # and its stale encoded partials demote for a clean raw
+                # redelivery (docs/codec.md, honest limits).
+                log.warn("adopted wire-codec choices without a codec "
+                         "plane; reverting pairs to raw",
+                         pairs=len(self._codec_choice))
+                self._codec_choice = {
+                    key: "" for key in self._codec_choice}
+                for dest, lids in self.assignment.items():
+                    for lid, meta in list(lids.items()):
+                        if meta.codec:
+                            lids[lid] = dataclasses.replace(meta, codec="")
             # The replicated telemetry picture survives the takeover:
             # the dead leader's fold is the starting table, and every
             # live node's next cumulative report simply replaces its row.
@@ -742,8 +817,10 @@ class LeaderNode:
 
     def handle_layer_nack(self, msg: LayerNackMsg) -> None:
         """A receiver's transport dropped a corrupt/abandoned fragment
-        this leader sent: retransmit the byte range (bounded)."""
-        self.nacker.handle(self.node, self.layers, self._lock, msg)
+        this leader sent: retransmit the byte range (bounded; in the
+        transfer's own — possibly encoded — byte space)."""
+        self.nacker.handle(self.node, self.layers, self._lock, msg,
+                           codecs=self.codecs)
 
     def _compute_own_digests(self) -> None:
         """Hash the leader's own layers for the digest stamp (background
@@ -850,6 +927,149 @@ class LeaderNode:
             out[lid] = d
         return out
 
+    # ------------------------------------------------- wire-codec choice
+
+    # Whether this scheduler supports negotiated wire codecs.  Mode 2's
+    # pull/steal tables pick senders per-layer with no per-pair codec
+    # admissibility, so it opts out (docs/codec.md, honest limits).
+    WIRE_CODEC_OK = True
+
+    def _node_bw(self, node_id: NodeID) -> int:
+        """The node's modeled NIC rate for the codec-choice bottleneck
+        estimate; 0 = unknown/unlimited.  Only mode 3 models NICs."""
+        return 0
+
+    def _pair_rate_locked(self, dest: NodeID, lid: LayerID,
+                          want) -> int:
+        """Lock held.  The (dest, layer) pair's modeled bottleneck rate
+        (bytes/s): the best raw holder's source rate, capped by the
+        dest's NIC.  0 = effectively unlimited (or unknown) — such a
+        pair ships raw; only provably-slow links pay the encode/decode
+        pass (docs/codec.md)."""
+        inf = 1 << 62
+        best = 0
+        for node_id, row in self.status.items():
+            m = row.get(lid)
+            if m is None or m.location == LayerLocation.CLIENT:
+                continue
+            if m.shard or getattr(m, "codec", ""):
+                continue  # rate-model raw full holders only
+            r = m.limit_rate if m.limit_rate > 0 else (
+                self._node_bw(node_id) or inf)
+            best = max(best, r)
+        if best == 0:
+            return 0  # no raw holder visible: stay raw
+        rate = min(best, self._node_bw(dest) or inf)
+        return 0 if rate >= inf else rate
+
+    def _decide_codec_locked(self, dest: NodeID, lid: LayerID,
+                             meta) -> str:
+        """Lock held.  The wire codec this (dest, layer) transfer
+        ships under ("" = canonical): the run's configured codec, IFF
+        the scheduler supports it, the dest advertised decode, the blob
+        has a codec layout, the pair is unsharded/unversioned (honest
+        limits: range digests hash raw ranges, and swap staging is
+        untested against re-encoded forms), and the pair's modeled
+        bottleneck is at or below the threshold — fast links ship raw."""
+        plane = self.codecs
+        if (plane is None or not plane.enabled
+                or not self.WIRE_CODEC_OK):
+            return ""
+        if meta.shard or meta.version:
+            return ""
+        c = plane.wire_codec
+        if c not in self.node_codecs.get(dest, ()):
+            return ""
+        if plane.nbytes(lid, c) is None:
+            return ""
+        own = self.layers.get(lid)
+        if own is not None and own.meta.location == LayerLocation.CLIENT:
+            # The leader's own copy is client-held: modes 0-2 would
+            # pipe-fetch RAW bytes under an encoded stamp (the client
+            # stream can't encode) — keep the pair canonical.
+            return ""
+        rate = self._pair_rate_locked(dest, lid, meta)
+        if rate <= 0 or rate > plane.min_rate:
+            return ""
+        return c
+
+    def _stamp_codecs(self) -> None:
+        """Choose (memoized) and stamp the wire codec onto every
+        assignment target meta — called before digest stamping and
+        before every plan, so re-merges (update/submit_job rebuild the
+        merged goal codec-less) re-apply the stable choices.  Choices
+        replicate to standbys: a promoted leader must keep planning the
+        SAME byte spaces mid-transfer partials live in."""
+        if self.codecs is None and not self._codec_choice:
+            return
+        changed = False
+        with self._lock:
+            for dest, lids in self.assignment.items():
+                for lid, meta in lids.items():
+                    key = (dest, lid)
+                    choice = self._codec_choice.get(key)
+                    if choice is None:
+                        choice = self._decide_codec_locked(dest, lid, meta)
+                        self._codec_choice[key] = choice
+                        if choice:
+                            changed = True
+                            self._codec_seen = True
+                            trace.count("codec.pairs_chosen")
+                            log.info("wire codec chosen for slow pair",
+                                     dest=dest, layerID=lid, codec=choice)
+                    if meta.codec != choice:
+                        lids[lid] = dataclasses.replace(meta, codec=choice)
+            choices = dict(self._codec_choice)
+        # Job targets must carry the same choices or quantized acks
+        # would never credit their pairs (sched/jobs.apply_codecs).
+        # Skipped while no pair was ever chosen (the common case) so
+        # replan ticks don't rescan every job for nothing; individual
+        # reverts apply their "" directly (_revert_codec_choice).
+        if any(choices.values()):
+            self.jobs.apply_codecs(
+                {(d, l): c for (d, l), c in choices.items()})
+        if changed:
+            self._replicate_codecs()
+
+    def _replicate_codecs(self) -> None:
+        with self._lock:
+            choices = {f"{d}:{l}": c
+                       for (d, l), c in self._codec_choice.items() if c}
+            caps = {str(n): sorted(s)
+                    for n, s in self.node_codecs.items()}
+        self._replicate("codecs", Choices=choices, NodeCodecs=caps)
+
+    def _codec_digest(self, lid: LayerID, codec: str) -> Optional[str]:
+        """The codec-qualified digest stamped for a quantized pair —
+        the hash of exactly the encoded bytes (cached; replans must not
+        re-encode/re-hash).  None when this leader can't produce it."""
+        key = (lid, codec)
+        with self._lock:
+            cached = self._codec_digest_cache.get(key)
+            layer = self.layers.get(lid)
+        if cached is not None:
+            return cached
+        if self.codecs is None or layer is None:
+            return None
+        d = self.codecs.encoded_digest(lid, layer, codec)
+        if d is not None:
+            with self._lock:
+                self._codec_digest_cache[key] = d
+        return d
+
+    def _revert_codec_choice(self, dest: NodeID, lid: LayerID) -> None:
+        """A chosen codec turned out unstampable (encode failed): the
+        pair reverts to canonical, loudly, and the memo pins the
+        reversion so replans don't flap."""
+        log.warn("wire codec reverted to raw for pair (encoded digest "
+                 "unavailable)", dest=dest, layerID=lid)
+        with self._lock:
+            self._codec_choice[(dest, lid)] = ""
+            row = self.assignment.get(dest)
+            if row is not None and lid in row:
+                row[lid] = dataclasses.replace(row[lid], codec="")
+        self.jobs.apply_codecs({(dest, lid): ""})
+
     def _send_digests_to(self, dest: NodeID) -> None:
         if dest == self.node.my_id:
             return
@@ -880,7 +1100,58 @@ class LeaderNode:
                 # to iterate): explicit "" entries carry the reconcile.
                 for lid in self.assignment.get(dest) or {}:
                     shards.setdefault(lid, "")
-        if not digests and not shards and not versions:
+            # Wire-codec transfers (docs/codec.md): the chosen codec
+            # per assigned layer rides the stamp — the one leader→dest
+            # channel preceding the bytes — so the dest accounts the
+            # transfer in encoded byte space from the first fragment.
+            codec_map = {lid: meta.codec
+                         for lid, meta in
+                         (self.assignment.get(dest) or {}).items()
+                         if meta.codec}
+            if self._codec_seen and not integrity.digests_enabled():
+                # With digests OFF the codec map is the ONLY channel
+                # that can tell a dest a pair REVERTED to raw (with
+                # digests on, the pair's digest entry carries the
+                # reconcile): explicit "" entries clear the dest's
+                # stale codec expectation — same sticky-"" discipline
+                # as the shards map above.
+                for lid in self.assignment.get(dest) or {}:
+                    codec_map.setdefault(lid, "")
+        if integrity.digests_enabled():
+            # For codec pairs the stamped digest is CODEC-QUALIFIED:
+            # the hash of exactly the encoded bytes — the CANONICAL
+            # digest must never reach the dest for them (encoded bytes
+            # would "fail" it forever).  Three cases, mirroring the
+            # sharded range-digest policy (docs/sharding.md):
+            # leader-readable layers stamp the encoded digest; a
+            # readable layer that REFUSES to encode (not a model blob)
+            # reverts the pair to raw; a holder-only layer keeps the
+            # codec and stamps NO digest — the transfer verifies by
+            # per-fragment CRC alone (docs/codec.md, honest limits;
+            # the seeders' deterministic encode keeps multi-sender
+            # ranges byte-identical).
+            bad = []
+            for lid, c in sorted(codec_map.items()):
+                d = self._codec_digest(lid, c)
+                if d is not None:
+                    digests[lid] = d
+                    continue
+                with self._lock:
+                    readable = (
+                        self.layers.get(lid) is not None
+                        and self.layers[lid].meta.location
+                        != LayerLocation.CLIENT)
+                if readable:
+                    self._revert_codec_choice(dest, lid)
+                    bad.append(lid)
+                else:
+                    digests.pop(lid, None)
+                    log.info("codec pair stamped without a digest "
+                             "(holder-only layer; CRC-only verify)",
+                             dest=dest, layerID=lid, codec=c)
+            for lid in bad:
+                codec_map.pop(lid, None)
+        if not digests and not shards and not versions and not codec_map:
             return
         try:
             self.node.transport.send(
@@ -888,7 +1159,7 @@ class LeaderNode:
                     self.node.my_id, digests, epoch=self.epoch,
                     shards=shards,
                     range_digests=self._range_digests_for(shards),
-                    versions=versions))
+                    versions=versions, codecs=codec_map))
         except (OSError, KeyError) as e:
             log.warn("digest stamp send failed", dest=dest, err=repr(e))
 
@@ -981,6 +1252,45 @@ class LeaderNode:
             "counters": telemetry.fold_counters(reports),
             "links": telemetry.fold_links(reports),
         }
+
+    def dest_bytes_table(self) -> Dict[str, dict]:
+        """Per-dest WIRE vs DECODED byte accounting for the run report
+        (docs/codec.md): ``wire_bytes`` is what actually crossed the
+        network for each delivered pair — the ENCODED size for a
+        quantized transfer, the shard range's bytes for a sharded one —
+        and ``decoded_bytes`` is what the dest materializes.  The two
+        are reported as separate columns on purpose: the telemetry link
+        table reconciles against WIRE bytes, never the decoded side."""
+        out: Dict[str, dict] = {}
+        plane = self.codecs
+        with self._lock:
+            for dest, lids in self.assignment.items():
+                if dest == self.node.my_id:
+                    continue
+                row = {"wire_bytes": 0, "decoded_bytes": 0, "layers": 0,
+                       "codec_layers": 0}
+                for lid, want in lids.items():
+                    held = self.status.get(dest, {}).get(lid)
+                    if not satisfies(held, want):
+                        continue
+                    raw = self._layer_size_locked(lid)
+                    if not raw and plane is not None:
+                        raw = plane.decoded_nbytes(lid) or 0
+                    codec = held.codec
+                    wire = raw
+                    if codec and plane is not None:
+                        wire = plane.nbytes(lid, codec) or raw
+                    if held.shard:
+                        wire = shard_range(held.shard, wire)[1]
+                        raw = shard_range(held.shard, raw)[1]
+                    row["wire_bytes"] += wire
+                    row["decoded_bytes"] += raw
+                    row["layers"] += 1
+                    if codec:
+                        row["codec_layers"] += 1
+                if row["layers"]:
+                    out[str(dest)] = row
+        return out
 
     def log_cluster_metrics(self) -> dict:
         """Log (and return) the folded cluster table — the mid-run
@@ -1206,6 +1516,10 @@ class LeaderNode:
         # raises, or every later announce bounces off it and the run
         # wedges with no timer and no layers ever sent.
         try:
+            # Codec choices precede the stamp: the digest channel is
+            # what tells each dest its transfers' byte spaces
+            # (docs/codec.md).
+            self._stamp_codecs()
             self._send_digests()
             with self._lock:
                 self._started = True
@@ -1264,6 +1578,25 @@ class LeaderNode:
                      node=msg.src_id)
             self.detector.revive(msg.src_id)
         self.detector.touch(msg.src_id)
+        # Wire-codec capability (docs/codec.md): what this node can
+        # decode — the negotiation's capability table.  An announce
+        # with no codecs is authoritative too (a restarted node may
+        # have lost the capability with its config), and REVOCATION
+        # replicates like a grant — a standby keeping a stale
+        # capability would let a promoted leader choose quantized
+        # transfers the node can no longer decode.  Compare-before-
+        # replicate: re-announces with an unchanged table (every
+        # recovery replan) add no replication traffic.
+        with self._lock:
+            new_caps = (frozenset(str(c) for c in msg.codecs)
+                        if msg.codecs else None)
+            old_caps = self.node_codecs.get(msg.src_id)
+            if new_caps:
+                self.node_codecs[msg.src_id] = new_caps
+            else:
+                self.node_codecs.pop(msg.src_id, None)
+        if new_caps != old_caps:
+            self._replicate_codecs()
         self._merge_announced_digests(msg.src_id, msg.digests)
         # Content index: an announce is the node's authoritative current
         # inventory — replace its digest contribution wholesale (a
@@ -1416,6 +1749,10 @@ class LeaderNode:
                 # adopts "FINISHED" and never re-drives the new goal.
                 self._startup_sent = False
                 self._replicate("startup", Sent=False)
+        # Re-merge dropped the codec choices from the target metas;
+        # re-apply the memoized ones (docs/codec.md) before anything
+        # replicates or stamps the new goal.
+        self._stamp_codecs()
         # New assignees that haven't announced get liveness leases, so one
         # that never shows up is still detected (as in __init__'s seeding).
         for node_id in assignment:
@@ -1454,7 +1791,8 @@ class LeaderNode:
                    priority: int = 0, kind: str = "push",
                    digests: Optional[Dict[LayerID, str]] = None,
                    avoid: Optional[Set[NodeID]] = None,
-                   version: str = "", swap_base: int = -1) -> dict:
+                   version: str = "", swap_base: int = -1,
+                   submitter: str = "") -> dict:
         """Admit one dissemination job into the long-lived service plane
         (docs/service.md) — the multi-job generalization of ``update()``.
 
@@ -1518,7 +1856,8 @@ class LeaderNode:
                 priority=int(priority), kind=str(kind), digests=digests,
                 avoid_sources={int(n) for n in (avoid or ())},
                 admit_ms=time.time() * 1000.0,
-                version=str(version), swap_base=int(swap_base)),
+                version=str(version), swap_base=int(swap_base),
+                submitter=str(submitter)),
             status_view)
         trace.count("jobs.admitted")
         log.info("dissemination job admitted", job=job.job_id,
@@ -1539,6 +1878,9 @@ class LeaderNode:
                 self._startup_sent = False
                 self._replicate("startup", Sent=False)
             merged = _nested_layer_map_to_json(self.assignment)
+        # The re-merge rebuilt the goal codec-less: re-apply choices
+        # (and choose for the job's new pairs) before stamps/replans.
+        self._stamp_codecs()
         for node_id in job.assignment:
             if node_id != self.node.my_id and node_id not in self.status:
                 self.detector.touch(node_id)
@@ -1577,6 +1919,54 @@ class LeaderNode:
         sends.  Only mode 3 tracks dispatched sends (``_live_jobs``);
         the base scheduler has nothing to revoke."""
 
+    def _submitter_id(self, msg: JobSubmitMsg) -> str:
+        """The submitter identity quotas key on (docs/service.md):
+        derived from the DLD_JOB_TOKEN the submit authenticated with
+        (hashed — the identity must never leak the secret into logs or
+        job records), falling back to the wire seat id on open
+        clusters."""
+        if msg.auth:
+            import hashlib
+
+            return hashlib.sha256(msg.auth.encode()).hexdigest()[:12]
+        return f"node{msg.src_id}"
+
+    def _quota_refusal(self, msg: JobSubmitMsg) -> str:
+        """Per-submitter quota/rate check (docs/service.md); returns
+        the refusal text ("" = admitted).  Idempotent resubmits of a
+        KNOWN job id are never refused — the retry path must stay safe.
+        Every refusal counts on ``jobs.quota_refused`` and is ANSWERED
+        by the caller (the serving invariant)."""
+        if self._job_quota <= 0 and self._job_rate_n <= 0:
+            return ""
+        if self.jobs.get(msg.job_id) is not None:
+            return ""
+        ident = self._submitter_id(msg)
+        now = time.monotonic()
+        if self._job_rate_n > 0:
+            with self._lock:
+                times = self._submit_times.setdefault(ident, [])
+                times[:] = [t for t in times
+                            if now - t < self._job_rate_s]
+                over = len(times) >= self._job_rate_n
+                if not over:
+                    times.append(now)
+            if over:
+                trace.count("jobs.quota_refused")
+                log.warn("job submit rate-limited", job=msg.job_id,
+                         submitter=ident, limit=self._job_rate_n,
+                         window_s=self._job_rate_s)
+                return (f"rate limited: {self._job_rate_n} submits per "
+                        f"{self._job_rate_s:g}s per submitter")
+        if (self._job_quota > 0
+                and self.jobs.active_count_for(ident) >= self._job_quota):
+            trace.count("jobs.quota_refused")
+            log.warn("job submit over quota", job=msg.job_id,
+                     submitter=ident, quota=self._job_quota)
+            return (f"quota exceeded: {self._job_quota} active jobs "
+                    "per submitter")
+        return ""
+
     def handle_job_submit(self, msg: JobSubmitMsg) -> None:
         """Wire half of ``submit_job`` — the ``cli.main -submit`` entry
         point.  Always answered (the serving invariant): admission
@@ -1603,6 +1993,12 @@ class LeaderNode:
             reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
                                  error="job_id and a non-empty "
                                        "assignment are required")
+        elif (refusal := self._quota_refusal(msg)):
+            # Per-submitter quotas/rate limits (docs/service.md): the
+            # refusal ANSWERS — a throttled submitter sees why, never a
+            # timeout.
+            reply = JobStatusMsg(self.node.my_id, epoch=self.epoch,
+                                 error=refusal)
         else:
             try:
                 summary = self.submit_job(msg.job_id, msg.assignment,
@@ -1611,7 +2007,8 @@ class LeaderNode:
                                           digests=msg.digests,
                                           avoid=msg.avoid,
                                           version=msg.version,
-                                          swap_base=msg.swap_base)
+                                          swap_base=msg.swap_base,
+                                          submitter=self._submitter_id(msg))
                 reply = JobStatusMsg(self.node.my_id,
                                      jobs={msg.job_id: summary},
                                      epoch=self.epoch)
@@ -1901,7 +2298,10 @@ class LeaderNode:
         range) pair, and full-layer vouching doesn't carry it
         (docs/sharding.md, honest limits)."""
         want = (self.assignment.get(dest) or {}).get(layer_id)
-        if want is not None and want.shard:
+        if want is not None and (want.shard or want.codec):
+            # Sharded and codec targets resolve by (digest, range/codec)
+            # keys that full-layer raw vouching doesn't carry
+            # (docs/sharding.md, docs/codec.md — honest limits).
             return False
         if self.jobs.owner_of(dest, layer_id) is None:
             return False
@@ -1941,7 +2341,9 @@ class LeaderNode:
         (node.go:326-352) — over the device fabric when one is wired.
         A sharded target (docs/sharding.md) ships as exactly its shard's
         byte range over the host path (the fabric plane speaks whole
-        layers only)."""
+        layers only); a wire-codec target (docs/codec.md) ships its
+        ENCODED form over the host path the same way."""
+        self._stamp_codecs()
         for node_id, layer_ids in self.assignment.items():
             for layer_id, want in layer_ids.items():
                 with self._lock:
@@ -1955,18 +2357,21 @@ class LeaderNode:
                 if layer is None:
                     log.warn("no layers found", layerID=layer_id)
                     continue
-                if not want.shard and self._try_fabric_full_layer(
-                        layer_id, self.node.my_id, node_id):
+                if (not want.shard and not want.codec
+                        and self._try_fabric_full_layer(
+                            layer_id, self.node.my_id, node_id)):
                     continue
                 owner = self.jobs.owner_of(node_id, layer_id)
                 self.loop.submit(self._send_one, node_id, layer_id, layer,
-                                 owner[1] if owner else "", want.shard)
+                                 owner[1] if owner else "", want.shard,
+                                 want.codec)
 
     def _send_one(self, dest: NodeID, layer_id: LayerID, layer,
-                  job_id: str = "", shard: str = "") -> None:
+                  job_id: str = "", shard: str = "",
+                  codec: str = "") -> None:
         try:
             send_layer(self.node, dest, layer_id, layer, job_id=job_id,
-                       shard=shard)
+                       shard=shard, codec=codec, codecs=self.codecs)
         except Exception as e:  # noqa: BLE001
             log.error("couldn't send a layer", layerID=layer_id, err=repr(e))
 
@@ -2243,9 +2648,15 @@ class LeaderNode:
             if (not version and prev is not None and delivered(prev)
                     and prev.version):
                 version = prev.version
+            # Codec-qualified holding (docs/codec.md): the ack reports
+            # the form the dest's bytes are actually in — trusted
+            # verbatim (a raw re-delivery over a stale quantized
+            # holding must be able to upgrade the row to raw, or the
+            # pair livelocks re-planning forever).
+            codec = msg.codec
             row[msg.layer_id] = LayerMeta(location=msg.location,
                                           data_size=size, shard=shard,
-                                          version=version)
+                                          version=version, codec=codec)
             # A delivered (layer, dest) pair needs no more salvage.
             self._salvaging.discard((msg.layer_id, msg.src_id))
             # The watchdog stops chasing any plan this ack settles.
@@ -2256,22 +2667,27 @@ class LeaderNode:
                     del self._plan_watch[seq]
         self._replicate("ack", Node=msg.src_id, Layer=msg.layer_id,
                         Location=int(msg.location), Size=size,
-                        Shard=shard, Version=version)
+                        Shard=shard, Version=version, Codec=codec)
         # Content index + job plane: the delivered copy verified against
         # the stamped digest before acking, so the new owner vouches for
         # those bytes; the ack credits every admitted job wanting the
         # pair (docs/service.md).  A SHARD ack vouches for its (range
         # digest, shard) key only — it can never alias-complete a
-        # full-layer pair (docs/sharding.md).
+        # full-layer pair (docs/sharding.md) — and a CODEC ack for its
+        # (encoded digest, codec) key only (docs/codec.md).
         with self._lock:
-            if shard:
+            if codec:
+                digest = self._codec_digest_cache.get(
+                    (msg.layer_id, codec))
+            elif shard:
                 digest = self._range_digest_cache.get((msg.layer_id, shard))
             else:
                 digest = self.layer_digests.get(msg.layer_id)
-        self.content.add(msg.src_id, msg.layer_id, digest, shard=shard)
+        self.content.add(msg.src_id, msg.layer_id, digest, shard=shard,
+                         codec=codec)
         self._jobs_completed(
             self.jobs.on_ack(msg.src_id, msg.layer_id, shard=shard,
-                             version=version))
+                             version=version, codec=codec))
         self._maybe_finish()
 
     def _jobs_completed(self, job_ids) -> None:
@@ -2492,16 +2908,22 @@ class RetransmitLeaderNode(LeaderNode):
         """(Re)index layer → owner set from live status (node.go:558-571).
         Rebuilt from scratch: status is the source of truth, and a
         restarted node no longer owns what its dead incarnation held.
-        FULL holdings only: a shard-holder (docs/sharding.md) can't
-        forward a whole layer, so it never enters the owner pool."""
+        FULL CANONICAL holdings only: a shard-holder (docs/sharding.md)
+        can't forward a whole layer, and a CODEC holder's bytes are the
+        encoded form — forwarding them as a raw delivery would ship
+        garbage under the layer's identity (docs/codec.md; mode 1/2's
+        coarse per-layer pool can't express per-pair admissibility, so
+        quantized holders simply never re-seed here — honest limit,
+        mode 3's arc filter does it exactly)."""
         self.layer_owners = {}
         for node_id, layer_ids in self.status.items():
             for layer_id, meta in layer_ids.items():
-                if meta.shard:
+                if meta.shard or getattr(meta, "codec", ""):
                     continue
                 self.layer_owners.setdefault(layer_id, set()).add(node_id)
 
     def send_layers(self) -> None:
+        self._stamp_codecs()
         with self._lock:
             self._build_layer_owners()
             owners_by_layer = {k: set(v) for k, v in self.layer_owners.items()}
@@ -2517,13 +2939,22 @@ class RetransmitLeaderNode(LeaderNode):
                 jid = jid_owner[1] if jid_owner else ""
                 owners = owners_by_layer.get(layer_id, set())
                 owners = owners - {node_id}
+                if want.codec:
+                    # A codec pair's owner must be able to ENCODE the
+                    # forward (the pool holds raw full holders only;
+                    # docs/codec.md).
+                    with self._lock:
+                        owners = {o for o in owners
+                                  if want.codec
+                                  in self.node_codecs.get(o, ())}
                 if owners:
                     # Deterministic owner pick (reference picks randomly via
                     # map iteration, node.go:583-588).
                     owner = min(owners)
                     try:
                         self.send_retransmit(layer_id, owner, node_id,
-                                             job_id=jid, shard=want.shard)
+                                             job_id=jid, shard=want.shard,
+                                             codec=want.codec)
                     except Exception as e:  # noqa: BLE001
                         log.error(
                             "couldn't send retransmit",
@@ -2534,23 +2965,26 @@ class RetransmitLeaderNode(LeaderNode):
                     if layer is None:
                         log.warn("no layers found", layerID=layer_id)
                         continue
-                    if not want.shard and self._try_fabric_full_layer(
-                            layer_id, self.node.my_id, node_id):
+                    if (not want.shard and not want.codec
+                            and self._try_fabric_full_layer(
+                                layer_id, self.node.my_id, node_id)):
                         continue
                     self.loop.submit(self._send_one, node_id, layer_id,
-                                     layer, jid, want.shard)
+                                     layer, jid, want.shard, want.codec)
 
     def send_retransmit(self, layer_id: LayerID, owner: NodeID,
                         dest: NodeID, job_id: str = "",
-                        shard: str = "") -> None:
+                        shard: str = "", codec: str = "") -> None:
         """Ask ``owner`` to forward ``layer_id`` to ``dest``; leader-owned
         layers go out directly (node.go:611-626).  With a fabric wired the
         forward becomes a one-source device plan — the owner's copy enters
         the fabric from its own stage and lands in the dest's HBM with no
         TCP byte stream (modes 1 and 2 share this path).  ``shard``:
         forward only that byte-range slice (host path only — the fabric
-        plane speaks whole layers)."""
-        if not shard and self._try_fabric_full_layer(layer_id, owner, dest):
+        plane speaks whole layers).  ``codec``: forward the ENCODED form
+        (host path only, docs/codec.md)."""
+        if (not shard and not codec
+                and self._try_fabric_full_layer(layer_id, owner, dest)):
             return
         if owner == self.node.my_id:
             layer = self.layers.get(layer_id)
@@ -2562,12 +2996,12 @@ class RetransmitLeaderNode(LeaderNode):
             # leader-owned transfer behind the previous one (mode 0's
             # sends are pooled for the same reason, node.go:343-349).
             self.loop.submit(self._send_one, dest, layer_id, layer, job_id,
-                             shard)
+                             shard, codec)
             return
         self.node.transport.send(
             owner, RetransmitMsg(self.node.my_id, layer_id, dest,
                                  epoch=self.epoch, job_id=job_id,
-                                 shard=shard)
+                                 shard=shard, codec=codec)
         )
 
 
@@ -2593,6 +3027,10 @@ class PullRetransmitLeaderNode(RetransmitLeaderNode):
     average job duration × queue length)."""
 
     MODE = 2
+    # Mode 2's pull/steal tables pick senders per layer with no
+    # per-pair codec admissibility: it never chooses wire codecs
+    # (docs/codec.md, honest limits).
+    WIRE_CODEC_OK = False
 
     def __init__(self, node: Node, layers: LayersSrc, assignment: Assignment,
                  start_loop: bool = True,
@@ -2997,7 +3435,13 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                else self.loop.register)
         reg(FlowRetransmitMsg, self.handle_flow_retransmit)
 
+    def _node_bw(self, node_id: NodeID) -> int:
+        """Mode 3 models NICs: the codec-choice bottleneck estimate
+        uses them (docs/codec.md)."""
+        return self.node_network_bw.get(node_id, 0)
+
     def send_layers(self) -> None:
+        self._stamp_codecs()
         t, self_jobs, jobs = self.assign_jobs()
         self._dispatch(t, self_jobs, jobs)
 
@@ -3019,11 +3463,37 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         with self._lock:
             # Size every layer from announced metadata — the leader need not
             # hold a layer to schedule it (its own layers are in status too).
+            # CODEC holdings are skipped: their data_size is the ENCODED
+            # byte count, not the canonical layer size the raw pairs
+            # plan by (codec pairs size via codec_sizes below).
             layer_sizes: Dict[LayerID, int] = {}
             for layer_metas in self.status.values():
                 for layer_id, meta in layer_metas.items():
-                    if meta.data_size > 0:
-                        layer_sizes[layer_id] = meta.data_size
+                    if meta.data_size > 0 and not getattr(
+                            meta, "codec", ""):
+                        layer_sizes[layer_id] = max(
+                            layer_sizes.get(layer_id, 0), meta.data_size)
+            # Wire-codec planning inputs (docs/codec.md): exact encoded
+            # sizes per chosen (layer, codec) — the demand-side
+            # "effective capacity = bandwidth x ratio" formulation —
+            # and each node's encode capability for arc admissibility.
+            codec_sizes: Dict[Tuple[LayerID, str], int] = {}
+            if self.codecs is not None:
+                for dest_l, lids_l in self.assignment.items():
+                    for lid_l, meta_l in lids_l.items():
+                        if meta_l.codec:
+                            n = self.codecs.nbytes(lid_l, meta_l.codec)
+                            if n is not None:
+                                codec_sizes[(lid_l, meta_l.codec)] = n
+                        if lid_l not in layer_sizes:
+                            # Only codec holders announced (a re-seed
+                            # cluster): the canonical size derives from
+                            # the model layout.
+                            n = self.codecs.decoded_nbytes(lid_l)
+                            if n:
+                                layer_sizes[lid_l] = n
+            node_codecs = {n: frozenset(s)
+                           for n, s in self.node_codecs.items()}
             for dest, layer_ids in self.assignment.items():
                 for layer_id, meta in layer_ids.items():
                     if layer_id not in layer_sizes:
@@ -3042,9 +3512,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         # pair with zero wire bytes.
                         continue
                     held = self.status.get(dest, {}).get(layer_id)
-                    if held is not None and shard_covers(held.shard,
-                                                         meta.shard):
-                        # Already in RAM/HBM (at covering shard):
+                    if (held is not None
+                            and shard_covers(held.shard, meta.shard)
+                            and codec_accepts(held.codec, meta.codec)):
+                        # Already in RAM/HBM (at covering shard, in an
+                        # acceptable codec form — a quantized holding
+                        # never stands in for a raw target):
                         # satisfaction counts it as-is — a self-job would
                         # re-send the layer to itself for nothing.
                         # DISK/CLIENT copies DO need the self-fetch
@@ -3101,6 +3574,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     modified, self.status, layer_sizes,
                     self.node_network_bw,
                     remaining=remaining_sizes, topology=self.topology,
+                    codec_sizes=codec_sizes, node_codecs=node_codecs,
                 )
                 t, jobs = graph.get_job_assignment()
             else:
@@ -3112,7 +3586,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     demands, self.status, layer_sizes,
                     self.node_network_bw, remaining=remaining_sizes,
                     topology=self.topology,
-                    graph_factory=make_flow_graph)
+                    graph_factory=make_flow_graph,
+                    codec_sizes=codec_sizes, node_codecs=node_codecs)
                 t = max(t_by_prio.values(), default=0)
                 # Per-job pacing: each send's rate budget comes from its
                 # OWN tier's min time (a preempting tier must not be
@@ -3268,10 +3743,11 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             with self._lock:
                 total = self._layer_size_locked(layer_id)
                 want = (self.assignment.get(dest) or {}).get(layer_id)
-            if want is not None and want.shard:
-                # Sharded targets ride the host path: the fabric plane's
-                # ingest/collectives materialize WHOLE layers only
-                # (docs/sharding.md, honest limits).
+            if want is not None and (want.shard or want.codec):
+                # Sharded and wire-codec targets ride the host path:
+                # the fabric plane's ingest/collectives materialize
+                # WHOLE canonical layers only (docs/sharding.md,
+                # docs/codec.md, honest limits).
                 for j in group:
                     host_jobs.setdefault(j.sender_id, []).append(j)
                 continue
@@ -3330,16 +3806,23 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 )
         with self._lock:
             tier_time = dict(self._tier_time)
+            # Wire-codec commands (docs/codec.md): each job's byte range
+            # indexes the pair's chosen form — the command must say so.
+            pair_codec = {
+                (dest, lid): meta.codec
+                for dest, lids in self.assignment.items()
+                for lid, meta in lids.items() if meta.codec}
         for sender, job_list in jobs.items():
             for job in job_list:
                 dest = job.dest_id
                 t_job = (tier_time.get(job.job_id, min_time_ms)
                          if job.job_id else min_time_ms)
                 rate = rate_for(job.data_size, t_job or min_time_ms)
+                codec = pair_codec.get((dest, job.layer_id), "")
                 log.debug(
                     "dispatching a job",
                     layer=job.layer_id, sender=sender, rate_mibps=rate >> 20,
-                    job=job.job_id or None,
+                    job=job.job_id or None, codec=codec or None,
                 )
                 try:
                     self.node.transport.send(
@@ -3348,6 +3831,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                             self.node.my_id, job.layer_id, dest,
                             job.data_size, job.offset, rate,
                             epoch=self.epoch, job_id=job.job_id,
+                            codec=codec,
                         ),
                     )
                 except (OSError, KeyError) as e:
@@ -3382,16 +3866,23 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 held = self.status.get(dest, {}).get(lid)
                 if (held is not None and delivered(held)
                         and (want is None
-                             or shard_covers(held.shard, want.shard))):
+                             or (shard_covers(held.shard, want.shard)
+                                 and codec_accepts(held.codec,
+                                                   want.codec)))):
                     continue  # already landed whole (target shard covered)
                 if (lid, dest) in self._salvaging:
                     continue
                 # The salvage source must really hold the bytes being
                 # re-requested: the target's shard for sharded pairs,
-                # the whole layer otherwise.
+                # the whole layer otherwise — and for a wire-codec pair,
+                # the exact encoded form (or raw + encode capability).
                 alt = pick_salvage_source(
                     self.status, lid, exclude={node_id, dest},
-                    need_shard=want.shard if want is not None else "")
+                    need_shard=want.shard if want is not None else "",
+                    need_codec=want.codec if want is not None else "",
+                    encoders=frozenset(
+                        n for n, s in self.node_codecs.items()
+                        if want is not None and want.codec in s))
                 if alt is None:
                     continue  # no surviving holder: base re-plan covers it
                 self._salvaging.add((lid, dest))
@@ -3425,7 +3916,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         handle_flow_retransmit(
             self.node, self.layers, self._lock,
             lambda lid, dest: fetch_from_client(self.node, lid, dest), msg,
-            revokes=self.revokes,
+            revokes=self.revokes, codecs=self.codecs,
         )
         dur = time.monotonic() - t0
         log.info(

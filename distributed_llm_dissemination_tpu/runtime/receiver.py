@@ -163,6 +163,7 @@ class ReceiverNode:
         fabric=None,
         boot_codec: str = "raw",
         boot_generate: int = 0,
+        codecs=None,
     ):
         """``boot_cfg``: a ``models.llama.ModelConfig``; when set, the
         startup message boots the model from the delivered layer blobs
@@ -191,7 +192,14 @@ class ReceiverNode:
         onto its own stage devices (seeder half) and ingests plans
         addressed to it over the device fabric (dest half) — layer bytes
         never touch the transport (the reference's per-transfer TCP byte
-        stream, transport.go:267-274, replaced by ICI)."""
+        stream, transport.go:267-274, replaced by ICI).
+
+        ``codecs``: the node's ``runtime.codec.WireCodecPlane``
+        (docs/codec.md).  With it, this node ANNOUNCES its wire-codec
+        decode capability (the leader may then choose quantized
+        transfers for it over slow links), serves encoded byte ranges
+        as a SENDER (flow jobs, NACK retransmits, mode-1/2 forwards),
+        and accounts decoded-vs-wire bytes for the run report."""
         self.node = node
         self.layers = layers
         self.storage_path = storage_path
@@ -249,6 +257,16 @@ class ReceiverNode:
         # the tag, so a v2 delivery can never be mistaken for (or
         # clobbered by) an unversioned copy under the same id.
         self._layer_versions: Dict[int, str] = {}
+        # Wire-codec targets (docs/codec.md): the node's codec plane
+        # (capability + encoded serving), the leader-stamped codec per
+        # assigned layer (interval accounting, journal, and NACK ranges
+        # then live in ENCODED byte space, and the stamped digest is
+        # codec-qualified), and the per-layer advisory frame tag — the
+        # fallback identity when no stamp arrived (digests disabled),
+        # so encoded bytes are never stored as raw.
+        self.codec_plane = codecs
+        self._layer_codecs: Dict[int, str] = {}
+        self._frag_codec: Dict[int, str] = {}
         # The rollout version the serving params were assembled under
         # ("" until a swap commits here).
         self.serving_version = ""
@@ -519,6 +537,7 @@ class ReceiverNode:
                     data_size=src.data_size,
                     shard=src.meta.shard,
                     version=src.meta.version,
+                    codec=src.meta.codec,
                 )
                 for lid, src in self.layers.items()
             }
@@ -536,7 +555,9 @@ class ReceiverNode:
             next_hop,
             AnnounceMsg(self.node.my_id, layer_ids,
                         partial=self._announce_partial(),
-                        digests=self._announce_digests()),
+                        digests=self._announce_digests(),
+                        codecs=(self.codec_plane.decode_codecs()
+                                if self.codec_plane is not None else [])),
         )
         # Telemetry plane: probe the leader's clock (request/response
         # midpoint → the offset cli/trace.py aligns timelines with) and
@@ -632,11 +653,14 @@ class ReceiverNode:
             # SHARD holdings never announce a layer digest: their buffer
             # is only real inside the shard's range, and hashing it as a
             # full layer would poison the leader's stamp collection
-            # (docs/sharding.md).  Their range digest is indexed at
-            # verify time instead.
+            # (docs/sharding.md).  CODEC holdings don't either: their
+            # digest is the digest of the ENCODED form — presenting it
+            # as the canonical layer digest would poison the stamp the
+            # same way (docs/codec.md).  Both index their own key into
+            # the content store at verify time instead.
             todo = [(lid, src) for lid, src in self.layers.items()
                     if lid not in self._own_digests
-                    and not src.meta.shard]
+                    and not src.meta.shard and not src.meta.codec]
         for lid, src in todo:
             d = integrity.digest_layer_src(src)
             if d is not None:
@@ -645,7 +669,8 @@ class ReceiverNode:
         with self._lock:
             return {lid: d for lid, d in self._own_digests.items()
                     if not (self.layers.get(lid) is not None
-                            and self.layers[lid].meta.shard)}
+                            and (self.layers[lid].meta.shard
+                                 or self.layers[lid].meta.codec))}
 
     def handle_layer_digests(self, msg: LayerDigestsMsg) -> None:
         """The leader's expected-digest stamp for this dest's layers;
@@ -660,6 +685,7 @@ class ReceiverNode:
         if self._fence_stale(msg):
             return
         widened = []
+        recoded = []
         with self._lock:
             # A CHANGED stamp (a swap retry superseding a poisoned
             # digest, docs/swap.md) resets the layer's verification
@@ -676,6 +702,29 @@ class ReceiverNode:
             # assigned layer belongs to — stored holdings and acks
             # carry the tag from here on.
             self._layer_versions.update(msg.versions)
+            # Wire-codec stamps (docs/codec.md): which ENCODED form
+            # each assigned layer arrives in.  Leader-authoritative per
+            # dest: a pair whose stamped codec CHANGED (re-targeted to
+            # raw after a takeover, or to a different codec) invalidates
+            # any in-flight partial state — its interval accounting
+            # lives in the OLD form's byte space, and mixing spaces
+            # would assemble garbage — so those layers demote for a
+            # clean redelivery.  A RAW holding under a codec stamp
+            # stays: canonical bytes satisfy every target.
+            for lid in sorted(set(msg.digests) | set(msg.codecs)):
+                new_codec = msg.codecs.get(lid, "")
+                old_codec = self._layer_codecs.get(lid, "")
+                if new_codec == old_codec:
+                    continue
+                src = self.layers.get(lid)
+                held = src.meta.codec if src is not None else ""
+                partial = lid in self._partial_totals_locked()
+                if (held and held != new_codec) or (partial and old_codec):
+                    recoded.append(lid)
+                if new_codec:
+                    self._layer_codecs[lid] = new_codec
+                else:
+                    self._layer_codecs.pop(lid, None)
             # The stamp is leader-authoritative per dest: a layer
             # stamped with a FULL digest and no shard entry — or an
             # explicit ``""`` entry in the shards map (the digests-off
@@ -709,7 +758,12 @@ class ReceiverNode:
                 {l: s for l, s in msg.shards.items() if s})
             self._range_digests.update(msg.range_digests)
         log.debug("layer digests stamped", n=len(msg.digests),
-                  shards=len(msg.shards))
+                  shards=len(msg.shards), codecs=len(msg.codecs))
+        for lid in recoded:
+            log.warn("layer's wire codec re-stamped; dropping stale "
+                     "form for clean redelivery", layerID=lid,
+                     codec=msg.codecs.get(lid, ""))
+            self._demote_corrupt_layer(lid)
         if widened:
             self._reopen_widened(widened)
         self._recheck_stamped(list(msg.digests))
@@ -766,6 +820,12 @@ class ReceiverNode:
         flow receiver re-checks completion; the base receiver has no
         partial state to promote."""
 
+    def _partial_totals_locked(self) -> dict:
+        """Lock held.  In-flight partial transfer totals ({layer:
+        total}) — the flow receiver's reassembly state; the base
+        receiver has none."""
+        return {}
+
     def _recheck_stamped(self, lids) -> None:
         """Retroactive digest verification for layers that landed before
         their stamp arrived (no-op for already-verified ones)."""
@@ -773,6 +833,7 @@ class ReceiverNode:
             with self._lock:
                 src = self.layers.get(lid)
                 done = lid in self._digest_ok
+                stamped_codec = self._layer_codecs.get(lid, "")
             if src is None or done or src.inmem_data is None:
                 continue
             if src.meta.shard:
@@ -780,7 +841,15 @@ class ReceiverNode:
                 # the shard gate; the full-layer stamp doesn't apply to
                 # its buffer (only the shard's range is real).
                 continue
-            if self._verify_layer_digest(lid, memoryview(src.inmem_data)):
+            if src.meta.codec != stamped_codec:
+                # A RAW holding under a codec stamp: the stamped digest
+                # is the ENCODED form's — it can't verify canonical
+                # bytes, and raw satisfies the target anyway
+                # (docs/codec.md).  Mismatched encoded forms were
+                # demoted at stamp time.
+                continue
+            if self._verify_layer_digest(lid, memoryview(src.inmem_data),
+                                         codec=src.meta.codec):
                 continue
             self._demote_corrupt_layer(lid)
             log.error("stamped digest failed for an already-held layer; "
@@ -797,14 +866,19 @@ class ReceiverNode:
         resolve.  Must not be called under ``self._lock``."""
         with self._lock:
             if (self._shard_specs.get(lid)
+                    or self._layer_codecs.get(lid)
                     or (self.layers.get(lid) is not None
-                        and self.layers[lid].meta.shard)):
-                return  # a shard holding can't donate full-layer bytes
+                        and (self.layers[lid].meta.shard
+                             or self.layers[lid].meta.codec))):
+                # A shard or codec holding can't donate full-layer
+                # canonical bytes (its digest keys a different form).
+                return
             digest = (self._own_digests.get(lid)
                       or self.layer_digests.get(lid))
             pending = ([l for l, d in self.layer_digests.items()
                         if d == digest and l not in self.layers
-                        and not self._shard_specs.get(l)]
+                        and not self._shard_specs.get(l)
+                        and not self._layer_codecs.get(l)]
                        if digest else [])
         if pending:
             self._try_content_resolve(sorted(pending))
@@ -827,6 +901,11 @@ class ReceiverNode:
                     # key, which full-layer vouching doesn't carry —
                     # no content resolve for them (docs/sharding.md,
                     # honest limits).
+                    continue
+                if self._layer_codecs.get(lid):
+                    # Codec targets resolve by the (digest, codec) key;
+                    # full-layer raw vouching doesn't carry it — no
+                    # content resolve (docs/codec.md, honest limits).
                     continue
                 digest = self.layer_digests.get(lid)
             if not digest:
@@ -898,6 +977,7 @@ class ReceiverNode:
         with self._lock:
             self.layers.pop(lid, None)
             self._own_digests.pop(lid, None)
+            self._frag_codec.pop(lid, None)
         self.content_store.forget(lid)
         if self._boot_stager is not None:
             self._boot_stager.invalidate(lid)
@@ -939,20 +1019,29 @@ class ReceiverNode:
                    reason) -> None:
         trace.count("integrity.nack_sent")
         telemetry.link_add(src_id, self.node.my_id, nacks=1)
+        # Wire-codec transfers NACK in ENCODED byte space: the codec
+        # rides the NACK so the serving holder retransmits ranges of
+        # the same encoded form (docs/codec.md).
+        with self._lock:
+            codec = (self._layer_codecs.get(layer_id)
+                     or self._frag_codec.get(layer_id, ""))
         log.warn("layer fragment NACKed", layerID=layer_id, src=src_id,
-                 offset=offset, bytes=size, reason=reason)
+                 offset=offset, bytes=size, reason=reason,
+                 codec=codec or None)
         try:
             self.node.add_node(src_id)
             self.node.transport.send(
                 src_id,
                 LayerNackMsg(self.node.my_id, layer_id, offset, size,
-                             total_size=total, reason=reason),
+                             total_size=total, reason=reason,
+                             codec=codec),
             )
         except (OSError, KeyError, ConnectionError) as e:
             log.error("NACK send failed", dest=src_id, layerID=layer_id,
                       err=repr(e))
 
-    def _verify_layer_digest(self, lid, data, shard: str = "") -> bool:
+    def _verify_layer_digest(self, lid, data, shard: str = "",
+                             codec: str = "") -> bool:
         """Check ``data`` against the layer's expected digest; True when
         no digest is known or it matches (memoized — a re-ack never
         re-hashes).  Counts + logs the outcome; the CALLER owns
@@ -961,7 +1050,11 @@ class ReceiverNode:
         ``data`` spans (the caller sliced the shard's range; the
         expected digest is then the stamped RANGE digest, and the
         verified bytes are content-indexed under the (digest, shard)
-        key — docs/sharding.md)."""
+        key — docs/sharding.md).  ``codec``: the wire-codec form the
+        bytes are in — the expected digest is then codec-qualified
+        (the stamp hashed exactly the encoded bytes), and the content
+        index carries the codec so encoded bytes never vouch for a raw
+        pair (docs/codec.md)."""
         expected = self._expected_digest(lid)
         if expected is None:
             return True
@@ -978,14 +1071,17 @@ class ReceiverNode:
                 # The bytes now provably hash to the stamp: seed the
                 # announce cache so a recovery re-announce (replan,
                 # digest retry) never re-hashes gigabytes it already
-                # verified on the handler thread.  (Shard holdings skip
-                # it — their cache entry would be a RANGE digest the
-                # announce must not present as a layer digest.)
-                if not shard:
+                # verified on the handler thread.  (Shard and codec
+                # holdings skip it — their cache entry would be a RANGE
+                # or encoded-form digest the announce must not present
+                # as a canonical layer digest.)
+                if not shard and not codec:
                     self._own_digests[lid] = expected
-            self.content_store.index(lid, expected, shard=shard)
+            self.content_store.index(lid, expected, shard=shard,
+                                     codec=codec)
             log.info("layer digest verified", layerID=lid,
-                     digest_ms=round(dt * 1000, 1), bytes=len(data))
+                     digest_ms=round(dt * 1000, 1), bytes=len(data),
+                     codec=codec or None)
             return True
         trace.count("integrity.digest_mismatch")
         log.error("layer digest MISMATCH", layerID=lid, expected=expected,
@@ -1129,14 +1225,24 @@ class ReceiverNode:
                           offset=fresh.offset, size=fresh.data_size,
                           total=msg.total_size)
                 return
+            # Wire-codec identity (docs/codec.md): the leader's stamp is
+            # authoritative; the frame's advisory tag is the fallback
+            # when no stamp arrived (digests disabled) — encoded bytes
+            # must never be stored as a raw holding.
+            with self._lock:
+                codec = self._layer_codecs.get(msg.layer_id, "")
+            codec = codec or msg.codec
             # Digest-gate whole-layer frames only, and only when a
             # digest is stamped — no byte copy on the unstamped path.
+            # For a codec transfer the stamped digest is the digest of
+            # the ENCODED bytes — exactly what arrived.
             if (self._expected_digest(msg.layer_id) is not None
                     and fresh.data_size == msg.total_size):
                 data = (memoryview(fresh.inmem_data)
                         if fresh.inmem_data is not None
                         else memoryview(fresh.read_bytes()))
-                if not self._verify_layer_digest(msg.layer_id, data):
+                if not self._verify_layer_digest(msg.layer_id, data,
+                                                 codec=codec):
                     # Budgeted like every digest recovery: a corrupt
                     # SOURCE re-serving the same bad bytes must go
                     # loud-and-quiet, not NACK-ping-pong forever.
@@ -1150,7 +1256,8 @@ class ReceiverNode:
                 src = self.layers.get(msg.layer_id)
                 if src is None:
                     src = fresh
-                    src.meta = LayerMeta(location=LayerLocation.INMEM)
+                    src.meta = LayerMeta(location=LayerLocation.INMEM,
+                                         codec=codec)
                     src.offset = 0
                     self.layers[msg.layer_id] = src
                     stored = True
@@ -1162,6 +1269,9 @@ class ReceiverNode:
                 telemetry.link_add(msg.src_id, self.node.my_id,
                                    job=msg.job_id,
                                    delivered_bytes=src.data_size)
+                if codec:
+                    self._count_codec_delivery(msg.layer_id,
+                                               src.data_size, codec)
         log.debug("saved layer in memory", layerID=msg.layer_id)
         loc = self._stage_to_hbm(msg.layer_id, src)
         # Streamed boot staging: this layer's decode + device placement
@@ -1651,6 +1761,19 @@ class ReceiverNode:
         except (OSError, KeyError) as e:
             log.error("re-announce for re-plan failed", err=repr(e))
 
+    def _count_codec_delivery(self, layer_id, wire_bytes: int,
+                              codec: str) -> None:
+        """Account one quantized delivery's wire-vs-decoded bytes
+        (docs/codec.md): the telemetry link table reconciles against
+        ENCODED wire bytes, and these counters carry the decoded side
+        so the run report shows both columns without conflating them."""
+        trace.count("codec.wire_deliveries")
+        trace.count("codec.wire_bytes", wire_bytes)
+        if self.codec_plane is not None:
+            dec = self.codec_plane.decoded_nbytes(layer_id)
+            if dec:
+                trace.count("codec.decoded_bytes", dec)
+
     def _send_ack(self, layer_id, loc, shard: str = "") -> None:
         """THE ack chokepoint: every completion path (whole-layer
         frames, flow reassembly, fabric delivery, content resolve,
@@ -1658,15 +1781,19 @@ class ReceiverNode:
         stamped exactly once — onto the stored holding (announce after
         a restart keeps it) and onto the wire ack (the leader's swap
         fence counts versioned acks) — and the live-swap controller
-        sees every completed layer."""
+        sees every completed layer.  The wire ack also carries the
+        holding's CODEC form (docs/codec.md): the leader records it,
+        so a quantized copy can never satisfy — or be planned as a
+        source for — a raw pair."""
         version = self._layer_versions.get(layer_id, "")
-        if version:
-            with self._lock:
-                src = self.layers.get(layer_id)
-                if src is not None:
-                    src.meta.version = version
+        with self._lock:
+            src = self.layers.get(layer_id)
+            if version and src is not None:
+                src.meta.version = version
+            codec = src.meta.codec if src is not None else ""
         self._send_to_leader(AckMsg(self.node.my_id, layer_id, loc,
-                                    shard=shard, version=version))
+                                    shard=shard, version=version,
+                                    codec=codec))
         if self.swap is not None and version:
             self.swap.on_layer(layer_id)
 
@@ -2141,7 +2268,8 @@ class RetransmitReceiverNode(ReceiverNode):
         self.loop.register(JobRevokeMsg, self.handle_job_revoke)
 
     def handle_layer_nack(self, msg: LayerNackMsg) -> None:
-        self.nacker.handle(self.node, self.layers, self._lock, msg)
+        self.nacker.handle(self.node, self.layers, self._lock, msg,
+                           codecs=self.codec_plane)
 
     def handle_job_revoke(self, msg: JobRevokeMsg) -> None:
         """Preemption revoke (docs/service.md): a re-plan demoted this
@@ -2168,7 +2296,8 @@ class RetransmitReceiverNode(ReceiverNode):
             return
         try:
             send_layer(self.node, msg.dest_id, msg.layer_id, layer,
-                       job_id=msg.job_id, shard=msg.shard)
+                       job_id=msg.job_id, shard=msg.shard,
+                       codec=msg.codec, codecs=self.codec_plane)
         except (OSError, KeyError) as e:
             log.error("failed to send layer", dest=msg.dest_id, err=repr(e))
 
@@ -2181,7 +2310,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                  start_loop: bool = True, heartbeat_interval: float = 0.0,
                  checkpoint_dir: str = "", stage_hbm: bool = False,
                  placement=None, boot_cfg=None, fabric=None,
-                 boot_codec: str = "raw", boot_generate: int = 0):
+                 boot_codec: str = "raw", boot_generate: int = 0,
+                 codecs=None):
         """``checkpoint_dir``: when set, every fragment is journaled there
         and partial layers survive a process restart (resume support —
         absent in the reference, whose partial accounting dies with the
@@ -2253,7 +2383,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                          heartbeat_interval=heartbeat_interval,
                          stage_hbm=stage_hbm, placement=placement,
                          boot_cfg=boot_cfg, fabric=fabric,
-                         boot_codec=boot_codec, boot_generate=boot_generate)
+                         boot_codec=boot_codec, boot_generate=boot_generate,
+                         codecs=codecs)
         # Replay checkpoint-restored coverage into device ingests so a
         # resumed transfer's already-held bytes are on-mesh too.
         if self.stage_hbm:
@@ -2454,6 +2585,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         with self._ingests_lock:
             self._ingest_dead.add(layer_id)
             self._ingests.pop(layer_id, None)
+
+    def _partial_totals_locked(self) -> dict:
+        return self._partial_total
 
     def _announce_partial(self) -> dict:
         """Partial coverage for the announce — EXCLUDING in-flight copy
@@ -2708,6 +2842,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 # duplicate proves the path is alive).
                 self._frag_src[lid] = msg.src_id
                 self._frag_t[lid] = _time.monotonic()
+                # Advisory codec tag (docs/codec.md): remembered so the
+                # promotion (and NACKs) know the transfer's encoded
+                # form even when the leader's stamp never arrived
+                # (digests disabled).
+                if msg.codec:
+                    self._frag_codec[lid] = msg.codec
                 # Journaled OUTSIDE the lock below (two fsyncs per
                 # fragment must not serialize every other handler), and
                 # only for fragments that landed NEW bytes — a full
@@ -2878,9 +3018,16 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     return False
             elif not cov.complete(total):
                 return False
+            # Wire-codec identity (docs/codec.md): the stamp is
+            # authoritative, the frames' advisory tag the fallback —
+            # the promoted holding (and its ack) must carry the form
+            # its bytes are actually in.
+            codec = (self._layer_codecs.get(lid)
+                     or self._frag_codec.pop(lid, ""))
             self.layers[lid] = LayerSrc(
                 inmem_data=buf, data_size=total,
-                meta=LayerMeta(location=LayerLocation.INMEM, shard=spec),
+                meta=LayerMeta(location=LayerLocation.INMEM, shard=spec,
+                               codec=codec),
             )
             del self._partial[lid]
             self._partial_total.pop(lid, None)
@@ -2889,6 +3036,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             self._frag_src.pop(lid, None)
             self._frag_t.pop(lid, None)
             ph = self._phase.pop(lid, None)
+        if codec:
+            self._count_codec_delivery(lid, total, codec)
         if self.ckpt is not None:
             self.ckpt.complete(lid)
         extra = {}
@@ -2986,7 +3135,8 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             view = memoryview(src.inmem_data)[s0:s0 + s_sz]
         else:
             view = memoryview(src.inmem_data)
-        if self._verify_layer_digest(lid, view, shard=shard):
+        if self._verify_layer_digest(lid, view, shard=shard,
+                                     codec=src.meta.codec):
             return True
         self._demote_corrupt_layer(lid)
         if self._bump_digest_retry(lid):
@@ -3008,7 +3158,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         handle_flow_retransmit(
             self.node, self.layers, self._lock,
             lambda lid, dest: fetch_from_client(self.node, lid, dest), msg,
-            revokes=self.revokes,
+            revokes=self.revokes, codecs=self.codec_plane,
         )
         dur = _time.monotonic() - t0
         log.info(

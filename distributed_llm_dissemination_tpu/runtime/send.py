@@ -54,8 +54,30 @@ def _fragment_bytes(rate: int) -> int:
     return FLOW_FRAGMENT_BYTES
 
 
+def _codec_view(layer: LayerSrc, layer_id: LayerID, codec: str,
+                codecs) -> Optional[LayerSrc]:
+    """The LayerSrc a transfer at wire-codec ``codec`` reads its bytes
+    — and byte SPACE — from (docs/codec.md): the holding itself when it
+    already is that encoded form (encoded bytes forward verbatim, no
+    decode/re-encode round trip), the cached encoded form of a
+    canonical holding otherwise (``codecs`` is the node's
+    ``WireCodecPlane``).  None = this holder cannot produce those exact
+    bytes (wrong encoded form, or no encode capability) — the caller
+    must refuse loudly rather than ship bytes the dest will account in
+    a different byte space."""
+    if not codec:
+        return layer
+    held = getattr(layer.meta, "codec", "")
+    if held == codec:
+        return layer
+    if held or codecs is None:
+        return None
+    return codecs.encoded_src(layer_id, layer, codec)
+
+
 def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
-               job_id: str = "", shard: str = "") -> None:
+               job_id: str = "", shard: str = "", codec: str = "",
+               codecs=None) -> None:
     """Send one full layer to ``dest``; client-held layers are fetched via
     the pipe mechanism instead (node.go:354-365).  ``job_id`` tags the
     frames with the admitted dissemination job they serve ("" = the base
@@ -66,24 +88,39 @@ def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
     the full layer size, so the dest's interval accounting speaks
     absolute layer coordinates) — the whole-layer path for modes 0-2
     honoring a sharded target.  Client-held layers can't range-serve
-    and fall back to the full-layer pipe fetch (over-delivery is safe)."""
+    and fall back to the full-layer pipe fetch (over-delivery is safe).
+
+    ``codec`` (docs/codec.md): ship the layer's ENCODED form — the
+    wire total (and any shard range) then lives in encoded byte space,
+    and the frames carry the codec tag.  Client-held layers can't
+    encode-serve; they fall back to the raw pipe fetch (the dest's
+    digest gate treats the raw bytes as a raw delivery — raw satisfies
+    every target)."""
     if layer.meta.location == LayerLocation.CLIENT:
         log.debug("loading layer from client", layer=layer_id)
         fetch_from_client(node, layer_id, dest)
         return
+    view = _codec_view(layer, layer_id, codec, codecs)
+    if view is None:
+        log.error("cannot serve layer at commanded wire codec",
+                  layerID=layer_id, codec=codec,
+                  held=getattr(layer.meta, "codec", ""))
+        return
+    if codec:
+        trace.count("codec.wire_sends")
     if shard:
-        off, size = shard_range(shard, layer.data_size)
-        sub = _sub_layer_src(layer, _sendable_location(layer), off, size,
+        off, size = shard_range(shard, view.data_size)
+        sub = _sub_layer_src(view, _sendable_location(view), off, size,
                              layer.meta.limit_rate)
         trace.count("shard.range_sends")
         node.transport.send(
-            dest, LayerMsg(node.my_id, layer_id, sub, layer.data_size,
-                           job_id=job_id, shard=shard)
+            dest, LayerMsg(node.my_id, layer_id, sub, view.data_size,
+                           job_id=job_id, shard=shard, codec=codec)
         )
         return
     node.transport.send(
-        dest, LayerMsg(node.my_id, layer_id, layer, layer.data_size,
-                       job_id=job_id)
+        dest, LayerMsg(node.my_id, layer_id, view, view.data_size,
+                       job_id=job_id, codec=codec)
     )
 
 
@@ -187,8 +224,13 @@ class NackRetransmitter:
         self.LIMIT = int(os.environ.get("DLD_NACK_RETRY_LIMIT", "6"))
 
     def handle(self, node: Node, layers: LayersSrc, lock: threading.Lock,
-               msg) -> bool:
-        """Serve one NACK; True when the range was re-sent."""
+               msg, codecs=None) -> bool:
+        """Serve one NACK; True when the range was re-sent.  A NACK
+        carrying a wire codec (docs/codec.md) names a range of the
+        ENCODED blob: it is served from the same-codec holding (or the
+        cached encoded form of a canonical one, ``codecs``) so the
+        retransmitted bytes are byte-identical to the originals —
+        NACK/retransmit recovery runs entirely in encoded space."""
         key = (msg.src_id, msg.layer_id, msg.offset)
         with self._lock:
             n = self._counts.get(key, 0) + 1
@@ -210,18 +252,27 @@ class NackRetransmitter:
             log.error("NACK for a client-held layer; cannot range-serve "
                       "it from here", layerID=msg.layer_id)
             return False
-        send_loc = _sendable_location(layer)
-        size = min(msg.size, max(0, layer.data_size - msg.offset))
+        codec = getattr(msg, "codec", "")
+        view = _codec_view(layer, msg.layer_id, codec, codecs)
+        if view is None:
+            log.error("NACK names a wire codec this holder cannot serve",
+                      layerID=msg.layer_id, codec=codec,
+                      held=getattr(layer.meta, "codec", ""))
+            return False
+        send_loc = _sendable_location(view)
+        size = min(msg.size, max(0, view.data_size - msg.offset))
         if size <= 0:
             log.error("NACK names an out-of-range span", layerID=msg.layer_id,
                       offset=msg.offset, size=msg.size,
-                      layer_size=layer.data_size)
+                      layer_size=view.data_size)
             return False
         if layer.meta.shard:
             # A SHARD holder's buffer is only real inside its shard's
             # range — serving bytes outside it would retransmit garbage
-            # as verified-looking frames (docs/sharding.md).
-            s0, sz = shard_range(layer.meta.shard, layer.data_size)
+            # as verified-looking frames (docs/sharding.md).  For a
+            # codec shard-holding the range lives in encoded space, the
+            # same space the holding's buffer is real in.
+            s0, sz = shard_range(layer.meta.shard, view.data_size)
             if msg.offset < s0 or msg.offset + size > s0 + sz:
                 log.error("NACK names bytes outside this holder's shard; "
                           "cannot range-serve them from here",
@@ -232,18 +283,19 @@ class NackRetransmitter:
         # Retransmits honor the holder's modeled source rate — a NACK
         # must not let a rate-limited seeder exceed what its source
         # could physically serve.
-        sub = _sub_layer_src(layer, send_loc, msg.offset, size,
+        sub = _sub_layer_src(view, send_loc, msg.offset, size,
                              layer.meta.limit_rate)
         log.warn("NACK retransmit", layerID=msg.layer_id, dest=msg.src_id,
                  offset=msg.offset, bytes=size, reason=msg.reason,
-                 attempt=n)
+                 attempt=n, codec=codec or None)
         trace.count("integrity.retransmit_frags")
         trace.count("integrity.retransmit_bytes", size)
         telemetry.link_add(node.my_id, msg.src_id,
                            retransmit_frames=1, retransmit_bytes=size)
         node.transport.send(
             msg.src_id,
-            LayerMsg(node.my_id, msg.layer_id, sub, layer.data_size),
+            LayerMsg(node.my_id, msg.layer_id, sub, view.data_size,
+                     codec=codec),
         )
         return True
 
@@ -439,6 +491,7 @@ def handle_flow_retransmit(
     fetch_fn: Callable[[LayerID, NodeID], None],
     msg: FlowRetransmitMsg,
     revokes: "Optional[RevokeRegistry]" = None,
+    codecs=None,
 ) -> None:
     """Execute one flow job: send ``[offset, offset+data_size)`` of a layer
     to the dest at the commanded rate (node.go:1592-1643).
@@ -449,6 +502,13 @@ def handle_flow_retransmit(
     landing mid-job stops the remaining fragments — either way the
     re-plan that issued the revoke re-dispatches the pair at the
     demoted tier's budget.
+
+    ``codecs`` (docs/codec.md): the sender's wire-codec plane.  A job
+    carrying a codec indexes the ENCODED blob — the commanded byte
+    range, every emitted fragment, and the wire total all live in
+    encoded space, read from the cached encoded form (or a same-codec
+    holding verbatim).  A holder that can't produce those bytes refuses
+    loudly (the leader's arc filter should never have picked it).
 
     The ClientLayer branch simulates a rate-limited fetch from the node's
     own external client, then loops the partial layer back into the node's
@@ -468,7 +528,18 @@ def handle_flow_retransmit(
         return
     node.add_node(msg.dest_id)
 
-    send_loc = _sendable_location(layer)
+    codec = getattr(msg, "codec", "")
+    view = layer
+    if codec and layer.meta.location != LayerLocation.CLIENT:
+        view = _codec_view(layer, msg.layer_id, codec, codecs)
+        if view is None:
+            log.error("flow job commands a wire codec this holder "
+                      "cannot serve", layerID=msg.layer_id, codec=codec,
+                      held=getattr(layer.meta, "codec", ""))
+            return
+        trace.count("codec.wire_sends")
+
+    send_loc = _sendable_location(view)
     if send_loc in (LayerLocation.INMEM, LayerLocation.DISK):
         frag_bytes = _fragment_bytes(msg.rate)
         sent = 0
@@ -482,12 +553,12 @@ def handle_flow_retransmit(
                          job=msg.job_id, sent=sent)
                 return
             n = min(frag_bytes, msg.data_size - sent)
-            partial = _sub_layer_src(layer, send_loc, msg.offset + sent, n,
+            partial = _sub_layer_src(view, send_loc, msg.offset + sent, n,
                                      msg.rate)
             node.transport.send(
                 msg.dest_id,
-                LayerMsg(node.my_id, msg.layer_id, partial, layer.data_size,
-                         job_id=msg.job_id),
+                LayerMsg(node.my_id, msg.layer_id, partial, view.data_size,
+                         job_id=msg.job_id, codec=codec),
             )
             sent += n
     elif layer.meta.location == LayerLocation.CLIENT:

@@ -23,11 +23,18 @@ Two small classes, two sides of the same key:
   resolve-and-ack completes the pair.
 
 Shard scoping (docs/sharding.md): every entry is keyed by
-``(digest, shard)`` — a SHARD holding's digest is the digest of its
-byte RANGE, verified over exactly those bytes, so it can only ever
+``(digest, shard, codec)`` — a SHARD holding's digest is the digest of
+its byte RANGE, verified over exactly those bytes, so it can only ever
 vouch for (and alias to) a target with the SAME range.  A full-layer
 query (``shard=""``) never matches a shard-vouched entry: a
 shard-holder can never ack a full-layer pair.
+
+Codec scoping (docs/codec.md): the third key component is the
+wire-codec form.  A quantized holding's digest is the digest of the
+ENCODED bytes — a different byte string than the canonical layer — so
+it vouches only under ``(digest, shard, codec)``: a raw query can never
+match an int8-vouched entry, and a quantized copy can never
+alias-complete (or be planned as already-holding) a raw pair.
 
 Digest trust model: both sides only index digests that were locally
 verified (node) or announced/stamped through the PR-4 integrity plane
@@ -42,23 +49,25 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..core.types import LayerID, NodeID
 
-# The (digest, shard) content key; shard "" = the whole layer.
-ContentKey = Tuple[str, str]
+# The (digest, shard, codec) content key; shard "" = the whole layer,
+# codec "" = canonical bytes.
+ContentKey = Tuple[str, str, str]
 
 
 class ContentStore:
-    """(digest, shard) → layer ids this node holds with those exact
-    bytes over exactly that range."""
+    """(digest, shard, codec) → layer ids this node holds with those
+    exact bytes over exactly that range in exactly that form."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._by_key: Dict[ContentKey, Set[LayerID]] = {}
         self._by_layer: Dict[LayerID, ContentKey] = {}
 
-    def index(self, lid: LayerID, digest: str, shard: str = "") -> None:
+    def index(self, lid: LayerID, digest: str, shard: str = "",
+              codec: str = "") -> None:
         if not digest:
             return
-        key = (str(digest), str(shard))
+        key = (str(digest), str(shard), str(codec))
         with self._lock:
             old = self._by_layer.get(lid)
             if old == key:
@@ -84,12 +93,13 @@ class ContentStore:
                     if not ids:
                         del self._by_key[key]
 
-    def lookup(self, digest: str, shard: str = "") -> Optional[LayerID]:
+    def lookup(self, digest: str, shard: str = "",
+               codec: str = "") -> Optional[LayerID]:
         """A local layer id holding these bytes over this exact range
-        (lowest id for determinism), or None.  A full-layer lookup
-        (``shard=""``) only matches full-layer holdings."""
+        in this exact form (lowest id for determinism), or None.  A
+        full-layer raw lookup only matches full-layer raw holdings."""
         with self._lock:
-            ids = self._by_key.get((str(digest), str(shard)))
+            ids = self._by_key.get((str(digest), str(shard), str(codec)))
             return min(ids) if ids else None
 
     def digest_of(self, lid: LayerID) -> Optional[str]:
@@ -102,13 +112,18 @@ class ContentStore:
             key = self._by_layer.get(lid)
             return key[1] if key is not None else None
 
+    def codec_of(self, lid: LayerID) -> Optional[str]:
+        with self._lock:
+            key = self._by_layer.get(lid)
+            return key[2] if key is not None else None
+
     def size(self) -> int:
         with self._lock:
             return len(self._by_layer)
 
 
 class ContentIndex:
-    """Leader-side (digest, shard) → holders map.
+    """Leader-side (digest, shard, codec) → holders map.
 
     An announce is the node's authoritative inventory, so
     :meth:`reset_node` replaces that node's contribution wholesale
@@ -118,48 +133,52 @@ class ContentIndex:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # node -> {layer: (digest, shard)}; digest->holders is derived.
+        # node -> {layer: (digest, shard, codec)}; digest->holders is
+        # derived.
         self._node_layers: Dict[NodeID, Dict[LayerID, ContentKey]] = {}
 
     def reset_node(self, node: NodeID,
                    digests: Optional[Dict[LayerID, str]] = None) -> None:
         """Replace a node's vouching with its announce-time FULL-layer
-        digests (shard holdings announce no layer digest — a range hash
-        as a layer digest would poison the stamp collection)."""
+        canonical digests (shard and codec holdings announce no layer
+        digest — a range or encoded-form hash as a layer digest would
+        poison the stamp collection)."""
         with self._lock:
             if digests:
                 self._node_layers[node] = {
-                    int(l): (str(d), "") for l, d in digests.items()}
+                    int(l): (str(d), "", "") for l, d in digests.items()}
             else:
                 self._node_layers.pop(node, None)
 
     def add(self, node: NodeID, lid: LayerID, digest: Optional[str],
-            shard: str = "") -> None:
+            shard: str = "", codec: str = "") -> None:
         if not digest:
             return
         with self._lock:
-            self._node_layers.setdefault(node, {})[lid] = (str(digest),
-                                                           str(shard))
+            self._node_layers.setdefault(node, {})[lid] = (
+                str(digest), str(shard), str(codec))
 
     def drop_node(self, node: NodeID) -> None:
         with self._lock:
             self._node_layers.pop(node, None)
 
-    def node_has(self, node: NodeID, digest: str, shard: str = "") -> bool:
+    def node_has(self, node: NodeID, digest: str, shard: str = "",
+                 codec: str = "") -> bool:
         """Whether ``node`` provably holds bytes hashing to ``digest``
-        over exactly ``shard``'s range, under ANY layer id.  A
-        full-layer query never matches a shard-vouched holding."""
+        over exactly ``shard``'s range in exactly ``codec``'s form,
+        under ANY layer id.  A full-layer raw query never matches a
+        shard- or codec-vouched holding."""
         if not digest:
             return False
-        key = (str(digest), str(shard))
+        key = (str(digest), str(shard), str(codec))
         with self._lock:
             return key in (self._node_layers.get(node) or {}).values()
 
-    def holders(self, digest: str,
-                shard: str = "") -> List[Tuple[NodeID, LayerID]]:
-        """Every (node, layer) currently vouched for (digest, shard),
-        sorted."""
-        key = (str(digest), str(shard))
+    def holders(self, digest: str, shard: str = "",
+                codec: str = "") -> List[Tuple[NodeID, LayerID]]:
+        """Every (node, layer) currently vouched for (digest, shard,
+        codec), sorted."""
+        key = (str(digest), str(shard), str(codec))
         out: List[Tuple[NodeID, LayerID]] = []
         with self._lock:
             for node in sorted(self._node_layers):

@@ -316,10 +316,17 @@ class StreamingBootStager:
         bits.  Consumable device blobs (``blob_donate_ok``: host
         fallback retained) are released by reference inside the helper
         the moment their decode is dispatched: HBM peaks at params-so-
-        far + the in-flight blob, not params + every wire blob."""
+        far + the in-flight blob, not params + every wire blob.
+
+        Per-blob codec (docs/codec.md): a blob delivered under a
+        negotiated WIRE codec decodes under ITS form, not the run's —
+        this is the decode-during-staging half of the quantized wire
+        path (the dequant rides the same per-blob device jit the
+        global-codec runs use)."""
         from .boot import stage_blob_leaves, verify_blob_digest
 
         verify_blob_digest(blob_id, src, self.digest_lookup,
                            self.digest_verified)
-        return stage_blob_leaves(self.cfg, blob_id, src, codec=self.codec,
+        codec = getattr(src.meta, "codec", "") or self.codec
+        return stage_blob_leaves(self.cfg, blob_id, src, codec=codec,
                                  sharding=self._sharding())

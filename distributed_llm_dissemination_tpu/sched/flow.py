@@ -60,6 +60,7 @@ from ..core.types import (
     LayerID,
     NodeID,
     Status,
+    codec_accepts,
     shard_covers,
     shard_range,
 )
@@ -78,7 +79,9 @@ def rate_for(data_size: int, t_ms: int) -> int:
 
 def pick_salvage_source(status: Status, layer_id: LayerID,
                         exclude=frozenset(),
-                        need_shard: str = "") -> Optional[NodeID]:
+                        need_shard: str = "",
+                        need_codec: str = "",
+                        encoders=frozenset()) -> Optional[NodeID]:
     """The surviving holder a dest should re-fetch a dead source's
     unsent byte ranges from (runtime/leader range salvage,
     docs/failover.md): fastest modeled source rate first (0 =
@@ -86,8 +89,14 @@ def pick_salvage_source(status: Status, layer_id: LayerID,
     held copies can't serve byte-range NACK retransmits, so they never
     qualify; neither does a shard-holder whose shard doesn't cover the
     range being salvaged (``need_shard`` — "" means the whole layer is
-    needed, so only full holders qualify).  None = no survivor holds the
-    layer — the caller falls back to a whole-layer re-plan."""
+    needed, so only full holders qualify).  ``need_codec``
+    (docs/codec.md): the transfer's wire-codec form — a holder
+    qualifies only when it holds that exact encoded form, or holds
+    canonical bytes AND can encode (a member of ``encoders``): the
+    salvage ranges index the encoded blob, and a holder that can't
+    reproduce those exact bytes would serve garbage as verified-looking
+    frames.  None = no qualified survivor — the caller falls back to a
+    whole-layer re-plan."""
     from ..core.types import LayerLocation
 
     best: Optional[NodeID] = None
@@ -99,6 +108,12 @@ def pick_salvage_source(status: Status, layer_id: LayerID,
         if meta is None or meta.location == LayerLocation.CLIENT:
             continue
         if not shard_covers(meta.shard, need_shard):
+            continue
+        held_codec = getattr(meta, "codec", "")
+        if held_codec:
+            if held_codec != need_codec:
+                continue
+        elif need_codec and nid not in encoders:
             continue
         rate = meta.limit_rate if meta.limit_rate != 0 else _INF
         if rate > best_rate:
@@ -249,6 +264,8 @@ def solve_joint(
     remaining: Optional[Dict[Tuple[LayerID, NodeID], int]] = None,
     topology: Optional["PodTopology"] = None,
     graph_factory=None,
+    codec_sizes: Optional[Dict[Tuple[LayerID, str], int]] = None,
+    node_codecs: Optional[Dict[NodeID, frozenset]] = None,
 ) -> Tuple[Dict[int, int], FlowJobsMap]:
     """All active jobs' remaining demands as ONE flow problem per
     priority tier (docs/service.md) — the multi-job generalization of a
@@ -294,10 +311,14 @@ def solve_joint(
     used_rate: Dict[NodeID, int] = {}
     out_jobs: FlowJobsMap = {}
     t_by_prio: Dict[int, int] = {}
-    # (layer, dest) -> shard spec already planned by a HIGHER tier this
-    # solve: the cross-tier in-flight dedup (docs/service.md "remaining
-    # openings") — one delivery satisfies every job wanting the pair.
-    planned_pairs: Dict[Tuple[LayerID, NodeID], str] = {}
+    # (layer, dest) -> (shard spec, codec) already planned by a HIGHER
+    # tier this solve: the cross-tier in-flight dedup (docs/service.md
+    # "remaining openings") — one delivery satisfies every job wanting
+    # the pair.  Codec-qualified: a pair planned quantized never dedups
+    # a raw want (different bytes — docs/codec.md); in practice all
+    # tiers read one meta per (dest, layer) from the merged goal, so
+    # the qualifier is a guard, not a divergence source.
+    planned_pairs: Dict[Tuple[LayerID, NodeID], Tuple[str, str]] = {}
     # Descending priority; within one priority, the un-avoiding group
     # first (deterministic).
     for prio, avoid in sorted(tiers, key=lambda k: (-k[0], k[1])):
@@ -308,8 +329,10 @@ def solve_joint(
                 row = merged.setdefault(dest, {})
                 for lid, meta in lids.items():
                     spec = getattr(meta, "shard", "")
+                    codec = getattr(meta, "codec", "")
                     prior = planned_pairs.get((lid, dest))
-                    if prior is not None and shard_covers(prior, spec):
+                    if (prior is not None and shard_covers(prior[0], spec)
+                            and codec_accepts(prior[1], codec)):
                         # A higher tier already ships (>=) these bytes
                         # to this dest; the ack will credit this job
                         # too — planning it again would be duplicate
@@ -346,7 +369,11 @@ def solve_joint(
             v = rem.get((lid, dest))
             if v is not None:
                 return v
-            total = layer_sizes.get(lid, 0)
+            codec = getattr(meta, "codec", "")
+            total = ((codec_sizes or {}).get((lid, codec))
+                     if codec else None)
+            if total is None:
+                total = layer_sizes.get(lid, 0)
             spec = getattr(meta, "shard", "")
             return shard_range(spec, total)[1] if spec else total
 
@@ -358,7 +385,8 @@ def solve_joint(
             status_view = {n: row for n, row in status.items()
                            if n not in set(avoid)}
         graph = factory(merged, status_view, layer_sizes, bw_res,
-                        remaining=rem, topology=topology)
+                        remaining=rem, topology=topology,
+                        codec_sizes=codec_sizes, node_codecs=node_codecs)
         t, jobs = graph.get_job_assignment()
         planned = sum(j.data_size for jl in jobs.values() for j in jl)
         if avoid and planned < required:
@@ -370,7 +398,9 @@ def solve_joint(
                      avoided=list(avoid), planned=planned,
                      required=required)
             graph = factory(merged, status, layer_sizes, bw_res,
-                            remaining=rem, topology=topology)
+                            remaining=rem, topology=topology,
+                            codec_sizes=codec_sizes,
+                            node_codecs=node_codecs)
             t, jobs = graph.get_job_assignment()
         t_by_prio[prio] = max(t_by_prio.get(prio, 0), t)
         per_dest: Dict[NodeID, int] = {}
@@ -392,14 +422,15 @@ def solve_joint(
             for dest, nbytes in per_dest.items():
                 used_rate[dest] = (used_rate.get(dest, 0)
                                    + nbytes * TIME_SCALE // max(1, t))
-        # Record this tier's planned pairs (shard-qualified) so LOWER
-        # tiers dedup against them instead of re-shipping in-flight
-        # bytes.  First (highest) tier's spec stands — the dedup test is
-        # coverage, not equality.
+        # Record this tier's planned pairs (shard- and codec-qualified)
+        # so LOWER tiers dedup against them instead of re-shipping
+        # in-flight bytes.  First (highest) tier's spec stands — the
+        # dedup test is coverage, not equality.
         for dest, lids in merged.items():
             for lid, meta in lids.items():
-                planned_pairs.setdefault((lid, dest),
-                                         getattr(meta, "shard", ""))
+                planned_pairs.setdefault(
+                    (lid, dest), (getattr(meta, "shard", ""),
+                                  getattr(meta, "codec", "")))
         log.info("joint tier solved", priority=prio, min_time_ms=t,
                  jobs=sorted({jid for jid, _ in tiers[(prio, avoid)]}),
                  avoided=list(avoid))
@@ -551,16 +582,35 @@ class FlowGraph:
         node_network_bw: Dict[NodeID, int],
         remaining: Optional[Dict[Tuple[LayerID, NodeID], int]] = None,
         topology: Optional[PodTopology] = None,
+        codec_sizes: Optional[Dict[Tuple[LayerID, str], int]] = None,
+        node_codecs: Optional[Dict[NodeID, frozenset]] = None,
     ):
         """``remaining``: optional per-(layer, dest) byte overrides — a
         resumed dest needs only its gap bytes, not the full layer.
         ``topology``: multi-slice shape; cross-slice flow then shares the
-        per-pair DCN capacity edges (module docstring)."""
+        per-pair DCN capacity edges (module docstring).
+
+        Wire codecs (docs/codec.md): a pair whose assignment meta names
+        a codec is sized by its ENCODED bytes — ``codec_sizes`` maps
+        (layer, codec) to the exact wire size (quant.blob_nbytes_codec)
+        — which is the demand-side formulation of "a quantized copy's
+        effective link capacity is bandwidth x (raw/encoded)": moving E
+        encoded bytes at link rate B takes E/B = raw/(B x ratio)
+        seconds, so budgets, predictions, and tier preemption all
+        shrink by the compression ratio with the link model untouched.
+        ``node_codecs`` maps sender → the codecs it can ENCODE; arc
+        admissibility (``_arc_ok``) then guarantees a quantized pair is
+        only ever planned from a same-codec holder (encoded bytes serve
+        verbatim) or a raw holder that can encode — and a quantized
+        HOLDER is never planned as a source for a raw (or
+        other-codec) pair."""
         self.assignment = assignment
         self.layer_sizes = layer_sizes
         self.node_network_bw = node_network_bw
         self.remaining = remaining or {}
         self.topology = topology
+        self.codec_sizes = codec_sizes or {}
+        self.node_codecs = node_codecs or {}
         self._slice: Dict[NodeID, int] = (
             topology.slices() if topology is not None else {}
         )
@@ -587,11 +637,17 @@ class FlowGraph:
         # that layer — a 1/8 holder can serve a matching 1/8 target but
         # must never be planned as a full-layer source.
         self._pair_shard: Dict[Tuple[LayerID, NodeID], str] = {}
+        # (layer, dest) -> the pair's chosen wire codec (docs/codec.md);
+        # absent = canonical bytes.
+        self._pair_codec: Dict[Tuple[LayerID, NodeID], str] = {}
         for dest, layers in assignment.items():
             for lid, meta in layers.items():
                 spec = getattr(meta, "shard", "")
                 if spec:
                     self._pair_shard[(lid, dest)] = spec
+                codec = getattr(meta, "codec", "")
+                if codec:
+                    self._pair_codec[(lid, dest)] = codec
         self.status = status = self._filter_shard_senders(status)
 
         self.idx: Dict[_V, int] = {}
@@ -658,13 +714,49 @@ class FlowGraph:
             out[node_id] = keep if len(keep) != len(row) else row
         return out
 
+    def _pair_total(self, layer_id: LayerID, dest: NodeID) -> int:
+        """The pair's transfer-space total: the ENCODED byte count for a
+        codec pair (its offsets, shard ranges, and interval accounting
+        all live in encoded space — docs/codec.md), the canonical layer
+        size otherwise."""
+        codec = self._pair_codec.get((layer_id, dest))
+        if codec:
+            enc = self.codec_sizes.get((layer_id, codec))
+            if enc is not None:
+                return enc
+        return self.layer_sizes[layer_id]
+
     def _pair_base(self, layer_id: LayerID, dest: NodeID) -> int:
         """Absolute byte offset the pair's delivery starts at: the shard
-        base for sharded targets, 0 otherwise."""
+        base for sharded targets (in the pair's transfer space), 0
+        otherwise."""
         spec = self._pair_shard.get((layer_id, dest))
         if not spec:
             return 0
-        return shard_range(spec, self.layer_sizes[layer_id])[0]
+        return shard_range(spec, self._pair_total(layer_id, dest))[0]
+
+    def _arc_ok(self, sender: NodeID, meta, layer_id: LayerID,
+                dest: NodeID) -> bool:
+        """Whether ``sender``'s holding may serve THIS (layer, dest)
+        pair (docs/codec.md).  A quantized holding serves only pairs
+        planned at exactly its codec (the encoded bytes forward
+        verbatim — this is what lets a quantized copy re-seed other
+        dests with no decode/re-encode round trip), and NEVER a raw
+        pair; a canonical holding serves raw pairs always and quantized
+        pairs only when the sender can encode — and is NOT client-held
+        (the client pipe streams raw bytes the node never touches, so
+        it can't encode regardless of the node's own capability)."""
+        want = self._pair_codec.get((layer_id, dest), "")
+        held = getattr(meta, "codec", "")
+        if held:
+            return held == want
+        if want:
+            from ..core.types import LayerLocation
+
+            if meta.location == LayerLocation.CLIENT:
+                return False
+            return want in self.node_codecs.get(sender, ())
+        return True
 
     def seed_pair_offsets(self) -> Dict[Tuple[LayerID, NodeID], int]:
         """Initial per-pair byte offsets for job decomposition.  Pairs
@@ -698,14 +790,17 @@ class FlowGraph:
     def _pair_size(self, layer_id: LayerID, dest: NodeID) -> int:
         """Bytes still needed by ``dest`` for ``layer_id``: a resume
         override if the caller gave one, else the target SHARD's bytes
-        (docs/sharding.md), else the full layer."""
+        (docs/sharding.md) of the pair's transfer-space total — the
+        ENCODED size for a codec pair (docs/codec.md), so a quantized
+        transfer books 1/ratio of the link budget a raw one would."""
         override = self.remaining.get((layer_id, dest))
         if override is not None:
             return override
+        total = self._pair_total(layer_id, dest)
         spec = self._pair_shard.get((layer_id, dest))
         if spec:
-            return shard_range(spec, self.layer_sizes[layer_id])[1]
-        return self.layer_sizes[layer_id]
+            return shard_range(spec, total)[1]
+        return total
 
     def _build(self, t: int) -> None:
         """(Re)build edge capacities for candidate time t (flow.go:221-270)."""
@@ -739,6 +834,8 @@ class FlowGraph:
                     self._class_capacity(node_id, meta.limit_rate, t),
                 )
                 for dest in dests:
+                    if not self._arc_ok(node_id, meta, layer_id, dest):
+                        continue  # codec-inadmissible sender (docs/codec.md)
                     layer = self.idx[
                         _V("layer", layer_id=layer_id, node_id=dest)
                     ]
@@ -849,9 +946,10 @@ class FlowGraph:
             def holds(sup: Tuple[NodeID, int],
                       dem: Tuple[LayerID, NodeID]) -> bool:
                 node_id, st = sup
-                lid, _ = dem
+                lid, dem_dest = dem
                 meta = self.status.get(node_id, {}).get(lid)
-                return meta is not None and int(meta.source_type) == st
+                return (meta is not None and int(meta.source_type) == st
+                        and self._arc_ok(node_id, meta, lid, dem_dest))
 
             split = _transport(supplies, demands, holds)
             if split is None:
@@ -870,6 +968,8 @@ class FlowGraph:
             for layer_id in sorted(self.status[node_id]):
                 meta = self.status[node_id][layer_id]
                 for dest in self.dests_of.get(layer_id, ()):
+                    if not self._arc_ok(node_id, meta, layer_id, dest):
+                        continue
                     arcs.append(
                         (node_id, int(meta.source_type), layer_id, dest))
         return arcs
@@ -969,7 +1069,9 @@ class FlowGraph:
         NativeFlowGraph's degrade on the C++ Dinic."""
         log.error("topology solve degraded to flat replan", why=why)
         flat = type(self)(self.assignment, self.status, self.layer_sizes,
-                          self.node_network_bw, remaining=self.remaining)
+                          self.node_network_bw, remaining=self.remaining,
+                          codec_sizes=self.codec_sizes,
+                          node_codecs=self.node_codecs)
         return flat.get_job_assignment()
 
     @staticmethod

@@ -32,6 +32,7 @@ from ..core.types import (
     LayerID,
     NodeID,
     Status,
+    codec_accepts,
     delivered,
     layer_ids_from_json,
     layer_ids_to_json,
@@ -89,6 +90,10 @@ class Job:
     resolved_at_admit: int = 0  # pairs already satisfied when admitted
     dropped_pairs: int = 0      # pairs lost to crashed dests
     admit_ms: float = 0.0       # submitter wall clock (advisory)
+    # Submitter identity (docs/service.md, quotas): the token-derived
+    # identity the job was admitted under — per-submitter quota and
+    # rate-limit accounting keys on it.  "" = pre-quota record.
+    submitter: str = ""
 
     def summary(self) -> dict:
         """JSON-ready status row (JobStatusMsg / -jobs / run report)."""
@@ -179,7 +184,8 @@ class JobManager:
     # ----------------------------------------------------------- accounting
 
     def on_ack(self, dest: NodeID, lid: LayerID,
-               shard: str = "", version: str = "") -> List[str]:
+               shard: str = "", version: str = "",
+               codec: str = "") -> List[str]:
         """Credit one delivered (dest, layer) pair against every active
         job that wants it; returns the job ids the ack completed.
         ``shard``: the delivered shard spec ("" = whole layer) — a
@@ -191,7 +197,11 @@ class JobManager:
         complete a swap job's demand), while an unversioned pair
         accepts any verified delivery of the id (mirroring
         ``satisfies``: a post-swap push job must not wedge on the
-        tag)."""
+        tag).  ``codec``: the delivered wire-codec form — a quantized
+        delivery credits only pairs PLANNED at that codec (the leader
+        stamps its codec choices onto job targets via
+        :meth:`apply_codecs`); canonical bytes credit everything
+        (docs/codec.md)."""
         finished: List[str] = []
         with self._lock:
             for job in self._jobs.values():
@@ -200,9 +210,12 @@ class JobManager:
                 want = job.assignment.get(dest, {}).get(lid)
                 want_shard = getattr(want, "shard", "") if want else ""
                 want_version = getattr(want, "version", "") if want else ""
+                want_codec = getattr(want, "codec", "") if want else ""
                 if not shard_covers(shard, want_shard):
                     continue
                 if want_version and version != want_version:
+                    continue
+                if not codec_accepts(codec, want_codec):
                     continue
                 job.remaining.discard((dest, lid))
                 if not job.remaining:
@@ -273,6 +286,36 @@ class JobManager:
                     finished.append(job.job_id)
         return finished
 
+    def apply_codecs(self, choices: Dict[Tuple[NodeID, LayerID], str]
+                     ) -> None:
+        """Stamp the leader's wire-codec choices onto active jobs'
+        target metas (docs/codec.md): job targets are codec-agnostic at
+        submission, but ack crediting and takeover reconciliation both
+        compare against the target meta — without the stamp, a
+        quantized delivery the leader itself planned would never credit
+        the job.  ``choices``: {(dest, layer): codec} ("" reverts a
+        pair to canonical)."""
+        if not choices:
+            return
+        with self._lock:
+            for job in self._jobs.values():
+                if job.state != ACTIVE:
+                    continue
+                for dest, lids in job.assignment.items():
+                    for lid, meta in lids.items():
+                        codec = choices.get((dest, lid))
+                        if (codec is not None
+                                and getattr(meta, "codec", "") != codec):
+                            lids[lid] = dataclasses.replace(
+                                meta, codec=codec)
+
+    def active_count_for(self, submitter: str) -> int:
+        """How many ACTIVE jobs this submitter identity currently owns
+        — the per-submitter quota's denominator (docs/service.md)."""
+        with self._lock:
+            return sum(1 for j in self._jobs.values()
+                       if j.state == ACTIVE and j.submitter == submitter)
+
     # -------------------------------------------------------------- queries
 
     def get(self, job_id: str) -> Optional[Job]:
@@ -335,6 +378,7 @@ class JobManager:
                 "Version": job.version,
                 "SwapBase": job.swap_base,
                 "Cancelled": job.cancelled,
+                "Submitter": job.submitter,
             }
 
     def to_json(self) -> Dict[str, dict]:
@@ -363,6 +407,7 @@ class JobManager:
             version=str(rec.get("Version", "")),
             swap_base=int(rec.get("SwapBase", -1)),
             cancelled=bool(rec.get("Cancelled", False)),
+            submitter=str(rec.get("Submitter", "")),
         )
 
     def load(self, records: Dict[str, dict]) -> None:

@@ -80,6 +80,8 @@ class NativeFlowGraph(FlowGraph):
                     i = class_edge[(sender, cls)]
                     per_t[i] = max(per_t[i], rate)
                 for dest in dests:
+                    if not self._arc_ok(node_id, meta, layer_id, dest):
+                        continue  # codec-inadmissible sender (docs/codec.md)
                     layer = self.idx[
                         _V("layer", layer_id=layer_id, node_id=dest)
                     ]
@@ -214,6 +216,8 @@ def make_flow_graph(
     node_network_bw: Dict[NodeID, int],
     remaining=None,
     topology=None,
+    codec_sizes=None,
+    node_codecs=None,
 ) -> FlowGraph:
     """The fastest available mode-3 scheduler for this environment.
 
@@ -221,7 +225,11 @@ def make_flow_graph(
     (the LP carries the holdings structure the relaxed graph drops) but
     every relaxed time search — the LP's seed bound and the no-scipy
     fallback's search — runs in the C++ Dinic, which now carries the
-    per-pair DCN ``xin``/``xout`` edges."""
+    per-pair DCN ``xin``/``xout`` edges.  Wire-codec pairs
+    (``codec_sizes``/``node_codecs``, docs/codec.md) size and
+    arc-filter identically on both paths — the predicates live on the
+    shared base class."""
     cls = FlowGraph if load_flow_solver() is None else NativeFlowGraph
     return cls(assignment, status, layer_sizes, node_network_bw,
-               remaining=remaining, topology=topology)
+               remaining=remaining, topology=topology,
+               codec_sizes=codec_sizes, node_codecs=node_codecs)

@@ -128,6 +128,7 @@ class InmemTransport(Transport):
             xxh3=xxh3,
             job_id=message.job_id,
             shard=message.shard,
+            codec=message.codec,
         )
         with self._lock:
             pipe_dest = self._pipes.pop(message.layer_id, None)

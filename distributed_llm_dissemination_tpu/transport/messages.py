@@ -169,12 +169,19 @@ class AnnounceMsg:
     them and stamps each assignee's expected digests
     (``LayerDigestsMsg``) so delivered layers verify end-to-end.
     Advisory and omitted when empty (digests disabled, or the bytes are
-    client-held and unreadable here)."""
+    client-held and unreadable here).
+
+    ``codecs`` (docs/codec.md): the wire codecs this node can DECODE
+    (and encode-serve) — the capability half of the codec negotiation.
+    The leader only ever chooses a quantized transfer for a dest that
+    advertised the codec; pre-codec peers announce nothing and interop
+    as raw.  Omitted when empty."""
 
     src_id: NodeID
     layer_ids: LayerIDs
     partial: dict = dataclasses.field(default_factory=dict)
     digests: dict = dataclasses.field(default_factory=dict)
+    codecs: list = dataclasses.field(default_factory=list)
 
     msg_type = MsgType.ANNOUNCE
 
@@ -191,6 +198,8 @@ class AnnounceMsg:
             payload["Digests"] = {
                 str(lid): str(d) for lid, d in self.digests.items()
             }
+        if self.codecs:
+            payload["Codecs"] = [str(c) for c in self.codecs]
         return payload
 
     @classmethod
@@ -205,6 +214,7 @@ class AnnounceMsg:
                 int(lid): str(h)
                 for lid, h in (d.get("Digests") or {}).items()
             },
+            codecs=[str(c) for c in d.get("Codecs") or []],
         )
 
 
@@ -223,13 +233,20 @@ class AckMsg:
     records the holding version-qualified, so a v2 swap pair is only
     ever completed by bytes verified under v2, and the swap commit
     fence knows exactly when a replica's v2 set is whole.  "" =
-    unversioned (every pre-swap ack), omitted on the wire."""
+    unversioned (every pre-swap ack), omitted on the wire.
+
+    ``codec`` (docs/codec.md): the wire-codec form the delivered bytes
+    are in ("" = canonical) — the leader records the holding
+    codec-qualified, so a quantized copy can never be mistaken for (or
+    satisfy) a raw demand, and can be re-planned as a SOURCE only for
+    same-codec transfers.  Omitted on the wire at default."""
 
     src_id: NodeID
     layer_id: LayerID
     location: LayerLocation = LayerLocation.INMEM
     shard: str = ""
     version: str = ""
+    codec: str = ""
 
     msg_type = MsgType.ACK
 
@@ -243,6 +260,8 @@ class AckMsg:
             payload["Shard"] = str(self.shard)
         if self.version:
             payload["Version"] = str(self.version)
+        if self.codec:
+            payload["Codec"] = str(self.codec)
         return payload
 
     @classmethod
@@ -253,6 +272,7 @@ class AckMsg:
             location=LayerLocation(d.get("Location", 0)),
             shard=str(d.get("Shard", "")),
             version=str(d.get("Version", "")),
+            codec=str(d.get("Codec", "")),
         )
 
 
@@ -273,7 +293,10 @@ class RetransmitMsg:
     forward serves (docs/service.md; "" = the base run).  ``shard``
     (docs/sharding.md): forward only this shard's byte range ("" = the
     whole layer; omitted on the wire — a legacy owner ships the full
-    layer, which still covers the target)."""
+    layer, which still covers the target).  ``codec`` (docs/codec.md):
+    ship the layer in this wire-codec form (the owner encodes its raw
+    copy, or serves an already-encoded same-codec holding verbatim);
+    "" = canonical bytes, omitted on the wire."""
 
     src_id: NodeID
     layer_id: LayerID
@@ -281,6 +304,7 @@ class RetransmitMsg:
     epoch: int = -1
     job_id: str = ""
     shard: str = ""
+    codec: str = ""
 
     msg_type = MsgType.RETRANSMIT
 
@@ -290,19 +314,28 @@ class RetransmitMsg:
              "DestID": self.dest_id}, self.epoch), self.job_id)
         if self.shard:
             payload["Shard"] = str(self.shard)
+        if self.codec:
+            payload["Codec"] = str(self.codec)
         return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "RetransmitMsg":
         return cls(int(d["SrcID"]), int(d["LayerID"]), int(d["DestID"]),
                    int(d.get("Epoch", -1)), str(d.get("Job", "")),
-                   str(d.get("Shard", "")))
+                   str(d.get("Shard", "")), str(d.get("Codec", "")))
 
 
 @dataclasses.dataclass
 class FlowRetransmitMsg:
     """Leader → sender: partial-layer send command with a bandwidth budget
-    (message.go:121-151)."""
+    (message.go:121-151).
+
+    ``codec`` (docs/codec.md): the transfer's wire-codec form — the
+    commanded byte range ``[offset, offset+data_size)`` then indexes the
+    ENCODED blob (the sender encodes its raw copy once and serves
+    ranges of the cached form, or serves a same-codec holding
+    verbatim).  "" = canonical bytes, omitted on the wire — a legacy
+    peer never sees the key."""
 
     src_id: NodeID
     layer_id: LayerID
@@ -312,11 +345,12 @@ class FlowRetransmitMsg:
     rate: int
     epoch: int = -1
     job_id: str = ""  # the admitted job this send serves ("" = base run)
+    codec: str = ""
 
     msg_type = MsgType.FLOW_RETRANSMIT
 
     def to_payload(self) -> dict:
-        return _job_to_payload(_epoch_to_payload({
+        payload = _job_to_payload(_epoch_to_payload({
             "SrcID": self.src_id,
             "LayerID": self.layer_id,
             "DestID": self.dest_id,
@@ -324,6 +358,9 @@ class FlowRetransmitMsg:
             "Offset": self.offset,
             "Rate": self.rate,
         }, self.epoch), self.job_id)
+        if self.codec:
+            payload["Codec"] = str(self.codec)
+        return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "FlowRetransmitMsg":
@@ -336,6 +373,7 @@ class FlowRetransmitMsg:
             int(d.get("Rate", 0)),
             int(d.get("Epoch", -1)),
             str(d.get("Job", "")),
+            str(d.get("Codec", "")),
         )
 
 
@@ -384,6 +422,13 @@ class LayerMsg:
     # byte ranges alone (offset/size are absolute layer coordinates
     # either way); the tag exists for logs and telemetry.
     shard: str = ""
+    # Wire-codec tag (docs/codec.md): the encoded form this fragment's
+    # bytes — and its offset/total coordinates — are in ("" = canonical
+    # bytes, the pre-codec wire format).  Advisory like the stamp: the
+    # dest's authoritative codec comes from the leader's digest-stamp
+    # channel; the tag is the fallback identity when no stamp arrived
+    # (digests disabled), so encoded bytes are never stored as raw.
+    codec: str = ""
 
     msg_type = MsgType.LAYER
 
@@ -430,6 +475,9 @@ class LayerHeader:
     job_id: str = ""
     # Advisory shard-target tag (omitted when ""; docs/sharding.md).
     shard: str = ""
+    # Wire-codec tag (omitted when ""; docs/codec.md): the encoded form
+    # this frame's payload — and byte coordinates — are in.
+    codec: str = ""
 
     def to_payload(self) -> dict:
         payload = {
@@ -453,6 +501,8 @@ class LayerHeader:
             payload["Job"] = str(self.job_id)
         if self.shard:
             payload["Shard"] = str(self.shard)
+        if self.codec:
+            payload["Codec"] = str(self.codec)
         return payload
 
     @classmethod
@@ -472,6 +522,7 @@ class LayerHeader:
             int(d["Xxh3"]) if "Xxh3" in d else None,
             str(d.get("Job", "")),
             str(d.get("Shard", "")),
+            str(d.get("Codec", "")),
         )
 
 
@@ -797,7 +848,12 @@ class LayerNackMsg:
     it.  ``src_id`` is the NACKing receiver (the retransmit's dest).
     Handled by every node that serves layers (leaders, retransmit
     receivers) with a bounded per-(dest, layer, range) retry budget —
-    a persistently corrupt path must fail loudly, not livelock."""
+    a persistently corrupt path must fail loudly, not livelock.
+
+    ``codec`` (docs/codec.md): the wire-codec form of the transfer the
+    NACK belongs to — offset/size/total then index the ENCODED blob,
+    and the serving holder retransmits ranges of its cached encoded
+    form.  "" = canonical bytes, omitted on the wire."""
 
     src_id: NodeID
     layer_id: LayerID
@@ -805,20 +861,25 @@ class LayerNackMsg:
     size: int
     total_size: int = 0
     reason: str = "crc"  # "crc" | "drop" | "stale" | "digest"
+    codec: str = ""
 
     msg_type = MsgType.LAYER_NACK
 
     def to_payload(self) -> dict:
-        return {"SrcID": self.src_id, "LayerID": self.layer_id,
-                "Offset": self.offset, "Size": self.size,
-                "TotalSize": self.total_size, "Reason": self.reason}
+        payload = {"SrcID": self.src_id, "LayerID": self.layer_id,
+                   "Offset": self.offset, "Size": self.size,
+                   "TotalSize": self.total_size, "Reason": self.reason}
+        if self.codec:
+            payload["Codec"] = str(self.codec)
+        return payload
 
     @classmethod
     def from_payload(cls, d: dict) -> "LayerNackMsg":
         return cls(int(d["SrcID"]), int(d["LayerID"]),
                    int(d.get("Offset", 0)), int(d.get("Size", 0)),
                    int(d.get("TotalSize", 0)),
-                   str(d.get("Reason", "crc")))
+                   str(d.get("Reason", "crc")),
+                   str(d.get("Codec", "")))
 
 
 @dataclasses.dataclass
@@ -850,8 +911,17 @@ class LayerDigestsMsg:
     its stored holding) carries the tag and the leader's swap fence
     can tell a v2 delivery from a stale copy under the same id.
 
-    All omitted-at-default: an unsharded, unversioned run's stamp is
-    byte-identical to the legacy format."""
+    Wire-codec transfers (docs/codec.md) ride it too — the codec
+    choice must precede the bytes: ``codecs`` — ``{layer_id: codec}``
+    — tells the dest which encoded form each assigned layer will
+    arrive in (interval accounting, journal, and NACK ranges then live
+    in ENCODED byte space), and for those layers the ``digests`` entry
+    is the CODEC-QUALIFIED digest — the hash of exactly the encoded
+    bytes — so a quantized copy verifies (and acks) under its own byte
+    identity and can never silently pass as a raw one.
+
+    All omitted-at-default: an unsharded, unversioned, un-codec'd
+    run's stamp is byte-identical to the legacy format."""
 
     src_id: NodeID
     digests: dict  # {layer_id: hex digest}
@@ -859,6 +929,7 @@ class LayerDigestsMsg:
     shards: dict = dataclasses.field(default_factory=dict)
     range_digests: dict = dataclasses.field(default_factory=dict)
     versions: dict = dataclasses.field(default_factory=dict)
+    codecs: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.LAYER_DIGESTS
 
@@ -876,6 +947,9 @@ class LayerDigestsMsg:
         if self.versions:
             payload["Versions"] = {str(lid): str(v)
                                    for lid, v in self.versions.items()}
+        if self.codecs:
+            payload["WireCodecs"] = {str(lid): str(c)
+                                     for lid, c in self.codecs.items()}
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -889,7 +963,9 @@ class LayerDigestsMsg:
                    {int(lid): str(h)
                     for lid, h in (d.get("RangeDigests") or {}).items()},
                    {int(lid): str(v)
-                    for lid, v in (d.get("Versions") or {}).items()})
+                    for lid, v in (d.get("Versions") or {}).items()},
+                   {int(lid): str(c)
+                    for lid, c in (d.get("WireCodecs") or {}).items()})
 
 
 @dataclasses.dataclass

@@ -422,7 +422,8 @@ class TcpTransport(Transport):
             self._queue.put(LayerMsg(header.src_id, header.layer_id, src,
                                      header.total_size,
                                      job_id=header.job_id,
-                                     shard=header.shard))
+                                     shard=header.shard,
+                                     codec=header.codec))
             return
         buf = alloc_recv_buffer(header.layer_size)
         view = memoryview(buf)
@@ -469,7 +470,7 @@ class TcpTransport(Transport):
         self._queue.put(
             LayerMsg(header.src_id, header.layer_id, layer_src,
                      header.total_size, job_id=header.job_id,
-                     shard=header.shard)
+                     shard=header.shard, codec=header.codec)
         )
 
     # --------------------------------------------------------- striped rx
@@ -605,7 +606,7 @@ class TcpTransport(Transport):
                     header.src_id, header.layer_id, src, header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
                     stripe_off=header.stripe_off, job_id=header.job_id,
-                    shard=header.shard))
+                    shard=header.shard, codec=header.codec))
                 return
             if self.layer_sink is not None:
                 # Sink present but declined (duplicate/overlap/finished):
@@ -627,7 +628,7 @@ class TcpTransport(Transport):
                     header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
                     stripe_off=header.stripe_off, job_id=header.job_id,
-                    shard=header.shard))
+                    shard=header.shard, codec=header.codec))
                 return
             # No sink: regroup stripes into the original logical payload
             # so un-striped consumers (mode-0/1/2 receivers, raw
@@ -703,7 +704,7 @@ class TcpTransport(Transport):
                     done["total"],
                     stripe_idx=0, stripe_n=1, stripe_off=0,
                     job_id=header.job_id,
-                    shard=header.shard))
+                    shard=header.shard, codec=header.codec))
         finally:
             if pipe_sock is not None:
                 pipe_sock.close()
@@ -1007,7 +1008,7 @@ class TcpTransport(Transport):
                     dest,
                     LayerMsg(message.src_id, message.layer_id, sub,
                              message.total_size, job_id=message.job_id,
-                             shard=message.shard),
+                             shard=message.shard, codec=message.codec),
                     stripe=stripe)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
@@ -1063,6 +1064,7 @@ class TcpTransport(Transport):
             offset=src.offset,
             job_id=message.job_id,
             shard=message.shard,
+            codec=message.codec,
         )
         if stripe is not None:
             header.stripe_idx = stripe["idx"]

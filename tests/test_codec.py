@@ -1,0 +1,619 @@
+"""Negotiated wire-codec tests (docs/codec.md).
+
+The tentpole invariants:
+
+- the codec vocabulary is strict: canonical bytes satisfy every target,
+  a quantized holding satisfies ONLY its exact codec — int8 bytes can
+  never complete (or ack as) a raw demand;
+- encode is deterministic and ``decode_to_raw`` re-materializes the
+  canonical blob layout exactly;
+- the flow solver sizes a codec pair by its ENCODED bytes (the
+  effective-capacity formulation) and never plans a quantized holder as
+  a source for a raw-only dest — nor a raw holder that can't encode for
+  a quantized pair — while a same-codec holder re-seeds verbatim;
+- end to end: the leader chooses the codec per (dest, layer) by link
+  rate, stamps it (with the CODEC-QUALIFIED digest) on the digest
+  channel, the seeder encodes-on-send, the dest assembles in encoded
+  byte space, verifies the encoded digest, acks codec-qualified, and
+  the telemetry link table reconciles BYTE-EXACTLY with encoded wire
+  bytes (the tier-1 guard) while fast links keep shipping raw;
+- a codec-qualified digest mismatch re-opens the transfer instead of
+  acking corruption, and recovery (NACK/retransmit) runs in encoded
+  byte space under seeded faults;
+- per-submitter job quotas/rate limits refuse loudly
+  (``jobs.quota_refused``) and always answer.
+"""
+
+import os
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import (
+    LayerLocation,
+    LayerMeta,
+    LayerSrc,
+    SourceType,
+    codec_accepts,
+    satisfies,
+)
+from distributed_llm_dissemination_tpu.models import quant
+from distributed_llm_dissemination_tpu.models.llama import CONFIGS
+from distributed_llm_dissemination_tpu.models.serde import seeded_blob
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    Node,
+)
+from distributed_llm_dissemination_tpu.runtime.codec import WireCodecPlane
+from distributed_llm_dissemination_tpu.sched.flow import (
+    FlowGraph,
+    pick_salvage_source,
+)
+from distributed_llm_dissemination_tpu.transport import reset_registry
+from distributed_llm_dissemination_tpu.transport.faults import (
+    FaultyTransport,
+    rules_from_spec,
+)
+from distributed_llm_dissemination_tpu.transport.messages import (
+    JobStatusMsg,
+    JobSubmitMsg,
+    LayerDigestsMsg,
+    LayerMsg,
+)
+from distributed_llm_dissemination_tpu.utils import integrity, telemetry, trace
+
+from test_node import close_all, make_transports
+
+TIMEOUT = 20.0
+CFG = CONFIGS["tiny"]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def _raw_blob(lid: int) -> bytes:
+    return seeded_blob(CFG, lid, 0)
+
+
+def _enc_blob(lid: int, codec: str = "int8") -> bytes:
+    return quant.encode_blob(CFG, lid, _raw_blob(lid), codec)
+
+
+def _blob_layer(lid: int, rate: int = 0) -> LayerSrc:
+    data = _raw_blob(lid)
+    return LayerSrc(
+        inmem_data=bytearray(data), data_size=len(data),
+        meta=LayerMeta(location=LayerLocation.INMEM, limit_rate=rate,
+                       source_type=SourceType.MEM),
+    )
+
+
+def _plane(wire_codec: str = "int8") -> WireCodecPlane:
+    return WireCodecPlane(CFG, wire_codec=wire_codec)
+
+
+# ------------------------------------------------------ codec vocabulary
+
+
+def test_codec_vocabulary():
+    # Canonical bytes satisfy everything; quantized only its own form.
+    assert codec_accepts("", "") and codec_accepts("", "int8")
+    assert codec_accepts("int8", "int8")
+    assert not codec_accepts("int8", "")
+    assert not codec_accepts("int8", "int4")
+    held = LayerMeta(location=LayerLocation.INMEM, codec="int8")
+    assert satisfies(held, LayerMeta(codec="int8"))
+    assert not satisfies(held, LayerMeta())  # the acceptance invariant
+    assert not satisfies(held, LayerMeta(codec="int4"))
+    raw = LayerMeta(location=LayerLocation.INMEM)
+    assert satisfies(raw, LayerMeta(codec="int8"))  # raw is the superset
+
+
+def test_encode_deterministic_and_decode_to_raw_layout():
+    raw = _raw_blob(0)
+    for codec in ("int8", "int4"):
+        enc1 = quant.encode_blob(CFG, 0, raw, codec)
+        enc2 = quant.encode_blob(CFG, 0, bytes(raw), codec)
+        assert enc1 == enc2, f"{codec} encode is not deterministic"
+        assert len(enc1) == quant.blob_nbytes_codec(CFG, 0, codec)
+        # decode_to_raw re-materializes the canonical LAYOUT exactly:
+        # re-encoding the decoded form reproduces the encoded bytes.
+        back = quant.decode_to_raw(CFG, 0, enc1, codec)
+        assert len(back) == len(raw)
+        assert quant.encode_blob(CFG, 0, back, codec) == enc1
+
+
+def test_wire_codec_plane_serves_and_caches_encoded_form():
+    plane = _plane()
+    assert plane.enabled
+    assert set(plane.decode_codecs()) == {"int8", "int4"}
+    layer = _blob_layer(0)
+    enc = plane.encoded_src(0, layer, "int8")
+    assert enc is not None and bytes(enc.inmem_data) == _enc_blob(0)
+    assert enc.meta.codec == "int8"
+    # Cached: the second call returns the same buffer (no re-encode).
+    again = plane.encoded_src(0, layer, "int8")
+    assert again.inmem_data is enc.inmem_data
+    # The codec-qualified digest is the digest of the ENCODED bytes.
+    d = plane.encoded_digest(0, layer, "int8")
+    assert d == integrity.layer_digest(_enc_blob(0))
+    # A non-model holding (size mismatch) refuses to encode.
+    junk = LayerSrc(inmem_data=bytearray(b"x" * 100), data_size=100,
+                    meta=LayerMeta(location=LayerLocation.INMEM))
+    assert plane.encoded_src(2, junk, "int8") is None
+    # An already-encoded holding never re-encodes.
+    assert plane.encoded_src(0, enc, "int8") is None
+
+
+# ------------------------------------------------------------- planner
+
+
+RAW = len(_raw_blob(0))
+ENC = len(_enc_blob(0))
+
+
+def _graph(assignment, status, node_codecs=None, bw=1 << 30):
+    nodes = set(status) | set(assignment)
+    return FlowGraph(assignment, status, {7: RAW},
+                     {n: bw for n in nodes},
+                     codec_sizes={(7, "int8"): ENC},
+                     node_codecs=node_codecs or {})
+
+
+def test_flow_solver_sizes_codec_pair_by_encoded_bytes():
+    status = {0: {7: LayerMeta(location=LayerLocation.INMEM,
+                               data_size=RAW)}}
+    # Link rate = RAW bytes/s, so the raw plan takes ~1000 ms and the
+    # time ratio is readable.
+    raw_t, raw_jobs = _graph({2: {7: LayerMeta()}}, status,
+                             {0: frozenset(["int8"])},
+                             bw=RAW).get_job_assignment()
+    enc_t, enc_jobs = _graph({2: {7: LayerMeta(codec="int8")}}, status,
+                             {0: frozenset(["int8"])},
+                             bw=RAW).get_job_assignment()
+    assert sum(j.data_size for jl in raw_jobs.values() for j in jl) == RAW
+    planned = [j for jl in enc_jobs.values() for j in jl]
+    assert sum(j.data_size for j in planned) == ENC
+    assert all(j.offset + j.data_size <= ENC for j in planned)
+    # Effective capacity = bandwidth x ratio: the predicted time shrinks
+    # by the compression ratio (floor granularity aside).
+    assert enc_t < raw_t
+    assert enc_t <= raw_t * (ENC / RAW) + 2
+
+
+def test_solver_never_plans_quantized_holder_for_raw_dest():
+    # The ONLY holder has int8 bytes; the target wants raw: nothing may
+    # be planned from it (acceptance criterion, docs/codec.md).
+    status = {1: {7: LayerMeta(location=LayerLocation.INMEM,
+                               data_size=ENC, codec="int8")}}
+    _, jobs = _graph({2: {7: LayerMeta()}}, status).get_job_assignment()
+    assert not jobs, f"quantized holder planned as raw source: {jobs}"
+    # With a raw holder alongside, every byte comes from the raw one.
+    status[0] = {7: LayerMeta(location=LayerLocation.INMEM,
+                              data_size=RAW)}
+    _, jobs = _graph({2: {7: LayerMeta()}}, status).get_job_assignment()
+    senders = {j.sender_id for jl in jobs.values() for j in jl}
+    assert senders == {0}
+
+
+def test_solver_codec_pair_needs_encoder_or_same_codec_holder():
+    raw_holder = {0: {7: LayerMeta(location=LayerLocation.INMEM,
+                                   data_size=RAW)}}
+    want = {2: {7: LayerMeta(codec="int8")}}
+    # A raw holder WITHOUT encode capability can't serve the pair.
+    _, jobs = _graph(want, raw_holder, node_codecs={}).get_job_assignment()
+    assert not jobs
+    # With capability it can.
+    _, jobs = _graph(want, raw_holder,
+                     node_codecs={0: frozenset(["int8"])}
+                     ).get_job_assignment()
+    assert sum(j.data_size for jl in jobs.values() for j in jl) == ENC
+    # A SAME-codec holder re-seeds verbatim — no encode capability
+    # needed (the encoded bytes forward as-is).
+    enc_holder = {1: {7: LayerMeta(location=LayerLocation.INMEM,
+                                   data_size=ENC, codec="int8")}}
+    _, jobs = _graph(want, enc_holder, node_codecs={}).get_job_assignment()
+    senders = {j.sender_id for jl in jobs.values() for j in jl}
+    assert senders == {1}
+    assert sum(j.data_size for jl in jobs.values() for j in jl) == ENC
+
+
+def test_solver_never_plans_client_held_sender_for_codec_pair():
+    """Review regression: a CLIENT-held copy can only pipe-stream RAW
+    bytes the node never touches — it must never be planned as a
+    source for a quantized pair, whatever the node's own announced
+    capability."""
+    status = {1: {7: LayerMeta(location=LayerLocation.CLIENT,
+                               data_size=RAW)}}
+    want = {2: {7: LayerMeta(codec="int8")}}
+    _, jobs = _graph(want, status,
+                     node_codecs={1: frozenset(["int8"])}
+                     ).get_job_assignment()
+    assert not jobs, f"client-held copy planned for a codec pair: {jobs}"
+    # The same holder serves the RAW pair fine (the normal pipe path).
+    _, jobs = _graph({2: {7: LayerMeta()}}, status,
+                     node_codecs={1: frozenset(["int8"])}
+                     ).get_job_assignment()
+    assert jobs
+
+
+def test_digests_off_stamp_carries_explicit_codec_reversion(monkeypatch):
+    """Review regression: with digests OFF the codec map is the only
+    channel that can tell a dest a pair REVERTED to raw (a plane-less
+    takeover) — the stamp must carry explicit "" entries, and the dest
+    must clear its stale codec expectation on them."""
+    monkeypatch.setenv("DLD_LAYER_DIGESTS", "0")
+    ts, _ = make_transports("inmem", [0, 1])
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, {1: {0: LayerMeta()}},
+        {0: 1 << 30, 1: 1 << 30})
+    r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                   start_loop=False)
+    try:
+        leader._codec_seen = True  # a pair was once chosen quantized
+        leader._codec_choice[(1, 0)] = ""  # ...and has reverted to raw
+        leader._send_digests_to(1)
+        msg = ts[1].deliver().get(timeout=TIMEOUT)
+        assert isinstance(msg, LayerDigestsMsg)
+        assert msg.codecs == {0: ""}
+        # The dest's stale expectation clears on the explicit "".
+        r._layer_codecs[0] = "int8"
+        r.handle_layer_digests(msg)
+        assert 0 not in r._layer_codecs
+    finally:
+        leader.close()
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_mode1_owner_pool_excludes_codec_holders():
+    """Review regression: mode 1/2's per-layer owner pool can't express
+    per-pair codec admissibility, so a quantized holder must never
+    enter it — a deterministic owner pick would otherwise forward
+    encoded bytes as a raw delivery."""
+    from distributed_llm_dissemination_tpu.runtime import (
+        RetransmitLeaderNode,
+    )
+
+    ts, _ = make_transports("inmem", [0, 1, 2])
+    leader = RetransmitLeaderNode(Node(0, 0, ts[0]),
+                                  {0: _blob_layer(0)}, {})
+    try:
+        leader.status[1] = {0: LayerMeta(location=LayerLocation.INMEM,
+                                         data_size=ENC, codec="int8")}
+        leader.status[2] = {0: LayerMeta(location=LayerLocation.INMEM,
+                                         data_size=RAW)}
+        with leader._lock:
+            leader._build_layer_owners()
+        assert leader.layer_owners[0] == {0, 2}, (
+            "codec holder entered the mode-1 owner pool")
+    finally:
+        leader.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_pick_salvage_source_is_codec_aware():
+    status = {
+        0: {7: LayerMeta(location=LayerLocation.INMEM)},          # raw
+        1: {7: LayerMeta(location=LayerLocation.INMEM,
+                         codec="int8")},                          # int8
+    }
+    # Raw need: the int8 holder never qualifies.
+    assert pick_salvage_source(status, 7, exclude={0}) is None
+    # Codec need: the same-codec holder qualifies; the raw holder only
+    # with encode capability.
+    assert pick_salvage_source(status, 7, need_codec="int8",
+                               exclude={0}) == 1
+    assert pick_salvage_source(status, 7, need_codec="int8",
+                               exclude={1}) is None
+    assert pick_salvage_source(status, 7, need_codec="int8",
+                               exclude={1},
+                               encoders=frozenset([0])) == 0
+
+
+# ------------------------------------------------------------ end to end
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_codec_wire_end_to_end_mixed_links(kind, monkeypatch):
+    """The tentpole e2e: one leader-held model layer set, one SLOW dest
+    (NIC below the threshold — ships int8, digest-stamped) and one FAST
+    dest (ships raw).  Asserts byte-exact encoded delivery, verified
+    codec-qualified digests, codec-qualified acks/status, and the
+    tier-1 guard: the telemetry link table reconciles BYTE-EXACTLY with
+    ENCODED wire bytes while the decoded side rides its own counters."""
+    monkeypatch.setenv("DLD_CODEC_MIN_RATE", str(64 << 20))
+    telemetry.reset_run()
+    ids = [0, 1, 2]
+    ts, _ = make_transports(kind, ids)
+    lids = [0, 1]
+    layers = {lid: _blob_layer(lid) for lid in lids}
+    assignment = {1: {lid: LayerMeta() for lid in lids},
+                  2: {lid: LayerMeta() for lid in lids}}
+    bw = {0: 1 << 30, 1: 4 << 20, 2: 1 << 30}  # dest 1 is the slow link
+    leader = FlowRetransmitLeaderNode(Node(0, 0, ts[0]), layers,
+                                      assignment, bw, codecs=_plane())
+    receivers = [FlowRetransmitReceiverNode(Node(i, 0, ts[i]), {},
+                                            codecs=_plane())
+                 for i in (1, 2)]
+    try:
+        for r in receivers:
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        slow, fast = receivers
+        for lid in lids:
+            enc = _enc_blob(lid)
+            # Slow dest: the encoded form, byte-exact, codec-qualified,
+            # digest-verified against the ENCODED digest.
+            src = slow.layers[lid]
+            assert src.meta.codec == "int8"
+            assert bytes(src.inmem_data) == enc
+            assert lid in slow._digest_ok
+            assert slow.content_store.codec_of(lid) == "int8"
+            assert leader.status[1][lid].codec == "int8"
+            # Fast dest: canonical bytes, raw ack.
+            assert fast.layers[lid].meta.codec == ""
+            assert bytes(fast.layers[lid].inmem_data) == _raw_blob(lid)
+            assert leader.status[2][lid].codec == ""
+            # The leader's content index keys the two forms apart.
+            assert leader.content.node_has(
+                1, integrity.layer_digest(enc), codec="int8")
+            assert not leader.content.node_has(
+                1, integrity.layer_digest(enc))
+        # Tier-1 guard: link-table delivered bytes reconcile BYTE-EXACT
+        # with ENCODED wire bytes per dest (never the decoded side).
+        enc_total = sum(len(_enc_blob(lid)) for lid in lids)
+        raw_total = sum(len(_raw_blob(lid)) for lid in lids)
+        links = telemetry.snapshot()["links"]
+
+        def delivered_to(dest):
+            return sum(row.get("delivered_bytes", 0)
+                       for key, row in links.items()
+                       if "#" not in key and key.endswith(f"->{dest}"))
+
+        assert delivered_to(1) == enc_total
+        assert delivered_to(2) == raw_total
+        counts = trace.counter_totals()
+        assert counts.get("codec.wire_bytes", 0) == enc_total
+        assert counts.get("codec.decoded_bytes", 0) == raw_total
+        # The run report carries BOTH columns, unconflated.
+        dests = leader.dest_bytes_table()
+        assert dests["1"]["wire_bytes"] == enc_total
+        assert dests["1"]["decoded_bytes"] == raw_total
+        assert dests["1"]["codec_layers"] == len(lids)
+        assert dests["2"]["wire_bytes"] == raw_total
+        assert dests["2"]["codec_layers"] == 0
+    finally:
+        close_all(leader, receivers, ts)
+
+
+def test_codec_digest_mismatch_reopens_and_redelivery_verifies():
+    """Acceptance regression: a quantized copy whose bytes don't hash
+    to the CODEC-QUALIFIED digest is demoted (never acked/stored) and
+    re-requested; the correctly stamped redelivery verifies and stores
+    codec-qualified."""
+    ts, _ = make_transports("inmem", [0, 1])
+    r = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {}, codecs=_plane())
+    try:
+        enc = _enc_blob(0)
+        wrong = integrity.layer_digest(b"not the encoded bytes")
+        r.handle_layer_digests(LayerDigestsMsg(
+            0, {0: wrong}, codecs={0: "int8"}))
+
+        def deliver():
+            src = LayerSrc(inmem_data=bytearray(enc), data_size=len(enc),
+                           meta=LayerMeta(location=LayerLocation.INMEM))
+            r.handle_layer(LayerMsg(0, 0, src, len(enc), codec="int8"))
+
+        before = trace.counter_totals().get("integrity.digest_mismatch", 0)
+        deliver()
+        # Mismatch: the layer is demoted — intervals re-opened, nothing
+        # acked into the goal state.
+        assert 0 not in r.layers
+        assert trace.counter_totals().get(
+            "integrity.digest_mismatch", 0) > before
+        # The corrected stamp (the re-request's) resets the verdict and
+        # the redelivery verifies against the encoded digest.
+        r.handle_layer_digests(LayerDigestsMsg(
+            0, {0: integrity.layer_digest(enc)}, codecs={0: "int8"}))
+        deliver()
+        assert 0 in r.layers
+        assert r.layers[0].meta.codec == "int8"
+        assert bytes(r.layers[0].inmem_data) == enc
+        assert 0 in r._digest_ok
+    finally:
+        r.close()
+        for t in ts.values():
+            t.close()
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_chaos_quantized_wire_corrupt_dup_slow(kind, monkeypatch):
+    """Chaos coverage (docs/codec.md): the seeded fault injector
+    corrupts/drops/dups frames of a QUANTIZED multi-fragment transfer
+    over a rate-limited link — NACK/retransmit recovery runs in encoded
+    byte space and the delivered layer verifies digest-exact."""
+    import distributed_llm_dissemination_tpu.runtime.send as send_mod
+
+    monkeypatch.setenv("DLD_CODEC_MIN_RATE", str(64 << 20))
+    monkeypatch.setattr(send_mod, "FLOW_FRAGMENT_BYTES", 32 * 1024)
+    telemetry.reset_run()
+    ts, _ = make_transports(kind, [0, 1])
+    seed, rules = rules_from_spec(
+        "seed=3,corrupt=2,dup=5,times=3,slow=2000000")
+    faulty = FaultyTransport(ts[1], rules, seed=seed)
+    layers = {0: _blob_layer(0, rate=4 << 20)}
+    assignment = {1: {0: LayerMeta()}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), layers, assignment,
+        {0: 1 << 30, 1: 4 << 20}, codecs=_plane())
+    receiver = FlowRetransmitReceiverNode(Node(1, 0, faulty), {},
+                                          codecs=_plane())
+    try:
+        receiver.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        enc = _enc_blob(0)
+        src = receiver.layers[0]
+        assert src.meta.codec == "int8"
+        assert bytes(src.inmem_data) == enc
+        assert 0 in receiver._digest_ok
+        counts = trace.counter_totals()
+        assert faulty.stats.get("corrupt", 0) >= 1, "fault never fired"
+        assert counts.get("integrity.crc_drop", 0) >= 1
+        assert counts.get("integrity.nack_sent", 0) >= 1
+        assert counts.get("integrity.retransmit_frags", 0) >= 1
+    finally:
+        close_all(leader, [receiver], ts)
+
+
+# ------------------------------------------------- quotas / rate limits
+
+
+def _submit(leader, ts, job_id, src_id=5, auth=""):
+    leader.handle_job_submit(JobSubmitMsg(
+        src_id, job_id, {1: {0: LayerMeta()}}, auth=auth))
+    reply = ts[src_id].deliver().get(timeout=TIMEOUT)
+    assert isinstance(reply, JobStatusMsg)
+    return reply
+
+
+def test_job_quota_per_submitter_refuses_loudly(monkeypatch):
+    monkeypatch.setenv("DLD_JOB_QUOTA", "1")
+    ts, _ = make_transports("inmem", [0, 1, 5, 6])
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: _blob_layer(0)}, {},
+        {0: 1 << 30, 1: 1 << 30})
+    try:
+        before = trace.counter_totals().get("jobs.quota_refused", 0)
+        ok = _submit(leader, ts, "job-a", src_id=5)
+        assert not ok.error and "job-a" in ok.jobs
+        # The same submitter's second ACTIVE job is refused — loudly,
+        # counted, and ANSWERED.
+        refused = _submit(leader, ts, "job-b", src_id=5)
+        assert "quota" in refused.error
+        assert trace.counter_totals().get(
+            "jobs.quota_refused", 0) == before + 1
+        # Idempotent resubmit of the known id is never quota-refused.
+        again = _submit(leader, ts, "job-a", src_id=5)
+        assert not again.error
+        # A DIFFERENT submitter identity has its own quota.
+        other = _submit(leader, ts, "job-c", src_id=6)
+        assert not other.error
+    finally:
+        leader.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_job_rate_limit_per_submitter(monkeypatch):
+    monkeypatch.setenv("DLD_JOB_RATE", "1/60")
+    ts, _ = make_transports("inmem", [0, 1, 5])
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: _blob_layer(0)}, {},
+        {0: 1 << 30, 1: 1 << 30})
+    try:
+        assert not _submit(leader, ts, "job-a").error
+        refused = _submit(leader, ts, "job-b")
+        assert "rate limited" in refused.error
+        assert trace.counter_totals().get("jobs.quota_refused", 0) >= 1
+    finally:
+        leader.close()
+        for t in ts.values():
+            t.close()
+
+
+# -------------------------------------------------- failover replication
+
+
+def test_shadow_replicates_codec_state():
+    from distributed_llm_dissemination_tpu.runtime.failover import (
+        ShadowLeaderState,
+    )
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        ControlDeltaMsg,
+    )
+
+    shadow = ShadowLeaderState()
+    shadow.apply(ControlDeltaMsg(0, 1, 0, "snapshot", {
+        "Mode": 3, "Assignment": {}, "Status": {},
+        "WireCodecs": {"2:7": "int8"},
+        "NodeCodecs": {"2": ["int8", "int4"]},
+    }))
+    # The codecs delta carries the leader's FULL current maps and
+    # REPLACES: a revoked capability / reverted choice is an absent
+    # entry, and a merge would resurrect it at takeover.
+    shadow.apply(ControlDeltaMsg(0, 1, 1, "codecs", {
+        "Choices": {"2:7": "int8", "3:8": "int4"},
+        "NodeCodecs": {"3": ["int4"]},
+    }))
+    shadow.apply(ControlDeltaMsg(0, 1, 2, "ack", {
+        "Node": 2, "Layer": 7, "Location": 0, "Size": 100,
+        "Codec": "int8"}))
+    out = shadow.export()
+    assert out["wire_codecs"] == {(2, 7): "int8", (3, 8): "int4"}
+    assert out["node_codecs"] == {3: ["int4"]}  # node 2's caps revoked
+    assert out["status"][2][7].codec == "int8"
+
+
+# ------------------------------------------------- decode-during-staging
+
+
+def test_stager_decodes_blob_under_its_own_codec():
+    """A blob delivered under a NEGOTIATED wire codec decodes under ITS
+    form (not the run codec) during staging — the decode-at-staging
+    half of the quantized wire path."""
+    import numpy as np
+
+    from distributed_llm_dissemination_tpu.runtime.stream_boot import (
+        StreamingBootStager,
+    )
+
+    enc = _enc_blob(0)
+    src = LayerSrc(inmem_data=bytearray(enc), data_size=len(enc),
+                   meta=LayerMeta(location=LayerLocation.INMEM,
+                                  codec="int8"))
+    stager = StreamingBootStager(CFG, codec="raw")
+    try:
+        assert stager.submit(0, src)
+        staged = stager.collect([0], timeout=60.0)
+        assert 0 in staged
+        expect = quant.decode_blob_host(CFG, 0, enc, "int8")
+        for name, arr in staged[0].items():
+            got = np.asarray(arr)[0]
+            assert got.shape == expect[name].shape
+            assert np.array_equal(got, np.asarray(expect[name])), name
+    finally:
+        stager.close()
+
+
+def test_boot_bulk_path_normalizes_codec_holding():
+    """The bulk/infill boot path normalizes a wire-codec holding to the
+    canonical raw form (host decode) so a stager miss never misdecodes
+    encoded bytes as raw."""
+    import numpy as np
+
+    from distributed_llm_dissemination_tpu.runtime.boot import (
+        stage_blob_leaves,
+    )
+    from distributed_llm_dissemination_tpu.models.quant import (
+        decode_to_raw,
+    )
+
+    enc = _enc_blob(1)
+    raw = decode_to_raw(CFG, 1, enc, "int8")
+    # What boot_from_layers' normalization produces, staged raw:
+    norm = LayerSrc(inmem_data=bytearray(raw), data_size=len(raw),
+                    meta=LayerMeta(location=LayerLocation.INMEM))
+    staged = stage_blob_leaves(CFG, 1, norm, codec="raw")
+    expect = quant.decode_blob_host(CFG, 1, enc, "int8")
+    for name, arr in staged.items():
+        assert np.array_equal(np.asarray(arr)[0],
+                              np.asarray(expect[name])), name

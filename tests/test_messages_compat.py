@@ -110,16 +110,17 @@ CASES = {
 # Optional wire keys that must be OMITTED at their defaults, per type:
 # the extension fields layered onto the legacy formats over PRs 2-7.
 OMITTED_AT_DEFAULT = {
-    MsgType.ANNOUNCE: {"Partial", "Digests"},
-    MsgType.ACK: {"Shard", "Version"},
-    MsgType.RETRANSMIT: {"Epoch", "Job", "Shard"},
-    MsgType.FLOW_RETRANSMIT: {"Epoch", "Job"},
+    MsgType.ANNOUNCE: {"Partial", "Digests", "Codecs"},
+    MsgType.ACK: {"Shard", "Version", "Codec"},
+    MsgType.RETRANSMIT: {"Epoch", "Job", "Shard", "Codec"},
+    MsgType.FLOW_RETRANSMIT: {"Epoch", "Job", "Codec"},
     MsgType.STARTUP: {"Epoch"},
     MsgType.DEVICE_PLAN: {"Epoch", "BatchID", "BatchN"},
     MsgType.SERVE: {"Epoch"},
     MsgType.BOOT_HINT: {"Epoch"},
+    MsgType.LAYER_NACK: {"Codec"},
     MsgType.LAYER_DIGESTS: {"Epoch", "Shards", "RangeDigests",
-                            "Versions"},
+                            "Versions", "WireCodecs"},
     MsgType.SOURCE_DEAD: {"Epoch"},
     MsgType.METRICS_REPORT: {"Epoch", "Counters", "Gauges", "Links",
                              "T", "Proc"},
@@ -270,3 +271,53 @@ def test_version_fields_interop_with_preswap_peers():
         old = decode_msg(msg.msg_type, stripped)
         assert getattr(old, "version", "") == ""
         assert getattr(old, "versions", {}) == {}
+
+
+def test_codec_fields_interop_with_precodec_peers():
+    """The negotiated wire-codec extension (docs/codec.md) must keep a
+    pre-codec cluster interoperable: every Codec field is omitted at
+    default (asserted type-by-type above), the nested LayerMeta codec
+    omits ``Codec`` when empty, codec-qualified instances round-trip
+    through real JSON, and a stripped (legacy-peer) payload decodes to
+    the canonical (raw) reading — pre-codec peers interop as raw."""
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AckMsg as _Ack,
+        AnnounceMsg as _Ann,
+        FlowRetransmitMsg as _Flow,
+        LayerDigestsMsg as _Digests,
+        LayerNackMsg as _Nack,
+        RetransmitMsg as _Rtx,
+    )
+
+    # LayerMeta: the Assignment/status/announce nested codec.
+    assert "Codec" not in LayerMeta().to_json()
+    m = LayerMeta(data_size=64, codec="int8")
+    assert LayerMeta.from_json(json.loads(json.dumps(m.to_json()))) == m
+    legacy = {k: v for k, v in m.to_json().items() if k != "Codec"}
+    assert LayerMeta.from_json(legacy).codec == ""
+
+    for msg in (
+        _Ann(1, {7: LayerMeta()}, codecs=["int8", "int4"]),
+        _Ack(1, 7, codec="int8"),
+        _Rtx(1, 7, 2, codec="int4"),
+        _Flow(1, 7, 2, 64, 0, 1000, codec="int8"),
+        _Nack(1, 7, 0, 64, codec="int8"),
+        _Digests(1, {7: "xxh3:ab"}, codecs={7: "int8"}),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        assert decode_msg(msg.msg_type, wire) == msg
+        # A pre-codec peer's payload (codec keys stripped) must decode
+        # into the canonical reading, never KeyError.
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("Codec", "Codecs", "WireCodecs")}
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "codec", "") == ""
+        assert getattr(old, "codecs", None) in (None, [], {})
+
+    # The data-plane preamble: the codec tag is additive and omitted
+    # at default (the five-key legacy format is pinned above).
+    h = LayerHeader(1, 7, 64, 128, 0, codec="int8")
+    payload = h.to_payload()
+    assert payload["Codec"] == "int8"
+    assert LayerHeader.from_payload(json.loads(json.dumps(payload))) == h
+    assert "Codec" not in LayerHeader(1, 7, 64, 128, 0).to_payload()
