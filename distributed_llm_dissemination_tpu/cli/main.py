@@ -16,6 +16,7 @@ client role for that ID, cmd/main.go:69-91).
 from __future__ import annotations
 
 import argparse
+from typing import Optional
 import os
 import sys
 import time
@@ -345,6 +346,28 @@ def run_client(args, conf: cfg.Config) -> int:
         return 0
 
 
+def resolve_groups(conf: cfg.Config, mode: Optional[int] = None):
+    """The config's ``Groups`` section → the resolved group table
+    (docs/hierarchy.md), or None for flat control.  One resolution
+    shared by the leader (planner + dispatch), the member seats (their
+    control parent is the sub-leader), and the sub-leader seats (they
+    attach a SubLeaderController) — and therefore the ONE place the
+    mode-3 requirement is enforced: EVERY role must refuse a
+    mis-moded hierarchical config, or members re-point at a
+    sub-leader that will never plan and hang instead of erroring."""
+    if conf.groups is None:
+        return None
+    if mode is not None and mode != 3:
+        raise SystemExit(
+            "Groups (hierarchical control, docs/hierarchy.md) requires "
+            f"mode 3; got mode {mode}")
+    from ..runtime.hierarchy import groups_from_config
+
+    leader_id = cfg.get_leader_conf(conf).id
+    return groups_from_config(conf.groups, [nc.id for nc in conf.nodes],
+                              leader_id) or None
+
+
 def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     """Leader role: constructor per mode, then drive the TTD timer
     (cmd/main.go:149-181)."""
@@ -382,6 +405,7 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
         # to the declared standbys, beacon the lease, fence by epoch.
         common.update(standbys=list(conf.standbys),
                       lease_interval=max(args.lease, 0.05), epoch=0)
+    groups = resolve_groups(conf, args.m)
     if args.m == 0:
         leader = LeaderNode(node, layers, assignment, **common)
     elif args.m == 1:
@@ -391,8 +415,15 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     else:
         bw = {nc.id: nc.network_bw for nc in conf.nodes}
         topo = conf.mesh.topology() if conf.mesh is not None else None
-        leader = FlowRetransmitLeaderNode(node, layers, assignment, bw,
-                                          topology=topo, **common)
+        if groups is not None:
+            from ..runtime import HierarchicalFlowLeaderNode
+
+            leader = HierarchicalFlowLeaderNode(
+                node, layers, assignment, bw, groups=groups,
+                topology=topo, **common)
+        else:
+            leader = FlowRetransmitLeaderNode(node, layers, assignment, bw,
+                                              topology=topo, **common)
 
     # One flag governs the run: the leader's decision rides StartupMsg,
     # so receivers can never boot (or skip) against the leader's wait.
@@ -641,6 +672,23 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
                                               checkpoint_dir=args.ckpt,
                                               **common)
 
+    groups = resolve_groups(conf, args.m)
+    sub_ctl = None
+    if groups is not None:
+        for gid, rec in groups.items():
+            if rec["leader"] == args.id:
+                # This seat owns a group (docs/hierarchy.md): attach
+                # the sub-leader controller on the already-running loop
+                # — member announces/acks/heartbeats/metrics fold here.
+                from ..runtime import SubLeaderController
+
+                sub_ctl = SubLeaderController(
+                    receiver, gid, rec["members"],
+                    member_timeout=args.ft)
+                ulog.log.info("sub-leader controller armed", group=gid,
+                              members=rec["members"])
+                break
+
     standby_ctl = None
     if args.id in conf.standbys:
         # This seat is in the leader succession: shadow the control
@@ -690,6 +738,10 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
                       f"(provenance {paths['provenance']})", flush=True)
             except OSError as e:
                 ulog.log.error("run report write failed", err=repr(e))
+    if sub_ctl is not None:
+        # A one-shot sub-leader must not exit before its members' final
+        # telemetry flushes folded upward (docs/hierarchy.md).
+        sub_ctl.drain()
     ulog.log.info("received startup: ready")
     if fabric is not None or args.hbm:
         # Executable-reuse evidence for this process's device plane
@@ -844,7 +896,18 @@ def main(argv=None) -> int:
         ulog.log.warn("TEST fault injection armed", spec=fault_spec)
     try:
         layers = fabricate()
-        node = Node(args.id, cfg.get_leader_conf(conf).id, transport)
+        # Hierarchical control (docs/hierarchy.md): a grouped member's
+        # control parent is its SUB-LEADER — announces, acks,
+        # heartbeats, and metric reports all fold there; the root only
+        # ever sees the group aggregate.
+        parent = cfg.get_leader_conf(conf).id
+        groups = resolve_groups(conf, args.m)
+        if groups is not None:
+            for rec in groups.values():
+                if args.id in rec["members"] and args.id != rec["leader"]:
+                    parent = rec["leader"]
+                    break
+        node = Node(args.id, parent, transport)
         if node_conf.is_leader:
             return run_leader(args, conf, node, layers)
         return run_receiver(args, conf, node, layers)

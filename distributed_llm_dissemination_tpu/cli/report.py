@@ -114,6 +114,7 @@ def build(cluster: dict, ttd_s: Optional[float] = None,
     counters = cluster.get("counters") or {}
     offsets = {}
     phases: dict = {}
+    threads_by_plane: dict = {}
     for node_id, snap in sorted(nodes.items(), key=lambda kv: str(kv[0])):
         gauges = snap.get("gauges") or {}
         if "clock_offset_ms" in gauges:
@@ -122,6 +123,12 @@ def build(cluster: dict, ttd_s: Optional[float] = None,
             if name.startswith("phase."):
                 phases.setdefault(str(node_id), {})[
                     name[len("phase."):]] = v
+            elif name.startswith("threads_"):
+                # Thread census (utils/threads.py): live thread counts
+                # by plane per node — the audit trail that the bounded
+                # data pools actually bound (docs/transport.md).
+                threads_by_plane.setdefault(str(node_id), {})[
+                    name[len("threads_"):]] = int(v)
     # Job plane (docs/service.md): rows tagged "src->dest#job" are the
     # per-job ADDITIVE split of the base rows — they render in their own
     # section so the base table still reconciles byte-exactly.
@@ -145,6 +152,7 @@ def build(cluster: dict, ttd_s: Optional[float] = None,
         "counters": dict(sorted(counters.items())),
         "planes": _split_counters(counters),
         "phases_ms_by_node": phases,
+        "threads_by_plane": threads_by_plane,
         "clock_offsets_ms": offsets,
         "nodes": {str(n): {"counters": snap.get("counters") or {},
                            "gauges": snap.get("gauges") or {}}
@@ -371,6 +379,21 @@ def render_md(report: dict) -> str:
         lines += ["## Phase totals by node (ms, thread-time sums)", ""]
         for node, per in sorted(phases.items()):
             items = ", ".join(f"{k}={_fmt(v)}"
+                              for k, v in sorted(per.items()))
+            lines.append(f"- node {node}: {items}")
+        lines.append("")
+    threads = report.get("threads_by_plane") or {}
+    if threads:
+        lines += [
+            "## Threads by plane (live census at last report)",
+            "",
+            "Data-plane threads are bounded by the worker pools "
+            "(utils/threads.py; docs/transport.md) — connection count "
+            "never implies thread count.",
+            "",
+        ]
+        for node, per in sorted(threads.items()):
+            items = ", ".join(f"{k}={v}"
                               for k, v in sorted(per.items()))
             lines.append(f"- node {node}: {items}")
         lines.append("")

@@ -226,6 +226,13 @@ class Config:
     # lease; on leader death the lowest-ranked live standby takes over
     # at a bumped epoch.  Standby ids must name receiver seats.
     standbys: List[NodeID] = dataclasses.field(default_factory=list)
+    # Hierarchical control (docs/hierarchy.md), mode 3 only: either an
+    # auto-partition request ``{"Size": K}`` (0 = ~sqrt(N) groups) over
+    # every non-root seat, or an explicit list of ``{"Leader": id,
+    # "Members": [...]}`` declarations.  Grouped members point their
+    # control plane at their sub-leader; the root plans over group
+    # ingress nodes.  None = flat control (the legacy plane).
+    groups: Optional[object] = None
 
     @classmethod
     def from_json(cls, d: dict) -> "Config":
@@ -242,7 +249,13 @@ class Config:
             model_codec=_validated_codec(_jget(d, "ModelCodec", "raw") or "raw"),
             wire_codec=_validated_codec(_jget(d, "WireCodec", "raw") or "raw"),
             standbys=[int(s) for s in _jget(d, "Standbys") or []],
+            groups=_jget(d, "Groups"),
         )
+        if conf.groups is not None and not isinstance(conf.groups,
+                                                      (dict, list)):
+            raise ValueError(
+                "Groups must be {'Size': K} or a list of "
+                "{'Leader': id, 'Members': [...]} declarations")
         if conf.wire_codec != "raw":
             # Fail at PARSE time like an unknown codec: a wire codec
             # re-encodes the CANONICAL blob, so the canonical form must

@@ -6,8 +6,14 @@ from .failover import (  # noqa: F401
     StandbyController,
 )
 from .failure import FailureDetector, HeartbeatSender  # noqa: F401
+from .hierarchy import (  # noqa: F401
+    SubLeaderController,
+    groups_from_config,
+    partition_groups,
+)
 from .leader import (  # noqa: F401
     FlowRetransmitLeaderNode,
+    HierarchicalFlowLeaderNode,
     LeaderNode,
     PullRetransmitLeaderNode,
     RetransmitLeaderNode,

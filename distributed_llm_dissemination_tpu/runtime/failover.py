@@ -173,6 +173,11 @@ class ShadowLeaderState:
         # encoded partials live in.
         self.wire_codecs: Dict[Tuple[NodeID, int], str] = {}
         self.node_codecs: Dict[NodeID, list] = {}
+        # Hierarchical control (docs/hierarchy.md): the group table
+        # (``{gid: {"Leader", "Members", "Dissolved"}}``) — a promoted
+        # standby must reconstruct the SAME hierarchy (or its dissolved
+        # remains), not fall back to flat planning.
+        self.groups: dict = {}
         self.have_snapshot = False
         self.deltas_applied = 0
 
@@ -220,6 +225,8 @@ class ShadowLeaderState:
                 if d.get("BaseAssignment") is not None:
                     self.base_assignment = _nested_layer_map_from_json(
                         d.get("BaseAssignment"))
+                self.groups = {str(g): dict(rec) for g, rec in
+                               (d.get("Groups") or {}).items()}
                 self.have_snapshot = True
             elif k == "status":
                 self.status[int(d["Node"])] = layer_ids_from_json(
@@ -266,6 +273,12 @@ class ShadowLeaderState:
             elif k == "base_assignment":
                 self.base_assignment = _nested_layer_map_from_json(
                     d.get("Assignment"))
+            elif k == "groups":
+                # The hierarchical group table (docs/hierarchy.md):
+                # always the full current table (a dissolve re-sends
+                # it), so REPLACE.
+                self.groups = {str(g): dict(rec) for g, rec in
+                               (d.get("Groups") or {}).items()}
             elif k == "codecs":
                 # Wire-codec choices + capability table (docs/codec.md).
                 # REPLACE, don't merge: the delta always carries the
@@ -321,6 +334,7 @@ class ShadowLeaderState:
                 "wire_codecs": dict(self.wire_codecs),
                 "node_codecs": {n: list(c)
                                 for n, c in self.node_codecs.items()},
+                "groups": {g: dict(rec) for g, rec in self.groups.items()},
                 "have_snapshot": self.have_snapshot,
             }
 
@@ -463,6 +477,7 @@ class StandbyController:
         self.receiver.note_leader_epoch(epoch)
         from .leader import (
             FlowRetransmitLeaderNode,
+            HierarchicalFlowLeaderNode,
             LeaderNode,
             PullRetransmitLeaderNode,
             RetransmitLeaderNode,
@@ -471,6 +486,12 @@ class StandbyController:
         classes = [LeaderNode, RetransmitLeaderNode,
                    PullRetransmitLeaderNode, FlowRetransmitLeaderNode]
         cls = classes[mode]
+        groups = shadow.get("groups") or {}
+        if mode == 3 and groups:
+            # The dead root ran the hierarchy (docs/hierarchy.md): the
+            # promoted leader must keep it — flat planning would send
+            # leases to grouped members and re-point them all.
+            cls = HierarchicalFlowLeaderNode
         kwargs = dict(start_loop=False, loop=self.receiver.loop,
                       lock=self.receiver._lock,
                       expected_nodes=set(), failure_timeout=ft,
@@ -484,6 +505,8 @@ class StandbyController:
         args = (self.node, self.receiver.layers, shadow["assignment"])
         if mode == 3:
             bw = self._bw if self._bw is not None else shadow["network_bw"]
+            if cls is HierarchicalFlowLeaderNode:
+                kwargs["groups"] = groups
             leader = cls(*args, bw, **kwargs)
         else:
             leader = cls(*args, **kwargs)

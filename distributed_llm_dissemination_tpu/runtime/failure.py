@@ -55,7 +55,8 @@ class HeartbeatSender:
     def start(self) -> None:
         if self._interval <= 0 or self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"heartbeat-{self._my_id}")
         self._thread.start()
 
     def _run(self) -> None:
@@ -91,7 +92,8 @@ class FailureDetector:
     def start(self) -> None:
         if self._timeout <= 0 or self._thread is not None:
             return
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="detector")
         self._thread.start()
 
     def touch(self, node_id: NodeID) -> None:

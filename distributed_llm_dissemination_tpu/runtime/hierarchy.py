@@ -1,0 +1,460 @@
+"""Hierarchical control: sub-leaders own a group's fan-out and fold its
+control traffic upward (docs/hierarchy.md).
+
+The flat control plane makes the leader touch every (dest, layer) pair:
+it plans them all in one flow graph, receives every announce, every ack,
+every heartbeat, and every metrics report.  At fleet scale both ends of
+that are the ceiling — the solve grows with node count, and the leader's
+message loop handles O(nodes) control traffic per layer.
+
+This module is the scale-out: the fleet partitions into GROUPS, each
+owned by a sub-leader (itself an ordinary receiver seat).  The root
+plans delivery to group INGRESS nodes only (``sched/flow.py`` over
+groups and the inter-group links); the sub-leader owns its members'
+plan dispatch, ack/NACK aggregation, liveness, and telemetry fold,
+reporting only aggregate coverage upward (``GroupStatusMsg``) — the
+root handles O(groups) messages where the flat plane handled O(nodes).
+
+Pieces:
+
+- :func:`partition_groups` — deterministic auto-partition (explicit
+  group declarations come from the config's ``Groups`` section).
+- :class:`SubLeaderController` — attach to a receiver to make its seat
+  a sub-leader: registers the member-facing handlers (announce / ack /
+  heartbeat / metrics) on the receiver's already-running loop, fans
+  each completed layer out to the members wanting it, and folds
+  everything upward.
+- The root half is :class:`~.leader.HierarchicalFlowLeaderNode`
+  (runtime/leader.py), which also owns the failover semantics: a dead
+  sub-leader DISSOLVES its group back to flat delivery
+  (``GroupPlanMsg(dissolve=True)`` to each member), and the group
+  table rides the epoch-fenced ``ControlDeltaMsg`` replication so a
+  promoted standby keeps the hierarchy.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core.types import LayerID, NodeID, delivered, satisfies
+from ..transport.messages import (
+    AckMsg,
+    AnnounceMsg,
+    BootReadyMsg,
+    GroupPlanMsg,
+    GroupStatusMsg,
+    HeartbeatMsg,
+    MetricsReportMsg,
+    SwapCommitMsg,
+)
+from ..utils import threads, trace
+from ..utils.logging import log
+from .failure import FailureDetector
+from .send import send_layer
+
+# How often a sub-leader re-drives unacked member sends (the safety net
+# under event-driven fan-out: a send eaten by a partition window or a
+# member restart is re-sent instead of waiting on root-level recovery).
+GROUP_RESEND_S = float(os.environ.get("DLD_GROUP_RESEND_S", "2.0"))
+# Debounce for folding member announces into one upward aggregate: a
+# fleet announcing at start collapses into ~one message per group.
+ANNOUNCE_FOLD_S = float(os.environ.get("DLD_GROUP_ANNOUNCE_FOLD_S", "0.1"))
+
+
+def partition_groups(node_ids: List[NodeID],
+                     group_size: int = 0) -> Dict[int, dict]:
+    """Deterministic auto-partition of ``node_ids`` into groups:
+    ``{gid: {"leader": sub_leader_id, "members": [...]}}`` (the
+    sub-leader is the group's first member).  ``group_size`` 0 sizes
+    groups at ~sqrt(N), so both the root's group count and each
+    sub-leader's member count grow as sqrt(N) — the balanced two-level
+    split (root-handled traffic grows sub-linearly in N)."""
+    ids = sorted(int(n) for n in node_ids)
+    if not ids:
+        return {}
+    size = int(group_size) or max(2, math.isqrt(len(ids)))
+    out: Dict[int, dict] = {}
+    for gid, start in enumerate(range(0, len(ids), size)):
+        chunk = ids[start:start + size]
+        out[gid] = {"leader": chunk[0], "members": chunk}
+    return out
+
+
+def groups_from_config(spec, node_ids: List[NodeID],
+                       leader_id: NodeID) -> Dict[int, dict]:
+    """The config's ``Groups`` section → the group table.  Either an
+    auto-partition request (``{"Size": K}``; 0 = sqrt sizing) over every
+    non-root seat, or an explicit list of ``{"Leader": id, "Members":
+    [...]}`` declarations.  The root is never grouped."""
+    ids = [int(n) for n in node_ids if int(n) != int(leader_id)]
+    if isinstance(spec, dict):
+        return partition_groups(ids, int(spec.get("Size", 0) or 0))
+    out: Dict[int, dict] = {}
+    seen: set = set()
+    known = set(ids)
+    for gid, rec in enumerate(spec or []):
+        sub = int(rec["Leader"])
+        members = sorted({int(m) for m in rec.get("Members") or []} | {sub})
+        if int(leader_id) in members:
+            raise ValueError("the root leader cannot be a group member")
+        unknown = set(members) - known
+        if unknown:
+            # Fail at CONFIG time like every other topology error — a
+            # hierarchy around a seat that doesn't exist would hang the
+            # run (its members' ingress demand targets a dead address).
+            raise ValueError(
+                f"Groups names unknown node ids {sorted(unknown)}")
+        overlap = seen & set(members)
+        if overlap:
+            raise ValueError(f"nodes {sorted(overlap)} appear in more "
+                             "than one group")
+        seen |= set(members)
+        out[gid] = {"leader": sub, "members": members}
+    return out
+
+
+class SubLeaderController:
+    """Make a receiver seat the sub-leader of one group.
+
+    Attach AFTER the receiver's loop is running: the member-facing
+    handlers (announce / ack / heartbeat / metrics report — message
+    types a plain receiver never registers) go onto the same loop, and
+    the receiver's ``on_layer_complete`` hook triggers fan-out the
+    moment one of this seat's own layers completes.  Everything the
+    members produce folds into cumulative ``GroupStatusMsg`` aggregates
+    to whatever seat is currently the root (``node.leader_id`` — a
+    takeover re-points it via the normal lease path, and the pending
+    queue + the reply-to-every-``GroupPlanMsg`` rule reconcile the new
+    root's view)."""
+
+    def __init__(self, receiver, group_id: int, members: List[NodeID],
+                 member_timeout: float = 0.0):
+        self.receiver = receiver
+        self.node = receiver.node
+        self.group_id = int(group_id)
+        self.members = [int(m) for m in members
+                        if int(m) != self.node.my_id]
+        self._lock = threading.Lock()
+        self._active = True
+        self._targets: Dict[NodeID, dict] = {}   # member -> {lid: meta}
+        self._covered: Dict[LayerID, set] = {}   # lid -> members done
+        self._announced: Dict[NodeID, dict] = {}  # member -> holdings
+        self._announce_dirty: set = set()
+        self._announce_timer: Optional[threading.Timer] = None
+        self._dead: set = set()
+        self._sent: Dict[tuple, float] = {}      # (member, lid) -> t
+        self._member_metrics: Dict[NodeID, dict] = {}
+        self._metrics_dirty = False
+        self._metrics_since_push: set = set()
+        self._stop = threading.Event()
+        # Member liveness is the sub-leader's job now: a silent member
+        # is reported upward as Dead (the root drops its pairs loudly),
+        # never individually monitored by the root.
+        self.detector = FailureDetector(member_timeout, self._member_dead)
+        for m in self.members:
+            self.detector.touch(m)
+        loop = receiver.loop
+        loop.register(GroupPlanMsg, self.handle_group_plan)
+        loop.register(AnnounceMsg, self.handle_member_announce)
+        loop.register(AckMsg, self.handle_member_ack)
+        loop.register(HeartbeatMsg,
+                      lambda msg: self.detector.touch(msg.src_id))
+        loop.register(MetricsReportMsg, self.handle_member_metrics)
+        # Root-bound member traffic the aggregate vocabulary doesn't
+        # carry is FORWARDED verbatim: boot reports gate the root's
+        # boot wait, and a member's swap confirm/query/error must reach
+        # the rollout driver (the sub-leader handles leader-originated
+        # swap roles itself — it can be a swap dest too).
+        loop.register(BootReadyMsg, self._forward_to_root)
+        loop.register(SwapCommitMsg, self._route_swap)
+        receiver.on_layer_complete = self._on_own_layer
+        self.detector.start()
+        threading.Thread(target=self._redrive_loop, daemon=True,
+                         name=f"subleader-redrive-{self.node.my_id}"
+                         ).start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.detector.stop()
+        with self._lock:
+            if self._announce_timer is not None:
+                self._announce_timer.cancel()
+
+    def drain(self, timeout: float = 2.0) -> None:
+        """Bounded wait for every live member's final telemetry flush
+        (receivers flush at startup, right before exiting a one-shot
+        run) to arrive and fold upward — a sub-leader exiting the
+        moment ITS startup lands would otherwise race its members'
+        flushes and the root's run report would miss them.  Anything
+        still dirty at the deadline is pushed as-is.  With the
+        telemetry plane disabled members never report, so there is
+        nothing to wait for."""
+        from ..utils import telemetry
+
+        if not telemetry.enabled():
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                live = {m for m in self.members if m not in self._dead}
+                settled = (not self._metrics_dirty
+                           and set(self._member_metrics) >= live)
+            if settled:
+                return
+            time.sleep(0.05)
+        self._push_metrics_if_dirty()
+
+    # ------------------------------------------------------ root-facing
+
+    def _push(self, **sections) -> None:
+        """One aggregate upward.  Rides the receiver's leader-routed
+        send, so a root lost to a failover window queues the report and
+        the takeover lease flushes it."""
+        msg = GroupStatusMsg(self.node.my_id, self.group_id, **sections)
+        self.receiver._send_to_leader(msg)
+
+    def _covered_snapshot_locked(self) -> Dict[LayerID, list]:
+        return {lid: sorted(members)
+                for lid, members in self._covered.items() if members}
+
+    def handle_group_plan(self, msg: GroupPlanMsg) -> None:
+        if self.receiver._fence_stale(msg):
+            return
+        if msg.dissolve:
+            # A root that declared THIS seat dead dissolved the group
+            # (we are a zombie to it): stand down as sub-leader — stop
+            # fan-out AND member liveness monitoring (members now
+            # heartbeat the root; keeping the detector would dead-
+            # report every one of them forever) — and follow the
+            # member path: re-announce to the root.
+            log.warn("sub-leader received dissolve; standing down",
+                     group=self.group_id)
+            with self._lock:
+                self._active = False
+                self._targets.clear()
+            self.detector.stop()
+            self.receiver.handle_group_plan(msg)
+            return
+        with self._lock:
+            self._active = True
+            self._targets = {int(m): dict(row)
+                             for m, row in msg.targets.items()
+                             if int(m) != self.node.my_id}
+            covered = self._covered_snapshot_locked()
+        trace.count("hier.group_plans")
+        log.info("group plan received", group=self.group_id,
+                 members=sorted(self._targets),
+                 layers=sorted({lid for row in msg.targets.values()
+                                for lid in row}))
+        # Receipt always answers with full cumulative coverage: this is
+        # the reconcile channel a promoted root's first re-plan uses.
+        self._push(covered=covered)
+        self._fan_out_ready()
+
+    # ---------------------------------------------------- member-facing
+
+    def handle_member_announce(self, msg: AnnounceMsg) -> None:
+        self.detector.touch(msg.src_id)
+        if self.detector.is_dead(msg.src_id):
+            self.detector.revive(msg.src_id)
+        with self._lock:
+            self._dead.discard(msg.src_id)
+            self._announced[msg.src_id] = dict(msg.layer_ids)
+            self._announce_dirty.add(msg.src_id)
+            # A re-announce is a restart: its RAM holdings are whatever
+            # the announce says now, so sends re-arm.
+            for key in [k for k in self._sent if k[0] == msg.src_id]:
+                del self._sent[key]
+            for members in self._covered.values():
+                members.discard(msg.src_id)
+            for lid, meta in msg.layer_ids.items():
+                want = self._targets.get(msg.src_id, {}).get(lid)
+                held_ok = (satisfies(meta, want) if want is not None
+                           else delivered(meta))
+                if held_ok:
+                    self._covered.setdefault(lid, set()).add(msg.src_id)
+            pending = set(self._announce_dirty)
+        if pending >= set(m for m in self.members
+                          if m not in self._dead):
+            self._flush_announces()
+        else:
+            with self._lock:
+                if self._announce_timer is None:
+                    self._announce_timer = threading.Timer(
+                        ANNOUNCE_FOLD_S, self._flush_announces)
+                    self._announce_timer.daemon = True
+                    self._announce_timer.start()
+        self._fan_out_ready()
+
+    def _flush_announces(self) -> None:
+        with self._lock:
+            if self._announce_timer is not None:
+                self._announce_timer.cancel()
+                self._announce_timer = None
+            dirty = {m: dict(self._announced.get(m) or {})
+                     for m in self._announce_dirty}
+            self._announce_dirty.clear()
+            covered = self._covered_snapshot_locked()
+        if dirty:
+            trace.count("hier.announce_folds")
+            self._push(announced=dirty, covered=covered)
+
+    def handle_member_ack(self, msg: AckMsg) -> None:
+        self.detector.touch(msg.src_id)
+        if msg.shard or msg.version or msg.codec:
+            # Qualified acks (sharded / versioned / codec holdings)
+            # carry tags the aggregate vocabulary doesn't: forward the
+            # ack VERBATIM so the root's swap fences and codec
+            # bookkeeping keep full fidelity (docs/hierarchy.md,
+            # honest limits).
+            trace.count("hier.acks_forwarded")
+            self.receiver._send_to_leader(msg)
+            return
+        push = None
+        with self._lock:
+            done = self._covered.setdefault(msg.layer_id, set())
+            if msg.src_id not in done:
+                done.add(msg.src_id)
+                self._sent.pop((msg.src_id, msg.layer_id), None)
+                if self._layer_complete_locked(msg.layer_id):
+                    push = self._covered_snapshot_locked()
+        if push is not None:
+            trace.count("hier.layer_folds")
+            log.info("group layer fully covered; folding upward",
+                     group=self.group_id, layerID=msg.layer_id)
+            self._push(covered=push)
+
+    def handle_member_metrics(self, msg: MetricsReportMsg) -> None:
+        self.detector.touch(msg.src_id)
+        with self._lock:
+            self._member_metrics[msg.src_id] = {
+                "Counters": dict(msg.counters),
+                "Gauges": dict(msg.gauges),
+                "Links": dict(msg.links),
+                "T": msg.t_wall_ms, "Proc": msg.proc}
+            self._metrics_dirty = True
+            self._metrics_since_push.add(msg.src_id)
+            live = {m for m in self.members if m not in self._dead}
+            flush_now = self._metrics_since_push >= live
+        if flush_now:
+            # Every live member has reported since the last batch: push
+            # NOW instead of waiting out the redrive tick — a short run
+            # (receivers exit right after startup, having flushed their
+            # final snapshots) would otherwise end before the batch
+            # ever left, and the root's report would miss the members.
+            self._push_metrics_if_dirty()
+
+    def _forward_to_root(self, msg) -> None:
+        """Pass a member's root-bound message upward verbatim (boot
+        reports; the forwarded-ack path uses this too)."""
+        self.detector.touch(msg.src_id)
+        trace.count("hier.msgs_forwarded")
+        self.receiver._send_to_leader(msg)
+
+    def _route_swap(self, msg: SwapCommitMsg) -> None:
+        """Leader-bound swap roles (confirm/query/error) from a member
+        forward to the root; leader-ORIGINATED roles (prepare / commit
+        / abort) are this seat's own business — the sub-leader can be
+        a swap dest like any receiver."""
+        if msg.applied or msg.query or msg.error:
+            self._forward_to_root(msg)
+            return
+        self.receiver.handle_swap_commit(msg)
+
+    def _member_dead(self, member: NodeID) -> None:
+        with self._lock:
+            self._dead.add(member)
+            covered = self._covered_snapshot_locked()
+        trace.count("hier.member_dead_reports")
+        log.error("group member silent past timeout; reporting upward",
+                  group=self.group_id, member=member)
+        self._push(dead=[int(member)], covered=covered)
+
+    # ----------------------------------------------------------- fan-out
+
+    def _layer_complete_locked(self, lid: LayerID) -> bool:
+        wanting = [m for m, row in self._targets.items()
+                   if lid in row and m not in self._dead]
+        return bool(wanting) and all(
+            m in self._covered.get(lid, ()) for m in wanting)
+
+    def _on_own_layer(self, lid: LayerID) -> None:
+        self._fan_out_ready()
+
+    def _fan_out_ready(self, resend_after: Optional[float] = None) -> None:
+        """Send every held layer to every member still missing it.
+        Event-driven calls pass no ``resend_after`` (only never-sent
+        pairs go out); the redrive loop passes ``GROUP_RESEND_S`` so
+        sends eaten by a partition or restart re-arm."""
+        now = time.monotonic()
+        due = []
+        with self._lock:
+            if not self._active:
+                return
+            for member, row in self._targets.items():
+                if member in self._dead:
+                    continue
+                for lid in row:
+                    if member in self._covered.get(lid, ()):
+                        continue
+                    t_sent = self._sent.get((member, lid))
+                    if t_sent is not None and (
+                            resend_after is None
+                            or now - t_sent < resend_after):
+                        continue
+                    # Claimed under THIS lock pass: two concurrent
+                    # triggers (own-layer hook + plan receipt) must not
+                    # both dispatch the same pair.
+                    self._sent[(member, lid)] = now
+                    due.append((member, lid))
+        for member, lid in due:
+            with self.receiver._lock:
+                layer = self.receiver.layers.get(lid)
+            if (layer is None or layer.meta.shard or layer.meta.codec
+                    or layer.meta.version):
+                # Not landed here yet (the root's plan is in flight) —
+                # or a QUALIFIED holding (a shard slice / encoded form /
+                # version-stamped rollout copy) that must never be
+                # fanned out as a whole plain raw layer:
+                # un-claim so the next trigger re-collects it once a
+                # full raw copy exists.
+                with self._lock:
+                    self._sent.pop((member, lid), None)
+                continue
+            trace.count("hier.fanout_sends")
+            log.info("fanning layer out to group member", layerID=lid,
+                     member=member, group=self.group_id)
+            threads.tx_pool().submit(self._send_one, member, lid, layer)
+
+    def _send_one(self, member: NodeID, lid: LayerID, layer) -> None:
+        try:
+            self.node.add_node(member)
+            send_layer(self.node, member, lid, layer)
+        except (OSError, KeyError, ConnectionError) as e:
+            log.warn("group fan-out send failed (redrive will retry)",
+                     layerID=lid, member=member, err=repr(e))
+
+    # ----------------------------------------------------------- redrive
+
+    def _redrive_loop(self) -> None:
+        interval = max(GROUP_RESEND_S / 2, 0.05)
+        while not self._stop.wait(interval):
+            try:
+                self._fan_out_ready(resend_after=GROUP_RESEND_S)
+                self._push_metrics_if_dirty()
+            except Exception as e:  # noqa: BLE001 — keep the net up
+                log.error("sub-leader redrive failed", err=repr(e))
+
+    def _push_metrics_if_dirty(self) -> None:
+        with self._lock:
+            if not self._metrics_dirty:
+                return
+            self._metrics_dirty = False
+            self._metrics_since_push.clear()
+            batch = {m: dict(s) for m, s in self._member_metrics.items()}
+        if batch:
+            self._push(metrics=batch)

@@ -69,6 +69,8 @@ from ..transport.messages import (
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
+    GroupPlanMsg,
+    GroupStatusMsg,
     HeartbeatMsg,
     JobRevokeMsg,
     JobStatusMsg,
@@ -519,12 +521,20 @@ class LeaderNode:
             if self._lease_stop.wait(self.lease_interval):
                 return
 
+    def _lease_recipients_locked(self) -> Set[NodeID]:
+        """Who hears the leadership beacon.  Lock held.  The
+        hierarchical leader narrows this to sub-leaders + ungrouped
+        seats: a grouped member's control parent is its SUB-LEADER, and
+        a root lease reaching it would re-point it flat
+        (docs/hierarchy.md)."""
+        return (set(self.status) | set(self.standbys)
+                | self.expected_nodes | set(self.assignment))
+
     def _broadcast_lease(self) -> None:
         with self._lock:
             if self._deposed:
                 return
-            recipients = (set(self.status) | set(self.standbys)
-                          | self.expected_nodes | set(self.assignment))
+            recipients = self._lease_recipients_locked()
             recipients.discard(self.node.my_id)
         msg = LeaderLeaseMsg(self.node.my_id, self.epoch,
                              list(self.standbys), self.lease_interval)
@@ -629,7 +639,14 @@ class LeaderNode:
                 "Metrics": {str(n): {k: v for k, v in s.items()
                                      if not k.startswith("_")}
                             for n, s in self.cluster_metrics.items()},
+                # Subclass sections (e.g. the hierarchical leader's
+                # group table, docs/hierarchy.md).
+                **self._snapshot_extra_locked(),
             }
+
+    def _snapshot_extra_locked(self) -> dict:
+        """Extra snapshot sections from subclasses.  Lock held."""
+        return {}
 
     def _send_snapshot_to(self, standby: NodeID) -> None:
         if self.replicator is None or self.epoch < 0:
@@ -1229,6 +1246,9 @@ class LeaderNode:
         the endpoint that owns it (utils/telemetry.fold_links).  This is
         what the -watch hook logs mid-run and what cli/report.py renders
         into RUN_REPORT."""
+        from ..utils import threads as threads_util
+
+        threads_util.publish_census()
         own = telemetry.snapshot()
         own_gauges = dict(own.get("gauges") or {})
         for name, rec in (own.get("phases") or {}).items():
@@ -1349,8 +1369,15 @@ class LeaderNode:
         with self._lock:
             return dict(self._boot_kinds)
 
+    def _touch_liveness(self, src_id: NodeID) -> None:
+        """Refresh a reporter's lease.  The hierarchical leader skips
+        GROUPED members — their liveness belongs to the sub-leader's
+        detector, and a forwarded boot report must not create a
+        root-side lease that later falsely expires."""
+        self.detector.touch(src_id)
+
     def handle_boot_ready(self, msg: BootReadyMsg) -> None:
-        self.detector.touch(msg.src_id)
+        self._touch_liveness(msg.src_id)
         logger = log.error if msg.kind == "failed" else log.info
         logger("node booted its model", node=msg.src_id, kind=msg.kind,
                boot_seconds=round(msg.seconds, 6))
@@ -1498,12 +1525,19 @@ class LeaderNode:
 
     # -------------------------------------------------------------- handlers
 
+    def _await_announce_set_locked(self) -> Set[NodeID]:
+        """The nodes whose announce gates the distribution start.  Lock
+        held.  The hierarchical leader excludes grouped members — they
+        announce to their SUB-LEADER, whose own announce (it is an
+        ingress dest) is what the root waits on (docs/hierarchy.md)."""
+        return set(self.assignment) | self.expected_nodes
+
     def _maybe_start(self) -> bool:
         """Flip to started when every awaited node has announced."""
         with self._lock:
             if self._started or self._starting:
                 return False
-            for node_id in set(self.assignment) | self.expected_nodes:
+            for node_id in self._await_announce_set_locked():
                 if node_id not in self.status:
                     return False
             self._starting = True
@@ -2614,16 +2648,27 @@ class LeaderNode:
             AckMsg(self.node.my_id, msg.layer_id, LayerLocation.INMEM),
         )
 
+    def _ack_liveness(self, src_id: NodeID) -> bool:
+        """The ack path's liveness gate: False = the sender is written
+        off and the ack must be ignored; True also refreshes its lease.
+        The hierarchical leader overrides for GROUPED members — their
+        liveness belongs to the sub-leader's detector, so an aggregated
+        ack must neither create a root-side lease nor bounce off one
+        (docs/hierarchy.md)."""
+        if self.detector.is_dead(src_id):
+            # Re-creating the status row would resurrect the node as a
+            # schedulable sender that no one monitors anymore.
+            log.warn("ignoring ack from crashed node", node=src_id)
+            return False
+        self.detector.touch(src_id)
+        return True
+
     def handle_ack(self, msg: AckMsg) -> None:
         """Record delivery; on satisfaction broadcast startup + signal ready
         (node.go:410-432)."""
         if msg.src_id != self.node.my_id:
-            if self.detector.is_dead(msg.src_id):
-                # Re-creating the status row would resurrect the node as a
-                # schedulable sender that no one monitors anymore.
-                log.warn("ignoring ack from crashed node", node=msg.src_id)
+            if not self._ack_liveness(msg.src_id):
                 return
-            self.detector.touch(msg.src_id)
         with self._lock:
             row = self.status.setdefault(msg.src_id, {})
             # Carry the layer's size into the new owner's status entry (the
@@ -3445,6 +3490,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         t, self_jobs, jobs = self.assign_jobs()
         self._dispatch(t, self_jobs, jobs)
 
+    def _plan_assignment_locked(self) -> Assignment:
+        """The goal ``assign_jobs`` plans over — the full assignment in
+        flat mode; the hierarchical leader reduces grouped members to
+        group-ingress demands (docs/hierarchy.md).  Lock held."""
+        return self.assignment
+
     def assign_jobs(self) -> Tuple[int, FlowJobsMap, FlowJobsMap]:
         """Split off self-jobs (dest already holds the layer at its own
         client), then solve the flow problem for the rest
@@ -3461,6 +3512,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         # (layer, dest) -> remaining bytes to plan for.
         remaining_sizes: Dict[Tuple[LayerID, NodeID], int] = {}
         with self._lock:
+            # The goal the flow graph plans over: the full assignment in
+            # flat mode; the hierarchical leader substitutes group
+            # INGRESS demands for grouped members' pairs, so the graph
+            # grows with the group count, not the fleet size
+            # (docs/hierarchy.md).
+            plan_asg = self._plan_assignment_locked()
             # Size every layer from announced metadata — the leader need not
             # hold a layer to schedule it (its own layers are in status too).
             # CODEC holdings are skipped: their data_size is the ENCODED
@@ -3479,7 +3536,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             # and each node's encode capability for arc admissibility.
             codec_sizes: Dict[Tuple[LayerID, str], int] = {}
             if self.codecs is not None:
-                for dest_l, lids_l in self.assignment.items():
+                for dest_l, lids_l in plan_asg.items():
                     for lid_l, meta_l in lids_l.items():
                         if meta_l.codec:
                             n = self.codecs.nbytes(lid_l, meta_l.codec)
@@ -3494,7 +3551,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                                 layer_sizes[lid_l] = n
             node_codecs = {n: frozenset(s)
                            for n, s in self.node_codecs.items()}
-            for dest, layer_ids in self.assignment.items():
+            for dest, layer_ids in plan_asg.items():
                 for layer_id, meta in layer_ids.items():
                     if layer_id not in layer_sizes:
                         log.error("no announced size for layer", layerID=layer_id)
@@ -3925,3 +3982,357 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             send_dur_ms=round(dur * 1000, 3),
             throughput_mibps=round(msg.data_size / max(dur, 1e-9) / (1 << 20), 2),
         )
+
+
+class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
+    """Mode 3 scaled out: two-level control for fleet-size fan-out
+    (docs/hierarchy.md).
+
+    The fleet partitions into GROUPS, each owned by a sub-leader
+    (``runtime/hierarchy.SubLeaderController`` on an ordinary receiver
+    seat).  This root keeps the FULL member goal — completion,
+    satisfaction, the job plane, and replication all still speak
+    (member, layer) pairs — but every hot path is reduced to groups:
+
+    - **Planning**: ``_plan_assignment_locked`` substitutes one group
+      INGRESS demand (deliver the layer to the sub-leader) for all of a
+      group's member pairs, so the flow graph — and the solve wall —
+      grows with the group count, not the fleet size.  Sub-leaders fan
+      layers out intra-group and members ack to THEM.
+    - **Upward traffic**: members announce / ack / heartbeat / report
+      metrics to their sub-leader; the root handles cumulative
+      ``GroupStatusMsg`` aggregates — O(groups) messages per event
+      where the flat plane handled O(nodes).
+    - **Failover**: the group table rides the epoch-fenced snapshot +
+      ``groups`` delta, so a promoted standby keeps the hierarchy; a
+      DEAD sub-leader dissolves its group back to flat delivery
+      (members are told to re-point at the root and re-announce).
+
+    Honest limits: grouped targets must be plain full raw layers —
+    pairs carrying a shard, wire codec, or rollout version plan FLAT
+    (directly to the member) and their qualified acks are forwarded
+    verbatim by the sub-leader; standbys must be ungrouped seats."""
+
+    MODE = 3
+
+    def __init__(self, node, layers, assignment, node_network_bw,
+                 groups=None, **kw):
+        self.groups: Dict[int, dict] = {}
+        self._dissolved: Set[int] = set()
+        self._member_group: Dict[NodeID, int] = {}
+        self._group_of_subleader: Dict[NodeID, int] = {}
+        self._dead_members: Set[NodeID] = set()
+        for gid, rec in (groups or {}).items():
+            gid = int(gid)
+            sub = int(rec["leader"] if "leader" in rec else rec["Leader"])
+            members = sorted(int(m) for m in (
+                rec.get("members") or rec.get("Members") or []))
+            dissolved = bool(rec.get("dissolved") or rec.get("Dissolved"))
+            self.groups[gid] = {"leader": sub, "members": members}
+            self._group_of_subleader[sub] = gid
+            if dissolved:
+                self._dissolved.add(gid)
+                continue
+            for m in members:
+                if m != sub:
+                    self._member_group[m] = gid
+        for s in kw.get("standbys") or ():
+            if int(s) in self._member_group or int(s) in \
+                    self._group_of_subleader:
+                raise ValueError(
+                    f"standby {s} is a grouped seat: standbys must be "
+                    "ungrouped (a promoted sub-leader's member-facing "
+                    "handlers would collide with the root's)")
+        super().__init__(node, layers, assignment, node_network_bw, **kw)
+        # Grouped members are their sub-leader's to monitor: the ctor
+        # seeded leases for every assignee, but a member never
+        # heartbeats the root, and an expiring root-side lease would
+        # falsely kill it.
+        for m in self._member_group:
+            self.detector.forget(m)
+
+    # --------------------------------------------------------- wiring
+
+    def _register_handlers(self) -> None:
+        super()._register_handlers()
+        reg = (self.loop.register_keep if self._shared_loop
+               else self.loop.register)
+        reg(GroupStatusMsg, self.handle_group_status)
+
+    def _grouped(self, node_id: NodeID) -> bool:
+        return node_id in self._member_group
+
+    def _ack_liveness(self, src_id: NodeID) -> bool:
+        if self._grouped(src_id):
+            # Member liveness belongs to the sub-leader's detector; an
+            # aggregated ack must neither create a root-side lease nor
+            # resurrect a reported-dead member.
+            return src_id not in self._dead_members
+        return super()._ack_liveness(src_id)
+
+    def _touch_liveness(self, src_id: NodeID) -> None:
+        if not self._grouped(src_id):
+            super()._touch_liveness(src_id)
+
+    def _await_announce_set_locked(self) -> Set[NodeID]:
+        return (super()._await_announce_set_locked()
+                - set(self._member_group))
+
+    def _lease_recipients_locked(self) -> Set[NodeID]:
+        return (super()._lease_recipients_locked()
+                - set(self._member_group))
+
+    # ------------------------------------------------------- planning
+
+    def _ingress_ok_locked(self, gid: int, lid: LayerID) -> bool:
+        """Whether (group, layer) may route through the group's ingress:
+        the sub-leader's OWN target for the layer (if any) must be a
+        plain full raw one — a qualified sub-leader pair (shard / codec
+        / version) would collide with the synthetic full-raw demand in
+        one plan slot, and its holding could never be fanned out whole.
+        Lock held."""
+        sub = self.groups[gid]["leader"]
+        own = (self.assignment.get(sub) or {}).get(lid)
+        return own is None or not (own.shard or own.codec or own.version)
+
+    def _plan_assignment_locked(self) -> Assignment:
+        """The reduced goal the flow graph sees: grouped members' still-
+        missing plain pairs collapse into one full-raw ingress demand
+        per (group, layer); qualified pairs (shard / codec / version),
+        pairs whose INGRESS would hold a qualified copy, and ungrouped
+        seats plan flat.  Lock held."""
+        out: Assignment = {}
+        for dest, lids in self.assignment.items():
+            gid = self._member_group.get(dest)
+            if gid is None:
+                row = out.setdefault(dest, {})
+                for lid, meta in lids.items():
+                    row[lid] = meta
+                continue
+            ingress = self.groups[gid]["leader"]
+            for lid, meta in lids.items():
+                if (meta.shard or meta.codec or meta.version
+                        or not self._ingress_ok_locked(gid, lid)):
+                    out.setdefault(dest, {})[lid] = meta
+                    continue
+                held = self.status.get(dest, {}).get(lid)
+                if held is not None and satisfies(held, meta):
+                    continue  # the member already holds it
+                out.setdefault(ingress, {}).setdefault(lid, LayerMeta())
+        return out
+
+    def send_layers(self) -> None:
+        super().send_layers()
+        self._send_group_plans()
+
+    def _send_group_plans(self) -> None:
+        """Hand every live sub-leader its members' current targets.
+        Sent with every (re-)plan — idempotent at the sub-leader, and
+        its receipt-reply (full cumulative coverage) doubles as the
+        reconcile channel after a root takeover."""
+        with self._lock:
+            plans = []
+            for gid, rec in sorted(self.groups.items()):
+                if gid in self._dissolved:
+                    continue
+                targets = {}
+                for m in rec["members"]:
+                    if m == rec["leader"] or m in self._dead_members:
+                        continue
+                    lids = self.assignment.get(m)
+                    if not lids:
+                        continue
+                    row = {lid: meta for lid, meta in lids.items()
+                           if not (meta.shard or meta.codec
+                                   or meta.version)
+                           and self._ingress_ok_locked(gid, lid)}
+                    if row:
+                        targets[m] = row
+                plans.append((gid, rec["leader"], targets))
+        for gid, sub, targets in plans:
+            try:
+                self.node.add_node(sub)
+                self.node.transport.send(
+                    sub, GroupPlanMsg(self.node.my_id, gid, targets,
+                                      epoch=self.epoch))
+                trace.count("hier.group_plans_sent")
+            except (OSError, KeyError) as e:
+                log.error("group plan send failed (next re-plan "
+                          "re-sends)", group=gid, sub=sub, err=repr(e))
+
+    # ----------------------------------------------------- aggregates
+
+    def handle_group_status(self, msg: GroupStatusMsg) -> None:
+        """One sub-leader aggregate: member announce inventories, member
+        deaths, cumulative coverage, batched member telemetry — each
+        applied through the SAME machinery the flat plane uses, so jobs,
+        content index, replication, and completion are unchanged.
+
+        Sender-gated like the swap fence's foreign-control check
+        (docs/swap.md): only the REGISTERED sub-leader of a live group
+        may speak for it, and only about its OWN members — any other
+        seat could otherwise crash a healthy member or overwrite its
+        status row with one message."""
+        with self._lock:
+            rec = self.groups.get(msg.group_id)
+            foreign = (rec is None or rec["leader"] != msg.src_id
+                       or msg.group_id in self._dissolved)
+            group_members = set(rec["members"]) if rec else set()
+        if foreign:
+            trace.count("hier.foreign_status_dropped")
+            log.warn("group status from a seat that does not own the "
+                     "group; dropped", group=msg.group_id,
+                     src=msg.src_id)
+            return
+        self.detector.touch(msg.src_id)
+        replan_for = []
+        if msg.announced:
+            with self._lock:
+                started = self._started
+            for m, row in sorted(msg.announced.items()):
+                if not self._grouped(m) or m not in group_members:
+                    continue  # dissolved meanwhile / not this group's
+                              # member: a direct announce supersedes
+                self._revive_member(m)
+                with self._lock:
+                    known = m in self.status
+                    self.status[m] = dict(row)
+                # A fold IS the member's restart channel: like the flat
+                # announce path, a re-announced member stops vouching
+                # for its dead incarnation's bytes (fresh vouching
+                # re-accrues via acks; the aggregate vocabulary carries
+                # no digests — docs/hierarchy.md honest limits).
+                self.content.reset_node(m, {})
+                self._replicate("status", Node=m,
+                                Layers=layer_ids_to_json(row))
+                if started and known:
+                    replan_for.append(m)
+            trace.count("hier.announce_aggregates")
+        for m in msg.dead:
+            with self._lock:
+                fresh = (m in group_members and self._grouped(m)
+                         and m not in self._dead_members)
+                if fresh:
+                    self._dead_members.add(m)
+            if fresh:
+                log.error("sub-leader reported member dead",
+                          member=m, group=msg.group_id)
+                trace.count("hier.member_crashes")
+                self.crash(m)
+        if msg.covered:
+            for lid, members in sorted(msg.covered.items()):
+                for m in members:
+                    if int(m) in group_members:
+                        self._apply_member_ack(int(m), int(lid))
+        for m, snap in sorted(msg.metrics.items()):
+            if int(m) in group_members:
+                self._fold_member_metrics(int(m), snap)
+        if replan_for:
+            # A restarted member re-announced (through the fold): its
+            # RAM holdings are gone — re-plan its missing layers like a
+            # direct re-announce would.
+            log.info("aggregated re-announce; re-planning",
+                     members=replan_for)
+            self._maybe_finish()
+            with self._lock:
+                finished = self._startup_sent
+            if not finished:
+                self._recover()
+        self._maybe_finish()
+
+    def _revive_member(self, m: NodeID) -> None:
+        """An announce fold naming a written-off member is its restart
+        coming back through the sub-leader: restore its dropped pairs
+        (pre-startup) exactly like a direct revival announce."""
+        with self._lock:
+            if m not in self._dead_members:
+                return
+            self._dead_members.discard(m)
+            dropped = self._dropped_assignment.pop(m, None)
+            if dropped and not self._startup_sent:
+                self._restore_assignment(m, dropped)
+        log.warn("dead-reported member announced again; reviving",
+                 member=m)
+        if dropped:
+            self._replicate("revive", Node=m)
+
+    def _apply_member_ack(self, m: NodeID, lid: LayerID) -> None:
+        """Apply one aggregated (member, layer) completion.  Reports
+        are CUMULATIVE, so already-satisfied pairs short-circuit before
+        touching replication or the job plane."""
+        with self._lock:
+            held = self.status.get(m, {}).get(lid)
+            if held is not None and delivered(held):
+                return
+        self.handle_ack(AckMsg(m, lid, LayerLocation.INMEM))
+
+    def _fold_member_metrics(self, member: NodeID, snap: dict) -> None:
+        rec = {"counters": dict(snap.get("Counters") or {}),
+               "gauges": dict(snap.get("Gauges") or {}),
+               "links": dict(snap.get("Links") or {}),
+               "t_wall_ms": float(snap.get("T", 0.0)),
+               "proc": str(snap.get("Proc", "")),
+               "_recv_mono": time.monotonic()}
+        with self._lock:
+            self.cluster_metrics[member] = rec
+        self._replicate("metrics", Node=member, Counters=rec["counters"],
+                        Gauges=rec["gauges"], Links=rec["links"],
+                        T=rec["t_wall_ms"], Proc=rec["proc"])
+
+    # ------------------------------------------------------- failover
+
+    def _groups_json(self) -> dict:
+        return {str(g): {"Leader": rec["leader"],
+                         "Members": list(rec["members"]),
+                         "Dissolved": g in self._dissolved}
+                for g, rec in sorted(self.groups.items())}
+
+    def _snapshot_extra_locked(self) -> dict:
+        return {"Groups": self._groups_json()}
+
+    def crash(self, node_id: NodeID) -> None:
+        gid = self._group_of_subleader.get(node_id)
+        with self._lock:
+            dissolve = gid is not None and gid not in self._dissolved
+        if dissolve:
+            self._dissolve_group(gid, node_id)
+        super().crash(node_id)
+
+    def _dissolve_group(self, gid: int, dead_sub: NodeID) -> None:
+        """A dead sub-leader's group degrades to FLAT delivery: members
+        re-point their control parent at this root and re-announce;
+        the re-plan (riding the crash that got us here) then plans them
+        directly.  Replicated, so a later takeover doesn't resurrect
+        the dead hierarchy.  Mutations run under ``_lock``: a re-plan
+        reading ``_member_group``/``_dissolved`` concurrently must see
+        either the grouped or the fully-dissolved state, never a
+        half-popped one."""
+        with self._lock:
+            rec = self.groups[gid]
+            self._dissolved.add(gid)
+            members = [m for m in rec["members"]
+                       if m != dead_sub and m not in self._dead_members]
+            for m in members:
+                self._member_group.pop(m, None)
+        for m in members:
+            # Flat now: the root monitors them directly (their announce
+            # refreshes the lease; one that never re-points expires).
+            self.detector.touch(m)
+        trace.count("hier.groups_dissolved")
+        log.error("sub-leader crashed; dissolving group to flat",
+                  group=gid, dead=dead_sub, members=members)
+        self._replicate("groups", Groups=self._groups_json())
+        out = GroupPlanMsg(self.node.my_id, gid, dissolve=True,
+                           epoch=self.epoch)
+        # The (declared-dead) sub-leader gets the notice too: a FALSE
+        # positive — a partitioned-but-alive sub-leader — must stand
+        # down (stop fanning out, stop dead-reporting members it no
+        # longer hears from) instead of running a zombie group forever.
+        for m in members + [dead_sub]:
+            try:
+                self.node.add_node(m)
+                self.node.transport.send(m, out)
+            except (OSError, KeyError) as e:
+                log.warn("dissolve notice undeliverable (the seat's own "
+                         "timeout will surface it)", member=m,
+                         err=repr(e))

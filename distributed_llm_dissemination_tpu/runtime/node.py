@@ -10,6 +10,7 @@ transfers never block control traffic.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -17,6 +18,7 @@ from typing import Callable, Dict, Optional, Type
 
 from ..core.types import NodeID, RoutingInfo
 from ..transport.base import Transport
+from ..utils import telemetry
 from ..utils.logging import log
 
 
@@ -74,11 +76,24 @@ class MessageLoop:
     property with tamer thread counts.
     """
 
-    def __init__(self, transport: Transport, max_workers: int = 16):
+    def __init__(self, transport: Transport,
+                 max_workers: Optional[int] = None):
+        if max_workers is None:
+            # Fleet-scale knob: an N-node in-process harness (the inmem
+            # fan-out rows) would otherwise lazily grow N x 16 handler
+            # threads.
+            try:
+                max_workers = int(os.environ.get("DLD_MSGLOOP_WORKERS",
+                                                 "16"))
+            except ValueError:
+                max_workers = 16
         self._transport = transport
         self._handlers: Dict[Type, Callable] = {}
         self._stop = threading.Event()
-        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        # Control-plane threads carry stable names (utils/threads.py
+        # census buckets them by prefix; docs/observability.md).
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="ctl-worker")
         self._thread: Optional[threading.Thread] = None
 
     def register(self, msg_cls: Type, handler: Callable) -> None:
@@ -95,16 +110,29 @@ class MessageLoop:
         self._handlers.setdefault(msg_cls, handler)
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="msgloop")
         self._thread.start()
 
     def _run(self) -> None:
         q = self._transport.deliver()
+        # Per-seat control-traffic accounting: the fan-out rows compare
+        # how many control messages the ROOT handled flat vs
+        # hierarchically (docs/hierarchy.md) — keyed by the transport's
+        # bound node id so co-resident inmem nodes don't pool into one
+        # counter.  The id is bound before start() in every real path
+        # and invariant for the loop's lifetime: resolve the counter
+        # key ONCE, off the per-message hot path.
+        node_id = getattr(self._transport, "node_id", None)
+        handled_key = (f"ctrl.handled.{node_id}"
+                       if node_id is not None else None)
         while not self._stop.is_set():
             try:
                 msg = q.get(timeout=0.1)
             except queue.Empty:
                 continue
+            if handled_key is not None:
+                telemetry.count(handled_key)
             handler = self._handlers.get(type(msg))
             if handler is None:
                 log.debug("unhandled message", kind=type(msg).__name__)

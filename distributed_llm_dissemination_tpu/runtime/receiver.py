@@ -46,6 +46,7 @@ from ..transport.messages import (
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
+    GroupPlanMsg,
     JobRevokeMsg,
     LayerDigestsMsg,
     LayerMsg,
@@ -66,6 +67,7 @@ from ..utils import (
     integrity,
     intervals,
     telemetry,
+    threads as threads_util,
     trace,
 )
 from ..utils.buffers import alloc_recv_buffer
@@ -370,6 +372,11 @@ class ReceiverNode:
         # messages (confirm/query/error) forward to the promoted
         # leader's driver — the shared loop keeps THIS handler.
         self.on_swap_leader_msg = None
+        # Hierarchical control (docs/hierarchy.md): an attached
+        # SubLeaderController sets this to trigger intra-group fan-out
+        # the moment one of this seat's own layers completes (fired at
+        # the ack chokepoint, every completion path).
+        self.on_layer_complete = None
         # Latched by close(): a closed receiver's still-draining daemon
         # work (a boot thread finishing late) must not emit leader-routed
         # messages — its seat's address may already belong to a NEW
@@ -419,6 +426,7 @@ class ReceiverNode:
         self.loop.register(LeaderLeaseMsg, self.handle_leader_lease)
         self.loop.register(TimeSyncMsg, self.handle_time_sync)
         self.loop.register(SwapCommitMsg, self.handle_swap_commit)
+        self.loop.register(GroupPlanMsg, self.handle_group_plan)
 
     # ------------------------------------------------- control-plane HA
 
@@ -488,6 +496,38 @@ class ReceiverNode:
                 self.announce()
             except (OSError, KeyError) as e:
                 log.error("re-announce to new leader failed", err=repr(e))
+
+    def handle_group_plan(self, msg: "GroupPlanMsg") -> None:
+        """Member half of hierarchical control (docs/hierarchy.md): a
+        ``dissolve`` notice means this member's sub-leader was declared
+        dead — re-point the control parent at the root and re-announce
+        there (acks/heartbeats/metrics flow to the root; the group
+        degrades to flat delivery).  A TARGETS plan is sub-leader
+        business; a seat without an attached SubLeaderController (which
+        replaces this handler) logs and ignores it."""
+        if self._fence_stale(msg):
+            return
+        if not msg.dissolve:
+            log.warn("group plan received by a non-sub-leader seat; "
+                     "ignoring", group=msg.group_id, src=msg.src_id)
+            return
+        trace.count("hier.dissolved_members")
+        log.warn("group dissolved; re-pointing control parent at root",
+                 group=msg.group_id, root=msg.src_id)
+        self.node.add_node(msg.src_id)
+        with self._lock:
+            self._leader_claim_epoch = max(self._leader_claim_epoch,
+                                           msg.epoch)
+        try:
+            self.node.update_leader(msg.src_id)
+        except KeyError:
+            pass
+        self._flush_leader_pending()
+        try:
+            self.announce()
+        except (OSError, KeyError) as e:
+            log.error("re-announce to root after dissolve failed",
+                      err=repr(e))
 
     def _send_to_leader(self, msg) -> None:
         """Leader-routed send with failover-window requeue: a leader
@@ -620,6 +660,10 @@ class ReceiverNode:
         is simply superseded by the next interval's snapshot."""
         if self._closed_evt.is_set():
             return
+        # Thread census by plane (docs/observability.md): refreshed
+        # just before every snapshot, so the run report's
+        # threads-by-plane table is per node and current.
+        threads_util.publish_census()
         snap = telemetry.snapshot()
         gauges = dict(snap.get("gauges") or {})
         # Phase buckets ride as flat gauges so the leader's fold (and
@@ -1309,7 +1353,8 @@ class ReceiverNode:
             if self._batch_enqueue(msg):
                 return  # a batch thread finishes the whole group
             threading.Thread(
-                target=self._receive_device_plan, args=(msg,), daemon=True
+                target=self._receive_device_plan, args=(msg,), daemon=True,
+                name="fabric-recv",
             ).start()
 
     def _report_plan_gap(self, missing) -> None:
@@ -1348,7 +1393,8 @@ class ReceiverNode:
         if msg.dest_id != self.node.my_id or not msg.layout:
             return
         threading.Thread(
-            target=self._await_spmd_plan, args=(msg, res), daemon=True
+            target=self._await_spmd_plan, args=(msg, res), daemon=True,
+            name="spmd-await",
         ).start()
 
     def _await_spmd_plan(self, msg: DevicePlanMsg, res) -> None:
@@ -1480,7 +1526,8 @@ class ReceiverNode:
                 self._prune_batches_locked()
         if msgs is not None:
             threading.Thread(
-                target=self._receive_device_batch, args=(msgs,), daemon=True
+                target=self._receive_device_batch, args=(msgs,), daemon=True,
+                name="fabric-batch",
             ).start()
         elif timer is not None:
             timer.start()
@@ -1510,7 +1557,8 @@ class ReceiverNode:
         log.warn("fabric plan batch incomplete; processing present plans",
                  batch=batch_id, got=len(msgs))
         threading.Thread(
-            target=self._receive_device_batch, args=(msgs,), daemon=True
+            target=self._receive_device_batch, args=(msgs,), daemon=True,
+            name="fabric-batch",
         ).start()
 
     def _receive_device_batch(self, msgs) -> None:
@@ -1531,7 +1579,7 @@ class ReceiverNode:
             results[i] = self._collect_plan(m)
 
         threads = [threading.Thread(target=collect_one, args=(i, m),
-                                    daemon=True)
+                                    daemon=True, name=f"fabric-collect-{i}")
                    for i, m in enumerate(ordered)]
         for t in threads:
             t.start()
@@ -1796,6 +1844,15 @@ class ReceiverNode:
                                     codec=codec))
         if self.swap is not None and version:
             self.swap.on_layer(layer_id)
+        hook = self.on_layer_complete
+        if hook is not None:
+            # Sub-leader fan-out trigger (docs/hierarchy.md): advisory —
+            # a hook failure must never break the ack path.
+            try:
+                hook(layer_id)
+            except Exception as e:  # noqa: BLE001
+                log.error("layer-complete hook failed", layerID=layer_id,
+                          err=repr(e))
 
     def handle_generate_req(self, msg: GenerateReqMsg) -> None:
         """Serve an inference request from this node's RESIDENT booted
@@ -2207,7 +2264,7 @@ class ReceiverNode:
             return
         self.serve_started.set()
         threading.Thread(
-            target=self._serve, args=(msg,), daemon=True
+            target=self._serve, args=(msg,), daemon=True, name="serve"
         ).start()
 
     def _serve(self, msg: ServeMsg) -> None:

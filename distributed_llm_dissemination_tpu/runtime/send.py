@@ -23,7 +23,7 @@ from ..core.types import (
     shard_range,
 )
 from ..transport.messages import ClientReqMsg, FlowRetransmitMsg, LayerMsg
-from ..utils import telemetry, trace
+from ..utils import telemetry, threads, trace
 from ..utils.logging import log
 from ..utils.rate import TokenBucket
 from .node import Node
@@ -581,6 +581,9 @@ def handle_flow_retransmit(
                          job_id=msg.job_id)
             )
 
-        threading.Thread(target=_simulate_client_fetch, daemon=True).start()
+        # A per-transfer data-plane task: rides the bounded tx pool
+        # (utils/threads.py) — simulated client fetches must not imply
+        # a thread each any more than real sends do.
+        threads.tx_pool().submit(_simulate_client_fetch)
     else:
         log.error("unknown location", layerID=msg.layer_id)

@@ -124,6 +124,20 @@ class MsgType(enum.IntEnum):
     # each sender — the sender drops the pending (job, dest, layer)
     # pairs (counted on ``jobs.revoked_pairs``) instead of burning the
     # reclaimed link budget on superseded commands.
+    # GROUP_PLAN / GROUP_STATUS — hierarchical control
+    # (docs/hierarchy.md): the root leader partitions its fleet into
+    # groups, each owned by a SUB-LEADER.  GROUP_PLAN (root →
+    # sub-leader, epoch-fenced) hands the sub-leader its members'
+    # delivery targets — the root plans the flow problem over group
+    # INGRESS nodes only, and the sub-leader owns intra-group fan-out;
+    # with ``dissolve`` it is instead sent root → member when the
+    # sub-leader died, telling the member to re-point its control
+    # parent at the root (the group degrades to flat).  GROUP_STATUS
+    # (sub-leader → root) is the aggregate upward channel: cumulative
+    # member coverage (one message per completed layer instead of one
+    # ack per member), member announce inventories, member deaths, and
+    # batched member telemetry snapshots — the root handles O(groups)
+    # control messages where the flat plane handled O(nodes).
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -143,6 +157,8 @@ class MsgType(enum.IntEnum):
     JOB_STATUS = 24
     SWAP_COMMIT = 25
     JOB_REVOKE = 26
+    GROUP_PLAN = 27
+    GROUP_STATUS = 28
 
 
 def _epoch_to_payload(payload: dict, epoch: int) -> dict:
@@ -1385,6 +1401,122 @@ class JobRevokeMsg:
         )
 
 
+@dataclasses.dataclass
+class GroupPlanMsg:
+    """Root leader → sub-leader (docs/hierarchy.md): the group's member
+    delivery targets.  Re-sent on every root re-plan — idempotent at
+    the sub-leader (targets REPLACE; receipt also answers with a full
+    cumulative ``GroupStatusMsg``, the takeover/reconcile poke).
+
+    ``targets``: ``{member: {layer: LayerMeta json}}`` — what each
+    member must end up holding.  The sub-leader fans a layer out to
+    every member wanting it the moment its own copy completes.
+
+    ``dissolve`` (root → MEMBER): the member's sub-leader was declared
+    dead — re-point the control parent at ``src_id`` (the root) and
+    re-announce there; the group degrades to flat delivery.  All other
+    fields are omitted on a dissolve notice.
+
+    Epoch-fenced like every leader-originated control message: a
+    zombie root's group plans are rejected, not raced."""
+
+    src_id: NodeID
+    group_id: int = 0
+    targets: dict = dataclasses.field(default_factory=dict)
+    dissolve: bool = False
+    epoch: int = -1
+
+    msg_type = MsgType.GROUP_PLAN
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id, "Group": int(self.group_id)}
+        if self.targets:
+            payload["Targets"] = {
+                str(m): layer_ids_to_json(row)
+                for m, row in self.targets.items()}
+        if self.dissolve:
+            payload["Dissolve"] = True
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "GroupPlanMsg":
+        return cls(
+            src_id=int(d["SrcID"]),
+            group_id=int(d.get("Group", 0)),
+            targets={int(m): layer_ids_from_json(row or {})
+                     for m, row in (d.get("Targets") or {}).items()},
+            dissolve=bool(d.get("Dissolve", False)),
+            epoch=int(d.get("Epoch", -1)),
+        )
+
+
+@dataclasses.dataclass
+class GroupStatusMsg:
+    """Sub-leader → root (docs/hierarchy.md): the aggregate upward
+    channel — the root handles ONE message per group event where the
+    flat plane handled one per member.
+
+    ``covered``: cumulative ``{layer: [members]}`` — members whose copy
+    of the layer completed (verified + acked to the sub-leader).
+    CUMULATIVE on purpose: the root applies it as a set-union, so a
+    report lost in a failover window is repaired by the next one (and
+    by the reply every ``GroupPlanMsg`` receipt sends).
+
+    ``announced``: ``{member: {layer: LayerMeta json}}`` — member
+    announce inventories folded upward (pre-held layers reduce the
+    group's ingress demand).
+
+    ``dead``: members the sub-leader's own failure detector declared
+    crashed; the root drops their pairs exactly like a direct crash.
+
+    ``metrics``: batched member telemetry snapshots (``{member:
+    {"Counters", "Gauges", "Links", "T", "Proc"}}``), folded into the
+    root's cluster table like direct ``MetricsReportMsg`` reports.
+
+    Every section is optional and omitted at default — a legacy peer
+    decodes the required keys alone."""
+
+    src_id: NodeID
+    group_id: int = 0
+    covered: dict = dataclasses.field(default_factory=dict)
+    announced: dict = dataclasses.field(default_factory=dict)
+    dead: list = dataclasses.field(default_factory=list)
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+    msg_type = MsgType.GROUP_STATUS
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id, "Group": int(self.group_id)}
+        if self.covered:
+            payload["Covered"] = {
+                str(lid): [int(m) for m in members]
+                for lid, members in self.covered.items()}
+        if self.announced:
+            payload["Announced"] = {
+                str(m): layer_ids_to_json(row)
+                for m, row in self.announced.items()}
+        if self.dead:
+            payload["Dead"] = [int(m) for m in self.dead]
+        if self.metrics:
+            payload["Metrics"] = {str(m): dict(snap)
+                                  for m, snap in self.metrics.items()}
+        return payload
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "GroupStatusMsg":
+        return cls(
+            src_id=int(d["SrcID"]),
+            group_id=int(d.get("Group", 0)),
+            covered={int(lid): [int(m) for m in members]
+                     for lid, members in (d.get("Covered") or {}).items()},
+            announced={int(m): layer_ids_from_json(row or {})
+                       for m, row in (d.get("Announced") or {}).items()},
+            dead=[int(m) for m in d.get("Dead") or []],
+            metrics={int(m): dict(snap)
+                     for m, snap in (d.get("Metrics") or {}).items()},
+        )
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -1410,6 +1542,8 @@ Message = Union[
     JobStatusMsg,
     SwapCommitMsg,
     JobRevokeMsg,
+    GroupPlanMsg,
+    GroupStatusMsg,
 ]
 
 _DECODERS = {
@@ -1439,6 +1573,8 @@ _DECODERS = {
     MsgType.JOB_STATUS: JobStatusMsg,
     MsgType.SWAP_COMMIT: SwapCommitMsg,
     MsgType.JOB_REVOKE: JobRevokeMsg,
+    MsgType.GROUP_PLAN: GroupPlanMsg,
+    MsgType.GROUP_STATUS: GroupStatusMsg,
 }
 
 

@@ -31,6 +31,7 @@ import itertools
 import json
 import os
 import queue
+import selectors
 import socket
 import struct
 import threading
@@ -39,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.types import LayerID, LayerLocation, LayerMeta, LayerSrc, NodeID
 from ..ops.reassembly import stripe_offsets
-from ..utils import integrity, telemetry, trace
+from ..utils import integrity, telemetry, threads, trace
 from ..utils.backoff import Backoff
 from ..utils.buffers import alloc_recv_buffer
 from ..utils.logging import log
@@ -189,6 +190,288 @@ class _PConn:
         self.lock = threading.Lock()
 
 
+class _ReadinessLoop:
+    """The shared receive event loop: ONE selector thread drives every
+    TcpTransport in the process, so connection count no longer implies
+    thread count (docs/transport.md).
+
+    Three fd kinds ride the selector:
+
+    - **listener** — accepts inline; accepted connections register as
+      conns (no per-connection thread, ever).
+    - **conn** (accepted) — the loop parses the length-prefixed JSON
+      envelope INCREMENTALLY with non-blocking reads (a stalled or
+      malicious peer can never wedge the loop mid-frame).  A complete
+      non-LAYER envelope is decoded and delivered inline — control
+      traffic costs zero threads and can never be starved by slow layer
+      bodies.  A LAYER envelope unregisters the connection and hands it
+      to the bounded ``utils.threads.rx_pool()``: the worker
+      blocking-reads the body through the unchanged zero-copy /
+      stripe-regroup / cut-through paths (the sender is actively
+      streaming it, and only layer bodies ever occupy a worker slot),
+      then re-registers the connection at the next frame boundary.
+    - **drain** — dialed control connections are write-only by protocol;
+      the loop watches them for FIN/RST and evicts, replacing the old
+      per-peer drain threads.
+
+    Registration mutates the selector, which is not thread-safe against
+    a concurrent ``select``: all mutations post to a command queue and
+    wake the loop via a self-pipe."""
+
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self._cmds: "queue.Queue" = queue.Queue()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ,
+                           {"kind": "wake"})
+        threading.Thread(target=self._run, daemon=True,
+                         name="tcp-evloop").start()
+
+    # ------------------------------------------------------ registration
+
+    def _post(self, fn) -> None:
+        self._cmds.put(fn)
+        try:
+            self._wake_w.send(b"x")
+        except (BlockingIOError, OSError):
+            pass  # wake pipe full = the loop is already awake
+
+    def _register(self, sock: socket.socket, rec: dict,
+                  nonblocking: bool = True) -> None:
+        try:
+            if nonblocking:
+                sock.setblocking(False)
+        except OSError:
+            if rec.get("kind") != "drain":
+                rec["transport"]._discard_accepted(sock)
+            return
+        try:
+            self._sel.register(sock, selectors.EVENT_READ, rec)
+        except KeyError:
+            # The kernel reuses fd NUMBERS: a socket closed before its
+            # unwatch command ran leaves a stale selector entry that a
+            # NEW socket with the same fd trips over.  Purge the stale
+            # entry (it can never fire — epoll dropped the closed fd)
+            # and register the live socket.
+            try:
+                stale = self._sel.get_key(sock)
+                self._sel.unregister(stale.fileobj)
+                self._sel.register(sock, selectors.EVENT_READ, rec)
+            except (KeyError, ValueError, OSError):
+                if rec.get("kind") != "drain":
+                    rec["transport"]._discard_accepted(sock)
+        except (ValueError, OSError):
+            if rec.get("kind") != "drain":
+                rec["transport"]._discard_accepted(sock)
+
+    def watch_listener(self, transport: "TcpTransport",
+                       sock: socket.socket) -> None:
+        self._post(lambda: self._register(
+            sock, {"kind": "listener", "transport": transport}))
+
+    def watch_conn(self, transport: "TcpTransport",
+                   sock: socket.socket) -> None:
+        """(Re-)arm envelope parsing on an accepted connection.  Called
+        at accept time and by a pool worker returning a connection at a
+        frame boundary; a transport that closed meanwhile gets the
+        socket closed instead of leaked into the selector."""
+        if transport._closed.is_set():
+            transport._discard_accepted(sock)
+            return
+        rec = {"kind": "conn", "transport": transport, "sock": sock,
+               "buf": bytearray(), "need": _LEN.size, "phase": "len"}
+        self._post(lambda: self._register(sock, rec))
+
+    def watch_drain(self, transport: "TcpTransport", sock: socket.socket,
+                    dest_addr: str, pconn: _PConn) -> None:
+        # The dialed conn stays BLOCKING: senders write frames on it
+        # concurrently (_send_frame under pconn.lock), and flipping it
+        # non-blocking would make a full send buffer raise mid-frame.
+        # The loop's drain read uses MSG_DONTWAIT instead.
+        self._post(lambda: self._register(
+            sock, {"kind": "drain", "transport": transport,
+                   "addr": dest_addr, "pconn": pconn}, nonblocking=False))
+
+    def unwatch_all(self, transport: "TcpTransport") -> None:
+        """Drop every registration belonging to a closing transport."""
+
+        def run():
+            for key in [k for k in list(self._sel.get_map().values())
+                        if k.data.get("transport") is transport]:
+                try:
+                    self._sel.unregister(key.fileobj)
+                except (KeyError, ValueError, OSError):
+                    pass
+
+        self._post(run)
+
+    # -------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        while True:
+            try:
+                events = self._sel.select()
+            except OSError:
+                time.sleep(0.01)  # a closed fd raced the select; retry
+                continue
+            # Wake bytes are consumed BEFORE the command drain — never
+            # the other way around, or a command posted while we were
+            # dispatching has its wake byte swallowed and sleeps until
+            # the next unrelated event (a lost wakeup).
+            try:
+                while self._wake_r.recv(4096):
+                    pass
+            except (BlockingIOError, OSError):
+                pass
+            while True:
+                try:
+                    self._cmds.get_nowait()()
+                except queue.Empty:
+                    break
+            for key, _ in events:
+                rec = key.data
+                kind = rec.get("kind")
+                try:
+                    if kind == "wake":
+                        pass  # drained above
+                    elif kind == "listener":
+                        self._on_accept(key.fileobj, rec)
+                    elif kind == "conn":
+                        self._on_conn(key.fileobj, rec)
+                    elif kind == "drain":
+                        self._on_drain(key.fileobj, rec)
+                except Exception as e:  # noqa: BLE001 — loop must survive
+                    log.error("readiness loop dispatch failed",
+                              kind=kind, err=repr(e))
+                    self._drop(key.fileobj, rec)
+
+    def _drop(self, sock, rec: dict) -> None:
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        tr = rec.get("transport")
+        if tr is not None:
+            tr._discard_accepted(sock)
+        else:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _on_accept(self, listener, rec: dict) -> None:
+        tr: "TcpTransport" = rec["transport"]
+        while True:
+            try:
+                conn, _ = listener.accept()
+            except (BlockingIOError, OSError):
+                return
+            if tr._closed.is_set():
+                conn.close()
+                return
+            with tr._lock:
+                tr._accepted.add(conn)
+            self.watch_conn(tr, conn)
+
+    def _on_conn(self, sock, rec: dict) -> None:
+        """Advance one connection's envelope parse as far as the kernel
+        buffer allows; never blocks."""
+        tr: "TcpTransport" = rec["transport"]
+        while True:
+            try:
+                chunk = sock.recv(rec["need"] - len(rec["buf"]))
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._drop(sock, rec)
+                return
+            if not chunk:
+                self._drop(sock, rec)  # clean EOF (or RST)
+                return
+            rec["buf"] += chunk
+            if len(rec["buf"]) < rec["need"]:
+                continue
+            if rec["phase"] == "len":
+                (rec["need"],) = _LEN.unpack(bytes(rec["buf"]))
+                rec["buf"] = bytearray()
+                rec["phase"] = "env"
+                continue
+            # One complete envelope.
+            try:
+                envelope = json.loads(bytes(rec["buf"]))
+                mtype = MsgType(envelope["type"])
+            except (ValueError, KeyError) as e:
+                if not tr._closed.is_set():
+                    log.error("receive loop failed", err=e)
+                self._drop(sock, rec)
+                return
+            rec["buf"] = bytearray()
+            rec["need"] = _LEN.size
+            rec["phase"] = "len"
+            if mtype != MsgType.LAYER:
+                overflow = tr._deliver_control(mtype, envelope)
+                if overflow is None:
+                    continue
+                # Delivery queue FULL: the consumer is wedged or
+                # absent.  Take the CONNECTION off the loop and let a
+                # pool worker do the blocking put, then re-register —
+                # per-connection ordering is preserved (nobody else
+                # reads the socket meanwhile) and the loop itself
+                # never blocks.
+                try:
+                    self._sel.unregister(sock)
+                except (KeyError, ValueError, OSError):
+                    return
+                threads.rx_pool().submit(tr._deliver_control_blocking,
+                                         sock, overflow)
+                return
+            # Layer body follows: hand the connection to the bounded
+            # worker pool for the (blocking) body read; the worker
+            # re-registers at the next frame boundary.
+            try:
+                self._sel.unregister(sock)
+            except (KeyError, ValueError, OSError):
+                return
+            threads.rx_pool().submit(tr._serve_layer_body, sock, envelope)
+            return
+
+    def _on_drain(self, sock, rec: dict) -> None:
+        """Dialed control conns: peers never write here, so readable
+        means FIN/RST (or stray bytes to discard) — evict so the next
+        send re-dials."""
+        tr: "TcpTransport" = rec["transport"]
+        while True:
+            try:
+                data = sock.recv(4096, socket.MSG_DONTWAIT)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                data = b""
+            if data:
+                continue  # discard unexpected bytes until EOF
+            try:
+                self._sel.unregister(sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            if not tr._closed.is_set():
+                tr._evict(rec["addr"], rec["pconn"])
+            return
+
+
+_loop: Optional[_ReadinessLoop] = None
+_loop_lock = threading.Lock()
+
+
+def _readiness_loop() -> _ReadinessLoop:
+    global _loop
+    with _loop_lock:
+        if _loop is None:
+            _loop = _ReadinessLoop()
+        return _loop
+
+
 class TcpTransport(Transport):
     def __init__(
         self,
@@ -263,41 +546,73 @@ class TcpTransport(Transport):
             actual = self._listener.getsockname()[1]
             self.addr = f"{host}:{actual}" if not addr.startswith(":") else f":{actual}"
         log.info("start listening", addr=self.addr)
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        # The shared readiness loop owns the listener AND every accepted
+        # connection (docs/transport.md): accepts and control frames are
+        # handled inline in the loop thread; layer bodies ride the
+        # bounded rx pool — K connections never mean K threads.
+        _readiness_loop().watch_listener(self, self._listener)
 
     # ------------------------------------------------------------------ rx
 
-    def _accept_loop(self) -> None:
+    def _discard_accepted(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._accepted.discard(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _deliver_control(self, mtype: MsgType, envelope: dict):
+        """Deliver one inline-parsed control envelope; returns None on
+        success (undecodable frames are dropped, logged, and count as
+        delivered) or the DECODED message when the delivery queue is
+        FULL — the caller (the readiness loop) then hands the whole
+        connection plus the message to ``_deliver_control_blocking``,
+        so the loop itself never blocks, per-connection frame order is
+        preserved (nothing reads the socket until the blocked put
+        lands), and the decode is paid exactly once."""
+        try:
+            msg = decode_msg(mtype, envelope["payload"])
+        except (ValueError, KeyError) as e:
+            if not self._closed.is_set():
+                log.error("control frame decode failed", err=repr(e))
+            return None
+        try:
+            self._queue.put_nowait(msg)
+            return None
+        except queue.Full:
+            return msg
+
+    def _deliver_control_blocking(self, conn: socket.socket, msg) -> None:
+        """Pool worker: block until the full delivery queue accepts the
+        message (the consumer's backpressure, like the old
+        per-connection reader), then return the connection to the
+        readiness loop."""
         while not self._closed.is_set():
             try:
-                conn, _ = self._listener.accept()
-            except OSError:
-                return  # listener closed
-            with self._lock:
-                self._accepted.add(conn)
-            threading.Thread(
-                target=self._recv_loop, args=(conn,), daemon=True
-            ).start()
+                self._queue.put(msg, timeout=0.5)
+                break
+            except queue.Full:
+                continue
+        _readiness_loop().watch_conn(self, conn)
 
-    def _recv_loop(self, conn: socket.socket) -> None:
-        """Per-connection reader (transport.go:97-225)."""
+    def _serve_layer_body(self, conn: socket.socket, envelope: dict) -> None:
+        """Pool worker: blocking-read one layer frame's body through the
+        unchanged receive paths (zero-copy sink placement, stripe
+        regroup, cut-through relay), then return the connection to the
+        readiness loop at the frame boundary."""
         try:
-            while True:
-                envelope = _recv_frame(conn)
-                if envelope is None:
-                    return
-                mtype = MsgType(envelope["type"])
-                if mtype != MsgType.LAYER:
-                    self._queue.put(decode_msg(mtype, envelope["payload"]))
-                    continue
-                self._receive_layer(conn, envelope)
+            conn.setblocking(True)
+            self._receive_layer(conn, envelope)
         except (ConnectionError, OSError, ValueError, KeyError) as e:
             if not self._closed.is_set():
                 log.error("receive loop failed", err=e)
-        finally:
-            with self._lock:
-                self._accepted.discard(conn)
-            conn.close()
+            self._discard_accepted(conn)
+            return
+        except BaseException:
+            self._discard_accepted(conn)
+            raise
+        _readiness_loop().watch_conn(self, conn)
 
     def _frame_ok(self, header: LayerHeader, view,
                   notify: bool = True) -> Tuple[bool, float]:
@@ -570,6 +885,7 @@ class TcpTransport(Transport):
             if not self._stripe_sweeper_started:
                 self._stripe_sweeper_started = True
                 threading.Thread(target=self._stripe_sweep_loop,
+                                 name="tcp-stripe-sweep",
                                  daemon=True).start()
         pipe_sock = self._stripe_pipe_sock(header, envelope)
         key = (header.src_id, header.layer_id, header.stripe_tid)
@@ -812,32 +1128,19 @@ class TcpTransport(Transport):
                 except OSError:
                     self._evict(dest_addr, pconn)
                     raise
-                threading.Thread(
-                    target=self._drain_control, args=(dest_addr, pconn),
-                    daemon=True,
-                ).start()
+                # Watch the dialed conn for FIN/RST on the shared
+                # readiness loop (the old per-peer drain thread).
+                # Dialed control conns are write-only by protocol
+                # (replies arrive on the PEER'S dial to OUR listener),
+                # so readable means the peer closed — without the
+                # watch, a peer restart leaves a half-closed socket in
+                # the pool and the NEXT send to it succeeds silently
+                # (TCP buffers the bytes, the RST arrives later): one
+                # message vanishes without tripping the send path's
+                # evict-and-redial retry.
+                _readiness_loop().watch_drain(self, pconn.sock,
+                                              dest_addr, pconn)
         return pconn
-
-    def _drain_control(self, dest_addr: str, pconn: _PConn) -> None:
-        """Evict a dialed control connection the moment the peer closes.
-
-        Dialed control conns are write-only by protocol (replies arrive
-        on the PEER'S dial to OUR listener), so a recv() here only ever
-        returns on FIN/RST.  Without this, a peer restart leaves a
-        half-closed socket in the pool and the NEXT send to it succeeds
-        silently (TCP buffers the bytes, the RST arrives later) — one
-        message vanishes without tripping the send path's evict-and-
-        redial retry.  A rebound seat (a genreq requester reusing an
-        idle seat's address, a restarted node) would lose exactly its
-        first reply that way."""
-        sock = pconn.sock
-        try:
-            while sock.recv(4096):
-                pass  # peers never write here; discard until EOF
-        except OSError:
-            pass
-        if not self._closed.is_set():
-            self._evict(dest_addr, pconn)
 
     def _evict(self, dest_addr: str, pconn: _PConn) -> None:
         """Drop a broken control connection so the next send re-dials."""
@@ -1013,16 +1316,13 @@ class TcpTransport(Transport):
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
 
-        threads = [
-            threading.Thread(target=send_stripe, args=(i, off, size),
-                             name=f"stripe-{message.layer_id}-{i}")
-            for i, (off, size) in enumerate(spans[1:], start=1)
-        ]
-        for t in threads:
-            t.start()
-        send_stripe(0, *spans[0])
-        for t in threads:
-            t.join()
+        # Concurrent stripes ride the bounded tx pool (utils/threads.py)
+        # — stripe 0 runs on the calling thread (run_all's guaranteed-
+        # progress slot), so a saturated pool serializes extra stripes
+        # instead of spawning a thread per stripe.
+        threads.tx_pool().run_all(
+            [(send_stripe, i, off, size)
+             for i, (off, size) in enumerate(spans)])
         if errors:
             raise errors[0]
         return True
@@ -1176,6 +1476,9 @@ class TcpTransport(Transport):
 
     def close(self) -> None:
         self._closed.set()
+        # Unhook from the shared readiness loop first, so the selector
+        # stops dispatching on sockets the shutdown below is closing.
+        _readiness_loop().unwatch_all(self)
         try:
             # shutdown() wakes the thread blocked in accept(); close()
             # alone leaves the kernel listener alive (the syscall holds a

@@ -34,6 +34,8 @@ from distributed_llm_dissemination_tpu.transport.messages import (
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
+    GroupPlanMsg,
+    GroupStatusMsg,
     HeartbeatMsg,
     JobRevokeMsg,
     JobStatusMsg,
@@ -105,6 +107,10 @@ CASES = {
         lambda: SwapCommitMsg(1, "v2"), {"SrcID", "Version"}),
     MsgType.JOB_REVOKE: (
         lambda: JobRevokeMsg(1, "j1"), {"SrcID", "JobID"}),
+    MsgType.GROUP_PLAN: (
+        lambda: GroupPlanMsg(1, 2), {"SrcID"}),
+    MsgType.GROUP_STATUS: (
+        lambda: GroupStatusMsg(1, 2), {"SrcID"}),
 }
 
 # Optional wire keys that must be OMITTED at their defaults, per type:
@@ -131,6 +137,8 @@ OMITTED_AT_DEFAULT = {
     MsgType.SWAP_COMMIT: {"Epoch", "SwapBase", "Abort", "Query",
                           "Applied", "Prepare", "Error"},
     MsgType.JOB_REVOKE: {"Epoch", "Pairs"},
+    MsgType.GROUP_PLAN: {"Epoch", "Targets", "Dissolve"},
+    MsgType.GROUP_STATUS: {"Covered", "Announced", "Dead", "Metrics"},
 }
 
 
