@@ -153,6 +153,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "table (states, remaining pairs, priorities) as "
                         "JSON on stdout and exit; same seat rules as "
                         "-submit")
+    # Elastic membership (docs/membership.md): the operator verbs.
+    p.add_argument("-join", action="store_true",
+                   help="receiver: this seat is NOT part of the running "
+                        "cluster's goal — send a JoinMsg to the leader "
+                        "first (admitted as a dest immediately, as a "
+                        "source once its holdings digest-verify), then "
+                        "run the normal receiver loop.  The seat still "
+                        "needs a topology entry for its own address")
+    p.add_argument("-drain", type=int, default=-1, metavar="NODE",
+                   help="one-shot operator tool: ask the running leader "
+                        "to DRAIN node NODE — its unique holdings are "
+                        "re-homed onto survivors before it is released "
+                        "— print the answer, exit.  Run from an idle "
+                        "seat like -submit/-jobs")
     return p
 
 
@@ -267,34 +281,56 @@ def _parse_job_spec(raw: str) -> dict:
     return spec
 
 
-def run_jobtool(args, conf: cfg.Config) -> int:
-    """The -submit / -jobs one-shot tools (docs/service.md): bind this
-    seat's address, send the request to the leader daemon, print its
-    JobStatusMsg reply as JSON, exit.  Like cli.genreq, -id must name a
-    topology seat NOT also running cli.main (the reply multiplexes on
-    the seat's address)."""
+def _oneshot_leader_rpc(args, conf: cfg.Config, reply_cls, make_msg,
+                        timeout: float, timeout_error: str):
+    """The one-shot operator-tool scaffolding shared by -submit/-jobs/
+    -drain: bind this idle seat's address, send one request to the
+    leader (``make_msg(leader_id)``), await one ``reply_cls`` reply.
+    Returns the reply, or None after ``timeout`` (the caller prints
+    ``timeout_error``).  Like cli.genreq, -id must name a topology seat
+    NOT also running cli.main (the reply multiplexes on the seat's
+    address)."""
     import json
     import queue as _queue
 
     from ..runtime.node import MessageLoop
-    from ..transport.messages import JobStatusMsg, JobSubmitMsg
 
     node_conf = cfg.get_node_conf(conf, args.id)
     leader_id = cfg.get_leader_conf(conf).id
     if args.id == leader_id:
-        raise SystemExit("-submit/-jobs must run from a non-leader seat "
-                         "(the leader process owns that address)")
+        raise SystemExit("one-shot tools must run from a non-leader "
+                         "seat (the leader process owns that address)")
     transport = TcpTransport(node_conf.addr,
                              addr_registry={nc.id: nc.addr
                                             for nc in conf.nodes})
     loop = MessageLoop(transport)
     replies: "_queue.Queue" = _queue.Queue()
-    loop.register(JobStatusMsg, replies.put)
+    loop.register(reply_cls, replies.put)
     loop.start()
     try:
+        transport.send(leader_id, make_msg(leader_id))
+        try:
+            return replies.get(timeout=timeout)
+        except _queue.Empty:
+            print(json.dumps({"error": timeout_error}))
+            return None
+    finally:
+        loop.stop()
+        transport.close()
+
+
+def run_jobtool(args, conf: cfg.Config) -> int:
+    """The -submit / -jobs one-shot tools (docs/service.md): send the
+    request to the leader daemon, print its JobStatusMsg reply as
+    JSON, exit."""
+    import json
+
+    from ..transport.messages import JobStatusMsg, JobSubmitMsg
+
+    def make_msg(leader_id):
         if args.submit:
             spec = _parse_job_spec(args.submit)
-            transport.send(leader_id, JobSubmitMsg(
+            return JobSubmitMsg(
                 args.id, str(spec["JobID"]), spec["Assignment"],
                 priority=int(spec.get("Priority", 0)),
                 kind=str(spec.get("Kind", "push")),
@@ -304,23 +340,44 @@ def run_jobtool(args, conf: cfg.Config) -> int:
                 # Admission control (docs/service.md): a token-armed
                 # leader daemon rejects unauthenticated submits; the
                 # operator exports the same secret on both sides.
-                auth=os.environ.get("DLD_JOB_TOKEN", "")))
-        else:
-            transport.send(leader_id, JobStatusMsg(args.id, query=True))
-        try:
-            resp = replies.get(timeout=30.0)
-        except _queue.Empty:
-            print(json.dumps({"error": "no reply from the leader daemon "
-                                       "(is it running with -daemon?)"}))
-            return 1
-        out = {"leader_epoch": resp.epoch, "jobs": resp.jobs}
-        if resp.error:
-            out["error"] = resp.error
-        print(json.dumps(out, indent=1, sort_keys=True))
-        return 1 if resp.error else 0
-    finally:
-        loop.stop()
-        transport.close()
+                auth=os.environ.get("DLD_JOB_TOKEN", ""))
+        return JobStatusMsg(args.id, query=True)
+
+    resp = _oneshot_leader_rpc(
+        args, conf, JobStatusMsg, make_msg, timeout=30.0,
+        timeout_error="no reply from the leader daemon (is it running "
+                      "with -daemon?)")
+    if resp is None:
+        return 1
+    out = {"leader_epoch": resp.epoch, "jobs": resp.jobs}
+    if resp.error:
+        out["error"] = resp.error
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 1 if resp.error else 0
+
+
+def run_draintool(args, conf: cfg.Config) -> int:
+    """The -drain NODE one-shot (docs/membership.md): ask the leader to
+    drain the named node, print its DONE (or refusal) answer as JSON,
+    exit."""
+    import json
+
+    from ..transport.messages import DrainMsg
+
+    resp = _oneshot_leader_rpc(
+        args, conf, DrainMsg,
+        lambda leader_id: DrainMsg(args.id, node=args.drain),
+        timeout=120.0,
+        timeout_error="no drain answer from the leader (is it "
+                      "running?)")
+    if resp is None:
+        return 1
+    out = {"node": resp.node, "done": resp.done,
+           "leader_epoch": resp.epoch}
+    if resp.error:
+        out["error"] = resp.error
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 0 if resp.done else 1
 
 
 def run_client(args, conf: cfg.Config) -> int:
@@ -713,7 +770,40 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
         f"id: {args.id}, filename: {args.f}, storagePath: {args.s}, mode: {args.m}]",
         flush=True,
     )
-    receiver.announce()
+    # Elastic membership (docs/membership.md): an explicit -join seat —
+    # or one whose seeded churn schedule (-test-faults join=T) says it
+    # appears late — JOINS the running cluster instead of announcing as
+    # a configured member.
+    join_wait = getattr(node.transport, "seconds_until_join",
+                        lambda: None)()
+    if args.join or join_wait is not None:
+        if join_wait:
+            ulog.log.info("churn schedule: dark until join",
+                          seconds=round(join_wait, 3))
+            time.sleep(join_wait)
+        if not receiver.join():
+            ulog.log.error("join was never admitted; exiting")
+            return 1
+        print("joined", flush=True)
+    else:
+        receiver.announce()
+    leave_wait = getattr(node.transport, "seconds_until_leave",
+                         lambda: None)()
+    if leave_wait is not None:
+        # The seeded departure: drain gracefully at the scheduled
+        # moment, then release the startup wait so the process exits
+        # cleanly (a drained seat never receives a StartupMsg).
+        import threading as _threading
+
+        def _scheduled_leave():
+            time.sleep(leave_wait)
+            ok = receiver.request_drain()
+            ulog.log.info("scheduled drain finished", ok=ok)
+            print(f"drained (ok={ok})", flush=True)
+            receiver.release_ready()
+
+        _threading.Thread(target=_scheduled_leave, daemon=True,
+                          name="churn-leave").start()
     receiver.ready().get()
     if standby_ctl is not None and standby_ctl.promoted.is_set():
         # This process took over mid-run: it IS the leader now — report
@@ -799,6 +889,11 @@ def main(argv=None) -> int:
         # One-shot service tools: no fabrication, no role loop — talk
         # to the running leader daemon and exit (docs/service.md).
         return run_jobtool(args, conf)
+
+    if args.drain >= 0:
+        # One-shot membership tool (docs/membership.md): ask the leader
+        # to drain the named node and report its answer.
+        return run_draintool(args, conf)
 
     if args.c:
         return run_client(args, conf)

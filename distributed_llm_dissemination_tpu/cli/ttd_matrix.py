@@ -1845,6 +1845,167 @@ def run_fanout(sizes=(64, 256), n_layers: int = 2,
     }
 
 
+def run_elasticity(joiner_counts=(2, 6), n_base: int = 2,
+                   n_layers: int = 3, layer_bytes: int = 256 << 10,
+                   timeout: float = 120.0) -> dict:
+    """Elastic-membership acceptance row (docs/membership.md; ROADMAP
+    item 5): the base goal disseminates from ONE origin seeder (the
+    leader) to ``n_base`` configured dests; then N UNCONFIGURED nodes
+    JOIN the running cluster concurrently and must reach full coverage
+    byte-exactly.  Per variant the row records the origin-seeder wire
+    bytes into the joiners vs the bytes peer holders served, and the
+    bars: the MAJORITY of refill bytes come from peer holders, and
+    origin bytes grow sub-linearly in the joiner count (the join
+    refill policy avoids the origin whenever peers can serve)."""
+    import threading as _threading
+
+    from ..core.types import LayerMeta
+    from ..runtime import (
+        FlowRetransmitLeaderNode,
+        FlowRetransmitReceiverNode,
+        Node,
+    )
+    from ..transport import reset_registry
+    from ..transport.inmem import InmemTransport
+    from ..utils import telemetry
+    from ..utils.provenance import harness_hash
+    from . import report as report_mod
+
+    pattern = bytes(range(256))
+
+    def mem_blob(lid: int):
+        from ..core.types import LayerLocation, LayerSrc, SourceType
+
+        rot = (lid * 53) % 256
+        data = bytearray((pattern[rot:] + pattern[:rot])
+                         * (layer_bytes // 256))
+        return LayerSrc(inmem_data=data, data_size=len(data),
+                        meta=LayerMeta(location=LayerLocation.INMEM,
+                                       source_type=SourceType.MEM))
+
+    def one_run(n_joiners: int) -> dict:
+        reset_registry()
+        telemetry.reset_run()
+        ids = list(range(n_base + 1))
+        registry = {i: f"n{i}" for i in ids}
+        ts = {i: InmemTransport(registry[i], addr_registry=registry)
+              for i in ids}
+        assignment = {i: {lid: LayerMeta() for lid in range(n_layers)}
+                      for i in ids[1:]}
+        leader = FlowRetransmitLeaderNode(
+            Node(0, 0, ts[0]), {lid: mem_blob(lid)
+                                for lid in range(n_layers)},
+            assignment, {i: 10 ** 9 for i in ids},
+            expected_nodes=set(ids[1:]))
+        recvs = {i: FlowRetransmitReceiverNode(Node(i, 0, ts[i]), {})
+                 for i in ids[1:]}
+        joiners = {}
+        try:
+            for r in recvs.values():
+                r.announce()
+            leader.start_distribution().get(timeout=timeout)
+            leader.ready().get(timeout=timeout)
+            # The joiners arrive CONCURRENTLY, mid-service: each join
+            # admits a refill job that overlaps the others' in-flight
+            # dissemination.
+            t0 = time.monotonic()
+            for k in range(n_joiners):
+                jid = 100 + k
+                tj = InmemTransport(f"n{jid}",
+                                    addr_registry={0: registry[0]})
+                ts[jid] = tj
+                joiners[jid] = FlowRetransmitReceiverNode(
+                    Node(jid, 0, tj), {})
+            threads = [_threading.Thread(
+                target=joiners[jid].join, kwargs={"timeout": timeout},
+                daemon=True) for jid in joiners]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout)
+
+            def covered():
+                for j in joiners.values():
+                    for lid in range(n_layers):
+                        src = j.layers.get(lid)
+                        if src is None or bytes(src.inmem_data) != bytes(
+                                mem_blob(lid).inmem_data):
+                            return False
+                return True
+
+            deadline = time.monotonic() + timeout
+            while not covered():
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"joiners not covered at n={n_joiners}")
+                time.sleep(0.02)
+            cover_s = round(time.monotonic() - t0, 4)
+            # BASE rows only: job-tagged fields file on the base row
+            # AND the #job split row — summing both double-counts.
+            origin_bytes = peer_bytes = 0
+            for key, row in telemetry.snapshot()["links"].items():
+                if "#" in key:
+                    continue
+                s, d = key.split("->")
+                if int(d) >= 100:
+                    b = int(row.get("tx_bytes", 0))
+                    if int(s) == 0:
+                        origin_bytes += b
+                    else:
+                        peer_bytes += b
+            rep = report_mod.build_from_leader(leader)
+            total = origin_bytes + peer_bytes
+            return {
+                "n_joiners": n_joiners,
+                "coverage_s": cover_s,
+                "origin_bytes": origin_bytes,
+                "peer_bytes": peer_bytes,
+                "peer_fraction": round(peer_bytes / total, 4)
+                                 if total else 0.0,
+                "byte_exact_deliveries": n_joiners * n_layers,
+                "members": leader.membership.size(),
+                "run_report": rep.get("provenance"),
+            }
+        finally:
+            leader.close()
+            for r in list(recvs.values()) + list(joiners.values()):
+                r.close()
+            for t in ts.values():
+                t.close()
+            reset_registry()
+
+    rows = []
+    for n in joiner_counts:
+        row = one_run(n)
+        rows.append(row)
+        print(f"elasticity n_joiners={n}: origin "
+              f"{row['origin_bytes']} B, peers {row['peer_bytes']} B "
+              f"(peer fraction {row['peer_fraction']}), covered in "
+              f"{row['coverage_s']}s", file=sys.stderr, flush=True)
+    lo, hi = rows[0], rows[-1]
+    joiner_growth = hi["n_joiners"] / max(lo["n_joiners"], 1)
+    origin_growth = (hi["origin_bytes"] / lo["origin_bytes"]
+                     if lo["origin_bytes"] else
+                     (0.0 if not hi["origin_bytes"] else float("inf")))
+    return {
+        "harness_hash": harness_hash(),
+        "backend": "inmem",
+        "mode": 3,
+        "n_base": n_base,
+        "n_layers": n_layers,
+        "layer_bytes": layer_bytes,
+        "rows": rows,
+        "joiner_growth": joiner_growth,
+        "origin_growth": round(origin_growth, 3),
+        # The acceptance bars (docs/membership.md): refills come mostly
+        # from peer holders, and origin bytes grow sub-linearly in the
+        # joiner count.
+        "peers_majority": all(r["peer_fraction"] > 0.5 for r in rows
+                              if r["origin_bytes"] + r["peer_bytes"]),
+        "origin_sublinear": origin_growth < joiner_growth,
+    }
+
+
 def run_live_swap(warm_s: float = 1.5, after_s: float = 1.5,
                   timeout: float = 300.0) -> dict:
     """Zero-downtime weight swap under live traffic (docs/swap.md, the
@@ -2263,6 +2424,51 @@ def _fanout_md(lines, results) -> None:
         "2-core container's scheduler, not the wire; the row's bars "
         "are the CONTROL-plane costs (solve wall, root-handled "
         "messages), which are load-independent counts.",
+        "",
+    ]
+
+
+def _elasticity_md(lines, results) -> None:
+    el = results.get("elasticity")
+    if not el:
+        return
+    lines += [
+        "## Elastic membership: join mid-run, refill from the swarm "
+        "(docs/membership.md)",
+        "",
+        f"The base goal ({el['n_base']} configured dests × "
+        f"{el['n_layers']} × {el['layer_bytes'] >> 10} KiB layers from "
+        "ONE origin seeder) disseminates; then N UNCONFIGURED nodes "
+        "JOIN the running cluster concurrently.  Each joiner is "
+        "admitted as a dest immediately (a `kind=\"join\"` refill job) "
+        "and the refill policy avoids the ORIGIN seeder whenever "
+        "current peer holders can serve — admission cost must not "
+        "scale with origin bandwidth.  Every joiner ends byte-exact "
+        "(digest-verified before acking, default integrity plane).",
+        "",
+        "| joiners | origin refill bytes | peer refill bytes | peer "
+        "fraction | coverage | RUN_REPORT |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in el["rows"]:
+        lines.append(
+            f"| {r['n_joiners']} | {r['origin_bytes']} | "
+            f"{r['peer_bytes']} | {r['peer_fraction']} | "
+            f"{r['coverage_s']}s | {str(r.get('run_report'))[:12]} |")
+    lines += [
+        "",
+        f"Joiner growth ×{el['joiner_growth']:.0f} → origin-bytes "
+        f"growth ×{el['origin_growth']}.  Bars: peers-majority "
+        f"**{'MET' if el['peers_majority'] else 'NOT MET'}**, "
+        f"origin-bytes sub-linear "
+        f"**{'MET' if el['origin_sublinear'] else 'NOT MET'}**.",
+        "",
+        "Honest framing: joiners here arrive AFTER the base goal "
+        "covered the configured dests (the service-era steady state), "
+        "so peers hold every layer and the origin serves zero refill "
+        "bytes; a joiner arriving before any peer holds a layer is "
+        "served by the origin — the avoid set is advisory and "
+        "deliverability always wins (docs/membership.md).",
         "",
     ]
 
@@ -2910,6 +3116,7 @@ def to_markdown(results: dict) -> str:
     _failover_md(lines, results)
     _service_md(lines, results)
     _fanout_md(lines, results)
+    _elasticity_md(lines, results)
     _sharded_md(lines, results)
     _swap_md(lines, results)
     return "\n".join(lines)
@@ -2964,6 +3171,13 @@ def main(argv=None) -> int:
                         "BASELINE, flat mode-3 vs hierarchical "
                         "sub-leaders — root solve wall, root-handled "
                         "control message count, TTD")
+    p.add_argument("-elasticity", action="store_true",
+                   help="also measure elastic membership "
+                        "(docs/membership.md): N unconfigured nodes "
+                        "JOIN the running cluster concurrently — "
+                        "origin-seeder vs peer-holder refill bytes, "
+                        "coverage byte-exactness, and the sub-linear "
+                        "origin-bytes bar")
     p.add_argument("-codec-wire", action="store_true",
                    help="also measure the NEGOTIATED wire codec "
                         "(docs/codec.md): raw-canonical seeders, "
@@ -3115,6 +3329,10 @@ def main(argv=None) -> int:
         results["live_swap"] = run_live_swap()
     elif prior_doc and prior_doc.get("live_swap"):
         results["live_swap"] = prior_doc["live_swap"]
+    if args.elasticity:
+        results["elasticity"] = run_elasticity()
+    elif prior_doc and prior_doc.get("elasticity"):
+        results["elasticity"] = prior_doc["elasticity"]
     if args.codec_wire:
         results["codec_wire"] = run_codec_wire(args.trials)
         from ..models.quant import codec_bench

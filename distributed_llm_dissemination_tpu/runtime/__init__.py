@@ -19,6 +19,7 @@ from .leader import (  # noqa: F401
     RetransmitLeaderNode,
     assignment_satisfied,
 )
+from .membership import MembershipTable, MemberRecord  # noqa: F401
 from .node import MessageLoop, Node  # noqa: F401
 from .receiver import (  # noqa: F401
     FlowRetransmitReceiverNode,

@@ -178,6 +178,12 @@ class ShadowLeaderState:
         # standby must reconstruct the SAME hierarchy (or its dissolved
         # remains), not fall back to flat planning.
         self.groups: dict = {}
+        # Elastic membership (docs/membership.md): the replicated
+        # roster (``{node: record}``) + the in-flight drain re-home
+        # jobs (``{job_id: node}``) — a promoted standby resumes
+        # admission and drains, and keeps departed members fenced.
+        self.membership: dict = {}
+        self.drain_jobs: dict = {}
         self.have_snapshot = False
         self.deltas_applied = 0
 
@@ -227,6 +233,10 @@ class ShadowLeaderState:
                         d.get("BaseAssignment"))
                 self.groups = {str(g): dict(rec) for g, rec in
                                (d.get("Groups") or {}).items()}
+                self.membership = {str(n): dict(rec) for n, rec in
+                                   (d.get("Membership") or {}).items()}
+                self.drain_jobs = {str(j): int(n) for j, n in
+                                   (d.get("DrainJobs") or {}).items()}
                 self.have_snapshot = True
             elif k == "status":
                 self.status[int(d["Node"])] = layer_ids_from_json(
@@ -298,6 +308,24 @@ class ShadowLeaderState:
                 if rec is not None:
                     rec["State"] = "done"
                     rec["Remaining"] = []
+            elif k == "membership":
+                # Elastic membership (docs/membership.md): always the
+                # leader's full current roster + drain map — REPLACE,
+                # so a departed seat is exactly an absent/LEFT row.
+                self.membership = {str(n): dict(rec) for n, rec in
+                                   (d.get("Members") or {}).items()}
+                self.drain_jobs = {str(j): int(n) for j, n in
+                                   (d.get("DrainJobs") or {}).items()}
+            elif k == "member_left":
+                # A clean leave (drain finalize): the seat's control
+                # rows vanish WITHOUT entering the dropped/crash
+                # bookkeeping — a takeover must not resurrect it or
+                # re-apply crash-path drops against it.
+                node = int(d["Node"])
+                self.status.pop(node, None)
+                self.assignment.pop(node, None)
+                self.partial.pop(node, None)
+                self.dropped.pop(node, None)
             elif k == "metrics":
                 self.metrics[int(d["Node"])] = {
                     "counters": dict(d.get("Counters") or {}),
@@ -335,6 +363,9 @@ class ShadowLeaderState:
                 "node_codecs": {n: list(c)
                                 for n, c in self.node_codecs.items()},
                 "groups": {g: dict(rec) for g, rec in self.groups.items()},
+                "membership": {n: dict(rec)
+                               for n, rec in self.membership.items()},
+                "drain_jobs": dict(self.drain_jobs),
                 "have_snapshot": self.have_snapshot,
             }
 

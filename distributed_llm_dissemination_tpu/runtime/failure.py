@@ -85,20 +85,40 @@ class FailureDetector:
         self._on_crash = on_crash
         self._last_seen: Dict[NodeID, float] = {}
         self._dead: "set[NodeID]" = set()
+        # Cleanly-departed nodes (elastic membership, docs/membership.md):
+        # unlike forget(), a removed node's in-flight heartbeats must not
+        # re-arm a lease — a drained node that then exits would be
+        # declared crashed by the very beacon it sent while draining.
+        self._removed: "set[NodeID]" = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
-        if self._timeout <= 0 or self._thread is not None:
+        """Start (or RESTART) the monitor.  Restartable on purpose: a
+        stood-down sub-leader whose group re-forms (docs/membership.md)
+        re-arms member liveness on the same detector instance.  A
+        restart during the stop window arms a FRESH stop event — the
+        prior thread captured the old one and exits on its own, so a
+        re-arm can never be silently swallowed by a still-draining
+        monitor."""
+        if self._timeout <= 0:
             return
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="detector")
-        self._thread.start()
+        with self._lock:
+            if (self._thread is not None and self._thread.is_alive()
+                    and not self._stop.is_set()):
+                return  # already monitoring
+            if self._stop.is_set():
+                self._stop = threading.Event()
+            thread = threading.Thread(target=self._run,
+                                      args=(self._stop,), daemon=True,
+                                      name="detector")
+            self._thread = thread
+        thread.start()
 
     def touch(self, node_id: NodeID) -> None:
         with self._lock:
-            if node_id not in self._dead:
+            if node_id not in self._dead and node_id not in self._removed:
                 self._last_seen[node_id] = time.monotonic()
 
     def forget(self, node_id: NodeID) -> None:
@@ -106,17 +126,27 @@ class FailureDetector:
         with self._lock:
             self._last_seen.pop(node_id, None)
 
+    def remove(self, node_id: NodeID) -> None:
+        """Permanently stop monitoring a cleanly-departed node: the
+        lease is dropped AND later touches (straggler heartbeats, a
+        queued announce) are ignored until :meth:`revive` — a clean
+        leave can never race a false ``crash()``."""
+        with self._lock:
+            self._last_seen.pop(node_id, None)
+            self._removed.add(node_id)
+
     def revive(self, node_id: NodeID) -> None:
         """Re-admit a declared-dead node (a restarted process announcing
         again) and restart its lease.  If the announce was actually a stale
         queued message, the fresh lease simply expires again."""
         with self._lock:
             self._dead.discard(node_id)
+            self._removed.discard(node_id)
             self._last_seen[node_id] = time.monotonic()
 
-    def _run(self) -> None:
+    def _run(self, stop: threading.Event) -> None:
         scan = self._timeout / 4
-        while not self._stop.wait(scan):
+        while not stop.wait(scan):
             now = time.monotonic()
             with self._lock:
                 expired = [
@@ -140,4 +170,5 @@ class FailureDetector:
             return node_id in self._dead
 
     def stop(self) -> None:
-        self._stop.set()
+        with self._lock:
+            self._stop.set()

@@ -240,11 +240,25 @@ class SubLeaderController:
             self.receiver.handle_group_plan(msg)
             return
         with self._lock:
+            rearmed = not self._active
             self._active = True
             self._targets = {int(m): dict(row)
                              for m, row in msg.targets.items()
                              if int(m) != self.node.my_id}
+            # Elastic membership (docs/membership.md): the plan is the
+            # root's authoritative member view — absorb seats it added
+            # (joiners placed into this group) so liveness monitoring
+            # and the announce/metrics flush gates cover them.
+            for m in self._targets:
+                if m not in self.members:
+                    self.members.append(m)
+                    self._dead.discard(m)
+                    self.detector.touch(m)
             covered = self._covered_snapshot_locked()
+        if rearmed:
+            # A stood-down sub-leader whose group RE-FORMED (its seat
+            # was re-admitted): member liveness re-arms with fan-out.
+            self.detector.start()
         trace.count("hier.group_plans")
         log.info("group plan received", group=self.group_id,
                  members=sorted(self._targets),
@@ -262,6 +276,10 @@ class SubLeaderController:
         if self.detector.is_dead(msg.src_id):
             self.detector.revive(msg.src_id)
         with self._lock:
+            # A joiner the root placed here may announce before the
+            # updated group plan lands: absorb it (docs/membership.md).
+            if msg.src_id not in self.members:
+                self.members.append(msg.src_id)
             self._dead.discard(msg.src_id)
             self._announced[msg.src_id] = dict(msg.layer_ids)
             self._announce_dirty.add(msg.src_id)

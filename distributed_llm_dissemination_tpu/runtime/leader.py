@@ -66,12 +66,14 @@ from ..transport.messages import (
     BootHintMsg,
     BootReadyMsg,
     DevicePlanMsg,
+    DrainMsg,
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
     GroupPlanMsg,
     GroupStatusMsg,
     HeartbeatMsg,
+    JoinMsg,
     JobRevokeMsg,
     JobStatusMsg,
     JobSubmitMsg,
@@ -97,6 +99,8 @@ from .failover import (
     _partial_to_json,
 )
 from .failure import FailureDetector
+from .membership import MembershipTable
+from . import membership as mship
 from .node import MessageLoop, Node
 from .store import ContentIndex
 from .send import (
@@ -303,6 +307,28 @@ class LeaderNode:
         # Assignments dropped by crash(), kept so a declared-dead node that
         # restarts and re-announces gets its layers back (resume).
         self._dropped_assignment: Dict[NodeID, LayerIDs] = {}
+        # Elastic membership (docs/membership.md): the replicated
+        # roster.  Configured seats (assignees, expected seeders,
+        # standbys, the leader itself) are ACTIVE + source-verified from
+        # the start — the config is the operator's trust statement;
+        # joiners enter JOINING (dest immediately, source once their
+        # holdings digest-verify) and drains run a re-home-then-prune
+        # protocol that never touches the crash path.
+        self.membership = MembershipTable()
+        self.membership.seed(
+            set(assignment) | set(expected_nodes or ())
+            | set(standbys or ()) | {node.my_id}, epoch=epoch)
+        # job_id -> the node a kind="drain" re-home job is draining;
+        # its completion fires the atomic prune (_finalize_drain).
+        self._drain_jobs: Dict[str, NodeID] = {}
+        # joiner -> wanted layer ids ([] = the layer universe): admits
+        # whose refill job waits for the joiner's FIRST announce — the
+        # announce carries its cold-boot holdings (inventory, partials,
+        # digests), so the job's remaining demand is computed against
+        # them and only the complement ever ships.
+        self._join_pending: Dict[NodeID, List[LayerID]] = {}
+        # draining node -> requester seats awaiting the DONE notice.
+        self._drain_waiters: Dict[NodeID, Set[NodeID]] = {}
         self.detector = FailureDetector(failure_timeout, self.crash)
         # Seed the liveness leases so a node that dies before ever
         # announcing is still detected (its lease simply expires).  Never
@@ -504,6 +530,8 @@ class LeaderNode:
         reg(JobSubmitMsg, self.handle_job_submit)
         reg(JobStatusMsg, self.handle_job_status)
         reg(SwapCommitMsg, self.handle_swap_commit)
+        reg(JoinMsg, self.handle_join)
+        reg(DrainMsg, self.handle_drain)
 
     # --------------------------------------------------- control-plane HA
 
@@ -626,6 +654,13 @@ class LeaderNode:
                                in self._codec_choice.items() if c},
                 "NodeCodecs": {str(n): sorted(s)
                                for n, s in self.node_codecs.items()},
+                # Elastic membership (docs/membership.md): the roster +
+                # in-flight drain re-home jobs — a promoted standby
+                # resumes admission and drains, and keeps departed
+                # members fenced.
+                "Membership": self.membership.to_json(),
+                "DrainJobs": {jid: int(n) for jid, n in
+                              sorted(self._drain_jobs.items())},
                 "PlanSeq": self._plan_seq_hint,
                 "StartupSent": self._startup_sent,
                 "NetworkBw": {str(n): b for n, b in getattr(
@@ -764,8 +799,25 @@ class LeaderNode:
                 # rest of the run rides the host path.
                 self._fabric_disabled = True
             peers = [n for n in self.status if n != self.node.my_id]
+        # Elastic membership (docs/membership.md): adopt the roster so
+        # the promoted leader keeps departed members fenced, resumes
+        # in-flight drains, and can dial adopted joiners (their
+        # addresses rode the membership replication — they are in
+        # nobody's config).
+        self.membership.load(shadow.get("membership") or {})
+        self.membership.seed(set(self.status) | set(self.assignment)
+                             | {self.node.my_id}, epoch=self.epoch)
+        if dead_leader is not None:
+            self.membership.forget(dead_leader)
+        with self._lock:
+            self._drain_jobs = {str(j): int(n) for j, n in
+                                (shadow.get("drain_jobs") or {}).items()}
+        for n, addr in sorted(self.membership.addrs().items()):
+            if n != self.node.my_id:
+                self._install_member_addr(n, addr)
         for n in peers:
-            self.detector.touch(n)
+            if not self.membership.is_left(n):
+                self.detector.touch(n)
         if dead_leader is not None:
             self.detector.forget(dead_leader)
         # Replicated job deltas are best-effort: reconcile remaining
@@ -788,6 +840,8 @@ class LeaderNode:
                  dests=sorted(self.assignment),
                  partials=sorted(self.partial_status))
         self._resume_swaps()
+        self._resume_drains()
+        self._resume_joins()
         with self._lock:
             already_done = self._startup_sent
         if already_done:
@@ -1606,6 +1660,14 @@ class LeaderNode:
         row is refreshed (delivered-to-RAM layers died with it; surviving
         state arrives via the announce itself, checkpointed partials
         included) and the scheduler re-plans its missing layers."""
+        if self.membership.is_left(msg.src_id):
+            # Zombie rejoiner (docs/membership.md): a departed member's
+            # late announce must not resurrect it as a schedulable seat
+            # nobody monitors — only a fresh JoinMsg re-admits it.
+            trace.count("membership.zombie_fenced")
+            log.warn("announce from a departed member fenced (a new "
+                     "JoinMsg re-admits it)", node=msg.src_id)
+            return
         was_dead = self.detector.is_dead(msg.src_id)
         if was_dead:
             log.warn("declared-dead node announced again; reviving",
@@ -1631,12 +1693,19 @@ class LeaderNode:
                 self.node_codecs.pop(msg.src_id, None)
         if new_caps != old_caps:
             self._replicate_codecs()
-        self._merge_announced_digests(msg.src_id, msg.digests)
-        # Content index: an announce is the node's authoritative current
-        # inventory — replace its digest contribution wholesale (a
-        # restarted node no longer vouches for its dead incarnation's
-        # bytes); acks extend it as deliveries land.
-        self.content.reset_node(msg.src_id, msg.digests)
+        if self._verify_member_source(msg.src_id, msg.digests):
+            self._merge_announced_digests(msg.src_id, msg.digests)
+            # Content index: an announce is the node's authoritative
+            # current inventory — replace its digest contribution
+            # wholesale (a restarted node no longer vouches for its dead
+            # incarnation's bytes); acks extend it as deliveries land.
+            self.content.reset_node(msg.src_id, msg.digests)
+        else:
+            # Probation (docs/membership.md): a JOINING seat whose
+            # holdings have not digest-verified neither stamps the
+            # integrity plane nor vouches in the content index — it is
+            # a dest, not a source, until a clean announce verifies.
+            self.content.reset_node(msg.src_id, {})
         with self._lock:
             # A re-plan is only for a node the run already has business
             # with: one that restarted (still in status), one returning
@@ -1683,6 +1752,14 @@ class LeaderNode:
             "partial", Node=msg.src_id,
             Partial=({str(l): info for l, info in msg.partial.items()}
                      if msg.partial else None))
+        with self._lock:
+            pending_want = self._join_pending.pop(msg.src_id, None)
+        if pending_want is not None:
+            # The joiner's first announce: its cold-boot holdings are
+            # now in status (and, verified, in the content index), so
+            # the refill job's remaining demand is exactly the
+            # complement (docs/membership.md).
+            self._admit_join_job(msg.src_id, pending_want)
         if self._started and self.jobs.has_active():
             # An announce is authoritative inventory, and an ACK can be
             # LOST in a failover window (sent to the dead leader before
@@ -2321,6 +2398,468 @@ class LeaderNode:
             return {v: self._swap_record_locked(v)
                     for v in sorted(self._swaps)}
 
+    # ------------------------------------------------ elastic membership
+
+    def _replicate_membership(self) -> None:
+        """Replicate the full roster + the in-flight drain-job map (the
+        delta REPLACES, like the hierarchy's group table — a revoked
+        membership is exactly an absent row)."""
+        with self._lock:
+            drains = {jid: int(n) for jid, n in self._drain_jobs.items()}
+        self._replicate("membership", Members=self.membership.to_json(),
+                        DrainJobs=drains)
+
+    def _install_member_addr(self, node: NodeID, addr: str) -> None:
+        """Make an unconfigured seat dialable: joiners exist in nobody's
+        config, so their wire address rides the membership plane."""
+        if not addr:
+            return
+        try:
+            self.node.transport.addr_registry[node] = addr
+        except (AttributeError, TypeError):
+            pass
+        self.node.add_node(node)
+
+    def _verify_member_source(self, node: NodeID, digests: dict) -> bool:
+        """Whether this announcer's holdings may be TRUSTED as transfer
+        sources (docs/membership.md).  Configured members are verified
+        by the config; an unknown legacy announcer is seeded ACTIVE
+        (pre-membership interop).  A JOINING seat verifies when every
+        announced digest agrees with the leader's existing stamp for
+        that layer — a mismatch keeps it a dest-only seat, loudly.
+        Layers without a stamp (and joins with digests disabled) can't
+        be cross-checked and verify trivially — an honest limit the
+        docs own."""
+        st = self.membership.state_of(node)
+        if st is None:
+            # A seat the roster never met: the pre-membership announce
+            # path admitted such peers silently — keep doing so.
+            self.membership.seed([node], epoch=self.epoch)
+            return True
+        if st != mship.JOINING:
+            return True
+        with self._lock:
+            for lid, d in (digests or {}).items():
+                stamped = self.layer_digests.get(lid)
+                if (stamped is not None and stamped != d
+                        and integrity.stamp_algo(stamped)
+                        == integrity.stamp_algo(d)):
+                    trace.count("membership.join_verify_failed")
+                    log.error("joiner's announced digest conflicts with "
+                              "the stamped one; holdings stay "
+                              "quarantined (dest-only seat)",
+                              node=node, layerID=lid, announced=d,
+                              stamped=stamped)
+                    return False
+        if self.membership.verify_source(node):
+            trace.count("membership.source_verified")
+            log.info("joiner's holdings digest-verified; admitted as a "
+                     "source", node=node)
+            self._replicate_membership()
+        return True
+
+    def _layer_universe_locked(self) -> List[LayerID]:
+        """The default join target: every layer the current goal names
+        plus the leader's own store (a pre-start pure-seeder goal may
+        be empty).  Lock held."""
+        out = {int(l) for lids in self.assignment.values() for l in lids}
+        out |= {int(l) for l in self.layers}
+        return sorted(out)
+
+    def _place_joiner(self, node: NodeID) -> NodeID:
+        """The joiner's control parent: the leader itself in flat mode.
+        The hierarchical leader overrides — grouped clusters absorb
+        joiners into the least-loaded live group and the parent is that
+        group's sub-leader (docs/membership.md)."""
+        return self.node.my_id
+
+    def handle_join(self, msg: JoinMsg) -> None:
+        """Admission (docs/membership.md): an unconfigured node asked
+        to join the running cluster.  It becomes a delivery DEST — a
+        ``kind="join"`` refill job over the layer universe it wants
+        (default: everything the current goal disseminates), submitted
+        on its FIRST announce so cold-boot holdings (inventory,
+        checkpointed partials, content-equal bytes under other ids)
+        reduce the demand before anything ships, planned with every
+        other demand and refilled from current peer holders (the origin
+        seeder is avoided whenever peers can serve, so admission cost
+        stops scaling with origin bandwidth) — and a SOURCE only once
+        its announced holdings digest-verify.  Idempotent per (seat,
+        generation): a retried request re-answers the same admit."""
+        if msg.admitted:
+            return  # admit/roster notices are receiver business
+        if self._deposed:
+            return  # the higher-epoch leader owns admission now
+        node = msg.src_id
+        if node == self.node.my_id:
+            return
+        rejoin = self.membership.is_left(node)
+        rec = self.membership.admit(node, addr=msg.addr, epoch=self.epoch)
+        self._install_member_addr(node, msg.addr)
+        trace.count("membership.joins")
+        log.info("membership: join request", node=node,
+                 addr=msg.addr or None, rejoin=rejoin,
+                 generation=rec.generation,
+                 want=sorted(int(l) for l in msg.want) or "universe")
+        want = sorted(int(l) for l in msg.want)
+        # Mode 3 models NICs: an unconfigured joiner gets the most
+        # conservative configured rate (it can still serve; the solver
+        # just never over-promises its unknown link).
+        bw_map = getattr(self, "node_network_bw", None)
+        if bw_map is not None and node not in bw_map:
+            known = [b for b in bw_map.values() if b > 0]
+            bw_map[node] = min(known) if known else 0
+        parent = self._place_joiner(node)
+        if parent == self.node.my_id:
+            # The root monitors ungrouped joiners directly; a grouped
+            # joiner's liveness belongs to its sub-leader's detector.
+            self.detector.revive(node)
+        self._replicate_membership()
+        # Roster notices go out BEFORE any plan can command a send to
+        # the joiner — a sender must be able to dial it — and the
+        # joiner gets the existing fleet's addresses in return (it is
+        # in nobody's config and has nobody's): NACK retransmits to a
+        # peer source, failover leases, and peer pulls all need to
+        # dial.
+        self._broadcast_roster(node, msg.addr)
+        self._introduce_peers(node)
+        with self._lock:
+            announced = node in self.status
+            if not announced:
+                self._join_pending[node] = want
+        if announced:
+            # A live re-join (the seat never left): refill immediately
+            # against its current status row.
+            self._admit_join_job(node, want)
+        parent_addr = ""
+        if parent != self.node.my_id:
+            try:
+                parent_addr = str(
+                    self.node.transport.addr_registry.get(parent, ""))
+            except AttributeError:
+                parent_addr = ""
+        try:
+            self.node.transport.send(
+                node, JoinMsg(self.node.my_id, node=node, admitted=True,
+                              parent=parent, parent_addr=parent_addr,
+                              epoch=self.epoch))
+        except (OSError, KeyError, ConnectionError) as e:
+            log.warn("join admit reply undeliverable (the joiner "
+                     "retries)", node=node, err=repr(e))
+
+    def _admit_join_job(self, node: NodeID, want: List[LayerID]) -> None:
+        """Submit the joiner's refill job ([] want = the current layer
+        universe).  Grouped joiners stay off this root's detector."""
+        with self._lock:
+            lids = [int(l) for l in want] or self._layer_universe_locked()
+        if not lids:
+            return
+        gen = self.membership.generation_of(node)
+        jid = f"join-{node}-g{gen}"
+        self.submit_job(jid, {node: {int(lid): LayerMeta()
+                                     for lid in lids}}, kind="join")
+        if self._place_joiner(node) != self.node.my_id:
+            # submit_job seeds a root-side lease for unknown dests; a
+            # grouped joiner heartbeats its SUB-LEADER, never this root
+            # — drop it or it expires into a false crash.
+            self.detector.forget(node)
+        job = self.jobs.get(jid)
+        with self._lock:
+            finished = self._startup_sent
+        if job is not None and job.state == "done" and finished:
+            # The refill resolved AT ADMISSION (the joiner's cold-boot
+            # holdings already cover its want) against an already-met
+            # goal: _maybe_finish will never re-fire, so the joiner's
+            # StartupMsg — its ready() release — must go out directly.
+            try:
+                self.node.transport.send(
+                    node, StartupMsg(self.node.my_id,
+                                     boot=self.boot_enabled,
+                                     serve=self._serve_promised,
+                                     epoch=self.epoch))
+            except (OSError, KeyError, ConnectionError) as e:
+                log.warn("startup to resolved-at-admit joiner "
+                         "undeliverable", node=node, err=repr(e))
+
+    def _broadcast_roster(self, node: NodeID, addr: str) -> None:
+        """Tell every live member the joiner's address — a later plan
+        may command ANY of them to send to it.  Best-effort: a seat
+        that missed the notice fails its send loudly and the re-plan
+        re-routes."""
+        if not addr:
+            return
+        with self._lock:
+            peers = sorted(set(self.status) | set(self.standbys))
+        out = JoinMsg(self.node.my_id, node=node, addr=addr,
+                      admitted=True, epoch=self.epoch)
+        for p in peers:
+            if p in (node, self.node.my_id):
+                continue
+            try:
+                self.node.transport.send(p, out)
+            except (OSError, KeyError, ConnectionError) as e:
+                log.debug("roster notice send failed", dest=p,
+                          err=repr(e))
+
+    def _introduce_peers(self, node: NodeID) -> None:
+        """Roster notices TO the joiner: every (peer, addr) this leader
+        can dial, so the joiner can answer any seat that later serves
+        or commands it.  Best-effort, like the outbound roster."""
+        try:
+            entries = dict(self.node.transport.addr_registry)
+        except (AttributeError, TypeError):
+            return
+        for peer, addr in sorted((int(p), str(a))
+                                 for p, a in entries.items()
+                                 if isinstance(p, int) and int(p) >= 0):
+            if peer == node or not addr:
+                continue
+            try:
+                self.node.transport.send(
+                    node, JoinMsg(self.node.my_id, node=peer, addr=addr,
+                                  admitted=True, epoch=self.epoch))
+            except (OSError, KeyError, ConnectionError) as e:
+                log.debug("peer introduction send failed", dest=node,
+                          peer=peer, err=repr(e))
+                return
+
+    def handle_drain(self, msg: DrainMsg) -> None:
+        """Planned departure (docs/membership.md): re-home the
+        drainer's unique holdings onto survivors BEFORE it leaves —
+        zero lost pairs, never post-crash salvage — then prune it from
+        the detector, lease recipients, and announce gating atomically
+        with the membership delta, and answer every requester."""
+        if msg.done:
+            return  # done notices are the drainer's business
+        if self._deposed:
+            return
+        node = msg.node if msg.node >= 0 else msg.src_id
+        requester = msg.src_id
+        if node == self.node.my_id:
+            self._answer_drain(requester, node,
+                               error="cannot drain the leader seat")
+            return
+        st = self.membership.state_of(node)
+        if st == mship.LEFT:
+            self._answer_drain(requester, node)  # idempotent: it's out
+            return
+        if st is None:
+            self._answer_drain(requester, node,
+                               error=f"unknown member {node}")
+            return
+        with self._lock:
+            self._drain_waiters.setdefault(node, set()).add(requester)
+        if not self.membership.start_drain(node):
+            if self.membership.is_left(node):
+                # Lost the race with a concurrent finalize: it already
+                # answered ITS waiter set — answer this straggler now
+                # instead of leaking an orphaned waiter entry.
+                with self._lock:
+                    waiters = self._drain_waiters.pop(node, set())
+                for w in sorted(waiters):
+                    self._answer_drain(w, node)
+            return  # already draining: the finalize answers every waiter
+        trace.count("membership.drains")
+        log.warn("membership: draining node (unique holdings re-home "
+                 "before it leaves)", node=node, requested_by=requester)
+        self._replicate_membership()
+        self._drain_rehome(node)
+
+    def _unique_holdings_locked(self, node: NodeID) -> List[LayerID]:
+        """Layers whose ONLY live full canonical copy is the drainer's
+        — losing the seat without re-homing them would lose the pair.
+        Qualified holdings (shard slices, encoded forms) never re-home
+        whole (honest limit, docs/membership.md).  Lock held."""
+        row = self.status.get(node) or {}
+        unique: List[LayerID] = []
+        for lid, meta in sorted(row.items()):
+            if (not delivered(meta) or meta.shard
+                    or getattr(meta, "codec", "")):
+                continue
+            held_elsewhere = False
+            for n, other in self.status.items():
+                if (n == node or self.membership.is_left(n)
+                        or self.membership.is_draining(n)):
+                    continue
+                m = other.get(lid)
+                if (m is not None and delivered(m) and not m.shard
+                        and not getattr(m, "codec", "")):
+                    held_elsewhere = True
+                    break
+            if not held_elsewhere:
+                unique.append(lid)
+        return unique
+
+    def _rehome_dest_locked(self, node: NodeID,
+                            lid: LayerID) -> Optional[NodeID]:
+        """The survivor a draining node's unique layer re-homes onto:
+        the lowest-id placeable announced seat that doesn't already
+        hold it (non-leader seats first — the leader is the fallback,
+        not the default dumping ground).  Lock held."""
+        placeable = self.membership.placeable()
+        candidates = [n for n in sorted(self.status)
+                      if n != node and n in placeable
+                      and n != self.node.my_id]
+        candidates.append(self.node.my_id)
+        for n in candidates:
+            if n == node or n not in placeable:
+                continue
+            meta = self.status.get(n, {}).get(lid)
+            if meta is not None and delivered(meta):
+                continue
+            return n
+        return None
+
+    def _drain_rehome(self, node: NodeID) -> None:
+        """Plan (or finish) one drain: submit the re-home job for the
+        drainer's unique holdings, or finalize immediately when nothing
+        unique remains.  Also the takeover re-drive (docs/membership.md:
+        a promoted leader resumes adopted drains in the bumped epoch)."""
+        with self._lock:
+            target: Assignment = {}
+            for lid in self._unique_holdings_locked(node):
+                dest = self._rehome_dest_locked(node, lid)
+                if dest is None:
+                    log.error("no survivor can take a draining node's "
+                              "unique layer; its bytes leave with it",
+                              node=node, layerID=lid)
+                    continue
+                target.setdefault(dest, {})[lid] = LayerMeta()
+            n_prior = sum(1 for n in self._drain_jobs.values()
+                          if n == node)
+        if not target:
+            self._finalize_drain(node)
+            return
+        gen = self.membership.generation_of(node)
+        jid = (f"drain-{node}-g{gen}" if n_prior == 0
+               else f"drain-{node}-g{gen}.{n_prior}")
+        with self._lock:
+            self._drain_jobs[jid] = node
+        log.info("drain re-home job submitted", node=node, job=jid,
+                 layers=sorted({int(l) for r in target.values()
+                                for l in r}),
+                 dests=sorted(target))
+        self.submit_job(jid, target, kind="drain")
+        job = self.jobs.get(jid)
+        if job is not None and job.state == "done":
+            # Admission found every re-home already satisfied (or the
+            # survivors' acks landed synchronously): finish now.
+            self._on_drain_job_done(jid)
+
+    def _on_drain_job_done(self, jid: str) -> None:
+        """A completed ``kind="drain"`` re-home job releases its
+        drainer (no-op for every other job)."""
+        with self._lock:
+            node = self._drain_jobs.pop(jid, None)
+        if node is not None:
+            self._finalize_drain(node)
+
+    def _forget_sender_jobs(self, node: NodeID) -> None:
+        """Hook: a departed seat's dispatched sends are forgotten —
+        never range-salvaged (mode 3 overrides; the base scheduler
+        tracks none)."""
+
+    def _finalize_drain(self, node: NodeID) -> None:
+        """The atomic prune: DRAINING → LEFT together with removal from
+        status, the goal, the failure detector, lease recipients, and
+        announce gating — after this, nothing the departed seat does
+        (or fails to do) can fire ``crash()`` or the salvage path."""
+        if not self.membership.complete_drain(node):
+            return
+        # Ban BEFORE the state prune: a straggler heartbeat landing
+        # between the two must not re-arm a lease that later expires
+        # into a false crash.
+        self.detector.remove(node)
+        with self._lock:
+            self.status.pop(node, None)
+            dropped = self.assignment.pop(node, None)
+            if self._base_assignment is not self.assignment:
+                self._base_assignment.pop(node, None)
+            self.expected_nodes.discard(node)
+            self.partial_status.pop(node, None)
+            # A clean leave is not a crash: nothing is parked for a
+            # revival resume, and none of its pairs enter range salvage.
+            self._dropped_assignment.pop(node, None)
+            self._salvaging = {p for p in self._salvaging
+                               if p[1] != node}
+            waiters = self._drain_waiters.pop(node, set())
+        self._forget_sender_jobs(node)
+        self.content.drop_node(node)
+        trace.count("membership.drained")
+        log.warn("membership: node drained and released", node=node,
+                 had_assignment=bool(dropped))
+        self._replicate_membership()
+        self._replicate("member_left", Node=node)
+        affected, finished = self.jobs.drop_dest(node)
+        for jid in affected:
+            self._replicate("job", **self.jobs.record(jid))
+        self._jobs_completed(finished)
+        for w in sorted(waiters | {node}):
+            if w == self.node.my_id:
+                continue
+            try:
+                self.node.add_node(w)
+                self.node.transport.send(
+                    w, DrainMsg(self.node.my_id, node=node, done=True,
+                                epoch=self.epoch))
+            except (OSError, KeyError, ConnectionError) as e:
+                log.debug("drain done notice undeliverable", dest=w,
+                          err=repr(e))
+        self._drive(self._recover)
+        self._maybe_finish()
+        self._maybe_complete_boot_wait()
+
+    def _answer_drain(self, requester: NodeID, node: NodeID,
+                      error: str = "") -> None:
+        """Every drain request is ANSWERED (the serving invariant),
+        refusals included."""
+        if requester == self.node.my_id:
+            return
+        try:
+            self.node.add_node(requester)
+            self.node.transport.send(
+                requester, DrainMsg(self.node.my_id, node=node,
+                                    done=not error, error=error,
+                                    epoch=self.epoch))
+        except (OSError, KeyError, ConnectionError) as e:
+            log.debug("drain answer undeliverable", dest=requester,
+                      err=repr(e))
+
+    def _resume_joins(self) -> None:
+        """Takeover re-drive: an adopted JOINING member whose refill
+        job never replicated (the admit raced the old leader's death)
+        gets a fresh pending entry — its next announce (triggered by
+        the takeover lease) submits the job at the bumped epoch."""
+        active = set(self.jobs.table())
+        for node in self.membership.joining():
+            gen = self.membership.generation_of(node)
+            if f"join-{node}-g{gen}" in active:
+                continue
+            with self._lock:
+                self._join_pending.setdefault(node, [])
+            log.info("adopted joiner without a replicated refill job; "
+                     "re-admitting on its next announce", node=node)
+
+    def _resume_drains(self) -> None:
+        """Takeover re-drive: every adopted DRAINING member either has
+        its re-home job resumed by the job plane (active record), or is
+        re-planned/finalized afresh at the bumped epoch."""
+        for node in self.membership.draining():
+            with self._lock:
+                jids = [j for j, n in self._drain_jobs.items()
+                        if n == node]
+            if any((job := self.jobs.get(j)) is not None
+                   and job.state == "active" for j in jids):
+                log.info("adopted drain still re-homing; the resumed "
+                         "job plane carries it", node=node)
+                continue
+            # The re-home record finished (or was lost with the dead
+            # leader): recompute and finish — or re-plan — now.
+            with self._lock:
+                for j in jids:
+                    self._drain_jobs.pop(j, None)
+            self._drain_rehome(node)
+
     def _content_skip_locked(self, dest: NodeID, layer_id: LayerID) -> bool:
         """Lock held.  True when shipping (dest, layer) would be wasted
         wire bytes: a job claims the pair AND the content index shows
@@ -2667,6 +3206,13 @@ class LeaderNode:
         """Record delivery; on satisfaction broadcast startup + signal ready
         (node.go:410-432)."""
         if msg.src_id != self.node.my_id:
+            if self.membership.is_left(msg.src_id):
+                # A departed member's straggler ack must not recreate
+                # its status row (docs/membership.md).
+                trace.count("membership.zombie_fenced")
+                log.warn("ack from a departed member fenced",
+                         node=msg.src_id)
+                return
             if not self._ack_liveness(msg.src_id):
                 return
         with self._lock:
@@ -2738,7 +3284,9 @@ class LeaderNode:
     def _jobs_completed(self, job_ids) -> None:
         """Log + replicate job completions (no-op on an empty list).
         A completed ``kind="swap"`` job drives its fence: clean
-        completion commits, a degraded one aborts (docs/swap.md)."""
+        completion commits, a degraded one aborts (docs/swap.md).  A
+        completed ``kind="drain"`` re-home job releases its drainer
+        (docs/membership.md)."""
         for jid in job_ids:
             job = self.jobs.get(jid)
             trace.count("jobs.completed")
@@ -2746,6 +3294,7 @@ class LeaderNode:
                      **(job.summary() if job is not None else {}))
             self._replicate("job_done", JobID=jid)
             self._on_swap_job_done(jid)
+            self._on_drain_job_done(jid)
 
     def _layer_size_locked(self, layer_id: LayerID) -> int:
         """A layer's full size: the max announced ``data_size`` across
@@ -2808,6 +3357,14 @@ class LeaderNode:
         never land), loudly, so the rest of the cluster still converges."""
         if node_id == self.node.my_id:
             log.error("refusing to declare self crashed")
+            return
+        if self.membership.is_left(node_id):
+            # A cleanly-departed member can never crash: the drain
+            # pruned it from every liveness table, and any straggler
+            # report naming it is fenced (docs/membership.md).
+            trace.count("membership.zombie_fenced")
+            log.info("crash report for a departed member ignored",
+                     node=node_id)
             return
         if self._spmd:
             # Every process must enter every collective; one is gone, so
@@ -2897,6 +3454,22 @@ class LeaderNode:
             self._replicate_swap(version)
         for version in dead_swaps:
             self._abort_swap(version, f"dest {node_id} crashed mid-rollout")
+        if self.membership.is_draining(node_id):
+            # The drainer died MID-drain: that is a real crash (the
+            # salvage above applies — its unsent re-home bytes may be
+            # lost), but the seat is terminally out: fence its
+            # generation and answer waiters loudly instead of silence.
+            self.membership.mark_left(node_id)
+            with self._lock:
+                waiters = self._drain_waiters.pop(node_id, set())
+                for jid in [j for j, n in self._drain_jobs.items()
+                            if n == node_id]:
+                    del self._drain_jobs[jid]
+            self._replicate_membership()
+            for w in sorted(waiters):
+                self._answer_drain(w, node_id,
+                                   error=f"node {node_id} crashed "
+                                         "mid-drain")
         affected, finished = self.jobs.drop_dest(node_id)
         for jid in affected:
             self._replicate("job", **self.jobs.record(jid))
@@ -2959,9 +3532,15 @@ class RetransmitLeaderNode(LeaderNode):
         garbage under the layer's identity (docs/codec.md; mode 1/2's
         coarse per-layer pool can't express per-pair admissibility, so
         quantized holders simply never re-seed here — honest limit,
-        mode 3's arc filter does it exactly)."""
+        mode 3's arc filter does it exactly).  UNVERIFIED joiners
+        (docs/membership.md) are quarantined too: their announced
+        holdings are not trusted as forward sources until they
+        digest-verify."""
         self.layer_owners = {}
+        quarantined = self.membership.unverified_sources()
         for node_id, layer_ids in self.status.items():
+            if node_id in quarantined:
+                continue
             for layer_id, meta in layer_ids.items():
                 if meta.shard or getattr(meta, "codec", ""):
                     continue
@@ -3518,13 +4097,22 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             # grows with the group count, not the fleet size
             # (docs/hierarchy.md).
             plan_asg = self._plan_assignment_locked()
+            # Elastic membership (docs/membership.md): an UNVERIFIED
+            # joiner's announced holdings must not be planned as
+            # transfer sources (its own demand reduction above/below
+            # still reads the full status) — hand the graph a
+            # source-filtered view.
+            quarantined = self.membership.unverified_sources()
+            src_status = ({n: row for n, row in self.status.items()
+                           if n not in quarantined}
+                          if quarantined else self.status)
             # Size every layer from announced metadata — the leader need not
             # hold a layer to schedule it (its own layers are in status too).
             # CODEC holdings are skipped: their data_size is the ENCODED
             # byte count, not the canonical layer size the raw pairs
             # plan by (codec pairs size via codec_sizes below).
             layer_sizes: Dict[LayerID, int] = {}
-            for layer_metas in self.status.values():
+            for layer_metas in src_status.values():
                 for layer_id, meta in layer_metas.items():
                     if meta.data_size > 0 and not getattr(
                             meta, "codec", ""):
@@ -3628,7 +4216,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                         dest, {})[layer_id] = meta
             if not tagged:
                 graph = make_flow_graph(
-                    modified, self.status, layer_sizes,
+                    modified, src_status, layer_sizes,
                     self.node_network_bw,
                     remaining=remaining_sizes, topology=self.topology,
                     codec_sizes=codec_sizes, node_codecs=node_codecs,
@@ -3640,7 +4228,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                      self._job_avoid_locked(jid, asg) if jid else set())
                     for (prio, jid), asg in sorted(by_tier.items())]
                 t_by_prio, jobs = solve_joint(
-                    demands, self.status, layer_sizes,
+                    demands, src_status, layer_sizes,
                     self.node_network_bw, remaining=remaining_sizes,
                     topology=self.topology,
                     graph_factory=make_flow_graph,
@@ -3714,6 +4302,18 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     log.warn("revoke send failed (the demoted sends "
                              "simply run)", sender=sender, err=repr(e))
 
+    def _forget_sender_jobs(self, node: NodeID) -> None:
+        """A cleanly-departed seat's dispatched sends are simply
+        forgotten (docs/membership.md): unlike ``crash()``, no
+        SourceDeadMsg fires and nothing enters range salvage — the
+        re-plan the drain finalize runs re-dispatches any pair its
+        departure left uncovered from the survivors."""
+        with self._lock:
+            self._live_jobs.pop(node, None)
+            for job_list in self._live_jobs.values():
+                job_list[:] = [j for j in job_list
+                               if j.dest_id != node]
+
     def _job_avoid_locked(self, jid: str, asg: Assignment) -> Set[NodeID]:
         """Lock held.  The sender-avoid set for one job's tier: the
         job's explicit ``avoid_sources``, plus — for "repair" jobs —
@@ -3726,8 +4326,15 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         if job is None:
             return set()
         avoid = set(job.avoid_sources)
-        if job.kind != "repair":
+        if job.kind not in ("repair", "join"):
             return avoid
+        # "join" refills (docs/membership.md) extend the repair
+        # politeness with ORIGIN avoidance: a late joiner pulls from
+        # current peer holders, touching the origin seeder (the
+        # leader's seat) only for bytes no peer holds — admission cost
+        # must not scale with origin bandwidth.
+        origin: Set[NodeID] = ({self.node.my_id} if job.kind == "join"
+                               else set())
         for lids in asg.values():
             for lid in lids:
                 slow: Set[NodeID] = set()
@@ -3737,8 +4344,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     if meta is None or not delivered(meta):
                         continue
                     (slow if meta.limit_rate else free).add(n)
-                if free - avoid:
-                    avoid |= slow
+                if free - avoid - origin:
+                    avoid |= slow | origin
         return avoid
 
     @staticmethod
@@ -3934,7 +4541,9 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 # the whole layer otherwise — and for a wire-codec pair,
                 # the exact encoded form (or raw + encode capability).
                 alt = pick_salvage_source(
-                    self.status, lid, exclude={node_id, dest},
+                    self.status, lid,
+                    exclude={node_id, dest}
+                    | self.membership.unverified_sources(),
                     need_shard=want.shard if want is not None else "",
                     need_codec=want.codec if want is not None else "",
                     encoders=frozenset(
@@ -4205,6 +4814,14 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
                 self.content.reset_node(m, {})
                 self._replicate("status", Node=m,
                                 Layers=layer_ids_to_json(row))
+                with self._lock:
+                    pending_want = self._join_pending.pop(m, None)
+                if pending_want is not None:
+                    # A grouped joiner's first announce arrives through
+                    # its sub-leader's fold: admit its refill job here,
+                    # exactly like the flat announce path
+                    # (docs/membership.md).
+                    self._admit_join_job(m, pending_want)
                 if started and known:
                     replan_for.append(m)
             trace.count("hier.announce_aggregates")
@@ -4336,3 +4953,125 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
                 log.warn("dissolve notice undeliverable (the seat's own "
                          "timeout will surface it)", member=m,
                          err=repr(e))
+
+    # --------------------------------------------- elastic membership
+
+    def _place_joiner(self, node: NodeID) -> NodeID:
+        """Grouped clusters absorb joiners (docs/membership.md): the
+        joiner lands in the least-loaded live group — bounded by the
+        ``partition_groups`` sqrt sizing, so churn can't melt one
+        sub-leader — and its control parent becomes that group's
+        sub-leader; the next re-plan's ``GroupPlanMsg`` hands the
+        sub-leader its targets.  A re-joining sub-leader seat, and a
+        fleet whose groups are all dissolved/oversubscribed, plan
+        flat (parent = this root)."""
+        import math
+
+        with self._lock:
+            gid = self._member_group.get(node)
+            if gid is not None:
+                return self.groups[gid]["leader"]
+            if node in self._group_of_subleader:
+                return self.node.my_id
+            candidates = sorted(
+                (len([m for m in rec["members"]
+                      if m not in self._dead_members]), g)
+                for g, rec in self.groups.items()
+                if g not in self._dissolved)
+            if not candidates:
+                return self.node.my_id
+            n_seats = len(self._member_group) + len(self.groups) + 2
+            cap = max(2, math.isqrt(n_seats) + 1)
+            size, gid = candidates[0]
+            if size >= 2 * cap:
+                return self.node.my_id  # every group oversubscribed
+            rec = self.groups[gid]
+            if node not in rec["members"]:
+                rec["members"] = sorted(set(rec["members"]) | {node})
+            self._member_group[node] = gid
+            self._dead_members.discard(node)
+            sub = rec["leader"]
+            gj = self._groups_json()
+        trace.count("hier.joiners_grouped")
+        log.info("joiner absorbed into group", node=node, group=gid,
+                 sub=sub)
+        self._replicate("groups", Groups=gj)
+        return sub
+
+    def handle_join(self, msg: JoinMsg) -> None:
+        super().handle_join(msg)
+        if not msg.admitted:
+            # A re-admitted sub-leader seat re-forms its dissolved
+            # group (the named PR 11 follow-up, docs/membership.md).
+            self._maybe_reform(msg.src_id)
+
+    def handle_announce(self, msg: AnnounceMsg) -> None:
+        super().handle_announce(msg)
+        # A dead sub-leader's seat coming back through a plain revival
+        # announce re-forms its group too.
+        if not self.membership.is_left(msg.src_id):
+            self._maybe_reform(msg.src_id)
+
+    def _maybe_reform(self, node: NodeID) -> None:
+        """Re-form a dissolved group when its sub-leader seat is
+        re-admitted: surviving members move back under it (re-point
+        notices), the root stops monitoring them directly, and the
+        next re-plan hands the sub-leader its targets again."""
+        gid = self._group_of_subleader.get(node)
+        if gid is None:
+            return
+        with self._lock:
+            if gid not in self._dissolved:
+                return
+            self._dissolved.discard(gid)
+            rec = self.groups[gid]
+            members = [m for m in rec["members"]
+                       if m != node and m not in self._dead_members
+                       and not self.membership.is_left(m)]
+            for m in members:
+                self._member_group[m] = gid
+            gj = self._groups_json()
+        for m in members:
+            # Their liveness belongs to the sub-leader's detector again.
+            self.detector.forget(m)
+        trace.count("hier.groups_reformed")
+        log.warn("sub-leader seat re-admitted; re-forming its "
+                 "dissolved group", group=gid, sub=node,
+                 members=members)
+        self._replicate("groups", Groups=gj)
+        addr = self.membership.addr_of(node)
+        out = JoinMsg(self.node.my_id, node=node, addr=addr,
+                      admitted=True, parent=node, parent_addr=addr,
+                      epoch=self.epoch)
+        for m in members:
+            try:
+                self.node.add_node(m)
+                self.node.transport.send(m, out)
+            except (OSError, KeyError, ConnectionError) as e:
+                log.warn("re-point notice undeliverable (the member "
+                         "stays flat until the next lease)", member=m,
+                         err=repr(e))
+        self._send_group_plans()
+
+    def _finalize_drain(self, node: NodeID) -> None:
+        """A drained SUB-LEADER's group dissolves (members re-point at
+        the root — the same degradation a sub-leader crash runs, minus
+        the crash); a drained grouped MEMBER simply leaves its group's
+        roster so fan-out stops chasing it."""
+        gid = self._group_of_subleader.get(node)
+        with self._lock:
+            dissolve = gid is not None and gid not in self._dissolved
+        if dissolve:
+            self._dissolve_group(gid, node)
+        gj = None
+        with self._lock:
+            mg = self._member_group.pop(node, None)
+            if mg is not None:
+                rec = self.groups.get(mg)
+                if rec and node in rec["members"]:
+                    rec["members"] = [m for m in rec["members"]
+                                      if m != node]
+                gj = self._groups_json()
+        if gj is not None:
+            self._replicate("groups", Groups=gj)
+        super()._finalize_drain(node)

@@ -43,11 +43,13 @@ from ..transport.messages import (
     BootHintMsg,
     BootReadyMsg,
     DevicePlanMsg,
+    DrainMsg,
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
     GroupPlanMsg,
     JobRevokeMsg,
+    JoinMsg,
     LayerDigestsMsg,
     LayerMsg,
     LayerNackMsg,
@@ -377,6 +379,12 @@ class ReceiverNode:
         # the moment one of this seat's own layers completes (fired at
         # the ack chokepoint, every completion path).
         self.on_layer_complete = None
+        # Elastic membership (docs/membership.md): the join/drain
+        # handshake latches — join() blocks on the admit notice,
+        # request_drain() on the leader's done/refused answer.
+        self._join_admitted = threading.Event()
+        self._drain_done = threading.Event()
+        self._drain_error = ""
         # Latched by close(): a closed receiver's still-draining daemon
         # work (a boot thread finishing late) must not emit leader-routed
         # messages — its seat's address may already belong to a NEW
@@ -427,6 +435,8 @@ class ReceiverNode:
         self.loop.register(TimeSyncMsg, self.handle_time_sync)
         self.loop.register(SwapCommitMsg, self.handle_swap_commit)
         self.loop.register(GroupPlanMsg, self.handle_group_plan)
+        self.loop.register(JoinMsg, self.handle_join)
+        self.loop.register(DrainMsg, self.handle_drain)
 
     # ------------------------------------------------- control-plane HA
 
@@ -528,6 +538,126 @@ class ReceiverNode:
         except (OSError, KeyError) as e:
             log.error("re-announce to root after dissolve failed",
                       err=repr(e))
+
+    # ------------------------------------------------ elastic membership
+
+    def join(self, want=None, timeout: float = 10.0,
+             attempts: int = 3) -> bool:
+        """Ask the leader to admit this UNCONFIGURED seat into the
+        running cluster (docs/membership.md), then announce.  ``want``
+        optionally names the layer ids to receive (empty = the current
+        goal's layer universe).  Bounded retry: a request eaten by a
+        fault window is re-sent; returns whether admission landed.
+        The announce that follows carries this seat's local holdings
+        (checkpointed partials + digests), so a COLD-BOOTING joiner
+        refills only its missing bytes — mostly from peer holders."""
+        self._join_admitted.clear()
+        req = JoinMsg(self.node.my_id,
+                      addr=self.node.transport.get_address(),
+                      want=[int(l) for l in want or []])
+        per_try = max(timeout / max(attempts, 1), 0.5)
+        for _ in range(max(attempts, 1)):
+            try:
+                self.node.transport.send(self.node.leader_id, req)
+            except (OSError, KeyError, ConnectionError) as e:
+                log.warn("join request send failed; retrying",
+                         err=repr(e))
+            if self._join_admitted.wait(per_try):
+                trace.count("membership.joined")
+                self.announce()
+                return True
+        log.error("join request never admitted", leader=self.node.leader_id)
+        return False
+
+    def release_ready(self) -> None:
+        """Release a ``ready()`` waiter without a StartupMsg: a DRAINED
+        seat never receives one (it left the goal), so its driver calls
+        this after a successful :meth:`request_drain` to unblock the
+        normal exit path."""
+        self._ready_q.put({})
+
+    def request_drain(self, timeout: float = 30.0) -> bool:
+        """Graceful leave (docs/membership.md): ask the leader to
+        re-home this seat's unique holdings and release it.  Blocks
+        until the DONE notice (True) or the timeout/refusal (False) —
+        only a True return makes exiting crash-path-safe."""
+        self._drain_done.clear()
+        self._drain_error = ""
+        trace.count("membership.drain_requested")
+        self._send_to_leader(DrainMsg(self.node.my_id))
+        if not self._drain_done.wait(timeout):
+            log.error("drain request not answered within the timeout")
+            return False
+        if self._drain_error:
+            log.error("drain refused", err=self._drain_error)
+            return False
+        return True
+
+    def handle_join(self, msg: JoinMsg) -> None:
+        """Receiver half of the JOIN vocabulary: the admit reply (this
+        seat's own admission — re-point at the named parent and latch),
+        roster notices (a peer joined: install its address), and
+        re-point notices (a re-formed group moves this member back
+        under its sub-leader)."""
+        if not msg.admitted:
+            return  # requests are leader business
+        if self._fence_stale(msg):
+            return
+        subject = msg.node if msg.node >= 0 else msg.src_id
+        if subject != self.node.my_id and msg.addr:
+            # Roster notice: a peer joined — make it dialable.
+            try:
+                self.node.transport.addr_registry[subject] = msg.addr
+            except (AttributeError, TypeError):
+                pass
+            self.node.add_node(subject)
+        repoint = (msg.parent >= 0 and msg.parent != self.node.my_id
+                   and (subject == self.node.my_id
+                        or msg.parent == subject))
+        if repoint and msg.parent != self.node.leader_id:
+            # Admission placed (or a re-formed group moved) this seat
+            # under a new control parent: announces, acks, heartbeats,
+            # and metric reports flow there now.
+            if msg.parent_addr:
+                try:
+                    self.node.transport.addr_registry[msg.parent] = \
+                        msg.parent_addr
+                except (AttributeError, TypeError):
+                    pass
+            self.node.add_node(msg.parent)
+            try:
+                self.node.update_leader(msg.parent)
+            except KeyError:
+                pass
+            trace.count("membership.repointed")
+            log.info("control parent re-pointed by membership notice",
+                     parent=msg.parent)
+            self._flush_leader_pending()
+            if subject != self.node.my_id:
+                # A re-point of an ALREADY-RUNNING member (group
+                # re-form): re-announce to the new parent.  A joiner's
+                # own admit skips this — join() announces once the
+                # latch below releases it.
+                try:
+                    self.announce()
+                except (OSError, KeyError) as e:
+                    log.error("re-announce to new parent failed",
+                              err=repr(e))
+        if subject == self.node.my_id:
+            self._join_admitted.set()
+
+    def handle_drain(self, msg: DrainMsg) -> None:
+        """The leader's answer to this seat's drain request (done or
+        refused)."""
+        if not msg.done and not msg.error:
+            return  # requests are leader business
+        if self._fence_stale(msg):
+            return
+        subject = msg.node if msg.node >= 0 else self.node.my_id
+        if subject != self.node.my_id:
+            return
+        self._drain_error = msg.error
+        self._drain_done.set()
 
     def _send_to_leader(self, msg) -> None:
         """Leader-routed send with failover-window requeue: a leader
@@ -3212,11 +3342,42 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             "start sending layer",
             layer=msg.layer_id, dest=msg.dest_id, size=msg.data_size, rate=msg.rate,
         )
-        handle_flow_retransmit(
-            self.node, self.layers, self._lock,
-            lambda lid, dest: fetch_from_client(self.node, lid, dest), msg,
-            revokes=self.revokes, codecs=self.codec_plane,
-        )
+        # Elastic membership (docs/membership.md): a flow command for a
+        # JUST-JOINED dest can overtake the roster notice carrying its
+        # address (the handler pool doesn't order across message
+        # types).  An unknown-peer failure here waits briefly for the
+        # address to land instead of dropping the pair — re-sent
+        # fragments from a mid-way retry are absorbed by interval
+        # reassembly like any duplicate.
+        for attempt in range(40):
+            try:
+                handle_flow_retransmit(
+                    self.node, self.layers, self._lock,
+                    lambda lid, dest: fetch_from_client(self.node, lid,
+                                                        dest), msg,
+                    revokes=self.revokes, codecs=self.codec_plane,
+                )
+                break
+            except (ConnectionError, KeyError) as e:
+                # Only the MISSING-ADDRESS case retries; a failure with
+                # the dest already in the registry is a real defect (or
+                # a dead peer) and must surface, not be masked by a 2 s
+                # busy-wait.
+                try:
+                    unknown = (msg.dest_id
+                               not in self.node.transport.addr_registry)
+                except (AttributeError, TypeError):
+                    unknown = False
+                if not unknown:
+                    raise
+                if attempt == 39:
+                    log.error("flow send dest unreachable past the "
+                              "roster-wait budget; dropping (a re-plan "
+                              "re-dispatches)", dest=msg.dest_id,
+                              layerID=msg.layer_id, err=repr(e))
+                    break
+                trace.count("membership.roster_waits")
+                _time.sleep(0.05)
         dur = _time.monotonic() - t0
         log.info(
             "finished sending layer",

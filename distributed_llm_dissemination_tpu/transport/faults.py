@@ -59,7 +59,7 @@ class FaultRule:
     the same failure timeline every run."""
 
     kind: str  # "corrupt" | "drop" | "dup" | "delay" | "reset"
-    #          | "partition" | "kill"
+    #          | "partition" | "kill" | "join" | "leave"
     direction: str = "out"  # "out" (send-side) | "in" (receive-side)
     # Matchers; None = wildcard.
     msg_type: Optional[MsgType] = None
@@ -128,6 +128,17 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
     - ``kill_after=T``: hard-stop this node's transport T seconds after
       construction (sends raise ``ConnectionError``, inbound vanishes)
       — the deterministic leader-kill switch
+    - ``join=T``: elastic-membership churn schedule (docs/membership.md)
+      — this node is DARK (sends raise, inbound vanishes: it does not
+      exist yet) until T seconds after construction, then comes alive;
+      the harness reads ``FaultyTransport.join_at`` and fires the
+      seat's ``join()`` at that moment — a seeded late-join, not a
+      sleep in test code
+    - ``leave=T``: the departure half of the churn schedule — purely an
+      exposed timestamp (``FaultyTransport.leave_at``): the harness
+      initiates the node's graceful DRAIN at T.  The transport itself
+      stays healthy (a drain is planned, not a fault); pair with
+      ``kill_after`` to model a crash-leave instead
     - ``slow=RATE[@P]``: rate-limit this node's outbound LAYER sends to
       peer P (all peers when omitted) to RATE bytes/second via a token
       bucket — the deterministic straggler-link injection the live-swap
@@ -161,6 +172,14 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
         if key == "kill_after":
             pending.append(lambda sd, tm, t=float(val):
                            FaultRule("kill", "out", t_start=t))
+            continue
+        if key == "join":
+            pending.append(lambda sd, tm, t=float(val):
+                           FaultRule("join", "out", t_start=t))
+            continue
+        if key == "leave":
+            pending.append(lambda sd, tm, t=float(val):
+                           FaultRule("leave", "out", t_start=t))
             continue
         if key == "slow":
             rate_s, _, peer = val.partition("@")
@@ -222,11 +241,13 @@ class FaultyTransport(Transport):
         self.inner = inner
         self.rules: List[FaultRule] = [
             r for r in rules
-            if r.kind not in ("partition", "kill", "slow")]
+            if r.kind not in ("partition", "kill", "slow", "join",
+                              "leave")]
         self.seed = seed
         self._lock = threading.Lock()
         self.stats = {"corrupt": 0, "drop": 0, "dup": 0, "delay": 0,
-                      "reset": 0, "partition": 0, "kill": 0, "slow": 0}
+                      "reset": 0, "partition": 0, "kill": 0, "slow": 0,
+                      "join": 0}
         # slow=RATE@P: a persistent per-link rate limit (token bucket),
         # not an every-Nth rule — the injected straggler link.
         self._slow = [(r.dest, TokenBucket(r.rate)) for r in rules
@@ -241,10 +262,19 @@ class FaultyTransport(Transport):
                             if r.kind == "partition"]
         kills = [r.t_start for r in rules if r.kind == "kill"]
         self._kill_at = min(kills) if kills else None
+        # Churn schedule (docs/membership.md): the node is DARK before
+        # join_at (it does not exist yet); leave_at is purely an
+        # exposed timestamp the harness drains the node at.  Both are
+        # seconds since construction, like every time-scheduled fault.
+        joins = [r.t_start for r in rules if r.kind == "join"]
+        self.join_at = min(joins) if joins else None
+        leaves = [r.t_start for r in rules if r.kind == "leave"]
+        self.leave_at = min(leaves) if leaves else None
         need_tamper = (
             any(r.direction == "in" and r.msg_type in (None, MsgType.LAYER)
                 for r in self.rules)
-            or self._partitions or self._kill_at is not None)
+            or self._partitions or self._kill_at is not None
+            or self.join_at is not None)
         if need_tamper:
             if hasattr(inner, "recv_tamper"):
                 inner.recv_tamper = self._tamper
@@ -260,6 +290,27 @@ class FaultyTransport(Transport):
     def _killed(self) -> bool:
         return (self._kill_at is not None
                 and time.monotonic() - self._t0 >= self._kill_at)
+
+    def _dark(self) -> bool:
+        """True before the join schedule says this node exists
+        (docs/membership.md): sends raise, inbound vanishes — a seeded
+        late joiner, invisible until its moment."""
+        return (self.join_at is not None
+                and time.monotonic() - self._t0 < self.join_at)
+
+    def seconds_until_join(self):
+        """Remaining dark time (None = no join schedule): the harness
+        sleeps this long, then fires the seat's ``join()``."""
+        if self.join_at is None:
+            return None
+        return max(0.0, self._t0 + self.join_at - time.monotonic())
+
+    def seconds_until_leave(self):
+        """Remaining time to the scheduled graceful drain (None = no
+        leave schedule)."""
+        if self.leave_at is None:
+            return None
+        return max(0.0, self._t0 + self.leave_at - time.monotonic())
 
     def _partitioned(self, peer) -> bool:
         """Whether traffic between this node and ``peer`` is currently
@@ -312,6 +363,10 @@ class FaultyTransport(Transport):
             with self._lock:
                 self.stats["kill"] += 1
             return False  # hard-stopped transport: nothing lands
+        if self._dark():
+            with self._lock:
+                self.stats["join"] += 1
+            return False  # not joined yet: nothing lands
         if self._partitioned(src):
             with self._lock:
                 self.stats["partition"] += 1
@@ -343,6 +398,10 @@ class FaultyTransport(Transport):
                 with self._lock:
                     self.stats["kill"] += 1
                 continue  # hard-stopped: inbound vanishes
+            if self._dark():
+                with self._lock:
+                    self.stats["join"] += 1
+                continue  # not joined yet: inbound vanishes
             if not isinstance(msg, LayerMsg):
                 src = getattr(msg, "src_id", None)
                 if self._partitioned(src):
@@ -370,6 +429,10 @@ class FaultyTransport(Transport):
             with self._lock:
                 self.stats["kill"] += 1
             raise ConnectionError("injected fault: transport killed")
+        if self._dark():
+            with self._lock:
+                self.stats["join"] += 1
+            raise ConnectionError("injected fault: node not joined yet")
         if self._partitioned(dest_id):
             with self._lock:
                 self.stats["partition"] += 1

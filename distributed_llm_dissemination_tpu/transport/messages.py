@@ -138,6 +138,25 @@ class MsgType(enum.IntEnum):
     # ack per member), member announce inventories, member deaths, and
     # batched member telemetry snapshots — the root handles O(groups)
     # control messages where the flat plane handled O(nodes).
+    # JOIN / DRAIN — elastic membership (docs/membership.md): the
+    # topology stops being a config constant.  JOIN is four roles in one
+    # type, disambiguated by its flags like SWAP_COMMIT: a REQUEST
+    # (unconfigured node → leader: admit me — my dialable address and,
+    # optionally, the layer ids I want; default = the current goal's
+    # layer universe), the ADMIT reply (leader → joiner,
+    # ``admitted=True``: your control parent — the root, or a sub-leader
+    # when a grouped cluster placed you — re-point and announce there),
+    # the ROSTER notice (leader → members, ``admitted=True`` +
+    # ``node``/``addr``: a peer joined; register its address so a later
+    # plan can command sends to it), and the RE-POINT notice (leader →
+    # member, ``parent`` set: your control parent changed — a re-formed
+    # group's members move back under their re-admitted sub-leader).
+    # DRAIN is the planned-departure verbs: a REQUEST (node → leader:
+    # drain me; or operator seat → leader with ``node`` naming the
+    # drainer) and the DONE notice (leader → drainer + requester,
+    # ``done=True``: your unique holdings are re-homed and you are out
+    # of every liveness/lease/announce table — exiting now cannot fire
+    # the crash path).
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -159,6 +178,8 @@ class MsgType(enum.IntEnum):
     JOB_REVOKE = 26
     GROUP_PLAN = 27
     GROUP_STATUS = 28
+    JOIN = 29
+    DRAIN = 30
 
 
 def _epoch_to_payload(payload: dict, epoch: int) -> dict:
@@ -1517,6 +1538,124 @@ class GroupStatusMsg:
         )
 
 
+@dataclasses.dataclass
+class JoinMsg:
+    """Elastic membership: the JOIN verb (docs/membership.md) — four
+    protocol roles in one type (see MsgType.JOIN).
+
+    - **request** (node → leader; no flags): admit ``src_id`` into the
+      running cluster.  ``addr`` is the joiner's dialable transport
+      address (the leader — and, via roster notices, every sender —
+      installs it in its registry; an unconfigured seat is in nobody's
+      config).  ``want`` optionally names the layer ids the joiner
+      wants; empty = the current goal's full layer universe.
+    - **admit** (leader → joiner; ``admitted=True``): admission
+      confirmed at ``epoch``.  ``parent`` (>= 0) names the joiner's
+      control parent — the root, or the sub-leader a grouped cluster
+      placed it under (``parent_addr`` its address) — the joiner
+      re-points its leader there and announces.
+    - **roster** (leader → member; ``admitted=True`` + ``node``/
+      ``addr``): peer ``node`` joined at ``addr`` — install the
+      address so later plans can command sends to it.
+    - **re-point** (leader → member; ``parent`` >= 0, ``node`` names
+      the parent): your control parent changed (a dissolved group
+      re-formed under its re-admitted sub-leader) — re-point and
+      re-announce there.
+
+    Epoch-fenced like every leader-originated notice: a zombie
+    ex-leader's admits and re-points are rejected, not raced.  All
+    extension fields are omitted at default — the request a legacy
+    tool could mint is the minimal {SrcID} payload."""
+
+    src_id: NodeID
+    addr: str = ""
+    want: list = dataclasses.field(default_factory=list)  # layer ids
+    node: NodeID = -1  # subject of an admit/roster notice (-1 = src_id)
+    admitted: bool = False
+    parent: NodeID = -1  # control parent to re-point at (-1 = keep)
+    parent_addr: str = ""
+    error: str = ""
+    epoch: int = -1
+
+    msg_type = MsgType.JOIN
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id}
+        if self.addr:
+            payload["Addr"] = str(self.addr)
+        if self.want:
+            payload["Want"] = [int(l) for l in self.want]
+        if self.node >= 0:
+            payload["Node"] = int(self.node)
+        if self.admitted:
+            payload["Admitted"] = True
+        if self.parent >= 0:
+            payload["Parent"] = int(self.parent)
+        if self.parent_addr:
+            payload["ParentAddr"] = str(self.parent_addr)
+        if self.error:
+            payload["Error"] = str(self.error)
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "JoinMsg":
+        return cls(
+            int(d["SrcID"]),
+            str(d.get("Addr", "")),
+            [int(l) for l in d.get("Want") or []],
+            int(d.get("Node", -1)),
+            bool(d.get("Admitted", False)),
+            int(d.get("Parent", -1)),
+            str(d.get("ParentAddr", "")),
+            str(d.get("Error", "")),
+            int(d.get("Epoch", -1)),
+        )
+
+
+@dataclasses.dataclass
+class DrainMsg:
+    """Elastic membership: the DRAIN verb (docs/membership.md) — a
+    planned departure, never a crash.
+
+    - **request** (node → leader; no flags): drain ``src_id`` —
+      re-home my unique holdings onto survivors, then release me.  An
+      OPERATOR seat drains another node by naming it in ``node``
+      (the ``cli.main -drain NODE`` one-shot).
+    - **done** (leader → drainer + requester; ``done=True``): ``node``'s
+      unique holdings are re-homed and it is pruned from the failure
+      detector, lease recipients, and announce gating — exiting now
+      cannot fire the crash path.  ``error`` reports a refused drain
+      (unknown node, the leader itself) instead of silence."""
+
+    src_id: NodeID
+    node: NodeID = -1  # the node to drain (-1 = src_id)
+    done: bool = False
+    error: str = ""
+    epoch: int = -1
+
+    msg_type = MsgType.DRAIN
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id}
+        if self.node >= 0:
+            payload["Node"] = int(self.node)
+        if self.done:
+            payload["Done"] = True
+        if self.error:
+            payload["Error"] = str(self.error)
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "DrainMsg":
+        return cls(
+            int(d["SrcID"]),
+            int(d.get("Node", -1)),
+            bool(d.get("Done", False)),
+            str(d.get("Error", "")),
+            int(d.get("Epoch", -1)),
+        )
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -1544,6 +1683,8 @@ Message = Union[
     JobRevokeMsg,
     GroupPlanMsg,
     GroupStatusMsg,
+    JoinMsg,
+    DrainMsg,
 ]
 
 _DECODERS = {
@@ -1575,6 +1716,8 @@ _DECODERS = {
     MsgType.JOB_REVOKE: JobRevokeMsg,
     MsgType.GROUP_PLAN: GroupPlanMsg,
     MsgType.GROUP_STATUS: GroupStatusMsg,
+    MsgType.JOIN: JoinMsg,
+    MsgType.DRAIN: DrainMsg,
 }
 
 

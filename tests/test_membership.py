@@ -1,0 +1,735 @@
+"""Elastic membership tests (docs/membership.md).
+
+What the tentpole demands:
+
+- MembershipTable lifecycle units (join → verify → drain → left, the
+  zombie-rejoiner generation bump) and populated wire round-trips for
+  the two new messages (defaults are covered by the enumeration guard
+  in test_messages_compat.py);
+- JOIN e2e on both backends: an UNCONFIGURED node joins a running
+  cluster, receives the goal byte-exactly, and its refill comes from
+  PEER holders — zero origin-seeder bytes once peers hold the layers;
+- source quarantine: a joiner announcing a digest that conflicts with
+  the stamped one stays a dest-only seat;
+- COLD-BOOT: a joiner holding local bytes (same id, or content-equal
+  bytes under another id, resolved via the content index) refills only
+  the complement;
+- DRAIN under load on both backends: the drainer's unique holdings are
+  re-homed onto survivors BEFORE it leaves — zero crash-path salvage,
+  zero lost pairs — and its later silence never fires ``crash()``;
+- the seeded churn chaos smoke (join + leave storm under corrupt/drop
+  faults, seed registered with conftest's replay printer);
+- leader-kill-during-churn: the promoted standby adopts the membership
+  table from its shadow and resumes admission byte-exactly at the
+  bumped epoch;
+- hierarchy: joiners are absorbed into groups, and a dissolved group
+  RE-FORMS when its sub-leader seat is re-admitted.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_llm_dissemination_tpu.core.types import LayerMeta
+from distributed_llm_dissemination_tpu.runtime import (
+    FlowRetransmitLeaderNode,
+    FlowRetransmitReceiverNode,
+    HierarchicalFlowLeaderNode,
+    MembershipTable,
+    Node,
+    StandbyController,
+    SubLeaderController,
+    partition_groups,
+)
+from distributed_llm_dissemination_tpu.runtime import membership as mship
+from distributed_llm_dissemination_tpu.transport import (
+    InmemTransport,
+    TcpTransport,
+    reset_registry,
+)
+from distributed_llm_dissemination_tpu.transport.faults import (
+    FaultRule,
+    FaultyTransport,
+    rules_from_spec,
+)
+from distributed_llm_dissemination_tpu.transport.messages import (
+    DrainMsg,
+    JoinMsg,
+    MsgType,
+)
+from distributed_llm_dissemination_tpu.utils import telemetry, trace
+
+from test_node import close_all, layer_bytes, make_transports, mem_layer
+
+TIMEOUT = 15.0
+HB = 0.1
+SIZE = 16 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+def _wait_for(cond, timeout=TIMEOUT, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _tx_bytes_to(dest):
+    """{src: layer bytes sent to ``dest``} from the telemetry links.
+    BASE rows only: job-tagged fields file on the base row AND the
+    ``#job`` split row (utils/telemetry.link_add), so summing both
+    would double-count."""
+    out = {}
+    for key, row in telemetry.snapshot()["links"].items():
+        if "#" in key:
+            continue
+        s, d = key.split("->")
+        if d != "None" and int(d) == dest:
+            out[int(s)] = out.get(int(s), 0) + int(row.get("tx_bytes", 0))
+    return out
+
+
+def _joiner_transport(kind, jid, leader_registry_entry):
+    """An UNCONFIGURED seat's transport: it knows only the leader."""
+    if kind == "inmem":
+        return InmemTransport(f"n{jid}",
+                              addr_registry={0: leader_registry_entry})
+    t = TcpTransport("127.0.0.1:0",
+                     addr_registry={0: leader_registry_entry})
+    return t
+
+
+# ------------------------------------------------------------ unit pieces
+
+
+def test_membership_table_lifecycle_and_zombie_generation():
+    t = MembershipTable()
+    t.seed([0, 1], epoch=0)
+    assert t.state_of(1) == mship.ACTIVE
+    rec = t.admit(9, addr="n9", epoch=0)
+    assert rec.state == mship.JOINING and not rec.verified
+    assert 9 in t.unverified_sources()
+    assert t.verify_source(9)
+    assert t.state_of(9) == mship.ACTIVE
+    assert 9 not in t.unverified_sources()
+    assert t.start_drain(9) and t.is_draining(9)
+    assert not t.start_drain(9)  # already draining
+    assert t.complete_drain(9) and t.is_left(9)
+    assert not t.complete_drain(9)
+    # Zombie rejoiner: a LEFT seat re-admits as a FRESH generation.
+    rec2 = t.admit(9, addr="n9b", epoch=3)
+    assert rec2.generation == rec.generation + 1
+    assert rec2.state == mship.JOINING and rec2.epoch == 3
+    # Round-trip through the replication encoding.
+    t2 = MembershipTable()
+    t2.load(t.to_json())
+    assert t2.state_of(9) == mship.JOINING
+    assert t2.generation_of(9) == rec2.generation
+    assert t2.addr_of(9) == "n9b"
+
+
+def test_membership_messages_populated_roundtrip():
+    j = JoinMsg(9, addr="10.0.0.9:7777", want=[1, 2], node=9,
+                admitted=True, parent=3, parent_addr="10.0.0.3:7",
+                error="x", epoch=4)
+    assert JoinMsg.from_payload(j.to_payload()) == j
+    d = DrainMsg(2, node=5, done=True, error="", epoch=4)
+    assert DrainMsg.from_payload(d.to_payload()) == d
+
+
+def test_faults_join_leave_schedule():
+    seed, rules = rules_from_spec("seed=3,join=0.15,leave=0.3,corrupt=5")
+    kinds = sorted(r.kind for r in rules)
+    assert kinds == ["corrupt", "join", "leave"]
+    inner = InmemTransport("nA", addr_registry={})
+    other = InmemTransport("nB", addr_registry={})
+    ft = FaultyTransport(inner, rules, seed=seed)
+    assert ft.join_at == 0.15 and ft.leave_at == 0.3
+    assert 0 < ft.seconds_until_join() <= 0.15
+    # Dark before join: sends raise.
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        SimpleMsg,
+    )
+
+    with pytest.raises(ConnectionError):
+        ft.send(0, SimpleMsg("a", "b"))
+    time.sleep(0.2)
+    assert ft.seconds_until_join() == 0.0
+    ft.addr_registry["nB"] = "nB"
+    ft.send("nB", SimpleMsg("a", "b"))  # alive now
+    assert ft.stats["join"] >= 1
+    ft.close()
+    other.close()
+
+
+def test_detector_remove_bans_touch():
+    from distributed_llm_dissemination_tpu.runtime.failure import (
+        FailureDetector,
+    )
+
+    fired = []
+    det = FailureDetector(0.2, fired.append)
+    det.touch(7)
+    det.remove(7)
+    det.touch(7)  # a straggler heartbeat must NOT re-arm the lease
+    det.start()
+    time.sleep(0.5)
+    det.stop()
+    assert fired == []
+
+
+# --------------------------------------------------------------- join e2e
+
+
+def _base_cluster(kind, lids, ids=(0, 1, 2), ft=0.0):
+    ts, registry = make_transports(kind, list(ids))
+    assignment = {i: {l: LayerMeta() for l in lids} for i in ids[1:]}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {l: mem_layer(l, SIZE) for l in lids},
+        assignment, {i: 10 ** 9 for i in ids},
+        expected_nodes=set(ids[1:]), failure_timeout=ft)
+    recvs = {i: FlowRetransmitReceiverNode(Node(i, 0, ts[i]), {},
+                                           heartbeat_interval=HB)
+             for i in ids[1:]}
+    return leader, recvs, ts, registry, assignment
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_join_receives_goal_from_peer_holders(kind):
+    """An unconfigured node joins a RUNNING cluster: admitted as a
+    dest, covered byte-exactly — and because peers already hold every
+    layer, the ORIGIN seeder ships zero refill bytes (the join avoid
+    policy; docs/membership.md)."""
+    lids = [0, 1]
+    leader, recvs, ts, registry, _ = _base_cluster(kind, lids)
+    tj = None
+    joiner = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        tj = _joiner_transport(kind, 9, registry[0])
+        joiner = FlowRetransmitReceiverNode(Node(9, 0, tj), {},
+                                            heartbeat_interval=HB)
+        assert joiner.join(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)  # the join job completes
+        for l in lids:
+            assert bytes(joiner.layers[l].inmem_data) == layer_bytes(
+                l, SIZE), l
+        # Admitted, announced, verified (no digest conflicts) → ACTIVE.
+        assert leader.membership.state_of(9) == mship.ACTIVE
+        assert 9 not in leader.membership.unverified_sources()
+        # Refill came from the PEERS, not the origin seeder.
+        tx = _tx_bytes_to(9)
+        assert tx.get(0, 0) == 0, tx
+        assert sum(tx.values()) >= len(lids) * SIZE, tx
+        totals = trace.counter_totals()
+        assert totals.get("membership.joins", 0) == 1
+        assert totals.get("membership.joined", 0) == 1
+    finally:
+        if joiner is not None:
+            joiner.close()
+        if tj is not None:
+            tj.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+def test_joiner_with_conflicting_digest_stays_quarantined():
+    """A joiner announcing bytes whose digest CONFLICTS with the
+    stamped one is a dest, never a source: its row is excluded from the
+    flow graph's senders and its digests never reach the content
+    index."""
+    lids = [0]
+    leader, recvs, ts, registry, _ = _base_cluster("inmem", lids)
+    tj = None
+    joiner = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        tj = _joiner_transport("inmem", 9, registry[0])
+        # The joiner holds CORRUPT bytes under the goal's layer id 0.
+        bad = mem_layer(0, SIZE)
+        bad.inmem_data[0] ^= 0xFF
+        joiner = FlowRetransmitReceiverNode(Node(9, 0, tj),
+                                            {0: bad},
+                                            heartbeat_interval=HB)
+        assert joiner.join(timeout=TIMEOUT)
+        _wait_for(lambda: 9 in leader.status, what="joiner announce")
+        assert 9 in leader.membership.unverified_sources()
+        assert leader.membership.state_of(9) == mship.JOINING
+        totals = trace.counter_totals()
+        assert totals.get("membership.join_verify_failed", 0) >= 1
+        # Its corrupt holding vouches for nothing.
+        assert not leader.content.node_has(
+            9, leader.layer_digests.get(0, ""))
+    finally:
+        if joiner is not None:
+            joiner.close()
+        if tj is not None:
+            tj.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+def test_cold_boot_joiner_refills_only_missing_bytes():
+    """Cold boot (docs/membership.md): the joiner already holds layer
+    0's bytes — under ANOTHER id, resolved via the content index — so
+    only layer 1 ever crosses the wire to it."""
+    lids = [0, 1]
+    leader, recvs, ts, registry, _ = _base_cluster("inmem", lids)
+    tj = None
+    joiner = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        tj = _joiner_transport("inmem", 9, registry[0])
+        # Same BYTES as layer 0, held under local id 100.
+        local = mem_layer(0, SIZE)
+        joiner = FlowRetransmitReceiverNode(Node(9, 0, tj),
+                                            {100: local},
+                                            heartbeat_interval=HB)
+        assert joiner.join(want=lids, timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        for l in lids:
+            assert bytes(joiner.layers[l].inmem_data) == layer_bytes(
+                l, SIZE), l
+        tx = _tx_bytes_to(9)
+        assert sum(tx.values()) == SIZE, tx  # layer 1 only
+        totals = trace.counter_totals()
+        assert totals.get("store.resolved_pairs",
+                          totals.get("store.leader_skipped", 0)) >= 1
+    finally:
+        if joiner is not None:
+            joiner.close()
+        if tj is not None:
+            tj.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+# -------------------------------------------------------------- drain e2e
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_drain_under_load_rehomes_unique_holdings(kind):
+    """Drain node 1 while the base goal is still delivering: its UNIQUE
+    layer (5, held nowhere else) is re-planned onto a survivor BEFORE
+    it leaves — zero crash-path salvage, zero lost pairs — and its
+    post-leave silence never fires crash()."""
+    lids = [0, 1]
+    ids = (0, 1, 2)
+    ts, registry = make_transports(kind, list(ids))
+    assignment = {1: {0: LayerMeta()},
+                  2: {l: LayerMeta() for l in lids}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {l: mem_layer(l, SIZE) for l in lids},
+        assignment, {i: 10 ** 9 for i in ids},
+        expected_nodes={1, 2}, failure_timeout=1.0)
+    r1 = FlowRetransmitReceiverNode(Node(1, 0, ts[1]),
+                                    {5: mem_layer(5, SIZE)},
+                                    heartbeat_interval=HB)
+    r2 = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {},
+                                    heartbeat_interval=HB)
+    try:
+        r1.announce()
+        r2.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        # Drain MID-LOAD: the base transfers may still be in flight.
+        assert r1.request_drain(timeout=TIMEOUT)
+        # The unique layer 5 was re-homed onto a survivor first.
+        holders = [n for n in (0, 2)
+                   if 5 in leader.status.get(n, {})]
+        assert holders, leader.status
+        if 2 in holders:
+            assert bytes(r2.layers[5].inmem_data) == layer_bytes(5, SIZE)
+        else:
+            assert bytes(leader.layers[5].inmem_data) == layer_bytes(
+                5, SIZE)
+        # Atomic prune: out of status, the goal, and announce gating.
+        assert 1 not in leader.status
+        assert 1 not in leader.assignment
+        assert 1 not in leader.expected_nodes
+        assert leader.membership.is_left(1)
+        # The remaining goal still completes (zero lost pairs).
+        leader.ready().get(timeout=TIMEOUT)
+        for l in lids:
+            assert bytes(r2.layers[l].inmem_data) == layer_bytes(l, SIZE)
+        totals = trace.counter_totals()
+        assert totals.get("membership.drained", 0) == 1
+        assert totals.get("failover.range_salvage", 0) == 0
+        # Silence after the clean leave is NOT a crash: no dropped
+        # assignment parked, no crashed boot-kind recorded.
+        time.sleep(1.6)  # > failure_timeout
+        assert 1 not in leader._dropped_assignment
+        assert leader._boot_kinds.get(1) != "crashed"
+    finally:
+        close_all(leader, [r1, r2], ts)
+
+
+def test_drain_refusals_are_answered():
+    """Unknown member and the leader seat itself: refused, loudly,
+    with an error — never silence."""
+    leader, recvs, ts, registry, _ = _base_cluster("inmem", [0])
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        replies = []
+        # Use receiver 1's seat to request a bogus drain; re-register
+        # its DrainMsg handler (register REPLACES) to capture answers.
+        r1 = recvs[1]
+        orig = r1.handle_drain
+        r1.loop.register(DrainMsg,
+                         lambda m: (replies.append(m), orig(m)))
+        ts[1].send(0, DrainMsg(1, node=77))
+        _wait_for(lambda: replies, what="refusal answer")
+        assert replies[0].error and not replies[0].done
+        replies.clear()
+        ts[1].send(0, DrainMsg(1, node=0))
+        _wait_for(lambda: replies, what="leader-seat refusal")
+        assert "leader" in replies[0].error
+    finally:
+        close_all(leader, list(recvs.values()), ts)
+
+
+def test_zombie_rejoiner_is_fenced_until_fresh_join():
+    """A drained node's straggler announce/ack must NOT resurrect it;
+    a fresh JoinMsg re-admits it at a new generation."""
+    leader, recvs, ts, registry, _ = _base_cluster("inmem", [0])
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        r1 = recvs[1]
+        assert r1.request_drain(timeout=TIMEOUT)
+        assert leader.membership.is_left(1)
+        # Straggler announce: fenced, no status row reappears.
+        r1.announce()
+        time.sleep(0.3)
+        assert 1 not in leader.status
+        totals = trace.counter_totals()
+        assert totals.get("membership.zombie_fenced", 0) >= 1
+        # A fresh JOIN re-admits the seat (new generation).  Its kept
+        # bytes satisfy the refill at admission — nothing re-ships, so
+        # ready() never re-arms; the roster and status row are the
+        # proof of readmission.
+        gen_before = leader.membership.generation_of(1)
+        assert r1.join(timeout=TIMEOUT)
+        _wait_for(lambda: 1 in leader.status, what="rejoin announce")
+        assert not leader.membership.is_left(1)
+        assert leader.membership.generation_of(1) == gen_before + 1
+        assert bytes(r1.layers[0].inmem_data) == layer_bytes(0, SIZE)
+    finally:
+        close_all(leader, list(recvs.values()), ts)
+
+
+# --------------------------------------------------------- churn chaos
+
+
+CHURN_SPEC = "seed=11,corrupt=5,dropin=7,times=4"
+
+
+@pytest.mark.timeout(90)
+def test_churn_chaos_smoke(chaos_seed):
+    """Tier-1 seeded churn storm: two joiners arrive through transports
+    injecting corrupt + dropped inbound layer frames while a configured
+    member drains mid-run.  Every live seat must end byte-exact, with
+    zero crash-path salvage and the chaos provably firing."""
+    chaos_seed(CHURN_SPEC)
+    lids = [0, 1]
+    leader, recvs, ts, registry, _ = _base_cluster("inmem", lids,
+                                                   ft=2.0)
+    joiners = {}
+    jts = {}
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        # Two joiners behind faulty transports; member 1 leaves.
+        threads = []
+        for k, jid in enumerate((7, 8)):
+            seed, rules = rules_from_spec(CHURN_SPEC)
+            inner = InmemTransport(f"n{jid}",
+                                   addr_registry={0: registry[0]})
+            jts[jid] = FaultyTransport(inner, rules, seed=seed + k)
+            joiners[jid] = FlowRetransmitReceiverNode(
+                Node(jid, 0, jts[jid]), {}, heartbeat_interval=HB)
+            threads.append(threading.Thread(
+                target=joiners[jid].join, kwargs={"timeout": TIMEOUT},
+                daemon=True))
+        drained = []
+        threads.append(threading.Thread(
+            target=lambda: drained.append(
+                recvs[1].request_drain(timeout=TIMEOUT)),
+            daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(TIMEOUT)
+
+        def covered():
+            return all(
+                lid in j.layers
+                and bytes(j.layers[lid].inmem_data) == layer_bytes(
+                    lid, SIZE)
+                for j in joiners.values() for lid in lids)
+
+        _wait_for(covered, timeout=30.0, what="joiners byte-exact")
+        assert drained == [True]
+        assert leader.membership.is_left(1)
+        for jid in joiners:
+            assert leader.membership.state_of(jid) in (
+                mship.ACTIVE, mship.JOINING)
+        totals = trace.counter_totals()
+        assert totals.get("failover.range_salvage", 0) == 0
+        fired = sum(t.stats["corrupt"] + t.stats["drop"]
+                    for t in jts.values())
+        assert fired > 0, "churn chaos fired no faults; vacuous"
+    finally:
+        for j in joiners.values():
+            j.close()
+        for t in jts.values():
+            t.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+@pytest.mark.timeout(120)
+def test_leader_kill_during_churn_promoted_resumes_membership():
+    """Kill the leader while a joiner's refill is in flight: the
+    promoted standby adopts the MEMBERSHIP table from its shadow
+    (joiner present + dialable) and resumes admission at the bumped
+    epoch — the joiner reaches full coverage byte-exactly."""
+    size = SIZE
+    ids = [0, 1, 2]
+    raw, registry = make_transports("inmem", ids)
+    ts = dict(raw)
+    # Wedge the dead-to-be leader's outbound LAYER frames so the kill
+    # provably strikes before it can deliver (the HA rigs' trick).
+    ts[0] = FaultyTransport(
+        raw[0], [FaultRule("drop", "out", msg_type=MsgType.LAYER)],
+        seed=1)
+    mk_layers = lambda: {0: mem_layer(0, size)}  # noqa: E731
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), mk_layers(), {2: {0: LayerMeta()}},
+        {i: 10 ** 9 for i in ids + [9]}, expected_nodes={1, 2},
+        failure_timeout=2.0, standbys=[1], lease_interval=0.15, epoch=0)
+    standby = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), mk_layers(),
+                                         heartbeat_interval=HB)
+    ctl = StandbyController(standby, rank=0, lease_timeout=0.5,
+                            standbys=[1], mode=3,
+                            node_network_bw={i: 10 ** 9 for i in ids},
+                            failure_timeout=2.0, lease_interval=0.15)
+    r2 = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {},
+                                    heartbeat_interval=HB)
+    tj = InmemTransport("n9", addr_registry={0: registry[0]})
+    joiner = FlowRetransmitReceiverNode(Node(9, 0, tj),
+                                        {}, heartbeat_interval=HB)
+    try:
+        standby.announce()
+        r2.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        assert joiner.join(timeout=TIMEOUT)
+        _wait_for(lambda: "9" in ctl.shadow.membership,
+                  what="membership to replicate into the shadow")
+        time.sleep(0.3)
+        leader.close()
+        _wait_for(ctl.promoted.is_set, timeout=TIMEOUT,
+                  what="standby promotion")
+        new_leader = ctl.leader
+        assert new_leader.epoch == 1
+        assert new_leader.membership.state_of(9) in (
+            mship.ACTIVE, mship.JOINING)
+        new_leader.ready().get(timeout=30.0)
+        assert bytes(joiner.layers[0].inmem_data) == layer_bytes(
+            0, size)
+        assert bytes(r2.layers[0].inmem_data) == layer_bytes(0, size)
+    finally:
+        ctl.close()
+        leader.close()
+        joiner.close()
+        tj.close()
+        for r in (standby, r2):
+            r.close()
+        for t in ts.values():
+            t.close()
+
+
+# ----------------------------------------------------------- hierarchy
+
+
+def _hier_rig(n_groups=2, group_size=2, lids=(0,), ft=0.0):
+    ids = [0] + list(range(1, 1 + n_groups * group_size))
+    ts, registry = make_transports("inmem", ids)
+    groups = partition_groups(ids[1:], group_size=group_size)
+    assignment = {i: {lid: LayerMeta() for lid in lids}
+                  for i in ids[1:]}
+    layers = {lid: mem_layer(lid, SIZE) for lid in lids}
+    subs = {rec["leader"] for rec in groups.values()}
+    leader = HierarchicalFlowLeaderNode(
+        Node(0, 0, ts[0]), layers, assignment,
+        {i: 10 ** 9 for i in ids}, groups=groups,
+        expected_nodes=subs, failure_timeout=ft)
+    recvs, ctls = {}, []
+    for gid, rec in sorted(groups.items()):
+        sub = rec["leader"]
+        r = FlowRetransmitReceiverNode(Node(sub, 0, ts[sub]), {},
+                                       heartbeat_interval=HB)
+        ctls.append(SubLeaderController(r, gid, rec["members"],
+                                        member_timeout=ft))
+        recvs[sub] = r
+        for m in rec["members"]:
+            if m != sub:
+                recvs[m] = FlowRetransmitReceiverNode(
+                    Node(m, sub, ts[m]), {}, heartbeat_interval=HB)
+    return leader, recvs, ctls, ts, registry, groups
+
+
+def test_joiner_absorbed_into_group():
+    """A grouped cluster places the joiner via the partition sizing:
+    its control parent becomes a SUB-LEADER, the sub-leader fans its
+    layers out, and the root's roster replicates the group change."""
+    leader, recvs, ctls, ts, registry, groups = _hier_rig()
+    tj = None
+    joiner = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        tj = InmemTransport("n9", addr_registry={0: registry[0]})
+        joiner = FlowRetransmitReceiverNode(Node(9, 0, tj), {},
+                                            heartbeat_interval=HB)
+        assert joiner.join(timeout=TIMEOUT)
+        # Re-pointed under a sub-leader (least-loaded group = gid 0).
+        assert joiner.node.leader_id in {rec["leader"]
+                                         for rec in groups.values()}
+        _wait_for(lambda: 0 in joiner.layers and bytes(
+            joiner.layers[0].inmem_data) == layer_bytes(0, SIZE),
+            what="joiner covered via sub-leader fan-out")
+        gid = leader._member_group.get(9)
+        assert gid is not None
+        assert 9 in leader.groups[gid]["members"]
+        assert trace.counter_totals().get("hier.joiners_grouped",
+                                          0) == 1
+    finally:
+        if joiner is not None:
+            joiner.close()
+        if tj is not None:
+            tj.close()
+        for c in ctls:
+            c.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+@pytest.mark.timeout(90)
+def test_dissolved_group_reforms_on_subleader_readmission():
+    """The named PR 11 follow-up: kill a sub-leader (group dissolves to
+    flat), then re-admit its seat — the group RE-FORMS: members are
+    re-pointed back under the sub-leader and fan-out resumes."""
+    leader, recvs, ctls, ts, registry, groups = _hier_rig(ft=0.8)
+    sub_id = groups[0]["leader"]   # 1
+    member = [m for m in groups[0]["members"] if m != sub_id][0]  # 2
+    new_sub = None
+    new_ctl = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        # Kill sub-leader 1: heartbeats stop, the group dissolves.
+        for c in ctls:
+            if c.group_id == 0:
+                c.close()
+        recvs[sub_id].close()
+        ts[sub_id].close()
+        _wait_for(lambda: trace.counter_totals().get(
+            "hier.groups_dissolved", 0) == 1, timeout=20.0,
+            what="group dissolve")
+        _wait_for(lambda: recvs[member].node.leader_id == 0,
+                  what="member re-pointed flat")
+        # Re-admit the sub-leader seat: fresh transport + receiver +
+        # controller under the SAME id/addr (a restarted process).
+        ts[sub_id] = InmemTransport(f"n{sub_id}",
+                                    addr_registry=registry)
+        new_sub = FlowRetransmitReceiverNode(
+            Node(sub_id, 0, ts[sub_id]), {}, heartbeat_interval=HB)
+        new_ctl = SubLeaderController(new_sub, 0, groups[0]["members"],
+                                      member_timeout=0.8)
+        new_sub.announce()
+        _wait_for(lambda: trace.counter_totals().get(
+            "hier.groups_reformed", 0) == 1, timeout=20.0,
+            what="group re-form")
+        _wait_for(lambda: recvs[member].node.leader_id == sub_id,
+                  what="member re-pointed under the sub-leader")
+        assert leader._member_group.get(member) == 0
+        assert 0 not in leader._dissolved
+    finally:
+        if new_ctl is not None:
+            new_ctl.close()
+        if new_sub is not None:
+            new_sub.close()
+        for c in ctls:
+            c.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+# ------------------------------------------------------------- slow soak
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_churn_soak_join_leave_storm(kind, chaos_seed):
+    """Rounds of join → verify → drain churn under seeded corrupt/drop
+    faults, both backends: the roster stays consistent, every joiner
+    covers byte-exactly, every drain re-homes, and nothing ever takes
+    the crash path."""
+    spec = "seed=23,corrupt=6,dropin=9,times=3"
+    chaos_seed(spec)
+    lids = [0, 1]
+    leader, recvs, ts, registry, _ = _base_cluster(kind, lids, ft=3.0)
+    live = {}
+    extra_ts = {}
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        for round_no in range(3):
+            jid = 20 + round_no
+            seed, rules = rules_from_spec(spec)
+            inner = _joiner_transport(kind, jid, registry[0])
+            ftj = FaultyTransport(inner, rules, seed=seed + round_no)
+            extra_ts[jid] = ftj
+            j = FlowRetransmitReceiverNode(Node(jid, 0, ftj), {},
+                                           heartbeat_interval=HB)
+            live[jid] = j
+            assert j.join(timeout=30.0), f"round {round_no} join"
+            leader.ready().get(timeout=60.0)
+            for lid in lids:
+                assert bytes(j.layers[lid].inmem_data) == layer_bytes(
+                    lid, SIZE), (round_no, lid)
+            if round_no:
+                # The PREVIOUS joiner drains away each round.
+                prev = live.pop(20 + round_no - 1)
+                assert prev.request_drain(timeout=30.0)
+                prev.close()
+                assert leader.membership.is_left(20 + round_no - 1)
+        totals = trace.counter_totals()
+        assert totals.get("failover.range_salvage", 0) == 0
+        assert totals.get("membership.drained", 0) == 2
+        assert totals.get("membership.joins", 0) == 3
+    finally:
+        for j in live.values():
+            j.close()
+        for t in extra_ts.values():
+            t.close()
+        close_all(leader, list(recvs.values()), ts)

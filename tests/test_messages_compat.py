@@ -31,6 +31,7 @@ from distributed_llm_dissemination_tpu.transport.messages import (
     ClientReqMsg,
     ControlDeltaMsg,
     DevicePlanMsg,
+    DrainMsg,
     FlowRetransmitMsg,
     GenerateReqMsg,
     GenerateRespMsg,
@@ -40,6 +41,7 @@ from distributed_llm_dissemination_tpu.transport.messages import (
     JobRevokeMsg,
     JobStatusMsg,
     JobSubmitMsg,
+    JoinMsg,
     LayerDigestsMsg,
     LayerHeader,
     LayerNackMsg,
@@ -111,6 +113,8 @@ CASES = {
         lambda: GroupPlanMsg(1, 2), {"SrcID"}),
     MsgType.GROUP_STATUS: (
         lambda: GroupStatusMsg(1, 2), {"SrcID"}),
+    MsgType.JOIN: (lambda: JoinMsg(9), {"SrcID"}),
+    MsgType.DRAIN: (lambda: DrainMsg(9), {"SrcID"}),
 }
 
 # Optional wire keys that must be OMITTED at their defaults, per type:
@@ -139,6 +143,9 @@ OMITTED_AT_DEFAULT = {
     MsgType.JOB_REVOKE: {"Epoch", "Pairs"},
     MsgType.GROUP_PLAN: {"Epoch", "Targets", "Dissolve"},
     MsgType.GROUP_STATUS: {"Covered", "Announced", "Dead", "Metrics"},
+    MsgType.JOIN: {"Addr", "Want", "Node", "Admitted", "Parent",
+                   "ParentAddr", "Error", "Epoch"},
+    MsgType.DRAIN: {"Node", "Done", "Error", "Epoch"},
 }
 
 

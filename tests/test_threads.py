@@ -128,8 +128,9 @@ def test_census_buckets_by_name():
 # ride utils/threads.py's pools (data plane) or be added here with a
 # stable thread name (control plane) so the census stays meaningful.
 THREAD_SPAWN_ALLOWLIST = {
-    "cli/main.py": 2,            # telemetry-watch, lp-warm
-    "cli/ttd_matrix.py": 3,      # harness loopback probes + req hammer
+    "cli/main.py": 3,            # telemetry-watch, lp-warm, churn-leave
+    "cli/ttd_matrix.py": 4,      # harness loopback probes + req hammer
+    #                              + elasticity concurrent joiners
     "parallel/fabric.py": 1,     # plan-window
     "parallel/spmd_fabric.py": 1,  # spmd-fabric
     "runtime/failover.py": 1,    # replicate-<standby>
