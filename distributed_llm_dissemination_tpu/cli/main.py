@@ -167,6 +167,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "re-homed onto survivors before it is released "
                         "— print the answer, exit.  Run from an idle "
                         "seat like -submit/-jobs")
+    # SLO-guarded rollout pipeline (docs/rollout.md): submit a rollout
+    # via -submit (Kind "rollout" + Waves/SLO/Split in the spec); these
+    # are the operator control verbs.
+    p.add_argument("-rollouts", action="store_true",
+                   help="query the running leader's rollout-pipeline "
+                        "table (wave states, SLO verdicts, traffic "
+                        "split, v1/v2 pools) as JSON and exit; same "
+                        "seat rules as -jobs")
+    p.add_argument("-rollout-pause", type=str, default="", metavar="ID",
+                   help="pause rollout ID: no further waves commit "
+                        "(in-flight dissemination and soaks finish)")
+    p.add_argument("-rollout-resume", type=str, default="",
+                   metavar="ID",
+                   help="resume paused rollout ID: a rolled-back wave "
+                        "is re-disseminated as a retry")
+    p.add_argument("-rollout-split", type=str, default="",
+                   metavar="ID:FRACTION",
+                   help="set rollout ID's traffic-split knob (the "
+                        "fraction of eligible traffic routed at v2 "
+                        "replicas during soak), e.g. canary-v2:0.25")
     return p
 
 
@@ -278,6 +298,17 @@ def _parse_job_spec(raw: str) -> dict:
     except (TypeError, ValueError) as e:
         raise SystemExit(
             f"-submit spec has non-integer node/layer keys: {e}")
+    try:
+        # Rollout pipeline (docs/rollout.md): the wave plan + SLO +
+        # split ride a Kind "rollout" spec through the same submit.
+        spec["Waves"] = [[int(n) for n in w]
+                         for w in spec.get("Waves") or []]
+        spec["SLO"] = dict(spec.get("SLO") or {})
+        # -1 = unset (driver default); an explicit 0.0 is honored.
+        spec["Split"] = float(spec.get("Split", -1.0))
+    except (TypeError, ValueError) as e:
+        raise SystemExit(
+            f"-submit spec has a malformed Waves/SLO/Split field: {e}")
     return spec
 
 
@@ -340,7 +371,9 @@ def run_jobtool(args, conf: cfg.Config) -> int:
                 # Admission control (docs/service.md): a token-armed
                 # leader daemon rejects unauthenticated submits; the
                 # operator exports the same secret on both sides.
-                auth=os.environ.get("DLD_JOB_TOKEN", ""))
+                auth=os.environ.get("DLD_JOB_TOKEN", ""),
+                waves=spec["Waves"], slo=spec["SLO"],
+                split=spec["Split"])
         return JobStatusMsg(args.id, query=True)
 
     resp = _oneshot_leader_rpc(
@@ -350,6 +383,57 @@ def run_jobtool(args, conf: cfg.Config) -> int:
     if resp is None:
         return 1
     out = {"leader_epoch": resp.epoch, "jobs": resp.jobs}
+    if resp.error:
+        out["error"] = resp.error
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 1 if resp.error else 0
+
+
+def run_rollouttool(args, conf: cfg.Config) -> int:
+    """The rollout-pipeline operator verbs (docs/rollout.md): query /
+    pause / resume / set-split against the running leader, print its
+    RolloutCtlMsg reply (the full rollout table) as JSON, exit."""
+    import json
+
+    from ..transport.messages import RolloutCtlMsg
+
+    # One mutating verb per invocation: the leader's verb chain
+    # executes exactly one, so combined flags would silently drop (or
+    # worse, mis-target) the rest — refuse up front.
+    if sum(map(bool, (args.rollout_pause, args.rollout_resume,
+                      args.rollout_split))) > 1:
+        raise SystemExit("pick ONE of -rollout-pause / -rollout-resume"
+                         " / -rollout-split per invocation")
+    rid, split = "", -1.0
+    if args.rollout_split:
+        rid, _, frac = args.rollout_split.rpartition(":")
+        if not rid:
+            raise SystemExit("-rollout-split wants ID:FRACTION")
+        try:
+            split = float(frac)
+        except ValueError:
+            raise SystemExit(f"-rollout-split fraction is not a "
+                             f"number: {frac!r}")
+    elif args.rollout_pause:
+        rid = args.rollout_pause
+    elif args.rollout_resume:
+        rid = args.rollout_resume
+
+    resp = _oneshot_leader_rpc(
+        args, conf, RolloutCtlMsg,
+        lambda leader_id: RolloutCtlMsg(
+            args.id, rollout_id=rid, query=args.rollouts,
+            pause=bool(args.rollout_pause),
+            resume=bool(args.rollout_resume), split=split,
+            # Mutating verbs ride the job-token admission gate
+            # (docs/service.md): the operator exports the same secret.
+            auth=os.environ.get("DLD_JOB_TOKEN", "")),
+        timeout=30.0,
+        timeout_error="no rollout answer from the leader (is it "
+                      "running?)")
+    if resp is None:
+        return 1
+    out = {"leader_epoch": resp.epoch, "rollouts": resp.table}
     if resp.error:
         out["error"] = resp.error
     print(json.dumps(out, indent=1, sort_keys=True))
@@ -728,6 +812,15 @@ def run_receiver(args, conf: cfg.Config, node: Node, layers) -> int:
         receiver = FlowRetransmitReceiverNode(node, layers, args.s or ".",
                                               checkpoint_dir=args.ckpt,
                                               **common)
+    # Announce-carried NIC rate (docs/membership.md): this seat's own
+    # configured rate rides its announce, so a leader admitting it as a
+    # JOINER models the real link instead of pinning the most
+    # conservative configured value.
+    try:
+        receiver.nic_bw = int(cfg.get_node_conf(conf, args.id).network_bw
+                              or 0)
+    except (AttributeError, ValueError, KeyError):
+        pass
 
     groups = resolve_groups(conf, args.m)
     sub_ctl = None
@@ -889,6 +982,11 @@ def main(argv=None) -> int:
         # One-shot service tools: no fabrication, no role loop — talk
         # to the running leader daemon and exit (docs/service.md).
         return run_jobtool(args, conf)
+
+    if (args.rollouts or args.rollout_pause or args.rollout_resume
+            or args.rollout_split):
+        # One-shot rollout-pipeline tools (docs/rollout.md).
+        return run_rollouttool(args, conf)
 
     if args.drain >= 0:
         # One-shot membership tool (docs/membership.md): ask the leader

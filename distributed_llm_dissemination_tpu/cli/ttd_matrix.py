@@ -2149,6 +2149,235 @@ def run_live_swap(warm_s: float = 1.5, after_s: float = 1.5,
         _service_teardown(leader, [dest], ts)
 
 
+def run_rollout(soak_s: float = 2.5, p99_ms: float = 2000.0,
+                bad_delay_ms: float = 1500.0,
+                timeout: float = 300.0) -> dict:
+    """SLO-guarded rollout pipeline under live traffic (docs/rollout.md,
+    the ROADMAP item-3 acceptance row): a continuous request stream
+    drives three tiny-model replicas while a ``kind="rollout"`` job
+    ships v2 through three canary waves.  Wave 1 is the INJECTED BAD
+    WAVE — its replica's answers ride a seeded ``slowserve`` transport
+    delay, so its soak p99 breaches the declared SLO: the pipeline must
+    auto-PAUSE and roll that wave back to v1 through the revert-abort
+    while wave 0 KEEPS serving v2 and wave 2 stays staged-but-held.
+    The bars: zero dropped requests fleet-wide, the breach verdict
+    recorded with per-replica p99, earlier wave still on v2 after the
+    rollback.  In-process inmem (the dual-backend wire path is
+    tier-1-tested in tests/test_rollout.py); RUN_REPORT provenance
+    recorded."""
+    import threading
+
+    import jax
+
+    from ..core.types import (
+        LayerLocation,
+        LayerMeta,
+        LayerSrc,
+        SourceType,
+    )
+    from ..models import serde
+    from ..models.llama import CONFIGS, init_params
+    from ..runtime import (
+        FlowRetransmitLeaderNode,
+        FlowRetransmitReceiverNode,
+        Node,
+    )
+    from ..runtime.client import GenRequester
+    from ..transport import InmemTransport
+    from ..transport.faults import FaultyTransport, rules_from_spec
+    from ..utils import telemetry, trace
+    from ..utils.provenance import harness_hash
+    from . import report as report_mod
+
+    telemetry.reset_run()
+    prior_metrics = os.environ.get("DLD_METRICS_INTERVAL_S")
+    os.environ["DLD_METRICS_INTERVAL_S"] = "0.25"
+    cfg = CONFIGS["tiny"]
+    swap_base = 1000
+    v1 = serde.blobs_from_params(cfg, init_params(cfg, jax.random.key(0)))
+    v2 = serde.blobs_from_params(cfg, init_params(cfg, jax.random.key(1)))
+
+    def blob_layer(data: bytes) -> LayerSrc:
+        return LayerSrc(inmem_data=bytearray(data), data_size=len(data),
+                        meta=LayerMeta(location=LayerLocation.INMEM,
+                                       source_type=SourceType.MEM))
+
+    replicas_ids = [1, 2, 3]
+    bad = 2  # wave 1's replica
+    ids = [0, *replicas_ids, 9]
+    ts = {i: InmemTransport(str(i)) for i in ids}
+    fault_spec = f"slowserve={bad_delay_ms:g}"
+    seed, rules = rules_from_spec(fault_spec)
+    ts[bad] = FaultyTransport(ts[bad], rules, seed=seed)
+    seed_layers = {b: blob_layer(v1[b]) for b in v1}
+    seed_layers.update({swap_base + b: blob_layer(v2[b]) for b in v2})
+    base = {r: {b: LayerMeta() for b in v1} for r in replicas_ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), seed_layers, base,
+        {i: 10 ** 9 for i in ids}, expected_nodes=set(replicas_ids))
+    replicas = {r: FlowRetransmitReceiverNode(Node(r, 0, ts[r]), {},
+                                              boot_cfg=cfg)
+                for r in replicas_ids}
+    requester = GenRequester(ts[9], my_id=9)
+    prompt, max_new = [3, 5, 7], 8
+    failures: list = []
+    served = {r: 0 for r in replicas_ids}
+    stop = threading.Event()
+
+    def hammer(replica):
+        while not stop.is_set():
+            try:
+                requester.request(replica, prompt, max_new,
+                                  timeout=timeout)
+                served[replica] += 1
+            except Exception as e:  # noqa: BLE001 — any failure counts
+                failures.append(repr(e))
+            time.sleep(0.03)
+
+    threads = [threading.Thread(target=hammer, args=(r,), daemon=True)
+               for r in replicas_ids]
+    try:
+        for r in replicas.values():
+            r.announce()
+        leader.ready().get(timeout=timeout)
+        leader.boot_ready().get(timeout=timeout)
+        for r in replicas_ids:  # warm the decode jits pre-rollout
+            requester.request(r, prompt, max_new, timeout=timeout)
+        for t in threads:
+            t.start()
+        t_roll = time.monotonic()
+        leader.submit_job(
+            "roll-v2",
+            {r: {swap_base + b: LayerMeta() for b in v2}
+             for r in replicas_ids},
+            priority=2, kind="rollout", version="v2",
+            swap_base=swap_base, waves=[[1], [2], [3]],
+            slo={"P99Ms": p99_ms, "MaxFailures": 5, "SoakS": soak_s},
+            split=0.5)
+        deadline = time.monotonic() + timeout
+
+        def row():
+            return leader.rollouts.summary("roll-v2")
+
+        while row().get("State") != "paused":
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"bad wave never breached: {row()}")
+            time.sleep(0.05)
+        pause_s = time.monotonic() - t_roll
+        # The rollback fence is in flight: wait for the replica revert.
+        while replicas[bad].serving_version != "":
+            if time.monotonic() > deadline:
+                raise TimeoutError("bad wave never reverted to v1")
+            time.sleep(0.05)
+        time.sleep(0.5)  # post-rollback serving window
+        stop.set()
+        for t in threads:
+            t.join(timeout=timeout)
+        final = row()
+        traffic = final["Traffic"]
+        counters = trace.counter_totals()
+        rep = report_mod.build_from_leader(leader)
+        # Post-rollback serving probes: wave 0 keeps v2, the rolled-
+        # back wave answers v1 again, wave 2 never flipped.
+        def toks(seed_):
+            from ..models.generate import generate
+            import jax.numpy as jnp
+
+            out = generate(init_params(cfg, jax.random.key(seed_)),
+                           jnp.asarray([prompt], jnp.int32), cfg,
+                           max_new=max_new)
+            return [int(t) for t in jax.device_get(out)[0]]
+
+        v1_tokens, v2_tokens = toks(0), toks(1)
+        probes = {r: requester.request(r, prompt, max_new,
+                                       timeout=timeout)
+                  for r in replicas_ids}
+        return {
+            "harness_hash": harness_hash(),
+            "backend": "inmem",
+            "mode": 3,
+            "model": "tiny",
+            "waves": final["Waves"],
+            "wave_states": final["WaveStates"],
+            "slo": final["SLO"],
+            "split": final["Split"],
+            "fault_spec": fault_spec,
+            "state": final["State"],
+            "paused_reason": final["PausedReason"],
+            "verdicts": final["Verdicts"],
+            "wall_to_breach_pause_s": round(pause_s, 3),
+            "request_failures": len(failures),
+            "zero_failures": not failures,
+            "requests_served": dict(served),
+            "traffic_after": traffic,
+            "wave0_keeps_v2": probes[1] == v2_tokens,
+            "bad_wave_back_on_v1": probes[bad] == v1_tokens,
+            "wave2_never_flipped": probes[3] == v1_tokens,
+            "serving_versions": {r: replicas[r].serving_version
+                                 for r in replicas_ids},
+            "slo_breaches": counters.get("rollout.slo_breach", 0),
+            "reverts": counters.get("swap.reverted", 0),
+            "waves_passed": counters.get("rollout.wave_passed", 0),
+            "run_report": rep.get("provenance"),
+        }
+    finally:
+        stop.set()
+        requester.close()
+        if prior_metrics is None:
+            os.environ.pop("DLD_METRICS_INTERVAL_S", None)
+        else:
+            os.environ["DLD_METRICS_INTERVAL_S"] = prior_metrics
+        _service_teardown(leader, list(replicas.values()), ts)
+
+
+def _rollout_md(lines, results) -> None:
+    ro = results.get("rollout")
+    if not ro:
+        return
+    bars = {
+        "zero dropped requests": ro["zero_failures"],
+        "bad wave auto-halted (SLO breach -> pause)":
+            ro["state"] == "paused" and ro["slo_breaches"] >= 1,
+        "bad wave rolled back to v1": ro["bad_wave_back_on_v1"],
+        "earlier wave keeps serving v2": ro["wave0_keeps_v2"],
+    }
+    lines += [
+        "## SLO-guarded rollout pipeline (docs/rollout.md)",
+        "",
+        f"A continuous request stream drives 3 tiny-model replicas "
+        f"({ro['backend']} backend, mode {ro['mode']}) through a "
+        f"3-wave `kind=\"rollout\"` pipeline (waves {ro['waves']}, "
+        f"SLO p99 <= {ro['slo']['p99_ms']:g}ms over "
+        f"{ro['slo']['soak_s']:g}s soaks, split {ro['split']}).  "
+        f"Wave 1's replica is the injected bad wave "
+        f"(`{ro['fault_spec']}`): its soak breached and the pipeline "
+        f"paused after {ro['wall_to_breach_pause_s']}s "
+        f"(`{ro['paused_reason']}`).",
+        "",
+        "| bar | met |",
+        "|---|---|",
+    ]
+    for name, met in bars.items():
+        lines.append(f"| {name} | {'MET' if met else 'NOT MET'} |")
+    lines += [
+        "",
+        f"Wave states `{ro['wave_states']}`; verdicts: "
+        + "; ".join(
+            f"wave {w}: {v['verdict']}"
+            + (f" (p99 {next(iter(v['replicas'].values()))['p99_ms']}"
+               "ms)" if v.get("replicas") else "")
+            for w, v in sorted(ro["verdicts"].items()))
+        + f".  {sum(ro['requests_served'].values())} requests served, "
+        f"{ro['request_failures']} failed.  Traffic pools after the "
+        f"rollback: v2={ro['traffic_after']['v2']} "
+        f"v1={ro['traffic_after']['v1']} at split "
+        f"{ro['traffic_after']['split']}.  Run report "
+        f"`{ro.get('run_report')}`.",
+        "",
+    ]
+
+
 def _swap_md(lines, results) -> None:
     sw = results.get("live_swap")
     if not sw:
@@ -3119,6 +3348,7 @@ def to_markdown(results: dict) -> str:
     _elasticity_md(lines, results)
     _sharded_md(lines, results)
     _swap_md(lines, results)
+    _rollout_md(lines, results)
     return "\n".join(lines)
 
 
@@ -3159,6 +3389,13 @@ def main(argv=None) -> int:
                    help="also measure the zero-downtime weight swap "
                         "row (tokens/s + p99 before/during/after a "
                         "mid-serve v1→v2 swap; docs/swap.md)")
+    p.add_argument("-rollout", action="store_true",
+                   help="also measure the SLO-guarded rollout pipeline "
+                        "(docs/rollout.md): a continuous request "
+                        "stream through a 3-wave rollout with an "
+                        "injected bad wave — auto-pause on the SLO "
+                        "breach, rollback to v1, earlier waves keep "
+                        "v2, zero dropped requests")
     p.add_argument("-sharded", action="store_true",
                    help="also measure sharded delivery "
                         "(docs/sharding.md): the multi-dest 64 MiB "
@@ -3329,6 +3566,10 @@ def main(argv=None) -> int:
         results["live_swap"] = run_live_swap()
     elif prior_doc and prior_doc.get("live_swap"):
         results["live_swap"] = prior_doc["live_swap"]
+    if args.rollout:
+        results["rollout"] = run_rollout()
+    elif prior_doc and prior_doc.get("rollout"):
+        results["rollout"] = prior_doc["rollout"]
     if args.elasticity:
         results["elasticity"] = run_elasticity()
     elif prior_doc and prior_doc.get("elasticity"):

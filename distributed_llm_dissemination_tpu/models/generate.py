@@ -167,6 +167,70 @@ def _decode_fn(cfg: ModelConfig, p: int, max_new: int, temperature: float):
     return decode
 
 
+@functools.lru_cache(maxsize=32)
+def _decode_step_fn(cfg: ModelConfig, temperature: float):
+    """ONE jitted decode step (vs ``_decode_fn``'s whole-generation
+    scan): forward the carried token at ``pos``, pick the next.  The
+    position is a traced scalar, so every step of a generation reuses
+    the same compiled program — the per-token flip path costs one
+    dispatch per token, not one compile."""
+
+    @jax.jit
+    def step(params, cache, token, pos, step_key):
+        logits, cache = _forward_with_cache(
+            params, token[:, None], pos[None], cache, cfg
+        )
+        return _pick(logits, step_key, temperature), cache
+
+    return step
+
+
+def generate_stepwise(
+    params_fn,
+    prompt: jax.Array,
+    cfg: ModelConfig,
+    max_new: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Token-at-a-time decoding that RE-READS the serving params before
+    every step — the per-token flip granularity of docs/rollout.md: an
+    in-flight generation finishes its current token on the params it
+    holds and picks up a freshly committed version on the NEXT decode
+    step, instead of pinning the flip behind the whole request.
+
+    ``params_fn() -> (params, version)`` is called once for the prefill
+    and once per decode step; the caller owns the per-step version
+    guard (the receiver's provider runs ``ensure_uniform_version`` on
+    the serving tree before returning it, so a step can never execute
+    on a mixed-version tree).  With a CONSTANT provider the emitted
+    tokens are exactly ``generate``'s — same kernels, same order, the
+    scan merely unrolled into per-step dispatches.  Note the KV cache
+    rows written before a mid-generation flip were computed under the
+    PREVIOUS version — the documented semantics of per-token pickup
+    (docs/rollout.md), not a bug: the alternative is serving the stale
+    version for the whole request."""
+    if max_new <= 0:
+        raise ValueError(f"max_new must be positive, got {max_new}")
+    if temperature > 0 and key is None:
+        raise ValueError("sampling needs a PRNG key")
+    b, p = prompt.shape
+    cache = init_cache(cfg, b, p + max_new)
+    params, _ = params_fn()
+    logits, cache = _prefill_fn(cfg, p)(params, prompt, cache)
+    keys = (jax.random.split(key, max_new) if key is not None
+            else jnp.zeros((max_new, 2), jnp.uint32))
+    token = _pick(logits, keys[0], temperature)
+    out = [token]
+    step = _decode_step_fn(cfg, float(temperature))
+    for i in range(1, max_new):
+        params, _ = params_fn()
+        token, cache = step(params, cache, token,
+                            jnp.asarray(p + i - 1, jnp.int32), keys[i])
+        out.append(token)
+    return jnp.stack(out, axis=1)
+
+
 def generate(
     params: Dict[str, Any],
     prompt: jax.Array,

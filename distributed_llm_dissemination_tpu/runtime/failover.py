@@ -167,6 +167,11 @@ class ShadowLeaderState:
         # so a promoted standby resumes (or re-fences) a half-finished
         # weight swap instead of stranding the fleet mid-rollout.
         self.swaps: dict = {}
+        # Rollout pipeline records (docs/rollout.md): rollout_id ->
+        # record (waves, per-wave states, SLO, verdicts, split) — a
+        # promoted standby resumes the pipeline MID-WAVE with the
+        # guard still armed.
+        self.rollouts: dict = {}
         # Wire-codec plane (docs/codec.md): the leader's per-(dest,
         # layer) codec choices and the cluster capability table, so a
         # promoted leader keeps planning the byte spaces in-flight
@@ -224,6 +229,8 @@ class ShadowLeaderState:
                              (d.get("Jobs") or {}).items()}
                 self.swaps = {str(v): dict(rec) for v, rec in
                               (d.get("Swaps") or {}).items()}
+                self.rollouts = {str(r): dict(rec) for r, rec in
+                                 (d.get("Rollouts") or {}).items()}
                 self.wire_codecs = self._codec_choices(d.get("WireCodecs"))
                 self.node_codecs = {
                     int(n): [str(c) for c in caps]
@@ -331,9 +338,14 @@ class ShadowLeaderState:
                     "counters": dict(d.get("Counters") or {}),
                     "gauges": dict(d.get("Gauges") or {}),
                     "links": dict(d.get("Links") or {}),
+                    "hists": dict(d.get("Hists") or {}),
                     "t_wall_ms": float(d.get("T", 0.0)),
                     "proc": str(d.get("Proc", "")),
                 }
+            elif k == "rollout":
+                # Rollout pipeline records (docs/rollout.md): the full
+                # current record per delta — REPLACE per rollout id.
+                self.rollouts[str(d["RolloutID"])] = dict(d)
             else:
                 log.warn("unknown control delta kind", kind=k)
 
@@ -356,6 +368,8 @@ class ShadowLeaderState:
                 "metrics": {n: dict(s) for n, s in self.metrics.items()},
                 "jobs": {j: dict(rec) for j, rec in self.jobs.items()},
                 "swaps": {v: dict(rec) for v, rec in self.swaps.items()},
+                "rollouts": {r: dict(rec)
+                             for r, rec in self.rollouts.items()},
                 "base_assignment": (
                     {n: dict(r) for n, r in self.base_assignment.items()}
                     if self.base_assignment is not None else None),

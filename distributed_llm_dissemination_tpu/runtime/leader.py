@@ -84,6 +84,7 @@ from ..transport.messages import (
     MetricsReportMsg,
     PlanResendReqMsg,
     RetransmitMsg,
+    RolloutCtlMsg,
     ServeMsg,
     SourceDeadMsg,
     StartupMsg,
@@ -102,6 +103,7 @@ from .failure import FailureDetector
 from .membership import MembershipTable
 from . import membership as mship
 from .node import MessageLoop, Node
+from .rollout import RolloutDriver
 from .store import ContentIndex
 from .send import (
     NackRetransmitter,
@@ -200,6 +202,17 @@ class LeaderNode:
         # leader resumes a half-finished rollout.
         self._swaps: Dict[str, dict] = {}
         self._swaps_by_job: Dict[str, str] = {}
+        # Rollout pipeline (docs/rollout.md): wave versions whose swap
+        # commit is HELD for the pipeline (version -> rollout id) — the
+        # driver writes the marker before submitting each wave job —
+        # and the pipeline state machine itself.
+        self._swap_holds: Dict[str, str] = {}
+        self.rollouts = RolloutDriver(self)
+        # Joiner seats whose NIC rate was PINNED to the most
+        # conservative configured value at admission: their first
+        # announce carrying a real rate supersedes it
+        # (docs/membership.md).
+        self._joiner_bw_pinned: Set[NodeID] = set()
         # Admission control (docs/service.md): the shared-secret job
         # token.  Read at construction like the other env knobs; empty
         # = open admission (the legacy behavior).
@@ -532,6 +545,7 @@ class LeaderNode:
         reg(SwapCommitMsg, self.handle_swap_commit)
         reg(JoinMsg, self.handle_join)
         reg(DrainMsg, self.handle_drain)
+        reg(RolloutCtlMsg, self.handle_rollout_ctl)
 
     # --------------------------------------------------- control-plane HA
 
@@ -640,6 +654,10 @@ class LeaderNode:
                 # standby resumes a half-finished rollout's fence.
                 "Swaps": {v: self._swap_record_locked(v)
                           for v in sorted(self._swaps)},
+                # Rollout pipeline records (docs/rollout.md): a
+                # promoted standby resumes the pipeline MID-WAVE with
+                # the SLO guard still armed.
+                "Rollouts": self.rollouts.to_json(),
                 "Status": _nested_layer_map_to_json(self.status),
                 "Partial": _partial_to_json(self.partial_status),
                 "Dropped": _nested_layer_map_to_json(
@@ -737,7 +755,13 @@ class LeaderNode:
                                if int(d) != dead_leader],
                      "state": str(rec.get("State", "rolling")),
                      "confirmed": {int(d) for d in
-                                   rec.get("Confirmed") or []}}
+                                   rec.get("Confirmed") or []},
+                     # Rollout-pipeline wave bookkeeping
+                     # (docs/rollout.md), absent on plain swaps.
+                     "hold": bool(rec.get("Hold", False)),
+                     "staged": bool(rec.get("Staged", False)),
+                     "rollout": str(rec.get("Rollout", "")),
+                     "revert": bool(rec.get("Revert", False))}
                 self._swaps[r["version"]] = r
                 if r["job_id"]:
                     self._swaps_by_job[r["job_id"]] = r["version"]
@@ -799,6 +823,10 @@ class LeaderNode:
                 # rest of the run rides the host path.
                 self._fabric_disabled = True
             peers = [n for n in self.status if n != self.node.my_id]
+        # Rollout pipeline (docs/rollout.md): adopt the wave records so
+        # the promoted leader resumes the pipeline mid-wave (the SLO
+        # guard re-arms in resume_from_takeover).
+        self.rollouts.load(shadow.get("rollouts") or {})
         # Elastic membership (docs/membership.md): adopt the roster so
         # the promoted leader keeps departed members fenced, resumes
         # in-flight drains, and can dial adopted joiners (their
@@ -840,6 +868,7 @@ class LeaderNode:
                  dests=sorted(self.assignment),
                  partials=sorted(self.partial_status))
         self._resume_swaps()
+        self.rollouts.resume_all()
         self._resume_drains()
         self._resume_joins()
         with self._lock:
@@ -1263,13 +1292,15 @@ class LeaderNode:
             trace.count("telemetry.fenced_report")
             return
         snap = {"counters": msg.counters, "gauges": msg.gauges,
-                "links": msg.links, "t_wall_ms": msg.t_wall_ms,
+                "links": msg.links, "hists": msg.hists,
+                "t_wall_ms": msg.t_wall_ms,
                 "proc": msg.proc, "_recv_mono": time.monotonic()}
         with self._lock:
             self.cluster_metrics[msg.src_id] = snap
         self._replicate("metrics", Node=msg.src_id,
                         Counters=msg.counters, Gauges=msg.gauges,
-                        Links=msg.links, T=msg.t_wall_ms, Proc=msg.proc)
+                        Links=msg.links, Hists=msg.hists,
+                        T=msg.t_wall_ms, Proc=msg.proc)
 
     def await_metrics(self, newer_than: float = 0.0,
                       timeout: float = 5.0) -> bool:
@@ -1752,6 +1783,29 @@ class LeaderNode:
             "partial", Node=msg.src_id,
             Partial=({str(l): info for l, info in msg.partial.items()}
                      if msg.partial else None))
+        bw_map = getattr(self, "node_network_bw", None)
+        if (bw_map is not None and msg.nic_bw > 0
+                and (msg.src_id in self._joiner_bw_pinned
+                     # A roster-admitted seat (it joined — its addr
+                     # rides the replicated membership plane, so this
+                     # ALSO covers a promoted leader whose local pinned
+                     # set died with its predecessor) whose modeled
+                     # rate differs from its announced one.
+                     or (bool(self.membership.addr_of(msg.src_id))
+                         and bw_map.get(msg.src_id)
+                         != int(msg.nic_bw)))):
+            # Joiner NIC modeling (docs/membership.md): the admit-time
+            # value was the most conservative configured rate (the seat
+            # is in nobody's config); the joiner's own announce-carried
+            # rate supersedes it, so the solver models the real link
+            # instead of starving the refill behind a worst-case guess.
+            with self._lock:
+                pinned = bw_map.get(msg.src_id)
+                bw_map[msg.src_id] = int(msg.nic_bw)
+            self._joiner_bw_pinned.discard(msg.src_id)
+            trace.count("membership.joiner_bw_honored")
+            log.info("joiner's announce-carried NIC rate honored",
+                     node=msg.src_id, pinned=pinned, rate=msg.nic_bw)
         with self._lock:
             pending_want = self._join_pending.pop(msg.src_id, None)
         if pending_want is not None:
@@ -1903,7 +1957,8 @@ class LeaderNode:
                    digests: Optional[Dict[LayerID, str]] = None,
                    avoid: Optional[Set[NodeID]] = None,
                    version: str = "", swap_base: int = -1,
-                   submitter: str = "") -> dict:
+                   submitter: str = "", waves=None, slo=None,
+                   split: float = -1.0) -> dict:
         """Admit one dissemination job into the long-lived service plane
         (docs/service.md) — the multi-job generalization of ``update()``.
 
@@ -1921,7 +1976,17 @@ class LeaderNode:
         tags every target meta with the rollout version — only
         deliveries verified under that version complete its pairs —
         and registers the swap driver record; on the job's clean
-        completion the epoch-fenced commit fence flips every replica."""
+        completion the epoch-fenced commit fence flips every replica.
+
+        ``kind="rollout"`` (docs/rollout.md) does not admit a job at
+        all: it EXPANDS into the declared waves — each a chained
+        ``kind="swap"`` job over its replica subset with the commit
+        held for the pipeline — and returns the rollout summary."""
+        if kind == "rollout":
+            return self.rollouts.admit(
+                str(job_id), assignment, waves, str(version),
+                int(swap_base), priority=int(priority),
+                digests=digests, slo=slo, split=float(split))
         digests = dict(digests or {})
         if version:
             # Stamp the rollout version onto every target: the merged
@@ -2119,7 +2184,9 @@ class LeaderNode:
                                           avoid=msg.avoid,
                                           version=msg.version,
                                           swap_base=msg.swap_base,
-                                          submitter=self._submitter_id(msg))
+                                          submitter=self._submitter_id(msg),
+                                          waves=msg.waves, slo=msg.slo,
+                                          split=msg.split)
                 reply = JobStatusMsg(self.node.my_id,
                                      jobs={msg.job_id: summary},
                                      epoch=self.epoch)
@@ -2193,6 +2260,14 @@ class LeaderNode:
                 "dests": sorted(job.assignment),
                 "state": "rolling",
                 "confirmed": set(),
+                # Rollout-pipeline wave bookkeeping (docs/rollout.md):
+                # a HELD swap completes its rollout but does not
+                # auto-commit — the pipeline releases the flip when the
+                # previous wave's soak verdict passes.
+                "hold": job.version in self._swap_holds,
+                "staged": False,
+                "rollout": self._swap_holds.get(job.version, ""),
+                "revert": False,
             }
             self._swaps_by_job[job.job_id] = job.version
         trace.count("swap.registered")
@@ -2204,10 +2279,21 @@ class LeaderNode:
 
     def _swap_record_locked(self, version: str) -> dict:
         rec = self._swaps[version]
-        return {"Version": rec["version"], "JobID": rec["job_id"],
-                "SwapBase": rec["swap_base"], "Dests": list(rec["dests"]),
-                "State": rec["state"],
-                "Confirmed": sorted(rec["confirmed"])}
+        out = {"Version": rec["version"], "JobID": rec["job_id"],
+               "SwapBase": rec["swap_base"], "Dests": list(rec["dests"]),
+               "State": rec["state"],
+               "Confirmed": sorted(rec["confirmed"])}
+        # Rollout-pipeline fields ride only when set (plain swap
+        # records keep their pre-rollout shape).
+        if rec.get("hold"):
+            out["Hold"] = True
+        if rec.get("staged"):
+            out["Staged"] = True
+        if rec.get("rollout"):
+            out["Rollout"] = rec["rollout"]
+        if rec.get("revert"):
+            out["Revert"] = True
+        return out
 
     def _replicate_swap(self, version: str) -> None:
         with self._lock:
@@ -2217,26 +2303,37 @@ class LeaderNode:
         self._replicate("swap", **data)
 
     def _swap_send_round(self, version: str, prepare: bool = False,
-                         only: Optional[Set[NodeID]] = None) -> None:
+                         only: Optional[Set[NodeID]] = None,
+                         finalize: bool = False) -> None:
         """One fence round: the operative message (prepare / commit /
         abort, per the record's state) to each dest — unconfirmed ones
-        only, unless ``only`` narrows it further."""
+        only, unless ``only`` narrows it further.  ``finalize`` sends
+        the advisory release-the-retained-tree notice to EVERY dest
+        (docs/rollout.md) regardless of confirm state."""
         with self._lock:
             rec = self._swaps.get(version)
             if rec is None:
                 return
             state = rec["state"]
+            revert = bool(rec.get("revert"))
             targets = [d for d in rec["dests"]
-                       if d not in rec["confirmed"]
+                       if (finalize or d not in rec["confirmed"])
                        and (only is None or d in only)
                        and d != self.node.my_id]
             swap_base = rec["swap_base"]
         for dest in targets:
-            msg = SwapCommitMsg(self.node.my_id, version,
-                                swap_base=swap_base,
-                                abort=(state == "aborted"),
-                                prepare=prepare and state == "rolling",
-                                epoch=self.epoch)
+            if finalize:
+                msg = SwapCommitMsg(self.node.my_id, version,
+                                    finalize=True, epoch=self.epoch)
+            else:
+                msg = SwapCommitMsg(self.node.my_id, version,
+                                    swap_base=swap_base,
+                                    abort=(state == "aborted"),
+                                    revert=(state == "aborted"
+                                            and revert),
+                                    prepare=prepare
+                                    and state == "rolling",
+                                    epoch=self.epoch)
             try:
                 self.node.add_node(dest)
                 self.node.transport.send(dest, msg)
@@ -2247,7 +2344,9 @@ class LeaderNode:
     def _on_swap_job_done(self, job_id: str) -> None:
         """A swap job finished rolling: clean completion commits the
         fence; any dropped pair (dest crashed, pair cancelled) aborts —
-        v1 keeps serving everywhere."""
+        v1 keeps serving everywhere.  A HELD swap (a rollout wave,
+        docs/rollout.md) marks STAGED instead of committing — the
+        pipeline releases the flip."""
         with self._lock:
             version = self._swaps_by_job.get(job_id)
             rec = self._swaps.get(version) if version else None
@@ -2258,6 +2357,18 @@ class LeaderNode:
             self._abort_swap(version, "rollout degraded: "
                              f"{job.dropped_pairs if job else '?'} pairs "
                              "dropped")
+            return
+        with self._lock:
+            rec = self._swaps.get(version)
+            hold = rec is not None and rec.get("hold")
+            if hold:
+                rec["staged"] = True
+        if hold:
+            trace.count("swap.staged_held")
+            log.info("held swap staged on every replica; awaiting the "
+                     "pipeline's release", version=version)
+            self._replicate_swap(version)
+            self.rollouts.on_wave_staged(version)
             return
         self._commit_swap(version)
 
@@ -2276,25 +2387,35 @@ class LeaderNode:
                          daemon=True,
                          name=f"swap-fence-{version}").start()
 
-    def _abort_swap(self, version: str, reason: str) -> None:
+    def _abort_swap(self, version: str, reason: str,
+                    revert: bool = False) -> None:
         """Rollback = never flip: cancel the job (remaining pairs drop
         VISIBLY), tell every dest to release its staged v2, keep v1
-        serving."""
+        serving.  With ``revert`` (the rollout SLO guard's rollback,
+        docs/rollout.md) an already-COMMITTED swap is allowed to abort:
+        the fence carries ``Revert`` and each replica restores its
+        retained pre-flip tree."""
         with self._lock:
             rec = self._swaps.get(version)
             if rec is None or rec["state"] in ("aborted",):
                 return
-            if rec["state"] == "committed":
+            if rec["state"] == "committed" and not revert:
                 log.error("abort requested for an already-committed "
                           "swap; refusing (the fleet flipped)",
                           version=version, reason=reason)
                 return
             rec["state"] = "aborted"
             rec["confirmed"] = set()
+            rec["revert"] = bool(revert)
             job_id = rec["job_id"]
+            rollout = rec.get("rollout", "")
         trace.count("swap.aborts")
-        log.error("live swap ABORTED; v1 keeps serving", version=version,
-                  reason=reason)
+        if revert:
+            trace.count("swap.reverts_issued")
+        log.error("live swap ABORTED; "
+                  + ("replicas reverting to the pre-flip tree"
+                     if revert else "v1 keeps serving"),
+                  version=version, reason=reason)
         if self.jobs.cancel(job_id):
             self._replicate("job", **self.jobs.record(job_id))
             with self._lock:
@@ -2305,6 +2426,10 @@ class LeaderNode:
         self._replicate_swap(version)
         self._swap_send_round(version)
         self._maybe_finish()
+        if rollout and not revert:
+            # A wave that died OUTSIDE the guard's own rollback (dest
+            # crash, staging failure): the pipeline pauses, loudly.
+            self.rollouts.on_wave_aborted(version, reason)
 
     def _swap_watchdog(self, version: str) -> None:
         """Bounded fence re-send: a node that lost the commit gets it
@@ -2363,13 +2488,8 @@ class LeaderNode:
                 if rec is None:
                     return
                 rec["confirmed"].add(msg.src_id)
-                done = (rec["state"] == "committed"
-                        and set(rec["dests"]) <= rec["confirmed"])
             self._replicate_swap(msg.version)
-            if done:
-                trace.count("swap.fleet_flipped")
-                log.info("every replica confirmed the flip; swap "
-                         "complete", version=msg.version)
+            self._maybe_swap_complete(msg.version)
             return
         if msg.query:
             # A staged node that never saw its fence: answer with the
@@ -2392,11 +2512,86 @@ class LeaderNode:
                              f"node {msg.src_id}: {msg.error}")
             return
 
+    def _maybe_swap_complete(self, version: str) -> None:
+        """Fire the fleet-flipped completion edge once every REMAINING
+        fence dest has confirmed.  Called from the confirm path and
+        from a dead dest's fence-set prune (``crash``): the prune can
+        be what completes the set, and without this edge a plain
+        swap's finalize round (or a rollout wave's soak open) would
+        wait forever on a confirmation that can no longer arrive —
+        survivors pinning their retained pre-flip trees the whole
+        time."""
+        with self._lock:
+            rec = self._swaps.get(version)
+            if (rec is None or rec["state"] != "committed"
+                    or not set(rec["dests"]) <= rec["confirmed"]
+                    or rec.get("fleet_flipped")):
+                return
+            rec["fleet_flipped"] = True
+            held = bool(rec.get("rollout"))
+        trace.count("swap.fleet_flipped")
+        log.info("every replica confirmed the flip; swap complete",
+                 version=version)
+        if held:
+            # A rollout wave: the flip edge opens its soak window
+            # (docs/rollout.md).
+            self.rollouts.on_wave_flipped(version)
+        else:
+            # A plain fleet-wide swap: everyone flipped — the rollback
+            # window closes now; release the retained pre-flip trees
+            # (advisory).
+            self._swap_send_round(version, finalize=True)
+
     def swap_table(self) -> Dict[str, dict]:
         """JSON-ready swap driver state (reports, tests, -jobs)."""
         with self._lock:
             return {v: self._swap_record_locked(v)
                     for v in sorted(self._swaps)}
+
+    # --------------------------------------------- rollout operator channel
+
+    def handle_rollout_ctl(self, msg: RolloutCtlMsg) -> None:
+        """The rollout pipeline's operator front door (docs/rollout.md):
+        query the table, pause/resume a pipeline, move the traffic-split
+        knob.  The MUTATING verbs (pause/resume/split) ride the
+        DLD_JOB_TOKEN admission gate — a resume re-submits a
+        rolled-back wave's swap job and a commit flips serving, exactly
+        the mutation class the token exists for; query stays open like
+        -jobs.  Every request is ANSWERED, refusals included."""
+        if msg.table or msg.error:
+            return  # someone's reply echoed here
+        error = ""
+        mutating = msg.pause or msg.resume or msg.split >= 0
+        if self._deposed:
+            error = "deposed: a higher-epoch leader owns the rollouts"
+        elif (mutating and self._job_token
+                and not hmac.compare_digest(msg.auth.encode(),
+                                            self._job_token.encode())):
+            trace.count("jobs.unauthorized")
+            log.warn("unauthorized rollout control verb rejected",
+                     rollout=msg.rollout_id, submitter=msg.src_id,
+                     pause=msg.pause, resume=msg.resume)
+            error = ("unauthorized: this leader requires a job token "
+                     "(DLD_JOB_TOKEN) for pause/resume/split")
+        elif msg.pause:
+            error = self.rollouts.pause(msg.rollout_id)
+        elif msg.resume:
+            error = self.rollouts.resume(msg.rollout_id)
+        elif msg.split >= 0:
+            error = self.rollouts.set_split(msg.rollout_id, msg.split)
+        elif not msg.query:
+            error = "no verb: set Query, Pause, Resume, or Split"
+        try:
+            self.node.add_node(msg.src_id)
+            self.node.transport.send(
+                msg.src_id,
+                RolloutCtlMsg(self.node.my_id,
+                              rollout_id=msg.rollout_id,
+                              table=self.rollouts.table(),
+                              error=error, epoch=self.epoch))
+        except (OSError, KeyError, ConnectionError) as e:
+            log.error("rollout ctl reply undeliverable",
+                      dest=msg.src_id, err=repr(e))
 
     # ------------------------------------------------ elastic membership
 
@@ -2502,13 +2697,15 @@ class LeaderNode:
                  generation=rec.generation,
                  want=sorted(int(l) for l in msg.want) or "universe")
         want = sorted(int(l) for l in msg.want)
-        # Mode 3 models NICs: an unconfigured joiner gets the most
-        # conservative configured rate (it can still serve; the solver
-        # just never over-promises its unknown link).
+        # Mode 3 models NICs: an unconfigured joiner starts at the most
+        # conservative configured rate (the solver never over-promises
+        # an unknown link) — PINNED only until its announce carries its
+        # own rate, which supersedes (docs/membership.md).
         bw_map = getattr(self, "node_network_bw", None)
         if bw_map is not None and node not in bw_map:
             known = [b for b in bw_map.values() if b > 0]
             bw_map[node] = min(known) if known else 0
+            self._joiner_bw_pinned.add(node)
         parent = self._place_joiner(node)
         if parent == self.node.my_id:
             # The root monitors ungrouped joiners directly; a grouped
@@ -2665,37 +2862,48 @@ class LeaderNode:
         self._replicate_membership()
         self._drain_rehome(node)
 
-    def _unique_holdings_locked(self, node: NodeID) -> List[LayerID]:
-        """Layers whose ONLY live full canonical copy is the drainer's
-        — losing the seat without re-homing them would lose the pair.
-        Qualified holdings (shard slices, encoded forms) never re-home
-        whole (honest limit, docs/membership.md).  Lock held."""
+    def _unique_holdings_locked(
+            self, node: NodeID) -> List[Tuple[LayerID, str, str]]:
+        """``(layer, shard, codec)`` holdings whose only live copy
+        CAPABLE of satisfying the same demands is the drainer's —
+        losing the seat without re-homing them would lose the pair.
+        A full canonical holding is unique when no survivor holds the
+        full raw layer; a QUALIFIED holding (shard slice, encoded form
+        — docs/sharding.md, docs/codec.md) is unique when no survivor
+        holds a covering shard in an accepting codec, and re-homes
+        shard/codec-QUALIFIED (the drainer re-seeds its own form
+        verbatim), never inflated to a whole raw layer it may not even
+        be able to produce.  Lock held."""
         row = self.status.get(node) or {}
-        unique: List[LayerID] = []
+        unique: List[Tuple[LayerID, str, str]] = []
         for lid, meta in sorted(row.items()):
-            if (not delivered(meta) or meta.shard
-                    or getattr(meta, "codec", "")):
+            if not delivered(meta):
                 continue
+            shard = meta.shard
+            codec = getattr(meta, "codec", "")
             held_elsewhere = False
             for n, other in self.status.items():
                 if (n == node or self.membership.is_left(n)
                         or self.membership.is_draining(n)):
                     continue
                 m = other.get(lid)
-                if (m is not None and delivered(m) and not m.shard
-                        and not getattr(m, "codec", "")):
+                if (m is not None and delivered(m)
+                        and shard_covers(m.shard, shard)
+                        and codec_accepts(getattr(m, "codec", ""),
+                                          codec)):
                     held_elsewhere = True
                     break
             if not held_elsewhere:
-                unique.append(lid)
+                unique.append((lid, shard, codec))
         return unique
 
-    def _rehome_dest_locked(self, node: NodeID,
-                            lid: LayerID) -> Optional[NodeID]:
-        """The survivor a draining node's unique layer re-homes onto:
-        the lowest-id placeable announced seat that doesn't already
-        hold it (non-leader seats first — the leader is the fallback,
-        not the default dumping ground).  Lock held."""
+    def _rehome_dest_locked(self, node: NodeID, lid: LayerID,
+                            shard: str = "",
+                            codec: str = "") -> Optional[NodeID]:
+        """The survivor a draining node's unique holding re-homes onto:
+        the lowest-id placeable announced seat without a satisfying
+        copy (non-leader seats first — the leader is the fallback, not
+        the default dumping ground).  Lock held."""
         placeable = self.membership.placeable()
         candidates = [n for n in sorted(self.status)
                       if n != node and n in placeable
@@ -2704,29 +2912,54 @@ class LeaderNode:
         for n in candidates:
             if n == node or n not in placeable:
                 continue
+            if codec and codec not in self.node_codecs.get(n, ()):
+                # A codec-qualified re-home pins the wire codec onto
+                # the dest (_drain_rehome), bypassing the negotiation's
+                # advertised-decode check — so enforce it here: never
+                # ship encoded bytes to a seat that can't decode them.
+                continue
             meta = self.status.get(n, {}).get(lid)
-            if meta is not None and delivered(meta):
+            if (meta is not None and delivered(meta)
+                    and shard_covers(meta.shard, shard)
+                    and codec_accepts(getattr(meta, "codec", ""),
+                                      codec)):
                 continue
             return n
         return None
 
     def _drain_rehome(self, node: NodeID) -> None:
         """Plan (or finish) one drain: submit the re-home job for the
-        drainer's unique holdings, or finalize immediately when nothing
-        unique remains.  Also the takeover re-drive (docs/membership.md:
-        a promoted leader resumes adopted drains in the bumped epoch)."""
+        drainer's unique holdings — full canonical AND shard/codec-
+        qualified (the PR 12 follow-up closed) — or finalize
+        immediately when nothing unique remains.  Also the takeover
+        re-drive (docs/membership.md: a promoted leader resumes adopted
+        drains in the bumped epoch)."""
         with self._lock:
             target: Assignment = {}
-            for lid in self._unique_holdings_locked(node):
-                dest = self._rehome_dest_locked(node, lid)
+            qualified = 0
+            for lid, shard, codec in self._unique_holdings_locked(node):
+                dest = self._rehome_dest_locked(node, lid, shard, codec)
                 if dest is None:
                     log.error("no survivor can take a draining node's "
-                              "unique layer; its bytes leave with it",
-                              node=node, layerID=lid)
+                              "unique holding; its bytes leave with it",
+                              node=node, layerID=lid,
+                              shard=shard or None, codec=codec or None)
                     continue
-                target.setdefault(dest, {})[lid] = LayerMeta()
+                target.setdefault(dest, {})[lid] = LayerMeta(
+                    shard=shard, codec=codec)
+                if shard or codec:
+                    qualified += 1
+                    if codec:
+                        # Pin the codec CHOICE so the stamp/accounting
+                        # machinery treats the re-home exactly like a
+                        # negotiated encoded pair (the dest accounts,
+                        # journals, and acks in encoded byte space).
+                        self._codec_choice[(dest, lid)] = codec
+                        self._codec_seen = True
             n_prior = sum(1 for n in self._drain_jobs.values()
                           if n == node)
+        if qualified:
+            trace.count("membership.qualified_rehomed", qualified)
         if not target:
             self._finalize_drain(node)
             return
@@ -3434,6 +3667,12 @@ class LeaderNode:
         # A serving replica died mid-rollout: the swap can no longer
         # land everywhere — abort (v1 keeps serving on the survivors)
         # BEFORE the job drops mark it "done with drops" (docs/swap.md).
+        # Rollout waves mid-flip/soak fail FIRST: a dead canary must
+        # read as a breach (pause + revert), never as a silent no_data
+        # pass — and failing the wave before the fence-set prune below
+        # keeps the prune's completion edge from opening a soak window
+        # on a wave that just lost a replica.
+        self.rollouts.on_replica_crashed(node_id)
         pruned = []
         with self._lock:
             dead_swaps = [v for v, rec in self._swaps.items()
@@ -3452,6 +3691,11 @@ class LeaderNode:
             # or a promoted standby re-adopts the dead dest and chases
             # its confirmation through the whole re-send budget.
             self._replicate_swap(version)
+            # The dead dest may have been the LAST unconfirmed one:
+            # the prune completes the fence set, so the completion
+            # edge (finalize round / soak open) must fire here — no
+            # further confirm will ever arrive to fire it.
+            self._maybe_swap_complete(version)
         for version in dead_swaps:
             self._abort_swap(version, f"dest {node_id} crashed mid-rollout")
         if self.membership.is_draining(node_id):

@@ -272,8 +272,16 @@ class ReceiverNode:
         self._layer_codecs: Dict[int, str] = {}
         self._frag_codec: Dict[int, str] = {}
         # The rollout version the serving params were assembled under
-        # ("" until a swap commits here).
+        # ("" until a swap commits here), and the per-blob version map
+        # of the CURRENT serving tree — the per-step uniformity guard
+        # of the token-granularity flip reads it (docs/rollout.md).
         self.serving_version = ""
+        self._serving_tree_versions: Dict[int, str] = {}
+        # This node's own modeled NIC rate (bytes/s, 0 = unknown),
+        # carried on announces so a mode-3 leader admitting this seat
+        # as a JOINER models the link honestly instead of pinning the
+        # most conservative configured value (docs/membership.md).
+        self.nic_bw = 0
         # Live-swap state machine (runtime/swap.py): stages v2 sets
         # concurrently with v1 serving and applies the epoch-fenced
         # commit flip.  Only serving-capable nodes carry one.
@@ -727,7 +735,8 @@ class ReceiverNode:
                         partial=self._announce_partial(),
                         digests=self._announce_digests(),
                         codecs=(self.codec_plane.decode_codecs()
-                                if self.codec_plane is not None else [])),
+                                if self.codec_plane is not None else []),
+                        nic_bw=int(self.nic_bw or 0)),
         )
         # Telemetry plane: probe the leader's clock (request/response
         # midpoint → the offset cli/trace.py aligns timelines with) and
@@ -807,7 +816,11 @@ class ReceiverNode:
             self.node.my_id, counters=snap.get("counters") or {},
             gauges=gauges, links=snap.get("links") or {},
             t_wall_ms=_time.time() * 1000.0, epoch=epoch,
-            proc=snap.get("proc", ""))
+            proc=snap.get("proc", ""),
+            # Fixed-bucket histograms ride too (the rollout pipeline's
+            # SLO guard reads per-replica serve latency from them,
+            # docs/rollout.md).
+            hists=snap.get("hists") or {})
         try:
             self.node.transport.send(self.node.leader_id, msg)
         except (OSError, KeyError) as e:
@@ -2031,8 +2044,16 @@ class ReceiverNode:
 
     def _serve_generate_req(self, msg: GenerateReqMsg) -> None:
         t0 = _time.monotonic()
+        me = self.node.my_id
 
         def reply(tokens=None, error=""):
+            # Telemetry (docs/rollout.md): per-REPLICA request latency
+            # and failure counters — the metric names carry the node id
+            # because co-resident nodes share one registry, and the SLO
+            # guard needs per-replica p99, not a process blur.  The
+            # reply send is INSIDE the timed window on purpose: a
+            # replica whose answers crawl out a congested NIC is slow
+            # as far as its users (and its SLO) are concerned.
             try:
                 self.node.transport.send(
                     msg.src_id,
@@ -2042,6 +2063,11 @@ class ReceiverNode:
             except (OSError, KeyError, ConnectionError) as e:
                 log.error("generate response send failed",
                           requester=msg.src_id, req=msg.req_id, err=repr(e))
+            telemetry.observe_ms(f"serve.latency_ms.n{me}",
+                                 (_time.monotonic() - t0) * 1000.0)
+            telemetry.count(f"serve.requests.n{me}")
+            if error:
+                telemetry.count(f"serve.failures.n{me}")
 
         if self.boot_cfg is None:
             reply(error="no booted model at this node (no boot config)")
@@ -2092,14 +2118,39 @@ class ReceiverNode:
             import jax
             import jax.numpy as jnp
 
-            from ..models.generate import generate
+            from ..models.generate import (
+                ensure_uniform_version,
+                generate,
+                generate_stepwise,
+            )
 
             temp = float(msg.temperature)
-            toks = generate(
-                res.params, jnp.asarray([list(msg.prompt)], jnp.int32),
-                cfg, int(msg.max_new), temperature=temp,
-                key=(jax.random.key(int(msg.seed)) if temp > 0 else None),
-            )
+            prompt_arr = jnp.asarray([list(msg.prompt)], jnp.int32)
+            prng = jax.random.key(int(msg.seed)) if temp > 0 else None
+            if os.environ.get("DLD_TOKEN_FLIP", "0") == "1":
+                # Per-TOKEN flip granularity (docs/rollout.md): re-read
+                # the serving tree before every decode step, so an
+                # in-flight generation picks a freshly committed
+                # version up at the NEXT token instead of finishing a
+                # long request on the old one.  Guarded per step: the
+                # tree's blob-version map must be uniform, or the step
+                # refuses (a mixed tree can't happen through the
+                # atomic flip — this is the invariant made executable).
+                def params_fn():
+                    with self._lock:
+                        cur = self.boot_result
+                        version = self.serving_version
+                        tree = dict(self._serving_tree_versions)
+                    ensure_uniform_version(tree, version)
+                    return cur.params, version
+
+                toks = generate_stepwise(
+                    params_fn, prompt_arr, cfg, int(msg.max_new),
+                    temperature=temp, key=prng)
+            else:
+                toks = generate(
+                    res.params, prompt_arr, cfg, int(msg.max_new),
+                    temperature=temp, key=prng)
             out = [int(t) for t in jax.device_get(toks)[0]]
         except Exception as e:  # noqa: BLE001 — must answer, not vanish
             log.error("generation request failed", requester=msg.src_id,
@@ -2156,6 +2207,8 @@ class ReceiverNode:
         request is ever dropped, and no forward spans both versions."""
         from .boot import BootResult
 
+        from ..models import serde
+
         cfg = self.boot_cfg
         res = BootResult(kind="full", seconds=0.0,
                          layer_ids=list(range(cfg.n_layers)),
@@ -2163,6 +2216,12 @@ class ReceiverNode:
         with self._lock:
             self.boot_result = res
             self.serving_version = version
+            # Every blob of the flipped-in tree carries THIS version:
+            # the per-step guard of the token-granularity flip asserts
+            # this map stays uniform (docs/rollout.md).
+            self._serving_tree_versions = {
+                slot: version
+                for slot in range(serde.head_blob_id(cfg) + 1)}
             self._boot_started = True
             self._boot_report = (0.0, "full")
         # A swap can land on a node that never booted v1 (it joined the

@@ -51,6 +51,7 @@ STAGING = "staging"      # prepare seen; v2 layers accumulating
 PREPARED = "prepared"    # full set verified; params built, flip-ready
 COMMITTED = "committed"  # flip applied; this version is serving
 ABORTED = "aborted"      # rollout failed; staged set released
+REVERTED = "reverted"    # flipped, then rolled BACK (SLO breach)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -95,13 +96,19 @@ class SwapController:
         answers (the serving invariant): a flip or an abort confirms
         with ``applied=True``; an impossible commit reports ``error``
         so the leader aborts instead of re-sending forever."""
+        if msg.finalize:
+            # The rollback window is over (the wave's soak verdict
+            # passed, or a plain swap's fleet flip completed): release
+            # the retained pre-flip tree.  Advisory — no answer.
+            self._finalize(msg.version)
+            return
         if msg.abort:
-            self._abort(msg.version)
+            self._abort(msg.version, revert=msg.revert)
             self._answer(version=msg.version, applied=True)
             return
         with self._lock:
             rec = self._versions.get(msg.version)
-            if rec is not None and rec["state"] == ABORTED:
+            if rec is not None and rec["state"] in (ABORTED, REVERTED):
                 # A retry rollout re-uses an aborted version name
                 # (docs/swap.md): start over with a fresh record — the
                 # released v2 set was re-announced away, so the retry
@@ -140,7 +147,7 @@ class SwapController:
         re-track from scratch (docs/swap.md)."""
         with self._lock:
             rec = self._versions.get(version)
-            if rec is not None and rec["state"] == ABORTED:
+            if rec is not None and rec["state"] in (ABORTED, REVERTED):
                 log.warn("prepare for a previously aborted version; "
                          "re-tracking for the retry", version=version)
                 del self._versions[version]
@@ -196,6 +203,11 @@ class SwapController:
             "flip_pending": False,
             "event": threading.Event(),  # set at PREPARED (or terminal)
             "queries": 0,
+            # The pre-flip serving state, retained from the flip until
+            # the leader's FINALIZE fence: (boot_result, version,
+            # tree-version map) — the rollback window of
+            # docs/rollout.md.  None before the flip / after finalize.
+            "prev": None,
         }
         self._versions[version] = rec
         log.info("tracking swap version", version=version,
@@ -420,6 +432,17 @@ class SwapController:
             rec["per_slot"] = {}
             rec["head"] = None
             rec["params"] = None
+        # Retain the pre-flip serving state until the leader finalizes:
+        # an SLO-breach rollback (docs/rollout.md) restores it with one
+        # pointer swap instead of a re-dissemination.
+        with self.r._lock:
+            prev = (getattr(self.r, "boot_result", None),
+                    getattr(self.r, "serving_version", ""),
+                    dict(getattr(self.r, "_serving_tree_versions", {})))
+        with self._lock:
+            rec = self._versions.get(version)
+            if rec is not None:
+                rec["prev"] = prev
         self.r._apply_swap_result(version, params)
         dt = time.monotonic() - t0
         trace.count("swap.flips")
@@ -428,14 +451,38 @@ class SwapController:
                  host_staged_blobs=n_host)
         self._answer(version=version, applied=True)
 
-    def _abort(self, version: str) -> None:
+    def _abort(self, version: str, revert: bool = False) -> None:
         """Rollback = don't flip: release the staged v2 set (decoded
-        leaves AND the store's v2 blob entries) and keep serving v1."""
+        leaves AND the store's v2 blob entries) and keep serving v1.
+        With ``revert`` (docs/rollout.md), an already-COMMITTED version
+        rolls BACK: the retained pre-flip tree is restored with one
+        pointer swap (the SLO-breach rollback); without it a committed
+        version refuses the abort, as ever."""
+        reverted = False
+        kept_for_boot = False
         with self._lock:
             rec = self._versions.get(version)
             if rec is None:
                 rec = self._track_locked(version, -1)
-            if rec["state"] in (COMMITTED, ABORTED):
+            if (rec["state"] == COMMITTED and revert and rec["prev"]
+                    and rec["prev"][0] is None):
+                # The flip WAS this replica's boot (it joined mid-
+                # rollout and never served the pre-flip version):
+                # "reverting" would restore a None tree and refuse
+                # every request.  Keep the flipped tree serving —
+                # degraded-but-serving beats dark — and release the
+                # retained marker so duplicate reverts stay no-ops.
+                rec["prev"] = None
+                rec_state = COMMITTED
+                kept_for_boot = True
+            elif rec["state"] == COMMITTED and revert and rec["prev"]:
+                prev = rec["prev"]
+                rec["prev"] = None
+                rec["state"] = REVERTED
+                rec["event"].set()
+                rec_state = REVERTED
+                reverted = True
+            elif rec["state"] in (COMMITTED, ABORTED, REVERTED):
                 rec_state = rec["state"]
             else:
                 rec["state"] = ABORTED
@@ -446,10 +493,31 @@ class SwapController:
                 rec_state = ABORTED
             swap_base = rec["swap_base"]
         if rec_state == COMMITTED:
-            log.error("abort for an already-committed version ignored "
-                      "(the flip happened; the leader's abort lost the "
-                      "race)", version=version)
+            if kept_for_boot:
+                trace.count("swap.revert_no_prev")
+                log.error("revert refused: the flip was this replica's "
+                          "boot (no pre-flip tree exists); keeping the "
+                          "flipped tree serving", version=version)
+            else:
+                log.error("abort for an already-committed version "
+                          "ignored (the flip happened; a plain abort "
+                          "cannot undo it"
+                          + (" and no retained pre-flip tree remains to "
+                             "revert to" if revert else "") + ")",
+                          version=version)
             return
+        if rec_state == REVERTED and not reverted:
+            return  # duplicate revert fence: already rolled back
+        if reverted:
+            prev_res, prev_version, prev_tree = prev
+            with self.r._lock:
+                self.r.boot_result = prev_res
+                self.r.serving_version = prev_version
+                self.r._serving_tree_versions = prev_tree
+            trace.count("swap.reverted")
+            log.warn("swap ROLLED BACK: serving restored to the "
+                     "pre-flip tree", version=version,
+                     restored_version=prev_version or "v1")
         dropped = 0
         if swap_base >= 0 and self.r.boot_cfg is not None:
             for lid in self._expected_ids(swap_base):
@@ -473,6 +541,19 @@ class SwapController:
                 self.r.announce()
             except (OSError, KeyError) as e:
                 log.error("post-abort re-announce failed", err=repr(e))
+
+    def _finalize(self, version: str) -> None:
+        """Release the retained pre-flip tree: the rollback window is
+        over (docs/rollout.md).  A finalize for an unknown/unflipped
+        version is a harmless no-op — the fence is advisory."""
+        with self._lock:
+            rec = self._versions.get(version)
+            if rec is None or rec["state"] != COMMITTED or not rec["prev"]:
+                return
+            rec["prev"] = None
+        trace.count("swap.finalized")
+        log.info("swap finalized; retained pre-flip tree released",
+                 version=version)
 
     def _arm_query(self, version: str) -> None:
         if self.query_interval <= 0:

@@ -144,6 +144,11 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
       bucket — the deterministic straggler-link injection the live-swap
       chaos case needs (a replica whose v2 staging lags the fleet while
       v1 keeps serving, docs/swap.md)
+    - ``slowserve=MS[:N]``: delay every Nth (default: every) outbound
+      GENERATE_RESP by MS ms — the deterministic BAD-WAVE injection of
+      the rollout pipeline (docs/rollout.md): a wave's replicas answer
+      slowly enough to breach the declared p99 SLO, without dropping a
+      single request
 
     e.g. ``seed=7,corrupt=9,dropin=13,dup=11,times=8``.  Returns
     ``(seed, rules)`` — hand both to ``FaultyTransport``."""
@@ -188,6 +193,13 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
                            FaultRule("slow", "out",
                                      msg_type=MsgType.LAYER,
                                      dest=p, rate=r))
+            continue
+        if key == "slowserve":
+            ms_s, _, n_s = val.partition(":")
+            pending.append(lambda sd, tm, ms=float(ms_s),
+                           n=int(n_s or 1): FaultRule(
+                "delay", "out", msg_type=MsgType.GENERATE_RESP,
+                every=n, times=tm, delay_s=ms / 1000.0))
             continue
         if key == "resetany":
             n = int(val)

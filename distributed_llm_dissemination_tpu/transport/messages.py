@@ -157,6 +157,15 @@ class MsgType(enum.IntEnum):
     # ``done=True``: your unique holdings are re-homed and you are out
     # of every liveness/lease/announce table — exiting now cannot fire
     # the crash path).
+    # ROLLOUT_CTL — SLO-guarded fleet rollout pipeline (docs/rollout.md):
+    # the operator channel of a ``kind="rollout"`` job.  A QUERY
+    # (operator seat → leader) asks for the rollout table (wave states,
+    # SLO verdicts, traffic split); PAUSE/RESUME gate the pipeline's
+    # wave commits; ``split`` (>= 0) sets the leader-owned traffic-split
+    # knob; the leader's reply carries ``table``.  The rollout RECORDS
+    # themselves replicate via ControlDeltaMsg kind "rollout" + the
+    # snapshot's Rollouts section — this message is only the operator
+    # front door.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -180,6 +189,7 @@ class MsgType(enum.IntEnum):
     GROUP_STATUS = 28
     JOIN = 29
     DRAIN = 30
+    ROLLOUT_CTL = 31
 
 
 def _epoch_to_payload(payload: dict, epoch: int) -> dict:
@@ -212,13 +222,21 @@ class AnnounceMsg:
     (and encode-serve) — the capability half of the codec negotiation.
     The leader only ever chooses a quantized transfer for a dest that
     advertised the codec; pre-codec peers announce nothing and interop
-    as raw.  Omitted when empty."""
+    as raw.  Omitted when empty.
+
+    ``nic_bw`` (docs/membership.md): this node's own modeled NIC rate
+    in bytes/second — an unconfigured JOINER's announce carries its
+    locally configured rate so the mode-3 leader can model the link
+    honestly instead of pinning the most conservative configured value
+    until an operator re-configures.  0 = unknown, omitted on the wire
+    (every pre-membership announce)."""
 
     src_id: NodeID
     layer_ids: LayerIDs
     partial: dict = dataclasses.field(default_factory=dict)
     digests: dict = dataclasses.field(default_factory=dict)
     codecs: list = dataclasses.field(default_factory=list)
+    nic_bw: int = 0
 
     msg_type = MsgType.ANNOUNCE
 
@@ -237,6 +255,8 @@ class AnnounceMsg:
             }
         if self.codecs:
             payload["Codecs"] = [str(c) for c in self.codecs]
+        if self.nic_bw:
+            payload["NicBw"] = int(self.nic_bw)
         return payload
 
     @classmethod
@@ -252,6 +272,7 @@ class AnnounceMsg:
                 for lid, h in (d.get("Digests") or {}).items()
             },
             codecs=[str(c) for c in d.get("Codecs") or []],
+            nic_bw=int(d.get("NicBw", 0)),
         )
 
 
@@ -1044,7 +1065,9 @@ class ControlDeltaMsg:
     "assignment" | "digests" | "startup" | "plan_seq" | "revive" |
     "metrics" | "base_assignment" | "job" | "job_done" — the last two
     carry the dissemination service's admitted-job records,
-    docs/service.md); ``data`` is the
+    docs/service.md — | "swap" | "rollout", the live-swap and
+    rollout-pipeline records, docs/swap.md + docs/rollout.md); ``data``
+    is the
     kind-specific JSON payload; ``seq`` is a per-leader monotonic
     counter (diagnostics — the shadow is reconciliation-corrected at
     takeover, so ordering races only cost re-sent bytes, never
@@ -1123,6 +1146,12 @@ class MetricsReportMsg:
     # snapshot per distinct token, not per node.  Omitted-field
     # compatible ("" = legacy reporter, counted per node).
     proc: str = ""
+    # Fixed-bucket histograms (utils/telemetry.HIST_BUCKETS_MS):
+    # ``{name: {"buckets": [...], "sum_ms": float, "n": int}}``.  Added
+    # for the rollout pipeline's SLO guard (docs/rollout.md) — the
+    # leader computes per-replica p99 serve latency from the shipped
+    # buckets.  Omitted when empty (every pre-rollout reporter).
+    hists: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.METRICS_REPORT
 
@@ -1141,6 +1170,9 @@ class MetricsReportMsg:
                 str(k): {str(f): v for f, v in row.items()}
                 for k, row in self.links.items()
             }
+        if self.hists:
+            payload["Hists"] = {str(k): dict(h)
+                                for k, h in self.hists.items()}
         if self.t_wall_ms:
             payload["T"] = float(self.t_wall_ms)
         return _epoch_to_payload(payload, self.epoch)
@@ -1158,6 +1190,7 @@ class MetricsReportMsg:
             float(d.get("T", 0.0)),
             int(d.get("Epoch", -1)),
             str(d.get("Proc", "")),
+            {str(k): dict(h) for k, h in (d.get("Hists") or {}).items()},
         )
 
 
@@ -1214,7 +1247,14 @@ class JobSubmitMsg:
     job token.  A leader started with ``DLD_JOB_TOKEN`` set rejects
     (and counts) any submit whose token does not constant-time-compare
     equal; omitted on the wire when empty, so open clusters keep the
-    legacy format."""
+    legacy format.
+
+    ``waves``/``slo``/``split`` (docs/rollout.md): a ``kind="rollout"``
+    submission declares its staged wave plan — ``waves`` is an ordered
+    list of replica-id subsets (canary first), ``slo`` the guard
+    (``{"P99Ms": float, "MaxFailures": int, "SoakS": float}``), and
+    ``split`` the initial traffic-split knob value.  All omitted at
+    default: every pre-rollout submit keeps the legacy format."""
 
     src_id: NodeID
     job_id: str
@@ -1227,6 +1267,12 @@ class JobSubmitMsg:
     version: str = ""
     swap_base: int = -1
     auth: str = ""
+    waves: list = dataclasses.field(default_factory=list)
+    slo: dict = dataclasses.field(default_factory=dict)
+    # -1 = unset (the driver applies its default); an EXPLICIT 0.0 is
+    # a real operator choice (no eligible v2 traffic during soak) and
+    # must ride the wire, so the sentinel mirrors RolloutCtlMsg.split.
+    split: float = -1.0
 
     msg_type = MsgType.JOB_SUBMIT
 
@@ -1252,6 +1298,12 @@ class JobSubmitMsg:
             payload["SwapBase"] = int(self.swap_base)
         if self.auth:
             payload["Auth"] = str(self.auth)
+        if self.waves:
+            payload["Waves"] = [[int(n) for n in w] for w in self.waves]
+        if self.slo:
+            payload["SLO"] = dict(self.slo)
+        if self.split >= 0:
+            payload["Split"] = float(self.split)
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -1269,6 +1321,9 @@ class JobSubmitMsg:
             str(d.get("Version", "")),
             int(d.get("SwapBase", -1)),
             str(d.get("Auth", "")),
+            [[int(n) for n in w] for w in d.get("Waves") or []],
+            dict(d.get("SLO") or {}),
+            float(d.get("Split", -1.0)),
         )
 
 
@@ -1343,7 +1398,18 @@ class SwapCommitMsg:
     ``error`` (node → leader): an unrecoverable v2 staging failure
     (digest retry budget exhausted) — the leader aborts the swap.
     ``epoch``: leader fencing epoch (docs/failover.md); a promoted
-    standby re-drives an adopted swap at its bumped epoch."""
+    standby re-drives an adopted swap at its bumped epoch.
+
+    Rollout-pipeline extensions (docs/rollout.md), omitted at default:
+
+    - ``revert`` (with ``abort=True``): the abort targets a COMMITTED
+      wave — the replica must roll its serving params BACK to the
+      retained pre-flip tree (the SLO-breach rollback), where a plain
+      abort of a committed version is refused.
+    - ``finalize`` (leader → replica): the wave's soak verdict PASSED
+      — release the retained pre-flip params (the rollback window is
+      over).  Advisory: a lost finalize only costs retained memory
+      until the next rollout."""
 
     src_id: NodeID
     version: str
@@ -1354,6 +1420,8 @@ class SwapCommitMsg:
     prepare: bool = False
     error: str = ""
     epoch: int = -1
+    revert: bool = False
+    finalize: bool = False
 
     msg_type = MsgType.SWAP_COMMIT
 
@@ -1372,6 +1440,10 @@ class SwapCommitMsg:
             payload["Prepare"] = True
         if self.error:
             payload["Error"] = str(self.error)
+        if self.revert:
+            payload["Revert"] = True
+        if self.finalize:
+            payload["Finalize"] = True
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -1386,6 +1458,8 @@ class SwapCommitMsg:
             bool(d.get("Prepare", False)),
             str(d.get("Error", "")),
             int(d.get("Epoch", -1)),
+            bool(d.get("Revert", False)),
+            bool(d.get("Finalize", False)),
         )
 
 
@@ -1656,6 +1730,85 @@ class DrainMsg:
         )
 
 
+@dataclasses.dataclass
+class RolloutCtlMsg:
+    """Operator ↔ leader channel of the SLO-guarded rollout pipeline
+    (docs/rollout.md).  Request roles (operator seat → leader),
+    disambiguated by flags like SWAP_COMMIT/JOIN:
+
+    - **query** (``query=True``): answer with the rollout table —
+      per-rollout wave states, SLO verdicts, the traffic-split knob,
+      and the derived v1/v2 serving pools.
+    - **pause** (``pause=True`` + ``rollout_id``): stop committing
+      further waves (in-flight dissemination and soaks finish; nothing
+      new flips).
+    - **resume** (``resume=True`` + ``rollout_id``): re-arm a paused
+      pipeline; a wave that was rolled back is re-disseminated as a
+      retry wave job.
+    - **set split** (``split`` >= 0 + ``rollout_id``): move the
+      leader-owned traffic-split knob (the fraction of eligible
+      traffic routed at v2 replicas during soak).
+
+    The reply (leader → requester) carries ``table`` (and ``error``
+    for refusals) — always ANSWERED, the serving invariant.
+
+    ``auth``: the shared-secret job token (docs/service.md).  The
+    MUTATING verbs — pause / resume / set-split — change what the
+    fleet serves (resume re-submits a rolled-back wave's swap job), so
+    a DLD_JOB_TOKEN-armed leader refuses them unauthenticated exactly
+    like job submission; query stays open like ``-jobs``.  Omitted on
+    the wire when empty."""
+
+    src_id: NodeID
+    rollout_id: str = ""
+    query: bool = False
+    pause: bool = False
+    resume: bool = False
+    split: float = -1.0
+    table: dict = dataclasses.field(default_factory=dict)
+    error: str = ""
+    epoch: int = -1
+    auth: str = ""
+
+    msg_type = MsgType.ROLLOUT_CTL
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id}
+        if self.rollout_id:
+            payload["RolloutID"] = str(self.rollout_id)
+        if self.query:
+            payload["Query"] = True
+        if self.pause:
+            payload["Pause"] = True
+        if self.resume:
+            payload["Resume"] = True
+        if self.split >= 0:
+            payload["Split"] = float(self.split)
+        if self.table:
+            payload["Table"] = {str(k): dict(v)
+                                for k, v in self.table.items()}
+        if self.error:
+            payload["Error"] = str(self.error)
+        if self.auth:
+            payload["Auth"] = str(self.auth)
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "RolloutCtlMsg":
+        return cls(
+            int(d["SrcID"]),
+            str(d.get("RolloutID", "")),
+            bool(d.get("Query", False)),
+            bool(d.get("Pause", False)),
+            bool(d.get("Resume", False)),
+            float(d.get("Split", -1.0)),
+            {str(k): dict(v) for k, v in (d.get("Table") or {}).items()},
+            str(d.get("Error", "")),
+            int(d.get("Epoch", -1)),
+            str(d.get("Auth", "")),
+        )
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -1685,6 +1838,7 @@ Message = Union[
     GroupStatusMsg,
     JoinMsg,
     DrainMsg,
+    RolloutCtlMsg,
 ]
 
 _DECODERS = {
@@ -1718,6 +1872,7 @@ _DECODERS = {
     MsgType.GROUP_STATUS: GroupStatusMsg,
     MsgType.JOIN: JoinMsg,
     MsgType.DRAIN: DrainMsg,
+    MsgType.ROLLOUT_CTL: RolloutCtlMsg,
 }
 
 

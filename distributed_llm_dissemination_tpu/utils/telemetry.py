@@ -255,6 +255,54 @@ def enabled() -> bool:
     return _links_enabled()
 
 
+# -------------------------------------------------- histogram analysis
+
+
+def percentile_from_hist(hist: Optional[dict], q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile (0 < q <= 1) of a fixed-bucket
+    histogram (``{"buckets": [...], "n": int}``) as the UPPER bound of
+    the bucket where the cumulative count crosses ``q * n`` —
+    deliberately conservative (never under-reports a latency), which is
+    the right bias for an SLO guard (docs/rollout.md).  The last bucket
+    is unbounded: a quantile landing there returns ``inf``.  Returns
+    None for an empty/absent histogram (no samples = no verdict)."""
+    if not hist:
+        return None
+    buckets = list(hist.get("buckets") or [])
+    n = int(hist.get("n", 0)) or sum(int(b) for b in buckets)
+    if n <= 0 or not buckets:
+        return None
+    want = q * n
+    seen = 0
+    for idx, count in enumerate(buckets):
+        seen += int(count)
+        if seen >= want:
+            if idx < len(HIST_BUCKETS_MS):
+                return float(HIST_BUCKETS_MS[idx])
+            return float("inf")
+    return float("inf")
+
+
+def hist_delta(now: Optional[dict], base: Optional[dict]) -> dict:
+    """Bucket-wise ``now - base`` of two cumulative fixed-bucket
+    histograms — the soak-window view the SLO guard evaluates
+    (docs/rollout.md).  A missing ``base`` means the window starts at
+    zero; counts are floored at 0 so a registry reset mid-window reads
+    as a fresh window, never a negative one."""
+    now = now or {}
+    base = base or {}
+    nb = list(now.get("buckets") or [])
+    bb = list(base.get("buckets") or [])
+    bb += [0] * (len(nb) - len(bb))
+    buckets = [max(0, int(a) - int(b)) for a, b in zip(nb, bb)]
+    return {
+        "buckets": buckets,
+        "sum_ms": max(0.0, float(now.get("sum_ms", 0.0))
+                      - float(base.get("sum_ms", 0.0))),
+        "n": max(0, int(now.get("n", 0)) - int(base.get("n", 0))),
+    }
+
+
 # ------------------------------------------------------- cluster folding
 
 

@@ -733,3 +733,198 @@ def test_churn_soak_join_leave_storm(kind, chaos_seed):
         for t in extra_ts.values():
             t.close()
         close_all(leader, list(recvs.values()), ts)
+
+
+# ---------------------------------------- qualified drain re-home (PR 13)
+
+
+@pytest.mark.timeout(120)
+def test_drain_rehomes_unique_shard_qualified_holding():
+    """The PR 12 follow-up closed (docs/membership.md): a drainer whose
+    only live copy of a layer is a SHARD slice re-homes it as a
+    shard-QUALIFIED drain job — the survivor ends up holding the same
+    slice byte-exactly — instead of the bytes leaving with the seat."""
+    from distributed_llm_dissemination_tpu.core.types import (
+        LayerLocation,
+        LayerSrc,
+        SourceType,
+        shard_range,
+    )
+
+    lids = [0]
+    ids = (0, 1, 2)
+    ts, registry = make_transports("inmem", list(ids))
+    full = layer_bytes(5, SIZE)
+    spec = "1/2@0"
+    lo, length = shard_range(spec, SIZE)
+    shard_src = LayerSrc(
+        inmem_data=bytearray(full), data_size=SIZE,
+        meta=LayerMeta(location=LayerLocation.INMEM,
+                       source_type=SourceType.MEM, shard=spec))
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {l: mem_layer(l, SIZE) for l in lids},
+        {2: {l: LayerMeta() for l in lids}},
+        {i: 10 ** 9 for i in ids},
+        expected_nodes={1, 2}, failure_timeout=0.0)
+    r1 = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {5: shard_src},
+                                    heartbeat_interval=HB)
+    r2 = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {},
+                                    heartbeat_interval=HB)
+    try:
+        r1.announce()
+        r2.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        # The drainer's shard holding is visible leader-side.
+        assert leader.status[1][5].shard == spec
+        assert r1.request_drain(timeout=TIMEOUT)
+        # Re-homed QUALIFIED: a survivor now holds the slice.
+        # Non-leader survivors come first in the re-home order, so the
+        # slice lands on r2 deterministically.
+        holder = next((n for n in (2, 0)
+                       if 5 in leader.status.get(n, {})), None)
+        assert holder == 2, leader.status
+        held = leader.status[holder][5]
+        assert held.shard == spec, held
+        _wait_for(lambda: 5 in r2.layers, what="re-homed slice")
+        got = bytes(r2.layers[5].inmem_data[lo:lo + length])
+        assert got == full[lo:lo + length]
+        assert leader.membership.is_left(1)
+        totals = trace.counter_totals()
+        assert totals.get("membership.qualified_rehomed", 0) >= 1
+        assert totals.get("membership.drained", 0) == 1
+        # The base goal still completes around the drain.
+        leader.ready().get(timeout=TIMEOUT)
+    finally:
+        close_all(leader, [r1, r2], ts)
+
+
+def test_unique_holdings_qualified_detection():
+    """Unit: codec/shard-qualified uniqueness.  A qualified holding is
+    unique unless a survivor holds a COVERING shard in an ACCEPTING
+    codec (raw full coverage satisfies everything); drained/left seats
+    never count as survivors."""
+    from distributed_llm_dissemination_tpu.core.types import (
+        LayerLocation,
+    )
+    from distributed_llm_dissemination_tpu.runtime import LeaderNode
+
+    ts, _ = make_transports("inmem", [0])
+    leader = LeaderNode(Node(0, 0, ts[0]), {}, {})
+    held = lambda **kw: LayerMeta(  # noqa: E731
+        location=LayerLocation.INMEM, **kw)
+    try:
+        leader.membership.seed([0, 1, 2], epoch=0)
+        with leader._lock:
+            leader.status = {
+                1: {5: held(codec="int8"), 6: held(shard="1/2@0"),
+                    7: held(), 8: held(codec="int4")},
+                2: {5: held(), 6: held(shard="1/4@0"), 7: held(),
+                    8: held(codec="int8")},
+            }
+            unique = leader._unique_holdings_locked(1)
+        # 5: survivor holds raw full (accepts any codec demand) — safe.
+        # 6: survivor's 1/4@0 does NOT cover 1/2@0 — unique, qualified.
+        # 7: raw full held elsewhere — safe.
+        # 8: survivor holds a DIFFERENT codec — unique, qualified.
+        assert unique == [(6, "1/2@0", ""), (8, "", "int4")]
+    finally:
+        close_all(leader, [], ts)
+
+
+def test_codec_qualified_rehome_requires_advertised_decode():
+    """Unit: a codec-qualified re-home pins the wire codec onto its
+    dest (bypassing negotiation), so the candidate filter must demand
+    the dest ADVERTISED decode for that codec — encoded bytes must
+    never land on a seat that can't decode them."""
+    from distributed_llm_dissemination_tpu.runtime import LeaderNode
+
+    ts, _ = make_transports("inmem", [0])
+    leader = LeaderNode(Node(0, 0, ts[0]), {}, {})
+    try:
+        leader.membership.seed([0, 1, 2, 3], epoch=0)
+        with leader._lock:
+            leader.status = {1: {}, 2: {}, 3: {}}
+            # Seat 2 (the lowest-id survivor) never advertised int8;
+            # seat 3 did.
+            leader.node_codecs[3] = frozenset({"int8"})
+            picked = leader._rehome_dest_locked(1, 5, codec="int8")
+            assert picked == 3
+            # Nobody advertising the codec: no dest (the holding
+            # leaves with its drainer, loudly) — never a blind pin.
+            leader.node_codecs.pop(3)
+            assert leader._rehome_dest_locked(1, 5,
+                                              codec="int8") is None
+            # Unqualified re-homes keep the plain lowest-id pick.
+            assert leader._rehome_dest_locked(1, 5) == 2
+    finally:
+        close_all(leader, [], ts)
+
+
+# ------------------------------------------ joiner NIC rate (PR 13)
+
+
+@pytest.mark.timeout(120)
+def test_joiner_announce_carried_nic_rate_honored():
+    """The PR 12 follow-up closed: a joiner's admit pins the most
+    conservative configured rate, and its announce-carried NicBw then
+    SUPERSEDES the pin — the solver models the real link."""
+    lids = [0]
+    leader, recvs, ts, registry, _ = _base_cluster("inmem", lids)
+    # Node 2's configured NIC is deliberately slow: the conservative
+    # pin would model the joiner at this crawl.
+    leader.node_network_bw[2] = 5_000_000
+    tj = None
+    joiner = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        tj = _joiner_transport("inmem", 9, registry[0])
+        joiner = FlowRetransmitReceiverNode(Node(9, 0, tj), {},
+                                            heartbeat_interval=HB)
+        joiner.nic_bw = 250_000_000
+        assert joiner.join(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        _wait_for(lambda: leader.node_network_bw.get(9) == 250_000_000,
+                  what="announce-carried NIC rate superseding the pin")
+        totals = trace.counter_totals()
+        assert totals.get("membership.joiner_bw_honored", 0) == 1
+        assert bytes(joiner.layers[0].inmem_data) == layer_bytes(0, SIZE)
+    finally:
+        if joiner is not None:
+            joiner.close()
+        if tj is not None:
+            tj.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+def test_adopted_joiner_nic_rate_honored_without_local_pin():
+    """Review regression: the joiner-pin set is leader-LOCAL, but a
+    promoted leader adopts the roster (addrs ride replication) — a
+    roster-admitted seat's announce-carried rate must supersede the
+    adopted conservative value even with an empty local pin set."""
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AnnounceMsg,
+    )
+
+    ids = (0, 1, 2)
+    ts, _ = make_transports("inmem", list(ids))
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {}, {}, {0: 10 ** 9, 1: 10 ** 9},
+        expected_nodes=set())
+    try:
+        # The adopted state: seat 9 is roster-admitted (addr present),
+        # its bw pinned conservatively — but THIS leader never pinned
+        # it (the set died with the predecessor).
+        leader.membership.admit(9, addr="n9", epoch=1)
+        leader.node_network_bw[9] = 5_000_000
+        assert 9 not in leader._joiner_bw_pinned
+        leader.handle_announce(AnnounceMsg(9, {}, nic_bw=250_000_000))
+        assert leader.node_network_bw[9] == 250_000_000
+        assert trace.counter_totals().get(
+            "membership.joiner_bw_honored", 0) == 1
+        # A CONFIGURED seat's announce never overrides its config.
+        leader.handle_announce(AnnounceMsg(1, {}, nic_bw=7))
+        assert leader.node_network_bw[1] == 10 ** 9
+    finally:
+        close_all(leader, [], ts)

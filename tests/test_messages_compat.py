@@ -50,6 +50,7 @@ from distributed_llm_dissemination_tpu.transport.messages import (
     MsgType,
     PlanResendReqMsg,
     RetransmitMsg,
+    RolloutCtlMsg,
     ServeMsg,
     SimpleMsg,
     SourceDeadMsg,
@@ -115,12 +116,13 @@ CASES = {
         lambda: GroupStatusMsg(1, 2), {"SrcID"}),
     MsgType.JOIN: (lambda: JoinMsg(9), {"SrcID"}),
     MsgType.DRAIN: (lambda: DrainMsg(9), {"SrcID"}),
+    MsgType.ROLLOUT_CTL: (lambda: RolloutCtlMsg(9), {"SrcID"}),
 }
 
 # Optional wire keys that must be OMITTED at their defaults, per type:
 # the extension fields layered onto the legacy formats over PRs 2-7.
 OMITTED_AT_DEFAULT = {
-    MsgType.ANNOUNCE: {"Partial", "Digests", "Codecs"},
+    MsgType.ANNOUNCE: {"Partial", "Digests", "Codecs", "NicBw"},
     MsgType.ACK: {"Shard", "Version", "Codec"},
     MsgType.RETRANSMIT: {"Epoch", "Job", "Shard", "Codec"},
     MsgType.FLOW_RETRANSMIT: {"Epoch", "Job", "Codec"},
@@ -133,19 +135,23 @@ OMITTED_AT_DEFAULT = {
                             "Versions", "WireCodecs"},
     MsgType.SOURCE_DEAD: {"Epoch"},
     MsgType.METRICS_REPORT: {"Epoch", "Counters", "Gauges", "Links",
-                             "T", "Proc"},
+                             "T", "Proc", "Hists"},
     MsgType.TIME_SYNC: {"T1", "Reply"},
     MsgType.JOB_SUBMIT: {"Epoch", "Priority", "Kind", "Digests", "Avoid",
-                         "Version", "SwapBase", "Auth"},
+                         "Version", "SwapBase", "Auth", "Waves", "SLO",
+                         "Split"},
     MsgType.JOB_STATUS: {"Epoch", "Query", "Jobs", "Error"},
     MsgType.SWAP_COMMIT: {"Epoch", "SwapBase", "Abort", "Query",
-                          "Applied", "Prepare", "Error"},
+                          "Applied", "Prepare", "Error", "Revert",
+                          "Finalize"},
     MsgType.JOB_REVOKE: {"Epoch", "Pairs"},
     MsgType.GROUP_PLAN: {"Epoch", "Targets", "Dissolve"},
     MsgType.GROUP_STATUS: {"Covered", "Announced", "Dead", "Metrics"},
     MsgType.JOIN: {"Addr", "Want", "Node", "Admitted", "Parent",
                    "ParentAddr", "Error", "Epoch"},
     MsgType.DRAIN: {"Node", "Done", "Error", "Epoch"},
+    MsgType.ROLLOUT_CTL: {"RolloutID", "Query", "Pause", "Resume",
+                          "Split", "Table", "Error", "Epoch", "Auth"},
 }
 
 
@@ -286,6 +292,45 @@ def test_version_fields_interop_with_preswap_peers():
         old = decode_msg(msg.msg_type, stripped)
         assert getattr(old, "version", "") == ""
         assert getattr(old, "versions", {}) == {}
+
+
+def test_rollout_fields_interop_with_prerollout_peers():
+    """The rollout-pipeline extension (docs/rollout.md) must keep a
+    pre-rollout cluster interoperable: every new field is omitted at
+    default (asserted type-by-type above), populated instances
+    round-trip through real JSON, and a stripped (legacy-peer) payload
+    decodes to the pre-rollout reading — never KeyError."""
+    for msg in (
+        AnnounceMsg(1, {7: LayerMeta()}, nic_bw=250 * 10 ** 6),
+        MetricsReportMsg(1, hists={
+            "serve.latency_ms.n1": {"buckets": [0, 1, 2], "sum_ms": 9.5,
+                                    "n": 3}}),
+        JobSubmitMsg(1, "canary-v2", {2: {7: LayerMeta()}},
+                     kind="rollout", version="v2", swap_base=1000,
+                     waves=[[2], [3, 4]],
+                     slo={"P99Ms": 500.0, "MaxFailures": 0,
+                          "SoakS": 2.0},
+                     split=0.25),
+        SwapCommitMsg(1, "v2#w1", abort=True, revert=True),
+        SwapCommitMsg(1, "v2#w0", finalize=True),
+        RolloutCtlMsg(9, rollout_id="canary-v2", query=True),
+        RolloutCtlMsg(9, rollout_id="canary-v2", split=0.75),
+        RolloutCtlMsg(0, rollout_id="canary-v2", table={
+            "canary-v2": {"State": "running", "WaveStates": ["passed"]}},
+            epoch=3),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        assert decode_msg(msg.msg_type, wire) == msg
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("NicBw", "Hists", "Waves", "SLO",
+                                 "Split", "Revert", "Finalize")}
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "nic_bw", 0) == 0
+        assert getattr(old, "hists", {}) == {}
+        assert getattr(old, "waves", []) == []
+        assert getattr(old, "slo", {}) == {}
+        assert getattr(old, "revert", False) is False
+        assert getattr(old, "finalize", False) is False
 
 
 def test_codec_fields_interop_with_precodec_peers():

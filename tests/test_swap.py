@@ -870,6 +870,51 @@ def test_committed_swap_prunes_and_replicates_dead_dest():
         close_all(leader, [], ts)
 
 
+def test_crash_prune_completing_fence_fires_finalize():
+    """The dead dest was the LAST unconfirmed one: the prune itself
+    completes the fence set, so the completion edge (the finalize
+    round releasing the survivors' retained pre-flip trees) must fire
+    from ``crash()`` — no further confirm will ever arrive to fire
+    it."""
+    from distributed_llm_dissemination_tpu.runtime import LeaderNode
+
+    ids = [0, 1, 2, 3]
+    ts, _ = make_transports("inmem", ids)
+    leader = LeaderNode(Node(0, 0, ts[0]), {}, {1: {0: LayerMeta()}},
+                        standbys=[3], lease_interval=0.2, epoch=0)
+    try:
+        with leader._lock:
+            leader._swaps["v2"] = {
+                "version": "v2", "job_id": "j", "swap_base": SWAP_BASE,
+                "dests": [1, 2], "state": "committed",
+                "confirmed": {1}}
+            leader._swaps_by_job["j"] = "v2"
+        finalized = []
+        orig = leader._swap_send_round
+
+        def spy(version, **kw):
+            if kw.get("finalize"):
+                finalized.append(version)
+            orig(version, **kw)
+
+        leader._swap_send_round = spy
+        before = dict(trace.counter_totals())
+        leader.crash(2)
+        assert finalized == ["v2"]
+        assert (trace.counter_totals().get("swap.fleet_flipped", 0)
+                - before.get("swap.fleet_flipped", 0)) == 1
+        # The edge fires ONCE: a later duplicate confirm from the
+        # survivor must not re-run it.
+        from distributed_llm_dissemination_tpu.transport.messages import (
+            SwapCommitMsg,
+        )
+        leader.handle_swap_commit(
+            SwapCommitMsg(1, "v2", applied=True))
+        assert finalized == ["v2"]
+    finally:
+        close_all(leader, [], ts)
+
+
 # --------------------------------------------- headroom staging policy
 
 
@@ -943,3 +988,72 @@ def test_headroom_probe_host_fallback(monkeypatch):
     assert got == _expected_tokens(1, [5, 5], 2)
     # The confirm went leader-ward.
     assert any(getattr(m, "applied", False) for m in r.sent)
+
+
+def test_revert_with_no_preflip_tree_keeps_flipped_tree(monkeypatch):
+    """A replica whose flip WAS its boot (it joined mid-rollout and
+    never served the pre-flip version) refuses a revert instead of
+    restoring a None tree: degraded-but-serving beats a seat that
+    answers nothing (``swap.revert_no_prev``)."""
+    from distributed_llm_dissemination_tpu.parallel import ingest
+    from distributed_llm_dissemination_tpu.runtime.swap import (
+        SwapController,
+    )
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        SwapCommitMsg,
+    )
+
+    monkeypatch.setattr(ingest, "hbm_headroom_bytes", lambda device=None: 0)
+
+    class _R:
+        def __init__(self):
+            from distributed_llm_dissemination_tpu.models import serde
+
+            cfg = _tiny()
+            self.boot_cfg = cfg
+            self.boot_codec = "raw"
+            self._lock = threading.Lock()
+            self._digest_ok = set()
+            self._layer_versions = {}
+            self.layers = {}
+            self.node = type("N", (), {"my_id": 1})()
+            self.sent = []
+            v2 = _model_blobs(1)
+            for b in v2:
+                self.layers[SWAP_BASE + b] = _blob_layer(v2[b])
+                self._layer_versions[SWAP_BASE + b] = "v2"
+            self.head_id = serde.head_blob_id(cfg)
+            self.applied = []
+            # No boot_result: the flip IS this replica's boot.
+
+        def _expected_digest(self, lid):
+            return None
+
+        def _send_to_leader(self, msg):
+            self.sent.append(msg)
+
+        def _apply_swap_result(self, version, params):
+            self.applied.append((version, params))
+            self.boot_result = params
+
+    r = _R()
+    ctl = SwapController(r)
+    ctl.query_interval = 0
+    ctl.on_commit(SwapCommitMsg(0, "v2", swap_base=SWAP_BASE))
+    _wait_for(lambda: r.applied, what="flip-as-boot commit")
+    assert ctl._versions["v2"]["state"] == "committed"
+    before = dict(trace.counter_totals())
+    ctl.on_commit(SwapCommitMsg(0, "v2", swap_base=SWAP_BASE,
+                                abort=True, revert=True))
+    totals = trace.counter_totals()
+    assert (totals.get("swap.revert_no_prev", 0)
+            - before.get("swap.revert_no_prev", 0)) == 1
+    assert (totals.get("swap.reverted", 0)
+            - before.get("swap.reverted", 0)) == 0
+    # Still COMMITTED, still serving the flipped tree, nothing re-
+    # applied, and the retained marker is released (a duplicate revert
+    # stays a no-op).
+    rec = ctl._versions["v2"]
+    assert rec["state"] == "committed" and rec["prev"] is None
+    assert len(r.applied) == 1
+    assert r.boot_result is r.applied[0][1]
