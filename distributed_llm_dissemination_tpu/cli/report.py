@@ -110,6 +110,8 @@ def build(cluster: dict, ttd_s: Optional[float] = None,
           extra: Optional[dict] = None) -> dict:
     """Assemble the report from a folded cluster-telemetry table (the
     shape ``runtime/leader.cluster_telemetry`` returns)."""
+    from ..utils import critical_path as cp
+
     nodes = cluster.get("nodes") or {}
     counters = cluster.get("counters") or {}
     offsets = {}
@@ -159,6 +161,28 @@ def build(cluster: dict, ttd_s: Optional[float] = None,
                   for n, snap in sorted(nodes.items(),
                                         key=lambda kv: str(kv[0]))},
     }
+    # Causal observability (docs/observability.md): the merged span
+    # timeline → the critical-path/attribution section + per-job
+    # waterfalls; the leader-derived fleet health timeline verbatim.
+    spans = cluster.get("spans") or []
+    if spans:
+        span_recs = cp.build_spans(spans, offsets=offsets)
+        report["critical_path"] = cp.analyze(
+            spans, ttd_s=ttd_s, predicted_s=predicted_s,
+            offsets=offsets, spans=span_recs)
+        jobs_seen = sorted({rec.get("job", "")
+                            for rec in span_recs.values()})
+        # Keyed by the job id VERBATIM ("" = the base run) — a job
+        # literally named "base" must not collide with the base run's
+        # waterfall; the renderer labels "" as "base run".
+        report["span_waterfalls"] = {
+            j: cp.waterfall_lines(span_recs, job=j) for j in jobs_seen}
+    health = cluster.get("health") or {}
+    if health.get("events") or health.get("intervals"):
+        report["health"] = {
+            "events": health.get("events") or [],
+            "intervals": health.get("intervals") or [],
+        }
     if extra:
         report.update(extra)
     return _finish(report)
@@ -221,6 +245,11 @@ def build_from_records(records: Iterable[dict],
                           for n, g in gauges.items()},
                 "counters": counters,
                 "links": links,
+                # The dump carries the merged span timeline + health
+                # view (docs/observability.md) — the offline report's
+                # critical-path and health sections read them back.
+                "spans": rec.get("spans") or [],
+                "health": rec.get("health") or {},
             }
         elif msg == "timer start":
             t_start = rec.get("time")
@@ -352,6 +381,83 @@ def render_md(report: dict) -> str:
                 for r in rows)
             lines.append(f"- `{jid}` links ({delivered} B delivered): "
                          f"{per}")
+        lines.append("")
+    cp = report.get("critical_path") or {}
+    if cp.get("chain"):
+        lines += [
+            "## Critical path (docs/observability.md)",
+            "",
+            "The chain of blocking delivery spans whose windows tile "
+            "the achieved TTD; per-phase totals attribute the "
+            "predicted-vs-achieved gap (`idle` is the honest residual "
+            "— wall between chained spans no live span explains).",
+            "",
+            f"Window {_fmt_unit(cp.get('window_s'), 's')} over "
+            f"{len(cp['chain'])} blocking span(s) of "
+            f"{cp.get('spans_seen')} seen · attributed "
+            f"{_fmt_unit(cp.get('attributed_s'), 's')} · idle "
+            f"{_fmt_unit(cp.get('idle_s'), 's')} · TTD coverage "
+            f"{_fmt(cp.get('coverage_frac'))} · unattributed frac "
+            f"{_fmt(cp.get('unattributed_frac'))}",
+            "",
+            "| phase | seconds |",
+            "|---|---|",
+        ]
+        for b, v in sorted((cp.get("phase_totals_s") or {}).items()):
+            lines.append(f"| {b} | {_fmt(v)} |")
+        lines.append("")
+        gap = cp.get("gap_attribution_s") or {}
+        if gap:
+            lines += [
+                f"Predicted {_fmt_unit(cp.get('predicted_s'), 's')} vs "
+                f"achieved {_fmt_unit(cp.get('ttd_s'), 's')} — gap "
+                f"{_fmt_unit(cp.get('gap_s'), 's')} decomposed: "
+                + ", ".join(f"{k}={_fmt(v)}s"
+                            for k, v in sorted(gap.items())),
+                "",
+            ]
+        per_link = cp.get("per_link_wire_s") or {}
+        if per_link:
+            lines += ["Per-link wire seconds on the chain: "
+                      + ", ".join(f"{k}: {_fmt(v)}s"
+                                  for k, v in sorted(per_link.items())),
+                      ""]
+        for entry in cp["chain"]:
+            ph = ", ".join(f"{k}={_fmt(v)}s"
+                           for k, v in (entry.get("phases_s") or {}).items())
+            lines.append(
+                f"- span `{entry['span']}` "
+                f"({_fmt(entry.get('src'))}→{_fmt(entry.get('dest'))}, "
+                f"layer {_fmt(entry.get('layer'))}"
+                + (f", job `{entry['job']}`" if entry.get("job") else "")
+                + f"): {ph}")
+        lines.append("")
+    waterfalls = report.get("span_waterfalls") or {}
+    for jname, rows in sorted(waterfalls.items()):
+        if not rows:
+            continue
+        lines += [f"### Delivery waterfall — "
+                  f"{f'job `{jname}`' if jname else 'base run'}",
+                  ""]
+        lines += [f"- {row}" for row in rows]
+        lines.append("")
+    health = report.get("health") or {}
+    if health.get("events"):
+        lines += [
+            "## Fleet health timeline (docs/observability.md)",
+            "",
+            "Straggler/recovery events derived from per-interval "
+            "deltas of the cumulative metrics reports, with onset "
+            "timestamps (`-watch` printed these live).",
+            "",
+        ]
+        for ev in health["events"]:
+            lines.append(
+                f"- t={_fmt(ev.get('t_ms'))}ms `{ev.get('kind')}` "
+                f"link {ev.get('link')} achieved "
+                f"{_fmt(ev.get('achieved_bps'))} B/s vs modeled "
+                f"{_fmt(ev.get('modeled_bps'))} B/s "
+                f"(frac {_fmt(ev.get('frac'))})")
         lines.append("")
     planes = report.get("planes") or {}
     for plane, doc in (("integrity", "docs/integrity.md"),

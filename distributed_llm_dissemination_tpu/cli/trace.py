@@ -83,6 +83,10 @@ _INSTANT_MESSAGES = {
     # Telemetry plane (docs/observability.md):
     "clock offset estimated",
     "cluster telemetry",
+    # Causal observability (spans + fleet health + live job progress):
+    "fleet health event",
+    "fleet health timeline",
+    "job progress",
 }
 
 
@@ -109,6 +113,56 @@ def _layer_of(rec: dict):
     return None
 
 
+def span_flow_events(records, offsets: dict) -> List[dict]:
+    """Perfetto flow arrows from the pair-lifecycle span timeline
+    (docs/observability.md): the LAST "cluster telemetry" dump carries
+    the merged span events; each span becomes one flow chain — a thin
+    anchor slice per phase on its recording node's row (named
+    ``span <id> <phase>``) plus s/t/f flow events with the span id —
+    so the leader's plan visibly arrows into the sender's dispatch and
+    the dest's receive/verify/stage across process rows."""
+    from ..utils.critical_path import PHASES
+
+    spans_dump = None
+    for rec in records:
+        if rec.get("message") == "cluster telemetry" and rec.get("spans"):
+            spans_dump = rec["spans"]  # last one wins (failover re-dump)
+    if not spans_dump:
+        return []
+    by_span: dict = {}
+    for ev in spans_dump:
+        s, ph, t = ev.get("span"), ev.get("phase"), ev.get("t_ms")
+        if not s or ph not in PHASES or not isinstance(t, (int, float)):
+            continue
+        by_span.setdefault(str(s), {})[ph] = ev
+    events: List[dict] = []
+    for flow_id, (span, phases) in enumerate(sorted(by_span.items()), 1):
+        chain = [phases[p] for p in PHASES if p in phases]
+        if len(chain) < 2:
+            continue
+        for k, ev in enumerate(chain):
+            pid = str(ev.get("node", "?"))
+            ts_us = (float(ev["t_ms"]) + offsets.get(pid, 0.0)) * 1000.0
+            layer = ev.get("layer")
+            tid = int(layer) if layer is not None else 0
+            events.append({
+                "ph": "X", "pid": pid, "tid": tid,
+                "name": f"span {span} {ev['phase']}",
+                "ts": ts_us, "dur": 100.0,  # 0.1 ms anchor slice
+                "args": {k2: v for k2, v in ev.items()
+                         if k2 not in ("t_ms",)},
+            })
+            flow_ph = ("s" if k == 0
+                       else "f" if k == len(chain) - 1 else "t")
+            events.append({
+                "ph": flow_ph, "cat": "span", "id": flow_id,
+                "pid": pid, "tid": tid, "name": f"span {span}",
+                "ts": ts_us + 1.0,
+                **({"bp": "e"} if flow_ph == "f" else {}),
+            })
+    return events
+
+
 def to_trace_events(records: Iterable[dict],
                     align_clocks: bool = True) -> List[dict]:
     """Chrome trace events from merged log records.
@@ -122,7 +176,9 @@ def to_trace_events(records: Iterable[dict],
     unshifted, which is exactly the old behavior."""
     records = list(records)
     offsets = clock_offsets(records) if align_clocks else {}
-    events: List[dict] = []
+    # Flow arrows from the span timeline (docs/observability.md) ride
+    # alongside the log-derived slices; same clock alignment.
+    events: List[dict] = list(span_flow_events(records, offsets))
     seen_pids = set()
     for rec in records:
         msg = rec.get("message")

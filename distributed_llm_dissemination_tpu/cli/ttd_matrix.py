@@ -2450,6 +2450,313 @@ def run_telemetry_overhead(scale: int = 64 << 20, trials: int = 3,
     return out
 
 
+def run_attribution(layer_bytes: int = 8 << 20, n_fast: int = 2,
+                    bw: int = 25_000_000, slow_rate: int = 2 << 20,
+                    timeout: float = 300.0) -> dict:
+    """The causal-observability acceptance row (docs/observability.md):
+    a mode-3 multi-node run — leader 0 seeding ``n_fast`` fast dests
+    plus one dest behind an injected ``slow=`` fault link — whose
+    achieved TTD must be EXPLAINED: the critical-path span chain's
+    window reconciles with the measured TTD within ±10%, the
+    predicted-vs-achieved gap decomposes per phase with no unattributed
+    residual above 15%, and the straggler link appears both in the LIVE
+    health events (onset stamped mid-run) and in the RUN_REPORT
+    critical path's per-link wire split."""
+    from ..core.types import LayerMeta
+    from ..transport.faults import FaultyTransport, rules_from_spec
+    from ..utils import critical_path as cp
+    from ..utils import telemetry
+    from ..utils.provenance import harness_hash
+    from . import report as report_mod
+    from ..runtime import (
+        FlowRetransmitLeaderNode,
+        FlowRetransmitReceiverNode,
+        Node,
+    )
+    from ..runtime import send as send_mod
+    from ..transport import TcpTransport
+
+    telemetry.reset_run()
+    slow_dest = n_fast + 1
+    ids = list(range(n_fast + 2))
+    # Small flow fragments so the throttled link trickles per-interval
+    # progress (the straggler detector judges interval deltas) instead
+    # of landing one late burst.
+    prior_frag = send_mod.FLOW_FRAGMENT_BYTES
+    prior_interval = os.environ.get("DLD_METRICS_INTERVAL_S")
+    send_mod.FLOW_FRAGMENT_BYTES = 256 << 10
+    os.environ["DLD_METRICS_INTERVAL_S"] = "0.25"
+    block = os.urandom(1 << 20)
+
+    def mem_layer(lid: int):
+        from ..core.types import (
+            LayerLocation,
+            LayerSrc,
+            SourceType,
+        )
+
+        reps = (layer_bytes + len(block) - 1) // len(block)
+        data = bytearray((block * reps)[:layer_bytes])
+        data[:8] = lid.to_bytes(8, "big")
+        return LayerSrc(inmem_data=data, data_size=layer_bytes,
+                        meta=LayerMeta(location=LayerLocation.INMEM,
+                                       source_type=SourceType.MEM))
+
+    ts = {i: TcpTransport("127.0.0.1:0") for i in ids}
+    reg = {i: t.get_address() for i, t in ts.items()}
+    for t in ts.values():
+        t.addr_registry.update(reg)
+    _, rules = rules_from_spec(f"slow={slow_rate}@{slow_dest}")
+    leader_t = FaultyTransport(ts[0], rules, seed=11)
+    assignment = {d: {lid: LayerMeta() for lid in range(2)}
+                  for d in range(1, n_fast + 1)}
+    assignment[slow_dest] = {0: LayerMeta()}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, leader_t), {lid: mem_layer(lid) for lid in range(2)},
+        assignment, {i: bw for i in ids}, expected_nodes=set(ids[1:]))
+    dests = [FlowRetransmitReceiverNode(Node(i, 0, ts[i]), {})
+             for i in ids[1:]]
+    try:
+        t0 = time.monotonic()
+        for r in dests:
+            r.announce()
+        leader.ready().get(timeout=timeout)
+        ttd = round(time.monotonic() - t0, 4)
+        predicted = (leader.predicted_ttd_ms or 0) / 1000.0
+        # One more report round so every dest's final span ring lands.
+        leader.await_metrics(newer_than=time.monotonic() - 0.01,
+                             timeout=5.0)
+        table = leader.cluster_telemetry()
+        res = cp.analyze(table["spans"], ttd_s=ttd,
+                         predicted_s=round(predicted, 4))
+        rep = report_mod.build_from_leader(leader, ttd_s=ttd)
+        health_events = leader.health.events()
+        straggler = [e for e in health_events
+                     if e.get("kind") == "straggler_link"
+                     and e.get("link") == f"0->{slow_dest}"]
+        slow_on_chain = any(c.get("dest") == slow_dest
+                            for c in res["chain"])
+        slow_in_links = f"0->{slow_dest}" in res["per_link_wire_s"]
+        coverage = res.get("coverage_frac") or 0.0
+        unattrib = res.get("unattributed_frac")
+        return {
+            "harness_hash": harness_hash(),
+            "backend": "tcp-loopback",
+            "mode": 3,
+            "layer_bytes": layer_bytes,
+            "n_dests": n_fast + 1,
+            "modeled_bw_bps": bw,
+            "slow_link": {"link": f"0->{slow_dest}",
+                          "injected_rate_bps": slow_rate},
+            "ttd_s": ttd,
+            "predicted_s": round(predicted, 4),
+            "critical_path": {
+                "window_s": res["window_s"],
+                "coverage_frac": coverage,
+                "attributed_s": res["attributed_s"],
+                "idle_s": res["idle_s"],
+                "unattributed_frac": unattrib,
+                "phase_totals_s": res["phase_totals_s"],
+                "gap_attribution_s": res.get("gap_attribution_s"),
+                "per_link_wire_s": res["per_link_wire_s"],
+                "chain_spans": [c["span"] for c in res["chain"]],
+            },
+            "reconciles_10pct": bool(abs(coverage - 1.0) <= 0.10),
+            "unattributed_le_15pct": bool(
+                unattrib is not None and unattrib <= 0.15),
+            "straggler_flagged_live": bool(straggler),
+            "straggler_onset_t_ms": (straggler[0]["t_ms"]
+                                     if straggler else None),
+            "straggler_on_critical_path": bool(slow_on_chain
+                                               and slow_in_links),
+            "health_events": health_events,
+            # Dual-backend span correlation + takeover survival are
+            # tier-1-tested; the row names the tests it leans on.
+            "span_correlation_tests":
+                "tests/test_observability.py::"
+                "test_span_chain_full_lifecycle_e2e[inmem|tcp]",
+            "takeover_tests":
+                "tests/test_observability.py::"
+                "test_adopted_leader_still_yields_complete_report + "
+                "test_health_events_and_spans_ride_shadow_replication",
+            "run_report": rep.get("provenance"),
+        }
+    finally:
+        send_mod.FLOW_FRAGMENT_BYTES = prior_frag
+        if prior_interval is None:
+            os.environ.pop("DLD_METRICS_INTERVAL_S", None)
+        else:
+            os.environ["DLD_METRICS_INTERVAL_S"] = prior_interval
+        leader.close()
+        for r in dests:
+            r.close()
+        for t in ts.values():
+            t.close()
+        leader_t.close()
+
+
+def _attribution_md(lines, results) -> None:
+    at = results.get("attribution")
+    if not at:
+        return
+    cp_res = at["critical_path"]
+    phases = ", ".join(f"{k}={v}s"
+                       for k, v in sorted(cp_res["phase_totals_s"].items()))
+    gap = ", ".join(f"{k}={v}s"
+                    for k, v in sorted(
+                        (cp_res.get("gap_attribution_s") or {}).items()))
+    lines += [
+        "## Explainable delivery: critical-path TTD attribution "
+        "(docs/observability.md)",
+        "",
+        f"Mode-3 over loopback TCP: leader 0 seeds {at['n_dests']} "
+        f"dests ({at['layer_bytes'] >> 20} MiB layers, modeled "
+        f"{at['modeled_bw_bps'] / 1e6:.0f} MB/s links); link "
+        f"`{at['slow_link']['link']}` is injected "
+        f"`slow={at['slow_link']['injected_rate_bps']}` "
+        f"({at['slow_link']['injected_rate_bps'] >> 20} MiB/s) — the "
+        "run's whole question is whether the observability plane "
+        "EXPLAINS the resulting TTD without being told about the "
+        "fault.",
+        "",
+        "| bar | value | met |",
+        "|---|---|---|",
+        f"| chain window vs achieved TTD (±10%) | "
+        f"{cp_res['window_s']}s vs {at['ttd_s']}s "
+        f"(coverage {cp_res['coverage_frac']}) | "
+        f"{'yes' if at['reconciles_10pct'] else 'NO'} |",
+        f"| unattributed residual ≤15% | "
+        f"{cp_res['unattributed_frac']} | "
+        f"{'yes' if at['unattributed_le_15pct'] else 'NO'} |",
+        f"| straggler flagged LIVE (health event, onset mid-run) | "
+        f"onset t={at['straggler_onset_t_ms']}ms | "
+        f"{'yes' if at['straggler_flagged_live'] else 'NO'} |",
+        f"| straggler on the RUN_REPORT critical path | chain spans "
+        f"{cp_res['chain_spans']} | "
+        f"{'yes' if at['straggler_on_critical_path'] else 'NO'} |",
+        "",
+        f"Predicted {at['predicted_s']}s vs achieved {at['ttd_s']}s "
+        f"— phase totals on the chain: {phases}.  Gap decomposition: "
+        f"{gap}.  Per-link wire seconds: "
+        + ", ".join(f"{k}: {v}s"
+                    for k, v in sorted(
+                        cp_res["per_link_wire_s"].items()))
+        + " — the injected link carries the excess, as it must.",
+        "",
+        f"Dual-backend span correlation: {at['span_correlation_tests']} "
+        f"(tier-1).  Leader-kill keeping span/health state through "
+        f"takeover: {at['takeover_tests']} (tier-1).  RUN_REPORT "
+        f"provenance `{at.get('run_report')}` (harness "
+        f"`{at.get('harness_hash')}`).",
+        "",
+    ]
+
+
+def run_span_overhead(scale: int = 64 << 20, trials: int = 3,
+                      scenario: str = "bench_8node_llama8b.json",
+                      mode: int = 0,
+                      timeout: float = 600.0) -> dict:
+    """The span recorder's measured cost (docs/observability.md): the
+    same BASELINE scenario with span recording ON (default) vs OFF
+    (``DLD_SPANS=0``) — the PR-6 telemetry-overhead A/B, but with the
+    arms INTERLEAVED (on, off, on, off, …): this container's CFS state
+    drifts 30-50% across minutes (measured: a sequential-arm run read
+    +45% that an off/on/off interleave immediately contradicted), so
+    sequential arms measure the drift, not the knob; adjacent pairs
+    largely cancel it.  Medians per arm + per-pair deltas recorded."""
+    import subprocess as _sp
+
+    out: dict = {"scenario": f"{os.path.splitext(scenario)[0]}"
+                             f"@{scale >> 20}MiB",
+                 "mode": mode, "trials": trials, "retries": 0,
+                 "interleaved": True}
+    with tempfile.TemporaryDirectory() as td:
+        local = os.path.join(td, scenario)
+        _localize_config(os.path.join(CONF_DIR, scenario), local,
+                         scale_to=scale)
+
+        def one_trial(env) -> float:
+            # This container sporadically wedges ONE seat of an 8-node
+            # run in its post-run ack-requeue loop (pre-existing;
+            # reproduced on the unmodified tree) — a hung HARNESS trial
+            # is not a measurement, so it retries bounded and counted,
+            # never silently.
+            for attempt in range(3):
+                try:
+                    return run_once(local, mode, timeout, env=env)
+                except _sp.TimeoutExpired:
+                    out["retries"] += 1
+                    print("trial wedged in the known post-run requeue "
+                          "loop; retrying", file=sys.stderr, flush=True)
+            raise TimeoutError("span-overhead trial wedged 3x")
+
+        arms: dict = {"on": [], "off": []}
+        for k in range(trials):
+            for label, env_val in (("on", "1"), ("off", "0")):
+                env = dict(os.environ)
+                env["DLD_SPANS"] = env_val
+                t = one_trial(env)
+                arms[label].append(t)
+                print(f"spans {label} trial {k}: TTD {t:.3f}s",
+                      file=sys.stderr, flush=True)
+        for label, ts in arms.items():
+            out[label] = {"ttd_s": round(statistics.median(ts), 4),
+                          "all": [round(t, 4) for t in ts]}
+    out["delta_frac"] = round(
+        (out["on"]["ttd_s"] - out["off"]["ttd_s"])
+        / max(out["off"]["ttd_s"], 1e-9), 4)
+    # Per-pair deltas: each pair is two adjacent same-minute runs —
+    # the drift-cancelling view the markdown reports next to the
+    # arm medians.
+    out["pair_deltas"] = [
+        round((a - b) / max(b, 1e-9), 4)
+        for a, b in zip(arms["on"], arms["off"])]
+    return out
+
+
+def _span_overhead_md(lines, results) -> None:
+    ov = results.get("span_overhead")
+    if not ov:
+        return
+    spread_on = ov["on"]["all"]
+    spread = round((max(spread_on) - min(spread_on))
+                   / max(min(spread_on), 1e-9), 3)
+    pairs = ov.get("pair_deltas") or []
+    pair_str = (", ".join(f"{p:+.1%}" for p in pairs)
+                if pairs else "—")
+    lines += [
+        "## Span-recording overhead (docs/observability.md)",
+        "",
+        f"The `{ov['scenario']}` BASELINE scenario (mode {ov['mode']}, "
+        f"{ov['trials']} trial pairs, arms INTERLEAVED on/off/on/off — "
+        "this container's CFS state drifts 30-50% across minutes, so "
+        "sequential arms measure the drift, not the knob) with "
+        "pair-lifecycle span recording ON vs OFF (`DLD_SPANS=0`).  The "
+        "hot path is one bounded-deque append under the registry lock "
+        "per LIFECYCLE EDGE (a handful per delivered layer — not per "
+        "frame), so the expected cost is below this host's noise "
+        "floor:",
+        "",
+        "| spans | TTD (median) | trials | arm delta |",
+        "|---|---|---|---|",
+        f"| on | {ov['on']['ttd_s']}s | {ov['on']['all']} | "
+        f"{ov['delta_frac']:+.1%} |",
+        f"| off (`DLD_SPANS=0`) | {ov['off']['ttd_s']}s | "
+        f"{ov['off']['all']} | — |",
+        "",
+        f"Per-pair (adjacent-run) deltas: {pair_str}.  "
+        f"(on-arm trial spread: {spread:.1%} of the fastest trial"
+        + (f"; {ov['retries']} wedged trial(s) retried — the known "
+           "pre-existing post-run requeue flake, reproduced on the "
+           "unmodified tree" if ov.get("retries") else "")
+        + ".  A delta inside the spread — either sign — is "
+        "indistinguishable from zero on this 2-core CFS-throttled "
+        "container; re-measure on quiet multi-core hardware for a "
+        "tight number.)",
+        "",
+    ]
+
+
 def _telemetry_overhead_md(lines, results) -> None:
     ov = results.get("telemetry_overhead")
     if not ov:
@@ -3342,6 +3649,8 @@ def to_markdown(results: dict) -> str:
                        else "—") + " |")
         lines.append("")
     _telemetry_overhead_md(lines, results)
+    _span_overhead_md(lines, results)
+    _attribution_md(lines, results)
     _failover_md(lines, results)
     _service_md(lines, results)
     _fanout_md(lines, results)
@@ -3415,6 +3724,18 @@ def main(argv=None) -> int:
                         "origin-seeder vs peer-holder refill bytes, "
                         "coverage byte-exactness, and the sub-linear "
                         "origin-bytes bar")
+    p.add_argument("-attribution", action="store_true",
+                   help="also run the explainable-delivery row "
+                        "(docs/observability.md): a mode-3 multi-node "
+                        "run with an injected slow= straggler link — "
+                        "the critical-path span chain must reconcile "
+                        "with the achieved TTD (±10%%), decompose the "
+                        "predicted-vs-achieved gap per phase, and flag "
+                        "the straggler live")
+    p.add_argument("-span-overhead", action="store_true",
+                   help="also measure span recording's TTD cost on a "
+                        "BASELINE scenario (ON vs DLD_SPANS=0; "
+                        "docs/observability.md)")
     p.add_argument("-codec-wire", action="store_true",
                    help="also measure the NEGOTIATED wire codec "
                         "(docs/codec.md): raw-canonical seeders, "
@@ -3543,6 +3864,14 @@ def main(argv=None) -> int:
         results["telemetry_overhead"] = run_telemetry_overhead()
     elif prior_doc and prior_doc.get("telemetry_overhead"):
         results["telemetry_overhead"] = prior_doc["telemetry_overhead"]
+    if args.span_overhead:
+        results["span_overhead"] = run_span_overhead()
+    elif prior_doc and prior_doc.get("span_overhead"):
+        results["span_overhead"] = prior_doc["span_overhead"]
+    if args.attribution:
+        results["attribution"] = run_attribution()
+    elif prior_doc and prior_doc.get("attribution"):
+        results["attribution"] = prior_doc["attribution"]
     if args.failover:
         results["failover"] = run_failover()
     elif prior_doc and prior_doc.get("failover"):

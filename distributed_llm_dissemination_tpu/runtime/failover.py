@@ -157,6 +157,10 @@ class ShadowLeaderState:
         # per-node metric snapshots ride replication too, so a takeover
         # keeps the cluster picture instead of starting blind.
         self.metrics: dict = {}
+        # Fleet health timeline (docs/observability.md): the derived
+        # event ring (straggler onsets and recoveries) — a promoted
+        # standby keeps the health history, not just the raw counters.
+        self.health: dict = {}
         # Job plane (docs/service.md): the admitted-job table (raw
         # replication records, ``sched.jobs.JobManager.record``) and the
         # BASE single-run goal (``assignment`` above is the MERGED
@@ -225,6 +229,7 @@ class ShadowLeaderState:
                 self.boot_enabled = bool(d.get("BootEnabled", True))
                 self.metrics = {int(n): dict(s) for n, s in
                                 (d.get("Metrics") or {}).items()}
+                self.health = dict(d.get("Health") or {})
                 self.jobs = {str(j): dict(rec) for j, rec in
                              (d.get("Jobs") or {}).items()}
                 self.swaps = {str(v): dict(rec) for v, rec in
@@ -339,9 +344,23 @@ class ShadowLeaderState:
                     "gauges": dict(d.get("Gauges") or {}),
                     "links": dict(d.get("Links") or {}),
                     "hists": dict(d.get("Hists") or {}),
+                    "spans": [dict(ev) for ev in d.get("Spans") or []],
                     "t_wall_ms": float(d.get("T", 0.0)),
                     "proc": str(d.get("Proc", "")),
                 }
+            elif k == "health":
+                # Fleet health events append-only (each delta carries
+                # the new events; the snapshot carries the full ring),
+                # BOUNDED like the leader's own ring — a flapping link
+                # in a long service run must not grow shadow memory and
+                # snapshot payloads without limit.
+                from ..utils.telemetry import health_ring_size
+
+                evs = self.health.setdefault("events", [])
+                evs.extend(dict(ev) for ev in d.get("Events") or [])
+                cap = health_ring_size()
+                if len(evs) > cap:
+                    del evs[:-cap]
             elif k == "rollout":
                 # Rollout pipeline records (docs/rollout.md): the full
                 # current record per delta — REPLACE per rollout id.
@@ -366,6 +385,8 @@ class ShadowLeaderState:
                 "failure_timeout": self.failure_timeout,
                 "boot_enabled": self.boot_enabled,
                 "metrics": {n: dict(s) for n, s in self.metrics.items()},
+                "health": {k: list(v) if isinstance(v, list) else dict(v)
+                           for k, v in self.health.items()},
                 "jobs": {j: dict(rec) for j, rec in self.jobs.items()},
                 "swaps": {v: dict(rec) for v, rec in self.swaps.items()},
                 "rollouts": {r: dict(rec)

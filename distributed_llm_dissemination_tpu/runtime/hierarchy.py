@@ -51,7 +51,7 @@ from ..transport.messages import (
     MetricsReportMsg,
     SwapCommitMsg,
 )
-from ..utils import threads, trace
+from ..utils import telemetry, threads, trace
 from ..utils.logging import log
 from .failure import FailureDetector
 from .send import send_layer
@@ -221,6 +221,14 @@ class SubLeaderController:
         return {lid: sorted(members)
                 for lid, members in self._covered.items() if members}
 
+    def _covered_spans(self, covered: Dict[LayerID, list]) -> dict:
+        """The advisory span map riding a coverage push (docs/
+        observability.md): each covered (member, layer)'s fan-out child
+        span id — deterministic, so the root's synthesized acks file
+        ``acked`` events on the members' own spans."""
+        return {lid: {m: telemetry.span_id(m, lid) for m in members}
+                for lid, members in covered.items()}
+
     def handle_group_plan(self, msg: GroupPlanMsg) -> None:
         if self.receiver._fence_stale(msg):
             return
@@ -266,7 +274,7 @@ class SubLeaderController:
                                 for lid in row}))
         # Receipt always answers with full cumulative coverage: this is
         # the reconcile channel a promoted root's first re-plan uses.
-        self._push(covered=covered)
+        self._push(covered=covered, spans=self._covered_spans(covered))
         self._fan_out_ready()
 
     # ---------------------------------------------------- member-facing
@@ -344,7 +352,7 @@ class SubLeaderController:
             trace.count("hier.layer_folds")
             log.info("group layer fully covered; folding upward",
                      group=self.group_id, layerID=msg.layer_id)
-            self._push(covered=push)
+            self._push(covered=push, spans=self._covered_spans(push))
 
     def handle_member_metrics(self, msg: MetricsReportMsg) -> None:
         self.detector.touch(msg.src_id)
@@ -353,6 +361,13 @@ class SubLeaderController:
                 "Counters": dict(msg.counters),
                 "Gauges": dict(msg.gauges),
                 "Links": dict(msg.links),
+                # Hists and span events batch upward too (docs/
+                # observability.md): the root's serve-p99 health view
+                # and critical-path walk need the members' OWN data —
+                # a grouped replica must not go silently blind to the
+                # SLO guard or the span timeline.
+                "Hists": {k: dict(h) for k, h in msg.hists.items()},
+                "Spans": [dict(ev) for ev in msg.spans],
                 "T": msg.t_wall_ms, "Proc": msg.proc}
             self._metrics_dirty = True
             self._metrics_since_push.add(msg.src_id)
@@ -451,7 +466,11 @@ class SubLeaderController:
     def _send_one(self, member: NodeID, lid: LayerID, layer) -> None:
         try:
             self.node.add_node(member)
-            send_layer(self.node, member, lid, layer)
+            # Span correlation (docs/observability.md): the fan-out is
+            # a CHILD span chained under this seat's own (root-planned)
+            # group-ingress pair — the parent tag rides the frames.
+            send_layer(self.node, member, lid, layer,
+                       span_parent=telemetry.span_id(self.node.my_id, lid))
         except (OSError, KeyError, ConnectionError) as e:
             log.warn("group fan-out send failed (redrive will retry)",
                      layerID=lid, member=member, err=repr(e))

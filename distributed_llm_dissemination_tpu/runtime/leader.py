@@ -396,6 +396,12 @@ class LeaderNode:
         # a takeover keeps the cluster picture; the leader's own process
         # metrics are read live from the registry at fold time.
         self.cluster_metrics: Dict[NodeID, dict] = {}
+        # Live fleet health timeline (docs/observability.md): per-
+        # interval deltas of those cumulative snapshots — per-link
+        # throughput/stall/NACK series + first-class straggler events,
+        # derived at every report fold, replicated so a promoted
+        # standby keeps the event history.
+        self.health = telemetry.HealthTimeline()
 
         if integrity.digests_enabled():
             threading.Thread(target=self._compute_own_digests,
@@ -641,8 +647,17 @@ class LeaderNode:
             rep.publish(self.epoch, kind, data)
 
     def _snapshot_payload(self) -> dict:
+        # Health snapshot BEFORE the leader lock: HealthTimeline.observe
+        # calls back into _modeled_link_rate (health lock → leader
+        # lock), so taking the health lock while holding the leader's
+        # would be a lock-order inversion.
+        health = self.health.snapshot()
         with self._lock:
             return {
+                # Fleet health timeline (docs/observability.md): the
+                # event ring + series tail — a promoted standby keeps
+                # the straggler history with onset timestamps.
+                "Health": health,
                 "Mode": self.MODE,
                 "Assignment": _nested_layer_map_to_json(self.assignment),
                 "BaseAssignment": _nested_layer_map_to_json(
@@ -827,6 +842,11 @@ class LeaderNode:
         # the promoted leader resumes the pipeline mid-wave (the SLO
         # guard re-arms in resume_from_takeover).
         self.rollouts.load(shadow.get("rollouts") or {})
+        # Fleet health timeline (docs/observability.md): adopt the dead
+        # leader's event ring verbatim — straggler onset timestamps
+        # survive the takeover; fresh interval deltas re-baseline from
+        # the first post-takeover report round.
+        self.health.ingest((shadow.get("health") or {}).get("events"))
         # Elastic membership (docs/membership.md): adopt the roster so
         # the promoted leader keeps departed members fenced, resumes
         # in-flight drains, and can dial adopted joiners (their
@@ -1293,6 +1313,7 @@ class LeaderNode:
             return
         snap = {"counters": msg.counters, "gauges": msg.gauges,
                 "links": msg.links, "hists": msg.hists,
+                "spans": msg.spans,
                 "t_wall_ms": msg.t_wall_ms,
                 "proc": msg.proc, "_recv_mono": time.monotonic()}
         with self._lock:
@@ -1300,7 +1321,44 @@ class LeaderNode:
         self._replicate("metrics", Node=msg.src_id,
                         Counters=msg.counters, Gauges=msg.gauges,
                         Links=msg.links, Hists=msg.hists,
+                        Spans=msg.spans,
                         T=msg.t_wall_ms, Proc=msg.proc)
+        self._health_observe(msg.src_id, snap, foreign=msg.health)
+
+    def _health_observe(self, node_id: NodeID, snap: dict,
+                        foreign=None) -> None:
+        """Fold one report into the fleet health timeline (docs/
+        observability.md): interval deltas + straggler scoring against
+        the modeled link rates.  New events are logged the moment they
+        are detected — the live channel ``-watch`` surfaces — and
+        replicated (kind "health") so a promoted standby keeps the
+        event history with onset timestamps."""
+        events = self.health.observe(
+            node_id, snap, self._modeled_link_rate,
+            expected_srcs=self._health_expected_srcs(node_id))
+        if foreign:
+            # Advisory reporter-surfaced events (MetricsReportMsg
+            # .health) fold in verbatim, deduplicated by onset.
+            events = list(events) + self.health.ingest(foreign)
+        for ev in events:
+            trace.count(f"telemetry.health_{ev.get('kind', 'event')}")
+            log.warn("fleet health event", **ev)
+            self._replicate("health", Events=[ev])
+
+    def _modeled_link_rate(self, src: NodeID, dest: NodeID) -> int:
+        """The modeled rate (bytes/s) health scoring judges the (src,
+        dest) link against, or 0 to skip.  The base leader has no link
+        model — mode 3 overrides this with the flow solver's inputs,
+        gated to links with an in-flight pair so a completed burst is
+        never mis-read as a straggler."""
+        return 0
+
+    def _health_expected_srcs(self, dest: NodeID):
+        """Sources with dispatched in-flight pairs to ``dest`` — the
+        links health scoring must judge even when their FIRST byte
+        never landed (no snapshot row).  Base leader: none (no link
+        model); mode 3 reads its live-job index."""
+        return ()
 
     def await_metrics(self, newer_than: float = 0.0,
                       timeout: float = 5.0) -> bool:
@@ -1347,6 +1405,7 @@ class LeaderNode:
             "counters": own.get("counters") or {},
             "gauges": own_gauges,
             "links": own.get("links") or {},
+            "spans": own.get("spans") or [],
             # A live registry read is by definition the freshest view
             # of this process — it must beat any shipped report from a
             # co-resident node in the per-process counter fold.
@@ -1356,6 +1415,11 @@ class LeaderNode:
             "nodes": reports,
             "counters": telemetry.fold_counters(reports),
             "links": telemetry.fold_links(reports),
+            # The merged cluster span timeline + the derived fleet
+            # health view (docs/observability.md) — what the critical-
+            # path analyzer and the RUN_REPORT sections consume.
+            "spans": telemetry.fold_spans(reports),
+            "health": self.health.snapshot(),
         }
 
     def dest_bytes_table(self) -> Dict[str, dict]:
@@ -1400,15 +1464,83 @@ class LeaderNode:
     def log_cluster_metrics(self) -> dict:
         """Log (and return) the folded cluster table — the mid-run
         status hook behind ``cli.main -watch`` and the end-of-run dump
-        the offline run report is built from."""
+        the offline run report is built from.  The dump now also
+        carries the merged span timeline + health events (the offline
+        critical-path/health sections read them back), and each active
+        job gets its own live progress line (docs/observability.md)."""
         table = self.cluster_telemetry()
+        health = table.get("health") or {}
         log.info("cluster telemetry",
                  nodes=sorted(table["nodes"]),
                  counters=table["counters"],
                  links=table["links"],
                  gauges={str(n): s.get("gauges") or {}
-                         for n, s in table["nodes"].items()})
+                         for n, s in table["nodes"].items()},
+                 spans=table.get("spans") or [],
+                 health=health)
+        for ev in (health.get("events") or [])[-8:]:
+            log.warn("fleet health timeline", **ev)
+        for jid, row in sorted(self.job_progress().items()):
+            # The -watch per-job LIVE progress line: delivered/total
+            # bytes from the per-job link split, ETA from the job's own
+            # tier pacing (the solver's min-time for its remaining
+            # demand at the last re-plan).
+            log.info("job progress", job=jid, **row)
         return table
+
+    def job_progress(self) -> Dict[str, dict]:
+        """Per-job delivery progress (docs/observability.md):
+        ``delivered_bytes`` summed off the job-tagged link rows of the
+        folded cluster table (the interval-delta data ``-watch``
+        already ships), ``remaining_bytes`` sized from the job's
+        remaining pairs (raw layer sizes — codec/shard-qualified pairs
+        size by their canonical bytes, the honest approximation the
+        docs note), and ``eta_s`` from the job's TIER PACING — the
+        joint solver's min-time budget for exactly this job's remaining
+        demand at the last re-plan."""
+        jobs = getattr(self, "jobs", None)
+        if jobs is None:
+            return {}
+        pairs = jobs.progress_pairs()
+        if not pairs:
+            return {}
+        with self._lock:
+            reports = {n: {k: v for k, v in s.items()
+                           if not k.startswith("_")}
+                       for n, s in self.cluster_metrics.items()}
+            tier_ms = dict(getattr(self, "_tier_time", {}) or {})
+            sizes = {}
+            for row in pairs.values():
+                for _dest, lid in row["remaining"]:
+                    if lid not in sizes:
+                        sizes[lid] = self._layer_size_locked(lid)
+        own = telemetry.snapshot()
+        reports[self.node.my_id] = {"proc": own.get("proc", ""),
+                                    "links": own.get("links") or {},
+                                    "t_wall_ms": time.time() * 1000.0}
+        links = telemetry.fold_links(reports)
+        delivered_by_job: Dict[str, int] = {}
+        for key, row in links.items():
+            job = row.get("job")
+            if job:
+                delivered_by_job[job] = (delivered_by_job.get(job, 0)
+                                         + int(row.get("delivered_bytes")
+                                               or 0))
+        out: Dict[str, dict] = {}
+        for jid, row in pairs.items():
+            remaining_b = sum(sizes.get(lid, 0)
+                              for _d, lid in row["remaining"])
+            delivered_b = delivered_by_job.get(jid, 0)
+            rec = {"state": row["state"], "kind": row["kind"],
+                   "remaining_pairs": len(row["remaining"]),
+                   "total_pairs": row["total_pairs"],
+                   "delivered_bytes": delivered_b,
+                   "total_bytes": delivered_b + remaining_b}
+            eta = tier_ms.get(jid)
+            if row["state"] == "active" and eta:
+                rec["eta_s"] = round(eta / 1000.0, 3)
+            out[jid] = rec
+        return out
 
     def handle_generate_req(self, msg: GenerateReqMsg) -> None:
         """The leader seat serves no model — refuse immediately so a
@@ -3481,8 +3613,11 @@ class LeaderNode:
             row[msg.layer_id] = LayerMeta(location=msg.location,
                                           data_size=size, shard=shard,
                                           version=version, codec=codec)
-            # A delivered (layer, dest) pair needs no more salvage.
+            # A delivered (layer, dest) pair needs no more salvage, and
+            # stops aging for health scoring.
             self._salvaging.discard((msg.layer_id, msg.src_id))
+            getattr(self, "_pair_dispatch_mono", {}).pop(
+                (msg.src_id, msg.layer_id), None)
             # The watchdog stops chasing any plan this ack settles.
             for seq, _rec in list(self._plan_watch.items()):
                 plan = self._sent_plans.get(seq)
@@ -3492,6 +3627,16 @@ class LeaderNode:
         self._replicate("ack", Node=msg.src_id, Layer=msg.layer_id,
                         Location=int(msg.location), Size=size,
                         Shard=shard, Version=version, Codec=codec)
+        # Pair-lifecycle span (docs/observability.md): the delivery's
+        # terminal control edge — staged→acked is the ack-propagation +
+        # leader-handling attribution.  The receiver's advisory SpanId
+        # wins (it is the span its own events filed under); a legacy
+        # ack falls back to the deterministic id.
+        telemetry.span_event(
+            msg.span_id or telemetry.span_id(msg.src_id, msg.layer_id),
+            "acked", node=self.node.my_id, dest=msg.src_id,
+            layer=msg.layer_id, shard=shard, version=version,
+            codec=codec)
         # Content index + job plane: the delivered copy verified against
         # the stamped digest before acking, so the new owner vouches for
         # those bytes; the ack credits every admitted job wanting the
@@ -3851,6 +3996,13 @@ class RetransmitLeaderNode(LeaderNode):
         forward only that byte-range slice (host path only — the fabric
         plane speaks whole layers).  ``codec``: forward the ENCODED form
         (host path only, docs/codec.md)."""
+        # Pair-lifecycle span (docs/observability.md): the pair entered
+        # a concrete plan NOW (modes 0-2's forward command; mode 3's
+        # flow dispatch records its own).
+        telemetry.span_event(telemetry.span_id(dest, layer_id), "planned",
+                             node=self.node.my_id, src=owner, dest=dest,
+                             layer=layer_id, job=job_id, codec=codec,
+                             shard=shard)
         if (not shard and not codec
                 and self._try_fabric_full_layer(layer_id, owner, dest)):
             return
@@ -4727,6 +4879,17 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                          if job.job_id else min_time_ms)
                 rate = rate_for(job.data_size, t_job or min_time_ms)
                 codec = pair_codec.get((dest, job.layer_id), "")
+                # Pair-lifecycle span (docs/observability.md): the pair
+                # entered the solved plan NOW — planned→dispatched is
+                # then the sender-side queueing the critical-path walk
+                # attributes.  One event per flow job; a multi-sender
+                # split's last command wins (the walk reads one planned
+                # edge per pair).
+                telemetry.span_event(
+                    telemetry.span_id(dest, job.layer_id), "planned",
+                    node=self.node.my_id, src=sender, dest=dest,
+                    layer=job.layer_id, job=job.job_id, codec=codec,
+                    bytes=job.data_size)
                 log.debug(
                     "dispatching a job",
                     layer=job.layer_id, sender=sender, rate_mibps=rate >> 20,
@@ -4747,9 +4910,62 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     continue
                 # Salvage index: a dispatched job is live until its
                 # (layer, dest) delivers — crash(sender) consults this
-                # to re-plan only the uncovered byte ranges.
+                # to re-plan only the uncovered byte ranges.  The
+                # dispatch time feeds health scoring: a pair is only
+                # straggler-judged once it has been in flight for a
+                # full metrics interval.
                 with self._lock:
                     self._live_jobs.setdefault(sender, []).append(job)
+                    self.__dict__.setdefault(
+                        "_pair_dispatch_mono", {})[
+                        (dest, job.layer_id)] = time.monotonic()
+
+    def _modeled_link_rate(self, src: NodeID, dest: NodeID) -> int:
+        """Mode 3's health-scoring link model (docs/observability.md):
+        the solver's own inputs — min(src NIC, dest NIC, the serving
+        holder's modeled source rate) — but ONLY while a dispatched
+        (src→dest) flow job's pair is still unsatisfied AND has been in
+        flight for at least one metrics interval.  Both gates exist for
+        honesty: a transfer that completed within one interval averages
+        far below the modeled rate over that interval, and a report
+        racing a just-dispatched (or just-finishing) burst would
+        otherwise mis-read a healthy link as a straggler."""
+        interval = telemetry.metrics_interval() or 2.0
+        with self._lock:
+            dispatch_t = getattr(self, "_pair_dispatch_mono", {})
+            now = time.monotonic()
+            live = None
+            for fj in self._live_jobs.get(src) or ():
+                if fj.dest_id != dest:
+                    continue
+                want = (self.assignment.get(dest) or {}).get(fj.layer_id)
+                if want is None:
+                    continue
+                t0 = dispatch_t.get((dest, fj.layer_id))
+                if t0 is None or now - t0 < interval:
+                    continue  # too young to judge over a full interval
+                held = self.status.get(dest, {}).get(fj.layer_id)
+                if held is None or not satisfies(held, want):
+                    live = fj
+                    break
+            if live is None:
+                return 0
+            bw = getattr(self, "node_network_bw", None) or {}
+            cands = [bw.get(src), bw.get(dest)]
+            meta = self.status.get(src, {}).get(live.layer_id)
+            if meta is not None and meta.limit_rate:
+                cands.append(meta.limit_rate)
+        rates = [int(r) for r in cands if r]
+        return min(rates) if rates else 0
+
+    def _health_expected_srcs(self, dest: NodeID):
+        """Mode 3: every sender with a dispatched live job to ``dest``
+        (the age/satisfaction gates stay in ``_modeled_link_rate`` —
+        an expected src whose pair is too young or already satisfied
+        scores as unmodeled and is skipped)."""
+        with self._lock:
+            return sorted({s for s, jobs in self._live_jobs.items()
+                           if any(fj.dest_id == dest for fj in jobs)})
 
     def crash(self, node_id: NodeID) -> None:
         """Range-level salvage (docs/failover.md): a dead SOURCE's
@@ -5084,7 +5300,10 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
             for lid, members in sorted(msg.covered.items()):
                 for m in members:
                     if int(m) in group_members:
-                        self._apply_member_ack(int(m), int(lid))
+                        self._apply_member_ack(
+                            int(m), int(lid),
+                            span=(msg.spans.get(int(lid)) or {}).get(
+                                int(m), ""))
         for m, snap in sorted(msg.metrics.items()):
             if int(m) in group_members:
                 self._fold_member_metrics(int(m), snap)
@@ -5117,20 +5336,27 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
         if dropped:
             self._replicate("revive", Node=m)
 
-    def _apply_member_ack(self, m: NodeID, lid: LayerID) -> None:
+    def _apply_member_ack(self, m: NodeID, lid: LayerID,
+                          span: str = "") -> None:
         """Apply one aggregated (member, layer) completion.  Reports
         are CUMULATIVE, so already-satisfied pairs short-circuit before
-        touching replication or the job plane."""
+        touching replication or the job plane.  ``span``: the
+        sub-leader's advisory fan-out child span id for the pair
+        (docs/observability.md) — the synthesized ack carries it so the
+        root's ``acked`` event files on the member's own span."""
         with self._lock:
             held = self.status.get(m, {}).get(lid)
             if held is not None and delivered(held):
                 return
-        self.handle_ack(AckMsg(m, lid, LayerLocation.INMEM))
+        self.handle_ack(AckMsg(m, lid, LayerLocation.INMEM, span_id=span))
 
     def _fold_member_metrics(self, member: NodeID, snap: dict) -> None:
         rec = {"counters": dict(snap.get("Counters") or {}),
                "gauges": dict(snap.get("Gauges") or {}),
                "links": dict(snap.get("Links") or {}),
+               "hists": {k: dict(h)
+                         for k, h in (snap.get("Hists") or {}).items()},
+               "spans": [dict(ev) for ev in snap.get("Spans") or []],
                "t_wall_ms": float(snap.get("T", 0.0)),
                "proc": str(snap.get("Proc", "")),
                "_recv_mono": time.monotonic()}
@@ -5138,7 +5364,9 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
             self.cluster_metrics[member] = rec
         self._replicate("metrics", Node=member, Counters=rec["counters"],
                         Gauges=rec["gauges"], Links=rec["links"],
+                        Hists=rec["hists"], Spans=rec["spans"],
                         T=rec["t_wall_ms"], Proc=rec["proc"])
+        self._health_observe(member, rec)
 
     # ------------------------------------------------------- failover
 

@@ -415,11 +415,7 @@ class ReceiverNode:
         self.clock_offset_ms = None
         self._metrics_stop = threading.Event()
         self._metrics_thread = None
-        try:
-            interval = float(os.environ.get("DLD_METRICS_INTERVAL_S",
-                                            "2.0"))
-        except ValueError:
-            interval = 2.0
+        interval = telemetry.metrics_interval()
         self._metrics_interval = interval if telemetry.enabled() else 0.0
         # Corrupt-fragment reports (a frame the transport dropped for a
         # failed CRC, an injected drop, or a TTL-pruned stripe group)
@@ -820,7 +816,11 @@ class ReceiverNode:
             # Fixed-bucket histograms ride too (the rollout pipeline's
             # SLO guard reads per-replica serve latency from them,
             # docs/rollout.md).
-            hists=snap.get("hists") or {})
+            hists=snap.get("hists") or {},
+            # Pair-lifecycle span ring (docs/observability.md):
+            # cumulative like every section — the leader's fold is
+            # replace-per-node.
+            spans=snap.get("spans") or [])
         try:
             self.node.transport.send(self.node.leader_id, msg)
         except (OSError, KeyError) as e:
@@ -1398,6 +1398,7 @@ class ReceiverNode:
         bad) drops the frame and NACKs the sender for a retransmit."""
         with self._lock:
             src = self.layers.get(msg.layer_id)
+        stored = False
         if src is None:
             fresh = msg.layer_src
             if 0 < fresh.data_size < msg.total_size:
@@ -1438,7 +1439,6 @@ class ReceiverNode:
                                         msg.total_size, msg.total_size,
                                         "digest")
                     return
-            stored = False
             with self._lock:
                 src = self.layers.get(msg.layer_id)
                 if src is None:
@@ -1459,11 +1459,33 @@ class ReceiverNode:
                 if codec:
                     self._count_codec_delivery(msg.layer_id,
                                                src.data_size, codec)
+                # Pair-lifecycle span (docs/observability.md): a
+                # whole-layer frame is first byte AND wire completion
+                # in one event pair (and the digest gate above already
+                # passed — the verify cost sits inside the frame walk).
+                span = msg.span_id or telemetry.span_id(
+                    self.node.my_id, msg.layer_id)
+                telemetry.span_event(span, "first_byte",
+                                     node=self.node.my_id,
+                                     src=msg.src_id, dest=self.node.my_id,
+                                     layer=msg.layer_id, job=msg.job_id,
+                                     parent=msg.span_parent)
+                telemetry.span_event(span, "wire_complete",
+                                     node=self.node.my_id,
+                                     src=msg.src_id, dest=self.node.my_id,
+                                     layer=msg.layer_id, job=msg.job_id,
+                                     bytes=src.data_size,
+                                     parent=msg.span_parent)
         log.debug("saved layer in memory", layerID=msg.layer_id)
         loc = self._stage_to_hbm(msg.layer_id, src)
         # Streamed boot staging: this layer's decode + device placement
         # starts NOW, overlapping the remaining layers' transfers.
         self._boot_stream_submit(msg.layer_id, src)
+        if stored:
+            telemetry.span_event(
+                telemetry.span_id(self.node.my_id, msg.layer_id),
+                "staged", node=self.node.my_id, dest=self.node.my_id,
+                layer=msg.layer_id)
         self._send_ack(msg.layer_id, loc)
         # The committed layer may be the donor a stamped-but-missing
         # layer was waiting for (stamp-before-donor race).
@@ -1984,7 +2006,9 @@ class ReceiverNode:
             codec = src.meta.codec if src is not None else ""
         self._send_to_leader(AckMsg(self.node.my_id, layer_id, loc,
                                     shard=shard, version=version,
-                                    codec=codec))
+                                    codec=codec,
+                                    span_id=telemetry.span_id(
+                                        self.node.my_id, layer_id)))
         if self.swap is not None and version:
             self.swap.on_layer(layer_id)
         hook = self.on_layer_complete
@@ -3035,6 +3059,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         journal = False
         dup_done = False
         foreign = False
+        first_frag = False
         with self._lock:
             if lid in self.layers:
                 # A re-plan duplicate of a finished layer: drop the bytes
@@ -3076,6 +3101,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     "t0": _time.monotonic(), "copy_s": 0.0,
                     "ingest_s": 0.0, "frags": 0, "placed": 0})
                 ph["frags"] += 1
+                first_frag = ph["frags"] == 1
                 if placed:
                     # Zero-copy receive: the transport landed this
                     # fragment (possibly one STRIPE of a striped
@@ -3106,6 +3132,15 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                     received=cov.covered_bytes(),
                     total=msg.total_size,
                 )
+        if first_frag:
+            # Pair-lifecycle span (docs/observability.md): the wire is
+            # live — dispatched→first_byte is the transfer's startup
+            # latency, first_byte→wire_complete its streaming window.
+            telemetry.span_event(
+                msg.span_id or telemetry.span_id(self.node.my_id, lid),
+                "first_byte", node=self.node.my_id, src=msg.src_id,
+                dest=self.node.my_id, layer=lid, job=msg.job_id,
+                parent=msg.span_parent)
         if dup_done:
             self._ack_completed(lid)
             return
@@ -3279,7 +3314,7 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             self._partial_total.pop(lid, None)
             self._durable.pop(lid, None)
             self._durable_crcs.pop(lid, None)
-            self._frag_src.pop(lid, None)
+            frag_src = self._frag_src.pop(lid, None)
             self._frag_t.pop(lid, None)
             ph = self._phase.pop(lid, None)
         if codec:
@@ -3297,6 +3332,10 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 "placed_fragments": ph.get("placed", 0),
                 "gbps": round(total / max(span, 1e-9) / 1e9, 3),
             }
+        telemetry.span_event(
+            telemetry.span_id(self.node.my_id, lid), "wire_complete",
+            node=self.node.my_id, src=frag_src, dest=self.node.my_id,
+            layer=lid, bytes=total, codec=codec, shard=spec)
         log.info("layer fully received", layer=lid, total_bytes=total,
                  **extra)
         return True
@@ -3317,6 +3356,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             return
         if not self._digest_gate(lid, src):
             return
+        # Pair-lifecycle span (docs/observability.md): the integrity
+        # gate passed — wire_complete→verified is the digest cost (zero
+        # when no digest was stamped; the phase collapses in the walk).
+        span = telemetry.span_id(self.node.my_id, lid)
+        telemetry.span_event(span, "verified", node=self.node.my_id,
+                             dest=self.node.my_id, layer=lid)
         with self._ingests_lock:
             self._ingest_done.add(lid)
             ing = self._ingests.pop(lid, None)
@@ -3333,6 +3378,9 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
             # Mid-wire boot staging: this layer's decode/upload overlaps
             # the layers still on the wire (runtime/stream_boot.py).
             self._boot_stream_submit(lid, src)
+        telemetry.span_event(span, "staged", node=self.node.my_id,
+                             dest=self.node.my_id, layer=lid,
+                             shard=shard)
         self._send_ack(lid, loc, shard=shard)
         # Stamp-before-donor race: this completed layer may be the
         # donor a stamped-but-missing layer was waiting for.

@@ -77,7 +77,7 @@ def _codec_view(layer: LayerSrc, layer_id: LayerID, codec: str,
 
 def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
                job_id: str = "", shard: str = "", codec: str = "",
-               codecs=None) -> None:
+               codecs=None, span_parent: str = "") -> None:
     """Send one full layer to ``dest``; client-held layers are fetched via
     the pipe mechanism instead (node.go:354-365).  ``job_id`` tags the
     frames with the admitted dissemination job they serve ("" = the base
@@ -108,6 +108,14 @@ def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
         return
     if codec:
         trace.count("codec.wire_sends")
+    # Pair-lifecycle span (docs/observability.md): the send begins NOW
+    # — the frames carry the advisory id (+ the parent tag for
+    # sub-leader fan-out children) for cross-node correlation.
+    span = telemetry.span_id(dest, layer_id)
+    telemetry.span_event(span, "dispatched", node=node.my_id,
+                         src=node.my_id, dest=dest, layer=layer_id,
+                         job=job_id, codec=codec, shard=shard,
+                         parent=span_parent)
     if shard:
         off, size = shard_range(shard, view.data_size)
         sub = _sub_layer_src(view, _sendable_location(view), off, size,
@@ -115,12 +123,14 @@ def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
         trace.count("shard.range_sends")
         node.transport.send(
             dest, LayerMsg(node.my_id, layer_id, sub, view.data_size,
-                           job_id=job_id, shard=shard, codec=codec)
+                           job_id=job_id, shard=shard, codec=codec,
+                           span_id=span, span_parent=span_parent)
         )
         return
     node.transport.send(
         dest, LayerMsg(node.my_id, layer_id, view, view.data_size,
-                       job_id=job_id, codec=codec)
+                       job_id=job_id, codec=codec,
+                       span_id=span, span_parent=span_parent)
     )
 
 
@@ -295,7 +305,11 @@ class NackRetransmitter:
         node.transport.send(
             msg.src_id,
             LayerMsg(node.my_id, msg.layer_id, sub, view.data_size,
-                     codec=codec),
+                     codec=codec,
+                     # Tag only: a retransmit serves the pair's EXISTING
+                     # span — re-recording "dispatched" here would
+                     # falsely shift the span's wire window.
+                     span_id=telemetry.span_id(msg.src_id, msg.layer_id)),
         )
         return True
 
@@ -541,6 +555,14 @@ def handle_flow_retransmit(
 
     send_loc = _sendable_location(view)
     if send_loc in (LayerLocation.INMEM, LayerLocation.DISK):
+        # Pair-lifecycle span (docs/observability.md): the command left
+        # the sender's queue NOW — planned→dispatched is the queueing
+        # attribution the critical-path walk charges to this sender.
+        span = telemetry.span_id(msg.dest_id, msg.layer_id)
+        telemetry.span_event(span, "dispatched", node=node.my_id,
+                             src=node.my_id, dest=msg.dest_id,
+                             layer=msg.layer_id, job=msg.job_id,
+                             codec=codec, bytes=msg.data_size)
         frag_bytes = _fragment_bytes(msg.rate)
         sent = 0
         while sent < msg.data_size:
@@ -558,7 +580,7 @@ def handle_flow_retransmit(
             node.transport.send(
                 msg.dest_id,
                 LayerMsg(node.my_id, msg.layer_id, partial, view.data_size,
-                         job_id=msg.job_id, codec=codec),
+                         job_id=msg.job_id, codec=codec, span_id=span),
             )
             sent += n
     elif layer.meta.location == LayerLocation.CLIENT:

@@ -43,7 +43,7 @@ import threading
 import time
 from typing import Dict, Optional
 
-from ..utils import trace
+from ..utils import telemetry, trace
 from ..utils.logging import log
 
 # States of one tracked version at this node.
@@ -428,6 +428,8 @@ class SwapController:
             # arriving from here on sees COMMITTED and refuses, loudly.
             rec["state"] = COMMITTED
             rec["flip_pending"] = False
+            flip_slots = sorted(rec["per_slot"]) or sorted(per_slot)
+            flip_base = rec["swap_base"]
             # The flipped-in tree owns the staged leaves now.
             rec["per_slot"] = {}
             rec["head"] = None
@@ -446,6 +448,18 @@ class SwapController:
         self.r._apply_swap_result(version, params)
         dt = time.monotonic() - t0
         trace.count("swap.flips")
+        # Pair-lifecycle spans (docs/observability.md): each staged v2
+        # pair's terminal edge for swap/rollout pairs — acked→flipped is
+        # the commit-fence propagation + flip cost the critical-path
+        # walk attributes to the rollout plane.
+        if flip_base >= 0:
+            for slot in flip_slots:
+                telemetry.span_event(
+                    telemetry.span_id(self.r.node.my_id,
+                                      flip_base + slot),
+                    "flipped", node=self.r.node.my_id,
+                    dest=self.r.node.my_id, layer=flip_base + slot,
+                    version=version)
         log.info("swap committed: serving flipped atomically",
                  version=version, flip_ms=round(dt * 1000, 1),
                  host_staged_blobs=n_host)

@@ -349,6 +349,18 @@ class JobManager:
                       if j.state == ACTIVE]
         return merge_assignments(base, extras)
 
+    def progress_pairs(self) -> Dict[str, dict]:
+        """Per-job remaining (dest, layer) pairs + totals — the raw
+        material of the leader's ``-watch`` live progress lines (docs/
+        observability.md): the leader sizes the pairs into bytes and
+        stamps the tier-pacing ETA."""
+        with self._lock:
+            return {jid: {"state": job.state,
+                          "remaining": sorted(job.remaining),
+                          "total_pairs": job.total_pairs,
+                          "priority": job.priority, "kind": job.kind}
+                    for jid, job in self._jobs.items()}
+
     def table(self) -> Dict[str, dict]:
         with self._lock:
             return {jid: self._jobs[jid].summary()

@@ -129,6 +129,8 @@ class InmemTransport(Transport):
             job_id=message.job_id,
             shard=message.shard,
             codec=message.codec,
+            span_id=message.span_id,
+            span_parent=message.span_parent,
         )
         with self._lock:
             pipe_dest = self._pipes.pop(message.layer_id, None)

@@ -305,6 +305,11 @@ class AckMsg:
     shard: str = ""
     version: str = ""
     codec: str = ""
+    # Advisory pair-lifecycle span id (docs/observability.md): the span
+    # this delivery's receiver-side events filed under, echoed so the
+    # leader's ``acked`` event correlates without re-derivation.  ""
+    # (every pre-span peer) omits the key — the legacy wire format.
+    span_id: str = ""
 
     msg_type = MsgType.ACK
 
@@ -320,6 +325,8 @@ class AckMsg:
             payload["Version"] = str(self.version)
         if self.codec:
             payload["Codec"] = str(self.codec)
+        if self.span_id:
+            payload["SpanId"] = str(self.span_id)
         return payload
 
     @classmethod
@@ -331,6 +338,7 @@ class AckMsg:
             shard=str(d.get("Shard", "")),
             version=str(d.get("Version", "")),
             codec=str(d.get("Codec", "")),
+            span_id=str(d.get("SpanId", "")),
         )
 
 
@@ -487,6 +495,14 @@ class LayerMsg:
     # channel; the tag is the fallback identity when no stamp arrived
     # (digests disabled), so encoded bytes are never stored as raw.
     codec: str = ""
+    # Advisory pair-lifecycle span correlation (docs/observability.md):
+    # the span id this transfer's events file under, and — for a
+    # sub-leader fan-out child — the PARENT span (the root-planned
+    # group-ingress pair) the child chains beneath.  Both "" at default
+    # and telemetry-only: a dropped tag only costs the receiver its
+    # recomputation of the deterministic id.
+    span_id: str = ""
+    span_parent: str = ""
 
     msg_type = MsgType.LAYER
 
@@ -536,6 +552,12 @@ class LayerHeader:
     # Wire-codec tag (omitted when ""; docs/codec.md): the encoded form
     # this frame's payload — and byte coordinates — are in.
     codec: str = ""
+    # Advisory span correlation tags (omitted when "";
+    # docs/observability.md): the pair-lifecycle span this frame's
+    # bytes serve, plus the parent span for sub-leader fan-out children.
+    # A peer predating the fields ignores them.
+    span_id: str = ""
+    span_parent: str = ""
 
     def to_payload(self) -> dict:
         payload = {
@@ -561,6 +583,10 @@ class LayerHeader:
             payload["Shard"] = str(self.shard)
         if self.codec:
             payload["Codec"] = str(self.codec)
+        if self.span_id:
+            payload["SpanId"] = str(self.span_id)
+        if self.span_parent:
+            payload["SpanParent"] = str(self.span_parent)
         return payload
 
     @classmethod
@@ -581,6 +607,8 @@ class LayerHeader:
             str(d.get("Job", "")),
             str(d.get("Shard", "")),
             str(d.get("Codec", "")),
+            str(d.get("SpanId", "")),
+            str(d.get("SpanParent", "")),
         )
 
 
@@ -1152,6 +1180,18 @@ class MetricsReportMsg:
     # leader computes per-replica p99 serve latency from the shipped
     # buckets.  Omitted when empty (every pre-rollout reporter).
     hists: dict = dataclasses.field(default_factory=dict)
+    # Pair-lifecycle span events (docs/observability.md): the node's
+    # bounded span ring, cumulative like every other section — the
+    # leader's fold is replace-per-node.  Omitted when empty (spans
+    # disabled, or a pre-span reporter).
+    spans: list = dataclasses.field(default_factory=list)
+    # Advisory locally-detected health events (docs/observability.md):
+    # a reporter MAY surface anomaly events for the leader's fleet
+    # health timeline to ingest verbatim.  Nothing in this repo
+    # populates it from plain receivers today — the timeline is
+    # leader-derived — but the section rides the wire so aggregating
+    # seats can.  Omitted when empty.
+    health: list = dataclasses.field(default_factory=list)
 
     msg_type = MsgType.METRICS_REPORT
 
@@ -1173,6 +1213,10 @@ class MetricsReportMsg:
         if self.hists:
             payload["Hists"] = {str(k): dict(h)
                                 for k, h in self.hists.items()}
+        if self.spans:
+            payload["Spans"] = [dict(ev) for ev in self.spans]
+        if self.health:
+            payload["Health"] = [dict(ev) for ev in self.health]
         if self.t_wall_ms:
             payload["T"] = float(self.t_wall_ms)
         return _epoch_to_payload(payload, self.epoch)
@@ -1191,6 +1235,8 @@ class MetricsReportMsg:
             int(d.get("Epoch", -1)),
             str(d.get("Proc", "")),
             {str(k): dict(h) for k, h in (d.get("Hists") or {}).items()},
+            [dict(ev) for ev in d.get("Spans") or []],
+            [dict(ev) for ev in d.get("Health") or []],
         )
 
 
@@ -1577,6 +1623,12 @@ class GroupStatusMsg:
     announced: dict = dataclasses.field(default_factory=dict)
     dead: list = dataclasses.field(default_factory=list)
     metrics: dict = dataclasses.field(default_factory=dict)
+    # Advisory span correlation for the aggregated coverage
+    # (docs/observability.md): ``{layer: {member: span_id}}`` — the
+    # sub-leader's fan-out child span per covered (member, layer), so
+    # the root's ``acked`` events chain the members under the planned
+    # group-ingress spans.  Omitted when empty (every pre-span peer).
+    spans: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.GROUP_STATUS
 
@@ -1595,6 +1647,10 @@ class GroupStatusMsg:
         if self.metrics:
             payload["Metrics"] = {str(m): dict(snap)
                                   for m, snap in self.metrics.items()}
+        if self.spans:
+            payload["Spans"] = {
+                str(lid): {str(m): str(s) for m, s in per.items()}
+                for lid, per in self.spans.items()}
         return payload
 
     @classmethod
@@ -1609,6 +1665,8 @@ class GroupStatusMsg:
             dead=[int(m) for m in d.get("Dead") or []],
             metrics={int(m): dict(snap)
                      for m, snap in (d.get("Metrics") or {}).items()},
+            spans={int(lid): {int(m): str(s) for m, s in per.items()}
+                   for lid, per in (d.get("Spans") or {}).items()},
         )
 
 

@@ -738,7 +738,9 @@ class TcpTransport(Transport):
                                      header.total_size,
                                      job_id=header.job_id,
                                      shard=header.shard,
-                                     codec=header.codec))
+                                     codec=header.codec,
+                                     span_id=header.span_id,
+                                     span_parent=header.span_parent))
             return
         buf = alloc_recv_buffer(header.layer_size)
         view = memoryview(buf)
@@ -785,7 +787,9 @@ class TcpTransport(Transport):
         self._queue.put(
             LayerMsg(header.src_id, header.layer_id, layer_src,
                      header.total_size, job_id=header.job_id,
-                     shard=header.shard, codec=header.codec)
+                     shard=header.shard, codec=header.codec,
+                     span_id=header.span_id,
+                     span_parent=header.span_parent)
         )
 
     # --------------------------------------------------------- striped rx
@@ -922,7 +926,9 @@ class TcpTransport(Transport):
                     header.src_id, header.layer_id, src, header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
                     stripe_off=header.stripe_off, job_id=header.job_id,
-                    shard=header.shard, codec=header.codec))
+                    shard=header.shard, codec=header.codec,
+                    span_id=header.span_id,
+                    span_parent=header.span_parent))
                 return
             if self.layer_sink is not None:
                 # Sink present but declined (duplicate/overlap/finished):
@@ -944,7 +950,9 @@ class TcpTransport(Transport):
                     header.total_size,
                     stripe_idx=header.stripe_idx, stripe_n=header.stripe_n,
                     stripe_off=header.stripe_off, job_id=header.job_id,
-                    shard=header.shard, codec=header.codec))
+                    shard=header.shard, codec=header.codec,
+                    span_id=header.span_id,
+                    span_parent=header.span_parent))
                 return
             # No sink: regroup stripes into the original logical payload
             # so un-striped consumers (mode-0/1/2 receivers, raw
@@ -1020,7 +1028,9 @@ class TcpTransport(Transport):
                     done["total"],
                     stripe_idx=0, stripe_n=1, stripe_off=0,
                     job_id=header.job_id,
-                    shard=header.shard, codec=header.codec))
+                    shard=header.shard, codec=header.codec,
+                    span_id=header.span_id,
+                    span_parent=header.span_parent))
         finally:
             if pipe_sock is not None:
                 pipe_sock.close()
@@ -1311,7 +1321,9 @@ class TcpTransport(Transport):
                     dest,
                     LayerMsg(message.src_id, message.layer_id, sub,
                              message.total_size, job_id=message.job_id,
-                             shard=message.shard, codec=message.codec),
+                             shard=message.shard, codec=message.codec,
+                             span_id=message.span_id,
+                             span_parent=message.span_parent),
                     stripe=stripe)
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
@@ -1365,6 +1377,8 @@ class TcpTransport(Transport):
             job_id=message.job_id,
             shard=message.shard,
             codec=message.codec,
+            span_id=message.span_id,
+            span_parent=message.span_parent,
         )
         if stripe is not None:
             header.stripe_idx = stripe["idx"]

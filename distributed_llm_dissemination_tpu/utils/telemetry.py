@@ -32,10 +32,12 @@ report (``cli/report.py``).
 
 from __future__ import annotations
 
+import collections
 import os
 import secrets
 import threading
-from typing import Dict, Optional, Tuple
+import time as _time
+from typing import Dict, List, Optional, Tuple
 
 # Process identity for snapshot folding: every snapshot carries this
 # token, and ``fold_counters`` counts ONE snapshot per distinct token.
@@ -76,6 +78,50 @@ def _links_enabled() -> bool:
     return os.environ.get("DLD_TELEMETRY", "1") != "0"
 
 
+# ------------------------------------------------- pair lifecycle spans
+
+# The causal span vocabulary (docs/observability.md): every delivery
+# pair's lifecycle is a chain of these phases, recorded where each
+# transition actually happens — ``planned``/``acked`` at the leader,
+# ``dispatched`` at the sender, ``first_byte``/``wire_complete``/
+# ``verified``/``staged`` at the dest, ``flipped`` at a swap/rollout
+# replica.  ``utils/critical_path.py`` walks the chain; the tier-1
+# static drift check pins each name to a live ``span_event`` call site,
+# so a renamed phase can't silently vanish from the critical-path walk.
+SPAN_PHASES: Tuple[str, ...] = (
+    "planned", "dispatched", "first_byte", "wire_complete",
+    "verified", "staged", "acked", "flipped")
+
+
+def spans_enabled() -> bool:
+    """Span recording's own kill switch (``DLD_SPANS=0`` — the overhead
+    A/B knob) on top of the telemetry master switch: spans are part of
+    the flight recorder, so ``DLD_TELEMETRY=0`` silences them too."""
+    return (os.environ.get("DLD_SPANS", "1") != "0") and _links_enabled()
+
+
+def span_ring_size() -> int:
+    """Bounded span ring capacity per registry (``DLD_SPAN_RING``).
+    Oldest events drop first — the honest limit docs/observability.md
+    records; ``telemetry.spans_dropped`` counts every drop."""
+    try:
+        return max(64, int(os.environ.get("DLD_SPAN_RING", "4096")))
+    except ValueError:
+        return 4096
+
+
+def span_id(dest, layer) -> str:
+    """The deterministic span id of one delivery pair, ``"dest.layer"``.
+    Every participant — the planning leader, the commanded sender, the
+    receiving dest — can mint it from what it already knows, so span
+    correlation works even when the advisory wire tag (``SpanId`` on
+    LayerHeader/AckMsg) was dropped by a legacy peer.  Qualified pairs
+    (shard/codec/version) share the pair's span and carry the
+    qualifiers as event fields — one (dest, layer) is one delivery
+    story."""
+    return f"{int(dest)}.{int(layer)}"
+
+
 class Telemetry:
     """One run's metric state.  All methods are thread-safe."""
 
@@ -92,6 +138,10 @@ class Telemetry:
         # files on its own row, so per-job splits are an additive view
         # of the base totals, never a replacement (docs/service.md).
         self._links: Dict[Tuple[int, int, str], Dict[str, float]] = {}
+        # Pair-lifecycle span events (docs/observability.md): a bounded
+        # ring of {"span", "phase", "t_ms", "node", ...} dicts.  Sized
+        # lazily at first event so tests can flip DLD_SPAN_RING.
+        self._spans: Optional[collections.deque] = None
 
     # ------------------------------------------------------------ scalars
 
@@ -130,6 +180,42 @@ class Telemetry:
             h["buckets"][idx] += 1
             h["sum_ms"] += ms
             h["n"] += 1
+
+    # -------------------------------------------------------------- spans
+
+    def span_event(self, span: str, phase: str, node=None,
+                   **fields) -> None:
+        """Record one pair-lifecycle span transition (docs/
+        observability.md).  ``span`` is the pair's span id
+        (``span_id(dest, layer)`` — or a sub-leader fan-out child's);
+        ``phase`` one of ``SPAN_PHASES``; ``node`` the seat where the
+        transition happened; extra fields (src, dest, layer, job,
+        bytes, codec, shard, version, parent) are attached verbatim.
+        Bounded: the ring drops oldest (``telemetry.spans_dropped``
+        counts), so a long service run degrades to a recent window
+        instead of growing without bound."""
+        if not spans_enabled():
+            return
+        ev = {"span": str(span), "phase": str(phase),
+              "t_ms": round(_time.time() * 1000.0, 3)}
+        if node is not None:
+            ev["node"] = int(node)
+        for k, v in fields.items():
+            if v or v == 0 and k in ("src", "dest", "layer"):
+                ev[k] = v
+        with self._lock:
+            ring = self._spans
+            if ring is None:
+                ring = self._spans = collections.deque(
+                    maxlen=span_ring_size())
+            if len(ring) == ring.maxlen:
+                self._counters["telemetry.spans_dropped"] = (
+                    self._counters.get("telemetry.spans_dropped", 0) + 1)
+            ring.append(ev)
+
+    def span_events(self) -> List[dict]:
+        with self._lock:
+            return [dict(ev) for ev in (self._spans or ())]
 
     # -------------------------------------------------------------- links
 
@@ -182,6 +268,7 @@ class Telemetry:
                         for k, v in sorted(fields.items())}
                     for (s, d, j), fields in sorted(self._links.items())
                 },
+                "spans": [dict(ev) for ev in (self._spans or ())],
             }
 
     def counter_totals(self) -> dict:
@@ -202,6 +289,7 @@ class Telemetry:
             self._phases.clear()
             self._hists.clear()
             self._links.clear()
+            self._spans = None
 
     def reset_phases(self) -> None:
         with self._lock:
@@ -241,6 +329,14 @@ def observe_ms(name: str, ms: float) -> None:
 
 def link_add(src, dest, **fields) -> None:
     _default.link_add(src, dest, **fields)
+
+
+def span_event(span: str, phase: str, node=None, **fields) -> None:
+    _default.span_event(span, phase, node=node, **fields)
+
+
+def span_events() -> List[dict]:
+    return _default.span_events()
 
 
 def snapshot() -> dict:
@@ -342,12 +438,11 @@ def fold_links(reports: Dict[int, dict],
     return out
 
 
-def fold_counters(reports: Dict[int, dict],
-                  local: Optional[dict] = None) -> Dict[str, int]:
-    """Sum event counters into cluster totals, counting ONE snapshot
-    per process (``PROC_TOKEN``): co-resident nodes report cumulative
-    views of the same shared registry, and summing those would multiply
-    every total by the node count.  Per process the FRESHEST snapshot
+def _freshest_per_proc(reports: Dict[int, dict],
+                       local: Optional[dict]) -> List[dict]:
+    """The ONE snapshot per process token (``PROC_TOKEN``) every
+    cluster fold dedups by: co-resident nodes report cumulative views
+    of the same shared registry, so per process the FRESHEST snapshot
     wins (max ``t_wall_ms``; a ``local`` live read beats any shipped
     report from the same process).  Legacy reports without a token
     count per node, the pre-token behavior."""
@@ -363,8 +458,283 @@ def fold_counters(reports: Dict[int, dict],
         admit(snap.get("proc") or ("node", node_id), snap)
     if local is not None:
         admit(local.get("proc") or ("local",), local, force=True)
+    return list(by_proc.values())
+
+
+def fold_counters(reports: Dict[int, dict],
+                  local: Optional[dict] = None) -> Dict[str, int]:
+    """Sum event counters into cluster totals over one snapshot per
+    process (``_freshest_per_proc`` — summing co-resident views would
+    multiply every total by the node count)."""
     out: Dict[str, int] = {}
-    for snap in by_proc.values():
+    for snap in _freshest_per_proc(reports, local):
         for name, v in (snap.get("counters") or {}).items():
             out[name] = out.get(name, 0) + int(v)
     return dict(sorted(out.items()))
+
+
+def fold_spans(reports: Dict[int, dict],
+               local: Optional[dict] = None) -> List[dict]:
+    """Merge per-node snapshots' span-event rings into one cluster
+    timeline over one snapshot per process (``_freshest_per_proc`` —
+    co-resident nodes report the same shared ring, so concatenating
+    them would duplicate every event).  Events sort by wall time;
+    correlation across nodes is the span id itself
+    (docs/observability.md)."""
+    out: List[dict] = []
+    for snap in _freshest_per_proc(reports, local):
+        out.extend(dict(ev) for ev in (snap.get("spans") or ()))
+    out.sort(key=lambda ev: ev.get("t_ms", 0.0))
+    return out
+
+
+# ---------------------------------------------- live fleet health timeline
+
+
+def metrics_interval() -> float:
+    """The MetricsReportMsg period (``DLD_METRICS_INTERVAL_S``, default
+    2 s; 0 disables shipping) — the ONE parse the reporter thread and
+    the health plane's in-flight age gate both read."""
+    try:
+        return float(os.environ.get("DLD_METRICS_INTERVAL_S", "2.0"))
+    except ValueError:
+        return 2.0
+
+
+def straggler_threshold() -> float:
+    """Achieved/modeled link-rate fraction below which a transferring
+    link counts as straggling (``DLD_STRAGGLER_FRAC``)."""
+    try:
+        return float(os.environ.get("DLD_STRAGGLER_FRAC", "0.5"))
+    except ValueError:
+        return 0.5
+
+
+def straggler_sustain() -> int:
+    """Consecutive breaching metrics intervals before a straggler event
+    fires (``DLD_STRAGGLER_N``; default 1 — onset within one
+    interval)."""
+    try:
+        return max(1, int(os.environ.get("DLD_STRAGGLER_N", "1")))
+    except ValueError:
+        return 1
+
+
+def health_ring_size() -> int:
+    """Bounded interval-series / event ring capacity
+    (``DLD_HEALTH_RING``); oldest drop first."""
+    try:
+        return max(16, int(os.environ.get("DLD_HEALTH_RING", "512")))
+    except ValueError:
+        return 512
+
+
+class HealthTimeline:
+    """The leader-side live fleet health derivation (docs/
+    observability.md): per-interval DELTAS of each node's cumulative
+    ``MetricsReportMsg`` snapshots, folded into a bounded ring of
+    time-series — per-link throughput, stall split, NACK/CRC-drop rate,
+    per-node serve p99 (the PR-13 hists) — plus first-class STRAGGLER
+    events: a link whose achieved rate sustains below
+    ``straggler_threshold()`` × its modeled rate while a transfer is
+    actually in flight is flagged with an onset timestamp, un-flagged
+    when it recovers.  All methods thread-safe; state is plain dicts so
+    it replicates through ``ControlDeltaMsg`` and a promoted standby
+    keeps the picture."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prev: Dict[int, dict] = {}       # node -> last snapshot
+        self._series = collections.deque(maxlen=health_ring_size())
+        self._events = collections.deque(maxlen=health_ring_size())
+        self._breach: Dict[str, int] = {}      # link key -> consecutive
+        self._flagged: Dict[str, float] = {}   # link key -> onset t_ms
+        self._seen: set = set()                # ingest dedup keys
+
+    # ------------------------------------------------------------ intake
+
+    def observe(self, node_id: int, snap: dict,
+                modeled_rate_fn=None, expected_srcs=()) -> List[dict]:
+        """Fold one node's cumulative snapshot; returns NEW events.
+
+        Links are scored from the DEST's report only (the rx-owner of
+        ``delivered_bytes`` — co-resident registries would otherwise
+        double-count) and only against base rows (per-job rows are an
+        additive split).  ``modeled_rate_fn(src, dest)`` returns the
+        modeled link rate in bytes/s, or 0 to skip scoring — the mode-3
+        leader returns 0 for links with no in-flight pair, so a
+        completed burst is never mis-read as a straggler.
+
+        ``expected_srcs``: sources the caller KNOWS have in-flight
+        pairs to this dest — a link so stalled its FIRST byte never
+        landed has no snapshot row at all, and would otherwise be
+        invisible to scoring (found hand-driving a whole-layer frame
+        through a throttled link: the frame completes or nothing does).
+        Absent rows for expected sources score as zero-rate
+        intervals."""
+        t_now = float(snap.get("t_wall_ms") or 0.0)
+        new_events: List[dict] = []
+        with self._lock:
+            prev = self._prev.get(int(node_id))
+            self._prev[int(node_id)] = snap
+            if prev is None:
+                return []
+            dt = (t_now - float(prev.get("t_wall_ms") or 0.0)) / 1000.0
+            if dt <= 0:
+                return []
+            links: Dict[str, dict] = {}
+
+            def score(key, src, dest, rec, d_bytes):
+                modeled = 0
+                if modeled_rate_fn is not None:
+                    try:
+                        modeled = int(modeled_rate_fn(src, dest) or 0)
+                    except Exception:  # noqa: BLE001 — advisory
+                        modeled = 0
+                if modeled <= 0:
+                    # Unscored (no model, or nothing in flight any
+                    # more): the breach streak AND the flag end here —
+                    # a later transfer's breaches must not inherit this
+                    # one's count, a flag held past its transfer would
+                    # suppress the next transfer's straggler event, and
+                    # a much-later recovery would carry a stale onset.
+                    # The straggler event itself stays in the ring —
+                    # that is the history; the flag is only "currently
+                    # judged slow".
+                    self._breach.pop(key, None)
+                    self._flagged.pop(key, None)
+                    return
+                # Scored whenever a judged transfer is in flight —
+                # INCLUDING a zero-delta interval: 0 B/s on a link the
+                # model says should be moving is the worst straggler,
+                # not an exempt one.
+                frac = (d_bytes / dt) / modeled
+                rec["modeled_bps"] = modeled
+                rec["frac"] = round(frac, 4)
+                if frac < straggler_threshold():
+                    n = self._breach.get(key, 0) + 1
+                    self._breach[key] = n
+                    if (n >= straggler_sustain()
+                            and key not in self._flagged):
+                        ev = {"t_ms": round(t_now, 1),
+                              "kind": "straggler_link",
+                              "link": key, "src": src, "dest": dest,
+                              "achieved_bps": rec["bps"],
+                              "modeled_bps": modeled,
+                              "frac": rec["frac"],
+                              "intervals": n}
+                        self._flagged[key] = ev["t_ms"]
+                        self._events.append(ev)
+                        new_events.append(dict(ev))
+                else:
+                    self._breach.pop(key, None)
+                    if key in self._flagged:
+                        ev = {"t_ms": round(t_now, 1),
+                              "kind": "link_recovered", "link": key,
+                              "src": src, "dest": dest,
+                              "achieved_bps": rec["bps"],
+                              "modeled_bps": modeled,
+                              "onset_t_ms": self._flagged.pop(key)}
+                        self._events.append(ev)
+                        new_events.append(dict(ev))
+
+            for key, row in (snap.get("links") or {}).items():
+                base, _, job = key.partition("#")
+                if job:
+                    continue
+                try:
+                    src_s, dest_s = base.split("->", 1)
+                    src, dest = int(src_s), int(dest_s)
+                except ValueError:
+                    continue
+                if dest != int(node_id):
+                    continue  # rx fields are owned by the dest's report
+                prow = (prev.get("links") or {}).get(key) or {}
+
+                def delta(name):
+                    return max(0.0, float(row.get(name) or 0)
+                               - float(prow.get(name) or 0))
+
+                d_bytes = delta("delivered_bytes")
+                rec = {"bps": round(d_bytes / dt, 1),
+                       "delivered": int(d_bytes),
+                       "nacks": int(delta("nacks")),
+                       "crc_drops": int(delta("crc_drops")),
+                       "wire_s": round(delta("wire_s"), 4),
+                       "verify_s": round(delta("verify_s"), 4),
+                       "place_s": round(delta("place_s"), 4)}
+                links[key] = rec
+                score(key, src, dest, rec, d_bytes)
+            # Links the caller expects in flight but whose FIRST byte
+            # never landed (no snapshot row): score them as zero-rate
+            # intervals — the fully-dark link must be the first flag,
+            # not the one blind spot.
+            for src in expected_srcs or ():
+                key = f"{int(src)}->{int(node_id)}"
+                if key in links:
+                    continue
+                rec = {"bps": 0.0, "delivered": 0, "absent": True}
+                links[key] = rec
+                score(key, int(src), int(node_id), rec, 0.0)
+            # Per-node serve p99 off the cumulative hists' window delta
+            # (the PR-13 SLO plumbing, reused — docs/rollout.md).
+            serve_p99 = None
+            for name, h in (snap.get("hists") or {}).items():
+                if not name.startswith("serve.latency_ms"):
+                    continue
+                d = hist_delta(h, (prev.get("hists") or {}).get(name))
+                p99 = percentile_from_hist(d, 0.99)
+                if p99 is not None:
+                    serve_p99 = (p99 if serve_p99 is None
+                                 else max(serve_p99, p99))
+            interval = {"t_ms": round(t_now, 1), "node": int(node_id),
+                        "dt_s": round(dt, 3), "links": links}
+            if serve_p99 is not None:
+                interval["serve_p99_ms"] = serve_p99
+            self._series.append(interval)
+        return new_events
+
+    def ingest(self, events) -> List[dict]:
+        """Adopt foreign events verbatim (a replicated shadow's ring at
+        takeover, or an advisory ``MetricsReportMsg.health`` section),
+        deduplicated by (t_ms, kind, link)."""
+        fresh: List[dict] = []
+        with self._lock:
+            if len(self._seen) > 8 * health_ring_size():
+                # Bound the dedup memory like every other health
+                # structure; a cleared set only risks re-appending an
+                # event already rotated out of the bounded ring.
+                self._seen.clear()
+            for ev in events or ():
+                key = (ev.get("t_ms"), ev.get("kind"), ev.get("link"))
+                if key in self._seen:
+                    continue
+                self._seen.add(key)
+                self._events.append(dict(ev))
+                link = str(ev.get("link") or "")
+                if ev.get("kind") == "straggler_link" and link:
+                    self._flagged.setdefault(link,
+                                             float(ev.get("t_ms") or 0))
+                elif ev.get("kind") == "link_recovered" and link:
+                    # Replay the recovery too: an adopted ring whose
+                    # link already healed must not stay marked flagged
+                    # (a later healthy interval would emit a spurious
+                    # duplicate recovery with the stale onset).
+                    self._flagged.pop(link, None)
+                fresh.append(dict(ev))
+        return fresh
+
+    # ----------------------------------------------------------- export
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
+    def snapshot(self, series_tail: int = 32) -> dict:
+        """JSON-ready view: the full event ring + the series tail (the
+        live ``-watch`` window; RUN_REPORT embeds the same shape)."""
+        with self._lock:
+            series = list(self._series)[-max(0, int(series_tail)):]
+            return {"events": [dict(ev) for ev in self._events],
+                    "intervals": [dict(iv) for iv in series],
+                    "flagged": dict(self._flagged)}

@@ -123,7 +123,7 @@ CASES = {
 # the extension fields layered onto the legacy formats over PRs 2-7.
 OMITTED_AT_DEFAULT = {
     MsgType.ANNOUNCE: {"Partial", "Digests", "Codecs", "NicBw"},
-    MsgType.ACK: {"Shard", "Version", "Codec"},
+    MsgType.ACK: {"Shard", "Version", "Codec", "SpanId"},
     MsgType.RETRANSMIT: {"Epoch", "Job", "Shard", "Codec"},
     MsgType.FLOW_RETRANSMIT: {"Epoch", "Job", "Codec"},
     MsgType.STARTUP: {"Epoch"},
@@ -135,7 +135,7 @@ OMITTED_AT_DEFAULT = {
                             "Versions", "WireCodecs"},
     MsgType.SOURCE_DEAD: {"Epoch"},
     MsgType.METRICS_REPORT: {"Epoch", "Counters", "Gauges", "Links",
-                             "T", "Proc", "Hists"},
+                             "T", "Proc", "Hists", "Spans", "Health"},
     MsgType.TIME_SYNC: {"T1", "Reply"},
     MsgType.JOB_SUBMIT: {"Epoch", "Priority", "Kind", "Digests", "Avoid",
                          "Version", "SwapBase", "Auth", "Waves", "SLO",
@@ -146,7 +146,8 @@ OMITTED_AT_DEFAULT = {
                           "Finalize"},
     MsgType.JOB_REVOKE: {"Epoch", "Pairs"},
     MsgType.GROUP_PLAN: {"Epoch", "Targets", "Dissolve"},
-    MsgType.GROUP_STATUS: {"Covered", "Announced", "Dead", "Metrics"},
+    MsgType.GROUP_STATUS: {"Covered", "Announced", "Dead", "Metrics",
+                           "Spans"},
     MsgType.JOIN: {"Addr", "Want", "Node", "Admitted", "Parent",
                    "ParentAddr", "Error", "Epoch"},
     MsgType.DRAIN: {"Node", "Done", "Error", "Epoch"},
@@ -331,6 +332,42 @@ def test_rollout_fields_interop_with_prerollout_peers():
         assert getattr(old, "slo", {}) == {}
         assert getattr(old, "revert", False) is False
         assert getattr(old, "finalize", False) is False
+
+
+def test_span_fields_interop_with_prespan_peers():
+    """The causal-span extension (docs/observability.md) must keep a
+    pre-span cluster interoperable: the advisory SpanId/parent tags and
+    the span/health report sections are omitted at default (asserted
+    type-by-type above), populated instances round-trip through real
+    JSON, and a stripped (legacy-peer) payload decodes to the
+    span-less reading — never KeyError."""
+    ev = {"span": "2.7", "phase": "acked", "t_ms": 123.0, "node": 0}
+    hev = {"t_ms": 500.0, "kind": "straggler_link", "link": "0->2",
+           "frac": 0.1}
+    for msg in (
+        AckMsg(2, 7, span_id="2.7"),
+        MetricsReportMsg(1, spans=[ev], health=[hev]),
+        GroupStatusMsg(1, 2, covered={7: [3, 4]},
+                       spans={7: {3: "3.7", 4: "4.7"}}),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        assert decode_msg(msg.msg_type, wire) == msg
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("SpanId", "SpanParent", "Spans",
+                                 "Health")}
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "span_id", "") == ""
+        assert getattr(old, "spans", []) in ([], {})
+        assert getattr(old, "health", []) == []
+
+    # The data-plane preamble: span tags are additive and omitted at
+    # default (the five-key legacy format is pinned above).
+    h = LayerHeader(1, 7, 64, 128, 0, span_id="2.7", span_parent="1.7")
+    payload = h.to_payload()
+    assert payload["SpanId"] == "2.7" and payload["SpanParent"] == "1.7"
+    assert LayerHeader.from_payload(json.loads(json.dumps(payload))) == h
+    bare = LayerHeader(1, 7, 64, 128, 0).to_payload()
+    assert "SpanId" not in bare and "SpanParent" not in bare
 
 
 def test_codec_fields_interop_with_precodec_peers():

@@ -316,8 +316,13 @@ def test_adopted_leader_still_yields_complete_report():
     standby = FlowRetransmitReceiverNode(
         Node(1, 0, ts[1]), {i: mem_layer(i, size) for i in range(2)},
         heartbeat_interval=lease)
+    # 25 missed beacons, not 4: this container's CFS throttling freezes
+    # the WHOLE process for 1.2 s+ at times (observed: no thread logs
+    # anything, then the detector wakes first), and the resulting
+    # BENIGN false takeover (docs/failover.md) races the snapshot this
+    # test is not about — the kill below is the takeover under test.
     ctl = StandbyController(
-        standby, rank=0, lease_timeout=0.4, standbys=[1], mode=3,
+        standby, rank=0, lease_timeout=2.5, standbys=[1], mode=3,
         node_network_bw={i: 10 ** 10 for i in ids}, failure_timeout=0.0,
         lease_interval=lease)
     worker = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {},
@@ -348,6 +353,10 @@ def test_adopted_leader_still_yields_complete_report():
         # per PROC_TOKEN.
         assert rep["counters"].get("failover.takeover", 0) == 1
         assert rep["provenance"]
+        # The causal picture survives too: the promoted leader's folded
+        # table carries the span timeline (replicated + re-reported),
+        # so its RUN_REPORT still explains the delivery.
+        assert rep.get("critical_path", {}).get("chain")
     finally:
         ctl.close()
         leader.close()
@@ -566,6 +575,497 @@ def test_every_trace_rule_string_exists_in_package_source():
         f"cli/trace.py rules name log messages that no longer exist in "
         f"the package source (renamed without updating the trace "
         f"rules?): {missing}")
+
+
+# --------------------------------- pair-lifecycle spans + critical path
+
+
+def test_span_ring_records_bounded_and_gated(monkeypatch):
+    reg = telemetry.Telemetry()
+    reg.span_event("2.7", "planned", node=0, src=0, dest=2, layer=7)
+    reg.span_event("2.7", "acked", node=0, dest=2, layer=7)
+    evs = reg.span_events()
+    assert [e["phase"] for e in evs] == ["planned", "acked"]
+    assert evs[0]["span"] == "2.7" and evs[0]["node"] == 0
+    assert reg.snapshot()["spans"] == evs
+    # Bounded: the ring drops oldest and counts the drops.
+    monkeypatch.setenv("DLD_SPAN_RING", "64")
+    reg2 = telemetry.Telemetry()
+    for i in range(70):
+        reg2.span_event("1.1", "planned", node=0, layer=1, dest=1,
+                        bytes=i)
+    assert len(reg2.span_events()) == 64
+    assert reg2.snapshot()["counters"]["telemetry.spans_dropped"] == 6
+    # Kill switches: DLD_SPANS=0, and the telemetry master switch.
+    monkeypatch.setenv("DLD_SPANS", "0")
+    reg3 = telemetry.Telemetry()
+    reg3.span_event("1.1", "planned", node=0)
+    assert reg3.span_events() == []
+    monkeypatch.delenv("DLD_SPANS")
+    monkeypatch.setenv("DLD_TELEMETRY", "0")
+    reg4 = telemetry.Telemetry()
+    reg4.span_event("1.1", "planned", node=0)
+    assert reg4.span_events() == []
+    # reset_run clears the ring.
+    reg.reset_run()
+    assert reg.span_events() == []
+
+
+def test_fold_spans_dedups_co_resident_processes():
+    ev1 = {"span": "2.7", "phase": "planned", "t_ms": 100.0, "node": 0}
+    ev2 = {"span": "2.7", "phase": "acked", "t_ms": 300.0, "node": 0}
+    shared_old = {"proc": "p1", "t_wall_ms": 100.0, "spans": [ev1]}
+    shared_new = {"proc": "p1", "t_wall_ms": 200.0, "spans": [ev1, ev2]}
+    other = {"proc": "p2", "t_wall_ms": 150.0,
+             "spans": [{"span": "3.7", "phase": "first_byte",
+                        "t_ms": 200.0, "node": 3}]}
+    out = telemetry.fold_spans({1: shared_old, 2: shared_new, 3: other})
+    # One snapshot per proc token (freshest wins), merged + time-sorted.
+    assert [e["t_ms"] for e in out] == [100.0, 200.0, 300.0]
+    assert sum(1 for e in out if e["span"] == "2.7") == 2
+
+
+def test_critical_path_chain_phase_totals_and_gap():
+    from distributed_llm_dissemination_tpu.utils import critical_path as cp
+
+    t0 = 1_000_000.0
+
+    def evs(span, node_src, node_dest, base, **phase_offsets):
+        out = []
+        for ph, off in phase_offsets.items():
+            node = (node_dest if ph in ("first_byte", "wire_complete",
+                                        "verified", "staged")
+                    else node_src)
+            out.append({"span": span, "phase": ph, "t_ms": base + off,
+                        "node": node, "src": node_src, "dest": node_dest,
+                        "layer": int(span.split(".")[1])})
+        return out
+
+    # Span A: planned at t0, acked at +1000; span B blocks on A (a
+    # re-plan 200 ms after A's ack) and finishes the run at +2400.
+    events = (evs("2.7", 0, 2, t0, planned=0, dispatched=100,
+                  first_byte=200, wire_complete=700, verified=800,
+                  staged=900, acked=1000)
+              + evs("3.8", 0, 3, t0 + 1200, planned=0, dispatched=100,
+                    wire_complete=900, verified=950, staged=1000,
+                    acked=1200))
+    res = cp.analyze(events, ttd_s=2.5, predicted_s=1.0)
+    assert [c["span"] for c in res["chain"]] == ["2.7", "3.8"]
+    # Buckets: queue 0.1+0.1; wire (0.1+0.5)+(0.8); verify 0.1+0.05;
+    # stage 0.1+0.05; ack 0.1+0.2; idle = 200 ms between the spans.
+    pt = res["phase_totals_s"]
+    assert pt["queue"] == pytest.approx(0.2)
+    assert pt["wire"] == pytest.approx(1.4)
+    assert pt["verify"] == pytest.approx(0.15)
+    assert pt["stage"] == pytest.approx(0.15)
+    assert pt["ack"] == pytest.approx(0.3)
+    assert res["idle_s"] == pytest.approx(0.2)
+    assert res["window_s"] == pytest.approx(2.4)
+    assert res["attributed_s"] == pytest.approx(2.2)
+    assert res["unattributed_frac"] == pytest.approx(0.2 / 2.4, abs=1e-3)
+    assert res["coverage_frac"] == pytest.approx(2.4 / 2.5)
+    # Gap decomposition: achieved 2.5 vs predicted 1.0 — the wire's own
+    # excess plus every phase the model never priced plus idle.
+    gap = res["gap_attribution_s"]
+    assert gap["wire_excess"] == pytest.approx(0.4)
+    assert gap["idle"] == pytest.approx(0.2)
+    assert res["per_link_wire_s"] == {
+        "0->2": pytest.approx(0.6), "0->3": pytest.approx(0.8)}
+    # Waterfall rendering: one bar per span, capped + announced.
+    spans = cp.build_spans(events)
+    lines = cp.waterfall_lines(spans, limit=1)
+    assert len(lines) == 2 and "more spans not shown" in lines[1]
+
+
+def test_critical_path_applies_clock_offsets():
+    from distributed_llm_dissemination_tpu.utils import critical_path as cp
+
+    # The dest's clock runs 500 ms slow; unaligned, wire_complete would
+    # land BEFORE dispatched.
+    events = [
+        {"span": "2.7", "phase": "dispatched", "t_ms": 1000.0, "node": 0},
+        {"span": "2.7", "phase": "wire_complete", "t_ms": 700.0,
+         "node": 2, "src": 0, "dest": 2, "layer": 7},
+    ]
+    spans = cp.build_spans(events, offsets={"2": 500.0})
+    assert spans["2.7"]["phases"]["wire_complete"] == 1200.0
+    durs = cp.phase_durations(spans["2.7"])
+    assert durs["wire"] == pytest.approx(0.2)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_span_chain_full_lifecycle_e2e(kind):
+    """Acceptance: a mode-3 delivery records the whole span chain —
+    planned (leader) → dispatched (sender) → first_byte/wire_complete/
+    verified/staged (dest) → acked (leader) — correlated by one span id
+    across both backends, and the RUN_REPORT carries the critical-path
+    section reconciling against the phases."""
+    size = 48 * 1024
+    ids = range(3)
+    ts = make_transports(kind, ids)
+    assignment = {2: {0: LayerMeta()}, 1: {1: LayerMeta()}}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {i: mem_layer(i, size) for i in range(2)},
+        assignment, node_network_bw={i: 10 ** 9 for i in ids})
+    r1 = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    r2 = FlowRetransmitReceiverNode(Node(2, 0, ts[2]), {})
+    try:
+        r1.announce()
+        r2.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        from distributed_llm_dissemination_tpu.utils import (
+            critical_path as cp,
+        )
+
+        table = leader.cluster_telemetry()
+        spans = cp.build_spans(table["spans"])
+        for span, dest in (("2.0", 2), ("1.1", 1)):
+            ph = spans[span]["phases"]
+            for name in ("planned", "dispatched", "first_byte",
+                         "wire_complete", "verified", "staged", "acked"):
+                assert name in ph, f"{span} missing {name}: {sorted(ph)}"
+            # Causal order holds within the chain (same host, one clock).
+            order = [ph[p] for p in telemetry.SPAN_PHASES if p in ph]
+            assert order == sorted(order)
+        res = cp.analyze(table["spans"], ttd_s=1.0)
+        assert {c["span"] for c in res["chain"]} <= set(spans)
+        assert res["attributed_s"] >= 0
+        rep = report.build_from_leader(leader, ttd_s=1.0)
+        assert rep["critical_path"]["chain"]
+        md = report.render_md(rep)
+        assert "Critical path" in md and "Delivery waterfall" in md
+    finally:
+        leader.close()
+        r1.close()
+        r2.close()
+        for t in ts.values():
+            t.close()
+
+
+def test_trace_emits_span_flow_arrows():
+    records = [
+        {"time": 2000, "node": "0", "message": "cluster telemetry",
+         "counters": {}, "links": {}, "gauges": {},
+         "spans": [
+             {"span": "2.7", "phase": "planned", "t_ms": 1000.0,
+              "node": 0, "layer": 7},
+             {"span": "2.7", "phase": "dispatched", "t_ms": 1100.0,
+              "node": 0, "layer": 7},
+             {"span": "2.7", "phase": "wire_complete", "t_ms": 1500.0,
+              "node": 2, "layer": 7},
+             {"span": "2.7", "phase": "acked", "t_ms": 1600.0,
+              "node": 0, "layer": 7},
+         ]},
+    ]
+    events = cli_trace.to_trace_events(records)
+    flows = [e for e in events if e.get("cat") == "span"]
+    assert [e["ph"] for e in flows] == ["s", "t", "t", "f"]
+    assert len({e["id"] for e in flows}) == 1
+    # The arrows hop process rows: start on the leader, through the dest.
+    assert flows[0]["pid"] == "0" and flows[2]["pid"] == "2"
+    anchors = [e for e in events
+               if e["ph"] == "X" and str(e["name"]).startswith("span ")]
+    assert {a["name"] for a in anchors} >= {
+        "span 2.7 planned", "span 2.7 dispatched",
+        "span 2.7 wire_complete", "span 2.7 acked"}
+
+
+def test_span_phase_names_pinned_to_call_sites():
+    """Satellite: the static drift check extended to the span phase
+    vocabulary — a renamed phase must FAIL here, not silently vanish
+    from the critical-path walk.  Every name in telemetry.SPAN_PHASES
+    must appear as a double-quoted literal (a live span_event call
+    site) in the package source outside the two defining modules."""
+    import distributed_llm_dissemination_tpu as pkg
+    from distributed_llm_dissemination_tpu.utils import critical_path
+
+    assert critical_path.PHASES == telemetry.SPAN_PHASES
+    pkg_dir = os.path.dirname(os.path.abspath(pkg.__file__))
+    source = []
+    for root, dirs, names in os.walk(pkg_dir):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            if (os.path.basename(root) == "utils"
+                    and name in ("telemetry.py", "critical_path.py")):
+                continue
+            with open(os.path.join(root, name)) as f:
+                source.append(f.read())
+    blob = "\n".join(source)
+    missing = [p for p in telemetry.SPAN_PHASES if f'"{p}"' not in blob]
+    assert not missing, (
+        f"span phases with no quoted call site in package source "
+        f"(renamed without updating telemetry.SPAN_PHASES / the "
+        f"recorders?): {missing}")
+
+
+# ------------------------------------------- live fleet health timeline
+
+
+def _snap(t_ms, delivered, node=2, hists=None):
+    return {"t_wall_ms": t_ms,
+            "links": {"0->2": {"delivered_bytes": delivered}},
+            "hists": hists or {}}
+
+
+def test_health_timeline_flags_straggler_then_recovery(monkeypatch):
+    monkeypatch.setenv("DLD_STRAGGLER_FRAC", "0.5")
+    monkeypatch.setenv("DLD_STRAGGLER_N", "1")
+    tl = telemetry.HealthTimeline()
+    modeled = lambda s, d: 10 ** 6  # noqa: E731
+    assert tl.observe(2, _snap(1000.0, 0), modeled) == []  # baseline
+    # 10 KB over 1 s against a modeled 1 MB/s: frac 0.01 — straggler.
+    evs = tl.observe(2, _snap(2000.0, 10_000), modeled)
+    assert len(evs) == 1 and evs[0]["kind"] == "straggler_link"
+    assert evs[0]["link"] == "0->2" and evs[0]["t_ms"] == 2000.0
+    assert evs[0]["frac"] < 0.5 and evs[0]["modeled_bps"] == 10 ** 6
+    # Still slow: flagged once, not re-spammed.
+    assert tl.observe(2, _snap(3000.0, 20_000), modeled) == []
+    # Recovery: a full-rate interval emits the recovery event with the
+    # original onset timestamp.
+    evs = tl.observe(2, _snap(4000.0, 20_000 + 2 * 10 ** 6), modeled)
+    assert len(evs) == 1 and evs[0]["kind"] == "link_recovered"
+    assert evs[0]["onset_t_ms"] == 2000.0
+    events = tl.events()
+    assert [e["kind"] for e in events] == ["straggler_link",
+                                          "link_recovered"]
+    # No model (rate 0) = no scoring; zero-delta intervals don't flag.
+    tl2 = telemetry.HealthTimeline()
+    tl2.observe(2, _snap(1000.0, 0), lambda s, d: 0)
+    assert tl2.observe(2, _snap(2000.0, 100), lambda s, d: 0) == []
+    # Review regression: the FLAG ends with its judged transfer — an
+    # unscored interval (transfer done) clears it silently (no stale
+    # recovery event), and a later slow transfer re-flags with a
+    # fresh onset.
+    tl3 = telemetry.HealthTimeline()
+    tl3.observe(2, _snap(1000.0, 0), modeled)
+    assert tl3.observe(2, _snap(2000.0, 10_000), modeled)  # flagged
+    assert tl3.observe(2, _snap(3000.0, 10_000),
+                       lambda s, d: 0) == []  # done: no recovery event
+    assert tl3.snapshot()["flagged"] == {}
+    later = tl3.observe(2, _snap(4000.0, 20_000), modeled)
+    assert (len(later) == 1 and later[0]["kind"] == "straggler_link"
+            and later[0]["t_ms"] == 4000.0)
+    # Ingest dedups by onset and marks the link flagged.
+    tl3 = telemetry.HealthTimeline()
+    ev = {"t_ms": 5.0, "kind": "straggler_link", "link": "0->2"}
+    assert tl3.ingest([ev, dict(ev)]) == [ev]
+    assert tl3.ingest([ev]) == []
+    assert "0->2" in tl3.snapshot()["flagged"]
+
+
+def test_health_timeline_flags_fully_stalled_link(monkeypatch):
+    """Review regression: 0 B/s on an in-flight modeled link is the
+    WORST straggler, not an exempt one — a zero-delta interval must
+    score and flag."""
+    monkeypatch.setenv("DLD_STRAGGLER_N", "1")
+    tl = telemetry.HealthTimeline()
+    modeled = lambda s, d: 10 ** 6  # noqa: E731
+    tl.observe(2, _snap(1000.0, 100), modeled)
+    evs = tl.observe(2, _snap(2000.0, 100), modeled)  # zero delta
+    assert len(evs) == 1 and evs[0]["kind"] == "straggler_link"
+    assert evs[0]["achieved_bps"] == 0.0
+
+
+def test_health_timeline_flags_link_with_no_row_at_all(monkeypatch):
+    """Hand-drive regression: a link so stalled its FIRST byte never
+    landed has NO snapshot row — the leader's expected-srcs hint must
+    make it score as a zero-rate interval (found driving a whole-layer
+    frame through a throttled CLI link: the frame completes or nothing
+    does)."""
+    monkeypatch.setenv("DLD_STRAGGLER_N", "1")
+    tl = telemetry.HealthTimeline()
+    modeled = lambda s, d: 10 ** 6  # noqa: E731
+    tl.observe(2, {"t_wall_ms": 1000.0, "links": {}}, modeled,
+               expected_srcs=[0])
+    evs = tl.observe(2, {"t_wall_ms": 2000.0, "links": {}}, modeled,
+                     expected_srcs=[0])
+    assert len(evs) == 1 and evs[0]["kind"] == "straggler_link"
+    assert evs[0]["link"] == "0->2" and evs[0]["achieved_bps"] == 0.0
+    iv = tl.snapshot()["intervals"][-1]
+    assert iv["links"]["0->2"].get("absent") is True
+
+
+def test_health_breach_streak_resets_across_unscored_gaps(monkeypatch):
+    """Review regression: with DLD_STRAGGLER_N=2, two breaches
+    separated by an UNSCORED interval (the transfer ended — modeled 0)
+    are not consecutive and must not fire."""
+    monkeypatch.setenv("DLD_STRAGGLER_N", "2")
+    tl = telemetry.HealthTimeline()
+    slow = lambda s, d: 10 ** 6   # noqa: E731
+    none = lambda s, d: 0         # noqa: E731
+    tl.observe(2, _snap(1000.0, 0), slow)
+    assert tl.observe(2, _snap(2000.0, 1_000), slow) == []   # breach 1
+    assert tl.observe(2, _snap(3000.0, 1_000), none) == []   # unscored
+    assert tl.observe(2, _snap(4000.0, 2_000), slow) == []   # breach 1'
+    # A genuinely consecutive second breach DOES fire.
+    evs = tl.observe(2, _snap(5000.0, 3_000), slow)
+    assert len(evs) == 1 and evs[0]["intervals"] == 2
+
+
+def test_health_ingest_replays_recovery(monkeypatch):
+    """Review regression: a replicated ring whose link already healed
+    must not stay flagged at the adopting leader."""
+    tl = telemetry.HealthTimeline()
+    tl.ingest([
+        {"t_ms": 1.0, "kind": "straggler_link", "link": "0->2"},
+        {"t_ms": 2.0, "kind": "link_recovered", "link": "0->2",
+         "onset_t_ms": 1.0},
+    ])
+    assert tl.snapshot()["flagged"] == {}
+
+
+def test_health_timeline_serve_p99_from_hist_delta():
+    tl = telemetry.HealthTimeline()
+    h0 = {"buckets": [5, 0, 0, 0, 0, 0, 0, 0, 0, 0], "sum_ms": 5.0,
+          "n": 5}
+    h1 = {"buckets": [5, 0, 0, 0, 4, 0, 0, 0, 0, 0], "sum_ms": 500.0,
+          "n": 9}
+    tl.observe(2, {"t_wall_ms": 1000.0, "links": {},
+                   "hists": {"serve.latency_ms.n2": h0}})
+    tl.observe(2, {"t_wall_ms": 2000.0, "links": {},
+                   "hists": {"serve.latency_ms.n2": h1}})
+    iv = tl.snapshot()["intervals"][-1]
+    # The window delta is 4 samples in the <=256 ms bucket: p99 = 256.
+    assert iv["serve_p99_ms"] == 256.0
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_slow_link_flagged_live_and_clean_run_flags_nothing(
+        kind, monkeypatch):
+    """Satellite acceptance (both backends, non-vacuous both ways): a
+    seeded ``slow=RATE`` fault link is flagged by the live health
+    timeline while the transfer is in flight — onset within about one
+    metrics interval of the pair aging past the scoring gate — and the
+    SAME topology run clean flags nothing."""
+    from distributed_llm_dissemination_tpu.runtime import send as send_mod
+    from distributed_llm_dissemination_tpu.transport.faults import (
+        FaultyTransport,
+        rules_from_spec,
+    )
+
+    size = 512 * 1024
+    # Small flow fragments so the throttled transfer trickles visible
+    # per-interval progress instead of landing as one late burst.
+    monkeypatch.setattr(send_mod, "FLOW_FRAGMENT_BYTES", 32 * 1024)
+    bw = 20 * 10 ** 6  # modeled 20 MB/s; loopback easily exceeds it
+
+    def one_run(slow: bool):
+        telemetry.reset_run()
+        ids = range(2)
+        ts = make_transports(kind, ids)
+        leader_t = ts[0]
+        if slow:
+            _, rules = rules_from_spec("slow=131072")  # 128 KiB/s
+            leader_t = FaultyTransport(ts[0], rules, seed=7)
+        leader = FlowRetransmitLeaderNode(
+            Node(0, 0, leader_t), {0: mem_layer(0, size)},
+            {1: {0: LayerMeta()}},
+            node_network_bw={i: bw for i in ids})
+        recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+        try:
+            recv.announce()
+            if slow:
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    evs = [e for e in leader.health.events()
+                           if e["kind"] == "straggler_link"]
+                    if evs:
+                        break
+                    time.sleep(0.05)
+                assert evs, "slow link never flagged"
+                assert evs[0]["link"] == "0->1"
+                assert evs[0]["achieved_bps"] < 0.5 * bw
+                assert evs[0]["modeled_bps"] == bw
+                # Non-vacuous: flagged while the transfer was still in
+                # flight (the run is ~4 s of throttled wire at
+                # 128 KiB/s; the assert above fired well before ready).
+                return
+            leader.ready().get(timeout=TIMEOUT)
+            # Let two more report rounds land; a clean run must stay
+            # quiet (the in-flight + age gates make a fast transfer
+            # unjudgeable — by design).
+            time.sleep(0.6)
+            assert leader.health.events() == []
+        finally:
+            leader.close()
+            recv.close()
+            for t in ts.values():
+                t.close()
+            if slow:
+                leader_t.close()
+
+    one_run(slow=True)
+    one_run(slow=False)
+
+
+def test_health_events_and_spans_ride_shadow_replication():
+    """Takeover keeps the causal/health picture: the shadow parses the
+    metrics delta's span section and the health delta/snapshot, and an
+    adopting leader re-ingests the event ring with onsets intact."""
+    from distributed_llm_dissemination_tpu.runtime.failover import (
+        ShadowLeaderState,
+    )
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        ControlDeltaMsg,
+    )
+
+    shadow = ShadowLeaderState()
+    ev = {"span": "2.7", "phase": "acked", "t_ms": 42.0, "node": 0}
+    hev = {"t_ms": 99.0, "kind": "straggler_link", "link": "0->2",
+           "src": 0, "dest": 2}
+    shadow.apply(ControlDeltaMsg(0, 1, 0, "metrics",
+                                 {"Node": 2, "Counters": {}, "Links": {},
+                                  "Spans": [ev], "T": 1.0, "Proc": "p"}))
+    shadow.apply(ControlDeltaMsg(0, 1, 1, "health", {"Events": [hev]}))
+    out = shadow.export()
+    assert out["metrics"][2]["spans"] == [ev]
+    assert out["health"]["events"] == [hev]
+    # Adoption path: a fresh timeline ingests the ring verbatim.
+    tl = telemetry.HealthTimeline()
+    tl.ingest(out["health"]["events"])
+    assert tl.events() == [hev]
+    assert tl.snapshot()["flagged"].get("0->2") == 99.0
+
+
+def test_job_progress_lines_from_job_links():
+    """Satellite: ``-watch``'s per-job live progress — delivered/total
+    bytes derived from the per-job link split, ETA stamped from the
+    job's own tier pacing while active."""
+    size = 64 * 1024
+    ids = range(2)
+    ts = make_transports("inmem", ids)
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, size)}, {},
+        node_network_bw={i: 10 ** 9 for i in ids},
+        expected_nodes={1})
+    recv = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {})
+    try:
+        recv.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)  # empty base goal
+        leader.submit_job("push-1", {1: {0: LayerMeta()}}, priority=1)
+        deadline = time.monotonic() + TIMEOUT
+        while time.monotonic() < deadline:
+            row = leader.jobs.table().get("push-1")
+            if row and row["State"] == "done":
+                break
+            time.sleep(0.02)
+        prog = leader.job_progress()["push-1"]
+        assert prog["state"] == "done"
+        assert prog["delivered_bytes"] == size
+        assert prog["total_bytes"] == size
+        assert prog["remaining_pairs"] == 0
+        # The -watch hook logs one "job progress" line per job (the
+        # literal the trace rules pin).
+        table = leader.log_cluster_metrics()
+        assert table["spans"]  # the dump carries the merged timeline
+    finally:
+        leader.close()
+        recv.close()
+        for t in ts.values():
+            t.close()
 
 
 # ---------------------------------------------- end-to-end offline CLI
