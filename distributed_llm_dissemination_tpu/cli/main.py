@@ -509,6 +509,21 @@ def resolve_groups(conf: cfg.Config, mode: Optional[int] = None):
                               leader_id) or None
 
 
+def resolve_pods(conf: cfg.Config, mode: Optional[int] = None):
+    """The config's ``Pods`` section → ``{pod_id: [members]}`` for the
+    mode-3 leader (fabric-assisted pod delivery, docs/fabric.md), or
+    None.  Config-time validation (disjoint, known ids) already ran in
+    ``Config.from_json``; the leader seat is re-checked at leader
+    construction."""
+    if conf.pods is None:
+        return None
+    if mode is not None and mode != 3:
+        raise SystemExit(
+            "Pods (fabric-assisted pod delivery, docs/fabric.md) "
+            f"requires mode 3; got mode {mode}")
+    return {pid: list(members) for pid, members in enumerate(conf.pods)}
+
+
 def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     """Leader role: constructor per mode, then drive the TTD timer
     (cmd/main.go:149-181)."""
@@ -547,6 +562,7 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
         common.update(standbys=list(conf.standbys),
                       lease_interval=max(args.lease, 0.05), epoch=0)
     groups = resolve_groups(conf, args.m)
+    pods = resolve_pods(conf, args.m)
     if args.m == 0:
         leader = LeaderNode(node, layers, assignment, **common)
     elif args.m == 1:
@@ -561,10 +577,11 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
 
             leader = HierarchicalFlowLeaderNode(
                 node, layers, assignment, bw, groups=groups,
-                topology=topo, **common)
+                topology=topo, pods=pods, **common)
         else:
             leader = FlowRetransmitLeaderNode(node, layers, assignment, bw,
-                                              topology=topo, **common)
+                                              topology=topo, pods=pods,
+                                              **common)
 
     # One flag governs the run: the leader's decision rides StartupMsg,
     # so receivers can never boot (or skip) against the leader's wait.

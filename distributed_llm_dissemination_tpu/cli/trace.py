@@ -80,6 +80,16 @@ _INSTANT_MESSAGES = {
     "job assignment calculated (topology)",
     "job assignment calculated (topology LP)",
     "topology solve degraded to flat replan",
+    # Fabric-assisted pod delivery (docs/fabric.md): the NIC shard
+    # phase, the on-mesh reconstruction, and its degrade edges.
+    "pod delivery planned",
+    "pod shard published for on-mesh gather",
+    "layer materialized from shards (on-mesh gather)",
+    "pod delivery materialized full tree",
+    "dispatching pod gather plan",
+    "pod delivery degraded to host path",
+    "pod gather timed out; degrading to host path",
+    "pod member gone; degrading its pod to host path",
     # Telemetry plane (docs/observability.md):
     "clock offset estimated",
     "cluster telemetry",

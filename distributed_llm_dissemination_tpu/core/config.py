@@ -233,6 +233,14 @@ class Config:
     # control plane at their sub-leader; the root plans over group
     # ingress nodes.  None = flat control (the legacy plane).
     groups: Optional[object] = None
+    # Fabric-assisted pod delivery (docs/fabric.md), mode 3 only: a
+    # list of member-id lists — each inner list one POD of dests
+    # sharing an ICI domain.  A layer every member of a pod wants ships
+    # as ONE 1/R shard per host over the NIC (possibly quantized under
+    # WireCodec) and the full tree materializes over the on-mesh
+    # gather, so pod NIC ingress is O(model_bytes), not
+    # O(model_bytes x replicas).  None = no pod delivery.
+    pods: Optional[List[List[NodeID]]] = None
 
     @classmethod
     def from_json(cls, d: dict) -> "Config":
@@ -250,12 +258,27 @@ class Config:
             wire_codec=_validated_codec(_jget(d, "WireCodec", "raw") or "raw"),
             standbys=[int(s) for s in _jget(d, "Standbys") or []],
             groups=_jget(d, "Groups"),
+            pods=([[int(m) for m in pod] for pod in _jget(d, "Pods")]
+                  if _jget(d, "Pods") is not None else None),
         )
         if conf.groups is not None and not isinstance(conf.groups,
                                                       (dict, list)):
             raise ValueError(
                 "Groups must be {'Size': K} or a list of "
                 "{'Leader': id, 'Members': [...]} declarations")
+        if conf.pods is not None:
+            known = {nc.id for nc in conf.nodes}
+            seen: set = set()
+            for pod in conf.pods:
+                if len(pod) < 2:
+                    raise ValueError("each Pods entry needs >= 2 members")
+                for m in pod:
+                    if m not in known:
+                        raise ValueError(f"Pods names unknown node {m}")
+                    if m in seen:
+                        raise ValueError(
+                            f"node {m} appears in more than one pod")
+                    seen.add(m)
         if conf.wire_codec != "raw":
             # Fail at PARSE time like an unknown codec: a wire codec
             # re-encodes the CANONICAL blob, so the canonical form must

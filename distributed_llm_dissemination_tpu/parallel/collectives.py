@@ -200,20 +200,37 @@ def gather_tiles_batched(mesh: Mesh, axis: str, sizes: Tuple[int, ...],
     return run
 
 
-def gather_byte_shards(parts, total: int, verify_digest=None) -> bytes:
+def gather_byte_shards(parts, total: int, verify_digest=None,
+                       codec: str = "", decode=None):
     """Materialize a FULL layer from its byte-range shards on-mesh
-    (docs/sharding.md): each ``(shard_index, bytes)`` part is one
-    ``1/N@K`` floor-split slice of a ``total``-byte layer; the N tiles
-    land one-per-device on an N-device mesh and ONE tiled ``all_gather``
-    (the existing ``gather_tiles`` path — padded tiles, static
-    re-splice) replicates the layer, which is then read back byte-exact.
-    On a real pod the hop is ICI at bisection bandwidth — the wire never
-    carried more than each dest's shard.
+    (docs/sharding.md, docs/fabric.md): each ``(shard_index, bytes)``
+    part is one ``1/N@K`` floor-split slice of a ``total``-byte layer;
+    the N tiles land one-per-device on an N-device mesh and ONE tiled
+    ``all_gather`` (the existing ``gather_tiles`` path — padded tiles,
+    static re-splice) replicates the layer, which is then read back
+    byte-exact.  On a real pod the hop is ICI at bisection bandwidth —
+    the wire never carried more than each dest's shard.
 
     ``parts``: iterable of ``(k, data)`` covering ALL of [0, N) in any
-    order.  ``verify_digest``: optional stamped full-layer digest — the
-    gathered layer is checked against it before being returned (the
-    acceptance gate: post-gather bytes must match the pre-shard stamp).
+    order.  ``verify_digest``: optional stamped full-layer digest in
+    the shards' WIRE form — the gathered blob is checked against it
+    before being returned (the acceptance gate: post-gather bytes must
+    match the pre-shard stamp; for quantized pod deliveries this is
+    the leader's codec-qualified full digest).
+
+    Codec awareness (docs/codec.md): the shards may be slices of a
+    quantized wire blob — ``codec`` names the form ("" = canonical) and
+    ``decode = (cfg, blob_id)`` asks for the per-blob dequant: on the
+    mesh path the gathered blob is ALREADY HBM-resident, so
+    ``quant.device_decode_jit(codec)`` consumes the replicated device
+    array directly (no host round trip) and the call returns
+    ``(wire_bytes, leaves)`` with the decoded leaves carrying the
+    stager's leading length-1 axis; the host-fallback path decodes on
+    host.  Without ``decode`` the return is plain wire bytes.
+
+    The tile pad is bucketed (``plan_cache.bucket_pad``) so every
+    same-bucket layer of a model reuses ONE compiled gather program —
+    the pod-delivery reconstruction compiles once, not per layer.
 
     Falls back to a host-side concatenation — loudly, counted on
     ``shard.gather_host_fallback`` — when the runtime has fewer devices
@@ -237,6 +254,7 @@ def gather_byte_shards(parts, total: int, verify_digest=None) -> bytes:
                 f"shard {k}/{n} is {len(by_k[k])} bytes; spec says {size}")
         sizes.append(size)
 
+    gathered_dev = None
     if n == 1:
         out = bytes(by_k[0])
     elif len(jax.devices()) < n:
@@ -245,17 +263,22 @@ def gather_byte_shards(parts, total: int, verify_digest=None) -> bytes:
                  "of the mesh", shards=n, devices=len(jax.devices()))
         out = b"".join(bytes(by_k[k]) for k in range(n))
     else:
+        from .plan_cache import bucket_pad
+
         devices = jax.devices()[:n]
         mesh = Mesh(np.array(devices), ("shards",))
-        pad = max(sizes)
+        # Bucketed pad: same-bucket layers share one compiled gather
+        # (plan_cache) — the splice slices the real sizes back out.
+        pad = bucket_pad(max(sizes))
         staged = np.zeros((n, pad), dtype=np.uint8)
         for k in range(n):
             staged[k, : sizes[k]] = np.frombuffer(bytes(by_k[k]), np.uint8)
         v = jax.device_put(
             staged.reshape(n * pad),
             NamedSharding(mesh, P("shards")))
-        gathered = gather_tiles(mesh, "shards", tuple(sizes), pad=pad)(v)
-        out = np.asarray(jax.device_get(gathered)).tobytes()[:total]
+        gathered_dev = gather_tiles(mesh, "shards", tuple(sizes),
+                                    pad=pad)(v)
+        out = np.asarray(jax.device_get(gathered_dev)).tobytes()[:total]
     if len(out) != total:
         raise ValueError(f"gathered {len(out)} bytes; layer is {total}")
     if verify_digest:
@@ -265,7 +288,46 @@ def gather_byte_shards(parts, total: int, verify_digest=None) -> bytes:
             raise ValueError("gathered layer failed the stamped "
                              "full-layer digest")
     trace.count("shard.gathered_layers")
-    return out
+    if decode is None:
+        return out
+    # Dequant AFTER the gather (and only after the digest gate above —
+    # corrupt bytes must never reach the decode): the device path feeds
+    # the already-replicated HBM blob straight into the codec's jit.
+    # Advisory: a decode failure (bytes that aren't a model blob) costs
+    # only the staged leaves — the materialized wire bytes still return.
+    try:
+        leaves = _decode_gathered(out, gathered_dev, total, codec, decode)
+    except Exception as e:  # noqa: BLE001 — decode is an optimization
+        log.warn("post-gather dequant failed; bulk staging will cover "
+                 "the blob", err=repr(e))
+        leaves = None
+    return out, leaves
+
+
+def _decode_gathered(wire: bytes, gathered_dev, total: int, codec: str,
+                     decode):
+    """The codec-aware tail of ``gather_byte_shards``: decode the
+    gathered wire blob into staged leaves ({name: (1, *shape)} — the
+    streaming stager's layout) under ``codec``, on device when the
+    gather left an HBM-resident copy."""
+    from ..models import quant, serde
+    from ..utils import trace
+
+    cfg, blob_id = decode
+    specs = tuple(serde.head_param_specs(cfg)
+                  if blob_id == serde.head_blob_id(cfg)
+                  else serde.layer_param_specs(cfg))
+    dt_name = np.dtype(cfg.dtype).name
+    if not codec:
+        codec = "raw"
+    if gathered_dev is not None:
+        # The replicated gather output is padded past ``total``; the
+        # decode jits take exact-size blobs — one device-local slice.
+        blob = jax.lax.slice(gathered_dev, (0,), (total,))
+        trace.count("pod.device_dequants")
+        return quant.device_decode_jit(codec)((blob,), specs, dt_name)
+    decoded = quant.decode_blob_host(cfg, blob_id, wire, codec)
+    return {name: arr[None] for name, arr in decoded.items()}
 
 
 @functools.lru_cache(maxsize=64)

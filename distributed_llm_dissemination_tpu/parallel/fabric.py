@@ -60,6 +60,15 @@ class FabricPlane:
         # plan_id -> last-publish monotonic time, for stale-plan GC (a plan
         # whose dest died would otherwise pin device buffers forever).
         self._touched: Dict[str, float] = {}
+        # Pod-delivery shard board (docs/fabric.md): key -> {rank: bytes}.
+        # Unlike ``_contribs`` (one consumer per plan), every pod member
+        # reads the SAME shard set — entries are refcounted out by
+        # ``pod_done`` (one call per member) instead of consumed by the
+        # first collect.  This is the single-controller stand-in for the
+        # ICI hop: each member's shard crosses process memory, never the
+        # accounted NIC links.
+        self._pod_parts: Dict[object, Dict[int, bytes]] = {}
+        self._pod_done: Dict[object, set] = {}
 
     def publish(self, plan_id: str, offset: int, arr) -> None:
         """Sender side: register one device-resident byte-range fragment."""
@@ -102,6 +111,47 @@ class FabricPlane:
             self._contribs.pop(plan_id, None)
             self._touched.pop(plan_id, None)
 
+    # --------------------------------------------- pod shard board
+
+    def pod_publish(self, key, rank: int, data) -> None:
+        """Pod member side: register shard ``rank``'s wire bytes under
+        ``key`` (one key per (layer, pod)).  Duplicates no-op — a
+        re-plan re-completion must not flap the set."""
+        with self._cond:
+            parts = self._pod_parts.setdefault(key, {})
+            if rank not in parts:
+                parts[rank] = bytes(data)
+            self._touched[("pod", key)] = time.monotonic()
+            self._cond.notify_all()
+
+    def pod_wait_new(self, key, have: int, timeout: float):
+        """Block until the board holds MORE than ``have`` shards for
+        ``key`` (any completion order), then return a snapshot of the
+        full ``{rank: bytes}`` map; None on timeout.  Members drain the
+        board incrementally: each new shard feeds ``submit_shard`` the
+        moment it appears, so the gather fires on the last arrival."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while len(self._pod_parts.get(key) or ()) <= have:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                self._cond.wait(left)
+            return dict(self._pod_parts[key])
+
+    def pod_done(self, key, members: int, who=None) -> None:
+        """Member ``who`` finished gathering ``key``; the entry drops
+        once ``members`` DISTINCT members have (a set, not a counter —
+        one member's retry after a timeout must not double-count and
+        drop the board under a slower member still draining it)."""
+        with self._cond:
+            done = self._pod_done.setdefault(key, set())
+            done.add(who)
+            if len(done) >= members:
+                self._pod_parts.pop(key, None)
+                self._pod_done.pop(key, None)
+                self._touched.pop(("pod", key), None)
+
     def gc(self, max_age: float = 600.0) -> int:
         """Drop plans idle longer than ``max_age`` seconds; returns how
         many were dropped.  Cheap enough to call opportunistically."""
@@ -109,7 +159,11 @@ class FabricPlane:
         with self._cond:
             stale = [p for p, ts in self._touched.items() if ts < cutoff]
             for p in stale:
-                self._contribs.pop(p, None)
+                if isinstance(p, tuple) and p and p[0] == "pod":
+                    self._pod_parts.pop(p[1], None)
+                    self._pod_done.pop(p[1], None)
+                else:
+                    self._contribs.pop(p, None)
                 self._touched.pop(p, None)
         return len(stale)
 

@@ -423,7 +423,12 @@ class SpmdFabric:
         # NOT blocked here: the caller's in-flight window retires it, so
         # the next plan's uploads overlap this gather on the device queue.
         out = gather_tiles_at(mesh, "fabric", sizes, order, pad=pad)(v)
-        if msg.dest_id != self.my_node:
+        # Pod-delivery reconstruction (docs/fabric.md): every node in the
+        # advisory keep-list retains the gathered layer, not just the
+        # nominal dest — one collective materializes the full tree on
+        # ALL pod members.
+        keepers = {msg.dest_id} | {int(n) for n in (msg.pod or ())}
+        if self.my_node not in keepers:
             return None, out
         # Keep the LOCAL copy: the gather leaves the full layer replicated
         # on every scope device; this node's addressable shards are its

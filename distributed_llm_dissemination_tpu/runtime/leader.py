@@ -46,6 +46,7 @@ from ..core.types import (
     Status,
     codec_accepts,
     delivered,
+    parse_shard_spec,
     layer_ids_to_json,
     satisfies,
     shard_covers,
@@ -55,6 +56,7 @@ from ..sched.flow import (
     FlowJob,
     FlowJobsMap,
     pick_salvage_source,
+    pod_shard_demands,
     rate_for,
     solve_joint,
 )
@@ -115,6 +117,14 @@ from .send import (
     reopen_upload_cache,
     send_layer,
 )
+
+
+def _range_key(spec: str, codec: str) -> str:
+    """The range-digest cache's spec key: the shard spec, qualified by
+    the wire codec when the range hashes the ENCODED blob
+    (docs/codec.md; shard x codec pod pairs).  One definition shared
+    by the stamping writer and the ack-time reader."""
+    return spec + (f"+{codec}" if codec else "")
 
 
 def assignment_satisfied(a: Assignment, s: Status) -> bool:
@@ -370,6 +380,12 @@ class LeaderNode:
         # digests disabled, stamps then carry explicit ""-spec entries
         # so a widened target still reconciles at the dest.
         self._sharding_seen = False
+        # Fabric-assisted pod delivery (docs/fabric.md): (layer, dest)
+        # -> the pair's NIC shard spec.  Empty on every scheduler that
+        # doesn't pod-plan (only mode 3 does, and only with a ``pods``
+        # grouping configured); the goal stays OPEN until every pod
+        # pair's FULL wire-form tree materialized (_pods_open_locked).
+        self._pod_pairs: Dict[Tuple[LayerID, NodeID], str] = {}
         self.nacker = NackRetransmitter()
         # Preemption revoke (docs/service.md): the leader is a sender
         # too — its own queued flow sends honor revokes via this
@@ -1017,19 +1033,29 @@ class LeaderNode:
                 for lid, meta in (self.assignment.get(dest) or {}).items()
                 if meta.shard}
 
-    def _range_digests_for(self, shards: Dict[LayerID, str]
+    def _range_digests_for(self, shards: Dict[LayerID, str],
+                           codec_map: Optional[Dict[LayerID, str]] = None,
                            ) -> Dict[LayerID, str]:
         """Per-range digests for a dest's shard targets — the digest of
         exactly the target's byte range, so the shard verifies without
         the dest ever holding the full layer (docs/sharding.md).  Only
         computable for layers whose bytes this leader can read; absent
         entries verify by per-fragment CRC alone (honest limit).
-        Cached per (layer, spec): replans must not re-hash gigabytes."""
+        Cached per (layer, spec[, codec]): replans must not re-hash
+        gigabytes.
+
+        ``codec_map`` (docs/codec.md, docs/fabric.md): layers whose
+        pair ships a wire codec hash the range of the ENCODED blob —
+        shard x codec composes in encoded byte space, and the stamp
+        must describe the bytes that actually cross the wire (this is
+        what lets a quantized pod slice verify end-to-end)."""
         if not integrity.digests_enabled():
             return {}
+        codec_map = codec_map or {}
         out: Dict[LayerID, str] = {}
         for lid, spec in shards.items():
-            key = (lid, spec)
+            codec = codec_map.get(lid, "")
+            key = (lid, _range_key(spec, codec))
             with self._lock:
                 cached = self._range_digest_cache.get(key)
                 layer = self.layers.get(lid)
@@ -1038,8 +1064,17 @@ class LeaderNode:
                 continue
             if layer is None or layer.meta.shard:
                 continue  # unreadable here (or leader holds a shard only)
-            off, size = shard_range(spec, layer.data_size)
-            d = integrity.digest_layer_src_range(layer, off, size)
+            if codec:
+                if self.codecs is None:
+                    continue  # CRC-only verify (honest limit)
+                enc = self.codecs.encoded_src(lid, layer, codec)
+                if enc is None:
+                    continue
+                off, size = shard_range(spec, enc.data_size)
+                d = integrity.digest_layer_src_range(enc, off, size)
+            else:
+                off, size = shard_range(spec, layer.data_size)
+                d = integrity.digest_layer_src_range(layer, off, size)
             if d is None:
                 continue
             with self._lock:
@@ -1151,6 +1186,34 @@ class LeaderNode:
         if changed:
             self._replicate_codecs()
 
+    def _stamp_targets(self) -> None:
+        """Pre-plan target stamping, in dependency order: the wire-codec
+        choice first (it refuses sharded metas, and a pod slice must
+        inherit the pair's codec), then the pod-delivery shard split
+        (docs/fabric.md) over the codec-stamped metas."""
+        self._stamp_codecs()
+        self._stamp_pod_shards()
+
+    def _stamp_pod_shards(self) -> None:
+        """Hook: rewrite pod members' full targets into per-host shard
+        slices (fabric-assisted pod delivery).  Only the mode-3 flow
+        scheduler implements it; every other mode plans pods flat."""
+
+    def _pods_open_locked(self) -> bool:
+        """Lock held.  Whether any pod pair still owes its FULL
+        materialized tree (the goal must not finish on shard coverage
+        alone).  Base schedulers never pod-plan."""
+        return False
+
+    def _on_pod_ack(self, dest: NodeID, layer_id: LayerID, shard: str,
+                    codec: str) -> None:
+        """Hook: an ack landed for a pod-delivery pair (mode 3 drives
+        the SPMD gather dispatch / completion accounting from here)."""
+
+    def _pods_member_gone(self, node: NodeID) -> None:
+        """Hook: a pod member crashed or departed — its pods' unfinished
+        pairs must degrade to the host path (mode 3 only)."""
+
     def _replicate_codecs(self) -> None:
         with self._lock:
             choices = {f"{d}:{l}": c
@@ -1188,7 +1251,18 @@ class LeaderNode:
             row = self.assignment.get(dest)
             if row is not None and lid in row:
                 row[lid] = dataclasses.replace(row[lid], codec="")
+            pod_pair = (lid, dest) in self._pod_pairs
         self.jobs.apply_codecs({(dest, lid): ""})
+        if pod_pair:
+            # The revert de-uniforms the pod's wire byte space for this
+            # layer (docs/fabric.md: one gather = one encoding) —
+            # degrade the (layer, pod) to host path instead of letting
+            # the watchdog discover a gather that can never verify.
+            pid = self._pod_of.get(dest)
+            if pid is not None:
+                log.warn("codec revert de-uniforms a pod layer; "
+                         "degrading to host path", layerID=lid, pod=pid)
+                self._degrade_pod_layer(lid, pid)
 
     def _send_digests_to(self, dest: NodeID) -> None:
         if dest == self.node.my_id:
@@ -1271,15 +1345,50 @@ class LeaderNode:
                              dest=dest, layerID=lid, codec=c)
             for lid in bad:
                 codec_map.pop(lid, None)
-        if not digests and not shards and not versions and not codec_map:
+        # Fabric-assisted pod delivery (docs/fabric.md): the dest's pod
+        # pairs ride the stamp as {layer: pod width} — the channel that
+        # tells it to feed its verified shard into the on-mesh
+        # reconstruction and ack the FULL tree, not stop at the shard.
+        # Pods whose EVERY member already materialized are omitted: a
+        # re-stamp (job admission, update) must not re-trigger
+        # publish/gather rounds in the steady state.
+        with self._lock:
+            pods = {}
+            pod_of = getattr(self, "_pod_of", {})
+            pod_members = getattr(self, "pods", {})
+            for (lid, d2), spec in self._pod_pairs.items():
+                if d2 != dest:
+                    continue
+                want = (self.assignment.get(dest) or {}).get(lid)
+                if want is None or want.shard != spec:
+                    continue
+                pid = pod_of.get(dest)
+                members = (pod_members.get(pid, ())
+                           if pid is not None else ())
+                done = bool(members)
+                for m in members:
+                    if (lid, m) not in self._pod_pairs:
+                        continue
+                    held = self.status.get(m, {}).get(lid)
+                    w = (self.assignment.get(m) or {}).get(lid)
+                    if (held is None or not delivered(held) or held.shard
+                            or (w is not None and not codec_accepts(
+                                held.codec, w.codec))):
+                        done = False
+                        break
+                if not done:
+                    pods[lid] = parse_shard_spec(spec)[0]
+        if (not digests and not shards and not versions and not codec_map
+                and not pods):
             return
         try:
             self.node.transport.send(
                 dest, LayerDigestsMsg(
                     self.node.my_id, digests, epoch=self.epoch,
                     shards=shards,
-                    range_digests=self._range_digests_for(shards),
-                    versions=versions, codecs=codec_map))
+                    range_digests=self._range_digests_for(shards,
+                                                          codec_map),
+                    versions=versions, codecs=codec_map, pods=pods))
         except (OSError, KeyError) as e:
             log.warn("digest stamp send failed", dest=dest, err=repr(e))
 
@@ -1770,7 +1879,7 @@ class LeaderNode:
             # Codec choices precede the stamp: the digest channel is
             # what tells each dest its transfers' byte spaces
             # (docs/codec.md).
-            self._stamp_codecs()
+            self._stamp_targets()
             self._send_digests()
             with self._lock:
                 self._started = True
@@ -2049,7 +2158,7 @@ class LeaderNode:
         # Re-merge dropped the codec choices from the target metas;
         # re-apply the memoized ones (docs/codec.md) before anything
         # replicates or stamps the new goal.
-        self._stamp_codecs()
+        self._stamp_targets()
         # New assignees that haven't announced get liveness leases, so one
         # that never shows up is still detected (as in __init__'s seeding).
         for node_id in assignment:
@@ -2188,7 +2297,7 @@ class LeaderNode:
             merged = _nested_layer_map_to_json(self.assignment)
         # The re-merge rebuilt the goal codec-less: re-apply choices
         # (and choose for the job's new pairs) before stamps/replans.
-        self._stamp_codecs()
+        self._stamp_targets()
         for node_id in job.assignment:
             if node_id != self.node.my_id and node_id not in self.status:
                 self.detector.touch(node_id)
@@ -3170,6 +3279,10 @@ class LeaderNode:
             except (OSError, KeyError, ConnectionError) as e:
                 log.debug("drain done notice undeliverable", dest=w,
                           err=repr(e))
+        # A cleanly-departed pod member breaks its pod the same way a
+        # crashed one does (docs/fabric.md): survivors' unfinished pod
+        # pairs degrade to host-path delivery.
+        self._pods_member_gone(node)
         self._drive(self._recover)
         self._maybe_finish()
         self._maybe_complete_boot_wait()
@@ -3281,7 +3394,7 @@ class LeaderNode:
         byte range over the host path (the fabric plane speaks whole
         layers only); a wire-codec target (docs/codec.md) ships its
         ENCODED form over the host path the same way."""
-        self._stamp_codecs()
+        self._stamp_targets()
         for node_id, layer_ids in self.assignment.items():
             for layer_id, want in layer_ids.items():
                 with self._lock:
@@ -3391,7 +3504,7 @@ class LeaderNode:
     def _dispatch_device_plan(
         self, layer_id: LayerID, dest: NodeID,
         layout: List[Tuple[NodeID, int, int]], total: int,
-        batch_id: str = "", batch_n: int = 1,
+        batch_id: str = "", batch_n: int = 1, pod=None,
     ) -> bool:
         """Send the plan to every participant; the layer bytes themselves
         never touch the transport (the fabric carries them).  Returns
@@ -3411,7 +3524,7 @@ class LeaderNode:
         msg = DevicePlanMsg(self.node.my_id, plan_id, layer_id, dest,
                             total, list(layout), seq=seq if spmd else -1,
                             batch_id=batch_id, batch_n=batch_n,
-                            epoch=self.epoch)
+                            pod=sorted(pod or []), epoch=self.epoch)
         with self._lock:
             active = not self._startup_sent
         if active:
@@ -3645,11 +3758,16 @@ class LeaderNode:
         # full-layer pair (docs/sharding.md) — and a CODEC ack for its
         # (encoded digest, codec) key only (docs/codec.md).
         with self._lock:
-            if codec:
+            if shard:
+                # A shard ack vouches for its RANGE's bytes only —
+                # codec-qualified when the range hashes the encoded
+                # blob (pod pairs); the FULL codec digest must never
+                # stand in for it (docs/sharding.md, docs/codec.md).
+                digest = self._range_digest_cache.get(
+                    (msg.layer_id, _range_key(shard, codec)))
+            elif codec:
                 digest = self._codec_digest_cache.get(
                     (msg.layer_id, codec))
-            elif shard:
-                digest = self._range_digest_cache.get((msg.layer_id, shard))
             else:
                 digest = self.layer_digests.get(msg.layer_id)
         self.content.add(msg.src_id, msg.layer_id, digest, shard=shard,
@@ -3657,6 +3775,10 @@ class LeaderNode:
         self._jobs_completed(
             self.jobs.on_ack(msg.src_id, msg.layer_id, shard=shard,
                              version=version, codec=codec))
+        # Fabric-assisted pod delivery (docs/fabric.md): a shard ack may
+        # complete a pod's NIC phase (the SPMD gather dispatches here);
+        # a full ack is the pair's materialized tree landing.
+        self._on_pod_ack(msg.src_id, msg.layer_id, shard, codec)
         self._maybe_finish()
 
     def _jobs_completed(self, job_ids) -> None:
@@ -3691,7 +3813,7 @@ class LeaderNode:
         with self._lock:
             if self._startup_sent or not assignment_satisfied(
                 self.assignment, self.status
-            ):
+            ) or self._pods_open_locked():
                 return
             self._startup_sent = True
             # Replicate INSIDE the lock (publish only enqueues): every
@@ -3863,6 +3985,10 @@ class LeaderNode:
         for jid in affected:
             self._replicate("job", **self.jobs.record(jid))
         self._jobs_completed(finished)
+        # Fabric-assisted pod delivery (docs/fabric.md): a dead pod
+        # member's pod degrades to host-path delivery — survivors must
+        # not wait on a gather contribution that can never arrive.
+        self._pods_member_gone(node_id)
         self._drive(self._recover)
         # The crash may have removed the last assignee the boot/TTFT wait
         # was blocked on.
@@ -3936,7 +4062,7 @@ class RetransmitLeaderNode(LeaderNode):
                 self.layer_owners.setdefault(layer_id, set()).add(node_id)
 
     def send_layers(self) -> None:
-        self._stamp_codecs()
+        self._stamp_targets()
         with self._lock:
             self._build_layer_owners()
             owners_by_layer = {k: set(v) for k, v in self.layer_owners.items()}
@@ -4409,13 +4535,44 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         fabric=None,
         placement=None,
         topology=None,
+        pods=None,
         **ha,
     ):
         """``topology``: optional ``sched.flow.PodTopology`` — multi-slice
         pods plan cross-slice transfers against the per-pair DCN
-        capacity instead of pretending every edge is ICI."""
+        capacity instead of pretending every edge is ICI.
+
+        ``pods`` (docs/fabric.md): ``{pod_id: [member node ids]}`` —
+        groups of dests sharing an ICI domain.  A layer every member of
+        a pod wants ships as ONE 1/R shard per host over the NIC
+        (possibly quantized), and the full tree materializes over the
+        on-mesh gather — pod NIC ingress is O(model_bytes), not
+        O(model_bytes x replicas).  Members must be disjoint across
+        pods and must not include the leader seat."""
         self.node_network_bw = dict(node_network_bw)
         self.topology = topology
+        self.pods: Dict[int, List[NodeID]] = {}
+        self._pod_of: Dict[NodeID, int] = {}
+        for pid, members in sorted((pods or {}).items()):
+            ms = sorted(int(m) for m in members)
+            if int(node.my_id) in ms:
+                raise ValueError("the leader seat cannot be a pod member")
+            for m in ms:
+                if m in self._pod_of:
+                    raise ValueError(
+                        f"node {m} appears in more than one pod")
+                self._pod_of[m] = int(pid)
+            self.pods[int(pid)] = ms
+        # Pods that lost a member (crash/drain) degrade to host-path
+        # delivery for the rest of the run — a silent mid-flight
+        # re-shard would strand partials in dead byte spaces.
+        self._pods_broken: Set[int] = set()
+        # (layer, dest) -> monotonic time of the pair's SHARD ack: the
+        # gather watchdog degrades pairs whose full tree never follows.
+        self._pod_shard_acked: Dict[Tuple[LayerID, NodeID], float] = {}
+        # (layer, pod) gathers already dispatched on the SPMD fabric.
+        self._pod_gather_sent: Set[Tuple[LayerID, int]] = set()
+        self._pod_plane_warned = False
         # sender -> dispatched (not yet known-delivered) flow jobs: the
         # range-salvage index — crash(sender) re-plans only its jobs'
         # DESTS' uncovered byte ranges (docs/failover.md).
@@ -4440,6 +4597,9 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                          expected_nodes=expected_nodes,
                          failure_timeout=failure_timeout,
                          fabric=fabric, placement=placement, **ha)
+        if self.pods and start_loop:
+            threading.Thread(target=self._pod_watchdog,
+                             name="pod-watchdog", daemon=True).start()
 
     @staticmethod
     def _warm_lp() -> None:
@@ -4461,7 +4621,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         return self.node_network_bw.get(node_id, 0)
 
     def send_layers(self) -> None:
-        self._stamp_codecs()
+        self._stamp_targets()
         t, self_jobs, jobs = self.assign_jobs()
         self._dispatch(t, self_jobs, jobs)
 
@@ -4470,6 +4630,343 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         flat mode; the hierarchical leader reduces grouped members to
         group-ingress demands (docs/hierarchy.md).  Lock held."""
         return self.assignment
+
+    # ------------------------------------------ fabric-assisted pod delivery
+
+    # How long a pod pair may sit shard-complete without its FULL tree
+    # ack before the whole (layer, pod) degrades to host-path delivery,
+    # and the check cadence (class attrs: tests tune them).
+    POD_GATHER_TIMEOUT = 60.0
+    POD_WATCH_PERIOD = 1.0
+
+    def _pod_plane_available(self) -> bool:
+        """Whether the pod members have an ICI reconstruction plane:
+        the SPMD lockstep fabric (the leader dispatches the gather), or
+        a shared single-controller ``FabricPlane`` whose shard board the
+        members self-coordinate over.  Without one, pod pairs would
+        shard-deliver and then wedge waiting for peers that can never
+        be reached — plan flat instead, loudly (the "hosts without a
+        fabric fall back to the host path" rule, docs/fabric.md)."""
+        if self._fabric_disabled:
+            return False
+        if self._spmd:
+            return True
+        return self.fabric is not None and hasattr(self.fabric,
+                                                   "pod_publish")
+
+    def _stamp_pod_shards(self) -> None:
+        """The pod-delivery demand transform (docs/fabric.md; the
+        pricing half lives in ``sched.flow.pod_shard_demands``): every
+        layer ALL members of a pod want as a plain full target is
+        re-targeted as one 1/R shard slice per member — the NIC then
+        carries ~model_bytes per pod (x codec ratio), and the ICI
+        gather materializes the other R-1 copies.  Idempotent across
+        re-plans: existing pairs keep their specs verbatim (mid-flight
+        partials live in those byte ranges)."""
+        if not self.pods:
+            return
+        if not self._pod_plane_available():
+            if not self._pod_plane_warned:
+                self._pod_plane_warned = True
+                log.warn("pods configured but no reconstruction plane "
+                         "(fabric missing/disabled); pod pairs plan "
+                         "flat over the host path")
+            return
+        added = []
+        redrive: Set[Tuple[LayerID, int]] = set()
+        with self._lock:
+            live = {pid: [m for m in ms if m in self.assignment]
+                    for pid, ms in self.pods.items()
+                    if pid not in self._pods_broken}
+            live = {pid: ms for pid, ms in live.items() if len(ms) >= 2}
+            if not live:
+                return
+            # ADOPTION (failover re-derivation): a promoted leader's
+            # replicated assignment already carries the predecessor's
+            # pod shard specs, which the transform below deliberately
+            # refuses to re-slice — recognize a consistent 1/R@k
+            # pattern across a pod's members as the SAME pod pairs, or
+            # the goal would close on shard acks with no gather ever
+            # driven (the open-until-materialized invariant).
+            for pid, ms in live.items():
+                layers = sorted({lid for m in ms
+                                 for lid in (self.assignment.get(m)
+                                             or {})})
+                for lid in layers:
+                    if any((lid, m) in self._pod_pairs for m in ms):
+                        continue
+                    speced = []
+                    for m in ms:
+                        meta = (self.assignment.get(m) or {}).get(lid)
+                        if meta is None or not meta.shard \
+                                or meta.version:
+                            continue
+                        speced.append((m, meta.shard))
+                    n = len(speced)
+                    if n < 2 or [s for _, s in speced] != [
+                            f"1/{n}@{k}" for k in range(n)]:
+                        continue
+                    for m, spec in speced:
+                        self._pod_pairs[(lid, m)] = spec
+                    redrive.add((lid, pid))
+                    log.info("adopted in-flight pod pairs from the "
+                             "replicated goal", layerID=lid, pod=pid,
+                             members=[m for m, _ in speced])
+            pairs = pod_shard_demands(self.assignment, live,
+                                      prior=self._pod_pairs)
+            for (lid, dest), spec in sorted(pairs.items()):
+                row = self.assignment.get(dest)
+                meta = (row or {}).get(lid)
+                if meta is None or meta.version:
+                    continue
+                if (lid, dest) in self._pod_pairs:
+                    # A goal re-merge (update/submit_job) rebuilds the
+                    # assignment spec-less: re-apply the STABLE prior
+                    # spec, exactly like _stamp_codecs re-applies its
+                    # memoized choices.
+                    if not meta.shard:
+                        row[lid] = dataclasses.replace(meta, shard=spec)
+                    continue
+                row[lid] = dataclasses.replace(meta, shard=spec)
+                self._pod_pairs[(lid, dest)] = spec
+                added.append((lid, dest, spec))
+        if added:
+            trace.count("pod.pairs_planned", len(added))
+            log.info("pod delivery planned",
+                     pairs=len(added),
+                     layers=sorted({lid for lid, _, _ in added}))
+        # Re-drive adopted pods whose shard phase already finished
+        # under the predecessor: seed the gather clock (the watchdog
+        # must cover them) and, under SPMD, dispatch the gather —
+        # no further shard ack will arrive to trigger it.
+        for lid, pid in sorted(redrive):
+            with self._lock:
+                if not self._pod_shards_ready_locked(lid, pid):
+                    continue
+                now = time.monotonic()
+                for m in self.pods.get(pid, ()):
+                    if (lid, m) in self._pod_pairs:
+                        self._pod_shard_acked.setdefault((lid, m), now)
+            if self._spmd:
+                self._maybe_dispatch_pod_gather(lid, pid)
+
+    def _pods_open_locked(self) -> bool:
+        """Lock held.  A pod pair is OPEN until its dest's status row
+        shows the FULL wire-form tree (shard "" in an accepting codec)
+        — shard coverage alone must not finish the goal
+        (docs/fabric.md: the gathered tree is the deliverable)."""
+        for (lid, dest), spec in self._pod_pairs.items():
+            want = (self.assignment.get(dest) or {}).get(lid)
+            if want is None or want.shard != spec:
+                continue  # degraded/dropped pair: the plain goal rules
+            held = self.status.get(dest, {}).get(lid)
+            if (held is None or not delivered(held) or held.shard
+                    or not codec_accepts(held.codec, want.codec)):
+                return True
+        return False
+
+    def _on_pod_ack(self, dest: NodeID, layer_id: LayerID, shard: str,
+                    codec: str) -> None:
+        key = (layer_id, dest)
+        with self._lock:
+            spec = self._pod_pairs.get(key)
+            if spec is None:
+                return
+            if not shard:
+                # The materialized tree landed: the pair is closed (the
+                # watchdog stops aging it).
+                self._pod_shard_acked.pop(key, None)
+                trace.count("pod.pairs_materialized")
+                log.info("pod pair materialized its full tree",
+                         layerID=layer_id, dest=dest,
+                         codec=codec or None)
+                return
+            pid = self._pod_of.get(dest)
+            # The gather clock starts only when the POD's shard set is
+            # COMPLETE — no tree can materialize before the last shard
+            # lands, so aging a pair from its own (possibly first) ack
+            # would spuriously degrade a pod whose other members are
+            # still legitimately downloading.
+            if pid is not None and self._pod_shards_ready_locked(
+                    layer_id, pid):
+                now = time.monotonic()
+                for m in self.pods.get(pid, ()):
+                    if (layer_id, m) in self._pod_pairs:
+                        self._pod_shard_acked.setdefault(
+                            (layer_id, m), now)
+        if self._spmd and pid is not None:
+            self._maybe_dispatch_pod_gather(layer_id, pid)
+
+    def _pod_shards_ready_locked(self, layer_id: LayerID,
+                                 pid: int) -> bool:
+        """Lock held.  Whether every member of ``pid`` with a pod pair
+        for ``layer_id`` has delivered its shard (the reconstruction
+        phase can begin — and be timed)."""
+        any_pair = False
+        for m in self.pods.get(pid, ()):
+            spec = self._pod_pairs.get((layer_id, m))
+            if spec is None:
+                continue
+            any_pair = True
+            held = self.status.get(m, {}).get(layer_id)
+            if (held is None or not delivered(held)
+                    or not shard_covers(held.shard, spec)):
+                return False
+        return any_pair
+
+    def _pod_wire_total_locked(self, layer_id: LayerID, codec: str) -> int:
+        """Lock held.  The pod pair's wire-space total: encoded bytes
+        for a codec pair, the canonical layer size otherwise."""
+        if codec and self.codecs is not None:
+            n = self.codecs.nbytes(layer_id, codec)
+            if n is not None:
+                return n
+        return self._layer_size_locked(layer_id)
+
+    def _maybe_dispatch_pod_gather(self, layer_id: LayerID,
+                                   pid: int) -> None:
+        """SPMD pods: once EVERY member of ``pid`` acked its shard of
+        ``layer_id``, broadcast ONE reconstruction plan — layout = the
+        members' shard ranges, ``pod`` = the members (all of them keep
+        the gathered tree).  The members then verify the full wire-form
+        digest and ack the FULL layer (receiver._await_spmd_plan)."""
+        with self._lock:
+            if (layer_id, pid) in self._pod_gather_sent:
+                return
+            members = [m for m in self.pods.get(pid, ())
+                       if (layer_id, m) in self._pod_pairs]
+            if len(members) < 2:
+                return
+            codec = ""
+            layout = []
+            for m in members:
+                spec = self._pod_pairs[(layer_id, m)]
+                held = self.status.get(m, {}).get(layer_id)
+                want = (self.assignment.get(m) or {}).get(layer_id)
+                if (held is None or not delivered(held)
+                        or not shard_covers(held.shard, spec)):
+                    return  # a member's shard is still in flight
+                codec = want.codec if want is not None else held.codec
+            total = self._pod_wire_total_locked(layer_id, codec)
+            if total <= 0:
+                return
+            for m in members:
+                off, size = shard_range(self._pod_pairs[(layer_id, m)],
+                                        total)
+                layout.append((m, off, size))
+            self._pod_gather_sent.add((layer_id, pid))
+        layout.sort(key=lambda t: t[1])
+        dest = min(members)
+        if not self._fabric_ok(layer_id, layout, dest, total):
+            log.warn("pod gather not fabric-eligible; members keep "
+                     "their shards (watchdog will degrade)",
+                     layerID=layer_id, pod=pid)
+            return
+        trace.count("pod.gathers_dispatched")
+        log.info("dispatching pod gather plan", layerID=layer_id,
+                 pod=pid, members=members, total_bytes=total,
+                 codec=codec or None)
+        if not self._dispatch_device_plan(layer_id, dest, layout, total,
+                                          pod=members):
+            # The documented contract: a failed plan send means the
+            # host path must carry the bytes — degrade NOW instead of
+            # sitting out the watchdog on a plan nobody received.
+            log.error("pod gather plan dispatch failed; degrading to "
+                      "host path", layerID=layer_id, pod=pid)
+            self._degrade_pod_layer(layer_id, pid)
+
+    def _pod_watchdog(self) -> None:
+        """Liveness for the reconstruction phase: a pod pair whose
+        shards all landed but whose FULL tree never acks (a member's
+        gather failed, a peer shard never published) degrades the whole
+        (layer, pod) to host-path delivery after ``POD_GATHER_TIMEOUT``
+        — bounded, loud, never a wedge (docs/fabric.md)."""
+        while not self._watch_stop.wait(self.POD_WATCH_PERIOD):
+            now = time.monotonic()
+            stale: Set[Tuple[LayerID, int]] = set()
+            with self._lock:
+                for key, t0 in list(self._pod_shard_acked.items()):
+                    if now - t0 < self.POD_GATHER_TIMEOUT:
+                        continue
+                    lid, dest = key
+                    pid = self._pod_of.get(dest)
+                    if pid is not None:
+                        stale.add((lid, pid))
+            for lid, pid in sorted(stale):
+                log.error("pod gather timed out; degrading to host path",
+                          layerID=lid, pod=pid)
+                trace.count("pod.gather_degraded")
+                self._degrade_pod_layer(lid, pid)
+
+    def _degrade_pod_layer(self, layer_id: LayerID, pid: int) -> None:
+        """Fall a (layer, pod)'s unfinished pairs back to plain
+        full-layer host-path targets: clear the shard specs (the
+        re-stamp's widen reconcile re-opens the members' partials, so
+        the re-plan ships only the missing ranges) and forget the pod
+        records.  Pairs whose tree already materialized keep it.  The
+        pod stops pod-planning for the rest of the run (a degrade →
+        re-shard → degrade loop must not be possible)."""
+        widened = []
+        with self._lock:
+            self._pods_broken.add(pid)
+            for m in self.pods.get(pid, ()):
+                key = (layer_id, m)
+                spec = self._pod_pairs.get(key)
+                if spec is None:
+                    continue
+                self._pod_pairs.pop(key)
+                self._pod_shard_acked.pop(key, None)
+                row = self.assignment.get(m)
+                meta = (row or {}).get(layer_id)
+                if meta is None or meta.shard != spec:
+                    continue
+                held = self.status.get(m, {}).get(layer_id)
+                if (held is not None and delivered(held)
+                        and not held.shard
+                        and codec_accepts(held.codec, meta.codec)):
+                    continue  # tree already landed; nothing to redo
+                row[layer_id] = dataclasses.replace(meta, shard="")
+                widened.append(m)
+            self._pod_gather_sent.discard((layer_id, pid))
+        if widened:
+            # The widen must reach the members BEFORE the re-plan's
+            # bytes: the stamp reconcile is what re-opens their shard
+            # holdings as partials (docs/sharding.md).
+            for m in widened:
+                self._send_digests_to(m)
+            self._drive(self._recover)
+
+    def _pods_member_gone(self, node: NodeID) -> None:
+        """A pod member crashed or departed: its pods' unfinished pod
+        pairs (every member's) degrade to host-path full delivery, and
+        the pod stops pod-planning for the rest of the run — the
+        survivors must never wait on a gather contribution that can no
+        longer arrive."""
+        pid = self._pod_of.get(node)
+        if pid is None:
+            return
+        with self._lock:
+            fresh = pid not in self._pods_broken
+            if fresh:
+                self._pods_broken.add(pid)
+            lids = sorted({lid for (lid, d) in self._pod_pairs
+                           if self._pod_of.get(d) == pid})
+            # The departed seat's own pairs drop outright (its
+            # assignment row is going away with it).
+            for lid in lids:
+                self._pod_pairs.pop((lid, node), None)
+                self._pod_shard_acked.pop((lid, node), None)
+        if fresh:
+            trace.count("pod.pods_broken")
+        if not lids:
+            return
+        # Degrade EVERY remaining pair — even for an already-broken pod
+        # (a second member dying after a single-layer timeout degrade
+        # still strands the other layers' gathers).
+        log.warn("pod member gone; degrading its pod to host path",
+                 node=node, pod=pid, layers=lids)
+        for lid in lids:
+            self._degrade_pod_layer(lid, pid)
 
     def assign_jobs(self) -> Tuple[int, FlowJobsMap, FlowJobsMap]:
         """Split off self-jobs (dest already holds the layer at its own

@@ -256,6 +256,14 @@ class ReceiverNode:
         # against without ever holding the full layer.
         self._shard_specs: Dict[int, str] = {}
         self._range_digests: Dict[int, str] = {}
+        # Fabric-assisted pod delivery (docs/fabric.md): leader-stamped
+        # pod width per layer — this dest's shard target is one slice
+        # of an n-way POD split; after the per-range digest gate the
+        # shard feeds the on-mesh reconstruction and the FULL tree acks
+        # once it verifies against the stamped full wire-form digest.
+        self._pod_widths: Dict[int, int] = {}
+        self._pod_collecting: set = set()
+        self._pod_stager_obj = None
         # Versioned rollout targets (docs/swap.md): leader-stamped
         # version per assigned layer — stored holdings and acks carry
         # the tag, so a v2 delivery can never be mistaken for (or
@@ -317,6 +325,7 @@ class ReceiverNode:
                 node_id=node.my_id,
                 digest_lookup=self._expected_digest,
                 digest_verified=self._digest_ok)
+            self._boot_stager.on_gathered = self._on_pod_gathered
         # Multi-controller serving (runtime/pp_serve.py): startup said a
         # ServeMsg will follow; the CLI keeps the process alive until
         # serve_done() fires (or times out).
@@ -944,6 +953,15 @@ class ReceiverNode:
             self._shard_specs.update(
                 {l: s for l, s in msg.shards.items() if s})
             self._range_digests.update(msg.range_digests)
+            # Pod-delivery stamps (docs/fabric.md): which shard targets
+            # are pod slices owing a full on-mesh reconstruction.  A
+            # stamped layer whose pod entry DISAPPEARED was degraded to
+            # plain delivery — stop expecting (or driving) a gather.
+            for lid in set(msg.digests) | set(msg.shards):
+                if lid not in msg.pods:
+                    self._pod_widths.pop(lid, None)
+            self._pod_widths.update(
+                {int(l): int(n) for l, n in msg.pods.items() if n > 1})
         log.debug("layer digests stamped", n=len(msg.digests),
                   shards=len(msg.shards), codecs=len(msg.codecs))
         for lid in recoded:
@@ -960,6 +978,11 @@ class ReceiverNode:
             # coverage already satisfies the just-learned shard must
             # promote now — no later fragment will re-run the check.
             self._on_shard_specs(sorted(msg.shards))
+        if msg.pods:
+            # So can POD stamps: a member already holding its slice (or
+            # the full tree) publishes it now instead of leaving peers
+            # to time out waiting (docs/fabric.md).
+            self._pod_publish_existing(sorted(msg.pods))
         if msg.versions:
             # Version stamps can lose the race against small layers the
             # same way: a layer that landed (and acked, unversioned)
@@ -1297,6 +1320,231 @@ class ReceiverNode:
             log.warn("streamed boot submit failed", layerID=layer_id,
                      err=repr(e))
 
+    # ------------------------------------ fabric-assisted pod delivery
+
+    def _pod_stager(self):
+        """The shard-gather driver (docs/fabric.md): the boot stager
+        when one exists (its gather ALSO dequants + stages the decoded
+        leaves on device), else a lazily-built cfg-less stager that
+        exists purely to run ``submit_shard``/``gather_byte_shards``
+        off the handler threads."""
+        if self._boot_stager is not None:
+            return self._boot_stager
+        with self._lock:
+            if self._pod_stager_obj is None:
+                from .stream_boot import StreamingBootStager
+
+                self._pod_stager_obj = StreamingBootStager(
+                    None, node_id=self.node.my_id)
+                self._pod_stager_obj.on_gathered = self._on_pod_gathered
+            return self._pod_stager_obj
+
+    def _pod_board(self):
+        """The pod shard-exchange board — the single-controller
+        ``FabricPlane``'s in-process stand-in for the ICI hop.  None
+        when this node has no fabric (or an SPMD one: there the leader
+        dispatches the reconstruction as a lockstep plan instead)."""
+        if self._spmd or self.fabric is None:
+            return None
+        return self.fabric if hasattr(self.fabric, "pod_publish") else None
+
+    def _start_pod_collect(self, lid: int, src) -> None:
+        """A verified holding covering this dest's pod slice exists
+        (usually the freshly completed SHARD; also a pre-existing full
+        or shard holding when the pod stamp arrives after a restart or
+        over seeded bytes): publish the slice to the pod board and
+        start this layer's collect loop (once) — peers' shards feed
+        ``submit_shard`` in ANY completion order, and the last arrival
+        fires the on-mesh gather."""
+        board = self._pod_board()
+        with self._lock:
+            n = self._pod_widths.get(lid)
+            # The STAMPED target spec names this dest's slice; the
+            # holding may be wider (a full tree publishes its slice so
+            # peers' gathers don't wait out the timeout for it).
+            spec = self._shard_specs.get(lid) or src.meta.shard
+            codec = self._layer_codecs.get(lid, "")
+            if board is None or n is None or lid in self._pod_collecting:
+                return
+            self._pod_collecting.add(lid)
+        from ..core.types import parse_shard_spec
+
+        parsed = parse_shard_spec(spec)
+        if (parsed is None or parsed[0] != n
+                or not shard_covers(src.meta.shard, spec)
+                or src.meta.codec != codec
+                or src.inmem_data is None):
+            log.error("pod stamp disagrees with the held bytes; not "
+                      "gathering", layerID=lid, spec=spec, pod_n=n,
+                      held_shard=src.meta.shard or None,
+                      held_codec=src.meta.codec or None)
+            with self._lock:
+                # Un-claim: a corrected re-stamp must be able to retry.
+                self._pod_collecting.discard(lid)
+            return
+        rank = parsed[1]
+        total = src.data_size
+        s0, s_sz = shard_range(spec, total)
+        key = (lid, n, codec)
+        board.pod_publish(key, rank,
+                          memoryview(src.inmem_data)[s0:s0 + s_sz])
+        log.info("pod shard published for on-mesh gather", layerID=lid,
+                 rank=rank, pod_n=n, bytes=s_sz, codec=codec or None)
+        threading.Thread(
+            target=self._pod_collect_loop, args=(lid, n, total, codec),
+            daemon=True, name=f"pod-collect-{self.node.my_id}").start()
+
+    def _pod_publish_existing(self, lids) -> None:
+        """A pod stamp can name layers this dest ALREADY holds (restart
+        re-announce, seeded replicas, a completed earlier pod round):
+        publish the slice from the existing holding so peers' gathers
+        never wait out the collect window for a member whose shard
+        phase finished before the stamp."""
+        if self._spmd:
+            return  # the leader drives SPMD reconstruction explicitly
+        for lid in lids:
+            with self._lock:
+                src = self.layers.get(lid)
+                spec = self._shard_specs.get(lid, "")
+                range_digest = self._range_digests.get(lid)
+                verified = lid in self._digest_ok
+            if src is None or src.inmem_data is None:
+                continue
+            if not verified and range_digest:
+                # A pre-held full tree never crossed the shard gate:
+                # its SLICE must verify against the stamped range
+                # digest before it may enter peers' gathers.
+                s0, s_sz = shard_range(spec, src.data_size)
+                verified = integrity.digest_matches(
+                    memoryview(src.inmem_data)[s0:s0 + s_sz],
+                    range_digest)
+                if not verified:
+                    log.error("pre-held bytes fail the stamped range "
+                              "digest; not publishing", layerID=lid)
+                    continue
+            elif not verified and range_digest is None:
+                verified = True  # CRC-only regime (no digest stamped)
+            self._start_pod_collect(lid, src)
+
+    def _pod_collect_loop(self, lid: int, n: int, total: int,
+                          codec: str) -> None:
+        """Drain the board into the shard gather until all ``n`` shards
+        arrived (the gather fires inside the stager's worker) or the
+        collect window expires — bounded: a timeout leaves the shard
+        holding acked as-is and the LEADER's pod watchdog degrades the
+        (layer, pod) to host-path delivery; never a wedge."""
+        board = self._pod_board()
+        if board is None:
+            return
+        key = (lid, n, codec)
+        stager = self._pod_stager()
+        with self._lock:
+            digest = self.layer_digests.get(lid, "")
+        have: set = set()
+        deadline = _time.monotonic() + self.FABRIC_COLLECT_TIMEOUT
+        while len(have) < n:
+            snap = board.pod_wait_new(key, len(have),
+                                      deadline - _time.monotonic())
+            if snap is None:
+                trace.count("pod.collect_timeouts")
+                log.error("pod delivery degraded to host path",
+                          reason="peer shards never arrived",
+                          layerID=lid, have=sorted(have), pod_n=n)
+                board.pod_done(key, n, who=self.node.my_id)
+                with self._lock:
+                    # Un-claim so a redelivery can retry the collect.
+                    self._pod_collecting.discard(lid)
+                return
+            for rank in sorted(set(snap) - have):
+                have.add(rank)
+                if not stager.submit_shard(
+                        lid, f"1/{n}@{rank}", snap[rank], total,
+                        expected_digest=digest, codec=codec):
+                    # Closed stager / conflicting geometry: the gather
+                    # can never fire here — fail LOUD and fast instead
+                    # of draining the board as if it had.
+                    trace.count("pod.collect_timeouts")
+                    log.error("pod delivery degraded to host path",
+                              reason="shard rejected by the gather "
+                                     "driver", layerID=lid, rank=rank)
+                    board.pod_done(key, n, who=self.node.my_id)
+                    with self._lock:
+                        self._pod_collecting.discard(lid)
+                    return
+        board.pod_done(key, n, who=self.node.my_id)
+
+    def _on_pod_gathered(self, lid: int, out, codec: str) -> None:
+        """Stager hook: this layer's on-mesh gather finished.  On
+        success the FULL wire-form tree becomes the holding (exactly
+        what a full host-path delivery at this codec would have
+        stored — staging/boot/serving reuse every existing path) and
+        the dest acks the full layer; on failure the shard holding
+        stands and the leader's watchdog degrades the pair, loudly."""
+        with self._lock:
+            pod = lid in self._pod_widths
+            self._pod_collecting.discard(lid)
+        if not pod:
+            return  # a plain sharded-delivery gather (harness-driven)
+        if out is None:
+            trace.count("pod.materialize_failed")
+            log.error("pod gather failed; shard holding stands (leader "
+                      "degrades the pair)", layerID=lid)
+            return
+        # The gather already verified the stamped full wire-form digest
+        # (gather_byte_shards raises on mismatch).
+        self._pod_store_full_tree(lid, out, codec, verified=True)
+
+    def _pod_store_full_tree(self, lid: int, data, codec: str,
+                             verified: bool, spmd: bool = False) -> None:
+        """THE pod materialization chokepoint (docs/fabric.md): both
+        reconstruction paths — the stager's board gather and the SPMD
+        lockstep plan — funnel here so verify/store/stage/span/ack can
+        never diverge.  ``verified``: the stamped full wire-form digest
+        already checked upstream; otherwise it is checked now (directly
+        — the per-lid memo and the range-digest lookup both describe
+        the SHARD phase, not the gathered tree).  A mismatch keeps the
+        shard holding (acked long ago); the leader's watchdog degrades
+        the pair — corrupt bytes never ack."""
+        if not verified:
+            with self._lock:
+                digest = self.layer_digests.get(lid, "")
+            if digest:
+                ok, dt, got = integrity.digest_check(
+                    memoryview(data), digest)
+                if ok is False:
+                    trace.count("pod.materialize_failed")
+                    log.error("pod-gathered tree failed the stamped "
+                              "full wire digest; keeping the shard "
+                              "holding", layerID=lid, expected=digest,
+                              got=got)
+                    return
+                trace.add_phase("integrity_digest", dt)
+        with self._lock:
+            src = self.layers.get(lid)
+            if src is not None and not src.meta.shard:
+                return  # already full (host-path redelivery won)
+            src = self.layers[lid] = LayerSrc(
+                inmem_data=bytearray(data), data_size=len(data),
+                meta=LayerMeta(location=LayerLocation.INMEM,
+                               codec=codec))
+            # Memoize the verdict so re-acks and stamp re-checks never
+            # re-hash the full tree.
+            if self.layer_digests.get(lid):
+                self._digest_ok.add(lid)
+        if codec:
+            self._count_codec_delivery(lid, len(data), codec)
+        loc = self._stage_to_hbm(lid, src)
+        self._boot_stream_submit(lid, src)  # dedupes if pre-staged
+        telemetry.span_event(
+            telemetry.span_id(self.node.my_id, lid), "staged",
+            node=self.node.my_id, dest=self.node.my_id, layer=lid,
+            shard="", codec=codec)
+        trace.count("pod.trees_materialized")
+        log.info("pod delivery materialized full tree", layerID=lid,
+                 bytes=len(data), codec=codec or None,
+                 **({"spmd": True} if spmd else {}))
+        self._send_ack(lid, loc)
+
     def close(self) -> None:
         self._closed_evt.set()
         self._metrics_stop.set()
@@ -1304,6 +1552,8 @@ class ReceiverNode:
         self.loop.stop()
         if self._boot_stager is not None:
             self._boot_stager.close()
+        if self._pod_stager_obj is not None:
+            self._pod_stager_obj.close()
         with self._lock:
             window = self._plan_window
         if window is not None:
@@ -1547,15 +1797,17 @@ class ReceiverNode:
         ``transport/faults.FaultyTransport`` now — the CLI's
         ``-test-drop-plan-seqs`` wraps the transport; this handler only
         ever sees plans that "arrived".)"""
+        mine = (msg.dest_id == self.node.my_id
+                or self.node.my_id in (msg.pod or ()))
         try:
             res = self.fabric.submit(msg)
         except Exception as e:  # noqa: BLE001 — closed/duplicate races
             log.error("spmd fabric submit failed", plan=msg.plan_id,
                       err=repr(e))
-            if msg.dest_id == self.node.my_id and msg.layout:
+            if mine and msg.layout:
                 self._request_replan()
             return
-        if msg.dest_id != self.node.my_id or not msg.layout:
+        if not mine or not msg.layout:
             return
         threading.Thread(
             target=self._await_spmd_plan, args=(msg, res), daemon=True,
@@ -1580,6 +1832,9 @@ class ReceiverNode:
                       "re-plan", plan=msg.plan_id, layerID=msg.layer_id)
             self._request_replan()
             return
+        if msg.pod:
+            self._spmd_pod_store(msg, arr)
+            return
         self._fabric_store(msg.layer_id, msg.total_size, device_arr=arr)
         # A duplicate plan for an already-held layer no-ops in the store:
         # ack whatever location the layer ACTUALLY has (a host-path copy
@@ -1589,6 +1844,28 @@ class ReceiverNode:
         log.info("layer landed over device fabric", layerID=msg.layer_id,
                  plan=msg.plan_id, total_bytes=msg.total_size, spmd=True)
         self._send_ack(msg.layer_id, loc)
+
+    def _spmd_pod_store(self, msg: DevicePlanMsg, arr) -> None:
+        """A pod reconstruction plan's gathered tree landed on this
+        member (docs/fabric.md): read the wire-form bytes back and run
+        the shared verify/store/stage/ack chokepoint
+        (``_pod_store_full_tree``)."""
+        import numpy as _np
+
+        lid = msg.layer_id
+        try:
+            import jax as _jax
+
+            data = _np.asarray(
+                _jax.device_get(arr)).tobytes()[:msg.total_size]
+        except Exception as e:  # noqa: BLE001 — loud, never wedge
+            log.error("pod gather readback failed", layerID=lid,
+                      err=repr(e))
+            return
+        with self._lock:
+            codec = self._layer_codecs.get(lid, "")
+        self._pod_store_full_tree(lid, data, codec, verified=False,
+                                  spmd=True)
 
     def _local_coverage(self, layer_id):
         """Byte ranges of an in-progress layer this node already holds
@@ -3382,6 +3659,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                              dest=self.node.my_id, layer=lid,
                              shard=shard)
         self._send_ack(lid, loc, shard=shard)
+        if shard:
+            # Fabric-assisted pod delivery (docs/fabric.md): a verified
+            # pod slice enters the on-mesh reconstruction — the FULL
+            # tree acks separately once the gather verifies.  No-op for
+            # plain sharded targets (no pod stamp).
+            self._start_pod_collect(lid, src)
         # Stamp-before-donor race: this completed layer may be the
         # donor a stamped-but-missing layer was waiting for.
         self._resolve_pending_for_layer(lid)

@@ -75,6 +75,13 @@ class StreamingBootStager:
         self.node_id = node_id
         self.digest_lookup = digest_lookup
         self.digest_verified = digest_verified
+        # Pod-delivery hook (docs/fabric.md): called from the worker
+        # thread after every shard-gather attempt with
+        # ``(blob_id, full_wire_bytes_or_None, codec)`` — the receiver
+        # stores the materialized holding and acks the FULL layer (or
+        # degrades loudly) the moment ITS layer gathers, in any
+        # completion order across layers.
+        self.on_gathered = None
         self._q: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
@@ -96,7 +103,9 @@ class StreamingBootStager:
         closed stagers, or ids the boot can never use."""
         from ..models import serde
 
-        if blob_id > serde.head_blob_id(self.cfg):
+        if self.cfg is None or blob_id > serde.head_blob_id(self.cfg):
+            # cfg-less stagers exist purely as shard-gather drivers
+            # (pod delivery on a -boot none run): nothing boots here.
             return False
         with self._lock:
             if self._closed or blob_id in self._submitted:
@@ -219,7 +228,7 @@ class StreamingBootStager:
         return None
 
     def submit_shard(self, blob_id: int, spec: str, data, total: int,
-                     expected_digest: str = "") -> bool:
+                     expected_digest: str = "", codec: str = "") -> bool:
         """Feed one completed SHARD of a layer to the shard gather
         (docs/sharding.md) — callable the moment the shard's interval
         set completes, in ANY completion order across shards.  Returns
@@ -228,7 +237,14 @@ class StreamingBootStager:
         (``parallel.collectives.gather_byte_shards``) and the
         materialized FULL layer becomes available via
         ``collect_gathered`` — verified against ``expected_digest``
-        (the stamped full-layer digest) when one is known."""
+        (the stamped full-layer digest) when one is known.
+
+        ``codec`` (docs/codec.md, docs/fabric.md): the WIRE form the
+        shards slice ("" = canonical) — ``total``, the specs' ranges,
+        and ``expected_digest`` then all live in encoded byte space,
+        and a boot-capable stager dequants the gathered blob on device
+        (``quant.device_decode_jit`` after the gather) so the decoded
+        leaves stage exactly like a full codec'd delivery's would."""
         from ..core.types import parse_shard_spec
 
         parsed = parse_shard_spec(spec)
@@ -238,7 +254,7 @@ class StreamingBootStager:
                 return False
             rec = self._shards.setdefault(
                 blob_id, {"n": n, "total": int(total), "parts": {},
-                          "digest": "", "queued": False})
+                          "digest": "", "codec": codec, "queued": False})
             if rec["n"] != n or rec["total"] != int(total):
                 log.error("conflicting shard geometry submitted",
                           blobID=blob_id, have_n=rec["n"], got_n=n)
@@ -248,6 +264,8 @@ class StreamingBootStager:
             rec["parts"][k] = bytes(data)
             if expected_digest:
                 rec["digest"] = expected_digest
+            if codec:
+                rec["codec"] = codec
             ready = len(rec["parts"]) >= n and not rec["queued"]
             if not ready:
                 return True
@@ -281,15 +299,27 @@ class StreamingBootStager:
         with self._lock:
             rec = self._shards.get(blob_id)
             if rec is None:
-                parts, total, digest = None, 0, ""
+                parts, total, digest, codec = None, 0, "", ""
             else:
                 parts = sorted(rec["parts"].items())
                 total, digest = rec["total"], rec["digest"]
-        out = None
+                codec = rec.get("codec", "")
+        out, leaves = None, None
         if parts is not None:
+            # Boot-capable stagers decode the gathered blob in the same
+            # pass (device dequant when the gather ran on-mesh) so the
+            # leaves stage exactly like a full delivery's.
+            decode = None
+            if self.cfg is not None:
+                from ..models import serde
+
+                if blob_id <= serde.head_blob_id(self.cfg):
+                    decode = (self.cfg, blob_id)
             try:
-                out = gather_byte_shards(parts, total,
-                                         verify_digest=digest or None)
+                got = gather_byte_shards(parts, total,
+                                         verify_digest=digest or None,
+                                         codec=codec, decode=decode)
+                out, leaves = got if decode is not None else (got, None)
             except Exception as e:  # noqa: BLE001 — loud, never wedge
                 log.error("on-mesh shard gather failed", blobID=blob_id,
                           err=repr(e))
@@ -297,6 +327,12 @@ class StreamingBootStager:
         with self._lock:
             if out is not None and blob_id in self._shards:
                 self._gathered[blob_id] = out
+                if leaves is not None:
+                    # The gather's dequant already staged this blob:
+                    # mark it submitted so a later full-delivery
+                    # ``submit`` dedupes instead of re-decoding.
+                    self._submitted.add(blob_id)
+                    self._staged[blob_id] = leaves
             in_wire = not self._startup_seen
             self._pending -= 1
             if self._pending == 0:
@@ -308,7 +344,16 @@ class StreamingBootStager:
             log.info("layer materialized from shards (on-mesh gather)",
                      blobID=blob_id, gather_ms=round(dt * 1000, 1),
                      in_wire=in_wire, bytes=len(out),
-                     digest_verified=bool(digest))
+                     codec=codec or None, digest_verified=bool(digest))
+        hook = self.on_gathered
+        if hook is not None:
+            try:
+                hook(blob_id, out, codec)
+            except Exception as e:  # noqa: BLE001 — the hook must not
+                log.error("on_gathered hook failed", blobID=blob_id,
+                          err=repr(e))  # kill the worker
+        if out is None:
+            trace.count("shard.gather_failed")
 
     def _stage_one(self, blob_id: int, src) -> dict:
         """One blob's staging — ``boot.stage_blob_leaves`` verbatim, so

@@ -121,6 +121,61 @@ def pick_salvage_source(status: Status, layer_id: LayerID,
     return best
 
 
+def pod_shard_demands(
+    assignment: Assignment,
+    pods: Dict[int, List[NodeID]],
+    prior: Optional[Dict[Tuple[LayerID, NodeID], str]] = None,
+) -> Dict[Tuple[LayerID, NodeID], str]:
+    """Fabric-assisted pod delivery's demand transform (docs/fabric.md):
+    price ONE shard-sized ingress demand per pod host instead of a full
+    raw layer per replica.
+
+    For every pod whose members ALL want layer ``L`` as a plain full
+    target (no shard/version — a codec choice is preserved: the shard
+    then slices the ENCODED blob, and ``codec_sizes`` prices it), each
+    member's target becomes its ``1/R@k`` slice (rank = position among
+    the pod's wanting members, sorted by node id), so the pod's total
+    NIC ingress for the layer is ~model_bytes (x codec ratio) instead
+    of model_bytes x R — the remaining R-1 copies materialize over ICI
+    (``parallel.collectives.gather_byte_shards``).  Members whose
+    codec CHOICES disagree for a layer are never pod-sliced: the
+    slices must all index ONE wire byte space, or the gather would
+    splice mismatched encodings.
+
+    ``prior``: the pod pairs of an earlier transform this re-plan must
+    keep VERBATIM (mid-flight partials live in those specs' byte
+    ranges; membership churn degrades pairs explicitly, never by a
+    silent re-shard).  Mutates nothing: returns the full pod-pair map
+    {(layer, dest): spec} (prior ∪ new) — the caller stamps the specs
+    onto its own assignment metas."""
+    prior = prior or {}
+    pod_pairs: Dict[Tuple[LayerID, NodeID], str] = dict(prior)
+    for pid in sorted(pods):
+        members = sorted(pods[pid])
+        layers = sorted({lid for m in members
+                         for lid in (assignment.get(m) or {})})
+        for lid in layers:
+            if any((lid, m) in prior for m in members):
+                continue  # already transformed; specs must stay stable
+            wanting = []
+            codecs = set()
+            for m in members:
+                meta = (assignment.get(m) or {}).get(lid)
+                if meta is None:
+                    continue
+                if meta.shard or getattr(meta, "version", ""):
+                    wanting = []
+                    break  # qualified pair: the pod must not re-slice it
+                codecs.add(getattr(meta, "codec", ""))
+                wanting.append(m)
+            if len(wanting) < 2 or len(codecs) > 1:
+                continue  # nothing to amortize, or mixed byte spaces
+            n = len(wanting)
+            for k, m in enumerate(wanting):
+                pod_pairs[(lid, m)] = f"1/{n}@{k}"
+    return pod_pairs
+
+
 @dataclasses.dataclass(frozen=True)
 class PodTopology:
     """Multi-slice pod shape for the flow solve.

@@ -872,6 +872,12 @@ class DevicePlanMsg:
     # Empty/1 = unbatched; receivers that predate the hint ignore it.
     batch_id: str = ""
     batch_n: int = 1
+    # Pod-delivery gather (advisory, docs/fabric.md): the plan is the
+    # on-mesh RECONSTRUCTION of a pod's NIC-delivered shards — every
+    # node listed here keeps the gathered layer (not just ``dest_id``,
+    # which is the lowest-id member, kept for legacy addressing).
+    # Empty = a plain single-dest plan, omitted on the wire.
+    pod: list = dataclasses.field(default_factory=list)
     epoch: int = -1
 
     msg_type = MsgType.DEVICE_PLAN
@@ -889,6 +895,8 @@ class DevicePlanMsg:
         if self.batch_id:
             payload["BatchID"] = self.batch_id
             payload["BatchN"] = self.batch_n
+        if self.pod:
+            payload["Pod"] = [int(n) for n in self.pod]
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -903,6 +911,7 @@ class DevicePlanMsg:
             int(d.get("Seq", -1)),
             str(d.get("BatchID", "")),
             int(d.get("BatchN", 1)),
+            [int(n) for n in d.get("Pod") or []],
             int(d.get("Epoch", -1)),
         )
 
@@ -1006,8 +1015,16 @@ class LayerDigestsMsg:
     bytes — so a quantized copy verifies (and acks) under its own byte
     identity and can never silently pass as a raw one.
 
-    All omitted-at-default: an unsharded, unversioned, un-codec'd
-    run's stamp is byte-identical to the legacy format."""
+    Fabric-assisted pod delivery (docs/fabric.md) rides the stamp the
+    same way: ``pods`` — ``{layer_id: n}`` — tells the dest its shard
+    target for the layer is one slice of an ``n``-way POD split (its
+    rank is the ``@K`` of its shard spec); after per-range verification
+    it feeds the shard into the on-mesh reconstruction and acks the
+    FULL layer once the gathered tree verifies against the stamped
+    full-layer (wire-form) digest, instead of acking at shard coverage.
+
+    All omitted-at-default: an unsharded, unversioned, un-codec'd,
+    un-pod run's stamp is byte-identical to the legacy format."""
 
     src_id: NodeID
     digests: dict  # {layer_id: hex digest}
@@ -1016,6 +1033,7 @@ class LayerDigestsMsg:
     range_digests: dict = dataclasses.field(default_factory=dict)
     versions: dict = dataclasses.field(default_factory=dict)
     codecs: dict = dataclasses.field(default_factory=dict)
+    pods: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.LAYER_DIGESTS
 
@@ -1036,6 +1054,9 @@ class LayerDigestsMsg:
         if self.codecs:
             payload["WireCodecs"] = {str(lid): str(c)
                                      for lid, c in self.codecs.items()}
+        if self.pods:
+            payload["Pods"] = {str(lid): int(n)
+                               for lid, n in self.pods.items()}
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -1051,7 +1072,9 @@ class LayerDigestsMsg:
                    {int(lid): str(v)
                     for lid, v in (d.get("Versions") or {}).items()},
                    {int(lid): str(c)
-                    for lid, c in (d.get("WireCodecs") or {}).items()})
+                    for lid, c in (d.get("WireCodecs") or {}).items()},
+                   {int(lid): int(n)
+                    for lid, n in (d.get("Pods") or {}).items()})
 
 
 @dataclasses.dataclass

@@ -418,3 +418,33 @@ def test_codec_fields_interop_with_precodec_peers():
     assert payload["Codec"] == "int8"
     assert LayerHeader.from_payload(json.loads(json.dumps(payload))) == h
     assert "Codec" not in LayerHeader(1, 7, 64, 128, 0).to_payload()
+
+
+def test_pod_fields_interop_with_prepod_peers():
+    """The fabric-assisted pod-delivery extension (docs/fabric.md) must
+    keep a pre-pod cluster interoperable: the advisory
+    ``LayerDigestsMsg.Pods`` map and ``DevicePlanMsg.Pod`` keep-list
+    are omitted at default (asserted type-by-type above), populated
+    instances round-trip through real JSON, and a stripped
+    (legacy-peer) payload decodes to the pre-pod reading — never
+    KeyError."""
+    for msg in (
+        LayerDigestsMsg(1, {7: "xxh3:ab"}, shards={7: "1/4@1"},
+                        range_digests={7: "xxh3:cd"}, pods={7: 4}),
+        LayerDigestsMsg(1, {7: "xxh3:ab"}, shards={7: "1/2@0"},
+                        codecs={7: "int8"}, pods={7: 2}),
+        DevicePlanMsg(1, "pod.7.0", 7, 2, 64,
+                      [(2, 0, 32), (3, 32, 32)], seq=5, pod=[2, 3]),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        assert decode_msg(msg.msg_type, wire) == msg
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("Pods", "Pod")}
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "pods", {}) == {}
+        assert getattr(old, "pod", []) == []
+    # Omitted at default: a pod-less stamp/plan is byte-identical to
+    # the legacy wire format.
+    assert "Pods" not in LayerDigestsMsg(1, {7: "xxh3:ab"}).to_payload()
+    assert "Pod" not in DevicePlanMsg(
+        1, "p", 7, 2, 64, [(1, 0, 64)]).to_payload()

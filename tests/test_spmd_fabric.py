@@ -789,6 +789,72 @@ def test_three_process_spmd_pipeline_serves():
             os.remove(conf_path)
 
 
+@pytest.mark.timeout(420)
+def test_three_process_spmd_pod_delivery():
+    """Fabric-assisted pod delivery across three real OS processes
+    (docs/fabric.md): the leader pod-plans one 1/2 shard per member
+    over host TCP, then broadcasts ONE lockstep gather plan whose
+    keep-list leaves the full tree on BOTH members — each verifies the
+    stamped full-layer digest and acks the FULL layer; the run only
+    completes once every tree materialized."""
+    from distributed_llm_dissemination_tpu.cli.ttd_matrix import (
+        spmd_pod_config,
+    )
+
+    conf = spmd_pod_config(1 << 16, layers=2)
+    conf_path = os.path.join(REPO, ".pytest-spmd-pod.json")
+    with open(conf_path, "w") as f:
+        json.dump(conf, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per process
+    cli = [sys.executable, "-m",
+           "distributed_llm_dissemination_tpu.cli.main",
+           "-f", conf_path, "-m", "3"]
+    procs = {}
+    try:
+        for i in (1, 2):
+            procs[i] = subprocess.Popen(
+                cli + ["-id", str(i)], stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=env, text=True)
+        procs[0] = subprocess.Popen(
+            cli + ["-id", "0"], stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env, text=True)
+        outs = {}
+        for i, p in procs.items():
+            try:
+                outs[i] = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs.values():
+                    q.kill()
+                raise
+        for i, p in procs.items():
+            assert p.returncode == 0, (
+                f"node {i} failed:\n{outs[i][1][-3000:]}"
+            )
+        lead_err = outs[0][1]
+        assert "pod delivery planned" in lead_err
+        assert "dispatching pod gather plan" in lead_err
+        assert "pod pair materialized its full tree" in lead_err
+        for i in (1, 2):
+            err = outs[i][1]
+            # Phase 1: the member's SHARD rode host TCP (the NIC) —
+            # unlike plain SPMD runs, where zero layer bytes touch TCP.
+            assert "layer fully received" in err, err[-3000:]
+            # Phase 2: the gather left the full tree here, verified.
+            assert "pod delivery materialized full tree" in err, (
+                f"node {i} never materialized:\n{err[-3000:]}"
+            )
+        assert "Time to deliver" in outs[0][0]
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        if os.path.exists(conf_path):
+            os.remove(conf_path)
+
+
 def test_serve_members_accepts_uneven_partition():
     """Round-4 lift: contiguous but UNEVEN slices (all holding the head)
     are servable; gaps still aren't."""

@@ -137,9 +137,11 @@ THREAD_SPAWN_ALLOWLIST = {
     "runtime/failover.py": 1,    # replicate-<standby>
     "runtime/failure.py": 2,     # heartbeat-<id>, detector
     "runtime/hierarchy.py": 1,   # subleader-redrive-<id>
-    "runtime/leader.py": 7,      # digests, watchdogs, lease, swap fence
+    "runtime/leader.py": 8,      # digests, watchdogs (spmd + pod),
+    #                              lease, swap fence
     "runtime/node.py": 1,        # msgloop
-    "runtime/receiver.py": 10,   # named control/fabric daemons
+    "runtime/receiver.py": 11,   # named control/fabric daemons
+    #                              (incl. pod-collect-<id>)
     "runtime/stream_boot.py": 2,  # boot-stream-<id> (both stagers)
     "runtime/swap.py": 2,        # swap-flip, swap-prepare
     "transport/faults.py": 1,    # fault-pump

@@ -155,3 +155,36 @@ def test_physical_row_records_warm_and_cold_ttft():
     assert phys["cold"]["ttft_s"] >= phys["ttd_s"]
     ph = phys["phases"]
     assert ph["streamed_blobs"] >= 1  # streamed staging engaged
+
+
+def test_row_flag_vocabulary_matches_runners():
+    """Tier-1 drift check (the cli/trace.py rule-table discipline
+    applied to the harness CLI): the optional-row flag vocabulary is
+    pinned here — adding, renaming, or deleting a `-<row>` flag (or its
+    runner) without updating this set fails loudly instead of silently
+    shipping a TTD_MATRIX.md that documents flags the CLI no longer
+    accepts."""
+    # Pinned against the module source (the flags are string literals
+    # in main()'s parser), with each row flag matched to its runner.
+    src = open(tm.__file__).read()
+    ROW_FLAGS = {
+        "-baseline": "run_baseline_scenarios",
+        "-physical": "run_physical",
+        "-telemetry-overhead": "run_telemetry_overhead",
+        "-failover": "run_failover",
+        "-service": "run_service_jobs",
+        "-swap": "run_live_swap",
+        "-rollout": "run_rollout",
+        "-sharded": "run_sharded_delivery",
+        "-fabric-delivery": "run_fabric_delivery",
+        "-fanout": "run_fanout",
+        "-elasticity": "run_elasticity",
+        "-attribution": "run_attribution",
+        "-span-overhead": "run_span_overhead",
+        "-codec-wire": "run_codec_wire",
+    }
+    missing = [f for f in ROW_FLAGS if f'"{f}"' not in src]
+    assert not missing, f"row flags gone from ttd_matrix.main: {missing}"
+    no_runner = [fn for fn in ROW_FLAGS.values()
+                 if f"def {fn}(" not in src]
+    assert not no_runner, f"row runners missing: {no_runner}"
