@@ -90,6 +90,13 @@ _INSTANT_MESSAGES = {
     "pod delivery degraded to host path",
     "pod gather timed out; degrading to host path",
     "pod member gone; degrading its pod to host path",
+    # Intra-group chain dissemination (docs/hierarchy.md): the planned
+    # member-to-member relay, its per-fragment forwards, and the two
+    # repair edges (mid-chain NACK service, dead-hop redrive).
+    "group chain planned",
+    "chain forward roles installed",
+    "relaying layer downstream",
+    "NACK served from in-flight partial coverage",
     # Telemetry plane (docs/observability.md):
     "clock offset estimated",
     "cluster telemetry",

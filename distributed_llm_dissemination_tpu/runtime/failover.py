@@ -182,6 +182,12 @@ class ShadowLeaderState:
         # encoded partials live in.
         self.wire_codecs: Dict[Tuple[NodeID, int], str] = {}
         self.node_codecs: Dict[NodeID, list] = {}
+        # Pod-delivery plane (docs/fabric.md): the LIVE pod membership —
+        # ``{"Table": {pid: [members]}, "Broken": [pids]}``.  A standby
+        # promoted after a pod break/widen must adopt the broken set, or
+        # its first re-plan would re-slice (resurrect) a pod whose
+        # gather contributions can never arrive.
+        self.pods: dict = {}
         # Hierarchical control (docs/hierarchy.md): the group table
         # (``{gid: {"Leader", "Members", "Dissolved"}}``) — a promoted
         # standby must reconstruct the SAME hierarchy (or its dissolved
@@ -245,6 +251,7 @@ class ShadowLeaderState:
                         d.get("BaseAssignment"))
                 self.groups = {str(g): dict(rec) for g, rec in
                                (d.get("Groups") or {}).items()}
+                self.pods = dict(d.get("Pods") or {})
                 self.membership = {str(n): dict(rec) for n, rec in
                                    (d.get("Membership") or {}).items()}
                 self.drain_jobs = {str(j): int(n) for j, n in
@@ -301,6 +308,11 @@ class ShadowLeaderState:
                 # it), so REPLACE.
                 self.groups = {str(g): dict(rec) for g, rec in
                                (d.get("Groups") or {}).items()}
+            elif k == "pods":
+                # Pod membership (docs/fabric.md): always the full
+                # current table + broken set (a break re-sends it), so
+                # REPLACE like the group table.
+                self.pods = dict(d.get("Pods") or {})
             elif k == "codecs":
                 # Wire-codec choices + capability table (docs/codec.md).
                 # REPLACE, don't merge: the delta always carries the
@@ -398,6 +410,8 @@ class ShadowLeaderState:
                 "node_codecs": {n: list(c)
                                 for n, c in self.node_codecs.items()},
                 "groups": {g: dict(rec) for g, rec in self.groups.items()},
+                "pods": {k: (dict(v) if isinstance(v, dict) else list(v))
+                         for k, v in self.pods.items()},
                 "membership": {n: dict(rec)
                                for n, rec in self.membership.items()},
                 "drain_jobs": dict(self.drain_jobs),
@@ -573,6 +587,15 @@ class StandbyController:
             bw = self._bw if self._bw is not None else shadow["network_bw"]
             if cls is HierarchicalFlowLeaderNode:
                 kwargs["groups"] = groups
+            pod_table = (shadow.get("pods") or {}).get("Table") or {}
+            if pod_table:
+                # The dead leader ran pod delivery (docs/fabric.md): the
+                # promoted leader must keep the pod table — without it
+                # the adopted goal's 1/R@k slices would never re-derive
+                # their pod pairs, and the open-until-materialized
+                # invariant would wedge on shard acks with no gather.
+                kwargs["pods"] = {int(p): [int(m) for m in ms]
+                                  for p, ms in pod_table.items()}
             leader = cls(*args, bw, **kwargs)
         else:
             leader = cls(*args, **kwargs)

@@ -40,7 +40,16 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..core.types import LayerID, NodeID, delivered, satisfies
+from ..core.types import (
+    LayerID,
+    NodeID,
+    codec_accepts,
+    delivered,
+    satisfies,
+    shard_covers,
+    shard_range,
+)
+from ..sched.flow import chain_forward_roles
 from ..transport.messages import (
     AckMsg,
     AnnounceMsg,
@@ -63,6 +72,15 @@ GROUP_RESEND_S = float(os.environ.get("DLD_GROUP_RESEND_S", "2.0"))
 # Debounce for folding member announces into one upward aggregate: a
 # fleet announcing at start collapses into ~one message per group.
 ANNOUNCE_FOLD_S = float(os.environ.get("DLD_GROUP_ANNOUNCE_FOLD_S", "0.1"))
+# Chain fan-out (docs/hierarchy.md): first dispatch of a layer wanted by
+# ≥2 members rides a K-striped member-to-member chain, so the
+# sub-leader's egress is O(model_bytes) instead of O(model_bytes × R).
+# Off degrades to the pre-chain star.  The REDRIVE pass always sends
+# direct — that is the convergence guarantee for legacy members (which
+# ignore forward roles) and the repair path around dead hops.
+GROUP_CHAIN = (os.environ.get("DLD_GROUP_CHAIN", "1").lower()
+               not in ("0", "false", "off"))
+GROUP_STRIPES = max(1, int(os.environ.get("DLD_GROUP_STRIPES", "4")))
 
 
 def partition_groups(node_ids: List[NodeID],
@@ -142,7 +160,16 @@ class SubLeaderController:
         self._active = True
         self._targets: Dict[NodeID, dict] = {}   # member -> {lid: meta}
         self._covered: Dict[LayerID, set] = {}   # lid -> members done
+        # QUALIFIED coverage (shard/codec/version targets) is tracked
+        # separately and NEVER pushed upward as ``covered`` — the root
+        # synthesizes plain INMEM acks from that section, which would
+        # erase the tags; qualified members ack the root verbatim (the
+        # forwarded-ack path) and this set only stops re-sends.
+        self._covered_q: Dict[LayerID, set] = {}
         self._announced: Dict[NodeID, dict] = {}  # member -> holdings
+        self._member_digests: Dict[NodeID, dict] = {}  # member -> stamps
+        self._member_codecs: Dict[NodeID, list] = {}  # member -> caps
+        self._plan_epoch = -1
         self._announce_dirty: set = set()
         self._announce_timer: Optional[threading.Timer] = None
         self._dead: set = set()
@@ -250,6 +277,7 @@ class SubLeaderController:
         with self._lock:
             rearmed = not self._active
             self._active = True
+            self._plan_epoch = msg.epoch
             self._targets = {int(m): dict(row)
                              for m, row in msg.targets.items()
                              if int(m) != self.node.my_id}
@@ -290,6 +318,16 @@ class SubLeaderController:
                 self.members.append(msg.src_id)
             self._dead.discard(msg.src_id)
             self._announced[msg.src_id] = dict(msg.layer_ids)
+            # Digest fold (docs/membership.md): the member's announced
+            # stamps ride the same debounce — they are what lets the
+            # root verify a GROUPED joiner and promote it to a source.
+            self._member_digests[msg.src_id] = dict(msg.digests or {})
+            # Codec capability fold (docs/codec.md): an empty announce
+            # is an authoritative revocation, exactly like the flat
+            # path — the root must stop choosing quantized transfers
+            # for a member that lost the capability with its config.
+            self._member_codecs[msg.src_id] = [
+                str(c) for c in (msg.codecs or [])]
             self._announce_dirty.add(msg.src_id)
             # A re-announce is a restart: its RAM holdings are whatever
             # the announce says now, so sends re-arm.
@@ -297,15 +335,27 @@ class SubLeaderController:
                 del self._sent[key]
             for members in self._covered.values():
                 members.discard(msg.src_id)
+            for members in self._covered_q.values():
+                members.discard(msg.src_id)
             for lid, meta in msg.layer_ids.items():
                 want = self._targets.get(msg.src_id, {}).get(lid)
                 held_ok = (satisfies(meta, want) if want is not None
                            else delivered(meta))
-                if held_ok:
+                if not held_ok:
+                    continue
+                if want is not None and (want.shard or want.codec
+                                         or want.version):
+                    self._covered_q.setdefault(lid, set()).add(msg.src_id)
+                else:
                     self._covered.setdefault(lid, set()).add(msg.src_id)
             pending = set(self._announce_dirty)
+        # This seat never member-announces to itself (its announce goes
+        # to the root directly), so it must not count as a pending
+        # announcer — with it in the set the immediate flush could
+        # never fire and every fold would eat the full debounce.
         if pending >= set(m for m in self.members
-                          if m not in self._dead):
+                          if m not in self._dead
+                          and m != self.node.my_id):
             self._flush_announces()
         else:
             with self._lock:
@@ -323,11 +373,18 @@ class SubLeaderController:
                 self._announce_timer = None
             dirty = {m: dict(self._announced.get(m) or {})
                      for m in self._announce_dirty}
+            digests = {m: dict(self._member_digests.get(m) or {})
+                       for m in self._announce_dirty
+                       if self._member_digests.get(m)}
+            codecs = {m: list(self._member_codecs.get(m) or [])
+                      for m in self._announce_dirty
+                      if m in self._member_codecs}
             self._announce_dirty.clear()
             covered = self._covered_snapshot_locked()
         if dirty:
             trace.count("hier.announce_folds")
-            self._push(announced=dirty, covered=covered)
+            self._push(announced=dirty, covered=covered, digests=digests,
+                       codecs=codecs)
 
     def handle_member_ack(self, msg: AckMsg) -> None:
         self.detector.touch(msg.src_id)
@@ -335,8 +392,20 @@ class SubLeaderController:
             # Qualified acks (sharded / versioned / codec holdings)
             # carry tags the aggregate vocabulary doesn't: forward the
             # ack VERBATIM so the root's swap fences and codec
-            # bookkeeping keep full fidelity (docs/hierarchy.md,
-            # honest limits).
+            # bookkeeping keep full fidelity.  Locally it still settles
+            # the member's chain/fan-out send when the tags match its
+            # target — qualified coverage stops re-sends without ever
+            # riding the plain ``covered`` section upward.
+            with self._lock:
+                want = self._targets.get(msg.src_id, {}).get(msg.layer_id)
+                if (want is not None
+                        and (msg.shard or "") == (want.shard or "")
+                        and codec_accepts(msg.codec, want.codec)
+                        and (not want.version
+                             or msg.version == want.version)):
+                    self._covered_q.setdefault(
+                        msg.layer_id, set()).add(msg.src_id)
+                    self._sent.pop((msg.src_id, msg.layer_id), None)
             trace.count("hier.acks_forwarded")
             self.receiver._send_to_leader(msg)
             return
@@ -401,75 +470,224 @@ class SubLeaderController:
     def _member_dead(self, member: NodeID) -> None:
         with self._lock:
             self._dead.add(member)
+            # Chain repair (docs/hierarchy.md): un-claim every uncovered
+            # send of the layers the dead member targeted, so the next
+            # event pass re-chains over the SURVIVORS — fresh forward
+            # roles splice around the hole, and the re-seeded stripes
+            # re-drive the dead seat's tail.  Downstream holes from
+            # bytes it never forwarded heal via the members' gap-NACK
+            # watchdogs against their upstream hop.
+            lids = set(self._targets.get(member) or {})
+            for key in [k for k in self._sent
+                        if k[0] == member
+                        or (k[1] in lids and not self._covered_done_locked(
+                            k[1], k[0]))]:
+                del self._sent[key]
             covered = self._covered_snapshot_locked()
         trace.count("hier.member_dead_reports")
         log.error("group member silent past timeout; reporting upward",
                   group=self.group_id, member=member)
         self._push(dead=[int(member)], covered=covered)
+        self._fan_out_ready()
 
     # ----------------------------------------------------------- fan-out
+
+    def _covered_done_locked(self, lid: LayerID, member: NodeID) -> bool:
+        return (member in self._covered.get(lid, ())
+                or member in self._covered_q.get(lid, ()))
 
     def _layer_complete_locked(self, lid: LayerID) -> bool:
         wanting = [m for m, row in self._targets.items()
                    if lid in row and m not in self._dead]
         return bool(wanting) and all(
-            m in self._covered.get(lid, ()) for m in wanting)
+            self._covered_done_locked(lid, m) for m in wanting)
 
     def _on_own_layer(self, lid: LayerID) -> None:
         self._fan_out_ready()
 
     def _fan_out_ready(self, resend_after: Optional[float] = None) -> None:
-        """Send every held layer to every member still missing it.
-        Event-driven calls pass no ``resend_after`` (only never-sent
-        pairs go out); the redrive loop passes ``GROUP_RESEND_S`` so
-        sends eaten by a partition or restart re-arm."""
+        """Deliver every held layer to every member still missing it.
+
+        FIRST dispatch of a layer wanted by ≥2 members rides a
+        K-striped member-to-member CHAIN (docs/hierarchy.md): forward
+        roles install on the members, each stripe seeds at its head,
+        and the rest of the bytes relay peer-to-peer — this seat's
+        egress is the wire size once, not once per member.  Single
+        wanters, chain-disabled runs, and every REDRIVE go direct — the
+        redrive star is the convergence guarantee (legacy members that
+        ignore roles, dead mid-chain hops, eaten sends).
+
+        Pairs are claimed under ONE lock pass: two concurrent triggers
+        (own-layer hook + plan receipt) must not both dispatch."""
         now = time.monotonic()
-        due = []
+        due = []        # (member, lid, meta): direct sends
+        fresh: Dict[LayerID, list] = {}  # lid -> [(member, meta)] chains
         with self._lock:
             if not self._active:
                 return
             for member, row in self._targets.items():
                 if member in self._dead:
                     continue
-                for lid in row:
-                    if member in self._covered.get(lid, ()):
+                for lid, meta in row.items():
+                    if self._covered_done_locked(lid, member):
                         continue
                     t_sent = self._sent.get((member, lid))
                     if t_sent is not None and (
                             resend_after is None
                             or now - t_sent < resend_after):
                         continue
-                    # Claimed under THIS lock pass: two concurrent
-                    # triggers (own-layer hook + plan receipt) must not
-                    # both dispatch the same pair.
                     self._sent[(member, lid)] = now
-                    due.append((member, lid))
-        for member, lid in due:
+                    if GROUP_CHAIN and t_sent is None:
+                        fresh.setdefault(lid, []).append((member, meta))
+                    else:
+                        due.append((member, lid, meta))
+        for lid in sorted(fresh):
+            pairs = fresh[lid]
+            if len(pairs) < 2:
+                due.extend((m, lid, meta) for m, meta in pairs)
+                continue
+            if not self._dispatch_chain(lid, pairs):
+                # Not servable yet (layer in flight / wrong form /
+                # members want mixed forms): un-claim so the next
+                # trigger re-collects; mixed forms degrade to star.
+                with self._lock:
+                    for m, _ in pairs:
+                        self._sent.pop((m, lid), None)
+                self._fan_out_star(due=[], retry=pairs, lid=lid)
+        self._fan_out_star(due)
+
+    def _fan_out_star(self, due, retry=None, lid=None) -> None:
+        """The direct-send leg: dispatch each (member, lid, meta) whose
+        target this seat's holding can serve, un-claiming the rest.
+        ``retry``: mixed-form chain rejects re-dispatched per member —
+        each pair re-claims individually so forms that DO serve
+        star-send now instead of waiting out a redrive tick."""
+        if retry:
+            now = time.monotonic()
+            with self._lock:
+                for m, meta in retry:
+                    if (m, lid) not in self._sent:
+                        self._sent[(m, lid)] = now
+                        due = due + [(m, lid, meta)]
+        for member, lid, meta in due:
             with self.receiver._lock:
                 layer = self.receiver.layers.get(lid)
-            if (layer is None or layer.meta.shard or layer.meta.codec
-                    or layer.meta.version):
-                # Not landed here yet (the root's plan is in flight) —
-                # or a QUALIFIED holding (a shard slice / encoded form /
-                # version-stamped rollout copy) that must never be
-                # fanned out as a whole plain raw layer:
+            if layer is None or not self._holding_serves(layer, meta):
+                # Not landed here yet (the root's plan is in flight), or
+                # a holding in the WRONG form for this target (e.g. a
+                # version-stamped rollout copy against a plain target):
                 # un-claim so the next trigger re-collects it once a
-                # full raw copy exists.
+                # servable copy exists.
                 with self._lock:
                     self._sent.pop((member, lid), None)
                 continue
             trace.count("hier.fanout_sends")
+            trace.count("hier.subleader_egress_bytes", layer.data_size)
             log.info("fanning layer out to group member", layerID=lid,
                      member=member, group=self.group_id)
-            threads.tx_pool().submit(self._send_one, member, lid, layer)
+            threads.tx_pool().submit(self._send_one, member, lid, layer,
+                                     meta)
 
-    def _send_one(self, member: NodeID, lid: LayerID, layer) -> None:
+    def _holding_serves(self, layer, meta) -> bool:
+        """Whether this seat's holding can produce the exact bytes the
+        member's target meta names (docs/hierarchy.md): the same
+        encoded form (or raw + an encode-capable plane), a shard range
+        the holding covers, and no version mismatch — a version-stamped
+        copy serves only that version's targets (a plain target's
+        digest gate would reject its bytes)."""
+        held = layer.meta
+        want_codec = meta.codec or ""
+        if held.codec and held.codec != want_codec:
+            return False
+        if (want_codec and not held.codec
+                and getattr(self.receiver, "codec_plane", None) is None):
+            return False
+        if not shard_covers(held.shard or "", meta.shard or ""):
+            return False
+        if (held.version or "") != (meta.version or ""):
+            return False
+        return True
+
+    def _dispatch_chain(self, lid: LayerID, pairs) -> bool:
+        """Plan + dispatch one layer's chain: forward roles to the
+        members, stripe seeds to the heads.  False when the holding
+        can't serve, or the members disagree on the target form (a
+        chain ships ONE byte space; mixed forms fall back to star)."""
+        forms = {(meta.shard or "", meta.codec or "", meta.version or "")
+                 for _, meta in pairs}
+        if len(forms) != 1:
+            return False
+        meta = pairs[0][1]
+        with self.receiver._lock:
+            layer = self.receiver.layers.get(lid)
+        if layer is None or not self._holding_serves(layer, meta):
+            return False
+        want_codec = meta.codec or ""
+        if want_codec and not layer.meta.codec:
+            plane = getattr(self.receiver, "codec_plane", None)
+            wire_total = plane.nbytes(lid, want_codec) if plane else None
+            if wire_total is None:
+                return False
+        else:
+            wire_total = layer.data_size
+        lo, size = shard_range(meta.shard or "", wire_total)
+        if size <= 0:
+            return False
+        members = sorted(m for m, _ in pairs)
+        stripes = min(GROUP_STRIPES, len(members))
+        heads, roles = chain_forward_roles(members, lo, size, stripes)
+        epoch = self._plan_epoch
+        trace.count("hier.chain_plans")
+        trace.count("hier.subleader_egress_bytes", size)
+        log.info("group chain planned", layerID=lid, group=self.group_id,
+                 members=len(members), stripes=len(heads),
+                 wire_bytes=size)
+        for m, hops in sorted(roles.items()):
+            if not hops:
+                continue
+            try:
+                self.node.add_node(m)
+                self.node.transport.send(m, GroupPlanMsg(
+                    self.node.my_id, self.group_id, epoch=epoch,
+                    forward={lid: [[a, b, nxt] for a, b, nxt in hops]}))
+            except (OSError, KeyError, ConnectionError) as e:
+                log.warn("chain role install failed (redrive will "
+                         "star-send)", member=m, layerID=lid,
+                         err=repr(e))
+        for head, (a, b) in heads:
+            threads.tx_pool().submit(self._send_range, head, lid, layer,
+                                     meta, (a, b - a))
+        return True
+
+    def _send_range(self, member: NodeID, lid: LayerID, layer, meta,
+                    rng) -> None:
+        """One stripe seed: ship only the stripe's wire range to its
+        head member; the chain relays the rest of the layer to it."""
+        try:
+            self.node.add_node(member)
+            send_layer(self.node, member, lid, layer,
+                       shard=meta.shard, codec=meta.codec,
+                       codecs=getattr(self.receiver, "codec_plane", None),
+                       span_parent=telemetry.span_id(self.node.my_id, lid),
+                       wire_range=rng)
+        except (OSError, KeyError, ConnectionError) as e:
+            log.warn("chain stripe send failed (redrive will retry)",
+                     layerID=lid, member=member, err=repr(e))
+
+    def _send_one(self, member: NodeID, lid: LayerID, layer,
+                  meta=None) -> None:
         try:
             self.node.add_node(member)
             # Span correlation (docs/observability.md): the fan-out is
             # a CHILD span chained under this seat's own (root-planned)
             # group-ingress pair — the parent tag rides the frames.
+            # Qualified targets ship in their stamped byte space: the
+            # shard/codec tags come from the member's target meta, and
+            # the plane encode-serves a raw holding (docs/codec.md).
             send_layer(self.node, member, lid, layer,
+                       shard=(meta.shard if meta is not None else ""),
+                       codec=(meta.codec if meta is not None else ""),
+                       codecs=getattr(self.receiver, "codec_plane", None),
                        span_parent=telemetry.span_id(self.node.my_id, lid))
         except (OSError, KeyError, ConnectionError) as e:
             log.warn("group fan-out send failed (redrive will retry)",

@@ -1020,17 +1020,32 @@ class LeaderNode:
         if integrity.digests_enabled():
             self._digests_ready.wait(timeout=300.0)
         with self._lock:
-            dests = list(self.assignment)
+            dests = self._digest_recipients_locked()
             digests = {str(l): d for l, d in self.layer_digests.items()}
         if digests:
             self._replicate("digests", Digests=digests)
         for dest in dests:
             self._send_digests_to(dest)
 
+    def _digest_row_locked(self, dest: NodeID) -> dict:
+        """The target metas the digest stamp to ``dest`` describes
+        (lock held): the dest's assignment row — plus, in the
+        hierarchical subclass, any synthetic group-ingress demand
+        routed THROUGH the seat (docs/hierarchy.md), so a sub-leader
+        ingesting a qualified (shard/codec/version) group form is
+        stamped exactly like a direct dest."""
+        return self.assignment.get(dest) or {}
+
+    def _digest_recipients_locked(self) -> list:
+        """Seats owed a digest stamp (lock held): every assignee — plus,
+        in the hierarchical subclass, sub-leaders whose only demand is a
+        synthetic group ingress (they'd otherwise verify nothing)."""
+        return list(self.assignment)
+
     def _assigned_shards_locked(self, dest: NodeID) -> Dict[LayerID, str]:
         """Lock held.  The dest's sub-layer targets: {layer: spec}."""
         return {lid: meta.shard
-                for lid, meta in (self.assignment.get(dest) or {}).items()
+                for lid, meta in self._digest_row_locked(dest).items()
                 if meta.shard}
 
     def _range_digests_for(self, shards: Dict[LayerID, str],
@@ -1269,7 +1284,7 @@ class LeaderNode:
             return
         with self._lock:
             digests = ({lid: self.layer_digests[lid]
-                        for lid in self.assignment.get(dest) or {}
+                        for lid in self._digest_row_locked(dest)
                         if lid in self.layer_digests}
                        if integrity.digests_enabled() else {})
             shards = self._assigned_shards_locked(dest)
@@ -1278,7 +1293,7 @@ class LeaderNode:
             # dest's holdings and acks carry the version tag.
             versions = {lid: meta.version
                         for lid, meta in
-                        (self.assignment.get(dest) or {}).items()
+                        self._digest_row_locked(dest).items()
                         if meta.version}
             # Sticky: once ANY sharded target or shard holding exists,
             # later stamps must keep carrying the dest's target picture
@@ -1292,7 +1307,7 @@ class LeaderNode:
                 # that can tell a dest its target reverted to the full
                 # layer (the digest-keyed widen detection has nothing
                 # to iterate): explicit "" entries carry the reconcile.
-                for lid in self.assignment.get(dest) or {}:
+                for lid in self._digest_row_locked(dest):
                     shards.setdefault(lid, "")
             # Wire-codec transfers (docs/codec.md): the chosen codec
             # per assigned layer rides the stamp — the one leader→dest
@@ -1300,7 +1315,7 @@ class LeaderNode:
             # transfer in encoded byte space from the first fragment.
             codec_map = {lid: meta.codec
                          for lid, meta in
-                         (self.assignment.get(dest) or {}).items()
+                         self._digest_row_locked(dest).items()
                          if meta.codec}
             if self._codec_seen and not integrity.digests_enabled():
                 # With digests OFF the codec map is the ONLY channel
@@ -1309,7 +1324,7 @@ class LeaderNode:
                 # reconcile): explicit "" entries clear the dest's
                 # stale codec expectation — same sticky-"" discipline
                 # as the shards map above.
-                for lid in self.assignment.get(dest) or {}:
+                for lid in self._digest_row_locked(dest):
                     codec_map.setdefault(lid, "")
         if integrity.digests_enabled():
             # For codec pairs the stamped digest is CODEC-QUALIFIED:
@@ -1853,9 +1868,9 @@ class LeaderNode:
 
     def _await_announce_set_locked(self) -> Set[NodeID]:
         """The nodes whose announce gates the distribution start.  Lock
-        held.  The hierarchical leader excludes grouped members — they
-        announce to their SUB-LEADER, whose own announce (it is an
-        ingress dest) is what the root waits on (docs/hierarchy.md)."""
+        held.  Grouped members announce to their SUB-LEADER; the folded
+        aggregate creates their status rows, which is what satisfies
+        this gate for them (docs/hierarchy.md)."""
         return set(self.assignment) | self.expected_nodes
 
     def _maybe_start(self) -> bool:
@@ -4928,6 +4943,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 row[layer_id] = dataclasses.replace(meta, shard="")
                 widened.append(m)
             self._pod_gather_sent.discard((layer_id, pid))
+        self._replicate_pods()
         if widened:
             # The widen must reach the members BEFORE the re-plan's
             # bytes: the stamp reconcile is what re-opens their shard
@@ -4958,6 +4974,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 self._pod_shard_acked.pop((lid, node), None)
         if fresh:
             trace.count("pod.pods_broken")
+            self._replicate_pods()
         if not lids:
             return
         # Degrade EVERY remaining pair — even for an already-broken pod
@@ -4967,6 +4984,72 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                  node=node, pod=pid, layers=lids)
         for lid in lids:
             self._degrade_pod_layer(lid, pid)
+
+    # ------------------------------------------------ pod-plane failover
+
+    def _pods_json(self) -> dict:
+        return {"Table": {str(p): list(ms)
+                          for p, ms in sorted(self.pods.items())},
+                "Broken": sorted(self._pods_broken)}
+
+    def _snapshot_extra_locked(self) -> dict:
+        extra = dict(super()._snapshot_extra_locked())
+        if self.pods:
+            extra["Pods"] = self._pods_json()
+        return extra
+
+    def _replicate_pods(self) -> None:
+        """Replicate the LIVE pod membership — full table + broken set,
+        REPLACE like the group table (docs/failover.md).  Without it a
+        standby promoted after a pod break would re-slice pod pairs for
+        a pod that already degraded, resurrecting a gather whose
+        contributions can never arrive."""
+        with self._lock:
+            if not self.pods:
+                return
+            pj = self._pods_json()
+        self._replicate("pods", Pods=pj)
+
+    def adopt_shadow(self, shadow: dict, dead_leader=None) -> None:
+        super().adopt_shadow(shadow, dead_leader)
+        pods = shadow.get("pods") or {}
+        broken = {int(p) for p in (pods.get("Broken") or ())}
+        with self._lock:
+            for p, ms in (pods.get("Table") or {}).items():
+                pid = int(p)
+                members = sorted(int(m) for m in ms)
+                if pid not in self.pods:
+                    self.pods[pid] = members
+                    for m in members:
+                        self._pod_of.setdefault(m, pid)
+            self._pods_broken |= broken
+            # A pod that BROKE before the takeover degraded at the dead
+            # leader, but the widened goal may not have reached this
+            # shadow: any leftover 1/R@k pod slice of a broken pod in
+            # the adopted assignment is a dead byte space — widen it
+            # back to the plain full target, exactly like the degrade
+            # did (docs/fabric.md).  Completed trees satisfy the plain
+            # want too, so widening them is harmless.
+            widened = []
+            for pid in sorted(broken):
+                for m in self.pods.get(pid) or ():
+                    row = self.assignment.get(m)
+                    if not row:
+                        continue
+                    for lid, meta in list(row.items()):
+                        s = meta.shard or ""
+                        if (s.startswith("1/") and "@" in s
+                                and not meta.version):
+                            row[lid] = dataclasses.replace(meta, shard="")
+                            widened.append(m)
+        if widened:
+            log.warn("adopted a broken pod's leftover shard slices; "
+                     "widened to host-path full targets",
+                     pods=sorted(broken), members=sorted(set(widened)))
+            # The widen reconcile stamp re-opens the members' shard
+            # holdings as partials (docs/sharding.md).
+            for m in sorted(set(widened)):
+                self._send_digests_to(m)
 
     def assign_jobs(self) -> Tuple[int, FlowJobsMap, FlowJobsMap]:
         """Split off self-jobs (dest already holds the layer at its own
@@ -5574,10 +5657,13 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
       DEAD sub-leader dissolves its group back to flat delivery
       (members are told to re-point at the root and re-announce).
 
-    Honest limits: grouped targets must be plain full raw layers —
-    pairs carrying a shard, wire codec, or rollout version plan FLAT
-    (directly to the member) and their qualified acks are forwarded
-    verbatim by the sub-leader; standbys must be ungrouped seats."""
+    Qualified targets compose (docs/hierarchy.md): a group whose live
+    members all want the SAME ``(shard, codec, version)`` form of a
+    layer plans ONE synthetic group ingress carrying that form — the
+    encoded / shard bytes cross the fleet fabric once, and the
+    sub-leader chains them member-to-member.  Mixed forms within a
+    group, and forms the ingress seat's own target conflicts with,
+    still plan flat (honest limit); standbys must be ungrouped seats."""
 
     MODE = 3
 
@@ -5641,8 +5727,13 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
             super()._touch_liveness(src_id)
 
     def _await_announce_set_locked(self) -> Set[NodeID]:
-        return (super()._await_announce_set_locked()
-                - set(self._member_group))
+        """Grouped members stay IN the await set: their announces
+        arrive as sub-leader folds (which create their status rows),
+        and the start-time route/codec decisions need every member's
+        form and capability picture before the first stamp latches
+        (docs/hierarchy.md).  A member the sub reported dead was
+        crash()-dropped from the assignment, so it no longer gates."""
+        return super()._await_announce_set_locked()
 
     def _lease_recipients_locked(self) -> Set[NodeID]:
         return (super()._lease_recipients_locked()
@@ -5650,24 +5741,81 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
 
     # ------------------------------------------------------- planning
 
-    def _ingress_ok_locked(self, gid: int, lid: LayerID) -> bool:
-        """Whether (group, layer) may route through the group's ingress:
-        the sub-leader's OWN target for the layer (if any) must be a
-        plain full raw one — a qualified sub-leader pair (shard / codec
-        / version) would collide with the synthetic full-raw demand in
-        one plan slot, and its holding could never be fanned out whole.
-        Lock held."""
-        sub = self.groups[gid]["leader"]
+    @staticmethod
+    def _form(meta: LayerMeta) -> Tuple[str, str, str]:
+        return (meta.shard or "", meta.codec or "", meta.version or "")
+
+    def _group_route_locked(self, gid: int, lid: LayerID):
+        """How (group, layer) reaches the group, lock held.  Returns
+        ``None`` (every member plans FLAT) or ``(kind, ingress_meta,
+        form)`` — a member pair routes through the group iff its
+        ``(shard, codec, version)`` form equals ``form``:
+
+        - ``("synthetic", meta, form)``: the root emits one ingress
+          demand ``meta`` at the sub-leader carrying the group's shared
+          form — full-raw when any member wants the plain layer (and
+          the sub-leader's own target, if any, is plain too: a
+          qualified own pair would collide with the synthetic demand in
+          one plan slot), else the ONE qualified form every member
+          agrees on (docs/hierarchy.md).
+        - ``("own", None, form)``: the sub-leader's OWN pair already
+          carries the bytes the group needs — its existing target has
+          the same form, or is plain raw while the group wants a
+          codec-only form the sub-leader can encode-serve — so the
+          root emits nothing extra.
+
+        Mixed member forms route only the plain subset (qualified
+        members plan flat, the pre-chain behavior); two or more
+        distinct qualified forms plan flat entirely (honest limit)."""
+        rec = self.groups[gid]
+        sub = rec["leader"]
         own = (self.assignment.get(sub) or {}).get(lid)
-        return own is None or not (own.shard or own.codec or own.version)
+        own_plain = own is None or not (own.shard or own.codec
+                                        or own.version)
+        plain_wanted = False
+        qual: Dict[Tuple[str, str, str], LayerMeta] = {}
+        for m in rec["members"]:
+            if m == sub or m in self._dead_members:
+                continue
+            meta = (self.assignment.get(m) or {}).get(lid)
+            if meta is None:
+                continue
+            form = self._form(meta)
+            if form == ("", "", ""):
+                plain_wanted = True
+            else:
+                qual[form] = meta
+        if plain_wanted:
+            return (("synthetic", LayerMeta(), ("", "", ""))
+                    if own_plain else None)
+        if len(qual) != 1:
+            return None
+        (form, meta), = qual.items()
+        if own is None:
+            return ("synthetic", dataclasses.replace(meta), form)
+        if self._form(own) == form:
+            return ("own", None, form)
+        if (own_plain and not form[0] and not form[2]
+                and form[1] in self.node_codecs.get(sub, ())):
+            # Raw own ingress; the sub-leader encode-serves the
+            # group's codec form from it (docs/codec.md).
+            return ("own", None, form)
+        return None
+
+    def _ingress_ok_locked(self, gid: int, lid: LayerID) -> bool:
+        """Whether (group, layer) routes through the group at all —
+        the route exists.  Lock held."""
+        return self._group_route_locked(gid, lid) is not None
 
     def _plan_assignment_locked(self) -> Assignment:
-        """The reduced goal the flow graph sees: grouped members' still-
-        missing plain pairs collapse into one full-raw ingress demand
-        per (group, layer); qualified pairs (shard / codec / version),
-        pairs whose INGRESS would hold a qualified copy, and ungrouped
-        seats plan flat.  Lock held."""
+        """The reduced goal the flow graph sees: grouped members'
+        still-missing pairs collapse into one ingress demand per
+        (group, layer) — full-raw for plain wants, the group's SHARED
+        qualified form when every member agrees on one
+        (``_group_route_locked``).  Members whose form differs from the
+        routed one, and ungrouped seats, plan flat.  Lock held."""
         out: Assignment = {}
+        routes: Dict[Tuple[int, LayerID], Optional[tuple]] = {}
         for dest, lids in self.assignment.items():
             gid = self._member_group.get(dest)
             if gid is None:
@@ -5677,15 +5825,74 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
                 continue
             ingress = self.groups[gid]["leader"]
             for lid, meta in lids.items():
-                if (meta.shard or meta.codec or meta.version
-                        or not self._ingress_ok_locked(gid, lid)):
+                key = (gid, lid)
+                if key not in routes:
+                    routes[key] = self._group_route_locked(gid, lid)
+                route = routes[key]
+                if route is None or route[2] != self._form(meta):
                     out.setdefault(dest, {})[lid] = meta
                     continue
                 held = self.status.get(dest, {}).get(lid)
                 if held is not None and satisfies(held, meta):
                     continue  # the member already holds it
-                out.setdefault(ingress, {}).setdefault(lid, LayerMeta())
+                if route[0] == "own":
+                    continue  # the sub-leader's own pair is the ingress
+                out.setdefault(ingress, {}).setdefault(lid, route[1])
         return out
+
+    def _group_ingress_row_locked(self, gid: int
+                                  ) -> Dict[LayerID, LayerMeta]:
+        """The SYNTHETIC ingress demands currently routed through
+        ``gid``'s sub-leader (lock held), derived from the live routing
+        decision — digest stamps read this BEFORE the first planning
+        pass, so it cannot be a planning-time cache."""
+        rows: Dict[LayerID, LayerMeta] = {}
+        rec = self.groups[gid]
+        for m in rec["members"]:
+            if m == rec["leader"] or m in self._dead_members:
+                continue
+            for lid in (self.assignment.get(m) or {}):
+                if lid in rows:
+                    continue
+                route = self._group_route_locked(gid, lid)
+                if route is not None and route[0] == "synthetic":
+                    rows[lid] = route[1]
+        return rows
+
+    def _digest_row_locked(self, dest: NodeID) -> dict:
+        row = dict(super()._digest_row_locked(dest))
+        gid = self._group_of_subleader.get(dest)
+        if gid is not None and gid not in self._dissolved:
+            for lid, meta in self._group_ingress_row_locked(gid).items():
+                row.setdefault(lid, meta)
+        return row
+
+    def _send_digests_to(self, dest: NodeID) -> None:
+        super()._send_digests_to(dest)
+        # A grouped dest's pairs may route through its group: the
+        # SUB-LEADER carries the ingress and needs the same stamp
+        # (digest / version / codec — _digest_row_locked merges the
+        # ingress row) BEFORE the bytes, or a versioned wave's ingress
+        # would land unversioned and never serve the members
+        # (docs/swap.md).  Idempotent, like every stamp re-send.
+        with self._lock:
+            gid = self._member_group.get(dest)
+            sub = (self.groups[gid]["leader"]
+                   if gid is not None and gid not in self._dissolved
+                   else None)
+        if sub is not None and sub != dest:
+            super()._send_digests_to(sub)
+
+    def _digest_recipients_locked(self) -> list:
+        dests = super()._digest_recipients_locked()
+        have = set(dests)
+        for gid, rec in sorted(self.groups.items()):
+            if gid in self._dissolved or rec["leader"] in have:
+                continue
+            if self._group_ingress_row_locked(gid):
+                have.add(rec["leader"])
+                dests.append(rec["leader"])
+        return dests
 
     def send_layers(self) -> None:
         super().send_layers()
@@ -5705,13 +5912,14 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
                 for m in rec["members"]:
                     if m == rec["leader"] or m in self._dead_members:
                         continue
-                    lids = self.assignment.get(m)
-                    if not lids:
-                        continue
-                    row = {lid: meta for lid, meta in lids.items()
-                           if not (meta.shard or meta.codec
-                                   or meta.version)
-                           and self._ingress_ok_locked(gid, lid)}
+                    # EVERY live member target rides the plan — even
+                    # pairs the root plans flat: the sub-leader is the
+                    # members' ack funnel, and it can only fold their
+                    # coverage for targets it knows about.  Its own
+                    # fan-out is gated on what its holdings can
+                    # actually serve, so unroutable forms simply wait
+                    # for the root's flat delivery.
+                    row = dict(self.assignment.get(m) or {})
                     if row:
                         targets[m] = row
                 plans.append((gid, rec["leader"], targets))
@@ -5752,6 +5960,31 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
             return
         self.detector.touch(msg.src_id)
         replan_for = []
+        if msg.codecs:
+            # Folded member codec capabilities (docs/codec.md), applied
+            # through the same grant/revoke discipline as the flat
+            # announce path, so the planner can choose quantized
+            # transfers for grouped members — the capability half of
+            # letting codec-qualified pairs route THROUGH a group.
+            # Absorbed BEFORE the announced rows: anything gated on a
+            # member's status row existing (the start latch, the first
+            # codec stamp) must see the member's capabilities too.
+            changed = False
+            with self._lock:
+                for m, caps in sorted(msg.codecs.items()):
+                    m = int(m)
+                    if not self._grouped(m) or m not in group_members:
+                        continue
+                    new_caps = (frozenset(str(c) for c in caps)
+                                if caps else None)
+                    old_caps = self.node_codecs.get(m)
+                    if new_caps:
+                        self.node_codecs[m] = new_caps
+                    else:
+                        self.node_codecs.pop(m, None)
+                    changed = changed or new_caps != old_caps
+            if changed:
+                self._replicate_codecs()
         if msg.announced:
             with self._lock:
                 started = self._started
@@ -5765,10 +5998,19 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
                     self.status[m] = dict(row)
                 # A fold IS the member's restart channel: like the flat
                 # announce path, a re-announced member stops vouching
-                # for its dead incarnation's bytes (fresh vouching
-                # re-accrues via acks; the aggregate vocabulary carries
-                # no digests — docs/hierarchy.md honest limits).
-                self.content.reset_node(m, {})
+                # for its dead incarnation's bytes, and a JOINING
+                # member's folded digest inventory is the verification
+                # evidence the flat path reads off AnnounceMsg — the
+                # same quarantine applies (docs/membership.md): a
+                # grouped joiner whose holdings digest-verify becomes a
+                # source; one that conflicts stays a dest.
+                digs = {int(l): str(dg) for l, dg in
+                        ((msg.digests or {}).get(m) or {}).items()}
+                if self._verify_member_source(m, digs):
+                    self._merge_announced_digests(m, digs)
+                    self.content.reset_node(m, digs)
+                else:
+                    self.content.reset_node(m, {})
                 self._replicate("status", Node=m,
                                 Layers=layer_ids_to_json(row))
                 with self._lock:
@@ -5782,6 +6024,14 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
                 if started and known:
                     replan_for.append(m)
             trace.count("hier.announce_aggregates")
+            # The fold IS the members' announce-gate arrival: the start
+            # latch waits on their status rows exactly like the flat
+            # path waits on direct announces — and like that path, a
+            # successful start must drive the first sends (and the
+            # already-satisfied check) itself.
+            if self._maybe_start():
+                self.send_layers()
+                self._maybe_finish()
         for m in msg.dead:
             with self._lock:
                 fresh = (m in group_members and self._grouped(m)
@@ -5874,7 +6124,9 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
                 for g, rec in sorted(self.groups.items())}
 
     def _snapshot_extra_locked(self) -> dict:
-        return {"Groups": self._groups_json()}
+        extra = dict(super()._snapshot_extra_locked())
+        extra["Groups"] = self._groups_json()
+        return extra
 
     def crash(self, node_id: NodeID) -> None:
         gid = self._group_of_subleader.get(node_id)

@@ -525,18 +525,25 @@ class ReceiverNode:
         ``dissolve`` notice means this member's sub-leader was declared
         dead — re-point the control parent at the root and re-announce
         there (acks/heartbeats/metrics flow to the root; the group
-        degrades to flat delivery).  A TARGETS plan is sub-leader
+        degrades to flat delivery).  A ``forward`` plan installs this
+        member's chain relay roles (mode-3 receivers override the
+        install; elsewhere it logs and the sub-leader's redrive covers
+        the member by direct send).  A TARGETS plan is sub-leader
         business; a seat without an attached SubLeaderController (which
         replaces this handler) logs and ignores it."""
         if self._fence_stale(msg):
             return
         if not msg.dissolve:
+            if msg.forward:
+                self._install_forward_roles(msg)
+                return
             log.warn("group plan received by a non-sub-leader seat; "
                      "ignoring", group=msg.group_id, src=msg.src_id)
             return
         trace.count("hier.dissolved_members")
         log.warn("group dissolved; re-pointing control parent at root",
                  group=msg.group_id, root=msg.src_id)
+        self._clear_forward_roles()
         self.node.add_node(msg.src_id)
         with self._lock:
             self._leader_claim_epoch = max(self._leader_claim_epoch,
@@ -551,6 +558,17 @@ class ReceiverNode:
         except (OSError, KeyError) as e:
             log.error("re-announce to root after dissolve failed",
                       err=repr(e))
+
+    def _install_forward_roles(self, msg: "GroupPlanMsg") -> None:
+        """Chain relay roles need the mode-3 reassembly plane; a plain
+        receiver can't forward mid-flight bytes — the roles are advisory,
+        so ignoring them is safe (the sub-leader's redrive converges
+        this member by direct send)."""
+        log.info("chain forward roles ignored (no relay plane at this "
+                 "seat)", group=msg.group_id, layers=sorted(msg.forward))
+
+    def _clear_forward_roles(self) -> None:
+        """No relay plane, nothing to clear (mode 3 overrides)."""
 
     # ------------------------------------------------ elastic membership
 
@@ -2958,6 +2976,13 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         # re-request instead of a stall until crash detection.
         self._frag_src: Dict[int, int] = {}
         self._frag_t: Dict[int, float] = {}
+        # Chain relay roles (docs/hierarchy.md): per-layer forward hops
+        # installed by the sub-leader's chain plan — each role is
+        # {"lo","hi","next","sent"} with ``sent`` the interval list of
+        # wire bytes already forwarded downstream, so every committed
+        # byte forwards exactly once no matter how fragments split.
+        self._fwd_roles: Dict[int, list] = {}
+        self._fwd_dispatched: set = set()  # (lid, next): relay span filed
         self._gap_stop = threading.Event()
         self._gap_thread = None
         try:
@@ -3086,6 +3111,184 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 self._frag_t[layer_id] = _time.monotonic()
         super()._on_corrupt_fragment(src_id, layer_id, offset, size,
                                      total, reason)
+
+    # ------------------------------------------------ chain relay plane
+
+    def _install_forward_roles(self, msg: GroupPlanMsg) -> None:
+        """Install (REPLACE, per layer) this member's chain relay roles
+        (docs/hierarchy.md).  A re-installed identical (lo, hi, next)
+        hop keeps its ``sent`` coverage — re-chains after a member death
+        must not re-ship ranges the survivor already forwarded — while
+        a changed hop starts clean.  Bytes that landed BEFORE the roles
+        arrived forward immediately via the backlog scan: role install
+        and data arrival race freely."""
+        installed = []
+        with self._lock:
+            for lid, hops in msg.forward.items():
+                lid = int(lid)
+                prior = {(r["lo"], r["hi"], r["next"]): r
+                         for r in self._fwd_roles.get(lid, [])}
+                fresh = []
+                for lo, hi, nxt in hops:
+                    key = (int(lo), int(hi), int(nxt))
+                    old = prior.get(key)
+                    fresh.append(old if old is not None else
+                                 {"lo": key[0], "hi": key[1],
+                                  "next": key[2], "sent": []})
+                if fresh:
+                    self._fwd_roles[lid] = fresh
+                else:
+                    self._fwd_roles.pop(lid, None)
+                installed.append(lid)
+        trace.count("hier.relay_roles")
+        log.info("chain forward roles installed", group=msg.group_id,
+                 layers=sorted(installed))
+        for lid in installed:
+            self._forward_committed(lid, None, None)
+
+    def _clear_forward_roles(self) -> None:
+        with self._lock:
+            self._fwd_roles.clear()
+
+    def _forward_committed(self, lid, ranges, total, job: str = "") -> None:
+        """Relay hop of the chain (docs/hierarchy.md): forward the
+        freshly committed ``ranges`` (None = backlog scan of everything
+        already landed) downstream per this member's roles, the moment
+        they land — cut-through at fragment granularity, never waiting
+        for layer completion.  ``sent`` interval accounting dedups, so
+        retransmitted duplicates forward nothing."""
+        sends = []
+        with self._lock:
+            roles = self._fwd_roles.get(lid)
+            if not roles:
+                return
+            layer = self.layers.get(lid)
+            if layer is not None:
+                buf = layer.inmem_data
+                total = layer.data_size
+                codec = layer.meta.codec
+                # A completed SHARD holding's buffer is only real inside
+                # its range (roles never exceed it by construction, but
+                # the clip keeps a malformed plan from shipping zeros).
+                s0, s_sz = shard_range(layer.meta.shard, total)
+                committed = [(s0, s0 + s_sz)]
+            else:
+                entry = self._partial.get(lid)
+                if entry is None:
+                    return
+                buf, cov = entry
+                total = self._partial_total.get(lid, total)
+                codec = (self._layer_codecs.get(lid)
+                         or self._frag_codec.get(lid, ""))
+                committed = cov.committed()
+            if ranges is None:
+                ranges = committed
+            if total is None or buf is None:
+                return
+            for role in roles:
+                for s, e in ranges:
+                    cs, ce = max(s, role["lo"]), min(e, role["hi"])
+                    if cs >= ce:
+                        continue
+                    for a, b in intervals.uncovered(role["sent"], cs, ce):
+                        role["sent"] = intervals.insert(role["sent"], a, b)
+                        sends.append((role["next"], a, b))
+        for nxt, a, b in sends:
+            threads_util.tx_pool().submit(
+                self._forward_one, nxt, lid, buf, a, b - a, total, codec,
+                job)
+
+    def _forward_one(self, nxt, lid, buf, off, size, total, codec,
+                     job) -> None:
+        """One relay send — a byte-range LayerMsg whose offset indexes
+        the SAME wire space the bytes landed in (the reassembly buffer
+        is the full-size wire blob, so the landing offset IS the
+        forwarding offset).  Failures are non-fatal: the downstream
+        member's gap-NACK watchdog re-requests what never arrived, and
+        the sub-leader's redrive star-sends around a dead hop."""
+        try:
+            self.node.add_node(nxt)
+            span = telemetry.span_id(nxt, lid)
+            with self._lock:
+                first = (lid, nxt) not in self._fwd_dispatched
+                if first:
+                    self._fwd_dispatched.add((lid, nxt))
+            if first:
+                telemetry.span_event(
+                    span, "dispatched", node=self.node.my_id,
+                    src=self.node.my_id, dest=nxt, layer=lid, job=job,
+                    codec=codec,
+                    parent=telemetry.span_id(self.node.my_id, lid))
+                log.info("relaying layer downstream", layerID=lid,
+                         next=nxt)
+            trace.count("hier.relay_frags")
+            trace.count("hier.relay_bytes", size)
+            src = LayerSrc(
+                inmem_data=buf, data_size=size, offset=off,
+                meta=LayerMeta(location=LayerLocation.INMEM))
+            self.node.transport.send(
+                nxt, LayerMsg(self.node.my_id, lid, src, total,
+                              job_id=job, codec=codec, span_id=span,
+                              span_parent=telemetry.span_id(
+                                  self.node.my_id, lid)))
+        except (OSError, KeyError, ConnectionError) as e:
+            log.warn("relay forward failed (downstream gap-NACK / "
+                     "sub-leader redrive recovers it)", layerID=lid,
+                     next=nxt, err=repr(e))
+
+    def handle_layer_nack(self, msg: LayerNackMsg) -> None:
+        """Mode-3 NACK service: a mid-chain member can be asked to
+        retransmit a range of a layer it is ITSELF still receiving (its
+        downstream lost a relayed fragment) — serve fully-committed
+        ranges straight from the reassembly buffer, and fall back to
+        the completed-holdings retransmitter otherwise."""
+        with self._lock:
+            held = msg.layer_id in self.layers
+        if not held and self._serve_nack_from_partial(msg):
+            return
+        super().handle_layer_nack(msg)
+
+    def _serve_nack_from_partial(self, msg: LayerNackMsg) -> bool:
+        """True when the NACK was handled here (served, or suppressed by
+        the shared retry budget).  Only byte-for-byte certain ranges
+        qualify: fully committed, inside the wire total, and in the SAME
+        codec byte space the transfer runs in — anything else falls
+        through to the holding path's loud refusals."""
+        lid = msg.layer_id
+        end = msg.offset + msg.size
+        with self._lock:
+            entry = self._partial.get(lid)
+            total = self._partial_total.get(lid)
+            if (entry is None or total is None or msg.size <= 0
+                    or msg.offset < 0 or end > total):
+                return False
+            buf, cov = entry
+            if intervals.uncovered(cov.committed(), msg.offset, end):
+                return False  # not all landed here yet
+            codec = (self._layer_codecs.get(lid)
+                     or self._frag_codec.get(lid, ""))
+        if (getattr(msg, "codec", "") or "") != (codec or ""):
+            return False
+        n = self.nacker.admit(msg.src_id, lid, msg.offset, msg.size)
+        if not n:
+            return True  # budget spent: suppressed, not re-servable
+        self.node.add_node(msg.src_id)
+        log.warn("NACK served from in-flight partial coverage",
+                 layerID=lid, dest=msg.src_id, offset=msg.offset,
+                 bytes=msg.size, reason=msg.reason, attempt=n,
+                 codec=codec or None)
+        trace.count("integrity.retransmit_frags")
+        trace.count("integrity.retransmit_bytes", msg.size)
+        telemetry.link_add(self.node.my_id, msg.src_id,
+                           retransmit_frames=1, retransmit_bytes=msg.size)
+        src = LayerSrc(inmem_data=buf, data_size=msg.size,
+                       offset=msg.offset,
+                       meta=LayerMeta(location=LayerLocation.INMEM))
+        self.node.transport.send(
+            msg.src_id,
+            LayerMsg(self.node.my_id, lid, src, total, codec=codec,
+                     span_id=telemetry.span_id(msg.src_id, lid)))
+        return True
 
     def close(self) -> None:
         self._gap_stop.set()
@@ -3489,6 +3692,12 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
                 msg.src_id, self.node.my_id, job=msg.job_id,
                 delivered_bytes=sum(hi - lo for lo, hi in claims))
         complete = self._commit_fragment(lid, tok, msg.total_size)
+        if tok is not None and claims:
+            # Chain relay (docs/hierarchy.md): the fragment's bytes are
+            # committed — forward them downstream NOW, not at layer
+            # completion (cut-through pipelining at hop granularity).
+            self._forward_committed(lid, claims, msg.total_size,
+                                    job=msg.job_id)
         if journal and not complete:
             # (The completing fragment skips the journal: its completion
             # already deleted the checkpoint files.)  Bytes first,

@@ -77,7 +77,8 @@ def _codec_view(layer: LayerSrc, layer_id: LayerID, codec: str,
 
 def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
                job_id: str = "", shard: str = "", codec: str = "",
-               codecs=None, span_parent: str = "") -> None:
+               codecs=None, span_parent: str = "",
+               wire_range: Optional[tuple] = None) -> None:
     """Send one full layer to ``dest``; client-held layers are fetched via
     the pipe mechanism instead (node.go:354-365).  ``job_id`` tags the
     frames with the admitted dissemination job they serve ("" = the base
@@ -95,7 +96,14 @@ def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
     and the frames carry the codec tag.  Client-held layers can't
     encode-serve; they fall back to the raw pipe fetch (the dest's
     digest gate treats the raw bytes as a raw delivery — raw satisfies
-    every target)."""
+    every target).
+
+    ``wire_range`` (docs/hierarchy.md): send only ``(offset, size)`` of
+    the wire byte space — the chain stripe seed path, where the
+    sub-leader ships each stripe to its head member and the rest of the
+    range arrives via member relays.  Offsets index the view the
+    shard/codec tags describe, and the frames still carry those tags so
+    downstream accounting stays in the stamped byte space."""
     if layer.meta.location == LayerLocation.CLIENT:
         log.debug("loading layer from client", layer=layer_id)
         fetch_from_client(node, layer_id, dest)
@@ -116,6 +124,22 @@ def send_layer(node: Node, dest: NodeID, layer_id: LayerID, layer: LayerSrc,
                          src=node.my_id, dest=dest, layer=layer_id,
                          job=job_id, codec=codec, shard=shard,
                          parent=span_parent)
+    if wire_range is not None:
+        off, size = int(wire_range[0]), int(wire_range[1])
+        size = min(size, max(0, view.data_size - off))
+        if size <= 0:
+            log.error("wire range outside the layer's byte space; dropped",
+                      layerID=layer_id, offset=wire_range[0],
+                      size=wire_range[1], layer_size=view.data_size)
+            return
+        sub = _sub_layer_src(view, _sendable_location(view), off, size,
+                             layer.meta.limit_rate)
+        node.transport.send(
+            dest, LayerMsg(node.my_id, layer_id, sub, view.data_size,
+                           job_id=job_id, shard=shard, codec=codec,
+                           span_id=span, span_parent=span_parent)
+        )
+        return
     if shard:
         off, size = shard_range(shard, view.data_size)
         sub = _sub_layer_src(view, _sendable_location(view), off, size,
@@ -233,6 +257,27 @@ class NackRetransmitter:
         # (DLD_GAP_NACK_S, DLD_WIRE_CRC, ...), not at import time.
         self.LIMIT = int(os.environ.get("DLD_NACK_RETRY_LIMIT", "6"))
 
+    def admit(self, dest: NodeID, layer_id: LayerID, offset: int,
+              size: int = 0) -> int:
+        """Count one retransmit attempt for (dest, layer, offset) and
+        return the attempt number, or 0 when the bounded budget is
+        exhausted.  ONE budget shared by every serving path on this
+        node (completed-holding retransmits and in-flight partial-range
+        relay serves), so a range can't double its retries by being
+        servable two ways."""
+        key = (dest, layer_id, offset)
+        with self._lock:
+            n = self._counts.get(key, 0) + 1
+            self._counts[key] = n
+        if n > self.LIMIT:
+            log.error("NACK retry budget exhausted; giving up on range "
+                      "(crash detection / re-announce must recover it)",
+                      dest=dest, layerID=layer_id,
+                      offset=offset, size=size, tries=n)
+            trace.count("integrity.nack_suppressed")
+            return 0
+        return n
+
     def handle(self, node: Node, layers: LayersSrc, lock: threading.Lock,
                msg, codecs=None) -> bool:
         """Serve one NACK; True when the range was re-sent.  A NACK
@@ -241,16 +286,8 @@ class NackRetransmitter:
         cached encoded form of a canonical one, ``codecs``) so the
         retransmitted bytes are byte-identical to the originals —
         NACK/retransmit recovery runs entirely in encoded space."""
-        key = (msg.src_id, msg.layer_id, msg.offset)
-        with self._lock:
-            n = self._counts.get(key, 0) + 1
-            self._counts[key] = n
-        if n > self.LIMIT:
-            log.error("NACK retry budget exhausted; giving up on range "
-                      "(crash detection / re-announce must recover it)",
-                      dest=msg.src_id, layerID=msg.layer_id,
-                      offset=msg.offset, size=msg.size, tries=n)
-            trace.count("integrity.nack_suppressed")
+        n = self.admit(msg.src_id, msg.layer_id, msg.offset, msg.size)
+        if not n:
             return False
         with lock:
             layer = layers.get(msg.layer_id)

@@ -176,6 +176,52 @@ def pod_shard_demands(
     return pod_pairs
 
 
+def group_stripe_ranges(base: int, size: int,
+                        stripes: int) -> List[Tuple[int, int]]:
+    """Contiguous near-equal stripe ranges over ``[base, base+size)`` —
+    the same floor-cut construction as the PR 2 transport striping, so
+    a chain stripe's boundaries line up with how fragments already land
+    (docs/hierarchy.md).  Degenerate inputs collapse safely: at most
+    ``size`` stripes, at least one."""
+    if size <= 0:
+        return []
+    k = max(1, min(int(stripes), size))
+    cuts = [base + (size * i) // k for i in range(k + 1)]
+    return [(cuts[i], cuts[i + 1])
+            for i in range(k) if cuts[i + 1] > cuts[i]]
+
+
+def chain_forward_roles(
+    members: List[NodeID], base: int, size: int, stripes: int,
+) -> Tuple[List[Tuple[NodeID, Tuple[int, int]]],
+           Dict[NodeID, List[Tuple[int, int, NodeID]]]]:
+    """K-striped pipelined broadcast over ``members`` (arXiv:2408.13356's
+    bandwidth-optimal construction, docs/hierarchy.md): stripe ``k``
+    roots at member ``k % R`` and rides a rotated ring, so with K ≥ R
+    every member heads ~K/R stripes, tails ~K/R, and forwards the rest —
+    per-member egress ≈ (R−1)/R × ``size`` and the source (sub-leader)
+    sends each byte exactly once.
+
+    Returns ``(heads, roles)``: ``heads`` = the source's seed sends,
+    one ``(member, (lo, hi))`` per stripe; ``roles`` = ``{member:
+    [(lo, hi, next_member), ...]}`` forward hops (non-tail positions
+    only).  Byte offsets are in the transfer's WIRE space — the caller
+    passes the encoded blob size for codec pairs and the shard range
+    for sharded ones, so chains compose with both."""
+    ms = [int(m) for m in members]
+    r = len(ms)
+    heads: List[Tuple[NodeID, Tuple[int, int]]] = []
+    roles: Dict[NodeID, List[Tuple[int, int, NodeID]]] = {m: [] for m in ms}
+    if not ms:
+        return heads, roles
+    for k, (lo, hi) in enumerate(group_stripe_ranges(base, size, stripes)):
+        chain = [ms[(k + i) % r] for i in range(r)]
+        heads.append((chain[0], (lo, hi)))
+        for up, down in zip(chain, chain[1:]):
+            roles[up].append((lo, hi, down))
+    return heads, roles
+
+
 @dataclasses.dataclass(frozen=True)
 class PodTopology:
     """Multi-slice pod shape for the flow solve.

@@ -1581,6 +1581,14 @@ class GroupPlanMsg:
     re-announce there; the group degrades to flat delivery.  All other
     fields are omitted on a dissolve notice.
 
+    ``forward`` (sub-leader → MEMBER, advisory): chain relay roles —
+    ``{layer: [[lo, hi, next_member], ...]}`` byte ranges (in the
+    transfer's wire byte space, i.e. the encoded blob for codec pairs)
+    the receiving member forwards downstream the moment they land
+    (docs/hierarchy.md).  Re-sent roles REPLACE per layer; an
+    empty-list row clears that layer's roles.  A legacy member ignores
+    the key and the sub-leader's redrive converges it by direct send.
+
     Epoch-fenced like every leader-originated control message: a
     zombie root's group plans are rejected, not raced."""
 
@@ -1589,6 +1597,7 @@ class GroupPlanMsg:
     targets: dict = dataclasses.field(default_factory=dict)
     dissolve: bool = False
     epoch: int = -1
+    forward: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.GROUP_PLAN
 
@@ -1600,6 +1609,10 @@ class GroupPlanMsg:
                 for m, row in self.targets.items()}
         if self.dissolve:
             payload["Dissolve"] = True
+        if self.forward:
+            payload["Forward"] = {
+                str(lid): [[int(h[0]), int(h[1]), int(h[2])] for h in hops]
+                for lid, hops in self.forward.items()}
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -1611,6 +1624,9 @@ class GroupPlanMsg:
                      for m, row in (d.get("Targets") or {}).items()},
             dissolve=bool(d.get("Dissolve", False)),
             epoch=int(d.get("Epoch", -1)),
+            forward={int(lid): [[int(h[0]), int(h[1]), int(h[2])]
+                                for h in hops or []]
+                     for lid, hops in (d.get("Forward") or {}).items()},
         )
 
 
@@ -1637,6 +1653,20 @@ class GroupStatusMsg:
     {"Counters", "Gauges", "Links", "T", "Proc"}}``), folded into the
     root's cluster table like direct ``MetricsReportMsg`` reports.
 
+    ``digests``: ``{member: {layer: digest}}`` — the members' announced
+    digest inventories, folded with the same debounce as ``announced``.
+    Advisory, but it is what lets the root digest-verify a GROUPED
+    joiner and promote it to a source (docs/membership.md) — without
+    it the aggregate fold left grouped joiners quarantined forever.
+
+    ``codecs``: ``{member: [codec, ...]}`` — the members' announced
+    wire-codec decode capabilities (docs/codec.md), folded with the
+    same debounce.  An explicit empty list is a REVOCATION (a restarted
+    member may have lost the capability with its config), mirroring the
+    flat announce path; without this fold the root could never choose a
+    quantized transfer for a grouped member, so codec-qualified pairs
+    were forced to plan flat around the hierarchy.
+
     Every section is optional and omitted at default — a legacy peer
     decodes the required keys alone."""
 
@@ -1646,6 +1676,8 @@ class GroupStatusMsg:
     announced: dict = dataclasses.field(default_factory=dict)
     dead: list = dataclasses.field(default_factory=list)
     metrics: dict = dataclasses.field(default_factory=dict)
+    digests: dict = dataclasses.field(default_factory=dict)
+    codecs: dict = dataclasses.field(default_factory=dict)
     # Advisory span correlation for the aggregated coverage
     # (docs/observability.md): ``{layer: {member: span_id}}`` — the
     # sub-leader's fan-out child span per covered (member, layer), so
@@ -1674,6 +1706,13 @@ class GroupStatusMsg:
             payload["Spans"] = {
                 str(lid): {str(m): str(s) for m, s in per.items()}
                 for lid, per in self.spans.items()}
+        if self.digests:
+            payload["Digests"] = {
+                str(m): {str(lid): str(dg) for lid, dg in row.items()}
+                for m, row in self.digests.items()}
+        if self.codecs:
+            payload["Codecs"] = {str(m): [str(c) for c in caps]
+                                 for m, caps in self.codecs.items()}
         return payload
 
     @classmethod
@@ -1690,6 +1729,10 @@ class GroupStatusMsg:
                      for m, snap in (d.get("Metrics") or {}).items()},
             spans={int(lid): {int(m): str(s) for m, s in per.items()}
                    for lid, per in (d.get("Spans") or {}).items()},
+            digests={int(m): {int(lid): str(dg) for lid, dg in row.items()}
+                     for m, row in (d.get("Digests") or {}).items()},
+            codecs={int(m): [str(c) for c in caps or []]
+                    for m, caps in (d.get("Codecs") or {}).items()},
         )
 
 

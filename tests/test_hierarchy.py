@@ -140,13 +140,14 @@ def test_hierarchical_refuses_grouped_standby():
 
 
 def _build_hier(n_groups, group_size, layer_ids, layer_size=24 * 1024,
-                root_id=0, member_timeout=0.0, **leader_kw):
+                root_id=0, member_timeout=0.0, kind="inmem",
+                **leader_kw):
     """Root ``root_id`` seeding ``layer_ids`` + ``n_groups`` groups of
     ``group_size`` (sub-leader = first member), every grouped seat an
     assignee of every layer."""
     ids = [root_id] + list(range(root_id + 1,
                                  root_id + 1 + n_groups * group_size))
-    ts, _ = make_transports("inmem", ids)
+    ts, _ = make_transports(kind, ids)
     groups = partition_groups(ids[1:], group_size=group_size)
     assignment = {i: {lid: LayerMeta() for lid in layer_ids}
                   for i in ids[1:]}
@@ -441,3 +442,297 @@ def test_chaos_smoke_hierarchy_leader_kill(monkeypatch, chaos_seed):
             r.close()
         for t in ts.values():
             t.close()
+
+
+# ------------------------------------------- intra-group chain (PR 17)
+
+
+def _chain_counters():
+    t = trace.counter_totals()
+    return (t.get("hier.chain_plans", 0), t.get("hier.relay_frags", 0))
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_chain_dissemination_byte_exact(kind):
+    """The chain tentpole e2e, both backends: one group of four — the
+    FIRST dispatch of every layer rides the K-striped member chain
+    (forward roles installed, fragments relayed member-to-member), the
+    run is byte-exact with digests verified at every seat, and the
+    sub-leader's egress is O(model_bytes), strictly below the star's
+    members x model_bytes."""
+    from distributed_llm_dissemination_tpu.utils import integrity
+
+    size = 48 * 1024
+    lids = [0, 1]
+    trace.reset_counters()
+    leader, recvs, ctls, ts, groups, assignment = _build_hier(
+        1, 4, lids, layer_size=size, kind=kind)
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        for i in assignment:
+            for lid in lids:
+                assert bytes(recvs[i].layers[lid].inmem_data) == \
+                    layer_bytes(lid, size), (i, lid)
+                if integrity.digests_enabled():
+                    assert lid in recvs[i]._digest_ok, (i, lid)
+        totals = trace.counter_totals()
+        assert totals.get("hier.chain_plans", 0) >= len(lids)
+        assert totals.get("hier.relay_roles", 0) >= 1
+        assert totals.get("hier.relay_frags", 0) >= 1
+        # Egress accounting: the whole point — the sub-leader shipped
+        # each layer's bytes ONCE (plus bounded redrive slack), never
+        # once per member like the star.
+        n_members = len(groups[0]["members"]) - 1  # minus the sub
+        total = len(lids) * size
+        egress = totals.get("hier.subleader_egress_bytes", 0)
+        assert total <= egress < n_members * total, (egress, total)
+    finally:
+        _close_hier(leader, recvs, ctls, ts)
+
+
+def test_chain_link_table_reconciles_byte_exact_multi_hop():
+    """Tier-1 guard (satellite): when bytes traverse a multi-hop chain,
+    the telemetry link table still reconciles BYTE-EXACTLY — every
+    (seat, layer) counted once at its landing, forwarded bytes never
+    double-counted, and the root's only data link is the group
+    ingress."""
+    from distributed_llm_dissemination_tpu.utils import telemetry
+
+    size = 32 * 1024
+    lids = [0, 1]
+    telemetry.reset_run()
+    trace.reset_counters()
+    leader, recvs, ctls, ts, groups, assignment = _build_hier(
+        1, 4, lids, layer_size=size)
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        assert trace.counter_totals().get("hier.chain_plans", 0) >= 1
+        links = telemetry.snapshot()["links"]
+        base = {key: row for key, row in links.items() if "#" not in key}
+        delivered = sum(row.get("delivered_bytes", 0)
+                        for row in base.values())
+        assert delivered == len(assignment) * len(lids) * size, base
+        # The root shipped ONLY the group ingress: no root->member
+        # data link ever carried a byte.
+        sub = groups[0]["leader"]
+        for key, row in base.items():
+            if key.startswith("0->") and key != f"0->{sub}":
+                assert row.get("delivered_bytes", 0) == 0, (key, row)
+        assert base[f"0->{sub}"]["delivered_bytes"] == len(lids) * size
+        # Relay hops really carried bytes (member->member rows exist).
+        relayed = sum(
+            row.get("delivered_bytes", 0) for key, row in base.items()
+            if "->" in key
+            and key.split("->")[0] not in ("0", str(sub)))
+        assert relayed > 0, base
+    finally:
+        _close_hier(leader, recvs, ctls, ts)
+
+
+@pytest.mark.timeout(90)
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_chain_mid_member_kill_repairs_and_converges(kind, monkeypatch):
+    """Seeded mid-chain member kill, both backends: a member whose
+    inbound LAYER frames are dropped (so its stripe seed and every
+    relay THROUGH it are provably lost) dies mid-run — the sub-leader's
+    detector reports it, survivors re-chain around the hole (gap-NACK +
+    re-seeded stripes), the root drops the dead seat's pairs, and the
+    survivors converge byte-exact."""
+    monkeypatch.setenv("DLD_GAP_NACK_S", "0.4")
+    size = 48 * 1024
+    trace.reset_counters()
+    ids = list(range(5))  # 0 root; one group [1(sub), 2, 3, 4]
+    raw, _ = make_transports(kind, ids)
+    ts = dict(raw)
+    victim = 3  # mid-chain hop of stripe 0 (members sorted: 2, 3, 4)
+    ts[victim] = FaultyTransport(
+        raw[victim], [FaultRule("drop", "in", msg_type=MsgType.LAYER)],
+        seed=1)
+    groups = {0: {"leader": 1, "members": [1, 2, 3, 4]}}
+    assignment = {i: {0: LayerMeta()} for i in ids[1:]}
+    leader = HierarchicalFlowLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, size)}, assignment,
+        {i: 10 ** 9 for i in ids}, groups=groups, expected_nodes={1},
+        failure_timeout=2.0)
+    sub = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                     heartbeat_interval=HB)
+    ctl = SubLeaderController(sub, 0, [1, 2, 3, 4], member_timeout=0.8)
+    recvs = {1: sub}
+    for m in (2, 3, 4):
+        recvs[m] = FlowRetransmitReceiverNode(Node(m, 1, ts[m]), {},
+                                              heartbeat_interval=HB)
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        _wait_for(lambda: _chain_counters()[0] >= 1,
+                  what="chain dispatch")
+        # Kill the wedged mid-chain member: heartbeats stop, the
+        # sub-leader's detector fires, the chain re-forms.
+        recvs[victim].close()
+        ts[victim].close()
+        leader.ready().get(timeout=60.0)
+        for m in (1, 2, 4):
+            assert bytes(recvs[m].layers[0].inmem_data) == \
+                layer_bytes(0, size), m
+        totals = trace.counter_totals()
+        assert totals.get("hier.member_dead_reports", 0) >= 1
+        assert totals.get("hier.member_crashes", 0) >= 1
+        assert totals.get("hier.relay_frags", 0) >= 1
+    finally:
+        ctl.close()
+        close_all(leader, [r for m, r in recvs.items() if m != victim],
+                  ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_codec_qualified_delivery_plans_through_group(kind, monkeypatch):
+    """Hierarchy x codecs (the lifted limit), both backends: every
+    grouped seat sits on a slow link and advertises int8 decode (the
+    members' capability rides the new GroupStatus codec fold) — the
+    root routes the group's SHARED codec form through ONE encoded
+    group ingress, the sub-leader chains the encoded bytes internally,
+    and every member verifies the codec-qualified digest."""
+    from test_codec import _enc_blob, _blob_layer, _plane
+    from distributed_llm_dissemination_tpu.utils import (
+        integrity,
+        telemetry,
+    )
+
+    monkeypatch.setenv("DLD_CODEC_MIN_RATE", str(64 << 20))
+    telemetry.reset_run()
+    trace.reset_counters()
+    ids = [0, 1, 2, 3]
+    ts, _ = make_transports(kind, ids)
+    groups = {0: {"leader": 1, "members": [1, 2, 3]}}
+    lids = [0, 1]
+    layers = {lid: _blob_layer(lid) for lid in lids}
+    assignment = {i: {lid: LayerMeta() for lid in lids}
+                  for i in (1, 2, 3)}
+    bw = {0: 1 << 30, 1: 4 << 20, 2: 4 << 20, 3: 4 << 20}
+    leader = HierarchicalFlowLeaderNode(
+        Node(0, 0, ts[0]), layers, assignment, bw, groups=groups,
+        expected_nodes={1}, codecs=_plane())
+    sub = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                     heartbeat_interval=HB,
+                                     codecs=_plane())
+    ctl = SubLeaderController(sub, 0, [1, 2, 3])
+    recvs = {1: sub}
+    for m in (2, 3):
+        recvs[m] = FlowRetransmitReceiverNode(Node(m, 1, ts[m]), {},
+                                              heartbeat_interval=HB,
+                                              codecs=_plane())
+    try:
+        for r in recvs.values():
+            r.announce()
+        # The members' decode capability must fold upward BEFORE the
+        # first plan stamps codec choices (choices are memoized).
+        _wait_for(lambda: all(m in leader.node_codecs for m in (1, 2, 3)),
+                  what="member codec capabilities to fold to the root")
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        for m in (1, 2, 3):
+            for lid in lids:
+                src = recvs[m].layers[lid]
+                assert src.meta.codec == "int8", (m, lid)
+                assert bytes(src.inmem_data) == _enc_blob(lid), (m, lid)
+                if integrity.digests_enabled():
+                    assert lid in recvs[m]._digest_ok, (m, lid)
+                assert leader.status[m][lid].codec == "int8", (m, lid)
+        # ONE group ingress of the ENCODED bytes: the root's only data
+        # link is to the sub-leader, and it carried exactly the
+        # encoded model once.
+        enc_total = sum(len(_enc_blob(lid)) for lid in lids)
+        links = telemetry.snapshot()["links"]
+        base = {key: row for key, row in links.items() if "#" not in key}
+        root_out = sum(row.get("delivered_bytes", 0)
+                       for key, row in base.items()
+                       if key.startswith("0->"))
+        assert root_out == enc_total, base
+        assert base.get("0->1", {}).get("delivered_bytes", 0) == \
+            enc_total
+        totals = trace.counter_totals()
+        assert totals.get("hier.chain_plans", 0) >= 1
+        assert totals.get("hier.relay_frags", 0) >= 1
+    finally:
+        ctl.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_rollout_wave_plans_through_group(kind):
+    """Hierarchy x versioned rollout (the lifted limit), both
+    backends: a version-stamped wave job targeting two grouped members
+    routes through ONE synthetic group ingress — the sub-leader (not
+    itself a wave dest) receives the v2 bytes once, chains them to the
+    members, and the members' VERSIONED acks ride verbatim to the root
+    so the wave's commit-fence bookkeeping keeps full fidelity."""
+    from distributed_llm_dissemination_tpu.utils import (
+        integrity,
+        telemetry,
+    )
+
+    size = 32 * 1024
+    telemetry.reset_run()
+    trace.reset_counters()
+    ids = [0, 1, 2, 3]
+    ts, _ = make_transports(kind, ids)
+    groups = {0: {"leader": 1, "members": [1, 2, 3]}}
+    assignment = {i: {0: LayerMeta()} for i in (1, 2, 3)}
+    leader = HierarchicalFlowLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, size)}, assignment,
+        {i: 10 ** 9 for i in ids}, groups=groups, expected_nodes={1})
+    sub = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                     heartbeat_interval=HB)
+    ctl = SubLeaderController(sub, 0, [1, 2, 3])
+    recvs = {1: sub}
+    for m in (2, 3):
+        recvs[m] = FlowRetransmitReceiverNode(Node(m, 1, ts[m]), {},
+                                              heartbeat_interval=HB)
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        # The wave: v2 bytes under a NEW layer id, version-stamped
+        # targets on the two members only (the sub-leader is not a
+        # dest — the ingress demand is synthesized).
+        wave_lid = 9
+        with leader._lock:
+            leader.layers[wave_lid] = mem_layer(wave_lid, size)
+        dig = integrity.layer_digest(layer_bytes(wave_lid, size))
+        leader.submit_job(
+            "wave0", {2: {wave_lid: LayerMeta()},
+                      3: {wave_lid: LayerMeta()}},
+            version="v2", digests={wave_lid: dig})
+        _wait_for(lambda: leader.jobs.table().get("wave0", {}).get(
+            "State") == "done", what="wave job completion")
+        for m in (2, 3):
+            src = recvs[m].layers[wave_lid]
+            assert src.meta.version == "v2", m
+            assert bytes(src.inmem_data) == layer_bytes(wave_lid, size)
+            if integrity.digests_enabled():
+                assert wave_lid in recvs[m]._digest_ok, m
+            # The versioned ack reached the root UNAGGREGATED.
+            assert leader.status[m][wave_lid].version == "v2", m
+        # The sub-leader carried the synthetic ingress (v2-stamped).
+        assert sub.layers[wave_lid].meta.version == "v2"
+        # Across the WHOLE run (base + wave) the root never shipped a
+        # byte to a member directly: every delivery routed through the
+        # group.
+        links = telemetry.snapshot()["links"]
+        base = {key: row for key, row in links.items() if "#" not in key}
+        for key, row in base.items():
+            if key.startswith("0->") and key != "0->1":
+                assert row.get("delivered_bytes", 0) == 0, (key, row)
+        assert base["0->1"]["delivered_bytes"] == 2 * size
+        assert trace.counter_totals().get("hier.acks_forwarded", 0) >= 2
+    finally:
+        ctl.close()
+        close_all(leader, list(recvs.values()), ts)

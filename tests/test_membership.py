@@ -630,6 +630,83 @@ def test_joiner_absorbed_into_group():
         close_all(leader, list(recvs.values()), ts)
 
 
+def test_grouped_joiner_with_verified_digest_becomes_source():
+    """A joiner placed INTO a group announces to its sub-leader, so its
+    holdings reach the root only through the announce fold — the folded
+    digest inventory (GroupStatusMsg.digests) is the verification
+    evidence: a joiner pre-holding byte-exact goal layers digest-
+    verifies through the fold and is promoted to a SOURCE, exactly like
+    a flat joiner whose announce verified directly."""
+    leader, recvs, ctls, ts, registry, groups = _hier_rig()
+    tj = None
+    joiner = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        tj = InmemTransport("n9", addr_registry={0: registry[0]})
+        # The joiner already holds the goal layer BYTE-EXACTLY.
+        joiner = FlowRetransmitReceiverNode(Node(9, 0, tj),
+                                            {0: mem_layer(0, SIZE)},
+                                            heartbeat_interval=HB)
+        assert joiner.join(timeout=TIMEOUT)
+        assert leader._member_group.get(9) is not None
+        _wait_for(lambda: 9 in leader.status,
+                  what="joiner inventory folded through the sub-leader")
+        _wait_for(lambda: leader.content.node_has(
+            9, leader.layer_digests.get(0, "")),
+            what="folded digest verification")
+        assert 9 not in leader.membership.unverified_sources()
+        _wait_for(lambda: leader.membership.state_of(9) == mship.ACTIVE,
+                  what="verified grouped joiner turning ACTIVE")
+    finally:
+        if joiner is not None:
+            joiner.close()
+        if tj is not None:
+            tj.close()
+        for c in ctls:
+            c.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
+def test_grouped_joiner_with_conflicting_digest_stays_quarantined():
+    """The quarantine half of the folded verification: a grouped
+    joiner whose pre-held bytes CONFLICT with the stamped digest stays
+    JOINING — a dest, never a source — even though its announce reached
+    the root as a sub-leader aggregate rather than directly."""
+    leader, recvs, ctls, ts, registry, groups = _hier_rig()
+    tj = None
+    joiner = None
+    try:
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        leader.ready().get(timeout=TIMEOUT)
+        tj = InmemTransport("n9", addr_registry={0: registry[0]})
+        bad = mem_layer(0, SIZE)
+        bad.inmem_data[0] ^= 0xFF
+        joiner = FlowRetransmitReceiverNode(Node(9, 0, tj), {0: bad},
+                                            heartbeat_interval=HB)
+        assert joiner.join(timeout=TIMEOUT)
+        assert leader._member_group.get(9) is not None
+        _wait_for(lambda: 9 in leader.status,
+                  what="joiner inventory folded through the sub-leader")
+        assert 9 in leader.membership.unverified_sources()
+        assert leader.membership.state_of(9) == mship.JOINING
+        # Its corrupt holding vouches for nothing.
+        assert not leader.content.node_has(
+            9, leader.layer_digests.get(0, ""))
+    finally:
+        if joiner is not None:
+            joiner.close()
+        if tj is not None:
+            tj.close()
+        for c in ctls:
+            c.close()
+        close_all(leader, list(recvs.values()), ts)
+
+
 @pytest.mark.timeout(90)
 def test_dissolved_group_reforms_on_subleader_readmission():
     """The named PR 11 follow-up: kill a sub-leader (group dissolves to

@@ -145,9 +145,9 @@ OMITTED_AT_DEFAULT = {
                           "Applied", "Prepare", "Error", "Revert",
                           "Finalize"},
     MsgType.JOB_REVOKE: {"Epoch", "Pairs"},
-    MsgType.GROUP_PLAN: {"Epoch", "Targets", "Dissolve"},
+    MsgType.GROUP_PLAN: {"Epoch", "Targets", "Dissolve", "Forward"},
     MsgType.GROUP_STATUS: {"Covered", "Announced", "Dead", "Metrics",
-                           "Spans"},
+                           "Spans", "Digests", "Codecs"},
     MsgType.JOIN: {"Addr", "Want", "Node", "Admitted", "Parent",
                    "ParentAddr", "Error", "Epoch"},
     MsgType.DRAIN: {"Node", "Done", "Error", "Epoch"},
@@ -448,3 +448,54 @@ def test_pod_fields_interop_with_prepod_peers():
     assert "Pods" not in LayerDigestsMsg(1, {7: "xxh3:ab"}).to_payload()
     assert "Pod" not in DevicePlanMsg(
         1, "p", 7, 2, 64, [(1, 0, 64)]).to_payload()
+
+
+def test_chain_fields_interop_with_prechain_peers():
+    """The intra-group chain extension (docs/hierarchy.md) must keep a
+    pre-chain cluster interoperable: the advisory
+    ``GroupPlanMsg.Forward`` relay roles and the
+    ``GroupStatusMsg.Digests`` fold are omitted at default (asserted
+    type-by-type above), populated instances round-trip through real
+    JSON with int-keyed maps restored, and a stripped (legacy-peer)
+    payload decodes to the pre-chain reading — never KeyError."""
+    for msg in (
+        GroupPlanMsg(1, 2, forward={7: [[0, 4096, 3], [4096, 8192, 4]],
+                                    9: []}),
+        GroupPlanMsg(1, 2, targets={3: {7: LayerMeta()}},
+                     forward={7: [[0, 64, 4]]}, epoch=5),
+        GroupStatusMsg(1, 2, covered={7: [3]},
+                       digests={3: {7: "xxh3:ab"}, 4: {}}),
+        GroupStatusMsg(1, 2, announced={3: {7: LayerMeta()}},
+                       digests={3: {7: "xxh3:ab", 9: "xxh3:cd"}},
+                       codecs={3: ["int8"], 4: []}),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        back = decode_msg(msg.msg_type, wire)
+        # Empty inner rows may legally drop on the wire (omitted-at-
+        # default discipline applies per-row too); every populated
+        # entry must survive with int keys.
+        assert isinstance(back, type(msg))
+        if msg.msg_type is MsgType.GROUP_PLAN:
+            assert {l: h for l, h in back.forward.items() if h} == \
+                {l: h for l, h in msg.forward.items() if h}
+            assert all(isinstance(l, int) for l in back.forward)
+            assert back.targets == msg.targets
+        else:
+            assert {m: d for m, d in back.digests.items() if d} == \
+                {m: d for m, d in msg.digests.items() if d}
+            assert all(isinstance(m, int) for m in back.digests)
+            assert back.covered == msg.covered
+            # Capability fold: grants AND explicit [] revocations
+            # survive the wire with int member keys.
+            assert back.codecs == msg.codecs
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("Forward", "Digests", "Codecs")}
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "forward", {}) == {}
+        assert getattr(old, "digests", {}) == {}
+        assert getattr(old, "codecs", {}) == {}
+    # Omitted at default: a chain-less plan / digest-less status is
+    # byte-identical to the legacy wire format.
+    assert "Forward" not in GroupPlanMsg(1, 2).to_payload()
+    assert "Digests" not in GroupStatusMsg(1, 2).to_payload()
+    assert "Codecs" not in GroupStatusMsg(1, 2).to_payload()

@@ -42,6 +42,11 @@ from distributed_llm_dissemination_tpu.runtime import (
     FlowRetransmitLeaderNode,
     FlowRetransmitReceiverNode,
     Node,
+    StandbyController,
+)
+from distributed_llm_dissemination_tpu.transport.faults import (
+    FaultRule,
+    FaultyTransport,
 )
 from distributed_llm_dissemination_tpu.runtime.codec import WireCodecPlane
 from distributed_llm_dissemination_tpu.runtime.stream_boot import (
@@ -51,6 +56,7 @@ from distributed_llm_dissemination_tpu.sched.flow import pod_shard_demands
 from distributed_llm_dissemination_tpu.transport import reset_registry
 from distributed_llm_dissemination_tpu.transport.messages import (
     DevicePlanMsg,
+    MsgType,
 )
 from distributed_llm_dissemination_tpu.utils import (
     integrity,
@@ -742,3 +748,77 @@ def test_preholding_member_publishes_slice_on_pod_stamp():
             assert bytes(src.inmem_data) == layer_bytes(0, layer_size)
     finally:
         close_all(leader, receivers, ts)
+
+
+@pytest.mark.timeout(90)
+def test_takeover_after_pod_break_does_not_resurrect_pod():
+    """Pod membership is replicated state (docs/fabric.md +
+    docs/failover.md): a pod that BROKE before a root kill must stay
+    broken at the promoted leader — a takeover that re-derived pod
+    pairs for it would strand the survivors' goals behind a gather
+    that can never complete.  The promoted leader adopts the broken
+    set, widens any leftover 1/R@k slices, and finishes the survivors
+    over the host path."""
+    telemetry.reset_run()
+    trace.reset_counters()
+    layer_size = 1 << 16
+    ids = [0, 1, 2, 3, 4]  # 0 root, 1 standby, 2-4 one pod
+    raw, _ = make_transports("inmem", ids)
+    ts = dict(raw)
+    # Wedge the root's outbound LAYER frames so the kill is guaranteed
+    # to strike mid-delivery (the HA rig's determinism trick).
+    ts[0] = FaultyTransport(
+        raw[0], [FaultRule("drop", "out", msg_type=MsgType.LAYER)],
+        seed=1)
+    board = FabricPlane()
+    bw = {i: 1 << 30 for i in ids}
+    assignment = {m: {0: LayerMeta()} for m in (2, 3, 4)}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mem_layer(0, layer_size)}, assignment,
+        bw, fabric=board, pods={0: [2, 3, 4]}, failure_timeout=2.0,
+        standbys=[1], lease_interval=0.15, epoch=0)
+    # The standby holds a replica copy so the promoted root can source.
+    standby = FlowRetransmitReceiverNode(
+        Node(1, 0, ts[1]), {0: mem_layer(0, layer_size)},
+        heartbeat_interval=0.5)
+    ctl = StandbyController(standby, rank=0, lease_timeout=0.5,
+                            standbys=[1], mode=3, node_network_bw=bw,
+                            failure_timeout=2.0, lease_interval=0.15)
+    recvs = {m: FlowRetransmitReceiverNode(
+        Node(m, 0, ts[m]), {}, fabric=board, heartbeat_interval=0.5)
+        for m in (2, 3, 4)}
+    victim = 4
+    try:
+        standby.announce()
+        for r in recvs.values():
+            r.announce()
+        leader.start_distribution().get(timeout=TIMEOUT)
+        # Kill a pod member mid-run: the pod breaks at the OLD root.
+        recvs[victim].close()
+        ts[victim].close()
+        leader.crash(victim)
+        assert 0 in leader._pods_broken
+        # The break must reach the standby shadow BEFORE the root dies.
+        _wait_for(lambda: 0 in {int(p) for p in
+                                (ctl.shadow.pods.get("Broken") or ())},
+                  what="broken pod to replicate into the shadow")
+        time.sleep(0.3)
+        leader.close()
+        _wait_for(ctl.promoted.is_set, timeout=TIMEOUT,
+                  what="standby promotion")
+        new = ctl.leader
+        # The regression: without the replicated broken set the
+        # promoted leader re-derives pod pairs for the dead pod and
+        # the survivors wedge behind an impossible gather.
+        assert new._pods_broken == {0}
+        with new._lock:
+            assert not new._pod_pairs, new._pod_pairs
+        new.ready().get(timeout=60.0)
+        for m in (2, 3):
+            src = recvs[m].layers[0]
+            assert src.meta.shard == ""
+            assert bytes(src.inmem_data) == layer_bytes(0, layer_size)
+        assert not new._pods_open_locked()
+    finally:
+        ctl.close()
+        close_all(leader, [standby, recvs[2], recvs[3]], ts)
