@@ -279,6 +279,18 @@ class Config:
                         raise ValueError(
                             f"node {m} appears in more than one pod")
                     seen.add(m)
+        if conf.model_codec != "raw":
+            # Entropy forms are WIRE-only: the canonical held form must
+            # boot through the codec jits, and the byte-domain DLE1
+            # coder has no device program (models/entropy.py) — refuse
+            # at parse time, not mid-boot.
+            from ..models.quant import ENTROPY_CODECS
+
+            if conf.model_codec in ENTROPY_CODECS:
+                raise ValueError(
+                    f"ModelCodec {conf.model_codec!r} is a wire-only "
+                    "entropy form; use it as WireCodec over raw "
+                    "canonicals instead")
         if conf.wire_codec != "raw":
             # Fail at PARSE time like an unknown codec: a wire codec
             # re-encodes the CANONICAL blob, so the canonical form must

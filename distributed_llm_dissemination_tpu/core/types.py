@@ -125,6 +125,26 @@ def codec_accepts(held: WireCodec, want: WireCodec) -> bool:
     invariant (docs/codec.md)."""
     return not held or held == want
 
+
+def codec_capability(codec: WireCodec) -> WireCodec:
+    """The CAPABILITY a codec string demands of an encoder.  Most codec
+    ids are their own capability; parameterized forms carry their
+    parameter after a colon — ``"delta:<base_digest_hex>"`` needs a
+    sender with the generic ``"delta"`` capability (announced in
+    ``AnnounceMsg.Codecs``) — so every "can this node encode it?" check
+    compares the prefix, never the full string (docs/codec.md)."""
+    return codec.split(":", 1)[0] if codec else codec
+
+
+def delta_base_digest(codec: WireCodec) -> str:
+    """The base digest a ``"delta:<hex>"`` codec string names, or ``""``
+    for every non-delta codec.  The base rides INSIDE the codec string —
+    one vocabulary through stamps, caches, sizes, and NACK coordinates —
+    so there is no separate base field to skew against the choice."""
+    if codec.startswith("delta:"):
+        return codec.split(":", 1)[1]
+    return ""
+
 # Reference: distributor/node.go:132 — a set of node IDs.
 NodeIDs = Set[NodeID]
 

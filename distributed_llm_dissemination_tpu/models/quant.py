@@ -61,7 +61,14 @@ from .serde import (
     layer_param_specs,
 )
 
-CODECS = ("raw", "int8", "int4")
+CODECS = ("raw", "int8", "int4", "int8e", "int4e")
+# Entropy wire forms (models/entropy.py): the quantized base form run
+# through the DLE1 block coder.  Sizes are DATA-DEPENDENT — the codec
+# plane prices them by actually encoding (``WireCodecPlane.ensure_sized``)
+# instead of from (model, codec) alone — and decode is host-first (the
+# byte-domain coder has no device program; the unpacked base then rides
+# the base codec's normal paths).
+ENTROPY_CODECS = {"int8e": "int8", "int4e": "int4"}
 _SCALE_DT = np.float32
 _QMAX = 127.0
 _QMAX4 = 7.0
@@ -102,9 +109,15 @@ def _q4_leaf_nbytes(layout) -> int:
 
 
 def blob_nbytes_codec(cfg: ModelConfig, blob_id: int, codec: str) -> int:
-    """Exact wire size of a blob under ``codec``."""
+    """Exact wire size of a blob under ``codec``.  Entropy forms raise:
+    their size depends on the bytes, not just (model, codec) — callers
+    price them through the codec plane's true-size cache."""
     if codec == "raw":
         return blob_nbytes(cfg, blob_id)
+    if codec in ENTROPY_CODECS:
+        raise ValueError(
+            f"codec {codec!r} is data-dependent; size it by encoding "
+            "(WireCodecPlane.ensure_sized), not from the model config")
     if codec == "int4":
         itemsize = np.dtype(cfg.dtype).itemsize
         return sum(
@@ -124,6 +137,11 @@ def encode_blob(cfg: ModelConfig, blob_id: int, raw: bytes, codec: str) -> bytes
     """Encode a raw (cfg.dtype) blob into its wire form under ``codec``."""
     if codec == "raw":
         return raw
+    if codec in ENTROPY_CODECS:
+        from . import entropy
+
+        return entropy.encode(
+            encode_blob(cfg, blob_id, raw, ENTROPY_CODECS[codec]))
     if codec == "int4":
         return _encode_blob_q4(cfg, blob_id, raw)
     if codec != "int8":
@@ -154,6 +172,11 @@ def decode_blob_host(
     specs = _blob_specs(cfg, blob_id)
     if codec == "raw":
         return serde._split_blob(cfg, data, specs)
+    if codec in ENTROPY_CODECS:
+        from . import entropy
+
+        return decode_blob_host(cfg, blob_id, entropy.decode(data),
+                                ENTROPY_CODECS[codec])
     if codec == "int4":
         return _decode_blob_q4_host(cfg, blob_id, data)
     if codec != "int8":
@@ -387,7 +410,7 @@ def codec_bench(cfg: Optional[ModelConfig] = None, blob_id: int = 0,
         return round(nbytes * n / max(dt, 1e-9) / 1e9, 3)
 
     out: dict = {"raw_bytes": len(raw)}
-    for codec in ("int8", "int4"):
+    for codec in ("int8", "int4", "int8e", "int4e"):
         enc = encode_blob(cfg, blob_id, raw, codec)
         row = {
             "encoded_bytes": len(enc),
@@ -403,15 +426,52 @@ def codec_bench(cfg: Optional[ModelConfig] = None, blob_id: int = 0,
         if device:
             specs = tuple(layer_param_specs(cfg))
             dt_name = np.dtype(cfg.dtype).name
-            arr = jnp.asarray(np.frombuffer(enc, np.uint8))
-            fn = device_decode_jit(codec)
+            base = ENTROPY_CODECS.get(codec, codec)
+            fn = device_decode_jit(base)
+            if codec in ENTROPY_CODECS:
+                # The honest device row for an entropy form is the boot
+                # path it actually takes: host unwrap THEN the base jit.
+                def dev_decode(e=enc, s=specs, c=codec, f=fn):
+                    _, bb = host_unwrap(c, e)
+                    leaves = f(
+                        (jnp.asarray(np.frombuffer(bb, np.uint8)),),
+                        s, dt_name)
+                    jax.block_until_ready(leaves)
+            else:
+                arr = jnp.asarray(np.frombuffer(enc, np.uint8))
 
-            def dev_decode(a=arr, s=specs, c=codec, f=fn):
-                leaves = f((a,), s, dt_name)
-                jax.block_until_ready(leaves)
+                def dev_decode(a=arr, s=specs, c=codec, f=fn):
+                    leaves = f((a,), s, dt_name)
+                    jax.block_until_ready(leaves)
 
             row["decode_device_gbps"] = rate(dev_decode, len(raw))
         out[codec] = row
+
+    # Content-delta form (models/entropy.py): encode/decode rates over a
+    # small-perturbation v2 of the same blob — the rollout-wave shape the
+    # delta codec exists for.  ~1% of the bytes touched deterministically
+    # (seeded), so the ratio row shows the regime where delta wins; a
+    # high-churn v2 degrades toward 1.0x (docs/codec.md frames when delta
+    # loses).  No device row: deltas reconstruct to RAW on the host
+    # before ack — the device never sees the wire form.
+    from . import entropy
+
+    rng = np.random.default_rng(1)
+    v2 = np.frombuffer(raw, np.uint8).copy()
+    touched = rng.choice(len(v2), size=max(1, len(v2) // 100),
+                         replace=False)
+    v2[touched] ^= rng.integers(1, 256, size=len(touched)).astype(np.uint8)
+    v2b = v2.tobytes()
+    denc = entropy.delta_encode(v2b, raw)
+    out["delta"] = {
+        "encoded_bytes": len(denc),
+        "ratio": round(len(raw) / len(denc), 3),
+        "encode_gbps": rate(
+            lambda: entropy.delta_encode(v2b, raw), len(raw)),
+        "decode_host_gbps": rate(
+            lambda: entropy.delta_decode(denc, raw), len(raw)),
+        "decode_device_gbps": 0.0,
+    }
     return out
 
 
@@ -424,11 +484,30 @@ def device_decode_jit(codec: str, donate: bool = False):
     distinct executables) or a warmup warms the wrong program."""
     if codec == "raw":
         return serde._decode_blobs_donated if donate else serde._decode_blobs
+    if codec in ENTROPY_CODECS:
+        raise ValueError(
+            f"codec {codec!r} has no device decode program — entropy "
+            "forms unwrap on the host first (host_unwrap), then the "
+            "base codec's jit applies")
     if codec == "int4":
         return _decode_q4blobs_donated if donate else _decode_q4blobs
     if codec != "int8":
         raise ValueError(f"unknown codec {codec!r}; known: {CODECS}")
     return _decode_qblobs_donated if donate else _decode_qblobs
+
+
+def host_unwrap(codec: str, data) -> Tuple[str, Any]:
+    """Peel an entropy wire form back to its quantized BASE on the host
+    (the byte-domain coder has no device program).  Returns
+    ``(base_codec, base_bytes)`` — identity for every other codec — so
+    device-path callers can prestage once and keep their jit dispatch
+    unchanged (runtime/boot.py, parallel/collectives.py)."""
+    base = ENTROPY_CODECS.get(codec)
+    if base is None:
+        return codec, data
+    from . import entropy
+
+    return base, entropy.decode(data)
 
 
 # -------------------------------------------------- codec-dispatch facade

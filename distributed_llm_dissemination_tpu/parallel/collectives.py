@@ -320,9 +320,11 @@ def _decode_gathered(wire: bytes, gathered_dev, total: int, codec: str,
     dt_name = np.dtype(cfg.dtype).name
     if not codec:
         codec = "raw"
-    if gathered_dev is not None:
+    if gathered_dev is not None and codec not in quant.ENTROPY_CODECS:
         # The replicated gather output is padded past ``total``; the
         # decode jits take exact-size blobs — one device-local slice.
+        # Entropy forms have no device decode program: they fall to the
+        # host branch (the gather kept the host wire copy).
         blob = jax.lax.slice(gathered_dev, (0,), (total,))
         trace.count("pod.device_dequants")
         return quant.device_decode_jit(codec)((blob,), specs, dt_name)

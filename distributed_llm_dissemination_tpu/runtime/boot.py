@@ -261,14 +261,26 @@ def stage_blob_leaves(cfg, blob_id: int, src, codec: str = "raw",
     specs = tuple(serde.head_param_specs(cfg) if head
                   else serde.layer_param_specs(cfg))
     arr = _device_blob(src)
+    data = None
+    if arr is not None and codec in quant.ENTROPY_CODECS:
+        # Entropy forms have no device decode program (docs/codec.md):
+        # pull the HBM-resident wire blob back to host, unwrap there
+        # (decode_blob_host runs the DLE1 pass before the base decode),
+        # and stage via the host path.  Boot-path cost, measured by
+        # quant.codec_bench and recorded in TTD_MATRIX.
+        data = np.asarray(arr).tobytes()
+        if blob_donate_ok(src):
+            src.device_array = None
+        arr = None
     if arr is not None:
         decode = quant.device_decode_jit(codec, donate=False)
         leaves = decode((arr,), specs, np.dtype(cfg.dtype).name)
         if blob_donate_ok(src):
             src.device_array = None
         return leaves
-    data = (src.inmem_data if src.inmem_data is not None
-            else src.read_bytes())
+    if data is None:
+        data = (src.inmem_data if src.inmem_data is not None
+                else src.read_bytes())
     host = quant.decode_blob_host(cfg, blob_id, data, codec)
     out = {}
     for name, _ in specs:
@@ -288,6 +300,15 @@ def decode_head(cfg, src, codec: str = "raw", donate: bool = False):
     from ..models import quant
 
     dev = _device_blob(src)
+    if dev is not None and codec in quant.ENTROPY_CODECS:
+        # No device decode program for entropy forms: host unwrap,
+        # exactly like stage_blob_leaves (docs/codec.md).
+        import numpy as np
+
+        data = np.asarray(dev).tobytes()
+        if donate:
+            src.device_array = None
+        return quant.head_from_blob_host(cfg, data, codec)
     if dev is not None:
         out = quant.head_from_device(cfg, dev, codec, donate=donate)
         if donate:

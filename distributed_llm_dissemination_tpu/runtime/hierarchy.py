@@ -625,7 +625,11 @@ class SubLeaderController:
         want_codec = meta.codec or ""
         if want_codec and not layer.meta.codec:
             plane = getattr(self.receiver, "codec_plane", None)
-            wire_total = plane.nbytes(lid, want_codec) if plane else None
+            # Data-dependent forms (entropy, delta) size by their one
+            # cached encode — the same blob the stripe sends then serve
+            # ranges of (docs/codec.md).
+            wire_total = (plane.ensure_sized(lid, layer, want_codec)
+                          if plane else None)
             if wire_total is None:
                 return False
         else:

@@ -42,10 +42,13 @@ from ..core.types import (
     LayerLocation,
     LayerMeta,
     LayersSrc,
+    LayerSrc,
     NodeID,
     Status,
     codec_accepts,
+    codec_capability,
     delivered,
+    delta_base_digest,
     parse_shard_spec,
     layer_ids_to_json,
     satisfies,
@@ -258,6 +261,16 @@ class LeaderNode:
                 codecs.decode_codecs())
         self._codec_choice: Dict[Tuple[NodeID, LayerID], str] = {}
         self._codec_digest_cache: Dict[Tuple[LayerID, str], str] = {}
+        # Content-delta plane (docs/codec.md): the per-layer pinned base
+        # digest ("" = delta provably not worth it for this layer) and
+        # digest → leader-local verified canonical bytes — what the
+        # plane's delta encoder reads the base from.  The resolver is a
+        # PLAIN dict .get: it runs inside the plane's encode path while
+        # self._lock may be held, so it must never take the leader lock.
+        self._delta_base: Dict[LayerID, str] = {}
+        self._delta_base_src: Dict[str, LayerSrc] = {}
+        if codecs is not None:
+            codecs.base_resolver = self._delta_base_src.get
         # Sticky once ANY pair was ever chosen quantized: digests-off
         # stamps then carry explicit ""-codec entries so a REVERTED
         # pair still reconciles at the dest (mirrors _sharding_seen).
@@ -820,6 +833,23 @@ class LeaderNode:
                 self._codec_choice[key] = c
                 if c:
                     self._codec_seen = True
+                base = delta_base_digest(c)
+                if base:
+                    # Adopted delta pairs: re-pin the base per layer and
+                    # re-point the plane's resolver at THIS seat's copy
+                    # of the base bytes (reverse scan of its own digest
+                    # table).  A seat that holds no such copy keeps the
+                    # choice but can't encode — the digest-stamp path
+                    # reverts those pairs to raw, loudly.
+                    self._delta_base[key[1]] = base
+                    if base not in self._delta_base_src:
+                        for lid2, dg2 in self.layer_digests.items():
+                            lay2 = self.layers.get(lid2)
+                            if (dg2 == base and lay2 is not None
+                                    and lay2.meta.location
+                                    != LayerLocation.CLIENT):
+                                self._delta_base_src[base] = lay2
+                                break
             for n, caps in (shadow.get("node_codecs") or {}).items():
                 if n != dead_leader:
                     self.node_codecs.setdefault(n, frozenset(caps))
@@ -1140,17 +1170,34 @@ class LeaderNode:
         has a codec layout, the pair is unsharded/unversioned (honest
         limits: range digests hash raw ranges, and swap staging is
         untested against re-encoded forms), and the pair's modeled
-        bottleneck is at or below the threshold — fast links ship raw."""
+        bottleneck is at or below the threshold — fast links ship raw.
+
+        Content-delta (docs/codec.md) is tried FIRST: when the dest
+        provably holds a verified base and the encoded (v2 − base) is
+        small, the pair ships ``delta:<base>`` — an order-of-magnitude
+        byte win whole-form quantization can't reach — and version-
+        qualified rollout pairs are eligible (the wave's whole point)."""
         plane = self.codecs
-        if (plane is None or not plane.enabled
-                or not self.WIRE_CODEC_OK):
+        if plane is None or not self.WIRE_CODEC_OK:
+            return ""
+        target = self.layer_digests.get(lid)
+        if (target and self.jobs.owner_of(dest, lid) is not None
+                and self.content.node_has(dest, target)):
+            # The dest already holds content-equal bytes and the job
+            # plane's resolve path exists for this pair: the content
+            # store acks it for ZERO wire bytes (_content_skip_locked,
+            # which refuses codec-stamped targets) — any encoded form,
+            # even a near-empty delta, would ship bytes a skip doesn't.
+            return ""
+        delta = self._decide_delta_locked(dest, lid, meta)
+        if delta:
+            return delta
+        if not plane.enabled:
             return ""
         if meta.shard or meta.version:
             return ""
         c = plane.wire_codec
         if c not in self.node_codecs.get(dest, ()):
-            return ""
-        if plane.nbytes(lid, c) is None:
             return ""
         own = self.layers.get(lid)
         if own is not None and own.meta.location == LayerLocation.CLIENT:
@@ -1158,10 +1205,132 @@ class LeaderNode:
             # pipe-fetch RAW bytes under an encoded stamp (the client
             # stream can't encode) — keep the pair canonical.
             return ""
+        if plane.nbytes(lid, c) is None:
+            # Entropy forms are data-dependent: size them by actually
+            # encoding the leader's own copy once (cached).  A pair
+            # nobody here can size must not ship the form.
+            if own is None or plane.ensure_sized(lid, own, c) is None:
+                return ""
         rate = self._pair_rate_locked(dest, lid, meta)
-        if rate <= 0 or rate > plane.min_rate:
+        if rate <= 0 or rate > plane.min_rate_for(c):
             return ""
         return c
+
+    def _decide_delta_locked(self, dest: NodeID, lid: LayerID,
+                             meta) -> str:
+        """Lock held.  The content-delta choice for this pair ("" = no
+        delta): requires the integrity plane (reconstruction verifies
+        against the stamped full-form digest — without it a stale base
+        would poison the layer silently), a dest that announced the
+        generic "delta" capability and PROVABLY holds the base
+        (ContentIndex), a leader-readable raw canonical copy, a link
+        slow enough that the encode pays, and an encoded delta that
+        actually survived the worth-it gate (docs/codec.md)."""
+        plane = self.codecs
+        if plane is None or not plane.delta_enabled:
+            return ""
+        if not integrity.digests_enabled():
+            return ""
+        if meta.shard:
+            # Honest limit: a pre-sharded target acks (and holds) its
+            # range only — it can never reconstruct the full layer from
+            # a slice of the delta stream.  Multi-source striping of a
+            # FULL delta pair still shards fine (ranges of one blob).
+            return ""
+        if "delta" not in self.node_codecs.get(dest, ()):
+            return ""
+        if getattr(self, "_pod_of", {}).get(dest) is not None:
+            # Honest limit: pod gathers assume a pod-uniform byte space;
+            # per-dest bases would de-uniform the gather (docs/fabric.md).
+            return ""
+        own = self.layers.get(lid)
+        if (own is None
+                or own.meta.location == LayerLocation.CLIENT
+                or own.meta.shard or getattr(own.meta, "codec", "")):
+            return ""
+        target = self.layer_digests.get(lid)
+        if not target:
+            return ""
+        rate = self._pair_rate_locked(dest, lid, meta)
+        if rate <= 0 or rate > plane.delta_min_rate:
+            return ""
+        base = self._delta_base.get(lid)
+        if base is None:
+            base = self._pick_delta_base_locked(lid, own)
+            self._delta_base[lid] = base
+        if not base or base == target:
+            return ""
+        if not self.content.node_has(dest, base):
+            return ""
+        codec = "delta:" + base
+        if plane.ensure_sized(lid, own, codec) is None:
+            return ""
+        trace.count("codec.delta_pairs_chosen")
+        return codec
+
+    def _pick_delta_base_locked(self, lid: LayerID, own) -> str:
+        """Lock held; runs once per layer (memoized by the caller, ""
+        pins "no base").  Candidate bases are the leader's OWN verified
+        raw full layers of the same byte length (the only bytes it can
+        encode against); they're ranked by strided-sample XOR sparsity
+        — cheap, no full encode per candidate — and only the winner is
+        fully encoded.  A delta that fails to at least halve the raw
+        bytes pins "": a rollout whose v2 actually changed everything
+        must ship whole forms, not a delta dressed up as one."""
+        plane = self.codecs
+        try:
+            raw = own.read_range()
+        except (OSError, ValueError) as e:
+            log.warn("delta base pick: target layer unreadable",
+                     layerID=lid, err=repr(e))
+            return ""
+        target = self.layer_digests.get(lid, "")
+        candidates: Dict[str, LayerSrc] = {}
+        for other_lid, digest in self.layer_digests.items():
+            if other_lid == lid or not digest or digest == target:
+                continue
+            if digest in candidates:
+                continue
+            layer = self.layers.get(other_lid)
+            if (layer is None
+                    or layer.meta.location == LayerLocation.CLIENT
+                    or layer.meta.shard
+                    or getattr(layer.meta, "codec", "")
+                    or layer.data_size != len(raw)):
+                continue
+            candidates[digest] = layer
+        if not candidates:
+            return ""
+        import numpy as np
+
+        tgt = np.frombuffer(raw, dtype=np.uint8)[::257]
+        scored: List[Tuple[float, str]] = []
+        for digest, layer in candidates.items():
+            try:
+                cand = layer.read_range()
+            except (OSError, ValueError):
+                continue
+            s = np.frombuffer(cand, dtype=np.uint8)[::257]
+            frac = float(np.count_nonzero(tgt != s)) / max(1, tgt.size)
+            scored.append((frac, digest))
+        if not scored:
+            return ""
+        frac, base = min(scored)
+        base_layer = candidates[base]
+        # The resolver map must carry the base BEFORE the sizing encode
+        # (the plane resolves it mid-encode, lock-free).
+        self._delta_base_src[base] = base_layer
+        sized = plane.ensure_sized(lid, own, "delta:" + base)
+        if sized is None or sized * 2 >= len(raw):
+            log.info("delta base rejected (encoded form not worth it)",
+                     layerID=lid, base=base,
+                     encoded=sized, raw_bytes=len(raw),
+                     sampled_diff_frac=round(frac, 4))
+            return ""
+        log.info("delta base pinned for layer", layerID=lid, base=base,
+                 encoded=sized, raw_bytes=len(raw),
+                 sampled_diff_frac=round(frac, 4))
+        return base
 
     def _stamp_codecs(self) -> None:
         """Choose (memoized) and stamp the wire codec onto every
@@ -1326,6 +1495,7 @@ class LeaderNode:
                 # as the shards map above.
                 for lid in self._digest_row_locked(dest):
                     codec_map.setdefault(lid, "")
+        full_digests: Dict[LayerID, str] = {}
         if integrity.digests_enabled():
             # For codec pairs the stamped digest is CODEC-QUALIFIED:
             # the hash of exactly the encoded bytes — the CANONICAL
@@ -1338,12 +1508,26 @@ class LeaderNode:
             # codec and stamps NO digest — the transfer verifies by
             # per-fragment CRC alone (docs/codec.md, honest limits;
             # the seeders' deterministic encode keeps multi-sender
-            # ranges byte-identical).
+            # ranges byte-identical).  Delta pairs additionally stamp
+            # the CANONICAL digest under FullDigests: the wire stream
+            # verifies under its own identity, the RECONSTRUCTED bytes
+            # under the canonical one — both gates must pass before ack.
             bad = []
             for lid, c in sorted(codec_map.items()):
                 d = self._codec_digest(lid, c)
                 if d is not None:
                     digests[lid] = d
+                    if delta_base_digest(c):
+                        with self._lock:
+                            full = self.layer_digests.get(lid)
+                        if full:
+                            full_digests[lid] = full
+                        else:
+                            # No canonical identity for the reconstructed
+                            # form: the delta cannot gate — revert.
+                            self._revert_codec_choice(dest, lid)
+                            bad.append(lid)
+                            digests.pop(lid, None)
                     continue
                 with self._lock:
                     readable = (
@@ -1403,7 +1587,8 @@ class LeaderNode:
                     shards=shards,
                     range_digests=self._range_digests_for(shards,
                                                           codec_map),
-                    versions=versions, codecs=codec_map, pods=pods))
+                    versions=versions, codecs=codec_map, pods=pods,
+                    full_digests=full_digests))
         except (OSError, KeyError) as e:
             log.warn("digest stamp send failed", dest=dest, err=repr(e))
 
@@ -3168,11 +3353,20 @@ class LeaderNode:
         for n in candidates:
             if n == node or n not in placeable:
                 continue
-            if codec and codec not in self.node_codecs.get(n, ()):
+            if codec and codec_capability(codec) not in \
+                    self.node_codecs.get(n, ()):
                 # A codec-qualified re-home pins the wire codec onto
                 # the dest (_drain_rehome), bypassing the negotiation's
                 # advertised-decode check — so enforce it here: never
                 # ship encoded bytes to a seat that can't decode them.
+                continue
+            base_d = delta_base_digest(codec)
+            if base_d and not (
+                    self.content.node_has(n, base_d)
+                    or (n == self.node.my_id
+                        and base_d in self._delta_base_src)):
+                # A delta re-home additionally needs the BASE bytes at
+                # the new seat — capability alone can't reconstruct.
                 continue
             meta = self.status.get(n, {}).get(lid)
             if (meta is not None and delivered(meta)
@@ -4096,11 +4290,19 @@ class RetransmitLeaderNode(LeaderNode):
                 if want.codec:
                     # A codec pair's owner must be able to ENCODE the
                     # forward (the pool holds raw full holders only;
-                    # docs/codec.md).
+                    # docs/codec.md) — and a delta pair's owner must
+                    # ALSO hold the base it encodes against.
                     with self._lock:
                         owners = {o for o in owners
-                                  if want.codec
+                                  if codec_capability(want.codec)
                                   in self.node_codecs.get(o, ())}
+                        base_d = delta_base_digest(want.codec)
+                        if base_d:
+                            owners = {
+                                o for o in owners
+                                if self.content.node_has(o, base_d)
+                                or (o == self.node.my_id
+                                    and base_d in self._delta_base_src)}
                 if owners:
                     # Deterministic owner pick (reference picks randomly via
                     # map iteration, node.go:583-588).
@@ -5099,11 +5301,17 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             # "effective capacity = bandwidth x ratio" formulation —
             # and each node's encode capability for arc admissibility.
             codec_sizes: Dict[Tuple[LayerID, str], int] = {}
+            base_holders: Dict[str, frozenset] = {}
             if self.codecs is not None:
                 for dest_l, lids_l in plan_asg.items():
                     for lid_l, meta_l in lids_l.items():
                         if meta_l.codec:
-                            n = self.codecs.nbytes(lid_l, meta_l.codec)
+                            # Data-dependent forms (entropy, delta) size
+                            # by their one cached encode; model-derivable
+                            # forms straight from the layout.
+                            n = self.codecs.ensure_sized(
+                                lid_l, self.layers.get(lid_l),
+                                meta_l.codec)
                             if n is not None:
                                 codec_sizes[(lid_l, meta_l.codec)] = n
                         if lid_l not in layer_sizes:
@@ -5113,6 +5321,19 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                             n = self.codecs.decoded_nbytes(lid_l)
                             if n:
                                 layer_sizes[lid_l] = n
+                # Delta arc admissibility (sched/flow._arc_ok): a
+                # delta:<base> pair may only route through senders that
+                # PROVABLY hold the base — the ContentIndex holders plus
+                # this leader when it pinned the base from its own store.
+                bases = {delta_base_digest(m.codec)
+                         for lids_l in plan_asg.values()
+                         for m in lids_l.values() if m.codec}
+                bases.discard("")
+                for b in bases:
+                    hs = {n for n, _ in self.content.holders(b)}
+                    if b in self._delta_base_src:
+                        hs.add(self.node.my_id)
+                    base_holders[b] = frozenset(hs)
             node_codecs = {n: frozenset(s)
                            for n, s in self.node_codecs.items()}
             for dest, layer_ids in plan_asg.items():
@@ -5196,6 +5417,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     self.node_network_bw,
                     remaining=remaining_sizes, topology=self.topology,
                     codec_sizes=codec_sizes, node_codecs=node_codecs,
+                    base_holders=base_holders,
                 )
                 t, jobs = graph.get_job_assignment()
             else:
@@ -5208,7 +5430,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     self.node_network_bw, remaining=remaining_sizes,
                     topology=self.topology,
                     graph_factory=make_flow_graph,
-                    codec_sizes=codec_sizes, node_codecs=node_codecs)
+                    codec_sizes=codec_sizes, node_codecs=node_codecs,
+                    base_holders=base_holders)
                 t = max(t_by_prio.values(), default=0)
                 # Per-job pacing: each send's rate budget comes from its
                 # OWN tier's min time (a preempting tier must not be
@@ -5588,7 +5811,14 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     need_codec=want.codec if want is not None else "",
                     encoders=frozenset(
                         n for n, s in self.node_codecs.items()
-                        if want is not None and want.codec in s))
+                        if want is not None
+                        and codec_capability(want.codec) in s
+                        and (not delta_base_digest(want.codec)
+                             or self.content.node_has(
+                                 n, delta_base_digest(want.codec))
+                             or (n == self.node.my_id
+                                 and delta_base_digest(want.codec)
+                                 in self._delta_base_src))))
                 if alt is None:
                     continue  # no surviving holder: base re-plan covers it
                 self._salvaging.add((lid, dest))
@@ -5796,9 +6026,15 @@ class HierarchicalFlowLeaderNode(FlowRetransmitLeaderNode):
         if self._form(own) == form:
             return ("own", None, form)
         if (own_plain and not form[0] and not form[2]
-                and form[1] in self.node_codecs.get(sub, ())):
+                and codec_capability(form[1])
+                in self.node_codecs.get(sub, ())
+                and (not delta_base_digest(form[1])
+                     or self.content.node_has(
+                         sub, delta_base_digest(form[1])))):
             # Raw own ingress; the sub-leader encode-serves the
-            # group's codec form from it (docs/codec.md).
+            # group's codec form from it (docs/codec.md) — a delta
+            # form only when the sub PROVABLY holds the base bytes
+            # the re-encode reads.
             return ("own", None, form)
         return None
 

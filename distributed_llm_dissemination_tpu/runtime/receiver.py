@@ -34,6 +34,7 @@ from ..core.types import (
     LayerMeta,
     LayerSrc,
     LayersSrc,
+    delta_base_digest,
     shard_covers,
     shard_range,
 )
@@ -279,6 +280,12 @@ class ReceiverNode:
         self.codec_plane = codecs
         self._layer_codecs: Dict[int, str] = {}
         self._frag_codec: Dict[int, str] = {}
+        # Content-delta stamps (docs/codec.md): per assigned layer, the
+        # canonical digest of the RECONSTRUCTED form of a delta
+        # transfer (LayerDigestsMsg.full_digests) — the second gate a
+        # delta pair passes (the first verifies the wire stream under
+        # its codec-qualified identity).
+        self._full_digests: Dict[int, str] = {}
         # The rollout version the serving params were assembled under
         # ("" until a swap commits here), and the per-blob version map
         # of the CURRENT serving tree — the per-step uniformity guard
@@ -311,6 +318,14 @@ class ReceiverNode:
         # rollout's unchanged layer), the store aliases the bytes and
         # acks instantly -- zero wire bytes.
         self.content_store = ContentStore()
+        # Delta base lookup (docs/codec.md): the plane resolves a base
+        # digest to this node's verified canonical holding — both for
+        # RECONSTRUCTING a delivered delta and for ENCODING one when
+        # this node is picked as a delta sender.  Only wired when no
+        # role claimed the plane yet (a leader wires its own map).
+        if (self.codec_plane is not None
+                and self.codec_plane.base_resolver is None):
+            self.codec_plane.base_resolver = self._resolve_delta_base
         # Per-layer streaming boot staging (runtime/stream_boot.py):
         # each completed blob's decode + host→device placement runs the
         # moment its interval set completes, concurrent with the
@@ -912,6 +927,16 @@ class ReceiverNode:
                     self._digest_retries.pop(lid, None)
                     self._digest_ok.discard(lid)
             self.layer_digests.update(msg.digests)
+            # Content-delta stamps (docs/codec.md): the canonical
+            # identity a delta pair's RECONSTRUCTED bytes verify
+            # against.  A changed stamp resets the verification state
+            # exactly like a changed stream digest above.
+            for lid, d in msg.full_digests.items():
+                prior = self._full_digests.get(lid)
+                if prior is not None and prior != d:
+                    self._digest_retries.pop(lid, None)
+                    self._digest_ok.discard(lid)
+            self._full_digests.update(msg.full_digests)
             # Rollout version stamps (docs/swap.md): which version each
             # assigned layer belongs to — stored holdings and acks
             # carry the tag from here on.
@@ -1074,8 +1099,14 @@ class ReceiverNode:
                 # is the ENCODED form's — it can't verify canonical
                 # bytes, and raw satisfies the target anyway
                 # (docs/codec.md).  Mismatched encoded forms were
-                # demoted at stamp time.
-                continue
+                # demoted at stamp time.  EXCEPT a raw holding under a
+                # DELTA stamp with a FullDigests entry: that's a
+                # reconstructed (or pre-held) canonical form, and the
+                # full digest verifies it (_verify_layer_digest).
+                if not (not src.meta.codec
+                        and delta_base_digest(stamped_codec)
+                        and self._full_digests.get(lid)):
+                    continue
             if self._verify_layer_digest(lid, memoryview(src.inmem_data),
                                          codec=src.meta.codec):
                 continue
@@ -1284,6 +1315,15 @@ class ReceiverNode:
         index carries the codec so encoded bytes never vouch for a raw
         pair (docs/codec.md)."""
         expected = self._expected_digest(lid)
+        if not codec and not shard:
+            with self._lock:
+                stamped_c = self._layer_codecs.get(lid, "")
+                if delta_base_digest(stamped_c):
+                    # RAW bytes under a delta stamp: a reconstructed
+                    # form's identity is the canonical FullDigests
+                    # entry, never the delta stream's codec-qualified
+                    # digest (docs/codec.md).
+                    expected = self._full_digests.get(lid)
         if expected is None:
             return True
         with self._lock:
@@ -1315,6 +1355,110 @@ class ReceiverNode:
         log.error("layer digest MISMATCH", layerID=lid, expected=expected,
                   got=got, bytes=len(data))
         return False
+
+    # ------------------------------------------------ content-delta plane
+
+    def _resolve_delta_base(self, digest):
+        """digest → this node's verified canonical holding (the codec
+        plane's ``base_resolver``): a content-store hit with
+        delivered-grade host bytes, full-layer raw form only — the same
+        donor rules as the content resolve.  Runs lock-free relative to
+        the plane (only this node's own lock, never held by plane
+        callers)."""
+        lid = self.content_store.lookup(digest)
+        if lid is None:
+            return None
+        with self._lock:
+            src = self.layers.get(lid)
+            if (src is None or src.inmem_data is None
+                    or src.meta.shard or src.meta.codec):
+                return None
+            return src
+
+    def _delta_reconstruct_bytes(self, lid, data, codec):
+        """Canonical bytes from a VERIFIED delta stream, gated against
+        the stamped FullDigests identity.  None = refused (no plane, no
+        stamp, base lost here, or the reconstruction mismatched) — the
+        caller demotes/drops for a raw re-plan; corrupt state never
+        acks (docs/codec.md)."""
+        plane = self.codec_plane
+        if plane is None:
+            log.error("delta transfer without a codec plane; refused",
+                      layerID=lid)
+            return None
+        full = self._full_digests.get(lid, "")
+        if not full:
+            # The leader only chooses delta with the integrity plane on
+            # and always stamps the canonical identity alongside —
+            # reconstructing unverifiable bytes would trade corruption
+            # for byte savings.
+            log.error("delta transfer without a FullDigests stamp; "
+                      "refusing reconstruction", layerID=lid)
+            return None
+        raw = plane.delta_reconstruct(lid, data, codec)
+        if raw is None:
+            return None
+        ok, dt, got = integrity.digest_check(memoryview(raw), full)
+        trace.add_phase("integrity_digest", dt)
+        if ok is False:
+            trace.count("integrity.digest_mismatch")
+            log.error("delta reconstruction failed the canonical "
+                      "digest", layerID=lid, expected=full, got=got)
+            return None
+        return raw
+
+    def _note_delta_reconstructed(self, lid, wire_bytes: int,
+                                  raw_bytes: int) -> None:
+        """Bookkeeping after a reconstructed delta holding COMMITTED:
+        the canonical digest seeds the announce cache and the content
+        store (this node now vouches for — and can base future deltas
+        on — the reconstructed bytes), and the wire/raw byte split is
+        counted so the run report shows the delta win explicitly."""
+        full = self._full_digests.get(lid, "")
+        if full:
+            with self._lock:
+                self._own_digests[lid] = full
+                self._digest_ok.add(lid)
+            self.content_store.index(lid, full)
+        trace.count("codec.delta_wire_bytes", wire_bytes)
+        trace.count("codec.delta_raw_bytes", raw_bytes)
+        log.info("delta stream reconstructed to canonical form",
+                 layerID=lid, wire_bytes=wire_bytes,
+                 raw_bytes=raw_bytes)
+
+    def _finalize_delta(self, lid, src):
+        """A completed, stream-verified delta holding reconstructs to
+        canonical bytes NOW — the store must never stage (or ack) the
+        delta stream itself.  Returns the replaced holding (or ``src``
+        unchanged for non-delta forms); None when reconstruction
+        refused — the layer demoted for a re-plan."""
+        codec = src.meta.codec
+        if not delta_base_digest(codec) or src.meta.shard:
+            return src
+        if src.inmem_data is None:
+            log.error("delta holding without host bytes; demoted",
+                      layerID=lid)
+            raw = None
+        else:
+            raw = self._delta_reconstruct_bytes(
+                lid, memoryview(src.inmem_data), codec)
+        if raw is None:
+            self._demote_corrupt_layer(lid)
+            if self._bump_digest_retry(lid):
+                self._request_replan()
+            return None
+        wire = src.data_size
+        with self._lock:
+            new_src = LayerSrc(
+                inmem_data=bytearray(raw), data_size=len(raw),
+                meta=LayerMeta(location=LayerLocation.INMEM,
+                               source_type=src.meta.source_type,
+                               version=src.meta.version),
+            )
+            new_src.offset = 0
+            self.layers[lid] = new_src
+        self._note_delta_reconstructed(lid, wire, len(raw))
+        return new_src
 
     def _announce_partial(self) -> dict:
         """Checkpointed in-progress coverage to include in the announce;
@@ -1707,6 +1851,31 @@ class ReceiverNode:
                                         msg.total_size, msg.total_size,
                                         "digest")
                     return
+            delta_wire = 0
+            if delta_base_digest(codec):
+                # Content-delta frame (docs/codec.md): the verified
+                # stream reconstructs to canonical bytes BEFORE the
+                # store — the delta form itself must never be held,
+                # staged, or acked.
+                data = (memoryview(fresh.inmem_data)
+                        if fresh.inmem_data is not None
+                        else memoryview(fresh.read_bytes()))
+                raw = self._delta_reconstruct_bytes(msg.layer_id, data,
+                                                    codec)
+                if raw is None:
+                    if self._bump_digest_retry(msg.layer_id):
+                        self._send_nack(msg.src_id, msg.layer_id, 0,
+                                        msg.total_size, msg.total_size,
+                                        "digest")
+                    return
+                delta_wire = fresh.data_size
+                self._count_codec_delivery(msg.layer_id, delta_wire,
+                                           codec)
+                fresh = LayerSrc(
+                    inmem_data=bytearray(raw), data_size=len(raw),
+                    meta=LayerMeta(location=LayerLocation.INMEM))
+                fresh.offset = 0
+                codec = ""
             with self._lock:
                 src = self.layers.get(msg.layer_id)
                 if src is None:
@@ -1723,7 +1892,11 @@ class ReceiverNode:
                 # goal state in the run report.
                 telemetry.link_add(msg.src_id, self.node.my_id,
                                    job=msg.job_id,
-                                   delivered_bytes=src.data_size)
+                                   delivered_bytes=(delta_wire
+                                                    or src.data_size))
+                if delta_wire:
+                    self._note_delta_reconstructed(
+                        msg.layer_id, delta_wire, src.data_size)
                 if codec:
                     self._count_codec_delivery(msg.layer_id,
                                                src.data_size, codec)
@@ -3841,6 +4014,11 @@ class FlowRetransmitReceiverNode(RetransmitReceiverNode):
         if src is None:
             return
         if not self._digest_gate(lid, src):
+            return
+        # Content-delta (docs/codec.md): a stream-verified delta
+        # holding reconstructs to canonical bytes before staging/ack.
+        src = self._finalize_delta(lid, src)
+        if src is None:
             return
         # Pair-lifecycle span (docs/observability.md): the integrity
         # gate passed — wire_complete→verified is the digest cost (zero

@@ -174,6 +174,17 @@ class ContentIndex:
         with self._lock:
             return key in (self._node_layers.get(node) or {}).values()
 
+    def node_digests(self, node: NodeID) -> Dict[LayerID, str]:
+        """``node``'s FULL-layer canonical holdings: {layer: digest}
+        (shard- and codec-vouched entries excluded) — the delta base
+        CANDIDATE set: any of these digests names bytes the dest can
+        provably reconstruct against (docs/codec.md)."""
+        with self._lock:
+            return {lid: k[0]
+                    for lid, k in (self._node_layers.get(node)
+                                   or {}).items()
+                    if not k[1] and not k[2]}
+
     def holders(self, digest: str, shard: str = "",
                 codec: str = "") -> List[Tuple[NodeID, LayerID]]:
         """Every (node, layer) currently vouched for (digest, shard,

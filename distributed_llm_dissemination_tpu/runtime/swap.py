@@ -227,8 +227,14 @@ class SwapController:
         r = self.r
         for lid in self._expected_ids(swap_base):
             with r._lock:
-                held = lid in r.layers
-            if not held:
+                src = r.layers.get(lid)
+            if src is None:
+                return False
+            if src.meta.shard or src.meta.codec:
+                # A shard slice or a still-ENCODED holding (codec form,
+                # or a delta stream awaiting reconstruction) must never
+                # enter the serving tree — the full canonical bytes
+                # have to land first (docs/swap.md, docs/codec.md).
                 return False
             if (r._expected_digest(lid) is not None
                     and lid not in r._digest_ok):

@@ -64,6 +64,7 @@ from ..core.types import (
     shard_covers,
     shard_range,
 )
+from ..utils import trace
 from ..utils.logging import log
 
 _INF = 1 << 62
@@ -142,6 +143,16 @@ def pod_shard_demands(
     slices must all index ONE wire byte space, or the gather would
     splice mismatched encodings.
 
+    VERSION-qualified pairs (swap/rollout waves, docs/rollout.md) ride
+    the transform like any other full target when the pod's wanting
+    members all carry the SAME version for the layer — the slices then
+    reconstruct one version's bytes, and the shard×codec digest
+    machinery (encoded range stamps) applies unchanged.  A pod whose
+    members want DIFFERENT versions of one layer id is refused loudly
+    (``pod.mixed_version_layers`` counter): its slices would splice two
+    checkpoints into one gathered blob, so those members keep whole-
+    layer targets instead.  Pre-SHARDED pairs still never re-slice.
+
     ``prior``: the pod pairs of an earlier transform this re-plan must
     keep VERBATIM (mid-flight partials live in those specs' byte
     ranges; membership churn degrades pairs explicitly, never by a
@@ -159,15 +170,27 @@ def pod_shard_demands(
                 continue  # already transformed; specs must stay stable
             wanting = []
             codecs = set()
+            versions = set()
             for m in members:
                 meta = (assignment.get(m) or {}).get(lid)
                 if meta is None:
                     continue
-                if meta.shard or getattr(meta, "version", ""):
+                if meta.shard:
                     wanting = []
-                    break  # qualified pair: the pod must not re-slice it
+                    break  # pre-sharded pair: the pod must not re-slice
                 codecs.add(getattr(meta, "codec", ""))
+                versions.add(getattr(meta, "version", ""))
                 wanting.append(m)
+            if len(versions) > 1:
+                # Mixed versions of one layer id inside one pod: the
+                # R slices would splice two checkpoints into one
+                # gathered blob.  Refuse the transform — loudly — and
+                # leave these members on whole-layer targets.
+                trace.count("pod.mixed_version_layers")
+                log.warn("pod layer not shard-planned: members want "
+                         "mixed versions", pod=pid, layerID=lid,
+                         versions=sorted(versions))
+                continue
             if len(wanting) < 2 or len(codecs) > 1:
                 continue  # nothing to amortize, or mixed byte spaces
             n = len(wanting)
@@ -367,6 +390,7 @@ def solve_joint(
     graph_factory=None,
     codec_sizes: Optional[Dict[Tuple[LayerID, str], int]] = None,
     node_codecs: Optional[Dict[NodeID, frozenset]] = None,
+    base_holders: Optional[Dict[str, frozenset]] = None,
 ) -> Tuple[Dict[int, int], FlowJobsMap]:
     """All active jobs' remaining demands as ONE flow problem per
     priority tier (docs/service.md) — the multi-job generalization of a
@@ -487,7 +511,8 @@ def solve_joint(
                            if n not in set(avoid)}
         graph = factory(merged, status_view, layer_sizes, bw_res,
                         remaining=rem, topology=topology,
-                        codec_sizes=codec_sizes, node_codecs=node_codecs)
+                        codec_sizes=codec_sizes, node_codecs=node_codecs,
+                        base_holders=base_holders)
         t, jobs = graph.get_job_assignment()
         planned = sum(j.data_size for jl in jobs.values() for j in jl)
         if avoid and planned < required:
@@ -501,7 +526,8 @@ def solve_joint(
             graph = factory(merged, status, layer_sizes, bw_res,
                             remaining=rem, topology=topology,
                             codec_sizes=codec_sizes,
-                            node_codecs=node_codecs)
+                            node_codecs=node_codecs,
+                            base_holders=base_holders)
             t, jobs = graph.get_job_assignment()
         t_by_prio[prio] = max(t_by_prio.get(prio, 0), t)
         per_dest: Dict[NodeID, int] = {}
@@ -685,6 +711,7 @@ class FlowGraph:
         topology: Optional[PodTopology] = None,
         codec_sizes: Optional[Dict[Tuple[LayerID, str], int]] = None,
         node_codecs: Optional[Dict[NodeID, frozenset]] = None,
+        base_holders: Optional[Dict[str, frozenset]] = None,
     ):
         """``remaining``: optional per-(layer, dest) byte overrides — a
         resumed dest needs only its gap bytes, not the full layer.
@@ -704,7 +731,14 @@ class FlowGraph:
         only ever planned from a same-codec holder (encoded bytes serve
         verbatim) or a raw holder that can encode — and a quantized
         HOLDER is never planned as a source for a raw (or
-        other-codec) pair."""
+        other-codec) pair.
+
+        ``base_holders`` (content-delta pairs, docs/codec.md): base
+        digest hex → the senders PROVABLY holding verified canonical
+        bytes with that digest.  A ``"delta:<hex>"`` pair is only
+        admissible from a sender that holds BOTH the base and the delta
+        capability — a sender with the capability but not the base
+        would have nothing to encode against."""
         self.assignment = assignment
         self.layer_sizes = layer_sizes
         self.node_network_bw = node_network_bw
@@ -712,6 +746,7 @@ class FlowGraph:
         self.topology = topology
         self.codec_sizes = codec_sizes or {}
         self.node_codecs = node_codecs or {}
+        self.base_holders = base_holders or {}
         self._slice: Dict[NodeID, int] = (
             topology.slices() if topology is not None else {}
         )
@@ -852,11 +887,21 @@ class FlowGraph:
         if held:
             return held == want
         if want:
-            from ..core.types import LayerLocation
+            from ..core.types import (
+                LayerLocation,
+                codec_capability,
+                delta_base_digest,
+            )
 
             if meta.location == LayerLocation.CLIENT:
                 return False
-            return want in self.node_codecs.get(sender, ())
+            if codec_capability(want) not in self.node_codecs.get(
+                    sender, ()):
+                return False
+            base = delta_base_digest(want)
+            if base and sender not in self.base_holders.get(base, ()):
+                return False  # delta needs the base held, verified, HERE
+            return True
         return True
 
     def seed_pair_offsets(self) -> Dict[Tuple[LayerID, NodeID], int]:
@@ -1172,7 +1217,8 @@ class FlowGraph:
         flat = type(self)(self.assignment, self.status, self.layer_sizes,
                           self.node_network_bw, remaining=self.remaining,
                           codec_sizes=self.codec_sizes,
-                          node_codecs=self.node_codecs)
+                          node_codecs=self.node_codecs,
+                          base_holders=self.base_holders)
         return flat.get_job_assignment()
 
     @staticmethod

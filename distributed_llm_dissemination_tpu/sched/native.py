@@ -218,6 +218,7 @@ def make_flow_graph(
     topology=None,
     codec_sizes=None,
     node_codecs=None,
+    base_holders=None,
 ) -> FlowGraph:
     """The fastest available mode-3 scheduler for this environment.
 
@@ -232,4 +233,5 @@ def make_flow_graph(
     cls = FlowGraph if load_flow_solver() is None else NativeFlowGraph
     return cls(assignment, status, layer_sizes, node_network_bw,
                remaining=remaining, topology=topology,
-               codec_sizes=codec_sizes, node_codecs=node_codecs)
+               codec_sizes=codec_sizes, node_codecs=node_codecs,
+               base_holders=base_holders)

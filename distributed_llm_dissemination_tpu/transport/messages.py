@@ -1023,6 +1023,15 @@ class LayerDigestsMsg:
     FULL layer once the gathered tree verifies against the stamped
     full-layer (wire-form) digest, instead of acking at shard coverage.
 
+    Content-delta transfers (docs/codec.md) stamp their base INSIDE
+    the codec string — ``codecs[lid] = "delta:<base_digest_hex>"`` — so
+    the choice, the byte space, and the base can never skew apart; the
+    ``digests`` entry is then the digest of the encoded DELTA stream,
+    and ``full_digests`` — ``{layer_id: hex}`` — carries the digest of
+    the full RECONSTRUCTED form, which the dest verifies after applying
+    the delta to its held base (and which its raw holding then vouches
+    under).  Omitted for every non-delta layer.
+
     All omitted-at-default: an unsharded, unversioned, un-codec'd,
     un-pod run's stamp is byte-identical to the legacy format."""
 
@@ -1034,6 +1043,7 @@ class LayerDigestsMsg:
     versions: dict = dataclasses.field(default_factory=dict)
     codecs: dict = dataclasses.field(default_factory=dict)
     pods: dict = dataclasses.field(default_factory=dict)
+    full_digests: dict = dataclasses.field(default_factory=dict)
 
     msg_type = MsgType.LAYER_DIGESTS
 
@@ -1057,6 +1067,10 @@ class LayerDigestsMsg:
         if self.pods:
             payload["Pods"] = {str(lid): int(n)
                                for lid, n in self.pods.items()}
+        if self.full_digests:
+            payload["FullDigests"] = {
+                str(lid): str(h)
+                for lid, h in self.full_digests.items()}
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -1074,7 +1088,9 @@ class LayerDigestsMsg:
                    {int(lid): str(c)
                     for lid, c in (d.get("WireCodecs") or {}).items()},
                    {int(lid): int(n)
-                    for lid, n in (d.get("Pods") or {}).items()})
+                    for lid, n in (d.get("Pods") or {}).items()},
+                   {int(lid): str(h)
+                    for lid, h in (d.get("FullDigests") or {}).items()})
 
 
 @dataclasses.dataclass

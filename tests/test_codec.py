@@ -131,7 +131,8 @@ def test_encode_deterministic_and_decode_to_raw_layout():
 def test_wire_codec_plane_serves_and_caches_encoded_form():
     plane = _plane()
     assert plane.enabled
-    assert set(plane.decode_codecs()) == {"int8", "int4"}
+    assert set(plane.decode_codecs()) == {"int8", "int4", "int8e",
+                                          "int4e", "delta"}
     layer = _blob_layer(0)
     enc = plane.encoded_src(0, layer, "int8")
     assert enc is not None and bytes(enc.inmem_data) == _enc_blob(0)
@@ -470,6 +471,300 @@ def test_chaos_quantized_wire_corrupt_dup_slow(kind, monkeypatch):
         assert counts.get("integrity.crc_drop", 0) >= 1
         assert counts.get("integrity.nack_sent", 0) >= 1
         assert counts.get("integrity.retransmit_frags", 0) >= 1
+    finally:
+        close_all(leader, [receiver], ts)
+
+
+# ------------------------------------------- entropy + delta wire forms
+
+
+def test_codec_registry_drift_guards():
+    """CI drift guard: the model registry, the runtime plane, the
+    codec_bench table, the TTD markdown renderer, and the wire-compat
+    enumeration must all agree on the codec id set — a new id added to
+    one without the others fails here, not in production."""
+    import inspect
+
+    from distributed_llm_dissemination_tpu.cli import ttd_matrix
+    from distributed_llm_dissemination_tpu.runtime.codec import (
+        ENTROPY_FORMS,
+        WHOLE_FORM_CODECS,
+    )
+
+    registered = set(quant.CODECS) - {"raw"}
+    assert set(WHOLE_FORM_CODECS) == registered
+    assert set(ENTROPY_FORMS) == set(quant.ENTROPY_CODECS)
+    assert set(quant.ENTROPY_CODECS.values()) <= registered
+    all_ids = registered | {"delta"}
+    # Every registered id (plus the delta form) lands a bench row.
+    bench = quant.codec_bench(CFG, device=False)
+    missing = all_ids - set(bench)
+    assert not missing, f"codec_bench has no row for {sorted(missing)}"
+    for codec in sorted(all_ids):
+        row = bench[codec]
+        assert row["encoded_bytes"] > 0 and row["encode_gbps"] > 0
+        assert row["decode_host_gbps"] > 0
+    # ...and the TTD markdown table + the compat enumeration name it.
+    for src in (inspect.getsource(ttd_matrix),
+                open(__file__.replace("test_codec", "test_messages_compat")
+                     ).read()):
+        for codec in sorted(all_ids):
+            assert f'"{codec}"' in src, f"{codec} missing from {src[:40]}"
+
+
+def test_plane_entropy_form_true_sizing_and_roundtrip():
+    """The entropy forms are DATA-DEPENDENT: the plane refuses to guess
+    their size (``nbytes`` None until sized), prices them by actually
+    encoding once (``ensure_sized``), and the served stream peels back
+    to exactly the base quantized bytes."""
+    from distributed_llm_dissemination_tpu.models import entropy
+
+    plane = _plane(wire_codec="int8e")
+    assert plane.enabled
+    layer = _blob_layer(0)
+    assert plane.nbytes(0, "int8e") is None  # unsized: data-dependent
+    n = plane.ensure_sized(0, layer, "int8e")
+    assert n is not None and n == plane.nbytes(0, "int8e")
+    enc = plane.encoded_src(0, layer, "int8e")
+    assert enc is not None and enc.data_size == n
+    assert enc.meta.codec == "int8e"
+    # The stream is a DLE1 coat over the int8 base form.
+    assert entropy.decode(bytes(enc.inmem_data)) == _enc_blob(0, "int8")
+    base, bb = quant.host_unwrap("int8e", bytes(enc.inmem_data))
+    assert base == "int8" and bb == _enc_blob(0, "int8")
+    # The codec-qualified digest is of the ENTROPY stream itself.
+    d = plane.encoded_digest(0, layer, "int8e")
+    assert d == integrity.layer_digest(bytes(enc.inmem_data))
+    # Family thresholds: entropy and delta gates are their own knobs.
+    assert plane.min_rate_for("int8") == plane.min_rate
+    assert plane.min_rate_for("int8e") == plane.entropy_min_rate
+    assert plane.min_rate_for("int4e") == plane.entropy_min_rate
+    assert plane.min_rate_for("delta:" + "ab" * 8) == plane.delta_min_rate
+    # Entropy sizes raise in quant (never guessed from the model).
+    with pytest.raises(ValueError):
+        quant.blob_nbytes_codec(CFG, 0, "int8e")
+
+
+def _delta_fixture(n=256 << 10, stride=512):
+    # Deterministic byte planes: v2 is a lightly-perturbed v1 sibling.
+    v1 = bytes((i * 131 + 17) & 0xFF for i in range(n))
+    v2 = bytearray(v1)
+    for i in range(0, n, stride):
+        v2[i] ^= 0xA5
+    return v1, bytes(v2)
+
+
+def test_plane_delta_modelless_encode_reconstruct_and_refusals():
+    """The delta form needs NO model config — it rides arbitrary layer
+    bytes — but it does need a VERIFIED base on both ends: the plane
+    encodes only against a base its resolver vouches for, reconstructs
+    only against a held base, and refuses (None, loudly) on a missing
+    base or a length mismatch instead of shipping garbage."""
+    v1, v2 = _delta_fixture()
+    base_digest = integrity.layer_digest(v1)
+    codec = "delta:" + base_digest
+    plane = WireCodecPlane(None)
+    assert plane.delta_enabled
+    assert set(plane.decode_codecs()) >= {"delta"}
+    base_src = LayerSrc(inmem_data=bytearray(v1), data_size=len(v1),
+                        meta=LayerMeta(location=LayerLocation.INMEM))
+    layer = LayerSrc(inmem_data=bytearray(v2), data_size=len(v2),
+                     meta=LayerMeta(location=LayerLocation.INMEM))
+    # No resolver wired: the plane can neither produce nor price delta.
+    assert plane.encoded_src(5, layer, codec) is None
+    plane.base_resolver = (
+        lambda d: base_src if d == base_digest else None)
+    enc = plane.encoded_src(5, layer, codec)
+    assert enc is not None and enc.meta.codec == codec
+    assert enc.data_size < len(v2) // 4  # the order-of-magnitude win
+    # True-size cache: the solver prices the pair at the encoded size.
+    assert plane.nbytes(5, codec) == enc.data_size
+    assert plane.ensure_sized(5, None, codec) == enc.data_size
+    # Reconstruction is byte-exact against the held base.
+    assert plane.delta_reconstruct(5, bytes(enc.inmem_data), codec) == v2
+    # Refusals: an unheld base, and a base of the wrong length.
+    other = "delta:" + integrity.layer_digest(b"something else")
+    assert plane.encoded_src(6, layer, other) is None
+    assert plane.delta_reconstruct(6, bytes(enc.inmem_data), other) is None
+    short = LayerSrc(inmem_data=bytearray(v1[:-1]),
+                     data_size=len(v1) - 1,
+                     meta=LayerMeta(location=LayerLocation.INMEM))
+    plane.base_resolver = (
+        lambda d: short if d == base_digest else None)
+    plane._cache.clear()
+    plane._sizes.clear()
+    assert plane.encoded_src(7, layer, codec) is None
+    # A model-less plane can never serve WHOLE forms (no blob layout).
+    assert plane.encoded_src(5, layer, "int8") is None
+    # Env kill switch: DLD_DELTA_CODEC=0 disables choosing delta.
+    os.environ["DLD_DELTA_CODEC"] = "0"
+    try:
+        assert not WireCodecPlane(None).delta_enabled
+    finally:
+        del os.environ["DLD_DELTA_CODEC"]
+
+
+def test_solver_delta_pair_needs_capability_and_base_holder():
+    """A ``delta:<hex>`` pair is only admissible from a sender holding
+    BOTH the generic delta capability and a verified copy of the base
+    (``FlowGraph.base_holders``) — and it is priced at the encoded
+    delta size, not raw."""
+    base = integrity.layer_digest(b"v1 bytes")
+    codec = "delta:" + base
+    DSZ = 1000
+    raw_holders = {
+        0: {7: LayerMeta(location=LayerLocation.INMEM, data_size=RAW)},
+        1: {7: LayerMeta(location=LayerLocation.INMEM, data_size=RAW)},
+    }
+    want = {2: {7: LayerMeta(codec=codec)}}
+
+    def graph(node_codecs, base_holders):
+        return FlowGraph(want, raw_holders, {7: RAW},
+                         {n: 1 << 30 for n in (0, 1, 2)},
+                         codec_sizes={(7, codec): DSZ},
+                         node_codecs=node_codecs,
+                         base_holders=base_holders)
+
+    # Capability without the base: inadmissible.
+    _, jobs = graph({0: frozenset(["delta"]), 1: frozenset(["delta"])},
+                    {}).get_job_assignment()
+    assert not jobs, f"delta planned without a base holder: {jobs}"
+    # Base without the capability: inadmissible.
+    _, jobs = graph({}, {base: frozenset([0, 1])}).get_job_assignment()
+    assert not jobs
+    # Both — but only on sender 0: every byte comes from 0, priced at
+    # the encoded delta size.
+    _, jobs = graph({0: frozenset(["delta"]), 1: frozenset(["delta"])},
+                    {base: frozenset([0])}).get_job_assignment()
+    senders = {j.sender_id for jl in jobs.values() for j in jl}
+    assert senders == {0}
+    planned = [j for jl in jobs.values() for j in jl]
+    assert sum(j.data_size for j in planned) == DSZ
+    assert all(j.offset + j.data_size <= DSZ for j in planned)
+    # Salvage stays base-aware through the same vocabulary: a NACK
+    # replacement sender must satisfy the full codec string too.
+    assert pick_salvage_source(
+        raw_holders, 7, need_codec=codec, exclude={0},
+        encoders=frozenset([1])) in (None, 1)
+
+
+@pytest.mark.parametrize("kind", ["inmem", "tcp"])
+def test_chaos_delta_wire_end_to_end(kind, monkeypatch):
+    """The delta-tentpole e2e (docs/codec.md), under seeded faults on
+    BOTH backends: a dest that verified v1 gets a v2 sibling as an
+    encoded ``delta:<v1-digest>`` stream — corrupt/dup'd frames recover
+    via NACK in the DELTA's byte coordinates — and the reconstructed
+    layer verifies the stamped full-form digest before acking, with the
+    telemetry link table reconciling in encoded byte space."""
+    import distributed_llm_dissemination_tpu.runtime.send as send_mod
+
+    monkeypatch.setattr(send_mod, "FLOW_FRAGMENT_BYTES", 16 * 1024)
+    telemetry.reset_run()
+    ts, _ = make_transports(kind, [0, 1])
+    seed, rules = rules_from_spec("seed=5,corrupt=2,dup=7,times=3")
+    faulty = FaultyTransport(ts[1], rules, seed=seed)
+    v1, v2 = _delta_fixture(n=512 << 10, stride=64)
+    layers = {0: LayerSrc(inmem_data=bytearray(v1), data_size=len(v1),
+                          meta=LayerMeta(location=LayerLocation.INMEM,
+                                         limit_rate=8 << 20,
+                                         source_type=SourceType.MEM))}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), layers, {1: {0: LayerMeta()}},
+        {0: 1 << 30, 1: 8 << 20}, codecs=WireCodecPlane(None))
+    receiver = FlowRetransmitReceiverNode(Node(1, 0, faulty), {},
+                                          codecs=WireCodecPlane(None))
+    try:
+        receiver.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        assert 0 in receiver._digest_ok  # the verified v1 base
+        with leader._lock:
+            leader.layers[100] = LayerSrc(
+                inmem_data=bytearray(v2), data_size=len(v2),
+                meta=LayerMeta(location=LayerLocation.INMEM,
+                               limit_rate=8 << 20,
+                               source_type=SourceType.MEM))
+        leader.submit_job(
+            "v2-delta", {1: {100: LayerMeta()}}, priority=1,
+            kind="push", digests={100: integrity.layer_digest(v2)})
+        leader.ready().get(timeout=TIMEOUT)
+        # The leader chose the delta form against the dest's v1 base.
+        choice = leader._codec_choice.get((1, 100), "")
+        assert choice == "delta:" + integrity.layer_digest(v1), choice
+        # Byte-exact reconstruction, full-form digest verified, and the
+        # holding re-keyed canonical (servable raw).
+        src = receiver.layers[100]
+        assert bytes(src.inmem_data) == v2
+        assert src.meta.codec == ""
+        assert 100 in receiver._digest_ok
+        counts = trace.counter_totals()
+        assert counts.get("codec.delta_pairs_chosen", 0) >= 1
+        assert counts.get("codec.delta_reconstructed", 0) >= 1
+        delta_wire = counts.get("codec.delta_wire_bytes", 0)
+        assert 0 < delta_wire < len(v2) // 4
+        # The link table reconciles in ENCODED byte space: the v2 job's
+        # delivered bytes are the delta stream's, never raw's.
+        links = telemetry.snapshot()["links"]
+        job_rx = sum(row.get("delivered_bytes", 0)
+                     for key, row in links.items()
+                     if key.endswith("#v2-delta"))
+        assert job_rx == delta_wire
+        # The faults really fired and recovery ran in delta coordinates.
+        assert faulty.stats.get("corrupt", 0) >= 1, "fault never fired"
+        assert counts.get("integrity.nack_sent", 0) >= 1
+    finally:
+        close_all(leader, [receiver], ts)
+
+
+def test_content_equal_pair_resolves_free_over_any_delta():
+    """A v2 id whose digest the dest PROVABLY already holds rides the
+    content store's zero-wire resolve, never a codec stamp — even a
+    near-empty delta ships bytes a skip doesn't (the delta_rollout
+    row's unchanged layers; docs/codec.md).  The genuinely changed
+    sibling in the same job still rides the delta form."""
+    if not integrity.digests_enabled():
+        pytest.skip("content addressing needs layer digests")
+    telemetry.reset_run()
+    ts, _ = make_transports("inmem", [0, 1])
+    v1, v2 = _delta_fixture(n=128 << 10, stride=64)
+
+    def mk(b):
+        return LayerSrc(inmem_data=bytearray(b), data_size=len(b),
+                        meta=LayerMeta(location=LayerLocation.INMEM,
+                                       limit_rate=8 << 20,
+                                       source_type=SourceType.MEM))
+
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, ts[0]), {0: mk(v1)}, {1: {0: LayerMeta()}},
+        {0: 1 << 30, 1: 8 << 20}, codecs=WireCodecPlane(None))
+    receiver = FlowRetransmitReceiverNode(Node(1, 0, ts[1]), {},
+                                          codecs=WireCodecPlane(None))
+    try:
+        receiver.announce()
+        leader.ready().get(timeout=TIMEOUT)
+        before = trace.counter_totals().get("store.resolved_layers", 0)
+        with leader._lock:
+            leader.layers[100] = mk(v1)  # content-equal to held v1
+            leader.layers[101] = mk(v2)  # genuinely changed
+        leader.submit_job(
+            "v2", {1: {100: LayerMeta(), 101: LayerMeta()}}, priority=1,
+            kind="push",
+            digests={100: integrity.layer_digest(v1),
+                     101: integrity.layer_digest(v2)})
+        leader.ready().get(timeout=TIMEOUT)
+        assert leader._codec_choice.get((1, 100), "") == ""
+        assert leader._codec_choice.get(
+            (1, 101), "") == "delta:" + integrity.layer_digest(v1)
+        assert bytes(receiver.layers[100].inmem_data) == v1
+        assert bytes(receiver.layers[101].inmem_data) == v2
+        assert trace.counter_totals().get(
+            "store.resolved_layers", 0) == before + 1
+        # The job's wire bytes are ONE small delta stream — the
+        # content-equal pair shipped nothing.
+        links = telemetry.snapshot()["links"]
+        job_rx = sum(row.get("delivered_bytes", 0)
+                     for key, row in links.items()
+                     if key.endswith("#v2"))
+        assert 0 < job_rx < len(v2) // 4
     finally:
         close_all(leader, [receiver], ts)
 

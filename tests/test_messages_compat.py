@@ -132,7 +132,7 @@ OMITTED_AT_DEFAULT = {
     MsgType.BOOT_HINT: {"Epoch"},
     MsgType.LAYER_NACK: {"Codec"},
     MsgType.LAYER_DIGESTS: {"Epoch", "Shards", "RangeDigests",
-                            "Versions", "WireCodecs"},
+                            "Versions", "WireCodecs", "FullDigests"},
     MsgType.SOURCE_DEAD: {"Epoch"},
     MsgType.METRICS_REPORT: {"Epoch", "Counters", "Gauges", "Links",
                              "T", "Proc", "Hists", "Spans", "Health"},
@@ -418,6 +418,60 @@ def test_codec_fields_interop_with_precodec_peers():
     assert payload["Codec"] == "int8"
     assert LayerHeader.from_payload(json.loads(json.dumps(payload))) == h
     assert "Codec" not in LayerHeader(1, 7, 64, 128, 0).to_payload()
+
+
+def test_delta_and_entropy_fields_interop_with_legacy_peers():
+    """The entropy/delta wire-form extension (docs/codec.md) must keep
+    a pre-delta cluster interoperable: the ``FullDigests`` stamp and
+    the new codec ids ride EXISTING optional fields (omitted at
+    default, asserted type-by-type above), parameterized
+    ``"delta:<hex>"`` codec strings round-trip through real JSON
+    everywhere a codec string travels, and a stripped (legacy-peer)
+    payload decodes to the canonical raw reading — never KeyError."""
+    from distributed_llm_dissemination_tpu.transport.messages import (
+        AckMsg as _Ack,
+        AnnounceMsg as _Ann,
+        FlowRetransmitMsg as _Flow,
+        LayerDigestsMsg as _Digests,
+        LayerNackMsg as _Nack,
+    )
+
+    delta = "delta:" + "ab" * 16
+    for msg in (
+        # The capability announce carries the GENERIC "delta" id plus
+        # the entropy forms alongside the plain quantized ones.
+        _Ann(1, {7: LayerMeta()},
+             codecs=["int8", "int4", "int8e", "int4e", "delta"]),
+        # The stamp: delta codec string + delta-stream digest +
+        # full-form (reconstructed) digest, all on one channel.
+        _Digests(1, {7: "xxh3:ab"}, codecs={7: delta},
+                 full_digests={7: "xxh3:ff"}),
+        _Digests(1, {7: "xxh3:ab"}, codecs={7: "int8e"}),
+        # Acks / recovery run in the delta's encoded coordinates.
+        _Ack(1, 7, codec=delta),
+        _Flow(1, 7, 2, 64, 0, 1000, codec=delta),
+        _Nack(1, 7, 0, 64, codec=delta),
+        _Nack(1, 7, 0, 64, codec="int4e"),
+    ):
+        wire = json.loads(json.dumps(msg.to_payload()))
+        assert decode_msg(msg.msg_type, wire) == msg
+        # A pre-delta peer's payload (new keys stripped) decodes into
+        # the canonical raw reading — legacy interop as raw.
+        stripped = {k: v for k, v in wire.items()
+                    if k not in ("Codec", "Codecs", "WireCodecs",
+                                 "FullDigests")}
+        old = decode_msg(msg.msg_type, stripped)
+        assert getattr(old, "codec", "") == ""
+        assert getattr(old, "codecs", None) in (None, [], {})
+        assert getattr(old, "full_digests", {}) == {}
+    # Omitted at default: a delta-less stamp is byte-identical to the
+    # legacy wire format.
+    assert "FullDigests" not in LayerDigestsMsg(1, {7: "xxh3:ab"}
+                                                ).to_payload()
+    # The data-plane preamble carries the parameterized string intact.
+    h = LayerHeader(1, 7, 64, 128, 0, codec=delta)
+    assert LayerHeader.from_payload(
+        json.loads(json.dumps(h.to_payload()))) == h
 
 
 def test_pod_fields_interop_with_prepod_peers():

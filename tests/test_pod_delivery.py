@@ -106,12 +106,39 @@ def test_pod_shard_demands_prices_one_shard_per_host():
     assert pod_shard_demands(asg3, {0: [1, 2]}) == {}
 
 
+def test_pod_shard_demands_version_qualified_rides_or_refuses():
+    """Version-qualified pairs (swap/rollout waves) ride the pod
+    transform when the pod's wanting members agree on the version —
+    shard × version × codec composes — and a MIXED-version pod is
+    refused loudly (``pod.mixed_version_layers``): its slices would
+    splice two checkpoints into one gathered blob."""
+    from distributed_llm_dissemination_tpu.utils import trace
+
+    # Uniform version: the slices reconstruct ONE version's bytes.
+    asg = {1: {7: LayerMeta(version="v2")},
+           2: {7: LayerMeta(version="v2")}}
+    assert pod_shard_demands(asg, {0: [1, 2]}) == \
+        {(7, 1): "1/2@0", (7, 2): "1/2@1"}
+    # Uniform version AND codec still composes.
+    asg2 = {1: {7: LayerMeta(version="v2", codec="int8")},
+            2: {7: LayerMeta(version="v2", codec="int8")}}
+    assert pod_shard_demands(asg2, {0: [1, 2]}) == \
+        {(7, 1): "1/2@0", (7, 2): "1/2@1"}
+    # Mixed versions (including versioned-vs-unversioned) refuse,
+    # loudly, and leave the members on whole-layer targets.
+    before = trace.counter_totals().get("pod.mixed_version_layers", 0)
+    for other in (LayerMeta(version="v3"), LayerMeta()):
+        asg3 = {1: {7: LayerMeta(version="v2")}, 2: {7: other}}
+        assert pod_shard_demands(asg3, {0: [1, 2]}) == {}
+    assert trace.counter_totals().get(
+        "pod.mixed_version_layers", 0) == before + 2
+
+
 def test_pod_shard_demands_skips_qualified_and_keeps_prior():
-    # A member already targeted at a shard or version: the pod must not
-    # re-slice the layer for ANY member.
-    for meta in (LayerMeta(shard="1/2@0"), LayerMeta(version="v2")):
-        asg = {1: {7: meta}, 2: {7: LayerMeta()}}
-        assert pod_shard_demands(asg, {0: [1, 2]}) == {}
+    # A member already targeted at a shard: the pod must not re-slice
+    # the layer for ANY member.
+    asg = {1: {7: LayerMeta(shard="1/2@0")}, 2: {7: LayerMeta()}}
+    assert pod_shard_demands(asg, {0: [1, 2]}) == {}
     # A single wanting member: nothing to amortize.
     assert pod_shard_demands({1: {7: LayerMeta()}}, {0: [1, 2]}) == {}
     # Prior pairs are kept VERBATIM across re-plans (mid-flight

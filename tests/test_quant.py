@@ -195,6 +195,51 @@ def test_unknown_codec_rejected():
         quant.encode_blob(CFG, 0, b"", "fp3")
 
 
+def test_entropy_codecs_wrap_their_base_form():
+    """``int8e``/``int4e`` are the base quantized form under a DLE1
+    coat: encode recurses through the base then entropy-codes, host
+    decode peels and matches the base decode exactly, ``host_unwrap``
+    exposes the base bytes for device-path callers, and the size is
+    DATA-DEPENDENT — ``blob_nbytes_codec`` refuses to guess it."""
+    from distributed_llm_dissemination_tpu.models import entropy
+
+    bid = 0
+    raw = serde.seeded_blob(CFG, bid, SEED)
+    for codec, base in quant.ENTROPY_CODECS.items():
+        enc = quant.encode_blob(CFG, bid, raw, codec)
+        base_enc = quant.encode_blob(CFG, bid, raw, base)
+        assert entropy.decode(enc) == base_enc
+        assert quant.host_unwrap(codec, enc) == (base, base_enc)
+        # Host decode matches the base form's decode, leaf by leaf.
+        dec = quant.decode_blob_host(CFG, bid, enc, codec)
+        base_dec = quant.decode_blob_host(CFG, bid, base_enc, base)
+        for name, _ in serde.layer_param_specs(CFG):
+            np.testing.assert_array_equal(dec[name], base_dec[name],
+                                          err_msg=f"{codec}:{name}")
+        # decode_to_raw normalizes through the same host path.
+        assert quant.decode_to_raw(CFG, bid, enc, codec) == \
+            quant.decode_to_raw(CFG, bid, base_enc, base)
+        with pytest.raises(ValueError, match="data-dependent|entropy"):
+            quant.blob_nbytes_codec(CFG, bid, codec)
+        # Entropy forms have no device program — the boot path unwraps
+        # on the host first.
+        with pytest.raises(ValueError, match="no device decode"):
+            quant.device_decode_jit(codec)
+    assert quant.host_unwrap("int8", b"abc") == ("int8", b"abc")
+
+
+def test_config_rejects_entropy_model_codec(tmp_path):
+    # Entropy forms are WIRE-only: refused as a canonical held form at
+    # parse time (the byte-domain coder has no device boot program).
+    p = tmp_path / "e.json"
+    p.write_text('{"Nodes": [], "Model": "tiny", "ModelCodec": "int8e"}')
+    with pytest.raises(ValueError, match="wire-only"):
+        cfg_mod.read_json(str(p))
+    p.write_text(
+        '{"Nodes": [], "Model": "tiny", "WireCodec": "int4e"}')
+    assert cfg_mod.read_json(str(p)).wire_codec == "int4e"
+
+
 def test_roundtrip_error_bounded_by_scale():
     # |dequant(x) - x| <= scale/2 + bf16 rounding slop, per element.
     bid = 0

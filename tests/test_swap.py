@@ -163,6 +163,52 @@ def test_mixed_version_guard():
         ensure_uniform_version({0: "v1", 1: "v1"}, "v2")
 
 
+def test_swap_gate_refuses_encoded_or_sharded_holdings():
+    """Swap completeness gates on FULL canonical bytes: a shard slice
+    or a still-ENCODED v2 holding (a negotiated codec form, or a delta
+    stream awaiting reconstruction) must never count toward the flip —
+    staging it would decode garbage into the serving tree
+    (docs/swap.md, docs/codec.md)."""
+    from types import SimpleNamespace
+
+    from distributed_llm_dissemination_tpu.models import serde
+    from distributed_llm_dissemination_tpu.models.llama import CONFIGS
+    from distributed_llm_dissemination_tpu.runtime.swap import (
+        SwapController,
+    )
+
+    cfg = CONFIGS["tiny"]
+    base = 1000
+
+    def holding(codec="", shard=""):
+        return LayerSrc(
+            inmem_data=bytearray(b"x"), data_size=1,
+            meta=LayerMeta(location=LayerLocation.INMEM, codec=codec,
+                           shard=shard))
+
+    layers = {base + b: holding()
+              for b in range(serde.head_blob_id(cfg) + 1)}
+    r = SimpleNamespace(node=SimpleNamespace(my_id=1),
+                        _lock=threading.Lock(), layers=layers,
+                        _digest_ok=set(),
+                        _expected_digest=lambda lid: None, boot_cfg=cfg)
+    ctl = SwapController(r)
+    assert ctl._set_complete(base)
+    for bad in (holding(codec="int8"), holding(codec="int8e"),
+                holding(codec="delta:" + "ab" * 16),
+                holding(shard="1/4@0")):
+        good = layers[base]
+        layers[base] = bad
+        assert not ctl._set_complete(base), bad.meta
+        layers[base] = good
+    assert ctl._set_complete(base)
+    # A missing blob, and a stamped-but-unverified digest, still gate.
+    r._expected_digest = lambda lid: "xxh3:ab"
+    assert not ctl._set_complete(base)
+    del layers[base]
+    assert not ctl._set_complete(base)
+
+
 # ------------------------------------------------- serving rig helpers
 
 
