@@ -187,6 +187,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="set rollout ID's traffic-split knob (the "
                         "fraction of eligible traffic routed at v2 "
                         "replicas during soak), e.g. canary-v2:0.25")
+    # Closed-loop autonomy (docs/autonomy.md): the policy engine's
+    # operator verbs — query is open, enable/disable ride the
+    # DLD_JOB_TOKEN admission gate like every other fleet mutation.
+    p.add_argument("-policies", action="store_true",
+                   help="query the running leader's policy engine "
+                        "(armed rules, cooldowns, quarantine mask, "
+                        "in-flight actions, audit tail) as JSON and "
+                        "exit; same seat rules as -jobs")
+    p.add_argument("-policy-enable", action="store_true",
+                   help="re-enable automatic policy actioning on the "
+                        "running leader (token-gated via DLD_JOB_TOKEN)")
+    p.add_argument("-policy-disable", action="store_true",
+                   help="drop the running leader's policy engine to "
+                        "MANUAL: rules keep sensing (streaks/cooldowns "
+                        "stay warm) but no action fires (token-gated "
+                        "via DLD_JOB_TOKEN)")
     return p
 
 
@@ -440,6 +456,41 @@ def run_rollouttool(args, conf: cfg.Config) -> int:
     return 1 if resp.error else 0
 
 
+def run_policytool(args, conf: cfg.Config) -> int:
+    """The autonomy operator verbs (docs/autonomy.md): query the policy
+    engine's table / enable / disable automatic actioning against the
+    running leader, print its PolicyCtlMsg reply as JSON, exit."""
+    import json
+
+    from ..transport.messages import PolicyCtlMsg
+
+    # One mutating verb per invocation, same refusal as the rollout
+    # verbs — the leader executes exactly one.
+    if args.policy_enable and args.policy_disable:
+        raise SystemExit("pick ONE of -policy-enable / -policy-disable "
+                         "per invocation")
+
+    resp = _oneshot_leader_rpc(
+        args, conf, PolicyCtlMsg,
+        lambda leader_id: PolicyCtlMsg(
+            args.id, query=args.policies,
+            enable=bool(args.policy_enable),
+            disable=bool(args.policy_disable),
+            # Mutating verbs ride the job-token admission gate
+            # (docs/service.md): the operator exports the same secret.
+            auth=os.environ.get("DLD_JOB_TOKEN", "")),
+        timeout=30.0,
+        timeout_error="no policy answer from the leader (is it "
+                      "running?)")
+    if resp is None:
+        return 1
+    out = {"leader_epoch": resp.epoch, "policies": resp.table}
+    if resp.error:
+        out["error"] = resp.error
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 1 if resp.error else 0
+
+
 def run_draintool(args, conf: cfg.Config) -> int:
     """The -drain NODE one-shot (docs/membership.md): ask the leader to
     drain the named node, print its DONE (or refusal) answer as JSON,
@@ -590,6 +641,11 @@ def run_leader(args, conf: cfg.Config, node: Node, layers) -> int:
     # Pod serving decodes -gen tokens (rides the ServeMsg): the leader's
     # flag governs the whole pod, like the boot decision.
     leader.serve_generate = max(0, args.gen)
+    # Closed-loop autonomy (docs/autonomy.md): arm the config's
+    # validated Policies block.  A bad block already failed LOUDLY at
+    # config parse (core/config.py → policy.validate_policies).
+    if conf.policies:
+        leader.policy.arm(conf.policies)
 
     print(
         f"launching leader...\n[addr: {node.transport.get_address()}, "
@@ -1004,6 +1060,10 @@ def main(argv=None) -> int:
             or args.rollout_split):
         # One-shot rollout-pipeline tools (docs/rollout.md).
         return run_rollouttool(args, conf)
+
+    if args.policies or args.policy_enable or args.policy_disable:
+        # One-shot autonomy tools (docs/autonomy.md).
+        return run_policytool(args, conf)
 
     if args.drain >= 0:
         # One-shot membership tool (docs/membership.md): ask the leader

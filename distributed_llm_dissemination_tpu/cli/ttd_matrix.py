@@ -2932,6 +2932,363 @@ def _rollout_md(lines, results) -> None:
     ]
 
 
+def run_autonomy(p99_ms: float = 250.0, hot_delay_ms: float = 600.0,
+                 bulk_bytes: int = 24 << 20, bw: int = 25_000_000,
+                 slow_rate: int = 2 << 20, timeout: float = 300.0,
+                 kill_switch: bool = False) -> dict:
+    """The closed-loop fleet-autonomy acceptance row (docs/autonomy.md,
+    ROADMAP item 4): a serving fleet takes TWO concurrent injections —
+    a ``slowserve`` hot replica breaching the serve SLO and a ``slow=``
+    straggler link under a bulk transfer — and the leader's policy
+    engine must converge the fleet back inside SLO with ZERO operator
+    verbs: the replica set grown onto a spare (join+refill through
+    ``submit_job``), the slow link demoted and re-planned around
+    through the flow solver, the breaching replica quarantined out of
+    the serve rotation, every action audited and span-attributed in
+    RUN_REPORT.  ``kill_switch=True`` runs the SAME injections under
+    ``DLD_POLICY=0``: sensing stays live (``held_manual`` audit
+    records) but nothing fires — the sibling row proving the zero-verb
+    convergence was the ENGINE, not a coincidence."""
+    import threading
+
+    import jax
+
+    from ..core.types import (
+        LayerLocation,
+        LayerMeta,
+        LayerSrc,
+        SourceType,
+    )
+    from ..models import serde
+    from ..models.llama import CONFIGS, init_params
+    from ..runtime import (
+        FlowRetransmitLeaderNode,
+        FlowRetransmitReceiverNode,
+        Node,
+    )
+    from ..runtime import send as send_mod
+    from ..runtime.client import GenRequester
+    from ..transport import InmemTransport
+    from ..transport.faults import FaultyTransport, rules_from_spec
+    from ..utils import telemetry, trace
+    from ..utils.provenance import harness_hash
+    from . import report as report_mod
+
+    telemetry.reset_run()
+    prior_metrics = os.environ.get("DLD_METRICS_INTERVAL_S")
+    prior_policy = os.environ.get("DLD_POLICY")
+    prior_sustain = os.environ.get("DLD_STRAGGLER_N")
+    prior_frag = send_mod.FLOW_FRAGMENT_BYTES
+    os.environ["DLD_METRICS_INTERVAL_S"] = "0.25"
+    os.environ["DLD_POLICY"] = "0" if kill_switch else "1"
+    # Two sustained intervals before a straggler flags: a pair planned
+    # mid-interval legitimately reads 0 B/s once — judging on a single
+    # interval would false-flag the very link the re-plan just chose.
+    os.environ["DLD_STRAGGLER_N"] = "2"
+    # Small fragments so the throttled link shows per-interval progress
+    # to the straggler detector instead of one late burst.
+    send_mod.FLOW_FRAGMENT_BYTES = 256 << 10
+    cfg = CONFIGS["tiny"]
+    v1 = serde.blobs_from_params(cfg, init_params(cfg, jax.random.key(0)))
+
+    def blob_layer(data) -> LayerSrc:
+        return LayerSrc(inmem_data=bytearray(data), data_size=len(data),
+                        meta=LayerMeta(location=LayerLocation.INMEM,
+                                       source_type=SourceType.MEM))
+
+    replicas_ids = [1, 2]
+    hot = 2                      # the slowserve-injected breacher
+    bulk_dest, spare = 3, 4      # straggler-link dest; growable seat
+    bulk_lid = 7000
+    bulk = os.urandom(bulk_bytes)
+    ids = [0, 1, 2, bulk_dest, spare]
+    ts = {i: InmemTransport(str(i)) for i in ids + [9]}
+    hot_spec = f"slowserve={hot_delay_ms:g}"
+    _, hot_rules = rules_from_spec(hot_spec)
+    ts[hot] = FaultyTransport(ts[hot], hot_rules, seed=7)
+    slow_spec = f"slow={slow_rate}@{bulk_dest}"
+    _, slow_rules = rules_from_spec(slow_spec)
+    leader_t = FaultyTransport(ts[0], slow_rules, seed=7)
+    seed_layers = {b: blob_layer(v1[b]) for b in v1}
+    seed_layers[bulk_lid] = blob_layer(bulk)
+    base = {r: {b: LayerMeta() for b in v1} for r in replicas_ids}
+    leader = FlowRetransmitLeaderNode(
+        Node(0, 0, leader_t), seed_layers, base,
+        {i: bw for i in ids},
+        expected_nodes={1, 2, bulk_dest, spare})
+    rules = [
+        {"Rule": "grow_on_serve_pressure", "P99Ms": p99_ms,
+         "Sustain": 2, "CooldownS": 60.0},
+        {"Rule": "quarantine_breacher", "P99Ms": p99_ms,
+         "Breaches": 2, "CooldownS": 60.0},
+        {"Rule": "replan_straggler", "FloorFrac": 0.1, "CooldownS": 5.0},
+    ]
+    leader.policy.arm(rules)
+    replicas = {r: FlowRetransmitReceiverNode(Node(r, 0, ts[r]), {},
+                                              boot_cfg=cfg)
+                for r in replicas_ids}
+    # Replica 1 also holds the bulk layer: the re-plan's alternative
+    # source once the leader's own link to the dest is demoted.
+    others = {
+        bulk_dest: FlowRetransmitReceiverNode(Node(bulk_dest, 0,
+                                                   ts[bulk_dest]), {}),
+        spare: FlowRetransmitReceiverNode(Node(spare, 0, ts[spare]), {}),
+    }
+    requester = GenRequester(ts[9], my_id=9)
+    prompt, max_new = [3, 5, 7], 8
+    failures: list = []
+    latencies: list = []         # (wall mono t, replica, ms)
+    stop = threading.Event()
+
+    def hammer(replica):
+        # The request router honors the leader's serve-rotation mask —
+        # exactly what the A/B split does in-process (docs/autonomy.md).
+        while not stop.is_set():
+            if replica in leader.serve_quarantined():
+                time.sleep(0.1)
+                continue
+            t0 = time.monotonic()
+            try:
+                requester.request(replica, prompt, max_new,
+                                  timeout=timeout)
+                latencies.append((time.monotonic(), replica,
+                                  (time.monotonic() - t0) * 1000.0))
+            except Exception as e:  # noqa: BLE001 — any failure counts
+                failures.append(repr(e))
+            time.sleep(0.03)
+
+    threads = [threading.Thread(target=hammer, args=(r,), daemon=True,
+                                name=f"autonomy-hammer-{r}")
+               for r in replicas_ids]
+    try:
+        for r in [*replicas.values(), *others.values()]:
+            r.announce()
+        leader.ready().get(timeout=timeout)
+        leader.boot_ready().get(timeout=timeout)
+        # Replica 1 gains the bulk layer out of band (an announce of
+        # held state, like any member-held source) so the solver has a
+        # second holder to route around the demoted leader link.
+        replicas[1].layers[bulk_lid] = blob_layer(bulk)
+        replicas[1].announce()
+        for r in replicas_ids:  # warm the decode jits
+            requester.request(r, prompt, max_new, timeout=timeout)
+        for t in threads:
+            t.start()
+        t0 = time.monotonic()
+        leader.submit_job("bulk", {bulk_dest: {bulk_lid: LayerMeta()}},
+                          priority=1)
+        deadline = time.monotonic() + timeout
+
+        def audits(action, outcome=None):
+            return [a for a in leader.policy.table()["Audit"]
+                    if a.get("Action") == action
+                    and (outcome is None or a.get("Outcome") == outcome)]
+
+        if kill_switch:
+            # The engine must SENSE both injections but HOLD: wait for
+            # the held_manual audit trail instead of actions.
+            while not (audits("quarantine", "held_manual")
+                       and audits("replan", "held_manual")):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"held_manual audits never appeared: "
+                        f"{leader.policy.table()['Audit']}")
+                time.sleep(0.05)
+            time.sleep(0.6)  # more intervals: prove it KEEPS holding
+            stop.set()
+            for t in threads:
+                t.join(timeout=timeout)
+            counters = trace.counter_totals()
+            tbl = leader.policy.table()
+            fired = {a: counters.get(f"policy.action_{a}", 0)
+                     for a in ("grow", "replan", "quarantine", "rehome")}
+            return {
+                "harness_hash": harness_hash(),
+                "backend": "inmem",
+                "mode": 3,
+                "kill_switch": True,
+                "env": "DLD_POLICY=0",
+                "fault_specs": [hot_spec, slow_spec],
+                "sensed_held_manual": {
+                    "quarantine": len(audits("quarantine",
+                                             "held_manual")),
+                    "replan": len(audits("replan", "held_manual")),
+                },
+                "actions_fired": fired,
+                "zero_actions": not any(fired.values()),
+                "quarantined": sorted(leader.serve_quarantined()),
+                "link_demotions": {f"{s}->{d}": b for (s, d), b
+                                   in leader.policy.demotions().items()},
+                "policy_jobs": sorted(
+                    j for j in leader.jobs.table()
+                    if str(j).startswith("policy-")),
+                "engine_active": tbl["Active"],
+                "request_failures": len(failures),
+            }
+
+        # ---- closed loop: wait for each autonomous action to land ----
+        def wait_for(pred, what):
+            while not pred():
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"autonomy never {what}: "
+                                       f"{leader.policy.table()}")
+                time.sleep(0.05)
+
+        wait_for(lambda: hot in leader.serve_quarantined(),
+                 "quarantined the breacher")
+        t_quar = time.monotonic()
+        wait_for(lambda: audits("replan"), "re-planned the straggler")
+
+        def job_done(jid):
+            job = leader.jobs.get(jid)
+            return job is not None and job.state == "done"
+
+        wait_for(lambda: job_done("bulk"), "finished the bulk transfer")
+        bulk_wall = round(time.monotonic() - t0, 3)
+
+        def grow_done():
+            jids = [r.get("Job") for r in audits("grow") if r.get("Job")]
+            return any(job_done(j) for j in jids)
+
+        wait_for(grow_done, "grew the replica set")
+        time.sleep(1.0)  # post-quarantine serving window for the SLO bar
+        stop.set()
+        for t in threads:
+            t.join(timeout=timeout)
+        # One more report round so every node's final span ring lands.
+        leader.await_metrics(newer_than=time.monotonic() - 0.01,
+                             timeout=5.0)
+        counters = trace.counter_totals()
+        tbl = leader.policy.table()
+        table = leader.cluster_telemetry()
+        rep = report_mod.build_from_leader(leader)
+        policy_spans = sorted({e.get("span") for e in table["spans"]
+                               if str(e.get("span", "")
+                                      ).startswith("policy:")})
+        grow_jobs = sorted({r.get("Job") for r in audits("grow")
+                            if r.get("Job")})
+        spare_layers = sorted(leader.status.get(spare) or {})
+        straggler = [e for e in leader.health.events()
+                     if e.get("kind") == "straggler_link"
+                     and e.get("link") == f"0->{bulk_dest}"]
+        post = sorted(ms for (t, r, ms) in latencies
+                      if t > t_quar + 0.3 and r != hot)
+        post_p99 = (round(post[min(len(post) - 1,
+                                   int(0.99 * len(post)))], 1)
+                    if post else None)
+        return {
+            "harness_hash": harness_hash(),
+            "backend": "inmem",
+            "mode": 3,
+            "model": "tiny",
+            "kill_switch": False,
+            "rules": rules,
+            "slo_p99_ms": p99_ms,
+            "fault_specs": [hot_spec, slow_spec],
+            "operator_verbs": 0,   # structural: no ctl message is sent
+            "quarantined": sorted(leader.serve_quarantined()),
+            "breacher_quarantined": hot in leader.serve_quarantined(),
+            "wall_to_quarantine_s": round(t_quar - t0, 3),
+            "straggler_flagged_live": bool(straggler),
+            "straggler_frac": (straggler[0].get("frac")
+                               if straggler else None),
+            "link_demotions": {f"{s}->{d}": b for (s, d), b
+                               in leader.policy.demotions().items()},
+            "bulk_done_s": bulk_wall,
+            "grow_jobs": grow_jobs,
+            "spare_grown_layers": len(spare_layers),
+            "spare_holds_model": all(
+                b in spare_layers for b in v1),
+            "post_quarantine_p99_ms": post_p99,
+            "slo_reconverged": (post_p99 is not None
+                                and post_p99 <= p99_ms),
+            "request_failures": len(failures),
+            "zero_failures": not failures,
+            "requests_total": len(latencies),
+            "actions_fired": {a: counters.get(f"policy.action_{a}", 0)
+                              for a in ("grow", "replan",
+                                        "quarantine", "rehome")},
+            "audit_tail": tbl["Audit"][-8:],
+            "policy_spans": policy_spans,
+            "span_attributed": bool(policy_spans),
+            "run_report": rep.get("provenance"),
+        }
+    finally:
+        stop.set()
+        requester.close()
+        send_mod.FLOW_FRAGMENT_BYTES = prior_frag
+        if prior_metrics is None:
+            os.environ.pop("DLD_METRICS_INTERVAL_S", None)
+        else:
+            os.environ["DLD_METRICS_INTERVAL_S"] = prior_metrics
+        if prior_policy is None:
+            os.environ.pop("DLD_POLICY", None)
+        else:
+            os.environ["DLD_POLICY"] = prior_policy
+        if prior_sustain is None:
+            os.environ.pop("DLD_STRAGGLER_N", None)
+        else:
+            os.environ["DLD_STRAGGLER_N"] = prior_sustain
+        _service_teardown(
+            leader, [*replicas.values(), *others.values()], ts)
+        leader_t.close()
+
+
+def _autonomy_md(lines, results) -> None:
+    au = results.get("autonomy")
+    if not au or not au.get("closed_loop"):
+        return
+    cl, ks = au["closed_loop"], au.get("kill_switch") or {}
+    bars = {
+        "breaching replica quarantined (serve-rotation mask)":
+            cl["breacher_quarantined"],
+        "straggler link flagged live and re-planned around":
+            cl["straggler_flagged_live"] and bool(cl["link_demotions"]),
+        "replica set grown onto the spare (join+refill)":
+            cl["spare_holds_model"],
+        "fleet back inside SLO after quarantine":
+            cl["slo_reconverged"],
+        "zero operator verbs": cl["operator_verbs"] == 0,
+        "zero dropped requests": cl["zero_failures"],
+        "every action span-attributed in RUN_REPORT":
+            cl["span_attributed"],
+    }
+    if ks:
+        bars["DLD_POLICY=0 sibling: sensed but ZERO actions"] = (
+            ks.get("zero_actions") and not ks.get("quarantined")
+            and not ks.get("link_demotions")
+            and not ks.get("policy_jobs"))
+    lines += [
+        "## Closed-loop fleet autonomy (docs/autonomy.md)",
+        "",
+        f"A serving fleet ({cl['backend']} backend, mode {cl['mode']}) "
+        f"takes two concurrent injections — `{cl['fault_specs'][0]}` on "
+        f"a hot replica and `{cl['fault_specs'][1]}` under a bulk "
+        f"transfer — and the leader's policy engine converges it back "
+        f"inside the p99 <= {cl['slo_p99_ms']:g}ms SLO with zero "
+        f"operator verbs: quarantine after "
+        f"{cl['wall_to_quarantine_s']}s, link demoted to "
+        f"{cl['link_demotions']}, bulk done in {cl['bulk_done_s']}s, "
+        f"post-quarantine p99 {cl['post_quarantine_p99_ms']}ms.",
+        "",
+        "| bar | met |",
+        "|---|---|",
+    ]
+    for name, met in bars.items():
+        lines.append(f"| {name} | {'MET' if met else 'NOT MET'} |")
+    lines += [
+        "",
+        f"Actions fired: {cl['actions_fired']}; policy spans "
+        f"{cl['policy_spans']}; {cl['requests_total']} requests served, "
+        f"{cl['request_failures']} failed.  "
+        + (f"Kill-switch sibling ({ks.get('env')}): held_manual audits "
+           f"{ks.get('sensed_held_manual')}, actions fired "
+           f"{ks.get('actions_fired')}.  " if ks else "")
+        + f"Run report `{cl.get('run_report')}`.",
+        "",
+    ]
+
+
 def _swap_md(lines, results) -> None:
     sw = results.get("live_swap")
     if not sw:
@@ -4368,6 +4725,7 @@ def to_markdown(results: dict) -> str:
     _fabric_delivery_md(lines, results)
     _swap_md(lines, results)
     _rollout_md(lines, results)
+    _autonomy_md(lines, results)
     return "\n".join(lines)
 
 
@@ -4415,6 +4773,16 @@ def main(argv=None) -> int:
                         "injected bad wave — auto-pause on the SLO "
                         "breach, rollback to v1, earlier waves keep "
                         "v2, zero dropped requests")
+    p.add_argument("-autonomy", action="store_true",
+                   help="also run the closed-loop fleet-autonomy row "
+                        "(docs/autonomy.md): a slowserve hot replica + "
+                        "a slow= straggler link under live traffic — "
+                        "the policy engine must grow the replica set, "
+                        "re-plan around the slow link, quarantine the "
+                        "breacher and converge back inside SLO with "
+                        "zero operator verbs, plus the DLD_POLICY=0 "
+                        "kill-switch sibling showing the same "
+                        "injections NOT acted on")
     p.add_argument("-sharded", action="store_true",
                    help="also measure sharded delivery "
                         "(docs/sharding.md): the multi-dest 64 MiB "
@@ -4622,6 +4990,13 @@ def main(argv=None) -> int:
         results["rollout"] = run_rollout()
     elif prior_doc and prior_doc.get("rollout"):
         results["rollout"] = prior_doc["rollout"]
+    if args.autonomy:
+        results["autonomy"] = {
+            "closed_loop": run_autonomy(),
+            "kill_switch": run_autonomy(kill_switch=True),
+        }
+    elif prior_doc and prior_doc.get("autonomy"):
+        results["autonomy"] = prior_doc["autonomy"]
     if args.elasticity:
         results["elasticity"] = run_elasticity()
     elif prior_doc and prior_doc.get("elasticity"):

@@ -241,6 +241,14 @@ class Config:
     # gather, so pod NIC ingress is O(model_bytes), not
     # O(model_bytes x replicas).  None = no pod delivery.
     pods: Optional[List[List[NodeID]]] = None
+    # Closed-loop autonomy (docs/autonomy.md): declarative policy rules
+    # the leader-side engine evaluates against the folded cluster
+    # signals every metrics interval — ``[{"Rule": <kind>, ...params},
+    # ...]``, validated LOUDLY at parse time (runtime/policy.py owns
+    # the grammar).  None/[] = manual fleet (no engine armed).  The
+    # ``DLD_POLICY`` env kill-switch drops an armed fleet back to
+    # manual without a config change.
+    policies: Optional[List[dict]] = None
 
     @classmethod
     def from_json(cls, d: dict) -> "Config":
@@ -260,7 +268,17 @@ class Config:
             groups=_jget(d, "Groups"),
             pods=([[int(m) for m in pod] for pod in _jget(d, "Pods")]
                   if _jget(d, "Pods") is not None else None),
+            policies=(list(_jget(d, "Policies"))
+                      if _jget(d, "Policies") is not None else None),
         )
+        if conf.policies is not None:
+            # A bad rule must be refused at ADMISSION (config parse),
+            # never at fire time — the engine owns the grammar
+            # (lazy import: policy pulls runtime modules pure-config
+            # users never need).
+            from ..runtime.policy import validate_policies
+
+            conf.policies = validate_policies(conf.policies)
         if conf.groups is not None and not isinstance(conf.groups,
                                                       (dict, list)):
             raise ValueError(

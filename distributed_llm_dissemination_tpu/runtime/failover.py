@@ -161,6 +161,15 @@ class ShadowLeaderState:
         # event ring (straggler onsets and recoveries) — a promoted
         # standby keeps the health history, not just the raw counters.
         self.health: dict = {}
+        # Autonomy engine (docs/autonomy.md): the policy engine's full
+        # state — armed rules, cooldowns (remaining seconds), breach
+        # streaks, quarantine mask, link demotions, in-flight actions
+        # and the audit tail — a promoted standby inherits the closed
+        # loop mid-action.
+        self.policy: dict = {}
+        # Mode-3 plan generation counter: fences stale revoke/dispatch
+        # pairs across replans (docs/service.md, wrong-eat race).
+        self.plan_gen = 0
         # Job plane (docs/service.md): the admitted-job table (raw
         # replication records, ``sched.jobs.JobManager.record``) and the
         # BASE single-run goal (``assignment`` above is the MERGED
@@ -236,6 +245,8 @@ class ShadowLeaderState:
                 self.metrics = {int(n): dict(s) for n, s in
                                 (d.get("Metrics") or {}).items()}
                 self.health = dict(d.get("Health") or {})
+                self.policy = dict(d.get("Policy") or {})
+                self.plan_gen = int(d.get("PlanGen", 0))
                 self.jobs = {str(j): dict(rec) for j, rec in
                              (d.get("Jobs") or {}).items()}
                 self.swaps = {str(v): dict(rec) for v, rec in
@@ -377,6 +388,15 @@ class ShadowLeaderState:
                 # Rollout pipeline records (docs/rollout.md): the full
                 # current record per delta — REPLACE per rollout id.
                 self.rollouts[str(d["RolloutID"])] = dict(d)
+            elif k == "policy":
+                # Autonomy engine state (docs/autonomy.md): every delta
+                # carries the engine's FULL current state (rules,
+                # cooldowns, mask, in-flight actions) — REPLACE, so a
+                # lifted quarantine or completed action is exactly an
+                # absent entry.
+                self.policy = dict(d)
+            elif k == "plan_gen":
+                self.plan_gen = max(self.plan_gen, int(d.get("Gen", 0)))
             else:
                 log.warn("unknown control delta kind", kind=k)
 
@@ -399,6 +419,8 @@ class ShadowLeaderState:
                 "metrics": {n: dict(s) for n, s in self.metrics.items()},
                 "health": {k: list(v) if isinstance(v, list) else dict(v)
                            for k, v in self.health.items()},
+                "policy": dict(self.policy),
+                "plan_gen": self.plan_gen,
                 "jobs": {j: dict(rec) for j, rec in self.jobs.items()},
                 "swaps": {v: dict(rec) for v, rec in self.swaps.items()},
                 "rollouts": {r: dict(rec)

@@ -126,6 +126,21 @@ class FailureDetector:
         with self._lock:
             self._last_seen.pop(node_id, None)
 
+    def silent_ages(self) -> Dict[NodeID, float]:
+        """Seconds of silence per monitored (not dead/removed) node —
+        the autonomy engine's death-suspicion signal: a node silent for
+        a large fraction of ``timeout`` gets its unique holdings
+        proactively re-homed BEFORE the crash path fires
+        (docs/autonomy.md).  Read-only; never mutates leases."""
+        now = time.monotonic()
+        with self._lock:
+            return {n: max(0.0, now - t)
+                    for n, t in self._last_seen.items()}
+
+    @property
+    def timeout(self) -> float:
+        return self._timeout
+
     def remove(self, node_id: NodeID) -> None:
         """Permanently stop monitoring a cleanly-departed node: the
         lease is dropped AND later touches (straggler heartbeats, a

@@ -88,6 +88,7 @@ from ..transport.messages import (
     LeaderLeaseMsg,
     MetricsReportMsg,
     PlanResendReqMsg,
+    PolicyCtlMsg,
     RetransmitMsg,
     RolloutCtlMsg,
     ServeMsg,
@@ -108,6 +109,7 @@ from .failure import FailureDetector
 from .membership import MembershipTable
 from . import membership as mship
 from .node import MessageLoop, Node
+from .policy import PolicyEngine
 from .rollout import RolloutDriver
 from .store import ContentIndex
 from .send import (
@@ -431,6 +433,14 @@ class LeaderNode:
         # derived at every report fold, replicated so a promoted
         # standby keeps the event history.
         self.health = telemetry.HealthTimeline()
+        # Closed-loop autonomy (docs/autonomy.md): the policy engine —
+        # declarative rules over the folded signals, acting through the
+        # SAME chokepoints the CLI verbs use.  Unarmed (no rules) it is
+        # inert; the CLI arms it from the config's Policies block.  Its
+        # state REPLACE-replicates (kind "policy" + snapshot section)
+        # so a promoted standby inherits armed rules, cooldowns, and
+        # in-flight actions.
+        self.policy = PolicyEngine(self)
 
         if integrity.digests_enabled():
             threading.Thread(target=self._compute_own_digests,
@@ -581,6 +591,7 @@ class LeaderNode:
         reg(JoinMsg, self.handle_join)
         reg(DrainMsg, self.handle_drain)
         reg(RolloutCtlMsg, self.handle_rollout_ctl)
+        reg(PolicyCtlMsg, self.handle_policy_ctl)
 
     # --------------------------------------------------- control-plane HA
 
@@ -681,12 +692,22 @@ class LeaderNode:
         # lock), so taking the health lock while holding the leader's
         # would be a lock-order inversion.
         health = self.health.snapshot()
+        # Same discipline for the autonomy engine (docs/autonomy.md):
+        # PolicyEngine._lock is leaf-most, so its state is folded
+        # before the leader lock is taken.
+        policy = self.policy.to_json()
         with self._lock:
             return {
                 # Fleet health timeline (docs/observability.md): the
                 # event ring + series tail — a promoted standby keeps
                 # the straggler history with onset timestamps.
                 "Health": health,
+                # Autonomy engine (docs/autonomy.md): armed rules,
+                # cooldowns (as remaining seconds), quarantine mask and
+                # in-flight actions — a promoted standby inherits the
+                # closed loop mid-action instead of re-deciding cold.
+                "Policy": policy,
+                "PlanGen": int(getattr(self, "_plan_gen", 0)),
                 "Mode": self.MODE,
                 "Assignment": _nested_layer_map_to_json(self.assignment),
                 "BaseAssignment": _nested_layer_map_to_json(
@@ -893,6 +914,13 @@ class LeaderNode:
         # survive the takeover; fresh interval deltas re-baseline from
         # the first post-takeover report round.
         self.health.ingest((shadow.get("health") or {}).get("events"))
+        # Autonomy engine (docs/autonomy.md): inherit the armed rules,
+        # cooldowns, quarantine mask and in-flight actions so the
+        # promoted leader completes what the dead one started (policy
+        # lock only — leaf-most, taken outside the leader lock).
+        self.policy.load(shadow.get("policy") or {})
+        if hasattr(self, "_plan_gen"):
+            self._plan_gen = int(shadow.get("plan_gen") or 0)
         # Elastic membership (docs/membership.md): adopt the roster so
         # the promoted leader keeps departed members fenced, resumes
         # in-flight drains, and can dial adopted joiners (their
@@ -937,6 +965,11 @@ class LeaderNode:
         self.rollouts.resume_all()
         self._resume_drains()
         self._resume_joins()
+        # Autonomy (docs/autonomy.md): re-apply inherited link
+        # demotions and re-submit inherited in-flight actions whose
+        # jobs did not survive the takeover — at the bumped epoch,
+        # under the SAME action ids (no double-fire, no drop).
+        self.policy.resume_from_takeover()
         with self._lock:
             already_done = self._startup_sent
         if already_done:
@@ -1632,16 +1665,23 @@ class LeaderNode:
                         Links=msg.links, Hists=msg.hists,
                         Spans=msg.spans,
                         T=msg.t_wall_ms, Proc=msg.proc)
-        self._health_observe(msg.src_id, snap, foreign=msg.health)
+        events = self._health_observe(msg.src_id, snap, foreign=msg.health)
+        # Closed-loop autonomy (docs/autonomy.md): every metrics
+        # interval IS the policy evaluation tick — the engine senses
+        # the folded serve signals + the NEW health events and drives
+        # the leader's own chokepoints.  Unarmed engines return
+        # immediately.
+        self.policy.tick(msg.src_id, snap, events)
 
     def _health_observe(self, node_id: NodeID, snap: dict,
-                        foreign=None) -> None:
+                        foreign=None) -> List[dict]:
         """Fold one report into the fleet health timeline (docs/
         observability.md): interval deltas + straggler scoring against
         the modeled link rates.  New events are logged the moment they
         are detected — the live channel ``-watch`` surfaces — and
         replicated (kind "health") so a promoted standby keeps the
-        event history with onset timestamps."""
+        event history with onset timestamps.  Returns the new events
+        (the policy engine's link-rule input)."""
         events = self.health.observe(
             node_id, snap, self._modeled_link_rate,
             expected_srcs=self._health_expected_srcs(node_id))
@@ -1653,6 +1693,7 @@ class LeaderNode:
             trace.count(f"telemetry.health_{ev.get('kind', 'event')}")
             log.warn("fleet health event", **ev)
             self._replicate("health", Events=[ev])
+        return list(events)
 
     def _modeled_link_rate(self, src: NodeID, dest: NodeID) -> int:
         """The modeled rate (bytes/s) health scoring judges the (src,
@@ -3033,6 +3074,117 @@ class LeaderNode:
         except (OSError, KeyError, ConnectionError) as e:
             log.error("rollout ctl reply undeliverable",
                       dest=msg.src_id, err=repr(e))
+
+    def handle_policy_ctl(self, msg: PolicyCtlMsg) -> None:
+        """The autonomy engine's operator front door (docs/autonomy.md):
+        query the policy table, enable/disable automatic actioning.
+        The MUTATING verbs (enable/disable) ride the DLD_JOB_TOKEN
+        admission gate — flipping a fleet between self-driving and
+        manual is exactly the mutation class the token exists for;
+        query stays open like -jobs.  Every request is ANSWERED,
+        refusals included."""
+        if msg.table or msg.error:
+            return  # someone's reply echoed here
+        error = ""
+        mutating = msg.enable or msg.disable
+        if self._deposed:
+            error = "deposed: a higher-epoch leader owns the policies"
+        elif (mutating and self._job_token
+                and not hmac.compare_digest(msg.auth.encode(),
+                                            self._job_token.encode())):
+            trace.count("jobs.unauthorized")
+            log.warn("unauthorized policy control verb rejected",
+                     submitter=msg.src_id, enable=msg.enable,
+                     disable=msg.disable)
+            error = ("unauthorized: this leader requires a job token "
+                     "(DLD_JOB_TOKEN) for enable/disable")
+        elif msg.enable and msg.disable:
+            error = "conflicting verbs: Enable and Disable both set"
+        elif msg.enable:
+            self.policy.set_enabled(True)
+        elif msg.disable:
+            self.policy.set_enabled(False)
+        elif not msg.query:
+            error = "no verb: set Query, Enable, or Disable"
+        try:
+            self.node.add_node(msg.src_id)
+            self.node.transport.send(
+                msg.src_id,
+                PolicyCtlMsg(self.node.my_id,
+                             table=self.policy.table(),
+                             error=error, epoch=self.epoch))
+        except (OSError, KeyError, ConnectionError) as e:
+            log.error("policy ctl reply undeliverable",
+                      dest=msg.src_id, err=repr(e))
+
+    def serve_quarantined(self) -> Set[NodeID]:
+        """The policy engine's serve-rotation mask (docs/autonomy.md):
+        replicas the A/B split and rollout soak baselining route
+        around.  Empty on an unarmed fleet."""
+        return self.policy.quarantined()
+
+    def policy_grow(self, model_node: NodeID, action_id: str) -> str:
+        """Autonomy actuator (docs/autonomy.md): grow the replica set
+        of ``model_node`` — copy its held layer set onto a placeable
+        spare via a join+refill job through ``submit_job`` (the same
+        chokepoint the join path uses), origin avoided.  Returns the
+        job id, or "" when no spare exists / nothing to copy (the
+        engine audits the skip)."""
+        with self._lock:
+            metas = dict(self.status.get(model_node) or {})
+            busy = set(self.assignment) | {self.node.my_id}
+        if not metas:
+            return ""
+        spares = self.membership.spares(busy | {model_node})
+        if not spares:
+            log.warn("policy grow: no placeable spare",
+                     model=model_node)
+            return ""
+        spare = spares[0]
+        target = {spare: {int(lid): LayerMeta(
+            version=getattr(meta, "version", ""))
+            for lid, meta in metas.items()}}
+        jid = f"policy-{action_id}"
+        self.submit_job(jid, target, kind="join",
+                        avoid={self.node.my_id} - {model_node},
+                        submitter="policy")
+        log.warn("policy grow submitted", job=jid, model=model_node,
+                 spare=spare, layers=len(metas))
+        return jid
+
+    def policy_rehome(self, node: NodeID, action_id: str) -> str:
+        """Autonomy actuator (docs/autonomy.md): proactively re-home a
+        death-suspect node's UNIQUE holdings before the failure
+        detector's crash path fires — the drain plane's re-home
+        derivation reused as a NON-destructive repair job (the suspect
+        stays a member; if it was merely slow, the run just gained
+        redundant copies).  Returns the job id, or "" when nothing is
+        uniquely at risk."""
+        with self._lock:
+            target: Assignment = {}
+            for lid, shard, codec in self._unique_holdings_locked(node):
+                dest = self._rehome_dest_locked(node, lid, shard, codec)
+                if dest is None:
+                    log.warn("policy rehome: no placeable dest",
+                             node=node, layer=lid)
+                    continue
+                target.setdefault(dest, {})[lid] = LayerMeta(
+                    shard=shard, codec=codec)
+                if codec:
+                    # Same pinning as _drain_rehome: the re-home ships
+                    # the qualified form the suspect holds.
+                    self._codec_choice[(dest, lid)] = codec
+                    self._codec_seen = True
+        if not target:
+            return ""
+        jid = f"policy-{action_id}"
+        # The suspect is NOT avoided as a source: it may be the only
+        # holder — if it is truly dead its sends stall and the crash
+        # path's salvage takes over; if it is slow, slow beats never.
+        self.submit_job(jid, target, kind="repair", submitter="policy")
+        log.warn("policy rehome submitted", job=jid, node=node,
+                 layers=sorted(l for r in target.values() for l in r))
+        return jid
 
     # ------------------------------------------------ elastic membership
 
@@ -4803,6 +4955,16 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         # job_id -> its priority tier's solved min time (ms): per-job
         # pacing for multi-job dispatches (docs/service.md).
         self._tier_time: Dict[str, int] = {}
+        # Autonomy link demotions (docs/autonomy.md): (src, dest) ->
+        # measured bytes/s.  The flow solver prices these arcs at the
+        # measured rate instead of infinity, so re-plans route AROUND a
+        # straggling link without removing it from the graph.
+        self._link_demotions: Dict[Tuple[NodeID, NodeID], int] = {}
+        # Plan generation: bumped on every solve.  Revokes carry the
+        # generation they fenced; re-dispatched commands carry the NEW
+        # one — a late revoke can no longer eat the re-plan's fresh
+        # command for the same (job, dest, layer) (docs/service.md).
+        self._plan_gen = 0
         if topology is not None:
             # Pre-warm the LP solver import (scipy + HiGHS, ~1-2 s cold)
             # off the critical path: the first assign_jobs otherwise pays
@@ -5411,6 +5573,14 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     tagged = tagged or owner is not None
                     by_tier.setdefault(key, {}).setdefault(
                         dest, {})[layer_id] = meta
+            # Every solve is a new plan generation: commands dispatched
+            # below carry it, and any revoke issued against an EARLIER
+            # generation can no longer eat them (docs/service.md).
+            self._plan_gen += 1
+            self._replicate("plan_gen", Gen=self._plan_gen)
+            # Autonomy link demotions (docs/autonomy.md): straggling
+            # links price at their measured rate instead of infinity.
+            demotions = dict(self._link_demotions)
             if not tagged:
                 graph = make_flow_graph(
                     modified, src_status, layer_sizes,
@@ -5418,6 +5588,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     remaining=remaining_sizes, topology=self.topology,
                     codec_sizes=codec_sizes, node_codecs=node_codecs,
                     base_holders=base_holders,
+                    link_demotions=demotions,
                 )
                 t, jobs = graph.get_job_assignment()
             else:
@@ -5431,7 +5602,8 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     topology=self.topology,
                     graph_factory=make_flow_graph,
                     codec_sizes=codec_sizes, node_codecs=node_codecs,
-                    base_holders=base_holders)
+                    base_holders=base_holders,
+                    link_demotions=demotions)
                 t = max(t_by_prio.values(), default=0)
                 # Per-job pacing: each send's rate budget comes from its
                 # OWN tier's min time (a preempting tier must not be
@@ -5465,6 +5637,12 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
             return
         targets: Dict[NodeID, Dict[str, Set[Tuple[NodeID, LayerID]]]] = {}
         with self._lock:
+            # The generation being revoked: commands from the re-plan
+            # that follows carry a HIGHER one, so a slow sender applying
+            # this revoke late cannot eat the re-dispatched command for
+            # the same (job, dest, layer) — the wrong-eat race
+            # (docs/service.md).
+            gen = self._plan_gen
             for sender, job_list in self._live_jobs.items():
                 for fj in job_list:
                     if not fj.job_id or fj.job_id == job.job_id:
@@ -5490,16 +5668,43 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                 if sender == self.node.my_id:
                     # The leader's own queue honors the registry
                     # directly — no wire round-trip to itself.
-                    self.revokes.add(jid, sorted(pairs))
+                    self.revokes.add(jid, sorted(pairs), gen=gen)
                     continue
                 try:
                     self.node.transport.send(
                         sender, JobRevokeMsg(self.node.my_id, jid,
                                              sorted(pairs),
-                                             epoch=self.epoch))
+                                             epoch=self.epoch, gen=gen))
                 except (OSError, KeyError) as e:
                     log.warn("revoke send failed (the demoted sends "
                              "simply run)", sender=sender, err=repr(e))
+
+    # ---------------------------------------- autonomy actuators (mode 3)
+
+    def policy_demote_link(self, src: NodeID, dest: NodeID,
+                           bps: int) -> None:
+        """Install a straggler-link demotion and re-plan around it
+        (docs/autonomy.md): the flow solver prices the (src, dest) arc
+        at the measured ``bps`` instead of infinity, so every pair that
+        CAN route elsewhere does, and pairs with no alternative keep
+        the slow path at an honest rate budget.  Called by the policy
+        engine (never under its lock)."""
+        with self._lock:
+            self._link_demotions[(int(src), int(dest))] = int(bps)
+        log.warn("link demoted for planning; re-planning around it",
+                 src=src, dest=dest, bps=int(bps))
+        trace.count("policy.link_demotions")
+        self._drive(self._update_replan)
+
+    def policy_lift_link(self, src: NodeID, dest: NodeID) -> None:
+        """Lift a link demotion after a ``link_recovered`` event and
+        re-plan at full modeled capacity."""
+        with self._lock:
+            if self._link_demotions.pop((int(src), int(dest)),
+                                        None) is None:
+                return
+        log.info("link demotion lifted; re-planning", src=src, dest=dest)
+        self._drive(self._update_replan)
 
     def _forget_sender_jobs(self, node: NodeID) -> None:
         """A cleanly-departed seat's dispatched sends are simply
@@ -5654,6 +5859,10 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
         seconds).  Fabric-eligible job groups ride the device plane
         instead."""
         jobs = self._split_fabric_jobs(jobs)
+        with self._lock:
+            # The generation these commands belong to: a revoke fenced
+            # at an older generation must not eat them (docs/service.md).
+            gen = self._plan_gen
         for dest, job_list in self_jobs.items():
             for job in job_list:
                 with self._lock:
@@ -5665,6 +5874,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                     FlowRetransmitMsg(
                         self.node.my_id, job.layer_id, job.sender_id,
                         job.data_size, job.offset, rate, epoch=self.epoch,
+                        gen=gen,
                     ),
                 )
         with self._lock:
@@ -5705,7 +5915,7 @@ class FlowRetransmitLeaderNode(RetransmitLeaderNode):
                             self.node.my_id, job.layer_id, dest,
                             job.data_size, job.offset, rate,
                             epoch=self.epoch, job_id=job.job_id,
-                            codec=codec,
+                            codec=codec, gen=gen,
                         ),
                     )
                 except (OSError, KeyError) as e:

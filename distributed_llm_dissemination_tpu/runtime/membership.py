@@ -219,6 +219,19 @@ class MembershipTable:
             return {n for n, rec in self._members.items()
                     if rec.state in (ACTIVE, JOINING)}
 
+    def spares(self, busy) -> List[NodeID]:
+        """Placeable seats NOT in ``busy`` — the candidate pool the
+        autonomy engine's grow rule places a replica refill onto
+        (docs/autonomy.md).  Sorted for deterministic policy choice;
+        ACTIVE (verified) seats order before still-JOINING ones so a
+        grow lands on settled capacity when any exists."""
+        busy = set(int(b) for b in busy)
+        with self._lock:
+            pool = [(0 if rec.state == ACTIVE else 1, n)
+                    for n, rec in self._members.items()
+                    if rec.state in (ACTIVE, JOINING) and n not in busy]
+        return [n for _, n in sorted(pool)]
+
     def draining(self) -> List[NodeID]:
         with self._lock:
             return sorted(n for n, rec in self._members.items()

@@ -3015,7 +3015,8 @@ class RetransmitReceiverNode(ReceiverNode):
         (and in-flight ones stop between fragments)."""
         if self._fence_stale(msg):
             return
-        n = self.revokes.add(msg.job_id, msg.pairs)
+        n = self.revokes.add(msg.job_id, msg.pairs,
+                             gen=getattr(msg, "gen", 0))
         log.info("preemption revoke registered", job=msg.job_id,
                  pairs=len(msg.pairs), registry=n)
 

@@ -409,8 +409,19 @@ class RolloutDriver:
         with self.leader._lock:
             return dict(self.leader.cluster_metrics.get(node) or {})
 
+    def _quarantined(self) -> set:
+        """The policy engine's serve-rotation mask (docs/autonomy.md);
+        empty when no engine is armed (or on a pre-policy leader)."""
+        getter = getattr(self.leader, "serve_quarantined", None)
+        return set(getter()) if getter is not None else set()
+
     def _baseline_wave(self, rid: str, idx: int, dests) -> None:
-        views = {d: serve_view(self._metrics_row(d), d) for d in dests}
+        # A quarantined replica's latency is exactly what the policy
+        # engine flagged — baselining on it would poison the SLO
+        # verdicts for the whole wave (docs/autonomy.md).
+        quarantined = self._quarantined()
+        views = {d: serve_view(self._metrics_row(d), d) for d in dests
+                 if d not in quarantined}
         with self._lock:
             self._baselines[(rid, idx)] = views
 
@@ -440,6 +451,12 @@ class RolloutDriver:
             dests = list(rec["waves"][idx])
             slo = dict(rec["slo"])
             baseline = self._baselines.get((rid, idx)) or {}
+        # Quarantined replicas get no verdict: the policy engine
+        # already flagged them, and counting their latency here would
+        # conflate "this WAVE regressed" with "this REPLICA is sick"
+        # (docs/autonomy.md).
+        quarantined = self._quarantined()
+        dests = [d for d in dests if d not in quarantined]
         replicas = {}
         breached = []
         for d in dests:
@@ -717,14 +734,19 @@ class RolloutDriver:
     def _traffic_locked(self, rec: dict) -> dict:
         """The A/B pools the split knob routes between: replicas of
         flipped waves serve v2, everyone else v1 (a FAILED wave rolled
-        back, so its replicas are v1 again)."""
+        back, so its replicas are v1 again).  Policy-quarantined
+        replicas (docs/autonomy.md) are masked OUT of both pools and
+        surfaced under their own key — traffic routes around a
+        breacher, operators still see it."""
+        quarantined = self._quarantined()
         v2 = []
         v1 = []
         for i, dests in enumerate(rec["waves"]):
             st = rec["wave_states"][i]
-            (v2 if st in (W_COMMITTING, W_SOAKING, W_PASSED)
-             else v1).extend(dests)
-        return {"split": rec["split"], "v2": sorted(v2), "v1": sorted(v1)}
+            pool = v2 if st in (W_COMMITTING, W_SOAKING, W_PASSED) else v1
+            pool.extend(d for d in dests if d not in quarantined)
+        return {"split": rec["split"], "v2": sorted(v2), "v1": sorted(v1),
+                "quarantined": sorted(quarantined)}
 
     def _summary_locked(self, rid: str) -> dict:
         rec = self._recs[rid]

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from ..core.types import (
     CLIENT_ID,
@@ -200,26 +200,46 @@ class RevokeRegistry:
     are CONSUMED on first match (the re-plan that triggered the revoke
     re-dispatches the same pair at the demoted rate — the fresh command
     must not be eaten too) and TTL-bounded (a revocation whose send
-    already finished must not linger to eat a future command)."""
+    already finished must not linger to eat a future command).
+
+    Generation keying closes the wrong-eat race the TTL alone left
+    open: a revoke carries the plan generation it fenced, a dispatched
+    command carries the generation of the solve that produced it, and
+    an entry eats ONLY commands stamped at or below its generation — a
+    revoke applied late at a slow sender can no longer eat the
+    re-plan's fresh command for the same (job, dest, layer).  ``gen=0``
+    on both sides preserves the legacy (TTL-only) behavior."""
 
     TTL_S = 30.0
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._revoked: Dict[tuple, float] = {}  # (job, dest, layer) -> t
+        # (job, dest, layer) -> (wall time, revoked plan generation)
+        self._revoked: Dict[tuple, Tuple[float, int]] = {}
 
-    def add(self, job_id: str, pairs) -> int:
+    def add(self, job_id: str, pairs, gen: int = 0) -> int:
         import time
 
         now = time.time()
         with self._lock:
             for dest, lid in pairs:
-                self._revoked[(str(job_id), int(dest), int(lid))] = now
+                key = (str(job_id), int(dest), int(lid))
+                old = self._revoked.get(key)
+                # A newer revoke's generation wins; never let a stale
+                # re-delivery LOWER the fence.
+                g = max(int(gen), old[1] if old else 0)
+                self._revoked[key] = (now, g)
             return len(self._revoked)
 
-    def consume(self, job_id: str, dest: NodeID, lid: LayerID) -> bool:
-        """True when (job, dest, layer) is revoked; the entry is spent
-        by the check."""
+    def consume(self, job_id: str, dest: NodeID, lid: LayerID,
+                gen: int = 0) -> bool:
+        """True when (job, dest, layer) is revoked for this command's
+        plan generation; a match spends the entry.  A command from a
+        NEWER generation than the revoke survives — and leaves the
+        entry ARMED, because the stale command it fences may still be
+        queued (or mid-fragments) behind this one; popping here would
+        disarm the revoke before its target ever checked (TTL bounds
+        the entry if that command never arrives)."""
         import time
 
         if not job_id:
@@ -227,11 +247,18 @@ class RevokeRegistry:
         key = (str(job_id), int(dest), int(lid))
         now = time.time()
         with self._lock:
-            t = self._revoked.pop(key, None)
-            if t is None:
+            rec = self._revoked.get(key)
+            if rec is None:
                 return False
+            t, revoked_gen = rec
             if now - t > self.TTL_S:
+                del self._revoked[key]
                 return False  # expired: treat as never revoked
+            if int(gen) > revoked_gen:
+                # The command postdates the revoke's plan: it is the
+                # re-dispatch the revoke made room for — let it run.
+                return False
+            del self._revoked[key]
             return True
 
 
@@ -572,7 +599,8 @@ def handle_flow_retransmit(
         log.error("no layer for flow job", layerID=msg.layer_id)
         return
     if (revokes is not None
-            and revokes.consume(msg.job_id, msg.dest_id, msg.layer_id)):
+            and revokes.consume(msg.job_id, msg.dest_id, msg.layer_id,
+                                gen=getattr(msg, "gen", 0))):
         trace.count("jobs.revoked_pairs")
         log.warn("queued flow send revoked by preemption; dropped",
                  layerID=msg.layer_id, dest=msg.dest_id, job=msg.job_id)
@@ -605,7 +633,8 @@ def handle_flow_retransmit(
         while sent < msg.data_size:
             if (sent > 0 and revokes is not None
                     and revokes.consume(msg.job_id, msg.dest_id,
-                                        msg.layer_id)):
+                                        msg.layer_id,
+                                        gen=getattr(msg, "gen", 0))):
                 trace.count("jobs.revoked_pairs")
                 log.warn("in-flight flow send revoked mid-job; stopping",
                          layerID=msg.layer_id, dest=msg.dest_id,

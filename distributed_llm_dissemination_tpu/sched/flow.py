@@ -391,6 +391,7 @@ def solve_joint(
     codec_sizes: Optional[Dict[Tuple[LayerID, str], int]] = None,
     node_codecs: Optional[Dict[NodeID, frozenset]] = None,
     base_holders: Optional[Dict[str, frozenset]] = None,
+    link_demotions: Optional[Dict[Tuple[NodeID, NodeID], int]] = None,
 ) -> Tuple[Dict[int, int], FlowJobsMap]:
     """All active jobs' remaining demands as ONE flow problem per
     priority tier (docs/service.md) — the multi-job generalization of a
@@ -512,7 +513,8 @@ def solve_joint(
         graph = factory(merged, status_view, layer_sizes, bw_res,
                         remaining=rem, topology=topology,
                         codec_sizes=codec_sizes, node_codecs=node_codecs,
-                        base_holders=base_holders)
+                        base_holders=base_holders,
+                        link_demotions=link_demotions)
         t, jobs = graph.get_job_assignment()
         planned = sum(j.data_size for jl in jobs.values() for j in jl)
         if avoid and planned < required:
@@ -527,7 +529,8 @@ def solve_joint(
                             remaining=rem, topology=topology,
                             codec_sizes=codec_sizes,
                             node_codecs=node_codecs,
-                            base_holders=base_holders)
+                            base_holders=base_holders,
+                            link_demotions=link_demotions)
             t, jobs = graph.get_job_assignment()
         t_by_prio[prio] = max(t_by_prio.get(prio, 0), t)
         per_dest: Dict[NodeID, int] = {}
@@ -712,6 +715,7 @@ class FlowGraph:
         codec_sizes: Optional[Dict[Tuple[LayerID, str], int]] = None,
         node_codecs: Optional[Dict[NodeID, frozenset]] = None,
         base_holders: Optional[Dict[str, frozenset]] = None,
+        link_demotions: Optional[Dict[Tuple[NodeID, NodeID], int]] = None,
     ):
         """``remaining``: optional per-(layer, dest) byte overrides — a
         resumed dest needs only its gap bytes, not the full layer.
@@ -738,7 +742,18 @@ class FlowGraph:
         bytes with that digest.  A ``"delta:<hex>"`` pair is only
         admissible from a sender that holds BOTH the base and the delta
         capability — a sender with the capability but not the base
-        would have nothing to encode against."""
+        would have nothing to encode against.
+
+        ``link_demotions`` (closed-loop autonomy, docs/autonomy.md):
+        (src, dest) → demoted modeled bytes/s for links the health
+        plane flagged as straggling — the solver then prices the slow
+        path at its MEASURED rate instead of the declared one and
+        routes around it whenever an alternative holder wins.  Honest
+        limit: the demotion caps each (sender, layer, dest) arc, not
+        the aggregate of all layers crossing the link — multiple
+        concurrent layers on one demoted link can together exceed the
+        demoted rate (the declared per-node NIC budget still bounds
+        them)."""
         self.assignment = assignment
         self.layer_sizes = layer_sizes
         self.node_network_bw = node_network_bw
@@ -747,6 +762,9 @@ class FlowGraph:
         self.codec_sizes = codec_sizes or {}
         self.node_codecs = node_codecs or {}
         self.base_holders = base_holders or {}
+        self.link_demotions = {
+            (int(s), int(d)): int(bps)
+            for (s, d), bps in (link_demotions or {}).items() if bps > 0}
         self._slice: Dict[NodeID, int] = (
             topology.slices() if topology is not None else {}
         )
@@ -993,7 +1011,15 @@ class FlowGraph:
                         self.cap[cls][xin] = _INF
                         self.cap[xout][layer] = _INF
                     else:
-                        self.cap[cls][layer] = _INF
+                        demoted = self.link_demotions.get(
+                            (node_id, dest))
+                        # A health-flagged straggler link is priced at
+                        # its demoted measured rate, not _INF — the
+                        # max-flow then routes around it whenever any
+                        # alternative holder wins (docs/autonomy.md).
+                        self.cap[cls][layer] = (
+                            demoted * t // TIME_SCALE
+                            if demoted else _INF)
         for a, b in self.x_pairs:
             xin = self.idx[_V("xin", node_id=a, layer_id=b)]
             xout = self.idx[_V("xout", node_id=a, layer_id=b)]

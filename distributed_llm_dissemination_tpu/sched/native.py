@@ -109,8 +109,17 @@ class NativeFlowGraph(FlowGraph):
                         contrib[(node_id, layer_id, dest)] = len(eu)
                         eu.append(cls)
                         ev.append(layer)
-                        const.append(_INF)
-                        per_t.append(0)
+                        # A health-demoted straggler link is priced at
+                        # its measured rate instead of _INF, mirroring
+                        # FlowGraph._build (docs/autonomy.md).
+                        demoted = self.link_demotions.get(
+                            (node_id, dest))
+                        if demoted:
+                            const.append(0)
+                            per_t.append(demoted)
+                        else:
+                            const.append(_INF)
+                            per_t.append(0)
         for a, b in self.x_pairs:
             eu.append(self.idx[_V("xin", node_id=a, layer_id=b)])
             ev.append(self.idx[_V("xout", node_id=a, layer_id=b)])
@@ -219,6 +228,7 @@ def make_flow_graph(
     codec_sizes=None,
     node_codecs=None,
     base_holders=None,
+    link_demotions=None,
 ) -> FlowGraph:
     """The fastest available mode-3 scheduler for this environment.
 
@@ -234,4 +244,4 @@ def make_flow_graph(
     return cls(assignment, status, layer_sizes, node_network_bw,
                remaining=remaining, topology=topology,
                codec_sizes=codec_sizes, node_codecs=node_codecs,
-               base_holders=base_holders)
+               base_holders=base_holders, link_demotions=link_demotions)

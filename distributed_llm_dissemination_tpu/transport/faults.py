@@ -149,6 +149,14 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
       the rollout pipeline (docs/rollout.md): a wave's replicas answer
       slowly enough to breach the declared p99 SLO, without dropping a
       single request
+    - ``flap=P@T1-T2[:N]``: a FLAPPING link to peer P — the [T1, T2)
+      window splits into N (default 3) equal up/down cycles, each
+      cycle's first half DOWN (a partition to P) and second half up.
+      Purely sugar over ``partition``: the parser emits N partition
+      rules with deterministic windows, so a flap replays bit-for-bit
+      like every time-scheduled fault.  The autonomy chaos case
+      (docs/autonomy.md) uses it to prove a flapping link is
+      quarantined/demoted ONCE, not toggled every interval
 
     e.g. ``seed=7,corrupt=9,dropin=13,dup=11,times=8``.  Returns
     ``(seed, rules)`` — hand both to ``FaultyTransport``."""
@@ -173,6 +181,26 @@ def rules_from_spec(spec: str) -> Tuple[int, List[FaultRule]]:
             pending.append(lambda sd, tm, p=int(peer), a=t1, b=t2:
                            FaultRule("partition", "out", dest=p,
                                      t_start=a, t_end=b))
+            continue
+        if key == "flap":
+            peer, _, rest = val.partition("@")
+            window, _, n_s = rest.partition(":")
+            t1s, _, t2s = window.partition("-")
+            if not t2s:
+                raise ValueError(
+                    "flap needs a bounded window: flap=P@T1-T2[:N]")
+            t1, t2 = float(t1s or 0.0), float(t2s)
+            cycles = int(n_s or 3)
+            if cycles < 1 or t2 <= t1:
+                raise ValueError(f"bad flap window/cycles: {val!r}")
+            # N down/up cycles of equal width: cycle i is DOWN for
+            # [t1 + 2iW, t1 + (2i+1)W), up for the next W.
+            w = (t2 - t1) / (2 * cycles)
+            for i in range(cycles):
+                a = t1 + 2 * i * w
+                pending.append(lambda sd, tm, p=int(peer), a=a, b=a + w:
+                               FaultRule("partition", "out", dest=p,
+                                         t_start=a, t_end=b))
             continue
         if key == "kill_after":
             pending.append(lambda sd, tm, t=float(val):

@@ -166,6 +166,15 @@ class MsgType(enum.IntEnum):
     # themselves replicate via ControlDeltaMsg kind "rollout" + the
     # snapshot's Rollouts section — this message is only the operator
     # front door.
+    # POLICY_CTL — closed-loop fleet autonomy (docs/autonomy.md): the
+    # operator channel of the leader-side policy engine.  A QUERY
+    # (operator seat → leader) asks for the policy table (armed rules,
+    # cooldowns, quarantine mask, audit trail); ENABLE/DISABLE toggle
+    # automatic actioning at runtime (token-gated — dropping a fleet to
+    # manual is an operator act); the leader's reply carries ``table``.
+    # The policy STATE itself replicates via ControlDeltaMsg kind
+    # "policy" + the snapshot's Policy section — this message is only
+    # the operator front door.
     HEARTBEAT = 8
     BOOT_READY = 9
     DEVICE_PLAN = 10
@@ -190,6 +199,7 @@ class MsgType(enum.IntEnum):
     JOIN = 29
     DRAIN = 30
     ROLLOUT_CTL = 31
+    POLICY_CTL = 32
 
 
 def _epoch_to_payload(payload: dict, epoch: int) -> dict:
@@ -401,7 +411,15 @@ class FlowRetransmitMsg:
     ENCODED blob (the sender encodes its raw copy once and serves
     ranges of the cached form, or serves a same-codec holding
     verbatim).  "" = canonical bytes, omitted on the wire — a legacy
-    peer never sees the key."""
+    peer never sees the key.
+
+    ``gen`` (docs/service.md): the leader plan generation that computed
+    this command.  A revoke is keyed to the generation it revoked
+    (``JobRevokeMsg.gen``); a replacing re-plan's command carries a
+    NEWER generation and therefore survives a stale queued revoke — the
+    close of the PR 9 "wrong-eat race".  0 = pre-generation leader,
+    omitted on the wire (legacy peers keep the old last-writer-wins
+    semantics)."""
 
     src_id: NodeID
     layer_id: LayerID
@@ -412,6 +430,7 @@ class FlowRetransmitMsg:
     epoch: int = -1
     job_id: str = ""  # the admitted job this send serves ("" = base run)
     codec: str = ""
+    gen: int = 0
 
     msg_type = MsgType.FLOW_RETRANSMIT
 
@@ -426,6 +445,8 @@ class FlowRetransmitMsg:
         }, self.epoch), self.job_id)
         if self.codec:
             payload["Codec"] = str(self.codec)
+        if self.gen:
+            payload["Gen"] = int(self.gen)
         return payload
 
     @classmethod
@@ -440,6 +461,7 @@ class FlowRetransmitMsg:
             int(d.get("Epoch", -1)),
             str(d.get("Job", "")),
             str(d.get("Codec", "")),
+            int(d.get("Gen", 0)),
         )
 
 
@@ -1133,8 +1155,10 @@ class ControlDeltaMsg:
     "metrics" | "base_assignment" | "job" | "job_done" — the last two
     carry the dissemination service's admitted-job records,
     docs/service.md — | "swap" | "rollout", the live-swap and
-    rollout-pipeline records, docs/swap.md + docs/rollout.md); ``data``
-    is the
+    rollout-pipeline records, docs/swap.md + docs/rollout.md — |
+    "policy", the autonomy engine's full state REPLACE — armed rules,
+    cooldowns, quarantine mask, in-flight actions — docs/autonomy.md);
+    ``data`` is the
     kind-specific JSON payload; ``seq`` is a per-leader monotonic
     counter (diagnostics — the shadow is reconciliation-corrected at
     takeover, so ordering races only cost re-sent bytes, never
@@ -1556,12 +1580,19 @@ class JobRevokeMsg:
     already completed simply ignores the revocation (the registry entry
     is consumed on first match and TTL-bounded), and a send wrongly
     dropped is re-planned by the very re-plan that triggered the
-    revoke.  Dropped pairs count on ``jobs.revoked_pairs``."""
+    revoke.  Dropped pairs count on ``jobs.revoked_pairs``.
+
+    ``gen``: the plan generation this revoke fences (docs/service.md) —
+    the registry entry only eats commands stamped with ``gen`` <= this
+    value, so the replacing re-plan's own (newer-generation) command
+    can never be consumed by its stale revoke.  0 = pre-generation
+    leader, omitted on the wire (legacy eat-anything semantics)."""
 
     src_id: NodeID
     job_id: str
     pairs: list = dataclasses.field(default_factory=list)  # [[dest, layer]]
     epoch: int = -1
+    gen: int = 0
 
     msg_type = MsgType.JOB_REVOKE
 
@@ -1569,6 +1600,8 @@ class JobRevokeMsg:
         payload: dict = {"SrcID": self.src_id, "JobID": str(self.job_id)}
         if self.pairs:
             payload["Pairs"] = [[int(d), int(l)] for d, l in self.pairs]
+        if self.gen:
+            payload["Gen"] = int(self.gen)
         return _epoch_to_payload(payload, self.epoch)
 
     @classmethod
@@ -1578,6 +1611,7 @@ class JobRevokeMsg:
             str(d["JobID"]),
             [[int(p[0]), int(p[1])] for p in d.get("Pairs") or []],
             int(d.get("Epoch", -1)),
+            int(d.get("Gen", 0)),
         )
 
 
@@ -1949,6 +1983,72 @@ class RolloutCtlMsg:
         )
 
 
+@dataclasses.dataclass
+class PolicyCtlMsg:
+    """Operator seat ↔ leader: the autonomy engine's control channel
+    (docs/autonomy.md).
+
+    Verbs (operator seat → leader):
+
+    - **query** (``query=True``): return the policy table — armed
+      rules, enabled flag, cooldown deadlines, quarantine mask, and
+      the recent audit trail of fired actions.
+    - **enable** (``enable=True``) / **disable** (``disable=True``):
+      toggle automatic actioning at runtime.  Disable is the soft
+      kill-switch: rules keep evaluating (streaks/cooldowns stay
+      warm) but no action fires until re-enabled.  The hard
+      kill-switch is ``DLD_POLICY=0`` (env, overrides everything).
+
+    The reply (leader → requester) carries ``table`` (and ``error``
+    for refusals) — always ANSWERED, the serving invariant.
+
+    ``auth``: the shared-secret job token (docs/service.md).  The
+    MUTATING verbs — enable / disable — change whether the fleet acts
+    on itself, so a DLD_JOB_TOKEN-armed leader refuses them
+    unauthenticated exactly like job submission; query stays open like
+    ``-jobs``.  Omitted on the wire when empty."""
+
+    src_id: NodeID
+    query: bool = False
+    enable: bool = False
+    disable: bool = False
+    table: dict = dataclasses.field(default_factory=dict)
+    error: str = ""
+    epoch: int = -1
+    auth: str = ""
+
+    msg_type = MsgType.POLICY_CTL
+
+    def to_payload(self) -> dict:
+        payload: dict = {"SrcID": self.src_id}
+        if self.query:
+            payload["Query"] = True
+        if self.enable:
+            payload["Enable"] = True
+        if self.disable:
+            payload["Disable"] = True
+        if self.table:
+            payload["Table"] = dict(self.table)
+        if self.error:
+            payload["Error"] = str(self.error)
+        if self.auth:
+            payload["Auth"] = str(self.auth)
+        return _epoch_to_payload(payload, self.epoch)
+
+    @classmethod
+    def from_payload(cls, d: dict) -> "PolicyCtlMsg":
+        return cls(
+            int(d["SrcID"]),
+            bool(d.get("Query", False)),
+            bool(d.get("Enable", False)),
+            bool(d.get("Disable", False)),
+            dict(d.get("Table") or {}),
+            str(d.get("Error", "")),
+            int(d.get("Epoch", -1)),
+            str(d.get("Auth", "")),
+        )
+
+
 Message = Union[
     AnnounceMsg,
     AckMsg,
@@ -1979,6 +2079,7 @@ Message = Union[
     JoinMsg,
     DrainMsg,
     RolloutCtlMsg,
+    PolicyCtlMsg,
 ]
 
 _DECODERS = {
@@ -2013,6 +2114,7 @@ _DECODERS = {
     MsgType.JOIN: JoinMsg,
     MsgType.DRAIN: DrainMsg,
     MsgType.ROLLOUT_CTL: RolloutCtlMsg,
+    MsgType.POLICY_CTL: PolicyCtlMsg,
 }
 
 

@@ -627,13 +627,19 @@ class HealthTimeline:
                         self._events.append(ev)
                         new_events.append(dict(ev))
                 else:
-                    self._breach.pop(key, None)
+                    # Carry the recovered-from streak length and the
+                    # measured ratio on the recovery event too, so
+                    # policies (and RUN_REPORT readers) threshold on
+                    # data, not just the event name (docs/autonomy.md).
+                    streak = self._breach.pop(key, None) or 0
                     if key in self._flagged:
                         ev = {"t_ms": round(t_now, 1),
                               "kind": "link_recovered", "link": key,
                               "src": src, "dest": dest,
                               "achieved_bps": rec["bps"],
                               "modeled_bps": modeled,
+                              "frac": rec["frac"],
+                              "intervals": int(streak),
                               "onset_t_ms": self._flagged.pop(key)}
                         self._events.append(ev)
                         new_events.append(dict(ev))

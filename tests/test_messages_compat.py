@@ -49,6 +49,7 @@ from distributed_llm_dissemination_tpu.transport.messages import (
     MetricsReportMsg,
     MsgType,
     PlanResendReqMsg,
+    PolicyCtlMsg,
     RetransmitMsg,
     RolloutCtlMsg,
     ServeMsg,
@@ -117,6 +118,7 @@ CASES = {
     MsgType.JOIN: (lambda: JoinMsg(9), {"SrcID"}),
     MsgType.DRAIN: (lambda: DrainMsg(9), {"SrcID"}),
     MsgType.ROLLOUT_CTL: (lambda: RolloutCtlMsg(9), {"SrcID"}),
+    MsgType.POLICY_CTL: (lambda: PolicyCtlMsg(9), {"SrcID"}),
 }
 
 # Optional wire keys that must be OMITTED at their defaults, per type:
@@ -125,7 +127,7 @@ OMITTED_AT_DEFAULT = {
     MsgType.ANNOUNCE: {"Partial", "Digests", "Codecs", "NicBw"},
     MsgType.ACK: {"Shard", "Version", "Codec", "SpanId"},
     MsgType.RETRANSMIT: {"Epoch", "Job", "Shard", "Codec"},
-    MsgType.FLOW_RETRANSMIT: {"Epoch", "Job", "Codec"},
+    MsgType.FLOW_RETRANSMIT: {"Epoch", "Job", "Codec", "Gen"},
     MsgType.STARTUP: {"Epoch"},
     MsgType.DEVICE_PLAN: {"Epoch", "BatchID", "BatchN"},
     MsgType.SERVE: {"Epoch"},
@@ -144,7 +146,7 @@ OMITTED_AT_DEFAULT = {
     MsgType.SWAP_COMMIT: {"Epoch", "SwapBase", "Abort", "Query",
                           "Applied", "Prepare", "Error", "Revert",
                           "Finalize"},
-    MsgType.JOB_REVOKE: {"Epoch", "Pairs"},
+    MsgType.JOB_REVOKE: {"Epoch", "Pairs", "Gen"},
     MsgType.GROUP_PLAN: {"Epoch", "Targets", "Dissolve", "Forward"},
     MsgType.GROUP_STATUS: {"Covered", "Announced", "Dead", "Metrics",
                            "Spans", "Digests", "Codecs"},
@@ -153,6 +155,8 @@ OMITTED_AT_DEFAULT = {
     MsgType.DRAIN: {"Node", "Done", "Error", "Epoch"},
     MsgType.ROLLOUT_CTL: {"RolloutID", "Query", "Pause", "Resume",
                           "Split", "Table", "Error", "Epoch", "Auth"},
+    MsgType.POLICY_CTL: {"Query", "Enable", "Disable", "Table",
+                         "Error", "Epoch", "Auth"},
 }
 
 

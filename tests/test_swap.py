@@ -672,11 +672,20 @@ def test_swap_soak_under_straggler_link():
 
 
 @pytest.mark.timeout(120)
-def test_preemption_revoke_drops_demoted_queued_sends():
+def test_preemption_revoke_drops_demoted_queued_sends(monkeypatch):
     """A higher-priority admission revokes a lower tier's dispatched-
-    but-undelivered sends: the sender drops the queued pair (counted on
-    jobs.revoked_pairs) and the re-plan re-dispatches it at the demoted
-    budget — delivery still completes."""
+    but-undelivered sends: the revoke is keyed to the PRE-re-plan
+    generation, so the ORIGINAL in-flight send eats it at a fragment
+    boundary (counted on jobs.revoked_pairs) while the re-plan's
+    re-dispatch — stamped with the bumped generation — sails through
+    and completes delivery at the demoted budget."""
+    from distributed_llm_dissemination_tpu.runtime import send as send_mod
+
+    # Small fragments so the 1 MiB crawl spans several fragment
+    # boundaries: the mid-job revoke check only runs BETWEEN fragments,
+    # and at the default 16 MiB (x stripes) the layer is one fragment
+    # and the original send would never look.
+    monkeypatch.setattr(send_mod, "FLOW_FRAGMENT_BYTES", 64 * 1024)
     before = _counters()
     ids = [0, 1, 2]
     ts, _ = make_transports("inmem", ids)
